@@ -1,0 +1,13 @@
+"""incubator_predictionio_tpu — a TPU-native machine-learning server framework.
+
+A fresh implementation of Apache PredictionIO's contracts (DASE engines, event
+server, storage registry, CLI) with the Spark-on-JVM execution layer replaced by
+an idiomatic JAX/XLA stack: training runs as jit/pjit programs sharded over the
+TPU ICI mesh, serving calls into a resident TPU inference shard.
+
+Reference structural analysis: SURVEY.md at the repo root.
+"""
+
+__version__ = "0.1.0"
+
+# Short convenience alias used throughout docs/tests:  import incubator_predictionio_tpu as piotpu

@@ -1,0 +1,25 @@
+"""Data layer: event model, property aggregation, storage registry, developer stores.
+
+TPU-native counterpart of the reference's ``data/`` module
+(data/src/main/scala/org/apache/predictionio/data/ in the reference tree).
+"""
+
+from incubator_predictionio_tpu.data.event import (
+    DataMap,
+    Event,
+    EventValidationError,
+    PropertyMap,
+    validate_event,
+)
+from incubator_predictionio_tpu.data.bimap import BiMap
+from incubator_predictionio_tpu.data.aggregator import aggregate_properties
+
+__all__ = [
+    "DataMap",
+    "Event",
+    "EventValidationError",
+    "PropertyMap",
+    "validate_event",
+    "BiMap",
+    "aggregate_properties",
+]

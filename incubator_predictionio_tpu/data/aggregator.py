@@ -1,0 +1,103 @@
+"""Property aggregation: fold ``$set``/``$unset``/``$delete`` event streams into snapshots.
+
+Behavioral parity with the reference aggregators
+(data/.../storage/LEventAggregator.scala:42-150 and PEventAggregator.scala:192):
+events are sorted by event time per entity and folded left; ``$set`` merges
+properties (right-biased), ``$unset`` removes keys, ``$delete`` drops the
+snapshot entirely (but first/last updated times survive a delete, matching the
+reference fold); non-special events are ignored. Entities whose final snapshot
+is deleted are absent from the result.
+
+The distributed flavor in the reference (PEventAggregator, Spark RDD joins) is
+replaced here by a plain single-pass fold: the event store hands us per-shard
+iterators and the caller merges shard results — property aggregation is
+metadata-sized work that never needs the TPU.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+from collections.abc import Iterable
+from typing import Optional
+
+from incubator_predictionio_tpu.data.event import Event, PropertyMap
+
+#: Event names that control aggregation (LEventAggregator.scala:93).
+AGGREGATOR_EVENT_NAMES = ("$set", "$unset", "$delete")
+
+
+class _Prop:
+    __slots__ = ("fields", "defined", "first_updated", "last_updated")
+
+    def __init__(self) -> None:
+        self.fields: dict = {}
+        self.defined = False
+        self.first_updated: Optional[_dt.datetime] = None
+        self.last_updated: Optional[_dt.datetime] = None
+
+    def apply(self, e: Event) -> None:
+        if e.event == "$set":
+            if not self.defined:
+                self.fields = e.properties.to_dict()
+                self.defined = True
+            else:
+                self.fields.update(e.properties.to_dict())
+        elif e.event == "$unset":
+            if self.defined:
+                for k in e.properties:
+                    self.fields.pop(k, None)
+        elif e.event == "$delete":
+            self.fields = {}
+            self.defined = False
+        else:
+            return  # non-special events do not touch aggregation state
+        t = e.event_time
+        self.first_updated = t if self.first_updated is None else min(self.first_updated, t)
+        self.last_updated = t if self.last_updated is None else max(self.last_updated, t)
+
+    def to_property_map(self) -> Optional[PropertyMap]:
+        if not self.defined:
+            return None
+        assert self.first_updated is not None and self.last_updated is not None
+        return PropertyMap(self.fields, self.first_updated, self.last_updated)
+
+
+def aggregate_properties(events: Iterable[Event]) -> dict[str, PropertyMap]:
+    """Aggregate properties grouped by entity id (LEventAggregator.scala:42-61)."""
+    by_entity: dict[str, list[Event]] = {}
+    for e in events:
+        by_entity.setdefault(e.entity_id, []).append(e)
+    out: dict[str, PropertyMap] = {}
+    for entity_id, evs in by_entity.items():
+        evs.sort(key=lambda e: e.event_time)
+        prop = _Prop()
+        for e in evs:
+            prop.apply(e)
+        pm = prop.to_property_map()
+        if pm is not None:
+            out[entity_id] = pm
+    return out
+
+
+def aggregate_properties_single(events: Iterable[Event]) -> Optional[PropertyMap]:
+    """Aggregate a single entity's property events (LEventAggregator.scala:70-90)."""
+    evs = sorted(events, key=lambda e: e.event_time)
+    prop = _Prop()
+    for e in evs:
+        prop.apply(e)
+    return prop.to_property_map()
+
+
+def merge_shard_aggregates(
+    shards: Iterable[dict[str, PropertyMap]]
+) -> dict[str, PropertyMap]:
+    """Merge per-shard aggregation results produced over *entity-disjoint* shards.
+
+    Replaces the reference's RDD-join merge (PEventAggregator.scala:192): our
+    sharded readers partition by entity hash, so entities never straddle shards
+    and the merge is a plain dict union.
+    """
+    out: dict[str, PropertyMap] = {}
+    for shard in shards:
+        out.update(shard)
+    return out

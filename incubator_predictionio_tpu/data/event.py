@@ -1,0 +1,334 @@
+"""Canonical event model: ``Event``, ``DataMap``, ``PropertyMap``, validation.
+
+Behavioral parity with the reference's event model
+(data/src/main/scala/org/apache/predictionio/data/storage/Event.scala:42-167 and
+DataMap.scala:45-245), re-expressed as plain Python dataclasses. The event is
+the unit of ingestion for the Event Server and the unit of storage for every
+EVENTDATA backend; the device-facing input pipeline converts batches of events
+to columnar numpy arrays downstream (see data/pipeline.py), so this layer stays
+framework-free.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+from collections.abc import Iterator, Mapping
+from dataclasses import dataclass, field, replace
+from typing import Any
+
+UTC = _dt.timezone.utc
+
+# Reserved name prefixes (Event.scala:77-78).
+_RESERVED_PREFIXES = ("$", "pio_")
+
+#: Special single-entity event names (Event.scala:83).
+SPECIAL_EVENTS = frozenset({"$set", "$unset", "$delete"})
+
+#: Built-in entity types permitted despite the reserved prefix (Event.scala:146).
+BUILTIN_ENTITY_TYPES = frozenset({"pio_pr"})
+
+#: Built-in property names permitted despite the reserved prefix (Event.scala:149).
+BUILTIN_PROPERTIES: frozenset[str] = frozenset()
+
+
+class EventValidationError(ValueError):
+    """Raised when an event violates the validation contract."""
+
+
+def is_reserved_prefix(name: str) -> bool:
+    return name.startswith(_RESERVED_PREFIXES)
+
+
+def is_special_event(name: str) -> bool:
+    return name in SPECIAL_EVENTS
+
+
+def _parse_time(value: Any) -> _dt.datetime:
+    """Parse an ISO-8601 timestamp (or pass through a datetime), defaulting to UTC."""
+    if value is None:
+        return _dt.datetime.now(UTC)
+    if isinstance(value, _dt.datetime):
+        return value if value.tzinfo else value.replace(tzinfo=UTC)
+    if isinstance(value, (int, float)):
+        return _dt.datetime.fromtimestamp(value, UTC)
+    if isinstance(value, str):
+        s = value.replace("Z", "+00:00")
+        try:
+            t = _dt.datetime.fromisoformat(s)
+        except ValueError as e:
+            raise EventValidationError(f"Cannot convert {value!r} to a timestamp") from e
+        return t if t.tzinfo else t.replace(tzinfo=UTC)
+    raise EventValidationError(f"Cannot convert {value!r} to a timestamp")
+
+
+class DataMap(Mapping[str, Any]):
+    """Immutable JSON property bag with typed getters.
+
+    Parity target: reference DataMap.scala:45-245 (get/getOpt/getOrElse,
+    ``++``/``--`` merge and removal operators). Values are JSON-compatible
+    Python objects.
+    """
+
+    __slots__ = ("_fields",)
+
+    def __init__(self, fields: Mapping[str, Any] | None = None):
+        object.__setattr__(self, "_fields", dict(fields or {}))
+
+    # -- Mapping protocol -------------------------------------------------
+    def __getitem__(self, key: str) -> Any:
+        return self._fields[key]
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self._fields)
+
+    def __len__(self) -> int:
+        return len(self._fields)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"DataMap({self._fields!r})"
+
+    def __eq__(self, other: object) -> bool:
+        if isinstance(other, DataMap):
+            return self._fields == other._fields
+        if isinstance(other, Mapping):
+            return self._fields == dict(other)
+        return NotImplemented
+
+    def __hash__(self) -> int:
+        return hash(json.dumps(self._fields, sort_keys=True, default=str))
+
+    # -- typed getters (DataMap.scala:75-160) -----------------------------
+    def require(self, name: str) -> Any:
+        if name not in self._fields:
+            raise KeyError(f"The field {name} is required.")
+        return self._fields[name]
+
+    def get(self, name: str, default: Any = None) -> Any:
+        return self._fields.get(name, default)
+
+    def get_str(self, name: str) -> str:
+        return str(self.require(name))
+
+    def get_float(self, name: str) -> float:
+        return float(self.require(name))
+
+    def get_int(self, name: str) -> int:
+        return int(self.require(name))
+
+    def get_bool(self, name: str) -> bool:
+        return bool(self.require(name))
+
+    def get_list(self, name: str) -> list[Any]:
+        v = self.require(name)
+        if not isinstance(v, list):
+            raise TypeError(f"Field {name} is not a list: {v!r}")
+        return v
+
+    def get_str_list(self, name: str) -> list[str]:
+        return [str(x) for x in self.get_list(name)]
+
+    def get_double_list(self, name: str) -> list[float]:
+        return [float(x) for x in self.get_list(name)]
+
+    # -- combinators (DataMap.scala:170-200) ------------------------------
+    def merged_with(self, other: "DataMap | Mapping[str, Any]") -> "DataMap":
+        """``this ++ other``: right-biased merge."""
+        merged = dict(self._fields)
+        merged.update(dict(other))
+        return DataMap(merged)
+
+    def without(self, keys) -> "DataMap":
+        """``this -- keys``: remove the given keys."""
+        keys = set(keys)
+        return DataMap({k: v for k, v in self._fields.items() if k not in keys})
+
+    def is_empty(self) -> bool:
+        return not self._fields
+
+    def to_dict(self) -> dict[str, Any]:
+        return dict(self._fields)
+
+
+class PropertyMap(DataMap):
+    """Aggregation result: a DataMap plus first/last update times.
+
+    Parity target: reference PropertyMap.scala:36-99.
+    """
+
+    __slots__ = ("first_updated", "last_updated")
+
+    def __init__(
+        self,
+        fields: Mapping[str, Any] | None,
+        first_updated: _dt.datetime,
+        last_updated: _dt.datetime,
+    ):
+        super().__init__(fields)
+        object.__setattr__(self, "first_updated", first_updated)
+        object.__setattr__(self, "last_updated", last_updated)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (
+            f"PropertyMap({self.to_dict()!r}, first_updated={self.first_updated}, "
+            f"last_updated={self.last_updated})"
+        )
+
+    def __eq__(self, other: object) -> bool:
+        if isinstance(other, PropertyMap):
+            return (
+                self.to_dict() == other.to_dict()
+                and self.first_updated == other.first_updated
+                and self.last_updated == other.last_updated
+            )
+        return super().__eq__(other)
+
+    __hash__ = DataMap.__hash__
+
+
+@dataclass(frozen=True)
+class Event:
+    """One immutable event (reference Event.scala:42-66).
+
+    ``event_time`` is when the event happened in the external world;
+    ``creation_time`` is when the Event Server received it. Both are
+    timezone-aware datetimes (UTC default).
+    """
+
+    event: str
+    entity_type: str
+    entity_id: str
+    target_entity_type: str | None = None
+    target_entity_id: str | None = None
+    properties: DataMap = field(default_factory=DataMap)
+    event_time: _dt.datetime = field(default_factory=lambda: _dt.datetime.now(UTC))
+    tags: tuple[str, ...] = ()
+    pr_id: str | None = None
+    event_id: str | None = None
+    creation_time: _dt.datetime = field(default_factory=lambda: _dt.datetime.now(UTC))
+
+    def with_id(self, event_id: str) -> "Event":
+        return replace(self, event_id=event_id)
+
+    # -- JSON (de)serialization (EventJson4sSupport.scala:33-240) ---------
+    def to_json_dict(self) -> dict[str, Any]:
+        d: dict[str, Any] = {
+            "eventId": self.event_id,
+            "event": self.event,
+            "entityType": self.entity_type,
+            "entityId": self.entity_id,
+            "properties": self.properties.to_dict(),
+            "eventTime": self.event_time.isoformat(),
+            "tags": list(self.tags),
+            "prId": self.pr_id,
+            "creationTime": self.creation_time.isoformat(),
+            "targetEntityType": self.target_entity_type,
+            "targetEntityId": self.target_entity_id,
+        }
+        return {k: v for k, v in d.items() if v is not None}
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_json_dict(), sort_keys=True)
+
+    @staticmethod
+    def from_json_dict(d: Mapping[str, Any]) -> "Event":
+        # Trusts creationTime when present — correct for the storage round-trip
+        # (reference DBSerializer). The API ingestion path must NOT trust it:
+        # the Event Server overrides creation_time with the server receipt time
+        # (reference EventJson4sSupport.scala:77-78 forces currentTime).
+        def _req_str(key: str) -> str:
+            v = d.get(key)
+            if v is None or not isinstance(v, str):
+                raise EventValidationError(f"field {key} is required and must be a string")
+            return v
+
+        tags = d.get("tags", [])
+        if not isinstance(tags, list):
+            raise EventValidationError("tags must be a list of strings")
+        props = d.get("properties", {})
+        if props is None:
+            props = {}
+        if not isinstance(props, Mapping):
+            raise EventValidationError("properties must be a JSON object")
+        return Event(
+            event=_req_str("event"),
+            entity_type=_req_str("entityType"),
+            entity_id=_req_str("entityId"),
+            target_entity_type=d.get("targetEntityType"),
+            target_entity_id=d.get("targetEntityId"),
+            properties=DataMap(props),
+            event_time=_parse_time(d.get("eventTime")),
+            tags=tuple(str(t) for t in tags),
+            pr_id=d.get("prId"),
+            event_id=d.get("eventId"),
+            creation_time=_parse_time(d.get("creationTime")),
+        )
+
+    @staticmethod
+    def from_json(s: str | bytes) -> "Event":
+        try:
+            d = json.loads(s)
+        except json.JSONDecodeError as e:
+            raise EventValidationError(f"invalid JSON: {e}") from e
+        if not isinstance(d, dict):
+            raise EventValidationError("event JSON must be an object")
+        return Event.from_json_dict(d)
+
+
+def validate_event(e: Event) -> Event:
+    """Validate an event, raising :class:`EventValidationError` on violation.
+
+    Rule-for-rule parity with the reference validator (Event.scala:112-167):
+
+    - event / entityType / entityId must be non-empty
+    - targetEntityType and targetEntityId must be both present or both absent,
+      and non-empty when present
+    - properties must be non-empty for ``$unset``
+    - reserved-prefix event names must be one of the special events
+    - special events cannot have a target entity
+    - reserved-prefix entity types must be built-in (currently only ``pio_pr``)
+    - property names must not use a reserved prefix
+    """
+    def req(cond: bool, msg: str) -> None:
+        if not cond:
+            raise EventValidationError(msg)
+
+    req(bool(e.event), "event must not be empty.")
+    req(bool(e.entity_type), "entityType must not be empty string.")
+    req(bool(e.entity_id), "entityId must not be empty string.")
+    req(e.target_entity_type != "", "targetEntityType must not be empty string")
+    req(e.target_entity_id != "", "targetEntityId must not be empty string.")
+    req(
+        (e.target_entity_type is None) == (e.target_entity_id is None),
+        "targetEntityType and targetEntityId must be specified together.",
+    )
+    req(
+        not (e.event == "$unset" and e.properties.is_empty()),
+        "properties cannot be empty for $unset event",
+    )
+    req(
+        not is_reserved_prefix(e.event) or is_special_event(e.event),
+        f"{e.event} is not a supported reserved event name.",
+    )
+    req(
+        not is_special_event(e.event)
+        or (e.target_entity_type is None and e.target_entity_id is None),
+        f"Reserved event {e.event} cannot have targetEntity",
+    )
+    req(
+        not is_reserved_prefix(e.entity_type) or e.entity_type in BUILTIN_ENTITY_TYPES,
+        f"The entityType {e.entity_type} is not allowed. 'pio_' is a reserved name prefix.",
+    )
+    req(
+        e.target_entity_type is None
+        or not is_reserved_prefix(e.target_entity_type)
+        or e.target_entity_type in BUILTIN_ENTITY_TYPES,
+        f"The targetEntityType {e.target_entity_type} is not allowed. "
+        "'pio_' is a reserved name prefix.",
+    )
+    for k in e.properties:
+        req(
+            not is_reserved_prefix(k) or k in BUILTIN_PROPERTIES,
+            f"The property {k} is not allowed. 'pio_' is a reserved name prefix.",
+        )
+    return e

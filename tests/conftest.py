@@ -1,0 +1,45 @@
+"""Test configuration: force an 8-virtual-device CPU mesh before JAX loads.
+
+Mirrors the reference's test strategy of running a real multi-worker context in
+unit tests (Spark ``local[4]`` via core/src/test/.../workflow/BaseTest.scala) —
+for us that is an 8-device CPU mesh so every sharding/pjit path executes real
+collectives without TPU hardware.
+"""
+
+import os
+
+# jax may already be in sys.modules (site hook imports it at interpreter
+# startup), but XLA_FLAGS / platform selection are only read lazily at first
+# backend initialization — so configuring here still works as long as no
+# backend has been touched yet.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+from jax._src import xla_bridge
+
+assert not xla_bridge._backends, (
+    "a JAX backend was initialized before tests/conftest.py ran; "
+    "virtual 8-device CPU mesh unavailable"
+)
+jax.config.update("jax_platforms", "cpu")
+
+import tempfile
+
+import pytest
+
+
+@pytest.fixture()
+def tmp_pio_home(monkeypatch):
+    """Isolated PIO_FS_BASEDIR + default sqlite storage config per test."""
+    with tempfile.TemporaryDirectory() as d:
+        monkeypatch.setenv("PIO_FS_BASEDIR", d)
+        monkeypatch.setenv("PIO_STORAGE_SOURCES_SQLITE_TYPE", "sqlite")
+        monkeypatch.setenv("PIO_STORAGE_SOURCES_SQLITE_PATH", os.path.join(d, "pio.db"))
+        for repo in ("METADATA", "EVENTDATA", "MODELDATA"):
+            monkeypatch.setenv(f"PIO_STORAGE_REPOSITORIES_{repo}_NAME", f"pio_{repo.lower()}")
+            monkeypatch.setenv(f"PIO_STORAGE_REPOSITORIES_{repo}_SOURCE", "SQLITE")
+        yield d

@@ -1,0 +1,121 @@
+"""Property-fold aggregation contract tests.
+
+Scenario parity with the reference's LEventAggregatorSpec /
+PEventAggregatorSpec against the shared TestEvents fixture
+(data/src/test/scala/.../storage/TestEvents.scala).
+"""
+
+import datetime as dt
+
+from incubator_predictionio_tpu.data import DataMap, Event, aggregate_properties
+from incubator_predictionio_tpu.data.aggregator import (
+    aggregate_properties_single,
+    merge_shard_aggregates,
+)
+
+UTC = dt.timezone.utc
+
+
+def t(n):
+    return dt.datetime(2020, 1, 1, 0, 0, n, tzinfo=UTC)
+
+
+def set_ev(eid, props, when):
+    return Event(event="$set", entity_type="user", entity_id=eid,
+                 properties=DataMap(props), event_time=when)
+
+
+def unset_ev(eid, keys, when):
+    return Event(event="$unset", entity_type="user", entity_id=eid,
+                 properties=DataMap({k: None for k in keys}), event_time=when)
+
+
+def delete_ev(eid, when):
+    return Event(event="$delete", entity_type="user", entity_id=eid,
+                 event_time=when)
+
+
+def test_set_merges_right_biased():
+    out = aggregate_properties([
+        set_ev("u1", {"a": 1, "b": 2}, t(1)),
+        set_ev("u1", {"b": 9, "c": 3}, t(2)),
+    ])
+    assert out["u1"].to_dict() == {"a": 1, "b": 9, "c": 3}
+    assert out["u1"].first_updated == t(1)
+    assert out["u1"].last_updated == t(2)
+
+
+def test_order_is_by_event_time_not_arrival():
+    out = aggregate_properties([
+        set_ev("u1", {"b": 9}, t(2)),
+        set_ev("u1", {"a": 1, "b": 2}, t(1)),
+    ])
+    assert out["u1"].to_dict() == {"a": 1, "b": 9}
+
+
+def test_unset_removes_keys():
+    out = aggregate_properties([
+        set_ev("u1", {"a": 1, "b": 2}, t(1)),
+        unset_ev("u1", ["a"], t(2)),
+    ])
+    assert out["u1"].to_dict() == {"b": 2}
+
+
+def test_unset_before_any_set_yields_nothing():
+    out = aggregate_properties([unset_ev("u1", ["a"], t(1))])
+    assert out == {}
+
+
+def test_delete_drops_entity():
+    out = aggregate_properties([
+        set_ev("u1", {"a": 1}, t(1)),
+        delete_ev("u1", t(2)),
+    ])
+    assert "u1" not in out
+
+
+def test_set_after_delete_restarts_but_times_survive():
+    # The reference fold keeps first/lastUpdated across $delete
+    # (LEventAggregator.scala:121-133: times update on every special event).
+    out = aggregate_properties([
+        set_ev("u1", {"a": 1}, t(1)),
+        delete_ev("u1", t(2)),
+        set_ev("u1", {"z": 9}, t(3)),
+    ])
+    assert out["u1"].to_dict() == {"z": 9}
+    assert out["u1"].first_updated == t(1)
+    assert out["u1"].last_updated == t(3)
+
+
+def test_non_special_events_ignored():
+    out = aggregate_properties([
+        set_ev("u1", {"a": 1}, t(1)),
+        Event(event="rate", entity_type="user", entity_id="u1",
+              properties=DataMap({"x": 5}), event_time=t(2)),
+    ])
+    assert out["u1"].to_dict() == {"a": 1}
+    assert out["u1"].last_updated == t(1)
+
+
+def test_multiple_entities():
+    out = aggregate_properties([
+        set_ev("u1", {"a": 1}, t(1)),
+        set_ev("u2", {"b": 2}, t(2)),
+    ])
+    assert set(out) == {"u1", "u2"}
+
+
+def test_single_entity_aggregate():
+    pm = aggregate_properties_single([
+        set_ev("u1", {"a": 1}, t(1)),
+        unset_ev("u1", ["a"], t(2)),
+    ])
+    assert pm is not None and pm.to_dict() == {}
+    assert aggregate_properties_single([delete_ev("u1", t(1))]) is None
+
+
+def test_merge_shard_aggregates():
+    s1 = aggregate_properties([set_ev("u1", {"a": 1}, t(1))])
+    s2 = aggregate_properties([set_ev("u2", {"b": 2}, t(1))])
+    merged = merge_shard_aggregates([s1, s2])
+    assert set(merged) == {"u1", "u2"}

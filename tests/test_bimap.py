@@ -1,0 +1,39 @@
+"""BiMap contract tests (parity: reference BiMapSpec)."""
+
+import numpy as np
+import pytest
+
+from incubator_predictionio_tpu.data import BiMap
+
+
+def test_forward_and_inverse():
+    m = BiMap({"a": 0, "b": 1})
+    assert m["a"] == 0
+    inv = m.inverse()
+    assert inv[1] == "b"
+    assert inv.inverse()["a"] == 0
+
+
+def test_duplicate_values_rejected():
+    with pytest.raises(ValueError):
+        BiMap({"a": 0, "b": 0})
+
+
+def test_string_int_contiguous_first_seen_order():
+    m = BiMap.string_int(["u3", "u1", "u3", "u2", "u1"])
+    assert len(m) == 3
+    assert sorted(m.values()) == [0, 1, 2]
+    assert m["u3"] == 0 and m["u1"] == 1 and m["u2"] == 2
+
+
+def test_lookup_array():
+    m = BiMap.string_int(["a", "b", "c"])
+    arr = m.lookup_array(["c", "missing", "a"])
+    assert arr.dtype == np.int32
+    assert arr.tolist() == [2, -1, 0]
+
+
+def test_get_and_contains():
+    m = BiMap.string_int(["a"])
+    assert "a" in m and "z" not in m
+    assert m.get("z", 7) == 7

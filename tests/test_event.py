@@ -1,0 +1,138 @@
+"""Event model + validation contract tests.
+
+Scenario parity with the reference's event validation rules
+(data/.../storage/Event.scala:112-167) and JSON forms
+(EventJson4sSupport.scala).
+"""
+
+import datetime as dt
+
+import pytest
+
+from incubator_predictionio_tpu.data import (
+    DataMap,
+    Event,
+    EventValidationError,
+    validate_event,
+)
+
+UTC = dt.timezone.utc
+
+
+def ev(**kw):
+    base = dict(event="rate", entity_type="user", entity_id="u1")
+    base.update(kw)
+    return Event(**base)
+
+
+class TestValidation:
+    def test_valid_plain_event(self):
+        validate_event(ev(target_entity_type="item", target_entity_id="i1",
+                          properties=DataMap({"rating": 4.5})))
+
+    def test_empty_event_name(self):
+        with pytest.raises(EventValidationError):
+            validate_event(ev(event=""))
+
+    def test_empty_entity(self):
+        with pytest.raises(EventValidationError):
+            validate_event(ev(entity_type=""))
+        with pytest.raises(EventValidationError):
+            validate_event(ev(entity_id=""))
+
+    def test_target_entity_must_pair(self):
+        with pytest.raises(EventValidationError):
+            validate_event(ev(target_entity_type="item"))
+        with pytest.raises(EventValidationError):
+            validate_event(ev(target_entity_id="i1"))
+
+    def test_unset_requires_properties(self):
+        with pytest.raises(EventValidationError):
+            validate_event(ev(event="$unset"))
+        validate_event(ev(event="$unset", properties=DataMap({"a": 1})))
+
+    def test_reserved_prefix_event_names(self):
+        for name in ("$set", "$unset", "$delete"):
+            kwargs = {"event": name}
+            if name == "$unset":
+                kwargs["properties"] = DataMap({"a": 1})
+            validate_event(ev(**kwargs))
+        with pytest.raises(EventValidationError):
+            validate_event(ev(event="$custom"))
+        with pytest.raises(EventValidationError):
+            validate_event(ev(event="pio_custom"))
+
+    def test_special_event_cannot_have_target(self):
+        with pytest.raises(EventValidationError):
+            validate_event(
+                ev(event="$set", target_entity_type="item", target_entity_id="i1")
+            )
+
+    def test_reserved_entity_type(self):
+        with pytest.raises(EventValidationError):
+            validate_event(ev(entity_type="pio_user"))
+        validate_event(ev(entity_type="pio_pr"))  # built-in
+
+    def test_reserved_property_name(self):
+        with pytest.raises(EventValidationError):
+            validate_event(ev(properties=DataMap({"pio_score": 1})))
+
+
+class TestJson:
+    def test_roundtrip(self):
+        e = ev(
+            target_entity_type="item",
+            target_entity_id="i1",
+            properties=DataMap({"rating": 4.5, "tags": ["a", "b"]}),
+            event_time=dt.datetime(2020, 1, 2, 3, 4, 5, tzinfo=UTC),
+            pr_id="pr1",
+            event_id="abc",
+        )
+        e2 = Event.from_json(e.to_json())
+        assert e2.event == "rate"
+        assert e2.entity_id == "u1"
+        assert e2.target_entity_id == "i1"
+        assert e2.properties.get_float("rating") == 4.5
+        assert e2.event_time == e.event_time
+        assert e2.pr_id == "pr1"
+        assert e2.event_id == "abc"
+
+    def test_from_json_defaults(self):
+        e = Event.from_json('{"event":"buy","entityType":"user","entityId":"u9"}')
+        assert e.properties.is_empty()
+        assert e.event_time.tzinfo is not None
+
+    def test_naive_time_becomes_utc(self):
+        e = Event.from_json_dict(
+            {"event": "e", "entityType": "t", "entityId": "i",
+             "eventTime": "2020-01-01T00:00:00"}
+        )
+        assert e.event_time.tzinfo is UTC
+
+    def test_missing_required(self):
+        with pytest.raises(EventValidationError):
+            Event.from_json('{"event":"buy"}')
+
+    def test_bad_json(self):
+        with pytest.raises(EventValidationError):
+            Event.from_json("not json")
+
+
+class TestDataMap:
+    def test_typed_getters(self):
+        m = DataMap({"a": "1", "b": 2.5, "c": [1, 2], "d": True, "s": ["x", 1]})
+        assert m.get_str("a") == "1"
+        assert m.get_float("b") == 2.5
+        assert m.get_int("b") == 2
+        assert m.get_bool("d") is True
+        assert m.get_double_list("c") == [1.0, 2.0]
+        assert m.get_str_list("s") == ["x", "1"]
+        with pytest.raises(KeyError):
+            m.require("zzz")
+
+    def test_merge_and_remove(self):
+        a = DataMap({"x": 1, "y": 2})
+        b = a.merged_with({"y": 3, "z": 4})
+        assert b.to_dict() == {"x": 1, "y": 3, "z": 4}
+        assert b.without(["x", "z"]).to_dict() == {"y": 3}
+        assert a.to_dict() == {"x": 1, "y": 2}  # immutability
