@@ -1,0 +1,479 @@
+"""Storage contracts: event store, meta-data DAOs, model store.
+
+TPU-native counterparts of the reference DAO traits:
+
+- :class:`EventStore` unifies the reference's ``LEvents`` (local, blocking —
+  LEvents.scala:40) and ``PEvents`` (Spark RDD — PEvents.scala:38) contracts.
+  The "P" (parallel) read path is :meth:`EventStore.find_sharded`, which hands
+  back *entity-disjoint* per-shard iterators the input pipeline consumes in
+  parallel — replacing RDD partitions.
+- Meta DAOs mirror data/.../storage/{Apps,AccessKeys,Channels,EngineInstances,
+  EvaluationInstances,Models}.scala.
+
+Backends register themselves in the registry (see registry.py) under a type
+name ("sqlite", "memory", "localfs", …) — replacing the reference's
+class-name-convention reflection (Storage.scala:310-336).
+"""
+
+from __future__ import annotations
+
+import abc
+import datetime as _dt
+import re
+import secrets
+from collections.abc import Iterable, Iterator, Sequence
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from incubator_predictionio_tpu.data.aggregator import (
+    AGGREGATOR_EVENT_NAMES,
+    aggregate_properties as _aggregate,
+)
+from incubator_predictionio_tpu.data.event import Event, PropertyMap
+
+
+class StorageError(Exception):
+    """Raised on backend failures (reference StorageException)."""
+
+
+#: Sentinel distinguishing "no filter" from "filter for None" in target-entity
+#: filters (the reference models this as Option[Option[String]] —
+#: PEvents.scala:56-60).
+UNSET: Any = object()
+
+
+# ---------------------------------------------------------------------------
+# Event store
+# ---------------------------------------------------------------------------
+
+class EventStore(abc.ABC):
+    """Behavioral contract for EVENTDATA backends.
+
+    All methods are synchronous; the Event Server wraps them in a thread
+    executor (the reference's futureInsert/futureFind Future plumbing —
+    LEvents.scala:85-200 — is an artifact of spray, not of the contract).
+    """
+
+    # -- lifecycle (LEvents.scala:50-76) ----------------------------------
+    @abc.abstractmethod
+    def init(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        """Initialize the store for an app/channel; idempotent."""
+
+    @abc.abstractmethod
+    def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        """Remove all data for an app/channel."""
+
+    def close(self) -> None:
+        """Release backend resources."""
+
+    # -- CRUD (LEvents.scala:85-160) --------------------------------------
+    @abc.abstractmethod
+    def insert(self, event: Event, app_id: int, channel_id: Optional[int] = None) -> str:
+        """Insert one event; returns the assigned event id."""
+
+    def insert_batch(
+        self, events: Sequence[Event], app_id: int, channel_id: Optional[int] = None
+    ) -> list[str]:
+        """Insert many events; default loops, backends may override with a fast path."""
+        return [self.insert(e, app_id, channel_id) for e in events]
+
+    @abc.abstractmethod
+    def get(
+        self, event_id: str, app_id: int, channel_id: Optional[int] = None
+    ) -> Optional[Event]: ...
+
+    @abc.abstractmethod
+    def delete(
+        self, event_id: str, app_id: int, channel_id: Optional[int] = None
+    ) -> bool: ...
+
+    # -- queries (LEvents.scala:170-260, PEvents.scala:45-103) ------------
+    @abc.abstractmethod
+    def find(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        entity_id: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Any = UNSET,
+        target_entity_id: Any = UNSET,
+        limit: Optional[int] = None,
+        reversed: bool = False,
+    ) -> Iterator[Event]:
+        """Iterate events in event-time order (descending when ``reversed``).
+
+        ``limit=None`` or a negative limit returns everything. Target-entity
+        filters accept :data:`UNSET` (no filter), ``None`` (must be absent),
+        or a string (must equal).
+        """
+
+    def find_sharded(
+        self,
+        app_id: int,
+        n_shards: int,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+    ) -> list[Iterator[Event]]:
+        """Entity-disjoint shard iterators — the parallel read path.
+
+        Replaces ``PEvents.find → RDD[Event]`` partitioning. Events of one
+        entity always land in the same shard (shard = hash(entity_id) mod n),
+        so per-shard property aggregation needs no cross-shard merge join.
+        Backends with native partitioning should override; the default
+        partitions one full scan.
+        """
+        buckets: list[list[Event]] = [[] for _ in range(n_shards)]
+        for e in self.find(
+            app_id, channel_id, start_time, until_time, entity_type, None, event_names
+        ):
+            buckets[entity_shard(e.entity_id, n_shards)].append(e)
+        return [iter(b) for b in buckets]
+
+    def aggregate_properties(
+        self,
+        app_id: int,
+        entity_type: str,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        required: Optional[Sequence[str]] = None,
+    ) -> dict[str, PropertyMap]:
+        """Fold ``$set/$unset/$delete`` into per-entity snapshots
+        (LEvents.scala:264-296 / PEvents.scala:105-135)."""
+        agg = _aggregate(
+            self.find(
+                app_id,
+                channel_id,
+                start_time,
+                until_time,
+                entity_type,
+                None,
+                AGGREGATOR_EVENT_NAMES,
+            )
+        )
+        if required:
+            req = set(required)
+            agg = {k: v for k, v in agg.items() if req <= set(v.keys())}
+        return agg
+
+
+def entity_shard(entity_id: str, n_shards: int) -> int:
+    """Stable entity→shard assignment (zlib.crc32; hash() is salted per-process)."""
+    import zlib
+
+    return zlib.crc32(entity_id.encode()) % n_shards
+
+
+# ---------------------------------------------------------------------------
+# Meta-data records
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class App:
+    """(Apps.scala:28-34)"""
+    id: int
+    name: str
+    description: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class AccessKey:
+    """(AccessKeys.scala:29-37); empty ``events`` whitelist = all events allowed."""
+    key: str
+    app_id: int
+    events: tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class Channel:
+    """(Channels.scala:28-42)"""
+    id: int
+    name: str
+    app_id: int
+
+    NAME_RE = re.compile(r"^[a-zA-Z0-9-]{1,16}$")
+
+    @staticmethod
+    def is_valid_name(name: str) -> bool:
+        return bool(Channel.NAME_RE.match(name))
+
+
+@dataclass(frozen=True)
+class EngineInstance:
+    """One train run's metadata (EngineInstances.scala:35-50).
+
+    ``mesh_conf`` replaces the reference's ``sparkConf`` map; ``env`` carries
+    the serialized PIO_* storage env exactly as the reference does.
+    """
+    id: str
+    status: str  # INIT | TRAINING | COMPLETED | FAILED
+    start_time: _dt.datetime
+    end_time: Optional[_dt.datetime]
+    engine_id: str
+    engine_version: str
+    engine_variant: str
+    engine_factory: str
+    batch: str = ""
+    env: dict[str, str] = field(default_factory=dict)
+    mesh_conf: dict[str, Any] = field(default_factory=dict)
+    data_source_params: str = "{}"
+    preparator_params: str = "{}"
+    algorithms_params: str = "[]"
+    serving_params: str = "{}"
+
+
+@dataclass(frozen=True)
+class EvaluationInstance:
+    """One evaluation run's metadata (EvaluationInstances.scala:35-60)."""
+    id: str
+    status: str
+    start_time: _dt.datetime
+    end_time: Optional[_dt.datetime]
+    evaluation_class: str = ""
+    engine_params_generator_class: str = ""
+    batch: str = ""
+    env: dict[str, str] = field(default_factory=dict)
+    evaluator_results: str = ""
+    evaluator_results_html: str = ""
+    evaluator_results_json: str = ""
+
+
+@dataclass(frozen=True)
+class Model:
+    """Opaque serialized model blob (Models.scala:33)."""
+    id: str
+    models: bytes
+
+
+# ---------------------------------------------------------------------------
+# Meta-data DAO contracts
+# ---------------------------------------------------------------------------
+
+class AppsStore(abc.ABC):
+    """(Apps.scala:40-75)"""
+
+    @abc.abstractmethod
+    def insert(self, app: App) -> Optional[int]:
+        """Insert; id 0 means auto-assign. Returns the assigned id."""
+
+    @abc.abstractmethod
+    def get(self, app_id: int) -> Optional[App]: ...
+
+    @abc.abstractmethod
+    def get_by_name(self, name: str) -> Optional[App]: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> list[App]: ...
+
+    @abc.abstractmethod
+    def update(self, app: App) -> bool: ...
+
+    @abc.abstractmethod
+    def delete(self, app_id: int) -> bool: ...
+
+
+class AccessKeysStore(abc.ABC):
+    """(AccessKeys.scala:42-77)"""
+
+    @abc.abstractmethod
+    def insert(self, access_key: AccessKey) -> Optional[str]:
+        """Insert; empty key → auto-generate. Returns the key."""
+
+    @abc.abstractmethod
+    def get(self, key: str) -> Optional[AccessKey]: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> list[AccessKey]: ...
+
+    @abc.abstractmethod
+    def get_by_app_id(self, app_id: int) -> list[AccessKey]: ...
+
+    @abc.abstractmethod
+    def update(self, access_key: AccessKey) -> bool: ...
+
+    @abc.abstractmethod
+    def delete(self, key: str) -> bool: ...
+
+    @staticmethod
+    def generate_key() -> str:
+        """64 url-safe chars (reference: Random.alphanumeric, AccessKeys.scala:55)."""
+        return secrets.token_urlsafe(48)[:64]
+
+
+class ChannelsStore(abc.ABC):
+    """(Channels.scala:47-80)"""
+
+    @abc.abstractmethod
+    def insert(self, channel: Channel) -> Optional[int]: ...
+
+    @abc.abstractmethod
+    def get(self, channel_id: int) -> Optional[Channel]: ...
+
+    @abc.abstractmethod
+    def get_by_app_id(self, app_id: int) -> list[Channel]: ...
+
+    @abc.abstractmethod
+    def delete(self, channel_id: int) -> bool: ...
+
+
+class EngineInstancesStore(abc.ABC):
+    """(EngineInstances.scala:55-95)"""
+
+    @abc.abstractmethod
+    def insert(self, instance: EngineInstance) -> str:
+        """Insert; empty id → auto-generate. Returns the id."""
+
+    @abc.abstractmethod
+    def get(self, instance_id: str) -> Optional[EngineInstance]: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> list[EngineInstance]: ...
+
+    @abc.abstractmethod
+    def update(self, instance: EngineInstance) -> bool: ...
+
+    @abc.abstractmethod
+    def delete(self, instance_id: str) -> bool: ...
+
+    def get_latest_completed(
+        self, engine_id: str, engine_version: str, engine_variant: str
+    ) -> Optional[EngineInstance]:
+        """Most recent COMPLETED instance for the (id, version, variant) triple
+        (EngineInstances.scala:82)."""
+        cands = [
+            i
+            for i in self.get_all()
+            if i.status == "COMPLETED"
+            and i.engine_id == engine_id
+            and i.engine_version == engine_version
+            and i.engine_variant == engine_variant
+        ]
+        return max(cands, key=lambda i: i.start_time, default=None)
+
+    def get_completed(
+        self, engine_id: str, engine_version: str, engine_variant: str
+    ) -> list[EngineInstance]:
+        out = [
+            i
+            for i in self.get_all()
+            if i.status == "COMPLETED"
+            and i.engine_id == engine_id
+            and i.engine_version == engine_version
+            and i.engine_variant == engine_variant
+        ]
+        out.sort(key=lambda i: i.start_time, reverse=True)
+        return out
+
+
+class EvaluationInstancesStore(abc.ABC):
+    """(EvaluationInstances.scala:65-100)"""
+
+    @abc.abstractmethod
+    def insert(self, instance: EvaluationInstance) -> str: ...
+
+    @abc.abstractmethod
+    def get(self, instance_id: str) -> Optional[EvaluationInstance]: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> list[EvaluationInstance]: ...
+
+    @abc.abstractmethod
+    def update(self, instance: EvaluationInstance) -> bool: ...
+
+    @abc.abstractmethod
+    def delete(self, instance_id: str) -> bool: ...
+
+    def get_completed(self) -> list[EvaluationInstance]:
+        out = [i for i in self.get_all() if i.status == "EVALCOMPLETED"]
+        out.sort(key=lambda i: i.start_time, reverse=True)
+        return out
+
+
+class ModelsStore(abc.ABC):
+    """(Models.scala:43-60)"""
+
+    @abc.abstractmethod
+    def insert(self, model: Model) -> None: ...
+
+    @abc.abstractmethod
+    def get(self, model_id: str) -> Optional[Model]: ...
+
+    @abc.abstractmethod
+    def delete(self, model_id: str) -> bool: ...
+
+
+# ---------------------------------------------------------------------------
+# Backend client
+# ---------------------------------------------------------------------------
+
+class StorageClient(abc.ABC):
+    """One configured backend instance; provides whichever DAOs it supports.
+
+    Replaces the reference's per-backend ``StorageClient`` + reflective DAO
+    lookup. A backend raises :class:`NotImplementedError` for repositories it
+    does not serve (e.g. localfs serves MODELDATA only, like the reference's
+    localfs backend).
+    """
+
+    def __init__(self, config: dict[str, str]):
+        self.config = config
+
+    def apps(self) -> AppsStore:
+        raise NotImplementedError(f"{type(self).__name__} does not serve METADATA")
+
+    def access_keys(self) -> AccessKeysStore:
+        raise NotImplementedError(f"{type(self).__name__} does not serve METADATA")
+
+    def channels(self) -> ChannelsStore:
+        raise NotImplementedError(f"{type(self).__name__} does not serve METADATA")
+
+    def engine_instances(self) -> EngineInstancesStore:
+        raise NotImplementedError(f"{type(self).__name__} does not serve METADATA")
+
+    def evaluation_instances(self) -> EvaluationInstancesStore:
+        raise NotImplementedError(f"{type(self).__name__} does not serve METADATA")
+
+    def events(self) -> EventStore:
+        raise NotImplementedError(f"{type(self).__name__} does not serve EVENTDATA")
+
+    def models(self) -> ModelsStore:
+        raise NotImplementedError(f"{type(self).__name__} does not serve MODELDATA")
+
+    def close(self) -> None:
+        pass
+
+
+def filter_events(
+    events: Iterable[Event],
+    start_time: Optional[_dt.datetime] = None,
+    until_time: Optional[_dt.datetime] = None,
+    entity_type: Optional[str] = None,
+    entity_id: Optional[str] = None,
+    event_names: Optional[Sequence[str]] = None,
+    target_entity_type: Any = UNSET,
+    target_entity_id: Any = UNSET,
+) -> Iterator[Event]:
+    """Shared in-memory predicate filter used by backends without native indexes."""
+    names = set(event_names) if event_names is not None else None
+    for e in events:
+        if start_time is not None and e.event_time < start_time:
+            continue
+        if until_time is not None and e.event_time >= until_time:
+            continue
+        if entity_type is not None and e.entity_type != entity_type:
+            continue
+        if entity_id is not None and e.entity_id != entity_id:
+            continue
+        if names is not None and e.event not in names:
+            continue
+        if target_entity_type is not UNSET and e.target_entity_type != target_entity_type:
+            continue
+        if target_entity_id is not UNSET and e.target_entity_id != target_entity_id:
+            continue
+        yield e

@@ -1,0 +1,59 @@
+"""Local-filesystem MODELDATA backend (reference storage/localfs/LocalFSModels.scala:32-62)."""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from incubator_predictionio_tpu.data.storage.base import Model, ModelsStore, StorageClient
+
+
+class LocalFSModels(ModelsStore):
+    def __init__(self, path: str):
+        self._path = path
+        os.makedirs(path, exist_ok=True)
+
+    def _file(self, model_id: str) -> str:
+        # model ids are uuid/hash strings; refuse path separators defensively
+        if "/" in model_id or model_id in (".", ".."):
+            raise ValueError(f"invalid model id {model_id!r}")
+        return os.path.join(self._path, model_id)
+
+    def insert(self, model: Model) -> None:
+        tmp = self._file(model.id) + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(model.models)
+        os.replace(tmp, self._file(model.id))
+
+    def get(self, model_id: str) -> Optional[Model]:
+        try:
+            with open(self._file(model_id), "rb") as f:
+                return Model(model_id, f.read())
+        except FileNotFoundError:
+            return None
+
+    def delete(self, model_id: str) -> bool:
+        try:
+            os.remove(self._file(model_id))
+            return True
+        except FileNotFoundError:
+            return False
+
+
+class LocalFSStorageClient(StorageClient):
+    """MODELDATA only, like the reference localfs backend.
+
+    Config keys: ``PATH`` (default ``$PIO_FS_BASEDIR/models`` or
+    ``~/.pio_store/models``).
+    """
+
+    def __init__(self, config: dict[str, str]):
+        super().__init__(config)
+        path = config.get("PATH")
+        if not path:
+            base = os.environ.get("PIO_FS_BASEDIR", os.path.expanduser("~/.pio_store"))
+            path = os.path.join(base, "models")
+        self._models = LocalFSModels(path)
+
+    def models(self) -> ModelsStore:
+        return self._models

@@ -1,0 +1,308 @@
+"""In-memory storage backend (all three repositories).
+
+Test/dev analogue of the reference's StorageMockContext-backed mocks
+(data/src/test/.../storage/StorageMockContext.scala) promoted to a real,
+fully contract-compliant backend — useful for unit tests and ephemeral dev
+servers.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import itertools
+import threading
+import uuid
+from typing import Any, Optional, Sequence
+
+from incubator_predictionio_tpu.data.event import Event
+from incubator_predictionio_tpu.data.storage.base import (
+    UNSET,
+    AccessKey,
+    AccessKeysStore,
+    App,
+    AppsStore,
+    Channel,
+    ChannelsStore,
+    EngineInstance,
+    EngineInstancesStore,
+    EvaluationInstance,
+    EvaluationInstancesStore,
+    EventStore,
+    Model,
+    ModelsStore,
+    StorageClient,
+    filter_events,
+)
+
+
+class MemEvents(EventStore):
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        # (app_id, channel_id) -> {event_id: Event}
+        self._tables: dict[tuple[int, Optional[int]], dict[str, Event]] = {}
+
+    def _table(self, app_id: int, channel_id: Optional[int]) -> dict[str, Event]:
+        key = (app_id, channel_id)
+        t = self._tables.get(key)
+        if t is None:
+            from incubator_predictionio_tpu.data.storage.base import StorageError
+
+            raise StorageError(
+                f"event table for app {app_id} channel {channel_id} not initialized"
+            )
+        return t
+
+    def init(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        with self._lock:
+            self._tables.setdefault((app_id, channel_id), {})
+        return True
+
+    def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        with self._lock:
+            return self._tables.pop((app_id, channel_id), None) is not None
+
+    def insert(self, event: Event, app_id: int, channel_id: Optional[int] = None) -> str:
+        event_id = event.event_id or uuid.uuid4().hex
+        with self._lock:
+            self._tables.setdefault((app_id, channel_id), {})[event_id] = event.with_id(event_id)
+        return event_id
+
+    def get(self, event_id: str, app_id: int, channel_id: Optional[int] = None) -> Optional[Event]:
+        with self._lock:
+            return self._tables.get((app_id, channel_id), {}).get(event_id)
+
+    def delete(self, event_id: str, app_id: int, channel_id: Optional[int] = None) -> bool:
+        with self._lock:
+            return self._tables.get((app_id, channel_id), {}).pop(event_id, None) is not None
+
+    def find(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        entity_id: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Any = UNSET,
+        target_entity_id: Any = UNSET,
+        limit: Optional[int] = None,
+        reversed: bool = False,
+    ):
+        with self._lock:
+            events = list(self._table(app_id, channel_id).values())
+        events.sort(key=lambda e: e.event_time, reverse=reversed)
+        it = filter_events(
+            events, start_time, until_time, entity_type, entity_id,
+            event_names, target_entity_type, target_entity_id,
+        )
+        if limit is not None and limit >= 0:
+            it = itertools.islice(it, limit)
+        return it
+
+
+class MemApps(AppsStore):
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._apps: dict[int, App] = {}
+        self._next = itertools.count(1)
+
+    def insert(self, app: App) -> Optional[int]:
+        with self._lock:
+            if self.get_by_name(app.name) is not None:
+                return None
+            app_id = app.id if app.id > 0 else next(self._next)
+            if app_id in self._apps:
+                return None
+            self._apps[app_id] = App(app_id, app.name, app.description)
+            return app_id
+
+    def get(self, app_id: int) -> Optional[App]:
+        return self._apps.get(app_id)
+
+    def get_by_name(self, name: str) -> Optional[App]:
+        return next((a for a in self._apps.values() if a.name == name), None)
+
+    def get_all(self) -> list[App]:
+        return list(self._apps.values())
+
+    def update(self, app: App) -> bool:
+        with self._lock:
+            if app.id not in self._apps:
+                return False
+            self._apps[app.id] = app
+            return True
+
+    def delete(self, app_id: int) -> bool:
+        with self._lock:
+            return self._apps.pop(app_id, None) is not None
+
+
+class MemAccessKeys(AccessKeysStore):
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._keys: dict[str, AccessKey] = {}
+
+    def insert(self, access_key: AccessKey) -> Optional[str]:
+        key = access_key.key or self.generate_key()
+        with self._lock:
+            if key in self._keys:
+                return None
+            self._keys[key] = AccessKey(key, access_key.app_id, tuple(access_key.events))
+            return key
+
+    def get(self, key: str) -> Optional[AccessKey]:
+        return self._keys.get(key)
+
+    def get_all(self) -> list[AccessKey]:
+        return list(self._keys.values())
+
+    def get_by_app_id(self, app_id: int) -> list[AccessKey]:
+        return [k for k in self._keys.values() if k.app_id == app_id]
+
+    def update(self, access_key: AccessKey) -> bool:
+        with self._lock:
+            if access_key.key not in self._keys:
+                return False
+            self._keys[access_key.key] = access_key
+            return True
+
+    def delete(self, key: str) -> bool:
+        with self._lock:
+            return self._keys.pop(key, None) is not None
+
+
+class MemChannels(ChannelsStore):
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._channels: dict[int, Channel] = {}
+        self._next = itertools.count(1)
+
+    def insert(self, channel: Channel) -> Optional[int]:
+        if not Channel.is_valid_name(channel.name):
+            return None
+        with self._lock:
+            channel_id = channel.id if channel.id > 0 else next(self._next)
+            if channel_id in self._channels:
+                return None
+            self._channels[channel_id] = Channel(channel_id, channel.name, channel.app_id)
+            return channel_id
+
+    def get(self, channel_id: int) -> Optional[Channel]:
+        return self._channels.get(channel_id)
+
+    def get_by_app_id(self, app_id: int) -> list[Channel]:
+        return [c for c in self._channels.values() if c.app_id == app_id]
+
+    def delete(self, channel_id: int) -> bool:
+        with self._lock:
+            return self._channels.pop(channel_id, None) is not None
+
+
+class MemEngineInstances(EngineInstancesStore):
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._instances: dict[str, EngineInstance] = {}
+
+    def insert(self, instance: EngineInstance) -> str:
+        instance_id = instance.id or uuid.uuid4().hex
+        with self._lock:
+            from dataclasses import replace
+            self._instances[instance_id] = replace(instance, id=instance_id)
+        return instance_id
+
+    def get(self, instance_id: str) -> Optional[EngineInstance]:
+        return self._instances.get(instance_id)
+
+    def get_all(self) -> list[EngineInstance]:
+        return list(self._instances.values())
+
+    def update(self, instance: EngineInstance) -> bool:
+        with self._lock:
+            if instance.id not in self._instances:
+                return False
+            self._instances[instance.id] = instance
+            return True
+
+    def delete(self, instance_id: str) -> bool:
+        with self._lock:
+            return self._instances.pop(instance_id, None) is not None
+
+
+class MemEvaluationInstances(EvaluationInstancesStore):
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._instances: dict[str, EvaluationInstance] = {}
+
+    def insert(self, instance: EvaluationInstance) -> str:
+        instance_id = instance.id or uuid.uuid4().hex
+        with self._lock:
+            from dataclasses import replace
+            self._instances[instance_id] = replace(instance, id=instance_id)
+        return instance_id
+
+    def get(self, instance_id: str) -> Optional[EvaluationInstance]:
+        return self._instances.get(instance_id)
+
+    def get_all(self) -> list[EvaluationInstance]:
+        return list(self._instances.values())
+
+    def update(self, instance: EvaluationInstance) -> bool:
+        with self._lock:
+            if instance.id not in self._instances:
+                return False
+            self._instances[instance.id] = instance
+            return True
+
+    def delete(self, instance_id: str) -> bool:
+        with self._lock:
+            return self._instances.pop(instance_id, None) is not None
+
+
+class MemModels(ModelsStore):
+    def __init__(self) -> None:
+        self._models: dict[str, Model] = {}
+
+    def insert(self, model: Model) -> None:
+        self._models[model.id] = model
+
+    def get(self, model_id: str) -> Optional[Model]:
+        return self._models.get(model_id)
+
+    def delete(self, model_id: str) -> bool:
+        return self._models.pop(model_id, None) is not None
+
+
+class MemoryStorageClient(StorageClient):
+    """Serves all three repositories from process memory."""
+
+    def __init__(self, config: dict[str, str]):
+        super().__init__(config)
+        self._apps = MemApps()
+        self._access_keys = MemAccessKeys()
+        self._channels = MemChannels()
+        self._engine_instances = MemEngineInstances()
+        self._evaluation_instances = MemEvaluationInstances()
+        self._events = MemEvents()
+        self._models = MemModels()
+
+    def apps(self) -> AppsStore:
+        return self._apps
+
+    def access_keys(self) -> AccessKeysStore:
+        return self._access_keys
+
+    def channels(self) -> ChannelsStore:
+        return self._channels
+
+    def engine_instances(self) -> EngineInstancesStore:
+        return self._engine_instances
+
+    def evaluation_instances(self) -> EvaluationInstancesStore:
+        return self._evaluation_instances
+
+    def events(self) -> EventStore:
+        return self._events
+
+    def models(self) -> ModelsStore:
+        return self._models

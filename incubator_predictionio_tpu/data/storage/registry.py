@@ -1,0 +1,229 @@
+"""Env-var-driven storage registry.
+
+Parity target: reference Storage.scala:146-425. The same configuration
+surface is kept verbatim so a PredictionIO operator's ``pio-env.sh`` concepts
+transfer directly:
+
+- ``PIO_STORAGE_SOURCES_<NAME>_TYPE`` — backend type of source ``<NAME>``
+  (plus arbitrary extra keys, e.g. ``_PATH``, ``_HOSTS``), parsed by the same
+  regex convention (Storage.scala:160-200);
+- ``PIO_STORAGE_REPOSITORIES_{METADATA,EVENTDATA,MODELDATA}_{NAME,SOURCE}`` —
+  which source serves each repository.
+
+Mechanism swap: the reference discovers DAO classes by class-name-convention
+reflection (Storage.scala:310-336); here backends self-register in
+:data:`BACKEND_TYPES` via :func:`register_backend`, and third-party backends
+can register at import time (the plugin story).
+
+When no env config exists at all, the registry defaults to sqlite under
+``$PIO_FS_BASEDIR`` (the reference's conf/pio-env.sh.template defaults to
+PostgreSQL for all three repos — sqlite is our zero-dependency analogue).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+import threading
+from typing import Callable, Optional
+
+from incubator_predictionio_tpu.data.event import DataMap, Event
+from incubator_predictionio_tpu.data.storage.base import (
+    AccessKeysStore,
+    AppsStore,
+    ChannelsStore,
+    EngineInstancesStore,
+    EvaluationInstancesStore,
+    EventStore,
+    ModelsStore,
+    StorageClient,
+    StorageError,
+)
+
+logger = logging.getLogger(__name__)
+
+REPOSITORIES = ("METADATA", "EVENTDATA", "MODELDATA")
+
+#: type name -> StorageClient factory
+BACKEND_TYPES: dict[str, Callable[[dict[str, str]], StorageClient]] = {}
+
+
+def register_backend(type_name: str):
+    """Class decorator registering a StorageClient under a backend type name."""
+
+    def deco(cls):
+        BACKEND_TYPES[type_name] = cls
+        return cls
+
+    return deco
+
+
+def _register_builtins() -> None:
+    from incubator_predictionio_tpu.data.storage.localfs import LocalFSStorageClient
+    from incubator_predictionio_tpu.data.storage.memory import MemoryStorageClient
+    from incubator_predictionio_tpu.data.storage.sqlite_backend import SqliteStorageClient
+
+    BACKEND_TYPES.setdefault("memory", MemoryStorageClient)
+    BACKEND_TYPES.setdefault("sqlite", SqliteStorageClient)
+    BACKEND_TYPES.setdefault("localfs", LocalFSStorageClient)
+
+
+_SOURCE_RE = re.compile(r"^PIO_STORAGE_SOURCES_([^_]+)_(.+)$")
+_REPO_RE = re.compile(r"^PIO_STORAGE_REPOSITORIES_([^_]+)_(NAME|SOURCE)$")
+
+
+class Storage:
+    """One resolved storage configuration: sources + repository bindings.
+
+    Instantiate via :func:`get_storage` (process-wide singleton honoring the
+    environment) or directly with an explicit env dict (tests — the analogue
+    of the reference's mockable EnvironmentService)."""
+
+    def __init__(self, env: Optional[dict[str, str]] = None):
+        _register_builtins()
+        self._env = dict(env) if env is not None else dict(os.environ)
+        self._lock = threading.RLock()
+        self._clients: dict[str, StorageClient] = {}
+        self._sources = self._parse_sources()
+        self._repos = self._parse_repositories()
+
+    # -- config parsing (Storage.scala:160-200) ---------------------------
+    def _parse_sources(self) -> dict[str, dict[str, str]]:
+        sources: dict[str, dict[str, str]] = {}
+        for key, value in self._env.items():
+            m = _SOURCE_RE.match(key)
+            if m:
+                sources.setdefault(m.group(1), {})[m.group(2)] = value
+        if not sources:
+            sources["DEFAULT"] = {"TYPE": "sqlite"}
+        return sources
+
+    def _parse_repositories(self) -> dict[str, tuple[str, str]]:
+        repos: dict[str, dict[str, str]] = {}
+        for key, value in self._env.items():
+            m = _REPO_RE.match(key)
+            if m:
+                repos.setdefault(m.group(1), {})[m.group(2)] = value
+        out: dict[str, tuple[str, str]] = {}
+        for repo in REPOSITORIES:
+            cfg = repos.get(repo, {})
+            name = cfg.get("NAME", f"pio_{repo.lower()}")
+            source = cfg.get("SOURCE")
+            if source is None:
+                source = next(iter(self._sources))
+            if source not in self._sources:
+                raise StorageError(
+                    f"repository {repo} references undefined source {source}; "
+                    f"defined sources: {sorted(self._sources)}"
+                )
+            out[repo] = (name, source)
+        return out
+
+    # -- client resolution ------------------------------------------------
+    def _client_for(self, repo: str) -> StorageClient:
+        _, source = self._repos[repo]
+        with self._lock:
+            if source not in self._clients:
+                cfg = self._sources[source]
+                type_name = cfg.get("TYPE")
+                if type_name not in BACKEND_TYPES:
+                    raise StorageError(
+                        f"unknown storage backend type {type_name!r} for source {source}; "
+                        f"registered: {sorted(BACKEND_TYPES)}"
+                    )
+                logger.info("storage: opening source %s (type=%s)", source, type_name)
+                self._clients[source] = BACKEND_TYPES[type_name](cfg)
+            return self._clients[source]
+
+    def repository_name(self, repo: str) -> str:
+        return self._repos[repo][0]
+
+    # -- DAO accessors (Storage.scala getMetaData*/getModelData*/...) -----
+    def get_meta_data_apps(self) -> AppsStore:
+        return self._client_for("METADATA").apps()
+
+    def get_meta_data_access_keys(self) -> AccessKeysStore:
+        return self._client_for("METADATA").access_keys()
+
+    def get_meta_data_channels(self) -> ChannelsStore:
+        return self._client_for("METADATA").channels()
+
+    def get_meta_data_engine_instances(self) -> EngineInstancesStore:
+        return self._client_for("METADATA").engine_instances()
+
+    def get_meta_data_evaluation_instances(self) -> EvaluationInstancesStore:
+        return self._client_for("METADATA").evaluation_instances()
+
+    def get_events(self) -> EventStore:
+        """The EVENTDATA store (both the L and P read paths of the reference)."""
+        return self._client_for("EVENTDATA").events()
+
+    # Reference-parity aliases (LEvents/PEvents were distinct traits there).
+    get_l_events = get_events
+    get_p_events = get_events
+
+    def get_model_data_models(self) -> ModelsStore:
+        return self._client_for("MODELDATA").models()
+
+    # -- health check (Storage.scala:372-394) -----------------------------
+    def verify_all_data_objects(self) -> list[str]:
+        """Touch every repository; returns a list of failures (empty = healthy).
+
+        Like the reference, the EVENTDATA check writes and removes a test
+        event table (app id 0)."""
+        failures = []
+        for accessor in (
+            self.get_meta_data_apps,
+            self.get_meta_data_access_keys,
+            self.get_meta_data_channels,
+            self.get_meta_data_engine_instances,
+            self.get_meta_data_evaluation_instances,
+            self.get_model_data_models,
+        ):
+            try:
+                accessor()
+            except Exception as e:  # noqa: BLE001 - health check reports everything
+                failures.append(f"{accessor.__name__}: {e}")
+        try:
+            events = self.get_events()
+            events.init(0)
+            eid = events.insert(
+                Event(event="$set", entity_type="pio_health", entity_id="check",
+                      properties=DataMap({"ok": True})),
+                0,
+            )
+            assert events.get(eid, 0) is not None
+            events.remove(0)
+        except Exception as e:  # noqa: BLE001
+            failures.append(f"eventdata: {e}")
+        return failures
+
+    def close(self) -> None:
+        with self._lock:
+            for c in self._clients.values():
+                c.close()
+            self._clients.clear()
+
+
+_storage_singleton: Optional[Storage] = None
+_singleton_lock = threading.Lock()
+
+
+def get_storage(refresh: bool = False) -> Storage:
+    """Process-wide Storage honoring ``os.environ`` (reference Storage object)."""
+    global _storage_singleton
+    with _singleton_lock:
+        if refresh and _storage_singleton is not None:
+            _storage_singleton.close()
+            _storage_singleton = None
+        if _storage_singleton is None:
+            _storage_singleton = Storage()
+        return _storage_singleton
+
+
+def storage_env_vars(env: Optional[dict[str, str]] = None) -> dict[str, str]:
+    """Extract the PIO_* env subset that must cross process boundaries
+    (reference Runner.pioEnvVars, Runner.scala:217-219)."""
+    env = env if env is not None else dict(os.environ)
+    return {k: v for k, v in env.items() if k.startswith("PIO_")}

@@ -1,0 +1,149 @@
+"""Developer-facing event stores — what engine templates actually call.
+
+Parity targets: reference ``LEventStore`` (data/.../store/LEventStore.scala:33-145,
+app-*name* resolution + low-latency reads used at serving time) and
+``PEventStore`` (store/PEventStore.scala:35-121, bulk reads + property
+aggregation used at training time). The P flavor's RDD return type becomes
+per-shard iterators / :class:`EventBatch` columnar arrays for the device
+input pipeline (see data/pipeline.py).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+from typing import Any, Iterator, Optional, Sequence
+
+from incubator_predictionio_tpu.data.event import Event, PropertyMap
+from incubator_predictionio_tpu.data.storage.base import UNSET
+from incubator_predictionio_tpu.data.storage.registry import Storage, get_storage
+
+
+class _BaseStore:
+    def __init__(self, storage: Optional[Storage] = None):
+        self._storage = storage
+
+    @property
+    def storage(self) -> Storage:
+        return self._storage if self._storage is not None else get_storage()
+
+    def _resolve(self, app_name: str, channel_name: Optional[str]) -> tuple[int, Optional[int]]:
+        """app name (+ optional channel name) → ids (LEventStore.scala:48-68)."""
+        app = self.storage.get_meta_data_apps().get_by_name(app_name)
+        if app is None:
+            raise ValueError(f"Invalid app name {app_name}")
+        if channel_name is None:
+            return app.id, None
+        channels = self.storage.get_meta_data_channels().get_by_app_id(app.id)
+        for c in channels:
+            if c.name == channel_name:
+                return app.id, c.id
+        raise ValueError(f"Invalid channel name {channel_name} for app {app_name}")
+
+
+class LEventStore(_BaseStore):
+    """Low-latency single-entity reads for serving-time business rules."""
+
+    def find_by_entity(
+        self,
+        app_name: str,
+        entity_type: str,
+        entity_id: str,
+        channel_name: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Any = UNSET,
+        target_entity_id: Any = UNSET,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        limit: Optional[int] = None,
+        latest: bool = True,
+    ) -> Iterator[Event]:
+        """(LEventStore.scala:74-118)"""
+        app_id, channel_id = self._resolve(app_name, channel_name)
+        return self.storage.get_events().find(
+            app_id,
+            channel_id,
+            start_time,
+            until_time,
+            entity_type,
+            entity_id,
+            event_names,
+            target_entity_type,
+            target_entity_id,
+            limit,
+            reversed=latest,
+        )
+
+    def find(
+        self,
+        app_name: str,
+        channel_name: Optional[str] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        entity_id: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Any = UNSET,
+        target_entity_id: Any = UNSET,
+        limit: Optional[int] = None,
+    ) -> Iterator[Event]:
+        """(LEventStore.scala:120-145)"""
+        app_id, channel_id = self._resolve(app_name, channel_name)
+        return self.storage.get_events().find(
+            app_id, channel_id, start_time, until_time, entity_type, entity_id,
+            event_names, target_entity_type, target_entity_id, limit,
+        )
+
+
+class PEventStore(_BaseStore):
+    """Bulk reads for training: full scans, shard iterators, property snapshots."""
+
+    def find(
+        self,
+        app_name: str,
+        channel_name: Optional[str] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        entity_id: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Any = UNSET,
+        target_entity_id: Any = UNSET,
+    ) -> Iterator[Event]:
+        """(PEventStore.scala:41-76)"""
+        app_id, channel_id = self._resolve(app_name, channel_name)
+        return self.storage.get_events().find(
+            app_id, channel_id, start_time, until_time, entity_type, entity_id,
+            event_names, target_entity_type, target_entity_id,
+        )
+
+    def find_sharded(
+        self,
+        app_name: str,
+        n_shards: int,
+        channel_name: Optional[str] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+    ) -> list[Iterator[Event]]:
+        """Entity-disjoint shard iterators (replaces PEvents RDD partitions)."""
+        app_id, channel_id = self._resolve(app_name, channel_name)
+        return self.storage.get_events().find_sharded(
+            app_id, n_shards, channel_id, start_time, until_time, entity_type,
+            event_names,
+        )
+
+    def aggregate_properties(
+        self,
+        app_name: str,
+        entity_type: str,
+        channel_name: Optional[str] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        required: Optional[Sequence[str]] = None,
+    ) -> dict[str, PropertyMap]:
+        """(PEventStore.scala:78-121)"""
+        app_id, channel_id = self._resolve(app_name, channel_name)
+        return self.storage.get_events().aggregate_properties(
+            app_id, entity_type, channel_id, start_time, until_time, required
+        )

@@ -1,0 +1,90 @@
+"""Storage registry config parsing + health check.
+
+Parity: reference Storage.scala env parsing (:160-200) and
+verifyAllDataObjects (:372-394); mocked-env unit-testability mirrors
+StorageMockContext.scala.
+"""
+
+import pytest
+
+from incubator_predictionio_tpu.data import DataMap, Event
+from incubator_predictionio_tpu.data.storage import Storage, StorageError, storage_env_vars
+from incubator_predictionio_tpu.data.store import LEventStore, PEventStore
+from incubator_predictionio_tpu.data.storage.base import App
+
+
+def test_env_parsing_multi_source(tmp_path):
+    env = {
+        "PIO_STORAGE_SOURCES_PGLIKE_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_PGLIKE_PATH": str(tmp_path / "meta.db"),
+        "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+        "PIO_STORAGE_SOURCES_FS_TYPE": "localfs",
+        "PIO_STORAGE_SOURCES_FS_PATH": str(tmp_path / "models"),
+        "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "pio_meta",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "PGLIKE",
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "FS",
+    }
+    s = Storage(env)
+    assert s.repository_name("METADATA") == "pio_meta"
+    app_id = s.get_meta_data_apps().insert(App(0, "a1"))
+    assert s.get_meta_data_apps().get(app_id) is not None
+    s.get_events().init(app_id)
+    s.get_events().insert(
+        Event(event="$set", entity_type="u", entity_id="1", properties=DataMap({"x": 1})),
+        app_id,
+    )
+    assert len(list(s.get_events().find(app_id))) == 1
+    from incubator_predictionio_tpu.data.storage.base import Model
+    s.get_model_data_models().insert(Model("m", b"blob"))
+    assert (tmp_path / "models" / "m").exists()
+    assert s.verify_all_data_objects() == []
+    s.close()
+
+
+def test_undefined_source_rejected():
+    with pytest.raises(StorageError):
+        Storage({
+            "PIO_STORAGE_SOURCES_A_TYPE": "memory",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "NOPE",
+        })
+
+
+def test_unknown_backend_type():
+    s = Storage({"PIO_STORAGE_SOURCES_A_TYPE": "hbase-nope"})
+    with pytest.raises(StorageError):
+        s.get_meta_data_apps()
+
+
+def test_default_config_is_sqlite(tmp_path, monkeypatch):
+    monkeypatch.setenv("PIO_FS_BASEDIR", str(tmp_path))
+    s = Storage({})
+    assert s.verify_all_data_objects() == []
+    assert (tmp_path / "pio.db").exists()
+    s.close()
+
+
+def test_storage_env_vars_subset():
+    env = {"PIO_STORAGE_SOURCES_A_TYPE": "memory", "PATH": "/bin", "PIO_FS_BASEDIR": "/x"}
+    sub = storage_env_vars(env)
+    assert "PATH" not in sub and len(sub) == 2
+
+
+def test_event_stores_resolve_app_names():
+    s = Storage({"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+    apps = s.get_meta_data_apps()
+    app_id = apps.insert(App(0, "shop"))
+    s.get_events().init(app_id)
+    s.get_events().insert(
+        Event(event="buy", entity_type="user", entity_id="u1",
+              target_entity_type="item", target_entity_id="i1"),
+        app_id,
+    )
+    l, p = LEventStore(s), PEventStore(s)
+    assert len(list(l.find("shop"))) == 1
+    assert len(list(l.find_by_entity("shop", "user", "u1"))) == 1
+    assert len(list(p.find("shop", event_names=["buy"]))) == 1
+    with pytest.raises(ValueError):
+        list(l.find("nope"))
+    with pytest.raises(ValueError):
+        list(l.find("shop", channel_name="nochan"))

@@ -1,0 +1,208 @@
+"""Shared behavioral contract suite run against every storage backend.
+
+Parity with the reference's approach (storage/jdbc/src/test/.../LEventsSpec.scala
+scenario list reused across jdbc/hbase/elasticsearch): one parametrized suite,
+each backend must pass identically.
+"""
+
+import datetime as dt
+
+import pytest
+
+from incubator_predictionio_tpu.data import DataMap, Event
+from incubator_predictionio_tpu.data.storage import (
+    UNSET,
+    AccessKey,
+    App,
+    Channel,
+    EngineInstance,
+    EvaluationInstance,
+    Model,
+    StorageError,
+)
+from incubator_predictionio_tpu.data.storage.memory import MemoryStorageClient
+from incubator_predictionio_tpu.data.storage.sqlite_backend import SqliteStorageClient
+
+UTC = dt.timezone.utc
+APP = 1
+
+
+def t(n):
+    return dt.datetime(2020, 1, 1, 0, 0, n, tzinfo=UTC)
+
+
+@pytest.fixture(params=["memory", "sqlite"])
+def client(request, tmp_path):
+    if request.param == "memory":
+        c = MemoryStorageClient({})
+    else:
+        c = SqliteStorageClient({"PATH": str(tmp_path / "pio.db")})
+    yield c
+    c.close()
+
+
+@pytest.fixture()
+def events(client):
+    es = client.events()
+    es.init(APP)
+    return es
+
+
+def mk(event="rate", eid="u1", tet="item", tid="i1", when=None, props=None):
+    return Event(
+        event=event, entity_type="user", entity_id=eid,
+        target_entity_type=tet, target_entity_id=tid,
+        properties=DataMap(props or {}), event_time=when or t(0),
+    )
+
+
+class TestEventStoreContract:
+    def test_insert_get_delete(self, events):
+        eid = events.insert(mk(), APP)
+        e = events.get(eid, APP)
+        assert e is not None and e.event_id == eid and e.entity_id == "u1"
+        assert events.delete(eid, APP) is True
+        assert events.get(eid, APP) is None
+        assert events.delete(eid, APP) is False
+
+    def test_insert_batch(self, events):
+        ids = events.insert_batch([mk(eid=f"u{i}", when=t(i)) for i in range(5)], APP)
+        assert len(set(ids)) == 5
+        assert len(list(events.find(APP))) == 5
+
+    def test_find_time_range_and_order(self, events):
+        for i in range(5):
+            events.insert(mk(eid=f"u{i}", when=t(i)), APP)
+        got = list(events.find(APP, start_time=t(1), until_time=t(4)))
+        assert [e.entity_id for e in got] == ["u1", "u2", "u3"]  # until exclusive
+        rev = list(events.find(APP, reversed=True, limit=2))
+        assert [e.entity_id for e in rev] == ["u4", "u3"]
+
+    def test_find_filters(self, events):
+        events.insert(mk(event="rate", eid="u1", when=t(1)), APP)
+        events.insert(mk(event="buy", eid="u1", tet="item", tid="i2", when=t(2)), APP)
+        events.insert(
+            Event(event="$set", entity_type="user", entity_id="u1",
+                  properties=DataMap({"a": 1}), event_time=t(3)), APP)
+        assert len(list(events.find(APP, event_names=["buy"]))) == 1
+        assert len(list(events.find(APP, target_entity_type=None))) == 1  # only $set
+        assert len(list(events.find(APP, target_entity_id="i2"))) == 1
+        assert len(list(events.find(APP, entity_type="user", entity_id="u1"))) == 3
+        assert len(list(events.find(APP, entity_type="nope"))) == 0
+
+    def test_channels_isolated(self, events):
+        events.init(APP, 7)
+        events.insert(mk(eid="main"), APP)
+        events.insert(mk(eid="chan"), APP, 7)
+        assert [e.entity_id for e in events.find(APP)] == ["main"]
+        assert [e.entity_id for e in events.find(APP, 7)] == ["chan"]
+        events.remove(APP, 7)
+
+    def test_aggregate_properties(self, events):
+        events.insert(
+            Event(event="$set", entity_type="user", entity_id="u1",
+                  properties=DataMap({"a": 1, "b": 2}), event_time=t(1)), APP)
+        events.insert(
+            Event(event="$unset", entity_type="user", entity_id="u1",
+                  properties=DataMap({"b": None}), event_time=t(2)), APP)
+        events.insert(
+            Event(event="$set", entity_type="item", entity_id="i1",
+                  properties=DataMap({"c": 3}), event_time=t(1)), APP)
+        agg = events.aggregate_properties(APP, "user")
+        assert set(agg) == {"u1"} and agg["u1"].to_dict() == {"a": 1}
+        agg2 = events.aggregate_properties(APP, "user", required=["missing"])
+        assert agg2 == {}
+
+    def test_find_sharded_entity_disjoint_and_complete(self, events):
+        for i in range(40):
+            events.insert(mk(eid=f"u{i % 10}", when=t(i % 50)), APP)
+        shards = events.find_sharded(APP, 4)
+        seen_entities = [set() for _ in range(4)]
+        total = 0
+        for si, it in enumerate(shards):
+            for e in it:
+                seen_entities[si].add(e.entity_id)
+                total += 1
+        assert total == 40
+        for a in range(4):
+            for b in range(a + 1, 4):
+                assert not (seen_entities[a] & seen_entities[b])
+
+    def test_remove_app(self, events):
+        events.insert(mk(), APP)
+        assert events.remove(APP)
+        with pytest.raises((StorageError, KeyError)):
+            list(events.find(APP))
+
+
+class TestMetaContract:
+    def test_apps_crud(self, client):
+        apps = client.apps()
+        app_id = apps.insert(App(0, "myapp", "desc"))
+        assert app_id and apps.get(app_id).name == "myapp"
+        assert apps.get_by_name("myapp").id == app_id
+        assert apps.insert(App(0, "myapp")) is None  # duplicate name
+        assert apps.update(App(app_id, "renamed", None))
+        assert apps.get_by_name("renamed") is not None
+        assert len(apps.get_all()) == 1
+        assert apps.delete(app_id) and apps.get(app_id) is None
+
+    def test_access_keys(self, client):
+        ak = client.access_keys()
+        key = ak.insert(AccessKey("", 3, ("rate", "buy")))
+        assert key and len(key) >= 32
+        got = ak.get(key)
+        assert got.app_id == 3 and got.events == ("rate", "buy")
+        assert ak.get_by_app_id(3) == [got]
+        assert ak.get_by_app_id(99) == []
+        assert ak.insert(AccessKey(key, 4)) is None  # duplicate
+        assert ak.delete(key) and ak.get(key) is None
+
+    def test_channels(self, client):
+        ch = client.channels()
+        cid = ch.insert(Channel(0, "live", 3))
+        assert cid and ch.get(cid).name == "live"
+        assert ch.insert(Channel(0, "bad name!", 3)) is None
+        assert ch.insert(Channel(0, "x" * 17, 3)) is None
+        assert [c.id for c in ch.get_by_app_id(3)] == [cid]
+        assert ch.delete(cid) and ch.get(cid) is None
+
+    def test_engine_instances(self, client):
+        ei = client.engine_instances()
+        mk_inst = lambda status, start: EngineInstance(
+            id="", status=status, start_time=start, end_time=None,
+            engine_id="eng", engine_version="1", engine_variant="default",
+            engine_factory="pkg.Factory", env={"PIO_X": "1"},
+            algorithms_params='[{"name":"algo"}]',
+        )
+        i1 = ei.insert(mk_inst("COMPLETED", t(1)))
+        i2 = ei.insert(mk_inst("COMPLETED", t(5)))
+        ei.insert(mk_inst("INIT", t(9)))
+        latest = ei.get_latest_completed("eng", "1", "default")
+        assert latest.id == i2
+        assert [x.id for x in ei.get_completed("eng", "1", "default")] == [i2, i1]
+        got = ei.get(i1)
+        assert got.env == {"PIO_X": "1"} and "algo" in got.algorithms_params
+        from dataclasses import replace
+        assert ei.update(replace(got, status="FAILED"))
+        assert ei.get(i1).status == "FAILED"
+        assert ei.delete(i1)
+
+    def test_evaluation_instances(self, client):
+        evi = client.evaluation_instances()
+        iid = evi.insert(EvaluationInstance(
+            id="", status="EVALCOMPLETED", start_time=t(1), end_time=t(2),
+            evaluation_class="pkg.Eval", evaluator_results="score=0.5",
+        ))
+        assert evi.get(iid).evaluator_results == "score=0.5"
+        assert [x.id for x in evi.get_completed()] == [iid]
+        assert evi.delete(iid) and evi.get(iid) is None
+
+    def test_models(self, client):
+        models = client.models()
+        blob = b"\x00\x01binary\xff" * 100
+        models.insert(Model("m1", blob))
+        assert models.get("m1").models == blob
+        models.insert(Model("m1", b"replaced"))
+        assert models.get("m1").models == b"replaced"
+        assert models.delete("m1") and models.get("m1") is None
