@@ -1,0 +1,71 @@
+"""Core runtime: DASE controller API + workflow."""
+
+from incubator_predictionio_tpu.core.base import (
+    AbstractDoer,
+    BaseAlgorithm,
+    BaseDataSource,
+    BaseEngine,
+    BaseEvaluator,
+    BaseEvaluatorResult,
+    BasePreparator,
+    BaseServing,
+    SanityCheck,
+    doer,
+)
+from incubator_predictionio_tpu.core.controller import (
+    AverageServing,
+    Engine,
+    EngineFactory,
+    EngineParams,
+    FirstServing,
+    IdentityPreparator,
+    LAlgorithm,
+    LDataSource,
+    LocalFileSystemPersistentModel,
+    LPreparator,
+    LServing,
+    P2LAlgorithm,
+    PAlgorithm,
+    PDataSource,
+    PersistentModel,
+    PersistentModelManifest,
+    PPreparator,
+    SimpleEngine,
+    StopAfterPrepareInterruption,
+    StopAfterReadInterruption,
+    WorkflowParams,
+    class_path,
+    load_class,
+    resolve_engine_factory,
+)
+from incubator_predictionio_tpu.core.evaluator import (
+    EngineParamsGenerator,
+    Evaluation,
+    MetricEvaluator,
+    MetricEvaluatorResult,
+)
+from incubator_predictionio_tpu.core.metric import (
+    AverageMetric,
+    Metric,
+    OptionAverageMetric,
+    OptionStdevMetric,
+    StdevMetric,
+    SumMetric,
+    ZeroMetric,
+)
+from incubator_predictionio_tpu.utils.params import EmptyParams, Params
+
+__all__ = [
+    "AbstractDoer", "AverageMetric", "AverageServing", "BaseAlgorithm",
+    "BaseDataSource", "BaseEngine", "BaseEvaluator", "BaseEvaluatorResult",
+    "BasePreparator", "BaseServing", "EmptyParams", "Engine", "EngineFactory",
+    "EngineParams", "EngineParamsGenerator", "Evaluation", "FirstServing",
+    "IdentityPreparator", "LAlgorithm", "LDataSource",
+    "LocalFileSystemPersistentModel", "LPreparator", "LServing", "Metric",
+    "MetricEvaluator", "MetricEvaluatorResult", "OptionAverageMetric",
+    "OptionStdevMetric", "P2LAlgorithm", "PAlgorithm", "PDataSource",
+    "Params", "PersistentModel", "PersistentModelManifest", "PPreparator",
+    "SanityCheck", "SimpleEngine", "StdevMetric", "StopAfterPrepareInterruption",
+    "StopAfterReadInterruption", "SumMetric", "WorkflowParams", "ZeroMetric",
+    "class_path", "doer", "load_class", "resolve_engine_factory",
+]

@@ -1,0 +1,493 @@
+"""Controller API — what engine template authors see.
+
+Parity targets: controller/Engine.scala:82-829, EngineParams.scala,
+EngineFactory.scala, the P/L/P2L stage flavors (PAlgorithm.scala:47,
+LAlgorithm.scala:45, P2LAlgorithm.scala:46, …), serving combinators, and the
+PersistentModel SPI.
+
+Flavor semantics, re-based on the mesh:
+
+- **P** (parallel): data/models live as sharded arrays on the mesh; ``train``
+  runs pjit/shard_map programs; ``batch_predict`` is a vectorized device path.
+- **L** (local): plain host objects; the framework never wraps them in RDDs
+  (the reference's 1-element-RDD trick, LAlgorithm.scala:45, collapses to a
+  no-op here).
+- **P2L**: train on the mesh, model gathered to host — the most common flavor
+  for templates (e.g. the classification MLP).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+from typing import Any, Callable, Generic, Sequence, Union
+
+from incubator_predictionio_tpu.core.base import (
+    A,
+    BaseAlgorithm,
+    BaseDataSource,
+    BaseEngine,
+    BasePreparator,
+    BaseServing,
+    EI,
+    M,
+    P,
+    PD,
+    Q,
+    SanityCheck,
+    TD,
+    doer,
+)
+from incubator_predictionio_tpu.parallel.mesh import MeshContext
+from incubator_predictionio_tpu.utils.params import EmptyParams, Params, params_from_json
+
+logger = logging.getLogger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# Workflow params
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class WorkflowParams:
+    """(workflow/WorkflowParams.scala:29-45)"""
+
+    batch: str = ""
+    verbose: int = 0
+    skip_sanity_check: bool = False
+    stop_after_read: bool = False
+    stop_after_prepare: bool = False
+
+
+class StopAfterReadInterruption(Exception):
+    """Raised when --stop-after-read is requested (Engine.scala:664-668)."""
+
+
+class StopAfterPrepareInterruption(Exception):
+    """Raised when --stop-after-prepare is requested (Engine.scala:680-684)."""
+
+
+def _sanity_check(obj: Any, label: str, params: WorkflowParams) -> None:
+    if params.skip_sanity_check:
+        return
+    if isinstance(obj, SanityCheck):
+        logger.info("sanity check: %s", label)
+        obj.sanity_check()
+
+
+# ---------------------------------------------------------------------------
+# Stage flavors
+# ---------------------------------------------------------------------------
+
+class PDataSource(BaseDataSource[TD, EI, Q, A]):
+    """Parallel data source: ``read_training`` should return columnar /
+    shardable data (controller/PDataSource.scala:37)."""
+
+
+class LDataSource(BaseDataSource[TD, EI, Q, A]):
+    """Local data source (controller/LDataSource.scala:38)."""
+
+
+class PPreparator(BasePreparator[TD, PD]):
+    """(controller/PPreparator.scala:33)"""
+
+
+class LPreparator(BasePreparator[TD, PD]):
+    """(controller/LPreparator.scala:36)"""
+
+
+class IdentityPreparator(BasePreparator[TD, TD]):
+    """Pass-through preparator (controller/IdentityPreparator.scala:32)."""
+
+    def prepare(self, ctx: MeshContext, td: TD) -> TD:
+        return td
+
+
+class PAlgorithm(BaseAlgorithm[PD, M, Q, P]):
+    """Parallel algorithm: model may remain sharded on the mesh
+    (controller/PAlgorithm.scala:47). ``batch_predict`` must be overridden
+    with a device path for evaluation (the reference throws likewise)."""
+
+    def batch_predict(self, model: M, queries: Sequence[tuple[int, Q]]) -> list[tuple[int, P]]:
+        raise NotImplementedError(
+            "PAlgorithm requires a vectorized batch_predict for evaluation"
+        )
+
+
+class LAlgorithm(BaseAlgorithm[PD, M, Q, P]):
+    """Local algorithm (controller/LAlgorithm.scala:45)."""
+
+
+class P2LAlgorithm(BaseAlgorithm[PD, M, Q, P]):
+    """Train on the mesh, keep a local (host) model
+    (controller/P2LAlgorithm.scala:46)."""
+
+
+class LServing(BaseServing[Q, P]):
+    """(controller/LServing.scala:30)"""
+
+
+class FirstServing(LServing[Q, P]):
+    """Serve the first algorithm's prediction (controller/LFirstServing.scala:28)."""
+
+    def serve(self, query: Q, predictions: Sequence[P]) -> P:
+        return predictions[0]
+
+
+class AverageServing(LServing[Q, float]):
+    """Average numeric predictions (controller/LAverageServing.scala:28)."""
+
+    def serve(self, query: Q, predictions: Sequence[float]) -> float:
+        return sum(predictions) / len(predictions)
+
+
+# ---------------------------------------------------------------------------
+# Persistent model SPI
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class PersistentModelManifest:
+    """Marker persisted in place of the model blob when the model saved itself
+    (workflow/PersistentModelManifest.scala:21)."""
+
+    class_path: str  # "module:ClassName" import path
+
+
+class PersistentModel(Generic[Q]):
+    """Custom model persistence SPI (controller/PersistentModel.scala:67-100).
+
+    A model class implementing ``save`` controls its own storage; it must also
+    provide a classmethod ``load(model_id, params, ctx)``. ``save`` returning
+    False falls back to default pickling."""
+
+    def save(self, model_id: str, params: Params, ctx: MeshContext) -> bool:
+        raise NotImplementedError
+
+    @classmethod
+    def load(cls, model_id: str, params: Params, ctx: MeshContext) -> "PersistentModel":
+        raise NotImplementedError
+
+
+class LocalFileSystemPersistentModel(PersistentModel[Q]):
+    """Save via pickle under PIO_FS_BASEDIR
+    (controller/LocalFileSystemPersistentModel.scala:43)."""
+
+    @staticmethod
+    def _path(model_id: str) -> str:
+        import os
+
+        base = os.environ.get("PIO_FS_BASEDIR", os.path.expanduser("~/.pio_store"))
+        d = os.path.join(base, "pmodels")
+        os.makedirs(d, exist_ok=True)
+        return os.path.join(d, model_id)
+
+    def save(self, model_id: str, params: Params, ctx: MeshContext) -> bool:
+        from incubator_predictionio_tpu.utils.serialization import serialize_model
+
+        with open(self._path(model_id), "wb") as f:
+            f.write(serialize_model(self))
+        return True
+
+    @classmethod
+    def load(cls, model_id: str, params: Params, ctx: MeshContext):
+        from incubator_predictionio_tpu.utils.serialization import deserialize_model
+
+        with open(cls._path(model_id), "rb") as f:
+            return deserialize_model(f.read())
+
+
+def class_path(cls: type) -> str:
+    return f"{cls.__module__}:{cls.__qualname__}"
+
+
+def load_class(path: str) -> type:
+    """Import a "module:Qualified.Name" path — the registry replacing the
+    reference's Class.forName reflection (WorkflowUtils.scala:53-118)."""
+    import importlib
+
+    module_name, _, qualname = path.partition(":")
+    if not qualname:
+        module_name, _, qualname = path.rpartition(".")
+    obj: Any = importlib.import_module(module_name)
+    for part in qualname.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+# ---------------------------------------------------------------------------
+# EngineParams
+# ---------------------------------------------------------------------------
+
+NamedParams = tuple[str, Params]
+
+
+def _named(p: Union[Params, NamedParams, None]) -> NamedParams:
+    if p is None:
+        return ("", EmptyParams())
+    if isinstance(p, tuple):
+        return p
+    return ("", p)
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineParams:
+    """Named parameters for every stage (controller/EngineParams.scala:35).
+
+    Each entry is ``(stage-name, params)``; the name selects the class from
+    the engine's class map (multi-algorithm engines list several entries in
+    ``algorithm_params_list``)."""
+
+    data_source_params: NamedParams = ("", EmptyParams())
+    preparator_params: NamedParams = ("", EmptyParams())
+    algorithm_params_list: tuple[NamedParams, ...] = ()
+    serving_params: NamedParams = ("", EmptyParams())
+
+    @staticmethod
+    def create(
+        data_source: Union[Params, NamedParams, None] = None,
+        preparator: Union[Params, NamedParams, None] = None,
+        algorithms: Sequence[Union[Params, NamedParams]] = (),
+        serving: Union[Params, NamedParams, None] = None,
+    ) -> "EngineParams":
+        return EngineParams(
+            data_source_params=_named(data_source),
+            preparator_params=_named(preparator),
+            algorithm_params_list=tuple(_named(a) for a in algorithms),
+            serving_params=_named(serving),
+        )
+
+
+ClassMap = dict[str, type]
+
+
+def _class_map(spec: Union[type, dict[str, type]]) -> ClassMap:
+    if isinstance(spec, dict):
+        return dict(spec)
+    return {"": spec}
+
+
+# ---------------------------------------------------------------------------
+# Engine
+# ---------------------------------------------------------------------------
+
+class Engine(BaseEngine[TD, EI, Q, P, A]):
+    """Four class-maps chained into train/eval/deploy flows
+    (controller/Engine.scala:82-88)."""
+
+    def __init__(
+        self,
+        data_source_class_map: Union[type, ClassMap],
+        preparator_class_map: Union[type, ClassMap],
+        algorithm_class_map: Union[type, ClassMap],
+        serving_class_map: Union[type, ClassMap],
+    ):
+        self.data_source_class_map = _class_map(data_source_class_map)
+        self.preparator_class_map = _class_map(preparator_class_map)
+        self.algorithm_class_map = _class_map(algorithm_class_map)
+        self.serving_class_map = _class_map(serving_class_map)
+
+    # -- helpers ----------------------------------------------------------
+    def _pick(self, class_map: ClassMap, name: str, stage: str) -> type:
+        if name not in class_map:
+            raise KeyError(
+                f"engine has no {stage} named {name!r}; available: {sorted(class_map)}"
+            )
+        return class_map[name]
+
+    def _instantiate(self, engine_params: EngineParams):
+        ds_name, ds_params = engine_params.data_source_params
+        prep_name, prep_params = engine_params.preparator_params
+        serv_name, serv_params = engine_params.serving_params
+        data_source = doer(self._pick(self.data_source_class_map, ds_name, "datasource"), ds_params)
+        preparator = doer(self._pick(self.preparator_class_map, prep_name, "preparator"), prep_params)
+        algo_list = engine_params.algorithm_params_list or (("", EmptyParams()),)
+        algorithms = [
+            doer(self._pick(self.algorithm_class_map, name, "algorithm"), params)
+            for name, params in algo_list
+        ]
+        serving = doer(self._pick(self.serving_class_map, serv_name, "serving"), serv_params)
+        return data_source, preparator, algorithms, serving
+
+    # -- train (object Engine.train, Engine.scala:623-712) ----------------
+    def train(
+        self,
+        ctx: MeshContext,
+        engine_params: EngineParams,
+        params: WorkflowParams = WorkflowParams(),
+    ) -> list[Any]:
+        data_source, preparator, algorithms, _ = self._instantiate(engine_params)
+        td = data_source.read_training(ctx)
+        _sanity_check(td, "training data", params)
+        if params.stop_after_read:
+            raise StopAfterReadInterruption()
+        pd = preparator.prepare(ctx, td)
+        _sanity_check(pd, "prepared data", params)
+        if params.stop_after_prepare:
+            raise StopAfterPrepareInterruption()
+        models = []
+        for i, algo in enumerate(algorithms):
+            logger.info("training algorithm %d/%d: %s", i + 1, len(algorithms),
+                        type(algo).__name__)
+            model = algo.train(ctx, pd)
+            _sanity_check(model, f"model[{i}]", params)
+            models.append(model)
+        return models
+
+    # -- eval (object Engine.eval, Engine.scala:728-816) ------------------
+    def eval(
+        self,
+        ctx: MeshContext,
+        engine_params: EngineParams,
+        params: WorkflowParams = WorkflowParams(),
+    ) -> list[tuple[EI, list[tuple[Q, P, A]]]]:
+        data_source, preparator, algorithms, serving = self._instantiate(engine_params)
+        eval_sets = data_source.read_eval(ctx)
+        results = []
+        for fold, (td, ei, qa) in enumerate(eval_sets):
+            pd = preparator.prepare(ctx, td)
+            models = [algo.train(ctx, pd) for algo in algorithms]
+            queries = [(i, serving.supplement(q)) for i, (q, _) in enumerate(qa)]
+            # per-algo vectorized predictions, grouped back per query index
+            per_query: list[list[Any]] = [[] for _ in queries]
+            for algo, model in zip(algorithms, models):
+                for i, p in algo.batch_predict(model, queries):
+                    per_query[i].append(p)
+            fold_out = [
+                (sq, serving.serve(sq, preds), a)
+                for ((_, sq), (_, a), preds) in zip(queries, qa, per_query)
+            ]
+            logger.info("eval fold %d: %d labeled queries", fold, len(fold_out))
+            results.append((ei, fold_out))
+        return results
+
+    # -- persistence glue (Engine.makeSerializableModels :284, prepareDeploy :198)
+    def models_for_persistence(
+        self,
+        ctx: MeshContext,
+        models: Sequence[Any],
+        instance_id: str,
+        engine_params: EngineParams,
+    ) -> list[Any]:
+        _, _, algorithms, _ = self._instantiate(engine_params)
+        out = []
+        for i, (algo, model) in enumerate(zip(algorithms, models)):
+            if isinstance(model, PersistentModel):
+                if model.save(f"{instance_id}_{i}", algo.params, ctx):
+                    out.append(PersistentModelManifest(class_path(type(model))))
+                    continue
+            out.append(algo.make_persistent_model(ctx, f"{instance_id}_{i}", model))
+        return out
+
+    def prepare_deploy(
+        self,
+        ctx: MeshContext,
+        engine_params: EngineParams,
+        persisted_models: Sequence[Any],
+        instance_id: str,
+    ) -> list[Any]:
+        """Persisted forms → live models (Engine.prepareDeploy, Engine.scala:198-258)."""
+        _, _, algorithms, _ = self._instantiate(engine_params)
+        retrain_needed = any(m is None for m in persisted_models)
+        retrained: list[Any] = []
+        if retrain_needed:
+            logger.warning(
+                "some models are not persistable; retraining at deploy "
+                "(reference tradeoff Engine.scala:210-232)"
+            )
+            retrained = self.train(ctx, engine_params)
+        out = []
+        for i, (algo, persisted) in enumerate(zip(algorithms, persisted_models)):
+            if isinstance(persisted, PersistentModelManifest):
+                model_cls = load_class(persisted.class_path)
+                out.append(model_cls.load(f"{instance_id}_{i}", algo.params, ctx))
+            elif persisted is None:
+                out.append(retrained[i])
+            else:
+                out.append(persisted)
+        return out
+
+    def serving_and_algorithms(self, engine_params: EngineParams):
+        """Instantiated (algorithms, serving) for the query path (CreateServer)."""
+        _, _, algorithms, serving = self._instantiate(engine_params)
+        return algorithms, serving
+
+    # -- variant JSON → EngineParams (Engine.jValueToEngineParams :355) ----
+    def engine_params_from_variant(self, variant: dict[str, Any]) -> EngineParams:
+        def stage_params(key: str, class_map: ClassMap) -> NamedParams:
+            spec = variant.get(key)
+            if spec is None:
+                return ("", EmptyParams())
+            name = spec.get("name", "")
+            cls = self._pick(class_map, name, key)
+            return (name, params_from_json(getattr(cls, "params_class", None), spec.get("params")))
+
+        algo_specs = variant.get("algorithms")
+        if algo_specs is None:
+            algos: tuple[NamedParams, ...] = ()
+        else:
+            algos = tuple(
+                (
+                    spec.get("name", ""),
+                    params_from_json(
+                        getattr(
+                            self._pick(self.algorithm_class_map, spec.get("name", ""), "algorithm"),
+                            "params_class",
+                            None,
+                        ),
+                        spec.get("params"),
+                    ),
+                )
+                for spec in algo_specs
+            )
+        return EngineParams(
+            data_source_params=stage_params("datasource", self.data_source_class_map),
+            preparator_params=stage_params("preparator", self.preparator_class_map),
+            algorithm_params_list=algos,
+            serving_params=stage_params("serving", self.serving_class_map),
+        )
+
+
+class SimpleEngine(Engine[TD, EI, Q, P, A]):
+    """1-datasource/1-algorithm sugar (EngineParams.scala:130)."""
+
+    def __init__(self, data_source_class: type, algorithm_class: type,
+                 serving_class: type = FirstServing):
+        super().__init__(data_source_class, IdentityPreparator, algorithm_class, serving_class)
+
+
+class EngineFactory:
+    """Template entry point (controller/EngineFactory.scala:31). Subclass and
+    implement ``apply``; the variant JSON's ``engineFactory`` key names this
+    class (or a plain callable) by import path."""
+
+    def apply(self) -> Engine:
+        raise NotImplementedError
+
+    def __call__(self) -> Engine:
+        return self.apply()
+
+
+EngineFactoryLike = Union[EngineFactory, Callable[[], Engine]]
+
+
+def resolve_engine_factory(path: str) -> Callable[[], Engine]:
+    """Import an engineFactory path → zero-arg callable returning an Engine
+    (WorkflowUtils.getEngine, WorkflowUtils.scala:53-118)."""
+    obj = load_class(path)
+    if isinstance(obj, type):
+        inst = obj()
+        if isinstance(inst, EngineFactory):
+            return inst
+        if isinstance(inst, Engine):
+            return lambda: inst
+        raise TypeError(f"{path} instantiates {type(inst)}, not an Engine/EngineFactory")
+    if isinstance(obj, EngineFactory) or callable(obj):
+        return obj
+    raise TypeError(f"{path} is not an engine factory")
+
+
+def variant_from_file(path: str) -> dict[str, Any]:
+    """Load an engine-variant JSON file (engine.json)."""
+    with open(path) as f:
+        return json.load(f)

@@ -1,0 +1,19 @@
+"""Workflow runtime: train/eval orchestration, deployment preparation."""
+
+from incubator_predictionio_tpu.core.workflow.core_workflow import (
+    CleanupFunctions,
+    run_evaluation,
+    run_train,
+)
+from incubator_predictionio_tpu.core.workflow.create_workflow import (
+    WorkflowConfig,
+    create_workflow,
+)
+
+__all__ = [
+    "CleanupFunctions",
+    "WorkflowConfig",
+    "create_workflow",
+    "run_evaluation",
+    "run_train",
+]

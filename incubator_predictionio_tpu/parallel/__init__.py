@@ -1,0 +1,10 @@
+"""Parallelism layer: device mesh context, sharding helpers, collectives.
+
+Replaces the reference's Spark execution layer (SparkContext construction in
+workflow/WorkflowContext.scala:28, spark-submit in tools/Runner.scala:185) with
+a `jax.sharding.Mesh` + XLA-collective stack over ICI/DCN.
+"""
+
+from incubator_predictionio_tpu.parallel.mesh import MeshContext
+
+__all__ = ["MeshContext"]

@@ -1,0 +1,182 @@
+"""MeshContext — the execution context handed to every DASE stage.
+
+This is the TPU-native replacement for the reference's ``SparkContext``
+(created in workflow/WorkflowContext.scala:29-47 and threaded through every
+stage signature, core/BaseDataSource.scala:43, BaseAlgorithm.scala:69):
+instead of an RDD factory it owns a ``jax.sharding.Mesh`` over the local (or
+multi-host) device topology plus the sharding helpers stages use to lay data
+and parameters out across it.
+
+Axis convention (the "How to Scale Your Model" recipe):
+
+- ``data``  — batch-dimension data parallelism (DP); gradients psum over it.
+- ``model`` — tensor/model parallelism (TP); embedding tables and wide matmuls
+  shard over it.
+
+Extra axes (``seq`` for context parallelism, ``expert`` for MoE) can be added
+per engine via ``axes=...``. All collectives ride XLA (psum/all_gather/
+ppermute) over ICI — there is no NCCL/MPI analogue to manage.
+
+Multi-host: call :meth:`MeshContext.create` with ``distributed=True`` after
+`jax.distributed.initialize`; the mesh then spans all processes' devices and
+per-host input feeding goes through :meth:`make_global_array`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import math
+from dataclasses import dataclass
+from typing import Any, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class MeshConf:
+    """Serializable mesh request — stored on EngineInstance rows the way the
+    reference stores ``sparkConf`` (EngineInstances.scala:44)."""
+
+    axes: dict[str, int] | None = None  # e.g. {"data": 4, "model": 2}; None = all data
+    distributed: bool = False
+
+    @staticmethod
+    def from_dict(d: dict[str, Any]) -> "MeshConf":
+        return MeshConf(axes=d.get("axes"), distributed=bool(d.get("distributed", False)))
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"axes": self.axes, "distributed": self.distributed}
+
+
+class MeshContext:
+    """Device mesh + sharding helpers; one per workflow run.
+
+    Stages receive this as ``ctx`` (where the reference passes ``sc``).
+    """
+
+    def __init__(self, mesh: Mesh):
+        self.mesh = mesh
+
+    # -- construction -----------------------------------------------------
+    @staticmethod
+    def create(
+        axes: Optional[dict[str, int]] = None,
+        devices: Optional[Sequence[jax.Device]] = None,
+        distributed: bool = False,
+    ) -> "MeshContext":
+        """Build a mesh over the available devices.
+
+        ``axes`` maps axis name → size; one axis may be -1 (inferred). Default
+        is a single ``data`` axis over every device. Axis sizes must multiply
+        to the device count — mismatches raise rather than silently dropping
+        devices.
+        """
+        if distributed:  # pragma: no cover - needs multi-host
+            jax.distributed.initialize()
+        devs = list(devices if devices is not None else jax.devices())
+        if not axes:
+            axes = {"data": len(devs)}
+        names = list(axes.keys())
+        sizes = list(axes.values())
+        if sizes.count(-1) > 1:
+            raise ValueError("at most one mesh axis may be -1")
+        if -1 in sizes:
+            known = math.prod(s for s in sizes if s != -1)
+            if len(devs) % known:
+                raise ValueError(
+                    f"cannot infer -1 axis: {len(devs)} devices not divisible by {known}"
+                )
+            sizes[sizes.index(-1)] = len(devs) // known
+        if math.prod(sizes) != len(devs):
+            raise ValueError(
+                f"mesh axes {dict(zip(names, sizes))} need {math.prod(sizes)} devices, "
+                f"have {len(devs)}"
+            )
+        dev_array = np.array(devs).reshape(sizes)
+        mesh = Mesh(dev_array, axis_names=names)
+        logger.info("mesh: %s over %d %s devices",
+                    dict(zip(names, sizes)), len(devs), devs[0].platform)
+        return MeshContext(mesh)
+
+    @staticmethod
+    def from_conf(conf: MeshConf | dict[str, Any] | None) -> "MeshContext":
+        if conf is None:
+            return MeshContext.create()
+        if isinstance(conf, dict):
+            conf = MeshConf.from_dict(conf)
+        return MeshContext.create(axes=conf.axes, distributed=conf.distributed)
+
+    # -- topology ---------------------------------------------------------
+    @property
+    def axis_names(self) -> tuple[str, ...]:
+        return tuple(self.mesh.axis_names)
+
+    @property
+    def n_devices(self) -> int:
+        return self.mesh.size
+
+    def axis_size(self, name: str) -> int:
+        return self.mesh.shape[name]
+
+    @property
+    def data_axis(self) -> str:
+        """The batch-parallel axis (first axis by convention)."""
+        return "data" if "data" in self.mesh.shape else self.mesh.axis_names[0]
+
+    # -- sharding helpers -------------------------------------------------
+    def sharding(self, *spec) -> NamedSharding:
+        return NamedSharding(self.mesh, P(*spec))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def replicate(self, tree):
+        """Place a pytree replicated on every device."""
+        return jax.device_put(tree, self.replicated())
+
+    def shard_batch(self, tree, axis_name: Optional[str] = None):
+        """Shard leading (batch) dim over the data axis; pads are the caller's
+        job — batch size must divide the axis size."""
+        axis = axis_name or self.data_axis
+        sh = self.sharding(axis)
+
+        def put(x):
+            x = np.asarray(x)
+            if x.shape[0] % self.axis_size(axis):
+                raise ValueError(
+                    f"batch dim {x.shape[0]} not divisible by mesh axis "
+                    f"{axis}={self.axis_size(axis)}"
+                )
+            return jax.device_put(x, sh)
+
+        return jax.tree.map(put, tree)
+
+    def pad_to_batch_multiple(self, n: int) -> int:
+        """Smallest multiple of the data-axis size ≥ n (static-shape friend)."""
+        k = self.axis_size(self.data_axis)
+        return ((n + k - 1) // k) * k
+
+    def make_global_array(self, local_data: np.ndarray, spec: P):
+        """Multi-host input feeding (jax.make_array_from_process_local_data)."""
+        return jax.make_array_from_process_local_data(
+            self.sharding(*spec), local_data
+        )  # pragma: no cover - needs multi-host
+
+    @contextlib.contextmanager
+    def activate(self):
+        """``with ctx.activate():`` — make the mesh current for shard_map /
+        implicit-sharding code regions."""
+        with self.mesh:
+            yield self
+
+    def stop(self) -> None:
+        """Release the context (parity with sc.stop(); devices are
+        process-owned in JAX so this is a no-op hook for plugins)."""
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"MeshContext({dict(self.mesh.shape)})"

@@ -1,0 +1,1 @@
+"""Shared utilities: serialization, params JSON binding, id generation."""

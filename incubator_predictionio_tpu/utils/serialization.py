@@ -1,0 +1,36 @@
+"""Model (de)serialization — the Kryo replacement.
+
+The reference Kryo-serializes trained model objects into the MODELDATA blob
+store (workflow/CoreWorkflow.scala:79-84, deserialization CreateServer.scala:199).
+Here models are arbitrary Python object graphs that may contain `jax.Array`
+leaves; we pickle with a reducer that converts device arrays to numpy on the
+way out, so blobs are host-independent and deserialization never requires the
+training topology. Deploy re-device-puts what it needs (the resident predict
+fn's donate/placement policy decides, not the blob format).
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any
+
+import jax
+import numpy as np
+
+
+class _JaxAwarePickler(pickle.Pickler):
+    def reducer_override(self, obj):
+        if isinstance(obj, jax.Array):
+            return (np.asarray, (np.asarray(obj),))
+        return NotImplemented
+
+
+def serialize_model(obj: Any) -> bytes:
+    buf = io.BytesIO()
+    _JaxAwarePickler(buf, protocol=pickle.HIGHEST_PROTOCOL).dump(obj)
+    return buf.getvalue()
+
+
+def deserialize_model(data: bytes) -> Any:
+    return pickle.loads(data)

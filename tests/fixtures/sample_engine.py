@@ -1,0 +1,88 @@
+"""Deterministic fake DASE implementations for core tests.
+
+Parity with the reference's test fixtures
+(core/src/test/scala/.../controller/SampleEngine.scala, 489 LoC of fake
+data sources/algorithms with predictable outputs).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from incubator_predictionio_tpu.core import (
+    Engine,
+    EngineFactory,
+    FirstServing,
+    IdentityPreparator,
+    LServing,
+    P2LAlgorithm,
+    Params,
+    PDataSource,
+    SanityCheck,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class DSParams(Params):
+    n: int = 10
+    fail_sanity: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class AlgoParams(Params):
+    mult: int = 1
+
+
+@dataclasses.dataclass
+class TrainingData(SanityCheck):
+    values: list
+    fail_sanity: bool = False
+
+    def sanity_check(self):
+        if self.fail_sanity:
+            raise ValueError("sanity check failed as requested")
+
+
+class SampleDataSource(PDataSource):
+    params_class = DSParams
+
+    def read_training(self, ctx):
+        return TrainingData(list(range(self.params.n)), self.params.fail_sanity)
+
+    def read_eval(self, ctx):
+        td = TrainingData(list(range(self.params.n)))
+        # two folds; queries are ints, actual = query * 10
+        folds = []
+        for fold in range(2):
+            qa = [(q, q * 10) for q in range(3)]
+            folds.append((td, {"fold": fold}, qa))
+        return folds
+
+
+class SampleAlgorithm(P2LAlgorithm):
+    params_class = AlgoParams
+
+    def train(self, ctx, pd: TrainingData):
+        return {"sum": sum(pd.values), "mult": self.params.mult}
+
+    def predict(self, model, query: int):
+        return model["sum"] * model["mult"] + query
+
+
+class SampleServing(LServing):
+    def serve(self, query, predictions):
+        return max(predictions)
+
+
+class SampleEngineFactory(EngineFactory):
+    def apply(self) -> Engine:
+        return Engine(
+            SampleDataSource,
+            IdentityPreparator,
+            {"algo": SampleAlgorithm, "": SampleAlgorithm},
+            {"": SampleServing, "first": FirstServing},
+        )
+
+
+def simple_engine() -> Engine:
+    return SampleEngineFactory().apply()

@@ -1,0 +1,159 @@
+"""Engine train/eval/persistence semantics (parity: core EngineTest.scala, 692 LoC)."""
+
+import dataclasses
+
+import pytest
+
+from incubator_predictionio_tpu.core import (
+    EmptyParams,
+    EngineParams,
+    StopAfterPrepareInterruption,
+    StopAfterReadInterruption,
+    WorkflowParams,
+)
+from incubator_predictionio_tpu.parallel.mesh import MeshContext
+from tests.fixtures.sample_engine import (
+    AlgoParams,
+    DSParams,
+    SampleEngineFactory,
+    simple_engine,
+)
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return MeshContext.create()
+
+
+def ep(n=10, mult=2, fail_sanity=False):
+    return EngineParams.create(
+        data_source=DSParams(n=n, fail_sanity=fail_sanity),
+        algorithms=[("algo", AlgoParams(mult=mult))],
+    )
+
+
+class TestTrain:
+    def test_train_produces_models(self, ctx):
+        models = simple_engine().train(ctx, ep(n=5, mult=3))
+        assert models == [{"sum": 10, "mult": 3}]
+
+    def test_multi_algo(self, ctx):
+        params = EngineParams.create(
+            data_source=DSParams(n=4),
+            algorithms=[("algo", AlgoParams(mult=1)), ("algo", AlgoParams(mult=10))],
+        )
+        models = simple_engine().train(ctx, params)
+        assert [m["mult"] for m in models] == [1, 10]
+
+    def test_sanity_check_enforced(self, ctx):
+        with pytest.raises(ValueError, match="sanity"):
+            simple_engine().train(ctx, ep(fail_sanity=True))
+        # skipped when requested (WorkflowParams.skipSanityCheck)
+        models = simple_engine().train(
+            ctx, ep(fail_sanity=True), WorkflowParams(skip_sanity_check=True)
+        )
+        assert len(models) == 1
+
+    def test_stop_after_hooks(self, ctx):
+        with pytest.raises(StopAfterReadInterruption):
+            simple_engine().train(ctx, ep(), WorkflowParams(stop_after_read=True))
+        with pytest.raises(StopAfterPrepareInterruption):
+            simple_engine().train(ctx, ep(), WorkflowParams(stop_after_prepare=True))
+
+    def test_unknown_stage_name(self, ctx):
+        bad = EngineParams.create(algorithms=[("nope", AlgoParams())])
+        with pytest.raises(KeyError, match="nope"):
+            simple_engine().train(ctx, bad)
+
+
+class TestEval:
+    def test_eval_shape_and_serving(self, ctx):
+        results = simple_engine().eval(ctx, ep(n=5, mult=1))
+        assert len(results) == 2  # two folds
+        ei, qpas = results[0]
+        assert ei == {"fold": 0}
+        # model sum=10, mult=1 → prediction = 10 + q; serving takes max (single algo)
+        assert [(q, p, a) for q, p, a in qpas] == [(0, 10, 0), (1, 11, 10), (2, 12, 20)]
+
+    def test_eval_multi_algo_serving_max(self, ctx):
+        params = EngineParams.create(
+            data_source=DSParams(n=5),
+            algorithms=[("algo", AlgoParams(mult=1)), ("algo", AlgoParams(mult=2))],
+        )
+        results = simple_engine().eval(ctx, params)
+        _, qpas = results[0]
+        assert qpas[0][1] == 20  # max(10*1+0, 10*2+0)
+
+
+class TestVariantJson:
+    def test_variant_binding(self):
+        engine = simple_engine()
+        variant = {
+            "id": "default",
+            "engineFactory": "tests.fixtures.sample_engine.SampleEngineFactory",
+            "datasource": {"params": {"n": 7}},
+            "algorithms": [{"name": "algo", "params": {"mult": 5}}],
+            "serving": {"name": "first"},
+        }
+        params = engine.engine_params_from_variant(variant)
+        assert params.data_source_params[1] == DSParams(n=7)
+        assert params.algorithm_params_list == (("algo", AlgoParams(mult=5)),)
+        assert params.serving_params == ("first", EmptyParams())
+
+    def test_unknown_param_rejected(self):
+        engine = simple_engine()
+        with pytest.raises(TypeError, match="unknown parameter"):
+            engine.engine_params_from_variant(
+                {"datasource": {"params": {"bogus": 1}}}
+            )
+
+    def test_camel_case_binding(self):
+        engine = simple_engine()
+        variant = {"datasource": {"params": {"failSanity": True}}}
+        params = engine.engine_params_from_variant(variant)
+        assert params.data_source_params[1].fail_sanity is True
+
+
+class TestPersistence:
+    def test_models_roundtrip_through_blob(self, ctx):
+        from incubator_predictionio_tpu.utils.serialization import (
+            deserialize_model,
+            serialize_model,
+        )
+
+        engine = simple_engine()
+        models = engine.train(ctx, ep(n=5, mult=3))
+        persisted = engine.models_for_persistence(ctx, models, "inst1", ep(n=5, mult=3))
+        blob = serialize_model(persisted)
+        restored = engine.prepare_deploy(ctx, ep(n=5, mult=3), deserialize_model(blob), "inst1")
+        assert restored == models
+
+    def test_jax_arrays_become_numpy(self):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from incubator_predictionio_tpu.utils.serialization import (
+            deserialize_model,
+            serialize_model,
+        )
+
+        model = {"w": jnp.arange(8.0), "meta": "x"}
+        restored = deserialize_model(serialize_model(model))
+        assert isinstance(restored["w"], np.ndarray)
+        assert restored["w"].tolist() == list(range(8))
+
+    def test_none_model_retrains_at_deploy(self, ctx):
+        engine = simple_engine()
+        restored = engine.prepare_deploy(ctx, ep(n=5, mult=3), [None], "inst2")
+        assert restored == [{"sum": 10, "mult": 3}]
+
+
+class TestEngineFactoryResolution:
+    def test_resolve_by_path(self):
+        from incubator_predictionio_tpu.core import resolve_engine_factory
+
+        factory = resolve_engine_factory("tests.fixtures.sample_engine.SampleEngineFactory")
+        engine = factory()
+        assert engine.algorithm_class_map  # it's an Engine
+        factory2 = resolve_engine_factory("tests.fixtures.sample_engine:simple_engine")
+        assert factory2().serving_class_map

@@ -1,0 +1,60 @@
+"""MeshContext over the virtual 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from incubator_predictionio_tpu.parallel.mesh import MeshConf, MeshContext
+
+
+def test_default_mesh_uses_all_devices():
+    ctx = MeshContext.create()
+    assert ctx.n_devices == 8
+    assert ctx.data_axis == "data"
+
+
+def test_axes_inference():
+    ctx = MeshContext.create(axes={"data": -1, "model": 2})
+    assert ctx.axis_size("data") == 4
+    assert ctx.axis_size("model") == 2
+
+
+def test_bad_axes_rejected():
+    with pytest.raises(ValueError):
+        MeshContext.create(axes={"data": 3})
+    with pytest.raises(ValueError):
+        MeshContext.create(axes={"data": -1, "model": -1})
+
+
+def test_shard_batch_and_psum():
+    ctx = MeshContext.create(axes={"data": 8})
+    x = np.arange(16.0).reshape(16, 1)
+    xs = ctx.shard_batch(x)
+    assert xs.sharding.spec == jax.sharding.PartitionSpec("data")
+
+    @jax.jit
+    def total(v):
+        return jnp.sum(v)
+
+    assert float(total(xs)) == x.sum()
+
+
+def test_shard_batch_divisibility_enforced():
+    ctx = MeshContext.create()
+    with pytest.raises(ValueError, match="not divisible"):
+        ctx.shard_batch(np.ones((3, 2)))
+    assert ctx.pad_to_batch_multiple(3) == 8
+    assert ctx.pad_to_batch_multiple(8) == 8
+
+
+def test_replicate():
+    ctx = MeshContext.create()
+    w = ctx.replicate({"w": np.ones((4, 4))})
+    assert w["w"].sharding.is_fully_replicated
+
+
+def test_conf_roundtrip():
+    conf = MeshConf(axes={"data": 4, "model": 2})
+    ctx = MeshContext.from_conf(conf.to_dict())
+    assert dict(ctx.mesh.shape) == {"data": 4, "model": 2}

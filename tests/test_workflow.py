@@ -1,0 +1,92 @@
+"""Workflow runtime: train/eval runs writing meta + model rows.
+
+Parity: EngineWorkflowTest / EvaluationWorkflowTest in the reference core
+tests, against in-memory storage.
+"""
+
+import datetime as dt
+
+import pytest
+
+from incubator_predictionio_tpu.core import (
+    AverageMetric,
+    EngineParams,
+    Evaluation,
+    MetricEvaluator,
+)
+from incubator_predictionio_tpu.core.workflow import run_evaluation, run_train
+from incubator_predictionio_tpu.data.storage.base import EngineInstance, EvaluationInstance
+from incubator_predictionio_tpu.data.storage.registry import Storage
+from incubator_predictionio_tpu.utils.serialization import deserialize_model
+from tests.fixtures.sample_engine import AlgoParams, DSParams, simple_engine
+
+UTC = dt.timezone.utc
+
+
+@pytest.fixture()
+def storage():
+    s = Storage({"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+    yield s
+    s.close()
+
+
+def make_instance():
+    return EngineInstance(
+        id="", status="INIT", start_time=dt.datetime.now(UTC), end_time=None,
+        engine_id="sample", engine_version="1", engine_variant="engine.json",
+        engine_factory="tests.fixtures.sample_engine.SampleEngineFactory",
+    )
+
+
+def test_run_train_persists_model_and_completes(storage):
+    params = EngineParams.create(
+        data_source=DSParams(n=5), algorithms=[("algo", AlgoParams(mult=3))]
+    )
+    iid = run_train(simple_engine(), params, make_instance(), storage=storage)
+    inst = storage.get_meta_data_engine_instances().get(iid)
+    assert inst.status == "COMPLETED" and inst.end_time is not None
+    blob = storage.get_model_data_models().get(iid)
+    assert deserialize_model(blob.models) == [{"sum": 10, "mult": 3}]
+    latest = storage.get_meta_data_engine_instances().get_latest_completed(
+        "sample", "1", "engine.json"
+    )
+    assert latest.id == iid
+
+
+def test_run_train_marks_failed(storage):
+    params = EngineParams.create(
+        data_source=DSParams(n=5, fail_sanity=True),
+        algorithms=[("algo", AlgoParams())],
+    )
+    with pytest.raises(ValueError):
+        run_train(simple_engine(), params, make_instance(), storage=storage)
+    instances = storage.get_meta_data_engine_instances().get_all()
+    assert len(instances) == 1 and instances[0].status == "FAILED"
+
+
+class ErrorMetric(AverageMetric):
+    def calculate_qpa(self, q, p, a) -> float:
+        return -abs(p - a)
+
+
+def test_run_evaluation_picks_best_variant(storage):
+    evaluation = Evaluation()
+    evaluation.engine = simple_engine()
+    evaluation.evaluator = MetricEvaluator(ErrorMetric())
+    variants = [
+        EngineParams.create(data_source=DSParams(n=5),
+                            algorithms=[("algo", AlgoParams(mult=m))])
+        for m in (1, 2, 3)
+    ]
+    instance = EvaluationInstance(
+        id="", status="INIT", start_time=dt.datetime.now(UTC), end_time=None,
+        evaluation_class="test.Eval",
+    )
+    iid, result = run_evaluation(evaluation, variants, instance, storage=storage)
+    # mult=1 gives smallest |p - a|
+    assert result.best_idx == 0
+    assert result.best_engine_params.algorithm_params_list[0][1] == AlgoParams(mult=1)
+    stored = storage.get_meta_data_evaluation_instances().get(iid)
+    assert stored.status == "EVALCOMPLETED"
+    assert "ErrorMetric" in stored.evaluator_results
+    assert stored.evaluator_results_json
