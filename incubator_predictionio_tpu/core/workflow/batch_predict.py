@@ -1,0 +1,66 @@
+"""BatchPredict — offline bulk scoring.
+
+Parity target: workflow/BatchPredict.scala:145-235: read one JSON query per
+line, run supplement → predict × algorithms → serve per query, write one JSON
+prediction per line.
+
+The reference deserializes Kryo models once per Spark partition and loops
+queries; here the deployed models are loaded once and queries go through each
+algorithm's **vectorized** ``batch_predict`` in device-sized chunks — the
+"high-performance parallelization" the reference's docs promise is the MXU
+batch dimension instead of executor fan-out.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+from typing import Optional
+
+from incubator_predictionio_tpu.parallel.mesh import MeshContext
+from incubator_predictionio_tpu.data.storage.registry import Storage
+from incubator_predictionio_tpu.server.query_server import ServerConfig, load_deployed_engine
+from incubator_predictionio_tpu.utils.json_util import bind_query, to_jsonable
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class BatchPredictConfig:
+    """(BatchPredict.scala flags :60-110)"""
+
+    engine_variant: str = "engine.json"
+    input_path: str = "batchpredict-input.json"
+    output_path: str = "batchpredict-output.json"
+    query_chunk: int = 1024  # device batch per predict round
+
+
+def run_batch_predict(
+    config: BatchPredictConfig,
+    storage: Optional[Storage] = None,
+    ctx: Optional[MeshContext] = None,
+) -> int:
+    """Returns the number of predictions written."""
+    deployed = load_deployed_engine(
+        ServerConfig(engine_variant=config.engine_variant), storage, ctx
+    )
+    serving = deployed.serving
+    n = 0
+    with open(config.input_path) as fin, open(config.output_path, "w") as fout:
+        lines = [line.strip() for line in fin if line.strip()]
+        queries = [
+            serving.supplement(bind_query(deployed.query_cls, json.loads(line)))
+            for line in lines
+        ]
+        for start in range(0, len(queries), config.query_chunk):
+            chunk = list(enumerate(queries[start:start + config.query_chunk]))
+            per_query: list[list] = [[] for _ in chunk]
+            for algo, model in zip(deployed.algorithms, deployed.models):
+                for i, p in algo.batch_predict(model, chunk):
+                    per_query[i].append(p)
+            for (_, q), preds in zip(chunk, per_query):
+                fout.write(json.dumps(to_jsonable(serving.serve(q, preds))) + "\n")
+                n += 1
+    logger.info("batch predict: %d queries → %s", n, config.output_path)
+    return n

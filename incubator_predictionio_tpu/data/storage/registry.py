@@ -222,6 +222,16 @@ def get_storage(refresh: bool = False) -> Storage:
         return _storage_singleton
 
 
+def use_storage(storage: Optional[Storage]) -> Optional[Storage]:
+    """Install an explicit Storage as the process singleton; returns the
+    previous one. The unit-test seam the reference gets from its mockable
+    EnvironmentService (StorageMockContext.scala:22). Pass None to reset."""
+    global _storage_singleton
+    with _singleton_lock:
+        prev, _storage_singleton = _storage_singleton, storage
+        return prev
+
+
 def storage_env_vars(env: Optional[dict[str, str]] = None) -> dict[str, str]:
     """Extract the PIO_* env subset that must cross process boundaries
     (reference Runner.pioEnvVars, Runner.scala:217-219)."""

@@ -1,0 +1,52 @@
+"""Webhook connector SPI (reference data/webhooks/{Json,Form}Connector.scala:26).
+
+A connector translates a third-party payload into event JSON. Connectors
+register in :data:`CONNECTORS` under ``(name, kind)`` with kind ``"json"`` or
+``"form"``; the Event Server serves them at ``/webhooks/<name>.<json|form>``.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Mapping
+
+
+class ConnectorError(ValueError):
+    """(reference ConnectorException)"""
+
+
+class JsonConnector(abc.ABC):
+    @abc.abstractmethod
+    def to_event_json(self, data: Mapping[str, Any]) -> dict: ...
+
+
+class FormConnector(abc.ABC):
+    @abc.abstractmethod
+    def to_event_json(self, data: Mapping[str, str]) -> dict: ...
+
+
+#: (name, "json"|"form") -> connector instance
+CONNECTORS: dict[tuple[str, str], Any] = {}
+
+
+def register_connector(name: str, kind: str, connector: Any) -> None:
+    if kind not in ("json", "form"):
+        raise ValueError(f"connector kind must be json or form, got {kind!r}")
+    CONNECTORS[(name, kind)] = connector
+
+
+def _register_builtin() -> None:
+    from incubator_predictionio_tpu.data.webhooks.example import (
+        ExampleFormConnector,
+        ExampleJsonConnector,
+    )
+    from incubator_predictionio_tpu.data.webhooks.mailchimp import MailChimpConnector
+    from incubator_predictionio_tpu.data.webhooks.segmentio import SegmentIOConnector
+
+    CONNECTORS.setdefault(("segmentio", "json"), SegmentIOConnector())
+    CONNECTORS.setdefault(("mailchimp", "form"), MailChimpConnector())
+    CONNECTORS.setdefault(("exampleJson", "json"), ExampleJsonConnector())
+    CONNECTORS.setdefault(("exampleForm", "form"), ExampleFormConnector())
+
+
+_register_builtin()

@@ -1,0 +1,79 @@
+"""Example webhook connectors (reference data/webhooks/examplejson/
+ExampleJsonConnector.scala and exampleform/ExampleFormConnector.scala):
+the documented starting points for custom connectors."""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+from incubator_predictionio_tpu.data.webhooks import (
+    ConnectorError,
+    FormConnector,
+    JsonConnector,
+)
+
+
+class ExampleJsonConnector(JsonConnector):
+    """Maps {"type": "userAction"|"userActionItem", ...} JSON payloads."""
+
+    def to_event_json(self, data: Mapping[str, Any]) -> dict:
+        typ = data.get("type")
+        if typ == "userAction":
+            return {
+                "event": data["event"],
+                "entityType": "user",
+                "entityId": str(data["userId"]),
+                "eventTime": data["timestamp"],
+                "properties": data.get("properties", {}),
+            }
+        if typ == "userActionItem":
+            return {
+                "event": data["event"],
+                "entityType": "user",
+                "entityId": str(data["userId"]),
+                "targetEntityType": "item",
+                "targetEntityId": str(data["itemId"]),
+                "eventTime": data["timestamp"],
+                "properties": data.get("properties", {}),
+            }
+        if typ is None:
+            raise ConnectorError("The field 'type' is required.")
+        raise ConnectorError(f"Cannot convert unknown type {typ} to event JSON")
+
+
+class ExampleFormConnector(FormConnector):
+    """Maps form fields incl. nested context[...] keys
+    (ExampleFormConnector.scala:58-125)."""
+
+    def to_event_json(self, data: Mapping[str, str]) -> dict:
+        typ = data.get("type")
+        if typ not in ("userAction", "userActionItem"):
+            if typ is None:
+                raise ConnectorError("The field 'type' is required.")
+            raise ConnectorError(f"Cannot convert unknown type {typ} to event JSON")
+        try:
+            properties: dict[str, Any] = {}
+            context = {
+                k[len("context["):-1]: v
+                for k, v in data.items()
+                if k.startswith("context[")
+            }
+            if context:
+                properties["context"] = context
+            event_json: dict[str, Any] = {
+                "event": data["event"],
+                "entityType": "user",
+                "entityId": data["userId"],
+                "eventTime": data["timestamp"],
+                "properties": properties,
+            }
+            if typ == "userActionItem":
+                event_json["targetEntityType"] = "item"
+                event_json["targetEntityId"] = data["itemId"]
+            for k, v in data.items():
+                if k.startswith("anotherProperty"):
+                    properties[k] = v
+            return event_json
+        except KeyError as e:
+            raise ConnectorError(f"Cannot convert {dict(data)} to event JSON: "
+                                 f"missing {e}") from e

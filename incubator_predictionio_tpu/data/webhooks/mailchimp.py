@@ -1,0 +1,84 @@
+"""MailChimp webhook connector (form-encoded).
+
+Behavioral parity with the reference
+(data/webhooks/mailchimp/MailChimpConnector.scala:32-300, 308 LoC): handles
+subscribe / unsubscribe / profile / upemail / cleaned / campaign payloads,
+mapping the bracketed form keys (``data[id]``, ``data[merges][EMAIL]`` …)
+into event properties. Timestamps arrive as ``yyyy-MM-dd HH:mm:ss`` (UTC) and
+are converted to ISO-8601.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+from typing import Any, Mapping
+
+from incubator_predictionio_tpu.data.webhooks import ConnectorError, FormConnector
+
+
+def _parse_time(s: str) -> str:
+    try:
+        return (
+            _dt.datetime.strptime(s, "%Y-%m-%d %H:%M:%S")
+            .replace(tzinfo=_dt.timezone.utc)
+            .isoformat()
+        )
+    except ValueError as e:
+        raise ConnectorError(f"Cannot parse MailChimp time {s!r}") from e
+
+
+def _collect(data: Mapping[str, str], prefix: str) -> dict[str, Any]:
+    """Lift ``data[x]`` / ``data[merges][Y]`` style keys into a nested dict."""
+    out: dict[str, Any] = {}
+    merges: dict[str, str] = {}
+    for k, v in data.items():
+        if k.startswith("data[merges]["):
+            merges[k[len("data[merges]["):-1]] = v
+        elif k.startswith("data[") and k.endswith("]"):
+            out[k[len("data["):-1]] = v
+    if merges:
+        out["merges"] = merges
+    return out
+
+
+class MailChimpConnector(FormConnector):
+    _ENTITY = {
+        # type -> (event, entityType, entity id form key, target pair or None)
+        # entity types per MailChimpConnector.scala: user except cleaned→"list"
+        # (:261) and campaign→"campaign" (:293)
+        "subscribe": ("subscribe", "user", "data[id]", ("list", "data[list_id]")),
+        "unsubscribe": ("unsubscribe", "user", "data[id]", ("list", "data[list_id]")),
+        "profile": ("profile", "user", "data[id]", ("list", "data[list_id]")),
+        "upemail": ("upemail", "user", "data[new_id]", ("list", "data[list_id]")),
+        "cleaned": ("cleaned", "list", "data[list_id]", None),
+        "campaign": ("campaign", "campaign", "data[id]", ("list", "data[list_id]")),
+    }
+
+    def to_event_json(self, data: Mapping[str, str]) -> dict:
+        typ = data.get("type")
+        if typ not in self._ENTITY:
+            raise ConnectorError(f"Cannot convert unknown type {typ} to event JSON.")
+        if "fired_at" not in data:
+            raise ConnectorError("The field 'fired_at' is required.")
+        event_name, entity_type, id_key, target = self._ENTITY[typ]
+        if id_key not in data:
+            raise ConnectorError(f"The field '{id_key}' is required.")
+        props = _collect(data, "data[")
+        # the id fields live at the event level, not in properties
+        for consumed in ("id", "new_id" if typ == "upemail" else None,
+                         "list_id" if target or typ == "cleaned" else None):
+            if consumed:
+                props.pop(consumed, None)
+        event_json: dict[str, Any] = {
+            "event": event_name,
+            "entityType": entity_type,
+            "entityId": data[id_key],
+            "eventTime": _parse_time(data["fired_at"]),
+            "properties": props,
+        }
+        if target is not None:
+            target_type, target_key = target
+            if target_key in data:
+                event_json["targetEntityType"] = target_type
+                event_json["targetEntityId"] = data[target_key]
+        return event_json

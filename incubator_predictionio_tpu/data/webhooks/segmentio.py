@@ -1,0 +1,62 @@
+"""Segment.io webhook connector.
+
+Behavioral parity with the reference
+(data/webhooks/segmentio/SegmentIOConnector.scala:24-188, 309 LoC): accepts
+Segment spec v2 payloads of type identify/track/alias/page/screen/group,
+emits an event named after the type on entityType "user" keyed by userId (or
+anonymousId), carrying the type-specific fields plus optional context under
+``properties``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+from incubator_predictionio_tpu.data.webhooks import ConnectorError, JsonConnector
+from incubator_predictionio_tpu.utils.params import snake_case as _snake
+
+_SUPPORTED_VERSIONS = ("2",)
+
+# type -> fields lifted into properties (reference toEventJson overloads :105-146)
+_TYPE_FIELDS = {
+    "identify": ("traits",),
+    "track": ("properties", "event"),
+    "alias": ("previousId",),
+    "page": ("name", "properties"),
+    "screen": ("name", "properties"),
+    "group": ("groupId", "traits"),
+}
+
+
+class SegmentIOConnector(JsonConnector):
+    def to_event_json(self, data: Mapping[str, Any]) -> dict:
+        version = str(data.get("version", ""))
+        if not version:
+            raise ConnectorError("Failed to get segment.io API version.")
+        if version.split(".")[0] not in _SUPPORTED_VERSIONS:
+            raise ConnectorError(
+                f"Supported segment.io API versions: [2]. got [{version}]"
+            )
+        typ = data.get("type")
+        if typ not in _TYPE_FIELDS:
+            raise ConnectorError(f"Cannot convert unknown type {typ} to event JSON.")
+        user_id = data.get("userId") or data.get("anonymousId")
+        if not user_id:
+            raise ConnectorError(
+                "there was no `userId` or `anonymousId` in the common fields."
+            )
+        properties: dict[str, Any] = {}
+        for field in _TYPE_FIELDS[typ]:
+            if field in data:
+                properties[_snake(field)] = data[field]
+        if "context" in data:
+            properties["context"] = data["context"]
+        event_json = {
+            "event": typ,
+            "entityType": "user",
+            "entityId": str(user_id),
+            "properties": properties,
+        }
+        if data.get("timestamp"):
+            event_json["eventTime"] = data["timestamp"]
+        return event_json

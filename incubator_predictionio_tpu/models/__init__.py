@@ -1,0 +1,6 @@
+"""JAX model zoo backing the engine templates.
+
+Replaces the reference's delegation to Spark MLlib (NaiveBayes, ALS,
+RandomForest) with TPU-first implementations: batched bfloat16 matmuls on the
+MXU, data/model-parallel sharding over the mesh, static shapes throughout.
+"""

@@ -1,0 +1,1 @@
+"""HTTP servers: event ingestion (Event Server) and query serving (Engine Server)."""

@@ -1,0 +1,330 @@
+"""Event Server — REST ingestion API.
+
+Parity target: data/api/EventServer.scala:54-663, route for route:
+
+- ``GET  /``                    — welcome ``{"status": "alive"}``
+- ``POST /events.json``         — create (201 + eventId; creationTime is
+                                  forced server-side, EventJson4sSupport.scala:77)
+- ``GET  /events.json``         — query with time/entity/event filters,
+                                  ``limit`` default 20 (−1 = all), ``reversed``
+- ``GET/DELETE /events/<id>.json``
+- ``POST /batch/events.json``   — ≤ 50 events, per-item statuses (:376-462)
+- ``GET  /stats.json``          — opt-in via PIO_EVENTSERVER_STATS=true
+- ``POST/GET /webhooks/<name>.json`` and ``.form`` — connector SPI
+
+Auth matches the reference (withAccessKey, EventServer.scala:92-120):
+``accessKey`` query param or HTTP Basic username; per-key event whitelist;
+optional ``channel`` query param resolved against the app's channels.
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import datetime as _dt
+import json
+import logging
+import os
+from dataclasses import replace
+from typing import Optional
+
+from aiohttp import web
+
+from incubator_predictionio_tpu.data.event import (
+    Event,
+    EventValidationError,
+    validate_event,
+)
+from incubator_predictionio_tpu.data.storage.base import AccessKey
+from incubator_predictionio_tpu.data.storage.registry import Storage, get_storage
+from incubator_predictionio_tpu.data.webhooks import CONNECTORS, ConnectorError
+from incubator_predictionio_tpu.server.stats import Stats
+
+logger = logging.getLogger(__name__)
+
+MAX_BATCH_SIZE = 50  # EventServer.scala:70
+
+
+@dataclasses.dataclass
+class EventServerConfig:
+    ip: str = "0.0.0.0"
+    port: int = 7070
+    stats: bool = dataclasses.field(
+        default_factory=lambda: os.environ.get("PIO_EVENTSERVER_STATS", "").lower()
+        in ("1", "true", "yes")
+    )
+
+
+@dataclasses.dataclass
+class AuthData:
+    """(EventServer.scala AuthData)"""
+
+    app_id: int
+    channel_id: Optional[int]
+    events: tuple[str, ...]  # whitelist; empty = all allowed
+
+
+class WhitelistDenied(Exception):
+    """Event name not in the access key's whitelist → 403."""
+
+
+class EventServer:
+    def __init__(self, config: EventServerConfig = EventServerConfig(),
+                 storage: Optional[Storage] = None):
+        self.config = config
+        self.storage = storage or get_storage()
+        self.stats = Stats()
+        self._runner: Optional[web.AppRunner] = None
+
+    # -- auth (EventServer.scala:92-120) ----------------------------------
+    def _authenticate(self, request: web.Request) -> AuthData:
+        key = request.query.get("accessKey")
+        if not key:
+            auth = request.headers.get("Authorization", "")
+            if auth.startswith("Basic "):
+                try:
+                    decoded = base64.b64decode(auth[6:]).decode()
+                    key = decoded.split(":", 1)[0]
+                except Exception:
+                    key = None
+        if not key:
+            raise web.HTTPUnauthorized(
+                text=json.dumps({"message": "Missing accessKey."}),
+                content_type="application/json",
+            )
+        access_key: Optional[AccessKey] = (
+            self.storage.get_meta_data_access_keys().get(key)
+        )
+        if access_key is None:
+            raise web.HTTPUnauthorized(
+                text=json.dumps({"message": "Invalid accessKey."}),
+                content_type="application/json",
+            )
+        channel_id = None
+        channel_name = request.query.get("channel")
+        if channel_name:
+            channels = self.storage.get_meta_data_channels().get_by_app_id(
+                access_key.app_id
+            )
+            match = next((c for c in channels if c.name == channel_name), None)
+            if match is None:
+                raise web.HTTPUnauthorized(
+                    text=json.dumps({"message": "Invalid channel."}),
+                    content_type="application/json",
+                )
+            channel_id = match.id
+        return AuthData(access_key.app_id, channel_id, access_key.events)
+
+    def _check_whitelist(self, auth: AuthData, event_name: str) -> None:
+        # 403 for non-whitelisted events (EventServer.scala:293, :431)
+        if auth.events and event_name not in auth.events:
+            raise WhitelistDenied(f"{event_name} events are not allowed")
+
+    # -- ingestion --------------------------------------------------------
+    def _ingest_one(self, payload: dict, auth: AuthData) -> str:
+        event = Event.from_json_dict(payload)
+        # server assigns receipt time; client-supplied creationTime is ignored
+        # (EventJson4sSupport.scala:77-78)
+        event = replace(event, creation_time=_dt.datetime.now(_dt.timezone.utc))
+        validate_event(event)
+        self._check_whitelist(auth, event.event)
+        events = self.storage.get_events()
+        events.init(auth.app_id, auth.channel_id)
+        return events.insert(event, auth.app_id, auth.channel_id)
+
+    async def handle_create(self, request: web.Request) -> web.Response:
+        auth = self._authenticate(request)
+        payload = None
+        try:
+            payload = await request.json()
+            if not isinstance(payload, dict):
+                raise EventValidationError("event JSON must be an object")
+            event_id = self._ingest_one(payload, auth)
+            status, body = 201, {"eventId": event_id}
+        except (EventValidationError, json.JSONDecodeError) as e:
+            status, body = 400, {"message": str(e)}
+        except WhitelistDenied as e:
+            status, body = 403, {"message": str(e)}
+        if self.config.stats:
+            self.stats.update(
+                auth.app_id, status,
+                payload.get("event", "<invalid>") if isinstance(payload, dict) else "<invalid>",
+                payload.get("entityType", "<invalid>") if isinstance(payload, dict) else "<invalid>",
+            )
+        return web.json_response(body, status=status)
+
+    async def handle_batch(self, request: web.Request) -> web.Response:
+        auth = self._authenticate(request)
+        try:
+            payload = await request.json()
+        except json.JSONDecodeError as e:
+            return web.json_response({"message": str(e)}, status=400)
+        if not isinstance(payload, list):
+            return web.json_response({"message": "request body must be a JSON array"},
+                                     status=400)
+        if len(payload) > MAX_BATCH_SIZE:
+            # EventServer.scala:390: whole batch rejected
+            return web.json_response(
+                {"message": f"Batch request must have less than or equal to "
+                            f"{MAX_BATCH_SIZE} events"},
+                status=400,
+            )
+        results = []
+        for item in payload:
+            try:
+                if not isinstance(item, dict):
+                    raise EventValidationError("event JSON must be an object")
+                event_id = self._ingest_one(item, auth)
+                results.append({"status": 201, "eventId": event_id})
+            except EventValidationError as e:
+                results.append({"status": 400, "message": str(e)})
+            except WhitelistDenied as e:
+                # per-item 403, batch continues (EventServer.scala:430-433)
+                results.append({"status": 403, "message": str(e)})
+        return web.json_response(results, status=200)
+
+    # -- reads ------------------------------------------------------------
+    async def handle_get_event(self, request: web.Request) -> web.Response:
+        auth = self._authenticate(request)
+        event = self.storage.get_events().get(
+            request.match_info["event_id"], auth.app_id, auth.channel_id
+        )
+        if event is None:
+            return web.json_response({"message": "Not Found"}, status=404)
+        return web.json_response(event.to_json_dict())
+
+    async def handle_delete_event(self, request: web.Request) -> web.Response:
+        auth = self._authenticate(request)
+        found = self.storage.get_events().delete(
+            request.match_info["event_id"], auth.app_id, auth.channel_id
+        )
+        if found:
+            return web.json_response({"message": "Found"})
+        return web.json_response({"message": "Not Found"}, status=404)
+
+    async def handle_find(self, request: web.Request) -> web.Response:
+        auth = self._authenticate(request)
+        q = request.query
+
+        def parse_time(name: str) -> Optional[_dt.datetime]:
+            v = q.get(name)
+            if not v:
+                return None
+            try:
+                return _dt.datetime.fromisoformat(v.replace("Z", "+00:00"))
+            except ValueError:
+                raise web.HTTPBadRequest(
+                    text=json.dumps({"message": f"Invalid {name}: {v}"}),
+                    content_type="application/json",
+                )
+
+        try:
+            limit = int(q.get("limit", 20))
+        except ValueError:
+            return web.json_response(
+                {"message": f"Invalid limit: {q.get('limit')}"}, status=400
+            )
+        event_names = q.getall("event") if "event" in q else None
+        from incubator_predictionio_tpu.data.storage.base import StorageError
+
+        try:
+            found = self.storage.get_events().find(
+                auth.app_id,
+                auth.channel_id,
+                start_time=parse_time("startTime"),
+                until_time=parse_time("untilTime"),
+                entity_type=q.get("entityType"),
+                entity_id=q.get("entityId"),
+                event_names=event_names,
+                limit=None if limit == -1 else limit,
+                reversed=q.get("reversed", "false").lower() == "true",
+            )
+            events = [e.to_json_dict() for e in found]
+        except StorageError as e:  # uninitialized app/channel table
+            return web.json_response({"message": str(e)}, status=404)
+        if not events:
+            return web.json_response({"message": "Not Found"}, status=404)
+        return web.json_response(events)
+
+    # -- misc -------------------------------------------------------------
+    async def handle_root(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "alive"})
+
+    async def handle_stats(self, request: web.Request) -> web.Response:
+        auth = self._authenticate(request)
+        if not self.config.stats:
+            return web.json_response(
+                {"message": "To see stats, launch Event Server with stats enabled "
+                            "(PIO_EVENTSERVER_STATS=true)"},
+                status=404,
+            )
+        return web.json_response(self.stats.get(auth.app_id))
+
+    # -- webhooks (EventServer.scala:491-599) -----------------------------
+    async def handle_webhook(self, request: web.Request) -> web.Response:
+        auth = self._authenticate(request)
+        name = request.match_info["name"]
+        form = request.match_info.get("ext") == "form"
+        connector = CONNECTORS.get((name, "form" if form else "json"))
+        if connector is None:
+            return web.json_response({"message": f"webhook {name} not supported"},
+                                     status=404)
+        try:
+            if form:
+                data = dict(await request.post())
+                event_json = connector.to_event_json(data)
+            else:
+                event_json = connector.to_event_json(await request.json())
+            event_id = self._ingest_one(event_json, auth)
+            return web.json_response({"eventId": event_id}, status=201)
+        except (ConnectorError, EventValidationError, json.JSONDecodeError) as e:
+            return web.json_response({"message": str(e)}, status=400)
+        except WhitelistDenied as e:
+            return web.json_response({"message": str(e)}, status=403)
+
+    async def handle_webhook_get(self, request: web.Request) -> web.Response:
+        self._authenticate(request)
+        name = request.match_info["name"]
+        form = request.match_info.get("ext") == "form"
+        if CONNECTORS.get((name, "form" if form else "json")) is None:
+            return web.json_response({"message": f"webhook {name} not supported"},
+                                     status=404)
+        return web.json_response({"message": f"webhook {name} connected"})
+
+    # -- app --------------------------------------------------------------
+    def make_app(self) -> web.Application:
+        app = web.Application()
+        r = app.router
+        r.add_get("/", self.handle_root)
+        r.add_post("/events.json", self.handle_create)
+        r.add_get("/events.json", self.handle_find)
+        r.add_get("/events/{event_id}.json", self.handle_get_event)
+        r.add_delete("/events/{event_id}.json", self.handle_delete_event)
+        r.add_post("/batch/events.json", self.handle_batch)
+        r.add_get("/stats.json", self.handle_stats)
+        r.add_post("/webhooks/{name}.{ext:json|form}", self.handle_webhook)
+        r.add_get("/webhooks/{name}.{ext:json|form}", self.handle_webhook_get)
+        return app
+
+    async def start(self) -> None:
+        self._runner = web.AppRunner(self.make_app())
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.config.ip, self.config.port)
+        await site.start()
+        logger.info("event server listening on %s:%d", self.config.ip, self.config.port)
+
+    async def shutdown(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+
+def serve_forever(config: EventServerConfig = EventServerConfig(),
+                  storage: Optional[Storage] = None) -> None:
+    import asyncio
+
+    async def main():
+        server = EventServer(config, storage)
+        await server.start()
+        await asyncio.Event().wait()
+
+    asyncio.run(main())

@@ -1,0 +1,283 @@
+"""Engine (query) server — the ``pio deploy`` surface.
+
+Parity target: workflow/CreateServer.scala:106-695. One deployed engine per
+server process; routes:
+
+- ``GET /``              — status page (engine info + serving stats, the
+                           reference's twirl HTML page becomes JSON/HTML)
+- ``POST /queries.json`` — the hot path: bind query → supplement →
+                           per-algorithm predict → serve → JSON
+- ``POST /reload``       — re-load the latest COMPLETED instance (MasterActor
+                           ReloadServer, CreateServer.scala:317-343)
+- ``POST /stop``         — graceful shutdown (auth via server access key)
+- ``GET /plugins.json``  — engine-server plugin listing
+
+Design notes vs the reference:
+- the reference calls algorithms sequentially per query with a "TODO:
+  Parallelize" (CreateServer.scala:488); our predict path is a resident
+  jit-compiled function per algorithm, and the (tiny) per-query host work is
+  done inline — the TPU round-trip dominates, so the fix the reference never
+  shipped is batching, which ``batch_predict`` exposes for bulk callers;
+- models are made device-resident once at deploy (prepare_for_serving), not
+  re-loaded per query;
+- the optional feedback loop POSTs a ``predict`` event back to the event
+  server asynchronously, with prId generation like CreateServer.scala:508-570.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import logging
+import time
+import uuid
+from typing import Any, Optional
+
+from aiohttp import web
+
+from incubator_predictionio_tpu.core.controller import (
+    Engine,
+    EngineParams,
+    resolve_engine_factory,
+    variant_from_file,
+)
+from incubator_predictionio_tpu.data.storage.base import EngineInstance
+from incubator_predictionio_tpu.data.storage.registry import Storage, get_storage
+from incubator_predictionio_tpu.parallel.mesh import MeshContext
+from incubator_predictionio_tpu.utils.json_util import bind_query, to_jsonable
+from incubator_predictionio_tpu.utils.serialization import deserialize_model
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class ServerConfig:
+    """(CreateServer.scala:106-175 flags)"""
+
+    engine_variant: str = "engine.json"
+    ip: str = "0.0.0.0"
+    port: int = 8000
+    feedback: bool = False
+    event_server_ip: str = "127.0.0.1"
+    event_server_port: int = 7070
+    access_key: Optional[str] = None  # for feedback events
+    server_access_key: Optional[str] = None  # guards /stop and /reload
+
+
+class DeployedEngine:
+    """Holds the live models + stages for one engine instance."""
+
+    def __init__(
+        self,
+        engine: Engine,
+        engine_params: EngineParams,
+        instance: EngineInstance,
+        models: list[Any],
+    ):
+        self.engine = engine
+        self.engine_params = engine_params
+        self.instance = instance
+        algorithms, serving = engine.serving_and_algorithms(engine_params)
+        self.algorithms = algorithms
+        self.serving = serving
+        self.models = [
+            self._prepare(a, m) for a, m in zip(algorithms, models)
+        ]
+        self.query_cls = next(
+            (a.query_class() for a in algorithms if a.query_class() is not None), None
+        )
+
+    @staticmethod
+    def _prepare(algorithm, model):
+        """Models exposing ``prepare_for_serving()`` become device-resident here."""
+        prep = getattr(model, "prepare_for_serving", None)
+        return prep() if callable(prep) else model
+
+    def predict(self, payload: dict) -> Any:
+        query = bind_query(self.query_cls, payload)
+        query = self.serving.supplement(query)
+        predictions = [
+            a.predict(m, query) for a, m in zip(self.algorithms, self.models)
+        ]
+        return self.serving.serve(query, predictions)
+
+
+def load_deployed_engine(
+    config: ServerConfig,
+    storage: Optional[Storage] = None,
+    ctx: Optional[MeshContext] = None,
+) -> DeployedEngine:
+    """variant → engine factory → latest COMPLETED instance → live models
+    (createServerActorWithEngine, CreateServer.scala:187-246)."""
+    storage = storage or get_storage()
+    ctx = ctx or MeshContext.create()
+    variant = variant_from_file(config.engine_variant)
+    factory_path = variant["engineFactory"]
+    engine = resolve_engine_factory(factory_path)()
+    engine_params = engine.engine_params_from_variant(variant)
+    import os
+
+    instances = storage.get_meta_data_engine_instances()
+    instance = instances.get_latest_completed(
+        variant.get("id", "default"), variant.get("version", "1"),
+        os.path.abspath(config.engine_variant),
+    )
+    if instance is None:
+        raise RuntimeError(
+            f"No COMPLETED engine instance for variant {config.engine_variant}; "
+            "run train first (reference: CreateServer.scala:199 'Invalid engine instance')"
+        )
+    blob = storage.get_model_data_models().get(instance.id)
+    if blob is None:
+        raise RuntimeError(f"model blob missing for instance {instance.id}")
+    persisted = deserialize_model(blob.models)
+    models = engine.prepare_deploy(ctx, engine_params, persisted, instance.id)
+    logger.info("deployed engine instance %s (trained %s)", instance.id,
+                instance.start_time)
+    return DeployedEngine(engine, engine_params, instance, models)
+
+
+class QueryServer:
+    def __init__(
+        self,
+        config: ServerConfig,
+        storage: Optional[Storage] = None,
+        ctx: Optional[MeshContext] = None,
+    ):
+        self.config = config
+        self.storage = storage or get_storage()
+        self.ctx = ctx or MeshContext.create()
+        self.deployed = load_deployed_engine(config, self.storage, self.ctx)
+        self.request_count = 0
+        self.avg_serving_sec = 0.0
+        self.last_serving_sec = 0.0
+        self._start_time = time.time()
+        self._runner: Optional[web.AppRunner] = None
+        self._stop_event = asyncio.Event()
+        self._feedback_tasks: set[asyncio.Task] = set()  # strong refs (GC pitfall)
+
+    # -- routes -----------------------------------------------------------
+    def make_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_get("/", self.handle_status)
+        app.router.add_post("/queries.json", self.handle_query)
+        app.router.add_post("/reload", self.handle_reload)
+        app.router.add_post("/stop", self.handle_stop)
+        app.router.add_get("/plugins.json", self.handle_plugins)
+        return app
+
+    async def handle_status(self, request: web.Request) -> web.Response:
+        inst = self.deployed.instance
+        return web.json_response({
+            "status": "alive",
+            "engineInstance": {
+                "id": inst.id,
+                "engineId": inst.engine_id,
+                "engineVersion": inst.engine_version,
+                "startTime": inst.start_time.isoformat(),
+            },
+            "algorithms": [type(a).__name__ for a in self.deployed.algorithms],
+            "requestCount": self.request_count,
+            "avgServingSec": self.avg_serving_sec,
+            "lastServingSec": self.last_serving_sec,
+            "uptimeSec": time.time() - self._start_time,
+        })
+
+    async def handle_query(self, request: web.Request) -> web.Response:
+        t0 = time.time()
+        try:
+            payload = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response({"message": "Invalid JSON query"}, status=400)
+        try:
+            prediction = self.deployed.predict(payload)
+        except (TypeError, ValueError, KeyError) as e:
+            return web.json_response({"message": f"Invalid query: {e}"}, status=400)
+        dt = time.time() - t0
+        self.request_count += 1
+        self.last_serving_sec = dt
+        self.avg_serving_sec += (dt - self.avg_serving_sec) / self.request_count
+        result = to_jsonable(prediction)
+        if self.config.feedback:
+            task = asyncio.create_task(self._send_feedback(payload, result))
+            self._feedback_tasks.add(task)
+            task.add_done_callback(self._feedback_tasks.discard)
+        return web.json_response(result)
+
+    async def _send_feedback(self, query: dict, prediction: Any) -> None:
+        """POST a `predict` event to the event server (CreateServer.scala:508-570)."""
+        import aiohttp
+
+        pr_id = prediction.get("prId") if isinstance(prediction, dict) else None
+        pr_id = pr_id or uuid.uuid4().hex
+        event = {
+            "event": "predict",
+            "entityType": "pio_pr",
+            "entityId": pr_id,
+            "properties": {"query": query, "prediction": prediction},
+        }
+        url = (
+            f"http://{self.config.event_server_ip}:{self.config.event_server_port}"
+            f"/events.json?accessKey={self.config.access_key or ''}"
+        )
+        try:
+            async with aiohttp.ClientSession() as session:
+                async with session.post(url, json=event,
+                                        timeout=aiohttp.ClientTimeout(total=5)) as resp:
+                    if resp.status >= 300:
+                        logger.warning("feedback event rejected: %s", resp.status)
+        except Exception as e:  # noqa: BLE001 - feedback must never break serving
+            logger.warning("feedback event failed: %s", e)
+
+    def _authorized(self, request: web.Request) -> bool:
+        key = self.config.server_access_key
+        if not key:
+            return True
+        return request.query.get("accessKey") == key
+
+    async def handle_reload(self, request: web.Request) -> web.Response:
+        if not self._authorized(request):
+            return web.json_response({"message": "Unauthorized"}, status=401)
+        try:
+            self.deployed = load_deployed_engine(self.config, self.storage, self.ctx)
+        except RuntimeError as e:
+            return web.json_response({"message": str(e)}, status=400)
+        return web.json_response({"message": "Reloaded",
+                                  "engineInstanceId": self.deployed.instance.id})
+
+    async def handle_stop(self, request: web.Request) -> web.Response:
+        if not self._authorized(request):
+            return web.json_response({"message": "Unauthorized"}, status=401)
+        self._stop_event.set()
+        return web.json_response({"message": "Shutting down"})
+
+    async def handle_plugins(self, request: web.Request) -> web.Response:
+        return web.json_response({"plugins": {"outputblockers": {}, "outputsniffers": {}}})
+
+    # -- lifecycle --------------------------------------------------------
+    async def start(self) -> None:
+        self._runner = web.AppRunner(self.make_app())
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.config.ip, self.config.port)
+        await site.start()
+        logger.info("engine server listening on %s:%d", self.config.ip, self.config.port)
+
+    async def wait_stopped(self) -> None:
+        await self._stop_event.wait()
+        await self.shutdown()
+
+    async def shutdown(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+
+def serve_forever(config: ServerConfig, storage: Optional[Storage] = None) -> None:
+    """Blocking entry used by the CLI `deploy` verb."""
+
+    async def main():
+        server = QueryServer(config, storage)
+        await server.start()
+        await server.wait_stopped()
+
+    asyncio.run(main())

@@ -1,0 +1,64 @@
+"""Rolling event-server statistics (reference data/api/Stats.scala:51-82,
+StatsActor.scala:36-77): per-app counters bucketed by hour, keeping the
+current and previous hour, served at /stats.json when enabled."""
+
+from __future__ import annotations
+
+import datetime as _dt
+import threading
+from collections import Counter
+from typing import Optional
+
+
+def _hour_floor(t: _dt.datetime) -> _dt.datetime:
+    return t.replace(minute=0, second=0, microsecond=0)
+
+
+class Stats:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._hour: Optional[_dt.datetime] = None
+        self._prev: dict[int, dict[str, Counter]] = {}
+        self._cur: dict[int, dict[str, Counter]] = {}
+
+    def _roll(self, now: _dt.datetime) -> None:
+        hour = _hour_floor(now)
+        if self._hour is None:
+            self._hour = hour
+        elif hour > self._hour:
+            self._prev = self._cur
+            self._cur = {}
+            self._hour = hour
+
+    def update(
+        self,
+        app_id: int,
+        status: int,
+        event_name: str,
+        entity_type: str,
+        now: Optional[_dt.datetime] = None,
+    ) -> None:
+        now = now or _dt.datetime.now(_dt.timezone.utc)
+        with self._lock:
+            self._roll(now)
+            app = self._cur.setdefault(
+                app_id,
+                {"status": Counter(), "event": Counter(), "entityType": Counter()},
+            )
+            app["status"][str(status)] += 1
+            app["event"][event_name] += 1
+            app["entityType"][entity_type] += 1
+
+    def get(self, app_id: int) -> dict:
+        with self._lock:
+            self._roll(_dt.datetime.now(_dt.timezone.utc))
+            out = {}
+            for label, data in (("previousHour", self._prev), ("currentHour", self._cur)):
+                app = data.get(app_id, {})
+                out[label] = {
+                    "status": dict(app.get("status", {})),
+                    "event": dict(app.get("event", {})),
+                    "entityType": dict(app.get("entityType", {})),
+                }
+            out["startTime"] = self._hour.isoformat() if self._hour else None
+            return out

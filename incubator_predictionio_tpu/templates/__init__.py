@@ -1,0 +1,6 @@
+"""Built-in engine templates — counterparts of the reference's examples/ gallery.
+
+Each template is a DASE engine: classification (MLP), recommendation
+(two-tower MF), similarproduct (implicit MF + cooccurrence), ecommerce
+(retrieval + business rules), sequential (transformer session recommender).
+"""

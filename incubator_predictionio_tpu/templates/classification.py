@@ -1,0 +1,188 @@
+"""Classification template — the scala-parallel-classification counterpart.
+
+Reference behavior (examples/scala-parallel-classification/add-algorithm/):
+the DataSource reads ``$set`` events on "user" entities carrying numeric
+feature properties plus a label property (DataSource.scala reads attr0-2 +
+"plan"), NaiveBayes/RandomForest train on LabeledPoints
+(NaiveBayesAlgorithm.scala:36-60), queries carry a feature vector and get a
+predicted label back.
+
+Here the algorithm is the JAX MLP (models/mlp.py) trained data-parallel on
+the mesh; k-fold eval folds are produced the reference way (readEval) using
+deterministic hashing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import numpy as np
+
+from incubator_predictionio_tpu.core import (
+    Engine,
+    EngineFactory,
+    FirstServing,
+    IdentityPreparator,
+    P2LAlgorithm,
+    Params,
+    PDataSource,
+    SanityCheck,
+)
+from incubator_predictionio_tpu.core.metric import AverageMetric
+from incubator_predictionio_tpu.data.store import PEventStore
+from incubator_predictionio_tpu.models.mlp import MLPClassifier, MLPConfig, MLPModel
+from incubator_predictionio_tpu.parallel.mesh import MeshContext
+
+
+# -- data source ------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str = "classification"
+    attrs: tuple[str, ...] = ("attr0", "attr1", "attr2")
+    label: str = "plan"
+    eval_k: Optional[int] = None  # k-fold eval when set (reference readEval)
+
+
+@dataclasses.dataclass
+class TrainingData(SanityCheck):
+    """Columnar features/labels (the RDD[LabeledPoint] counterpart)."""
+
+    x: np.ndarray  # [n, d] float32
+    y: np.ndarray  # [n] labels (original values)
+
+    def sanity_check(self) -> None:
+        if len(self.x) == 0:
+            raise ValueError("TrainingData is empty (no labeled entities found)")
+        if not np.isfinite(self.x).all():
+            raise ValueError("TrainingData contains non-finite features")
+
+
+@dataclasses.dataclass(frozen=True)
+class Query:
+    features: tuple[float, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class PredictedResult:
+    label: object
+    scores: Optional[dict] = None
+
+
+class DataSource(PDataSource):
+    params_class = DataSourceParams
+
+    def __init__(self, params: DataSourceParams):
+        super().__init__(params)
+        self._store = PEventStore()
+
+    def _read(self) -> TrainingData:
+        props = self._store.aggregate_properties(
+            self.params.app_name,
+            "user",
+            required=[*self.params.attrs, self.params.label],
+        )
+        xs, ys = [], []
+        for pm in props.values():
+            xs.append([float(pm.get(a)) for a in self.params.attrs])
+            ys.append(pm.get(self.params.label))
+        return TrainingData(
+            np.asarray(xs, np.float32).reshape(len(xs), len(self.params.attrs)),
+            np.asarray(ys),
+        )
+
+    def read_training(self, ctx: MeshContext) -> TrainingData:
+        return self._read()
+
+    def read_eval(self, ctx: MeshContext):
+        """k-fold split by stable row hash (reference readEval pattern)."""
+        k = self.params.eval_k
+        if not k:
+            return []
+        td = self._read()
+        fold_of = np.arange(len(td.y)) % k
+        folds = []
+        for fold in range(k):
+            train_mask = fold_of != fold
+            test_mask = ~train_mask
+            train = TrainingData(td.x[train_mask], td.y[train_mask])
+            qa = [
+                (Query(tuple(map(float, row))), label)
+                for row, label in zip(td.x[test_mask], td.y[test_mask])
+            ]
+            folds.append((train, {"fold": fold}, qa))
+        return folds
+
+
+# -- algorithm --------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class MLPAlgorithmParams(Params):
+    hidden_dims: tuple[int, ...] = (128, 128)
+    learning_rate: float = 1e-2
+    batch_size: int = 256
+    epochs: int = 50
+    seed: int = 0
+
+
+class MLPAlgorithm(P2LAlgorithm):
+    """NaiveBayes → MLP (cites NaiveBayesAlgorithm.scala:36-60 for the slot
+    it fills, not the math)."""
+
+    params_class = MLPAlgorithmParams
+    query_cls = Query
+
+    def _config(self) -> MLPConfig:
+        p = self.params
+        return MLPConfig(
+            hidden_dims=tuple(p.hidden_dims),
+            learning_rate=p.learning_rate,
+            batch_size=p.batch_size,
+            epochs=p.epochs,
+            seed=p.seed,
+        )
+
+    def train(self, ctx: MeshContext, pd: TrainingData) -> MLPModel:
+        return MLPClassifier(self._config()).fit(ctx, pd.x, pd.y)
+
+    def predict(self, model: MLPModel, query: Query) -> PredictedResult:
+        x = np.asarray([query.features], np.float32)
+        logits = MLPClassifier.logits(model, x)[0]
+        probs = np.exp(logits - logits.max())
+        probs /= probs.sum()
+        best = int(logits.argmax())
+        return PredictedResult(
+            label=model.classes[best],
+            scores={str(c): float(p) for c, p in zip(model.classes, probs)},
+        )
+
+    def batch_predict(
+        self, model: MLPModel, queries: Sequence[tuple[int, Query]]
+    ) -> list[tuple[int, PredictedResult]]:
+        if not queries:
+            return []
+        x = np.asarray([q.features for _, q in queries], np.float32)
+        labels = MLPClassifier.predict(model, x)
+        return [(i, PredictedResult(label=l)) for (i, _), l in zip(queries, labels)]
+
+
+# -- metric -----------------------------------------------------------------
+
+class Accuracy(AverageMetric):
+    """(reference AccuracyMetric in the classification template's Evaluation)"""
+
+    def calculate_qpa(self, q, p: PredictedResult, a) -> float:
+        return 1.0 if p.label == a else 0.0
+
+
+# -- engine factory ---------------------------------------------------------
+
+class ClassificationEngine(EngineFactory):
+    def apply(self) -> Engine:
+        return Engine(
+            DataSource,
+            IdentityPreparator,
+            {"mlp": MLPAlgorithm, "": MLPAlgorithm},
+            FirstServing,
+        )

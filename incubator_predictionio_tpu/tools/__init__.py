@@ -1,0 +1,1 @@
+"""Operator tools: CLI console, export/import, dashboard, admin API."""

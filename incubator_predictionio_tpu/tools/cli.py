@@ -1,0 +1,530 @@
+"""``pio-tpu`` console — the ``pio`` CLI counterpart.
+
+Parity target: tools/console/Console.scala:134-623 and commands/*. Verbs:
+
+  version, status,
+  app {new,list,show,delete,data-delete,channel-new,channel-delete},
+  accesskey {new,list,delete},
+  train, eval, deploy, undeploy, batchpredict, eventserver,
+  export, import
+
+Differences by design: no ``build`` verb (Python engines need no sbt/assembly
+step — the variant JSON's ``engineFactory`` import path replaces the built
+jar), and ``run``'s spark-submit plumbing is unnecessary (everything runs
+in-process on the mesh).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional
+
+import incubator_predictionio_tpu as piotpu
+from incubator_predictionio_tpu.data.storage.base import AccessKey, App, Channel
+from incubator_predictionio_tpu.data.storage.registry import Storage, get_storage
+
+
+def _out(msg: str) -> None:
+    print(msg)
+
+
+def _err(msg: str) -> None:
+    print(msg, file=sys.stderr)
+
+
+# ---------------------------------------------------------------------------
+# app / accesskey commands (commands/App.scala:31-363, AccessKey.scala)
+# ---------------------------------------------------------------------------
+
+def cmd_app_new(args, storage: Storage) -> int:
+    apps = storage.get_meta_data_apps()
+    if apps.get_by_name(args.name) is not None:
+        _err(f"App {args.name} already exists. Aborting.")
+        return 1
+    app_id = apps.insert(App(args.id or 0, args.name, args.description))
+    if app_id is None:
+        _err("Unable to create new app.")
+        return 1
+    storage.get_events().init(app_id)
+    key = storage.get_meta_data_access_keys().insert(
+        AccessKey(args.access_key or "", app_id, ())
+    )
+    _out("Initialized Event Store for this app ID: {}.".format(app_id))
+    _out(f"Created new app:")
+    _out(f"      Name: {args.name}")
+    _out(f"        ID: {app_id}")
+    _out(f"Access Key: {key}")
+    return 0
+
+
+def cmd_app_list(args, storage: Storage) -> int:
+    apps = sorted(storage.get_meta_data_apps().get_all(), key=lambda a: a.name)
+    keys = storage.get_meta_data_access_keys()
+    _out(f"{'Name':<20} | {'ID':<4} | Access Key | Allowed Event(s)")
+    for app in apps:
+        for k in keys.get_by_app_id(app.id):
+            events = ", ".join(k.events) if k.events else "(all)"
+            _out(f"{app.name:<20} | {app.id:<4} | {k.key} | {events}")
+    _out(f"Finished listing {len(apps)} app(s).")
+    return 0
+
+
+def cmd_app_show(args, storage: Storage) -> int:
+    app = storage.get_meta_data_apps().get_by_name(args.name)
+    if app is None:
+        _err(f"App {args.name} does not exist. Aborting.")
+        return 1
+    _out(f"    App Name: {app.name}")
+    _out(f"      App ID: {app.id}")
+    _out(f" Description: {app.description or ''}")
+    for k in storage.get_meta_data_access_keys().get_by_app_id(app.id):
+        events = ", ".join(k.events) if k.events else "(all)"
+        _out(f"  Access Key: {k.key} | {events}")
+    for c in storage.get_meta_data_channels().get_by_app_id(app.id):
+        _out(f"     Channel: {c.name} (ID {c.id})")
+    return 0
+
+
+def cmd_app_delete(args, storage: Storage) -> int:
+    app = storage.get_meta_data_apps().get_by_name(args.name)
+    if app is None:
+        _err(f"App {args.name} does not exist. Aborting.")
+        return 1
+    if not args.force and not _confirm(f"Delete app {args.name}?"):
+        return 1
+    for c in storage.get_meta_data_channels().get_by_app_id(app.id):
+        storage.get_events().remove(app.id, c.id)
+        storage.get_meta_data_channels().delete(c.id)
+    storage.get_events().remove(app.id)
+    for k in storage.get_meta_data_access_keys().get_by_app_id(app.id):
+        storage.get_meta_data_access_keys().delete(k.key)
+    storage.get_meta_data_apps().delete(app.id)
+    _out(f"Deleted app {args.name}.")
+    return 0
+
+
+def cmd_app_data_delete(args, storage: Storage) -> int:
+    app = storage.get_meta_data_apps().get_by_name(args.name)
+    if app is None:
+        _err(f"App {args.name} does not exist. Aborting.")
+        return 1
+    if not args.force and not _confirm(f"Delete data of app {args.name}?"):
+        return 1
+    if args.channel:
+        channels = storage.get_meta_data_channels().get_by_app_id(app.id)
+        channel = next((c for c in channels if c.name == args.channel), None)
+        if channel is None:
+            _err(f"Channel {args.channel} does not exist.")
+            return 1
+        storage.get_events().remove(app.id, channel.id)
+        storage.get_events().init(app.id, channel.id)
+    else:
+        storage.get_events().remove(app.id)
+        storage.get_events().init(app.id)
+    _out("Done.")
+    return 0
+
+
+def cmd_channel_new(args, storage: Storage) -> int:
+    app = storage.get_meta_data_apps().get_by_name(args.app_name)
+    if app is None:
+        _err(f"App {args.app_name} does not exist. Aborting.")
+        return 1
+    if not Channel.is_valid_name(args.channel):
+        _err(f"Unable to create new channel. The channel name {args.channel} is "
+             "invalid (alphanumeric/dash, 1-16 chars).")
+        return 1
+    channels = storage.get_meta_data_channels()
+    if any(c.name == args.channel for c in channels.get_by_app_id(app.id)):
+        _err(f"Unable to create new channel. Channel {args.channel} already exists.")
+        return 1
+    channel_id = channels.insert(Channel(0, args.channel, app.id))
+    storage.get_events().init(app.id, channel_id)
+    _out(f"Channel {args.channel} (ID {channel_id}) created for app {args.app_name}.")
+    return 0
+
+
+def cmd_channel_delete(args, storage: Storage) -> int:
+    app = storage.get_meta_data_apps().get_by_name(args.app_name)
+    if app is None:
+        _err(f"App {args.app_name} does not exist. Aborting.")
+        return 1
+    channels = storage.get_meta_data_channels()
+    channel = next((c for c in channels.get_by_app_id(app.id)
+                    if c.name == args.channel), None)
+    if channel is None:
+        _err(f"Channel {args.channel} does not exist.")
+        return 1
+    if not args.force and not _confirm(f"Delete channel {args.channel}?"):
+        return 1
+    storage.get_events().remove(app.id, channel.id)
+    channels.delete(channel.id)
+    _out(f"Deleted channel {args.channel}.")
+    return 0
+
+
+def cmd_accesskey_new(args, storage: Storage) -> int:
+    app = storage.get_meta_data_apps().get_by_name(args.app_name)
+    if app is None:
+        _err(f"App {args.app_name} does not exist. Aborting.")
+        return 1
+    key = storage.get_meta_data_access_keys().insert(
+        AccessKey(args.access_key or "", app.id, tuple(args.event or ()))
+    )
+    _out(f"Created new access key: {key}")
+    return 0
+
+
+def cmd_accesskey_list(args, storage: Storage) -> int:
+    keys = storage.get_meta_data_access_keys()
+    if args.app_name:
+        app = storage.get_meta_data_apps().get_by_name(args.app_name)
+        if app is None:
+            _err(f"App {args.app_name} does not exist. Aborting.")
+            return 1
+        listed = keys.get_by_app_id(app.id)
+    else:
+        listed = keys.get_all()
+    for k in listed:
+        events = ", ".join(k.events) if k.events else "(all)"
+        _out(f"{k.key} | app {k.app_id} | {events}")
+    _out(f"Finished listing {len(listed)} access key(s).")
+    return 0
+
+
+def cmd_accesskey_delete(args, storage: Storage) -> int:
+    if storage.get_meta_data_access_keys().delete(args.key):
+        _out(f"Deleted access key {args.key}.")
+        return 0
+    _err(f"Error deleting access key {args.key}.")
+    return 1
+
+
+def _confirm(prompt: str) -> bool:
+    answer = input(f"{prompt} (Y/n) ")
+    return answer.strip().lower() in ("", "y", "yes")
+
+
+# ---------------------------------------------------------------------------
+# train / eval / deploy / batchpredict / servers
+# ---------------------------------------------------------------------------
+
+def cmd_train(args, storage: Storage) -> int:
+    from incubator_predictionio_tpu.core.workflow.create_workflow import (
+        WorkflowConfig,
+        create_workflow,
+    )
+
+    axes = json.loads(args.mesh_axes) if args.mesh_axes else None
+    config = WorkflowConfig(
+        engine_variant=args.engine_variant,
+        batch=args.batch,
+        verbose=args.verbose,
+        skip_sanity_check=args.skip_sanity_check,
+        stop_after_read=args.stop_after_read,
+        stop_after_prepare=args.stop_after_prepare,
+        mesh_axes=axes,
+    )
+    instance_id = create_workflow(config, storage)
+    _out(f"Training completed. Engine instance ID: {instance_id}")
+    return 0
+
+
+def cmd_eval(args, storage: Storage) -> int:
+    from incubator_predictionio_tpu.core.workflow.create_workflow import (
+        WorkflowConfig,
+        create_workflow,
+    )
+
+    config = WorkflowConfig(
+        engine_variant=args.engine_variant,
+        evaluation_class=args.evaluation_class,
+        engine_params_generator_class=args.engine_params_generator_class,
+        batch=args.batch,
+    )
+    instance_id = create_workflow(config, storage)
+    inst = storage.get_meta_data_evaluation_instances().get(instance_id)
+    _out(f"Evaluation completed. Instance ID: {instance_id}")
+    if inst is not None and inst.evaluator_results:
+        _out(inst.evaluator_results)
+    return 0
+
+
+def cmd_deploy(args, storage: Storage) -> int:
+    from incubator_predictionio_tpu.server.query_server import ServerConfig, serve_forever
+
+    config = ServerConfig(
+        engine_variant=args.engine_variant,
+        ip=args.ip,
+        port=args.port,
+        feedback=args.feedback,
+        event_server_ip=args.event_server_ip,
+        event_server_port=args.event_server_port,
+        access_key=args.access_key,
+        server_access_key=args.server_access_key,
+    )
+    serve_forever(config, storage)
+    return 0
+
+
+def cmd_undeploy(args, storage: Storage) -> int:
+    import urllib.request
+
+    url = f"http://{args.ip}:{args.port}/stop"
+    if args.server_access_key:
+        url += f"?accessKey={args.server_access_key}"
+    try:
+        with urllib.request.urlopen(
+            urllib.request.Request(url, method="POST"), timeout=10
+        ) as resp:
+            _out(resp.read().decode())
+        return 0
+    except Exception as e:  # noqa: BLE001
+        _err(f"Undeploy failed: {e}")
+        return 1
+
+
+def cmd_batchpredict(args, storage: Storage) -> int:
+    from incubator_predictionio_tpu.core.workflow.batch_predict import (
+        BatchPredictConfig,
+        run_batch_predict,
+    )
+
+    n = run_batch_predict(
+        BatchPredictConfig(
+            engine_variant=args.engine_variant,
+            input_path=args.input,
+            output_path=args.output,
+            query_chunk=args.query_partitions or 1024,
+        ),
+        storage,
+    )
+    _out(f"Batch predict completed: {n} predictions written to {args.output}")
+    return 0
+
+
+def cmd_eventserver(args, storage: Storage) -> int:
+    from incubator_predictionio_tpu.server.event_server import (
+        EventServerConfig,
+        serve_forever,
+    )
+
+    serve_forever(EventServerConfig(ip=args.ip, port=args.port,
+                                    stats=args.stats), storage)
+    return 0
+
+
+def cmd_export(args, storage: Storage) -> int:
+    from incubator_predictionio_tpu.tools.export_import import export_events
+
+    channel_id = _resolve_channel(args, storage)
+    n = export_events(args.appid, args.output, channel_id, storage)
+    _out(f"Exported {n} events.")
+    return 0
+
+
+def cmd_import(args, storage: Storage) -> int:
+    from incubator_predictionio_tpu.tools.export_import import import_events
+
+    channel_id = _resolve_channel(args, storage)
+    n = import_events(args.appid, args.input, channel_id, storage)
+    _out(f"Imported {n} events.")
+    return 0
+
+
+def _resolve_channel(args, storage: Storage) -> Optional[int]:
+    if not getattr(args, "channel", None):
+        return None
+    channels = storage.get_meta_data_channels().get_by_app_id(args.appid)
+    channel = next((c for c in channels if c.name == args.channel), None)
+    if channel is None:
+        raise SystemExit(f"Channel {args.channel} does not exist for app {args.appid}")
+    return channel.id
+
+
+def cmd_status(args, storage: Storage) -> int:
+    """(commands/Management.scala:99-181 + Storage.verifyAllDataObjects)"""
+    import jax
+
+    _out(f"incubator_predictionio_tpu {piotpu.__version__}")
+    devices = jax.devices()
+    _out(f"Devices: {len(devices)} × {devices[0].platform}"
+         f" ({devices[0].device_kind})")
+    failures = storage.verify_all_data_objects()
+    if failures:
+        for f in failures:
+            _err(f"  [FAILED] {f}")
+        _err("Unable to connect to all storage backends successfully.")
+        return 1
+    _out("Storage: all repositories verified (METADATA/EVENTDATA/MODELDATA).")
+    _out("Your system is all ready to go.")
+    return 0
+
+
+def cmd_version(args, storage) -> int:
+    _out(piotpu.__version__)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# parser
+# ---------------------------------------------------------------------------
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="pio-tpu",
+        description="TPU-native PredictionIO-capability ML server framework",
+    )
+    sub = parser.add_subparsers(dest="command")
+
+    sub.add_parser("version")
+    sub.add_parser("status")
+
+    # app
+    app = sub.add_parser("app").add_subparsers(dest="app_command")
+    p = app.add_parser("new")
+    p.add_argument("name")
+    p.add_argument("--id", type=int, default=0)
+    p.add_argument("--description")
+    p.add_argument("--access-key", default="")
+    app.add_parser("list")
+    p = app.add_parser("show")
+    p.add_argument("name")
+    p = app.add_parser("delete")
+    p.add_argument("name")
+    p.add_argument("-f", "--force", action="store_true")
+    p = app.add_parser("data-delete")
+    p.add_argument("name")
+    p.add_argument("--channel")
+    p.add_argument("-f", "--force", action="store_true")
+    p = app.add_parser("channel-new")
+    p.add_argument("app_name")
+    p.add_argument("channel")
+    p = app.add_parser("channel-delete")
+    p.add_argument("app_name")
+    p.add_argument("channel")
+    p.add_argument("-f", "--force", action="store_true")
+
+    # accesskey
+    ak = sub.add_parser("accesskey").add_subparsers(dest="accesskey_command")
+    p = ak.add_parser("new")
+    p.add_argument("app_name")
+    p.add_argument("--access-key", default="")
+    p.add_argument("--event", action="append")
+    p = ak.add_parser("list")
+    p.add_argument("app_name", nargs="?")
+    p = ak.add_parser("delete")
+    p.add_argument("key")
+
+    # train
+    p = sub.add_parser("train")
+    p.add_argument("-v", "--engine-variant", default="engine.json")
+    p.add_argument("--batch", default="")
+    p.add_argument("--verbose", action="store_true")
+    p.add_argument("--skip-sanity-check", action="store_true")
+    p.add_argument("--stop-after-read", action="store_true")
+    p.add_argument("--stop-after-prepare", action="store_true")
+    p.add_argument("--mesh-axes", help='JSON, e.g. \'{"data": 4, "model": 2}\'')
+
+    # eval
+    p = sub.add_parser("eval")
+    p.add_argument("evaluation_class")
+    p.add_argument("engine_params_generator_class", nargs="?")
+    p.add_argument("-v", "--engine-variant", default="engine.json")
+    p.add_argument("--batch", default="")
+
+    # deploy / undeploy
+    p = sub.add_parser("deploy")
+    p.add_argument("-v", "--engine-variant", default="engine.json")
+    p.add_argument("--ip", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--feedback", action="store_true")
+    p.add_argument("--event-server-ip", default="127.0.0.1")
+    p.add_argument("--event-server-port", type=int, default=7070)
+    p.add_argument("--accesskey", dest="access_key")
+    p.add_argument("--server-access-key")
+    p = sub.add_parser("undeploy")
+    p.add_argument("--ip", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--server-access-key")
+
+    # batchpredict
+    p = sub.add_parser("batchpredict")
+    p.add_argument("--input", default="batchpredict-input.json")
+    p.add_argument("--output", default="batchpredict-output.json")
+    p.add_argument("-v", "--engine-variant", default="engine.json")
+    p.add_argument("--query-partitions", type=int)
+
+    # eventserver
+    p = sub.add_parser("eventserver")
+    p.add_argument("--ip", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=7070)
+    p.add_argument("--stats", action="store_true")
+
+    # export / import
+    p = sub.add_parser("export")
+    p.add_argument("--appid", type=int, required=True)
+    p.add_argument("--output", required=True)
+    p.add_argument("--channel")
+    p = sub.add_parser("import")
+    p.add_argument("--appid", type=int, required=True)
+    p.add_argument("--input", required=True)
+    p.add_argument("--channel")
+
+    return parser
+
+
+_COMMANDS = {
+    "version": cmd_version,
+    "status": cmd_status,
+    "train": cmd_train,
+    "eval": cmd_eval,
+    "deploy": cmd_deploy,
+    "undeploy": cmd_undeploy,
+    "batchpredict": cmd_batchpredict,
+    "eventserver": cmd_eventserver,
+    "export": cmd_export,
+    "import": cmd_import,
+}
+
+_APP_COMMANDS = {
+    "new": cmd_app_new,
+    "list": cmd_app_list,
+    "show": cmd_app_show,
+    "delete": cmd_app_delete,
+    "data-delete": cmd_app_data_delete,
+    "channel-new": cmd_channel_new,
+    "channel-delete": cmd_channel_delete,
+}
+
+_ACCESSKEY_COMMANDS = {
+    "new": cmd_accesskey_new,
+    "list": cmd_accesskey_list,
+    "delete": cmd_accesskey_delete,
+}
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if not args.command:
+        parser.print_help()
+        return 1
+    storage = get_storage()
+    if args.command == "app":
+        if not args.app_command:
+            parser.parse_args(["app", "--help"])
+            return 1
+        return _APP_COMMANDS[args.app_command](args, storage)
+    if args.command == "accesskey":
+        if not args.accesskey_command:
+            parser.parse_args(["accesskey", "--help"])
+            return 1
+        return _ACCESSKEY_COMMANDS[args.accesskey_command](args, storage)
+    return _COMMANDS[args.command](args, storage)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,63 @@
+"""`pio export` / `pio import`: events ↔ JSON-lines files.
+
+Parity targets: tools/export/EventsToFile.scala:36-114 and
+tools/imprt/FileToEvents.scala:36-112 (minus the Spark job wrapping — the
+event store's sharded readers and batch inserts do the parallel work).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Optional
+
+from incubator_predictionio_tpu.data.event import Event, validate_event
+from incubator_predictionio_tpu.data.storage.registry import Storage, get_storage
+
+logger = logging.getLogger(__name__)
+
+
+def export_events(
+    app_id: int,
+    output_path: str,
+    channel_id: Optional[int] = None,
+    storage: Optional[Storage] = None,
+) -> int:
+    storage = storage or get_storage()
+    n = 0
+    with open(output_path, "w") as f:
+        for event in storage.get_events().find(app_id, channel_id):
+            f.write(event.to_json() + "\n")
+            n += 1
+    logger.info("exported %d events from app %s to %s", n, app_id, output_path)
+    return n
+
+
+def import_events(
+    app_id: int,
+    input_path: str,
+    channel_id: Optional[int] = None,
+    storage: Optional[Storage] = None,
+    batch_size: int = 1000,
+) -> int:
+    storage = storage or get_storage()
+    events_store = storage.get_events()
+    events_store.init(app_id, channel_id)
+    n = 0
+    batch: list[Event] = []
+    with open(input_path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            event = validate_event(Event.from_json(line))
+            batch.append(event)
+            if len(batch) >= batch_size:
+                events_store.insert_batch(batch, app_id, channel_id)
+                n += len(batch)
+                batch = []
+    if batch:
+        events_store.insert_batch(batch, app_id, channel_id)
+        n += len(batch)
+    logger.info("imported %d events into app %s", n, app_id)
+    return n

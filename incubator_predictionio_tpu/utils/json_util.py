@@ -1,0 +1,42 @@
+"""JSON helpers: dataclass/numpy-aware encoding, query binding."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Optional, Type
+
+import numpy as np
+
+from incubator_predictionio_tpu.utils.params import params_from_json
+
+
+def to_jsonable(obj: Any) -> Any:
+    """Recursively convert dataclasses / numpy scalars+arrays / tuples into
+    JSON-encodable structures."""
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return {k: to_jsonable(v) for k, v in dataclasses.asdict(obj).items()}
+    if isinstance(obj, dict):
+        return {str(k): to_jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [to_jsonable(v) for v in obj]
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if isinstance(obj, np.generic):
+        return obj.item()
+    return obj
+
+
+def dumps(obj: Any, **kw) -> str:
+    return json.dumps(to_jsonable(obj), **kw)
+
+
+def bind_query(query_cls: Optional[Type], payload: dict) -> Any:
+    """Bind a /queries.json body onto the algorithm's query dataclass.
+
+    Falls back to the raw dict when the algorithm declares no query class
+    (the reference's CustomQuerySerializer escape hatch)."""
+    if query_cls is None or not dataclasses.is_dataclass(query_cls):
+        return payload
+    # reuse the params binding rules (camelCase→snake_case, unknown keys raise)
+    return params_from_json(query_cls, payload)

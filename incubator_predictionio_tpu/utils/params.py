@@ -28,8 +28,12 @@ class EmptyParams(Params):
 _CAMEL_RE = re.compile(r"(?<!^)(?=[A-Z])")
 
 
-def _snake(name: str) -> str:
+def snake_case(name: str) -> str:
+    """camelCase → snake_case (shared by params binding and webhook mappers)."""
     return _CAMEL_RE.sub("_", name).lower()
+
+
+_snake = snake_case
 
 
 def params_from_json(cls: Optional[Type[Params]], obj: Any) -> Params:

@@ -1,0 +1,115 @@
+"""End-to-end classification template: events → train → persist → predict → eval.
+
+Parity with the reference integration flow (QuickStartTest scenario), at unit
+scale on the virtual CPU mesh.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from incubator_predictionio_tpu.core import EngineParams
+from incubator_predictionio_tpu.core.workflow import run_train
+from incubator_predictionio_tpu.data import DataMap, Event
+from incubator_predictionio_tpu.data.storage.base import App, EngineInstance
+from incubator_predictionio_tpu.data.storage.registry import Storage
+from incubator_predictionio_tpu.data.store import PEventStore
+from incubator_predictionio_tpu.parallel.mesh import MeshContext
+from incubator_predictionio_tpu.templates.classification import (
+    Accuracy,
+    ClassificationEngine,
+    DataSourceParams,
+    MLPAlgorithmParams,
+    Query,
+)
+from incubator_predictionio_tpu.utils.serialization import deserialize_model
+import datetime as dt
+
+UTC = dt.timezone.utc
+
+
+@pytest.fixture(scope="module")
+def storage():
+    s = Storage({"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+    apps = s.get_meta_data_apps()
+    app_id = apps.insert(App(0, "cls-test"))
+    events = s.get_events()
+    events.init(app_id)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(96, 3))
+    y = (x @ np.array([2.0, -1.0, 0.5]) > 0).astype(int)
+    for i in range(len(y)):
+        events.insert(
+            Event(
+                event="$set", entity_type="user", entity_id=f"u{i}",
+                properties=DataMap({
+                    "attr0": float(x[i, 0]), "attr1": float(x[i, 1]),
+                    "attr2": float(x[i, 2]), "plan": int(y[i]),
+                }),
+                event_time=dt.datetime(2020, 1, 1, tzinfo=UTC),
+            ),
+            app_id,
+        )
+    yield s
+    s.close()
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return MeshContext.create()
+
+
+def engine_params(eval_k=None, epochs=60):
+    return EngineParams.create(
+        data_source=DataSourceParams(app_name="cls-test", eval_k=eval_k),
+        algorithms=[("mlp", MLPAlgorithmParams(hidden_dims=(16,), epochs=epochs,
+                                               learning_rate=3e-2, batch_size=96))],
+    )
+
+
+def test_train_and_predict(storage, ctx):
+    from incubator_predictionio_tpu.data.storage import use_storage
+
+    engine = ClassificationEngine().apply()
+    prev = use_storage(storage)
+    try:
+        instance = EngineInstance(
+            id="", status="INIT", start_time=dt.datetime.now(UTC), end_time=None,
+            engine_id="cls", engine_version="1", engine_variant="v",
+            engine_factory="incubator_predictionio_tpu.templates.classification.ClassificationEngine",
+        )
+        iid = run_train(engine, engine_params(), instance, storage=storage, ctx=ctx)
+        blob = storage.get_model_data_models().get(iid)
+        assert blob is not None
+        [model] = engine.prepare_deploy(
+            ctx, engine_params(), deserialize_model(blob.models), iid
+        )
+        algorithms, serving = engine.serving_and_algorithms(engine_params())
+        # train-set accuracy should be high for a separable rule
+        props = PEventStore(storage).aggregate_properties("cls-test", "user")
+        correct = total = 0
+        for pm in props.values():
+            q = Query(features=(pm.get("attr0"), pm.get("attr1"), pm.get("attr2")))
+            pred = serving.serve(q, [algorithms[0].predict(model, q)])
+            correct += int(pred.label == pm.get("plan"))
+            total += 1
+        assert total == 96
+        assert correct / total > 0.9, f"accuracy {correct}/{total}"
+        assert pred.scores and abs(sum(pred.scores.values()) - 1.0) < 1e-5
+    finally:
+        use_storage(prev)
+
+
+def test_eval_accuracy_metric(storage, ctx):
+    from incubator_predictionio_tpu.data.storage import use_storage
+
+    prev = use_storage(storage)
+    try:
+        engine = ClassificationEngine().apply()
+        results = engine.eval(ctx, engine_params(eval_k=3, epochs=40))
+        assert len(results) == 3
+        acc = Accuracy().calculate(ctx, results)
+        assert acc > 0.75, f"k-fold accuracy {acc}"
+    finally:
+        use_storage(prev)

@@ -1,0 +1,329 @@
+"""Event Server REST contract tests.
+
+Scenario parity: reference EventServiceSpec (spray route tests) + the
+black-box eventserver_test.py integration scenarios (auth, CRUD, batch
+semantics incl. partially-malformed batches, stats, webhooks).
+"""
+
+import asyncio
+import datetime as dt
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from incubator_predictionio_tpu.data.storage import AccessKey, App, Channel, Storage
+from incubator_predictionio_tpu.server.event_server import EventServer, EventServerConfig
+
+UTC = dt.timezone.utc
+
+
+@pytest.fixture()
+def env():
+    storage = Storage({"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+    app_id = storage.get_meta_data_apps().insert(App(0, "esapp"))
+    storage.get_events().init(app_id)
+    key = storage.get_meta_data_access_keys().insert(AccessKey("", app_id, ()))
+    limited = storage.get_meta_data_access_keys().insert(
+        AccessKey("", app_id, ("rate",))
+    )
+    chan_id = storage.get_meta_data_channels().insert(Channel(0, "live", app_id))
+    storage.get_events().init(app_id, chan_id)
+    yield storage, app_id, key, limited
+    storage.close()
+
+
+def run_client(env, coro_fn, stats=False):
+    storage, app_id, key, limited = env
+
+    async def runner():
+        server = EventServer(
+            EventServerConfig(stats=stats), storage=storage
+        )
+        client = TestClient(TestServer(server.make_app()))
+        await client.start_server()
+        try:
+            return await coro_fn(client, key, limited)
+        finally:
+            await client.close()
+
+    return asyncio.run(runner())
+
+
+EVENT = {
+    "event": "rate",
+    "entityType": "user",
+    "entityId": "u1",
+    "targetEntityType": "item",
+    "targetEntityId": "i1",
+    "properties": {"rating": 5},
+    "eventTime": "2020-01-01T00:00:00Z",
+}
+
+
+def test_root_alive(env):
+    async def t(client, key, limited):
+        resp = await client.get("/")
+        assert resp.status == 200
+        assert (await resp.json())["status"] == "alive"
+
+    run_client(env, t)
+
+
+def test_auth_required_and_basic_header(env):
+    async def t(client, key, limited):
+        resp = await client.post("/events.json", json=EVENT)
+        assert resp.status == 401
+        resp = await client.post("/events.json?accessKey=wrong", json=EVENT)
+        assert resp.status == 401
+        import base64
+
+        header = "Basic " + base64.b64encode(f"{key}:".encode()).decode()
+        resp = await client.post("/events.json", json=EVENT,
+                                 headers={"Authorization": header})
+        assert resp.status == 201
+
+    run_client(env, t)
+
+
+def test_create_get_delete_roundtrip(env):
+    async def t(client, key, limited):
+        resp = await client.post(f"/events.json?accessKey={key}", json=EVENT)
+        assert resp.status == 201
+        event_id = (await resp.json())["eventId"]
+        resp = await client.get(f"/events/{event_id}.json?accessKey={key}")
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["event"] == "rate" and body["entityId"] == "u1"
+        # client-supplied creationTime must be overridden server-side
+        resp2 = await client.post(
+            f"/events.json?accessKey={key}",
+            json={**EVENT, "creationTime": "1970-01-01T00:00:00Z"},
+        )
+        got = await client.get(
+            f"/events/{(await resp2.json())['eventId']}.json?accessKey={key}"
+        )
+        assert (await got.json())["creationTime"].startswith(
+            str(dt.datetime.now(UTC).year)
+        )
+        resp = await client.delete(f"/events/{event_id}.json?accessKey={key}")
+        assert resp.status == 200
+        resp = await client.delete(f"/events/{event_id}.json?accessKey={key}")
+        assert resp.status == 404
+
+    run_client(env, t)
+
+
+def test_malformed_and_invalid_events(env):
+    async def t(client, key, limited):
+        resp = await client.post(f"/events.json?accessKey={key}", data=b"{oops")
+        assert resp.status == 400
+        resp = await client.get(f"/events.json?accessKey={key}&limit=abc")
+        assert resp.status == 400
+        resp = await client.get(f"/events.json?accessKey={key}&startTime=notadate")
+        assert resp.status == 400
+        assert "startTime" in (await resp.json())["message"]
+        resp = await client.post(
+            f"/events.json?accessKey={key}",
+            json={"event": "$badname", "entityType": "user", "entityId": "u1"},
+        )
+        assert resp.status == 400
+        assert "reserved" in (await resp.json())["message"]
+
+    run_client(env, t)
+
+
+def test_event_whitelist(env):
+    async def t(client, key, limited):
+        resp = await client.post(f"/events.json?accessKey={limited}", json=EVENT)
+        assert resp.status == 201
+        # 403 for non-whitelisted events (EventServer.scala:293)
+        resp = await client.post(
+            f"/events.json?accessKey={limited}", json={**EVENT, "event": "buy"}
+        )
+        assert resp.status == 403
+        # batch continues past a denied item with per-item 403 (:430-433)
+        resp = await client.post(
+            f"/batch/events.json?accessKey={limited}",
+            json=[EVENT, {**EVENT, "event": "buy"}, {**EVENT, "entityId": "u2"}],
+        )
+        assert [r["status"] for r in await resp.json()] == [201, 403, 201]
+
+    run_client(env, t)
+
+
+def test_channel_isolation(env):
+    async def t(client, key, limited):
+        resp = await client.post(
+            f"/events.json?accessKey={key}&channel=live", json=EVENT
+        )
+        assert resp.status == 201
+        resp = await client.post(
+            f"/events.json?accessKey={key}&channel=nochan", json=EVENT
+        )
+        assert resp.status == 401
+        # default channel has no events yet
+        resp = await client.get(f"/events.json?accessKey={key}")
+        assert resp.status == 404
+        resp = await client.get(f"/events.json?accessKey={key}&channel=live")
+        assert resp.status == 200
+        assert len(await resp.json()) == 1
+
+    run_client(env, t)
+
+
+def test_find_filters_and_limit(env):
+    async def t(client, key, limited):
+        for i in range(25):
+            await client.post(
+                f"/events.json?accessKey={key}",
+                json={**EVENT, "entityId": f"u{i}",
+                      "eventTime": f"2020-01-01T00:00:{i:02d}Z"},
+            )
+        resp = await client.get(f"/events.json?accessKey={key}")
+        assert len(await resp.json()) == 20  # default limit (EventServer.scala:353)
+        resp = await client.get(f"/events.json?accessKey={key}&limit=-1")
+        assert len(await resp.json()) == 25
+        resp = await client.get(
+            f"/events.json?accessKey={key}&limit=-1"
+            f"&startTime=2020-01-01T00:00:10Z&untilTime=2020-01-01T00:00:15Z"
+        )
+        assert len(await resp.json()) == 5
+        resp = await client.get(
+            f"/events.json?accessKey={key}&entityType=user&entityId=u3"
+        )
+        assert len(await resp.json()) == 1
+        resp = await client.get(
+            f"/events.json?accessKey={key}&reversed=true&limit=1"
+        )
+        assert (await resp.json())[0]["entityId"] == "u24"
+
+    run_client(env, t)
+
+
+def test_batch_semantics(env):
+    async def t(client, key, limited):
+        batch = [
+            EVENT,
+            {"event": "", "entityType": "user", "entityId": "ux"},  # invalid
+            {**EVENT, "entityId": "u2"},
+        ]
+        resp = await client.post(f"/batch/events.json?accessKey={key}", json=batch)
+        assert resp.status == 200
+        results = await resp.json()
+        assert [r["status"] for r in results] == [201, 400, 201]
+        # cap at 50
+        resp = await client.post(
+            f"/batch/events.json?accessKey={key}", json=[EVENT] * 51
+        )
+        assert resp.status == 400
+
+    run_client(env, t)
+
+
+def test_stats_opt_in(env):
+    async def t_disabled(client, key, limited):
+        resp = await client.get(f"/stats.json?accessKey={key}")
+        assert resp.status == 404
+
+    run_client(env, t_disabled, stats=False)
+
+    async def t_enabled(client, key, limited):
+        await client.post(f"/events.json?accessKey={key}", json=EVENT)
+        # malformed JSON with stats enabled must still 400, not 500
+        resp = await client.post(f"/events.json?accessKey={key}", data=b"{oops")
+        assert resp.status == 400
+        resp = await client.get(f"/stats.json?accessKey={key}")
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["currentHour"]["event"].get("rate") == 1
+
+    run_client(env, t_enabled, stats=True)
+
+
+def test_webhooks_example_json(env):
+    async def t(client, key, limited):
+        resp = await client.get(f"/webhooks/exampleJson.json?accessKey={key}")
+        assert resp.status == 200
+        payload = {
+            "type": "userAction", "event": "click", "userId": "u1",
+            "timestamp": "2020-01-01T00:00:00Z", "properties": {"x": 1},
+        }
+        resp = await client.post(
+            f"/webhooks/exampleJson.json?accessKey={key}", json=payload
+        )
+        assert resp.status == 201
+        resp = await client.post(
+            f"/webhooks/exampleJson.json?accessKey={key}", json={"type": "nope"}
+        )
+        assert resp.status == 400
+        resp = await client.post(f"/webhooks/nothere.json?accessKey={key}", json={})
+        assert resp.status == 404
+
+    run_client(env, t)
+
+
+def test_webhooks_segmentio(env):
+    async def t(client, key, limited):
+        payload = {
+            "version": "2", "type": "track", "userId": "u9",
+            "event": "Signed Up", "properties": {"plan": "Pro"},
+            "timestamp": "2020-01-01T00:00:00Z",
+        }
+        resp = await client.post(
+            f"/webhooks/segmentio.json?accessKey={key}", json=payload
+        )
+        assert resp.status == 201
+        event_id = (await resp.json())["eventId"]
+        got = await (await client.get(
+            f"/events/{event_id}.json?accessKey={key}"
+        )).json()
+        assert got["event"] == "track" and got["entityId"] == "u9"
+        assert got["properties"]["event"] == "Signed Up"
+        # unsupported version
+        resp = await client.post(
+            f"/webhooks/segmentio.json?accessKey={key}",
+            json={**payload, "version": "1"},
+        )
+        assert resp.status == 400
+
+    run_client(env, t)
+
+
+def test_webhooks_mailchimp_form(env):
+    async def t(client, key, limited):
+        form = {
+            "type": "subscribe", "fired_at": "2009-03-26 21:35:57",
+            "data[id]": "8a25ff1d98", "data[list_id]": "a6b5da1054",
+            "data[email]": "api@mailchimp.com", "data[email_type]": "html",
+            "data[merges][EMAIL]": "api@mailchimp.com",
+            "data[merges][FNAME]": "MailChimp", "data[merges][LNAME]": "API",
+            "data[ip_opt]": "10.20.10.30", "data[ip_signup]": "10.20.10.30",
+        }
+        resp = await client.post(
+            f"/webhooks/mailchimp.form?accessKey={key}", data=form
+        )
+        assert resp.status == 201
+        event_id = (await resp.json())["eventId"]
+        got = await (await client.get(
+            f"/events/{event_id}.json?accessKey={key}"
+        )).json()
+        assert got["event"] == "subscribe"
+        assert got["entityId"] == "8a25ff1d98"
+        assert got["targetEntityId"] == "a6b5da1054"
+        assert got["properties"]["merges"]["FNAME"] == "MailChimp"
+        assert got["eventTime"].startswith("2009-03-26T21:35:57")
+        # campaign events use entityType "campaign" (MailChimpConnector.scala:293)
+        resp = await client.post(
+            f"/webhooks/mailchimp.form?accessKey={key}",
+            data={"type": "campaign", "fired_at": "2009-03-26 21:35:57",
+                  "data[id]": "cid1", "data[list_id]": "a6b5da1054",
+                  "data[subject]": "Hi", "data[status]": "sent",
+                  "data[reason]": ""},
+        )
+        assert resp.status == 201
+        got = await (await client.get(
+            f"/events/{(await resp.json())['eventId']}.json?accessKey={key}"
+        )).json()
+        assert got["entityType"] == "campaign" and got["entityId"] == "cid1"
+
+    run_client(env, t)

@@ -1,0 +1,155 @@
+"""Query (engine) server: deploy → /queries.json → reload/stop.
+
+Parity: reference deploy + query flow (CreateServer.scala ServerActor route)
+driven through aiohttp test client with a real trained classification model.
+"""
+
+import asyncio
+import datetime as dt
+import json
+import os
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from incubator_predictionio_tpu.core import EngineParams
+from incubator_predictionio_tpu.core.workflow import run_train
+from incubator_predictionio_tpu.data import DataMap, Event
+from incubator_predictionio_tpu.data.storage import App, Storage, use_storage
+from incubator_predictionio_tpu.data.storage.base import EngineInstance
+from incubator_predictionio_tpu.parallel.mesh import MeshContext
+from incubator_predictionio_tpu.server.query_server import QueryServer, ServerConfig
+from incubator_predictionio_tpu.templates.classification import (
+    ClassificationEngine,
+    DataSourceParams,
+    MLPAlgorithmParams,
+)
+
+UTC = dt.timezone.utc
+
+
+@pytest.fixture(scope="module")
+def deployed_env(tmp_path_factory):
+    tmp_path = tmp_path_factory.mktemp("qs")
+    storage = Storage({"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+    prev = use_storage(storage)
+    app_id = storage.get_meta_data_apps().insert(App(0, "qs-test"))
+    events = storage.get_events()
+    events.init(app_id)
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(64, 3))
+    y = (x[:, 0] > 0).astype(int)
+    for i in range(64):
+        events.insert(
+            Event(event="$set", entity_type="user", entity_id=f"u{i}",
+                  properties=DataMap({"attr0": float(x[i, 0]),
+                                      "attr1": float(x[i, 1]),
+                                      "attr2": float(x[i, 2]),
+                                      "plan": int(y[i])}),
+                  event_time=dt.datetime(2020, 1, 1, tzinfo=UTC)),
+            app_id,
+        )
+    variant_path = str(tmp_path / "engine.json")
+    variant = {
+        "id": "default",
+        "version": "1",
+        "engineFactory":
+            "incubator_predictionio_tpu.templates.classification.ClassificationEngine",
+        "datasource": {"params": {"appName": "qs-test"}},
+        "algorithms": [{"name": "mlp",
+                        "params": {"hiddenDims": [8], "epochs": 80,
+                                   "learningRate": 0.03, "batchSize": 64}}],
+    }
+    with open(variant_path, "w") as f:
+        json.dump(variant, f)
+    engine = ClassificationEngine().apply()
+    engine_params = engine.engine_params_from_variant(variant)
+    ctx = MeshContext.create()
+    instance = EngineInstance(
+        id="", status="INIT", start_time=dt.datetime.now(UTC), end_time=None,
+        engine_id="default", engine_version="1",
+        engine_variant=os.path.abspath(variant_path),
+        engine_factory=variant["engineFactory"],
+    )
+    run_train(engine, engine_params, instance, storage=storage, ctx=ctx)
+    yield storage, variant_path, x, y
+    use_storage(prev)
+    storage.close()
+
+
+def run_server(deployed_env, coro_fn, **server_kw):
+    storage, variant_path, x, y = deployed_env
+
+    async def runner():
+        server = QueryServer(
+            ServerConfig(engine_variant=variant_path, **server_kw), storage=storage
+        )
+        client = TestClient(TestServer(server.make_app()))
+        await client.start_server()
+        try:
+            return await coro_fn(client, server, x, y)
+        finally:
+            await client.close()
+
+    return asyncio.run(runner())
+
+
+def test_query_roundtrip_and_stats(deployed_env):
+    async def t(client, server, x, y):
+        correct = 0
+        for i in range(20):
+            resp = await client.post(
+                "/queries.json", json={"features": list(map(float, x[i]))}
+            )
+            assert resp.status == 200
+            body = await resp.json()
+            assert "label" in body and "scores" in body
+            correct += int(body["label"] == int(y[i]))
+        assert correct >= 18
+        status = await (await client.get("/")).json()
+        assert status["requestCount"] == 20
+        assert status["avgServingSec"] > 0
+        assert status["engineInstance"]["engineId"] == "default"
+
+    run_server(deployed_env, t)
+
+
+def test_invalid_queries(deployed_env):
+    async def t(client, server, x, y):
+        resp = await client.post("/queries.json", data=b"{nope")
+        assert resp.status == 400
+        resp = await client.post("/queries.json", json={"bogus": [1, 2, 3]})
+        assert resp.status == 400
+        assert "Invalid query" in (await resp.json())["message"]
+
+    run_server(deployed_env, t)
+
+
+def test_reload_and_stop_auth(deployed_env):
+    async def t(client, server, x, y):
+        resp = await client.post("/reload")
+        assert resp.status == 401
+        resp = await client.post("/stop")
+        assert resp.status == 401
+        resp = await client.post("/reload?accessKey=sekret")
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["message"] == "Reloaded" and body["engineInstanceId"]
+        resp = await client.post("/stop?accessKey=sekret")
+        assert resp.status == 200
+
+    run_server(deployed_env, t, server_access_key="sekret")
+
+
+def test_undeployed_engine_errors(tmp_path):
+    storage = Storage({"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+    variant_path = str(tmp_path / "engine.json")
+    with open(variant_path, "w") as f:
+        json.dump({
+            "engineFactory":
+                "incubator_predictionio_tpu.templates.classification.ClassificationEngine",
+        }, f)
+    with pytest.raises(RuntimeError, match="No COMPLETED engine instance"):
+        QueryServer(ServerConfig(engine_variant=variant_path), storage=storage)
+    storage.close()
