@@ -133,6 +133,7 @@ class MLPClassifier:
         loss = np.inf
         for _ in range(cfg.epochs):
             params, opt_state, loss = train_epoch(params, opt_state)
+            loss.block_until_ready()  # see two_tower.py: CPU collective-deadlock guard
         final_loss = float(loss)
 
         host_params = jax.tree.map(np.asarray, params)

@@ -1,0 +1,340 @@
+"""Recommendation template — the scala-parallel-recommendation counterpart.
+
+Reference behavior (tests/pio_tests/engines/recommendation-engine/src/main/scala/):
+- DataSource reads "rate" and "buy" events user→item via PEventStore
+  (DataSource.scala:45-77); "buy" implies rating 4.0; later events of the
+  same (user, item) pair win (Preparator semantics in ALSAlgorithm.scala's
+  MLlibRating mapping);
+- ALSAlgorithm trains MLlib ALS with user/item BiMaps
+  (ALSAlgorithm.scala:50-93) and warns above 30 iterations (:44-48);
+- Query {"user": U, "num": N} → PredictedResult {"itemScores":
+  [{"item": I, "score": S}, …]}; Serving returns the head prediction;
+- Evaluation: Precision@K over k-fold readEval folds (Evaluation.scala:62-106,
+  DataSource.scala:83-…).
+
+Algorithm here: two-tower MF on the mesh (models/two_tower.py), with the same
+BiMap id handling, the same >30-iterations warning semantics (logged, not a
+stack-overflow guard — our scan has no recursion to blow), and a vectorized
+``batch_predict`` for evaluation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Optional, Sequence
+
+import numpy as np
+
+from incubator_predictionio_tpu.core import (
+    Engine,
+    EngineFactory,
+    EngineParamsGenerator,
+    Evaluation,
+    FirstServing,
+    IdentityPreparator,
+    MetricEvaluator,
+    OptionAverageMetric,
+    PAlgorithm,
+    Params,
+    PDataSource,
+    SanityCheck,
+)
+from incubator_predictionio_tpu.data.bimap import BiMap
+from incubator_predictionio_tpu.data.store import PEventStore
+from incubator_predictionio_tpu.models.two_tower import (
+    TwoTowerConfig,
+    TwoTowerMF,
+    TwoTowerModel,
+)
+from incubator_predictionio_tpu.parallel.mesh import MeshContext
+
+logger = logging.getLogger(__name__)
+
+
+# -- queries / results ------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Query:
+    user: str
+    num: int = 10
+
+
+@dataclasses.dataclass(frozen=True)
+class ItemScore:
+    item: str
+    score: float
+
+
+@dataclasses.dataclass(frozen=True)
+class PredictedResult:
+    item_scores: tuple[ItemScore, ...] = ()
+
+
+# -- data source ------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str = "recommendation"
+    eval_k: Optional[int] = None
+    eval_queries_per_fold: int = 100
+    buy_rating: float = 4.0  # implicit weight of a "buy" (DataSource.scala:61)
+    seed: int = 42
+
+
+@dataclasses.dataclass
+class TrainingData(SanityCheck):
+    """Rating triples, columnar (the RDD[Rating] counterpart)."""
+
+    users: np.ndarray     # [n] str
+    items: np.ndarray     # [n] str
+    ratings: np.ndarray   # [n] float32
+
+    def sanity_check(self) -> None:
+        if len(self.users) == 0:
+            raise ValueError("TrainingData is empty (no rate/buy events found)")
+
+
+class DataSource(PDataSource):
+    params_class = DataSourceParams
+
+    def __init__(self, params: DataSourceParams):
+        super().__init__(params)
+        self._store = PEventStore()
+
+    def _read(self) -> TrainingData:
+        users, items, ratings = [], [], []
+        # latest event of a (user, item) pair wins: find() is time-ordered
+        latest: dict[tuple[str, str], float] = {}
+        for e in self._store.find(
+            self.params.app_name,
+            entity_type="user",
+            event_names=("rate", "buy"),
+            target_entity_type="item",
+        ):
+            rating = (
+                float(e.properties.get("rating", 0.0))
+                if e.event == "rate"
+                else self.params.buy_rating
+            )
+            latest[(e.entity_id, e.target_entity_id)] = rating
+        for (u, i), r in latest.items():
+            users.append(u)
+            items.append(i)
+            ratings.append(r)
+        return TrainingData(
+            np.asarray(users), np.asarray(items), np.asarray(ratings, np.float32)
+        )
+
+    def read_training(self, ctx: MeshContext) -> TrainingData:
+        return self._read()
+
+    def read_eval(self, ctx: MeshContext):
+        """k-fold split over rating triples (reference DataSource.scala:83-…):
+        held-out fold becomes (Query(user, num=k-ish), ActualResult(ratings))."""
+        k = self.params.eval_k
+        if not k:
+            return []
+        td = self._read()
+        n = len(td.ratings)
+        rng = np.random.default_rng(self.params.seed)
+        fold_of = rng.integers(0, k, n)
+        folds = []
+        for fold in range(k):
+            train_mask = fold_of != fold
+            test_mask = ~train_mask
+            train = TrainingData(
+                td.users[train_mask], td.items[train_mask], td.ratings[train_mask]
+            )
+            # group held-out positives per user
+            per_user: dict[str, list[tuple[str, float]]] = {}
+            for u, i, r in zip(td.users[test_mask], td.items[test_mask],
+                               td.ratings[test_mask]):
+                per_user.setdefault(u, []).append((i, float(r)))
+            qa = [
+                (Query(user=u, num=self.params.eval_queries_per_fold),
+                 ActualResult(tuple(ItemRating(i, r) for i, r in pairs)))
+                for u, pairs in per_user.items()
+            ]
+            folds.append((train, {"fold": fold}, qa))
+        return folds
+
+
+@dataclasses.dataclass(frozen=True)
+class ItemRating:
+    item: str
+    rating: float
+
+
+@dataclasses.dataclass(frozen=True)
+class ActualResult:
+    """Held-out positives for one user (reference ActualResult)."""
+
+    ratings: tuple[ItemRating, ...]
+
+
+# -- algorithm --------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class ALSAlgorithmParams(Params):
+    """Named after the reference's params (rank/numIterations/lambda/seed)."""
+
+    rank: int = 32
+    num_iterations: int = 20
+    lambda_: float = 1e-4
+    learning_rate: float = 3e-2
+    batch_size: int = 8192
+    seed: Optional[int] = None
+
+
+@dataclasses.dataclass
+class RecModel:
+    """TwoTowerModel + id vocabularies (reference ALSModel: factors + BiMaps)."""
+
+    mf: TwoTowerModel
+    user_map: BiMap
+    item_map: BiMap
+
+    def prepare_for_serving(self) -> "RecModel":
+        self.mf.prepare_for_serving()
+        return self
+
+
+class ALSAlgorithm(PAlgorithm):
+    """MLlib ALS slot (ALSAlgorithm.scala:50-93) filled by two-tower MF."""
+
+    params_class = ALSAlgorithmParams
+    query_cls = Query
+
+    def train(self, ctx: MeshContext, pd: TrainingData) -> RecModel:
+        p = self.params
+        if p.num_iterations > 30:
+            # parity with the reference guardrail (ALSAlgorithm.scala:44-48);
+            # informational here — no recursion depth to overflow
+            logger.warning(
+                "ALSAlgorithmParams.num_iterations = %d > 30: long schedules "
+                "rarely help MF; consider lowering", p.num_iterations,
+            )
+        user_map = BiMap.string_int(pd.users)
+        item_map = BiMap.string_int(pd.items)
+        cfg = TwoTowerConfig(
+            rank=p.rank,
+            learning_rate=p.learning_rate,
+            reg=p.lambda_,
+            epochs=p.num_iterations,
+            batch_size=p.batch_size,
+            seed=p.seed if p.seed is not None else 0,
+        )
+        mf = TwoTowerMF(cfg).fit(
+            ctx,
+            user_map.lookup_array(pd.users),
+            item_map.lookup_array(pd.items),
+            pd.ratings,
+            n_users=len(user_map),
+            n_items=len(item_map),
+        )
+        return RecModel(mf, user_map, item_map)
+
+    def predict(self, model: RecModel, query: Query) -> PredictedResult:
+        uidx = model.user_map.get(query.user)
+        if uidx is None:
+            # unknown user → empty result (reference returns empty itemScores)
+            return PredictedResult()
+        idx, scores = TwoTowerMF.recommend(model.mf, uidx, query.num)
+        inv = model.item_map.inverse()
+        return PredictedResult(tuple(
+            ItemScore(inv[int(i)], float(s)) for i, s in zip(idx, scores)
+        ))
+
+    def batch_predict(
+        self, model: RecModel, queries: Sequence[tuple[int, Query]]
+    ) -> list[tuple[int, PredictedResult]]:
+        if not queries:
+            return []
+        known = [(qi, q) for qi, q in queries if q.user in model.user_map]
+        out: list[tuple[int, PredictedResult]] = [
+            (qi, PredictedResult()) for qi, q in queries if q.user not in model.user_map
+        ]
+        if known:
+            num = max(q.num for _, q in known)
+            uidx = np.asarray([model.user_map[q.user] for _, q in known], np.int32)
+            idx, scores = TwoTowerMF.recommend_batch(model.mf, uidx, num)
+            inv = model.item_map.inverse()
+            for (qi, q), row_idx, row_scores in zip(known, idx, scores):
+                out.append((qi, PredictedResult(tuple(
+                    ItemScore(inv[int(i)], float(s))
+                    for i, s in zip(row_idx[: q.num], row_scores[: q.num])
+                ))))
+        return out
+
+
+# -- metrics (reference Evaluation.scala:62-106) ----------------------------
+
+class PrecisionAtK(OptionAverageMetric):
+    """Fraction of top-k recommendations that are relevant (rating ≥ threshold).
+    None (skipped) when the user has no relevant held-out items."""
+
+    def __init__(self, k: int = 10, rating_threshold: float = 2.0):
+        self.k = k
+        self.rating_threshold = rating_threshold
+
+    @property
+    def header(self) -> str:
+        return f"Precision@K (k={self.k}, threshold={self.rating_threshold})"
+
+    def calculate_qpa(self, q: Query, p: PredictedResult, a: ActualResult):
+        positives = {r.item for r in a.ratings if r.rating >= self.rating_threshold}
+        if not positives:
+            # precision undefined without positives (Evaluation.scala:43-46)
+            return None
+        tp = sum(1 for s in p.item_scores[: self.k] if s.item in positives)
+        return tp / min(self.k, len(positives))  # Evaluation.scala:49
+
+
+class PositiveCount(OptionAverageMetric):
+    """Average number of relevant held-out items per query (diagnostic,
+    reference Evaluation.scala:53-60)."""
+
+    def __init__(self, rating_threshold: float = 2.0):
+        self.rating_threshold = rating_threshold
+
+    @property
+    def header(self) -> str:
+        return f"PositiveCount (threshold={self.rating_threshold})"
+
+    def calculate_qpa(self, q, p, a: ActualResult):
+        return float(sum(1 for r in a.ratings if r.rating >= self.rating_threshold))
+
+
+# -- engine / evaluation ----------------------------------------------------
+
+class RecommendationEngine(EngineFactory):
+    def apply(self) -> Engine:
+        return Engine(
+            DataSource,
+            IdentityPreparator,
+            {"als": ALSAlgorithm, "": ALSAlgorithm},
+            FirstServing,
+        )
+
+
+class RecommendationEvaluation(Evaluation, EngineParamsGenerator):
+    """Precision@K evaluation with a small rank/reg grid
+    (reference Evaluation.scala + EngineParamsList)."""
+
+    def __init__(self, app_name: str = "recommendation", eval_k: int = 3):
+        from incubator_predictionio_tpu.core import EngineParams
+
+        self.engine = RecommendationEngine().apply()
+        self.evaluator = MetricEvaluator(
+            metric=PrecisionAtK(k=10, rating_threshold=2.0),
+            other_metrics=[PositiveCount(rating_threshold=2.0)],
+        )
+        self.engine_params_list = [
+            EngineParams.create(
+                data_source=DataSourceParams(app_name=app_name, eval_k=eval_k),
+                algorithms=[("als", ALSAlgorithmParams(rank=rank, num_iterations=it))],
+            )
+            for rank in (16, 32)
+            for it in (10, 20)
+        ]

@@ -1,0 +1,155 @@
+"""Recommendation template: two-tower MF train/predict/eval + FastEval caching.
+
+Parity: the reference QuickStartTest recommendation-engine scenario +
+FastEvalEngineTest caching semantics, at unit scale on the CPU mesh.
+"""
+
+import datetime as dt
+
+import numpy as np
+import pytest
+
+from incubator_predictionio_tpu.core import EngineParams
+from incubator_predictionio_tpu.core.fast_eval import FastEvalEngine
+from incubator_predictionio_tpu.data import DataMap, Event
+from incubator_predictionio_tpu.data.storage import App, Storage, use_storage
+from incubator_predictionio_tpu.parallel.mesh import MeshContext
+from incubator_predictionio_tpu.templates.recommendation import (
+    ALSAlgorithmParams,
+    DataSourceParams,
+    PositiveCount,
+    PrecisionAtK,
+    Query,
+    RecommendationEngine,
+)
+
+UTC = dt.timezone.utc
+
+N_USERS, N_ITEMS = 24, 16
+
+
+@pytest.fixture(scope="module")
+def storage():
+    """Synthetic taste clusters: even users like even items, odd like odd."""
+    s = Storage({"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+    app_id = s.get_meta_data_apps().insert(App(0, "rec-test"))
+    events = s.get_events()
+    events.init(app_id)
+    rng = np.random.default_rng(3)
+    t0 = dt.datetime(2020, 1, 1, tzinfo=UTC)
+    for u in range(N_USERS):
+        for i in range(N_ITEMS):
+            if rng.random() < 0.6:
+                liked = (u % 2) == (i % 2)
+                rating = (4.0 + rng.random()) if liked else (1.0 + rng.random())
+                events.insert(
+                    Event(event="rate", entity_type="user", entity_id=f"u{u}",
+                          target_entity_type="item", target_entity_id=f"i{i}",
+                          properties=DataMap({"rating": rating}),
+                          event_time=t0 + dt.timedelta(seconds=u * 100 + i)),
+                    app_id,
+                )
+    # a few buys (implicit rating 4.0) and a re-rate (later event wins)
+    events.insert(Event(event="buy", entity_type="user", entity_id="u0",
+                        target_entity_type="item", target_entity_id="i2",
+                        event_time=t0 + dt.timedelta(days=1)), app_id)
+    events.insert(Event(event="rate", entity_type="user", entity_id="u0",
+                        target_entity_type="item", target_entity_id="i1",
+                        properties=DataMap({"rating": 1.0}),
+                        event_time=t0 + dt.timedelta(days=2)), app_id)
+    yield s
+    s.close()
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return MeshContext.create(axes={"data": 4, "model": 2})
+
+
+def ep(rank=8, iters=200, eval_k=None):
+    # iters = SGD epochs here (one batch per epoch at this scale); small data
+    # needs a longer schedule than MovieLens-scale runs
+    return EngineParams.create(
+        data_source=DataSourceParams(app_name="rec-test", eval_k=eval_k),
+        algorithms=[("als", ALSAlgorithmParams(
+            rank=rank, num_iterations=iters, learning_rate=5e-2, batch_size=512))],
+    )
+
+
+def test_train_and_recommend(storage, ctx):
+    prev = use_storage(storage)
+    try:
+        engine = RecommendationEngine().apply()
+        [model] = engine.train(ctx, ep())
+        algorithms, serving = engine.serving_and_algorithms(ep())
+        algo = algorithms[0]
+        # u0 is an even user → evens should dominate its top-4
+        pred = serving.serve(Query(user="u0", num=4),
+                             [algo.predict(model, Query(user="u0", num=4))])
+        assert len(pred.item_scores) == 4
+        even_hits = sum(1 for s in pred.item_scores if int(s.item[1:]) % 2 == 0)
+        assert even_hits >= 3, [s.item for s in pred.item_scores]
+        # scores sorted descending
+        scores = [s.score for s in pred.item_scores]
+        assert scores == sorted(scores, reverse=True)
+        # unknown user → empty itemScores (reference behavior)
+        assert algo.predict(model, Query(user="nobody", num=4)).item_scores == ()
+    finally:
+        use_storage(prev)
+
+
+def test_later_event_wins(storage, ctx):
+    prev = use_storage(storage)
+    try:
+        from incubator_predictionio_tpu.core import doer
+        from incubator_predictionio_tpu.templates.recommendation import DataSource
+
+        ds = doer(DataSource, DataSourceParams(app_name="rec-test"))
+        td = ds.read_training(ctx)
+        pairs = dict(zip(zip(td.users.tolist(), td.items.tolist()),
+                         td.ratings.tolist()))
+        assert pairs[("u0", "i2")] == 4.0   # buy overrides earlier rate
+        assert pairs[("u0", "i1")] == 1.0   # re-rate wins
+    finally:
+        use_storage(prev)
+
+
+def test_batch_predict_matches_single(storage, ctx):
+    prev = use_storage(storage)
+    try:
+        engine = RecommendationEngine().apply()
+        [model] = engine.train(ctx, ep())
+        algorithms, _ = engine.serving_and_algorithms(ep())
+        algo = algorithms[0]
+        queries = [(0, Query(user="u1", num=3)), (1, Query(user="nobody", num=3)),
+                   (2, Query(user="u2", num=5))]
+        results = dict(algo.batch_predict(model, queries))
+        assert [s.item for s in results[0].item_scores] == \
+            [s.item for s in algo.predict(model, queries[0][1]).item_scores]
+        assert results[1].item_scores == ()
+        assert len(results[2].item_scores) == 5
+    finally:
+        use_storage(prev)
+
+
+def test_eval_precision_and_fast_eval_caching(storage, ctx):
+    prev = use_storage(storage)
+    try:
+        engine = FastEvalEngine.from_engine(RecommendationEngine().apply())
+        variants = [ep(rank=8, eval_k=2), ep(rank=8, eval_k=2),
+                    ep(rank=4, eval_k=2)]
+        results = engine.batch_eval(ctx, variants, None)
+        assert len(results) == 3
+        # identical variants share every prefix; third shares ds+prep only
+        assert engine.last_cache_stats == {"ds": 1, "prep": 1, "algo": 2}
+        metric = PrecisionAtK(k=4, rating_threshold=4.0)
+        score = metric.calculate(ctx, results[0][1])
+        # Ranking is near-perfect on parity (see test_train_and_recommend), but
+        # like the reference ALS the recommender does not exclude train-seen
+        # items, so held-out positives compete with memorized ones; the
+        # realistic ceiling here is ~0.35 vs random ~0.25.
+        assert score > 0.25, score
+        pc = PositiveCount(rating_threshold=4.0).calculate(ctx, results[0][1])
+        assert pc > 0
+    finally:
+        use_storage(prev)
