@@ -142,40 +142,22 @@ class TwoTowerMF:
             "ub": jax.device_put(np.zeros(nu_p, np.float32), bias_sharding),
             "ib": jax.device_put(np.zeros(ni_p, np.float32), bias_sharding),
         }
-        tx = optax.adam(cfg.learning_rate)
-        opt_state = tx.init(params)  # zeros_like inherits the param shardings
+        opt_state = optax.adam(cfg.learning_rate).init(params)
 
-        def loss_fn(p, bu, bi, br, bw):
-            ue = p["ue"][bu].astype(jnp.bfloat16)
-            ie = p["ie"][bi].astype(jnp.bfloat16)
-            pred = jnp.sum(ue * ie, axis=-1).astype(jnp.float32) + p["ub"][bu] + p["ib"][bi]
-            err = (pred - br) ** 2
-            mse = jnp.sum(err * bw) / jnp.maximum(jnp.sum(bw), 1.0)
-            reg = cfg.reg * (
-                jnp.sum(ue.astype(jnp.float32) ** 2) + jnp.sum(ie.astype(jnp.float32) ** 2)
-            ) / jnp.maximum(jnp.sum(bw), 1.0)
-            return mse + reg
-
-        @partial(jax.jit, donate_argnums=(0, 1))
-        def train_epoch(p, o):
-            def step(carry, batch):
-                p, o = carry
-                bu, bi, br, bw = batch
-                loss, grads = jax.value_and_grad(loss_fn)(p, bu, bi, br, bw)
-                updates, o = tx.update(grads, o, p)
-                p = optax.apply_updates(p, updates)
-                return (p, o), loss
-
-            (p, o), losses = jax.lax.scan(step, (p, o), (ub, ib, rb, wb))
-            return p, o, losses.mean()
+        # The CPU backend's subgroup-collective rendezvous can deadlock when
+        # async dispatch interleaves separate executions; serialize epochs
+        # there. On TPU, sync sparsely — per-dispatch tunnel latency dominates
+        # small steps otherwise.
+        sync_every = 1 if ctx.mesh.devices.flat[0].platform == "cpu" else 8
 
         loss = np.inf
-        for _ in range(cfg.epochs):
-            params, opt_state, loss = train_epoch(params, opt_state)
-            # synchronize per epoch: unbounded async dispatch can interleave
-            # different runs' subgroup collectives on the CPU backend and
-            # deadlock its rendezvous; one host sync per scan-epoch is noise
-            loss.block_until_ready()
+        for e in range(cfg.epochs):
+            params, opt_state, loss = _train_epoch(
+                params, opt_state, ub, ib, rb, wb, cfg.learning_rate, cfg.reg
+            )
+            if (e + 1) % sync_every == 0:
+                loss.block_until_ready()
+        # final host gather below (tree.map np.asarray) is the closing sync
 
         host = jax.tree.map(np.asarray, params)
         model = TwoTowerModel(
@@ -233,6 +215,36 @@ class TwoTowerMF:
             num,
         )
         return np.asarray(idx), np.asarray(scores)
+
+
+@partial(jax.jit, static_argnames=("lr", "reg"), donate_argnums=(0, 1))
+def _train_epoch(p, o, ub, ib, rb, wb, lr, reg):
+    """One epoch = lax.scan over staged batches. Module-level with static
+    (lr, reg) so repeated fits of the same shapes reuse one executable."""
+    tx = optax.adam(lr)
+
+    def loss_fn(p, bu, bi, br, bw):
+        ue = p["ue"][bu].astype(jnp.bfloat16)
+        ie = p["ie"][bi].astype(jnp.bfloat16)
+        pred = jnp.sum(ue * ie, axis=-1).astype(jnp.float32) + p["ub"][bu] + p["ib"][bi]
+        err = (pred - br) ** 2
+        denom = jnp.maximum(jnp.sum(bw), 1.0)
+        mse = jnp.sum(err * bw) / denom
+        l2 = reg * (
+            jnp.sum(ue.astype(jnp.float32) ** 2) + jnp.sum(ie.astype(jnp.float32) ** 2)
+        ) / denom
+        return mse + l2
+
+    def step(carry, batch):
+        p, o = carry
+        bu, bi, br, bw = batch
+        loss, grads = jax.value_and_grad(loss_fn)(p, bu, bi, br, bw)
+        updates, o = tx.update(grads, o, p)
+        p = optax.apply_updates(p, updates)
+        return (p, o), loss
+
+    (p, o), losses = jax.lax.scan(step, (p, o), (ub, ib, rb, wb))
+    return p, o, losses.mean()
 
 
 @partial(jax.jit, static_argnames=("num",))
