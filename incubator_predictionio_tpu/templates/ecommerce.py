@@ -1,0 +1,309 @@
+"""E-commerce recommendation template — the scala-parallel-ecommercerecommendation counterpart.
+
+Reference behavior (examples/scala-parallel-ecommercerecommendation/.../ECommAlgorithm.scala:79-597):
+- trains implicit MF on view (+ optional buy) events and keeps per-item
+  ``ProductModel``s with popularity counts (``trainDefault`` :211);
+- query-time business rules: category filter, whitelist/blacklist,
+  **unavailable items** read live from the event store ("constraint"
+  ``$set`` events, latest wins :150-180), and unseen-only filtering of the
+  user's view/buy history (:429-470);
+- prediction fallbacks: predictKnownUser (:429) → predictSimilar from the
+  user's recent views (:505) → predictDefault popularity (:475).
+
+The live reads ride :class:`LEventStore` exactly like the reference — this is
+the low-latency serving-time storage path (SURVEY §7 hard part on
+LEventStore-equivalent reads at predict time). Dynamic candidate filters
+become -inf masks over the static item axis before one on-device top-k.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Optional, Sequence
+
+import numpy as np
+
+from incubator_predictionio_tpu.core import (
+    Engine,
+    EngineFactory,
+    FirstServing,
+    IdentityPreparator,
+    PAlgorithm,
+    Params,
+    PDataSource,
+    SanityCheck,
+)
+from incubator_predictionio_tpu.data.bimap import BiMap
+from incubator_predictionio_tpu.data.store import LEventStore, PEventStore
+from incubator_predictionio_tpu.models.two_tower import (
+    TwoTowerConfig,
+    TwoTowerMF,
+    TwoTowerModel,
+)
+from incubator_predictionio_tpu.parallel.mesh import MeshContext
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class Query:
+    user: str
+    num: int = 10
+    categories: Optional[tuple[str, ...]] = None
+    white_list: Optional[tuple[str, ...]] = None
+    black_list: Optional[tuple[str, ...]] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class ItemScore:
+    item: str
+    score: float
+
+
+@dataclasses.dataclass(frozen=True)
+class PredictedResult:
+    item_scores: tuple[ItemScore, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str = "ecommerce"
+
+
+@dataclasses.dataclass
+class TrainingData(SanityCheck):
+    users: BiMap
+    items: BiMap
+    categories: dict[str, tuple[str, ...]]
+    u_idx: np.ndarray       # [n] interaction user idx (views + buys)
+    i_idx: np.ndarray       # [n] interaction item idx
+    weight: np.ndarray      # [n] 1.0 view / buy_weight buy
+    buy_counts: np.ndarray  # [n_items] popularity
+
+    def sanity_check(self) -> None:
+        if len(self.items) == 0:
+            raise ValueError("no items found ($set events on entityType 'item')")
+        if len(self.u_idx) == 0:
+            raise ValueError("no view/buy events found")
+
+
+class DataSource(PDataSource):
+    params_class = DataSourceParams
+
+    def __init__(self, params: DataSourceParams):
+        super().__init__(params)
+        self._store = PEventStore()
+
+    def read_training(self, ctx: MeshContext) -> TrainingData:
+        app = self.params.app_name
+        item_props = self._store.aggregate_properties(app, "item")
+        items = BiMap.string_int(item_props.keys())
+        categories = {
+            iid: tuple(pm.get("categories") or ()) for iid, pm in item_props.items()
+        }
+        inter_u, inter_i, weight = [], [], []
+        buy_counts = np.zeros(len(items), np.int64)
+        user_ids = set()
+        for e in self._store.find(
+            app, entity_type="user", event_names=("view", "buy"),
+            target_entity_type="item",
+        ):
+            if e.target_entity_id not in items:
+                continue
+            user_ids.add(e.entity_id)
+            inter_u.append(e.entity_id)
+            inter_i.append(e.target_entity_id)
+            weight.append(1.0 if e.event == "view" else 2.0)
+            if e.event == "buy":
+                buy_counts[items[e.target_entity_id]] += 1
+        users = BiMap.string_int(user_ids)
+        return TrainingData(
+            users=users,
+            items=items,
+            categories=categories,
+            u_idx=users.lookup_array(inter_u),
+            i_idx=items.lookup_array(inter_i),
+            weight=np.asarray(weight, np.float32),
+            buy_counts=buy_counts,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class ECommAlgorithmParams(Params):
+    """(ECommAlgorithm.scala ECommAlgorithmParams: appName, unseenOnly,
+    seenEvents, similarEvents, rank, numIterations, lambda, seed)"""
+
+    app_name: str = "ecommerce"
+    unseen_only: bool = True
+    seen_events: tuple[str, ...] = ("buy", "view")
+    similar_events: tuple[str, ...] = ("view",)
+    rank: int = 16
+    num_iterations: int = 20
+    learning_rate: float = 3e-2
+    negatives_per_positive: int = 4
+    seed: Optional[int] = None
+
+
+@dataclasses.dataclass
+class ECommModel:
+    mf: TwoTowerModel
+    user_map: BiMap
+    item_map: BiMap
+    categories: dict[str, tuple[str, ...]]
+    popularity: np.ndarray  # [n_items] buy counts
+    item_vecs_norm: np.ndarray  # L2-normalized item factors for predictSimilar
+
+    def prepare_for_serving(self) -> "ECommModel":
+        self.mf.prepare_for_serving()
+        return self
+
+
+class ECommAlgorithm(PAlgorithm):
+    params_class = ECommAlgorithmParams
+    query_cls = Query
+
+    def __init__(self, params: ECommAlgorithmParams):
+        super().__init__(params)
+        self._levents = LEventStore()
+
+    def train(self, ctx: MeshContext, pd: TrainingData) -> ECommModel:
+        from incubator_predictionio_tpu.models.negative_sampling import sample_negatives
+
+        p = self.params
+        rng = np.random.default_rng(p.seed if p.seed is not None else 0)
+        k = p.negatives_per_positive
+        neg_u, neg_i = sample_negatives(pd.u_idx, pd.i_idx, len(pd.items), k, rng)
+        users = np.concatenate([pd.u_idx, neg_u])
+        items = np.concatenate([pd.i_idx, neg_i])
+        ratings = np.concatenate([pd.weight, np.zeros(len(neg_u), np.float32)])
+        mf = TwoTowerMF(TwoTowerConfig(
+            rank=p.rank, epochs=p.num_iterations, learning_rate=p.learning_rate,
+            batch_size=8192, seed=p.seed if p.seed is not None else 0,
+        )).fit(ctx, users, items, ratings, len(pd.users), len(pd.items))
+        norm = mf.item_emb / (np.linalg.norm(mf.item_emb, axis=1, keepdims=True) + 1e-9)
+        return ECommModel(
+            mf=mf,
+            user_map=pd.users,
+            item_map=pd.items,
+            categories=pd.categories,
+            popularity=pd.buy_counts.astype(np.float32),
+            item_vecs_norm=norm,
+        )
+
+    # -- live event-store reads (serving time) ----------------------------
+    def _unavailable_items(self) -> set[str]:
+        """Latest "constraint/unavailableItems" ``$set`` wins
+        (ECommAlgorithm.scala:150-180)."""
+        try:
+            events = list(self._levents.find_by_entity(
+                self.params.app_name, "constraint", "unavailableItems",
+                event_names=("$set",), limit=1, latest=True,
+            ))
+        except ValueError:
+            return set()
+        if not events:
+            return set()
+        return set(events[0].properties.get("items") or ())
+
+    def _seen_items(self, user: str) -> set[str]:
+        """User's view/buy history (ECommAlgorithm.scala:429-470)."""
+        try:
+            return {
+                e.target_entity_id
+                for e in self._levents.find_by_entity(
+                    self.params.app_name, "user", user,
+                    event_names=tuple(self.params.seen_events),
+                    target_entity_type="item",
+                )
+                if e.target_entity_id
+            }
+        except ValueError:
+            return set()
+
+    def _recent_similar_items(self, user: str, limit: int = 10) -> list[str]:
+        """User's recent view targets for predictSimilar (:505-530)."""
+        try:
+            return [
+                e.target_entity_id
+                for e in self._levents.find_by_entity(
+                    self.params.app_name, "user", user,
+                    event_names=tuple(self.params.similar_events),
+                    target_entity_type="item", limit=limit, latest=True,
+                )
+                if e.target_entity_id
+            ]
+        except ValueError:
+            return []
+
+    # -- masking ----------------------------------------------------------
+    def _mask(self, model: ECommModel, query: Query) -> np.ndarray:
+        n = len(model.item_map)
+        mask = np.zeros(n, np.float32)
+        if query.white_list is not None:
+            allowed = model.item_map.lookup_array(query.white_list)
+            white = np.full(n, -np.inf, np.float32)
+            white[allowed[allowed >= 0]] = 0.0
+            mask += white
+        for item in (query.black_list or ()):
+            idx = model.item_map.get(item)
+            if idx is not None:
+                mask[idx] = -np.inf
+        if query.categories is not None:
+            wanted = set(query.categories)
+            for iid, idx in model.item_map.items():
+                if not wanted.intersection(model.categories.get(iid, ())):
+                    mask[idx] = -np.inf
+        for item in self._unavailable_items():
+            idx = model.item_map.get(item)
+            if idx is not None:
+                mask[idx] = -np.inf
+        if self.params.unseen_only:
+            for item in self._seen_items(query.user):
+                idx = model.item_map.get(item)
+                if idx is not None:
+                    mask[idx] = -np.inf
+        return mask
+
+    # -- prediction -------------------------------------------------------
+    def predict(self, model: ECommModel, query: Query) -> PredictedResult:
+        mask = self._mask(model, query)
+        uidx = model.user_map.get(query.user)
+        if uidx is not None:
+            scores = (
+                model.mf.user_emb[uidx] @ model.mf.item_emb.T
+                + model.mf.item_bias + model.mf.user_bias[uidx] + model.mf.mean
+            )
+        else:
+            recent = [model.item_map[i] for i in self._recent_similar_items(query.user)
+                      if i in model.item_map]
+            if recent:
+                logger.info("unknown user %s: predictSimilar from %d recent views",
+                            query.user, len(recent))
+                qv = model.item_vecs_norm[np.asarray(recent)]
+                scores = (qv @ model.item_vecs_norm.T).sum(axis=0)
+            else:
+                logger.info("unknown user %s: predictDefault popularity", query.user)
+                scores = model.popularity.copy()
+        scores = scores + mask
+        num = min(query.num, len(scores))
+        top = np.argpartition(-scores, num - 1)[:num]
+        top = top[np.argsort(-scores[top])]
+        inv = model.item_map.inverse()
+        return PredictedResult(tuple(
+            ItemScore(inv[int(i)], float(scores[i]))
+            for i in top if np.isfinite(scores[i])
+        ))
+
+    def batch_predict(self, model, queries):
+        return [(i, self.predict(model, q)) for i, q in queries]
+
+
+class ECommerceEngine(EngineFactory):
+    def apply(self) -> Engine:
+        return Engine(
+            DataSource,
+            IdentityPreparator,
+            {"ecomm": ECommAlgorithm, "": ECommAlgorithm},
+            FirstServing,
+        )

@@ -1,0 +1,378 @@
+"""SimilarProduct template — the scala-parallel-similarproduct counterpart.
+
+Reference behavior (examples/scala-parallel-similarproduct/multi-events-multi-algos/):
+- DataSource reads users/items ``$set`` events (items carry ``categories``)
+  plus "view" and "like"/"dislike" user→item events;
+- three algorithms behind one engine: implicit-MF on views (ALSAlgorithm.scala:61-135
+  ``ALS.trainImplicit``), item-cooccurrence counts (CooccurrenceAlgorithm.scala:51-133),
+  and signed MF on like/dislike (LikeAlgorithm.scala);
+- Query {"items": […], "num": N, "categories"?, "categoryBlackList"?,
+  "whiteList"?, "blackList"?} → items similar to the query items, filtered;
+- Serving sums scores per item across algorithms (multi-algo serving).
+
+TPU mapping: implicit MF = two-tower towers with sampled negatives; item-item
+similarity is a normalized [q, k] × [k, n] matmul + masked ``lax.top_k``;
+cooccurrence counts are one Uᵀ U MXU matmul over the binary view matrix —
+the reference's RDD self-join (CooccurrenceAlgorithm.scala:87) becomes a
+single contraction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from incubator_predictionio_tpu.core import (
+    Engine,
+    EngineFactory,
+    IdentityPreparator,
+    LServing,
+    PAlgorithm,
+    Params,
+    PDataSource,
+    SanityCheck,
+)
+from incubator_predictionio_tpu.data.bimap import BiMap
+from incubator_predictionio_tpu.data.store import PEventStore
+from incubator_predictionio_tpu.models.two_tower import TwoTowerConfig, TwoTowerMF
+from incubator_predictionio_tpu.parallel.mesh import MeshContext
+
+logger = logging.getLogger(__name__)
+
+
+# -- query / result ---------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Query:
+    items: tuple[str, ...]
+    num: int = 10
+    categories: Optional[tuple[str, ...]] = None
+    category_black_list: Optional[tuple[str, ...]] = None
+    white_list: Optional[tuple[str, ...]] = None
+    black_list: Optional[tuple[str, ...]] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class ItemScore:
+    item: str
+    score: float
+
+
+@dataclasses.dataclass(frozen=True)
+class PredictedResult:
+    item_scores: tuple[ItemScore, ...] = ()
+
+
+# -- data source ------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str = "similarproduct"
+
+
+@dataclasses.dataclass
+class TrainingData(SanityCheck):
+    users: BiMap                       # user id ↔ index
+    items: BiMap                       # item id ↔ index
+    categories: dict[str, tuple[str, ...]]   # item id → categories
+    view_u: np.ndarray                 # [n_views] user idx
+    view_i: np.ndarray                 # [n_views] item idx
+    like_u: np.ndarray                 # [n_likes] user idx
+    like_i: np.ndarray                 # [n_likes] item idx
+    like_sign: np.ndarray              # [n_likes] +1 like / -1 dislike
+
+    def sanity_check(self) -> None:
+        if len(self.items) == 0:
+            raise ValueError("no items found ($set events on entityType 'item')")
+        if len(self.view_u) == 0 and len(self.like_u) == 0:
+            raise ValueError("no view/like events found")
+
+
+class DataSource(PDataSource):
+    params_class = DataSourceParams
+
+    def __init__(self, params: DataSourceParams):
+        super().__init__(params)
+        self._store = PEventStore()
+
+    def read_training(self, ctx: MeshContext) -> TrainingData:
+        app = self.params.app_name
+        # item properties → catalog + categories (DataSource.scala itemsRDD)
+        item_props = self._store.aggregate_properties(app, "item")
+        items = BiMap.string_int(item_props.keys())
+        categories = {
+            iid: tuple(pm.get("categories") or ()) for iid, pm in item_props.items()
+        }
+        user_props = self._store.aggregate_properties(app, "user")
+        view_events, like_u, like_i, like_sign = [], [], [], []
+        user_ids = set(user_props.keys())
+        for e in self._store.find(
+            app, entity_type="user", event_names=("view", "like", "dislike"),
+            target_entity_type="item",
+        ):
+            user_ids.add(e.entity_id)
+            if e.target_entity_id not in items:
+                continue  # events referencing unknown items are dropped
+            if e.event == "view":
+                view_events.append((e.entity_id, e.target_entity_id))
+            else:
+                like_u.append(e.entity_id)
+                like_i.append(e.target_entity_id)
+                like_sign.append(1.0 if e.event == "like" else -1.0)
+        users = BiMap.string_int(user_ids)
+        view_u = users.lookup_array([u for u, _ in view_events])
+        view_i = items.lookup_array([i for _, i in view_events])
+        return TrainingData(
+            users=users,
+            items=items,
+            categories=categories,
+            view_u=view_u,
+            view_i=view_i,
+            like_u=users.lookup_array(like_u),
+            like_i=items.lookup_array(like_i),
+            like_sign=np.asarray(like_sign, np.float32),
+        )
+
+
+# -- shared model + filtering ----------------------------------------------
+
+@dataclasses.dataclass
+class ItemSimModel:
+    """Normalized item vectors + catalog metadata for similarity scoring."""
+
+    item_vecs: np.ndarray            # [n_items, k] L2-normalized
+    item_map: BiMap
+    categories: dict[str, tuple[str, ...]]
+
+    _device_vt = None
+
+    def prepare_for_serving(self) -> "ItemSimModel":
+        self._device_vt = jax.device_put(np.ascontiguousarray(self.item_vecs.T))
+        return self
+
+
+def _category_mask(model: ItemSimModel, query: Query) -> np.ndarray:
+    """-inf mask implementing whitelist/blacklist/category filters + query-item
+    exclusion (reference isCandidateItem, ALSAlgorithm.scala:200-230)."""
+    n = len(model.item_map)
+    mask = np.zeros(n, np.float32)
+    if query.white_list is not None:
+        allowed = model.item_map.lookup_array(query.white_list)
+        white = np.full(n, -np.inf, np.float32)
+        white[allowed[allowed >= 0]] = 0.0
+        mask += white
+    for black in (query.black_list or ()):
+        idx = model.item_map.get(black)
+        if idx is not None:
+            mask[idx] = -np.inf
+    if query.categories is not None:
+        wanted = set(query.categories)
+        for iid, idx in model.item_map.items():
+            if not wanted.intersection(model.categories.get(iid, ())):
+                mask[idx] = -np.inf
+    if query.category_black_list is not None:
+        banned = set(query.category_black_list)
+        for iid, idx in model.item_map.items():
+            if banned.intersection(model.categories.get(iid, ())):
+                mask[idx] = -np.inf
+    for qi in query.items:  # exclude the query items themselves
+        idx = model.item_map.get(qi)
+        if idx is not None:
+            mask[idx] = -np.inf
+    return mask
+
+
+@jax.jit
+def _sim_scores(qvecs, item_vt, mask):
+    scores = (
+        (qvecs.astype(jnp.bfloat16) @ item_vt.astype(jnp.bfloat16)).astype(jnp.float32)
+    )
+    return scores.sum(axis=0) + mask
+
+
+def _similar_items(model: ItemSimModel, query: Query) -> PredictedResult:
+    known = [model.item_map[i] for i in query.items if i in model.item_map]
+    if not known:
+        return PredictedResult()
+    if model._device_vt is None:
+        model.prepare_for_serving()
+    qvecs = jnp.asarray(model.item_vecs[np.asarray(known)])
+    scores = np.asarray(_sim_scores(qvecs, model._device_vt, jnp.asarray(_category_mask(model, query))))
+    num = min(query.num, len(scores))
+    top = np.argpartition(-scores, num - 1)[:num]
+    top = top[np.argsort(-scores[top])]
+    inv = model.item_map.inverse()
+    return PredictedResult(tuple(
+        ItemScore(inv[int(i)], float(scores[i]))
+        for i in top if np.isfinite(scores[i])
+    ))
+
+
+def _l2_normalize(v: np.ndarray) -> np.ndarray:
+    return v / (np.linalg.norm(v, axis=1, keepdims=True) + 1e-9)
+
+
+# -- algorithms -------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class ALSAlgorithmParams(Params):
+    rank: int = 16
+    num_iterations: int = 20
+    learning_rate: float = 3e-2
+    negatives_per_positive: int = 4
+    seed: Optional[int] = None
+
+
+class ALSAlgorithm(PAlgorithm):
+    """Implicit MF on view events (ALSAlgorithm.scala:61-135
+    ``ALS.trainImplicit``) via two-tower towers + sampled negatives."""
+
+    params_class = ALSAlgorithmParams
+    query_cls = Query
+
+    def train(self, ctx: MeshContext, pd: TrainingData) -> ItemSimModel:
+        from incubator_predictionio_tpu.models.negative_sampling import sample_negatives
+
+        p = self.params
+        rng = np.random.default_rng(p.seed if p.seed is not None else 0)
+        pos_u, pos_i = pd.view_u, pd.view_i
+        k = p.negatives_per_positive
+        neg_u, neg_i = sample_negatives(pos_u, pos_i, len(pd.items), k, rng)
+        users = np.concatenate([pos_u, neg_u])
+        items = np.concatenate([pos_i, neg_i])
+        ratings = np.concatenate([
+            np.ones(len(pos_u), np.float32), np.zeros(len(neg_u), np.float32)
+        ])
+        mf = TwoTowerMF(TwoTowerConfig(
+            rank=p.rank, epochs=p.num_iterations, learning_rate=p.learning_rate,
+            batch_size=8192, seed=p.seed if p.seed is not None else 0,
+        )).fit(ctx, users, items, ratings, len(pd.users), len(pd.items))
+        return ItemSimModel(
+            item_vecs=_l2_normalize(mf.item_emb),
+            item_map=pd.items,
+            categories=pd.categories,
+        )
+
+    def predict(self, model: ItemSimModel, query: Query) -> PredictedResult:
+        return _similar_items(model, query)
+
+    def batch_predict(self, model, queries):
+        return [(i, self.predict(model, q)) for i, q in queries]
+
+
+class LikeAlgorithm(ALSAlgorithm):
+    """Signed MF on like/dislike (LikeAlgorithm.scala: like=+1, dislike=-1;
+    later event for the same (user, item) wins in the reference — here all
+    signals contribute, which is the same MF objective up to weighting)."""
+
+    def train(self, ctx: MeshContext, pd: TrainingData) -> ItemSimModel:
+        p = self.params
+        if len(pd.like_u) == 0:
+            raise ValueError("LikeAlgorithm requires like/dislike events")
+        mf = TwoTowerMF(TwoTowerConfig(
+            rank=p.rank, epochs=p.num_iterations, learning_rate=p.learning_rate,
+            batch_size=8192, seed=p.seed if p.seed is not None else 0,
+        )).fit(ctx, pd.like_u, pd.like_i, pd.like_sign,
+               len(pd.users), len(pd.items))
+        return ItemSimModel(
+            item_vecs=_l2_normalize(mf.item_emb),
+            item_map=pd.items,
+            categories=pd.categories,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class CooccurrenceAlgorithmParams(Params):
+    n: int = 20  # top co-occurring items kept per item (CooccurrenceAlgorithm.scala:27)
+
+
+@dataclasses.dataclass
+class CooccurrenceModel:
+    top_cooccurrences: dict[int, list[tuple[int, int]]]  # item → [(item, count)]
+    item_map: BiMap
+    categories: dict[str, tuple[str, ...]]
+
+
+class CooccurrenceAlgorithm(PAlgorithm):
+    """Item co-view counts (CooccurrenceAlgorithm.scala:51-133). The RDD
+    self-join becomes Uᵀ U on the device: U is the binary user×item view
+    matrix, so one bf16 matmul yields every pairwise co-count."""
+
+    params_class = CooccurrenceAlgorithmParams
+    query_cls = Query
+
+    def train(self, ctx: MeshContext, pd: TrainingData) -> CooccurrenceModel:
+        n_users, n_items = len(pd.users), len(pd.items)
+        u = np.zeros((n_users, n_items), np.float32)
+        u[pd.view_u, pd.view_i] = 1.0  # de-duplicated views
+        cooc = np.array(_cooccur(jnp.asarray(u)))  # copy: jax buffers are read-only
+        np.fill_diagonal(cooc, 0)
+        top_n = self.params.n
+        top: dict[int, list[tuple[int, int]]] = {}
+        for i in range(n_items):
+            row = cooc[i]
+            nz = np.nonzero(row)[0]
+            if len(nz) == 0:
+                continue
+            order = nz[np.argsort(-row[nz])][:top_n]
+            top[i] = [(int(j), int(row[j])) for j in order]
+        return CooccurrenceModel(top, pd.items, pd.categories)
+
+    def predict(self, model: CooccurrenceModel, query: Query) -> PredictedResult:
+        counts: dict[int, int] = {}
+        for qi in query.items:
+            idx = model.item_map.get(qi)
+            if idx is None:
+                continue
+            for j, c in model.top_cooccurrences.get(idx, ()):
+                counts[j] = counts.get(j, 0) + c
+        sim_model = ItemSimModel(np.zeros((len(model.item_map), 1)), model.item_map,
+                                 model.categories)
+        mask = _category_mask(sim_model, query)
+        scored = [
+            (j, c) for j, c in counts.items() if np.isfinite(mask[j])
+        ]
+        scored.sort(key=lambda t: -t[1])
+        inv = model.item_map.inverse()
+        return PredictedResult(tuple(
+            ItemScore(inv[j], float(c)) for j, c in scored[: query.num]
+        ))
+
+    def batch_predict(self, model, queries):
+        return [(i, self.predict(model, q)) for i, q in queries]
+
+
+@jax.jit
+def _cooccur(u):
+    return (u.T.astype(jnp.bfloat16) @ u.astype(jnp.bfloat16)).astype(jnp.float32)
+
+
+# -- serving ----------------------------------------------------------------
+
+class Serving(LServing):
+    """Multi-algo: sum scores per item across algorithm outputs
+    (multi-events-multi-algos Serving.scala: standardize-free sum variant)."""
+
+    def serve(self, query: Query, predictions: Sequence[PredictedResult]) -> PredictedResult:
+        combined: dict[str, float] = {}
+        for pred in predictions:
+            for s in pred.item_scores:
+                combined[s.item] = combined.get(s.item, 0.0) + s.score
+        top = sorted(combined.items(), key=lambda t: -t[1])[: query.num]
+        return PredictedResult(tuple(ItemScore(i, sc) for i, sc in top))
+
+
+class SimilarProductEngine(EngineFactory):
+    def apply(self) -> Engine:
+        return Engine(
+            DataSource,
+            IdentityPreparator,
+            {"als": ALSAlgorithm, "cooccurrence": CooccurrenceAlgorithm,
+             "likealgo": LikeAlgorithm, "": ALSAlgorithm},
+            {"": Serving},
+        )

@@ -1,0 +1,159 @@
+"""E-commerce template: personalized recs + live business rules at serving time."""
+
+import datetime as dt
+
+import numpy as np
+import pytest
+
+from incubator_predictionio_tpu.core import EngineParams
+from incubator_predictionio_tpu.data import DataMap, Event
+from incubator_predictionio_tpu.data.storage import App, Storage, use_storage
+from incubator_predictionio_tpu.parallel.mesh import MeshContext
+from incubator_predictionio_tpu.templates.ecommerce import (
+    DataSourceParams,
+    ECommAlgorithmParams,
+    ECommerceEngine,
+    Query,
+)
+
+UTC = dt.timezone.utc
+N_USERS, N_ITEMS = 16, 10
+
+
+@pytest.fixture(scope="module")
+def env():
+    s = Storage({"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+    app_id = s.get_meta_data_apps().insert(App(0, "ec-test"))
+    events = s.get_events()
+    events.init(app_id)
+    t0 = dt.datetime(2020, 1, 1, tzinfo=UTC)
+    rng = np.random.default_rng(11)
+    for i in range(N_ITEMS):
+        events.insert(Event(
+            event="$set", entity_type="item", entity_id=f"i{i}",
+            properties=DataMap({"categories": ["even" if i % 2 == 0 else "odd"]}),
+            event_time=t0), app_id)
+    for u in range(N_USERS):
+        for i in range(N_ITEMS):
+            if (u % 2) == (i % 2) and rng.random() < 0.8:
+                events.insert(Event(
+                    event="view", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item", target_entity_id=f"i{i}",
+                    event_time=t0 + dt.timedelta(seconds=u * 50 + i)), app_id)
+                if rng.random() < 0.5:
+                    events.insert(Event(
+                        event="buy", entity_type="user", entity_id=f"u{u}",
+                        target_entity_type="item", target_entity_id=f"i{i}",
+                        event_time=t0 + dt.timedelta(seconds=10000 + u * 50 + i)),
+                        app_id)
+    yield s, app_id
+    s.close()
+
+
+@pytest.fixture(scope="module")
+def trained(env):
+    s, app_id = env
+    prev = use_storage(s)
+    try:
+        ctx = MeshContext.create()
+        engine = ECommerceEngine().apply()
+        params = EngineParams.create(
+            data_source=DataSourceParams(app_name="ec-test"),
+            algorithms=[("ecomm", ECommAlgorithmParams(
+                app_name="ec-test", rank=8, num_iterations=120,
+                learning_rate=5e-2, unseen_only=False))],
+        )
+        models = engine.train(ctx, params)
+        algos, serving = engine.serving_and_algorithms(params)
+        yield engine, params, models[0], algos[0], serving
+    finally:
+        use_storage(prev)
+
+
+def test_known_user_personalized(env, trained):
+    s, _ = env
+    prev = use_storage(s)
+    try:
+        _, _, model, algo, serving = trained
+        pred = serving.serve(Query(user="u0", num=4),
+                             [algo.predict(model, Query(user="u0", num=4))])
+        assert len(pred.item_scores) == 4
+        evens = sum(1 for sc in pred.item_scores if int(sc.item[1:]) % 2 == 0)
+        assert evens >= 3, [sc.item for sc in pred.item_scores]
+    finally:
+        use_storage(prev)
+
+
+def test_unseen_only_filters_history(env, trained):
+    s, _ = env
+    prev = use_storage(s)
+    try:
+        _, _, model, algo, _ = trained
+        algo.params = ECommAlgorithmParams(
+            app_name="ec-test", unseen_only=True)
+        seen = algo._seen_items("u0")
+        assert seen  # u0 viewed/bought things
+        pred = algo.predict(model, Query(user="u0", num=10))
+        assert not seen.intersection({sc.item for sc in pred.item_scores})
+    finally:
+        use_storage(prev)
+
+
+def test_unavailable_items_constraint_live(env, trained):
+    s, app_id = env
+    prev = use_storage(s)
+    try:
+        _, _, model, algo, _ = trained
+        # push a live constraint: i0, i2 unavailable ($set on constraint entity)
+        s.get_events().insert(Event(
+            event="$set", entity_type="constraint", entity_id="unavailableItems",
+            properties=DataMap({"items": ["i0", "i2"]}),
+            event_time=dt.datetime.now(UTC)), app_id)
+        pred = algo.predict(model, Query(user="u0", num=10))
+        items = {sc.item for sc in pred.item_scores}
+        assert not items.intersection({"i0", "i2"})
+        # a later $set replaces the constraint entirely (latest wins)
+        s.get_events().insert(Event(
+            event="$set", entity_type="constraint", entity_id="unavailableItems",
+            properties=DataMap({"items": []}),
+            event_time=dt.datetime.now(UTC) + dt.timedelta(seconds=1)), app_id)
+        assert algo._unavailable_items() == set()
+    finally:
+        use_storage(prev)
+
+
+def test_unknown_user_fallbacks(env, trained):
+    s, app_id = env
+    prev = use_storage(s)
+    try:
+        _, _, model, algo, _ = trained
+        # cold user with no history → popularity fallback
+        pred = algo.predict(model, Query(user="coldstart", num=3))
+        assert len(pred.item_scores) == 3
+        pops = [sc.score for sc in pred.item_scores]
+        assert pops == sorted(pops, reverse=True)
+        # cold user with recent views → predictSimilar to those views
+        s.get_events().insert(Event(
+            event="view", entity_type="user", entity_id="warmish",
+            target_entity_type="item", target_entity_id="i0",
+            event_time=dt.datetime.now(UTC)), app_id)
+        pred = algo.predict(model, Query(user="warmish", num=4))
+        evens = sum(1 for sc in pred.item_scores if int(sc.item[1:]) % 2 == 0)
+        assert evens >= 3, [sc.item for sc in pred.item_scores]
+    finally:
+        use_storage(prev)
+
+
+def test_category_and_list_filters(env, trained):
+    s, _ = env
+    prev = use_storage(s)
+    try:
+        _, _, model, algo, _ = trained
+        pred = algo.predict(model, Query(user="u0", num=10, categories=("odd",)))
+        assert all(int(sc.item[1:]) % 2 == 1 for sc in pred.item_scores)
+        pred = algo.predict(model, Query(user="u0", num=10, white_list=("i4",)))
+        assert {sc.item for sc in pred.item_scores} <= {"i4"}
+        pred = algo.predict(model, Query(user="u0", num=10, black_list=("i0",)))
+        assert "i0" not in {sc.item for sc in pred.item_scores}
+    finally:
+        use_storage(prev)

@@ -1,0 +1,147 @@
+"""SimilarProduct template: implicit MF similarity, cooccurrence, filters, multi-algo serving."""
+
+import datetime as dt
+
+import numpy as np
+import pytest
+
+from incubator_predictionio_tpu.core import EngineParams, doer
+from incubator_predictionio_tpu.data import DataMap, Event
+from incubator_predictionio_tpu.data.storage import App, Storage, use_storage
+from incubator_predictionio_tpu.parallel.mesh import MeshContext
+from incubator_predictionio_tpu.templates.similarproduct import (
+    ALSAlgorithmParams,
+    CooccurrenceAlgorithm,
+    CooccurrenceAlgorithmParams,
+    DataSource,
+    DataSourceParams,
+    Query,
+    SimilarProductEngine,
+)
+
+UTC = dt.timezone.utc
+N_USERS, N_ITEMS = 20, 12
+
+
+@pytest.fixture(scope="module")
+def storage():
+    """Even users view even items, odd view odd; items carry parity categories."""
+    s = Storage({"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+    app_id = s.get_meta_data_apps().insert(App(0, "sp-test"))
+    events = s.get_events()
+    events.init(app_id)
+    t0 = dt.datetime(2020, 1, 1, tzinfo=UTC)
+    rng = np.random.default_rng(5)
+    for i in range(N_ITEMS):
+        events.insert(Event(
+            event="$set", entity_type="item", entity_id=f"i{i}",
+            properties=DataMap({"categories": ["even" if i % 2 == 0 else "odd"]}),
+            event_time=t0), app_id)
+    for u in range(N_USERS):
+        events.insert(Event(event="$set", entity_type="user", entity_id=f"u{u}",
+                            properties=DataMap({"sign": "x"}), event_time=t0), app_id)
+        for i in range(N_ITEMS):
+            if (u % 2) == (i % 2) and rng.random() < 0.8:
+                events.insert(Event(
+                    event="view", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item", target_entity_id=f"i{i}",
+                    event_time=t0 + dt.timedelta(seconds=u * 50 + i)), app_id)
+            if (u % 2) == (i % 2) and rng.random() < 0.3:
+                events.insert(Event(
+                    event="like", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item", target_entity_id=f"i{i}",
+                    event_time=t0 + dt.timedelta(seconds=3000 + u * 50 + i)), app_id)
+    yield s
+    s.close()
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return MeshContext.create()
+
+
+def test_datasource_reads_catalog_and_events(storage, ctx):
+    prev = use_storage(storage)
+    try:
+        td = doer(DataSource, DataSourceParams(app_name="sp-test")).read_training(ctx)
+        assert len(td.items) == N_ITEMS and len(td.users) == N_USERS
+        assert td.categories["i0"] == ("even",)
+        assert len(td.view_u) > 50
+        assert (td.like_sign == 1.0).all()
+    finally:
+        use_storage(prev)
+
+
+def test_als_similarity_respects_structure_and_filters(storage, ctx):
+    prev = use_storage(storage)
+    try:
+        engine = SimilarProductEngine().apply()
+        params = EngineParams.create(
+            data_source=DataSourceParams(app_name="sp-test"),
+            algorithms=[("als", ALSAlgorithmParams(rank=8, num_iterations=150,
+                                                   learning_rate=5e-2))],
+        )
+        [model] = engine.train(ctx, params)
+        algos, serving = engine.serving_and_algorithms(params)
+        pred = algos[0].predict(model, Query(items=("i0",), num=4))
+        assert len(pred.item_scores) == 4
+        assert "i0" not in [s.item for s in pred.item_scores]  # query item excluded
+        evens = sum(1 for s in pred.item_scores if int(s.item[1:]) % 2 == 0)
+        assert evens >= 3, [s.item for s in pred.item_scores]
+        # category filter
+        pred = algos[0].predict(model, Query(items=("i0",), num=4,
+                                             categories=("odd",)))
+        assert all(int(s.item[1:]) % 2 == 1 for s in pred.item_scores)
+        # whitelist / blacklist
+        pred = algos[0].predict(model, Query(items=("i0",), num=4,
+                                             white_list=("i2", "i4")))
+        assert {s.item for s in pred.item_scores} <= {"i2", "i4"}
+        pred = algos[0].predict(model, Query(items=("i0",), num=4,
+                                             black_list=("i2",)))
+        assert "i2" not in [s.item for s in pred.item_scores]
+        # unknown query items → empty
+        assert algos[0].predict(model, Query(items=("nope",), num=4)).item_scores == ()
+    finally:
+        use_storage(prev)
+
+
+def test_cooccurrence_counts(storage, ctx):
+    prev = use_storage(storage)
+    try:
+        td = doer(DataSource, DataSourceParams(app_name="sp-test")).read_training(ctx)
+        algo = doer(CooccurrenceAlgorithm, CooccurrenceAlgorithmParams(n=5))
+        model = algo.train(ctx, td)
+        pred = algo.predict(model, Query(items=("i0",), num=4))
+        assert pred.item_scores
+        # co-viewed items share parity with i0
+        assert all(int(s.item[1:]) % 2 == 0 for s in pred.item_scores)
+        # counts descending
+        counts = [s.score for s in pred.item_scores]
+        assert counts == sorted(counts, reverse=True)
+    finally:
+        use_storage(prev)
+
+
+def test_multi_algo_serving_sums_scores(storage, ctx):
+    prev = use_storage(storage)
+    try:
+        engine = SimilarProductEngine().apply()
+        params = EngineParams.create(
+            data_source=DataSourceParams(app_name="sp-test"),
+            algorithms=[
+                ("als", ALSAlgorithmParams(rank=8, num_iterations=100,
+                                           learning_rate=5e-2)),
+                ("cooccurrence", CooccurrenceAlgorithmParams(n=5)),
+            ],
+        )
+        models = engine.train(ctx, params)
+        assert len(models) == 2
+        algos, serving = engine.serving_and_algorithms(params)
+        q = Query(items=("i0",), num=3)
+        preds = [a.predict(m, q) for a, m in zip(algos, models)]
+        combined = serving.serve(q, preds)
+        assert len(combined.item_scores) == 3
+        scores = [s.score for s in combined.item_scores]
+        assert scores == sorted(scores, reverse=True)
+    finally:
+        use_storage(prev)
