@@ -1,0 +1,214 @@
+"""Sequential-recommendation transformer (SASRec/Transformer4Rec-style).
+
+No reference counterpart exists (the reference's only sequence model is
+``e2.engine.MarkovChain``, MarkovChain.scala:25) — this is the new
+long-context capability BASELINE.md asks for: a causal transformer over
+session item sequences predicting the next item, with sequence/context
+parallelism via ring attention (parallel/ring.py) when the mesh has a
+``seq`` axis.
+
+TPU mapping:
+- tokens [B, L]: B sharded over ``data``, L over ``seq`` (when present);
+- attention: blockwise ring attention (ppermute ring over ICI) or local
+  per-device causal attention when the mesh has no seq axis;
+- matmuls in bf16 with fp32 accumulation; params fp32 replicated (weight
+  tying: output logits reuse the item embedding);
+- targets/weights precomputed on host — the next-token shift never crosses
+  shard boundaries on device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from incubator_predictionio_tpu.parallel.mesh import MeshContext
+from incubator_predictionio_tpu.parallel.ring import (
+    causal_attention_reference,
+    ring_attention_sharded,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 1024        # items + 1 (0 is padding)
+    max_len: int = 64
+    d_model: int = 64
+    n_heads: int = 2
+    n_layers: int = 2
+    learning_rate: float = 1e-3
+    batch_size: int = 256
+    epochs: int = 10
+    seed: int = 0
+    attention: str = "auto"       # "auto" | "local" | "ring"
+
+
+def _init_params(key, cfg: TransformerConfig):
+    k = iter(jax.random.split(key, 4 + 8 * cfg.n_layers))
+    d, dh = cfg.d_model, cfg.d_model * 4
+    init = lambda kk, shape, scale: jax.random.normal(kk, shape, jnp.float32) * scale
+    params = {
+        "item_emb": init(next(k), (cfg.vocab_size, d), 0.02),
+        "pos_emb": init(next(k), (cfg.max_len, d), 0.02),
+        "ln_f": {"g": jnp.ones(d), "b": jnp.zeros(d)},
+        "layers": [],
+    }
+    for _ in range(cfg.n_layers):
+        params["layers"].append({
+            "ln1": {"g": jnp.ones(d), "b": jnp.zeros(d)},
+            "wq": init(next(k), (d, d), d ** -0.5),
+            "wk": init(next(k), (d, d), d ** -0.5),
+            "wv": init(next(k), (d, d), d ** -0.5),
+            "wo": init(next(k), (d, d), d ** -0.5),
+            "ln2": {"g": jnp.ones(d), "b": jnp.zeros(d)},
+            "w1": init(next(k), (d, dh), d ** -0.5),
+            "b1": jnp.zeros(dh),
+            "w2": init(next(k), (dh, d), dh ** -0.5),
+            "b2": jnp.zeros(d),
+        })
+    return params
+
+
+def _ln(x, p):
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + 1e-6) * p["g"] + p["b"]
+
+
+def _bf16_matmul(x, w):
+    return (x.astype(jnp.bfloat16) @ w.astype(jnp.bfloat16)).astype(jnp.float32)
+
+
+def _forward(params, tokens, positions, cfg: TransformerConfig,
+             mesh=None, use_ring=False):
+    """tokens, positions: [B, L] int32 → hidden [B, L, D] fp32."""
+    h = params["item_emb"][tokens] + params["pos_emb"][positions]
+    b, l, d = h.shape
+    nh, dh = cfg.n_heads, d // cfg.n_heads
+    for layer in params["layers"]:
+        x = _ln(h, layer["ln1"])
+        q = _bf16_matmul(x, layer["wq"]).reshape(b, l, nh, dh)
+        k = _bf16_matmul(x, layer["wk"]).reshape(b, l, nh, dh)
+        v = _bf16_matmul(x, layer["wv"]).reshape(b, l, nh, dh)
+        if use_ring:
+            att = ring_attention_sharded(q, k, v, mesh)
+        else:
+            att = causal_attention_reference(q, k, v)
+        h = h + _bf16_matmul(att.reshape(b, l, d), layer["wo"])
+        x = _ln(h, layer["ln2"])
+        x = jax.nn.gelu(_bf16_matmul(x, layer["w1"]) + layer["b1"])
+        h = h + _bf16_matmul(x, layer["w2"]) + layer["b2"]
+    return _ln(h, params["ln_f"])
+
+
+@dataclasses.dataclass
+class TransformerModel:
+    params: dict
+    item_map: object  # BiMap item id ↔ token (token 0 = padding)
+    config: TransformerConfig
+
+    def prepare_for_serving(self) -> "TransformerModel":
+        self.params = jax.device_put(self.params)
+        return self
+
+
+class TransformerRecommender:
+    def __init__(self, config: TransformerConfig):
+        self.config = config
+
+    def _use_ring(self, ctx: MeshContext) -> bool:
+        if self.config.attention == "ring":
+            return True
+        if self.config.attention == "local":
+            return False
+        return "seq" in ctx.mesh.shape and ctx.axis_size("seq") > 1
+
+    def fit(self, ctx: MeshContext, sequences: np.ndarray, item_map) -> "TransformerModel":
+        """sequences: [N, max_len+1] int32 token rows (0-padded *left*), each
+        row a session; position t predicts position t+1."""
+        cfg = self.config
+        use_ring = self._use_ring(ctx)
+        tokens = sequences[:, :-1]
+        targets = sequences[:, 1:]
+        weights = (targets != 0).astype(np.float32) * (tokens != 0).astype(np.float32)
+        n, l = tokens.shape
+        if l != cfg.max_len:
+            raise ValueError(f"sequences must be max_len+1 = {cfg.max_len + 1} wide")
+        positions = np.broadcast_to(np.arange(l, dtype=np.int32), (n, l))
+
+        global_batch = ctx.pad_to_batch_multiple(min(cfg.batch_size, max(n, 1)))
+        n_batches = max(1, (n + global_batch - 1) // global_batch)
+        n_pad = n_batches * global_batch
+        pad = n_pad - n
+
+        def stage(a, fill=0):
+            a = np.concatenate([a, np.full((pad, *a.shape[1:]), fill, a.dtype)])
+            a = a.reshape(n_batches, global_batch, *a.shape[1:])
+            seq_axis = "seq" if use_ring else None
+            return jax.device_put(
+                a, ctx.sharding(None, ctx.data_axis, seq_axis)
+            )
+
+        tb = stage(tokens.astype(np.int32))
+        pb = stage(positions.astype(np.int32))
+        yb = stage(targets.astype(np.int32))
+        wb = stage(weights.astype(np.float32))
+
+        params = ctx.replicate(_init_params(jax.random.key(cfg.seed), cfg))
+        tx = optax.adam(cfg.learning_rate)
+        opt_state = tx.init(params)
+        mesh = ctx.mesh
+
+        def loss_fn(p, bt, bp, by, bw):
+            h = _forward(p, bt, bp, cfg, mesh, use_ring)
+            logits = _bf16_matmul(h, p["item_emb"].T)
+            ls = optax.softmax_cross_entropy_with_integer_labels(logits, by)
+            return jnp.sum(ls * bw) / jnp.maximum(jnp.sum(bw), 1.0)
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def train_epoch(p, o):
+            def step(carry, batch):
+                p, o = carry
+                loss, grads = jax.value_and_grad(loss_fn)(p, *batch)
+                updates, o = tx.update(grads, o, p)
+                return (optax.apply_updates(p, updates), o), loss
+
+            (p, o), losses = jax.lax.scan(step, (p, o), (tb, pb, yb, wb))
+            return p, o, losses.mean()
+
+        sync_every = 1 if ctx.mesh.devices.flat[0].platform == "cpu" else 8
+        loss = None
+        for e in range(cfg.epochs):
+            params, opt_state, loss = train_epoch(params, opt_state)
+            if (e + 1) % sync_every == 0:
+                loss.block_until_ready()
+
+        model = TransformerModel(jax.tree.map(np.asarray, params), item_map, cfg)
+        model.final_loss = float(loss) if loss is not None else float("nan")
+        return model
+
+    # -- inference --------------------------------------------------------
+    @staticmethod
+    def next_item_scores(model: TransformerModel, history_tokens: np.ndarray) -> np.ndarray:
+        """history_tokens: [B, max_len] (left-padded) → [B, vocab] scores."""
+        cfg = model.config
+        positions = np.broadcast_to(
+            np.arange(cfg.max_len, dtype=np.int32), history_tokens.shape
+        )
+        return np.asarray(_serve_scores(
+            model.params, jnp.asarray(history_tokens), jnp.asarray(positions),
+            cfg,
+        ))
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def _serve_scores(params, tokens, positions, cfg):
+    h = _forward(params, tokens, positions, cfg)  # local attention at serving
+    last = h[:, -1, :]  # left-padded → last position holds the newest item
+    return _bf16_matmul(last, params["item_emb"].T)

@@ -1,0 +1,122 @@
+"""Ring attention — context/sequence parallelism over the mesh ``seq`` axis.
+
+The reference has no long-context machinery (SURVEY §5: N/A in the reference;
+the Transformer4Rec-style sequential template introduces it as a new
+capability). Design follows the blockwise ring-attention recipe: the sequence
+is sharded over the ``seq`` mesh axis, each device keeps its Q chunk pinned
+while K/V chunks rotate around the ring via ``ppermute`` (ICI
+neighbor-to-neighbor traffic, no all-gather), and softmax is accumulated
+online flash-style (running max / numerator / denominator, fp32 accumulators,
+bf16 QKᵀ and PV matmuls on the MXU).
+
+Causality across chunks is by chunk index: a device at ring position ``i``
+fully attends chunks ``j < i``, causally masks its own chunk, and skips
+``j > i`` (their scores are -inf; the online update is a no-op).
+
+Public entry: :func:`ring_attention` (to be called inside ``shard_map`` with
+the ``seq`` axis in scope) and :func:`ring_attention_sharded` (wraps the
+shard_map for [B, L, H, D] inputs sharded B→data, L→seq).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def _chunk_attend(q, k, v, mask, m, l, o):
+    """One online-softmax update with an extra additive mask.
+
+    q: [B, Lq, H, D]; k/v: [B, Lk, H, D]; mask: [Lq, Lk] additive (0/-inf);
+    m/l: [B, H, Lq] running max / denominator; o: [B, Lq, H, D] numerator.
+    """
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    # [B, H, Lq, Lk] scores on the MXU in bf16, accumulated fp32
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q.astype(jnp.bfloat16), k.astype(jnp.bfloat16),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    s = s + mask[None, None, :, :]
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    # guard fully-masked rows: exp(-inf - -inf) → use where
+    alpha = jnp.exp(jnp.where(jnp.isfinite(m), m - m_new, -jnp.inf))
+    p = jnp.exp(s - m_new[..., None])  # [B, H, Lq, Lk]
+    l_new = l * alpha + p.sum(axis=-1)
+    pv = jnp.einsum(
+        "bhqk,bkhd->bqhd", p.astype(jnp.bfloat16), v.astype(jnp.bfloat16),
+        preferred_element_type=jnp.float32,
+    )
+    o_new = o * alpha.transpose(0, 2, 1)[..., None] + pv
+    return m_new, l_new, o_new
+
+
+def ring_attention(q, k, v, axis_name: str, pvary_axes=None):
+    """Causal ring attention for one sequence shard (call under shard_map).
+
+    q, k, v: [B, Lc, H, D] — this device's chunk of the globally
+    length-L = Lc × axis_size sequence. Returns [B, Lc, H, D] in q's dtype.
+    ``pvary_axes``: all manual axes in scope (defaults to just ``axis_name``);
+    fresh accumulators must be marked varying over every one of them.
+    """
+    s_size = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    b, lc, h, d = q.shape
+    neg = jnp.float32(-jnp.inf)
+    causal = jnp.where(
+        jnp.arange(lc)[:, None] >= jnp.arange(lc)[None, :], 0.0, neg
+    )  # within-chunk causal mask
+    zeros = jnp.zeros((lc, lc), jnp.float32)
+
+    def body(carry, step):
+        kc, vc, m, l, o = carry
+        j = (my - step) % s_size  # origin chunk index of the K/V we now hold
+        mask = jnp.where(j == my, causal, jnp.where(j < my, zeros, neg + zeros))
+        m, l, o = _chunk_attend(q, kc, vc, mask, m, l, o)
+        kc = jax.lax.ppermute(kc, axis_name, [(i, (i + 1) % s_size) for i in range(s_size)])
+        vc = jax.lax.ppermute(vc, axis_name, [(i, (i + 1) % s_size) for i in range(s_size)])
+        return (kc, vc, m, l, o), None
+
+    # pvary: fresh accumulators must be marked varying over the manual axes,
+    # or scan rejects the carry (unvarying input vs varying output)
+    axes = tuple(pvary_axes) if pvary_axes is not None else (axis_name,)
+    m0 = jax.lax.pvary(jnp.full((b, h, lc), neg), axes)
+    l0 = jax.lax.pvary(jnp.zeros((b, h, lc), jnp.float32), axes)
+    o0 = jax.lax.pvary(jnp.zeros((b, lc, h, d), jnp.float32), axes)
+    (kc, vc, m, l, o), _ = jax.lax.scan(
+        body, (k, v, m0, l0, o0), jnp.arange(s_size)
+    )
+    del kc, vc
+    out = o / jnp.maximum(l, 1e-20).transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention_sharded(q, k, v, mesh, data_axis: str = "data",
+                           seq_axis: str = "seq"):
+    """shard_map wrapper: q/k/v [B, L, H, D] with B sharded over ``data_axis``
+    and L over ``seq_axis``."""
+    spec = P(data_axis, seq_axis, None, None)
+    fn = jax.shard_map(
+        functools.partial(ring_attention, axis_name=seq_axis,
+                          pvary_axes=mesh.axis_names),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)
+
+
+def causal_attention_reference(q, k, v):
+    """Single-device causal attention (the correctness oracle for tests)."""
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    lq = q.shape[1]
+    mask = jnp.where(jnp.arange(lq)[:, None] >= jnp.arange(lq)[None, :], 0.0,
+                     -jnp.inf)
+    s = s + mask[None, None, :, :]
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(q.dtype)

@@ -1,0 +1,220 @@
+"""Sequential recommender template — next-item prediction over session events.
+
+New capability relative to the reference (whose only sequence model is
+``e2.engine.MarkovChain``): a Transformer4Rec-style causal transformer
+(models/transformer.py) trained on per-user item sequences, with optional
+ring-attention sequence parallelism on meshes with a ``seq`` axis. The DASE
+wiring mirrors the other templates: events in, engine params from variant
+JSON, /queries.json out.
+
+Query: ``{"recent_items": [...], "num": N}`` scores the next item after an
+explicit session, or ``{"user": U, "num": N}`` reads the user's recent
+view/buy events live from the event store (LEventStore, like the ecommerce
+template's serving-time reads).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Optional, Sequence
+
+import numpy as np
+
+from incubator_predictionio_tpu.core import (
+    Engine,
+    EngineFactory,
+    FirstServing,
+    IdentityPreparator,
+    PAlgorithm,
+    Params,
+    PDataSource,
+    SanityCheck,
+)
+from incubator_predictionio_tpu.data.bimap import BiMap
+from incubator_predictionio_tpu.data.store import LEventStore, PEventStore
+from incubator_predictionio_tpu.models.transformer import (
+    TransformerConfig,
+    TransformerModel,
+    TransformerRecommender,
+)
+from incubator_predictionio_tpu.parallel.mesh import MeshContext
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class Query:
+    user: Optional[str] = None
+    recent_items: Optional[tuple[str, ...]] = None
+    num: int = 10
+
+
+@dataclasses.dataclass(frozen=True)
+class ItemScore:
+    item: str
+    score: float
+
+
+@dataclasses.dataclass(frozen=True)
+class PredictedResult:
+    item_scores: tuple[ItemScore, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str = "sequential"
+    max_len: int = 32
+    events: tuple[str, ...] = ("view", "buy")
+
+
+@dataclasses.dataclass
+class TrainingData(SanityCheck):
+    sequences: np.ndarray  # [n, max_len+1] int32 tokens, 0-padded left
+    item_map: BiMap        # item id → token (1-based; 0 = padding)
+
+    def sanity_check(self) -> None:
+        if len(self.sequences) == 0:
+            raise ValueError("no sessions found")
+
+
+def encode_session(items: Sequence[str], item_map: BiMap, width: int) -> np.ndarray:
+    """Left-pad a session's tokens to ``width`` (newest item last)."""
+    tokens = [item_map[i] for i in items if i in item_map][-width:]
+    out = np.zeros(width, np.int32)
+    if tokens:
+        out[-len(tokens):] = tokens
+    return out
+
+
+class DataSource(PDataSource):
+    params_class = DataSourceParams
+
+    def __init__(self, params: DataSourceParams):
+        super().__init__(params)
+        self._store = PEventStore()
+
+    def read_training(self, ctx: MeshContext) -> TrainingData:
+        p = self.params
+        sessions: dict[str, list[str]] = {}
+        item_ids: list[str] = []
+        for e in self._store.find(
+            p.app_name, entity_type="user", event_names=tuple(p.events),
+            target_entity_type="item",
+        ):  # find() is event-time ordered
+            sessions.setdefault(e.entity_id, []).append(e.target_entity_id)
+            item_ids.append(e.target_entity_id)
+        # token 0 reserved for padding → 1-based item tokens
+        base = BiMap.string_int(item_ids)
+        item_map = BiMap({k: v + 1 for k, v in base.items()})
+        width = p.max_len + 1
+        rows = [
+            encode_session(items, item_map, width)
+            for items in sessions.values()
+            if len(items) >= 2
+        ]
+        return TrainingData(
+            sequences=np.stack(rows) if rows else np.zeros((0, width), np.int32),
+            item_map=item_map,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerAlgorithmParams(Params):
+    app_name: str = "sequential"
+    max_len: int = 32
+    d_model: int = 64
+    n_heads: int = 2
+    n_layers: int = 2
+    learning_rate: float = 1e-3
+    batch_size: int = 256
+    epochs: int = 10
+    seed: int = 0
+    attention: str = "auto"  # "auto" | "local" | "ring"
+    recent_events: tuple[str, ...] = ("view", "buy")
+
+
+class TransformerAlgorithm(PAlgorithm):
+    params_class = TransformerAlgorithmParams
+    query_cls = Query
+
+    def __init__(self, params: TransformerAlgorithmParams):
+        super().__init__(params)
+        self._levents = LEventStore()
+
+    def train(self, ctx: MeshContext, pd: TrainingData) -> TransformerModel:
+        p = self.params
+        cfg = TransformerConfig(
+            vocab_size=len(pd.item_map) + 1,
+            max_len=p.max_len,
+            d_model=p.d_model,
+            n_heads=p.n_heads,
+            n_layers=p.n_layers,
+            learning_rate=p.learning_rate,
+            batch_size=p.batch_size,
+            epochs=p.epochs,
+            seed=p.seed,
+            attention=p.attention,
+        )
+        return TransformerRecommender(cfg).fit(ctx, pd.sequences, pd.item_map)
+
+    def _history(self, query: Query, model: TransformerModel) -> list[str]:
+        if query.recent_items is not None:
+            return list(query.recent_items)
+        if query.user is None:
+            return []
+        try:
+            events = list(self._levents.find_by_entity(
+                self.params.app_name, "user", query.user,
+                event_names=tuple(self.params.recent_events),
+                target_entity_type="item",
+                limit=model.config.max_len, latest=True,
+            ))
+        except ValueError:
+            return []
+        return [e.target_entity_id for e in reversed(events) if e.target_entity_id]
+
+    def predict(self, model: TransformerModel, query: Query) -> PredictedResult:
+        return self.batch_predict(model, [(0, query)])[0][1]
+
+    def batch_predict(
+        self, model: TransformerModel, queries: Sequence[tuple[int, Query]]
+    ) -> list[tuple[int, PredictedResult]]:
+        if not queries:
+            return []
+        histories = [self._history(q, model) for _, q in queries]
+        rows = np.stack([
+            encode_session(h, model.item_map, model.config.max_len)
+            for h in histories
+        ])
+        scores = TransformerRecommender.next_item_scores(model, rows)
+        inv = model.item_map.inverse()
+        out = []
+        for (qi, q), h, row_scores in zip(queries, histories, scores):
+            if not any(i in model.item_map for i in h):
+                out.append((qi, PredictedResult()))  # cold session
+                continue
+            s = row_scores.copy()
+            s[0] = -np.inf  # padding token
+            for i in h:     # exclude history items
+                tok = model.item_map.get(i)
+                if tok is not None:
+                    s[tok] = -np.inf
+            num = min(q.num, len(s) - 1)
+            top = np.argpartition(-s, num - 1)[:num]
+            top = top[np.argsort(-s[top])]
+            out.append((qi, PredictedResult(tuple(
+                ItemScore(inv[int(t)], float(s[t]))
+                for t in top if np.isfinite(s[t])
+            ))))
+        return out
+
+
+class SequentialEngine(EngineFactory):
+    def apply(self) -> Engine:
+        return Engine(
+            DataSource,
+            IdentityPreparator,
+            {"transformer": TransformerAlgorithm, "": TransformerAlgorithm},
+            FirstServing,
+        )

@@ -1,0 +1,139 @@
+"""Sequential template: transformer next-item prediction, local + ring attention."""
+
+import datetime as dt
+
+import numpy as np
+import pytest
+
+from incubator_predictionio_tpu.core import EngineParams, doer
+from incubator_predictionio_tpu.data import Event
+from incubator_predictionio_tpu.data.storage import App, Storage, use_storage
+from incubator_predictionio_tpu.parallel.mesh import MeshContext
+from incubator_predictionio_tpu.templates.sequential import (
+    DataSource,
+    DataSourceParams,
+    Query,
+    SequentialEngine,
+    TransformerAlgorithmParams,
+)
+
+UTC = dt.timezone.utc
+N_ITEMS = 12
+CYCLE = [f"i{j}" for j in range(N_ITEMS)]
+
+
+@pytest.fixture(scope="module")
+def storage():
+    """Sessions walk a fixed item cycle: next(i_k) = i_{k+1 mod n} — a
+    deterministic sequence pattern a causal model must pick up."""
+    s = Storage({"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+    app_id = s.get_meta_data_apps().insert(App(0, "seq-test"))
+    events = s.get_events()
+    events.init(app_id)
+    t0 = dt.datetime(2020, 1, 1, tzinfo=UTC)
+    rng = np.random.default_rng(9)
+    for u in range(48):
+        start = int(rng.integers(0, N_ITEMS))
+        length = int(rng.integers(5, 12))
+        for step in range(length):
+            events.insert(Event(
+                event="view", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item",
+                target_entity_id=CYCLE[(start + step) % N_ITEMS],
+                event_time=t0 + dt.timedelta(seconds=u * 1000 + step)), app_id)
+    yield s
+    s.close()
+
+
+def algo_params(attention="auto", epochs=60):
+    return TransformerAlgorithmParams(
+        app_name="seq-test", max_len=16, d_model=32, n_heads=2, n_layers=2,
+        learning_rate=3e-3, batch_size=64, epochs=epochs, attention=attention)
+
+
+def engine_params(attention="auto", epochs=60):
+    return EngineParams.create(
+        data_source=DataSourceParams(app_name="seq-test", max_len=16),
+        algorithms=[("transformer", algo_params(attention, epochs))],
+    )
+
+
+def test_datasource_sessions(storage):
+    prev = use_storage(storage)
+    try:
+        ctx = MeshContext.create()
+        td = doer(DataSource, DataSourceParams(app_name="seq-test", max_len=16)) \
+            .read_training(ctx)
+        assert td.sequences.shape[1] == 17
+        assert len(td.item_map) == N_ITEMS
+        assert 0 not in set(td.item_map.values())  # token 0 reserved for padding
+        # left-padding: zeros only at the front
+        row = td.sequences[0]
+        nz = np.nonzero(row)[0]
+        assert (row[nz[0]:] != 0).all()
+    finally:
+        use_storage(prev)
+
+
+def test_learns_cycle_local_attention(storage):
+    prev = use_storage(storage)
+    try:
+        ctx = MeshContext.create()  # data-parallel only
+        engine = SequentialEngine().apply()
+        [model] = engine.train(ctx, engine_params(attention="local"))
+        algos, serving = engine.serving_and_algorithms(engine_params("local"))
+        algo = algos[0]
+        hits = 0
+        for start in range(N_ITEMS):
+            hist = tuple(CYCLE[(start + j) % N_ITEMS] for j in range(4))
+            expected = CYCLE[(start + 4) % N_ITEMS]
+            pred = serving.serve(
+                Query(recent_items=hist, num=1),
+                [algo.predict(model, Query(recent_items=hist, num=1))],
+            )
+            hits += int(pred.item_scores and pred.item_scores[0].item == expected)
+        assert hits >= 10, f"cycle prediction hits {hits}/12"
+        # cold session → empty
+        assert algo.predict(model, Query(recent_items=("nope",), num=3)) \
+            .item_scores == ()
+        # history items excluded from recommendations
+        pred = algo.predict(model, Query(recent_items=tuple(CYCLE[:4]), num=12))
+        assert not set(CYCLE[:4]) & {s.item for s in pred.item_scores}
+    finally:
+        use_storage(prev)
+
+
+def test_ring_attention_training_matches(storage):
+    """Train with ring attention on a data×seq mesh; same structure learned."""
+    prev = use_storage(storage)
+    try:
+        ctx = MeshContext.create(axes={"data": 2, "seq": 4})
+        engine = SequentialEngine().apply()
+        [model] = engine.train(ctx, engine_params(attention="ring", epochs=60))
+        algos, _ = engine.serving_and_algorithms(engine_params("ring"))
+        algo = algos[0]
+        hits = 0
+        for start in range(N_ITEMS):
+            hist = tuple(CYCLE[(start + j) % N_ITEMS] for j in range(4))
+            expected = CYCLE[(start + 4) % N_ITEMS]
+            pred = algo.predict(model, Query(recent_items=hist, num=1))
+            hits += int(pred.item_scores and pred.item_scores[0].item == expected)
+        assert hits >= 10, f"ring-trained cycle hits {hits}/12"
+    finally:
+        use_storage(prev)
+
+
+def test_user_history_query(storage):
+    prev = use_storage(storage)
+    try:
+        ctx = MeshContext.create()
+        engine = SequentialEngine().apply()
+        [model] = engine.train(ctx, engine_params(attention="local", epochs=40))
+        algos, _ = engine.serving_and_algorithms(engine_params("local", 40))
+        pred = algos[0].predict(model, Query(user="u0", num=3))
+        # live history read produced scores; u0 has seen most of the tiny
+        # catalog, so after history exclusion few candidates remain
+        assert len(pred.item_scores) >= 1
+        assert algos[0].predict(model, Query(user="ghost", num=3)).item_scores == ()
+    finally:
+        use_storage(prev)
