@@ -1,0 +1,145 @@
+"""SelfCleaningDataSource — sliding event-window compaction.
+
+Behavioral parity with the reference mixin (core/SelfCleaningDataSource.scala:42-324):
+a data source can declare an ``EventWindow(duration, remove_duplicates,
+compress_properties)``; cleaning then
+
+- drops events older than ``duration`` (against the newest event's time),
+- folds each entity's ``$set``/``$unset``/``$delete`` stream into one ``$set``
+  snapshot carrying the folded properties (``compress_properties``),
+- removes exact duplicate events (``remove_duplicates``),
+
+and rewrites the store (the reference's cleanPersistedPEvents :160 /
+wipePEvents :176 pair). Used as a mixin on a DataSource or standalone via
+:func:`clean_events`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime as _dt
+import logging
+from typing import Optional
+
+from incubator_predictionio_tpu.data.aggregator import (
+    AGGREGATOR_EVENT_NAMES,
+    aggregate_properties,
+)
+from incubator_predictionio_tpu.data.event import Event
+from incubator_predictionio_tpu.data.storage.registry import Storage, get_storage
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class EventWindow:
+    """(SelfCleaningDataSource.scala:320)"""
+
+    duration: Optional[_dt.timedelta] = None
+    remove_duplicates: bool = False
+    compress_properties: bool = False
+
+
+def _dedup_key(e: Event) -> tuple:
+    return (e.event, e.entity_type, e.entity_id, e.target_entity_type,
+            e.target_entity_id, e.event_time,
+            tuple(sorted(e.properties.to_dict().items(), key=lambda t: t[0])))
+
+
+def clean_events(
+    app_id: int,
+    window: EventWindow,
+    channel_id: Optional[int] = None,
+    storage: Optional[Storage] = None,
+    now: Optional[_dt.datetime] = None,
+) -> dict[str, int]:
+    """Compact one app/channel's events; returns counters for logging/tests."""
+    storage = storage or get_storage()
+    events_store = storage.get_events()
+    all_events = list(events_store.find(app_id, channel_id))
+    if not all_events:
+        return {"kept": 0, "dropped_window": 0, "dropped_duplicates": 0,
+                "compressed": 0}
+    now = now or max(e.event_time for e in all_events)
+    cutoff = now - window.duration if window.duration else None
+
+    counters = {"dropped_window": 0, "dropped_duplicates": 0, "compressed": 0}
+    kept: list[Event] = []
+    property_events: list[Event] = []
+    seen: set[tuple] = set()
+    for e in sorted(all_events, key=lambda e: e.event_time):
+        if cutoff is not None and e.event_time < cutoff:
+            counters["dropped_window"] += 1
+            continue
+        if window.remove_duplicates:
+            key = _dedup_key(e)
+            if key in seen:
+                counters["dropped_duplicates"] += 1
+                continue
+            seen.add(key)
+        if window.compress_properties and e.event in AGGREGATOR_EVENT_NAMES:
+            property_events.append(e)
+        else:
+            kept.append(e)
+
+    if window.compress_properties and property_events:
+        by_type: dict[str, list[Event]] = {}
+        for e in property_events:
+            by_type.setdefault(e.entity_type, []).append(e)
+        for entity_type, evs in by_type.items():
+            snapshots = aggregate_properties(evs)
+            counters["compressed"] += len(evs) - len(snapshots)
+            for entity_id, pm in snapshots.items():
+                kept.append(Event(
+                    event="$set",
+                    entity_type=entity_type,
+                    entity_id=entity_id,
+                    properties=pm,
+                    event_time=pm.last_updated,
+                ))
+
+    # rewrite (wipe + reinsert, wipePEvents :176)
+    events_store.remove(app_id, channel_id)
+    events_store.init(app_id, channel_id)
+    kept.sort(key=lambda e: e.event_time)
+    events_store.insert_batch(
+        [dataclasses.replace(e, event_id=None) for e in kept], app_id, channel_id
+    )
+    counters["kept"] = len(kept)
+    logger.info("self-cleaning app %s: %s", app_id, counters)
+    return counters
+
+
+class SelfCleaningDataSource:
+    """Mixin: declare ``app_name`` and ``event_window`` on your DataSource and
+    call :meth:`clean_persisted_events` before reading
+    (SelfCleaningDataSource.scala usage pattern)."""
+
+    app_name: str
+    event_window: EventWindow = EventWindow()
+
+    def _storage(self) -> Storage:
+        return get_storage()
+
+    def clean_persisted_events(self, channel_name: Optional[str] = None) -> dict[str, int]:
+        storage = self._storage()
+        app = storage.get_meta_data_apps().get_by_name(self.app_name)
+        if app is None:
+            raise ValueError(f"Invalid app name {self.app_name}")
+        channel_id = None
+        if channel_name:
+            channels = storage.get_meta_data_channels().get_by_app_id(app.id)
+            channel = next((c for c in channels if c.name == channel_name), None)
+            if channel is None:
+                raise ValueError(f"Invalid channel name {channel_name}")
+            channel_id = channel.id
+        return clean_events(app.id, self.event_window, channel_id, storage)
+
+    def wipe(self, channel_name: Optional[str] = None) -> None:
+        """Remove and re-init the store (wipePEvents :176)."""
+        storage = self._storage()
+        app = storage.get_meta_data_apps().get_by_name(self.app_name)
+        if app is None:
+            raise ValueError(f"Invalid app name {self.app_name}")
+        storage.get_events().remove(app.id)
+        storage.get_events().init(app.id)

@@ -1,0 +1,25 @@
+"""e2 — evaluation helper library (reference e2/src/main/scala/.../e2/).
+
+Pure helpers usable by any template: categorical NaiveBayes, Markov chain,
+binary one-hot vectorizer, k-fold cross-validation. The reference versions
+are Spark-RDD helpers; these are host-side numpy (this is metadata-scale
+math; the TPU path lives in models/)."""
+
+from incubator_predictionio_tpu.e2.naive_bayes import (
+    CategoricalNaiveBayes,
+    CategoricalNaiveBayesModel,
+    LabeledPoint,
+)
+from incubator_predictionio_tpu.e2.markov_chain import MarkovChain, MarkovChainModel
+from incubator_predictionio_tpu.e2.vectorizer import BinaryVectorizer
+from incubator_predictionio_tpu.e2.cross_validation import k_fold_split
+
+__all__ = [
+    "BinaryVectorizer",
+    "CategoricalNaiveBayes",
+    "CategoricalNaiveBayesModel",
+    "LabeledPoint",
+    "MarkovChain",
+    "MarkovChainModel",
+    "k_fold_split",
+]

@@ -1,0 +1,37 @@
+"""k-fold cross-validation splitting.
+
+Behavioral parity with the reference
+(e2/.../evaluation/CrossValidation.scala:36-73 ``splitData``): fold membership
+by index mod k; returns ``[(TD, EI, [(Q, A)])]`` ready for ``read_eval``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, Sequence, TypeVar
+
+D = TypeVar("D")
+TD = TypeVar("TD")
+EI = TypeVar("EI")
+Q = TypeVar("Q")
+A = TypeVar("A")
+
+
+def k_fold_split(
+    eval_k: int,
+    dataset: Iterable[D],
+    evaluator_info: EI,
+    training_data_creator: Callable[[Sequence[D]], TD],
+    query_creator: Callable[[D], Q],
+    actual_creator: Callable[[D], A],
+) -> list[tuple[TD, EI, list[tuple[Q, A]]]]:
+    points = list(dataset)
+    folds = []
+    for fold_idx in range(eval_k):
+        training = [p for i, p in enumerate(points) if i % eval_k != fold_idx]
+        testing = [p for i, p in enumerate(points) if i % eval_k == fold_idx]
+        folds.append((
+            training_data_creator(training),
+            evaluator_info,
+            [(query_creator(d), actual_creator(d)) for d in testing],
+        ))
+    return folds

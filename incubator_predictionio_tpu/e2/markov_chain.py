@@ -1,0 +1,58 @@
+"""Markov chain over state-transition tallies.
+
+Behavioral parity with the reference (e2/.../engine/MarkovChain.scala:32-86):
+``train`` keeps each state's top-N outgoing transitions normalized by the
+state's total tally; ``predict`` propagates a current-state probability
+vector one step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class MarkovChainModel:
+    """(MarkovChain.scala:62-86): sparse transition rows, top-N per state."""
+
+    n_states: int
+    n: int
+    # state → (target indices ascending, probabilities)
+    rows: dict[int, tuple[np.ndarray, np.ndarray]]
+
+    def predict(self, current_state: Iterable[float]) -> np.ndarray:
+        current = np.asarray(list(current_state), np.float64)
+        out = np.zeros(self.n_states, np.float64)
+        for i, (idx, probs) in self.rows.items():
+            out[idx] += probs * current[i]
+        return out
+
+    def transition_matrix(self) -> np.ndarray:
+        m = np.zeros((self.n_states, self.n_states), np.float64)
+        for i, (idx, probs) in self.rows.items():
+            m[i, idx] = probs
+        return m
+
+
+class MarkovChain:
+    @staticmethod
+    def train(entries: Iterable[tuple[int, int, float]], n_states: int,
+              top_n: int) -> MarkovChainModel:
+        """``entries``: (from_state, to_state, tally) triples — the
+        CoordinateMatrix entries of the reference (MarkovChain.scala:32)."""
+        by_row: dict[int, dict[int, float]] = {}
+        for i, j, value in entries:
+            by_row.setdefault(i, {})
+            by_row[i][j] = by_row[i].get(j, 0.0) + value
+        rows = {}
+        for i, targets in by_row.items():
+            total = sum(targets.values())
+            top = sorted(targets.items(), key=lambda t: -t[1])[:top_n]
+            top.sort(key=lambda t: t[0])  # indices ascending (SparseVector form)
+            idx = np.asarray([j for j, _ in top], np.int64)
+            probs = np.asarray([v / total for _, v in top], np.float64)
+            rows[i] = (idx, probs)
+        return MarkovChainModel(n_states=n_states, n=top_n, rows=rows)

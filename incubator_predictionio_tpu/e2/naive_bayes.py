@@ -1,0 +1,89 @@
+"""Categorical Naive Bayes over string features.
+
+Behavioral parity with the reference (e2/.../engine/CategoricalNaiveBayes.scala:29-154):
+log-space priors and per-position feature likelihoods, a pluggable default
+likelihood for unseen feature values (defaults to -inf, i.e. veto), and
+``predict`` returning the argmax label.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from collections import Counter, defaultdict
+from typing import Callable, Iterable, Optional, Sequence
+
+
+@dataclasses.dataclass(frozen=True)
+class LabeledPoint:
+    """(CategoricalNaiveBayes.scala:156)"""
+
+    label: str
+    features: tuple[str, ...]
+
+
+@dataclasses.dataclass
+class CategoricalNaiveBayesModel:
+    """(CategoricalNaiveBayes.scala:87-154)"""
+
+    priors: dict[str, float]  # label → log prior
+    likelihoods: dict[str, list[dict[str, float]]]  # label → per-position {value: log p}
+
+    def log_score(
+        self,
+        point: LabeledPoint,
+        default_likelihood: Callable[[Sequence[float]], float] = lambda ls: -math.inf,
+    ) -> Optional[float]:
+        """Log score of (features, label); None when label unseen (:101-113)."""
+        if point.label not in self.priors:
+            return None
+        return self._log_score_internal(point.label, point.features, default_likelihood)
+
+    def _log_score_internal(self, label, features, default_likelihood) -> float:
+        feature_likelihoods = self.likelihoods[label]
+        score = self.priors[label]
+        for position, value in enumerate(features):
+            table = feature_likelihoods[position] if position < len(feature_likelihoods) else {}
+            if value in table:
+                score += table[value]
+            else:
+                score += default_likelihood(list(table.values()))
+        return score
+
+    def predict(self, features: Sequence[str]) -> str:
+        """Label with the highest log score (:140-152); unseen feature values
+        score -inf, vetoing the label (the reference predict's default)."""
+        best_label, best_score = None, None
+        for label in self.priors:
+            score = self._log_score_internal(
+                label, features, lambda ls: -math.inf
+            )
+            if best_score is None or score > best_score:
+                best_label, best_score = label, score
+        assert best_label is not None
+        return best_label
+
+
+class CategoricalNaiveBayes:
+    @staticmethod
+    def train(points: Iterable[LabeledPoint]) -> CategoricalNaiveBayesModel:
+        """(CategoricalNaiveBayes.scala:29-85)"""
+        points = list(points)
+        if not points:
+            raise ValueError("no labeled points")
+        n = len(points)
+        label_counts = Counter(p.label for p in points)
+        priors = {lb: math.log(c / n) for lb, c in label_counts.items()}
+        likelihoods: dict[str, list[dict[str, float]]] = {}
+        for label, count in label_counts.items():
+            positions: defaultdict[int, Counter] = defaultdict(Counter)
+            for p in points:
+                if p.label == label:
+                    for i, v in enumerate(p.features):
+                        positions[i][v] += 1
+            n_pos = max(positions) + 1 if positions else 0
+            likelihoods[label] = [
+                {v: math.log(c / count) for v, c in positions[i].items()}
+                for i in range(n_pos)
+            ]
+        return CategoricalNaiveBayesModel(priors, likelihoods)

@@ -1,0 +1,51 @@
+"""Binary one-hot vectorizer over (property, value) pairs.
+
+Behavioral parity with the reference (e2/.../engine/BinaryVectorizer.scala:26-69):
+a fixed (property, value) → column index map; ``to_binary`` sets 1.0 for each
+known pair. Output is a dense numpy vector (feature counts here are
+metadata-sized; the model layer re-shards as needed).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Mapping, Sequence
+
+import numpy as np
+
+
+class BinaryVectorizer:
+    def __init__(self, property_map: Mapping[tuple[str, str], int]):
+        self.property_map = dict(property_map)
+        self.num_features = len(self.property_map)
+        self.properties = [
+            pair for pair, _ in sorted(self.property_map.items(), key=lambda t: t[1])
+        ]
+
+    def __repr__(self) -> str:  # pragma: no cover
+        pairs = ",".join(f"({p}, {v})" for p, v in self.properties)
+        return f"BinaryVectorizer({self.num_features}): {pairs}"
+
+    def to_binary(self, pairs: Sequence[tuple[str, str]]) -> np.ndarray:
+        vec = np.zeros(self.num_features, np.float32)
+        for pair in pairs:
+            idx = self.property_map.get(pair)
+            if idx is not None:
+                vec[idx] = 1.0
+        return vec
+
+    # -- constructors (BinaryVectorizer.scala:47-68) ----------------------
+    @staticmethod
+    def from_maps(maps: Iterable[Mapping[str, str]],
+                  properties: set[str]) -> "BinaryVectorizer":
+        """Distinct (property, value) pairs restricted to ``properties``,
+        indexed in first-seen order."""
+        seen: dict[tuple[str, str], int] = {}
+        for m in maps:
+            for k, v in m.items():
+                if k in properties and (k, v) not in seen:
+                    seen[(k, v)] = len(seen)
+        return BinaryVectorizer(seen)
+
+    @staticmethod
+    def from_pairs(pairs: Sequence[tuple[str, str]]) -> "BinaryVectorizer":
+        return BinaryVectorizer({p: i for i, p in enumerate(pairs)})

@@ -122,6 +122,9 @@ class EventServer:
 
     # -- ingestion --------------------------------------------------------
     def _ingest_one(self, payload: dict, auth: AuthData) -> str:
+        from incubator_predictionio_tpu.server.plugins import apply_input_plugins
+
+        payload = apply_input_plugins(dict(payload))
         event = Event.from_json_dict(payload)
         # server assigns receipt time; client-supplied creationTime is ignored
         # (EventJson4sSupport.scala:77-78)

@@ -199,6 +199,9 @@ class QueryServer:
         self.last_serving_sec = dt
         self.avg_serving_sec += (dt - self.avg_serving_sec) / self.request_count
         result = to_jsonable(prediction)
+        from incubator_predictionio_tpu.server.plugins import apply_output_plugins
+
+        result = apply_output_plugins(self.deployed.instance, payload, result)
         if self.config.feedback:
             task = asyncio.create_task(self._send_feedback(payload, result))
             self._feedback_tasks.add(task)
@@ -253,7 +256,22 @@ class QueryServer:
         return web.json_response({"message": "Shutting down"})
 
     async def handle_plugins(self, request: web.Request) -> web.Response:
-        return web.json_response({"plugins": {"outputblockers": {}, "outputsniffers": {}}})
+        from incubator_predictionio_tpu.server.plugins import (
+            ENGINE_SERVER_PLUGINS,
+            EngineServerPlugin,
+        )
+
+        def listing(output_type):
+            return {
+                p.name: {"description": p.description, "class": type(p).__name__}
+                for p in ENGINE_SERVER_PLUGINS.values()
+                if p.output_type == output_type
+            }
+
+        return web.json_response({"plugins": {
+            "outputblockers": listing(EngineServerPlugin.OUTPUTBLOCKER),
+            "outputsniffers": listing(EngineServerPlugin.OUTPUTSNIFFER),
+        }})
 
     # -- lifecycle --------------------------------------------------------
     async def start(self) -> None:

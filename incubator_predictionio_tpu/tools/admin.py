@@ -1,0 +1,96 @@
+"""Admin REST API (experimental in the reference).
+
+Parity target: tools/admin/AdminAPI.scala:39-161 + CommandClient.scala:
+GET ``/`` status, ``/cmd/app`` CRUD used by external dashboards.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from aiohttp import web
+
+from incubator_predictionio_tpu.data.storage.base import AccessKey, App
+from incubator_predictionio_tpu.data.storage.registry import Storage, get_storage
+
+
+@dataclasses.dataclass
+class AdminConfig:
+    ip: str = "127.0.0.1"
+    port: int = 7071
+
+
+class AdminAPI:
+    def __init__(self, config: AdminConfig = AdminConfig(),
+                 storage: Optional[Storage] = None):
+        self.config = config
+        self.storage = storage or get_storage()
+
+    async def handle_root(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "alive"})
+
+    async def handle_app_list(self, request: web.Request) -> web.Response:
+        apps = self.storage.get_meta_data_apps().get_all()
+        keys = self.storage.get_meta_data_access_keys()
+        return web.json_response([
+            {"name": a.name, "id": a.id, "description": a.description,
+             "accessKeys": [k.key for k in keys.get_by_app_id(a.id)]}
+            for a in sorted(apps, key=lambda a: a.name)
+        ])
+
+    async def handle_app_new(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except Exception:  # noqa: BLE001
+            return web.json_response({"message": "invalid JSON"}, status=400)
+        name = body.get("name")
+        if not name:
+            return web.json_response({"message": "name is required"}, status=400)
+        apps = self.storage.get_meta_data_apps()
+        if apps.get_by_name(name) is not None:
+            return web.json_response(
+                {"message": f"App {name} already exists."}, status=409)
+        app_id = apps.insert(App(int(body.get("id", 0)), name, body.get("description")))
+        self.storage.get_events().init(app_id)
+        key = self.storage.get_meta_data_access_keys().insert(AccessKey("", app_id, ()))
+        return web.json_response(
+            {"name": name, "id": app_id, "accessKey": key}, status=201)
+
+    async def handle_app_delete(self, request: web.Request) -> web.Response:
+        name = request.match_info["name"]
+        apps = self.storage.get_meta_data_apps()
+        app = apps.get_by_name(name)
+        if app is None:
+            return web.json_response({"message": f"App {name} does not exist."},
+                                     status=404)
+        self.storage.get_events().remove(app.id)
+        for k in self.storage.get_meta_data_access_keys().get_by_app_id(app.id):
+            self.storage.get_meta_data_access_keys().delete(k.key)
+        apps.delete(app.id)
+        return web.json_response({"message": f"App {name} deleted."})
+
+    async def handle_app_data_delete(self, request: web.Request) -> web.Response:
+        name = request.match_info["name"]
+        app = self.storage.get_meta_data_apps().get_by_name(name)
+        if app is None:
+            return web.json_response({"message": f"App {name} does not exist."},
+                                     status=404)
+        self.storage.get_events().remove(app.id)
+        self.storage.get_events().init(app.id)
+        return web.json_response({"message": f"Removed data of app {name}."})
+
+    def make_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_get("/", self.handle_root)
+        app.router.add_get("/cmd/app", self.handle_app_list)
+        app.router.add_post("/cmd/app", self.handle_app_new)
+        app.router.add_delete("/cmd/app/{name}", self.handle_app_delete)
+        app.router.add_delete("/cmd/app/{name}/data", self.handle_app_data_delete)
+        return app
+
+
+def serve_forever(config: AdminConfig = AdminConfig(),
+                  storage: Optional[Storage] = None) -> None:
+    web.run_app(AdminAPI(config, storage).make_app(),
+                host=config.ip, port=config.port)

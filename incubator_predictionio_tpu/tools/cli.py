@@ -305,6 +305,20 @@ def cmd_batchpredict(args, storage: Storage) -> int:
     return 0
 
 
+def cmd_dashboard(args, storage: Storage) -> int:
+    from incubator_predictionio_tpu.tools.dashboard import DashboardConfig, serve_forever
+
+    serve_forever(DashboardConfig(ip=args.ip, port=args.port), storage)
+    return 0
+
+
+def cmd_adminserver(args, storage: Storage) -> int:
+    from incubator_predictionio_tpu.tools.admin import AdminConfig, serve_forever
+
+    serve_forever(AdminConfig(ip=args.ip, port=args.port), storage)
+    return 0
+
+
 def cmd_eventserver(args, storage: Storage) -> int:
     from incubator_predictionio_tpu.server.event_server import (
         EventServerConfig,
@@ -463,6 +477,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--port", type=int, default=7070)
     p.add_argument("--stats", action="store_true")
 
+    # dashboard / adminserver
+    p = sub.add_parser("dashboard")
+    p.add_argument("--ip", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=9000)
+    p = sub.add_parser("adminserver")
+    p.add_argument("--ip", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=7071)
+
     # export / import
     p = sub.add_parser("export")
     p.add_argument("--appid", type=int, required=True)
@@ -485,6 +507,8 @@ _COMMANDS = {
     "undeploy": cmd_undeploy,
     "batchpredict": cmd_batchpredict,
     "eventserver": cmd_eventserver,
+    "dashboard": cmd_dashboard,
+    "adminserver": cmd_adminserver,
     "export": cmd_export,
     "import": cmd_import,
 }

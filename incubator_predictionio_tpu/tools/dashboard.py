@@ -1,0 +1,82 @@
+"""Evaluation dashboard — lists completed evaluation instances.
+
+Parity target: tools/dashboard/Dashboard.scala:44-160 + the twirl index page:
+an HTML index of completed EvaluationInstances (newest first) with per-
+instance evaluator results served as txt/html/json.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import html
+from typing import Optional
+
+from aiohttp import web
+
+from incubator_predictionio_tpu.data.storage.registry import Storage, get_storage
+
+
+@dataclasses.dataclass
+class DashboardConfig:
+    ip: str = "127.0.0.1"
+    port: int = 9000
+
+
+class Dashboard:
+    def __init__(self, config: DashboardConfig = DashboardConfig(),
+                 storage: Optional[Storage] = None):
+        self.config = config
+        self.storage = storage or get_storage()
+
+    async def handle_index(self, request: web.Request) -> web.Response:
+        instances = self.storage.get_meta_data_evaluation_instances().get_completed()
+        rows = "".join(
+            "<tr>"
+            f"<td>{html.escape(i.id)}</td>"
+            f"<td>{html.escape(i.evaluation_class)}</td>"
+            f"<td>{i.start_time.isoformat()}</td>"
+            f"<td>{i.end_time.isoformat() if i.end_time else ''}</td>"
+            f"<td>{html.escape(i.evaluator_results)}</td>"
+            f"<td><a href='/engine_instances/{i.id}/evaluator_results.txt'>txt</a> "
+            f"<a href='/engine_instances/{i.id}/evaluator_results.html'>html</a> "
+            f"<a href='/engine_instances/{i.id}/evaluator_results.json'>json</a></td>"
+            "</tr>"
+            for i in instances
+        )
+        page = (
+            "<html><head><title>Evaluation Dashboard</title></head><body>"
+            "<h1>Completed Evaluations</h1>"
+            "<table border=1><tr><th>ID</th><th>Evaluation</th><th>Started</th>"
+            f"<th>Finished</th><th>Result</th><th>Details</th></tr>{rows}</table>"
+            "</body></html>"
+        )
+        return web.Response(text=page, content_type="text/html")
+
+    async def handle_results(self, request: web.Request) -> web.Response:
+        instance_id = request.match_info["instance_id"]
+        fmt = request.match_info["fmt"]
+        inst = self.storage.get_meta_data_evaluation_instances().get(instance_id)
+        if inst is None:
+            return web.json_response({"message": "Not Found"}, status=404)
+        if fmt == "txt":
+            return web.Response(text=inst.evaluator_results, content_type="text/plain")
+        if fmt == "html":
+            return web.Response(text=inst.evaluator_results_html,
+                                content_type="text/html")
+        return web.Response(text=inst.evaluator_results_json,
+                            content_type="application/json")
+
+    def make_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_get("/", self.handle_index)
+        app.router.add_get(
+            "/engine_instances/{instance_id}/evaluator_results.{fmt:txt|html|json}",
+            self.handle_results,
+        )
+        return app
+
+
+def serve_forever(config: DashboardConfig = DashboardConfig(),
+                  storage: Optional[Storage] = None) -> None:
+    web.run_app(Dashboard(config, storage).make_app(),
+                host=config.ip, port=config.port)

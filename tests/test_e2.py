@@ -1,0 +1,104 @@
+"""e2 helper library (parity: e2 module specs in the reference)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from incubator_predictionio_tpu.e2 import (
+    BinaryVectorizer,
+    CategoricalNaiveBayes,
+    LabeledPoint,
+    MarkovChain,
+    k_fold_split,
+)
+
+
+class TestCategoricalNaiveBayes:
+    POINTS = [
+        LabeledPoint("spam", ("free", "money")),
+        LabeledPoint("spam", ("free", "offer")),
+        LabeledPoint("ham", ("meeting", "money")),
+        LabeledPoint("ham", ("meeting", "notes")),
+    ]
+
+    def test_train_and_predict(self):
+        model = CategoricalNaiveBayes.train(self.POINTS)
+        assert model.predict(("free", "offer")) == "spam"
+        assert model.predict(("meeting", "notes")) == "ham"
+
+    def test_priors_and_likelihoods(self):
+        model = CategoricalNaiveBayes.train(self.POINTS)
+        assert model.priors["spam"] == pytest.approx(math.log(0.5))
+        assert model.likelihoods["spam"][0]["free"] == pytest.approx(math.log(1.0))
+        assert model.likelihoods["ham"][1]["money"] == pytest.approx(math.log(0.5))
+
+    def test_log_score_unseen(self):
+        model = CategoricalNaiveBayes.train(self.POINTS)
+        assert model.log_score(LabeledPoint("nope", ("free",))) is None
+        # unseen feature value with default -inf likelihood
+        s = model.log_score(LabeledPoint("spam", ("free", "unknownword")))
+        assert s == -math.inf
+        s2 = model.log_score(
+            LabeledPoint("spam", ("free", "unknownword")),
+            default_likelihood=lambda ls: math.log(1e-3),
+        )
+        assert math.isfinite(s2)
+
+
+class TestMarkovChain:
+    def test_top_n_normalization(self):
+        # state 0 → 1 (3), → 2 (1); state 1 → 2 (2)
+        model = MarkovChain.train([(0, 1, 3.0), (0, 2, 1.0), (1, 2, 2.0)],
+                                  n_states=3, top_n=2)
+        m = model.transition_matrix()
+        assert m[0, 1] == pytest.approx(0.75)
+        assert m[0, 2] == pytest.approx(0.25)
+        assert m[1, 2] == pytest.approx(1.0)
+
+    def test_top_n_truncation(self):
+        model = MarkovChain.train(
+            [(0, j, float(j + 1)) for j in range(5)], n_states=5, top_n=2)
+        idx, probs = model.rows[0]
+        assert list(idx) == [3, 4]  # two largest tallies, index-sorted
+        assert probs.sum() == pytest.approx((4 + 5) / 15)
+
+    def test_predict_propagates(self):
+        model = MarkovChain.train([(0, 1, 1.0), (1, 0, 1.0)], n_states=2, top_n=1)
+        out = model.predict([1.0, 0.0])
+        assert out.tolist() == [0.0, 1.0]
+
+
+class TestBinaryVectorizer:
+    def test_from_maps_and_to_binary(self):
+        vec = BinaryVectorizer.from_maps(
+            [{"color": "red", "size": "L", "noise": "x"},
+             {"color": "blue", "size": "L"}],
+            properties={"color", "size"},
+        )
+        assert vec.num_features == 3  # (color,red), (size,L), (color,blue)
+        v = vec.to_binary([("color", "blue"), ("size", "L"), ("junk", "y")])
+        assert v.sum() == 2.0
+        assert v[vec.property_map[("color", "blue")]] == 1.0
+
+    def test_from_pairs(self):
+        vec = BinaryVectorizer.from_pairs([("a", "1"), ("b", "2")])
+        assert vec.to_binary([("a", "1")]).tolist() == [1.0, 0.0]
+
+
+def test_k_fold_split():
+    folds = k_fold_split(
+        3, range(9), {"info": 1},
+        training_data_creator=list,
+        query_creator=lambda d: d,
+        actual_creator=lambda d: d * 10,
+    )
+    assert len(folds) == 3
+    td, ei, qa = folds[0]
+    assert ei == {"info": 1}
+    assert [q for q, _ in qa] == [0, 3, 6]
+    assert td == [1, 2, 4, 5, 7, 8]
+    assert qa[1] == (3, 30)
+    # every point appears exactly once as a test point
+    all_q = sorted(q for _, _, qa in folds for q, _ in qa)
+    assert all_q == list(range(9))

@@ -1,0 +1,148 @@
+"""Plugin SPIs, evaluation dashboard, admin API."""
+
+import asyncio
+import datetime as dt
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from incubator_predictionio_tpu.data.storage import App, Storage
+from incubator_predictionio_tpu.data.storage.base import EvaluationInstance
+from incubator_predictionio_tpu.server.plugins import (
+    ENGINE_SERVER_PLUGINS,
+    EVENT_SERVER_PLUGINS,
+    EngineServerPlugin,
+    EventServerPlugin,
+    apply_input_plugins,
+    apply_output_plugins,
+    register_engine_server_plugin,
+    register_event_server_plugin,
+)
+
+UTC = dt.timezone.utc
+
+
+@pytest.fixture(autouse=True)
+def clean_plugins():
+    yield
+    ENGINE_SERVER_PLUGINS.clear()
+    EVENT_SERVER_PLUGINS.clear()
+
+
+def test_output_blocker_transforms_and_sniffer_observes():
+    seen = []
+
+    class Blocker(EngineServerPlugin):
+        name = "masker"
+        output_type = EngineServerPlugin.OUTPUTBLOCKER
+
+        def process(self, engine_instance, query, prediction, context):
+            return {**prediction, "masked": True}
+
+    class Sniffer(EngineServerPlugin):
+        name = "sniffer"
+        output_type = EngineServerPlugin.OUTPUTSNIFFER
+
+        def process(self, engine_instance, query, prediction, context):
+            seen.append(prediction)
+
+    register_engine_server_plugin(Blocker())
+    register_engine_server_plugin(Sniffer())
+    out = apply_output_plugins(None, {"q": 1}, {"label": "x"})
+    assert out == {"label": "x", "masked": True}
+    assert seen == [out]
+
+
+def test_sniffer_errors_do_not_break_serving():
+    class Bad(EngineServerPlugin):
+        name = "bad"
+        output_type = EngineServerPlugin.OUTPUTSNIFFER
+
+        def process(self, engine_instance, query, prediction, context):
+            raise RuntimeError("boom")
+
+    register_engine_server_plugin(Bad())
+    assert apply_output_plugins(None, {}, {"ok": 1}) == {"ok": 1}
+
+
+def test_input_blocker_can_reject_and_transform():
+    class Tagger(EventServerPlugin):
+        name = "tagger"
+        input_type = EventServerPlugin.INPUTBLOCKER
+
+        def process(self, event_info, context):
+            if event_info.get("event") == "forbidden":
+                raise ValueError("rejected by policy")
+            return {**event_info, "tags": ["tagged"]}
+
+    register_event_server_plugin(Tagger())
+    out = apply_input_plugins({"event": "rate"})
+    assert out["tags"] == ["tagged"]
+    with pytest.raises(ValueError):
+        apply_input_plugins({"event": "forbidden"})
+
+
+def _eval_instance():
+    return EvaluationInstance(
+        id="", status="EVALCOMPLETED", start_time=dt.datetime(2020, 1, 1, tzinfo=UTC),
+        end_time=dt.datetime(2020, 1, 2, tzinfo=UTC),
+        evaluation_class="my.Eval", evaluator_results="[0.9] Accuracy",
+        evaluator_results_html="<h3>Accuracy</h3>",
+        evaluator_results_json='{"best": 0.9}',
+    )
+
+
+def test_dashboard_lists_and_serves_results():
+    from incubator_predictionio_tpu.tools.dashboard import Dashboard
+
+    storage = Storage({"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+    iid = storage.get_meta_data_evaluation_instances().insert(_eval_instance())
+
+    async def run():
+        client = TestClient(TestServer(Dashboard(storage=storage).make_app()))
+        await client.start_server()
+        try:
+            index = await (await client.get("/")).text()
+            assert iid in index and "my.Eval" in index
+            txt = await client.get(f"/engine_instances/{iid}/evaluator_results.txt")
+            assert await txt.text() == "[0.9] Accuracy"
+            js = await client.get(f"/engine_instances/{iid}/evaluator_results.json")
+            assert (await js.json())["best"] == 0.9
+            missing = await client.get("/engine_instances/nope/evaluator_results.txt")
+            assert missing.status == 404
+        finally:
+            await client.close()
+
+    asyncio.run(run())
+    storage.close()
+
+
+def test_admin_api_app_crud():
+    from incubator_predictionio_tpu.tools.admin import AdminAPI
+
+    storage = Storage({"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+
+    async def run():
+        client = TestClient(TestServer(AdminAPI(storage=storage).make_app()))
+        await client.start_server()
+        try:
+            assert (await (await client.get("/")).json())["status"] == "alive"
+            resp = await client.post("/cmd/app", json={"name": "shop"})
+            assert resp.status == 201
+            body = await resp.json()
+            assert body["accessKey"]
+            resp = await client.post("/cmd/app", json={"name": "shop"})
+            assert resp.status == 409
+            apps = await (await client.get("/cmd/app")).json()
+            assert [a["name"] for a in apps] == ["shop"]
+            resp = await client.delete("/cmd/app/shop/data")
+            assert resp.status == 200
+            resp = await client.delete("/cmd/app/shop")
+            assert resp.status == 200
+            assert await (await client.get("/cmd/app")).json() == []
+            assert (await client.delete("/cmd/app/shop")).status == 404
+        finally:
+            await client.close()
+
+    asyncio.run(run())
+    storage.close()

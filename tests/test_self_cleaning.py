@@ -1,0 +1,101 @@
+"""SelfCleaningDataSource compaction (parity: SelfCleaningDataSourceTest)."""
+
+import datetime as dt
+
+from incubator_predictionio_tpu.core.self_cleaning import (
+    EventWindow,
+    SelfCleaningDataSource,
+    clean_events,
+)
+from incubator_predictionio_tpu.data import DataMap, Event
+from incubator_predictionio_tpu.data.storage import App, Storage, use_storage
+
+UTC = dt.timezone.utc
+
+
+def t(days):
+    return dt.datetime(2020, 1, 1, tzinfo=UTC) + dt.timedelta(days=days)
+
+
+def setup_store():
+    s = Storage({"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+    app_id = s.get_meta_data_apps().insert(App(0, "clean-test"))
+    s.get_events().init(app_id)
+    return s, app_id
+
+
+def test_window_drops_old_events():
+    s, app_id = setup_store()
+    for day in range(10):
+        s.get_events().insert(
+            Event(event="view", entity_type="user", entity_id="u1",
+                  target_entity_type="item", target_entity_id=f"i{day}",
+                  event_time=t(day)), app_id)
+    counters = clean_events(app_id, EventWindow(duration=dt.timedelta(days=3)),
+                            storage=s)
+    assert counters["dropped_window"] == 6  # cutoff vs newest event (day 9)
+    remaining = list(s.get_events().find(app_id))
+    assert len(remaining) == 4
+    assert min(e.event_time for e in remaining) >= t(6)
+
+
+def test_dedup():
+    s, app_id = setup_store()
+    for _ in range(3):
+        s.get_events().insert(
+            Event(event="view", entity_type="user", entity_id="u1",
+                  target_entity_type="item", target_entity_id="i1",
+                  event_time=t(0)), app_id)
+    counters = clean_events(app_id, EventWindow(remove_duplicates=True), storage=s)
+    assert counters["dropped_duplicates"] == 2
+    assert len(list(s.get_events().find(app_id))) == 1
+
+
+def test_compress_properties_snapshots():
+    s, app_id = setup_store()
+    ev = s.get_events()
+    ev.insert(Event(event="$set", entity_type="user", entity_id="u1",
+                    properties=DataMap({"a": 1, "b": 2}), event_time=t(0)), app_id)
+    ev.insert(Event(event="$unset", entity_type="user", entity_id="u1",
+                    properties=DataMap({"b": None}), event_time=t(1)), app_id)
+    ev.insert(Event(event="$set", entity_type="user", entity_id="u1",
+                    properties=DataMap({"c": 3}), event_time=t(2)), app_id)
+    ev.insert(Event(event="$set", entity_type="user", entity_id="gone",
+                    properties=DataMap({"x": 1}), event_time=t(0)), app_id)
+    ev.insert(Event(event="$delete", entity_type="user", entity_id="gone",
+                    event_time=t(1)), app_id)
+    ev.insert(Event(event="view", entity_type="user", entity_id="u1",
+                    target_entity_type="item", target_entity_id="i1",
+                    event_time=t(1)), app_id)
+    clean_events(app_id, EventWindow(compress_properties=True), storage=s)
+    remaining = list(s.get_events().find(app_id))
+    sets = [e for e in remaining if e.event == "$set"]
+    views = [e for e in remaining if e.event == "view"]
+    assert len(views) == 1
+    assert len(sets) == 1  # deleted entity produces no snapshot
+    assert sets[0].entity_id == "u1"
+    assert sets[0].properties.to_dict() == {"a": 1, "c": 3}
+    # aggregation after compaction is unchanged
+    agg = s.get_events().aggregate_properties(app_id, "user")
+    assert agg["u1"].to_dict() == {"a": 1, "c": 3}
+
+
+def test_mixin_resolves_app_and_wipes():
+    s, app_id = setup_store()
+    prev = use_storage(s)
+    try:
+        class DS(SelfCleaningDataSource):
+            app_name = "clean-test"
+            event_window = EventWindow(remove_duplicates=True)
+
+        ds = DS()
+        s.get_events().insert(
+            Event(event="view", entity_type="user", entity_id="u1",
+                  target_entity_type="item", target_entity_id="i1",
+                  event_time=t(0)), app_id)
+        counters = ds.clean_persisted_events()
+        assert counters["kept"] == 1
+        ds.wipe()
+        assert list(s.get_events().find(app_id)) == []
+    finally:
+        use_storage(prev)
