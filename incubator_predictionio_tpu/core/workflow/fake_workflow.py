@@ -1,0 +1,55 @@
+"""FakeWorkflow — run an arbitrary function through the workflow machinery.
+
+Parity target: workflow/FakeWorkflow.scala:33-109 (``FakeRun``): wraps a
+``MeshContext → None`` function in a fake engine/evaluator pair so it runs
+with workflow bookkeeping (instance rows, cleanup hooks) — used for tests and
+experiments.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+from typing import Callable, Optional
+
+from incubator_predictionio_tpu.core.workflow.core_workflow import CleanupFunctions
+from incubator_predictionio_tpu.data.storage.base import EvaluationInstance
+from incubator_predictionio_tpu.data.storage.registry import Storage, get_storage
+from incubator_predictionio_tpu.parallel.mesh import MeshContext
+
+
+def fake_run(
+    fn: Callable[[MeshContext], None],
+    storage: Optional[Storage] = None,
+    ctx: Optional[MeshContext] = None,
+) -> str:
+    """Run ``fn`` with workflow bookkeeping; returns the instance id."""
+    storage = storage or get_storage()
+    instances = storage.get_meta_data_evaluation_instances()
+    now = _dt.datetime.now(_dt.timezone.utc)
+    instance_id = instances.insert(EvaluationInstance(
+        id="", status="INIT", start_time=now, end_time=None,
+        evaluation_class="FakeRun",
+    ))
+    ctx = ctx or MeshContext.create()
+    try:
+        with ctx.activate():
+            fn(ctx)
+        from dataclasses import replace
+
+        inst = instances.get(instance_id)
+        instances.update(replace(
+            inst, status="EVALCOMPLETED",
+            end_time=_dt.datetime.now(_dt.timezone.utc)))
+        return instance_id
+    except Exception:
+        from dataclasses import replace
+
+        inst = instances.get(instance_id)
+        if inst is not None:
+            instances.update(replace(
+                inst, status="EVALFAILED",
+                end_time=_dt.datetime.now(_dt.timezone.utc)))
+        raise
+    finally:
+        CleanupFunctions.run()
+        ctx.stop()

@@ -45,10 +45,24 @@ logger = logging.getLogger(__name__)
 MAX_BATCH_SIZE = 50  # EventServer.scala:70
 
 
+def _ssl_context(config) -> "Optional[object]":
+    if not getattr(config, "ssl_cert", None):
+        return None
+    import ssl
+
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(config.ssl_cert, config.ssl_key)
+    return ctx
+
+
 @dataclasses.dataclass
 class EventServerConfig:
     ip: str = "0.0.0.0"
     port: int = 7070
+    # TLS termination (reference common/SSLConfiguration.scala:30 — JKS
+    # keystore becomes a PEM cert/key pair)
+    ssl_cert: Optional[str] = None
+    ssl_key: Optional[str] = None
     stats: bool = dataclasses.field(
         default_factory=lambda: os.environ.get("PIO_EVENTSERVER_STATS", "").lower()
         in ("1", "true", "yes")
@@ -312,7 +326,8 @@ class EventServer:
     async def start(self) -> None:
         self._runner = web.AppRunner(self.make_app())
         await self._runner.setup()
-        site = web.TCPSite(self._runner, self.config.ip, self.config.port)
+        site = web.TCPSite(self._runner, self.config.ip, self.config.port,
+                           ssl_context=_ssl_context(self.config))
         await site.start()
         logger.info("event server listening on %s:%d", self.config.ip, self.config.port)
 

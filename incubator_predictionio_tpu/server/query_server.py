@@ -59,6 +59,8 @@ class ServerConfig:
     ip: str = "0.0.0.0"
     port: int = 8000
     feedback: bool = False
+    ssl_cert: Optional[str] = None  # TLS (reference SSLConfiguration.scala:30)
+    ssl_key: Optional[str] = None
     event_server_ip: str = "127.0.0.1"
     event_server_port: int = 7070
     access_key: Optional[str] = None  # for feedback events
@@ -275,9 +277,12 @@ class QueryServer:
 
     # -- lifecycle --------------------------------------------------------
     async def start(self) -> None:
+        from incubator_predictionio_tpu.server.event_server import _ssl_context
+
         self._runner = web.AppRunner(self.make_app())
         await self._runner.setup()
-        site = web.TCPSite(self._runner, self.config.ip, self.config.port)
+        site = web.TCPSite(self._runner, self.config.ip, self.config.port,
+                           ssl_context=_ssl_context(self.config))
         await site.start()
         logger.info("engine server listening on %s:%d", self.config.ip, self.config.port)
 

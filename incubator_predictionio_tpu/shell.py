@@ -1,0 +1,31 @@
+"""Interactive shell bootstrap — the ``pio-shell`` / pypio counterpart.
+
+The reference ships a py4j bridge (python/pypio/) so data scientists can read
+event data from pyspark; this framework *is* Python, so the bridge collapses
+to a convenience module:
+
+    $ python -q
+    >>> from incubator_predictionio_tpu.shell import *
+    >>> p_event_store.aggregate_properties("myapp", "user")
+
+Exposes configured ``storage``, ``l_event_store``, ``p_event_store``, and a
+default ``mesh`` context, mirroring pypio's ``pypio.shell`` bootstrap
+(python/pypio/shell.py) and ``PEventStore`` facade
+(python/pypio/data/eventstore.py:30-46).
+"""
+
+from incubator_predictionio_tpu.data.storage.registry import get_storage
+from incubator_predictionio_tpu.data.store import LEventStore, PEventStore
+from incubator_predictionio_tpu.parallel.mesh import MeshContext
+
+storage = get_storage()
+l_event_store = LEventStore(storage)
+p_event_store = PEventStore(storage)
+
+
+def mesh(**axes) -> MeshContext:
+    """Create a MeshContext (all devices on one ``data`` axis by default)."""
+    return MeshContext.create(axes=axes or None)
+
+
+__all__ = ["storage", "l_event_store", "p_event_store", "mesh"]

@@ -264,6 +264,8 @@ def cmd_deploy(args, storage: Storage) -> int:
         event_server_port=args.event_server_port,
         access_key=args.access_key,
         server_access_key=args.server_access_key,
+        ssl_cert=args.ssl_cert,
+        ssl_key=args.ssl_key,
     )
     serve_forever(config, storage)
     return 0
@@ -326,7 +328,8 @@ def cmd_eventserver(args, storage: Storage) -> int:
     )
 
     serve_forever(EventServerConfig(ip=args.ip, port=args.port,
-                                    stats=args.stats), storage)
+                                    stats=args.stats, ssl_cert=args.ssl_cert,
+                                    ssl_key=args.ssl_key), storage)
     return 0
 
 
@@ -459,6 +462,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--event-server-port", type=int, default=7070)
     p.add_argument("--accesskey", dest="access_key")
     p.add_argument("--server-access-key")
+    p.add_argument("--ssl-cert")
+    p.add_argument("--ssl-key")
     p = sub.add_parser("undeploy")
     p.add_argument("--ip", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8000)
@@ -476,6 +481,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ip", default="0.0.0.0")
     p.add_argument("--port", type=int, default=7070)
     p.add_argument("--stats", action="store_true")
+    p.add_argument("--ssl-cert")
+    p.add_argument("--ssl-key")
 
     # dashboard / adminserver
     p = sub.add_parser("dashboard")
