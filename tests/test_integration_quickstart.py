@@ -1,0 +1,158 @@
+"""Black-box integration tier: the QuickStart walk-through over real HTTP.
+
+Parity with the reference's top test tier (tests/pio_tests/scenarios/
+quickstart_test.py + basic_app_usecases.py): drive app creation, event
+ingestion over the Event Server's HTTP API, train through the workflow,
+deploy the engine server, query it over HTTP, reload, undeploy — all
+in-process but over real sockets.
+"""
+
+import asyncio
+import datetime as dt
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from incubator_predictionio_tpu.data.storage import Storage, use_storage
+
+UTC = dt.timezone.utc
+
+
+@pytest.fixture()
+def isolated_storage():
+    s = Storage({"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+    prev = use_storage(s)
+    yield s
+    use_storage(prev)
+    s.close()
+
+
+def test_quickstart_full_flow(isolated_storage, tmp_path):
+    storage = isolated_storage
+    from incubator_predictionio_tpu.server.event_server import (
+        EventServer,
+        EventServerConfig,
+    )
+    from incubator_predictionio_tpu.server.query_server import QueryServer, ServerConfig
+    from incubator_predictionio_tpu.tools import cli
+
+    # -- pio app new (via the CLI command layer) --------------------------
+    class Args:
+        name = "quickstart"
+        id = 0
+        description = None
+        access_key = ""
+
+    assert cli.cmd_app_new(Args(), storage) == 0
+    key = storage.get_meta_data_access_keys().get_all()[0].key
+
+    # -- import events over HTTP (batch API) ------------------------------
+    rng = np.random.default_rng(17)
+    x = rng.normal(size=(64, 3))
+    y = (x[:, 0] + x[:, 1] > 0).astype(int)
+    events = [
+        {"event": "$set", "entityType": "user", "entityId": f"u{i}",
+         "properties": {"attr0": float(x[i, 0]), "attr1": float(x[i, 1]),
+                        "attr2": float(x[i, 2]), "plan": int(y[i])},
+         "eventTime": "2020-01-01T00:00:00Z"}
+        for i in range(64)
+    ]
+
+    async def ingest():
+        server = EventServer(EventServerConfig(), storage=storage)
+        client = TestClient(TestServer(server.make_app()))
+        await client.start_server()
+        try:
+            for start in range(0, 64, 32):
+                resp = await client.post(
+                    f"/batch/events.json?accessKey={key}",
+                    json=events[start:start + 32])
+                assert resp.status == 200
+                assert all(r["status"] == 201 for r in await resp.json())
+            # negative: bad key still rejected
+            assert (await client.post("/events.json?accessKey=no",
+                                      json=events[0])).status == 401
+        finally:
+            await client.close()
+
+    asyncio.run(ingest())
+
+    # -- pio train --------------------------------------------------------
+    variant_path = tmp_path / "engine.json"
+    variant_path.write_text(json.dumps({
+        "id": "default", "version": "1",
+        "engineFactory":
+            "incubator_predictionio_tpu.templates.classification.ClassificationEngine",
+        "datasource": {"params": {"appName": "quickstart"}},
+        "algorithms": [{"name": "mlp", "params": {
+            "hiddenDims": [8], "epochs": 80, "learningRate": 0.03,
+            "batchSize": 64}}],
+    }))
+    from incubator_predictionio_tpu.core.workflow.create_workflow import (
+        WorkflowConfig,
+        create_workflow,
+    )
+
+    instance_id = create_workflow(
+        WorkflowConfig(engine_variant=str(variant_path)), storage)
+    assert storage.get_meta_data_engine_instances().get(instance_id).status \
+        == "COMPLETED"
+
+    # -- pio deploy + query over HTTP -------------------------------------
+    async def deploy_and_query():
+        server = QueryServer(
+            ServerConfig(engine_variant=str(variant_path),
+                         server_access_key="sk"),
+            storage=storage)
+        client = TestClient(TestServer(server.make_app()))
+        await client.start_server()
+        try:
+            ok = 0
+            for i in range(16):
+                resp = await client.post(
+                    "/queries.json",
+                    json={"features": [float(v) for v in x[i]]})
+                assert resp.status == 200
+                ok += int((await resp.json())["label"] == int(y[i]))
+            assert ok >= 14
+            # reload picks the same latest instance
+            resp = await client.post("/reload?accessKey=sk")
+            assert (await resp.json())["engineInstanceId"] == instance_id
+            # status page reflects traffic
+            status = await (await client.get("/")).json()
+            assert status["requestCount"] == 16
+        finally:
+            await client.close()
+
+    asyncio.run(deploy_and_query())
+
+
+def test_cli_subprocess_surface(tmp_path):
+    """The installed console works as a real subprocess (bin/pio parity)."""
+    env = dict(os.environ)
+    env.update({
+        "PIO_FS_BASEDIR": str(tmp_path),
+        "PIO_STORAGE_SOURCES_SQLITE_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_SQLITE_PATH": str(tmp_path / "pio.db"),
+        "JAX_PLATFORMS": "cpu",
+    })
+    run = lambda *args: subprocess.run(
+        [sys.executable, "-m", "incubator_predictionio_tpu.tools.cli", *args],
+        capture_output=True, text=True, env=env, timeout=120)
+    out = run("version")
+    assert out.returncode == 0 and out.stdout.strip()
+    out = run("app", "new", "subapp")
+    assert out.returncode == 0 and "Access Key:" in out.stdout
+    out = run("app", "list")
+    assert "subapp" in out.stdout
+    out = run("accesskey", "list", "subapp")
+    assert "Finished listing 1 access key" in out.stdout
+    out = run("status")
+    assert "all ready to go" in out.stdout
+    out = run("app", "delete", "subapp", "-f")
+    assert out.returncode == 0
