@@ -60,14 +60,34 @@ class TwoTowerModel:
     config: TwoTowerConfig
 
     _device_items = None  # device-resident (item_emb.T, item_bias) for serving
+    _device_items_q = None  # int8-quantized catalog (pallas retrieval kernel)
 
-    def prepare_for_serving(self) -> "TwoTowerModel":
+    def prepare_for_serving(self, quantize: bool = False) -> "TwoTowerModel":
+        """Make serving state device-resident. ``quantize=True`` stores the
+        catalog int8 row-quantized and scores through the fused Pallas
+        retrieval kernel (ops/retrieval.py) — 4× less HBM for the item table
+        and a faster score pass on TPU."""
         self.user_emb = jax.device_put(self.user_emb)
         self.user_bias = jax.device_put(self.user_bias)
-        self._device_items = (
-            jax.device_put(np.ascontiguousarray(self.item_emb.T)),
-            jax.device_put(self.item_bias),
-        )
+        if quantize:
+            from incubator_predictionio_tpu.ops.retrieval import (
+                pad_catalog,
+                quantize_rows,
+            )
+
+            items_q, scales = quantize_rows(np.asarray(self.item_emb))
+            base_mask = np.zeros(self.n_items, np.float32)
+            items_q, scales, bias, mask = pad_catalog(
+                items_q, scales, np.asarray(self.item_bias, np.float32), base_mask
+            )
+            self._device_items_q = tuple(
+                jax.device_put(v) for v in (items_q, scales, bias, mask)
+            )
+        else:
+            self._device_items = (
+                jax.device_put(np.ascontiguousarray(self.item_emb.T)),
+                jax.device_put(self.item_bias),
+            )
         return self
 
     @property
@@ -198,19 +218,28 @@ class TwoTowerMF:
     ) -> tuple[np.ndarray, np.ndarray]:
         """Vectorized top-k over the full catalog for a batch of users."""
         num = min(num, model.n_items)  # k cannot exceed the catalog
-        if model._device_items is None:
+        if model._device_items is None and model._device_items_q is None:
             model.prepare_for_serving()
+        ue = jnp.asarray(np.asarray(model.user_emb)[user_idx])
+        ub = jnp.asarray(np.asarray(model.user_bias)[user_idx])
+        if model._device_items_q is not None:
+            items_q, scales, bias, base_mask = model._device_items_q
+            mask = base_mask
+            if exclude is not None and len(exclude):
+                m = np.zeros(items_q.shape[0], np.float32)
+                m[np.asarray(exclude, np.int64)] = -np.inf
+                mask = mask + jnp.asarray(m)
+            idx, scores = _topk_quantized(
+                ue, ub, items_q, scales, bias, mask, model.mean, num
+            )
+            return np.asarray(idx), np.asarray(scores)
         item_t, item_b = model._device_items
         mask = None
         if exclude is not None and len(exclude):
             mask = np.zeros(model.n_items, np.float32)
             mask[np.asarray(exclude, np.int64)] = -np.inf
         idx, scores = _topk_scores(
-            jnp.asarray(np.asarray(model.user_emb)[user_idx]),
-            jnp.asarray(np.asarray(model.user_bias)[user_idx]),
-            item_t,
-            item_b,
-            model.mean,
+            ue, ub, item_t, item_b, model.mean,
             None if mask is None else jnp.asarray(mask),
             num,
         )
@@ -245,6 +274,21 @@ def _train_epoch(p, o, ub, ib, rb, wb, lr, reg):
 
     (p, o), losses = jax.lax.scan(step, (p, o), (ub, ib, rb, wb))
     return p, o, losses.mean()
+
+
+@partial(jax.jit, static_argnames=("num",))
+def _topk_quantized(ue, ub, items_q, scales, bias, mask, mean, num):
+    """Quantized catalog scoring: Pallas kernel on TPU, jnp oracle elsewhere."""
+    from incubator_predictionio_tpu.ops.retrieval import (
+        score_catalog_quantized,
+        score_catalog_reference,
+    )
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    scorer = score_catalog_quantized if on_tpu else score_catalog_reference
+    scores = scorer(ue, items_q, scales, bias, mask) + ub[:, None] + mean
+    values, indices = jax.lax.top_k(scores, num)
+    return indices, values
 
 
 @partial(jax.jit, static_argnames=("num",))

@@ -1,0 +1,4 @@
+"""Hand-written TPU kernels (Pallas) for the framework's hot ops.
+
+Everything here has a pure-XLA fallback; kernels engage on TPU (or in Pallas
+interpret mode for CPU tests)."""
