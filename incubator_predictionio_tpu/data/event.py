@@ -5,7 +5,7 @@ Behavioral parity with the reference's event model
 DataMap.scala:45-245), re-expressed as plain Python dataclasses. The event is
 the unit of ingestion for the Event Server and the unit of storage for every
 EVENTDATA backend; the device-facing input pipeline converts batches of events
-to columnar numpy arrays downstream (see data/pipeline.py), so this layer stays
+to columnar numpy arrays downstream (templates consume find_sharded iterators), so this layer stays
 framework-free.
 """
 

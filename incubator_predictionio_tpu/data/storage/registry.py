@@ -60,6 +60,9 @@ def register_backend(type_name: str):
 
 
 def _register_builtins() -> None:
+    from incubator_predictionio_tpu.data.storage.eventlog_backend import (
+        EventLogStorageClient,
+    )
     from incubator_predictionio_tpu.data.storage.localfs import LocalFSStorageClient
     from incubator_predictionio_tpu.data.storage.memory import MemoryStorageClient
     from incubator_predictionio_tpu.data.storage.sqlite_backend import SqliteStorageClient
@@ -67,6 +70,7 @@ def _register_builtins() -> None:
     BACKEND_TYPES.setdefault("memory", MemoryStorageClient)
     BACKEND_TYPES.setdefault("sqlite", SqliteStorageClient)
     BACKEND_TYPES.setdefault("localfs", LocalFSStorageClient)
+    BACKEND_TYPES.setdefault("eventlog", EventLogStorageClient)
 
 
 _SOURCE_RE = re.compile(r"^PIO_STORAGE_SOURCES_([^_]+)_(.+)$")

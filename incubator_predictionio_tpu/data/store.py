@@ -4,8 +4,9 @@ Parity targets: reference ``LEventStore`` (data/.../store/LEventStore.scala:33-1
 app-*name* resolution + low-latency reads used at serving time) and
 ``PEventStore`` (store/PEventStore.scala:35-121, bulk reads + property
 aggregation used at training time). The P flavor's RDD return type becomes
-per-shard iterators / :class:`EventBatch` columnar arrays for the device
-input pipeline (see data/pipeline.py).
+per-shard iterators consumed by the device input pipeline; bulk scans run
+through the native event-log runtime when the ``eventlog`` backend is active
+(native/src/eventlog.cc).
 """
 
 from __future__ import annotations

@@ -1,0 +1,494 @@
+// Native event-log runtime: filtered scan + property fold over PIOLOG01 files.
+//
+// This is the TPU-native counterpart of the reference's storage scan path
+// (Spark JdbcRDD partition scans — storage/jdbc/.../JDBCPEvents.scala:91;
+// HBase TableInputFormat scans — storage/hbase/.../HBPEvents.scala:63-85) and
+// of the distributed property fold (data/.../storage/PEventAggregator.scala:192).
+// Instead of shipping filters to a database/Spark, the log lives on local disk
+// and is scanned at memory bandwidth here; Python drives it through ctypes
+// (incubator_predictionio_tpu/native/__init__.py) and falls back to a pure
+// Python mirror (native/format.py) when this library is unavailable.
+//
+// Format spec: see native/format.py module docstring. The fold treats TLV
+// property values as opaque byte spans — it only merges/removes top-level
+// object keys, exactly mirroring data/aggregator.py semantics ($set is
+// right-biased merge, $unset removes keys, $delete clears the snapshot but
+// first/last-updated timestamps survive).
+//
+// Build: g++ -O3 -std=c++17 -shared -fPIC eventlog.cc -o libpioeventlog.so
+
+#include <algorithm>
+#include <array>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kNoneId = 0xFFFFFFFFu;
+constexpr uint16_t kAbsent16 = 0xFFFFu;
+constexpr uint8_t kKindIntern = 1;
+constexpr uint8_t kKindEvent = 2;
+constexpr uint8_t kKindTombstone = 3;
+
+struct Span {
+  const uint8_t* p = nullptr;
+  size_t n = 0;
+  std::string str() const { return std::string(reinterpret_cast<const char*>(p), n); }
+  bool eq(const char* s) const { return s != nullptr && strlen(s) == n && memcmp(p, s, n) == 0; }
+};
+
+struct Reader {
+  const uint8_t* p;
+  size_t n;
+  size_t pos = 0;
+  bool fail = false;
+
+  bool need(size_t k) {
+    if (pos + k > n) {
+      fail = true;
+      return false;
+    }
+    return true;
+  }
+  uint8_t u8() {
+    if (!need(1)) return 0;
+    return p[pos++];
+  }
+  uint16_t u16() {
+    if (!need(2)) return 0;
+    uint16_t v;
+    memcpy(&v, p + pos, 2);
+    pos += 2;
+    return v;
+  }
+  uint32_t u32() {
+    if (!need(4)) return 0;
+    uint32_t v;
+    memcpy(&v, p + pos, 4);
+    pos += 4;
+    return v;
+  }
+  int16_t i16() { return static_cast<int16_t>(u16()); }
+  int64_t i64() {
+    if (!need(8)) return 0;
+    int64_t v;
+    memcpy(&v, p + pos, 8);
+    pos += 8;
+    return v;
+  }
+  Span bytes(size_t k) {
+    if (!need(k)) return {};
+    Span s{p + pos, k};
+    pos += k;
+    return s;
+  }
+  Span str16() { return bytes(u16()); }
+  // absent -> {nullptr, 0} with present=false
+  Span optstr16(bool* present) {
+    uint16_t k = u16();
+    if (k == kAbsent16) {
+      *present = false;
+      return {};
+    }
+    *present = true;
+    return bytes(k);
+  }
+};
+
+// Skip one TLV value, returning false on malformed input.
+bool skip_tlv(Reader& r) {
+  uint8_t t = r.u8();
+  if (r.fail) return false;
+  switch (t) {
+    case 0:
+    case 1:
+    case 2:
+      return true;
+    case 3:
+    case 4:
+      r.bytes(8);
+      return !r.fail;
+    case 5:
+    case 8: {
+      uint32_t k = r.u32();
+      r.bytes(k);
+      return !r.fail;
+    }
+    case 6: {
+      uint32_t k = r.u32();
+      for (uint32_t i = 0; i < k && !r.fail; i++)
+        if (!skip_tlv(r)) return false;
+      return !r.fail;
+    }
+    case 7: {
+      uint32_t k = r.u32();
+      for (uint32_t i = 0; i < k && !r.fail; i++) {
+        r.str16();
+        if (r.fail || !skip_tlv(r)) return false;
+      }
+      return !r.fail;
+    }
+    default:
+      return false;
+  }
+}
+
+struct ParsedEvent {
+  Span id;
+  int64_t event_time_us;
+  uint32_t name_id;
+  uint32_t entity_type_id;
+  uint32_t target_type_id;  // kNoneId = absent
+  Span entity_id;
+  bool has_target_id;
+  Span target_id;
+  Span props;  // TLV object bytes
+};
+
+// Parse an EVENT payload far enough for filtering + folding.
+bool parse_event(const uint8_t* payload, size_t len, ParsedEvent* out) {
+  Reader r{payload, len};
+  r.u8();  // kind, checked by caller
+  out->id = r.str16();
+  if (r.fail) return false;
+  out->event_time_us = r.i64();
+  r.i16();  // event tz
+  r.i64();  // creation us
+  r.i16();  // creation tz
+  out->name_id = r.u32();
+  out->entity_type_id = r.u32();
+  out->target_type_id = r.u32();
+  out->entity_id = r.str16();
+  out->target_id = r.optstr16(&out->has_target_id);
+  bool has_pr;
+  r.optstr16(&has_pr);  // pr_id
+  uint16_t n_tags = r.u16();
+  for (uint16_t i = 0; i < n_tags && !r.fail; i++) r.str16();
+  uint32_t props_len = r.u32();
+  out->props = r.bytes(props_len);
+  return !r.fail;
+}
+
+struct Filter {
+  int64_t start_us;  // INT64_MIN = open
+  int64_t until_us;  // INT64_MAX = open
+  const char* entity_type;
+  const char* entity_id;
+  const char** event_names;
+  int32_t n_event_names;
+  int32_t target_type_mode;  // 0 any | 1 absent | 2 equals
+  const char* target_type;
+  int32_t target_id_mode;
+  const char* target_id;
+};
+
+struct LogData {
+  std::vector<uint8_t> buf;
+  std::unordered_map<uint32_t, std::string> strings;
+  // live (non-tombstoned) event record offsets, file order
+  std::vector<size_t> event_offsets;
+};
+
+bool load_log(const char* path, LogData* log) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return false;
+  fseek(f, 0, SEEK_END);
+  long sz = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  if (sz < 8) {
+    fclose(f);
+    return false;
+  }
+  log->buf.resize(static_cast<size_t>(sz));
+  size_t got = fread(log->buf.data(), 1, log->buf.size(), f);
+  fclose(f);
+  if (got != log->buf.size()) return false;
+  if (memcmp(log->buf.data(), "PIOLOG01", 8) != 0) return false;
+
+  const uint8_t* p = log->buf.data();
+  size_t n = log->buf.size();
+  size_t pos = 8;
+  // id -> index into `events` of the latest live record with that id; a
+  // TOMBSTONE kills only prior events, so delete-then-reinsert stays live.
+  std::unordered_map<std::string, size_t> live;
+  std::vector<std::pair<size_t, bool>> events;  // (offset, live)
+  while (pos + 4 <= n) {
+    uint32_t plen;
+    memcpy(&plen, p + pos, 4);
+    if (pos + 4 + plen > n || plen < 1) break;  // torn tail
+    const uint8_t* payload = p + pos + 4;
+    uint8_t kind = payload[0];
+    if (kind == kKindIntern) {
+      if (plen >= 7) {
+        uint32_t sid;
+        uint16_t slen;
+        memcpy(&sid, payload + 1, 4);
+        memcpy(&slen, payload + 5, 2);
+        if (7 + static_cast<size_t>(slen) <= plen)
+          log->strings[sid] =
+              std::string(reinterpret_cast<const char*>(payload + 7), slen);
+      }
+    } else if (kind == kKindEvent || kind == kKindTombstone) {
+      Reader r{payload, plen};
+      r.u8();
+      Span id = r.str16();
+      if (!r.fail) {
+        if (kind == kKindEvent) {
+          auto [it, fresh] = live.try_emplace(id.str(), events.size());
+          if (!fresh) {
+            events[it->second].second = false;  // duplicate id: latest wins
+            it->second = events.size();
+          }
+          events.emplace_back(pos, true);
+        } else {
+          auto it = live.find(id.str());
+          if (it != live.end()) {
+            events[it->second].second = false;
+            live.erase(it);
+          }
+        }
+      }
+    }
+    pos += 4 + plen;
+  }
+  log->event_offsets.reserve(events.size());
+  for (auto& [off, is_live] : events)
+    if (is_live) log->event_offsets.push_back(off);
+  return true;
+}
+
+bool matches(const Filter& f, const LogData& log, const ParsedEvent& e) {
+  if (e.event_time_us < f.start_us || e.event_time_us >= f.until_us) return false;
+  if (f.entity_type != nullptr) {
+    auto it = log.strings.find(e.entity_type_id);
+    if (it == log.strings.end() || it->second != f.entity_type) return false;
+  }
+  if (f.entity_id != nullptr && !e.entity_id.eq(f.entity_id)) return false;
+  if (f.n_event_names > 0) {
+    auto it = log.strings.find(e.name_id);
+    if (it == log.strings.end()) return false;
+    bool hit = false;
+    for (int32_t i = 0; i < f.n_event_names; i++)
+      if (it->second == f.event_names[i]) {
+        hit = true;
+        break;
+      }
+    if (!hit) return false;
+  }
+  if (f.target_type_mode == 1) {
+    if (e.target_type_id != kNoneId) return false;
+  } else if (f.target_type_mode == 2) {
+    if (e.target_type_id == kNoneId) return false;
+    auto it = log.strings.find(e.target_type_id);
+    if (it == log.strings.end() || it->second != f.target_type) return false;
+  }
+  if (f.target_id_mode == 1) {
+    if (e.has_target_id) return false;
+  } else if (f.target_id_mode == 2) {
+    if (!e.has_target_id || !e.target_id.eq(f.target_id)) return false;
+  }
+  return true;
+}
+
+void put_u16(std::vector<uint8_t>& out, uint16_t v) {
+  out.insert(out.end(), reinterpret_cast<uint8_t*>(&v),
+             reinterpret_cast<uint8_t*>(&v) + 2);
+}
+void put_u32(std::vector<uint8_t>& out, uint32_t v) {
+  out.insert(out.end(), reinterpret_cast<uint8_t*>(&v),
+             reinterpret_cast<uint8_t*>(&v) + 4);
+}
+void put_i64(std::vector<uint8_t>& out, int64_t v) {
+  out.insert(out.end(), reinterpret_cast<uint8_t*>(&v),
+             reinterpret_cast<uint8_t*>(&v) + 8);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Scan the log at `path` for live events matching `filter`.
+// On success returns the match count and mallocs *out_offsets / *out_times_us
+// (caller frees via pl_free). Returns -1 on I/O or format error.
+int64_t pl_scan(const char* path, const Filter* filter, uint64_t** out_offsets,
+                int64_t** out_times_us) {
+  LogData log;
+  if (!load_log(path, &log)) return -1;
+  std::vector<uint64_t> offs;
+  std::vector<int64_t> times;
+  const uint8_t* p = log.buf.data();
+  for (size_t off : log.event_offsets) {
+    uint32_t plen;
+    memcpy(&plen, p + off, 4);
+    ParsedEvent e;
+    if (!parse_event(p + off + 4, plen, &e)) return -1;
+    if (!matches(*filter, log, e)) continue;
+    offs.push_back(off);
+    times.push_back(e.event_time_us);
+  }
+  *out_offsets = static_cast<uint64_t*>(malloc(offs.size() * sizeof(uint64_t) + 1));
+  *out_times_us = static_cast<int64_t*>(malloc(times.size() * sizeof(int64_t) + 1));
+  if (*out_offsets == nullptr || *out_times_us == nullptr) {
+    free(*out_offsets);
+    free(*out_times_us);
+    return -1;
+  }
+  memcpy(*out_offsets, offs.data(), offs.size() * sizeof(uint64_t));
+  memcpy(*out_times_us, times.data(), times.size() * sizeof(int64_t));
+  return static_cast<int64_t>(offs.size());
+}
+
+// Fold $set/$unset/$delete events matching `filter` into per-entity property
+// snapshots (semantics of data/aggregator.py / reference LEventAggregator).
+//
+// Result buffer layout (mallocd into *out_buf, length returned; pl_free):
+//   u32 n_entities, then per entity:
+//     str16 entity_id, i64 first_updated_us, i64 last_updated_us,
+//     TLV object (type 7) of the folded properties
+// Returns the byte length, or -1 on error.
+int64_t pl_fold(const char* path, const Filter* filter, uint8_t** out_buf) {
+  LogData log;
+  if (!load_log(path, &log)) return -1;
+
+  // resolve the three special names to interned ids (absent -> kNoneId)
+  uint32_t set_id = kNoneId, unset_id = kNoneId, delete_id = kNoneId;
+  for (auto& [sid, s] : log.strings) {
+    if (s == "$set") set_id = sid;
+    else if (s == "$unset") unset_id = sid;
+    else if (s == "$delete") delete_id = sid;
+  }
+
+  struct Rec {
+    int64_t t_us;
+    size_t seq;  // file order tiebreak
+    uint32_t name_id;
+    Span props;
+  };
+  std::unordered_map<std::string, std::vector<Rec>> by_entity;
+  const uint8_t* p = log.buf.data();
+  size_t seq = 0;
+  for (size_t off : log.event_offsets) {
+    uint32_t plen;
+    memcpy(&plen, p + off, 4);
+    ParsedEvent e;
+    if (!parse_event(p + off + 4, plen, &e)) return -1;
+    seq++;
+    if (e.name_id != set_id && e.name_id != unset_id && e.name_id != delete_id)
+      continue;
+    if (!matches(*filter, log, e)) continue;
+    by_entity[e.entity_id.str()].push_back(
+        Rec{e.event_time_us, seq, e.name_id, e.props});
+  }
+
+  struct Snapshot {
+    // key -> TLV value span; vector keeps first-set order like a Python dict
+    std::vector<std::pair<std::string, Span>> fields;
+    bool defined = false;
+    int64_t first_us = 0, last_us = 0;
+    bool touched = false;
+  };
+
+  std::vector<uint8_t> out;
+  put_u32(out, 0);  // n_entities, patched at the end
+  uint32_t n_entities = 0;
+
+  // deterministic output order: sort entities lexicographically
+  std::vector<const std::string*> keys;
+  keys.reserve(by_entity.size());
+  for (auto& kv : by_entity) keys.push_back(&kv.first);
+  std::sort(keys.begin(), keys.end(),
+            [](const std::string* a, const std::string* b) { return *a < *b; });
+
+  for (const std::string* key : keys) {
+    auto& recs = by_entity[*key];
+    std::sort(recs.begin(), recs.end(), [](const Rec& a, const Rec& b) {
+      return a.t_us != b.t_us ? a.t_us < b.t_us : a.seq < b.seq;
+    });
+    Snapshot snap;
+    for (const Rec& r : recs) {
+      if (r.name_id == set_id) {
+        // right-biased merge of the record's top-level object keys
+        Reader pr{r.props.p, r.props.n};
+        if (pr.u8() != 7) return -1;
+        uint32_t nk = pr.u32();
+        for (uint32_t i = 0; i < nk; i++) {
+          Span k = pr.str16();
+          size_t vstart = pr.pos;
+          if (!skip_tlv(pr)) return -1;
+          Span v{pr.p + vstart, pr.pos - vstart};
+          std::string ks = k.str();
+          bool found = false;
+          for (auto& kv : snap.fields)
+            if (kv.first == ks) {
+              kv.second = v;
+              found = true;
+              break;
+            }
+          if (!found) snap.fields.emplace_back(std::move(ks), v);
+        }
+        snap.defined = true;
+      } else if (r.name_id == unset_id) {
+        if (snap.defined) {
+          Reader pr{r.props.p, r.props.n};
+          if (pr.u8() != 7) return -1;
+          uint32_t nk = pr.u32();
+          for (uint32_t i = 0; i < nk; i++) {
+            Span k = pr.str16();
+            if (!skip_tlv(pr)) return -1;
+            std::string ks = k.str();
+            snap.fields.erase(
+                std::remove_if(snap.fields.begin(), snap.fields.end(),
+                               [&](auto& kv) { return kv.first == ks; }),
+                snap.fields.end());
+          }
+        }
+      } else {  // $delete
+        snap.fields.clear();
+        snap.defined = false;
+      }
+      if (!snap.touched) {
+        snap.first_us = snap.last_us = r.t_us;
+        snap.touched = true;
+      } else {
+        snap.first_us = std::min(snap.first_us, r.t_us);
+        snap.last_us = std::max(snap.last_us, r.t_us);
+      }
+    }
+    if (!snap.defined) continue;
+    n_entities++;
+    put_u16(out, static_cast<uint16_t>(key->size()));
+    out.insert(out.end(), key->begin(), key->end());
+    put_i64(out, snap.first_us);
+    put_i64(out, snap.last_us);
+    out.push_back(7);  // TLV object
+    put_u32(out, static_cast<uint32_t>(snap.fields.size()));
+    for (auto& [k, v] : snap.fields) {
+      put_u16(out, static_cast<uint16_t>(k.size()));
+      out.insert(out.end(), k.begin(), k.end());
+      out.insert(out.end(), v.p, v.p + v.n);
+    }
+  }
+  memcpy(out.data(), &n_entities, 4);
+
+  *out_buf = static_cast<uint8_t*>(malloc(out.size() + 1));
+  if (*out_buf == nullptr) return -1;
+  memcpy(*out_buf, out.data(), out.size());
+  return static_cast<int64_t>(out.size());
+}
+
+// Count live (non-tombstoned) events in the log. -1 on error.
+int64_t pl_count(const char* path) {
+  LogData log;
+  if (!load_log(path, &log)) return -1;
+  return static_cast<int64_t>(log.event_offsets.size());
+}
+
+void pl_free(void* p) { free(p); }
+
+}  // extern "C"

@@ -117,7 +117,7 @@ class DataSource(PDataSource):
             weight.append(1.0 if e.event == "view" else 2.0)
             if e.event == "buy":
                 buy_counts[items[e.target_entity_id]] += 1
-        users = BiMap.string_int(user_ids)
+        users = BiMap.string_int(sorted(user_ids))  # sorted: set order is hash-seed dependent
         return TrainingData(
             users=users,
             items=items,

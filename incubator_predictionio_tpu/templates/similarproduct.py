@@ -124,7 +124,7 @@ class DataSource(PDataSource):
                 like_u.append(e.entity_id)
                 like_i.append(e.target_entity_id)
                 like_sign.append(1.0 if e.event == "like" else -1.0)
-        users = BiMap.string_int(user_ids)
+        users = BiMap.string_int(sorted(user_ids))  # sorted: set order is hash-seed dependent
         view_u = users.lookup_array([u for u, _ in view_events])
         view_i = items.lookup_array([i for _, i in view_events])
         return TrainingData(

@@ -31,12 +31,20 @@ def t(n):
     return dt.datetime(2020, 1, 1, 0, 0, n, tzinfo=UTC)
 
 
-@pytest.fixture(params=["memory", "sqlite"])
-def client(request, tmp_path):
+@pytest.fixture(params=["memory", "sqlite", "eventlog", "eventlog-pyfallback"])
+def client(request, tmp_path, monkeypatch):
     if request.param == "memory":
         c = MemoryStorageClient({})
-    else:
+    elif request.param == "sqlite":
         c = SqliteStorageClient({"PATH": str(tmp_path / "pio.db")})
+    else:
+        from incubator_predictionio_tpu.data.storage.eventlog_backend import (
+            EventLogStorageClient,
+        )
+
+        if request.param == "eventlog-pyfallback":
+            monkeypatch.setenv("PIO_NATIVE_DISABLE", "1")
+        c = EventLogStorageClient({"PATH": str(tmp_path / "eventlog")})
     yield c
     c.close()
 
@@ -46,6 +54,17 @@ def events(client):
     es = client.events()
     es.init(APP)
     return es
+
+
+@pytest.fixture()
+def meta_client(client):
+    """Backends that serve METADATA/MODELDATA; EVENTDATA-only backends skip
+    (the reference likewise runs only LEventsSpec/PEventsSpec against HBase)."""
+    try:
+        client.apps()
+    except NotImplementedError:
+        pytest.skip("EVENTDATA-only backend")
+    return client
 
 
 def mk(event="rate", eid="u1", tet="item", tid="i1", when=None, props=None):
@@ -136,8 +155,8 @@ class TestEventStoreContract:
 
 
 class TestMetaContract:
-    def test_apps_crud(self, client):
-        apps = client.apps()
+    def test_apps_crud(self, meta_client):
+        apps = meta_client.apps()
         app_id = apps.insert(App(0, "myapp", "desc"))
         assert app_id and apps.get(app_id).name == "myapp"
         assert apps.get_by_name("myapp").id == app_id
@@ -147,8 +166,8 @@ class TestMetaContract:
         assert len(apps.get_all()) == 1
         assert apps.delete(app_id) and apps.get(app_id) is None
 
-    def test_access_keys(self, client):
-        ak = client.access_keys()
+    def test_access_keys(self, meta_client):
+        ak = meta_client.access_keys()
         key = ak.insert(AccessKey("", 3, ("rate", "buy")))
         assert key and len(key) >= 32
         got = ak.get(key)
@@ -158,8 +177,8 @@ class TestMetaContract:
         assert ak.insert(AccessKey(key, 4)) is None  # duplicate
         assert ak.delete(key) and ak.get(key) is None
 
-    def test_channels(self, client):
-        ch = client.channels()
+    def test_channels(self, meta_client):
+        ch = meta_client.channels()
         cid = ch.insert(Channel(0, "live", 3))
         assert cid and ch.get(cid).name == "live"
         assert ch.insert(Channel(0, "bad name!", 3)) is None
@@ -167,8 +186,8 @@ class TestMetaContract:
         assert [c.id for c in ch.get_by_app_id(3)] == [cid]
         assert ch.delete(cid) and ch.get(cid) is None
 
-    def test_engine_instances(self, client):
-        ei = client.engine_instances()
+    def test_engine_instances(self, meta_client):
+        ei = meta_client.engine_instances()
         mk_inst = lambda status, start: EngineInstance(
             id="", status=status, start_time=start, end_time=None,
             engine_id="eng", engine_version="1", engine_variant="default",
@@ -188,8 +207,8 @@ class TestMetaContract:
         assert ei.get(i1).status == "FAILED"
         assert ei.delete(i1)
 
-    def test_evaluation_instances(self, client):
-        evi = client.evaluation_instances()
+    def test_evaluation_instances(self, meta_client):
+        evi = meta_client.evaluation_instances()
         iid = evi.insert(EvaluationInstance(
             id="", status="EVALCOMPLETED", start_time=t(1), end_time=t(2),
             evaluation_class="pkg.Eval", evaluator_results="score=0.5",
@@ -198,8 +217,8 @@ class TestMetaContract:
         assert [x.id for x in evi.get_completed()] == [iid]
         assert evi.delete(iid) and evi.get(iid) is None
 
-    def test_models(self, client):
-        models = client.models()
+    def test_models(self, meta_client):
+        models = meta_client.models()
         blob = b"\x00\x01binary\xff" * 100
         models.insert(Model("m1", blob))
         assert models.get("m1").models == blob
