@@ -19,6 +19,7 @@ Like the reference's HBase backend it serves EVENTDATA only; combine with
 from __future__ import annotations
 
 import datetime as _dt
+import fcntl
 import os
 import threading
 import uuid
@@ -41,7 +42,14 @@ from incubator_predictionio_tpu.native import format as fmt
 
 
 class _Log:
-    """One open log file: append handle + in-memory id index + string table."""
+    """One open log file: append handle + in-memory id index + string table.
+
+    Single-writer: an exclusive advisory lock (flock) is held on the append
+    handle for its lifetime, so a second writer — another process, or another
+    store over the same directory — fails fast instead of corrupting the
+    intern table (writers assign intern ids from their own in-memory count).
+    Readers never take the lock.
+    """
 
     def __init__(self, path: str):
         self.path = path
@@ -49,17 +57,35 @@ class _Log:
         self.interner = fmt.Interner()
         self.strings: dict[int, str] = {}
         self.index: dict[str, int] = {}  # live event_id -> record offset
-        if os.path.exists(path):
-            with open(path, "rb") as f:
-                buf = f.read()
+        existed = os.path.exists(path)
+        self.f = open(path, "ab")
+        try:
+            fcntl.flock(self.f.fileno(), fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            self.f.close()
+            raise StorageError(
+                f"event log {path} is locked by another writer "
+                "(eventlog is single-writer; route writes through one "
+                "event server / store instance)"
+            )
+        if existed:
+            with open(path, "rb") as rf:
+                buf = rf.read()
+            if len(buf) == 0:
+                existed = False  # crash before the magic was written
+        if existed:
             self.strings, self.index, _ = fmt.read_log(buf)
             self.interner.ids = {s: i for i, s in self.strings.items()}
-            self.f = open(path, "ab")
-        else:
-            self.f = open(path, "ab")
-            if self.f.tell() == 0:
-                self.f.write(fmt.MAGIC)
-                self.f.flush()
+            # A crash can leave a torn/zeroed tail. Scanners skip it, but new
+            # appends would land AFTER the garbage and be unreachable — so
+            # truncate back to the end of the last valid record.
+            valid_end = fmt.valid_extent(buf)
+            if valid_end < len(buf):
+                self.f.truncate(valid_end)
+                self.f.seek(valid_end)
+        if self.f.tell() == 0:
+            self.f.write(fmt.MAGIC)
+            self.f.flush()
 
     def append_event(self, event: Event, event_id: str) -> None:
         with self.lock:
@@ -214,26 +240,26 @@ class EventLogEvents(EventStore):
             _UNSET_MAP(target_entity_type),
             _UNSET_MAP(target_entity_id),
         )
-        # One read of the log per find(): the native scanner touches the file
-        # for filtering; Python then reads it once and decodes only the chosen
-        # hits. The fallback decodes each record exactly once while filtering.
         with log.lock:
             log.f.flush()
             hits = native_scan(log.path, flt)
-            with open(log.path, "rb") as f:
-                buf = f.read()
         if hits is not None:
+            # the native scanner did the full pass; decode only the chosen
+            # hits via seek+read (a limit-N query touches N records, not the
+            # whole log)
             hits.sort(key=lambda h: (h[1], h[0]), reverse=reversed)
             if limit is not None and limit >= 0:
                 hits = hits[:limit]
-            for off, _ in hits:
-                (plen,) = fmt.struct.unpack_from("<I", buf, off)
-                _, event = fmt.decode_event_payload(
-                    buf[off + 4:off + 4 + plen], log.strings
-                )
-                yield event
+            with open(log.path, "rb") as f:
+                for off, _ in hits:
+                    f.seek(off)
+                    (plen,) = fmt.struct.unpack_from("<I", f.read(4), 0)
+                    _, event = fmt.decode_event_payload(f.read(plen), log.strings)
+                    yield event
             return
-        # pure-Python mirror of the native scan
+        # pure-Python mirror of the native scan: one full read + decode
+        with open(log.path, "rb") as f:
+            buf = f.read()
         strings, live, _ = fmt.read_log(buf)
         live_offsets = set(live.values())
         start_us = fmt.time_to_us(start_time) if start_time else None
@@ -275,10 +301,7 @@ class EventLogEvents(EventStore):
         until_time: Optional[_dt.datetime] = None,
         required: Optional[Sequence[str]] = None,
     ) -> dict[str, PropertyMap]:
-        try:
-            log = self._log(app_id, channel_id)
-        except StorageError:
-            raise
+        log = self._log(app_id, channel_id)
         flt = make_filter(
             start_time, until_time, entity_type, None, None,
         )

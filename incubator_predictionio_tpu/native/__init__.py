@@ -112,8 +112,6 @@ def get_lib() -> Any:
             ctypes.POINTER(_PlFilter),
             ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
         ]
-        lib.pl_count.restype = ctypes.c_int64
-        lib.pl_count.argtypes = [ctypes.c_char_p]
         lib.pl_free.restype = None
         lib.pl_free.argtypes = [ctypes.c_void_p]
         _lib = lib
@@ -211,12 +209,3 @@ def fold(path: str, flt: _PlFilter) -> Optional[bytes]:
     finally:
         lib.pl_free(buf)
 
-
-def count(path: str) -> Optional[int]:
-    lib = get_lib()
-    if lib is None:
-        return None
-    n = lib.pl_count(path.encode())
-    if n < 0:
-        raise OSError(f"native count failed for {path}")
-    return n

@@ -49,7 +49,7 @@ from __future__ import annotations
 import datetime as _dt
 import struct
 from collections.abc import Mapping
-from typing import Any, BinaryIO, Iterator, Optional
+from typing import Any, Iterator, Optional
 
 from incubator_predictionio_tpu.data.event import DataMap, Event
 
@@ -354,5 +354,16 @@ def read_log(
     return strings, offsets, dead
 
 
-def write_header(f: BinaryIO) -> None:
-    f.write(MAGIC)
+def valid_extent(buf: bytes) -> int:
+    """Byte offset just past the last complete record (i.e. where a torn or
+    zeroed tail begins; == len(buf) when the log is clean)."""
+    if buf[:8] != MAGIC:
+        raise ValueError("not a PIOLOG01 file")
+    pos = 8
+    n = len(buf)
+    while pos + 4 <= n:
+        (plen,) = struct.unpack_from("<I", buf, pos)
+        if pos + 4 + plen > n or plen == 0:
+            break
+        pos += 4 + plen
+    return pos

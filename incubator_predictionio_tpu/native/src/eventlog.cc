@@ -482,13 +482,6 @@ int64_t pl_fold(const char* path, const Filter* filter, uint8_t** out_buf) {
   return static_cast<int64_t>(out.size());
 }
 
-// Count live (non-tombstoned) events in the log. -1 on error.
-int64_t pl_count(const char* path) {
-  LogData log;
-  if (!load_log(path, &log)) return -1;
-  return static_cast<int64_t>(log.event_offsets.size());
-}
-
 void pl_free(void* p) { free(p); }
 
 }  // extern "C"

@@ -6,7 +6,10 @@ Parity target: tools/console/Console.scala:134-623 and commands/*. Verbs:
   app {new,list,show,delete,data-delete,channel-new,channel-delete},
   accesskey {new,list,delete},
   train, eval, deploy, undeploy, batchpredict, eventserver,
-  export, import
+  export, import,
+  start-all, stop-all (bin/pio-start-all / pio-stop-all: daemonize the
+  serving stack with pidfiles), redeploy (examples/redeploy-script: cron-able
+  train-with-retries + hot /reload of the deployed engine)
 
 Differences by design: no ``build`` verb (Python engines need no sbt/assembly
 step — the variant JSON's ``engineFactory`` import path replaces the built
@@ -333,6 +336,46 @@ def cmd_eventserver(args, storage: Storage) -> int:
     return 0
 
 
+def cmd_start_all(args, storage: Storage) -> int:
+    from incubator_predictionio_tpu.tools.ops import StartAllConfig, start_all
+
+    start_all(StartAllConfig(
+        ip=args.ip,
+        event_server_port=args.event_server_port,
+        with_dashboard=args.with_dashboard,
+        dashboard_port=args.dashboard_port,
+        with_adminserver=args.with_adminserver,
+        adminserver_port=args.adminserver_port,
+        stats=args.stats,
+        wait_secs=args.wait_secs,
+    ))
+    return 0
+
+
+def cmd_stop_all(args, storage: Storage) -> int:
+    from incubator_predictionio_tpu.tools.ops import stop_all
+
+    stop_all()
+    return 0
+
+
+def cmd_redeploy(args, storage: Storage) -> int:
+    from incubator_predictionio_tpu.tools.ops import RedeployConfig, redeploy
+
+    server_url = None if args.no_reload else f"http://{args.ip}:{args.port}"
+    instance_id = redeploy(RedeployConfig(
+        engine_variant=args.engine_variant,
+        batch=args.batch,
+        retries=args.retries,
+        retry_wait_secs=args.retry_wait,
+        server_url=server_url,
+        server_access_key=args.server_access_key,
+        interval_secs=args.interval,
+        mesh_axes=json.loads(args.mesh_axes) if args.mesh_axes else None,
+    ), storage)
+    return 0 if instance_id else 1
+
+
 def cmd_export(args, storage: Storage) -> int:
     from incubator_predictionio_tpu.tools.export_import import export_events
 
@@ -492,6 +535,30 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ip", default="127.0.0.1")
     p.add_argument("--port", type=int, default=7071)
 
+    # start-all / stop-all / redeploy
+    p = sub.add_parser("start-all")
+    p.add_argument("--ip", default="0.0.0.0")
+    p.add_argument("--event-server-port", type=int, default=7070)
+    p.add_argument("--with-dashboard", action="store_true")
+    p.add_argument("--dashboard-port", type=int, default=9000)
+    p.add_argument("--with-adminserver", action="store_true")
+    p.add_argument("--adminserver-port", type=int, default=7071)
+    p.add_argument("--stats", action="store_true")
+    p.add_argument("--wait-secs", type=float, default=60.0)
+    sub.add_parser("stop-all")
+    p = sub.add_parser("redeploy")
+    p.add_argument("-v", "--engine-variant", default="engine.json")
+    p.add_argument("--batch", default="")
+    p.add_argument("--retries", type=int, default=3)
+    p.add_argument("--retry-wait", type=float, default=30.0)
+    p.add_argument("--ip", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--server-access-key")
+    p.add_argument("--no-reload", action="store_true")
+    p.add_argument("--interval", type=float,
+                   help="seconds between passes; omit to run once")
+    p.add_argument("--mesh-axes", help='JSON, e.g. \'{"data": 4, "model": 2}\'')
+
     # export / import
     p = sub.add_parser("export")
     p.add_argument("--appid", type=int, required=True)
@@ -518,6 +585,9 @@ _COMMANDS = {
     "adminserver": cmd_adminserver,
     "export": cmd_export,
     "import": cmd_import,
+    "start-all": cmd_start_all,
+    "stop-all": cmd_stop_all,
+    "redeploy": cmd_redeploy,
 }
 
 _APP_COMMANDS = {

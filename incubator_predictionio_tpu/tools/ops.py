@@ -1,0 +1,255 @@
+"""Ops verbs: daemon supervision (start-all/stop-all) and the redeploy loop.
+
+Parity targets:
+
+- ``bin/pio-start-all`` / ``bin/pio-stop-all`` (reference bin/pio-start-all:1-60):
+  boot the serving stack. The reference also boots external storage services
+  (PGSQL/HBase/ES); this framework's builtin backends (sqlite/eventlog/memory)
+  are in-process, so start-all supervises only the framework's own servers —
+  event server always, dashboard/admin server opt-in.
+- ``bin/pio-daemon`` (nohup + pidfile): each server runs as a detached
+  subprocess with a pidfile under ``$PIO_FS_BASEDIR/pids`` and a log under
+  ``$PIO_FS_BASEDIR/logs``.
+- ``examples/redeploy-script/redeploy.sh``: the blessed cron retrain+redeploy
+  loop — train with retries, then hot-reload the deployed engine via its
+  ``POST /reload`` endpoint (the MasterActor ReloadServer analogue,
+  core/.../workflow/CreateServer.scala:317-343).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+from dataclasses import dataclass
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_DAEMONS = ("eventserver", "dashboard", "adminserver")
+
+
+def _base_dir() -> str:
+    return os.environ.get("PIO_FS_BASEDIR", os.path.expanduser("~/.pio_store"))
+
+
+def _pid_dir() -> str:
+    d = os.path.join(_base_dir(), "pids")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _log_dir() -> str:
+    d = os.path.join(_base_dir(), "logs")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _pid_file(name: str) -> str:
+    return os.path.join(_pid_dir(), f"{name}.pid")
+
+
+def _alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except (ProcessLookupError, PermissionError):
+        return False
+    return True
+
+
+def _read_pid(name: str) -> Optional[int]:
+    try:
+        with open(_pid_file(name)) as f:
+            return int(f.read().strip())
+    except (OSError, ValueError):
+        return None
+
+
+def _http_ok(url: str, timeout: float = 2.0) -> bool:
+    try:
+        with urllib.request.urlopen(url, timeout=timeout):
+            return True
+    except (urllib.error.URLError, OSError):
+        return False
+
+
+@dataclass
+class StartAllConfig:
+    ip: str = "0.0.0.0"
+    event_server_port: int = 7070
+    with_dashboard: bool = False
+    dashboard_port: int = 9000
+    with_adminserver: bool = False
+    adminserver_port: int = 7071
+    stats: bool = False
+    wait_secs: float = 60.0  # first-boot waits may pay a jax import
+
+
+def _spawn(name: str, argv: list[str]) -> int:
+    """Start one daemon: detached subprocess + pidfile + logfile."""
+    log_path = os.path.join(_log_dir(), f"{name}.log")
+    with open(log_path, "ab") as log:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "incubator_predictionio_tpu.tools.cli", *argv],
+            stdout=log,
+            stderr=subprocess.STDOUT,
+            start_new_session=True,  # survives the parent CLI exiting
+        )
+    with open(_pid_file(name), "w") as f:
+        f.write(str(proc.pid))
+    return proc.pid
+
+
+def start_all(config: StartAllConfig) -> dict[str, int]:
+    """Start the serving stack; returns {daemon: pid}. Idempotent per daemon."""
+    started: dict[str, int] = {}
+    plan: list[tuple[str, list[str], str]] = [(
+        "eventserver",
+        ["eventserver", "--ip", config.ip, "--port", str(config.event_server_port)]
+        + (["--stats"] if config.stats else []),
+        f"http://127.0.0.1:{config.event_server_port}/",
+    )]
+    if config.with_dashboard:
+        plan.append((
+            "dashboard",
+            ["dashboard", "--ip", config.ip, "--port", str(config.dashboard_port)],
+            f"http://127.0.0.1:{config.dashboard_port}/",
+        ))
+    if config.with_adminserver:
+        plan.append((
+            "adminserver",
+            ["adminserver", "--ip", config.ip, "--port", str(config.adminserver_port)],
+            f"http://127.0.0.1:{config.adminserver_port}/",
+        ))
+
+    health_urls: list[tuple[str, str]] = []
+    for name, argv, url in plan:
+        pid = _read_pid(name)
+        if pid is not None and _alive(pid):
+            print(f"{name} already running (pid {pid}).")
+            continue
+        pid = _spawn(name, argv)
+        started[name] = pid
+        health_urls.append((name, url))
+        print(f"Started {name} (pid {pid}), log: {os.path.join(_log_dir(), name + '.log')}")
+
+    deadline = time.monotonic() + config.wait_secs
+    pending = dict(health_urls)
+    while pending and time.monotonic() < deadline:
+        for name, url in list(pending.items()):
+            if _http_ok(url):
+                print(f"{name} is up.")
+                del pending[name]
+        if pending:
+            time.sleep(0.5)
+    for name in pending:
+        print(f"WARNING: {name} did not answer health check within "
+              f"{config.wait_secs:.0f}s — check its log.", file=sys.stderr)
+    return started
+
+
+def stop_all(timeout: float = 10.0) -> list[str]:
+    """Stop every pidfile-tracked daemon; returns the names stopped."""
+    stopped = []
+    for name in _DAEMONS:
+        pid = _read_pid(name)
+        if pid is None:
+            continue
+        if _alive(pid):
+            os.kill(pid, signal.SIGTERM)
+            deadline = time.monotonic() + timeout
+            while _alive(pid) and time.monotonic() < deadline:
+                time.sleep(0.1)
+            if _alive(pid):
+                os.kill(pid, signal.SIGKILL)
+            print(f"Stopped {name} (pid {pid}).")
+            stopped.append(name)
+        try:
+            os.remove(_pid_file(name))
+        except OSError:
+            pass
+    if not stopped:
+        print("No running daemons found.")
+    return stopped
+
+
+# ---------------------------------------------------------------------------
+# redeploy loop (examples/redeploy-script/redeploy.sh)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class RedeployConfig:
+    engine_variant: str = "engine.json"
+    batch: str = ""
+    retries: int = 3
+    retry_wait_secs: float = 30.0
+    # where the deployed engine server answers /reload; None skips the reload
+    server_url: Optional[str] = "http://127.0.0.1:8000"
+    server_access_key: Optional[str] = None
+    # run forever every interval_secs when set (cron-in-process)
+    interval_secs: Optional[float] = None
+    mesh_axes: Optional[dict] = None
+
+
+def redeploy_once(config: RedeployConfig, storage=None) -> Optional[str]:
+    """One train-with-retries + hot-reload pass.
+
+    Returns the new engine instance id, or None if every attempt failed.
+    """
+    from incubator_predictionio_tpu.core.workflow.create_workflow import (
+        WorkflowConfig,
+        create_workflow,
+    )
+    from incubator_predictionio_tpu.data.storage import get_storage
+
+    storage = storage or get_storage()
+    instance_id: Optional[str] = None
+    for attempt in range(1, config.retries + 1):
+        try:
+            instance_id = create_workflow(
+                WorkflowConfig(
+                    engine_variant=config.engine_variant,
+                    batch=config.batch or "redeploy",
+                    mesh_axes=config.mesh_axes,
+                ),
+                storage,
+            )
+            break
+        except Exception as e:  # noqa: BLE001 — retry loop must survive anything
+            logger.warning("train attempt %d/%d failed: %s", attempt, config.retries, e)
+            if attempt < config.retries:
+                time.sleep(config.retry_wait_secs)
+    if instance_id is None:
+        print(f"Training failed after {config.retries} attempts.", file=sys.stderr)
+        return None
+    print(f"Training completed. Engine instance ID: {instance_id}")
+
+    if config.server_url:
+        url = config.server_url.rstrip("/") + "/reload"
+        if config.server_access_key:
+            url += f"?accessKey={config.server_access_key}"
+        try:
+            req = urllib.request.Request(url, method="POST")
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                body = resp.read().decode()
+            print(f"Reloaded deployed engine: {body}")
+        except (urllib.error.URLError, OSError) as e:
+            print(f"WARNING: reload failed ({e}); the deployed engine keeps "
+                  "serving the previous instance.", file=sys.stderr)
+    return instance_id
+
+
+def redeploy(config: RedeployConfig, storage=None) -> Optional[str]:
+    """Run the redeploy pass once, or forever at ``interval_secs``."""
+    if config.interval_secs is None:
+        return redeploy_once(config, storage)
+    last = None
+    while True:
+        last = redeploy_once(config, storage)
+        time.sleep(config.interval_secs)
+    return last  # pragma: no cover — loop exits only by signal

@@ -1,0 +1,176 @@
+"""Ops tier: start-all/stop-all daemon supervision + redeploy loop.
+
+Parity targets: bin/pio-start-all, bin/pio-stop-all, bin/pio-daemon
+(pidfile supervision) and examples/redeploy-script/redeploy.sh.
+"""
+
+import datetime as dt
+import http.server
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from incubator_predictionio_tpu.data import DataMap, Event
+from incubator_predictionio_tpu.data.storage import App, Storage, use_storage
+from incubator_predictionio_tpu.tools import ops
+
+UTC = dt.timezone.utc
+
+
+# ---------------------------------------------------------------------------
+# pidfile supervision (unit level; subprocess spawning covered by the
+# integration test below)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def base_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv("PIO_FS_BASEDIR", str(tmp_path))
+    return tmp_path
+
+
+def test_pidfile_roundtrip_and_liveness(base_dir):
+    assert ops._read_pid("eventserver") is None
+    with open(ops._pid_file("eventserver"), "w") as f:
+        f.write(str(os.getpid()))
+    assert ops._read_pid("eventserver") == os.getpid()
+    assert ops._alive(os.getpid())
+    assert not ops._alive(2**22 - 1)  # unlikely-to-exist pid
+
+
+def test_stop_all_cleans_stale_pidfiles(base_dir, capsys):
+    with open(ops._pid_file("dashboard"), "w") as f:
+        f.write("999999999")  # dead pid
+    stopped = ops.stop_all()
+    assert stopped == []
+    assert not os.path.exists(ops._pid_file("dashboard"))
+
+
+def test_start_all_skips_running_daemon(base_dir, capsys, monkeypatch):
+    # a pidfile pointing at THIS process counts as "already running"
+    with open(ops._pid_file("eventserver"), "w") as f:
+        f.write(str(os.getpid()))
+    spawned = []
+    monkeypatch.setattr(ops, "_spawn", lambda name, argv: spawned.append(name) or 1)
+    started = ops.start_all(ops.StartAllConfig(wait_secs=0.0))
+    assert started == {} and spawned == []
+    assert "already running" in capsys.readouterr().out
+
+
+def test_start_all_spawn_plan(base_dir, monkeypatch):
+    spawned = {}
+
+    def fake_spawn(name, argv):
+        spawned[name] = argv
+        return 4242
+
+    monkeypatch.setattr(ops, "_spawn", fake_spawn)
+    monkeypatch.setattr(ops, "_http_ok", lambda url, timeout=2.0: True)
+    started = ops.start_all(ops.StartAllConfig(
+        event_server_port=17070, with_dashboard=True, dashboard_port=19000,
+        with_adminserver=True, adminserver_port=17071, stats=True, wait_secs=5.0,
+    ))
+    assert started == {"eventserver": 4242, "dashboard": 4242, "adminserver": 4242}
+    assert "17070" in spawned["eventserver"] and "--stats" in spawned["eventserver"]
+    assert "--port" in spawned["dashboard"] and "19000" in spawned["dashboard"]
+    assert "17071" in spawned["adminserver"]
+
+
+# ---------------------------------------------------------------------------
+# redeploy loop
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def trained_app(tmp_path):
+    """Storage with a classification app's events + an engine.json variant."""
+    s = Storage({"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+    prev = use_storage(s)
+    app_id = s.get_meta_data_apps().insert(App(0, "redeploy-test"))
+    es = s.get_events()
+    es.init(app_id)
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(48, 3))
+    y = (x[:, 0] > 0).astype(int)
+    for i in range(48):
+        es.insert(Event(
+            event="$set", entity_type="user", entity_id=f"u{i}",
+            properties=DataMap({"attr0": float(x[i, 0]), "attr1": float(x[i, 1]),
+                                "attr2": float(x[i, 2]), "plan": int(y[i])}),
+            event_time=dt.datetime(2020, 1, 1, tzinfo=UTC)), app_id)
+    variant = tmp_path / "engine.json"
+    variant.write_text(json.dumps({
+        "id": "default", "version": "1",
+        "engineFactory":
+            "incubator_predictionio_tpu.templates.classification.ClassificationEngine",
+        "datasource": {"params": {"appName": "redeploy-test"}},
+        "algorithms": [{"name": "mlp", "params": {
+            "hiddenDims": [4], "epochs": 10, "learningRate": 0.05,
+            "batchSize": 48}}],
+    }))
+    yield s, str(variant)
+    use_storage(prev)
+    s.close()
+
+
+def test_redeploy_once_trains_and_reloads(trained_app):
+    storage, variant = trained_app
+    reloads = []
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            reloads.append(self.path)
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.end_headers()
+            self.wfile.write(b'{"engineInstanceId": "x"}')
+
+        def log_message(self, *a):  # quiet
+            pass
+
+    httpd = http.server.HTTPServer(("127.0.0.1", 0), Handler)
+    port = httpd.server_address[1]
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    try:
+        instance_id = ops.redeploy_once(ops.RedeployConfig(
+            engine_variant=variant,
+            server_url=f"http://127.0.0.1:{port}",
+            server_access_key="sk",
+            retries=1,
+        ), storage)
+    finally:
+        httpd.shutdown()
+    assert instance_id is not None
+    inst = storage.get_meta_data_engine_instances().get(instance_id)
+    assert inst.status == "COMPLETED" and inst.batch == "redeploy"
+    assert reloads == ["/reload?accessKey=sk"]
+
+
+def test_redeploy_once_survives_unreachable_server(trained_app, capsys):
+    storage, variant = trained_app
+    instance_id = ops.redeploy_once(ops.RedeployConfig(
+        engine_variant=variant,
+        server_url="http://127.0.0.1:1",  # nothing listens there
+        retries=1,
+    ), storage)
+    assert instance_id is not None  # training result is kept
+    assert "reload failed" in capsys.readouterr().err
+
+
+def test_redeploy_retries_then_gives_up(trained_app, capsys):
+    storage, _ = trained_app
+    instance_id = ops.redeploy_once(ops.RedeployConfig(
+        engine_variant="/nonexistent/engine.json",
+        server_url=None, retries=2, retry_wait_secs=0.0,
+    ), storage)
+    assert instance_id is None
+    assert "failed after 2 attempts" in capsys.readouterr().err
+
+
+def test_redeploy_skips_reload_when_disabled(trained_app):
+    storage, variant = trained_app
+    instance_id = ops.redeploy_once(ops.RedeployConfig(
+        engine_variant=variant, server_url=None, retries=1), storage)
+    assert instance_id is not None
