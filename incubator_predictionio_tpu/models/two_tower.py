@@ -26,6 +26,7 @@ zero-weight rows.
 from __future__ import annotations
 
 import dataclasses
+import logging
 from functools import partial
 from typing import Optional
 
@@ -35,6 +36,8 @@ import numpy as np
 import optax
 
 from incubator_predictionio_tpu.parallel.mesh import MeshContext
+
+logger = logging.getLogger(__name__)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -46,6 +49,10 @@ class TwoTowerConfig:
     batch_size: int = 8192          # global batch
     implicit_negatives: int = 0     # >0 → implicit mode with sampled negatives
     seed: int = 0
+    # mid-training checkpoint/resume (utils/checkpoint.py); 0 = off
+    checkpoint_dir: Optional[str] = None
+    checkpoint_every: int = 0       # epochs between checkpoints
+    checkpoint_keep: int = 3
 
 
 @dataclasses.dataclass
@@ -164,6 +171,13 @@ class TwoTowerMF:
         }
         opt_state = optax.adam(cfg.learning_rate).init(params)
 
+        from incubator_predictionio_tpu.utils.checkpoint import maybe_resume, scalar
+
+        ckpt, params, opt_state, start_epoch = maybe_resume(
+            cfg.checkpoint_dir, cfg.checkpoint_every, cfg.checkpoint_keep,
+            params, opt_state, cfg.epochs, ctx.mesh,
+        )
+
         # The CPU backend's subgroup-collective rendezvous can deadlock when
         # async dispatch interleaves separate executions; serialize epochs
         # there. On TPU, sync sparsely — per-dispatch tunnel latency dominates
@@ -171,12 +185,19 @@ class TwoTowerMF:
         sync_every = 1 if ctx.mesh.devices.flat[0].platform == "cpu" else 8
 
         loss = np.inf
-        for e in range(cfg.epochs):
-            params, opt_state, loss = _train_epoch(
-                params, opt_state, ub, ib, rb, wb, cfg.learning_rate, cfg.reg
-            )
-            if (e + 1) % sync_every == 0:
-                loss.block_until_ready()
+        try:
+            for e in range(start_epoch, cfg.epochs):
+                params, opt_state, loss = _train_epoch(
+                    params, opt_state, ub, ib, rb, wb, cfg.learning_rate, cfg.reg
+                )
+                if (e + 1) % sync_every == 0:
+                    loss.block_until_ready()
+                if ckpt is not None and (e + 1) % cfg.checkpoint_every == 0:
+                    ckpt.save(e + 1, {"params": params, "opt": opt_state,
+                                      "epoch": scalar(e + 1)})
+        finally:
+            if ckpt is not None:
+                ckpt.close()
         # final host gather below (tree.map np.asarray) is the closing sync
 
         host = jax.tree.map(np.asarray, params)

@@ -185,6 +185,8 @@ class ALSAlgorithmParams(Params):
     learning_rate: float = 3e-2
     batch_size: int = 8192
     seed: Optional[int] = None
+    checkpoint_dir: Optional[str] = None   # mid-training resume (utils/checkpoint.py)
+    checkpoint_every: int = 0
 
 
 @dataclasses.dataclass
@@ -224,6 +226,8 @@ class ALSAlgorithm(PAlgorithm):
             epochs=p.num_iterations,
             batch_size=p.batch_size,
             seed=p.seed if p.seed is not None else 0,
+            checkpoint_dir=p.checkpoint_dir,
+            checkpoint_every=p.checkpoint_every,
         )
         mf = TwoTowerMF(cfg).fit(
             ctx,

@@ -132,6 +132,8 @@ class TransformerAlgorithmParams(Params):
     seed: int = 0
     attention: str = "auto"  # "auto" | "local" | "ring"
     recent_events: tuple[str, ...] = ("view", "buy")
+    checkpoint_dir: Optional[str] = None   # mid-training resume (utils/checkpoint.py)
+    checkpoint_every: int = 0
 
 
 class TransformerAlgorithm(PAlgorithm):
@@ -155,6 +157,8 @@ class TransformerAlgorithm(PAlgorithm):
             epochs=p.epochs,
             seed=p.seed,
             attention=p.attention,
+            checkpoint_dir=p.checkpoint_dir,
+            checkpoint_every=p.checkpoint_every,
         )
         return TransformerRecommender(cfg).fit(ctx, pd.sequences, pd.item_map)
 

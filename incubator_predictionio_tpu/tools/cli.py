@@ -339,7 +339,7 @@ def cmd_eventserver(args, storage: Storage) -> int:
 def cmd_start_all(args, storage: Storage) -> int:
     from incubator_predictionio_tpu.tools.ops import StartAllConfig, start_all
 
-    start_all(StartAllConfig(
+    _, unhealthy = start_all(StartAllConfig(
         ip=args.ip,
         event_server_port=args.event_server_port,
         with_dashboard=args.with_dashboard,
@@ -349,7 +349,7 @@ def cmd_start_all(args, storage: Storage) -> int:
         stats=args.stats,
         wait_secs=args.wait_secs,
     ))
-    return 0
+    return 1 if unhealthy else 0
 
 
 def cmd_stop_all(args, storage: Storage) -> int:

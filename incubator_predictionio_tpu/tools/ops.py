@@ -25,6 +25,7 @@ import subprocess
 import sys
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
 from dataclasses import dataclass
 from typing import Optional
@@ -105,26 +106,33 @@ def _spawn(name: str, argv: list[str]) -> int:
     return proc.pid
 
 
-def start_all(config: StartAllConfig) -> dict[str, int]:
-    """Start the serving stack; returns {daemon: pid}. Idempotent per daemon."""
+def start_all(config: StartAllConfig) -> tuple[dict[str, int], list[str]]:
+    """Start the serving stack. Idempotent per daemon.
+
+    Returns ``(started, unhealthy)``: {daemon: pid} for newly spawned daemons
+    and the names among them that never answered their health check.
+    """
     started: dict[str, int] = {}
+    # daemons bound to a wildcard address answer on loopback; a specific
+    # --ip must be health-checked at that address
+    health_host = "127.0.0.1" if config.ip in ("0.0.0.0", "::") else config.ip
     plan: list[tuple[str, list[str], str]] = [(
         "eventserver",
         ["eventserver", "--ip", config.ip, "--port", str(config.event_server_port)]
         + (["--stats"] if config.stats else []),
-        f"http://127.0.0.1:{config.event_server_port}/",
+        f"http://{health_host}:{config.event_server_port}/",
     )]
     if config.with_dashboard:
         plan.append((
             "dashboard",
             ["dashboard", "--ip", config.ip, "--port", str(config.dashboard_port)],
-            f"http://127.0.0.1:{config.dashboard_port}/",
+            f"http://{health_host}:{config.dashboard_port}/",
         ))
     if config.with_adminserver:
         plan.append((
             "adminserver",
             ["adminserver", "--ip", config.ip, "--port", str(config.adminserver_port)],
-            f"http://127.0.0.1:{config.adminserver_port}/",
+            f"http://{health_host}:{config.adminserver_port}/",
         ))
 
     health_urls: list[tuple[str, str]] = []
@@ -150,7 +158,7 @@ def start_all(config: StartAllConfig) -> dict[str, int]:
     for name in pending:
         print(f"WARNING: {name} did not answer health check within "
               f"{config.wait_secs:.0f}s — check its log.", file=sys.stderr)
-    return started
+    return started, list(pending)
 
 
 def stop_all(timeout: float = 10.0) -> list[str]:
@@ -232,7 +240,9 @@ def redeploy_once(config: RedeployConfig, storage=None) -> Optional[str]:
     if config.server_url:
         url = config.server_url.rstrip("/") + "/reload"
         if config.server_access_key:
-            url += f"?accessKey={config.server_access_key}"
+            url += "?" + urllib.parse.urlencode(
+                {"accessKey": config.server_access_key}
+            )
         try:
             req = urllib.request.Request(url, method="POST")
             with urllib.request.urlopen(req, timeout=30) as resp:

@@ -1,0 +1,172 @@
+"""Mid-training checkpoint/resume — the capability the reference lacks.
+
+The reference has model-level persistence only: a training run either finishes
+and Kryo-serializes its models into MODELDATA (workflow/CoreWorkflow.scala:79-84)
+or leaves nothing; non-persistable ``P`` models are even *retrained from
+scratch at deploy* (controller/Engine.scala:210-232). SURVEY §5 marks this the
+explicit tradeoff to beat: orbax checkpoints make it obsolete.
+
+:class:`TrainCheckpointer` wraps ``orbax.checkpoint.CheckpointManager`` with
+the narrow contract the trainers need:
+
+- ``save(step, state)`` — state is any pytree of jax/numpy arrays (params +
+  optimizer state + epoch counter); sharded ``jax.Array`` leaves are written
+  natively, no host gather required;
+- ``latest_step()`` / ``restore(step, like=...)`` — restoring against a
+  ``like`` template of freshly-initialized device arrays brings leaves back
+  *with the template's shardings*, so a resumed run continues on the same mesh
+  layout without extra device_puts;
+- retention via ``max_to_keep`` (old steps garbage-collected).
+
+Trainers opt in through their config (``checkpoint_dir`` + ``checkpoint_every``
+on :class:`~incubator_predictionio_tpu.models.two_tower.TwoTowerConfig` and
+:class:`~incubator_predictionio_tpu.models.transformer.TransformerConfig`);
+a fit() pointed at a directory holding earlier steps resumes from the latest
+one instead of starting over.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+class TrainCheckpointer:
+    """Step-indexed pytree checkpoints in ``directory`` (created on demand)."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                # synchronous writes: save() returning means the step is
+                # durable — the property resume correctness rests on
+                enable_async_checkpointing=False,
+            ),
+        )
+
+    def save(self, step: int, state: Any) -> None:
+        self._mgr.save(step, args=self._ocp.args.StandardSave(state))
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def all_steps(self) -> list[int]:
+        return sorted(self._mgr.all_steps())
+
+    def delete_all(self) -> None:
+        """Drop every saved step (stale state from a prior completed run)."""
+        for step in self.all_steps():
+            self._mgr.delete(step)
+
+    def restore(self, step: Optional[int] = None, like: Any = None) -> Any:
+        """Restore ``step`` (default: latest). With ``like``, leaves come back
+        matching the template's dtypes/shardings (device arrays stay device
+        arrays); without it, plain host numpy in generic containers."""
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        if like is not None:
+            args = self._ocp.args.StandardRestore(like)
+            return self._mgr.restore(step, args=args)
+        return self._mgr.restore(step)
+
+    def close(self) -> None:
+        self._mgr.close()
+
+    def __enter__(self) -> "TrainCheckpointer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def scalar(x: int) -> np.ndarray:
+    """Wrap a python int as an array leaf (checkpoint trees hold arrays)."""
+    return np.asarray(x, np.int32)
+
+
+def maybe_resume(
+    directory: Optional[str],
+    every: int,
+    keep: int,
+    params: Any,
+    opt_state: Any,
+    epochs: int,
+    mesh,
+) -> tuple[Optional[TrainCheckpointer], Any, Any, int]:
+    """Open a checkpointer and resume an interrupted run if one is recoverable.
+
+    Returns ``(ckpt, params, opt_state, start_epoch)`` — the single entry
+    point both trainers share. Three non-resume outcomes all mean "train from
+    scratch" (``start_epoch == 0``):
+
+    - checkpointing disabled (no directory / ``every <= 0``): ``ckpt is None``;
+    - restore fails (e.g. the vocabulary grew between redeploy passes, so the
+      stored tables no longer match the new run's shapes): stale state is
+      deleted, fresh start;
+    - latest step >= ``epochs``: leftover state from a prior *completed* run —
+      this is a new run on possibly-new data, so it must not short-circuit.
+
+    The caller owns ``ckpt.close()`` (wrap the epoch loop in try/finally).
+    """
+    if not directory or every <= 0:
+        return None, params, opt_state, 0
+    ck = TrainCheckpointer(directory, max_to_keep=keep)
+    if ck.latest_step() is None:
+        return ck, params, opt_state, 0
+    try:
+        state = restore_placed(
+            ck, {"params": params, "opt": opt_state, "epoch": scalar(0)}, mesh
+        )
+        resumed = int(state["epoch"])
+    except Exception as e:  # noqa: BLE001 — any restore failure ⇒ fresh start
+        logger.warning(
+            "checkpoint restore from %s failed (%s): restarting fresh",
+            directory, e,
+        )
+        ck.delete_all()
+        return ck, params, opt_state, 0
+    if resumed >= epochs:
+        logger.warning(
+            "checkpoint at epoch %d >= epochs %d in %s: stale completed-run "
+            "state, restarting fresh", resumed, epochs, directory,
+        )
+        ck.delete_all()  # step numbers will be re-saved
+        return ck, params, opt_state, 0
+    return ck, state["params"], state["opt"], resumed
+
+
+def restore_placed(ck: TrainCheckpointer, like: Any, mesh) -> Any:
+    """Restore the latest step and re-place every leaf for ``mesh``.
+
+    Orbax restores leaves committed to specific devices. Leaves whose template
+    carries a ``NamedSharding`` keep it; everything else (optimizer scalar
+    counts, host arrays) is replicated over the mesh — a committed
+    single-device scalar next to mesh-sharded params is a jit device-mismatch
+    error otherwise.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    state = ck.restore(like=like)
+    replicated = NamedSharding(mesh, PartitionSpec())
+
+    def put(template, value):
+        sh = getattr(template, "sharding", None)
+        if isinstance(sh, NamedSharding):
+            return jax.device_put(value, sh)
+        return jax.device_put(value, replicated)
+
+    return jax.tree.map(put, like, state)

@@ -54,8 +54,8 @@ def test_start_all_skips_running_daemon(base_dir, capsys, monkeypatch):
         f.write(str(os.getpid()))
     spawned = []
     monkeypatch.setattr(ops, "_spawn", lambda name, argv: spawned.append(name) or 1)
-    started = ops.start_all(ops.StartAllConfig(wait_secs=0.0))
-    assert started == {} and spawned == []
+    started, unhealthy = ops.start_all(ops.StartAllConfig(wait_secs=0.0))
+    assert started == {} and spawned == [] and unhealthy == []
     assert "already running" in capsys.readouterr().out
 
 
@@ -68,14 +68,33 @@ def test_start_all_spawn_plan(base_dir, monkeypatch):
 
     monkeypatch.setattr(ops, "_spawn", fake_spawn)
     monkeypatch.setattr(ops, "_http_ok", lambda url, timeout=2.0: True)
-    started = ops.start_all(ops.StartAllConfig(
+    started, unhealthy = ops.start_all(ops.StartAllConfig(
         event_server_port=17070, with_dashboard=True, dashboard_port=19000,
         with_adminserver=True, adminserver_port=17071, stats=True, wait_secs=5.0,
     ))
     assert started == {"eventserver": 4242, "dashboard": 4242, "adminserver": 4242}
+    assert unhealthy == []
     assert "17070" in spawned["eventserver"] and "--stats" in spawned["eventserver"]
     assert "--port" in spawned["dashboard"] and "19000" in spawned["dashboard"]
     assert "17071" in spawned["adminserver"]
+
+
+def test_start_all_reports_unhealthy_and_polls_bound_ip(base_dir, monkeypatch):
+    urls: list[str] = []
+    monkeypatch.setattr(ops, "_spawn", lambda name, argv: 4242)
+
+    def never_ok(url, timeout=2.0):
+        urls.append(url)
+        return False
+
+    monkeypatch.setattr(ops, "_http_ok", never_ok)
+    started, unhealthy = ops.start_all(
+        ops.StartAllConfig(ip="10.1.2.3", wait_secs=0.6)
+    )
+    assert started == {"eventserver": 4242}
+    assert unhealthy == ["eventserver"]
+    # non-wildcard --ip must be health-checked at that address, not loopback
+    assert urls and all("10.1.2.3" in u for u in urls)
 
 
 # ---------------------------------------------------------------------------
