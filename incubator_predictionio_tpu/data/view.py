@@ -1,0 +1,123 @@
+"""Columnar views over events — the DataView / batch-view counterpart.
+
+The reference's view layer (data/view/{DataView,LBatchView,PBatchView}.scala)
+turns event streams into Spark DataFrames / aggregated maps for ad-hoc
+analysis; `DataView.create` (DataView.scala:40) is the non-deprecated entry.
+Here the tabular target is columnar numpy — the layout every downstream
+consumer in this framework (jax staging, vectorizers, notebooks) wants:
+
+- :func:`events_to_columns` — event stream → dict of aligned numpy columns
+  (core fields + requested property columns with dtype inference);
+- :func:`properties_to_columns` — ``aggregate_properties`` snapshots →
+  entity-per-row columnar table.
+
+Column conventions: string-ish fields are object arrays with ``None`` for
+missing; numeric property columns are float64 with NaN for missing;
+``event_time``/``creation_time`` are numpy ``datetime64[ms]`` (UTC).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+from typing import Iterable, Mapping, Optional, Sequence
+
+import numpy as np
+
+from incubator_predictionio_tpu.data.event import Event, PropertyMap
+
+
+def _to_dt64(t: _dt.datetime) -> np.datetime64:
+    # store UTC wall-clock; datetime64 is naive so strip tzinfo after shifting
+    if t.tzinfo is not None:
+        t = t.astimezone(_dt.timezone.utc).replace(tzinfo=None)
+    return np.datetime64(t, "ms")
+
+
+def _object_column(values: list) -> np.ndarray:
+    # elementwise fill: np.asarray(list-of-lists, object) would build a 2-D
+    # array for equal-length list values instead of a 1-D column of objects
+    col = np.empty(len(values), object)
+    for i, v in enumerate(values):
+        col[i] = v
+    return col
+
+
+def _property_column(values: list, numeric: bool) -> np.ndarray:
+    if numeric:
+        col = np.full(len(values), np.nan, np.float64)
+        for i, v in enumerate(values):
+            if v is not None:
+                col[i] = float(v)
+        return col
+    return _object_column(values)
+
+
+def events_to_columns(
+    events: Iterable[Event],
+    property_fields: Optional[Sequence[str]] = None,
+) -> dict[str, np.ndarray]:
+    """Materialize an event stream as aligned numpy columns.
+
+    Core columns: ``event``, ``entity_type``, ``entity_id``,
+    ``target_entity_type``, ``target_entity_id``, ``pr_id``, ``event_time``,
+    ``creation_time``. Each name in ``property_fields`` adds a column from
+    ``event.properties`` — float64/NaN when every present value is numeric
+    (bool counts as numeric 0/1), object/None otherwise.
+    """
+    evs = list(events)
+    props = list(property_fields or ())
+    cols: dict[str, np.ndarray] = {
+        "event": np.asarray([e.event for e in evs], object),
+        "entity_type": np.asarray([e.entity_type for e in evs], object),
+        "entity_id": np.asarray([e.entity_id for e in evs], object),
+        "target_entity_type": np.asarray(
+            [e.target_entity_type for e in evs], object),
+        "target_entity_id": np.asarray(
+            [e.target_entity_id for e in evs], object),
+        "pr_id": np.asarray([e.pr_id for e in evs], object),
+        "event_time": np.asarray([_to_dt64(e.event_time) for e in evs],
+                                 "datetime64[ms]"),
+        "creation_time": np.asarray([_to_dt64(e.creation_time) for e in evs],
+                                    "datetime64[ms]"),
+    }
+    for name in props:
+        values = [e.properties.get(name) for e in evs]
+        present = [v for v in values if v is not None]
+        numeric = bool(present) and all(
+            isinstance(v, (int, float, bool)) for v in present
+        )
+        cols[name] = _property_column(values, numeric)
+    return cols
+
+
+def properties_to_columns(
+    snapshots: Mapping[str, PropertyMap],
+    fields: Optional[Sequence[str]] = None,
+) -> dict[str, np.ndarray]:
+    """``aggregate_properties`` result → entity-per-row columnar table.
+
+    Columns: ``entity_id``, ``first_updated``, ``last_updated``, plus one per
+    requested field (default: union of fields across all snapshots, sorted).
+    Rows are sorted by entity id for deterministic downstream staging.
+    """
+    ids = sorted(snapshots)
+    if fields is None:
+        seen: set[str] = set()
+        for pm in snapshots.values():
+            seen.update(pm.keys())
+        fields = sorted(seen)
+    cols: dict[str, np.ndarray] = {
+        "entity_id": np.asarray(ids, object),
+        "first_updated": np.asarray(
+            [_to_dt64(snapshots[i].first_updated) for i in ids], "datetime64[ms]"),
+        "last_updated": np.asarray(
+            [_to_dt64(snapshots[i].last_updated) for i in ids], "datetime64[ms]"),
+    }
+    for name in fields:
+        values = [snapshots[i].get(name) for i in ids]
+        present = [v for v in values if v is not None]
+        numeric = bool(present) and all(
+            isinstance(v, (int, float, bool)) for v in present
+        )
+        cols[name] = _property_column(values, numeric)
+    return cols

@@ -186,26 +186,15 @@ class TransformerRecommender:
             (p, o), losses = jax.lax.scan(step, (p, o), (tb, pb, yb, wb))
             return p, o, losses.mean()
 
-        from incubator_predictionio_tpu.utils.checkpoint import maybe_resume, scalar
-
-        ckpt, params, opt_state, start_epoch = maybe_resume(
-            cfg.checkpoint_dir, cfg.checkpoint_every, cfg.checkpoint_keep,
-            params, opt_state, cfg.epochs, ctx.mesh,
-        )
+        from incubator_predictionio_tpu.utils.checkpoint import checkpointed_epochs
 
         sync_every = 1 if ctx.mesh.devices.flat[0].platform == "cpu" else 8
-        loss = None
-        try:
-            for e in range(start_epoch, cfg.epochs):
-                params, opt_state, loss = train_epoch(params, opt_state)
-                if (e + 1) % sync_every == 0:
-                    loss.block_until_ready()
-                if ckpt is not None and (e + 1) % cfg.checkpoint_every == 0:
-                    ckpt.save(e + 1, {"params": params, "opt": opt_state,
-                                      "epoch": scalar(e + 1)})
-        finally:
-            if ckpt is not None:
-                ckpt.close()
+        params, opt_state, loss = checkpointed_epochs(
+            cfg.checkpoint_dir, cfg.checkpoint_every, cfg.checkpoint_keep,
+            cfg.epochs, params, opt_state, ctx.mesh,
+            lambda p, o: train_epoch(p, o),
+            sync_every,
+        )
 
         model = TransformerModel(jax.tree.map(np.asarray, params), item_map, cfg)
         model.final_loss = float(loss) if loss is not None else float("nan")

@@ -26,7 +26,6 @@ zero-weight rows.
 from __future__ import annotations
 
 import dataclasses
-import logging
 from functools import partial
 from typing import Optional
 
@@ -36,8 +35,6 @@ import numpy as np
 import optax
 
 from incubator_predictionio_tpu.parallel.mesh import MeshContext
-
-logger = logging.getLogger(__name__)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -171,12 +168,7 @@ class TwoTowerMF:
         }
         opt_state = optax.adam(cfg.learning_rate).init(params)
 
-        from incubator_predictionio_tpu.utils.checkpoint import maybe_resume, scalar
-
-        ckpt, params, opt_state, start_epoch = maybe_resume(
-            cfg.checkpoint_dir, cfg.checkpoint_every, cfg.checkpoint_keep,
-            params, opt_state, cfg.epochs, ctx.mesh,
-        )
+        from incubator_predictionio_tpu.utils.checkpoint import checkpointed_epochs
 
         # The CPU backend's subgroup-collective rendezvous can deadlock when
         # async dispatch interleaves separate executions; serialize epochs
@@ -184,20 +176,16 @@ class TwoTowerMF:
         # small steps otherwise.
         sync_every = 1 if ctx.mesh.devices.flat[0].platform == "cpu" else 8
 
-        loss = np.inf
-        try:
-            for e in range(start_epoch, cfg.epochs):
-                params, opt_state, loss = _train_epoch(
-                    params, opt_state, ub, ib, rb, wb, cfg.learning_rate, cfg.reg
-                )
-                if (e + 1) % sync_every == 0:
-                    loss.block_until_ready()
-                if ckpt is not None and (e + 1) % cfg.checkpoint_every == 0:
-                    ckpt.save(e + 1, {"params": params, "opt": opt_state,
-                                      "epoch": scalar(e + 1)})
-        finally:
-            if ckpt is not None:
-                ckpt.close()
+        params, opt_state, loss = checkpointed_epochs(
+            cfg.checkpoint_dir, cfg.checkpoint_every, cfg.checkpoint_keep,
+            cfg.epochs, params, opt_state, ctx.mesh,
+            lambda p, o: _train_epoch(
+                p, o, ub, ib, rb, wb, cfg.learning_rate, cfg.reg
+            ),
+            sync_every,
+        )
+        if loss is None:
+            loss = np.inf
         # final host gather below (tree.map np.asarray) is the closing sync
 
         host = jax.tree.map(np.asarray, params)

@@ -7,16 +7,23 @@ feature properties plus a label property (DataSource.scala reads attr0-2 +
 (NaiveBayesAlgorithm.scala:36-60), queries carry a feature vector and get a
 predicted label back.
 
-Here the algorithm is the JAX MLP (models/mlp.py) trained data-parallel on
-the mesh; k-fold eval folds are produced the reference way (readEval) using
-deterministic hashing.
+Here the flagship algorithm is the JAX MLP (models/mlp.py) trained
+data-parallel on the mesh; the "add-algorithm" variant of the reference
+example (a second algorithm registered next to the first, with serving
+combining their answers) is mirrored by :class:`NaiveBayesAlgorithm`
+(Gaussian NB over the numeric features, fit/scored on-device) plus
+:class:`VoteServing` (majority vote across algorithms). k-fold eval folds
+are produced the reference way (readEval) using deterministic hashing.
 """
 
 from __future__ import annotations
 
 import dataclasses
+from collections import Counter
 from typing import Optional, Sequence
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from incubator_predictionio_tpu.core import (
@@ -24,6 +31,7 @@ from incubator_predictionio_tpu.core import (
     EngineFactory,
     FirstServing,
     IdentityPreparator,
+    LServing,
     P2LAlgorithm,
     Params,
     PDataSource,
@@ -167,6 +175,109 @@ class MLPAlgorithm(P2LAlgorithm):
         return [(i, PredictedResult(label=l)) for (i, _), l in zip(queries, labels)]
 
 
+# -- second algorithm: Gaussian naive Bayes (the "add-algorithm" variant) ---
+
+@dataclasses.dataclass(frozen=True)
+class NaiveBayesAlgorithmParams(Params):
+    var_smoothing: float = 1e-6
+    seed: int = 0  # unused (closed-form fit); kept for params-surface parity
+
+
+@dataclasses.dataclass
+class NaiveBayesModel:
+    classes: np.ndarray   # [c] original label values
+    means: np.ndarray     # [c, d]
+    variances: np.ndarray # [c, d]
+    log_priors: np.ndarray  # [c]
+
+
+def _nb_fit(x, y_idx, n_classes: int, smoothing: float):
+    ones = jnp.ones(x.shape[0], jnp.float32)
+    counts = jax.ops.segment_sum(ones, y_idx, n_classes)
+    means = jax.ops.segment_sum(x, y_idx, n_classes) / counts[:, None]
+    # variance as mean squared deviation (E[x²]−E[x]² cancels catastrophically
+    # in float32 for large-magnitude/small-spread features), floored at the
+    # smoothing so constant columns stay positive
+    dev = x - means[y_idx]
+    variances = jax.ops.segment_sum(dev * dev, y_idx, n_classes) / counts[:, None]
+    variances = jnp.maximum(variances, smoothing)
+    log_priors = jnp.log(counts / counts.sum())
+    return means, variances, log_priors
+
+
+@jax.jit
+def _nb_loglik(x, means, variances, log_priors):
+    # [b, 1, d] against [c, d]: full Gaussian log-likelihood per class
+    quad = (x[:, None, :] - means[None]) ** 2 / variances[None]
+    ll = -0.5 * (jnp.log(2.0 * jnp.pi * variances)[None] + quad).sum(-1)
+    return ll + log_priors[None, :]
+
+
+class NaiveBayesAlgorithm(P2LAlgorithm):
+    """Second algorithm of the reference add-algorithm example
+    (examples/scala-parallel-classification/add-algorithm/): MLlib NaiveBayes
+    there; Gaussian NB over the numeric feature columns here, with the
+    closed-form fit and the scoring pass both running as jax ops."""
+
+    params_class = NaiveBayesAlgorithmParams
+    query_cls = Query
+
+    def train(self, ctx: MeshContext, pd: TrainingData) -> NaiveBayesModel:
+        classes, y_idx = np.unique(pd.y, return_inverse=True)
+        means, variances, log_priors = _nb_fit(
+            jnp.asarray(pd.x), jnp.asarray(y_idx.astype(np.int32)),
+            len(classes), self.params.var_smoothing,
+        )
+        return NaiveBayesModel(
+            classes=classes,
+            means=np.asarray(means),
+            variances=np.asarray(variances),
+            log_priors=np.asarray(log_priors),
+        )
+
+    def _scores(self, model: NaiveBayesModel, x: np.ndarray) -> np.ndarray:
+        return np.asarray(_nb_loglik(
+            x, model.means, model.variances, model.log_priors
+        ))
+
+    def predict(self, model: NaiveBayesModel, query: Query) -> PredictedResult:
+        ll = self._scores(model, np.asarray([query.features], np.float32))[0]
+        probs = np.exp(ll - ll.max())
+        probs /= probs.sum()
+        return PredictedResult(
+            label=model.classes[int(ll.argmax())],
+            scores={str(c): float(p) for c, p in zip(model.classes, probs)},
+        )
+
+    def batch_predict(
+        self, model: NaiveBayesModel, queries: Sequence[tuple[int, Query]]
+    ) -> list[tuple[int, PredictedResult]]:
+        if not queries:
+            return []
+        x = np.asarray([q.features for _, q in queries], np.float32)
+        ll = self._scores(model, x)
+        return [
+            (i, PredictedResult(label=model.classes[int(row.argmax())]))
+            for (i, _), row in zip(queries, ll)
+        ]
+
+
+class VoteServing(LServing):
+    """Majority vote over per-algorithm labels; ties go to the first
+    algorithm's answer (the reference example's serving combines multiple
+    algorithm outputs — LServing.serve sees one P per algorithm)."""
+
+    def serve(self, query: Query, predictions: Sequence[PredictedResult]) -> PredictedResult:
+        if not predictions:
+            raise ValueError("no predictions to serve")
+        votes = Counter(p.label for p in predictions)
+        top = max(votes.values())
+        for p in predictions:  # first algorithm wins ties
+            if votes[p.label] == top:
+                return p
+        raise AssertionError("unreachable")
+
+
 # -- metric -----------------------------------------------------------------
 
 class Accuracy(AverageMetric):
@@ -183,6 +294,6 @@ class ClassificationEngine(EngineFactory):
         return Engine(
             DataSource,
             IdentityPreparator,
-            {"mlp": MLPAlgorithm, "": MLPAlgorithm},
-            FirstServing,
+            {"mlp": MLPAlgorithm, "nb": NaiveBayesAlgorithm, "": MLPAlgorithm},
+            {"first": FirstServing, "vote": VoteServing, "": FirstServing},
         )

@@ -230,7 +230,15 @@ def cmd_train(args, storage: Storage) -> int:
         stop_after_prepare=args.stop_after_prepare,
         mesh_axes=axes,
     )
-    instance_id = create_workflow(config, storage)
+    if getattr(args, "profile_dir", None):
+        from incubator_predictionio_tpu.utils.tracing import profile_trace
+
+        with profile_trace(args.profile_dir):
+            instance_id = create_workflow(config, storage)
+        _out(f"Profiler trace written to {args.profile_dir} "
+             "(TensorBoard 'profile' plugin layout).")
+    else:
+        instance_id = create_workflow(config, storage)
     _out(f"Training completed. Engine instance ID: {instance_id}")
     return 0
 
@@ -412,6 +420,13 @@ def cmd_status(args, storage: Storage) -> int:
     devices = jax.devices()
     _out(f"Devices: {len(devices)} × {devices[0].platform}"
          f" ({devices[0].device_kind})")
+    from incubator_predictionio_tpu.utils.tracing import device_memory_report
+
+    for row in device_memory_report():
+        if row["bytes_in_use"] is not None:
+            _out(f"  {row['device']}: {row['bytes_in_use'] / 2**20:.1f} MiB in use"
+                 + (f" / {row['bytes_limit'] / 2**20:.0f} MiB"
+                    if row["bytes_limit"] else ""))
     failures = storage.verify_all_data_objects()
     if failures:
         for f in failures:
@@ -487,6 +502,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--stop-after-read", action="store_true")
     p.add_argument("--stop-after-prepare", action="store_true")
     p.add_argument("--mesh-axes", help='JSON, e.g. \'{"data": 4, "model": 2}\'')
+    p.add_argument("--profile-dir",
+                   help="capture a jax.profiler trace of the run into this dir")
 
     # eval
     p = sub.add_parser("eval")

@@ -75,7 +75,8 @@ def _http_ok(url: str, timeout: float = 2.0) -> bool:
     try:
         with urllib.request.urlopen(url, timeout=timeout):
             return True
-    except (urllib.error.URLError, OSError):
+    except (urllib.error.URLError, OSError, ValueError):
+        # ValueError covers http.client.InvalidURL (malformed host/port)
         return False
 
 
@@ -114,8 +115,13 @@ def start_all(config: StartAllConfig) -> tuple[dict[str, int], list[str]]:
     """
     started: dict[str, int] = {}
     # daemons bound to a wildcard address answer on loopback; a specific
-    # --ip must be health-checked at that address
-    health_host = "127.0.0.1" if config.ip in ("0.0.0.0", "::") else config.ip
+    # --ip must be health-checked at that address (IPv6 literals need brackets)
+    if config.ip in ("0.0.0.0", "::"):
+        health_host = "127.0.0.1"
+    elif ":" in config.ip:
+        health_host = f"[{config.ip}]"
+    else:
+        health_host = config.ip
     plan: list[tuple[str, list[str], str]] = [(
         "eventserver",
         ["eventserver", "--ip", config.ip, "--port", str(config.event_server_port)]

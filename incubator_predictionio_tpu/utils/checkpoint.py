@@ -148,6 +148,48 @@ def maybe_resume(
     return ck, state["params"], state["opt"], resumed
 
 
+def checkpointed_epochs(
+    directory: Optional[str],
+    every: int,
+    keep: int,
+    epochs: int,
+    params: Any,
+    opt_state: Any,
+    mesh,
+    train_one_epoch,
+    sync_every: int,
+) -> tuple[Any, Any, Any]:
+    """The shared epoch driver both trainers run.
+
+    Resumes via :func:`maybe_resume`, then runs
+    ``train_one_epoch(params, opt_state) -> (params, opt_state, loss)`` for
+    the remaining epochs with profiler step annotations, a device sync every
+    ``sync_every`` epochs (CPU backends need per-epoch serialization; on TPU
+    sparse syncs amortize dispatch latency), and a checkpoint every ``every``
+    epochs. The checkpointer is closed even if an epoch raises. Returns
+    ``(params, opt_state, loss)``; ``loss`` is ``None`` when no epoch ran.
+    """
+    from incubator_predictionio_tpu.utils.tracing import step_annotation
+
+    ckpt, params, opt_state, start_epoch = maybe_resume(
+        directory, every, keep, params, opt_state, epochs, mesh
+    )
+    loss = None
+    try:
+        for e in range(start_epoch, epochs):
+            with step_annotation("train_epoch", e):
+                params, opt_state, loss = train_one_epoch(params, opt_state)
+            if (e + 1) % sync_every == 0:
+                loss.block_until_ready()
+            if ckpt is not None and (e + 1) % every == 0:
+                ckpt.save(e + 1, {"params": params, "opt": opt_state,
+                                  "epoch": scalar(e + 1)})
+    finally:
+        if ckpt is not None:
+            ckpt.close()
+    return params, opt_state, loss
+
+
 def restore_placed(ck: TrainCheckpointer, like: Any, mesh) -> Any:
     """Restore the latest step and re-place every leaf for ``mesh``.
 

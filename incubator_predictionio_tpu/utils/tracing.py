@@ -1,0 +1,76 @@
+"""Profiling/tracing hooks — the jax.profiler equivalents of SURVEY §5.
+
+The reference's observability is two serving counters on the engine status
+page (CreateServer.scala:578-585), opt-in event-server stats
+(data/api/Stats.scala:51), and delegation to the Spark UI for anything
+compute-side. The counters live on in the query/event servers
+(server/query_server.py, server/stats.py); this module supplies the
+compute-side story the Spark UI used to cover:
+
+- :func:`profile_trace` — capture an XLA/TPU profiler trace of any block
+  (training run, batch-predict pass) into a TensorBoard-readable log dir;
+  exposed as ``pio-tpu train --profile-dir DIR``;
+- :func:`annotate` / :func:`step_annotation` — named host-side spans that
+  show up on the trace timeline (wrap one epoch, one request batch…);
+- :func:`device_memory_report` — per-device HBM in-use/limit snapshot,
+  printed by ``pio-tpu status`` (platforms without allocator stats — CPU —
+  report empty dicts).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Iterator, Optional
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: str) -> Iterator[None]:
+    """Capture a jax.profiler trace of the enclosed block into ``log_dir``.
+
+    The output is the standard XLA profile (TensorBoard 'profile' plugin
+    layout) containing device timelines, HLO cost breakdowns, and any
+    :func:`annotate` spans opened inside the block.
+    """
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named span context manager visible on the profiler timeline."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
+
+
+def step_annotation(name: str, step: Optional[int] = None):
+    """Span carrying a step number — the profiler groups per-step stats."""
+    import jax
+
+    if step is None:
+        return jax.profiler.StepTraceAnnotation(name)
+    return jax.profiler.StepTraceAnnotation(name, step_num=step)
+
+
+def device_memory_report() -> list[dict[str, Any]]:
+    """One row per local device: platform + allocator stats when available."""
+    import jax
+
+    rows: list[dict[str, Any]] = []
+    for d in jax.local_devices():
+        try:
+            stats = d.memory_stats() or {}
+        except Exception:  # noqa: BLE001 — CPU/older backends have no stats
+            stats = {}
+        rows.append({
+            "device": str(d),
+            "platform": d.platform,
+            "bytes_in_use": stats.get("bytes_in_use"),
+            "bytes_limit": stats.get("bytes_limit"),
+            "peak_bytes_in_use": stats.get("peak_bytes_in_use"),
+        })
+    return rows

@@ -21,7 +21,10 @@ from incubator_predictionio_tpu.templates.classification import (
     ClassificationEngine,
     DataSourceParams,
     MLPAlgorithmParams,
+    NaiveBayesAlgorithmParams,
+    PredictedResult,
     Query,
+    VoteServing,
 )
 from incubator_predictionio_tpu.utils.serialization import deserialize_model
 import datetime as dt
@@ -99,6 +102,94 @@ def test_train_and_predict(storage, ctx):
         assert pred.scores and abs(sum(pred.scores.values()) - 1.0) < 1e-5
     finally:
         use_storage(prev)
+
+
+def test_naive_bayes_algorithm_accuracy(storage, ctx):
+    """The add-algorithm variant: Gaussian NB alone on separable data."""
+    from incubator_predictionio_tpu.data.storage import use_storage
+
+    prev = use_storage(storage)
+    try:
+        engine = ClassificationEngine().apply()
+        params = EngineParams.create(
+            data_source=DataSourceParams(app_name="cls-test"),
+            algorithms=[("nb", NaiveBayesAlgorithmParams())],
+        )
+        models = engine.train(ctx, params)
+        algorithms, _ = engine.serving_and_algorithms(params)
+        props = PEventStore(storage).aggregate_properties("cls-test", "user")
+        correct = total = 0
+        for pm in props.values():
+            q = Query(features=(pm.get("attr0"), pm.get("attr1"), pm.get("attr2")))
+            pred = algorithms[0].predict(models[0], q)
+            correct += int(pred.label == pm.get("plan"))
+            total += 1
+        assert correct / total > 0.8, f"NB accuracy {correct}/{total}"
+        assert pred.scores and abs(sum(pred.scores.values()) - 1.0) < 1e-5
+    finally:
+        use_storage(prev)
+
+
+def test_multi_algorithm_vote_serving(storage, ctx):
+    """Both algorithms registered at once; VoteServing combines them
+    (the point of the reference's add-algorithm example)."""
+    from incubator_predictionio_tpu.data.storage import use_storage
+
+    prev = use_storage(storage)
+    try:
+        engine = ClassificationEngine().apply()
+        params = EngineParams.create(
+            data_source=DataSourceParams(app_name="cls-test"),
+            algorithms=[
+                ("mlp", MLPAlgorithmParams(hidden_dims=(16,), epochs=60,
+                                           learning_rate=3e-2, batch_size=96)),
+                ("nb", NaiveBayesAlgorithmParams()),
+            ],
+            serving=("vote", None),
+        )
+        models = engine.train(ctx, params)
+        assert len(models) == 2
+        algorithms, serving = engine.serving_and_algorithms(params)
+        assert isinstance(serving, VoteServing)
+        props = PEventStore(storage).aggregate_properties("cls-test", "user")
+        correct = total = 0
+        for pm in props.values():
+            q = Query(features=(pm.get("attr0"), pm.get("attr1"), pm.get("attr2")))
+            preds = [a.predict(m, q) for a, m in zip(algorithms, models)]
+            pred = serving.serve(q, preds)
+            correct += int(pred.label == pm.get("plan"))
+            total += 1
+        assert correct / total > 0.9, f"vote accuracy {correct}/{total}"
+    finally:
+        use_storage(prev)
+
+
+def test_naive_bayes_large_magnitude_small_spread(ctx):
+    """float32 E[x²]−E[x]² cancellation regression: near-constant
+    large-magnitude features must not yield negative variance / NaN scores."""
+    from incubator_predictionio_tpu.templates.classification import (
+        NaiveBayesAlgorithm,
+        TrainingData,
+    )
+
+    x = np.asarray([[1000.1, 5.0]] * 20 + [[2000.2, -5.0]] * 20, np.float32)
+    y = np.asarray([0] * 20 + [1] * 20)
+    algo = NaiveBayesAlgorithm(NaiveBayesAlgorithmParams())
+    model = algo.train(ctx, TrainingData(x, y))
+    assert (model.variances > 0).all()
+    pred = algo.predict(model, Query(features=(1000.1, 5.0)))
+    assert pred.label == 0
+    assert all(np.isfinite(v) for v in pred.scores.values())
+
+
+def test_vote_serving_tie_goes_to_first_algorithm():
+    serving = VoteServing(None)
+    a = PredictedResult(label="A")
+    b = PredictedResult(label="B")
+    assert serving.serve(None, [a, b]).label == "A"   # 1-1 tie → first
+    assert serving.serve(None, [b, a, a]).label == "A"  # majority wins
+    with pytest.raises(ValueError):
+        serving.serve(None, [])
 
 
 def test_eval_accuracy_metric(storage, ctx):

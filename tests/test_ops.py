@@ -97,6 +97,24 @@ def test_start_all_reports_unhealthy_and_polls_bound_ip(base_dir, monkeypatch):
     assert urls and all("10.1.2.3" in u for u in urls)
 
 
+def test_start_all_brackets_ipv6_health_host(base_dir, monkeypatch):
+    urls: list[str] = []
+    monkeypatch.setattr(ops, "_spawn", lambda name, argv: 4242)
+
+    def record(url, timeout=2.0):
+        urls.append(url)
+        return True
+
+    monkeypatch.setattr(ops, "_http_ok", record)
+    ops.start_all(ops.StartAllConfig(ip="fd00::1", wait_secs=1.0))
+    assert urls and all(u.startswith("http://[fd00::1]:") for u in urls)
+
+
+def test_http_ok_malformed_url_returns_false():
+    # InvalidURL (ValueError subclass) must not escape the health poll
+    assert ops._http_ok("http://fd00::1:7070/") is False
+
+
 # ---------------------------------------------------------------------------
 # redeploy loop
 # ---------------------------------------------------------------------------

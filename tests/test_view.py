@@ -1,0 +1,88 @@
+"""Columnar views (data/view.py) — DataView/batch-view counterpart."""
+
+import datetime as dt
+
+import numpy as np
+
+from incubator_predictionio_tpu.data.aggregator import aggregate_properties
+from incubator_predictionio_tpu.data.event import DataMap, Event
+from incubator_predictionio_tpu.data.view import events_to_columns, properties_to_columns
+
+UTC = dt.timezone.utc
+
+
+def _ev(name, eid, t, props=None, target=None):
+    return Event(
+        event=name, entity_type="user", entity_id=eid,
+        target_entity_type="item" if target else None,
+        target_entity_id=target,
+        properties=DataMap(props or {}),
+        event_time=dt.datetime(2026, 7, 1, 0, 0, t, tzinfo=UTC),
+    )
+
+
+def test_events_to_columns_core_and_property_dtypes():
+    events = [
+        _ev("rate", "u1", 0, {"rating": 5, "note": "great"}, target="i1"),
+        _ev("rate", "u2", 1, {"rating": 2.5}, target="i2"),
+        _ev("view", "u1", 2, {}, target="i3"),
+    ]
+    cols = events_to_columns(events, property_fields=["rating", "note"])
+    assert list(cols["event"]) == ["rate", "rate", "view"]
+    assert list(cols["entity_id"]) == ["u1", "u2", "u1"]
+    assert list(cols["target_entity_id"]) == ["i1", "i2", "i3"]
+    # numeric property → float64 with NaN fill
+    assert cols["rating"].dtype == np.float64
+    np.testing.assert_array_equal(cols["rating"][:2], [5.0, 2.5])
+    assert np.isnan(cols["rating"][2])
+    # mixed/string property → object with None fill
+    assert cols["note"].dtype == object
+    assert cols["note"][0] == "great" and cols["note"][2] is None
+    # event_time is datetime64[ms] UTC, ordered as inserted
+    assert cols["event_time"].dtype == np.dtype("datetime64[ms]")
+    assert cols["event_time"][2] - cols["event_time"][0] == np.timedelta64(2000, "ms")
+
+
+def test_events_to_columns_list_valued_property_stays_1d():
+    """Equal-length list properties must not collapse into a 2-D array."""
+    events = [
+        _ev("tag", "u1", 0, {"categories": ["a", "b"]}),
+        _ev("tag", "u2", 1, {"categories": ["c", "d"]}),
+        _ev("tag", "u3", 2, {}),
+    ]
+    cols = events_to_columns(events, property_fields=["categories"])
+    assert cols["categories"].shape == (3,)
+    assert cols["categories"][0] == ["a", "b"]
+    assert cols["categories"][2] is None
+
+
+def test_events_to_columns_empty():
+    cols = events_to_columns([], property_fields=["x"])
+    assert all(len(v) == 0 for v in cols.values())
+    assert cols["x"].dtype == object  # nothing present → not provably numeric
+
+
+def test_properties_to_columns_from_aggregation():
+    events = [
+        Event(event="$set", entity_type="user", entity_id="a",
+              properties=DataMap({"age": 30, "plan": "pro"}),
+              event_time=dt.datetime(2026, 7, 1, tzinfo=UTC)),
+        Event(event="$set", entity_type="user", entity_id="b",
+              properties=DataMap({"age": 41}),
+              event_time=dt.datetime(2026, 7, 2, tzinfo=UTC)),
+        Event(event="$unset", entity_type="user", entity_id="a",
+              properties=DataMap({"plan": None}),
+              event_time=dt.datetime(2026, 7, 3, tzinfo=UTC)),
+    ]
+    snaps = aggregate_properties(events)
+    cols = properties_to_columns(snaps)
+    assert list(cols["entity_id"]) == ["a", "b"]  # sorted, deterministic
+    assert cols["age"].dtype == np.float64
+    np.testing.assert_array_equal(cols["age"], [30.0, 41.0])
+    # 'plan' was unset on a and never set on b, so the default field union
+    # omits it; requesting it explicitly yields an all-None object column
+    assert "plan" not in cols
+    cols_p = properties_to_columns(snaps, fields=["plan"])
+    assert cols_p["plan"].dtype == object
+    assert cols_p["plan"][0] is None and cols_p["plan"][1] is None
+    assert (cols["last_updated"][0] - cols["first_updated"][0]) > np.timedelta64(0, "ms")
