@@ -42,7 +42,13 @@ def _object_column(values: list) -> np.ndarray:
     return col
 
 
-def _property_column(values: list, numeric: bool) -> np.ndarray:
+def _property_column(values: list) -> np.ndarray:
+    """float64/NaN when every present value is numeric (bool counts as 0/1),
+    object/None otherwise."""
+    present = [v for v in values if v is not None]
+    numeric = bool(present) and all(
+        isinstance(v, (int, float, bool)) for v in present
+    )
     if numeric:
         col = np.full(len(values), np.nan, np.float64)
         for i, v in enumerate(values):
@@ -81,12 +87,11 @@ def events_to_columns(
                                     "datetime64[ms]"),
     }
     for name in props:
-        values = [e.properties.get(name) for e in evs]
-        present = [v for v in values if v is not None]
-        numeric = bool(present) and all(
-            isinstance(v, (int, float, bool)) for v in present
-        )
-        cols[name] = _property_column(values, numeric)
+        if name in cols:
+            raise ValueError(
+                f"property field {name!r} collides with a core column"
+            )
+        cols[name] = _property_column([e.properties.get(name) for e in evs])
     return cols
 
 
@@ -114,10 +119,9 @@ def properties_to_columns(
             [_to_dt64(snapshots[i].last_updated) for i in ids], "datetime64[ms]"),
     }
     for name in fields:
-        values = [snapshots[i].get(name) for i in ids]
-        present = [v for v in values if v is not None]
-        numeric = bool(present) and all(
-            isinstance(v, (int, float, bool)) for v in present
-        )
-        cols[name] = _property_column(values, numeric)
+        if name in cols:
+            raise ValueError(
+                f"property field {name!r} collides with a core column"
+            )
+        cols[name] = _property_column([snapshots[i].get(name) for i in ids])
     return cols

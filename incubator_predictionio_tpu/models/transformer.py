@@ -175,25 +175,29 @@ class TransformerRecommender:
             ls = optax.softmax_cross_entropy_with_integer_labels(logits, by)
             return jnp.sum(ls * bw) / jnp.maximum(jnp.sum(bw), 1.0)
 
-        @partial(jax.jit, donate_argnums=(0, 1))
-        def train_epoch(p, o):
+        @partial(jax.jit, static_argnames=("n_epochs",), donate_argnums=(0, 1))
+        def train_epochs(p, o, n_epochs):
             def step(carry, batch):
                 p, o = carry
                 loss, grads = jax.value_and_grad(loss_fn)(p, *batch)
                 updates, o = tx.update(grads, o, p)
                 return (optax.apply_updates(p, updates), o), loss
 
-            (p, o), losses = jax.lax.scan(step, (p, o), (tb, pb, yb, wb))
-            return p, o, losses.mean()
+            def epoch(carry, _):
+                carry, losses = jax.lax.scan(step, carry, (tb, pb, yb, wb))
+                return carry, losses.mean()
+
+            (p, o), epoch_losses = jax.lax.scan(
+                epoch, (p, o), None, length=n_epochs
+            )
+            return p, o, epoch_losses[-1]
 
         from incubator_predictionio_tpu.utils.checkpoint import checkpointed_epochs
 
-        sync_every = 1 if ctx.mesh.devices.flat[0].platform == "cpu" else 8
         params, opt_state, loss = checkpointed_epochs(
             cfg.checkpoint_dir, cfg.checkpoint_every, cfg.checkpoint_keep,
             cfg.epochs, params, opt_state, ctx.mesh,
-            lambda p, o: train_epoch(p, o),
-            sync_every,
+            train_epochs,
         )
 
         model = TransformerModel(jax.tree.map(np.asarray, params), item_map, cfg)

@@ -170,19 +170,12 @@ class TwoTowerMF:
 
         from incubator_predictionio_tpu.utils.checkpoint import checkpointed_epochs
 
-        # The CPU backend's subgroup-collective rendezvous can deadlock when
-        # async dispatch interleaves separate executions; serialize epochs
-        # there. On TPU, sync sparsely — per-dispatch tunnel latency dominates
-        # small steps otherwise.
-        sync_every = 1 if ctx.mesh.devices.flat[0].platform == "cpu" else 8
-
         params, opt_state, loss = checkpointed_epochs(
             cfg.checkpoint_dir, cfg.checkpoint_every, cfg.checkpoint_keep,
             cfg.epochs, params, opt_state, ctx.mesh,
-            lambda p, o: _train_epoch(
-                p, o, ub, ib, rb, wb, cfg.learning_rate, cfg.reg
+            lambda p, o, n: _train_epochs(
+                p, o, ub, ib, rb, wb, cfg.learning_rate, cfg.reg, n
             ),
-            sync_every,
         )
         if loss is None:
             loss = np.inf
@@ -255,10 +248,13 @@ class TwoTowerMF:
         return np.asarray(idx), np.asarray(scores)
 
 
-@partial(jax.jit, static_argnames=("lr", "reg"), donate_argnums=(0, 1))
-def _train_epoch(p, o, ub, ib, rb, wb, lr, reg):
-    """One epoch = lax.scan over staged batches. Module-level with static
-    (lr, reg) so repeated fits of the same shapes reuse one executable."""
+@partial(jax.jit, static_argnames=("lr", "reg", "n_epochs"), donate_argnums=(0, 1))
+def _train_epochs(p, o, ub, ib, rb, wb, lr, reg, n_epochs):
+    """``n_epochs`` epochs in one dispatch: lax.scan over epochs of lax.scan
+    over staged batches — the whole schedule runs on device with no host
+    round-trips (the dominant cost behind a device tunnel). Module-level with
+    static (lr, reg, n_epochs) so repeated fits of the same shapes reuse one
+    executable. Returns the last epoch's mean loss."""
     tx = optax.adam(lr)
 
     def loss_fn(p, bu, bi, br, bw):
@@ -281,8 +277,12 @@ def _train_epoch(p, o, ub, ib, rb, wb, lr, reg):
         p = optax.apply_updates(p, updates)
         return (p, o), loss
 
-    (p, o), losses = jax.lax.scan(step, (p, o), (ub, ib, rb, wb))
-    return p, o, losses.mean()
+    def epoch(carry, _):
+        carry, losses = jax.lax.scan(step, carry, (ub, ib, rb, wb))
+        return carry, losses.mean()
+
+    (p, o), epoch_losses = jax.lax.scan(epoch, (p, o), None, length=n_epochs)
+    return p, o, epoch_losses[-1]
 
 
 @partial(jax.jit, static_argnames=("num",))

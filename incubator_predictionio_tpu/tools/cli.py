@@ -20,6 +20,7 @@ in-process on the mesh).
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import sys
 from typing import Optional
@@ -233,12 +234,14 @@ def cmd_train(args, storage: Storage) -> int:
     if getattr(args, "profile_dir", None):
         from incubator_predictionio_tpu.utils.tracing import profile_trace
 
-        with profile_trace(args.profile_dir):
-            instance_id = create_workflow(config, storage)
+        trace = profile_trace(args.profile_dir)
+    else:
+        trace = contextlib.nullcontext()
+    with trace:
+        instance_id = create_workflow(config, storage)
+    if getattr(args, "profile_dir", None):
         _out(f"Profiler trace written to {args.profile_dir} "
              "(TensorBoard 'profile' plugin layout).")
-    else:
-        instance_id = create_workflow(config, storage)
     _out(f"Training completed. Engine instance ID: {instance_id}")
     return 0
 

@@ -156,17 +156,20 @@ def checkpointed_epochs(
     params: Any,
     opt_state: Any,
     mesh,
-    train_one_epoch,
-    sync_every: int,
+    train_epochs,
 ) -> tuple[Any, Any, Any]:
     """The shared epoch driver both trainers run.
 
-    Resumes via :func:`maybe_resume`, then runs
-    ``train_one_epoch(params, opt_state) -> (params, opt_state, loss)`` for
-    the remaining epochs with profiler step annotations, a device sync every
-    ``sync_every`` epochs (CPU backends need per-epoch serialization; on TPU
-    sparse syncs amortize dispatch latency), and a checkpoint every ``every``
-    epochs. The checkpointer is closed even if an epoch raises. Returns
+    Resumes via :func:`maybe_resume`, then drives
+    ``train_epochs(params, opt_state, n_epochs) -> (params, opt_state, loss)``
+    in the largest chunks the checkpoint cadence allows: all remaining epochs
+    in ONE dispatch when checkpointing is off, else ``every`` epochs per
+    dispatch. Chunking is the TPU-side throughput lever — per-dispatch host
+    round-trip latency (large behind a device tunnel) amortizes over the whole
+    chunk, and the epoch loop runs as a ``lax.scan`` entirely on device. The
+    host sync at each chunk boundary doubles as the durability point for the
+    checkpoint save (and serializes executions, which the CPU backend's
+    subgroup-collective rendezvous requires). Returns
     ``(params, opt_state, loss)``; ``loss`` is ``None`` when no epoch ran.
     """
     from incubator_predictionio_tpu.utils.tracing import step_annotation
@@ -176,14 +179,16 @@ def checkpointed_epochs(
     )
     loss = None
     try:
-        for e in range(start_epoch, epochs):
-            with step_annotation("train_epoch", e):
-                params, opt_state, loss = train_one_epoch(params, opt_state)
-            if (e + 1) % sync_every == 0:
-                loss.block_until_ready()
-            if ckpt is not None and (e + 1) % every == 0:
-                ckpt.save(e + 1, {"params": params, "opt": opt_state,
-                                  "epoch": scalar(e + 1)})
+        e = start_epoch
+        while e < epochs:
+            chunk = min(every, epochs - e) if ckpt is not None else epochs - e
+            with step_annotation("train_epochs", e):
+                params, opt_state, loss = train_epochs(params, opt_state, chunk)
+            loss.block_until_ready()
+            e += chunk
+            if ckpt is not None:
+                ckpt.save(e, {"params": params, "opt": opt_state,
+                              "epoch": scalar(e)})
     finally:
         if ckpt is not None:
             ckpt.close()
