@@ -162,6 +162,103 @@ class EventStore(abc.ABC):
             agg = {k: v for k, v in agg.items() if req <= set(v.keys())}
         return agg
 
+    def assemble_triples(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Any = UNSET,
+        value_property: Optional[str] = None,
+        default_values: Optional[dict] = None,
+        missing_value: float = 0.0,
+        dedup: bool = False,
+    ):
+        """Matching events → columnar (entity, target, value) training triples.
+
+        The bulk read every template's DataSource runs; backends with a native
+        scan (eventlog) override it to skip per-event Python objects entirely.
+        Returns ``(entity_vocab, target_vocab, entity_idx, target_idx,
+        values)``: two object arrays of distinct ids in first-emitted order,
+        two int32 index arrays into them, and a float32 value array.
+
+        Per event the value is ``default_values[event_name]`` when present,
+        else the numeric coercion of ``value_property`` (numbers, bools, and
+        fully-numeric strings), else ``missing_value``. Events without a
+        target entity are skipped. ``dedup=True`` keeps one row per
+        (entity, target) pair — the latest event wins, rows in pair-first-seen
+        order — matching "later events of the same pair overwrite" template
+        semantics; ``dedup=False`` emits one row per event in time order.
+        """
+        import numpy as np
+
+        defaults = dict(default_values or {})
+        evocab: dict[str, int] = {}
+        tvocab: dict[str, int] = {}
+        e_idx: list[int] = []
+        t_idx: list[int] = []
+        vals: list[float] = []
+        pair_row: dict[tuple[int, int], int] = {}
+        for e in self.find(
+            app_id, channel_id, start_time, until_time, entity_type, None,
+            event_names, target_entity_type,
+        ):
+            if e.target_entity_id is None:
+                continue
+            if e.event in defaults:
+                v = float(defaults[e.event])
+            else:
+                raw = (
+                    e.properties.get(value_property)
+                    if value_property is not None else None
+                )
+                v = _coerce_value(raw, missing_value)
+            ui = evocab.setdefault(e.entity_id, len(evocab))
+            ti = tvocab.setdefault(e.target_entity_id, len(tvocab))
+            if dedup:
+                row = pair_row.get((ui, ti))
+                if row is not None:
+                    vals[row] = v
+                    continue
+                pair_row[(ui, ti)] = len(vals)
+            e_idx.append(ui)
+            t_idx.append(ti)
+            vals.append(v)
+        return (
+            np.asarray(list(evocab), object),
+            np.asarray(list(tvocab), object),
+            np.asarray(e_idx, np.int32),
+            np.asarray(t_idx, np.int32),
+            np.asarray(vals, np.float32),
+        )
+
+
+# Strict decimal grammar shared with the native scanner (parse_decimal in
+# native/src/eventlog.cc): digits with optional '.'/exponent, or
+# inf/infinity/nan. Narrower than Python float() (no '_' separators, no
+# unicode digits) so the two assemble_triples implementations cannot diverge.
+_DECIMAL_RE = re.compile(
+    r"[+-]?((\d+(\.\d*)?|\.\d+)([eE][+-]?\d+)?|inf(inity)?|nan)",
+    re.ASCII | re.IGNORECASE,
+)
+
+
+def _coerce_value(raw: Any, missing_value: float) -> float:
+    """Numeric coercion for assemble_triples property values."""
+    if raw is None:
+        return missing_value
+    if isinstance(raw, str):
+        # ASCII-whitespace trim only — the native parse_decimal trims the
+        # same set, so unicode spaces (NBSP etc.) fail identically
+        s = raw.strip(" \t\n\r\v\f")
+        return float(s) if _DECIMAL_RE.fullmatch(s) else missing_value
+    try:
+        return float(raw)
+    except (TypeError, ValueError):
+        return missing_value
+
 
 def entity_shard(entity_id: str, n_shards: int) -> int:
     """Stable entity→shard assignment (zlib.crc32; hash() is salted per-process)."""

@@ -34,6 +34,7 @@ from incubator_predictionio_tpu.data.storage.base import (
 )
 from incubator_predictionio_tpu.data.storage.registry import register_backend
 from incubator_predictionio_tpu.native import (
+    assemble as native_assemble,
     fold as native_fold,
     make_filter,
     scan as native_scan,
@@ -48,15 +49,25 @@ class _Log:
     handle for its lifetime, so a second writer — another process, or another
     store over the same directory — fails fast instead of corrupting the
     intern table (writers assign intern ids from their own in-memory count).
-    Readers never take the lock.
+    Readers never take the lock: a ``read_only`` log keeps no append handle
+    and refreshes its in-memory index whenever the file changes on disk —
+    that's how a trainer process reads while the event server (the one
+    writer) stays live, the topology the reference gets for free from its
+    database services.
     """
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, read_only: bool = False):
         self.path = path
         self.lock = threading.RLock()
         self.interner = fmt.Interner()
         self.strings: dict[int, str] = {}
         self.index: dict[str, int] = {}  # live event_id -> record offset
+        self.read_only = read_only
+        if read_only:
+            self.f = None
+            self._ro_end = 0  # absolute offset of the next unparsed byte
+            self.refresh()
+            return
         existed = os.path.exists(path)
         self.f = open(path, "ab")
         try:
@@ -87,7 +98,47 @@ class _Log:
             self.f.write(fmt.MAGIC)
             self.f.flush()
 
+    def refresh(self) -> None:
+        """Writer: flush appends to disk. Read-only: fold newly appended
+        records into the in-memory index/string table (the writer lives
+        elsewhere). The format is append-only, so only the suffix past the
+        last complete record is read and parsed — a previously torn tail is
+        retried from the same offset once the writer completes it."""
+        with self.lock:
+            if self.f is not None:
+                self.f.flush()
+                return
+            try:
+                size = os.stat(self.path).st_size
+            except FileNotFoundError:
+                return
+            if self._ro_end == 0:
+                if size < len(fmt.MAGIC):
+                    return
+                self._ro_end = len(fmt.MAGIC)
+            if size <= self._ro_end:
+                return
+            with open(self.path, "rb") as rf:
+                if self._ro_end == len(fmt.MAGIC):
+                    magic = rf.read(len(fmt.MAGIC))
+                    if magic != fmt.MAGIC:
+                        raise StorageError(f"{self.path} is not a PIOLOG01 file")
+                else:
+                    rf.seek(self._ro_end)
+                chunk = rf.read()
+            self._ro_end = fmt.apply_records(
+                chunk, self._ro_end, self.strings, self.index
+            )
+
+    def _require_writer(self) -> None:
+        if self.f is None:
+            raise StorageError(
+                f"event log {self.path} opened read-only (another process "
+                "holds the writer lock); route writes through that process"
+            )
+
     def append_event(self, event: Event, event_id: str) -> None:
+        self._require_writer()
         with self.lock:
             off_base = self.f.tell()
             blob = fmt.encode_event(event, event_id, self.interner)
@@ -107,6 +158,7 @@ class _Log:
                 self.strings.setdefault(i, s)
 
     def append_tombstone(self, event_id: str) -> None:
+        self._require_writer()
         with self.lock:
             self.f.write(fmt.encode_tombstone(event_id))
             self.f.flush()
@@ -114,7 +166,7 @@ class _Log:
 
     def read_at(self, offset: int) -> Event:
         with self.lock:
-            self.f.flush()
+            self.refresh()
             with open(self.path, "rb") as f:
                 f.seek(offset)
                 head = f.read(4)
@@ -125,7 +177,8 @@ class _Log:
 
     def close(self) -> None:
         with self.lock:
-            self.f.close()
+            if self.f is not None:
+                self.f.close()
 
 
 class EventLogEvents(EventStore):
@@ -149,7 +202,12 @@ class EventLogEvents(EventStore):
                     raise StorageError(
                         f"event log for app {app_id} channel {channel_id} not initialized"
                     )
-                log = _Log(path)
+                try:
+                    log = _Log(path)
+                except StorageError:
+                    # another process (the event server) holds the writer
+                    # lock — serve reads from a lock-free read-only view
+                    log = _Log(path, read_only=True)
                 self._logs[key] = log
             return log
 
@@ -200,6 +258,7 @@ class EventLogEvents(EventStore):
             log = self._log(app_id, channel_id)
         except StorageError:
             return None
+        log.refresh()  # read-only views pick up the writer's appends
         off = log.index.get(event_id)
         if off is None:
             return None
@@ -210,6 +269,7 @@ class EventLogEvents(EventStore):
             log = self._log(app_id, channel_id)
         except StorageError:
             return False
+        log._require_writer()  # a stale read-only index must not answer False
         if event_id not in log.index:
             return False
         log.append_tombstone(event_id)
@@ -241,8 +301,14 @@ class EventLogEvents(EventStore):
             _UNSET_MAP(target_entity_id),
         )
         with log.lock:
-            log.f.flush()
+            log.refresh()
             hits = native_scan(log.path, flt)
+            # refresh again AFTER the scan: a live writer may have interned
+            # new strings between our refresh and the scanner's own file
+            # read — every id a scanned event references is in the file by
+            # then (intern records precede their event), so this re-read
+            # makes log.strings sufficient to decode every hit
+            log.refresh()
         if hits is not None:
             # the native scanner did the full pass; decode only the chosen
             # hits via seek+read (a limit-N query touches N records, not the
@@ -292,6 +358,39 @@ class EventLogEvents(EventStore):
         for _, _, e in out:
             yield e
 
+    def assemble_triples(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Any = UNSET,
+        value_property: Optional[str] = None,
+        default_values: Optional[dict] = None,
+        missing_value: float = 0.0,
+        dedup: bool = False,
+    ):
+        log = self._log(app_id, channel_id)
+        flt = make_filter(
+            start_time, until_time, entity_type, None, event_names,
+            _UNSET_MAP(target_entity_type),
+        )
+        with log.lock:
+            log.refresh()
+            result = native_assemble(
+                log.path, flt, value_property, default_values,
+                missing_value, dedup,
+            )
+        if result is None:
+            return super().assemble_triples(
+                app_id, channel_id, start_time, until_time, entity_type,
+                event_names, target_entity_type, value_property,
+                default_values, missing_value, dedup,
+            )
+        return result
+
     def aggregate_properties(
         self,
         app_id: int,
@@ -306,7 +405,7 @@ class EventLogEvents(EventStore):
             start_time, until_time, entity_type, None, None,
         )
         with log.lock:
-            log.f.flush()
+            log.refresh()
             buf = native_fold(log.path, flt)
         if buf is None:
             return super().aggregate_properties(

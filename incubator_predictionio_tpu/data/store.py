@@ -148,3 +148,30 @@ class PEventStore(_BaseStore):
         return self.storage.get_events().aggregate_properties(
             app_id, entity_type, channel_id, start_time, until_time, required
         )
+
+    def assemble_triples(
+        self,
+        app_name: str,
+        channel_name: Optional[str] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Any = UNSET,
+        value_property: Optional[str] = None,
+        default_values: Optional[dict] = None,
+        missing_value: float = 0.0,
+        dedup: bool = False,
+    ):
+        """Columnar (entity, target, value) triples — the bulk training read.
+
+        See :meth:`EventStore.assemble_triples
+        <incubator_predictionio_tpu.data.storage.base.EventStore.assemble_triples>`
+        for semantics; the eventlog backend serves this from the native C++
+        scanner without building per-event Python objects."""
+        app_id, channel_id = self._resolve(app_name, channel_name)
+        return self.storage.get_events().assemble_triples(
+            app_id, channel_id, start_time, until_time, entity_type,
+            event_names, target_entity_type, value_property, default_values,
+            missing_value, dedup,
+        )

@@ -27,9 +27,13 @@ import datetime as _dt
 import logging
 import os
 import shutil
+import struct
 import subprocess
 import threading
 from typing import Any, Optional, Sequence
+
+_U16 = struct.Struct("<H")
+_U32 = struct.Struct("<I")
 
 logger = logging.getLogger(__name__)
 
@@ -110,6 +114,18 @@ def get_lib() -> Any:
         lib.pl_fold.argtypes = [
             ctypes.c_char_p,
             ctypes.POINTER(_PlFilter),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+        ]
+        lib.pl_assemble.restype = ctypes.c_int64
+        lib.pl_assemble.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(_PlFilter),
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int32,
+            ctypes.c_double,
+            ctypes.c_int32,
             ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
         ]
         lib.pl_free.restype = None
@@ -208,4 +224,71 @@ def fold(path: str, flt: _PlFilter) -> Optional[bytes]:
         return ctypes.string_at(buf, n)
     finally:
         lib.pl_free(buf)
+
+
+def assemble(
+    path: str,
+    flt: _PlFilter,
+    value_property: Optional[str],
+    default_values: Optional[dict[str, float]],
+    missing_value: float,
+    dedup: bool,
+):
+    """Native triple assembly → (entity_vocab, target_vocab, entity_idx,
+    target_idx, values) numpy arrays, or None if the library is unavailable.
+    Semantics documented at ``pl_assemble`` in src/eventlog.cc and mirrored by
+    ``EventStore.assemble_triples``."""
+    import numpy as np
+
+    lib = get_lib()
+    if lib is None:
+        return None
+    defaults = dict(default_values or {})
+    names = (ctypes.c_char_p * len(defaults))(
+        *[n.encode() for n in defaults]
+    )
+    vals = (ctypes.c_double * len(defaults))(*[float(v) for v in defaults.values()])
+    buf = ctypes.POINTER(ctypes.c_uint8)()
+    n = lib.pl_assemble(
+        path.encode(),
+        ctypes.byref(flt),
+        value_property.encode() if value_property is not None else None,
+        names,
+        vals,
+        len(defaults),
+        float(missing_value),
+        1 if dedup else 0,
+        ctypes.byref(buf),
+    )
+    if n < 0:
+        raise OSError(f"native assemble failed for {path}")
+    try:
+        raw = ctypes.string_at(buf, n)
+    finally:
+        lib.pl_free(buf)
+
+    pos = 0
+
+    def read_vocab():
+        nonlocal pos
+        (count,) = _U32.unpack_from(raw, pos)
+        pos += 4
+        out = np.empty(count, object)
+        for i in range(count):
+            (slen,) = _U16.unpack_from(raw, pos)
+            pos += 2
+            out[i] = raw[pos:pos + slen].decode()
+            pos += slen
+        return out
+
+    evocab = read_vocab()
+    tvocab = read_vocab()
+    (n_rows,) = _U32.unpack_from(raw, pos)
+    pos += 4
+    e_idx = np.frombuffer(raw, np.uint32, n_rows, pos).astype(np.int32)
+    pos += 4 * n_rows
+    t_idx = np.frombuffer(raw, np.uint32, n_rows, pos).astype(np.int32)
+    pos += 4 * n_rows
+    values = np.frombuffer(raw, np.float32, n_rows, pos).copy()
+    return evocab, tvocab, e_idx, t_idx, values
 

@@ -337,20 +337,12 @@ def read_log(
     that id, so an id re-inserted after a delete is live again (matching the
     other backends' delete-then-reinsert behavior).
     """
+    if buf[:8] != MAGIC:
+        raise ValueError("not a PIOLOG01 file")
     strings: dict[int, str] = {}
     offsets: dict[str, int] = {}
     dead: set[str] = set()
-    for off, kind, payload in iter_records(buf):
-        if kind == KIND_INTERN:
-            sid, slen = struct.unpack_from("<IH", payload, 1)
-            strings[sid] = payload[7:7 + slen].decode()
-        elif kind == KIND_EVENT:
-            eid, _ = _read_str16(payload, 1)
-            offsets[eid] = off
-        elif kind == KIND_TOMBSTONE:
-            eid, _ = _read_str16(payload, 1)
-            offsets.pop(eid, None)
-            dead.add(eid)
+    apply_records(buf[8:], 8, strings, offsets, dead)
     return strings, offsets, dead
 
 
@@ -367,3 +359,39 @@ def valid_extent(buf: bytes) -> int:
             break
         pos += 4 + plen
     return pos
+
+
+def apply_records(
+    chunk: bytes,
+    base_off: int,
+    strings: dict[int, str],
+    index: dict[str, int],
+    dead: Optional[set] = None,
+) -> int:
+    """Fold a raw record run (no magic header) starting at absolute file
+    offset ``base_off`` into ``strings``/``index`` in place — the single
+    record-dispatch parser: :func:`read_log` feeds it a whole file, read-only
+    log views feed it just the suffix the writer appended since last time.
+    Returns the absolute offset just past the last complete record (the next
+    tail position)."""
+    pos = 0
+    n = len(chunk)
+    while pos + 4 <= n:
+        (plen,) = struct.unpack_from("<I", chunk, pos)
+        if pos + 4 + plen > n or plen == 0:
+            break  # torn tail: retry from here next refresh
+        payload = chunk[pos + 4:pos + 4 + plen]
+        kind = payload[0]
+        if kind == KIND_INTERN:
+            sid, slen = struct.unpack_from("<IH", payload, 1)
+            strings[sid] = payload[7:7 + slen].decode()
+        elif kind == KIND_EVENT:
+            eid, _ = _read_str16(payload, 1)
+            index[eid] = base_off + pos
+        elif kind == KIND_TOMBSTONE:
+            eid, _ = _read_str16(payload, 1)
+            index.pop(eid, None)
+            if dead is not None:
+                dead.add(eid)
+        pos += 4 + plen
+    return base_off + pos

@@ -19,6 +19,8 @@
 
 #include <algorithm>
 #include <array>
+#include <cctype>
+#include <charconv>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
@@ -475,6 +477,218 @@ int64_t pl_fold(const char* path, const Filter* filter, uint8_t** out_buf) {
     }
   }
   memcpy(out.data(), &n_entities, 4);
+
+  *out_buf = static_cast<uint8_t*>(malloc(out.size() + 1));
+  if (*out_buf == nullptr) return -1;
+  memcpy(*out_buf, out.data(), out.size());
+  return static_cast<int64_t>(out.size());
+}
+
+// Strict decimal grammar shared with the Python fallback
+// (EventStore.assemble_triples): optional whitespace and sign, then digits
+// with optional '.' and exponent, or inf/infinity/nan (case-insensitive).
+// Deliberately narrower than both strtod (no hex, no partial parses) and
+// Python float() (no '_' separators, no unicode digits) so the two
+// implementations cannot diverge on exotic inputs.
+bool parse_decimal(const std::string& raw, double* out) {
+  // trim exactly the ASCII whitespace set the Python fallback strips
+  // (str.strip(" \t\n\r\v\f")) — unicode spaces fail on both sides
+  auto is_ws = [](char c) {
+    return c == ' ' || c == '\t' || c == '\n' || c == '\r' || c == '\v' ||
+           c == '\f';
+  };
+  size_t a = 0, b = raw.size();
+  while (a < b && is_ws(raw[a])) a++;
+  while (b > a && is_ws(raw[b - 1])) b--;
+  if (a == b) return false;
+  std::string s = raw.substr(a, b - a);
+  size_t i = (s[0] == '+' || s[0] == '-') ? 1 : 0;
+  std::string body = s.substr(i);
+  std::string lower = body;
+  for (char& c : lower) c = static_cast<char>(tolower(static_cast<unsigned char>(c)));
+  if (!(lower == "inf" || lower == "infinity" || lower == "nan")) {
+    bool digit = false, dot = false, exp_seen = false, exp_digit = false;
+    for (size_t j = 0; j < body.size(); j++) {
+      char c = body[j];
+      if (c >= '0' && c <= '9') {
+        (exp_seen ? exp_digit : digit) = true;
+      } else if (c == '.') {
+        if (dot || exp_seen) return false;
+        dot = true;
+      } else if (c == 'e' || c == 'E') {
+        if (exp_seen || !digit) return false;
+        exp_seen = true;
+        if (j + 1 < body.size() && (body[j + 1] == '+' || body[j + 1] == '-')) j++;
+      } else {
+        return false;
+      }
+    }
+    if (!digit || (exp_seen && !exp_digit)) return false;
+  }
+  // conversion via std::from_chars: locale-independent, unlike strtod,
+  // which honors LC_NUMERIC and would misread "3.5" under a comma locale
+  const char* first = s.c_str();
+  const char* last = first + s.size();
+  if (*first == '+') first++;  // from_chars rejects an explicit '+'
+  auto res = std::from_chars(first, last, *out);
+  return res.ec == std::errc() && res.ptr == last;
+}
+
+// Assemble (entity, target, value) training triples from events matching
+// `filter` — the event-store → device input pipeline's host half, run at
+// memory bandwidth instead of one Python object per event.
+//
+// Events are processed in (event_time, file order). Per event the value is:
+//   1. default_vals[j] when the event name equals default_names[j];
+//   2. else the numeric coercion of property `value_prop` (int/double/bool,
+//      or a string that fully parses as a double) when present;
+//   3. else missing_val.
+// Events without a target entity id are skipped (no pair to form). With
+// dedup=1 the LAST event of an (entity, target) pair wins and row order is
+// pair-first-seen; with dedup=0 every event emits a row in time order.
+// Vocab ids are dense, in first-emitted-row order.
+//
+// Result buffer (mallocd into *out_buf, byte length returned; pl_free):
+//   u32 n_entities, str16 × n_entities      # entity vocab
+//   u32 n_targets,  str16 × n_targets      # target vocab
+//   u32 n_rows, u32 entity_idx[n_rows], u32 target_idx[n_rows],
+//   f32 values[n_rows]
+// Returns -1 on I/O or format error.
+int64_t pl_assemble(const char* path, const Filter* filter,
+                    const char* value_prop, const char** default_names,
+                    const double* default_vals, int32_t n_defaults,
+                    double missing_val, int32_t dedup, uint8_t** out_buf) {
+  LogData log;
+  if (!load_log(path, &log)) return -1;
+
+  struct Rec {
+    int64_t t_us;
+    size_t seq;
+    uint32_t name_id;
+    Span entity_id;
+    Span target_id;
+    Span props;
+  };
+  std::vector<Rec> recs;
+  const uint8_t* p = log.buf.data();
+  size_t seq = 0;
+  for (size_t off : log.event_offsets) {
+    uint32_t plen;
+    memcpy(&plen, p + off, 4);
+    ParsedEvent e;
+    if (!parse_event(p + off + 4, plen, &e)) return -1;
+    seq++;
+    if (!e.has_target_id) continue;
+    if (!matches(*filter, log, e)) continue;
+    recs.push_back(Rec{e.event_time_us, seq, e.name_id, e.entity_id,
+                       e.target_id, e.props});
+  }
+  std::sort(recs.begin(), recs.end(), [](const Rec& a, const Rec& b) {
+    return a.t_us != b.t_us ? a.t_us < b.t_us : a.seq < b.seq;
+  });
+
+  // resolve default event names to interned ids once (absent name -> no hits)
+  std::unordered_map<uint32_t, double> default_by_id;
+  for (int32_t j = 0; j < n_defaults; j++) {
+    for (auto& [sid, s] : log.strings)
+      if (s == default_names[j]) {
+        default_by_id[sid] = default_vals[j];
+        break;
+      }
+  }
+
+  std::unordered_map<std::string, uint32_t> evocab, tvocab;
+  std::vector<std::string> enames, tnames;
+  std::vector<uint32_t> e_idx, t_idx;
+  std::vector<float> vals;
+  // (entity vocab id, target vocab id) -> row index, dedup=1 only
+  std::unordered_map<uint64_t, size_t> pair_row;
+
+  for (const Rec& r : recs) {
+    double v = missing_val;
+    auto dit = default_by_id.find(r.name_id);
+    if (dit != default_by_id.end()) {
+      v = dit->second;
+    } else if (value_prop != nullptr) {
+      Reader pr{r.props.p, r.props.n};
+      if (pr.u8() != 7) return -1;
+      uint32_t nk = pr.u32();
+      for (uint32_t i = 0; i < nk && !pr.fail; i++) {
+        Span k = pr.str16();
+        if (k.eq(value_prop)) {
+          uint8_t t = pr.u8();
+          if (t == 3) {
+            v = static_cast<double>(pr.i64());
+          } else if (t == 4) {
+            int64_t bits = pr.i64();
+            memcpy(&v, &bits, 8);
+          } else if (t == 1) {
+            v = 0.0;
+          } else if (t == 2) {
+            v = 1.0;
+          } else if (t == 5 || t == 8) {
+            std::string s = pr.bytes(pr.u32()).str();
+            double parsed;
+            if (parse_decimal(s, &parsed)) v = parsed;
+          }
+          break;
+        }
+        if (!skip_tlv(pr)) return -1;
+      }
+      if (pr.fail) return -1;
+    }
+    std::string eid = r.entity_id.str(), tid = r.target_id.str();
+    auto intern = [](std::unordered_map<std::string, uint32_t>& vocab,
+                     std::vector<std::string>& names,
+                     const std::string& s) -> uint32_t {
+      auto [it, fresh] = vocab.try_emplace(s, vocab.size());
+      if (fresh) names.push_back(s);
+      return it->second;
+    };
+    if (dedup != 0) {
+      // only create vocab entries when the pair's row is created; an update
+      // can't introduce new ids (the pair existed, so both ids exist)
+      auto eit = evocab.find(eid);
+      auto tit = tvocab.find(tid);
+      if (eit != evocab.end() && tit != tvocab.end()) {
+        uint64_t key = (static_cast<uint64_t>(eit->second) << 32) | tit->second;
+        auto rit = pair_row.find(key);
+        if (rit != pair_row.end()) {
+          vals[rit->second] = static_cast<float>(v);
+          continue;
+        }
+      }
+      uint32_t ui = intern(evocab, enames, eid);
+      uint32_t ti = intern(tvocab, tnames, tid);
+      pair_row[(static_cast<uint64_t>(ui) << 32) | ti] = vals.size();
+      e_idx.push_back(ui);
+      t_idx.push_back(ti);
+      vals.push_back(static_cast<float>(v));
+    } else {
+      e_idx.push_back(intern(evocab, enames, eid));
+      t_idx.push_back(intern(tvocab, tnames, tid));
+      vals.push_back(static_cast<float>(v));
+    }
+  }
+
+  std::vector<uint8_t> out;
+  auto put_vocab = [&out](const std::vector<std::string>& names) {
+    put_u32(out, static_cast<uint32_t>(names.size()));
+    for (const std::string& s : names) {
+      put_u16(out, static_cast<uint16_t>(s.size()));
+      out.insert(out.end(), s.begin(), s.end());
+    }
+  };
+  put_vocab(enames);
+  put_vocab(tnames);
+  put_u32(out, static_cast<uint32_t>(vals.size()));
+  auto put_block = [&out](const void* src, size_t bytes) {
+    const uint8_t* b = static_cast<const uint8_t*>(src);
+    out.insert(out.end(), b, b + bytes);
+  };
+  put_block(e_idx.data(), e_idx.size() * 4);
+  put_block(t_idx.data(), t_idx.size() * 4);
+  put_block(vals.data(), vals.size() * 4);
 
   *out_buf = static_cast<uint8_t*>(malloc(out.size() + 1));
   if (*out_buf == nullptr) return -1;

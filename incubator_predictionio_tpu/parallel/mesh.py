@@ -37,6 +37,24 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 logger = logging.getLogger(__name__)
 
 
+def honor_platform_env() -> None:
+    """Make ``JAX_PLATFORMS`` authoritative before the first backend init.
+
+    Site hooks can pin JAX to an accelerator plugin even when the caller
+    exported ``JAX_PLATFORMS=cpu`` (observed with tunneled-device plugins,
+    where a dead tunnel then hangs every ``jax.devices()`` call). If the env
+    asks for specific platforms and no backend exists yet, apply the request
+    through jax.config so it wins over the hook.
+    """
+    import os
+
+    from jax._src import xla_bridge
+
+    requested = os.environ.get("JAX_PLATFORMS")
+    if requested and not xla_bridge._backends:
+        jax.config.update("jax_platforms", requested)
+
+
 @dataclass(frozen=True)
 class MeshConf:
     """Serializable mesh request — stored on EngineInstance rows the way the
@@ -76,6 +94,7 @@ class MeshContext:
         to the device count — mismatches raise rather than silently dropping
         devices.
         """
+        honor_platform_env()
         if distributed:  # pragma: no cover - needs multi-host
             jax.distributed.initialize()
         devs = list(devices if devices is not None else jax.devices())

@@ -84,14 +84,19 @@ class DataSourceParams(Params):
 
 @dataclasses.dataclass
 class TrainingData(SanityCheck):
-    """Rating triples, columnar (the RDD[Rating] counterpart)."""
+    """Rating triples, columnar-indexed (the RDD[Rating] counterpart):
+    vocabularies of distinct ids plus int32 index arrays into them — the
+    layout :meth:`PEventStore.assemble_triples` produces and the embedding
+    tables consume directly."""
 
-    users: np.ndarray     # [n] str
-    items: np.ndarray     # [n] str
-    ratings: np.ndarray   # [n] float32
+    user_idx: np.ndarray    # [n] int32 into user_vocab
+    item_idx: np.ndarray    # [n] int32 into item_vocab
+    ratings: np.ndarray     # [n] float32
+    user_vocab: np.ndarray  # [U] str
+    item_vocab: np.ndarray  # [I] str
 
     def sanity_check(self) -> None:
-        if len(self.users) == 0:
+        if len(self.ratings) == 0:
             raise ValueError("TrainingData is empty (no rate/buy events found)")
 
 
@@ -103,35 +108,30 @@ class DataSource(PDataSource):
         self._store = PEventStore()
 
     def _read(self) -> TrainingData:
-        users, items, ratings = [], [], []
-        # latest event of a (user, item) pair wins: find() is time-ordered
-        latest: dict[tuple[str, str], float] = {}
-        for e in self._store.find(
-            self.params.app_name,
-            entity_type="user",
-            event_names=("rate", "buy"),
-            target_entity_type="item",
-        ):
-            rating = (
-                float(e.properties.get("rating", 0.0))
-                if e.event == "rate"
-                else self.params.buy_rating
+        # latest event of a (user, item) pair wins (dedup=True); "buy" implies
+        # a fixed rating, "rate" carries it in properties (DataSource.scala:45-77)
+        user_vocab, item_vocab, user_idx, item_idx, ratings = (
+            self._store.assemble_triples(
+                self.params.app_name,
+                entity_type="user",
+                event_names=("rate", "buy"),
+                target_entity_type="item",
+                value_property="rating",
+                default_values={"buy": self.params.buy_rating},
+                dedup=True,
             )
-            latest[(e.entity_id, e.target_entity_id)] = rating
-        for (u, i), r in latest.items():
-            users.append(u)
-            items.append(i)
-            ratings.append(r)
-        return TrainingData(
-            np.asarray(users), np.asarray(items), np.asarray(ratings, np.float32)
         )
+        return TrainingData(user_idx, item_idx, ratings, user_vocab, item_vocab)
 
     def read_training(self, ctx: MeshContext) -> TrainingData:
         return self._read()
 
     def read_eval(self, ctx: MeshContext):
         """k-fold split over rating triples (reference DataSource.scala:83-…):
-        held-out fold becomes (Query(user, num=k-ish), ActualResult(ratings))."""
+        held-out fold becomes (Query(user, num=k-ish), ActualResult(ratings)).
+        Each fold's TrainingData is re-indexed against the fold's own vocab so
+        held-out-only users stay unknown at predict time (the reference builds
+        its BiMaps per fold from train data only)."""
         k = self.params.eval_k
         if not k:
             return []
@@ -143,12 +143,11 @@ class DataSource(PDataSource):
         for fold in range(k):
             train_mask = fold_of != fold
             test_mask = ~train_mask
-            train = TrainingData(
-                td.users[train_mask], td.items[train_mask], td.ratings[train_mask]
-            )
+            train = _subset(td, train_mask)
             # group held-out positives per user
             per_user: dict[str, list[tuple[str, float]]] = {}
-            for u, i, r in zip(td.users[test_mask], td.items[test_mask],
+            for u, i, r in zip(td.user_vocab[td.user_idx[test_mask]],
+                               td.item_vocab[td.item_idx[test_mask]],
                                td.ratings[test_mask]):
                 per_user.setdefault(u, []).append((i, float(r)))
             qa = [
@@ -158,6 +157,21 @@ class DataSource(PDataSource):
             ]
             folds.append((train, {"fold": fold}, qa))
         return folds
+
+
+def _subset(td: TrainingData, mask: np.ndarray) -> TrainingData:
+    """Rows where ``mask`` — re-indexed against a vocab of only the ids that
+    survive, so absent ids are genuinely unknown to the trained model."""
+    u, i, r = td.user_idx[mask], td.item_idx[mask], td.ratings[mask]
+    keep_u = np.unique(u)
+    keep_i = np.unique(i)
+    remap_u = np.full(len(td.user_vocab), -1, np.int32)
+    remap_u[keep_u] = np.arange(len(keep_u), dtype=np.int32)
+    remap_i = np.full(len(td.item_vocab), -1, np.int32)
+    remap_i[keep_i] = np.arange(len(keep_i), dtype=np.int32)
+    return TrainingData(
+        remap_u[u], remap_i[i], r, td.user_vocab[keep_u], td.item_vocab[keep_i]
+    )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -217,8 +231,8 @@ class ALSAlgorithm(PAlgorithm):
                 "ALSAlgorithmParams.num_iterations = %d > 30: long schedules "
                 "rarely help MF; consider lowering", p.num_iterations,
             )
-        user_map = BiMap.string_int(pd.users)
-        item_map = BiMap.string_int(pd.items)
+        user_map = BiMap({u: i for i, u in enumerate(pd.user_vocab)})
+        item_map = BiMap({t: i for i, t in enumerate(pd.item_vocab)})
         cfg = TwoTowerConfig(
             rank=p.rank,
             learning_rate=p.learning_rate,
@@ -231,8 +245,8 @@ class ALSAlgorithm(PAlgorithm):
         )
         mf = TwoTowerMF(cfg).fit(
             ctx,
-            user_map.lookup_array(pd.users),
-            item_map.lookup_array(pd.items),
+            pd.user_idx,
+            pd.item_idx,
             pd.ratings,
             n_users=len(user_map),
             n_items=len(item_map),

@@ -419,7 +419,10 @@ def cmd_status(args, storage: Storage) -> int:
     """(commands/Management.scala:99-181 + Storage.verifyAllDataObjects)"""
     import jax
 
+    from incubator_predictionio_tpu.parallel.mesh import honor_platform_env
+
     _out(f"incubator_predictionio_tpu {piotpu.__version__}")
+    honor_platform_env()
     devices = jax.devices()
     _out(f"Devices: {len(devices)} × {devices[0].platform}"
          f" ({devices[0].device_kind})")

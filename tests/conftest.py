@@ -17,6 +17,10 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+# The env var matters as much as the jax.config call below: accelerator site
+# hooks consult JAX_PLATFORMS directly, and with only the config set they may
+# still try to initialize a (possibly dead) tunneled device backend.
+os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax
 from jax._src import xla_bridge

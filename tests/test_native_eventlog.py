@@ -293,14 +293,15 @@ def test_torn_tail_truncated_so_new_appends_survive(store, tmp_path):
 
 
 def test_second_writer_rejected(store, tmp_path):
-    """The log is single-writer: a concurrent store fails fast instead of
-    corrupting the intern table (code-review regression)."""
+    """The log is single-writer: a concurrent store's WRITES fail fast instead
+    of corrupting the intern table; its reads fall back to the lock-free
+    read-only view (code-review regression)."""
     from incubator_predictionio_tpu.data.storage.base import StorageError
 
     store.insert(Event(event="rate", entity_type="user", entity_id="u1",
                        event_time=t(0)), APP)
     other = EventLogEvents(str(tmp_path))
-    with pytest.raises(StorageError, match="locked by another writer"):
+    with pytest.raises(StorageError, match="read-only"):
         other.insert(Event(event="buy", entity_type="user", entity_id="u2",
                            event_time=t(1)), APP)
     other.close()
@@ -308,3 +309,125 @@ def test_second_writer_rejected(store, tmp_path):
     store.insert(Event(event="view", entity_type="user", entity_id="u3",
                        event_time=t(2)), APP)
     assert len(list(store.find(APP))) == 2
+
+
+# ---------------------------------------------------------------------------
+# triple assembly (the bulk training read)
+# ---------------------------------------------------------------------------
+
+def _rating_stream(rng, n=400):
+    """rate/buy/view events with ratings of every coercible (and not) kind."""
+    evs = []
+    for i in range(n):
+        name = rng.choice(["rate", "buy", "view", "$set"])
+        props = {}
+        if name == "rate":
+            props["rating"] = rng.choice(
+                [1.5, 4, True, False, "3.5", " 2.0 ", "oops", None, [1], 2**70,
+                 # adversarial coercion forms: the shared strict grammar must
+                 # treat these identically in C++ and Python
+                 "0x10", "1_000", "Infinity", "-inf", "NaN", "+2e3", "2e",
+                 ".5", "5.", "١٢٣", "", "3.5 ", " 1.5"]
+            )
+            if rng.random() < 0.2:
+                props = {}  # rating property absent
+        has_target = name != "$set"
+        evs.append(Event(
+            event=name,
+            entity_type="user",
+            entity_id=f"u{rng.randint(0, 15)}",
+            target_entity_type="item" if has_target else None,
+            target_entity_id=f"i{rng.randint(0, 8)}" if has_target else None,
+            properties=DataMap(props),
+            event_time=t(rng.randint(0, 50)),
+        ))
+    return evs
+
+
+@pytest.mark.parametrize("dedup", [False, True])
+def test_assemble_parity_random(store, monkeypatch, dedup):
+    rng = random.Random(21)
+    ids = store.insert_batch(_rating_stream(rng), APP)
+    for eid in rng.sample(ids, len(ids) // 10):
+        store.delete(eid, APP)
+
+    def run():
+        return store.assemble_triples(
+            APP,
+            entity_type="user",
+            event_names=("rate", "buy"),
+            target_entity_type="item",
+            value_property="rating",
+            default_values={"buy": 4.0},
+            dedup=dedup,
+        )
+
+    native, python = _with_fallback(monkeypatch, store, run)
+    import numpy as np
+
+    for a, b, label in zip(native, python,
+                           ("evocab", "tvocab", "eidx", "tidx", "vals")):
+        if a.dtype.kind == "f":
+            assert np.array_equal(a, b, equal_nan=True), label
+        else:
+            assert a.tolist() == b.tolist(), label
+
+
+def test_assemble_template_semantics(store):
+    """Last-wins dedup, per-event-name defaults, missing rating → missing_value."""
+    evs = [
+        Event(event="rate", entity_type="user", entity_id="u1",
+              target_entity_type="item", target_entity_id="i1",
+              properties=DataMap({"rating": 2.0}), event_time=t(0)),
+        Event(event="buy", entity_type="user", entity_id="u2",
+              target_entity_type="item", target_entity_id="i1",
+              event_time=t(1)),
+        # same pair, later: overwrites the 2.0
+        Event(event="rate", entity_type="user", entity_id="u1",
+              target_entity_type="item", target_entity_id="i1",
+              properties=DataMap({"rating": 5.0}), event_time=t(2)),
+        # rating property missing → 0.0
+        Event(event="rate", entity_type="user", entity_id="u1",
+              target_entity_type="item", target_entity_id="i2",
+              event_time=t(3)),
+    ]
+    store.insert_batch(evs, APP)
+    uv, iv, ui, ii, vals = store.assemble_triples(
+        APP, entity_type="user", event_names=("rate", "buy"),
+        target_entity_type="item", value_property="rating",
+        default_values={"buy": 4.0}, dedup=True,
+    )
+    assert uv.tolist() == ["u1", "u2"]
+    assert iv.tolist() == ["i1", "i2"]
+    # pair-first-seen order: (u1,i1), (u2,i1), (u1,i2)
+    assert ui.tolist() == [0, 1, 0]
+    assert ii.tolist() == [0, 0, 1]
+    assert vals.tolist() == [5.0, 4.0, 0.0]
+
+
+def test_read_only_reader_while_writer_locked(store, tmp_path):
+    """A second store over the same directory (e.g. a trainer process while
+    the event server holds the writer lock) falls back to lock-free reads and
+    sees appends made after it opened; its writes fail with a clear error."""
+    store.insert_batch(_rating_stream(random.Random(3), 50), APP)
+    reader = EventLogEvents(str(tmp_path))
+    try:
+        n0 = len(list(reader.find(APP)))
+        assert n0 == len(list(store.find(APP)))
+        # writer appends after the reader opened → reader refreshes
+        store.insert(Event(event="rate", entity_type="user", entity_id="uX",
+                           target_entity_type="item", target_entity_id="iX",
+                           properties=DataMap({"rating": 3.0}),
+                           event_time=t(999)), APP)
+        assert len(list(reader.find(APP))) == n0 + 1
+        # the assemble fast path works through the read-only view too
+        uv, iv, ui, ii, vals = reader.assemble_triples(
+            APP, entity_type="user", event_names=("rate", "buy"),
+            target_entity_type="item", value_property="rating",
+            default_values={"buy": 4.0}, dedup=True)
+        assert "uX" in uv.tolist()
+        with pytest.raises(Exception, match="read-only"):
+            reader.insert(Event(event="rate", entity_type="user",
+                                entity_id="u", event_time=t(1)), APP)
+    finally:
+        reader.close()

@@ -106,7 +106,8 @@ def test_later_event_wins(storage, ctx):
 
         ds = doer(DataSource, DataSourceParams(app_name="rec-test"))
         td = ds.read_training(ctx)
-        pairs = dict(zip(zip(td.users.tolist(), td.items.tolist()),
+        pairs = dict(zip(zip(td.user_vocab[td.user_idx].tolist(),
+                             td.item_vocab[td.item_idx].tolist()),
                          td.ratings.tolist()))
         assert pairs[("u0", "i2")] == 4.0   # buy overrides earlier rate
         assert pairs[("u0", "i1")] == 1.0   # re-rate wins
