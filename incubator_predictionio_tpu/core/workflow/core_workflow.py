@@ -67,28 +67,44 @@ def run_train(
     ctx: Optional[MeshContext] = None,
 ) -> str:
     """Train, persist models, mark the instance COMPLETED
-    (CoreWorkflow.runTrain, CoreWorkflow.scala:45-102). Returns instance id."""
+    (CoreWorkflow.runTrain, CoreWorkflow.scala:45-102). Returns instance id.
+
+    In a multi-process job every process trains (SPMD collectives need all of
+    them), but only process 0 touches storage — the single-Spark-driver role
+    (``MeshContext.is_primary``); secondaries return a placeholder id."""
     storage = storage or get_storage()
     instances = storage.get_meta_data_engine_instances()
-    instance_id = engine_instance.id or instances.insert(engine_instance)
-    if engine_instance.id:
-        instances.update(engine_instance)
     ctx = ctx or MeshContext.from_conf(engine_instance.mesh_conf or None)
+    primary = ctx.is_primary
+    if primary:
+        instance_id = engine_instance.id or instances.insert(engine_instance)
+        if engine_instance.id:
+            instances.update(engine_instance)
+    else:
+        instance_id = engine_instance.id or "<secondary>"
     try:
         with ctx.activate():
             models = engine.train(ctx, engine_params, params)
-            persisted = engine.models_for_persistence(ctx, models, instance_id, engine_params)
-        blob = serialize_model(persisted)
-        storage.get_model_data_models().insert(Model(instance_id, blob))
-        inst = instances.get(instance_id)
-        instances.update(replace(inst, status="COMPLETED", end_time=_now()))
-        logger.info("training finished: instance %s (%d bytes of models)",
-                    instance_id, len(blob))
+            # training ends with a collective host gather (all processes),
+            # but persistence — and its save side effects, e.g.
+            # PersistentModel files keyed by instance id — is primary-only
+            if primary:
+                persisted = engine.models_for_persistence(
+                    ctx, models, instance_id, engine_params
+                )
+        if primary:
+            blob = serialize_model(persisted)
+            storage.get_model_data_models().insert(Model(instance_id, blob))
+            inst = instances.get(instance_id)
+            instances.update(replace(inst, status="COMPLETED", end_time=_now()))
+            logger.info("training finished: instance %s (%d bytes of models)",
+                        instance_id, len(blob))
         return instance_id
     except Exception:
-        inst = instances.get(instance_id)
-        if inst is not None:
-            instances.update(replace(inst, status="FAILED", end_time=_now()))
+        if primary:
+            inst = instances.get(instance_id)
+            if inst is not None:
+                instances.update(replace(inst, status="FAILED", end_time=_now()))
         logger.error("training failed:\n%s", traceback.format_exc())
         raise
     finally:

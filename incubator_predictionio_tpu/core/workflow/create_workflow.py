@@ -51,6 +51,7 @@ class WorkflowConfig:
     stop_after_read: bool = False
     stop_after_prepare: bool = False
     mesh_axes: Optional[dict[str, int]] = None  # replaces --master/spark conf
+    distributed: bool = False  # join a jax.distributed job (launcher / pod)
 
 
 def _workflow_params(config: WorkflowConfig) -> WorkflowParams:
@@ -79,7 +80,11 @@ def _run_train(config: WorkflowConfig, storage: Optional[Storage]) -> str:
     if not isinstance(engine, Engine):
         raise TypeError(f"engineFactory {factory_path} did not produce an Engine")
     engine_params = engine.engine_params_from_variant(variant)
-    mesh_conf: dict[str, Any] = {"axes": config.mesh_axes} if config.mesh_axes else {}
+    mesh_conf: dict[str, Any] = {}
+    if config.mesh_axes:
+        mesh_conf["axes"] = config.mesh_axes
+    if config.distributed:
+        mesh_conf["distributed"] = True
     instance = EngineInstance(
         id="",
         status="INIT",

@@ -155,18 +155,18 @@ class TransformerRecommender:
             a = np.concatenate([a, np.full((pad, *a.shape[1:]), fill, a.dtype)])
             a = a.reshape(n_batches, global_batch, *a.shape[1:])
             seq_axis = "seq" if use_ring else None
-            return jax.device_put(
-                a, ctx.sharding(None, ctx.data_axis, seq_axis)
-            )
+            return ctx.put(a, None, ctx.data_axis, seq_axis)
 
         tb = stage(tokens.astype(np.int32))
         pb = stage(positions.astype(np.int32))
         yb = stage(targets.astype(np.int32))
         wb = stage(weights.astype(np.float32))
 
-        params = ctx.replicate(_init_params(jax.random.key(cfg.seed), cfg))
+        params = ctx.replicate(
+            jax.tree.map(np.asarray, _init_params(jax.random.key(cfg.seed), cfg))
+        )
         tx = optax.adam(cfg.learning_rate)
-        opt_state = tx.init(params)
+        opt_state = jax.jit(tx.init)(params)
         mesh = ctx.mesh
 
         def loss_fn(p, bt, bp, by, bw):
@@ -200,7 +200,7 @@ class TransformerRecommender:
             train_epochs,
         )
 
-        model = TransformerModel(jax.tree.map(np.asarray, params), item_map, cfg)
+        model = TransformerModel(ctx.host_gather(params), item_map, cfg)
         model.final_loss = float(loss) if loss is not None else float("nan")
         return model
 

@@ -130,24 +130,17 @@ class TwoTowerMF:
         def stage(a, dtype):
             a = np.asarray(a, dtype)[order] if len(a) == n else np.asarray(a, dtype)
             a = a.reshape(n_batches, global_batch)
-            return jax.device_put(a, ctx.sharding(None, ctx.data_axis))
+            return ctx.put(a, None, ctx.data_axis)
 
         ub = stage(users, np.int32)
         ib = stage(items, np.int32)
         rb = stage(ratings.astype(np.float32) - mean, np.float32)
-        wb = jax.device_put(w.reshape(n_batches, global_batch),
-                            ctx.sharding(None, ctx.data_axis))
+        wb = ctx.put(w.reshape(n_batches, global_batch), None, ctx.data_axis)
 
         key = jax.random.key(cfg.seed)
         ku, ki = jax.random.split(key)
         scale = 1.0 / np.sqrt(cfg.rank)
         model_axis = "model" if "model" in ctx.mesh.shape else None
-        emb_sharding = (
-            ctx.sharding(model_axis, None) if model_axis else ctx.replicated()
-        )
-        bias_sharding = (
-            ctx.sharding(model_axis) if model_axis else ctx.replicated()
-        )
         # pad vocab rows up to the model-axis multiple (static row sharding)
         def pad_rows(v: int) -> int:
             if not model_axis:
@@ -156,17 +149,21 @@ class TwoTowerMF:
             return ((v + m - 1) // m) * m
 
         nu_p, ni_p = pad_rows(n_users), pad_rows(n_items)
+        emb_spec = (model_axis, None) if model_axis else ()
+        bias_spec = (model_axis,) if model_axis else ()
         params = {
-            "ue": jax.device_put(
+            "ue": ctx.put(
                 np.asarray(jax.random.normal(ku, (nu_p, cfg.rank), jnp.float32) * scale),
-                emb_sharding),
-            "ie": jax.device_put(
+                *emb_spec),
+            "ie": ctx.put(
                 np.asarray(jax.random.normal(ki, (ni_p, cfg.rank), jnp.float32) * scale),
-                emb_sharding),
-            "ub": jax.device_put(np.zeros(nu_p, np.float32), bias_sharding),
-            "ib": jax.device_put(np.zeros(ni_p, np.float32), bias_sharding),
+                *emb_spec),
+            "ub": ctx.put(np.zeros(nu_p, np.float32), *bias_spec),
+            "ib": ctx.put(np.zeros(ni_p, np.float32), *bias_spec),
         }
-        opt_state = optax.adam(cfg.learning_rate).init(params)
+        # jitted init: multi-process-safe (optimizer state inherits the
+        # params' global shardings instead of materializing host-side)
+        opt_state = jax.jit(optax.adam(cfg.learning_rate).init)(params)
 
         from incubator_predictionio_tpu.utils.checkpoint import checkpointed_epochs
 
@@ -179,9 +176,9 @@ class TwoTowerMF:
         )
         if loss is None:
             loss = np.inf
-        # final host gather below (tree.map np.asarray) is the closing sync
+        # final host gather is the closing sync (collective when multi-process)
 
-        host = jax.tree.map(np.asarray, params)
+        host = ctx.host_gather(params)
         model = TwoTowerModel(
             user_emb=host["ue"][:n_users],
             item_emb=host["ie"][:n_items],

@@ -1,0 +1,122 @@
+"""Multi-process launcher — the ``Runner.runOnSpark`` counterpart.
+
+The reference scales out by forking ``spark-submit`` with a serialized env
+(tools/Runner.scala:185-335); here scale-out is N identical processes running
+the SAME CLI verb under ``jax.distributed``, with XLA collectives over
+ICI/DCN doing what Spark's shuffle/RPC did. This module is the process
+spawner for the single-host/multi-process form (and the integration-test
+stand-in for a pod, using CPU devices + gloo); on a real multi-host pod the
+operator runs one ``pio-tpu <verb> --distributed`` per host and
+``jax.distributed.initialize`` auto-detects the topology, so no launcher
+process is needed at all.
+
+Each spawned process gets:
+
+- ``PIO_DIST_COORDINATOR``  — host:port of process 0's coordinator service;
+- ``PIO_DIST_NUM_PROCESSES`` / ``PIO_DIST_PROCESS_ID`` — the job topology;
+
+consumed by :func:`incubator_predictionio_tpu.parallel.mesh.
+init_distributed_from_env` when the verb builds its MeshContext with
+``distributed=True``. Storage writes happen only on process 0
+(``MeshContext.is_primary``), mirroring the reference's single Spark driver.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+CLI_MODULE = "incubator_predictionio_tpu.tools.cli"
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@dataclass
+class LaunchResult:
+    returncodes: list[int]
+    outputs: list[str]  # combined stdout+stderr per process
+
+    @property
+    def ok(self) -> bool:
+        return all(rc == 0 for rc in self.returncodes)
+
+
+def launch_local(
+    cli_args: Sequence[str],
+    num_processes: int,
+    coordinator_port: Optional[int] = None,
+    cpu_devices_per_process: Optional[int] = None,
+    env: Optional[dict[str, str]] = None,
+    timeout: Optional[float] = None,
+) -> LaunchResult:
+    """Run ``pio-tpu <cli_args>`` as ``num_processes`` coordinated processes.
+
+    ``cpu_devices_per_process`` forces a CPU mesh with that many virtual
+    devices per process (the no-hardware test topology); leave it ``None`` on
+    real accelerators, where each process claims its locally attached chips.
+    Processes run concurrently and are all waited on; output is captured
+    per process.
+    """
+    import tempfile
+    import time
+
+    if num_processes < 1:
+        raise ValueError("num_processes must be >= 1")
+    port = coordinator_port or free_port()
+    procs: list[subprocess.Popen] = []
+    # capture into temp files, not pipes: a child blocked on a full 64KB
+    # pipe blocks its collectives, which stalls every coordinated peer —
+    # a deadlock no sequential drain order can avoid
+    logs = [tempfile.TemporaryFile(mode="w+") for _ in range(num_processes)]
+    for pid in range(num_processes):
+        penv = dict(os.environ)
+        if env:
+            penv.update(env)
+        penv["PIO_DIST_COORDINATOR"] = f"127.0.0.1:{port}"
+        penv["PIO_DIST_NUM_PROCESSES"] = str(num_processes)
+        penv["PIO_DIST_PROCESS_ID"] = str(pid)
+        if cpu_devices_per_process:
+            penv["JAX_PLATFORMS"] = "cpu"
+            flags = penv.get("XLA_FLAGS", "")
+            flags = " ".join(
+                f for f in flags.split()
+                if "xla_force_host_platform_device_count" not in f
+            )
+            penv["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count="
+                f"{cpu_devices_per_process}"
+            ).strip()
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", CLI_MODULE, *cli_args],
+            env=penv,
+            stdout=logs[pid],
+            stderr=subprocess.STDOUT,
+            text=True,
+        ))
+    deadline = None if timeout is None else time.monotonic() + timeout
+    returncodes: list[int] = []
+    try:
+        for p in procs:
+            remaining = None if deadline is None else deadline - time.monotonic()
+            if remaining is not None and remaining <= 0:
+                raise subprocess.TimeoutExpired(p.args, timeout or 0)
+            returncodes.append(p.wait(timeout=remaining))
+    except subprocess.TimeoutExpired:
+        for q in procs:
+            q.kill()
+        raise
+    finally:
+        outputs = []
+        for f in logs:
+            f.seek(0)
+            outputs.append(f.read())
+            f.close()
+    return LaunchResult(returncodes, outputs)

@@ -55,6 +55,38 @@ def honor_platform_env() -> None:
         jax.config.update("jax_platforms", requested)
 
 
+def init_distributed_from_env() -> None:
+    """Join (or form) a multi-process job — the spark-submit replacement.
+
+    Coordinator/topology comes from ``PIO_DIST_COORDINATOR`` /
+    ``PIO_DIST_NUM_PROCESSES`` / ``PIO_DIST_PROCESS_ID`` (set per process by
+    :mod:`incubator_predictionio_tpu.parallel.launcher` or by the operator's
+    per-host launch script); absent those, ``jax.distributed.initialize()``
+    auto-detects the topology on TPU pods. CPU meshes get gloo cross-process
+    collectives — the CI/test stand-in for ICI/DCN.
+    """
+    import os
+
+    try:
+        if jax.distributed.is_initialized():
+            return
+    except AttributeError:  # pragma: no cover - older jax
+        pass
+    from jax._src import xla_bridge
+
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu") and not xla_bridge._backends:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    coordinator = os.environ.get("PIO_DIST_COORDINATOR")
+    if coordinator:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=int(os.environ["PIO_DIST_NUM_PROCESSES"]),
+            process_id=int(os.environ["PIO_DIST_PROCESS_ID"]),
+        )
+    else:  # pragma: no cover - needs a real pod environment
+        jax.distributed.initialize()
+
+
 @dataclass(frozen=True)
 class MeshConf:
     """Serializable mesh request — stored on EngineInstance rows the way the
@@ -95,8 +127,8 @@ class MeshContext:
         devices.
         """
         honor_platform_env()
-        if distributed:  # pragma: no cover - needs multi-host
-            jax.distributed.initialize()
+        if distributed:
+            init_distributed_from_env()
         devs = list(devices if devices is not None else jax.devices())
         if not axes:
             axes = {"data": len(devs)}
@@ -147,6 +179,12 @@ class MeshContext:
         """The batch-parallel axis (first axis by convention)."""
         return "data" if "data" in self.mesh.shape else self.mesh.axis_names[0]
 
+    @property
+    def is_primary(self) -> bool:
+        """True on the process that owns storage writes (process 0; always
+        True single-process) — the 'Spark driver' role in a multi-host job."""
+        return jax.process_index() == 0
+
     # -- sharding helpers -------------------------------------------------
     def sharding(self, *spec) -> NamedSharding:
         return NamedSharding(self.mesh, P(*spec))
@@ -154,9 +192,40 @@ class MeshContext:
     def replicated(self) -> NamedSharding:
         return NamedSharding(self.mesh, P())
 
+    def put(self, a, *spec):
+        """Place a host array onto the mesh with PartitionSpec ``spec``.
+
+        Single-process this is ``device_put``; multi-process it builds a
+        global ``jax.Array`` from each process's copy of the full host array
+        (``make_array_from_callback`` hands every addressable shard its
+        global slice), so the same staging code runs on a laptop mesh and a
+        pod."""
+        a = np.asarray(a)
+        sh = self.sharding(*spec)
+        if jax.process_count() == 1:
+            return jax.device_put(a, sh)
+        return jax.make_array_from_callback(  # pragma: no cover - multiproc
+            a.shape, sh, lambda idx: a[idx]
+        )
+
     def replicate(self, tree):
         """Place a pytree replicated on every device."""
-        return jax.device_put(tree, self.replicated())
+        if jax.process_count() == 1:
+            return jax.device_put(tree, self.replicated())
+        return jax.tree.map(  # pragma: no cover - multiproc
+            lambda x: self.put(x), tree
+        )
+
+    def host_gather(self, tree):
+        """Global device arrays → host numpy on every process (collective
+        when the tree spans processes; plain np.asarray otherwise)."""
+        if jax.process_count() == 1:
+            return jax.tree.map(np.asarray, tree)
+        from jax.experimental import multihost_utils  # pragma: no cover
+
+        return multihost_utils.process_allgather(  # pragma: no cover
+            tree, tiled=True
+        )
 
     def shard_batch(self, tree, axis_name: Optional[str] = None):
         """Shard leading (batch) dim over the data axis; pads are the caller's

@@ -230,6 +230,7 @@ def cmd_train(args, storage: Storage) -> int:
         stop_after_read=args.stop_after_read,
         stop_after_prepare=args.stop_after_prepare,
         mesh_axes=axes,
+        distributed=getattr(args, "distributed", False),
     )
     if getattr(args, "profile_dir", None):
         from incubator_predictionio_tpu.utils.tracing import profile_trace
@@ -242,7 +243,11 @@ def cmd_train(args, storage: Storage) -> int:
     if getattr(args, "profile_dir", None):
         _out(f"Profiler trace written to {args.profile_dir} "
              "(TensorBoard 'profile' plugin layout).")
-    _out(f"Training completed. Engine instance ID: {instance_id}")
+    if instance_id == "<secondary>":
+        _out("Training completed (secondary process; the primary wrote the "
+             "engine instance).")
+    else:
+        _out(f"Training completed. Engine instance ID: {instance_id}")
     return 0
 
 
@@ -508,8 +513,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--stop-after-read", action="store_true")
     p.add_argument("--stop-after-prepare", action="store_true")
     p.add_argument("--mesh-axes", help='JSON, e.g. \'{"data": 4, "model": 2}\'')
+    p.add_argument("--distributed", action="store_true",
+                   help="join a jax.distributed job (see the launch verb / "
+                        "PIO_DIST_* env)")
     p.add_argument("--profile-dir",
                    help="capture a jax.profiler trace of the run into this dir")
+
+    # launch (Runner.runOnSpark counterpart: N coordinated local processes)
+    p = sub.add_parser("launch")
+    p.add_argument("-n", "--num-processes", type=int, required=True)
+    p.add_argument("--coordinator-port", type=int)
+    p.add_argument("--cpu-devices-per-process", type=int,
+                   help="force a CPU mesh with this many virtual devices per "
+                        "process (testing without accelerators)")
+    p.add_argument("verb_args", nargs=argparse.REMAINDER,
+                   help="the pio-tpu verb (and flags) each process runs")
 
     # eval
     p = sub.add_parser("eval")
@@ -595,10 +613,43 @@ def build_parser() -> argparse.ArgumentParser:
     return parser
 
 
+def cmd_launch(args, storage: Storage) -> int:
+    """Spawn N coordinated processes of another verb (Runner.scala:185's
+    spark-submit construction, minus the JVM)."""
+    from incubator_predictionio_tpu.parallel.launcher import launch_local
+
+    verb_args = list(args.verb_args)
+    if verb_args and verb_args[0] == "--":
+        verb_args = verb_args[1:]
+    if not verb_args:
+        _out("launch: no verb given (e.g. pio-tpu launch -n 2 train -v engine.json)")
+        return 2
+    if verb_args[0] != "train":
+        # without --distributed gating, N processes of any other verb would
+        # just run N independent copies against shared storage
+        _out(f"launch: only the train verb joins a distributed job "
+             f"(got {verb_args[0]!r})")
+        return 2
+    if "--distributed" not in verb_args:
+        verb_args.append("--distributed")
+    result = launch_local(
+        verb_args,
+        num_processes=args.num_processes,
+        coordinator_port=args.coordinator_port,
+        cpu_devices_per_process=args.cpu_devices_per_process,
+    )
+    for pid, (rc, out) in enumerate(zip(result.returncodes, result.outputs)):
+        _out(f"--- process {pid} (exit {rc}) ---")
+        if out:
+            _out(out.rstrip())
+    return 0 if result.ok else 1
+
+
 _COMMANDS = {
     "version": cmd_version,
     "status": cmd_status,
     "train": cmd_train,
+    "launch": cmd_launch,
     "eval": cmd_eval,
     "deploy": cmd_deploy,
     "undeploy": cmd_undeploy,
