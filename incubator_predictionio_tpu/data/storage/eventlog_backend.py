@@ -66,6 +66,8 @@ class _Log:
         if read_only:
             self.f = None
             self._ro_end = 0  # absolute offset of the next unparsed byte
+            self._ro_tail = b""  # last bytes ending at _ro_end (regrow detector)
+            self._ro_stat = None  # (st_size, st_mtime_ns) at last refresh
             self.refresh()
             return
         existed = os.path.exists(path)
@@ -109,26 +111,59 @@ class _Log:
                 self.f.flush()
                 return
             try:
-                size = os.stat(self.path).st_size
+                st = os.stat(self.path)
             except FileNotFoundError:
                 return
-            if self._ro_end == 0:
-                if size < len(fmt.MAGIC):
-                    return
-                self._ro_end = len(fmt.MAGIC)
-            if size <= self._ro_end:
+            size = st.st_size
+            sig = (st.st_size, st.st_mtime_ns)
+            if sig == self._ro_stat and size <= self._ro_end:
+                # Unchanged since last refresh — skip the open+tail check
+                # (point reads call refresh() per record; this is the common
+                # case). Any truncate/append moves size or mtime_ns.
+                return
+            if size < self._ro_end:
+                # File shrank: a recovering writer truncated a torn tail that
+                # we may have (mis)parsed as complete records. Our index can
+                # hold offsets past the new EOF, and `size <= _ro_end` would
+                # suppress refreshes forever — rebuild the view from scratch.
+                self._reset_ro_view()
+            if self._ro_end == 0 and size < len(fmt.MAGIC):
                 return
             with open(self.path, "rb") as rf:
-                if self._ro_end == len(fmt.MAGIC):
-                    magic = rf.read(len(fmt.MAGIC))
-                    if magic != fmt.MAGIC:
-                        raise StorageError(f"{self.path} is not a PIOLOG01 file")
-                else:
-                    rf.seek(self._ro_end)
+                magic = rf.read(len(fmt.MAGIC))
+                if magic != fmt.MAGIC:
+                    raise StorageError(f"{self.path} is not a PIOLOG01 file")
+                if self._ro_end == 0:
+                    self._ro_end = len(fmt.MAGIC)
+                    self._ro_tail = fmt.MAGIC
+                elif self._ro_tail:
+                    # Truncate-then-REGROW leaves size >= _ro_end while the
+                    # bytes under our offset changed; verify the tail snapshot
+                    # before trusting the offset.
+                    rf.seek(self._ro_end - len(self._ro_tail))
+                    if rf.read(len(self._ro_tail)) != self._ro_tail:
+                        self._reset_ro_view()
+                        self._ro_end = len(fmt.MAGIC)
+                        self._ro_tail = fmt.MAGIC
+                if size <= self._ro_end:
+                    self._ro_stat = sig
+                    return
+                rf.seek(self._ro_end)
                 chunk = rf.read()
+            old_end = self._ro_end
             self._ro_end = fmt.apply_records(
-                chunk, self._ro_end, self.strings, self.index
+                chunk, old_end, self.strings, self.index
             )
+            consumed = self._ro_end - old_end
+            self._ro_tail = (self._ro_tail + chunk[:consumed])[-32:]
+            self._ro_stat = sig
+
+    def _reset_ro_view(self) -> None:
+        self._ro_end = 0
+        self._ro_tail = b""
+        self._ro_stat = None
+        self.strings = {}
+        self.index = {}
 
     def _require_writer(self) -> None:
         if self.f is None:

@@ -43,10 +43,11 @@ def free_port() -> int:
 class LaunchResult:
     returncodes: list[int]
     outputs: list[str]  # combined stdout+stderr per process
+    timed_out: bool = False  # deadline hit; unfinished processes got rc=124
 
     @property
     def ok(self) -> bool:
-        return all(rc == 0 for rc in self.returncodes)
+        return not self.timed_out and all(rc == 0 for rc in self.returncodes)
 
 
 def launch_local(
@@ -103,20 +104,33 @@ def launch_local(
         ))
     deadline = None if timeout is None else time.monotonic() + timeout
     returncodes: list[int] = []
+    timed_out = False
     try:
         for p in procs:
             remaining = None if deadline is None else deadline - time.monotonic()
-            if remaining is not None and remaining <= 0:
-                raise subprocess.TimeoutExpired(p.args, timeout or 0)
-            returncodes.append(p.wait(timeout=remaining))
-    except subprocess.TimeoutExpired:
-        for q in procs:
-            q.kill()
-        raise
+            try:
+                if remaining is not None and remaining <= 0:
+                    raise subprocess.TimeoutExpired(p.args, timeout or 0)
+                returncodes.append(p.wait(timeout=remaining))
+            except subprocess.TimeoutExpired:
+                # Kill the whole job but return normally: the captured logs
+                # are the evidence of WHICH peer wedged — raising would
+                # discard them.
+                timed_out = True
+                killed = set()
+                for q in procs:
+                    if q.poll() is None:
+                        q.kill()
+                        q.wait()
+                        killed.add(id(q))
+                returncodes = [
+                    124 if id(q) in killed else q.returncode for q in procs
+                ]
+                break
     finally:
         outputs = []
         for f in logs:
             f.seek(0)
             outputs.append(f.read())
             f.close()
-    return LaunchResult(returncodes, outputs)
+    return LaunchResult(returncodes, outputs, timed_out=timed_out)

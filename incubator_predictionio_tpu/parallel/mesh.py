@@ -48,11 +48,21 @@ def honor_platform_env() -> None:
     """
     import os
 
-    from jax._src import xla_bridge
-
     requested = os.environ.get("JAX_PLATFORMS")
-    if requested and not xla_bridge._backends:
+    if requested and not _backends_initialized():
         jax.config.update("jax_platforms", requested)
+
+
+def _backends_initialized() -> bool:
+    """True if a JAX backend already exists. Peeks at a private attr; a jax
+    upgrade renaming it must not break CLI verbs, so fall back to False
+    (re-applying the config update is a no-op after backend init)."""
+    try:
+        from jax._src import xla_bridge
+
+        return bool(xla_bridge._backends)
+    except (ImportError, AttributeError):  # pragma: no cover - future jax
+        return False
 
 
 def init_distributed_from_env() -> None:
@@ -72,9 +82,7 @@ def init_distributed_from_env() -> None:
             return
     except AttributeError:  # pragma: no cover - older jax
         pass
-    from jax._src import xla_bridge
-
-    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu") and not xla_bridge._backends:
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu") and not _backends_initialized():
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
     coordinator = os.environ.get("PIO_DIST_COORDINATOR")
     if coordinator:

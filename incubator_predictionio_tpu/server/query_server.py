@@ -66,6 +66,8 @@ class ServerConfig:
     access_key: Optional[str] = None  # for feedback events
     server_access_key: Optional[str] = None  # guards /stop and /reload
     max_batch: int = 64  # micro-batch cap for /queries.json (1 = no batching)
+    log_url: Optional[str] = None  # remote error-log shipping (CreateServer.scala:423-436)
+    log_prefix: str = ""  # prepended to shipped log messages
 
 
 class DeployedEngine:
@@ -161,12 +163,18 @@ class MicroBatcher:
         self.batches_served = 0
         self.max_batch_seen = 0
         self._task: Optional[asyncio.Task] = None
+        self._stopped = False
 
     def start(self) -> None:
+        if self._stopped:
+            raise RuntimeError("server shutting down")
         if self._task is None:
             self._task = asyncio.get_running_loop().create_task(self._drain())
 
     async def stop(self) -> None:
+        """Cancel the drainer and fail everything still queued so callers
+        don't hang until aiohttp force-cancels them."""
+        self._stopped = True
         if self._task is not None:
             self._task.cancel()
             try:
@@ -174,6 +182,13 @@ class MicroBatcher:
             except asyncio.CancelledError:
                 pass
             self._task = None
+        while True:
+            try:
+                _, fut = self.queue.get_nowait()
+            except asyncio.QueueEmpty:
+                break
+            if not fut.done():
+                fut.set_result(RuntimeError("server shutting down"))
 
     async def submit(self, payload: dict) -> Any:
         self.start()
@@ -200,11 +215,48 @@ class MicroBatcher:
                 results = await loop.run_in_executor(
                     None, self.deployed.predict_batch, payloads
                 )
+            except asyncio.CancelledError:
+                # stop() cancelled us mid-dispatch: these futures are already
+                # dequeued, so the queue-drain in stop() can't see them — fail
+                # them here or their callers hang forever
+                for _, fut in batch:
+                    if not fut.done():
+                        fut.set_result(RuntimeError("server shutting down"))
+                raise
             except Exception as e:  # noqa: BLE001 - keep the drainer alive
                 results = [e] * len(batch)
             for (_, fut), r in zip(batch, results):
                 if not fut.done():
                     fut.set_result(r)
+
+
+class LatencyReservoir:
+    """Fixed-size ring of recent serving latencies → p50/p95/p99 on demand.
+
+    The instrumented form of the north-star metric (BASELINE.md: predict p50);
+    the reference only ever kept avg/last (CreateServer.scala:567-575)."""
+
+    def __init__(self, capacity: int = 4096):
+        self.capacity = capacity
+        self._buf: list[float] = []
+        self._pos = 0
+
+    def record(self, seconds: float) -> None:
+        if len(self._buf) < self.capacity:
+            self._buf.append(seconds)
+        else:
+            self._buf[self._pos] = seconds
+            self._pos = (self._pos + 1) % self.capacity
+
+    def percentiles(self, qs: tuple[float, ...] = (0.5, 0.95, 0.99)) -> dict[str, float]:
+        if not self._buf:
+            return {f"p{int(q * 100)}": 0.0 for q in qs}
+        s = sorted(self._buf)
+        out = {}
+        for q in qs:
+            idx = min(len(s) - 1, max(0, int(round(q * (len(s) - 1)))))
+            out[f"p{int(q * 100)}"] = s[idx]
+        return out
 
 
 def load_deployed_engine(
@@ -257,6 +309,7 @@ class QueryServer:
         self.request_count = 0
         self.avg_serving_sec = 0.0
         self.last_serving_sec = 0.0
+        self.latency = LatencyReservoir()
         self._start_time = time.time()
         self._runner: Optional[web.AppRunner] = None
         self._stop_event = asyncio.Event()
@@ -286,6 +339,9 @@ class QueryServer:
             "requestCount": self.request_count,
             "avgServingSec": self.avg_serving_sec,
             "lastServingSec": self.last_serving_sec,
+            "servingSecPercentiles": self.latency.percentiles(),
+            "batchesServed": self.batcher.batches_served,
+            "maxBatchSeen": self.batcher.max_batch_seen,
             "uptimeSec": time.time() - self._start_time,
         })
 
@@ -299,10 +355,14 @@ class QueryServer:
             prediction = await self.batcher.submit(payload)
         except (TypeError, ValueError, KeyError) as e:
             return web.json_response({"message": f"Invalid query: {e}"}, status=400)
+        except Exception as e:  # noqa: BLE001 - ship serving errors remotely
+            self._ship_remote_log(f"query failed: {e!r}")
+            raise
         dt = time.time() - t0
         self.request_count += 1
         self.last_serving_sec = dt
         self.avg_serving_sec += (dt - self.avg_serving_sec) / self.request_count
+        self.latency.record(dt)
         result = to_jsonable(prediction)
         from incubator_predictionio_tpu.server.plugins import apply_output_plugins
 
@@ -313,10 +373,23 @@ class QueryServer:
             task.add_done_callback(self._feedback_tasks.discard)
         return web.json_response(result)
 
-    async def _send_feedback(self, query: dict, prediction: Any) -> None:
-        """POST a `predict` event to the event server (CreateServer.scala:508-570)."""
+    @staticmethod
+    async def _post_json(url: str, body: dict, what: str) -> None:
+        """Fire-and-forget POST; failures are logged, never raised (feedback
+        and log shipping must never break serving)."""
         import aiohttp
 
+        try:
+            async with aiohttp.ClientSession() as session:
+                async with session.post(url, json=body,
+                                        timeout=aiohttp.ClientTimeout(total=5)) as resp:
+                    if resp.status >= 300:
+                        logger.warning("%s rejected: %s", what, resp.status)
+        except Exception as e:  # noqa: BLE001
+            logger.warning("%s failed: %s", what, e)
+
+    async def _send_feedback(self, query: dict, prediction: Any) -> None:
+        """POST a `predict` event to the event server (CreateServer.scala:508-570)."""
         pr_id = prediction.get("prId") if isinstance(prediction, dict) else None
         pr_id = pr_id or uuid.uuid4().hex
         event = {
@@ -329,14 +402,21 @@ class QueryServer:
             f"http://{self.config.event_server_ip}:{self.config.event_server_port}"
             f"/events.json?accessKey={self.config.access_key or ''}"
         )
-        try:
-            async with aiohttp.ClientSession() as session:
-                async with session.post(url, json=event,
-                                        timeout=aiohttp.ClientTimeout(total=5)) as resp:
-                    if resp.status >= 300:
-                        logger.warning("feedback event rejected: %s", resp.status)
-        except Exception as e:  # noqa: BLE001 - feedback must never break serving
-            logger.warning("feedback event failed: %s", e)
+        await self._post_json(url, event, "feedback event")
+
+    def _ship_remote_log(self, message: str) -> None:
+        """Fire-and-forget POST of a serving error to ``--log-url``
+        (reference ``remoteLog``, CreateServer.scala:423-436)."""
+        if not self.config.log_url:
+            return
+
+        body = {"level": "ERROR",
+                "message": f"{self.config.log_prefix}{message}",
+                "engineInstanceId": self.deployed.instance.id}
+        task = asyncio.create_task(
+            self._post_json(self.config.log_url, body, "remote log"))
+        self._feedback_tasks.add(task)
+        task.add_done_callback(self._feedback_tasks.discard)
 
     def _authorized(self, request: web.Request) -> bool:
         key = self.config.server_access_key
@@ -351,6 +431,9 @@ class QueryServer:
             self.deployed = load_deployed_engine(self.config, self.storage, self.ctx)
         except RuntimeError as e:
             return web.json_response({"message": str(e)}, status=400)
+        # The batcher captured the old DeployedEngine at construction; repoint
+        # it or /reload would silently keep serving the stale model.
+        self.batcher.deployed = self.deployed
         return web.json_response({"message": "Reloaded",
                                   "engineInstanceId": self.deployed.instance.id})
 
@@ -394,8 +477,11 @@ class QueryServer:
         await self.shutdown()
 
     async def shutdown(self) -> None:
+        # stop accepting connections BEFORE stopping the batcher — a query in
+        # the gap would otherwise resurrect the drainer task
         if self._runner is not None:
             await self._runner.cleanup()
+        await self.batcher.stop()
 
 
 def serve_forever(config: ServerConfig, storage: Optional[Storage] = None) -> None:

@@ -285,6 +285,8 @@ def cmd_deploy(args, storage: Storage) -> int:
         server_access_key=args.server_access_key,
         ssl_cert=args.ssl_cert,
         ssl_key=args.ssl_key,
+        log_url=args.log_url,
+        log_prefix=args.log_prefix,
     )
     serve_forever(config, storage)
     return 0
@@ -526,6 +528,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--cpu-devices-per-process", type=int,
                    help="force a CPU mesh with this many virtual devices per "
                         "process (testing without accelerators)")
+    p.add_argument("--timeout", type=float, default=None,
+                   help="kill the whole job after this many seconds (a wedged "
+                        "peer otherwise hangs the launcher indefinitely)")
     p.add_argument("verb_args", nargs=argparse.REMAINDER,
                    help="the pio-tpu verb (and flags) each process runs")
 
@@ -548,6 +553,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--server-access-key")
     p.add_argument("--ssl-cert")
     p.add_argument("--ssl-key")
+    p.add_argument("--log-url",
+                   help="ship serving errors to this URL "
+                        "(reference CreateServer.scala:423-436)")
+    p.add_argument("--log-prefix", default="",
+                   help="prefix for shipped log messages")
     p = sub.add_parser("undeploy")
     p.add_argument("--ip", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8000)
@@ -637,7 +647,11 @@ def cmd_launch(args, storage: Storage) -> int:
         num_processes=args.num_processes,
         coordinator_port=args.coordinator_port,
         cpu_devices_per_process=args.cpu_devices_per_process,
+        timeout=args.timeout,
     )
+    if result.timed_out:
+        _out(f"launch: timed out after {args.timeout}s; job killed "
+             "(per-process logs below show which peer wedged)")
     for pid, (rc, out) in enumerate(zip(result.returncodes, result.outputs)):
         _out(f"--- process {pid} (exit {rc}) ---")
         if out:

@@ -431,3 +431,75 @@ def test_read_only_reader_while_writer_locked(store, tmp_path):
                                 entity_id="u", event_time=t(1)), APP)
     finally:
         reader.close()
+
+
+def test_read_only_reader_recovers_from_file_shrink(tmp_path):
+    """If the file shrinks under a read-only view (a recovering writer
+    truncated a torn tail the reader had already parsed), the reader must
+    rebuild from scratch instead of suppressing refreshes forever with stale
+    index offsets past the new EOF."""
+    from incubator_predictionio_tpu.data.storage.eventlog_backend import _Log
+
+    path = str(tmp_path / "app_1.piolog")
+    writer = _Log(path)
+    interner_snapshot = None
+    for i in range(6):
+        writer.append_event(
+            Event(event="rate", entity_type="user", entity_id=f"u{i}",
+                  properties=DataMap({"rating": float(i)}), event_time=t(i)),
+            f"e{i}")
+        if i == 2:
+            interner_snapshot = writer.f.tell()
+    reader = _Log(path, read_only=True)
+    assert set(reader.index) == {f"e{i}" for i in range(6)}
+    writer.close()
+    # simulate crash recovery: truncate back to after e0..e2, then a new
+    # writer appends different records
+    with open(path, "r+b") as f:
+        f.truncate(interner_snapshot)
+    writer2 = _Log(path)
+    writer2.append_event(
+        Event(event="rate", entity_type="user", entity_id="fresh",
+              properties=DataMap({"rating": 9.0}), event_time=t(100)),
+        "fresh-1")
+    writer2.close()
+    reader.refresh()
+    assert set(reader.index) == {"e0", "e1", "e2", "fresh-1"}
+    assert reader.read_at(reader.index["fresh-1"]).entity_id == "fresh"
+    reader.close()
+
+
+def test_read_only_reader_recovers_from_truncate_then_regrow(tmp_path):
+    """Truncate-then-REGROW: the writer truncates a tail the reader parsed,
+    then appends enough that size is back past the reader's offset — the size
+    check alone can't see it; the tail snapshot must."""
+    from incubator_predictionio_tpu.data.storage.eventlog_backend import _Log
+
+    path = str(tmp_path / "app_1.piolog")
+    writer = _Log(path)
+    cut = None
+    for i in range(6):
+        writer.append_event(
+            Event(event="rate", entity_type="user", entity_id=f"u{i}",
+                  properties=DataMap({"rating": float(i)}), event_time=t(i)),
+            f"e{i}")
+        if i == 2:
+            cut = writer.f.tell()
+    reader = _Log(path, read_only=True)
+    assert len(reader.index) == 6
+    writer.close()
+    with open(path, "r+b") as f:
+        f.truncate(cut)
+    writer2 = _Log(path)
+    for i in range(10):  # regrow well past the reader's old offset
+        writer2.append_event(
+            Event(event="rate", entity_type="user", entity_id=f"new{i}",
+                  properties=DataMap({"rating": 1.0}), event_time=t(200 + i)),
+            f"n{i}")
+    writer2.close()
+    reader.refresh()
+    assert set(reader.index) == (
+        {"e0", "e1", "e2"} | {f"n{i}" for i in range(10)}
+    )
+    assert reader.read_at(reader.index["n9"]).entity_id == "new9"
+    reader.close()

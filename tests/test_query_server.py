@@ -136,10 +136,68 @@ def test_reload_and_stop_auth(deployed_env):
         assert resp.status == 200
         body = await resp.json()
         assert body["message"] == "Reloaded" and body["engineInstanceId"]
+        # the micro-batcher must serve the NEW engine after /reload — the
+        # pre-fix bug kept the stale DeployedEngine captured at construction
+        assert server.batcher.deployed is server.deployed
+        resp = await client.post("/queries.json",
+                                 json={"features": list(map(float, x[0]))})
+        assert resp.status == 200
         resp = await client.post("/stop?accessKey=sekret")
         assert resp.status == 200
 
     run_server(deployed_env, t, server_access_key="sekret")
+
+
+def test_latency_percentiles_on_status(deployed_env):
+    async def t(client, server, x, y):
+        for i in range(10):
+            resp = await client.post(
+                "/queries.json", json={"features": list(map(float, x[i]))}
+            )
+            assert resp.status == 200
+        status = await (await client.get("/")).json()
+        pcts = status["servingSecPercentiles"]
+        assert set(pcts) == {"p50", "p95", "p99"}
+        assert 0 < pcts["p50"] <= pcts["p95"] <= pcts["p99"]
+
+    run_server(deployed_env, t)
+
+
+def test_batcher_stop_fails_queued_requests(deployed_env):
+    async def t(client, server, x, y):
+        # enqueue without a running drainer, then stop: queued futures must be
+        # failed rather than left to hang until aiohttp force-cancels
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        await server.batcher.queue.put(({"features": [0.0, 0.0, 0.0]}, fut))
+        await server.shutdown()
+        assert isinstance(fut.result(), RuntimeError)
+
+    run_server(deployed_env, t)
+
+
+def test_remote_log_shipping(deployed_env):
+    from aiohttp import web
+
+    async def t(client, server, x, y):
+        received = []
+
+        async def sink(request):
+            received.append(await request.json())
+            return web.json_response({})
+
+        sink_app = web.Application()
+        sink_app.router.add_post("/logs", sink)
+        sink_server = TestServer(sink_app)
+        await sink_server.start_server()
+        server.config.log_url = str(sink_server.make_url("/logs"))
+        server._ship_remote_log("boom")
+        await asyncio.gather(*server._feedback_tasks)
+        assert received and received[0]["level"] == "ERROR"
+        assert "boom" in received[0]["message"]
+        await sink_server.close()
+
+    run_server(deployed_env, t)
 
 
 def test_undeployed_engine_errors(tmp_path):
