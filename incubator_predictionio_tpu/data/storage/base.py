@@ -125,15 +125,21 @@ class EventStore(abc.ABC):
         Replaces ``PEvents.find → RDD[Event]`` partitioning. Events of one
         entity always land in the same shard (shard = hash(entity_id) mod n),
         so per-shard property aggregation needs no cross-shard merge join.
-        Backends with native partitioning should override; the default
-        partitions one full scan.
+        Backends with native partitioning should override; the default filters
+        a scan per shard lazily — a caller consuming only its own shard (one
+        process of a multi-host job) holds O(1) events in memory, never the
+        full store.
         """
-        buckets: list[list[Event]] = [[] for _ in range(n_shards)]
-        for e in self.find(
-            app_id, channel_id, start_time, until_time, entity_type, None, event_names
-        ):
-            buckets[entity_shard(e.entity_id, n_shards)].append(e)
-        return [iter(b) for b in buckets]
+
+        def shard_iter(shard: int) -> Iterator[Event]:
+            for e in self.find(
+                app_id, channel_id, start_time, until_time, entity_type,
+                None, event_names,
+            ):
+                if entity_shard(e.entity_id, n_shards) == shard:
+                    yield e
+
+        return [shard_iter(i) for i in range(n_shards)]
 
     def aggregate_properties(
         self,
@@ -175,6 +181,9 @@ class EventStore(abc.ABC):
         default_values: Optional[dict] = None,
         missing_value: float = 0.0,
         dedup: bool = False,
+        n_shards: Optional[int] = None,
+        shard_index: int = 0,
+        chunk_rows: int = 262_144,
     ):
         """Matching events → columnar (entity, target, value) training triples.
 
@@ -191,21 +200,52 @@ class EventStore(abc.ABC):
         (entity, target) pair — the latest event wins, rows in pair-first-seen
         order — matching "later events of the same pair overwrite" template
         semantics; ``dedup=False`` emits one row per event in time order.
+
+        ``n_shards``/``shard_index`` select an entity-disjoint slice (same
+        partition as :meth:`find_sharded`): the per-process read path of a
+        multi-host job — each process assembles only its shard's rows
+        (reference: RDD partition reads, PEvents.scala:38). Rows accumulate
+        into fixed-size numpy chunks (``chunk_rows``), so intermediate host
+        memory is bounded by the output size + one chunk, not by per-row
+        Python object overhead.
         """
         import numpy as np
 
         defaults = dict(default_values or {})
         evocab: dict[str, int] = {}
         tvocab: dict[str, int] = {}
-        e_idx: list[int] = []
-        t_idx: list[int] = []
-        vals: list[float] = []
         pair_row: dict[tuple[int, int], int] = {}
-        for e in self.find(
+        chunks: list[tuple[np.ndarray, np.ndarray, np.ndarray]] = []
+        ce = np.empty(chunk_rows, np.int32)
+        ct = np.empty(chunk_rows, np.int32)
+        cv = np.empty(chunk_rows, np.float32)
+        fill = 0
+        n_rows = 0
+
+        def flush():
+            nonlocal fill
+            if fill:
+                chunks.append((ce[:fill].copy(), ct[:fill].copy(), cv[:fill].copy()))
+                fill = 0
+
+        def set_row(row: int, v: float) -> None:
+            # dedup overwrite: the row may live in a flushed chunk
+            chunk, off = divmod(row, chunk_rows)
+            if chunk < len(chunks):
+                chunks[chunk][2][off] = v
+            else:
+                cv[off] = v
+
+        events = self.find(
             app_id, channel_id, start_time, until_time, entity_type, None,
             event_names, target_entity_type,
-        ):
+        )
+        for e in events:
             if e.target_entity_id is None:
+                continue
+            if n_shards is not None and entity_shard(
+                e.entity_id, n_shards
+            ) != shard_index:
                 continue
             if e.event in defaults:
                 v = float(defaults[e.event])
@@ -220,18 +260,29 @@ class EventStore(abc.ABC):
             if dedup:
                 row = pair_row.get((ui, ti))
                 if row is not None:
-                    vals[row] = v
+                    set_row(row, v)
                     continue
-                pair_row[(ui, ti)] = len(vals)
-            e_idx.append(ui)
-            t_idx.append(ti)
-            vals.append(v)
+                pair_row[(ui, ti)] = n_rows
+            ce[fill], ct[fill], cv[fill] = ui, ti, v
+            fill += 1
+            n_rows += 1
+            if fill == chunk_rows:
+                flush()
+        flush()
+        if not chunks:
+            e_idx = np.empty(0, np.int32)
+            t_idx = np.empty(0, np.int32)
+            vals = np.empty(0, np.float32)
+        else:
+            e_idx = np.concatenate([c[0] for c in chunks])
+            t_idx = np.concatenate([c[1] for c in chunks])
+            vals = np.concatenate([c[2] for c in chunks])
         return (
             np.asarray(list(evocab), object),
             np.asarray(list(tvocab), object),
-            np.asarray(e_idx, np.int32),
-            np.asarray(t_idx, np.int32),
-            np.asarray(vals, np.float32),
+            e_idx,
+            t_idx,
+            vals,
         )
 
 

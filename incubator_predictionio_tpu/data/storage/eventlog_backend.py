@@ -117,10 +117,19 @@ class _Log:
             size = st.st_size
             sig = (st.st_size, st.st_mtime_ns)
             if sig == self._ro_stat and size <= self._ro_end:
-                # Unchanged since last refresh — skip the open+tail check
-                # (point reads call refresh() per record; this is the common
-                # case). Any truncate/append moves size or mtime_ns.
-                return
+                # Same stat signature since last refresh (the common case for
+                # point reads, which call refresh() per record). The stat
+                # alone can miss a truncate-then-regrow to the identical size
+                # within one mtime granule, so still verify the tail bytes —
+                # one small pread, no magic re-check / full reparse.
+                if self._ro_tail and _pread(
+                    self.path, self._ro_end - len(self._ro_tail),
+                    len(self._ro_tail),
+                ) == self._ro_tail:
+                    return
+                # tail moved under an unchanged stat → fall through to the
+                # full (rebuilding) path
+                self._ro_stat = None
             if size < self._ro_end:
                 # File shrank: a recovering writer truncated a torn tail that
                 # we may have (mis)parsed as complete records. Our index can
@@ -214,6 +223,12 @@ class _Log:
         with self.lock:
             if self.f is not None:
                 self.f.close()
+
+
+def _pread(path: str, offset: int, n: int) -> bytes:
+    with open(path, "rb") as f:
+        f.seek(max(offset, 0))
+        return f.read(n)
 
 
 class EventLogEvents(EventStore):
@@ -406,6 +421,9 @@ class EventLogEvents(EventStore):
         default_values: Optional[dict] = None,
         missing_value: float = 0.0,
         dedup: bool = False,
+        n_shards: Optional[int] = None,
+        shard_index: int = 0,
+        chunk_rows: int = 262_144,
     ):
         log = self._log(app_id, channel_id)
         flt = make_filter(
@@ -414,15 +432,21 @@ class EventLogEvents(EventStore):
         )
         with log.lock:
             log.refresh()
+            # sharding happens inside the C++ scan (crc32 entity partition),
+            # so a multi-process job's per-process read materializes ~1/P of
+            # the store — never a full replica
             result = native_assemble(
                 log.path, flt, value_property, default_values,
-                missing_value, dedup,
+                missing_value, dedup, n_shards=n_shards,
+                shard_index=shard_index,
             )
         if result is None:
             return super().assemble_triples(
                 app_id, channel_id, start_time, until_time, entity_type,
                 event_names, target_entity_type, value_property,
                 default_values, missing_value, dedup,
+                n_shards=n_shards, shard_index=shard_index,
+                chunk_rows=chunk_rows,
             )
         return result
 

@@ -162,16 +162,20 @@ class PEventStore(_BaseStore):
         default_values: Optional[dict] = None,
         missing_value: float = 0.0,
         dedup: bool = False,
+        n_shards: Optional[int] = None,
+        shard_index: int = 0,
     ):
         """Columnar (entity, target, value) triples — the bulk training read.
 
         See :meth:`EventStore.assemble_triples
         <incubator_predictionio_tpu.data.storage.base.EventStore.assemble_triples>`
         for semantics; the eventlog backend serves this from the native C++
-        scanner without building per-event Python objects."""
+        scanner without building per-event Python objects. Pass
+        ``n_shards``/``shard_index`` for the per-process slice of a multi-host
+        job (entity-disjoint, same partition as :meth:`find_sharded`)."""
         app_id, channel_id = self._resolve(app_name, channel_name)
         return self.storage.get_events().assemble_triples(
             app_id, channel_id, start_time, until_time, entity_type,
             event_names, target_entity_type, value_property, default_values,
-            missing_value, dedup,
+            missing_value, dedup, n_shards=n_shards, shard_index=shard_index,
         )

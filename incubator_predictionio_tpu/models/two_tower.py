@@ -111,31 +111,44 @@ class TwoTowerMF:
         ratings: np.ndarray,   # [n] float32
         n_users: int,
         n_items: int,
+        rows_are_local: bool = False,
     ) -> TwoTowerModel:
+        """``rows_are_local=True``: the given triples are only THIS process's
+        entity-disjoint shard (indices already global); batches are assembled
+        per process and joined into global arrays via
+        ``make_array_from_process_local_data`` — host memory is data/P per
+        process instead of a full replica (reference counterpart: RDD
+        partition reads, PEvents.scala:38)."""
         cfg = self.config
         n = len(users)
         if not (len(items) == len(ratings) == n):
             raise ValueError("users/items/ratings must be equal length")
-        mean = float(ratings.mean()) if n else 0.0
 
-        global_batch = ctx.pad_to_batch_multiple(min(cfg.batch_size, max(n, 1)))
-        n_batches = max(1, (n + global_batch - 1) // global_batch)
-        n_pad = n_batches * global_batch
-        rng = np.random.default_rng(cfg.seed)
-        perm = rng.permutation(n)
-        pad_idx = rng.integers(0, max(n, 1), n_pad - n)
-        order = np.concatenate([perm, pad_idx])
-        w = np.concatenate([np.ones(n, np.float32), np.zeros(n_pad - n, np.float32)])
+        if rows_are_local and ctx.process_count > 1:
+            ub, ib, rb, wb, mean = self._stage_local(
+                ctx, users, items, ratings)
+        else:
+            mean = float(ratings.mean()) if n else 0.0
+            global_batch = ctx.pad_to_batch_multiple(
+                min(cfg.batch_size, max(n, 1)))
+            n_batches = max(1, (n + global_batch - 1) // global_batch)
+            n_pad = n_batches * global_batch
+            rng = np.random.default_rng(cfg.seed)
+            perm = rng.permutation(n)
+            pad_idx = rng.integers(0, max(n, 1), n_pad - n)
+            order = np.concatenate([perm, pad_idx])
+            w = np.concatenate(
+                [np.ones(n, np.float32), np.zeros(n_pad - n, np.float32)])
 
-        def stage(a, dtype):
-            a = np.asarray(a, dtype)[order] if len(a) == n else np.asarray(a, dtype)
-            a = a.reshape(n_batches, global_batch)
-            return ctx.put(a, None, ctx.data_axis)
+            def stage(a, dtype):
+                a = np.asarray(a, dtype)[order] if len(a) == n else np.asarray(a, dtype)
+                a = a.reshape(n_batches, global_batch)
+                return ctx.put(a, None, ctx.data_axis)
 
-        ub = stage(users, np.int32)
-        ib = stage(items, np.int32)
-        rb = stage(ratings.astype(np.float32) - mean, np.float32)
-        wb = ctx.put(w.reshape(n_batches, global_batch), None, ctx.data_axis)
+            ub = stage(users, np.int32)
+            ib = stage(items, np.int32)
+            rb = stage(ratings.astype(np.float32) - mean, np.float32)
+            wb = ctx.put(w.reshape(n_batches, global_batch), None, ctx.data_axis)
 
         key = jax.random.key(cfg.seed)
         ku, ki = jax.random.split(key)
@@ -189,6 +202,54 @@ class TwoTowerMF:
         )
         model.final_loss = float(loss)
         return model
+
+    def _stage_local(self, ctx: MeshContext, users, items, ratings):
+        """Per-process batch staging for entity-sharded input rows."""
+        cfg = self.config
+        n_local = len(users)
+        procs = ctx.process_count
+        # one metadata exchange: (row count, rating sum) per process
+        stats = ctx.allgather_obj(
+            (n_local, float(np.asarray(ratings, np.float64).sum())))
+        n_global = sum(s[0] for s in stats)
+        mean = (sum(s[1] for s in stats) / n_global) if n_global else 0.0
+        global_batch = ctx.pad_to_batch_multiple(
+            min(cfg.batch_size, max(n_global, 1)))
+        if global_batch % procs:
+            raise ValueError(
+                f"global batch {global_batch} not divisible by "
+                f"{procs} processes")
+        b_local = global_batch // procs
+        n_batches = max(
+            1, max((s[0] + b_local - 1) // b_local for s in stats))
+        n_pad = n_batches * b_local
+        rng = np.random.default_rng(cfg.seed + ctx.process_index)
+        if n_local:
+            order = np.concatenate([
+                rng.permutation(n_local),
+                rng.integers(0, n_local, n_pad - n_local),
+            ])
+        else:
+            order = np.zeros(n_pad, np.int64)  # all-padding shard
+            users = np.zeros(1, np.int32)
+            items = np.zeros(1, np.int32)
+            ratings = np.zeros(1, np.float32)
+        w = np.concatenate([
+            np.ones(n_local, np.float32),
+            np.zeros(n_pad - n_local, np.float32),
+        ])
+
+        def stage(a, dtype):
+            a = np.asarray(a, dtype)[order].reshape(n_batches, b_local)
+            return ctx.put_local_batches(a)
+
+        return (
+            stage(users, np.int32),
+            stage(items, np.int32),
+            stage(np.asarray(ratings, np.float32) - mean, np.float32),
+            ctx.put_local_batches(w.reshape(n_batches, b_local)),
+            mean,
+        )
 
     # -- scoring ----------------------------------------------------------
     @staticmethod

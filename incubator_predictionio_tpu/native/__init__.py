@@ -126,6 +126,8 @@ def get_lib() -> Any:
             ctypes.c_int32,
             ctypes.c_double,
             ctypes.c_int32,
+            ctypes.c_int32,  # n_shards (0 = unsharded)
+            ctypes.c_int32,  # shard_index
             ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
         ]
         lib.pl_free.restype = None
@@ -233,11 +235,15 @@ def assemble(
     default_values: Optional[dict[str, float]],
     missing_value: float,
     dedup: bool,
+    n_shards: Optional[int] = None,
+    shard_index: int = 0,
 ):
     """Native triple assembly → (entity_vocab, target_vocab, entity_idx,
     target_idx, values) numpy arrays, or None if the library is unavailable.
     Semantics documented at ``pl_assemble`` in src/eventlog.cc and mirrored by
-    ``EventStore.assemble_triples``."""
+    ``EventStore.assemble_triples``. ``n_shards``/``shard_index`` select the
+    entity-disjoint shard during the C++ scan (crc32 partition, identical to
+    ``entity_shard``)."""
     import numpy as np
 
     lib = get_lib()
@@ -258,6 +264,8 @@ def assemble(
         len(defaults),
         float(missing_value),
         1 if dedup else 0,
+        int(n_shards or 0),
+        int(shard_index),
         ctypes.byref(buf),
     )
     if n < 0:

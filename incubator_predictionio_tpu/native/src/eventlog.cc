@@ -32,6 +32,25 @@
 
 namespace {
 
+// CRC-32 (IEEE, reflected, poly 0xEDB88320) — bit-identical to Python's
+// zlib.crc32, the entity→shard partition function shared with
+// data/storage/base.py entity_shard(). One table, built on first use.
+uint32_t crc32_ieee(const uint8_t* data, size_t n) {
+  static uint32_t table[256];
+  static bool init = false;
+  if (!init) {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      table[i] = c;
+    }
+    init = true;
+  }
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; i++) c = table[(c ^ data[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
 constexpr uint32_t kNoneId = 0xFFFFFFFFu;
 constexpr uint16_t kAbsent16 = 0xFFFFu;
 constexpr uint8_t kKindIntern = 1;
@@ -548,6 +567,11 @@ bool parse_decimal(const std::string& raw, double* out) {
 // pair-first-seen; with dedup=0 every event emits a row in time order.
 // Vocab ids are dense, in first-emitted-row order.
 //
+// n_shards > 0 keeps only events whose entity hashes into shard_index
+// (crc32(entity_id) % n_shards — the same entity-disjoint partition as
+// EventStore.find_sharded), filtered DURING the scan so a multi-process
+// job's per-process read materializes ~1/P of the store, never all of it.
+//
 // Result buffer (mallocd into *out_buf, byte length returned; pl_free):
 //   u32 n_entities, str16 × n_entities      # entity vocab
 //   u32 n_targets,  str16 × n_targets      # target vocab
@@ -557,7 +581,9 @@ bool parse_decimal(const std::string& raw, double* out) {
 int64_t pl_assemble(const char* path, const Filter* filter,
                     const char* value_prop, const char** default_names,
                     const double* default_vals, int32_t n_defaults,
-                    double missing_val, int32_t dedup, uint8_t** out_buf) {
+                    double missing_val, int32_t dedup,
+                    int32_t n_shards, int32_t shard_index,
+                    uint8_t** out_buf) {
   LogData log;
   if (!load_log(path, &log)) return -1;
 
@@ -580,6 +606,10 @@ int64_t pl_assemble(const char* path, const Filter* filter,
     seq++;
     if (!e.has_target_id) continue;
     if (!matches(*filter, log, e)) continue;
+    if (n_shards > 0 &&
+        static_cast<int32_t>(crc32_ieee(e.entity_id.p, e.entity_id.n) %
+                             static_cast<uint32_t>(n_shards)) != shard_index)
+      continue;
     recs.push_back(Rec{e.event_time_us, seq, e.name_id, e.entity_id,
                        e.target_id, e.props});
   }

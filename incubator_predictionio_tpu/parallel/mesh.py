@@ -193,6 +193,14 @@ class MeshContext:
         True single-process) — the 'Spark driver' role in a multi-host job."""
         return jax.process_index() == 0
 
+    @property
+    def process_count(self) -> int:
+        return jax.process_count()
+
+    @property
+    def process_index(self) -> int:
+        return jax.process_index()
+
     # -- sharding helpers -------------------------------------------------
     def sharding(self, *spec) -> NamedSharding:
         return NamedSharding(self.mesh, P(*spec))
@@ -261,7 +269,51 @@ class MeshContext:
         """Multi-host input feeding (jax.make_array_from_process_local_data)."""
         return jax.make_array_from_process_local_data(
             self.sharding(*spec), local_data
-        )  # pragma: no cover - needs multi-host
+        )
+
+    def put_local_batches(self, tree, axis: Optional[str] = None):
+        """Per-process staged batches → one global array per leaf.
+
+        Each leaf is ``[n_batches, B_local, ...]`` holding ONLY this
+        process's rows; the result is the global ``[n_batches, B, ...]``
+        array sharded over the data axis on dim 1 (B = B_local × processes).
+        This is the bounded-memory alternative to :meth:`put`'s
+        full-copy-per-process staging: host RSS per process is data/P.
+        """
+        axis = axis or self.data_axis
+
+        def put(x):
+            x = np.asarray(x)
+            sh = self.sharding(None, axis)
+            if jax.process_count() == 1:
+                return jax.device_put(x, sh)
+            return self.make_global_array(x, P(None, axis))
+
+        return jax.tree.map(put, tree)
+
+    def allgather_obj(self, obj: Any) -> list[Any]:
+        """All-gather a small picklable host object across processes —
+        the metadata exchange primitive (vocab union, row counts) of the
+        sharded input path. Single-process returns ``[obj]``. Two rounds of
+        ``process_allgather`` (lengths, then padded payloads) because
+        payloads differ per process."""
+        import pickle
+
+        if jax.process_count() == 1:
+            return [obj]
+        from jax.experimental import multihost_utils
+
+        payload = np.frombuffer(pickle.dumps(obj), np.uint8)
+        lens = np.asarray(multihost_utils.process_allgather(
+            np.asarray([len(payload)], np.int64))).reshape(-1)
+        padded = np.zeros(int(lens.max()), np.uint8)
+        padded[: len(payload)] = payload
+        gathered = np.asarray(multihost_utils.process_allgather(padded))
+        gathered = gathered.reshape(jax.process_count(), -1)
+        return [
+            pickle.loads(gathered[i, : int(lens[i])].tobytes())
+            for i in range(jax.process_count())
+        ]
 
     @contextlib.contextmanager
     def activate(self):

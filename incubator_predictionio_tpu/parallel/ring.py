@@ -54,6 +54,15 @@ def _chunk_attend(q, k, v, mask, m, l, o):
     return m_new, l_new, o_new
 
 
+def _mark_varying(x, axes):
+    """Mark ``x`` device-varying over manual ``axes`` — pcast on jax ≥ 0.9,
+    pvary before it (pinned here so an upgrade can't silently break the ring;
+    tests assert the suite is deprecation-warning-free)."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axes, to="varying")
+    return jax.lax.pvary(x, axes)  # pragma: no cover - older jax
+
+
 def ring_attention(q, k, v, axis_name: str, pvary_axes=None):
     """Causal ring attention for one sequence shard (call under shard_map).
 
@@ -80,12 +89,14 @@ def ring_attention(q, k, v, axis_name: str, pvary_axes=None):
         vc = jax.lax.ppermute(vc, axis_name, [(i, (i + 1) % s_size) for i in range(s_size)])
         return (kc, vc, m, l, o), None
 
-    # pvary: fresh accumulators must be marked varying over the manual axes,
-    # or scan rejects the carry (unvarying input vs varying output)
+    # fresh accumulators must be marked varying over the manual axes, or scan
+    # rejects the carry (unvarying input vs varying output); pcast is the
+    # current API (pvary deprecated in jax 0.9)
     axes = tuple(pvary_axes) if pvary_axes is not None else (axis_name,)
-    m0 = jax.lax.pvary(jnp.full((b, h, lc), neg), axes)
-    l0 = jax.lax.pvary(jnp.zeros((b, h, lc), jnp.float32), axes)
-    o0 = jax.lax.pvary(jnp.zeros((b, lc, h, d), jnp.float32), axes)
+    _vary = functools.partial(_mark_varying, axes=axes)
+    m0 = _vary(jnp.full((b, h, lc), neg))
+    l0 = _vary(jnp.zeros((b, h, lc), jnp.float32))
+    o0 = _vary(jnp.zeros((b, lc, h, d), jnp.float32))
     (kc, vc, m, l, o), _ = jax.lax.scan(
         body, (k, v, m0, l0, o0), jnp.arange(s_size)
     )

@@ -19,12 +19,14 @@ optional ``channel`` query param resolved against the app's channels.
 
 from __future__ import annotations
 
+import asyncio
 import base64
 import dataclasses
 import datetime as _dt
 import json
 import logging
 import os
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import replace
 from typing import Optional
 
@@ -89,6 +91,18 @@ class EventServer:
         self.storage = storage or get_storage()
         self.stats = Stats()
         self._runner: Optional[web.AppRunner] = None
+        # Storage calls are synchronous (LEvents contract, storage/base.py);
+        # run them here so concurrent ingestion can't stall the accept loop —
+        # the async surface the reference gets from Futures
+        # (EventServer.scala:261-375). Backends are thread-safe (RLocks;
+        # sqlite opens with check_same_thread=False).
+        self._executor = ThreadPoolExecutor(
+            max_workers=4, thread_name_prefix="evstore")
+
+    async def _run(self, fn, *args):
+        """Run a blocking storage call off the event loop."""
+        return await asyncio.get_running_loop().run_in_executor(
+            self._executor, fn, *args)
 
     # -- auth (EventServer.scala:92-120) ----------------------------------
     def _authenticate(self, request: web.Request) -> AuthData:
@@ -150,13 +164,13 @@ class EventServer:
         return events.insert(event, auth.app_id, auth.channel_id)
 
     async def handle_create(self, request: web.Request) -> web.Response:
-        auth = self._authenticate(request)
+        auth = await self._run(self._authenticate, request)
         payload = None
         try:
             payload = await request.json()
             if not isinstance(payload, dict):
                 raise EventValidationError("event JSON must be an object")
-            event_id = self._ingest_one(payload, auth)
+            event_id = await self._run(self._ingest_one, payload, auth)
             status, body = 201, {"eventId": event_id}
         except (EventValidationError, json.JSONDecodeError) as e:
             status, body = 400, {"message": str(e)}
@@ -170,8 +184,24 @@ class EventServer:
             )
         return web.json_response(body, status=status)
 
+    def _ingest_batch(self, payload: list, auth: AuthData) -> list[dict]:
+        """One executor hop for the whole batch (not one per item)."""
+        results = []
+        for item in payload:
+            try:
+                if not isinstance(item, dict):
+                    raise EventValidationError("event JSON must be an object")
+                event_id = self._ingest_one(item, auth)
+                results.append({"status": 201, "eventId": event_id})
+            except EventValidationError as e:
+                results.append({"status": 400, "message": str(e)})
+            except WhitelistDenied as e:
+                # per-item 403, batch continues (EventServer.scala:430-433)
+                results.append({"status": 403, "message": str(e)})
+        return results
+
     async def handle_batch(self, request: web.Request) -> web.Response:
-        auth = self._authenticate(request)
+        auth = await self._run(self._authenticate, request)
         try:
             payload = await request.json()
         except json.JSONDecodeError as e:
@@ -186,41 +216,32 @@ class EventServer:
                             f"{MAX_BATCH_SIZE} events"},
                 status=400,
             )
-        results = []
-        for item in payload:
-            try:
-                if not isinstance(item, dict):
-                    raise EventValidationError("event JSON must be an object")
-                event_id = self._ingest_one(item, auth)
-                results.append({"status": 201, "eventId": event_id})
-            except EventValidationError as e:
-                results.append({"status": 400, "message": str(e)})
-            except WhitelistDenied as e:
-                # per-item 403, batch continues (EventServer.scala:430-433)
-                results.append({"status": 403, "message": str(e)})
+        results = await self._run(self._ingest_batch, payload, auth)
         return web.json_response(results, status=200)
 
     # -- reads ------------------------------------------------------------
     async def handle_get_event(self, request: web.Request) -> web.Response:
-        auth = self._authenticate(request)
-        event = self.storage.get_events().get(
-            request.match_info["event_id"], auth.app_id, auth.channel_id
+        auth = await self._run(self._authenticate, request)
+        event = await self._run(
+            self.storage.get_events().get,
+            request.match_info["event_id"], auth.app_id, auth.channel_id,
         )
         if event is None:
             return web.json_response({"message": "Not Found"}, status=404)
         return web.json_response(event.to_json_dict())
 
     async def handle_delete_event(self, request: web.Request) -> web.Response:
-        auth = self._authenticate(request)
-        found = self.storage.get_events().delete(
-            request.match_info["event_id"], auth.app_id, auth.channel_id
+        auth = await self._run(self._authenticate, request)
+        found = await self._run(
+            self.storage.get_events().delete,
+            request.match_info["event_id"], auth.app_id, auth.channel_id,
         )
         if found:
             return web.json_response({"message": "Found"})
         return web.json_response({"message": "Not Found"}, status=404)
 
     async def handle_find(self, request: web.Request) -> web.Response:
-        auth = self._authenticate(request)
+        auth = await self._run(self._authenticate, request)
         q = request.query
 
         def parse_time(name: str) -> Optional[_dt.datetime]:
@@ -244,19 +265,24 @@ class EventServer:
         event_names = q.getall("event") if "event" in q else None
         from incubator_predictionio_tpu.data.storage.base import StorageError
 
-        try:
+        start_time, until_time = parse_time("startTime"), parse_time("untilTime")
+
+        def do_find() -> list[dict]:
             found = self.storage.get_events().find(
                 auth.app_id,
                 auth.channel_id,
-                start_time=parse_time("startTime"),
-                until_time=parse_time("untilTime"),
+                start_time=start_time,
+                until_time=until_time,
                 entity_type=q.get("entityType"),
                 entity_id=q.get("entityId"),
                 event_names=event_names,
                 limit=None if limit == -1 else limit,
                 reversed=q.get("reversed", "false").lower() == "true",
             )
-            events = [e.to_json_dict() for e in found]
+            return [e.to_json_dict() for e in found]
+
+        try:
+            events = await self._run(do_find)
         except StorageError as e:  # uninitialized app/channel table
             return web.json_response({"message": str(e)}, status=404)
         if not events:
@@ -268,7 +294,7 @@ class EventServer:
         return web.json_response({"status": "alive"})
 
     async def handle_stats(self, request: web.Request) -> web.Response:
-        auth = self._authenticate(request)
+        auth = await self._run(self._authenticate, request)
         if not self.config.stats:
             return web.json_response(
                 {"message": "To see stats, launch Event Server with stats enabled "
@@ -279,7 +305,7 @@ class EventServer:
 
     # -- webhooks (EventServer.scala:491-599) -----------------------------
     async def handle_webhook(self, request: web.Request) -> web.Response:
-        auth = self._authenticate(request)
+        auth = await self._run(self._authenticate, request)
         name = request.match_info["name"]
         form = request.match_info.get("ext") == "form"
         connector = CONNECTORS.get((name, "form" if form else "json"))
@@ -292,7 +318,7 @@ class EventServer:
                 event_json = connector.to_event_json(data)
             else:
                 event_json = connector.to_event_json(await request.json())
-            event_id = self._ingest_one(event_json, auth)
+            event_id = await self._run(self._ingest_one, event_json, auth)
             return web.json_response({"eventId": event_id}, status=201)
         except (ConnectorError, EventValidationError, json.JSONDecodeError) as e:
             return web.json_response({"message": str(e)}, status=400)
@@ -300,7 +326,7 @@ class EventServer:
             return web.json_response({"message": str(e)}, status=403)
 
     async def handle_webhook_get(self, request: web.Request) -> web.Response:
-        self._authenticate(request)
+        await self._run(self._authenticate, request)
         name = request.match_info["name"]
         form = request.match_info.get("ext") == "form"
         if CONNECTORS.get((name, "form" if form else "json")) is None:
@@ -334,6 +360,7 @@ class EventServer:
     async def shutdown(self) -> None:
         if self._runner is not None:
             await self._runner.cleanup()
+        self._executor.shutdown(wait=False)
 
 
 def serve_forever(config: EventServerConfig = EventServerConfig(),

@@ -94,9 +94,17 @@ class TrainingData(SanityCheck):
     ratings: np.ndarray     # [n] float32
     user_vocab: np.ndarray  # [U] str
     item_vocab: np.ndarray  # [I] str
+    # multi-process sharded read: rows are THIS process's entity shard only
+    # (vocabularies and indices are global); n_rows_global is the job total
+    rows_are_local: bool = False
+    n_rows_global: Optional[int] = None
 
     def sanity_check(self) -> None:
-        if len(self.ratings) == 0:
+        total = (
+            self.n_rows_global if self.n_rows_global is not None
+            else len(self.ratings)
+        )
+        if total == 0:
             raise ValueError("TrainingData is empty (no rate/buy events found)")
 
 
@@ -124,7 +132,58 @@ class DataSource(PDataSource):
         return TrainingData(user_idx, item_idx, ratings, user_vocab, item_vocab)
 
     def read_training(self, ctx: MeshContext) -> TrainingData:
+        if ctx.process_count > 1:
+            return self._read_sharded(ctx)
         return self._read()
+
+    def _read_sharded(self, ctx: MeshContext) -> TrainingData:
+        """Per-process entity-disjoint read (VERDICT: each process reads ~1/P
+        of the store instead of replicating it; reference counterpart: RDD
+        partition reads, storage/jdbc JDBCPEvents.scala:91).
+
+        Users are entity-sharded, so the global user vocabulary is the
+        concatenation of per-shard vocabularies (one offset exchange). Item
+        ids cross shards, so the global item vocabulary is the deterministic
+        first-seen union over shards in process order (one metadata
+        allgather — vocab-sized, never event-sized)."""
+        procs, pid = ctx.process_count, ctx.process_index
+        uv, iv, ui, ii, vals = self._store.assemble_triples(
+            self.params.app_name,
+            entity_type="user",
+            event_names=("rate", "buy"),
+            target_entity_type="item",
+            value_property="rating",
+            default_values={"buy": self.params.buy_rating},
+            dedup=True,
+            n_shards=procs,
+            shard_index=pid,
+        )
+        meta = ctx.allgather_obj({
+            "users": uv.tolist(), "items": iv.tolist(), "n_rows": len(vals),
+        })
+        user_offset = sum(len(m["users"]) for m in meta[:pid])
+        user_vocab = np.asarray(
+            [u for m in meta for u in m["users"]], object)
+        item_global: dict[str, int] = {}
+        for m in meta:
+            for it in m["items"]:
+                item_global.setdefault(it, len(item_global))
+        item_vocab = np.asarray(list(item_global), object)
+        item_remap = np.asarray(
+            [item_global[it] for it in iv], np.int32)
+        n_rows_global = sum(m["n_rows"] for m in meta)
+        logger.info(
+            "sharded read: %d of %d rows (shard %d/%d), %d local users, "
+            "%d global users, %d global items",
+            len(vals), n_rows_global, pid, procs, len(uv),
+            len(user_vocab), len(item_vocab),
+        )
+        return TrainingData(
+            ui + np.int32(user_offset),
+            item_remap[ii] if len(ii) else ii,
+            vals, user_vocab, item_vocab,
+            rows_are_local=True, n_rows_global=n_rows_global,
+        )
 
     def read_eval(self, ctx: MeshContext):
         """k-fold split over rating triples (reference DataSource.scala:83-…):
@@ -250,6 +309,7 @@ class ALSAlgorithm(PAlgorithm):
             pd.ratings,
             n_users=len(user_map),
             n_items=len(item_map),
+            rows_are_local=pd.rows_are_local,
         )
         return RecModel(mf, user_map, item_map)
 

@@ -22,6 +22,7 @@ from __future__ import annotations
 import argparse
 import contextlib
 import json
+import logging
 import sys
 from typing import Optional
 
@@ -701,6 +702,13 @@ def main(argv: Optional[list[str]] = None) -> int:
     if not args.command:
         parser.print_help()
         return 1
+    # INFO-level console logging, like the reference console's log4j default
+    # (WorkflowUtils.modifyLogging); framework INFO lines (mesh layout,
+    # sharded reads, checkpoints) are part of the operator surface
+    logging.basicConfig(
+        level=logging.DEBUG if getattr(args, "verbose", False) else logging.INFO,
+        format="[%(levelname)s] [%(name)s] %(message)s",
+    )
     storage = get_storage()
     if args.command == "app":
         if not args.app_command:

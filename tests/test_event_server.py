@@ -327,3 +327,57 @@ def test_webhooks_mailchimp_form(env):
         assert got["entityType"] == "campaign" and got["entityId"] == "cid1"
 
     run_client(env, t)
+
+
+def test_slow_storage_does_not_block_loop(env):
+    """Storage I/O runs in the executor (storage/base.py:52-55 contract): a
+    slow insert must not stall unrelated requests on the asyncio loop."""
+    import time
+
+    storage, app_id, key, limited = env
+    events = storage.get_events()
+    orig_insert = events.insert
+
+    def slow_insert(event, app_id_, channel_id=None):
+        time.sleep(0.4)
+        return orig_insert(event, app_id_, channel_id)
+
+    events.insert = slow_insert
+
+    async def t(client, key, limited):
+        slow = asyncio.create_task(
+            client.post(f"/events.json?accessKey={key}", json=EVENT))
+        await asyncio.sleep(0.05)  # let the slow insert reach its sleep
+        t0 = time.perf_counter()
+        resp = await client.get("/")
+        dt_root = time.perf_counter() - t0
+        assert resp.status == 200
+        # pre-fix, the loop was blocked inside the sync insert and "/" waited
+        # the full 0.4s; with the executor it answers immediately
+        assert dt_root < 0.2, f"loop blocked for {dt_root:.3f}s"
+        resp = await slow
+        assert resp.status == 201
+
+    try:
+        run_client(env, t)
+    finally:
+        events.insert = orig_insert
+
+
+def test_concurrent_batch_ingestion(env):
+    """Concurrent /batch/events.json posts all land; per-item statuses kept."""
+    async def t(client, key, limited):
+        batch = [dict(EVENT, entityId=f"u{i}") for i in range(50)]
+
+        async def post_one():
+            resp = await client.post(f"/batch/events.json?accessKey={key}",
+                                     json=batch)
+            assert resp.status == 200
+            body = await resp.json()
+            assert all(r["status"] == 201 for r in body)
+
+        await asyncio.gather(*(post_one() for _ in range(8)))
+        resp = await client.get(f"/events.json?accessKey={key}&limit=-1")
+        assert len(await resp.json()) == 400
+
+    run_client(env, t)

@@ -73,6 +73,21 @@ print("seeded", app_id)
     assert out.returncode == 0, out.stdout + out.stderr
     assert "Training completed" in out.stdout
 
+    # sharded input feeding: each process reads only its entity shard of the
+    # store, not a full replica (reference: RDD partition reads)
+    import re
+
+    shard_reads = re.findall(
+        r"sharded read: (\d+) of (\d+) rows \(shard (\d+)/2\)", out.stdout)
+    assert len(shard_reads) == 2, out.stdout
+    totals = {int(t) for _, t, _ in shard_reads}
+    assert len(totals) == 1  # both processes agree on the global row count
+    total = totals.pop()
+    locals_ = [int(n) for n, _, _ in shard_reads]
+    assert sum(locals_) == total
+    # 12 users hash into 2 shards; each process must hold a proper subset
+    assert all(0 < n < total for n in locals_), locals_
+
     # exactly one COMPLETED instance + one model blob (process 0 only writes)
     check = subprocess.run(
         [sys.executable, "-"],

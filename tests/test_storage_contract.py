@@ -225,3 +225,80 @@ class TestMetaContract:
         models.insert(Model("m1", b"replaced"))
         assert models.get("m1").models == b"replaced"
         assert models.delete("m1") and models.get("m1") is None
+
+
+class TestShardedAssembly:
+    """assemble_triples n_shards/shard_index: the per-process read path."""
+
+    @pytest.fixture()
+    def seeded(self, events):
+        t0 = dt.datetime(2023, 1, 1, tzinfo=UTC)
+        for i in range(300):
+            events.insert(
+                Event(event="rate", entity_type="user", entity_id=f"u{i % 17}",
+                      target_entity_type="item", target_entity_id=f"i{i % 11}",
+                      properties=DataMap({"rating": float(1 + i % 5)}),
+                      event_time=t0 + dt.timedelta(seconds=i)),
+                APP,
+            )
+        return events
+
+    def test_shards_partition_rows_and_reindex(self, seeded):
+        full = seeded.assemble_triples(
+            APP, entity_type="user", event_names=("rate",),
+            target_entity_type="item", value_property="rating", dedup=True)
+        fuv, fiv, fui, fii, fvals = full
+        shard_rows = 0
+        seen_users: set = set()
+        full_pairs = {
+            (fuv[u], fiv[i]): v for u, i, v in zip(fui, fii, fvals)
+        }
+        got_pairs = {}
+        for s in range(3):
+            uv, iv, ui, ii, vals = seeded.assemble_triples(
+                APP, entity_type="user", event_names=("rate",),
+                target_entity_type="item", value_property="rating",
+                dedup=True, n_shards=3, shard_index=s)
+            # indices are dense into the shard's own vocabularies
+            if len(ui):
+                assert ui.max() < len(uv) and ii.max() < len(iv)
+            assert len(set(uv)) == len(uv)
+            shard_rows += len(vals)
+            assert not (seen_users & set(uv))  # entity-disjoint
+            seen_users |= set(uv)
+            for u, i, v in zip(ui, ii, vals):
+                got_pairs[(uv[u], iv[i])] = v
+        assert shard_rows == len(fvals)
+        assert seen_users == set(fuv)
+        assert got_pairs == full_pairs
+
+    def test_chunked_assembly_matches_unchunked(self, seeded):
+        big = seeded.assemble_triples(
+            APP, entity_type="user", event_names=("rate",),
+            target_entity_type="item", value_property="rating", dedup=True)
+        small = seeded.assemble_triples(
+            APP, entity_type="user", event_names=("rate",),
+            target_entity_type="item", value_property="rating", dedup=True,
+            chunk_rows=7)
+        for a, b in zip(big, small):
+            assert a.tolist() == b.tolist()
+
+    def test_chunked_dedup_overwrites_flushed_chunk(self, events):
+        t0 = dt.datetime(2023, 1, 1, tzinfo=UTC)
+        # row 0 lands in chunk 0 (size 2); its overwrite arrives after flush
+        rows = [("u1", "i1", 1.0), ("u2", "i1", 2.0), ("u3", "i1", 3.0),
+                ("u1", "i1", 9.0)]
+        for k, (u, i, r) in enumerate(rows):
+            events.insert(
+                Event(event="rate", entity_type="user", entity_id=u,
+                      target_entity_type="item", target_entity_id=i,
+                      properties=DataMap({"rating": r}),
+                      event_time=t0 + dt.timedelta(seconds=k)),
+                APP,
+            )
+        uv, iv, ui, ii, vals = events.assemble_triples(
+            APP, entity_type="user", event_names=("rate",),
+            target_entity_type="item", value_property="rating",
+            dedup=True, chunk_rows=2)
+        got = {(uv[u], iv[i]): v for u, i, v in zip(ui, ii, vals)}
+        assert got[("u1", "i1")] == 9.0 and len(vals) == 3
