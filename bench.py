@@ -335,12 +335,13 @@ def _pallas_parity_check(model) -> bool:
         score_catalog_reference,
     )
 
-    items_q, scales = quantize_rows(np.asarray(model.item_emb[:2048]))
+    n = min(2048, model.item_emb.shape[0])
+    items_q, scales = quantize_rows(np.asarray(model.item_emb[:n]))
     items_q, scales, bias, mask = pad_catalog(
         items_q, scales,
-        np.asarray(model.item_bias[:2048], np.float32),
-        np.zeros(2048, np.float32))
-    ue = jnp.asarray(model.user_emb[:64])
+        np.asarray(model.item_bias[:n], np.float32),
+        np.zeros(n, np.float32))
+    ue = jnp.asarray(np.asarray(model.user_emb)[:64], jnp.float32)
     got = np.asarray(score_catalog_quantized(ue, items_q, scales, bias, mask))
     want = np.asarray(score_catalog_reference(ue, items_q, scales, bias, mask))
     ok = bool(np.allclose(got, want, rtol=2e-2, atol=2e-2))
@@ -485,15 +486,39 @@ def bench_serving(ctx) -> dict:
         def pct(q):
             return float(s[min(len(s) - 1, int(q * (len(s) - 1)))])
 
-        return {
+        out = {
             "predict_p50_ms": round(pct(0.50), 2),
             "predict_p95_ms": round(pct(0.95), 2),
             "predict_p99_ms": round(pct(0.99), 2),
             "queries_per_sec": round(len(s) / (2.0 if SMALL else 6.0), 1),
             "max_batch_seen": status.get("maxBatchSeen"),
+            "jit_compile_keys": status.get("jitCompileKeys"),
             "server_p50_ms": round(
                 status["servingSecPercentiles"]["p50"] * 1e3, 2),
         }
+        # parity of the DEPLOYED scorer (the serving config runs the
+        # quantized Pallas path on TPU — assert it against the oracle here,
+        # not only in the synthetic retrieval bench)
+        import jax
+
+        if jax.devices()[0].platform == "tpu":
+            import copy
+
+            instances = storage.get_meta_data_engine_instances()
+            inst = instances.get_latest_completed(
+                "bench", "1", os.path.abspath(variant_path))
+            blob = storage.get_model_data_models().get(inst.id)
+            from incubator_predictionio_tpu.utils.serialization import (
+                deserialize_model,
+            )
+
+            persisted = deserialize_model(blob.models)
+            models = engine.prepare_deploy(
+                ctx, engine_params, persisted, inst.id)
+            mf = copy.deepcopy(models[0].mf)
+            mf._device_items_q = None
+            out["pallas_kernel_parity"] = _pallas_parity_check(mf)
+        return out
     finally:
         use_storage(prev)
         storage.close()

@@ -52,6 +52,22 @@ class TwoTowerConfig:
     checkpoint_keep: int = 3
 
 
+#: Micro-batch bucket ladder for serving: every request batch is padded up to
+#: the next bucket so the jitted scorers see a handful of static shapes
+#: instead of one per batch size (the round-2 compile-churn bug). Beyond the
+#: largest bucket, batches round up to a multiple of it.
+SERVE_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256)
+
+
+def serve_bucket(b: int) -> int:
+    """Smallest bucket ≥ ``b`` (multiples of the top bucket past the ladder)."""
+    for s in SERVE_BUCKETS:
+        if b <= s:
+            return s
+    top = SERVE_BUCKETS[-1]
+    return ((b + top - 1) // top) * top
+
+
 @dataclasses.dataclass
 class TwoTowerModel:
     """user/item factor tables + biases + global mean (host numpy)."""
@@ -63,16 +79,27 @@ class TwoTowerModel:
     mean: float
     config: TwoTowerConfig
 
-    _device_items = None  # device-resident (item_emb.T, item_bias) for serving
+    _device_items = None  # (item_embᵀ bf16, item_bias, zero mask) for serving
     _device_items_q = None  # int8-quantized catalog (pallas retrieval kernel)
+    _device_users = None  # (user_emb bf16, user_bias) — gathered inside jit
+    _serve_k = 0  # static top-k the serving executables are compiled for
 
-    def prepare_for_serving(self, quantize: bool = False) -> "TwoTowerModel":
+    def prepare_for_serving(
+        self, quantize: bool = False, serve_k: int = 128
+    ) -> "TwoTowerModel":
         """Make serving state device-resident. ``quantize=True`` stores the
         catalog int8 row-quantized and scores through the fused Pallas
         retrieval kernel (ops/retrieval.py) — 4× less HBM for the item table
-        and a faster score pass on TPU."""
-        self.user_emb = jax.device_put(self.user_emb)
-        self.user_bias = jax.device_put(self.user_bias)
+        and a faster score pass on TPU.
+
+        ``serve_k`` fixes the static top-k the serving executables compute:
+        queries asking ``num ≤ serve_k`` share ONE executable per batch bucket
+        (results sliced host-side), so per-query ``num`` never recompiles."""
+        self._serve_k = min(serve_k, self.n_items)
+        self._device_users = (
+            jax.device_put(np.asarray(self.user_emb, np.float32).astype(jnp.bfloat16)),
+            jax.device_put(np.asarray(self.user_bias, np.float32)),
+        )
         if quantize:
             from incubator_predictionio_tpu.ops.retrieval import (
                 pad_catalog,
@@ -89,10 +116,31 @@ class TwoTowerModel:
             )
         else:
             self._device_items = (
-                jax.device_put(np.ascontiguousarray(self.item_emb.T)),
-                jax.device_put(self.item_bias),
+                jax.device_put(
+                    np.ascontiguousarray(
+                        np.asarray(self.item_emb, np.float32).T
+                    ).astype(jnp.bfloat16)
+                ),
+                jax.device_put(np.asarray(self.item_bias, np.float32)),
+                jax.device_put(np.zeros(self.n_items, np.float32)),
             )
         return self
+
+    def warmup(self, max_batch: int = 64) -> int:
+        """Pre-compile the serving executable for every batch bucket up to
+        ``max_batch`` (deploy-time cost, so no live query ever waits on XLA).
+        Returns the number of buckets warmed."""
+        if self._device_users is None:
+            self.prepare_for_serving()
+        n = 0
+        for b in SERVE_BUCKETS:
+            if b > max(1, max_batch):
+                break
+            TwoTowerMF.recommend_batch(
+                self, np.zeros(b, np.int32), self._serve_k or 1
+            )
+            n += 1
+        return n
 
     @property
     def n_items(self) -> int:
@@ -276,34 +324,50 @@ class TwoTowerMF:
         num: int,
         exclude: Optional[np.ndarray] = None,
     ) -> tuple[np.ndarray, np.ndarray]:
-        """Vectorized top-k over the full catalog for a batch of users."""
+        """Vectorized top-k over the full catalog for a batch of users.
+
+        Shape discipline (the serving hot path): the user batch is padded to
+        a :data:`SERVE_BUCKETS` bucket and the top-k size is the model's
+        static ``serve_k`` whenever ``num`` fits under it — so the whole
+        query mix shares a handful of pre-warmed executables. The user-row
+        gather happens ON DEVICE (indices in, [bucket, k] out) — no
+        full-table host round-trip per call."""
+        from incubator_predictionio_tpu.utils import jitstats
+
         num = min(num, model.n_items)  # k cannot exceed the catalog
         if model._device_items is None and model._device_items_q is None:
             model.prepare_for_serving()
-        ue = jnp.asarray(np.asarray(model.user_emb)[user_idx])
-        ub = jnp.asarray(np.asarray(model.user_bias)[user_idx])
-        if model._device_items_q is not None:
+        b = len(user_idx)
+        bucket = serve_bucket(max(b, 1))
+        k = model._serve_k if 0 < num <= model._serve_k else num
+        uidx = np.zeros(bucket, np.int32)
+        uidx[:b] = np.asarray(user_idx, np.int32)
+        ue_tab, ub_tab = model._device_users
+        quantized = model._device_items_q is not None
+        if quantized:
             items_q, scales, bias, base_mask = model._device_items_q
-            mask = base_mask
-            if exclude is not None and len(exclude):
-                m = np.zeros(items_q.shape[0], np.float32)
-                m[np.asarray(exclude, np.int64)] = -np.inf
-                mask = mask + jnp.asarray(m)
-            idx, scores = _topk_quantized(
-                ue, ub, items_q, scales, bias, mask, model.mean, num
-            )
-            return np.asarray(idx), np.asarray(scores)
-        item_t, item_b = model._device_items
-        mask = None
+        else:
+            item_t, item_b, base_mask = model._device_items
+        mask = base_mask
         if exclude is not None and len(exclude):
-            mask = np.zeros(model.n_items, np.float32)
-            mask[np.asarray(exclude, np.int64)] = -np.inf
-        idx, scores = _topk_scores(
-            ue, ub, item_t, item_b, model.mean,
-            None if mask is None else jnp.asarray(mask),
-            num,
-        )
-        return np.asarray(idx), np.asarray(scores)
+            m = np.zeros(base_mask.shape[0], np.float32)
+            m[np.asarray(exclude, np.int64)] = -np.inf
+            mask = mask + jnp.asarray(m)
+        jitstats.record((
+            "two_tower_topk", quantized, bucket, k,
+            model.n_items, ue_tab.shape[0],
+        ))
+        if quantized:
+            idx, scores = _topk_quantized(
+                jnp.asarray(uidx), ue_tab, ub_tab,
+                items_q, scales, bias, mask, model.mean, k,
+            )
+        else:
+            idx, scores = _topk_scores(
+                jnp.asarray(uidx), ue_tab, ub_tab,
+                item_t, item_b, model.mean, mask, k,
+            )
+        return np.asarray(idx[:b, :num]), np.asarray(scores[:b, :num])
 
 
 @partial(jax.jit, static_argnames=("lr", "reg", "n_epochs"), donate_argnums=(0, 1))
@@ -344,8 +408,9 @@ def _train_epochs(p, o, ub, ib, rb, wb, lr, reg, n_epochs):
 
 
 @partial(jax.jit, static_argnames=("num",))
-def _topk_quantized(ue, ub, items_q, scales, bias, mask, mean, num):
-    """Quantized catalog scoring: Pallas kernel on TPU, jnp oracle elsewhere."""
+def _topk_quantized(uidx, ue_tab, ub_tab, items_q, scales, bias, mask, mean, num):
+    """Quantized catalog scoring: Pallas kernel on TPU, jnp oracle elsewhere.
+    User rows are gathered on device from the resident bf16 table."""
     from incubator_predictionio_tpu.ops.retrieval import (
         score_catalog_quantized,
         score_catalog_reference,
@@ -353,21 +418,25 @@ def _topk_quantized(ue, ub, items_q, scales, bias, mask, mean, num):
 
     on_tpu = jax.devices()[0].platform == "tpu"
     scorer = score_catalog_quantized if on_tpu else score_catalog_reference
-    scores = scorer(ue, items_q, scales, bias, mask) + ub[:, None] + mean
+    scores = scorer(ue_tab[uidx], items_q, scales, bias, mask) \
+        + ub_tab[uidx][:, None] + mean
     values, indices = jax.lax.top_k(scores, num)
     return indices, values
 
 
 @partial(jax.jit, static_argnames=("num",))
-def _topk_scores(ue, ub, item_t, item_b, mean, mask, num):
-    # [b,k] @ [k,n] on the MXU in bfloat16; scores accumulated in fp32
+def _topk_scores(uidx, ue_tab, ub_tab, item_t, item_b, mean, mask, num):
+    # device gather of the query rows, then [b,k] @ [k,n] on the MXU in
+    # bfloat16 with fp32 score accumulation
     scores = (
-        (ue.astype(jnp.bfloat16) @ item_t.astype(jnp.bfloat16)).astype(jnp.float32)
+        jax.lax.dot_general(
+            ue_tab[uidx], item_t, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
         + item_b[None, :]
-        + ub[:, None]
+        + ub_tab[uidx][:, None]
         + mean
+        + mask[None, :]
     )
-    if mask is not None:
-        scores = scores + mask[None, :]
     values, indices = jax.lax.top_k(scores, num)
     return indices, values

@@ -45,6 +45,7 @@ from incubator_predictionio_tpu.core.controller import (
 from incubator_predictionio_tpu.data.storage.base import EngineInstance
 from incubator_predictionio_tpu.data.storage.registry import Storage, get_storage
 from incubator_predictionio_tpu.parallel.mesh import MeshContext
+from incubator_predictionio_tpu.utils import jitstats
 from incubator_predictionio_tpu.utils.json_util import bind_query, to_jsonable
 from incubator_predictionio_tpu.utils.serialization import deserialize_model
 
@@ -79,6 +80,8 @@ class DeployedEngine:
         engine_params: EngineParams,
         instance: EngineInstance,
         models: list[Any],
+        max_batch: int = 64,
+        warmup: bool = True,
     ):
         self.engine = engine
         self.engine_params = engine_params
@@ -92,12 +95,22 @@ class DeployedEngine:
         self.query_cls = next(
             (a.query_class() for a in algorithms if a.query_class() is not None), None
         )
+        if warmup:
+            self.warmup(max_batch)
 
     @staticmethod
     def _prepare(algorithm, model):
         """Models exposing ``prepare_for_serving()`` become device-resident here."""
         prep = getattr(model, "prepare_for_serving", None)
         return prep() if callable(prep) else model
+
+    def warmup(self, max_batch: int) -> None:
+        """Pre-compile every serving batch bucket at deploy time so no live
+        query ever pays an XLA compile (the round-2 p50 regression)."""
+        for m in self.models:
+            w = getattr(m, "warmup", None)
+            if callable(w):
+                w(max_batch)
 
     def predict(self, payload: dict) -> Any:
         query = bind_query(self.query_cls, payload)
@@ -291,7 +304,8 @@ def load_deployed_engine(
     models = engine.prepare_deploy(ctx, engine_params, persisted, instance.id)
     logger.info("deployed engine instance %s (trained %s)", instance.id,
                 instance.start_time)
-    return DeployedEngine(engine, engine_params, instance, models)
+    return DeployedEngine(engine, engine_params, instance, models,
+                          max_batch=config.max_batch)
 
 
 class QueryServer:
@@ -342,6 +356,9 @@ class QueryServer:
             "servingSecPercentiles": self.latency.percentiles(),
             "batchesServed": self.batcher.batches_served,
             "maxBatchSeen": self.batcher.max_batch_seen,
+            # compile-churn gauge: distinct serving executables built in this
+            # process; must stay flat under load once warmup has run
+            "jitCompileKeys": jitstats.count(),
             "uptimeSec": time.time() - self._start_time,
         })
 

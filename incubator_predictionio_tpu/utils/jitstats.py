@@ -1,0 +1,51 @@
+"""Compile-churn gauge for the serving hot path.
+
+The round-2 serving regression (every distinct micro-batch size triggered a
+fresh XLA compile) was invisible in the bench artifact — the status page had
+``maxBatchSeen`` but no compile counter. This module tracks the set of
+distinct jit cache keys the serving scorers have dispatched with, so the
+query-server status page (and the bench JSON) can expose exactly how many
+executables serving built. A healthy bucketed server warms up every bucket at
+deploy and the count stays flat under load; a growing count under load IS the
+round-2 bug.
+
+Counting happens at the call site (models register the key they are about to
+dispatch with), not via XLA hooks — the key (function, bucket, k, catalog
+shape, quantized?) corresponds 1:1 to a jit cache entry because the jitted
+functions are module-level with only those statics/shapes varying.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Hashable
+
+_lock = threading.Lock()
+_keys: set[Hashable] = set()
+
+
+def record(key: Hashable) -> bool:
+    """Register a jit dispatch key; returns True when it is new (a compile)."""
+    with _lock:
+        if key in _keys:
+            return False
+        _keys.add(key)
+        return True
+
+
+def count() -> int:
+    """Number of distinct serving executables built so far in this process."""
+    with _lock:
+        return len(_keys)
+
+
+def snapshot() -> list:
+    """The keys themselves (sorted repr order) — for debugging/status pages."""
+    with _lock:
+        return sorted(_keys, key=repr)
+
+
+def reset() -> None:
+    """Test hook."""
+    with _lock:
+        _keys.clear()
