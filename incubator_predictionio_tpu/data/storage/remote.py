@@ -1,0 +1,559 @@
+"""``remote`` storage backend — the networked client half.
+
+Counterpart of the reference's JDBC/HBase/ES client backends
+(storage/jdbc/.../JDBCLEvents.scala:109-150, storage/jdbc/.../JDBCModels.scala,
+storage/jdbc/.../JDBCApps.scala …): every process of a multi-host job points
+at one `pio-tpu storageserver` (server/storage_server.py) and shares all
+three repositories over a socket — no shared filesystem required.
+
+Config (``PIO_STORAGE_SOURCES_<NAME>_*``):
+
+- ``TYPE=remote``
+- ``URL=http://host:7072``  (or ``HOST`` + ``PORT`` [+ ``SCHEME``])
+- ``KEY=<shared secret>``   (optional; sent as ``X-PIO-Storage-Key``)
+- ``CA_CERT=<pem path>``    (optional; pin/verify the server's TLS cert)
+- ``TIMEOUT=30``            (socket timeout, seconds)
+
+Transport notes:
+- unary calls reuse one persistent HTTP connection per thread (retried once
+  on a stale socket — the JDBC connection-pool analogue);
+- ``find`` streams JSON-lines on a dedicated connection and yields lazily, so
+  scanning a big store holds O(1) events client-side;
+- ``find_sharded`` pushes the shard predicate to the server: each process of
+  a ``launch`` job receives ONLY its entity shard's bytes;
+- ``assemble_triples`` returns the server-built columnar arrays from one
+  ``.npz`` body — the training bulk read is a single round trip.
+"""
+
+from __future__ import annotations
+
+import base64
+import datetime as _dt
+import http.client
+import io
+import json
+import logging
+import ssl as _ssl
+import threading
+import urllib.parse
+from typing import Any, Iterator, Optional, Sequence
+
+import numpy as np
+
+from incubator_predictionio_tpu.data.event import Event
+from incubator_predictionio_tpu.data.storage.base import (
+    UNSET,
+    AccessKey,
+    AccessKeysStore,
+    App,
+    AppsStore,
+    Channel,
+    ChannelsStore,
+    EngineInstance,
+    EngineInstancesStore,
+    EvaluationInstance,
+    EvaluationInstancesStore,
+    EventStore,
+    Model,
+    ModelsStore,
+    StorageClient,
+    StorageError,
+)
+from incubator_predictionio_tpu.data.storage.registry import register_backend
+from incubator_predictionio_tpu.data.storage.wire import (
+    dec_engine_instance,
+    dec_evaluation_instance,
+    enc_dt,
+    enc_engine_instance,
+    enc_evaluation_instance,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class _Transport:
+    """Thread-local persistent connections; idempotent calls get one retry on
+    stale sockets, non-idempotent writes never auto-retry (an insert whose
+    response was lost may have committed — re-sending would double-apply)."""
+
+    def __init__(self, url: str, key: Optional[str], timeout: float,
+                 ca_cert: Optional[str] = None):
+        p = urllib.parse.urlsplit(url)
+        if p.scheme not in ("http", "https"):
+            raise StorageError(f"remote storage URL must be http(s): {url!r}")
+        self.host = p.hostname or "127.0.0.1"
+        self.port = p.port or (443 if p.scheme == "https" else 7072)
+        self.scheme = p.scheme
+        self.key = key
+        self.timeout = timeout
+        self.ca_cert = ca_cert
+        self._local = threading.local()
+
+    def _new_conn(self) -> http.client.HTTPConnection:
+        if self.scheme == "https":
+            if self.ca_cert:
+                # pin the server's own (self-signed) cert: encryption AND
+                # server authentication without a CA hierarchy
+                ctx = _ssl.create_default_context(cafile=self.ca_cert)
+                ctx.check_hostname = False
+                ctx.verify_mode = _ssl.CERT_REQUIRED
+            else:
+                # unpinned mode: transport privacy only — the shared KEY
+                # header is the authentication; set CA_CERT to also
+                # authenticate the server
+                ctx = _ssl.create_default_context()
+                ctx.check_hostname = False
+                ctx.verify_mode = _ssl.CERT_NONE
+            return http.client.HTTPSConnection(
+                self.host, self.port, timeout=self.timeout, context=ctx)
+        return http.client.HTTPConnection(
+            self.host, self.port, timeout=self.timeout)
+
+    def _headers(self) -> dict[str, str]:
+        h = {"Content-Type": "application/json"}
+        if self.key:
+            h["X-PIO-Storage-Key"] = self.key
+        return h
+
+    def request(self, path: str, body: dict,
+                idempotent: bool = True) -> tuple[int, bytes]:
+        """Unary call on the pooled per-thread connection."""
+        payload = json.dumps(body).encode()
+        attempts = (0, 1) if idempotent else (1,)
+        for attempt in attempts:
+            conn = getattr(self._local, "conn", None)
+            if conn is None:
+                conn = self._new_conn()
+                self._local.conn = conn
+            try:
+                conn.request("POST", path, payload, self._headers())
+                resp = conn.getresponse()
+                return resp.status, resp.read()
+            except (http.client.HTTPException, ConnectionError, OSError) as e:
+                self._local.conn = None
+                try:
+                    conn.close()
+                except Exception:  # noqa: BLE001
+                    pass
+                if attempt:
+                    raise StorageError(
+                        f"remote storage unreachable: {e!r}") from e
+        raise AssertionError("unreachable")
+
+    def stream(self, path: str, body: dict):
+        """Streaming call on a DEDICATED connection (the pooled one must stay
+        free for unary calls issued while the caller consumes the stream).
+        Returns (response, connection); caller closes the connection."""
+        conn = self._new_conn()
+        try:
+            conn.request("POST", path, json.dumps(body).encode(),
+                         self._headers())
+            resp = conn.getresponse()
+            if resp.status != 200:
+                detail = resp.read(2048).decode(errors="replace")
+                raise StorageError(
+                    f"remote storage {path} failed: {resp.status} {detail}")
+            return resp, conn
+        except (http.client.HTTPException, ConnectionError, OSError) as e:
+            conn.close()
+            raise StorageError(f"remote storage unreachable: {e}") from e
+
+    #: RPC methods safe to auto-retry on a stale socket (pure reads plus the
+    #: contract's explicitly idempotent lifecycle calls). Mutations whose
+    #: response was lost may already have committed — the caller decides.
+    _IDEMPOTENT = frozenset({
+        "get", "get_all", "get_by_name", "get_by_app_id",
+        "aggregate_properties", "init",
+    })
+
+    def call(self, store: str, method: str, args: dict) -> Any:
+        status, data = self.request(
+            f"/rpc/{store}/{method}", args,
+            idempotent=method in self._IDEMPOTENT)
+        if status == 401:
+            raise StorageError("remote storage: unauthorized (bad KEY)")
+        if status != 200:
+            raise StorageError(
+                f"remote storage {store}.{method} failed: {status} "
+                f"{data[:2048].decode(errors='replace')}")
+        return json.loads(data)["result"]
+
+
+def _enc_opt_filter(args: dict, key: str, value: Any) -> None:
+    """UNSET → key absent; None/str → key present (see server dec_opt_filter)."""
+    if value is not UNSET:
+        args[key] = value
+
+
+# ---------------------------------------------------------------------------
+# event store
+# ---------------------------------------------------------------------------
+
+class RemoteEventStore(EventStore):
+    def __init__(self, tp: _Transport):
+        self._tp = tp
+
+    def init(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        return self._tp.call("events", "init",
+                             {"app_id": app_id, "channel_id": channel_id})
+
+    def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        return self._tp.call("events", "remove",
+                             {"app_id": app_id, "channel_id": channel_id})
+
+    def insert(self, event: Event, app_id: int,
+               channel_id: Optional[int] = None) -> str:
+        return self._tp.call("events", "insert", {
+            "event": event.to_json_dict(), "app_id": app_id,
+            "channel_id": channel_id,
+        })
+
+    def insert_batch(self, events: Sequence[Event], app_id: int,
+                     channel_id: Optional[int] = None) -> list[str]:
+        return self._tp.call("events", "insert_batch", {
+            "events": [e.to_json_dict() for e in events],
+            "app_id": app_id, "channel_id": channel_id,
+        })
+
+    def get(self, event_id: str, app_id: int,
+            channel_id: Optional[int] = None) -> Optional[Event]:
+        d = self._tp.call("events", "get", {
+            "event_id": event_id, "app_id": app_id, "channel_id": channel_id})
+        return None if d is None else Event.from_json_dict(d)
+
+    def delete(self, event_id: str, app_id: int,
+               channel_id: Optional[int] = None) -> bool:
+        return self._tp.call("events", "delete", {
+            "event_id": event_id, "app_id": app_id, "channel_id": channel_id})
+
+    def _stream_find(self, args: dict) -> Iterator[Event]:
+        resp, conn = self._tp.stream("/rpc/events/find", args)
+        try:
+            while True:
+                try:
+                    line = resp.readline()
+                except (http.client.HTTPException, ConnectionError, OSError) as e:
+                    # server aborted mid-stream (backend error after the 200
+                    # header) — surface the module's error type, not IncompleteRead
+                    raise StorageError(
+                        f"remote storage find stream aborted: {e!r}") from e
+                if not line:
+                    break
+                yield Event.from_json_dict(json.loads(line))
+        finally:
+            conn.close()
+
+    def find(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        entity_id: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Any = UNSET,
+        target_entity_id: Any = UNSET,
+        limit: Optional[int] = None,
+        reversed: bool = False,
+    ) -> Iterator[Event]:
+        args: dict[str, Any] = {
+            "app_id": app_id, "channel_id": channel_id,
+            "start_time": enc_dt(start_time), "until_time": enc_dt(until_time),
+            "entity_type": entity_type, "entity_id": entity_id,
+            "event_names": list(event_names) if event_names is not None else None,
+            "limit": limit, "reversed": reversed,
+        }
+        _enc_opt_filter(args, "target_entity_type", target_entity_type)
+        _enc_opt_filter(args, "target_entity_id", target_entity_id)
+        return self._stream_find(args)
+
+    def find_sharded(
+        self,
+        app_id: int,
+        n_shards: int,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+    ) -> list[Iterator[Event]]:
+        def shard_iter(shard: int) -> Iterator[Event]:
+            # server-side shard filter: only this shard's bytes on the wire
+            return self._stream_find({
+                "app_id": app_id, "channel_id": channel_id,
+                "start_time": enc_dt(start_time),
+                "until_time": enc_dt(until_time),
+                "entity_type": entity_type,
+                "event_names": (list(event_names)
+                                if event_names is not None else None),
+                "n_shards": n_shards, "shard_index": shard,
+            })
+
+        return [shard_iter(i) for i in range(n_shards)]
+
+    def aggregate_properties(
+        self,
+        app_id: int,
+        entity_type: str,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        required: Optional[Sequence[str]] = None,
+    ):
+        from incubator_predictionio_tpu.data.event import PropertyMap
+
+        raw = self._tp.call("events", "aggregate_properties", {
+            "app_id": app_id, "entity_type": entity_type,
+            "channel_id": channel_id,
+            "start_time": enc_dt(start_time), "until_time": enc_dt(until_time),
+            "required": list(required) if required is not None else None,
+        })
+        return {
+            k: PropertyMap(
+                v["fields"],
+                _dt.datetime.fromisoformat(v["first_updated"]),
+                _dt.datetime.fromisoformat(v["last_updated"]),
+            )
+            for k, v in raw.items()
+        }
+
+    def assemble_triples(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Any = UNSET,
+        value_property: Optional[str] = None,
+        default_values: Optional[dict] = None,
+        missing_value: float = 0.0,
+        dedup: bool = False,
+        n_shards: Optional[int] = None,
+        shard_index: int = 0,
+        chunk_rows: int = 262_144,
+    ):
+        args: dict[str, Any] = {
+            "app_id": app_id, "channel_id": channel_id,
+            "start_time": enc_dt(start_time), "until_time": enc_dt(until_time),
+            "entity_type": entity_type,
+            "event_names": (list(event_names)
+                            if event_names is not None else None),
+            "value_property": value_property,
+            "default_values": default_values,
+            "missing_value": missing_value, "dedup": dedup,
+            "n_shards": n_shards, "shard_index": shard_index,
+        }
+        _enc_opt_filter(args, "target_entity_type", target_entity_type)
+        resp, conn = self._tp.stream("/rpc/events/assemble_triples", args)
+        try:
+            data = resp.read()
+        finally:
+            conn.close()
+        npz = np.load(io.BytesIO(data))
+        return (
+            npz["entity_vocab"].astype(object),
+            npz["target_vocab"].astype(object),
+            npz["entity_idx"],
+            npz["target_idx"],
+            npz["values"],
+        )
+
+
+# ---------------------------------------------------------------------------
+# meta / model stores
+# ---------------------------------------------------------------------------
+
+class RemoteAppsStore(AppsStore):
+    def __init__(self, tp: _Transport):
+        self._tp = tp
+
+    def insert(self, app: App) -> Optional[int]:
+        return self._tp.call("apps", "insert",
+                             {"record": {"id": app.id, "name": app.name,
+                                         "description": app.description}})
+
+    def get(self, app_id: int) -> Optional[App]:
+        d = self._tp.call("apps", "get", {"id": app_id})
+        return None if d is None else App(**d)
+
+    def get_by_name(self, name: str) -> Optional[App]:
+        d = self._tp.call("apps", "get_by_name", {"name": name})
+        return None if d is None else App(**d)
+
+    def get_all(self) -> list[App]:
+        return [App(**d) for d in self._tp.call("apps", "get_all", {})]
+
+    def update(self, app: App) -> bool:
+        return self._tp.call("apps", "update",
+                             {"record": {"id": app.id, "name": app.name,
+                                         "description": app.description}})
+
+    def delete(self, app_id: int) -> bool:
+        return self._tp.call("apps", "delete", {"id": app_id})
+
+
+class RemoteAccessKeysStore(AccessKeysStore):
+    def __init__(self, tp: _Transport):
+        self._tp = tp
+
+    @staticmethod
+    def _enc(k: AccessKey) -> dict:
+        return {"key": k.key, "app_id": k.app_id, "events": list(k.events)}
+
+    @staticmethod
+    def _dec(d: dict) -> AccessKey:
+        return AccessKey(d["key"], d["app_id"], tuple(d["events"]))
+
+    def insert(self, access_key: AccessKey) -> Optional[str]:
+        return self._tp.call("access_keys", "insert",
+                             {"record": self._enc(access_key)})
+
+    def get(self, key: str) -> Optional[AccessKey]:
+        d = self._tp.call("access_keys", "get", {"id": key})
+        return None if d is None else self._dec(d)
+
+    def get_all(self) -> list[AccessKey]:
+        return [self._dec(d)
+                for d in self._tp.call("access_keys", "get_all", {})]
+
+    def get_by_app_id(self, app_id: int) -> list[AccessKey]:
+        return [self._dec(d) for d in self._tp.call(
+            "access_keys", "get_by_app_id", {"app_id": app_id})]
+
+    def update(self, access_key: AccessKey) -> bool:
+        return self._tp.call("access_keys", "update",
+                             {"record": self._enc(access_key)})
+
+    def delete(self, key: str) -> bool:
+        return self._tp.call("access_keys", "delete", {"id": key})
+
+
+class RemoteChannelsStore(ChannelsStore):
+    def __init__(self, tp: _Transport):
+        self._tp = tp
+
+    def insert(self, channel: Channel) -> Optional[int]:
+        return self._tp.call("channels", "insert", {"record": {
+            "id": channel.id, "name": channel.name, "app_id": channel.app_id}})
+
+    def get(self, channel_id: int) -> Optional[Channel]:
+        d = self._tp.call("channels", "get", {"id": channel_id})
+        return None if d is None else Channel(**d)
+
+    def get_by_app_id(self, app_id: int) -> list[Channel]:
+        return [Channel(**d) for d in self._tp.call(
+            "channels", "get_by_app_id", {"app_id": app_id})]
+
+    def delete(self, channel_id: int) -> bool:
+        return self._tp.call("channels", "delete", {"id": channel_id})
+
+
+class RemoteEngineInstancesStore(EngineInstancesStore):
+    def __init__(self, tp: _Transport):
+        self._tp = tp
+
+    def insert(self, instance: EngineInstance) -> str:
+        return self._tp.call("engine_instances", "insert",
+                             {"record": enc_engine_instance(instance)})
+
+    def get(self, instance_id: str) -> Optional[EngineInstance]:
+        d = self._tp.call("engine_instances", "get", {"id": instance_id})
+        return None if d is None else dec_engine_instance(d)
+
+    def get_all(self) -> list[EngineInstance]:
+        return [dec_engine_instance(d)
+                for d in self._tp.call("engine_instances", "get_all", {})]
+
+    def update(self, instance: EngineInstance) -> bool:
+        return self._tp.call("engine_instances", "update",
+                             {"record": enc_engine_instance(instance)})
+
+    def delete(self, instance_id: str) -> bool:
+        return self._tp.call("engine_instances", "delete", {"id": instance_id})
+
+
+class RemoteEvaluationInstancesStore(EvaluationInstancesStore):
+    def __init__(self, tp: _Transport):
+        self._tp = tp
+
+    def insert(self, instance: EvaluationInstance) -> str:
+        return self._tp.call("evaluation_instances", "insert",
+                             {"record": enc_evaluation_instance(instance)})
+
+    def get(self, instance_id: str) -> Optional[EvaluationInstance]:
+        d = self._tp.call("evaluation_instances", "get", {"id": instance_id})
+        return None if d is None else dec_evaluation_instance(d)
+
+    def get_all(self) -> list[EvaluationInstance]:
+        return [dec_evaluation_instance(d)
+                for d in self._tp.call("evaluation_instances", "get_all", {})]
+
+    def update(self, instance: EvaluationInstance) -> bool:
+        return self._tp.call("evaluation_instances", "update",
+                             {"record": enc_evaluation_instance(instance)})
+
+    def delete(self, instance_id: str) -> bool:
+        return self._tp.call("evaluation_instances", "delete",
+                             {"id": instance_id})
+
+
+class RemoteModelsStore(ModelsStore):
+    def __init__(self, tp: _Transport):
+        self._tp = tp
+
+    def insert(self, model: Model) -> None:
+        self._tp.call("models", "insert", {
+            "id": model.id,
+            "blob": base64.b64encode(model.models).decode()})
+
+    def get(self, model_id: str) -> Optional[Model]:
+        d = self._tp.call("models", "get", {"id": model_id})
+        return None if d is None else Model(d["id"], base64.b64decode(d["blob"]))
+
+    def delete(self, model_id: str) -> bool:
+        return self._tp.call("models", "delete", {"id": model_id})
+
+
+# ---------------------------------------------------------------------------
+# client
+# ---------------------------------------------------------------------------
+
+@register_backend("remote")
+class RemoteStorageClient(StorageClient):
+    """All three repositories served over one storage-server socket."""
+
+    def __init__(self, config: dict[str, str]):
+        super().__init__(config)
+        url = config.get("URL")
+        if not url:
+            scheme = config.get("SCHEME", "http")
+            host = config.get("HOSTS", config.get("HOST", "127.0.0.1"))
+            port = config.get("PORTS", config.get("PORT", "7072"))
+            url = f"{scheme}://{host}:{port}"
+        self._tp = _Transport(
+            url, config.get("KEY"), float(config.get("TIMEOUT", "30")),
+            ca_cert=config.get("CA_CERT"))
+
+    def apps(self) -> AppsStore:
+        return RemoteAppsStore(self._tp)
+
+    def access_keys(self) -> AccessKeysStore:
+        return RemoteAccessKeysStore(self._tp)
+
+    def channels(self) -> ChannelsStore:
+        return RemoteChannelsStore(self._tp)
+
+    def engine_instances(self) -> EngineInstancesStore:
+        return RemoteEngineInstancesStore(self._tp)
+
+    def evaluation_instances(self) -> EvaluationInstancesStore:
+        return RemoteEvaluationInstancesStore(self._tp)
+
+    def events(self) -> EventStore:
+        return RemoteEventStore(self._tp)
+
+    def models(self) -> ModelsStore:
+        return RemoteModelsStore(self._tp)

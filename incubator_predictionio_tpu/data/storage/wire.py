@@ -1,0 +1,76 @@
+"""Wire codecs shared by the storage server and the ``remote`` client.
+
+Server-independent on purpose: the client half (remote.py) is imported by the
+registry in every process, so it must not drag the aiohttp server stack in —
+only these plain JSON<->dataclass conventions. Datetimes travel ISO-8601,
+bytes base64 (at the call sites), the target-entity filter's three-state
+semantics (UNSET / None / value) as key-absence vs null vs string
+(PEvents.scala:56-60's Option[Option[String]]).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime as _dt
+from typing import Any, Optional
+
+from incubator_predictionio_tpu.data.storage.base import (
+    UNSET,
+    AccessKey,
+    App,
+    Channel,
+    EngineInstance,
+    EvaluationInstance,
+)
+
+
+def enc_dt(t: Optional[_dt.datetime]) -> Optional[str]:
+    return None if t is None else t.isoformat()
+
+
+def dec_dt(s: Optional[str]) -> Optional[_dt.datetime]:
+    return None if s is None else _dt.datetime.fromisoformat(s)
+
+
+def dec_opt_filter(d: dict, key: str) -> Any:
+    """Decode a target-entity filter: absent key = UNSET sentinel, null =
+    must-be-absent, string = must-equal."""
+    return d[key] if key in d else UNSET
+
+
+_META_CODECS = {
+    App: (dataclasses.asdict, lambda d: App(**d)),
+    AccessKey: (
+        lambda a: {"key": a.key, "app_id": a.app_id, "events": list(a.events)},
+        lambda d: AccessKey(d["key"], d["app_id"], tuple(d["events"])),
+    ),
+    Channel: (dataclasses.asdict, lambda d: Channel(**d)),
+}
+
+
+def enc_engine_instance(i: EngineInstance) -> dict:
+    d = dataclasses.asdict(i)
+    d["start_time"] = enc_dt(i.start_time)
+    d["end_time"] = enc_dt(i.end_time)
+    return d
+
+
+def dec_engine_instance(d: dict) -> EngineInstance:
+    d = dict(d)
+    d["start_time"] = dec_dt(d["start_time"])
+    d["end_time"] = dec_dt(d["end_time"])
+    return EngineInstance(**d)
+
+
+def enc_evaluation_instance(i: EvaluationInstance) -> dict:
+    d = dataclasses.asdict(i)
+    d["start_time"] = enc_dt(i.start_time)
+    d["end_time"] = enc_dt(i.end_time)
+    return d
+
+
+def dec_evaluation_instance(d: dict) -> EvaluationInstance:
+    d = dict(d)
+    d["start_time"] = dec_dt(d["start_time"])
+    d["end_time"] = dec_dt(d["end_time"])
+    return EvaluationInstance(**d)

@@ -1,0 +1,480 @@
+"""Storage Server — the networked storage backend's server half.
+
+The reference's production story is shared networked storage: every host of a
+job points at the same PostgreSQL/HBase/Elasticsearch service
+(storage/jdbc/.../JDBCLEvents.scala:109-150, storage/hbase/.../HBEventsUtil.scala:76-131,
+storage/elasticsearch/.../ESLEvents.scala:41) discovered through the registry
+(data/.../storage/Storage.scala:310-336). This framework's equivalent is a
+storage *server process* (`pio-tpu storageserver`) that owns one embedded
+backend (sqlite / eventlog / memory / localfs) and exposes the full storage
+contract — METADATA + EVENTDATA + MODELDATA — over HTTP, with the ``remote``
+backend type (data/storage/remote.py) as the client half. A multi-host
+``launch`` job sets ``PIO_STORAGE_SOURCES_<N>_TYPE=remote`` and every process
+shares one store without a shared filesystem.
+
+Wire protocol (designed for the TPU input path, not per-row ORM chatter):
+
+- ``POST /rpc/{store}/{method}`` — JSON args → JSON result for all CRUD and
+  metadata calls. Bytes travel base64 (model blobs), datetimes ISO-8601.
+- ``POST /rpc/events/find`` — chunked JSON-lines stream of events, so scans
+  never materialize server-side; the client iterator is lazy end to end.
+  Accepts ``n_shards``/``shard_index`` so a multi-host job's per-process
+  sharded read pulls ONLY its entity shard over the network.
+- ``POST /rpc/events/assemble_triples`` — the training bulk read returns the
+  five columnar arrays as one binary ``.npz`` body: the event log becomes
+  device-ready tensors in a single round trip (the networked analogue of the
+  native scanner's columnar fast path).
+
+Auth: optional shared key (``--server-access-key`` / config ``KEY``) checked
+on every request via the ``X-PIO-Storage-Key`` header. TLS via the same PEM
+cert/key pair as the other servers (common/SSLConfiguration.scala:30).
+
+Storage calls run in a thread executor — the event loop never blocks on
+sqlite/fs I/O (same discipline as the Event Server).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import dataclasses
+import io
+import json
+import logging
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Optional
+
+import numpy as np
+from aiohttp import web
+
+from incubator_predictionio_tpu.data.event import Event
+from incubator_predictionio_tpu.data.storage.base import (
+    AccessKey,
+    App,
+    Channel,
+    Model,
+    StorageError,
+)
+from incubator_predictionio_tpu.data.storage.registry import Storage, get_storage
+
+logger = logging.getLogger(__name__)
+
+
+# wire codecs live in data/storage/wire.py (server-independent — the remote
+# client imports them without dragging aiohttp in)
+from incubator_predictionio_tpu.data.storage.wire import (  # noqa: E402
+    _META_CODECS,
+    dec_dt,
+    dec_engine_instance,
+    dec_evaluation_instance,
+    dec_opt_filter,
+    enc_dt,
+    enc_engine_instance,
+    enc_evaluation_instance,
+)
+
+
+# ---------------------------------------------------------------------------
+# server
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class StorageServerConfig:
+    ip: str = "0.0.0.0"
+    port: int = 7072
+    ssl_cert: Optional[str] = None
+    ssl_key: Optional[str] = None
+    server_access_key: Optional[str] = None  # shared secret for all calls
+
+
+class StorageServer:
+    """Serves one backing :class:`Storage` over the RPC surface above."""
+
+    def __init__(self, config: StorageServerConfig,
+                 storage: Optional[Storage] = None):
+        self.config = config
+        self.storage = storage or get_storage()
+        self._executor = ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix="pio-storage")
+        self._runner: Optional[web.AppRunner] = None
+
+    async def _run(self, fn, *args, **kw):
+        return await asyncio.get_running_loop().run_in_executor(
+            self._executor, lambda: fn(*args, **kw))
+
+    # -- app --------------------------------------------------------------
+    def make_app(self) -> web.Application:
+        app = web.Application(client_max_size=256 * 1024 * 1024)
+        app.router.add_get("/", self.handle_status)
+        app.router.add_post("/rpc/events/find", self.handle_find)
+        app.router.add_post("/rpc/events/assemble_triples",
+                            self.handle_assemble_triples)
+        app.router.add_post("/rpc/{store}/{method}", self.handle_rpc)
+        return app
+
+    def _authorized(self, request: web.Request) -> bool:
+        key = self.config.server_access_key
+        return not key or request.headers.get("X-PIO-Storage-Key") == key
+
+    async def handle_status(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "alive", "service": "storage"})
+
+    # -- generic JSON RPC --------------------------------------------------
+    async def handle_rpc(self, request: web.Request) -> web.Response:
+        if not self._authorized(request):
+            return web.json_response({"message": "Unauthorized"}, status=401)
+        store = request.match_info["store"]
+        method = request.match_info["method"]
+        try:
+            args = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response({"message": "invalid JSON"}, status=400)
+        handler = _RPC.get((store, method))
+        if handler is None:
+            return web.json_response(
+                {"message": f"unknown rpc {store}.{method}"}, status=404)
+        try:
+            result = await self._run(handler, self.storage, args)
+        except StorageError as e:
+            return web.json_response({"message": str(e)}, status=500)
+        except (TypeError, ValueError, KeyError) as e:
+            return web.json_response({"message": repr(e)}, status=400)
+        return web.json_response({"result": result})
+
+    # -- streaming find ----------------------------------------------------
+    async def handle_find(self, request: web.Request) -> web.StreamResponse:
+        if not self._authorized(request):
+            return web.json_response({"message": "Unauthorized"}, status=401)
+        try:
+            a = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response({"message": "invalid JSON"}, status=400)
+        events = self.storage.get_events()
+        n_shards = a.get("n_shards")
+
+        def make_iter():
+            if n_shards is not None:
+                return events.find_sharded(
+                    a["app_id"], n_shards,
+                    channel_id=a.get("channel_id"),
+                    start_time=dec_dt(a.get("start_time")),
+                    until_time=dec_dt(a.get("until_time")),
+                    entity_type=a.get("entity_type"),
+                    event_names=a.get("event_names"),
+                )[a.get("shard_index", 0)]
+            return events.find(
+                a["app_id"],
+                channel_id=a.get("channel_id"),
+                start_time=dec_dt(a.get("start_time")),
+                until_time=dec_dt(a.get("until_time")),
+                entity_type=a.get("entity_type"),
+                entity_id=a.get("entity_id"),
+                event_names=a.get("event_names"),
+                target_entity_type=dec_opt_filter(a, "target_entity_type"),
+                target_entity_id=dec_opt_filter(a, "target_entity_id"),
+                limit=a.get("limit"),
+                reversed=a.get("reversed", False),
+            )
+
+        sentinel = object()
+
+        def pull(it, n=256):
+            # a chunk of events per executor hop (not one hop per event)
+            out = []
+            for _ in range(n):
+                e = next(it, sentinel)
+                if e is sentinel:
+                    break
+                out.append(e)
+            return out
+
+        # materialize the iterator AND its first chunk before committing to a
+        # 200 stream, so backend errors (e.g. uninitialized app) surface as a
+        # proper error status instead of a truncated stream
+        try:
+            it = await self._run(make_iter)
+            chunk = await self._run(pull, it)
+        except StorageError as e:
+            return web.json_response({"message": str(e)}, status=500)
+        resp = web.StreamResponse(
+            headers={"Content-Type": "application/x-ndjson"})
+        await resp.prepare(request)
+        while chunk:
+            body = "".join(
+                json.dumps(e.to_json_dict(), separators=(",", ":")) + "\n"
+                for e in chunk
+            )
+            await resp.write(body.encode())
+            chunk = await self._run(pull, it)
+        await resp.write_eof()
+        return resp
+
+    # -- columnar bulk read ------------------------------------------------
+    async def handle_assemble_triples(self, request: web.Request) -> web.Response:
+        if not self._authorized(request):
+            return web.json_response({"message": "Unauthorized"}, status=401)
+        try:
+            a = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response({"message": "invalid JSON"}, status=400)
+        events = self.storage.get_events()
+
+        def run():
+            uv, tv, ui, ti, vals = events.assemble_triples(
+                a["app_id"],
+                channel_id=a.get("channel_id"),
+                start_time=dec_dt(a.get("start_time")),
+                until_time=dec_dt(a.get("until_time")),
+                entity_type=a.get("entity_type"),
+                event_names=a.get("event_names"),
+                target_entity_type=dec_opt_filter(a, "target_entity_type"),
+                value_property=a.get("value_property"),
+                default_values=a.get("default_values"),
+                missing_value=a.get("missing_value", 0.0),
+                dedup=a.get("dedup", False),
+                n_shards=a.get("n_shards"),
+                shard_index=a.get("shard_index", 0),
+            )
+            buf = io.BytesIO()
+            # vocabularies ship as unicode arrays (ids are strings); indices
+            # and values as raw dtypes — one binary body, zero pickling
+            np.savez(
+                buf,
+                entity_vocab=uv.astype(np.str_),
+                target_vocab=tv.astype(np.str_),
+                entity_idx=ui, target_idx=ti, values=vals,
+            )
+            return buf.getvalue()
+
+        try:
+            body = await self._run(run)
+        except StorageError as e:
+            return web.json_response({"message": str(e)}, status=500)
+        return web.Response(body=body,
+                            content_type="application/octet-stream")
+
+    # -- lifecycle ---------------------------------------------------------
+    async def start(self) -> None:
+        from incubator_predictionio_tpu.server.event_server import _ssl_context
+
+        self._runner = web.AppRunner(self.make_app())
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.config.ip, self.config.port,
+                           ssl_context=_ssl_context(self.config))
+        await site.start()
+        logger.info("storage server listening on %s:%d",
+                    self.config.ip, self.config.port)
+
+    async def shutdown(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+        self._executor.shutdown(wait=False)
+
+
+def serve_forever(config: StorageServerConfig,
+                  storage: Optional[Storage] = None) -> None:
+    """Blocking entry used by the CLI `storageserver` verb; runs until the
+    process is signalled (same lifecycle as the event server)."""
+
+    async def main():
+        server = StorageServer(config, storage)
+        await server.start()
+        await asyncio.Event().wait()
+
+    asyncio.run(main())
+
+
+class ThreadedStorageServer:
+    """A storage server on a daemon thread with its own event loop — the
+    in-process harness tests and single-host multi-process jobs use (the
+    parent process serves, `launch` children connect over the socket)."""
+
+    def __init__(self, storage: Storage, config: Optional[StorageServerConfig] = None):
+        import threading
+
+        self.config = config or StorageServerConfig(ip="127.0.0.1", port=0)
+        self.storage = storage
+        self._loop = asyncio.new_event_loop()
+        self._server: Optional[StorageServer] = None
+        self._boot_error: Optional[BaseException] = None
+        self._started = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run_loop, name="pio-storage-server", daemon=True)
+        self._thread.start()
+        if not self._started.wait(timeout=30):
+            raise StorageError("storage server thread failed to start in 30s")
+        if self._boot_error is not None:
+            raise StorageError(
+                f"storage server failed to start: {self._boot_error!r}"
+            ) from self._boot_error
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.config.ip}:{self.config.port}"
+
+    def _run_loop(self) -> None:
+        asyncio.set_event_loop(self._loop)
+
+        async def boot():
+            self._server = StorageServer(self.config, self.storage)
+            await self._server.start()
+            if self.config.port == 0:
+                # ephemeral bind: publish the kernel-chosen port
+                self.config.port = self._server._runner.addresses[0][1]
+
+        try:
+            self._loop.run_until_complete(boot())
+        except BaseException as e:  # noqa: BLE001 - reported to the constructor
+            self._boot_error = e
+            self._started.set()
+            return
+        self._started.set()
+        self._loop.run_forever()
+
+    def close(self) -> None:
+        async def stop():
+            await self._server.shutdown()
+            self._loop.stop()
+
+        if self._loop.is_running():
+            asyncio.run_coroutine_threadsafe(stop(), self._loop)
+            self._thread.join(timeout=10)
+        self._loop.close()
+
+
+# ---------------------------------------------------------------------------
+# RPC handler table: (store, method) -> fn(storage, args) -> jsonable
+# ---------------------------------------------------------------------------
+
+def _events_insert(s: Storage, a: dict):
+    return s.get_events().insert(
+        Event.from_json_dict(a["event"]), a["app_id"], a.get("channel_id"))
+
+
+def _events_insert_batch(s: Storage, a: dict):
+    evs = [Event.from_json_dict(d) for d in a["events"]]
+    return s.get_events().insert_batch(evs, a["app_id"], a.get("channel_id"))
+
+
+def _events_get(s: Storage, a: dict):
+    e = s.get_events().get(a["event_id"], a["app_id"], a.get("channel_id"))
+    return None if e is None else e.to_json_dict()
+
+
+def _events_delete(s: Storage, a: dict):
+    return s.get_events().delete(a["event_id"], a["app_id"], a.get("channel_id"))
+
+
+def _events_init(s: Storage, a: dict):
+    return s.get_events().init(a["app_id"], a.get("channel_id"))
+
+
+def _events_remove(s: Storage, a: dict):
+    return s.get_events().remove(a["app_id"], a.get("channel_id"))
+
+
+def _events_aggregate(s: Storage, a: dict):
+    agg = s.get_events().aggregate_properties(
+        a["app_id"], a["entity_type"],
+        channel_id=a.get("channel_id"),
+        start_time=dec_dt(a.get("start_time")),
+        until_time=dec_dt(a.get("until_time")),
+        required=a.get("required"),
+    )
+    return {
+        k: {"fields": v.to_dict(),
+            "first_updated": enc_dt(v.first_updated),
+            "last_updated": enc_dt(v.last_updated)}
+        for k, v in agg.items()
+    }
+
+
+def _meta_handlers(store_name: str, getter, record_cls):
+    enc, _dec = _META_CODECS[record_cls]
+
+    def insert(s, a):
+        return getter(s).insert(_dec(a["record"]))
+
+    def get(s, a):
+        r = getter(s).get(a["id"])
+        return None if r is None else enc(r)
+
+    def get_all(s, a):
+        return [enc(r) for r in getter(s).get_all()]
+
+    def update(s, a):
+        return getter(s).update(_dec(a["record"]))
+
+    def delete(s, a):
+        return getter(s).delete(a["id"])
+
+    return {
+        (store_name, "insert"): insert,
+        (store_name, "get"): get,
+        (store_name, "get_all"): get_all,
+        (store_name, "update"): update,
+        (store_name, "delete"): delete,
+    }
+
+
+_RPC: dict[tuple, Any] = {
+    ("events", "insert"): _events_insert,
+    ("events", "insert_batch"): _events_insert_batch,
+    ("events", "get"): _events_get,
+    ("events", "delete"): _events_delete,
+    ("events", "init"): _events_init,
+    ("events", "remove"): _events_remove,
+    ("events", "aggregate_properties"): _events_aggregate,
+    # models (bytes travel base64)
+    ("models", "insert"): lambda s, a: s.get_model_data_models().insert(
+        Model(a["id"], base64.b64decode(a["blob"]))),
+    ("models", "get"): lambda s, a: (
+        (lambda m: None if m is None else
+         {"id": m.id, "blob": base64.b64encode(m.models).decode()})
+        (s.get_model_data_models().get(a["id"]))),
+    ("models", "delete"): lambda s, a: s.get_model_data_models().delete(a["id"]),
+}
+
+_RPC.update(_meta_handlers("apps", Storage.get_meta_data_apps, App))
+_RPC.update(_meta_handlers(
+    "access_keys", Storage.get_meta_data_access_keys, AccessKey))
+_RPC.update(_meta_handlers("channels", Storage.get_meta_data_channels, Channel))
+
+# apps/access_keys/channels extra finders
+_RPC[("apps", "get_by_name")] = lambda s, a: (
+    (lambda r: None if r is None else _META_CODECS[App][0](r))
+    (s.get_meta_data_apps().get_by_name(a["name"])))
+_RPC[("access_keys", "get_by_app_id")] = lambda s, a: [
+    _META_CODECS[AccessKey][0](k)
+    for k in s.get_meta_data_access_keys().get_by_app_id(a["app_id"])]
+_RPC[("channels", "get_by_app_id")] = lambda s, a: [
+    _META_CODECS[Channel][0](c)
+    for c in s.get_meta_data_channels().get_by_app_id(a["app_id"])]
+
+# engine / evaluation instances (datetimes in records)
+_RPC[("engine_instances", "insert")] = lambda s, a: (
+    s.get_meta_data_engine_instances().insert(dec_engine_instance(a["record"])))
+_RPC[("engine_instances", "get")] = lambda s, a: (
+    (lambda r: None if r is None else enc_engine_instance(r))
+    (s.get_meta_data_engine_instances().get(a["id"])))
+_RPC[("engine_instances", "get_all")] = lambda s, a: [
+    enc_engine_instance(r)
+    for r in s.get_meta_data_engine_instances().get_all()]
+_RPC[("engine_instances", "update")] = lambda s, a: (
+    s.get_meta_data_engine_instances().update(dec_engine_instance(a["record"])))
+_RPC[("engine_instances", "delete")] = lambda s, a: (
+    s.get_meta_data_engine_instances().delete(a["id"]))
+_RPC[("evaluation_instances", "insert")] = lambda s, a: (
+    s.get_meta_data_evaluation_instances().insert(
+        dec_evaluation_instance(a["record"])))
+_RPC[("evaluation_instances", "get")] = lambda s, a: (
+    (lambda r: None if r is None else enc_evaluation_instance(r))
+    (s.get_meta_data_evaluation_instances().get(a["id"])))
+_RPC[("evaluation_instances", "get_all")] = lambda s, a: [
+    enc_evaluation_instance(r)
+    for r in s.get_meta_data_evaluation_instances().get_all()]
+_RPC[("evaluation_instances", "update")] = lambda s, a: (
+    s.get_meta_data_evaluation_instances().update(
+        dec_evaluation_instance(a["record"])))
+_RPC[("evaluation_instances", "delete")] = lambda s, a: (
+    s.get_meta_data_evaluation_instances().delete(a["id"]))

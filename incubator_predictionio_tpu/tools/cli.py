@@ -355,6 +355,19 @@ def cmd_eventserver(args, storage: Storage) -> int:
     return 0
 
 
+def cmd_storageserver(args, storage: Storage) -> int:
+    from incubator_predictionio_tpu.server.storage_server import (
+        StorageServerConfig,
+        serve_forever,
+    )
+
+    serve_forever(StorageServerConfig(
+        ip=args.ip, port=args.port,
+        ssl_cert=args.ssl_cert, ssl_key=args.ssl_key,
+        server_access_key=args.server_access_key), storage)
+    return 0
+
+
 def cmd_start_all(args, storage: Storage) -> int:
     from incubator_predictionio_tpu.tools.ops import StartAllConfig, start_all
 
@@ -579,6 +592,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ssl-cert")
     p.add_argument("--ssl-key")
 
+    # storageserver — serve this process's storage config to remote clients
+    p = sub.add_parser(
+        "storageserver",
+        help="serve the local storage backends over HTTP (the shared "
+             "networked store of a multi-host job; clients use TYPE=remote)")
+    p.add_argument("--ip", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=7072)
+    p.add_argument("--ssl-cert")
+    p.add_argument("--ssl-key")
+    p.add_argument("--server-access-key",
+                   help="shared secret required from every client")
+
     # dashboard / adminserver
     p = sub.add_parser("dashboard")
     p.add_argument("--ip", default="127.0.0.1")
@@ -670,6 +695,7 @@ _COMMANDS = {
     "undeploy": cmd_undeploy,
     "batchpredict": cmd_batchpredict,
     "eventserver": cmd_eventserver,
+    "storageserver": cmd_storageserver,
     "dashboard": cmd_dashboard,
     "adminserver": cmd_adminserver,
     "export": cmd_export,

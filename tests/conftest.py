@@ -47,3 +47,19 @@ def tmp_pio_home(monkeypatch):
             monkeypatch.setenv(f"PIO_STORAGE_REPOSITORIES_{repo}_NAME", f"pio_{repo.lower()}")
             monkeypatch.setenv(f"PIO_STORAGE_REPOSITORIES_{repo}_SOURCE", "SQLITE")
         yield d
+
+
+@pytest.fixture(scope="session")
+def tls_cert(tmp_path_factory):
+    """Self-signed PEM cert/key pair for TLS round-trip tests (the reference
+    ships a JKS keystore for the same purpose; our servers take PEM)."""
+    import subprocess
+
+    d = tmp_path_factory.mktemp("tls")
+    cert, key = str(d / "cert.pem"), str(d / "key.pem")
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", key, "-out", cert, "-days", "1",
+         "-subj", "/CN=localhost"],
+        check=True, capture_output=True)
+    return cert, key

@@ -18,12 +18,36 @@ import pytest
 
 
 @pytest.mark.slow
-def test_launch_two_process_train(tmp_path):
-    env = {
-        "PIO_FS_BASEDIR": str(tmp_path / "fs"),
-        "PIO_STORAGE_SOURCES_SQLITE_TYPE": "sqlite",
-        "PIO_STORAGE_SOURCES_SQLITE_PATH": str(tmp_path / "pio.db"),
-    }
+@pytest.mark.parametrize("backend", ["sqlite", "remote"])
+def test_launch_two_process_train(tmp_path, backend, request):
+    if backend == "sqlite":
+        # shared filesystem: every process opens the same sqlite file
+        env = {
+            "PIO_FS_BASEDIR": str(tmp_path / "fs"),
+            "PIO_STORAGE_SOURCES_SQLITE_TYPE": "sqlite",
+            "PIO_STORAGE_SOURCES_SQLITE_PATH": str(tmp_path / "pio.db"),
+        }
+    else:
+        # shared NOTHING: a storage server in this (parent) process owns the
+        # store; both launch processes reach it over the socket — the
+        # reference's shared-PostgreSQL deployment topology
+        from incubator_predictionio_tpu.data.storage import Storage
+        from incubator_predictionio_tpu.server.storage_server import (
+            ThreadedStorageServer,
+        )
+
+        backing = Storage({
+            "PIO_STORAGE_SOURCES_BACK_TYPE": "sqlite",
+            "PIO_STORAGE_SOURCES_BACK_PATH": str(tmp_path / "backing.db"),
+        })
+        server = ThreadedStorageServer(backing)
+        request.addfinalizer(backing.close)
+        request.addfinalizer(server.close)
+        env = {
+            "PIO_FS_BASEDIR": str(tmp_path / "fs"),
+            "PIO_STORAGE_SOURCES_R_TYPE": "remote",
+            "PIO_STORAGE_SOURCES_R_URL": server.url,
+        }
     run_env = dict(os.environ)
     run_env.update(env)
     run_env["JAX_PLATFORMS"] = "cpu"

@@ -31,12 +31,34 @@ def t(n):
     return dt.datetime(2020, 1, 1, 0, 0, n, tzinfo=UTC)
 
 
-@pytest.fixture(params=["memory", "sqlite", "eventlog", "eventlog-pyfallback"])
+@pytest.fixture(params=["memory", "sqlite", "eventlog", "eventlog-pyfallback",
+                        "remote"])
 def client(request, tmp_path, monkeypatch):
     if request.param == "memory":
         c = MemoryStorageClient({})
     elif request.param == "sqlite":
         c = SqliteStorageClient({"PATH": str(tmp_path / "pio.db")})
+    elif request.param == "remote":
+        # the full contract over a REAL socket: a storage server thread
+        # backed by sqlite, exercised through the remote client
+        from incubator_predictionio_tpu.data.storage import Storage
+        from incubator_predictionio_tpu.data.storage.remote import (
+            RemoteStorageClient,
+        )
+        from incubator_predictionio_tpu.server.storage_server import (
+            ThreadedStorageServer,
+        )
+
+        backing = Storage({
+            "PIO_STORAGE_SOURCES_BACK_TYPE": "sqlite",
+            "PIO_STORAGE_SOURCES_BACK_PATH": str(tmp_path / "backing.db"),
+        })
+        server = ThreadedStorageServer(backing)
+        c = RemoteStorageClient({"URL": server.url})
+        yield c
+        server.close()
+        backing.close()
+        return
     else:
         from incubator_predictionio_tpu.data.storage.eventlog_backend import (
             EventLogStorageClient,
