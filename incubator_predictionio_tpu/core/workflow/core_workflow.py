@@ -126,33 +126,41 @@ def run_evaluation(
     if evaluation.engine is None or evaluation.evaluator is None:
         raise ValueError("Evaluation must define engine and evaluator (engine_metric=…)")
     storage = storage or get_storage()
-    instances = storage.get_meta_data_evaluation_instances()
-    instance_id = evaluation_instance.id or instances.insert(evaluation_instance)
-    if evaluation_instance.id:
-        instances.update(evaluation_instance)
     ctx = ctx or MeshContext.create()
+    # multi-process eval: every process computes (identical QA set, replicated
+    # model → identical metrics); only the primary writes metadata rows
+    primary = ctx.is_primary
+    instances = storage.get_meta_data_evaluation_instances()
+    if primary:
+        instance_id = evaluation_instance.id or instances.insert(evaluation_instance)
+        if evaluation_instance.id:
+            instances.update(evaluation_instance)
+    else:
+        instance_id = "<secondary>"
     try:
         with ctx.activate():
             eval_data_set = evaluation.engine.batch_eval(ctx, list(engine_params_list), params)
             result = evaluation.evaluator.evaluate(ctx, evaluation, eval_data_set, params)
-        inst = instances.get(instance_id)
-        if not result.no_save:
-            instances.update(
-                replace(
-                    inst,
-                    status="EVALCOMPLETED",
-                    end_time=_now(),
-                    evaluator_results=result.to_one_liner(),
-                    evaluator_results_html=result.to_html(),
-                    evaluator_results_json=result.to_json(),
+        if primary:
+            inst = instances.get(instance_id)
+            if not result.no_save:
+                instances.update(
+                    replace(
+                        inst,
+                        status="EVALCOMPLETED",
+                        end_time=_now(),
+                        evaluator_results=result.to_one_liner(),
+                        evaluator_results_html=result.to_html(),
+                        evaluator_results_json=result.to_json(),
+                    )
                 )
-            )
         logger.info("evaluation finished: %s", result.to_one_liner())
         return instance_id, result
     except Exception:
-        inst = instances.get(instance_id)
-        if inst is not None:
-            instances.update(replace(inst, status="EVALFAILED", end_time=_now()))
+        if primary:
+            inst = instances.get(instance_id)
+            if inst is not None:
+                instances.update(replace(inst, status="EVALFAILED", end_time=_now()))
         raise
     finally:
         CleanupFunctions.run()

@@ -132,12 +132,19 @@ def _run_eval(config: WorkflowConfig, storage: Optional[Storage]) -> str:
         batch=config.batch,
         env=storage_env_vars(),
     )
+    mesh_conf: dict[str, Any] = {}
+    if config.mesh_axes:
+        mesh_conf["axes"] = config.mesh_axes
+    if config.distributed:
+        mesh_conf["distributed"] = True
+    ctx = MeshContext.from_conf(mesh_conf or None)
     instance_id, _ = run_evaluation(
         evaluation,
         list(generator.engine_params_list),
         instance,
         _workflow_params(config),
         storage=storage,
+        ctx=ctx,
     )
     return instance_id
 

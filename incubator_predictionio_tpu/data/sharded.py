@@ -1,0 +1,91 @@
+"""Sharded-read primitives: shard → vocab allgather → remap.
+
+The per-process read path of a multi-host job (reference counterpart: RDD
+partition reads, data/.../storage/PEvents.scala:38): each process reads ONLY
+its entity shard of the store (``find_sharded`` / ``assemble_triples`` with
+``n_shards``), then the processes exchange *vocabulary-sized* metadata — never
+event-sized — to agree on global id spaces:
+
+- :func:`concat_vocab` — for the SHARDED entity type (users): shards are
+  entity-disjoint by construction, so the global vocabulary is the
+  concatenation of per-shard vocabularies and a local index globalizes by
+  adding an offset;
+- :func:`union_vocab` — for the target type (items), whose ids cross shards:
+  the global vocabulary is the deterministic union over shards in process
+  order (or sorted), with an int32 remap array for local indices;
+- :func:`global_sum` / :func:`global_row_count` — reductions over small
+  per-shard statistics (row counts, per-item counters, feature moments).
+
+Every function is also correct single-process (it degenerates to identity),
+so data sources call them unconditionally from their ``_read_sharded`` path.
+All calls are collective: every process must execute the same sequence.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from incubator_predictionio_tpu.parallel.mesh import MeshContext
+
+
+def concat_vocab(
+    ctx: MeshContext, local_vocab: Sequence[str]
+) -> tuple[np.ndarray, int]:
+    """Entity-disjoint vocabularies → (global vocab, this process's offset).
+
+    Local index ``i`` globalizes as ``i + offset``. Requires that no id
+    appears in two processes' vocabularies (guaranteed when the store was
+    read entity-sharded)."""
+    parts = ctx.allgather_obj(list(local_vocab))
+    offset = sum(len(p) for p in parts[: ctx.process_index])
+    vocab = np.asarray([v for p in parts for v in p], object)
+    return vocab, offset
+
+
+def union_vocab(
+    ctx: MeshContext, local_vocab: Sequence[str]
+) -> tuple[np.ndarray, np.ndarray]:
+    """Cross-shard vocabularies → (global vocab, local→global remap).
+
+    Global order is first-seen over shards in process order (matches
+    single-process first-seen reads). The remap is an int32 array with
+    ``remap[local_idx] == global_idx``. Callers needing sorted vocabularies
+    use :func:`union_label_set` and index by value instead."""
+    parts = ctx.allgather_obj(list(local_vocab))
+    glob: dict[str, int] = {}
+    for p in parts:
+        for v in p:
+            glob.setdefault(v, len(glob))
+    vocab = np.asarray(list(glob), object)
+    remap = np.asarray([glob[v] for v in local_vocab], np.int32)
+    return vocab, remap
+
+
+def global_sum(ctx: MeshContext, value):
+    """Sum small numeric host values over processes, leaf-wise: ``value`` may
+    be a scalar, a numpy array, or any pytree of them (tuples of moment
+    accumulators etc. sum element-wise, not concatenate)."""
+    import jax
+
+    parts = ctx.allgather_obj(value)
+
+    def add_all(*leaves):
+        out = leaves[0]
+        for leaf in leaves[1:]:
+            out = out + leaf
+        return out
+
+    return jax.tree.map(add_all, *parts)
+
+
+def global_row_count(ctx: MeshContext, n_local: int) -> int:
+    return int(global_sum(ctx, int(n_local)))
+
+
+def union_label_set(ctx: MeshContext, local_labels) -> list:
+    """Sorted union of label values across processes (classification's
+    global class vocabulary)."""
+    parts = ctx.allgather_obj(sorted(set(local_labels)))
+    return sorted({v for p in parts for v in p})

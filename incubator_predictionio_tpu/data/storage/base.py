@@ -149,11 +149,22 @@ class EventStore(abc.ABC):
         start_time: Optional[_dt.datetime] = None,
         until_time: Optional[_dt.datetime] = None,
         required: Optional[Sequence[str]] = None,
+        n_shards: Optional[int] = None,
+        shard_index: int = 0,
     ) -> dict[str, PropertyMap]:
         """Fold ``$set/$unset/$delete`` into per-entity snapshots
-        (LEvents.scala:264-296 / PEvents.scala:105-135)."""
-        agg = _aggregate(
-            self.find(
+        (LEvents.scala:264-296 / PEvents.scala:105-135).
+
+        ``n_shards``/``shard_index`` restrict to one entity-disjoint shard
+        (same partition as :meth:`find_sharded`) — aggregation is per-entity,
+        so a shard's snapshots are exact without any cross-shard merge."""
+        if n_shards is not None:
+            events_iter = self.find_sharded(
+                app_id, n_shards, channel_id, start_time, until_time,
+                entity_type, AGGREGATOR_EVENT_NAMES,
+            )[shard_index]
+        else:
+            events_iter = self.find(
                 app_id,
                 channel_id,
                 start_time,
@@ -162,7 +173,7 @@ class EventStore(abc.ABC):
                 None,
                 AGGREGATOR_EVENT_NAMES,
             )
-        )
+        agg = _aggregate(events_iter)
         if required:
             req = set(required)
             agg = {k: v for k, v in agg.items() if req <= set(v.keys())}

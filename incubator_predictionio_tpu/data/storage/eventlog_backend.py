@@ -458,7 +458,16 @@ class EventLogEvents(EventStore):
         start_time: Optional[_dt.datetime] = None,
         until_time: Optional[_dt.datetime] = None,
         required: Optional[Sequence[str]] = None,
+        n_shards: Optional[int] = None,
+        shard_index: int = 0,
     ) -> dict[str, PropertyMap]:
+        if n_shards is not None:
+            # sharded fold stays in Python (per-entity snapshots are exact
+            # per shard); the native fold currently folds the whole log
+            return super().aggregate_properties(
+                app_id, entity_type, channel_id, start_time, until_time,
+                required, n_shards, shard_index,
+            )
         log = self._log(app_id, channel_id)
         flt = make_filter(
             start_time, until_time, entity_type, None, None,

@@ -61,12 +61,17 @@ from incubator_predictionio_tpu.data.storage.base import (
 )
 from incubator_predictionio_tpu.data.storage.registry import register_backend
 from incubator_predictionio_tpu.data.storage.wire import (
+    _META_CODECS,
     dec_engine_instance,
     dec_evaluation_instance,
     enc_dt,
     enc_engine_instance,
     enc_evaluation_instance,
 )
+
+_APP_ENC, _APP_DEC = _META_CODECS[App]
+_KEY_ENC, _KEY_DEC = _META_CODECS[AccessKey]
+_CHAN_ENC, _CHAN_DEC = _META_CODECS[Channel]
 
 logger = logging.getLogger(__name__)
 
@@ -75,6 +80,13 @@ class _Transport:
     """Thread-local persistent connections; idempotent calls get one retry on
     stale sockets, non-idempotent writes never auto-retry (an insert whose
     response was lost may have committed — re-sending would double-apply)."""
+
+    #: Pooled connections idle longer than this are reconnected before use —
+    #: below aiohttp's 75s server keep-alive, so a write after a long idle
+    #: gap (e.g. models.insert after a slow fit) never lands on a socket the
+    #: server already closed (non-idempotent calls get no retry, so sending
+    #: them on a known-stale connection would fail permanently).
+    MAX_IDLE_SECS = 55.0
 
     def __init__(self, url: str, key: Optional[str], timeout: float,
                  ca_cert: Optional[str] = None):
@@ -118,16 +130,30 @@ class _Transport:
     def request(self, path: str, body: dict,
                 idempotent: bool = True) -> tuple[int, bytes]:
         """Unary call on the pooled per-thread connection."""
+        import time
+
         payload = json.dumps(body).encode()
         attempts = (0, 1) if idempotent else (1,)
         for attempt in attempts:
             conn = getattr(self._local, "conn", None)
+            now = time.monotonic()
+            if conn is not None and (
+                now - getattr(self._local, "last_used", 0.0) > self.MAX_IDLE_SECS
+            ):
+                # idle past the server keep-alive window: reconnect BEFORE
+                # sending (safe — nothing is in flight yet)
+                try:
+                    conn.close()
+                except Exception:  # noqa: BLE001
+                    pass
+                conn = None
             if conn is None:
                 conn = self._new_conn()
                 self._local.conn = conn
             try:
                 conn.request("POST", path, payload, self._headers())
                 resp = conn.getresponse()
+                self._local.last_used = time.monotonic()
                 return resp.status, resp.read()
             except (http.client.HTTPException, ConnectionError, OSError) as e:
                 self._local.conn = None
@@ -151,6 +177,7 @@ class _Transport:
             resp = conn.getresponse()
             if resp.status != 200:
                 detail = resp.read(2048).decode(errors="replace")
+                conn.close()
                 raise StorageError(
                     f"remote storage {path} failed: {resp.status} {detail}")
             return resp, conn
@@ -300,6 +327,8 @@ class RemoteEventStore(EventStore):
         start_time: Optional[_dt.datetime] = None,
         until_time: Optional[_dt.datetime] = None,
         required: Optional[Sequence[str]] = None,
+        n_shards: Optional[int] = None,
+        shard_index: int = 0,
     ):
         from incubator_predictionio_tpu.data.event import PropertyMap
 
@@ -308,6 +337,7 @@ class RemoteEventStore(EventStore):
             "channel_id": channel_id,
             "start_time": enc_dt(start_time), "until_time": enc_dt(until_time),
             "required": list(required) if required is not None else None,
+            "n_shards": n_shards, "shard_index": shard_index,
         })
         return {
             k: PropertyMap(
@@ -367,29 +397,28 @@ class RemoteEventStore(EventStore):
 # ---------------------------------------------------------------------------
 
 class RemoteAppsStore(AppsStore):
+    """Record encoding comes from wire._META_CODECS — the SAME table the
+    server decodes with, so the two halves cannot drift."""
+
     def __init__(self, tp: _Transport):
         self._tp = tp
 
     def insert(self, app: App) -> Optional[int]:
-        return self._tp.call("apps", "insert",
-                             {"record": {"id": app.id, "name": app.name,
-                                         "description": app.description}})
+        return self._tp.call("apps", "insert", {"record": _APP_ENC(app)})
 
     def get(self, app_id: int) -> Optional[App]:
         d = self._tp.call("apps", "get", {"id": app_id})
-        return None if d is None else App(**d)
+        return None if d is None else _APP_DEC(d)
 
     def get_by_name(self, name: str) -> Optional[App]:
         d = self._tp.call("apps", "get_by_name", {"name": name})
-        return None if d is None else App(**d)
+        return None if d is None else _APP_DEC(d)
 
     def get_all(self) -> list[App]:
-        return [App(**d) for d in self._tp.call("apps", "get_all", {})]
+        return [_APP_DEC(d) for d in self._tp.call("apps", "get_all", {})]
 
     def update(self, app: App) -> bool:
-        return self._tp.call("apps", "update",
-                             {"record": {"id": app.id, "name": app.name,
-                                         "description": app.description}})
+        return self._tp.call("apps", "update", {"record": _APP_ENC(app)})
 
     def delete(self, app_id: int) -> bool:
         return self._tp.call("apps", "delete", {"id": app_id})
@@ -399,33 +428,25 @@ class RemoteAccessKeysStore(AccessKeysStore):
     def __init__(self, tp: _Transport):
         self._tp = tp
 
-    @staticmethod
-    def _enc(k: AccessKey) -> dict:
-        return {"key": k.key, "app_id": k.app_id, "events": list(k.events)}
-
-    @staticmethod
-    def _dec(d: dict) -> AccessKey:
-        return AccessKey(d["key"], d["app_id"], tuple(d["events"]))
-
     def insert(self, access_key: AccessKey) -> Optional[str]:
         return self._tp.call("access_keys", "insert",
-                             {"record": self._enc(access_key)})
+                             {"record": _KEY_ENC(access_key)})
 
     def get(self, key: str) -> Optional[AccessKey]:
         d = self._tp.call("access_keys", "get", {"id": key})
-        return None if d is None else self._dec(d)
+        return None if d is None else _KEY_DEC(d)
 
     def get_all(self) -> list[AccessKey]:
-        return [self._dec(d)
+        return [_KEY_DEC(d)
                 for d in self._tp.call("access_keys", "get_all", {})]
 
     def get_by_app_id(self, app_id: int) -> list[AccessKey]:
-        return [self._dec(d) for d in self._tp.call(
+        return [_KEY_DEC(d) for d in self._tp.call(
             "access_keys", "get_by_app_id", {"app_id": app_id})]
 
     def update(self, access_key: AccessKey) -> bool:
         return self._tp.call("access_keys", "update",
-                             {"record": self._enc(access_key)})
+                             {"record": _KEY_ENC(access_key)})
 
     def delete(self, key: str) -> bool:
         return self._tp.call("access_keys", "delete", {"id": key})
@@ -436,15 +457,15 @@ class RemoteChannelsStore(ChannelsStore):
         self._tp = tp
 
     def insert(self, channel: Channel) -> Optional[int]:
-        return self._tp.call("channels", "insert", {"record": {
-            "id": channel.id, "name": channel.name, "app_id": channel.app_id}})
+        return self._tp.call("channels", "insert",
+                             {"record": _CHAN_ENC(channel)})
 
     def get(self, channel_id: int) -> Optional[Channel]:
         d = self._tp.call("channels", "get", {"id": channel_id})
-        return None if d is None else Channel(**d)
+        return None if d is None else _CHAN_DEC(d)
 
     def get_by_app_id(self, app_id: int) -> list[Channel]:
-        return [Channel(**d) for d in self._tp.call(
+        return [_CHAN_DEC(d) for d in self._tp.call(
             "channels", "get_by_app_id", {"app_id": app_id})]
 
     def delete(self, channel_id: int) -> bool:

@@ -142,11 +142,15 @@ class PEventStore(_BaseStore):
         start_time: Optional[_dt.datetime] = None,
         until_time: Optional[_dt.datetime] = None,
         required: Optional[Sequence[str]] = None,
+        n_shards: Optional[int] = None,
+        shard_index: int = 0,
     ) -> dict[str, PropertyMap]:
-        """(PEventStore.scala:78-121)"""
+        """(PEventStore.scala:78-121); ``n_shards``/``shard_index`` select one
+        entity-disjoint shard — the per-process slice of a multi-host job."""
         app_id, channel_id = self._resolve(app_name, channel_name)
         return self.storage.get_events().aggregate_properties(
-            app_id, entity_type, channel_id, start_time, until_time, required
+            app_id, entity_type, channel_id, start_time, until_time, required,
+            n_shards, shard_index,
         )
 
     def assemble_triples(

@@ -81,31 +81,66 @@ class MLPClassifier:
         self.config = config
 
     # -- training ---------------------------------------------------------
-    def fit(self, ctx: MeshContext, x: np.ndarray, y: np.ndarray) -> MLPModel:
+    def fit(
+        self,
+        ctx: MeshContext,
+        x: np.ndarray,
+        y: np.ndarray,
+        rows_are_local: bool = False,
+    ) -> MLPModel:
+        """``rows_are_local=True``: (x, y) are only THIS process's
+        entity-disjoint shard. Normalization moments and the class
+        vocabulary are agreed globally via vocabulary-sized allgathers, so
+        every process trains the identical model on 1/P of the rows
+        (reference counterpart: RDD partition reads, PEvents.scala:38)."""
         cfg = self.config
-        classes, y_idx = np.unique(y, return_inverse=True)
         n, d = x.shape
+        if rows_are_local and ctx.process_count > 1:
+            from incubator_predictionio_tpu.data.sharded import (
+                global_sum,
+                union_label_set,
+            )
+            from incubator_predictionio_tpu.parallel.staging import (
+                stage_sharded_batches,
+            )
+
+            classes = np.asarray(union_label_set(ctx, y.tolist()))
+            cls_index = {c: i for i, c in enumerate(classes.tolist())}
+            y_idx = np.asarray([cls_index[v] for v in y.tolist()], np.int32)
+            # global feature moments from per-shard (n, Σx, Σx²)
+            n_g, sx, sxx = global_sum(
+                ctx, (n, x.sum(axis=0, dtype=np.float64),
+                      (x.astype(np.float64) ** 2).sum(axis=0)))
+            mean = (sx / max(n_g, 1)).astype(x.dtype)
+            var = np.maximum(sxx / max(n_g, 1) - mean.astype(np.float64) ** 2, 0.0)
+            std = (np.sqrt(var) + 1e-8).astype(x.dtype)
+            xn = ((x - mean) / std).astype(np.float32)
+            (xb, yb), wb, _ = stage_sharded_batches(
+                ctx, (xn, y_idx), cfg.batch_size, cfg.seed, n_global=n_g)
+        else:
+            classes, y_idx = np.unique(y, return_inverse=True)
+            mean = x.mean(axis=0)
+            std = x.std(axis=0) + 1e-8
+            xn = ((x - mean) / std).astype(np.float32)
+
+            # pad to a whole number of global batches (static shapes)
+            global_batch = min(cfg.batch_size, ctx.pad_to_batch_multiple(n))
+            global_batch = ctx.pad_to_batch_multiple(global_batch)
+            n_batches = max(1, (n + global_batch - 1) // global_batch)
+            n_pad = n_batches * global_batch
+            pad = n_pad - n
+            xp = np.concatenate([xn, np.zeros((pad, d), np.float32)])
+            yp = np.concatenate([y_idx.astype(np.int32), np.zeros(pad, np.int32)])
+            wp = np.concatenate([np.ones(n, np.float32),
+                                 np.zeros(pad, np.float32)])
+
+            # stage on device: [n_batches, batch, ...] sharded over data axis
+            def stage(a):
+                a = a.reshape(n_batches, global_batch, *a.shape[1:])
+                return jax.device_put(a, ctx.sharding(None, ctx.data_axis))
+
+            xb, yb, wb = stage(xp), stage(yp), stage(wp)
         n_classes = len(classes)
-        mean = x.mean(axis=0)
-        std = x.std(axis=0) + 1e-8
-        xn = ((x - mean) / std).astype(np.float32)
-
-        # pad to a whole number of global batches (static shapes)
-        global_batch = min(cfg.batch_size, ctx.pad_to_batch_multiple(n))
-        global_batch = ctx.pad_to_batch_multiple(global_batch)
-        n_batches = max(1, (n + global_batch - 1) // global_batch)
-        n_pad = n_batches * global_batch
-        pad = n_pad - n
-        xp = np.concatenate([xn, np.zeros((pad, d), np.float32)])
-        yp = np.concatenate([y_idx.astype(np.int32), np.zeros(pad, np.int32)])
-        wp = np.concatenate([np.ones(n, np.float32), np.zeros(pad, np.float32)])
-
-        # stage batches on device: [n_batches, batch, ...] sharded over data axis
-        def stage(a):
-            a = a.reshape(n_batches, global_batch, *a.shape[1:])
-            return jax.device_put(a, ctx.sharding(None, ctx.data_axis))
-
-        xb, yb, wb = stage(xp), stage(yp), stage(wp)
 
         dims = [d, *cfg.hidden_dims, n_classes]
         params = ctx.replicate(_init_params(jax.random.key(cfg.seed), dims))
@@ -117,8 +152,10 @@ class MLPClassifier:
             losses = optax.softmax_cross_entropy_with_integer_labels(logits, by)
             return jnp.sum(losses * bw) / jnp.maximum(jnp.sum(bw), 1.0)
 
+        # batches are jit ARGUMENTS, not closure captures: captured arrays
+        # bake in as constants, which fails for multi-process global arrays
         @partial(jax.jit, donate_argnums=(0, 1))
-        def train_epoch(p, o):
+        def train_epoch(p, o, xb, yb, wb):
             def step(carry, batch):
                 p, o = carry
                 bx, by, bw = batch
@@ -132,7 +169,7 @@ class MLPClassifier:
 
         loss = np.inf
         for _ in range(cfg.epochs):
-            params, opt_state, loss = train_epoch(params, opt_state)
+            params, opt_state, loss = train_epoch(params, opt_state, xb, yb, wb)
             loss.block_until_ready()  # see two_tower.py: CPU collective-deadlock guard
         final_loss = float(loss)
 

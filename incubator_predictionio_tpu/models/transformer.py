@@ -133,9 +133,20 @@ class TransformerRecommender:
             return False
         return "seq" in ctx.mesh.shape and ctx.axis_size("seq") > 1
 
-    def fit(self, ctx: MeshContext, sequences: np.ndarray, item_map) -> "TransformerModel":
+    def fit(
+        self,
+        ctx: MeshContext,
+        sequences: np.ndarray,
+        item_map,
+        rows_are_local: bool = False,
+    ) -> "TransformerModel":
         """sequences: [N, max_len+1] int32 token rows (0-padded *left*), each
-        row a session; position t predicts position t+1."""
+        row a session; position t predicts position t+1.
+
+        ``rows_are_local=True``: the rows are only THIS process's session
+        shard (sessions are user-entity-sharded, tokens already global);
+        batches are joined via per-process input feeding
+        (parallel/staging.py) — host memory is data/P per process."""
         cfg = self.config
         use_ring = self._use_ring(ctx)
         tokens = sequences[:, :-1]
@@ -146,21 +157,45 @@ class TransformerRecommender:
             raise ValueError(f"sequences must be max_len+1 = {cfg.max_len + 1} wide")
         positions = np.broadcast_to(np.arange(l, dtype=np.int32), (n, l))
 
-        global_batch = ctx.pad_to_batch_multiple(min(cfg.batch_size, max(n, 1)))
-        n_batches = max(1, (n + global_batch - 1) // global_batch)
-        n_pad = n_batches * global_batch
-        pad = n_pad - n
+        if rows_are_local and ctx.process_count > 1:
+            if use_ring:
+                # sequence-parallel staging needs every process to hold the
+                # full sequence dim; dp×sp with per-process rows would need a
+                # 2-level make_global_array — dp-only is the launch topology
+                raise ValueError(
+                    "rows_are_local training does not compose with ring "
+                    "(sequence-parallel) attention; use attention='local'")
+            from incubator_predictionio_tpu.parallel.staging import (
+                stage_sharded_batches,
+            )
 
-        def stage(a, fill=0):
-            a = np.concatenate([a, np.full((pad, *a.shape[1:]), fill, a.dtype)])
-            a = a.reshape(n_batches, global_batch, *a.shape[1:])
-            seq_axis = "seq" if use_ring else None
-            return ctx.put(a, None, ctx.data_axis, seq_axis)
+            (tb, pb, yb, wb), w_pad, _ = stage_sharded_batches(
+                ctx,
+                (tokens.astype(np.int32),
+                 np.ascontiguousarray(positions, np.int32),
+                 targets.astype(np.int32),
+                 weights.astype(np.float32)),
+                cfg.batch_size, cfg.seed,
+            )
+            # padding rows were resampled from real rows: zero their loss
+            # weight via the staging weight column
+            wb = wb * w_pad[..., None]
+        else:
+            global_batch = ctx.pad_to_batch_multiple(min(cfg.batch_size, max(n, 1)))
+            n_batches = max(1, (n + global_batch - 1) // global_batch)
+            n_pad = n_batches * global_batch
+            pad = n_pad - n
 
-        tb = stage(tokens.astype(np.int32))
-        pb = stage(positions.astype(np.int32))
-        yb = stage(targets.astype(np.int32))
-        wb = stage(weights.astype(np.float32))
+            def stage(a, fill=0):
+                a = np.concatenate([a, np.full((pad, *a.shape[1:]), fill, a.dtype)])
+                a = a.reshape(n_batches, global_batch, *a.shape[1:])
+                seq_axis = "seq" if use_ring else None
+                return ctx.put(a, None, ctx.data_axis, seq_axis)
+
+            tb = stage(tokens.astype(np.int32))
+            pb = stage(positions.astype(np.int32))
+            yb = stage(targets.astype(np.int32))
+            wb = stage(weights.astype(np.float32))
 
         params = ctx.replicate(
             jax.tree.map(np.asarray, _init_params(jax.random.key(cfg.seed), cfg))
@@ -175,8 +210,11 @@ class TransformerRecommender:
             ls = optax.softmax_cross_entropy_with_integer_labels(logits, by)
             return jnp.sum(ls * bw) / jnp.maximum(jnp.sum(bw), 1.0)
 
+        # staged batches are jit ARGUMENTS, not closure captures: captured
+        # arrays bake in as trace constants, which fails for multi-process
+        # global arrays (non-addressable shards)
         @partial(jax.jit, static_argnames=("n_epochs",), donate_argnums=(0, 1))
-        def train_epochs(p, o, n_epochs):
+        def train_epochs(p, o, tb, pb, yb, wb, n_epochs):
             def step(carry, batch):
                 p, o = carry
                 loss, grads = jax.value_and_grad(loss_fn)(p, *batch)
@@ -197,7 +235,7 @@ class TransformerRecommender:
         params, opt_state, loss = checkpointed_epochs(
             cfg.checkpoint_dir, cfg.checkpoint_every, cfg.checkpoint_keep,
             cfg.epochs, params, opt_state, ctx.mesh,
-            train_epochs,
+            lambda p, o, n: train_epochs(p, o, tb, pb, yb, wb, n),
         )
 
         model = TransformerModel(ctx.host_gather(params), item_map, cfg)

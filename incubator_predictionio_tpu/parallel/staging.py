@@ -1,0 +1,82 @@
+"""Per-process input staging for entity-sharded training rows.
+
+The generic form of the sharded input path every model trainer shares: this
+process holds ``n_local`` rows (its entity shard, indices already global);
+batches are assembled per process and joined into global ``[n_batches, B,
+...]`` arrays via ``jax.make_array_from_process_local_data``
+(MeshContext.put_local_batches) — host memory per process is data/P instead
+of a full replica. Reference counterpart: RDD partition → executor feeding
+(PEvents.scala:38); design per "How to Scale Your Model"'s
+per-host-input-feeding recipe.
+
+Rows are shuffled per process and padded (by resampling local rows) to a
+whole number of equal local batches; a weight column zeroes the padding's
+loss contribution so resampled rows don't bias the objective.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from incubator_predictionio_tpu.parallel.mesh import MeshContext
+
+
+def stage_sharded_batches(
+    ctx: MeshContext,
+    arrays: Sequence[np.ndarray],
+    batch_size: int,
+    seed: int,
+    n_global: Optional[int] = None,
+):
+    """Stage this process's rows into globally-sharded device batches.
+
+    ``arrays``: equal-length ``[n_local, ...]`` host arrays (one shard's
+    rows). Returns ``(staged, weights, n_global)`` where ``staged`` is a
+    tuple of ``[n_batches, B_global, ...]`` device arrays sharded over the
+    data axis, ``weights`` the matching ``[n_batches, B_global]`` 0/1 array,
+    and ``n_global`` the job-wide row count. Collective: all processes must
+    call with the same ``batch_size``/``seed``.
+    """
+    n_local = len(arrays[0])
+    for a in arrays:
+        if len(a) != n_local:
+            raise ValueError("staged arrays must share the leading dim")
+    if n_global is None:
+        from incubator_predictionio_tpu.data.sharded import global_row_count
+
+        n_global = global_row_count(ctx, n_local)
+    procs = ctx.process_count
+    global_batch = ctx.pad_to_batch_multiple(min(batch_size, max(n_global, 1)))
+    if global_batch % procs:
+        raise ValueError(
+            f"global batch {global_batch} not divisible by {procs} processes")
+    b_local = global_batch // procs
+    # every process needs the same n_batches: size for the largest shard
+    max_local = int(max(ctx.allgather_obj(n_local)))
+    n_batches = max(1, (max_local + b_local - 1) // b_local)
+    n_pad = n_batches * b_local
+    rng = np.random.default_rng(seed + ctx.process_index)
+    if n_local:
+        order = np.concatenate([
+            rng.permutation(n_local),
+            rng.integers(0, n_local, n_pad - n_local),
+        ])
+        arrays = [np.asarray(a) for a in arrays]
+    else:
+        # all-padding shard: one zero row, all weights zero
+        order = np.zeros(n_pad, np.int64)
+        arrays = [np.zeros((1, *np.asarray(a).shape[1:]),
+                           np.asarray(a).dtype) for a in arrays]
+    w = np.concatenate([
+        np.ones(n_local, np.float32),
+        np.zeros(n_pad - n_local, np.float32),
+    ])
+    staged = tuple(
+        ctx.put_local_batches(
+            a[order].reshape(n_batches, b_local, *a.shape[1:]))
+        for a in arrays
+    )
+    weights = ctx.put_local_batches(w.reshape(n_batches, b_local))
+    return staged, weights, n_global

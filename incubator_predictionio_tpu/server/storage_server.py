@@ -113,8 +113,13 @@ class StorageServer:
         return app
 
     def _authorized(self, request: web.Request) -> bool:
+        import hmac
+
         key = self.config.server_access_key
-        return not key or request.headers.get("X-PIO-Storage-Key") == key
+        if not key:
+            return True
+        return hmac.compare_digest(
+            request.headers.get("X-PIO-Storage-Key", ""), key)
 
     async def handle_status(self, request: web.Request) -> web.Response:
         return web.json_response({"status": "alive", "service": "storage"})
@@ -380,6 +385,8 @@ def _events_aggregate(s: Storage, a: dict):
         start_time=dec_dt(a.get("start_time")),
         until_time=dec_dt(a.get("until_time")),
         required=a.get("required"),
+        n_shards=a.get("n_shards"),
+        shard_index=a.get("shard_index", 0),
     )
     return {
         k: {"fields": v.to_dict(),

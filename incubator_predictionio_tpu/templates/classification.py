@@ -19,6 +19,7 @@ are produced the reference way (readEval) using deterministic hashing.
 from __future__ import annotations
 
 import dataclasses
+import logging
 from collections import Counter
 from typing import Optional, Sequence
 
@@ -43,6 +44,9 @@ from incubator_predictionio_tpu.models.mlp import MLPClassifier, MLPConfig, MLPM
 from incubator_predictionio_tpu.parallel.mesh import MeshContext
 
 
+logger = logging.getLogger(__name__)
+
+
 # -- data source ------------------------------------------------------------
 
 @dataclasses.dataclass(frozen=True)
@@ -59,9 +63,14 @@ class TrainingData(SanityCheck):
 
     x: np.ndarray  # [n, d] float32
     y: np.ndarray  # [n] labels (original values)
+    # multi-process sharded read: rows are THIS process's entity shard only
+    rows_are_local: bool = False
+    n_rows_global: Optional[int] = None
 
     def sanity_check(self) -> None:
-        if len(self.x) == 0:
+        total = (self.n_rows_global if self.n_rows_global is not None
+                 else len(self.x))
+        if total == 0:
             raise ValueError("TrainingData is empty (no labeled entities found)")
         if not np.isfinite(self.x).all():
             raise ValueError("TrainingData contains non-finite features")
@@ -85,11 +94,14 @@ class DataSource(PDataSource):
         super().__init__(params)
         self._store = PEventStore()
 
-    def _read(self) -> TrainingData:
+    def _read(self, n_shards: Optional[int] = None,
+              shard_index: int = 0) -> TrainingData:
         props = self._store.aggregate_properties(
             self.params.app_name,
             "user",
             required=[*self.params.attrs, self.params.label],
+            n_shards=n_shards,
+            shard_index=shard_index,
         )
         xs, ys = [], []
         for pm in props.values():
@@ -101,7 +113,24 @@ class DataSource(PDataSource):
         )
 
     def read_training(self, ctx: MeshContext) -> TrainingData:
+        if ctx.process_count > 1:
+            return self._read_sharded(ctx)
         return self._read()
+
+    def _read_sharded(self, ctx: MeshContext) -> TrainingData:
+        """Per-process entity-disjoint aggregate: each process folds $set
+        events for 1/P of the users (property snapshots are per-entity, so a
+        shard's fold is exact; reference: RDD partition reads)."""
+        from incubator_predictionio_tpu.data.sharded import global_row_count
+
+        td = self._read(n_shards=ctx.process_count,
+                        shard_index=ctx.process_index)
+        n_global = global_row_count(ctx, len(td.x))
+        logger.info(
+            "sharded read: %d of %d rows (shard %d/%d)",
+            len(td.x), n_global, ctx.process_index, ctx.process_count)
+        return TrainingData(td.x, td.y, rows_are_local=True,
+                            n_rows_global=n_global)
 
     def read_eval(self, ctx: MeshContext):
         """k-fold split by stable row hash (reference readEval pattern)."""
@@ -152,7 +181,8 @@ class MLPAlgorithm(P2LAlgorithm):
         )
 
     def train(self, ctx: MeshContext, pd: TrainingData) -> MLPModel:
-        return MLPClassifier(self._config()).fit(ctx, pd.x, pd.y)
+        return MLPClassifier(self._config()).fit(
+            ctx, pd.x, pd.y, rows_are_local=pd.rows_are_local)
 
     def predict(self, model: MLPModel, query: Query) -> PredictedResult:
         x = np.asarray([query.features], np.float32)
@@ -223,6 +253,8 @@ class NaiveBayesAlgorithm(P2LAlgorithm):
     query_cls = Query
 
     def train(self, ctx: MeshContext, pd: TrainingData) -> NaiveBayesModel:
+        if pd.rows_are_local and ctx.process_count > 1:
+            return self._train_sharded(ctx, pd)
         classes, y_idx = np.unique(pd.y, return_inverse=True)
         means, variances, log_priors = _nb_fit(
             jnp.asarray(pd.x), jnp.asarray(y_idx.astype(np.int32)),
@@ -233,6 +265,39 @@ class NaiveBayesAlgorithm(P2LAlgorithm):
             means=np.asarray(means),
             variances=np.asarray(variances),
             log_priors=np.asarray(log_priors),
+        )
+
+    def _train_sharded(self, ctx: MeshContext, pd: TrainingData) -> NaiveBayesModel:
+        """Closed-form fit from globally-summed per-class moments: two passes
+        (means first, then squared deviations against the global means) so the
+        E[x²]−E[x]² cancellation the single-process fit avoids stays avoided."""
+        from incubator_predictionio_tpu.data.sharded import (
+            global_sum,
+            union_label_set,
+        )
+
+        classes = np.asarray(union_label_set(ctx, pd.y.tolist()))
+        cls_index = {c: i for i, c in enumerate(classes.tolist())}
+        y_idx = np.asarray([cls_index[v] for v in pd.y.tolist()], np.int64)
+        c, d = len(classes), pd.x.shape[1] if pd.x.ndim == 2 else 0
+        counts = np.zeros(c, np.float64)
+        np.add.at(counts, y_idx, 1.0)
+        sx = np.zeros((c, d), np.float64)
+        np.add.at(sx, y_idx, pd.x.astype(np.float64))
+        counts, sx = global_sum(ctx, (counts, sx))
+        means = sx / np.maximum(counts[:, None], 1.0)
+        dev = pd.x.astype(np.float64) - means[y_idx]
+        ssd = np.zeros((c, d), np.float64)
+        np.add.at(ssd, y_idx, dev * dev)
+        ssd = global_sum(ctx, ssd)
+        variances = np.maximum(
+            ssd / np.maximum(counts[:, None], 1.0), self.params.var_smoothing)
+        log_priors = np.log(counts / counts.sum())
+        return NaiveBayesModel(
+            classes=classes,
+            means=means.astype(np.float32),
+            variances=variances.astype(np.float32),
+            log_priors=log_priors.astype(np.float32),
         )
 
     def _scores(self, model: NaiveBayesModel, x: np.ndarray) -> np.ndarray:

@@ -79,12 +79,18 @@ class TrainingData(SanityCheck):
     u_idx: np.ndarray       # [n] interaction user idx (views + buys)
     i_idx: np.ndarray       # [n] interaction item idx
     weight: np.ndarray      # [n] 1.0 view / buy_weight buy
-    buy_counts: np.ndarray  # [n_items] popularity
+    buy_counts: np.ndarray  # [n_items] popularity (always global)
+    # multi-process sharded read: interaction rows are THIS process's user
+    # shard only (BiMaps/indices/buy_counts are global)
+    rows_are_local: bool = False
+    n_rows_global: Optional[int] = None
 
     def sanity_check(self) -> None:
         if len(self.items) == 0:
             raise ValueError("no items found ($set events on entityType 'item')")
-        if len(self.u_idx) == 0:
+        total = (self.n_rows_global if self.n_rows_global is not None
+                 else len(self.u_idx))
+        if total == 0:
             raise ValueError("no view/buy events found")
 
 
@@ -97,6 +103,8 @@ class DataSource(PDataSource):
 
     def read_training(self, ctx: MeshContext) -> TrainingData:
         app = self.params.app_name
+        procs, pid = ctx.process_count, ctx.process_index
+        sharded = procs > 1
         item_props = self._store.aggregate_properties(app, "item")
         items = BiMap.string_int(item_props.keys())
         categories = {
@@ -105,11 +113,17 @@ class DataSource(PDataSource):
         inter_u, inter_i, weight = [], [], []
         buy_counts = np.zeros(len(items), np.int64)
         user_ids = set()
-        for e in self._store.find(
-            app, entity_type="user", event_names=("view", "buy"),
-            target_entity_type="item",
-        ):
-            if e.target_entity_id not in items:
+        if sharded:
+            # per-process entity-disjoint slice (reference: RDD partitions)
+            events = self._store.find_sharded(
+                app, procs, entity_type="user", event_names=("view", "buy"))[pid]
+        else:
+            events = self._store.find(
+                app, entity_type="user", event_names=("view", "buy"),
+                target_entity_type="item",
+            )
+        for e in events:
+            if e.target_entity_type != "item" or e.target_entity_id not in items:
                 continue
             user_ids.add(e.entity_id)
             inter_u.append(e.entity_id)
@@ -117,6 +131,20 @@ class DataSource(PDataSource):
             weight.append(1.0 if e.event == "view" else 2.0)
             if e.event == "buy":
                 buy_counts[items[e.target_entity_id]] += 1
+        n_rows_global = None
+        if sharded:
+            from incubator_predictionio_tpu.data.sharded import (
+                global_row_count,
+                global_sum,
+                union_label_set,
+            )
+
+            user_ids = set(union_label_set(ctx, user_ids))
+            buy_counts = global_sum(ctx, buy_counts)  # popularity is global
+            n_rows_global = global_row_count(ctx, len(inter_u))
+            logger.info(
+                "sharded read: %d of %d rows (shard %d/%d)",
+                len(inter_u), n_rows_global, pid, procs)
         users = BiMap.string_int(sorted(user_ids))  # sorted: set order is hash-seed dependent
         return TrainingData(
             users=users,
@@ -126,6 +154,8 @@ class DataSource(PDataSource):
             i_idx=items.lookup_array(inter_i),
             weight=np.asarray(weight, np.float32),
             buy_counts=buy_counts,
+            rows_are_local=sharded,
+            n_rows_global=n_rows_global,
         )
 
 
@@ -180,7 +210,8 @@ class ECommAlgorithm(PAlgorithm):
         mf = TwoTowerMF(TwoTowerConfig(
             rank=p.rank, epochs=p.num_iterations, learning_rate=p.learning_rate,
             batch_size=8192, seed=p.seed if p.seed is not None else 0,
-        )).fit(ctx, users, items, ratings, len(pd.users), len(pd.items))
+        )).fit(ctx, users, items, ratings, len(pd.users), len(pd.items),
+               rows_are_local=pd.rows_are_local)
         norm = mf.item_emb / (np.linalg.norm(mf.item_emb, axis=1, keepdims=True) + 1e-9)
         return ECommModel(
             mf=mf,

@@ -146,6 +146,12 @@ class DataSource(PDataSource):
         ids cross shards, so the global item vocabulary is the deterministic
         first-seen union over shards in process order (one metadata
         allgather — vocab-sized, never event-sized)."""
+        from incubator_predictionio_tpu.data.sharded import (
+            concat_vocab,
+            global_row_count,
+            union_vocab,
+        )
+
         procs, pid = ctx.process_count, ctx.process_index
         uv, iv, ui, ii, vals = self._store.assemble_triples(
             self.params.app_name,
@@ -158,20 +164,9 @@ class DataSource(PDataSource):
             n_shards=procs,
             shard_index=pid,
         )
-        meta = ctx.allgather_obj({
-            "users": uv.tolist(), "items": iv.tolist(), "n_rows": len(vals),
-        })
-        user_offset = sum(len(m["users"]) for m in meta[:pid])
-        user_vocab = np.asarray(
-            [u for m in meta for u in m["users"]], object)
-        item_global: dict[str, int] = {}
-        for m in meta:
-            for it in m["items"]:
-                item_global.setdefault(it, len(item_global))
-        item_vocab = np.asarray(list(item_global), object)
-        item_remap = np.asarray(
-            [item_global[it] for it in iv], np.int32)
-        n_rows_global = sum(m["n_rows"] for m in meta)
+        user_vocab, user_offset = concat_vocab(ctx, uv)
+        item_vocab, item_remap = union_vocab(ctx, iv)
+        n_rows_global = global_row_count(ctx, len(vals))
         logger.info(
             "sharded read: %d of %d rows (shard %d/%d), %d local users, "
             "%d global users, %d global items",
@@ -190,10 +185,17 @@ class DataSource(PDataSource):
         held-out fold becomes (Query(user, num=k-ish), ActualResult(ratings)).
         Each fold's TrainingData is re-indexed against the fold's own vocab so
         held-out-only users stay unknown at predict time (the reference builds
-        its BiMaps per fold from train data only)."""
+        its BiMaps per fold from train data only).
+
+        Multi-process: each process reads its entity shard, fold membership is
+        a stable hash of the (user, item) pair (no coordination), fold train
+        rows stay local (``rows_are_local``), and the (small) held-out QA
+        pairs are allgathered so every process evaluates the same query set."""
         k = self.params.eval_k
         if not k:
             return []
+        if ctx.process_count > 1:
+            return self._read_eval_sharded(ctx, k)
         td = self._read()
         n = len(td.ratings)
         rng = np.random.default_rng(self.params.seed)
@@ -203,16 +205,72 @@ class DataSource(PDataSource):
             train_mask = fold_of != fold
             test_mask = ~train_mask
             train = _subset(td, train_mask)
-            # group held-out positives per user
-            per_user: dict[str, list[tuple[str, float]]] = {}
-            for u, i, r in zip(td.user_vocab[td.user_idx[test_mask]],
-                               td.item_vocab[td.item_idx[test_mask]],
-                               td.ratings[test_mask]):
-                per_user.setdefault(u, []).append((i, float(r)))
+            qa = self._fold_qa(td, test_mask)
+            folds.append((train, {"fold": fold}, qa))
+        return folds
+
+    def _fold_qa(self, td: TrainingData, test_mask: np.ndarray):
+        """Held-out positives grouped per user → (Query, ActualResult) pairs."""
+        per_user: dict[str, list[tuple[str, float]]] = {}
+        for u, i, r in zip(td.user_vocab[td.user_idx[test_mask]],
+                           td.item_vocab[td.item_idx[test_mask]],
+                           td.ratings[test_mask]):
+            per_user.setdefault(u, []).append((i, float(r)))
+        return [
+            (Query(user=u, num=self.params.eval_queries_per_fold),
+             ActualResult(tuple(ItemRating(i, r) for i, r in pairs)))
+            for u, pairs in per_user.items()
+        ]
+
+    def _read_eval_sharded(self, ctx: MeshContext, k: int):
+        import zlib
+
+        from incubator_predictionio_tpu.data.sharded import (
+            concat_vocab,
+            global_row_count,
+            union_vocab,
+        )
+
+        td = self._read_sharded(ctx)  # local rows, global vocabularies
+        u_str = td.user_vocab[td.user_idx]
+        i_str = td.item_vocab[td.item_idx]
+        fold_of = np.asarray([
+            zlib.crc32(f"{self.params.seed}|{u}|{i}".encode()) % k
+            for u, i in zip(u_str, i_str)
+        ], np.int64) if len(u_str) else np.zeros(0, np.int64)
+        folds = []
+        for fold in range(k):
+            train_mask = fold_of != fold
+            test_mask = ~train_mask
+            # fold-local vocabularies: users are entity-disjoint → concat;
+            # items cross shards → union (collective, vocab-sized)
+            keep_u = np.unique(td.user_idx[train_mask])
+            keep_i = np.unique(td.item_idx[train_mask])
+            user_vocab, user_offset = concat_vocab(
+                ctx, td.user_vocab[keep_u])
+            item_vocab, item_remap = union_vocab(ctx, td.item_vocab[keep_i])
+            remap_u = np.full(len(td.user_vocab), -1, np.int32)
+            remap_u[keep_u] = user_offset + np.arange(len(keep_u), dtype=np.int32)
+            remap_i = np.full(len(td.item_vocab), -1, np.int32)
+            remap_i[keep_i] = item_remap
+            n_global = global_row_count(ctx, int(train_mask.sum()))
+            train = TrainingData(
+                remap_u[td.user_idx[train_mask]],
+                remap_i[td.item_idx[train_mask]],
+                td.ratings[train_mask],
+                user_vocab, item_vocab,
+                rows_are_local=True, n_rows_global=n_global,
+            )
+            # every process evaluates the full query set (identical model on
+            # every process; metrics agree without a reduce)
+            local_qa = self._fold_qa(td, test_mask)
+            parts = ctx.allgather_obj(
+                [(q.user, q.num, [(ir.item, ir.rating) for ir in a.ratings])
+                 for q, a in local_qa])
             qa = [
-                (Query(user=u, num=self.params.eval_queries_per_fold),
+                (Query(user=u, num=num),
                  ActualResult(tuple(ItemRating(i, r) for i, r in pairs)))
-                for u, pairs in per_user.items()
+                for part in parts for u, num, pairs in part
             ]
             folds.append((train, {"fold": fold}, qa))
         return folds

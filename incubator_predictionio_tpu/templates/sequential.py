@@ -72,9 +72,15 @@ class DataSourceParams(Params):
 class TrainingData(SanityCheck):
     sequences: np.ndarray  # [n, max_len+1] int32 tokens, 0-padded left
     item_map: BiMap        # item id → token (1-based; 0 = padding)
+    # multi-process sharded read: sequences are THIS process's user shard
+    # only (sessions never cross shards; item_map/tokens are global)
+    rows_are_local: bool = False
+    n_rows_global: Optional[int] = None
 
     def sanity_check(self) -> None:
-        if len(self.sequences) == 0:
+        total = (self.n_rows_global if self.n_rows_global is not None
+                 else len(self.sequences))
+        if total == 0:
             raise ValueError("no sessions found")
 
 
@@ -96,16 +102,39 @@ class DataSource(PDataSource):
 
     def read_training(self, ctx: MeshContext) -> TrainingData:
         p = self.params
+        procs, pid = ctx.process_count, ctx.process_index
+        sharded = procs > 1
         sessions: dict[str, list[str]] = {}
         item_ids: list[str] = []
-        for e in self._store.find(
-            p.app_name, entity_type="user", event_names=tuple(p.events),
-            target_entity_type="item",
-        ):  # find() is event-time ordered
+        if sharded:
+            # sessions are per-user, users are entity-sharded → each process
+            # reads whole sessions for 1/P of the users (never splits one)
+            events = self._store.find_sharded(
+                p.app_name, procs, entity_type="user",
+                event_names=tuple(p.events))[pid]
+        else:
+            events = self._store.find(
+                p.app_name, entity_type="user", event_names=tuple(p.events),
+                target_entity_type="item",
+            )
+        for e in events:  # find() is event-time ordered
+            if e.target_entity_type != "item":
+                continue
             sessions.setdefault(e.entity_id, []).append(e.target_entity_id)
             item_ids.append(e.target_entity_id)
         # token 0 reserved for padding → 1-based item tokens
         base = BiMap.string_int(item_ids)
+        n_rows_global = None
+        if sharded:
+            from incubator_predictionio_tpu.data.sharded import (
+                global_row_count,
+                union_vocab,
+            )
+
+            # global token space: first-seen union over shards in process
+            # order (one vocab-sized allgather)
+            vocab, _ = union_vocab(ctx, list(base))
+            base = BiMap({v: i for i, v in enumerate(vocab.tolist())})
         item_map = BiMap({k: v + 1 for k, v in base.items()})
         width = p.max_len + 1
         rows = [
@@ -113,9 +142,16 @@ class DataSource(PDataSource):
             for items in sessions.values()
             if len(items) >= 2
         ]
+        if sharded:
+            n_rows_global = global_row_count(ctx, len(rows))
+            logger.info(
+                "sharded read: %d of %d rows (shard %d/%d)",
+                len(rows), n_rows_global, pid, procs)
         return TrainingData(
             sequences=np.stack(rows) if rows else np.zeros((0, width), np.int32),
             item_map=item_map,
+            rows_are_local=sharded,
+            n_rows_global=n_rows_global,
         )
 
 
@@ -160,7 +196,9 @@ class TransformerAlgorithm(PAlgorithm):
             checkpoint_dir=p.checkpoint_dir,
             checkpoint_every=p.checkpoint_every,
         )
-        return TransformerRecommender(cfg).fit(ctx, pd.sequences, pd.item_map)
+        return TransformerRecommender(cfg).fit(
+            ctx, pd.sequences, pd.item_map,
+            rows_are_local=pd.rows_are_local)
 
     def _history(self, query: Query, model: TransformerModel) -> list[str]:
         if query.recent_items is not None:

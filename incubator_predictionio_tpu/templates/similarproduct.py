@@ -85,11 +85,20 @@ class TrainingData(SanityCheck):
     like_u: np.ndarray                 # [n_likes] user idx
     like_i: np.ndarray                 # [n_likes] item idx
     like_sign: np.ndarray              # [n_likes] +1 like / -1 dislike
+    # multi-process sharded read: event rows are THIS process's user shard
+    # only (BiMaps and indices are global); *_global are job-wide counts
+    rows_are_local: bool = False
+    n_views_global: Optional[int] = None
+    n_likes_global: Optional[int] = None
 
     def sanity_check(self) -> None:
         if len(self.items) == 0:
             raise ValueError("no items found ($set events on entityType 'item')")
-        if len(self.view_u) == 0 and len(self.like_u) == 0:
+        n_views = (self.n_views_global if self.n_views_global is not None
+                   else len(self.view_u))
+        n_likes = (self.n_likes_global if self.n_likes_global is not None
+                   else len(self.like_u))
+        if n_views == 0 and n_likes == 0:
             raise ValueError("no view/like events found")
 
 
@@ -102,7 +111,11 @@ class DataSource(PDataSource):
 
     def read_training(self, ctx: MeshContext) -> TrainingData:
         app = self.params.app_name
-        # item properties → catalog + categories (DataSource.scala itemsRDD)
+        procs, pid = ctx.process_count, ctx.process_index
+        sharded = procs > 1
+        # item properties → catalog + categories (DataSource.scala itemsRDD);
+        # catalog reads stay replicated — vocabulary-sized, every process
+        # needs the full id space anyway
         item_props = self._store.aggregate_properties(app, "item")
         items = BiMap.string_int(item_props.keys())
         categories = {
@@ -110,12 +123,22 @@ class DataSource(PDataSource):
         }
         user_props = self._store.aggregate_properties(app, "user")
         view_events, like_u, like_i, like_sign = [], [], [], []
-        user_ids = set(user_props.keys())
-        for e in self._store.find(
-            app, entity_type="user", event_names=("view", "like", "dislike"),
-            target_entity_type="item",
-        ):
-            user_ids.add(e.entity_id)
+        local_users: set[str] = set()
+        if sharded:
+            # per-process entity-disjoint slice of the event stream
+            events = self._store.find_sharded(
+                app, procs, entity_type="user",
+                event_names=("view", "like", "dislike"))[pid]
+        else:
+            events = self._store.find(
+                app, entity_type="user",
+                event_names=("view", "like", "dislike"),
+                target_entity_type="item",
+            )
+        for e in events:
+            if e.target_entity_type != "item":
+                continue
+            local_users.add(e.entity_id)
             if e.target_entity_id not in items:
                 continue  # events referencing unknown items are dropped
             if e.event == "view":
@@ -124,6 +147,25 @@ class DataSource(PDataSource):
                 like_u.append(e.entity_id)
                 like_i.append(e.target_entity_id)
                 like_sign.append(1.0 if e.event == "like" else -1.0)
+        user_ids = set(user_props.keys())
+        n_views_global = n_likes_global = None
+        if sharded:
+            from incubator_predictionio_tpu.data.sharded import (
+                global_row_count,
+                union_label_set,
+            )
+
+            # global user vocabulary: $set users (replicated read) ∪ the
+            # union of per-shard event users — one vocab-sized allgather
+            user_ids |= set(union_label_set(ctx, local_users))
+            n_views_global = global_row_count(ctx, len(view_events))
+            n_likes_global = global_row_count(ctx, len(like_u))
+            logger.info(
+                "sharded read: %d of %d rows (shard %d/%d)",
+                len(view_events) + len(like_u),
+                n_views_global + n_likes_global, pid, procs)
+        else:
+            user_ids |= local_users
         users = BiMap.string_int(sorted(user_ids))  # sorted: set order is hash-seed dependent
         view_u = users.lookup_array([u for u, _ in view_events])
         view_i = items.lookup_array([i for _, i in view_events])
@@ -136,6 +178,9 @@ class DataSource(PDataSource):
             like_u=users.lookup_array(like_u),
             like_i=items.lookup_array(like_i),
             like_sign=np.asarray(like_sign, np.float32),
+            rows_are_local=sharded,
+            n_views_global=n_views_global,
+            n_likes_global=n_likes_global,
         )
 
 
@@ -251,7 +296,8 @@ class ALSAlgorithm(PAlgorithm):
         mf = TwoTowerMF(TwoTowerConfig(
             rank=p.rank, epochs=p.num_iterations, learning_rate=p.learning_rate,
             batch_size=8192, seed=p.seed if p.seed is not None else 0,
-        )).fit(ctx, users, items, ratings, len(pd.users), len(pd.items))
+        )).fit(ctx, users, items, ratings, len(pd.users), len(pd.items),
+               rows_are_local=pd.rows_are_local)
         return ItemSimModel(
             item_vecs=_l2_normalize(mf.item_emb),
             item_map=pd.items,
@@ -272,13 +318,16 @@ class LikeAlgorithm(ALSAlgorithm):
 
     def train(self, ctx: MeshContext, pd: TrainingData) -> ItemSimModel:
         p = self.params
-        if len(pd.like_u) == 0:
+        n_likes = (pd.n_likes_global if pd.n_likes_global is not None
+                   else len(pd.like_u))
+        if n_likes == 0:
             raise ValueError("LikeAlgorithm requires like/dislike events")
         mf = TwoTowerMF(TwoTowerConfig(
             rank=p.rank, epochs=p.num_iterations, learning_rate=p.learning_rate,
             batch_size=8192, seed=p.seed if p.seed is not None else 0,
         )).fit(ctx, pd.like_u, pd.like_i, pd.like_sign,
-               len(pd.users), len(pd.items))
+               len(pd.users), len(pd.items),
+               rows_are_local=pd.rows_are_local)
         return ItemSimModel(
             item_vecs=_l2_normalize(mf.item_emb),
             item_map=pd.items,
@@ -311,6 +360,12 @@ class CooccurrenceAlgorithm(PAlgorithm):
         u = np.zeros((n_users, n_items), np.float32)
         u[pd.view_u, pd.view_i] = 1.0  # de-duplicated views
         cooc = np.array(_cooccur(jnp.asarray(u)))  # copy: jax buffers are read-only
+        if pd.rows_are_local:
+            # each process counted only its user shard's co-views; users are
+            # entity-disjoint, so the global count matrix is the plain sum
+            from incubator_predictionio_tpu.data.sharded import global_sum
+
+            cooc = global_sum(ctx, cooc)
         np.fill_diagonal(cooc, 0)
         top_n = self.params.n
         top: dict[int, list[tuple[int, int]]] = {}

@@ -258,13 +258,20 @@ def cmd_eval(args, storage: Storage) -> int:
         create_workflow,
     )
 
+    axes = json.loads(args.mesh_axes) if getattr(args, "mesh_axes", None) else None
     config = WorkflowConfig(
         engine_variant=args.engine_variant,
         evaluation_class=args.evaluation_class,
         engine_params_generator_class=args.engine_params_generator_class,
         batch=args.batch,
+        mesh_axes=axes,
+        distributed=getattr(args, "distributed", False),
     )
     instance_id = create_workflow(config, storage)
+    if instance_id == "<secondary>":
+        _out("Evaluation completed (secondary process; the primary wrote "
+             "the evaluation instance).")
+        return 0
     inst = storage.get_meta_data_evaluation_instances().get(instance_id)
     _out(f"Evaluation completed. Instance ID: {instance_id}")
     if inst is not None and inst.evaluator_results:
@@ -554,6 +561,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("engine_params_generator_class", nargs="?")
     p.add_argument("-v", "--engine-variant", default="engine.json")
     p.add_argument("--batch", default="")
+    p.add_argument("--mesh-axes", help='JSON, e.g. \'{"data": 4}\'')
+    p.add_argument("--distributed", action="store_true",
+                   help="join a jax.distributed job (see the launch verb)")
 
     # deploy / undeploy
     p = sub.add_parser("deploy")
@@ -660,10 +670,10 @@ def cmd_launch(args, storage: Storage) -> int:
     if not verb_args:
         _out("launch: no verb given (e.g. pio-tpu launch -n 2 train -v engine.json)")
         return 2
-    if verb_args[0] != "train":
+    if verb_args[0] not in ("train", "eval"):
         # without --distributed gating, N processes of any other verb would
         # just run N independent copies against shared storage
-        _out(f"launch: only the train verb joins a distributed job "
+        _out(f"launch: only the train/eval verbs join a distributed job "
              f"(got {verb_args[0]!r})")
         return 2
     if "--distributed" not in verb_args:
