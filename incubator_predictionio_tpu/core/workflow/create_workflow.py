@@ -52,6 +52,19 @@ class WorkflowConfig:
     stop_after_prepare: bool = False
     mesh_axes: Optional[dict[str, int]] = None  # replaces --master/spark conf
     distributed: bool = False  # join a jax.distributed job (launcher / pod)
+    # prefix-memoized tuning evals (FastEvalEngine.scala is the default
+    # machinery behind `pio eval`; --no-fast-eval opts out)
+    fast_eval: bool = True
+
+
+def _mesh_conf(config: WorkflowConfig) -> dict[str, Any]:
+    """WorkflowConfig mesh flags → the mesh_conf dict train and eval share."""
+    mesh_conf: dict[str, Any] = {}
+    if config.mesh_axes:
+        mesh_conf["axes"] = config.mesh_axes
+    if config.distributed:
+        mesh_conf["distributed"] = True
+    return mesh_conf
 
 
 def _workflow_params(config: WorkflowConfig) -> WorkflowParams:
@@ -80,11 +93,7 @@ def _run_train(config: WorkflowConfig, storage: Optional[Storage]) -> str:
     if not isinstance(engine, Engine):
         raise TypeError(f"engineFactory {factory_path} did not produce an Engine")
     engine_params = engine.engine_params_from_variant(variant)
-    mesh_conf: dict[str, Any] = {}
-    if config.mesh_axes:
-        mesh_conf["axes"] = config.mesh_axes
-    if config.distributed:
-        mesh_conf["distributed"] = True
+    mesh_conf = _mesh_conf(config)
     instance = EngineInstance(
         id="",
         status="INIT",
@@ -122,6 +131,14 @@ def _run_eval(config: WorkflowConfig, storage: Optional[Storage]) -> str:
         generator = evaluation  # reference allows Evaluation with EngineParamsGenerator mixed in
     else:
         raise ValueError("evaluation requires an EngineParamsGenerator")
+    if (config.fast_eval and evaluation.engine is not None
+            and type(evaluation.engine) is Engine):
+        # tuning evals share pipeline prefixes across variants: memoize
+        # datasource/prepare/train per distinct params prefix
+        # (FastEvalEngine.scala:46-313 is the reference's default machinery)
+        from incubator_predictionio_tpu.core.fast_eval import FastEvalEngine
+
+        evaluation.engine = FastEvalEngine.from_engine(evaluation.engine)
     instance = EvaluationInstance(
         id="",
         status="INIT",
@@ -132,12 +149,7 @@ def _run_eval(config: WorkflowConfig, storage: Optional[Storage]) -> str:
         batch=config.batch,
         env=storage_env_vars(),
     )
-    mesh_conf: dict[str, Any] = {}
-    if config.mesh_axes:
-        mesh_conf["axes"] = config.mesh_axes
-    if config.distributed:
-        mesh_conf["distributed"] = True
-    ctx = MeshContext.from_conf(mesh_conf or None)
+    ctx = MeshContext.from_conf(_mesh_conf(config) or None)
     instance_id, _ = run_evaluation(
         evaluation,
         list(generator.engine_params_list),

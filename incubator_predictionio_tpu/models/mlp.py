@@ -134,10 +134,13 @@ class MLPClassifier:
             wp = np.concatenate([np.ones(n, np.float32),
                                  np.zeros(pad, np.float32)])
 
-            # stage on device: [n_batches, batch, ...] sharded over data axis
+            # stage on device: [n_batches, batch, ...] sharded over data
+            # axis; ctx.put (not raw device_put) so replicated-rows training
+            # also works on cross-process meshes (e.g. distributed eval of
+            # folds read single-process)
             def stage(a):
                 a = a.reshape(n_batches, global_batch, *a.shape[1:])
-                return jax.device_put(a, ctx.sharding(None, ctx.data_axis))
+                return ctx.put(a, None, ctx.data_axis)
 
             xb, yb, wb = stage(xp), stage(yp), stage(wp)
         n_classes = len(classes)

@@ -266,6 +266,7 @@ def cmd_eval(args, storage: Storage) -> int:
         batch=args.batch,
         mesh_axes=axes,
         distributed=getattr(args, "distributed", False),
+        fast_eval=not getattr(args, "no_fast_eval", False),
     )
     instance_id = create_workflow(config, storage)
     if instance_id == "<secondary>":
@@ -564,6 +565,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mesh-axes", help='JSON, e.g. \'{"data": 4}\'')
     p.add_argument("--distributed", action="store_true",
                    help="join a jax.distributed job (see the launch verb)")
+    p.add_argument("--no-fast-eval", action="store_true",
+                   help="disable prefix memoization across variants "
+                        "(FastEvalEngine is the default)")
 
     # deploy / undeploy
     p = sub.add_parser("deploy")

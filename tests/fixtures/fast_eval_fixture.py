@@ -1,0 +1,8 @@
+"""Module-level Evaluation instance for the cmd-level FastEval-default test
+(loaded by class path through create_workflow, like `pio-tpu eval` does)."""
+
+from incubator_predictionio_tpu.templates.recommendation import (
+    RecommendationEvaluation,
+)
+
+EVAL = RecommendationEvaluation(app_name="fasteval-app", eval_k=2)

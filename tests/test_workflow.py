@@ -90,3 +90,50 @@ def test_run_evaluation_picks_best_variant(storage):
     assert stored.status == "EVALCOMPLETED"
     assert "ErrorMetric" in stored.evaluator_results
     assert stored.evaluator_results_json
+
+
+def test_cmd_eval_routes_through_fast_eval_by_default(storage):
+    """`pio-tpu eval` memoizes shared pipeline prefixes automatically
+    (reference FastEvalEngine.scala is the default machinery): the
+    recommendation grid's 4 variants share one datasource read and one
+    prepare — only the 4 distinct trainings run."""
+    import datetime as dtm
+
+    from incubator_predictionio_tpu.core.fast_eval import FastEvalEngine
+    from incubator_predictionio_tpu.core.workflow.create_workflow import (
+        WorkflowConfig,
+        create_workflow,
+    )
+    from incubator_predictionio_tpu.data import DataMap, Event
+    from incubator_predictionio_tpu.data.storage.base import App
+
+    import tests.fixtures.fast_eval_fixture as fixture
+
+    app_id = storage.get_meta_data_apps().insert(App(0, "fasteval-app"))
+    ev = storage.get_events()
+    ev.init(app_id)
+    t0 = dtm.datetime(2024, 1, 1, tzinfo=dtm.timezone.utc)
+    for i in range(160):
+        ev.insert(Event(
+            event="rate", entity_type="user", entity_id=f"u{i % 10}",
+            target_entity_type="item", target_entity_id=f"i{i % 8}",
+            properties=DataMap({"rating": float(1 + i % 5)}),
+            event_time=t0 + dtm.timedelta(seconds=i)), app_id)
+
+    from incubator_predictionio_tpu.data.storage import use_storage
+
+    config = WorkflowConfig(
+        evaluation_class="tests.fixtures.fast_eval_fixture.EVAL")
+    prev = use_storage(storage)  # PEventStore resolves the process singleton
+    try:
+        iid = create_workflow(config, storage)
+    finally:
+        use_storage(prev)
+    inst = storage.get_meta_data_evaluation_instances().get(iid)
+    assert inst.status == "EVALCOMPLETED"
+    # the loaded module-level instance was wrapped in place
+    assert isinstance(fixture.EVAL.engine, FastEvalEngine)
+    stats = fixture.EVAL.engine.last_cache_stats
+    # 4 variants (rank × iterations grid) → 1 read + 1 prepare (6 prefix
+    # cache hits), one training per distinct algo params
+    assert stats == {"ds": 1, "prep": 1, "algo": 4}
