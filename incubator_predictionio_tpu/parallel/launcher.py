@@ -57,6 +57,7 @@ def launch_local(
     cpu_devices_per_process: Optional[int] = None,
     env: Optional[dict[str, str]] = None,
     timeout: Optional[float] = None,
+    command: Optional[Sequence[str]] = None,
 ) -> LaunchResult:
     """Run ``pio-tpu <cli_args>`` as ``num_processes`` coordinated processes.
 
@@ -64,7 +65,9 @@ def launch_local(
     devices per process (the no-hardware test topology); leave it ``None`` on
     real accelerators, where each process claims its locally attached chips.
     Processes run concurrently and are all waited on; output is captured
-    per process.
+    per process. ``command`` replaces the default ``python -m <cli>`` argv
+    entirely (same coordination env) — used by harness dry runs that execute
+    an inline script instead of a CLI verb.
     """
     import tempfile
     import time
@@ -96,7 +99,8 @@ def launch_local(
                 f"{cpu_devices_per_process}"
             ).strip()
         procs.append(subprocess.Popen(
-            [sys.executable, "-m", CLI_MODULE, *cli_args],
+            list(command) if command is not None
+            else [sys.executable, "-m", CLI_MODULE, *cli_args],
             env=penv,
             stdout=logs[pid],
             stderr=subprocess.STDOUT,

@@ -436,10 +436,14 @@ class QueryServer:
         task.add_done_callback(self._feedback_tasks.discard)
 
     def _authorized(self, request: web.Request) -> bool:
+        import hmac
+
         key = self.config.server_access_key
         if not key:
             return True
-        return request.query.get("accessKey") == key
+        # bytes operands: compare_digest rejects non-ASCII str
+        return hmac.compare_digest(
+            request.query.get("accessKey", "").encode(), key.encode())
 
     async def handle_reload(self, request: web.Request) -> web.Response:
         if not self._authorized(request):

@@ -118,8 +118,9 @@ class StorageServer:
         key = self.config.server_access_key
         if not key:
             return True
+        # bytes operands: compare_digest rejects non-ASCII str
         return hmac.compare_digest(
-            request.headers.get("X-PIO-Storage-Key", ""), key)
+            request.headers.get("X-PIO-Storage-Key", "").encode(), key.encode())
 
     async def handle_status(self, request: web.Request) -> web.Response:
         return web.json_response({"status": "alive", "service": "storage"})

@@ -19,6 +19,9 @@ from incubator_predictionio_tpu.data.storage.registry import Storage, get_storag
 class AdminConfig:
     ip: str = "127.0.0.1"
     port: int = 7071
+    ssl_cert: Optional[str] = None  # PEM pair (common/SSLConfiguration.scala:30)
+    ssl_key: Optional[str] = None
+    server_access_key: Optional[str] = None  # KeyAuthentication.scala:28
 
 
 class AdminAPI:
@@ -81,7 +84,10 @@ class AdminAPI:
         return web.json_response({"message": f"Removed data of app {name}."})
 
     def make_app(self) -> web.Application:
-        app = web.Application()
+        from incubator_predictionio_tpu.tools.dashboard import key_auth_middleware
+
+        app = web.Application(
+            middlewares=[key_auth_middleware(self.config.server_access_key)])
         app.router.add_get("/", self.handle_root)
         app.router.add_get("/cmd/app", self.handle_app_list)
         app.router.add_post("/cmd/app", self.handle_app_new)
@@ -92,5 +98,8 @@ class AdminAPI:
 
 def serve_forever(config: AdminConfig = AdminConfig(),
                   storage: Optional[Storage] = None) -> None:
+    from incubator_predictionio_tpu.server.event_server import _ssl_context
+
     web.run_app(AdminAPI(config, storage).make_app(),
-                host=config.ip, port=config.port)
+                host=config.ip, port=config.port,
+                ssl_context=_ssl_context(config))

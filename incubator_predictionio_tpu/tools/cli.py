@@ -340,14 +340,20 @@ def cmd_batchpredict(args, storage: Storage) -> int:
 def cmd_dashboard(args, storage: Storage) -> int:
     from incubator_predictionio_tpu.tools.dashboard import DashboardConfig, serve_forever
 
-    serve_forever(DashboardConfig(ip=args.ip, port=args.port), storage)
+    serve_forever(DashboardConfig(
+        ip=args.ip, port=args.port,
+        ssl_cert=args.ssl_cert, ssl_key=args.ssl_key,
+        server_access_key=args.server_access_key), storage)
     return 0
 
 
 def cmd_adminserver(args, storage: Storage) -> int:
     from incubator_predictionio_tpu.tools.admin import AdminConfig, serve_forever
 
-    serve_forever(AdminConfig(ip=args.ip, port=args.port), storage)
+    serve_forever(AdminConfig(
+        ip=args.ip, port=args.port,
+        ssl_cert=args.ssl_cert, ssl_key=args.ssl_key,
+        server_access_key=args.server_access_key), storage)
     return 0
 
 
@@ -622,9 +628,15 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("dashboard")
     p.add_argument("--ip", default="127.0.0.1")
     p.add_argument("--port", type=int, default=9000)
+    p.add_argument("--ssl-cert")
+    p.add_argument("--ssl-key")
+    p.add_argument("--server-access-key")
     p = sub.add_parser("adminserver")
     p.add_argument("--ip", default="127.0.0.1")
     p.add_argument("--port", type=int, default=7071)
+    p.add_argument("--ssl-cert")
+    p.add_argument("--ssl-key")
+    p.add_argument("--server-access-key")
 
     # start-all / stop-all / redeploy
     p = sub.add_parser("start-all")

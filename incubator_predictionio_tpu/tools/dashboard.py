@@ -2,12 +2,15 @@
 
 Parity target: tools/dashboard/Dashboard.scala:44-160 + the twirl index page:
 an HTML index of completed EvaluationInstances (newest first) with per-
-instance evaluator results served as txt/html/json.
+instance evaluator results served as txt/html/json. TLS + key auth mirror
+the reference's SSLConfiguration.scala:30 (JKS keystore → PEM pair here) and
+KeyAuthentication.scala:28 (``accessKey`` query param).
 """
 
 from __future__ import annotations
 
 import dataclasses
+import hmac
 import html
 from typing import Optional
 
@@ -20,6 +23,27 @@ from incubator_predictionio_tpu.data.storage.registry import Storage, get_storag
 class DashboardConfig:
     ip: str = "127.0.0.1"
     port: int = 9000
+    ssl_cert: Optional[str] = None  # PEM pair (SSLConfiguration.scala:30)
+    ssl_key: Optional[str] = None
+    server_access_key: Optional[str] = None  # KeyAuthentication.scala:28
+
+
+def key_auth_middleware(server_access_key: Optional[str]):
+    """aiohttp middleware enforcing the reference's ``accessKey`` query-param
+    auth on every route (constant-time compare). No key configured = open."""
+
+    @web.middleware
+    async def check(request: web.Request, handler):
+        # bytes operands: compare_digest rejects non-ASCII str (a non-ASCII
+        # guess must 401, not 500)
+        if server_access_key and not hmac.compare_digest(
+            request.query.get("accessKey", "").encode(),
+            server_access_key.encode(),
+        ):
+            return web.json_response({"message": "Unauthorized"}, status=401)
+        return await handler(request)
+
+    return check
 
 
 class Dashboard:
@@ -67,7 +91,8 @@ class Dashboard:
                             content_type="application/json")
 
     def make_app(self) -> web.Application:
-        app = web.Application()
+        app = web.Application(
+            middlewares=[key_auth_middleware(self.config.server_access_key)])
         app.router.add_get("/", self.handle_index)
         app.router.add_get(
             "/engine_instances/{instance_id}/evaluator_results.{fmt:txt|html|json}",
@@ -78,5 +103,8 @@ class Dashboard:
 
 def serve_forever(config: DashboardConfig = DashboardConfig(),
                   storage: Optional[Storage] = None) -> None:
+    from incubator_predictionio_tpu.server.event_server import _ssl_context
+
     web.run_app(Dashboard(config, storage).make_app(),
-                host=config.ip, port=config.port)
+                host=config.ip, port=config.port,
+                ssl_context=_ssl_context(config))

@@ -146,3 +146,65 @@ def test_admin_api_app_crud():
 
     asyncio.run(run())
     storage.close()
+
+
+def test_dashboard_and_admin_tls_key_auth(tls_cert):
+    """HTTPS + accessKey auth on both operator servers (reference
+    Dashboard.scala:44-160 SSL + common/KeyAuthentication.scala:28): requests
+    without the key get 401, with the key they round-trip over TLS."""
+    import aiohttp
+    from aiohttp import web
+
+    from incubator_predictionio_tpu.server.event_server import _ssl_context
+    from incubator_predictionio_tpu.tools.admin import AdminAPI, AdminConfig
+    from incubator_predictionio_tpu.tools.dashboard import Dashboard, DashboardConfig
+
+    cert, key = tls_cert
+    storage = Storage({"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+
+    async def serve(app, config):
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0,
+                           ssl_context=_ssl_context(config))
+        await site.start()
+        return runner, runner.addresses[0][1]
+
+    async def drive():
+        dconf = DashboardConfig(ssl_cert=cert, ssl_key=key,
+                                server_access_key="dash-key")
+        aconf = AdminConfig(ssl_cert=cert, ssl_key=key,
+                            server_access_key="admin-key")
+        drunner, dport = await serve(Dashboard(dconf, storage).make_app(), dconf)
+        arunner, aport = await serve(AdminAPI(aconf, storage).make_app(), aconf)
+        try:
+            conn = aiohttp.TCPConnector(ssl=False)
+            async with aiohttp.ClientSession(connector=conn) as s:
+                # dashboard: 401 without/with-wrong key, 200 with key, https
+                r = await s.get(f"https://127.0.0.1:{dport}/")
+                assert r.status == 401
+                r = await s.get(f"https://127.0.0.1:{dport}/?accessKey=nope")
+                assert r.status == 401
+                r = await s.get(f"https://127.0.0.1:{dport}/?accessKey=dash-key")
+                assert r.status == 200
+                assert "Completed Evaluations" in await r.text()
+                # admin: same contract, and writes are gated too
+                r = await s.post(f"https://127.0.0.1:{aport}/cmd/app",
+                                 json={"name": "x"})
+                assert r.status == 401
+                r = await s.post(
+                    f"https://127.0.0.1:{aport}/cmd/app?accessKey=admin-key",
+                    json={"name": "x"})
+                assert r.status == 201
+                r = await s.get(
+                    f"https://127.0.0.1:{aport}/cmd/app?accessKey=admin-key")
+                assert r.status == 200
+                assert [a["name"] for a in await r.json()] == ["x"]
+        finally:
+            await drunner.cleanup()
+            await arunner.cleanup()
+
+    try:
+        asyncio.run(drive())
+    finally:
+        storage.close()
