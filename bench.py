@@ -389,6 +389,46 @@ def bench_sequential(ctx, peaks, device) -> dict:
 # 7. serving latency through the deployed query server (north-star p50)
 # ---------------------------------------------------------------------------
 
+#: Standalone load client (argv: base_url, duration_s, n_users). Runs in its
+#: own process with plain aiohttp — no jax, no shared event loop with the
+#: server — and prints one JSON line of client-observed stats.
+_SERVING_CLIENT_SCRIPT = """
+import asyncio, json, sys, time
+
+import aiohttp
+import numpy as np
+
+base, duration, n_users = sys.argv[1], float(sys.argv[2]), int(sys.argv[3])
+lat_ms = []
+
+async def main():
+    async with aiohttp.ClientSession() as s:
+        r = await s.post(base + "/queries.json", json={"user": "u1", "num": 10})
+        assert r.status == 200, r.status  # warmup round trip
+        stop_at = time.perf_counter() + duration
+
+        async def worker(wid):
+            rng = np.random.default_rng(wid)
+            while time.perf_counter() < stop_at:
+                q = {"user": f"u{rng.integers(0, n_users)}", "num": 10}
+                t0 = time.perf_counter()
+                r = await s.post(base + "/queries.json", json=q)
+                await r.read()
+                lat_ms.append((time.perf_counter() - t0) * 1e3)
+                assert r.status == 200, r.status
+
+        await asyncio.gather(*(worker(i) for i in range(16)))
+
+asyncio.run(main())
+a = np.sort(np.asarray(lat_ms))
+pct = lambda q: float(a[min(len(a) - 1, int(q * (len(a) - 1)))])
+print(json.dumps({
+    "p50_ms": round(pct(0.50), 2), "p95_ms": round(pct(0.95), 2),
+    "p99_ms": round(pct(0.99), 2), "qps": round(len(a) / duration, 1),
+    "count": len(a),
+}))
+"""
+
 def bench_serving(ctx) -> dict:
     """Train the recommendation template through the real workflow, deploy it
     in the real query server, and measure client-observed latency under
@@ -396,8 +436,6 @@ def bench_serving(ctx) -> dict:
     MicroBatcher → batch_predict → serve, the full CreateServer.scala:464-494
     path."""
     import datetime as dt_mod
-
-    from aiohttp.test_utils import TestClient, TestServer
 
     from incubator_predictionio_tpu.core.workflow import run_train
     from incubator_predictionio_tpu.data import DataMap, Event
@@ -450,47 +488,54 @@ def bench_serving(ctx) -> dict:
             engine_factory=variant["engineFactory"])
         run_train(engine, engine_params, instance, storage=storage, ctx=ctx)
 
-        lat_ms: list[float] = []
+        # The server runs IN the bench process (it owns the accelerator); the
+        # LOAD CLIENT is a separate OS process driving a real TCP socket —
+        # client-observed latency includes the wire, not a shared event loop.
+        import subprocess
+        import sys as _sys
 
-        async def drive() -> dict:
+        from incubator_predictionio_tpu.parallel.launcher import free_port
+
+        duration = 2.0 if SMALL else 6.0
+        port = free_port()
+        client_script = _SERVING_CLIENT_SCRIPT
+
+        async def drive() -> tuple[dict, dict]:
             server = QueryServer(
-                ServerConfig(engine_variant=variant_path), storage=storage, ctx=ctx)
-            client = TestClient(TestServer(server.make_app()))
-            await client.start_server()
+                ServerConfig(engine_variant=variant_path, ip="127.0.0.1",
+                             port=port),
+                storage=storage, ctx=ctx)
+            await server.start()
             try:
-                # warmup (first top-k compile)
-                await client.post("/queries.json",
-                                  json={"user": "u1", "num": 10})
-                duration = 2.0 if SMALL else 6.0
-                stop_at = time.perf_counter() + duration
+                proc = await asyncio.create_subprocess_exec(
+                    _sys.executable, "-c", client_script,
+                    f"http://127.0.0.1:{port}", str(duration), str(n_users),
+                    stdout=subprocess.PIPE,
+                )
+                try:
+                    stdout, _ = await asyncio.wait_for(
+                        proc.communicate(), timeout=duration + 120)
+                except asyncio.TimeoutError:
+                    proc.kill()  # a wedged load generator must not outlive us
+                    await proc.wait()
+                    raise
+                assert proc.returncode == 0, proc.returncode
+                client_stats = json.loads(stdout.decode().strip().splitlines()[-1])
+                import aiohttp
 
-                async def worker(wid: int) -> None:
-                    w_rng = np.random.default_rng(wid)
-                    while time.perf_counter() < stop_at:
-                        q = {"user": f"u{w_rng.integers(0, n_users)}", "num": 10}
-                        t0 = time.perf_counter()
-                        resp = await client.post("/queries.json", json=q)
-                        await resp.read()
-                        lat_ms.append((time.perf_counter() - t0) * 1e3)
-                        assert resp.status == 200
-
-                await asyncio.gather(*(worker(i) for i in range(16)))
-                status = await (await client.get("/")).json()
-                return status
+                async with aiohttp.ClientSession() as s:
+                    status = await (await s.get(
+                        f"http://127.0.0.1:{port}/")).json()
+                return client_stats, status
             finally:
-                await client.close()
+                await server.shutdown()
 
-        status = asyncio.run(drive())
-        s = np.sort(np.asarray(lat_ms))
-
-        def pct(q):
-            return float(s[min(len(s) - 1, int(q * (len(s) - 1)))])
-
+        client_stats, status = asyncio.run(drive())
         out = {
-            "predict_p50_ms": round(pct(0.50), 2),
-            "predict_p95_ms": round(pct(0.95), 2),
-            "predict_p99_ms": round(pct(0.99), 2),
-            "queries_per_sec": round(len(s) / (2.0 if SMALL else 6.0), 1),
+            "predict_p50_ms": client_stats["p50_ms"],
+            "predict_p95_ms": client_stats["p95_ms"],
+            "predict_p99_ms": client_stats["p99_ms"],
+            "queries_per_sec": client_stats["qps"],
             "max_batch_seen": status.get("maxBatchSeen"),
             "jit_compile_keys": status.get("jitCompileKeys"),
             "server_p50_ms": round(
@@ -528,56 +573,121 @@ def bench_serving(ctx) -> dict:
 # 8. event-server ingestion throughput (EventServer.scala:261-462 hot path)
 # ---------------------------------------------------------------------------
 
+#: Standalone event-server process (argv: port, backend, path). Seeds the
+#: app + access key in ITS OWN storage (built from PIO_STORAGE_* style
+#: config), then serves — the bench client reaches it only over the socket,
+#: exactly like a production deployment.
+_INGEST_SERVER_SCRIPT = """
+import os, sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+port, backend, path = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+# EVENTDATA on the benched backend; METADATA in-memory (eventlog is an
+# EVENTDATA-only backend, like the reference's HBase)
+cfg = {
+    "PIO_STORAGE_SOURCES_META_TYPE": "memory",
+    "PIO_STORAGE_SOURCES_EV_TYPE": backend,
+    "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "META",
+    "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "EV",
+    "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "META",
+}
+if path:
+    cfg["PIO_STORAGE_SOURCES_EV_PATH"] = path
+from incubator_predictionio_tpu.data.storage import AccessKey, App, Storage
+from incubator_predictionio_tpu.server.event_server import (
+    EventServerConfig, serve_forever)
+
+storage = Storage(cfg)
+app_id = storage.get_meta_data_apps().insert(App(0, "ingest-app"))
+storage.get_meta_data_access_keys().insert(
+    AccessKey(key="bench-key", app_id=app_id, events=()))
+storage.get_events().init(app_id)
+serve_forever(EventServerConfig(ip="127.0.0.1", port=port, stats=False),
+              storage)
+"""
+
+
 def bench_ingestion() -> dict:
-    from aiohttp.test_utils import TestClient, TestServer
+    """Batch-ingest throughput per EVENTDATA backend, out-of-process: the
+    event server runs as its own OS process on each durable backend (sqlite
+    WAL/fsync, eventlog append+CRC) plus memory as the no-durability ceiling;
+    the client drives a real socket (EventServer.scala:261-462 hot path)."""
+    import subprocess
+    import sys as _sys
+    import tempfile
 
-    from incubator_predictionio_tpu.data.storage import App, Storage, use_storage
-    from incubator_predictionio_tpu.server.event_server import EventServer, EventServerConfig
+    from incubator_predictionio_tpu.parallel.launcher import free_port
 
-    storage = Storage({"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
-    prev = use_storage(storage)
-    try:
-        app_id = storage.get_meta_data_apps().insert(App(0, "ingest-app"))
-        keys = storage.get_meta_data_access_keys()
-        from incubator_predictionio_tpu.data.storage.base import AccessKey
+    out: dict[str, float] = {}
+    n_batches = 40 if SMALL else 200
+    payload = [
+        {"event": "view", "entityType": "user", "entityId": f"u{i}",
+         "targetEntityType": "item", "targetEntityId": f"i{i % 97}"}
+        for i in range(50)  # the reference's 50-event batch cap
+    ]
 
-        key = "bench-key"
-        keys.insert(AccessKey(key=key, app_id=app_id, events=()))
-        storage.get_events().init(app_id)
-        server = EventServer(EventServerConfig(stats=False), storage=storage)
+    async def drive(port: int) -> float:
+        import aiohttp
 
-        n_batches = 40 if SMALL else 200
-        payload = [
-            {"event": "view", "entityType": "user", "entityId": f"u{i}",
-             "targetEntityType": "item", "targetEntityId": f"i{i % 97}"}
-            for i in range(50)  # the reference's 50-event batch cap
-        ]
+        url = f"http://127.0.0.1:{port}/batch/events.json?accessKey=bench-key"
+        async with aiohttp.ClientSession() as client:
+            # readiness poll (the server process seeds its store first)
+            for _ in range(120):
+                if proc.poll() is not None:  # died at startup: fail fast
+                    raise RuntimeError(
+                        f"event server exited rc={proc.returncode}")
+                try:
+                    r = await client.get(f"http://127.0.0.1:{port}/")
+                    if r.status == 200:
+                        break
+                except aiohttp.ClientError:
+                    pass
+                await asyncio.sleep(0.25)
+            else:
+                raise RuntimeError("event server did not come up")
+            r = await client.post(url, json=payload)  # warmup
+            assert r.status == 200, r.status
+            t0 = time.perf_counter()
 
-        async def drive() -> float:
-            client = TestClient(TestServer(server.make_app()))
-            await client.start_server()
+            async def worker(n: int) -> None:
+                for _ in range(n):
+                    resp = await client.post(url, json=payload)
+                    assert resp.status == 200
+                    await resp.read()
+
+            per = n_batches // 8
+            await asyncio.gather(*(worker(per) for _ in range(8)))
+            return 8 * per * 50 / (time.perf_counter() - t0)
+
+    for backend in ("memory", "sqlite", "eventlog"):
+        tmp = tempfile.mkdtemp(prefix=f"pio-ingest-{backend}-")
+        path = "" if backend == "memory" else os.path.join(tmp, "store")
+        port = free_port()
+        proc = subprocess.Popen(
+            [_sys.executable, "-c", _INGEST_SERVER_SCRIPT,
+             str(port), backend, path],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        try:
+            eps = asyncio.run(drive(port))
+            out[f"ingest_events_per_sec_{backend}"] = round(eps, 1)
+        except Exception as e:  # noqa: BLE001 - one backend must not zero the rest
+            _log(f"ingestion[{backend}] FAILED: {e!r}")
+            out[f"ingest_events_per_sec_{backend}"] = 0.0
+        finally:
+            proc.terminate()
             try:
-                url = f"/batch/events.json?accessKey={key}"
-                await client.post(url, json=payload)  # warmup
-                t0 = time.perf_counter()
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+            import shutil
 
-                async def worker(n: int) -> None:
-                    for _ in range(n):
-                        resp = await client.post(url, json=payload)
-                        assert resp.status == 200
-                        await resp.read()
-
-                per = n_batches // 8
-                await asyncio.gather(*(worker(per) for _ in range(8)))
-                return 8 * per * 50 / (time.perf_counter() - t0)
-            finally:
-                await client.close()
-
-        eps = asyncio.run(drive())
-        return {"ingest_events_per_sec": round(eps, 1)}
-    finally:
-        use_storage(prev)
-        storage.close()
+            shutil.rmtree(tmp, ignore_errors=True)
+    # headline key: the default deployment backend (sqlite)
+    out["ingest_events_per_sec"] = out.get("ingest_events_per_sec_sqlite", 0.0)
+    return out
 
 
 # ---------------------------------------------------------------------------
