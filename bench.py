@@ -153,24 +153,70 @@ def bench_recommendation(ctx, peaks) -> dict:
     items = rng.integers(0, REC_ITEMS, REC_EVENTS).astype(np.int32)
     ratings = (1.0 + 4.0 * rng.random(REC_EVENTS)).astype(np.float32)
 
-    def run():
+    def run(seed):
+        # distinct seed per run: a timed run identical to the warmup can be
+        # served from an execution cache by tunneled device backends
         return TwoTowerMF(TwoTowerConfig(
-            rank=REC_RANK, batch_size=REC_BATCH, epochs=REC_EPOCHS, seed=0,
+            rank=REC_RANK, batch_size=REC_BATCH, epochs=REC_EPOCHS, seed=seed,
         )).fit(ctx, users, items, ratings, REC_USERS, REC_ITEMS)
 
-    run()  # warmup: pays every compile
+    run(0)  # warmup: pays every compile
     t0 = time.perf_counter()
-    run()
+    model = run(1)
     dt = time.perf_counter() - t0
     flops, bts = _two_tower_flops_bytes(
         REC_EVENTS, REC_RANK, REC_BATCH, REC_EPOCHS, REC_USERS, REC_ITEMS)
     host_eps = bench_numpy_baseline(users, items, ratings)
     eps = REC_EPOCHS * REC_EVENTS / dt
+    t_train = model.timings["train_sec"]
     return {
         "events_per_sec": round(eps, 1),
-        "mfu": _mfu(flops, dt, peaks[0]),
-        "hbm_util": _bw(bts, dt, peaks[1]),
+        "train_events_per_sec": round(REC_EPOCHS * REC_EVENTS / t_train, 1),
+        "mfu": _mfu(flops, t_train, peaks[0]),
+        "hbm_util": _bw(bts, t_train, peaks[1]),
         "vs_host_numpy": round(eps / host_eps, 2),
+        "timings": model.timings,
+    }
+
+
+def bench_recommendation_scaled(ctx, peaks, device) -> dict:
+    """Production-representative two-tower shapes (VERDICT r2: ≥1M users,
+    ≥100k items, rank 128): the dominant HBM traffic is the dense adam
+    streaming over the 142M-parameter fused tables — the config whose
+    ``hbm_util`` tells whether the schedule saturates the chip's bandwidth."""
+    from incubator_predictionio_tpu.models.two_tower import TwoTowerConfig, TwoTowerMF
+
+    small = SMALL or device.platform == "cpu"
+    n_users, n_items, rank = (
+        (100_000, 20_000, 64) if small else (1_000_000, 100_000, 128))
+    n_events = 200_000 if small else 4_000_000
+    batch, epochs = 65536, (2 if small else 4)
+    rng = np.random.default_rng(9)
+    users = rng.integers(0, n_users, n_events).astype(np.int32)
+    items = rng.integers(0, n_items, n_events).astype(np.int32)
+    ratings = (1.0 + 4.0 * rng.random(n_events)).astype(np.float32)
+
+    def run(seed):
+        return TwoTowerMF(TwoTowerConfig(
+            rank=rank, batch_size=batch, epochs=epochs, seed=seed,
+        )).fit(ctx, users, items, ratings, n_users, n_items)
+
+    run(0)
+    t0 = time.perf_counter()
+    model = run(1)
+    dt = time.perf_counter() - t0
+    flops, bts = _two_tower_flops_bytes(
+        n_events, rank, batch, epochs, n_users, n_items)
+    # utilization over the train phase: behind a device tunnel the one-time
+    # 0.5GB model pull (timings["gather_sec"]) dwarfs the loop and says
+    # nothing about the chip (a PCIe host link moves it in ~60ms)
+    t_train = model.timings["train_sec"]
+    return {
+        "events_per_sec": round(epochs * n_events / dt, 1),
+        "train_events_per_sec": round(epochs * n_events / t_train, 1),
+        "mfu": _mfu(flops, t_train, peaks[0]),
+        "hbm_util": _bw(bts, t_train, peaks[1]),
+        "timings": model.timings,
     }
 
 
@@ -193,21 +239,22 @@ def bench_similarproduct(ctx, peaks) -> dict:
         [np.ones(n_pos, np.float32), np.zeros(len(neg_u), np.float32)])
     epochs, batch, rank = 10, 65536, 64
 
-    def run():
+    def run(seed):
         return TwoTowerMF(TwoTowerConfig(
-            rank=rank, batch_size=batch, epochs=epochs, seed=0,
+            rank=rank, batch_size=batch, epochs=epochs, seed=seed,
         )).fit(ctx, users, items, ratings, n_users, n_items)
 
-    run()
+    run(0)
     t0 = time.perf_counter()
-    run()
+    model = run(1)
     dt = time.perf_counter() - t0
     flops, bts = _two_tower_flops_bytes(
         len(users), rank, batch, epochs, n_users, n_items)
+    t_train = model.timings["train_sec"]
     return {
         "events_per_sec": round(epochs * len(users) / dt, 1),
-        "mfu": _mfu(flops, dt, peaks[0]),
-        "hbm_util": _bw(bts, dt, peaks[1]),
+        "mfu": _mfu(flops, t_train, peaks[0]),
+        "hbm_util": _bw(bts, t_train, peaks[1]),
     }
 
 
@@ -290,7 +337,7 @@ def bench_ecommerce_retrieval(ctx, peaks, device) -> dict:
     not just in skipped-on-CPU tests)."""
     from incubator_predictionio_tpu.models.two_tower import TwoTowerConfig, TwoTowerModel, TwoTowerMF
 
-    n_users, n_items, rank = 10_000, (20_000 if SMALL else 100_000), 64
+    n_users, n_items, rank = 10_000, (20_000 if SMALL else 1_000_000), 64
     rng = np.random.default_rng(3)
     model = TwoTowerModel(
         user_emb=rng.standard_normal((n_users, rank)).astype(np.float32),
@@ -303,7 +350,10 @@ def bench_ecommerce_retrieval(ctx, peaks, device) -> dict:
     if device.platform == "tpu":
         parity = _pallas_parity_check(model)
         model._device_items_q = None
-    model.prepare_for_serving(quantize=device.platform == "tpu")
+    # host_max_elements=0: this bench measures DEVICE catalog scoring by
+    # design (SMALL's 20k-item catalog would otherwise take the host path)
+    model.prepare_for_serving(quantize=device.platform == "tpu",
+                              host_max_elements=0)
     batch, iters = 256, 20
     exclude = rng.integers(0, n_items, 50).astype(np.int64)
     uidx = rng.integers(0, n_users, batch).astype(np.int32)
@@ -361,11 +411,18 @@ def bench_sequential(ctx, peaks, device) -> dict:
         TransformerRecommender,
     )
 
-    # full shapes need the MXU; a CPU (fallback) run uses toy shapes so one
-    # config can't eat the whole wall-clock budget
+    # production-representative shapes (VERDICT r2: d_model ≥512, seq ≥512)
+    # need the MXU; a CPU (fallback) run uses toy shapes so one config can't
+    # eat the whole wall-clock budget
     small = SMALL or device.platform == "cpu"
-    vocab, max_len, d, layers, heads = 10_000, 128, 256, 4, 4
-    n, epochs, batch = (256 if small else 4096), (1 if small else 2), 128
+    if small:
+        vocab, max_len, d, layers, heads = 10_000, 128, 256, 4, 4
+        n, epochs, batch = 256, 1, 128
+    else:
+        vocab, max_len, d, layers, heads = 10_000, 512, 512, 6, 8
+        n, epochs, batch = 2048, 2, 64
+    import dataclasses as _dc
+
     rng = np.random.default_rng(11)
     seqs = rng.integers(1, vocab, (n, max_len + 1)).astype(np.int32)
     cfg = TransformerConfig(
@@ -374,14 +431,19 @@ def bench_sequential(ctx, peaks, device) -> dict:
 
     TransformerRecommender(cfg).fit(ctx, seqs, None)
     t0 = time.perf_counter()
-    TransformerRecommender(cfg).fit(ctx, seqs, None)
+    # distinct seed: identical re-runs can be served from an execution cache
+    # by tunneled device backends (no recompile — seed is data, not static)
+    model = TransformerRecommender(_dc.replace(cfg, seed=1)).fit(ctx, seqs, None)
     dt = time.perf_counter() - t0
     tokens = epochs * n * max_len
     n_nonemb = 12 * layers * d * d  # attn(4d²) + mlp(8d²) per layer
     flops_per_token = 6 * n_nonemb + 12 * layers * d * max_len
+    t_train = model.timings["train_sec"]
     return {
         "tokens_per_sec": round(tokens / dt, 1),
-        "mfu": _mfu(tokens * flops_per_token, dt, peaks[0]),
+        "train_tokens_per_sec": round(tokens / t_train, 1),
+        "mfu": _mfu(tokens * flops_per_token, t_train, peaks[0]),
+        "timings": model.timings,
     }
 
 
@@ -704,6 +766,8 @@ def main() -> None:
     configs: dict[str, dict] = {}
     suite = {
         "recommendation": lambda: bench_recommendation(ctx, peaks),
+        "recommendation_scaled": lambda: bench_recommendation_scaled(
+            ctx, peaks, device),
         "classification": lambda: bench_classification(ctx, peaks),
         "similarproduct": lambda: bench_similarproduct(ctx, peaks),
         "ecommerce_retrieval": lambda: bench_ecommerce_retrieval(ctx, peaks, device),
@@ -723,6 +787,7 @@ def main() -> None:
             configs[name] = {"error": repr(e)}
 
     rec = configs.get("recommendation", {})
+    rec_scaled = configs.get("recommendation_scaled", {})
     serving = configs.get("serving", {})
     print(json.dumps({
         "metric": "recommendation_train_throughput",
@@ -732,7 +797,9 @@ def main() -> None:
         "platform": device.platform,
         "device": getattr(device, "device_kind", "unknown"),
         "mfu": rec.get("mfu"),
-        "hbm_util": rec.get("hbm_util"),
+        # hbm_util headline: the production-representative config (the
+        # MovieLens-shaped one is too small to exercise a v5e)
+        "hbm_util": rec_scaled.get("hbm_util", rec.get("hbm_util")),
         "predict_p50_ms": serving.get("predict_p50_ms"),
         "predict_p95_ms": serving.get("predict_p95_ms"),
         "configs": configs,

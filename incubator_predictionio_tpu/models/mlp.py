@@ -17,6 +17,7 @@ TPU mapping:
 from __future__ import annotations
 
 import dataclasses
+import functools
 from functools import partial
 
 import jax
@@ -55,6 +56,36 @@ def _forward(params, x: jax.Array) -> jax.Array:
                         + layer["b"].astype(jnp.bfloat16), 0.0)
     out = h @ params[-1]["w"].astype(jnp.bfloat16) + params[-1]["b"].astype(jnp.bfloat16)
     return out.astype(jnp.float32)
+
+
+@functools.lru_cache(maxsize=32)
+def _train_epoch_fn(learning_rate: float):
+    """Module-level CACHED jitted epoch: repeated fits with the same
+    learning rate (and shapes, via the jit cache) reuse one executable —
+    a jit nested in ``fit`` recompiles every call (see transformer.py)."""
+    tx = optax.adam(learning_rate)
+
+    def loss_fn(p, bx, by, bw):
+        logits = _forward(p, bx)
+        losses = optax.softmax_cross_entropy_with_integer_labels(logits, by)
+        return jnp.sum(losses * bw) / jnp.maximum(jnp.sum(bw), 1.0)
+
+    # batches are jit ARGUMENTS, not closure captures: captured arrays
+    # bake in as constants, which fails for multi-process global arrays
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def train_epoch(p, o, xb, yb, wb):
+        def step(carry, batch):
+            p, o = carry
+            bx, by, bw = batch
+            loss, grads = jax.value_and_grad(loss_fn)(p, bx, by, bw)
+            updates, o = tx.update(grads, o, p)
+            p = optax.apply_updates(p, updates)
+            return (p, o), loss
+
+        (p, o), losses = jax.lax.scan(step, (p, o), (xb, yb, wb))
+        return p, o, losses.mean()
+
+    return train_epoch
 
 
 @dataclasses.dataclass
@@ -149,26 +180,7 @@ class MLPClassifier:
         params = ctx.replicate(_init_params(jax.random.key(cfg.seed), dims))
         tx = optax.adam(cfg.learning_rate)
         opt_state = ctx.replicate(tx.init(params))
-
-        def loss_fn(p, bx, by, bw):
-            logits = _forward(p, bx)
-            losses = optax.softmax_cross_entropy_with_integer_labels(logits, by)
-            return jnp.sum(losses * bw) / jnp.maximum(jnp.sum(bw), 1.0)
-
-        # batches are jit ARGUMENTS, not closure captures: captured arrays
-        # bake in as constants, which fails for multi-process global arrays
-        @partial(jax.jit, donate_argnums=(0, 1))
-        def train_epoch(p, o, xb, yb, wb):
-            def step(carry, batch):
-                p, o = carry
-                bx, by, bw = batch
-                loss, grads = jax.value_and_grad(loss_fn)(p, bx, by, bw)
-                updates, o = tx.update(grads, o, p)
-                p = optax.apply_updates(p, updates)
-                return (p, o), loss
-
-            (p, o), losses = jax.lax.scan(step, (p, o), (xb, yb, wb))
-            return p, o, losses.mean()
+        train_epoch = _train_epoch_fn(cfg.learning_rate)
 
         loss = np.inf
         for _ in range(cfg.epochs):

@@ -120,14 +120,61 @@ def ring_attention_sharded(q, k, v, mesh, data_axis: str = "data",
     return fn(q, k, v)
 
 
+def causal_attention(q, k, v):
+    """Single-device causal attention for the training hot path.
+
+    On TPU with long sequences: the Pallas flash-attention kernel (online
+    softmax over VMEM blocks — the [L, L] score matrix never touches HBM,
+    which at d_model 512 / seq 512 removes ~2 GB of HBM traffic per layer
+    per step). Block sizes are pinned to min(L, 512) everywhere: measured on
+    v5e, the kernel's defaults lose to the materializing reference (137 vs
+    98 ms/step on the scaled sequential config) while 512-blocks win (85
+    ms/step). Short sequences (< 256 or non-multiple-of-128) take the jnp
+    reference — tile-aligned blocking needs room to pay off, and the
+    reference doubles as the kernel's correctness oracle in tests.
+    Layout: [B, L, H, DH] in and out (the kernel wants [B, H, L, DH])."""
+    l = q.shape[1]
+    if jax.devices()[0].platform == "tpu" and l >= 256 and l % 128 == 0:
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            BlockSizes,
+            flash_attention,
+        )
+
+        # largest pinned block that divides L (the kernel requires it)
+        b = 512 if l % 512 == 0 else (256 if l % 256 == 0 else 128)
+        bs = BlockSizes(
+            block_q=b, block_k_major=b, block_k=b, block_b=1,
+            block_q_major_dkv=b, block_k_major_dkv=b,
+            block_k_dkv=b, block_q_dkv=b,
+            block_k_major_dq=b, block_k_dq=b, block_q_dq=b,
+        )
+        out = flash_attention(
+            q.transpose(0, 2, 1, 3).astype(jnp.bfloat16),
+            k.transpose(0, 2, 1, 3).astype(jnp.bfloat16),
+            v.transpose(0, 2, 1, 3).astype(jnp.bfloat16),
+            causal=True,
+            sm_scale=1.0 / math.sqrt(q.shape[-1]),
+            block_sizes=bs,
+        )
+        return out.transpose(0, 2, 1, 3).astype(q.dtype)
+    return causal_attention_reference(q, k, v)
+
+
 def causal_attention_reference(q, k, v):
-    """Single-device causal attention (the correctness oracle for tests)."""
+    """Single-device causal attention (also the correctness oracle for the
+    ring tests): QK/PV matmuls run in bfloat16 on the MXU with fp32
+    accumulation; softmax stays fp32."""
     scale = 1.0 / math.sqrt(q.shape[-1])
-    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * scale
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q.astype(jnp.bfloat16), k.astype(jnp.bfloat16),
+        preferred_element_type=jnp.float32,
+    ) * scale
     lq = q.shape[1]
     mask = jnp.where(jnp.arange(lq)[:, None] >= jnp.arange(lq)[None, :], 0.0,
                      -jnp.inf)
     s = s + mask[None, None, :, :]
     p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(q.dtype)
+    return jnp.einsum(
+        "bhqk,bkhd->bqhd", p.astype(jnp.bfloat16), v.astype(jnp.bfloat16),
+        preferred_element_type=jnp.float32,
+    ).astype(q.dtype)

@@ -93,7 +93,8 @@ def test_two_tower_quantized_serving_matches_float():
     import copy
 
     model_q = copy.deepcopy(model_f)
-    model_q.prepare_for_serving(quantize=True)
+    # host_max_elements=0 pins the DEVICE quantized path under test
+    model_q.prepare_for_serving(quantize=True, host_max_elements=0)
     users = np.arange(n_users, dtype=np.int32)
     idx_f, sc_f = TwoTowerMF.recommend_batch(model_f, users, 5)
     idx_q, sc_q = TwoTowerMF.recommend_batch(model_q, users, 5)
@@ -138,7 +139,9 @@ def test_serving_buckets_no_compile_churn():
     from incubator_predictionio_tpu.utils import jitstats
 
     model = _toy_model()
-    model.prepare_for_serving(serve_k=10)
+    # host_max_elements=0: force the DEVICE path (a toy catalog would
+    # otherwise serve from host numpy, where nothing compiles)
+    model.prepare_for_serving(serve_k=10, host_max_elements=0)
     jitstats.reset()
     model.warmup(max_batch=16)
     warmed = jitstats.count()
@@ -159,10 +162,38 @@ def test_serving_bucket_padding_correctness():
     from incubator_predictionio_tpu.models.two_tower import TwoTowerMF
 
     model = _toy_model(seed=3)
-    model.prepare_for_serving(serve_k=10)
+    model.prepare_for_serving(serve_k=10, host_max_elements=0)
     users = np.asarray([4, 17, 9], np.int32)  # pads to bucket 4
     idx_b, sc_b = TwoTowerMF.recommend_batch(model, users, 7)
     for r, u in enumerate(users):
         idx_1, sc_1 = TwoTowerMF.recommend(model, int(u), 7)
         np.testing.assert_array_equal(idx_b[r], idx_1)
         np.testing.assert_allclose(sc_b[r], sc_1, rtol=1e-5, atol=1e-5)
+
+
+def test_host_fast_path_matches_device():
+    """Small catalogs serve from host numpy; results must agree with the
+    device scorer (same math, no device dispatch on the query path)."""
+    from incubator_predictionio_tpu.models.two_tower import TwoTowerMF
+    from incubator_predictionio_tpu.utils import jitstats
+
+    host_m = _toy_model(seed=5)
+    host_m.prepare_for_serving(serve_k=10)  # toy catalog → host path
+    assert host_m._host_items is not None and host_m._device_items is None
+    dev_m = _toy_model(seed=5)
+    dev_m.prepare_for_serving(serve_k=10, host_max_elements=0)
+    assert dev_m._device_items is not None
+
+    users = np.asarray([1, 12, 29], np.int32)
+    jitstats.reset()
+    idx_h, sc_h = TwoTowerMF.recommend_batch(host_m, users, 6)
+    assert jitstats.count() == 0  # no executable involved on the host path
+    idx_d, sc_d = TwoTowerMF.recommend_batch(dev_m, users, 6)
+    # bf16 device rounding may swap near-ties: compare as sets + score values
+    for r in range(len(users)):
+        assert len(set(idx_h[r]) & set(idx_d[r])) >= 5, (idx_h[r], idx_d[r])
+    np.testing.assert_allclose(sc_h, sc_d, rtol=2e-2, atol=2e-2)  # bf16 device
+    # exclusion masking works on the host path too
+    idx_h2, _ = TwoTowerMF.recommend_batch(
+        host_m, users, 6, exclude=np.asarray(idx_h[0][:2]))
+    assert not set(idx_h[0][:2]) & set(idx_h2[0])
