@@ -145,53 +145,17 @@ def _two_tower_flops_bytes(n_events, rank, batch, epochs, n_users, n_items):
     return steps * flops_step, steps * bytes_step
 
 
-def bench_recommendation(ctx, peaks) -> dict:
+def _bench_two_tower(ctx, peaks, n_users, n_items, rank, n_events, batch,
+                     epochs, data_seed) -> tuple[dict, np.ndarray, np.ndarray, np.ndarray]:
+    """Shared warmup+timed two-tower run. Distinct model seeds per run: a
+    timed run identical to the warmup can be served from an execution cache
+    by tunneled device backends. Utilization is computed over the train
+    phase — behind a device tunnel the one-time model pull
+    (timings["gather_sec"]) dwarfs the loop and says nothing about the chip
+    (a PCIe host link moves the same bytes in ~60ms)."""
     from incubator_predictionio_tpu.models.two_tower import TwoTowerConfig, TwoTowerMF
 
-    rng = np.random.default_rng(42)
-    users = rng.integers(0, REC_USERS, REC_EVENTS).astype(np.int32)
-    items = rng.integers(0, REC_ITEMS, REC_EVENTS).astype(np.int32)
-    ratings = (1.0 + 4.0 * rng.random(REC_EVENTS)).astype(np.float32)
-
-    def run(seed):
-        # distinct seed per run: a timed run identical to the warmup can be
-        # served from an execution cache by tunneled device backends
-        return TwoTowerMF(TwoTowerConfig(
-            rank=REC_RANK, batch_size=REC_BATCH, epochs=REC_EPOCHS, seed=seed,
-        )).fit(ctx, users, items, ratings, REC_USERS, REC_ITEMS)
-
-    run(0)  # warmup: pays every compile
-    t0 = time.perf_counter()
-    model = run(1)
-    dt = time.perf_counter() - t0
-    flops, bts = _two_tower_flops_bytes(
-        REC_EVENTS, REC_RANK, REC_BATCH, REC_EPOCHS, REC_USERS, REC_ITEMS)
-    host_eps = bench_numpy_baseline(users, items, ratings)
-    eps = REC_EPOCHS * REC_EVENTS / dt
-    t_train = model.timings["train_sec"]
-    return {
-        "events_per_sec": round(eps, 1),
-        "train_events_per_sec": round(REC_EPOCHS * REC_EVENTS / t_train, 1),
-        "mfu": _mfu(flops, t_train, peaks[0]),
-        "hbm_util": _bw(bts, t_train, peaks[1]),
-        "vs_host_numpy": round(eps / host_eps, 2),
-        "timings": model.timings,
-    }
-
-
-def bench_recommendation_scaled(ctx, peaks, device) -> dict:
-    """Production-representative two-tower shapes (VERDICT r2: ≥1M users,
-    ≥100k items, rank 128): the dominant HBM traffic is the dense adam
-    streaming over the 142M-parameter fused tables — the config whose
-    ``hbm_util`` tells whether the schedule saturates the chip's bandwidth."""
-    from incubator_predictionio_tpu.models.two_tower import TwoTowerConfig, TwoTowerMF
-
-    small = SMALL or device.platform == "cpu"
-    n_users, n_items, rank = (
-        (100_000, 20_000, 64) if small else (1_000_000, 100_000, 128))
-    n_events = 200_000 if small else 4_000_000
-    batch, epochs = 65536, (2 if small else 4)
-    rng = np.random.default_rng(9)
+    rng = np.random.default_rng(data_seed)
     users = rng.integers(0, n_users, n_events).astype(np.int32)
     items = rng.integers(0, n_items, n_events).astype(np.int32)
     ratings = (1.0 + 4.0 * rng.random(n_events)).astype(np.float32)
@@ -201,23 +165,44 @@ def bench_recommendation_scaled(ctx, peaks, device) -> dict:
             rank=rank, batch_size=batch, epochs=epochs, seed=seed,
         )).fit(ctx, users, items, ratings, n_users, n_items)
 
-    run(0)
+    run(0)  # warmup: pays every compile
     t0 = time.perf_counter()
     model = run(1)
     dt = time.perf_counter() - t0
     flops, bts = _two_tower_flops_bytes(
         n_events, rank, batch, epochs, n_users, n_items)
-    # utilization over the train phase: behind a device tunnel the one-time
-    # 0.5GB model pull (timings["gather_sec"]) dwarfs the loop and says
-    # nothing about the chip (a PCIe host link moves it in ~60ms)
     t_train = model.timings["train_sec"]
-    return {
+    return ({
         "events_per_sec": round(epochs * n_events / dt, 1),
         "train_events_per_sec": round(epochs * n_events / t_train, 1),
         "mfu": _mfu(flops, t_train, peaks[0]),
         "hbm_util": _bw(bts, t_train, peaks[1]),
         "timings": model.timings,
-    }
+    }, users, items, ratings)
+
+
+def bench_recommendation(ctx, peaks) -> dict:
+    out, users, items, ratings = _bench_two_tower(
+        ctx, peaks, REC_USERS, REC_ITEMS, REC_RANK, REC_EVENTS,
+        REC_BATCH, REC_EPOCHS, data_seed=42)
+    host_eps = bench_numpy_baseline(users, items, ratings)
+    out["vs_host_numpy"] = round(out["events_per_sec"] / host_eps, 2)
+    return out
+
+
+def bench_recommendation_scaled(ctx, peaks, device) -> dict:
+    """Production-representative two-tower shapes (VERDICT r2: ≥1M users,
+    ≥100k items, rank 128): the dominant HBM traffic is the dense adam
+    streaming over the 142M-parameter fused tables — the config whose
+    ``hbm_util`` tells whether the schedule saturates the chip's bandwidth."""
+    small = SMALL or device.platform == "cpu"
+    n_users, n_items, rank = (
+        (100_000, 20_000, 64) if small else (1_000_000, 100_000, 128))
+    out, *_ = _bench_two_tower(
+        ctx, peaks, n_users, n_items, rank,
+        n_events=200_000 if small else 4_000_000,
+        batch=65536, epochs=2 if small else 4, data_seed=9)
+    return out
 
 
 def bench_similarproduct(ctx, peaks) -> dict:

@@ -66,13 +66,17 @@ def _register_builtins() -> None:
     from incubator_predictionio_tpu.data.storage.localfs import LocalFSStorageClient
     from incubator_predictionio_tpu.data.storage.memory import MemoryStorageClient
     from incubator_predictionio_tpu.data.storage.remote import RemoteStorageClient
+    from incubator_predictionio_tpu.data.storage.s3 import S3StorageClient
     from incubator_predictionio_tpu.data.storage.sqlite_backend import SqliteStorageClient
+    from incubator_predictionio_tpu.data.storage.webhdfs import WebHDFSStorageClient
 
     BACKEND_TYPES.setdefault("memory", MemoryStorageClient)
     BACKEND_TYPES.setdefault("sqlite", SqliteStorageClient)
     BACKEND_TYPES.setdefault("localfs", LocalFSStorageClient)
     BACKEND_TYPES.setdefault("eventlog", EventLogStorageClient)
     BACKEND_TYPES.setdefault("remote", RemoteStorageClient)
+    BACKEND_TYPES.setdefault("webhdfs", WebHDFSStorageClient)
+    BACKEND_TYPES.setdefault("s3", S3StorageClient)
 
 
 _SOURCE_RE = re.compile(r"^PIO_STORAGE_SOURCES_([^_]+)_(.+)$")

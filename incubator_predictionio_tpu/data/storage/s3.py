@@ -1,0 +1,220 @@
+"""S3 MODELDATA backend — the reference's s3 backend without the AWS SDK.
+
+Parity target: storage/s3/.../S3Models.scala:36-101 (put/get/delete model
+blobs as objects). The reference pulls in the AWS Java SDK; here the S3 REST
+API is spoken directly with stdlib HTTP + an AWS Signature V4 signer
+(hashlib/hmac), which also works against any S3-compatible object store
+(MinIO, GCS interop, Ceph RGW) by pointing ``ENDPOINT`` at it.
+
+Config (``PIO_STORAGE_SOURCES_<NAME>_*``):
+
+- ``TYPE=s3``
+- ``BUCKET_NAME=pio-models``     (reference config key)
+- ``BASE_PATH=models``           (key prefix; reference config key)
+- ``ENDPOINT=https://s3.us-east-1.amazonaws.com``  (or any S3-compatible)
+- ``REGION=us-east-1``
+- ``ACCESS_KEY`` / ``SECRET_KEY``  (or AWS_ACCESS_KEY_ID/... env vars)
+- ``TIMEOUT=60``
+
+Addressing is path-style (``endpoint/bucket/key``) — universally supported
+and required by most S3-compatible stores.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import hashlib
+import hmac
+import logging
+import os
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Optional
+
+from incubator_predictionio_tpu.data.storage.base import (
+    Model,
+    ModelsStore,
+    StorageClient,
+    StorageError,
+)
+
+logger = logging.getLogger(__name__)
+
+
+def _sign(key: bytes, msg: str) -> bytes:
+    return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+
+def sigv4_headers(
+    method: str,
+    url: str,
+    region: str,
+    access_key: str,
+    secret_key: str,
+    payload: bytes = b"",
+    now: Optional[_dt.datetime] = None,
+) -> dict[str, str]:
+    """AWS Signature Version 4 for one S3 request (service ``s3``).
+
+    Returns the headers to attach (Host, x-amz-date, x-amz-content-sha256,
+    Authorization). Stdlib-only; the canonical-request/signing-key recipe
+    follows the public SigV4 specification."""
+    p = urllib.parse.urlsplit(url)
+    host = p.netloc
+    now = now or _dt.datetime.now(_dt.timezone.utc)
+    amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+    datestamp = now.strftime("%Y%m%d")
+    payload_hash = hashlib.sha256(payload).hexdigest()
+
+    canonical_query = "&".join(
+        sorted(
+            f"{urllib.parse.quote(k, safe='-_.~')}="
+            f"{urllib.parse.quote(v, safe='-_.~')}"
+            for k, v in urllib.parse.parse_qsl(
+                p.query, keep_blank_values=True)
+        )
+    )
+    headers = {
+        "host": host,
+        "x-amz-content-sha256": payload_hash,
+        "x-amz-date": amz_date,
+    }
+    signed_headers = ";".join(sorted(headers))
+    canonical_headers = "".join(
+        f"{k}:{headers[k]}\n" for k in sorted(headers))
+    canonical_request = "\n".join([
+        method,
+        urllib.parse.quote(p.path or "/", safe="/-_.~"),
+        canonical_query,
+        canonical_headers,
+        signed_headers,
+        payload_hash,
+    ])
+    scope = f"{datestamp}/{region}/s3/aws4_request"
+    string_to_sign = "\n".join([
+        "AWS4-HMAC-SHA256",
+        amz_date,
+        scope,
+        hashlib.sha256(canonical_request.encode()).hexdigest(),
+    ])
+    k = _sign(f"AWS4{secret_key}".encode(), datestamp)
+    k = _sign(k, region)
+    k = _sign(k, "s3")
+    k = _sign(k, "aws4_request")
+    signature = hmac.new(k, string_to_sign.encode(), hashlib.sha256).hexdigest()
+    return {
+        "x-amz-date": amz_date,
+        "x-amz-content-sha256": payload_hash,
+        "Authorization": (
+            f"AWS4-HMAC-SHA256 Credential={access_key}/{scope}, "
+            f"SignedHeaders={signed_headers}, Signature={signature}"
+        ),
+    }
+
+
+class S3Models(ModelsStore):
+    def __init__(self, endpoint: str, bucket: str, base_path: str,
+                 region: str, access_key: str, secret_key: str,
+                 timeout: float):
+        self._endpoint = endpoint.rstrip("/")
+        self._bucket = bucket
+        self._prefix = base_path.strip("/")
+        self._region = region
+        self._access = access_key
+        self._secret = secret_key
+        self._timeout = timeout
+
+    def _url(self, model_id: str) -> str:
+        if "/" in model_id or model_id in (".", ".."):
+            raise ValueError(f"invalid model id {model_id!r}")
+        key = f"{self._prefix}/{model_id}" if self._prefix else model_id
+        return f"{self._endpoint}/{self._bucket}/{key}"
+
+    def _request(self, method: str, model_id: str, payload: bytes = b""):
+        url = self._url(model_id)
+        req = urllib.request.Request(
+            url, data=payload if method == "PUT" else None, method=method)
+        for k, v in sigv4_headers(
+            method, url, self._region, self._access, self._secret, payload,
+        ).items():
+            req.add_header(k, v)
+        return urllib.request.urlopen(req, timeout=self._timeout)
+
+    def insert(self, model: Model) -> None:
+        try:
+            self._request("PUT", model.id, model.models).read()
+        except (urllib.error.URLError, OSError) as e:
+            raise StorageError(f"s3 insert failed: {e}") from e
+
+    @staticmethod
+    def _missing(e: urllib.error.HTTPError) -> bool:
+        """AWS returns 404 for a missing key only when the caller holds
+        s3:ListBucket; under a least-privilege object-only policy it returns
+        403 instead. Both mean 'not there' for the Optional/bool contract;
+        the 403 case is logged because it can also mean bad credentials."""
+        if e.code == 404:
+            return True
+        if e.code == 403:
+            logger.warning(
+                "s3: 403 on object probe — treating as missing (under an "
+                "object-only IAM policy AWS returns 403 for absent keys; "
+                "if ALL calls fail with 403, check the credentials)")
+            return True
+        return False
+
+    def get(self, model_id: str) -> Optional[Model]:
+        try:
+            with self._request("GET", model_id) as resp:
+                return Model(model_id, resp.read())
+        except urllib.error.HTTPError as e:
+            if self._missing(e):
+                return None
+            raise StorageError(f"s3 get failed: {e}") from e
+        except (urllib.error.URLError, OSError) as e:
+            raise StorageError(f"s3 unreachable: {e}") from e
+
+    def delete(self, model_id: str) -> bool:
+        try:
+            self._request("HEAD", model_id).read()
+        except urllib.error.HTTPError as e:
+            if self._missing(e):
+                return False
+            raise StorageError(f"s3 delete failed: {e}") from e
+        except (urllib.error.URLError, OSError) as e:
+            raise StorageError(f"s3 unreachable: {e}") from e
+        try:
+            self._request("DELETE", model_id).read()
+            return True
+        except urllib.error.HTTPError as e:
+            raise StorageError(f"s3 delete failed: {e}") from e
+        except (urllib.error.URLError, OSError) as e:
+            raise StorageError(f"s3 unreachable: {e}") from e
+
+
+class S3StorageClient(StorageClient):
+    """MODELDATA only, like the reference s3 backend."""
+
+    def __init__(self, config: dict[str, str]):
+        super().__init__(config)
+        bucket = config.get("BUCKET_NAME")
+        if not bucket:
+            raise StorageError("s3 backend requires BUCKET_NAME")
+        region = config.get("REGION", os.environ.get("AWS_REGION", "us-east-1"))
+        endpoint = config.get(
+            "ENDPOINT", f"https://s3.{region}.amazonaws.com")
+        access = config.get(
+            "ACCESS_KEY", os.environ.get("AWS_ACCESS_KEY_ID", ""))
+        secret = config.get(
+            "SECRET_KEY", os.environ.get("AWS_SECRET_ACCESS_KEY", ""))
+        if not access or not secret:
+            raise StorageError(
+                "s3 backend requires ACCESS_KEY/SECRET_KEY "
+                "(or AWS_ACCESS_KEY_ID/AWS_SECRET_ACCESS_KEY env)")
+        self._models = S3Models(
+            endpoint, bucket, config.get("BASE_PATH", ""),
+            region, access, secret, float(config.get("TIMEOUT", "60")),
+        )
+
+    def models(self) -> ModelsStore:
+        return self._models

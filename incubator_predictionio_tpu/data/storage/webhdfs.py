@@ -1,0 +1,124 @@
+"""WebHDFS MODELDATA backend — the reference's hdfs backend over REST.
+
+Parity target: storage/hdfs/.../HDFSModels.scala:31-63 (stream model blobs
+to ``Path(f, id)``). The reference talks to HDFS through the Hadoop client
+jars; the TPU-native framework speaks the WebHDFS REST protocol
+(``/webhdfs/v1/...?op=CREATE|OPEN|DELETE``) with the standard library — no
+Hadoop runtime in the serving/training processes, just HTTP to the namenode
+(which redirects data operations to a datanode, per the protocol).
+
+Config (``PIO_STORAGE_SOURCES_<NAME>_*``):
+
+- ``TYPE=webhdfs``
+- ``URL=http://namenode:9870``  (the namenode's HTTP address)
+- ``PATH=/pio/models``          (base directory; created on demand)
+- ``USER=pio``                  (``user.name`` query param, simple auth)
+- ``TIMEOUT=60``
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Optional
+
+from incubator_predictionio_tpu.data.storage.base import (
+    Model,
+    ModelsStore,
+    StorageClient,
+    StorageError,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class WebHDFSModels(ModelsStore):
+    def __init__(self, url: str, base_path: str, user: Optional[str],
+                 timeout: float):
+        self._url = url.rstrip("/")
+        self._base = "/" + base_path.strip("/")
+        self._user = user
+        self._timeout = timeout
+
+    def _op_url(self, model_id: str, op: str, **params) -> str:
+        if "/" in model_id or model_id in (".", ".."):
+            raise ValueError(f"invalid model id {model_id!r}")
+        q = {"op": op, **params}
+        if self._user:
+            q["user.name"] = self._user
+        return (f"{self._url}/webhdfs/v1{self._base}/{model_id}"
+                f"?{urllib.parse.urlencode(q)}")
+
+    def insert(self, model: Model) -> None:
+        """Two-step CREATE per the WebHDFS protocol: the namenode answers the
+        bare PUT with a 307 whose Location is the datanode write URL; the
+        blob goes to that second URL (urllib auto-follows 307 only for
+        GET/HEAD, so the redirect is handled explicitly)."""
+        url = self._op_url(model.id, "CREATE", overwrite="true")
+        try:
+            loc = None
+            try:
+                resp = urllib.request.urlopen(
+                    urllib.request.Request(url, method="PUT"),
+                    timeout=self._timeout)
+                loc = resp.headers.get("Location")  # gateway variants: 200/201
+            except urllib.error.HTTPError as e:
+                if e.code != 307:
+                    raise
+                loc = e.headers.get("Location")
+            if not loc:
+                raise StorageError("webhdfs CREATE returned no write location")
+            req = urllib.request.Request(loc, data=model.models, method="PUT")
+            req.add_header("Content-Type", "application/octet-stream")
+            urllib.request.urlopen(req, timeout=self._timeout).read()
+        except (urllib.error.URLError, OSError) as e:
+            raise StorageError(f"webhdfs insert failed: {e}") from e
+
+    def get(self, model_id: str) -> Optional[Model]:
+        url = self._op_url(model_id, "OPEN")
+        try:
+            with urllib.request.urlopen(url, timeout=self._timeout) as resp:
+                return Model(model_id, resp.read())
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return None
+            raise StorageError(f"webhdfs get failed: {e}") from e
+        except (urllib.error.URLError, OSError) as e:
+            raise StorageError(f"webhdfs unreachable: {e}") from e
+
+    def delete(self, model_id: str) -> bool:
+        url = self._op_url(model_id, "DELETE")
+        try:
+            with urllib.request.urlopen(
+                urllib.request.Request(url, method="DELETE"),
+                timeout=self._timeout,
+            ) as resp:
+                return bool(json.loads(resp.read() or b"{}").get("boolean"))
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return False
+            raise StorageError(f"webhdfs delete failed: {e}") from e
+        except (urllib.error.URLError, OSError) as e:
+            raise StorageError(f"webhdfs unreachable: {e}") from e
+
+
+class WebHDFSStorageClient(StorageClient):
+    """MODELDATA only, like the reference hdfs backend."""
+
+    def __init__(self, config: dict[str, str]):
+        super().__init__(config)
+        url = config.get("URL")
+        if not url:
+            raise StorageError("webhdfs backend requires URL (namenode http)")
+        self._models = WebHDFSModels(
+            url,
+            config.get("PATH", "/pio/models"),
+            config.get("USER"),
+            float(config.get("TIMEOUT", "60")),
+        )
+
+    def models(self) -> ModelsStore:
+        return self._models

@@ -178,8 +178,11 @@ class MLPClassifier:
 
         dims = [d, *cfg.hidden_dims, n_classes]
         params = ctx.replicate(_init_params(jax.random.key(cfg.seed), dims))
-        tx = optax.adam(cfg.learning_rate)
-        opt_state = ctx.replicate(tx.init(params))
+        from incubator_predictionio_tpu.utils.optim import jit_adam_init
+
+        # cached jitted init: state inherits the params' shardings and
+        # repeated fits (eval sweeps) reuse one executable
+        opt_state = jit_adam_init(cfg.learning_rate)(params)
         train_epoch = _train_epoch_fn(cfg.learning_rate)
 
         loss = np.inf

@@ -110,6 +110,12 @@ class TwoTowerModel:
         queries asking ``num ≤ serve_k`` share ONE executable per batch bucket
         (results sliced host-side), so per-query ``num`` never recompiles."""
         self._serve_k = min(serve_k, self.n_items)
+        # re-preparation switches paths cleanly: clear every serving buffer
+        # first (a stale _host_items would shadow a requested device path)
+        self._host_items = None
+        self._device_items = None
+        self._device_items_q = None
+        self._device_users = None
         host_max = (HOST_SERVE_MAX_ELEMENTS if host_max_elements is None
                     else host_max_elements)
         # host check first: ``quantize`` applies to device-resident catalogs;
