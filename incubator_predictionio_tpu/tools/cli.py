@@ -5,7 +5,10 @@ Parity target: tools/console/Console.scala:134-623 and commands/*. Verbs:
   version, status,
   app {new,list,show,delete,data-delete,channel-new,channel-delete},
   accesskey {new,list,delete},
-  train, eval, deploy, undeploy, batchpredict, eventserver,
+  template {list,get} (commands/Template.scala — the gallery collapses to
+  the in-package template registry; ``get`` scaffolds a ready-to-train
+  engine.json),
+  train, eval, deploy, undeploy, batchpredict, eventserver, storageserver,
   export, import,
   start-all, stop-all (bin/pio-start-all / pio-stop-all: daemonize the
   serving stack with pidfiles), redeploy (examples/redeploy-script: cron-able
@@ -422,6 +425,94 @@ def cmd_redeploy(args, storage: Storage) -> int:
     return 0 if instance_id else 1
 
 
+#: In-package template registry (commands/Template.scala:33-69 points at the
+#: external gallery; templates ship in-package here, so list/get are real).
+TEMPLATES = {
+    "recommendation": {
+        "factory": "incubator_predictionio_tpu.templates.recommendation."
+                   "RecommendationEngine",
+        "algorithms": [{"name": "als", "params": {
+            "rank": 64, "numIterations": 20}}],
+        "description": "two-tower MF over rate/buy events "
+                       "(scala-parallel-recommendation slot)",
+    },
+    "classification": {
+        "factory": "incubator_predictionio_tpu.templates.classification."
+                   "ClassificationEngine",
+        "algorithms": [{"name": "mlp", "params": {}}],
+        "description": "MLP over $set attribute/label snapshots "
+                       "(scala-parallel-classification slot)",
+    },
+    "similarproduct": {
+        "factory": "incubator_predictionio_tpu.templates.similarproduct."
+                   "SimilarProductEngine",
+        "algorithms": [{"name": "als", "params": {}}],
+        "description": "implicit MF + cooccurrence over view/like events "
+                       "(scala-parallel-similarproduct slot)",
+    },
+    "ecommerce": {
+        "factory": "incubator_predictionio_tpu.templates.ecommerce."
+                   "ECommerceEngine",
+        "algorithms": [{"name": "ecomm", "params": {}}],
+        "algo_app_name": True,  # live serving-time event reads
+        "description": "two-tower retrieval with live constraints "
+                       "(scala-parallel-ecommercerecommendation slot)",
+    },
+    "sequential": {
+        "factory": "incubator_predictionio_tpu.templates.sequential."
+                   "SequentialEngine",
+        "algorithms": [{"name": "transformer", "params": {}}],
+        "algo_app_name": True,  # user-history reads at serving time
+        "description": "session transformer next-item recommender "
+                       "(long-context flagship; no reference counterpart)",
+    },
+}
+
+
+def cmd_template_list(args, storage: Storage) -> int:
+    for name, t in TEMPLATES.items():
+        _out(f"{name:16s} {t['description']}")
+        _out(f"{'':16s}   engineFactory: {t['factory']}")
+    return 0
+
+
+def cmd_template_get(args, storage: Storage) -> int:
+    """Scaffold a ready-to-train engine.json for the named template."""
+    t = TEMPLATES.get(args.name)
+    if t is None:
+        _err(f"Unknown template {args.name!r}; try: pio-tpu template list")
+        return 1
+    import copy
+    import os
+
+    os.makedirs(args.directory, exist_ok=True)
+    path = os.path.join(args.directory, "engine.json")
+    if os.path.exists(path) and not args.force:
+        _err(f"{path} already exists (use --force to overwrite)")
+        return 1
+    app_name = args.app_name or args.name
+    algorithms = copy.deepcopy(t["algorithms"])
+    if t.get("algo_app_name"):
+        # these algorithms read live events at SERVING time through their own
+        # appName param (seen items, user history) — it must match the
+        # datasource's app or those lookups silently return nothing
+        for a in algorithms:
+            a["params"]["appName"] = app_name
+    variant = {
+        "id": args.name,
+        "version": "1",
+        "engineFactory": t["factory"],
+        "datasource": {"params": {"appName": app_name}},
+        "algorithms": algorithms,
+    }
+    with open(path, "w") as f:
+        json.dump(variant, f, indent=2)
+        f.write("\n")
+    _out(f"Wrote {path} — next: pio-tpu app new {args.app_name or args.name}; "
+         f"pio-tpu train -v {path}")
+    return 0
+
+
 def cmd_export(args, storage: Storage) -> int:
     from incubator_predictionio_tpu.tools.export_import import export_events
 
@@ -533,6 +624,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("app_name", nargs="?")
     p = ak.add_parser("delete")
     p.add_argument("key")
+
+    # template (commands/Template.scala; in-package registry here)
+    tp = sub.add_parser("template").add_subparsers(dest="template_command")
+    tp.add_parser("list")
+    p = tp.add_parser("get")
+    p.add_argument("name")
+    p.add_argument("directory", nargs="?", default=".")
+    p.add_argument("--app-name")
+    p.add_argument("--force", action="store_true")
 
     # train
     p = sub.add_parser("train")
@@ -741,6 +841,11 @@ _APP_COMMANDS = {
     "channel-delete": cmd_channel_delete,
 }
 
+_TEMPLATE_COMMANDS = {
+    "list": cmd_template_list,
+    "get": cmd_template_get,
+}
+
 _ACCESSKEY_COMMANDS = {
     "new": cmd_accesskey_new,
     "list": cmd_accesskey_list,
@@ -772,6 +877,13 @@ def main(argv: Optional[list[str]] = None) -> int:
             parser.parse_args(["accesskey", "--help"])
             return 1
         return _ACCESSKEY_COMMANDS[args.accesskey_command](args, storage)
+    if args.command == "template":
+        if not args.template_command:
+            # parse_args(["template", "--help"]) would SystemExit(0); a
+            # missing subcommand must FAIL for scripted callers
+            _err("template: missing subcommand (list|get)")
+            return 1
+        return _TEMPLATE_COMMANDS[args.template_command](args, storage)
     return _COMMANDS[args.command](args, storage)
 
 
