@@ -1,0 +1,292 @@
+"""Elasticsearch EVENTDATA backend — the reference's ES backend over plain REST.
+
+Parity target: storage/elasticsearch/.../ESLEvents.scala:41-… (index per
+app/channel, document per event, range/term filtered search sorted by event
+time) and ESUtils.scala's scroll pagination. The reference links the ES REST
+client + elasticsearch-spark; here the documented REST surface is spoken
+directly with stdlib HTTP: ``_doc`` CRUD, ``_bulk`` NDJSON ingestion, and
+``_search`` with a bool filter + ``search_after`` pagination (the modern
+replacement for scroll). Works against Elasticsearch 7/8 and API-compatible
+stores (OpenSearch).
+
+Config (``PIO_STORAGE_SOURCES_<NAME>_*``):
+
+- ``TYPE=elasticsearch``
+- ``URL=http://es-host:9200``
+- ``INDEX_PREFIX=pio_event``   (index name: ``<prefix>_<app>[_<channel>]``)
+- ``USERNAME`` / ``PASSWORD``  (optional basic auth)
+- ``TIMEOUT=60``
+
+Scope: EVENTDATA (the reference's ES backend also serves metadata in
+ES-default deployments; metadata/models here ride sqlite or the storage
+server — see COMPONENTS.md §2.4).
+
+Writes use ``refresh=wait_for`` so the store honors the read-your-writes
+behavior the storage contract (and the reference's tests) assume.
+"""
+
+from __future__ import annotations
+
+import base64
+import datetime as _dt
+import json
+import logging
+import urllib.error
+import urllib.request
+from typing import Any, Iterator, Optional, Sequence
+from uuid import uuid4
+
+from incubator_predictionio_tpu.data.event import Event
+from incubator_predictionio_tpu.data.storage.base import (
+    UNSET,
+    EventStore,
+    StorageClient,
+    StorageError,
+)
+
+logger = logging.getLogger(__name__)
+
+_PAGE = 1000  # search_after page size
+
+
+class ESEvents(EventStore):
+    def __init__(self, url: str, prefix: str, timeout: float,
+                 username: Optional[str] = None,
+                 password: Optional[str] = None):
+        self._url = url.rstrip("/")
+        self._prefix = prefix
+        self._timeout = timeout
+        self._auth = None
+        if username is not None:
+            token = base64.b64encode(
+                f"{username}:{password or ''}".encode()).decode()
+            self._auth = f"Basic {token}"
+
+    # -- transport --------------------------------------------------------
+    def _call(self, method: str, path: str, body: Any = None,
+              ndjson: bool = False, ok_codes: Sequence[int] = (200, 201)):
+        url = f"{self._url}{path}"
+        data = None
+        if body is not None:
+            data = body.encode() if isinstance(body, str) else json.dumps(
+                body).encode()
+        req = urllib.request.Request(url, data=data, method=method)
+        if data is not None:
+            req.add_header(
+                "Content-Type",
+                "application/x-ndjson" if ndjson else "application/json")
+        if self._auth:
+            req.add_header("Authorization", self._auth)
+        try:
+            with urllib.request.urlopen(req, timeout=self._timeout) as resp:
+                payload = resp.read()
+                return resp.status, json.loads(payload) if payload else {}
+        except urllib.error.HTTPError as e:
+            if e.code in ok_codes:
+                payload = e.read()
+                return e.code, json.loads(payload) if payload else {}
+            detail = e.read()[:2048].decode(errors="replace")
+            raise StorageError(
+                f"elasticsearch {method} {path}: {e.code} {detail}") from e
+        except (urllib.error.URLError, OSError) as e:
+            raise StorageError(f"elasticsearch unreachable: {e}") from e
+
+    def _index(self, app_id: int, channel_id: Optional[int]) -> str:
+        return (f"{self._prefix}_{app_id}"
+                + (f"_{channel_id}" if channel_id is not None else ""))
+
+    # -- lifecycle --------------------------------------------------------
+    def init(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        mapping = {"mappings": {"properties": {
+            "event": {"type": "keyword"},
+            "entityType": {"type": "keyword"},
+            "entityId": {"type": "keyword"},
+            "targetEntityType": {"type": "keyword"},
+            "targetEntityId": {"type": "keyword"},
+            "eventTimeMillis": {"type": "long"},
+            "tiebreak": {"type": "keyword"},
+            # the full event JSON rides as an unindexed source field
+            "doc": {"type": "object", "enabled": False},
+        }}}
+        try:
+            self._call("PUT", f"/{self._index(app_id, channel_id)}", mapping)
+        except StorageError as e:
+            if "resource_already_exists" not in str(e):
+                raise
+        return True
+
+    def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        try:
+            self._call("DELETE", f"/{self._index(app_id, channel_id)}")
+            return True
+        except StorageError as e:
+            if "index_not_found" in str(e) or " 404 " in str(e):
+                return False
+            raise
+
+    # -- CRUD -------------------------------------------------------------
+    @staticmethod
+    def _quote_id(event_id: str) -> str:
+        """Ids are client-suppliable; percent-encode so an id like ``a/b``
+        or ``x?pretty`` can't change the route or the query string."""
+        import urllib.parse
+
+        return urllib.parse.quote(event_id, safe="")
+
+    def _doc(self, event: Event, event_id: str) -> dict:
+        e = event.with_id(event_id)
+        return {
+            "event": e.event,
+            "entityType": e.entity_type,
+            "entityId": e.entity_id,
+            "targetEntityType": e.target_entity_type,
+            "targetEntityId": e.target_entity_id,
+            "eventTimeMillis": int(e.event_time.timestamp() * 1000),
+            # UNIQUE sort tiebreak for search_after: a non-unique key makes
+            # ES skip/duplicate docs at page boundaries; equal-timestamp
+            # order is id-lexicographic (deterministic, like real ES)
+            "tiebreak": event_id,
+            "doc": e.to_json_dict(),
+        }
+
+    def insert(self, event: Event, app_id: int,
+               channel_id: Optional[int] = None) -> str:
+        event_id = event.event_id or uuid4().hex
+        idx = self._index(app_id, channel_id)
+        self._call(
+            "PUT", f"/{idx}/_doc/{self._quote_id(event_id)}?refresh=wait_for",
+            self._doc(event, event_id))
+        return event_id
+
+    def insert_batch(self, events: Sequence[Event], app_id: int,
+                     channel_id: Optional[int] = None) -> list[str]:
+        if not events:
+            return []
+        idx = self._index(app_id, channel_id)
+        ids, lines = [], []
+        for e in events:
+            event_id = e.event_id or uuid4().hex
+            ids.append(event_id)
+            lines.append(json.dumps({"index": {"_id": event_id}}))
+            lines.append(json.dumps(self._doc(e, event_id)))
+        status, out = self._call(
+            "POST", f"/{idx}/_bulk?refresh=wait_for",
+            "\n".join(lines) + "\n", ndjson=True)
+        if out.get("errors"):
+            raise StorageError(f"elasticsearch bulk insert had errors: "
+                               f"{json.dumps(out)[:2048]}")
+        return ids
+
+    def get(self, event_id: str, app_id: int,
+            channel_id: Optional[int] = None) -> Optional[Event]:
+        idx = self._index(app_id, channel_id)
+        status, out = self._call(
+            "GET", f"/{idx}/_doc/{self._quote_id(event_id)}",
+            ok_codes=(200, 404))
+        if status == 404 or not out.get("found"):
+            return None
+        return Event.from_json_dict(out["_source"]["doc"])
+
+    def delete(self, event_id: str, app_id: int,
+               channel_id: Optional[int] = None) -> bool:
+        idx = self._index(app_id, channel_id)
+        status, out = self._call(
+            "DELETE",
+            f"/{idx}/_doc/{self._quote_id(event_id)}?refresh=wait_for",
+            ok_codes=(200, 404))
+        return out.get("result") == "deleted"
+
+    # -- queries ----------------------------------------------------------
+    def find(
+        self,
+        app_id: int,
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        entity_type: Optional[str] = None,
+        entity_id: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Any = UNSET,
+        target_entity_id: Any = UNSET,
+        limit: Optional[int] = None,
+        reversed: bool = False,
+    ) -> Iterator[Event]:
+        idx = self._index(app_id, channel_id)
+        must: list[dict] = []
+        must_not: list[dict] = []
+        rng: dict[str, int] = {}
+        if start_time is not None:
+            rng["gte"] = int(start_time.timestamp() * 1000)
+        if until_time is not None:
+            rng["lt"] = int(until_time.timestamp() * 1000)
+        if rng:
+            must.append({"range": {"eventTimeMillis": rng}})
+        if entity_type is not None:
+            must.append({"term": {"entityType": entity_type}})
+        if entity_id is not None:
+            must.append({"term": {"entityId": entity_id}})
+        if event_names is not None:
+            must.append({"terms": {"event": list(event_names)}})
+        for field, flt in (("targetEntityType", target_entity_type),
+                           ("targetEntityId", target_entity_id)):
+            if flt is UNSET:
+                continue
+            if flt is None:
+                must_not.append({"exists": {"field": field}})
+            else:
+                must.append({"term": {field: flt}})
+        query = {"bool": {"filter": must, "must_not": must_not}}
+        order = "desc" if reversed else "asc"
+        sort = [{"eventTimeMillis": order}, {"tiebreak": order}]
+        remaining = None if limit is None or limit < 0 else limit
+
+        def pages():
+            search_after = None
+            served = 0
+            while True:
+                # never request more docs than the limit still needs
+                size = (_PAGE if remaining is None
+                        else min(_PAGE, remaining - served))
+                if size <= 0:
+                    return
+                body = {"query": query, "sort": sort, "size": size}
+                if search_after is not None:
+                    body["search_after"] = search_after
+                _, out = self._call("POST", f"/{idx}/_search", body)
+                hits = out.get("hits", {}).get("hits", [])
+                if not hits:
+                    return
+                yield from hits
+                served += len(hits)
+                if len(hits) < size:
+                    return
+                search_after = hits[-1]["sort"]
+
+        n = 0
+        for hit in pages():
+            if remaining is not None and n >= remaining:
+                return
+            n += 1
+            yield Event.from_json_dict(hit["_source"]["doc"])
+
+
+class ESStorageClient(StorageClient):
+    """EVENTDATA over the Elasticsearch REST API."""
+
+    def __init__(self, config: dict[str, str]):
+        super().__init__(config)
+        url = config.get("URL")
+        if not url:
+            hosts = config.get("HOSTS", "localhost")
+            ports = config.get("PORTS", "9200")
+            url = f"http://{hosts.split(',')[0]}:{ports.split(',')[0]}"
+        self._events = ESEvents(
+            url,
+            config.get("INDEX_PREFIX", "pio_event"),
+            float(config.get("TIMEOUT", "60")),
+            username=config.get("USERNAME"),
+            password=config.get("PASSWORD"),
+        )
+
+    def events(self) -> EventStore:
+        return self._events

@@ -65,6 +65,7 @@ def _register_builtins() -> None:
     )
     from incubator_predictionio_tpu.data.storage.localfs import LocalFSStorageClient
     from incubator_predictionio_tpu.data.storage.memory import MemoryStorageClient
+    from incubator_predictionio_tpu.data.storage.elasticsearch import ESStorageClient
     from incubator_predictionio_tpu.data.storage.remote import RemoteStorageClient
     from incubator_predictionio_tpu.data.storage.s3 import S3StorageClient
     from incubator_predictionio_tpu.data.storage.sqlite_backend import SqliteStorageClient
@@ -77,6 +78,7 @@ def _register_builtins() -> None:
     BACKEND_TYPES.setdefault("remote", RemoteStorageClient)
     BACKEND_TYPES.setdefault("webhdfs", WebHDFSStorageClient)
     BACKEND_TYPES.setdefault("s3", S3StorageClient)
+    BACKEND_TYPES.setdefault("elasticsearch", ESStorageClient)
 
 
 _SOURCE_RE = re.compile(r"^PIO_STORAGE_SOURCES_([^_]+)_(.+)$")

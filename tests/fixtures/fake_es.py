@@ -1,0 +1,144 @@
+"""In-memory Elasticsearch protocol fake for the ES backend tests.
+
+Implements the documented subset the backend speaks: index create/delete
+(with ES's resource_already_exists / index_not_found error shapes), _doc
+CRUD, _bulk NDJSON, and _search with bool filter/must_not, multi-field sort,
+``search_after`` pagination, and size. Independent of the client code — the
+DSL is interpreted from the request JSON, so a client-side query-building
+bug fails the suite instead of round-tripping through shared helpers.
+"""
+
+from __future__ import annotations
+
+import json
+
+from aiohttp import web
+
+
+def make_es_app():
+    indices: dict[str, dict] = {}  # index -> {doc_id: source}
+    app = web.Application()
+
+    def es_error(status: int, err_type: str) -> web.Response:
+        return web.json_response(
+            {"error": {"type": err_type, "reason": err_type}, "status": status},
+            status=status)
+
+    async def put_index(request: web.Request):
+        name = request.match_info["index"]
+        if name in indices:
+            return es_error(400, "resource_already_exists_exception")
+        indices[name] = {}
+        return web.json_response({"acknowledged": True})
+
+    async def delete_index(request: web.Request):
+        name = request.match_info["index"]
+        if name not in indices:
+            return es_error(404, "index_not_found_exception")
+        del indices[name]
+        return web.json_response({"acknowledged": True})
+
+    async def put_doc(request: web.Request):
+        idx = indices.get(request.match_info["index"])
+        if idx is None:
+            return es_error(404, "index_not_found_exception")
+        doc_id = request.match_info["id"]
+        created = doc_id not in idx
+        idx[doc_id] = await request.json()
+        return web.json_response(
+            {"result": "created" if created else "updated", "_id": doc_id},
+            status=201 if created else 200)
+
+    async def bulk(request: web.Request):
+        idx = indices.get(request.match_info["index"])
+        if idx is None:
+            return es_error(404, "index_not_found_exception")
+        lines = [ln for ln in (await request.text()).splitlines() if ln.strip()]
+        items = []
+        for action_line, source_line in zip(lines[::2], lines[1::2]):
+            action = json.loads(action_line)
+            doc_id = action["index"]["_id"]
+            idx[doc_id] = json.loads(source_line)
+            items.append({"index": {"_id": doc_id, "status": 201}})
+        return web.json_response({"errors": False, "items": items})
+
+    async def get_doc(request: web.Request):
+        idx = indices.get(request.match_info["index"])
+        doc_id = request.match_info["id"]
+        if idx is None or doc_id not in idx:
+            return web.json_response(
+                {"found": False, "_id": doc_id}, status=404)
+        return web.json_response(
+            {"found": True, "_id": doc_id, "_source": idx[doc_id]})
+
+    async def delete_doc(request: web.Request):
+        idx = indices.get(request.match_info["index"])
+        doc_id = request.match_info["id"]
+        if idx is None or doc_id not in idx:
+            return web.json_response(
+                {"result": "not_found", "_id": doc_id}, status=404)
+        del idx[doc_id]
+        return web.json_response({"result": "deleted", "_id": doc_id})
+
+    def matches(src: dict, clause: dict) -> bool:
+        if "term" in clause:
+            ((field, value),) = clause["term"].items()
+            return src.get(field) == value
+        if "terms" in clause:
+            ((field, values),) = clause["terms"].items()
+            return src.get(field) in values
+        if "range" in clause:
+            ((field, bounds),) = clause["range"].items()
+            v = src.get(field)
+            if v is None:
+                return False
+            if "gte" in bounds and not v >= bounds["gte"]:
+                return False
+            if "lt" in bounds and not v < bounds["lt"]:
+                return False
+            return True
+        if "exists" in clause:
+            return src.get(clause["exists"]["field"]) is not None
+        raise web.HTTPBadRequest(text=f"unsupported clause {clause}")
+
+    async def search(request: web.Request):
+        idx = indices.get(request.match_info["index"])
+        if idx is None:
+            return es_error(404, "index_not_found_exception")
+        body = await request.json()
+        bool_q = body.get("query", {}).get("bool", {})
+        hits = [
+            src for src in idx.values()
+            if all(matches(src, c) for c in bool_q.get("filter", []))
+            and not any(matches(src, c) for c in bool_q.get("must_not", []))
+        ]
+        sort_spec = body.get("sort", [])
+
+        def sort_key(src):
+            return tuple(
+                src.get(next(iter(s))) for s in sort_spec
+            )
+
+        descending = bool(sort_spec) and (
+            next(iter(sort_spec[0].values())) == "desc")
+        hits.sort(key=sort_key, reverse=descending)
+        after = body.get("search_after")
+        if after is not None:
+            after = tuple(after)
+            hits = [h for h in hits if (
+                sort_key(h) < after if descending else sort_key(h) > after)]
+        size = body.get("size", 10)
+        page = hits[:size]
+        return web.json_response({"hits": {"hits": [
+            {"_id": "?", "_source": src, "sort": list(sort_key(src))}
+            for src in page
+        ]}})
+
+    app.router.add_put("/{index}", put_index)
+    app.router.add_delete("/{index}", delete_index)
+    app.router.add_post("/{index}/_bulk", bulk)
+    app.router.add_post("/{index}/_search", search)
+    app.router.add_put("/{index}/_doc/{id}", put_doc)
+    app.router.add_get("/{index}/_doc/{id}", get_doc)
+    app.router.add_delete("/{index}/_doc/{id}", delete_doc)
+    return app

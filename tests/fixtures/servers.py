@@ -1,0 +1,43 @@
+"""Test harness: run any aiohttp app on a daemon thread with its own loop."""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+
+from aiohttp import web
+
+
+class ThreadedApp:
+    def __init__(self, app: web.Application):
+        self._loop = asyncio.new_event_loop()
+        self._app = app
+        self.port = None
+        started = threading.Event()
+
+        def run():
+            asyncio.set_event_loop(self._loop)
+
+            async def boot():
+                self._runner = web.AppRunner(self._app)
+                await self._runner.setup()
+                site = web.TCPSite(self._runner, "127.0.0.1", 0)
+                await site.start()
+                self.port = self._runner.addresses[0][1]
+
+            self._loop.run_until_complete(boot())
+            started.set()
+            self._loop.run_forever()
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+        assert started.wait(timeout=30)
+
+    def close(self):
+        async def stop():
+            await self._runner.cleanup()
+            self._loop.stop()
+
+        asyncio.run_coroutine_threadsafe(stop(), self._loop)
+        self._thread.join(timeout=10)
+        self._loop.close()

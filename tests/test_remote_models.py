@@ -7,50 +7,11 @@ targets: storage/hdfs/.../HDFSModels.scala:31-63,
 storage/s3/.../S3Models.scala:36-101.
 """
 
-import asyncio
-import threading
-
 import pytest
 from aiohttp import web
 
 from incubator_predictionio_tpu.data.storage import Model, Storage, StorageError
-
-
-class _ThreadedApp:
-    """Any aiohttp app on a daemon thread with its own loop (test harness)."""
-
-    def __init__(self, app: web.Application):
-        self._loop = asyncio.new_event_loop()
-        self._app = app
-        self.port = None
-        started = threading.Event()
-
-        def run():
-            asyncio.set_event_loop(self._loop)
-
-            async def boot():
-                self._runner = web.AppRunner(self._app)
-                await self._runner.setup()
-                site = web.TCPSite(self._runner, "127.0.0.1", 0)
-                await site.start()
-                self.port = self._runner.addresses[0][1]
-
-            self._loop.run_until_complete(boot())
-            started.set()
-            self._loop.run_forever()
-
-        self._thread = threading.Thread(target=run, daemon=True)
-        self._thread.start()
-        assert started.wait(timeout=30)
-
-    def close(self):
-        async def stop():
-            await self._runner.cleanup()
-            self._loop.stop()
-
-        asyncio.run_coroutine_threadsafe(stop(), self._loop)
-        self._thread.join(timeout=10)
-        self._loop.close()
+from tests.fixtures.servers import ThreadedApp as _ThreadedApp
 
 
 # ---------------------------------------------------------------------------

@@ -75,3 +75,47 @@ def test_inside_jit_with_grad(ctx):
     ref = jax.grad(lambda q, k, v: jnp.sum(causal_attention_reference(q, k, v) ** 2))(
         q, k, v)
     np.testing.assert_allclose(np.asarray(g), np.asarray(ref), rtol=5e-2, atol=5e-2)
+
+
+def test_long_context_matches_reference(ctx):
+    """Long-sequence parity: L=512 over a 4-way seq axis (128-token chunks
+    per device) — the long-context configuration BASELINE.md's flagship
+    trains at, checked against the single-device oracle."""
+    q, k, v = make_qkv(b=2, l=512, h=4, d=16, seed=3)
+    expected = causal_attention_reference(q, k, v)
+    sh = ctx.sharding("data", "seq", None, None)
+    qs, ks, vs = (jax.device_put(x, sh) for x in (q, k, v))
+    got = ring_attention_sharded(qs, ks, vs, ctx.mesh)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_flash_guard_block_selection():
+    """The flash/reference routing decision, tested directly (the in-path
+    platform check would mask the length guard on this CPU suite): short or
+    tile-unaligned L falls back, aligned L picks the largest dividing block."""
+    from incubator_predictionio_tpu.parallel.ring import flash_block_size
+
+    assert flash_block_size(32) is None          # too short
+    assert flash_block_size(129) is None         # not a multiple of 128
+    assert flash_block_size(255) is None
+    assert flash_block_size(256) == 256
+    assert flash_block_size(384) == 128          # 384 % 256 != 0
+    assert flash_block_size(512) == 512
+    assert flash_block_size(640) == 128          # the L=640 crash case
+    assert flash_block_size(768) == 256
+    assert flash_block_size(1024) == 512
+
+
+def test_causal_attention_fallback_matches_reference():
+    """On non-TPU platforms causal_attention IS the reference — exact
+    equality (flash would differ by bf16 rounding)."""
+    from incubator_predictionio_tpu.parallel.ring import causal_attention
+
+    for l in (32, 129):
+        rng = np.random.default_rng(l)
+        mk = lambda: jnp.asarray(rng.normal(size=(2, l, 2, 8)).astype(np.float32))
+        q, k, v = mk(), mk(), mk()
+        np.testing.assert_array_equal(
+            np.asarray(causal_attention(q, k, v)),
+            np.asarray(causal_attention_reference(q, k, v)))

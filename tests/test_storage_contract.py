@@ -32,12 +32,27 @@ def t(n):
 
 
 @pytest.fixture(params=["memory", "sqlite", "eventlog", "eventlog-pyfallback",
-                        "remote"])
+                        "remote", "elasticsearch"])
 def client(request, tmp_path, monkeypatch):
     if request.param == "memory":
         c = MemoryStorageClient({})
     elif request.param == "sqlite":
         c = SqliteStorageClient({"PATH": str(tmp_path / "pio.db")})
+    elif request.param == "elasticsearch":
+        # the REST client against an in-process ES protocol fake — exercises
+        # query-DSL construction + search_after pagination over a real socket
+        from incubator_predictionio_tpu.data.storage.elasticsearch import (
+            ESStorageClient,
+        )
+        from tests.fixtures.fake_es import make_es_app
+        from tests.fixtures.servers import ThreadedApp
+
+        server = ThreadedApp(make_es_app())
+        c = ESStorageClient({"URL": f"http://127.0.0.1:{server.port}"})
+        yield c
+        c.close()
+        server.close()
+        return
     elif request.param == "remote":
         # the full contract over a REAL socket: a storage server thread
         # backed by sqlite, exercised through the remote client
