@@ -56,6 +56,7 @@ class ESEvents(EventStore):
         self._url = url.rstrip("/")
         self._prefix = prefix
         self._timeout = timeout
+        self._initialized: set[str] = set()  # indices known to exist
         self._auth = None
         if username is not None:
             token = base64.b64encode(
@@ -97,6 +98,12 @@ class ESEvents(EventStore):
 
     # -- lifecycle --------------------------------------------------------
     def init(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        # memoized: the event server calls init before every ingest, and
+        # unlike the embedded backends' local CREATE IF NOT EXISTS this one
+        # is a remote round trip
+        index = self._index(app_id, channel_id)
+        if index in self._initialized:
+            return True
         mapping = {"mappings": {"properties": {
             "event": {"type": "keyword"},
             "entityType": {"type": "keyword"},
@@ -109,15 +116,18 @@ class ESEvents(EventStore):
             "doc": {"type": "object", "enabled": False},
         }}}
         try:
-            self._call("PUT", f"/{self._index(app_id, channel_id)}", mapping)
+            self._call("PUT", f"/{index}", mapping)
         except StorageError as e:
             if "resource_already_exists" not in str(e):
                 raise
+        self._initialized.add(index)
         return True
 
     def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        index = self._index(app_id, channel_id)
+        self._initialized.discard(index)
         try:
-            self._call("DELETE", f"/{self._index(app_id, channel_id)}")
+            self._call("DELETE", f"/{index}")
             return True
         except StorageError as e:
             if "index_not_found" in str(e) or " 404 " in str(e):

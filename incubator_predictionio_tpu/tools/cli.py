@@ -395,6 +395,8 @@ def cmd_start_all(args, storage: Storage) -> int:
         dashboard_port=args.dashboard_port,
         with_adminserver=args.with_adminserver,
         adminserver_port=args.adminserver_port,
+        with_storageserver=args.with_storageserver,
+        storageserver_port=args.storageserver_port,
         stats=args.stats,
         wait_secs=args.wait_secs,
     ))
@@ -746,6 +748,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dashboard-port", type=int, default=9000)
     p.add_argument("--with-adminserver", action="store_true")
     p.add_argument("--adminserver-port", type=int, default=7071)
+    p.add_argument("--with-storageserver", action="store_true")
+    p.add_argument("--storageserver-port", type=int, default=7072)
     p.add_argument("--stats", action="store_true")
     p.add_argument("--wait-secs", type=float, default=60.0)
     sub.add_parser("stop-all")

@@ -32,7 +32,7 @@ from typing import Optional
 
 logger = logging.getLogger(__name__)
 
-_DAEMONS = ("eventserver", "dashboard", "adminserver")
+_DAEMONS = ("eventserver", "dashboard", "adminserver", "storageserver")
 
 
 def _base_dir() -> str:
@@ -88,6 +88,9 @@ class StartAllConfig:
     dashboard_port: int = 9000
     with_adminserver: bool = False
     adminserver_port: int = 7071
+    # shared networked store for multi-host jobs (clients use TYPE=remote)
+    with_storageserver: bool = False
+    storageserver_port: int = 7072
     stats: bool = False
     wait_secs: float = 60.0  # first-boot waits may pay a jax import
 
@@ -139,6 +142,13 @@ def start_all(config: StartAllConfig) -> tuple[dict[str, int], list[str]]:
             "adminserver",
             ["adminserver", "--ip", config.ip, "--port", str(config.adminserver_port)],
             f"http://{health_host}:{config.adminserver_port}/",
+        ))
+    if config.with_storageserver:
+        plan.append((
+            "storageserver",
+            ["storageserver", "--ip", config.ip,
+             "--port", str(config.storageserver_port)],
+            f"http://{health_host}:{config.storageserver_port}/",
         ))
 
     health_urls: list[tuple[str, str]] = []

@@ -70,13 +70,17 @@ def test_start_all_spawn_plan(base_dir, monkeypatch):
     monkeypatch.setattr(ops, "_http_ok", lambda url, timeout=2.0: True)
     started, unhealthy = ops.start_all(ops.StartAllConfig(
         event_server_port=17070, with_dashboard=True, dashboard_port=19000,
-        with_adminserver=True, adminserver_port=17071, stats=True, wait_secs=5.0,
+        with_adminserver=True, adminserver_port=17071,
+        with_storageserver=True, storageserver_port=17072,
+        stats=True, wait_secs=5.0,
     ))
-    assert started == {"eventserver": 4242, "dashboard": 4242, "adminserver": 4242}
+    assert started == {"eventserver": 4242, "dashboard": 4242,
+                       "adminserver": 4242, "storageserver": 4242}
     assert unhealthy == []
     assert "17070" in spawned["eventserver"] and "--stats" in spawned["eventserver"]
     assert "--port" in spawned["dashboard"] and "19000" in spawned["dashboard"]
     assert "17071" in spawned["adminserver"]
+    assert "17072" in spawned["storageserver"]
 
 
 def test_start_all_reports_unhealthy_and_polls_bound_ip(base_dir, monkeypatch):
