@@ -98,9 +98,14 @@ class ESEvents(EventStore):
 
     # -- lifecycle --------------------------------------------------------
     def init(self, app_id: int, channel_id: Optional[int] = None) -> bool:
-        # memoized: the event server calls init before every ingest, and
+        # Memoized: the event server calls init before every ingest, and
         # unlike the embedded backends' local CREATE IF NOT EXISTS this one
-        # is a remote round trip
+        # is a remote round trip. The memo is dropped whenever a call for
+        # the index fails, so a recreated/missing index is re-initialized on
+        # the next attempt. Caveat (same as any explicit-mapping ES user):
+        # deleting an index outside the framework while writes are in flight
+        # can let ES auto-create it with dynamic mappings — re-run init (or
+        # restart the writer) after external index surgery.
         index = self._index(app_id, channel_id)
         if index in self._initialized:
             return True
@@ -159,13 +164,23 @@ class ESEvents(EventStore):
             "doc": e.to_json_dict(),
         }
 
+    def _drop_memo_on_error(self, index: str, exc: StorageError) -> None:
+        """A failed call may mean the index vanished — forget it so the next
+        init() re-creates the mapping instead of trusting the memo."""
+        self._initialized.discard(index)
+        raise exc
+
     def insert(self, event: Event, app_id: int,
                channel_id: Optional[int] = None) -> str:
         event_id = event.event_id or uuid4().hex
         idx = self._index(app_id, channel_id)
-        self._call(
-            "PUT", f"/{idx}/_doc/{self._quote_id(event_id)}?refresh=wait_for",
-            self._doc(event, event_id))
+        try:
+            self._call(
+                "PUT",
+                f"/{idx}/_doc/{self._quote_id(event_id)}?refresh=wait_for",
+                self._doc(event, event_id))
+        except StorageError as e:
+            self._drop_memo_on_error(idx, e)
         return event_id
 
     def insert_batch(self, events: Sequence[Event], app_id: int,
@@ -179,9 +194,12 @@ class ESEvents(EventStore):
             ids.append(event_id)
             lines.append(json.dumps({"index": {"_id": event_id}}))
             lines.append(json.dumps(self._doc(e, event_id)))
-        status, out = self._call(
-            "POST", f"/{idx}/_bulk?refresh=wait_for",
-            "\n".join(lines) + "\n", ndjson=True)
+        try:
+            status, out = self._call(
+                "POST", f"/{idx}/_bulk?refresh=wait_for",
+                "\n".join(lines) + "\n", ndjson=True)
+        except StorageError as e:
+            self._drop_memo_on_error(idx, e)
         if out.get("errors"):
             raise StorageError(f"elasticsearch bulk insert had errors: "
                                f"{json.dumps(out)[:2048]}")

@@ -397,6 +397,7 @@ def cmd_start_all(args, storage: Storage) -> int:
         adminserver_port=args.adminserver_port,
         with_storageserver=args.with_storageserver,
         storageserver_port=args.storageserver_port,
+        storageserver_access_key=args.storageserver_access_key,
         stats=args.stats,
         wait_secs=args.wait_secs,
     ))
@@ -750,6 +751,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--adminserver-port", type=int, default=7071)
     p.add_argument("--with-storageserver", action="store_true")
     p.add_argument("--storageserver-port", type=int, default=7072)
+    p.add_argument("--storageserver-access-key",
+                   help="shared secret required from remote storage clients")
     p.add_argument("--stats", action="store_true")
     p.add_argument("--wait-secs", type=float, default=60.0)
     sub.add_parser("stop-all")

@@ -91,6 +91,7 @@ class StartAllConfig:
     # shared networked store for multi-host jobs (clients use TYPE=remote)
     with_storageserver: bool = False
     storageserver_port: int = 7072
+    storageserver_access_key: Optional[str] = None  # shared client secret
     stats: bool = False
     wait_secs: float = 60.0  # first-boot waits may pay a jax import
 
@@ -147,7 +148,9 @@ def start_all(config: StartAllConfig) -> tuple[dict[str, int], list[str]]:
         plan.append((
             "storageserver",
             ["storageserver", "--ip", config.ip,
-             "--port", str(config.storageserver_port)],
+             "--port", str(config.storageserver_port)]
+            + (["--server-access-key", config.storageserver_access_key]
+               if config.storageserver_access_key else []),
             f"http://{health_host}:{config.storageserver_port}/",
         ))
 

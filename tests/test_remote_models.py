@@ -166,3 +166,84 @@ def test_s3_bad_credentials_rejected():
         s.close()
     finally:
         server.close()
+
+
+def test_train_deploy_flow_with_webhdfs_modeldata(tmp_path):
+    """The full workflow with MODELDATA on WebHDFS: train writes the model
+    blob through the namenode redirect, deploy fetches it back (the
+    reference's HDFSModels deployment topology, HDFSModels.scala:31-63)."""
+    import datetime as dt
+    import json as _json
+
+    from incubator_predictionio_tpu.core.workflow import run_train
+    from incubator_predictionio_tpu.data import DataMap, Event
+    from incubator_predictionio_tpu.data.storage import App
+    from incubator_predictionio_tpu.data.storage.base import EngineInstance
+    from incubator_predictionio_tpu.parallel.mesh import MeshContext
+    from incubator_predictionio_tpu.server.query_server import (
+        ServerConfig,
+        load_deployed_engine,
+    )
+    from incubator_predictionio_tpu.templates.recommendation import (
+        RecommendationEngine,
+    )
+
+    from incubator_predictionio_tpu.data.storage import use_storage
+
+    store: dict = {}
+    server = _ThreadedApp(make_webhdfs_app(store, {}))
+    unset = object()
+    prev = unset
+    try:
+        s = Storage({
+            "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+            "PIO_STORAGE_SOURCES_H_TYPE": "webhdfs",
+            "PIO_STORAGE_SOURCES_H_URL": f"http://127.0.0.1:{server.port}",
+            "PIO_STORAGE_SOURCES_H_PATH": "/pio/models",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "MEM",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "H",
+        })
+        prev = use_storage(s)  # PEventStore resolves the process singleton
+        app_id = s.get_meta_data_apps().insert(App(0, "hdfsapp"))
+        ev = s.get_events()
+        ev.init(app_id)
+        t0 = dt.datetime(2024, 1, 1, tzinfo=dt.timezone.utc)
+        ev.insert_batch([
+            Event(event="rate", entity_type="user", entity_id=f"u{i % 9}",
+                  target_entity_type="item", target_entity_id=f"i{i % 7}",
+                  properties=DataMap({"rating": float(1 + i % 5)}),
+                  event_time=t0)
+            for i in range(150)
+        ], app_id)
+
+        variant_path = tmp_path / "engine.json"
+        variant = {
+            "id": "hdfs-test", "version": "1",
+            "engineFactory": "incubator_predictionio_tpu.templates."
+                             "recommendation.RecommendationEngine",
+            "datasource": {"params": {"appName": "hdfsapp"}},
+            "algorithms": [{"name": "als", "params": {
+                "rank": 8, "numIterations": 2, "batchSize": 64}}],
+        }
+        variant_path.write_text(_json.dumps(variant))
+        ctx = MeshContext.create()
+        engine = RecommendationEngine().apply()
+        engine_params = engine.engine_params_from_variant(variant)
+        instance = EngineInstance(
+            id="", status="INIT", start_time=dt.datetime.now(dt.timezone.utc),
+            end_time=None, engine_id="hdfs-test", engine_version="1",
+            engine_variant=str(variant_path.resolve()),
+            engine_factory=variant["engineFactory"])
+        iid = run_train(engine, engine_params, instance, storage=s, ctx=ctx)
+        assert store and iid in store  # blob landed on "HDFS"
+
+        deployed = load_deployed_engine(
+            ServerConfig(engine_variant=str(variant_path)), s, ctx)
+        out = deployed.predict({"user": "u1", "num": 3})
+        assert len(out.item_scores) == 3
+        s.close()
+    finally:
+        if prev is not unset:  # only restore if we actually swapped
+            use_storage(prev)
+        server.close()
