@@ -547,6 +547,12 @@ def bench_serving(ctx) -> dict:
         port = free_port()
         client_script = _SERVING_CLIENT_SCRIPT
 
+        # gauge serving-only compiles: earlier configs in this process (e.g.
+        # the retrieval bench) already registered jit keys
+        from incubator_predictionio_tpu.utils import jitstats
+
+        jitstats.reset()
+
         async def drive() -> tuple[dict, dict]:
             server = QueryServer(
                 ServerConfig(engine_variant=variant_path, ip="127.0.0.1",
@@ -588,9 +594,10 @@ def bench_serving(ctx) -> dict:
             "server_p50_ms": round(
                 status["servingSecPercentiles"]["p50"] * 1e3, 2),
         }
-        # parity of the DEPLOYED scorer (the serving config runs the
-        # quantized Pallas path on TPU — assert it against the oracle here,
-        # not only in the synthetic retrieval bench)
+        # Pallas/oracle parity on the DEPLOYED model's factors. The bench
+        # catalog itself serves from the host fast path (small catalog); this
+        # asserts that had it been large enough for the device path, the
+        # quantized scorer agrees — on the trained weights, not synthetic ones
         import jax
 
         if jax.devices()[0].platform == "tpu":
