@@ -106,6 +106,10 @@ class MLPModel:
         self.params = jax.device_put(self.params)
         return self
 
+    def serving_info(self) -> dict:
+        """Status-page observability (see TwoTowerModel.serving_info)."""
+        return {"path": "device-params", "classes": len(self.classes)}
+
 
 class MLPClassifier:
     def __init__(self, config: MLPConfig = MLPConfig()):

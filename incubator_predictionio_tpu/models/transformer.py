@@ -166,6 +166,12 @@ class TransformerModel:
         self.params = jax.device_put(self.params)
         return self
 
+    def serving_info(self) -> dict:
+        """Status-page observability (see TwoTowerModel.serving_info)."""
+        return {"path": "device-params",
+                "vocab": self.config.vocab_size,
+                "max_len": self.config.max_len}
+
 
 class TransformerRecommender:
     def __init__(self, config: TransformerConfig):

@@ -179,6 +179,19 @@ class TwoTowerModel:
     def n_items(self) -> int:
         return self.item_emb.shape[0]
 
+    def serving_info(self) -> dict:
+        """Which serving path this model runs (status-page observability)."""
+        if self._device_items_q is not None:
+            path = "device-int8-pallas"
+        elif self._device_items is not None:
+            path = "device-bf16"
+        elif self._host_items is not None:
+            path = "host-numpy"
+        else:
+            path = "unprepared"
+        return {"path": path, "serve_k": self._serve_k,
+                "catalog_rows": self.n_items}
+
 
 class TwoTowerMF:
     def __init__(self, config: TwoTowerConfig = TwoTowerConfig()):

@@ -350,6 +350,12 @@ class QueryServer:
                 "startTime": inst.start_time.isoformat(),
             },
             "algorithms": [type(a).__name__ for a in self.deployed.algorithms],
+            # which execution path each model serves from (host numpy for
+            # small catalogs, device bf16 / int8-pallas for large ones)
+            "servingPaths": [
+                m.serving_info() if hasattr(m, "serving_info") else None
+                for m in self.deployed.models
+            ],
             "requestCount": self.request_count,
             "avgServingSec": self.avg_serving_sec,
             "lastServingSec": self.last_serving_sec,

@@ -188,6 +188,9 @@ class ECommModel:
         self.mf.prepare_for_serving()
         return self
 
+    def serving_info(self) -> dict:
+        return self.mf.serving_info()
+
 
 class ECommAlgorithm(PAlgorithm):
     params_class = ECommAlgorithmParams

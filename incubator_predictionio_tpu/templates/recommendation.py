@@ -342,6 +342,9 @@ class RecModel:
         """Pre-compile every serving batch bucket (called at deploy)."""
         return self.mf.warmup(max_batch)
 
+    def serving_info(self) -> dict:
+        return self.mf.serving_info()
+
 
 class ALSAlgorithm(PAlgorithm):
     """MLlib ALS slot (ALSAlgorithm.scala:50-93) filled by two-tower MF."""

@@ -200,6 +200,10 @@ class ItemSimModel:
         self._device_vt = jax.device_put(np.ascontiguousarray(self.item_vecs.T))
         return self
 
+    def serving_info(self) -> dict:
+        """Status-page observability (see TwoTowerModel.serving_info)."""
+        return {"path": "device-bf16", "catalog_rows": len(self.item_map)}
+
 
 def _category_mask(model: ItemSimModel, query: Query) -> np.ndarray:
     """-inf mask implementing whitelist/blacklist/category filters + query-item

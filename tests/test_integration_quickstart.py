@@ -123,9 +123,10 @@ def test_quickstart_full_flow(isolated_storage, tmp_path):
             # reload picks the same latest instance
             resp = await client.post("/reload?accessKey=sk")
             assert (await resp.json())["engineInstanceId"] == instance_id
-            # status page reflects traffic
+            # status page reflects traffic + the serving execution path
             status = await (await client.get("/")).json()
             assert status["requestCount"] == 16
+            assert status["servingPaths"][0]["path"] == "device-params"
         finally:
             await client.close()
 

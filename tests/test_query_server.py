@@ -159,6 +159,9 @@ def test_latency_percentiles_on_status(deployed_env):
         pcts = status["servingSecPercentiles"]
         assert set(pcts) == {"p50", "p95", "p99"}
         assert 0 < pcts["p50"] <= pcts["p95"] <= pcts["p99"]
+        # serving-path observability row exists per deployed model
+        assert len(status["servingPaths"]) == 1
+        assert status["servingPaths"][0]["path"] == "device-params"
 
     run_server(deployed_env, t)
 
