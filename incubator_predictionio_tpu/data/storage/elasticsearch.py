@@ -1,4 +1,4 @@
-"""Elasticsearch EVENTDATA backend — the reference's ES backend over plain REST.
+"""Elasticsearch backend — the reference's ES backend over plain REST.
 
 Parity target: storage/elasticsearch/.../ESLEvents.scala:41-… (index per
 app/channel, document per event, range/term filtered search sorted by event
@@ -13,13 +13,22 @@ Config (``PIO_STORAGE_SOURCES_<NAME>_*``):
 
 - ``TYPE=elasticsearch``
 - ``URL=http://es-host:9200``
-- ``INDEX_PREFIX=pio_event``   (index name: ``<prefix>_<app>[_<channel>]``)
+- ``INDEX_PREFIX=pio_event``   (event index name: ``<prefix>_<app>[_<channel>]``)
+- ``META_INDEX_PREFIX=pio_meta`` (metadata/model indices, see below)
 - ``USERNAME`` / ``PASSWORD``  (optional basic auth)
 - ``TIMEOUT=60``
 
-Scope: EVENTDATA (the reference's ES backend also serves metadata in
-ES-default deployments; metadata/models here ride sqlite or the storage
-server — see COMPONENTS.md §2.4).
+Scope: EVENTDATA + METADATA + MODELDATA. The reference's ES backend serves
+events and all five metadata DAOs (ESApps/ESAccessKeys/ESChannels/
+ESEngineInstances/ESEvaluationInstances, with ESSequences `_version`-based id
+generation — ESSequences.scala:52-75); it has no ESModels, so the models
+store here is an extension (blob documents, base64 in ``_source``) that lets
+an ES deployment run every repository off one service the way the
+reference's default-PostgreSQL topology does.
+
+Metadata indices live under ``META_INDEX_PREFIX`` (default ``pio_meta``):
+``<prefix>_apps``, ``_access_keys``, ``_channels``, ``_engine_instances``,
+``_evaluation_instances``, ``_models``, ``_sequences``.
 
 Writes use ``refresh=wait_for`` so the store honors the read-your-writes
 behavior the storage contract (and the reference's tests) assume.
@@ -28,10 +37,12 @@ behavior the storage contract (and the reference's tests) assume.
 from __future__ import annotations
 
 import base64
+import dataclasses
 import datetime as _dt
 import json
 import logging
 import urllib.error
+import urllib.parse
 import urllib.request
 from typing import Any, Iterator, Optional, Sequence
 from uuid import uuid4
@@ -39,9 +50,27 @@ from uuid import uuid4
 from incubator_predictionio_tpu.data.event import Event
 from incubator_predictionio_tpu.data.storage.base import (
     UNSET,
+    AccessKey,
+    AccessKeysStore,
+    App,
+    AppsStore,
+    Channel,
+    ChannelsStore,
+    EngineInstance,
+    EngineInstancesStore,
+    EvaluationInstance,
+    EvaluationInstancesStore,
     EventStore,
+    Model,
+    ModelsStore,
     StorageClient,
     StorageError,
+)
+from incubator_predictionio_tpu.data.storage.wire import (
+    dec_engine_instance,
+    dec_evaluation_instance,
+    enc_engine_instance,
+    enc_evaluation_instance,
 )
 
 logger = logging.getLogger(__name__)
@@ -49,23 +78,39 @@ logger = logging.getLogger(__name__)
 _PAGE = 1000  # search_after page size
 
 
-class ESEvents(EventStore):
-    def __init__(self, url: str, prefix: str, timeout: float,
+def _quote(doc_id: str) -> str:
+    """Ids are client-suppliable; percent-encode so an id like ``a/b`` or
+    ``x?pretty`` can't change the route or the query string."""
+    return urllib.parse.quote(doc_id, safe="")
+
+
+class _Transport:
+    """One ES endpoint: HTTP plumbing + memoized index creation.
+
+    The memo matters because (unlike the embedded backends' local CREATE IF
+    NOT EXISTS) every ensure here is a remote round trip, and the event
+    server calls init before every ingest. It is dropped whenever a call for
+    the index fails, so a recreated/missing index is re-initialized on the
+    next attempt. Caveat (same as any explicit-mapping ES user): deleting an
+    index outside the framework while writes are in flight can let ES
+    auto-create it with dynamic mappings — re-run init (or restart the
+    writer) after external index surgery.
+    """
+
+    def __init__(self, url: str, timeout: float,
                  username: Optional[str] = None,
                  password: Optional[str] = None):
         self._url = url.rstrip("/")
-        self._prefix = prefix
         self._timeout = timeout
-        self._initialized: set[str] = set()  # indices known to exist
         self._auth = None
         if username is not None:
             token = base64.b64encode(
                 f"{username}:{password or ''}".encode()).decode()
             self._auth = f"Basic {token}"
+        self._known: set[str] = set()  # indices known to exist
 
-    # -- transport --------------------------------------------------------
-    def _call(self, method: str, path: str, body: Any = None,
-              ndjson: bool = False, ok_codes: Sequence[int] = (200, 201)):
+    def call(self, method: str, path: str, body: Any = None,
+             ndjson: bool = False, ok_codes: Sequence[int] = (200, 201)):
         url = f"{self._url}{path}"
         data = None
         if body is not None:
@@ -92,23 +137,37 @@ class ESEvents(EventStore):
         except (urllib.error.URLError, OSError) as e:
             raise StorageError(f"elasticsearch unreachable: {e}") from e
 
+    def ensure(self, index: str, mapping: dict) -> None:
+        if index in self._known:
+            return
+        try:
+            self.call("PUT", f"/{index}", mapping)
+        except StorageError as e:
+            if "resource_already_exists" not in str(e):
+                raise
+        self._known.add(index)
+
+    def forget(self, index: str) -> None:
+        """A failed call may mean the index vanished — drop the memo so the
+        next ensure() re-creates the mapping instead of trusting it."""
+        self._known.discard(index)
+
+
+# ---------------------------------------------------------------------------
+# EVENTDATA
+# ---------------------------------------------------------------------------
+
+class ESEvents(EventStore):
+    def __init__(self, transport: _Transport, prefix: str):
+        self._t = transport
+        self._prefix = prefix
+
     def _index(self, app_id: int, channel_id: Optional[int]) -> str:
         return (f"{self._prefix}_{app_id}"
                 + (f"_{channel_id}" if channel_id is not None else ""))
 
     # -- lifecycle --------------------------------------------------------
     def init(self, app_id: int, channel_id: Optional[int] = None) -> bool:
-        # Memoized: the event server calls init before every ingest, and
-        # unlike the embedded backends' local CREATE IF NOT EXISTS this one
-        # is a remote round trip. The memo is dropped whenever a call for
-        # the index fails, so a recreated/missing index is re-initialized on
-        # the next attempt. Caveat (same as any explicit-mapping ES user):
-        # deleting an index outside the framework while writes are in flight
-        # can let ES auto-create it with dynamic mappings — re-run init (or
-        # restart the writer) after external index surgery.
-        index = self._index(app_id, channel_id)
-        if index in self._initialized:
-            return True
         mapping = {"mappings": {"properties": {
             "event": {"type": "keyword"},
             "entityType": {"type": "keyword"},
@@ -120,19 +179,14 @@ class ESEvents(EventStore):
             # the full event JSON rides as an unindexed source field
             "doc": {"type": "object", "enabled": False},
         }}}
-        try:
-            self._call("PUT", f"/{index}", mapping)
-        except StorageError as e:
-            if "resource_already_exists" not in str(e):
-                raise
-        self._initialized.add(index)
+        self._t.ensure(self._index(app_id, channel_id), mapping)
         return True
 
     def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
         index = self._index(app_id, channel_id)
-        self._initialized.discard(index)
+        self._t.forget(index)
         try:
-            self._call("DELETE", f"/{index}")
+            self._t.call("DELETE", f"/{index}")
             return True
         except StorageError as e:
             if "index_not_found" in str(e) or " 404 " in str(e):
@@ -140,14 +194,6 @@ class ESEvents(EventStore):
             raise
 
     # -- CRUD -------------------------------------------------------------
-    @staticmethod
-    def _quote_id(event_id: str) -> str:
-        """Ids are client-suppliable; percent-encode so an id like ``a/b``
-        or ``x?pretty`` can't change the route or the query string."""
-        import urllib.parse
-
-        return urllib.parse.quote(event_id, safe="")
-
     def _doc(self, event: Event, event_id: str) -> dict:
         e = event.with_id(event_id)
         return {
@@ -164,23 +210,18 @@ class ESEvents(EventStore):
             "doc": e.to_json_dict(),
         }
 
-    def _drop_memo_on_error(self, index: str, exc: StorageError) -> None:
-        """A failed call may mean the index vanished — forget it so the next
-        init() re-creates the mapping instead of trusting the memo."""
-        self._initialized.discard(index)
-        raise exc
-
     def insert(self, event: Event, app_id: int,
                channel_id: Optional[int] = None) -> str:
         event_id = event.event_id or uuid4().hex
         idx = self._index(app_id, channel_id)
         try:
-            self._call(
+            self._t.call(
                 "PUT",
-                f"/{idx}/_doc/{self._quote_id(event_id)}?refresh=wait_for",
+                f"/{idx}/_doc/{_quote(event_id)}?refresh=wait_for",
                 self._doc(event, event_id))
-        except StorageError as e:
-            self._drop_memo_on_error(idx, e)
+        except StorageError:
+            self._t.forget(idx)
+            raise
         return event_id
 
     def insert_batch(self, events: Sequence[Event], app_id: int,
@@ -195,11 +236,12 @@ class ESEvents(EventStore):
             lines.append(json.dumps({"index": {"_id": event_id}}))
             lines.append(json.dumps(self._doc(e, event_id)))
         try:
-            status, out = self._call(
+            status, out = self._t.call(
                 "POST", f"/{idx}/_bulk?refresh=wait_for",
                 "\n".join(lines) + "\n", ndjson=True)
-        except StorageError as e:
-            self._drop_memo_on_error(idx, e)
+        except StorageError:
+            self._t.forget(idx)
+            raise
         if out.get("errors"):
             raise StorageError(f"elasticsearch bulk insert had errors: "
                                f"{json.dumps(out)[:2048]}")
@@ -208,8 +250,8 @@ class ESEvents(EventStore):
     def get(self, event_id: str, app_id: int,
             channel_id: Optional[int] = None) -> Optional[Event]:
         idx = self._index(app_id, channel_id)
-        status, out = self._call(
-            "GET", f"/{idx}/_doc/{self._quote_id(event_id)}",
+        status, out = self._t.call(
+            "GET", f"/{idx}/_doc/{_quote(event_id)}",
             ok_codes=(200, 404))
         if status == 404 or not out.get("found"):
             return None
@@ -218,9 +260,9 @@ class ESEvents(EventStore):
     def delete(self, event_id: str, app_id: int,
                channel_id: Optional[int] = None) -> bool:
         idx = self._index(app_id, channel_id)
-        status, out = self._call(
+        status, out = self._t.call(
             "DELETE",
-            f"/{idx}/_doc/{self._quote_id(event_id)}?refresh=wait_for",
+            f"/{idx}/_doc/{_quote(event_id)}?refresh=wait_for",
             ok_codes=(200, 404))
         return out.get("result") == "deleted"
 
@@ -280,7 +322,7 @@ class ESEvents(EventStore):
                 body = {"query": query, "sort": sort, "size": size}
                 if search_after is not None:
                     body["search_after"] = search_after
-                _, out = self._call("POST", f"/{idx}/_search", body)
+                _, out = self._t.call("POST", f"/{idx}/_search", body)
                 hits = out.get("hits", {}).get("hits", [])
                 if not hits:
                     return
@@ -298,8 +340,371 @@ class ESEvents(EventStore):
             yield Event.from_json_dict(hit["_source"]["doc"])
 
 
+# ---------------------------------------------------------------------------
+# METADATA / MODELDATA
+# ---------------------------------------------------------------------------
+
+class _ESSequences:
+    """Monotonic id generator: the ``_version`` of a repeatedly re-indexed
+    per-name document IS the sequence value (ESSequences.scala:52-75)."""
+
+    def __init__(self, transport: _Transport, index: str):
+        self._t = transport
+        self._index = index
+
+    def gen_next(self, name: str) -> int:
+        self._t.ensure(self._index, {"mappings": {"properties": {
+            "n": {"type": "keyword", "index": False}}}})
+        try:
+            _, out = self._t.call(
+                "PUT", f"/{self._index}/_doc/{_quote(name)}", {"n": name})
+        except StorageError:
+            self._t.forget(self._index)
+            raise
+        version = out.get("_version")
+        if version is None:
+            raise StorageError(
+                f"elasticsearch did not return _version for sequence {name}: "
+                f"{json.dumps(out)[:512]}")
+        return int(version)
+
+
+class _ESMetaIndex:
+    """One metadata index: ensured mapping + doc CRUD + filtered search.
+
+    All reads that go through ``_search`` rely on the write path's
+    ``refresh=wait_for`` for read-your-writes.
+    """
+
+    def __init__(self, transport: _Transport, index: str, mapping: dict,
+                 sort_field: str):
+        self._t = transport
+        self._index = index
+        self._mapping = {"mappings": {"properties": mapping}}
+        self._sort_field = sort_field
+
+    def put(self, doc_id: str, source: dict, create: bool = False) -> bool:
+        """Index a document; with ``create=True`` fail (return False) if the
+        id already exists (ES ``op_type=create`` → 409 version conflict)."""
+        self._t.ensure(self._index, self._mapping)
+        params = "?refresh=wait_for" + ("&op_type=create" if create else "")
+        try:
+            status, out = self._t.call(
+                "PUT", f"/{self._index}/_doc/{_quote(doc_id)}{params}",
+                source, ok_codes=(200, 201, 409))
+        except StorageError:
+            self._t.forget(self._index)
+            raise
+        if status == 409:
+            return False
+        return True
+
+    def get(self, doc_id: str) -> Optional[dict]:
+        self._t.ensure(self._index, self._mapping)
+        status, out = self._t.call(
+            "GET", f"/{self._index}/_doc/{_quote(doc_id)}",
+            ok_codes=(200, 404))
+        if status == 404 or not out.get("found"):
+            return None
+        return out["_source"]
+
+    def delete(self, doc_id: str) -> bool:
+        self._t.ensure(self._index, self._mapping)
+        status, out = self._t.call(
+            "DELETE", f"/{self._index}/_doc/{_quote(doc_id)}?refresh=wait_for",
+            ok_codes=(200, 404))
+        return out.get("result") == "deleted"
+
+    def search(self, filters: Sequence[dict] = ()) -> Iterator[dict]:
+        """All matching sources, search_after-paginated, ordered by the
+        index's unique sort field (metadata sets are small; the pagination
+        is for contract-correctness, not scale)."""
+        self._t.ensure(self._index, self._mapping)
+        query = {"bool": {"filter": list(filters)}}
+        sort = [{self._sort_field: "asc"}]
+        search_after = None
+        while True:
+            body = {"query": query, "sort": sort, "size": _PAGE}
+            if search_after is not None:
+                body["search_after"] = search_after
+            try:
+                _, out = self._t.call("POST", f"/{self._index}/_search", body)
+            except StorageError:
+                # the index may have vanished (external surgery) — drop the
+                # memo so the next call's ensure() re-creates it
+                self._t.forget(self._index)
+                raise
+            hits = out.get("hits", {}).get("hits", [])
+            for hit in hits:
+                yield hit["_source"]
+            if len(hits) < _PAGE:
+                return
+            search_after = hits[-1]["sort"]
+
+
+class ESApps(AppsStore):
+    """ESApps.scala:39-… (sequence-generated int ids, term query by name)."""
+
+    def __init__(self, transport: _Transport, prefix: str, seq: _ESSequences):
+        self._idx = _ESMetaIndex(transport, f"{prefix}_apps", {
+            "id": {"type": "long"},
+            "name": {"type": "keyword"},
+            "description": {"type": "keyword", "index": False},
+        }, sort_field="id")
+        self._seq = seq
+
+    def insert(self, app: App) -> Optional[int]:
+        if self.get_by_name(app.name) is not None:
+            return None
+        app_id = app.id
+        if not app_id:
+            # skip sequence values already taken by explicit-id inserts
+            # (ESApps.scala:56-70's generateId loop)
+            while True:
+                app_id = self._seq.gen_next("apps")
+                if self.get(app_id) is None:
+                    break
+        elif self.get(app_id) is not None:
+            return None
+        self._idx.put(str(app_id), self._src(dataclasses.replace(app, id=app_id)))
+        return app_id
+
+    @staticmethod
+    def _src(app: App) -> dict:
+        return {"id": app.id, "name": app.name, "description": app.description}
+
+    @staticmethod
+    def _app(src: dict) -> App:
+        return App(src["id"], src["name"], src.get("description"))
+
+    def get(self, app_id: int) -> Optional[App]:
+        src = self._idx.get(str(app_id))
+        return self._app(src) if src else None
+
+    def get_by_name(self, name: str) -> Optional[App]:
+        for src in self._idx.search([{"term": {"name": name}}]):
+            return self._app(src)
+        return None
+
+    def get_all(self) -> list[App]:
+        return [self._app(s) for s in self._idx.search()]
+
+    def update(self, app: App) -> bool:
+        # update-on-missing returns False like the embedded backends
+        # (memory.py / sqlite UPDATE rowcount) — no ghost documents
+        if self.get(app.id) is None:
+            return False
+        return self._idx.put(str(app.id), self._src(app))
+
+    def delete(self, app_id: int) -> bool:
+        return self._idx.delete(str(app_id))
+
+
+class ESAccessKeys(AccessKeysStore):
+    """ESAccessKeys.scala (key-addressed docs, term query by appid)."""
+
+    def __init__(self, transport: _Transport, prefix: str):
+        self._idx = _ESMetaIndex(transport, f"{prefix}_access_keys", {
+            "key": {"type": "keyword"},
+            "appId": {"type": "long"},
+            "events": {"type": "keyword"},
+        }, sort_field="key")
+
+    def insert(self, access_key: AccessKey) -> Optional[str]:
+        key = access_key.key or self.generate_key()
+        created = self._idx.put(
+            key, {"key": key, "appId": access_key.app_id,
+                  "events": list(access_key.events)}, create=True)
+        return key if created else None
+
+    @staticmethod
+    def _ak(src: dict) -> AccessKey:
+        return AccessKey(src["key"], src["appId"], tuple(src.get("events") or ()))
+
+    def get(self, key: str) -> Optional[AccessKey]:
+        src = self._idx.get(key)
+        return self._ak(src) if src else None
+
+    def get_all(self) -> list[AccessKey]:
+        return [self._ak(s) for s in self._idx.search()]
+
+    def get_by_app_id(self, app_id: int) -> list[AccessKey]:
+        return [self._ak(s)
+                for s in self._idx.search([{"term": {"appId": app_id}}])]
+
+    def update(self, access_key: AccessKey) -> bool:
+        if self.get(access_key.key) is None:
+            return False
+        return self._idx.put(
+            access_key.key, {"key": access_key.key, "appId": access_key.app_id,
+                             "events": list(access_key.events)})
+
+    def delete(self, key: str) -> bool:
+        return self._idx.delete(key)
+
+
+class ESChannels(ChannelsStore):
+    """ESChannels.scala (sequence-generated int ids, term query by appid)."""
+
+    def __init__(self, transport: _Transport, prefix: str, seq: _ESSequences):
+        self._idx = _ESMetaIndex(transport, f"{prefix}_channels", {
+            "id": {"type": "long"},
+            "name": {"type": "keyword"},
+            "appId": {"type": "long"},
+        }, sort_field="id")
+        self._seq = seq
+
+    def insert(self, channel: Channel) -> Optional[int]:
+        if not Channel.is_valid_name(channel.name):
+            return None
+        channel_id = channel.id
+        if not channel_id:
+            while True:
+                channel_id = self._seq.gen_next("channels")
+                if self.get(channel_id) is None:
+                    break
+        elif self.get(channel_id) is not None:
+            return None
+        self._idx.put(str(channel_id), {
+            "id": channel_id, "name": channel.name, "appId": channel.app_id})
+        return channel_id
+
+    @staticmethod
+    def _ch(src: dict) -> Channel:
+        return Channel(src["id"], src["name"], src["appId"])
+
+    def get(self, channel_id: int) -> Optional[Channel]:
+        src = self._idx.get(str(channel_id))
+        return self._ch(src) if src else None
+
+    def get_by_app_id(self, app_id: int) -> list[Channel]:
+        return [self._ch(s)
+                for s in self._idx.search([{"term": {"appId": app_id}}])]
+
+    def delete(self, channel_id: int) -> bool:
+        return self._idx.delete(str(channel_id))
+
+
+class ESEngineInstances(EngineInstancesStore):
+    """ESEngineInstances.scala — searchable status/engine triple + start time;
+    the full record rides as an unindexed ``doc`` field (wire encoding)."""
+
+    def __init__(self, transport: _Transport, prefix: str):
+        self._idx = _ESMetaIndex(transport, f"{prefix}_engine_instances", {
+            "id": {"type": "keyword"},
+            "status": {"type": "keyword"},
+            "engineId": {"type": "keyword"},
+            "engineVersion": {"type": "keyword"},
+            "engineVariant": {"type": "keyword"},
+            "startTimeMillis": {"type": "long"},
+            "doc": {"type": "object", "enabled": False},
+        }, sort_field="id")
+
+    @staticmethod
+    def _src(i: EngineInstance) -> dict:
+        return {
+            "id": i.id,
+            "status": i.status,
+            "engineId": i.engine_id,
+            "engineVersion": i.engine_version,
+            "engineVariant": i.engine_variant,
+            "startTimeMillis": int(i.start_time.timestamp() * 1000),
+            "doc": enc_engine_instance(i),
+        }
+
+    def insert(self, instance: EngineInstance) -> str:
+        instance_id = instance.id or uuid4().hex
+        i = dataclasses.replace(instance, id=instance_id)
+        self._idx.put(instance_id, self._src(i))
+        return instance_id
+
+    def get(self, instance_id: str) -> Optional[EngineInstance]:
+        src = self._idx.get(instance_id)
+        return dec_engine_instance(src["doc"]) if src else None
+
+    def get_all(self) -> list[EngineInstance]:
+        return [dec_engine_instance(s["doc"]) for s in self._idx.search()]
+
+    def update(self, instance: EngineInstance) -> bool:
+        if not instance.id or self._idx.get(instance.id) is None:
+            return False
+        return self._idx.put(instance.id, self._src(instance))
+
+    def delete(self, instance_id: str) -> bool:
+        return self._idx.delete(instance_id)
+
+
+class ESEvaluationInstances(EvaluationInstancesStore):
+    """ESEvaluationInstances.scala — same layout as engine instances."""
+
+    def __init__(self, transport: _Transport, prefix: str):
+        self._idx = _ESMetaIndex(transport, f"{prefix}_evaluation_instances", {
+            "id": {"type": "keyword"},
+            "status": {"type": "keyword"},
+            "startTimeMillis": {"type": "long"},
+            "doc": {"type": "object", "enabled": False},
+        }, sort_field="id")
+
+    @staticmethod
+    def _src(i: EvaluationInstance) -> dict:
+        return {
+            "id": i.id,
+            "status": i.status,
+            "startTimeMillis": int(i.start_time.timestamp() * 1000),
+            "doc": enc_evaluation_instance(i),
+        }
+
+    def insert(self, instance: EvaluationInstance) -> str:
+        instance_id = instance.id or uuid4().hex
+        i = dataclasses.replace(instance, id=instance_id)
+        self._idx.put(instance_id, self._src(i))
+        return instance_id
+
+    def get(self, instance_id: str) -> Optional[EvaluationInstance]:
+        src = self._idx.get(instance_id)
+        return dec_evaluation_instance(src["doc"]) if src else None
+
+    def get_all(self) -> list[EvaluationInstance]:
+        return [dec_evaluation_instance(s["doc"]) for s in self._idx.search()]
+
+    def update(self, instance: EvaluationInstance) -> bool:
+        if not instance.id or self._idx.get(instance.id) is None:
+            return False
+        return self._idx.put(instance.id, self._src(instance))
+
+    def delete(self, instance_id: str) -> bool:
+        return self._idx.delete(instance_id)
+
+
+class ESModels(ModelsStore):
+    """Model blobs as base64 ``binary``-typed documents. The reference has no
+    ESModels (models ride jdbc/localfs/hdfs/s3 there); this extension keeps a
+    pure-ES deployment single-service."""
+
+    def __init__(self, transport: _Transport, prefix: str):
+        self._idx = _ESMetaIndex(transport, f"{prefix}_models", {
+            "id": {"type": "keyword"},
+            "models": {"type": "binary"},
+        }, sort_field="id")
+
+    def insert(self, model: Model) -> None:
+        self._idx.put(model.id, {
+            "id": model.id,
+            "models": base64.b64encode(model.models).decode(),
+        })
+
+    def get(self, model_id: str) -> Optional[Model]:
+        src = self._idx.get(model_id)
+        if src is None:
+            return None
+        return Model(model_id, base64.b64decode(src["models"]))
+
+    def delete(self, model_id: str) -> bool:
+        return self._idx.delete(model_id)
+
+
 class ESStorageClient(StorageClient):
-    """EVENTDATA over the Elasticsearch REST API."""
+    """EVENTDATA + METADATA + MODELDATA over the Elasticsearch REST API."""
 
     def __init__(self, config: dict[str, str]):
         super().__init__(config)
@@ -308,13 +713,39 @@ class ESStorageClient(StorageClient):
             hosts = config.get("HOSTS", "localhost")
             ports = config.get("PORTS", "9200")
             url = f"http://{hosts.split(',')[0]}:{ports.split(',')[0]}"
-        self._events = ESEvents(
+        t = _Transport(
             url,
-            config.get("INDEX_PREFIX", "pio_event"),
             float(config.get("TIMEOUT", "60")),
             username=config.get("USERNAME"),
             password=config.get("PASSWORD"),
         )
+        meta = config.get("META_INDEX_PREFIX", "pio_meta")
+        seq = _ESSequences(t, f"{meta}_sequences")
+        self._events = ESEvents(t, config.get("INDEX_PREFIX", "pio_event"))
+        self._apps = ESApps(t, meta, seq)
+        self._access_keys = ESAccessKeys(t, meta)
+        self._channels = ESChannels(t, meta, seq)
+        self._engine_instances = ESEngineInstances(t, meta)
+        self._evaluation_instances = ESEvaluationInstances(t, meta)
+        self._models = ESModels(t, meta)
 
     def events(self) -> EventStore:
         return self._events
+
+    def apps(self) -> AppsStore:
+        return self._apps
+
+    def access_keys(self) -> AccessKeysStore:
+        return self._access_keys
+
+    def channels(self) -> ChannelsStore:
+        return self._channels
+
+    def engine_instances(self) -> EngineInstancesStore:
+        return self._engine_instances
+
+    def evaluation_instances(self) -> EvaluationInstancesStore:
+        return self._evaluation_instances
+
+    def models(self) -> ModelsStore:
+        return self._models

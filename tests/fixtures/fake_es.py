@@ -17,6 +17,7 @@ from aiohttp import web
 
 def make_es_app():
     indices: dict[str, dict] = {}  # index -> {doc_id: source}
+    versions: dict[str, dict] = {}  # index -> {doc_id: version counter}
     app = web.Application()
 
     def es_error(status: int, err_type: str) -> web.Response:
@@ -36,17 +37,24 @@ def make_es_app():
         if name not in indices:
             return es_error(404, "index_not_found_exception")
         del indices[name]
+        versions.pop(name, None)
         return web.json_response({"acknowledged": True})
 
     async def put_doc(request: web.Request):
-        idx = indices.get(request.match_info["index"])
+        name = request.match_info["index"]
+        idx = indices.get(name)
         if idx is None:
             return es_error(404, "index_not_found_exception")
         doc_id = request.match_info["id"]
         created = doc_id not in idx
+        if not created and request.query.get("op_type") == "create":
+            return es_error(409, "version_conflict_engine_exception")
         idx[doc_id] = await request.json()
+        ver = versions.setdefault(name, {})
+        ver[doc_id] = ver.get(doc_id, 0) + 1
         return web.json_response(
-            {"result": "created" if created else "updated", "_id": doc_id},
+            {"result": "created" if created else "updated", "_id": doc_id,
+             "_version": ver[doc_id]},
             status=201 if created else 200)
 
     async def bulk(request: web.Request):

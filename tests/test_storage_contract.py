@@ -254,6 +254,23 @@ class TestMetaContract:
         assert [x.id for x in evi.get_completed()] == [iid]
         assert evi.delete(iid) and evi.get(iid) is None
 
+    def test_update_on_missing_returns_false(self, meta_client):
+        """update() must not upsert: no ghost records, False returned."""
+        assert meta_client.apps().update(App(999, "ghost", None)) is False
+        assert meta_client.apps().get(999) is None
+        assert meta_client.access_keys().update(AccessKey("nokey", 1)) is False
+        assert meta_client.access_keys().get("nokey") is None
+        inst = EngineInstance(
+            id="missing", status="COMPLETED", start_time=t(1), end_time=None,
+            engine_id="e", engine_version="1", engine_variant="v",
+            engine_factory="f")
+        assert meta_client.engine_instances().update(inst) is False
+        assert meta_client.engine_instances().get("missing") is None
+        evi = EvaluationInstance(id="missing", status="EVALCOMPLETED",
+                                 start_time=t(1), end_time=None)
+        assert meta_client.evaluation_instances().update(evi) is False
+        assert meta_client.evaluation_instances().get("missing") is None
+
     def test_models(self, meta_client):
         models = meta_client.models()
         blob = b"\x00\x01binary\xff" * 100
