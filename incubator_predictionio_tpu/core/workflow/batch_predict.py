@@ -60,7 +60,8 @@ def run_batch_predict(
                 for i, p in algo.batch_predict(model, chunk):
                     per_query[i].append(p)
             for (_, q), preds in zip(chunk, per_query):
-                fout.write(json.dumps(to_jsonable(serving.serve(q, preds))) + "\n")
+                fout.write(json.dumps(to_jsonable(
+                    serving.serve(q, preds), camelize_fields=True)) + "\n")
                 n += 1
     logger.info("batch predict: %d queries → %s", n, config.output_path)
     return n

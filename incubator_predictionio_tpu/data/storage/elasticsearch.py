@@ -84,6 +84,14 @@ def _quote(doc_id: str) -> str:
     return urllib.parse.quote(doc_id, safe="")
 
 
+def _millis(t: _dt.datetime) -> int:
+    """Epoch millis with naive datetimes read as UTC (the Event layer's rule,
+    data/event.py) — never the writer host's local timezone."""
+    if t.tzinfo is None:
+        t = t.replace(tzinfo=_dt.timezone.utc)
+    return int(t.timestamp() * 1000)
+
+
 class _Transport:
     """One ES endpoint: HTTP plumbing + memoized index creation.
 
@@ -399,6 +407,24 @@ class _ESMetaIndex:
             return False
         return True
 
+    def replace(self, doc_id: str, source: dict) -> bool:
+        """Atomically replace an EXISTING document (no upsert): the ES
+        ``_update`` endpoint with a source-replacement script 404s on a
+        missing doc, so there is no get-then-put window in which a
+        concurrent delete could be resurrected as a ghost record."""
+        self._t.ensure(self._index, self._mapping)
+        body = {"script": {"source": "ctx._source = params.src",
+                           "lang": "painless", "params": {"src": source}}}
+        try:
+            status, _ = self._t.call(
+                "POST",
+                f"/{self._index}/_update/{_quote(doc_id)}?refresh=wait_for",
+                body, ok_codes=(200, 201, 404))
+        except StorageError:
+            self._t.forget(self._index)
+            raise
+        return status != 404
+
     def get(self, doc_id: str) -> Optional[dict]:
         self._t.ensure(self._index, self._mapping)
         status, out = self._t.call(
@@ -492,9 +518,7 @@ class ESApps(AppsStore):
     def update(self, app: App) -> bool:
         # update-on-missing returns False like the embedded backends
         # (memory.py / sqlite UPDATE rowcount) — no ghost documents
-        if self.get(app.id) is None:
-            return False
-        return self._idx.put(str(app.id), self._src(app))
+        return self._idx.replace(str(app.id), self._src(app))
 
     def delete(self, app_id: int) -> bool:
         return self._idx.delete(str(app_id))
@@ -533,9 +557,7 @@ class ESAccessKeys(AccessKeysStore):
                 for s in self._idx.search([{"term": {"appId": app_id}}])]
 
     def update(self, access_key: AccessKey) -> bool:
-        if self.get(access_key.key) is None:
-            return False
-        return self._idx.put(
+        return self._idx.replace(
             access_key.key, {"key": access_key.key, "appId": access_key.app_id,
                              "events": list(access_key.events)})
 
@@ -608,7 +630,7 @@ class ESEngineInstances(EngineInstancesStore):
             "engineId": i.engine_id,
             "engineVersion": i.engine_version,
             "engineVariant": i.engine_variant,
-            "startTimeMillis": int(i.start_time.timestamp() * 1000),
+            "startTimeMillis": _millis(i.start_time),
             "doc": enc_engine_instance(i),
         }
 
@@ -626,9 +648,9 @@ class ESEngineInstances(EngineInstancesStore):
         return [dec_engine_instance(s["doc"]) for s in self._idx.search()]
 
     def update(self, instance: EngineInstance) -> bool:
-        if not instance.id or self._idx.get(instance.id) is None:
+        if not instance.id:
             return False
-        return self._idx.put(instance.id, self._src(instance))
+        return self._idx.replace(instance.id, self._src(instance))
 
     def delete(self, instance_id: str) -> bool:
         return self._idx.delete(instance_id)
@@ -650,7 +672,7 @@ class ESEvaluationInstances(EvaluationInstancesStore):
         return {
             "id": i.id,
             "status": i.status,
-            "startTimeMillis": int(i.start_time.timestamp() * 1000),
+            "startTimeMillis": _millis(i.start_time),
             "doc": enc_evaluation_instance(i),
         }
 
@@ -668,9 +690,9 @@ class ESEvaluationInstances(EvaluationInstancesStore):
         return [dec_evaluation_instance(s["doc"]) for s in self._idx.search()]
 
     def update(self, instance: EvaluationInstance) -> bool:
-        if not instance.id or self._idx.get(instance.id) is None:
+        if not instance.id:
             return False
-        return self._idx.put(instance.id, self._src(instance))
+        return self._idx.replace(instance.id, self._src(instance))
 
     def delete(self, instance_id: str) -> bool:
         return self._idx.delete(instance_id)

@@ -386,7 +386,9 @@ class QueryServer:
         self.last_serving_sec = dt
         self.avg_serving_sec += (dt - self.avg_serving_sec) / self.request_count
         self.latency.record(dt)
-        result = to_jsonable(prediction)
+        # camelCase field names: the reference's response shape
+        # (CreateServer.scala:494's json4s serialization of e.g. ItemScore)
+        result = to_jsonable(prediction, camelize_fields=True)
         from incubator_predictionio_tpu.server.plugins import apply_output_plugins
 
         result = apply_output_plugins(self.deployed.instance, payload, result)

@@ -1,6 +1,7 @@
 """Built-in engine templates — counterparts of the reference's examples/ gallery.
 
 Each template is a DASE engine: classification (MLP), recommendation
-(two-tower MF), similarproduct (implicit MF + cooccurrence), ecommerce
-(retrieval + business rules), sequential (transformer session recommender).
+(two-tower MF), similarproduct (implicit MF + cooccurrence), recommended_user
+(user-to-user implicit MF over follow events), ecommerce (retrieval +
+business rules), sequential (transformer session recommender).
 """

@@ -1,0 +1,28 @@
+"""Shared cosine-similarity serving path for the similarproduct family
+(similarproduct, recommended_user).
+
+One jitted bf16 MXU matmul scores every candidate against the summed query
+vectors; filters ride as an additive -inf mask (the reference's per-candidate
+cosine loops: similarproduct ALSAlgorithm.scala:150-175, recommended-user
+ALSAlgorithm.scala:150-160).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def l2_normalize(v: np.ndarray) -> np.ndarray:
+    return v / (np.linalg.norm(v, axis=1, keepdims=True) + 1e-9)
+
+
+@jax.jit
+def sim_scores(qvecs, cand_vt, mask):
+    """[q, k] query rows × [k, n] candidate columns → [n] summed cosine
+    scores (+ mask). Rows must be L2-normalized for cosine semantics."""
+    scores = (
+        (qvecs.astype(jnp.bfloat16) @ cand_vt.astype(jnp.bfloat16)).astype(jnp.float32)
+    )
+    return scores.sum(axis=0) + mask

@@ -1,0 +1,268 @@
+"""RecommendedUser template — the scala-parallel-similarproduct/recommended-user
+variant: recommend USERS to follow, from user→user "follow" events.
+
+Reference behavior (examples/scala-parallel-similarproduct/recommended-user/):
+- DataSource reads user ``$set`` events plus "follow" user→user events
+  (DataSource.scala:55-85);
+- ALSAlgorithm runs implicit MF over (follower, followedUser) pairs and keeps
+  the followed-side factor matrix (ALSAlgorithm.scala:104-124
+  ``ALS.trainImplicit`` → ``m.productFeatures``);
+- Query {"users": […], "num": N, "whiteList"?, "blackList"?} → top-N similar
+  users by the SUM of cosine similarities against every query user's vector,
+  excluding the query users themselves (ALSAlgorithm.scala:127-185).
+
+TPU mapping: identical to the item-similarity path — the reference's
+per-candidate parallel-collection cosine loop (ALSAlgorithm.scala:150-160)
+becomes one bf16 ``[q, k] × [k, n]`` MXU matmul over the L2-normalized
+followed-user table, plus an additive -inf filter mask.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from incubator_predictionio_tpu.core import (
+    Engine,
+    EngineFactory,
+    FirstServing,
+    IdentityPreparator,
+    PAlgorithm,
+    Params,
+    PDataSource,
+    SanityCheck,
+)
+from incubator_predictionio_tpu.data.bimap import BiMap
+from incubator_predictionio_tpu.data.store import PEventStore
+from incubator_predictionio_tpu.models.two_tower import TwoTowerConfig, TwoTowerMF
+from incubator_predictionio_tpu.parallel.mesh import MeshContext
+from incubator_predictionio_tpu.templates._similarity import l2_normalize, sim_scores
+
+logger = logging.getLogger(__name__)
+
+
+# -- query / result ---------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Query:
+    users: tuple[str, ...]
+    num: int = 10
+    white_list: Optional[tuple[str, ...]] = None
+    black_list: Optional[tuple[str, ...]] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class SimilarUserScore:
+    user: str
+    score: float
+
+
+@dataclasses.dataclass(frozen=True)
+class PredictedResult:
+    similar_user_scores: tuple[SimilarUserScore, ...] = ()
+
+
+# -- data source ------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str = "recommendeduser"
+
+
+@dataclasses.dataclass
+class TrainingData(SanityCheck):
+    users: BiMap                 # user id ↔ index (followers and followed share it)
+    follow_u: np.ndarray         # [n_follows] follower idx
+    follow_t: np.ndarray         # [n_follows] followed idx
+    # multi-process sharded read: follow rows are THIS process's follower
+    # shard only (the BiMap is global); n_follows_global is the job-wide count
+    rows_are_local: bool = False
+    n_follows_global: Optional[int] = None
+
+    def sanity_check(self) -> None:
+        if len(self.users) == 0:
+            raise ValueError("no users found ($set events on entityType 'user')")
+        n = (self.n_follows_global if self.n_follows_global is not None
+             else len(self.follow_u))
+        if n == 0:
+            raise ValueError("no follow events found")
+
+
+class DataSource(PDataSource):
+    """DataSource.scala:40-86 — users + follow events, sharded per process."""
+
+    params_class = DataSourceParams
+
+    def __init__(self, params: DataSourceParams):
+        super().__init__(params)
+        self._store = PEventStore()
+
+    def read_training(self, ctx: MeshContext) -> TrainingData:
+        app = self.params.app_name
+        procs, pid = ctx.process_count, ctx.process_index
+        sharded = procs > 1
+        user_props = self._store.aggregate_properties(app, "user")
+        if sharded:
+            events = self._store.find_sharded(
+                app, procs, entity_type="user", event_names=("follow",))[pid]
+        else:
+            events = self._store.find(
+                app, entity_type="user", event_names=("follow",),
+                target_entity_type="user")
+        follows: list[tuple[str, str]] = []
+        local_users: set[str] = set()
+        for e in events:
+            if e.target_entity_type != "user" or e.target_entity_id is None:
+                continue
+            local_users.add(e.entity_id)
+            local_users.add(e.target_entity_id)
+            follows.append((e.entity_id, e.target_entity_id))
+        user_ids = set(user_props.keys())
+        n_follows_global = None
+        if sharded:
+            from incubator_predictionio_tpu.data.sharded import (
+                global_row_count,
+                union_label_set,
+            )
+
+            # global vocabulary: $set users ∪ union of per-shard event users
+            # (followed ids can live outside this follower shard)
+            user_ids |= set(union_label_set(ctx, local_users))
+            n_follows_global = global_row_count(ctx, len(follows))
+            logger.info("sharded read: %d of %d follow rows (shard %d/%d)",
+                        len(follows), n_follows_global, pid, procs)
+        else:
+            user_ids |= local_users
+        users = BiMap.string_int(sorted(user_ids))
+        return TrainingData(
+            users=users,
+            follow_u=users.lookup_array([u for u, _ in follows]),
+            follow_t=users.lookup_array([t for _, t in follows]),
+            rows_are_local=sharded,
+            n_follows_global=n_follows_global,
+        )
+
+
+# -- model + algorithm ------------------------------------------------------
+
+@dataclasses.dataclass
+class SimilarUserModel:
+    """L2-normalized followed-user vectors (the reference keeps
+    ``productFeatures`` — ALSAlgorithm.scala:119-124)."""
+
+    user_vecs: np.ndarray        # [n_users, k] L2-normalized
+    user_map: BiMap
+
+    _device_vt = None
+
+    def prepare_for_serving(self) -> "SimilarUserModel":
+        self._device_vt = jax.device_put(np.ascontiguousarray(self.user_vecs.T))
+        return self
+
+    def serving_info(self) -> dict:
+        return {"path": "device-bf16", "catalog_rows": len(self.user_map)}
+
+
+@dataclasses.dataclass(frozen=True)
+class ALSAlgorithmParams(Params):
+    rank: int = 16
+    num_iterations: int = 20
+    learning_rate: float = 3e-2
+    negatives_per_positive: int = 4
+    seed: Optional[int] = None
+
+
+class ALSAlgorithm(PAlgorithm):
+    """Implicit MF over follow pairs; cosine-sum scoring
+    (ALSAlgorithm.scala:104-185)."""
+
+    params_class = ALSAlgorithmParams
+    query_cls = Query
+
+    def train(self, ctx: MeshContext, pd: TrainingData) -> SimilarUserModel:
+        from incubator_predictionio_tpu.models.negative_sampling import sample_negatives
+
+        p = self.params
+        rng = np.random.default_rng(p.seed if p.seed is not None else 0)
+        pos_u, pos_t = pd.follow_u, pd.follow_t
+        neg_u, neg_t = sample_negatives(
+            pos_u, pos_t, len(pd.users), p.negatives_per_positive, rng)
+        mf = TwoTowerMF(TwoTowerConfig(
+            rank=p.rank, epochs=p.num_iterations, learning_rate=p.learning_rate,
+            batch_size=8192, seed=p.seed if p.seed is not None else 0,
+        )).fit(
+            ctx,
+            np.concatenate([pos_u, neg_u]),
+            np.concatenate([pos_t, neg_t]),
+            np.concatenate([np.ones(len(pos_u), np.float32),
+                            np.zeros(len(neg_u), np.float32)]),
+            len(pd.users), len(pd.users),
+            rows_are_local=pd.rows_are_local,
+        )
+        # followed-side tower = the reference's productFeatures
+        return SimilarUserModel(
+            user_vecs=l2_normalize(mf.item_emb),
+            user_map=pd.users,
+        )
+
+    def predict(self, model: SimilarUserModel, query: Query) -> PredictedResult:
+        known = [model.user_map[u] for u in query.users if u in model.user_map]
+        if not known:
+            logger.info("no feature vectors for query users %s", query.users)
+            return PredictedResult()
+        if model._device_vt is None:
+            model.prepare_for_serving()
+        mask = self._filter_mask(model, query)
+        qvecs = jnp.asarray(model.user_vecs[np.asarray(known)])
+        scores = np.asarray(sim_scores(qvecs, model._device_vt, jnp.asarray(mask)))
+        num = min(query.num, len(scores))
+        top = np.argpartition(-scores, num - 1)[:num]
+        top = top[np.argsort(-scores[top])]
+        inv = model.user_map.inverse()
+        # score > 0 cut is reference behavior for THIS variant: "keep
+        # similarUsers with score > 0" (ALSAlgorithm.scala:160)
+        return PredictedResult(tuple(
+            SimilarUserScore(inv[int(i)], float(scores[i]))
+            for i in top if np.isfinite(scores[i]) and scores[i] > 0
+        ))
+
+    @staticmethod
+    def _filter_mask(model: SimilarUserModel, query: Query) -> np.ndarray:
+        """-inf mask: whitelist/blacklist + query-user self-exclusion
+        (isCandidateSimilarUser, ALSAlgorithm.scala:200-230)."""
+        n = len(model.user_map)
+        mask = np.zeros(n, np.float32)
+        if query.white_list is not None:
+            allowed = model.user_map.lookup_array(query.white_list)
+            white = np.full(n, -np.inf, np.float32)
+            white[allowed[allowed >= 0]] = 0.0
+            mask += white
+        for banned in (query.black_list or ()):
+            idx = model.user_map.get(banned)
+            if idx is not None:
+                mask[idx] = -np.inf
+        for qu in query.users:  # never recommend the query users themselves
+            idx = model.user_map.get(qu)
+            if idx is not None:
+                mask[idx] = -np.inf
+        return mask
+
+    def batch_predict(self, model, queries):
+        return [(i, self.predict(model, q)) for i, q in queries]
+
+
+class RecommendedUserEngine(EngineFactory):
+    """Engine.scala:41-48."""
+
+    def apply(self) -> Engine:
+        return Engine(
+            DataSource,
+            IdentityPreparator,
+            {"als": ALSAlgorithm, "": ALSAlgorithm},
+            {"": FirstServing},
+        )

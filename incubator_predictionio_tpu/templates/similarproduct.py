@@ -41,6 +41,7 @@ from incubator_predictionio_tpu.data.bimap import BiMap
 from incubator_predictionio_tpu.data.store import PEventStore
 from incubator_predictionio_tpu.models.two_tower import TwoTowerConfig, TwoTowerMF
 from incubator_predictionio_tpu.parallel.mesh import MeshContext
+from incubator_predictionio_tpu.templates._similarity import l2_normalize, sim_scores
 
 logger = logging.getLogger(__name__)
 
@@ -236,14 +237,6 @@ def _category_mask(model: ItemSimModel, query: Query) -> np.ndarray:
     return mask
 
 
-@jax.jit
-def _sim_scores(qvecs, item_vt, mask):
-    scores = (
-        (qvecs.astype(jnp.bfloat16) @ item_vt.astype(jnp.bfloat16)).astype(jnp.float32)
-    )
-    return scores.sum(axis=0) + mask
-
-
 def _similar_items(model: ItemSimModel, query: Query) -> PredictedResult:
     known = [model.item_map[i] for i in query.items if i in model.item_map]
     if not known:
@@ -251,7 +244,7 @@ def _similar_items(model: ItemSimModel, query: Query) -> PredictedResult:
     if model._device_vt is None:
         model.prepare_for_serving()
     qvecs = jnp.asarray(model.item_vecs[np.asarray(known)])
-    scores = np.asarray(_sim_scores(qvecs, model._device_vt, jnp.asarray(_category_mask(model, query))))
+    scores = np.asarray(sim_scores(qvecs, model._device_vt, jnp.asarray(_category_mask(model, query))))
     num = min(query.num, len(scores))
     top = np.argpartition(-scores, num - 1)[:num]
     top = top[np.argsort(-scores[top])]
@@ -260,10 +253,6 @@ def _similar_items(model: ItemSimModel, query: Query) -> PredictedResult:
         ItemScore(inv[int(i)], float(scores[i]))
         for i in top if np.isfinite(scores[i])
     ))
-
-
-def _l2_normalize(v: np.ndarray) -> np.ndarray:
-    return v / (np.linalg.norm(v, axis=1, keepdims=True) + 1e-9)
 
 
 # -- algorithms -------------------------------------------------------------
@@ -303,7 +292,7 @@ class ALSAlgorithm(PAlgorithm):
         )).fit(ctx, users, items, ratings, len(pd.users), len(pd.items),
                rows_are_local=pd.rows_are_local)
         return ItemSimModel(
-            item_vecs=_l2_normalize(mf.item_emb),
+            item_vecs=l2_normalize(mf.item_emb),
             item_map=pd.items,
             categories=pd.categories,
         )
@@ -333,7 +322,7 @@ class LikeAlgorithm(ALSAlgorithm):
                len(pd.users), len(pd.items),
                rows_are_local=pd.rows_are_local)
         return ItemSimModel(
-            item_vecs=_l2_normalize(mf.item_emb),
+            item_vecs=l2_normalize(mf.item_emb),
             item_map=pd.items,
             categories=pd.categories,
         )

@@ -453,6 +453,13 @@ TEMPLATES = {
         "description": "implicit MF + cooccurrence over view/like events "
                        "(scala-parallel-similarproduct slot)",
     },
+    "recommendeduser": {
+        "factory": "incubator_predictionio_tpu.templates.recommended_user."
+                   "RecommendedUserEngine",
+        "algorithms": [{"name": "als", "params": {}}],
+        "description": "user-to-user implicit MF over follow events "
+                       "(similarproduct/recommended-user slot)",
+    },
     "ecommerce": {
         "factory": "incubator_predictionio_tpu.templates.ecommerce."
                    "ECommerceEngine",

@@ -11,15 +11,30 @@ import numpy as np
 from incubator_predictionio_tpu.utils.params import params_from_json
 
 
-def to_jsonable(obj: Any) -> Any:
+def _camel(name: str) -> str:
+    head, *rest = name.split("_")
+    return head + "".join(w[:1].upper() + w[1:] for w in rest)
+
+
+def to_jsonable(obj: Any, camelize_fields: bool = False) -> Any:
     """Recursively convert dataclasses / numpy scalars+arrays / tuples into
-    JSON-encodable structures."""
+    JSON-encodable structures.
+
+    ``camelize_fields=True`` renders DATACLASS FIELD names in camelCase —
+    the reference's wire shape for predictions (``itemScores``,
+    ``similarUserScores``; query binding already accepts camelCase in).
+    Plain dict keys are user data and pass through untouched.
+    """
     if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
-        return {k: to_jsonable(v) for k, v in dataclasses.asdict(obj).items()}
+        return {
+            (_camel(f.name) if camelize_fields else f.name):
+                to_jsonable(getattr(obj, f.name), camelize_fields)
+            for f in dataclasses.fields(obj)
+        }
     if isinstance(obj, dict):
-        return {str(k): to_jsonable(v) for k, v in obj.items()}
+        return {str(k): to_jsonable(v, camelize_fields) for k, v in obj.items()}
     if isinstance(obj, (list, tuple)):
-        return [to_jsonable(v) for v in obj]
+        return [to_jsonable(v, camelize_fields) for v in obj]
     if isinstance(obj, np.ndarray):
         return obj.tolist()
     if isinstance(obj, np.generic):

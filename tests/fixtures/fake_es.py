@@ -70,6 +70,26 @@ def make_es_app():
             items.append({"index": {"_id": doc_id, "status": 201}})
         return web.json_response({"errors": False, "items": items})
 
+    async def update_doc(request: web.Request):
+        """_update with a source-replacement script: atomic replace, 404 on
+        missing doc (document_missing_exception) — no upsert."""
+        name = request.match_info["index"]
+        idx = indices.get(name)
+        if idx is None:
+            return es_error(404, "index_not_found_exception")
+        doc_id = request.match_info["id"]
+        if doc_id not in idx:
+            return es_error(404, "document_missing_exception")
+        body = await request.json()
+        script = body.get("script") or {}
+        if script.get("source") != "ctx._source = params.src":
+            return es_error(400, "illegal_argument_exception")
+        idx[doc_id] = script["params"]["src"]
+        ver = versions.setdefault(name, {})
+        ver[doc_id] = ver.get(doc_id, 0) + 1
+        return web.json_response(
+            {"result": "updated", "_id": doc_id, "_version": ver[doc_id]})
+
     async def get_doc(request: web.Request):
         idx = indices.get(request.match_info["index"])
         doc_id = request.match_info["id"]
@@ -146,6 +166,7 @@ def make_es_app():
     app.router.add_delete("/{index}", delete_index)
     app.router.add_post("/{index}/_bulk", bulk)
     app.router.add_post("/{index}/_search", search)
+    app.router.add_post("/{index}/_update/{id}", update_doc)
     app.router.add_put("/{index}/_doc/{id}", put_doc)
     app.router.add_get("/{index}/_doc/{id}", get_doc)
     app.router.add_delete("/{index}/_doc/{id}", delete_doc)

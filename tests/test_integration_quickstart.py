@@ -178,7 +178,7 @@ def test_cli_template_scaffold_trains(tmp_path):
     out = run("template", "list")
     assert out.returncode == 0
     for name in ("recommendation", "classification", "similarproduct",
-                 "ecommerce", "sequential"):
+                 "recommendeduser", "ecommerce", "sequential"):
         assert name in out.stdout
     out = run("template", "get", "recommendation", str(tmp_path / "scaffold"),
               "--app-name", "tplapp")

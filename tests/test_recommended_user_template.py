@@ -1,0 +1,121 @@
+"""RecommendedUser template: user→user implicit MF over follow events."""
+
+import datetime as dt
+
+import pytest
+
+from incubator_predictionio_tpu.core import EngineParams, doer
+from incubator_predictionio_tpu.data import Event
+from incubator_predictionio_tpu.data.storage import App, Storage, use_storage
+from incubator_predictionio_tpu.parallel.mesh import MeshContext
+from incubator_predictionio_tpu.templates.recommended_user import (
+    ALSAlgorithmParams,
+    DataSource,
+    DataSourceParams,
+    Query,
+    RecommendedUserEngine,
+)
+
+UTC = dt.timezone.utc
+N_USERS = 16
+
+
+@pytest.fixture(scope="module")
+def storage():
+    """Two follow communities: even users follow even users, odd follow odd."""
+    s = Storage({"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+    app_id = s.get_meta_data_apps().insert(App(0, "ru-test"))
+    events = s.get_events()
+    events.init(app_id)
+    t0 = dt.datetime(2020, 1, 1, tzinfo=UTC)
+    for u in range(N_USERS):
+        events.insert(Event(event="$set", entity_type="user",
+                            entity_id=f"u{u}", event_time=t0), app_id)
+    for u in range(N_USERS):
+        for t in range(N_USERS):
+            if u != t and (u % 2) == (t % 2):
+                events.insert(Event(
+                    event="follow", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="user", target_entity_id=f"u{t}",
+                    event_time=t0 + dt.timedelta(seconds=u * 50 + t)), app_id)
+    yield s
+    s.close()
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return MeshContext.create()
+
+
+def test_datasource_reads_users_and_follows(storage, ctx):
+    prev = use_storage(storage)
+    try:
+        td = doer(DataSource, DataSourceParams(app_name="ru-test")).read_training(ctx)
+        assert len(td.users) == N_USERS
+        # each user follows the 7 same-parity peers
+        assert len(td.follow_u) == N_USERS * (N_USERS // 2 - 1)
+        assert (td.follow_u != td.follow_t).all()
+    finally:
+        use_storage(prev)
+
+
+@pytest.fixture(scope="module")
+def trained(storage, ctx):
+    prev = use_storage(storage)
+    try:
+        engine = RecommendedUserEngine().apply()
+        params = EngineParams.create(
+            data_source=DataSourceParams(app_name="ru-test"),
+            algorithms=[("als", ALSAlgorithmParams(
+                rank=8, num_iterations=150, learning_rate=5e-2, seed=3))],
+        )
+        [model] = engine.train(ctx, params)
+        algos, _serving = engine.serving_and_algorithms(params)
+        return algos[0], model
+    finally:
+        use_storage(prev)
+
+
+def test_recommends_same_community_excluding_self(trained):
+    algo, model = trained
+    res = algo.predict(model, Query(users=("u0",), num=5))
+    assert len(res.similar_user_scores) == 5
+    names = [s.user for s in res.similar_user_scores]
+    assert "u0" not in names  # query users never recommended back
+    even = sum(1 for n in names if int(n[1:]) % 2 == 0)
+    assert even >= 4, names  # community structure learned
+    # scores are descending
+    scores = [s.score for s in res.similar_user_scores]
+    assert scores == sorted(scores, reverse=True)
+
+
+def test_multi_user_query_and_filters(trained):
+    algo, model = trained
+    res = algo.predict(model, Query(users=("u1", "u3"), num=4))
+    names = [s.user for s in res.similar_user_scores]
+    assert names and all(n not in ("u1", "u3") for n in names)
+
+    white = ("u2", "u4", "u6")
+    res = algo.predict(model, Query(users=("u0",), num=10, white_list=white))
+    assert {s.user for s in res.similar_user_scores} <= set(white)
+
+    res = algo.predict(model, Query(users=("u0",), num=10, black_list=("u2",)))
+    assert "u2" not in {s.user for s in res.similar_user_scores}
+
+
+def test_api_response_shape_is_camel_case(trained):
+    """The wire shape matches the reference's json4s output:
+    {"similarUserScores": [{"user": …, "score": …}]} (Engine.scala:30-38)."""
+    from incubator_predictionio_tpu.utils.json_util import to_jsonable
+
+    algo, model = trained
+    wire = to_jsonable(algo.predict(model, Query(users=("u0",), num=2)),
+                       camelize_fields=True)
+    assert set(wire) == {"similarUserScores"}
+    assert all(set(s) == {"user", "score"} for s in wire["similarUserScores"])
+
+
+def test_unknown_query_users_yield_empty(trained):
+    algo, model = trained
+    res = algo.predict(model, Query(users=("stranger",), num=5))
+    assert res.similar_user_scores == ()
