@@ -210,7 +210,7 @@ class ESEvents(EventStore):
             "entityId": e.entity_id,
             "targetEntityType": e.target_entity_type,
             "targetEntityId": e.target_entity_id,
-            "eventTimeMillis": int(e.event_time.timestamp() * 1000),
+            "eventTimeMillis": _millis(e.event_time),
             # UNIQUE sort tiebreak for search_after: a non-unique key makes
             # ES skip/duplicate docs at page boundaries; equal-timestamp
             # order is id-lexicographic (deterministic, like real ES)
@@ -294,9 +294,9 @@ class ESEvents(EventStore):
         must_not: list[dict] = []
         rng: dict[str, int] = {}
         if start_time is not None:
-            rng["gte"] = int(start_time.timestamp() * 1000)
+            rng["gte"] = _millis(start_time)
         if until_time is not None:
-            rng["lt"] = int(until_time.timestamp() * 1000)
+            rng["lt"] = _millis(until_time)
         if rng:
             must.append({"range": {"eventTimeMillis": rng}})
         if entity_type is not None:

@@ -69,6 +69,12 @@ class PredictedResult:
 @dataclasses.dataclass(frozen=True)
 class DataSourceParams(Params):
     app_name: str = "ecommerce"
+    # train-with-rate-event variant: which events count as view/buy signal,
+    # and the implicit buy weight (examples/scala-parallel-
+    # ecommercerecommendation/train-with-rate-event)
+    view_event_names: tuple[str, ...] = ("view",)
+    buy_event_names: tuple[str, ...] = ("buy",)
+    buy_weight: float = 2.0
 
 
 @dataclasses.dataclass
@@ -113,13 +119,16 @@ class DataSource(PDataSource):
         inter_u, inter_i, weight = [], [], []
         buy_counts = np.zeros(len(items), np.int64)
         user_ids = set()
+        view_names = tuple(self.params.view_event_names)
+        buy_names = tuple(self.params.buy_event_names)
+        wanted = (*view_names, *buy_names)
         if sharded:
             # per-process entity-disjoint slice (reference: RDD partitions)
             events = self._store.find_sharded(
-                app, procs, entity_type="user", event_names=("view", "buy"))[pid]
+                app, procs, entity_type="user", event_names=wanted)[pid]
         else:
             events = self._store.find(
-                app, entity_type="user", event_names=("view", "buy"),
+                app, entity_type="user", event_names=wanted,
                 target_entity_type="item",
             )
         for e in events:
@@ -128,8 +137,9 @@ class DataSource(PDataSource):
             user_ids.add(e.entity_id)
             inter_u.append(e.entity_id)
             inter_i.append(e.target_entity_id)
-            weight.append(1.0 if e.event == "view" else 2.0)
-            if e.event == "buy":
+            is_view = e.event in view_names
+            weight.append(1.0 if is_view else self.params.buy_weight)
+            if not is_view:
                 buy_counts[items[e.target_entity_id]] += 1
         n_rows_global = None
         if sharded:

@@ -58,6 +58,9 @@ logger = logging.getLogger(__name__)
 class Query:
     user: str
     num: int = 10
+    # blacklist-items variant (examples/scala-parallel-recommendation/
+    # blacklist-items/src/main/scala/ALSAlgorithm.scala): never return these
+    black_list: Optional[tuple[str, ...]] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -80,6 +83,16 @@ class DataSourceParams(Params):
     eval_queries_per_fold: int = 100
     buy_rating: float = 4.0  # implicit weight of a "buy" (DataSource.scala:61)
     seed: int = 42
+    # reading-custom-events / train-with-view-event variants: which events
+    # carry signal, and implicit ratings for events with no "rating" property
+    # (e.g. eventNames=["view"], defaultRatings={"view": 1.0})
+    event_names: tuple[str, ...] = ("rate", "buy")
+    default_ratings: Optional[dict[str, float]] = None
+
+    def rating_defaults(self) -> dict[str, float]:
+        if self.default_ratings is not None:
+            return {k: float(v) for k, v in self.default_ratings.items()}
+        return {"buy": self.buy_rating}
 
 
 @dataclasses.dataclass
@@ -122,10 +135,10 @@ class DataSource(PDataSource):
             self._store.assemble_triples(
                 self.params.app_name,
                 entity_type="user",
-                event_names=("rate", "buy"),
+                event_names=tuple(self.params.event_names),
                 target_entity_type="item",
                 value_property="rating",
-                default_values={"buy": self.params.buy_rating},
+                default_values=self.params.rating_defaults(),
                 dedup=True,
             )
         )
@@ -156,10 +169,10 @@ class DataSource(PDataSource):
         uv, iv, ui, ii, vals = self._store.assemble_triples(
             self.params.app_name,
             entity_type="user",
-            event_names=("rate", "buy"),
+            event_names=tuple(self.params.event_names),
             target_entity_type="item",
             value_property="rating",
-            default_values={"buy": self.params.buy_rating},
+            default_values=self.params.rating_defaults(),
             dedup=True,
             n_shards=procs,
             shard_index=pid,
@@ -384,15 +397,29 @@ class ALSAlgorithm(PAlgorithm):
         )
         return RecModel(mf, user_map, item_map)
 
+    @staticmethod
+    def _banned(model: RecModel, query: Query) -> set[int]:
+        """Known-catalog indices of the query's blackList (blacklist-items
+        variant); unknown ids are ignored like the reference's flatten."""
+        return {
+            idx for b in (query.black_list or ())
+            if (idx := model.item_map.get(b)) is not None
+        }
+
     def predict(self, model: RecModel, query: Query) -> PredictedResult:
         uidx = model.user_map.get(query.user)
         if uidx is None:
             # unknown user → empty result (reference returns empty itemScores)
             return PredictedResult()
-        idx, scores = TwoTowerMF.recommend(model.mf, uidx, query.num)
+        banned = self._banned(model, query)
+        # device-side -inf exclude mask: bucket shapes stay untouched
+        idx, scores = TwoTowerMF.recommend(
+            model.mf, uidx, query.num,
+            exclude=np.fromiter(banned, np.int64) if banned else None)
         inv = model.item_map.inverse()
         return PredictedResult(tuple(
-            ItemScore(inv[int(i)], float(s)) for i, s in zip(idx, scores)
+            ItemScore(inv[int(i)], float(s))
+            for i, s in zip(idx, scores) if int(i) not in banned
         ))
 
     def batch_predict(
@@ -405,15 +432,16 @@ class ALSAlgorithm(PAlgorithm):
             (qi, PredictedResult()) for qi, q in queries if q.user not in model.user_map
         ]
         if known:
-            num = max(q.num for _, q in known)
+            banned = [self._banned(model, q) for _, q in known]
+            num = max(q.num + len(b) for (_, q), b in zip(known, banned))
             uidx = np.asarray([model.user_map[q.user] for _, q in known], np.int32)
             idx, scores = TwoTowerMF.recommend_batch(model.mf, uidx, num)
             inv = model.item_map.inverse()
-            for (qi, q), row_idx, row_scores in zip(known, idx, scores):
+            for (qi, q), b, row_idx, row_scores in zip(known, banned, idx, scores):
                 out.append((qi, PredictedResult(tuple(
                     ItemScore(inv[int(i)], float(s))
-                    for i, s in zip(row_idx[: q.num], row_scores[: q.num])
-                ))))
+                    for i, s in zip(row_idx, row_scores) if int(i) not in b
+                )[: q.num])))
         return out
 
 

@@ -74,6 +74,9 @@ class PredictedResult:
 @dataclasses.dataclass(frozen=True)
 class DataSourceParams(Params):
     app_name: str = "similarproduct"
+    # train-with-rate-event variant: treat other events (e.g. "rate") as view
+    # signal (examples/scala-parallel-similarproduct/train-with-rate-event)
+    view_event_names: tuple[str, ...] = ("view",)
 
 
 @dataclasses.dataclass
@@ -125,15 +128,15 @@ class DataSource(PDataSource):
         user_props = self._store.aggregate_properties(app, "user")
         view_events, like_u, like_i, like_sign = [], [], [], []
         local_users: set[str] = set()
+        view_names = tuple(self.params.view_event_names)
+        wanted = (*view_names, "like", "dislike")
         if sharded:
             # per-process entity-disjoint slice of the event stream
             events = self._store.find_sharded(
-                app, procs, entity_type="user",
-                event_names=("view", "like", "dislike"))[pid]
+                app, procs, entity_type="user", event_names=wanted)[pid]
         else:
             events = self._store.find(
-                app, entity_type="user",
-                event_names=("view", "like", "dislike"),
+                app, entity_type="user", event_names=wanted,
                 target_entity_type="item",
             )
         for e in events:
@@ -142,7 +145,7 @@ class DataSource(PDataSource):
             local_users.add(e.entity_id)
             if e.target_entity_id not in items:
                 continue  # events referencing unknown items are dropped
-            if e.event == "view":
+            if e.event in view_names:
                 view_events.append((e.entity_id, e.target_entity_id))
             else:
                 like_u.append(e.entity_id)

@@ -98,6 +98,68 @@ def test_train_and_recommend(storage, ctx):
         use_storage(prev)
 
 
+def test_blacklist_query(storage, ctx):
+    """blacklist-items variant: blackListed items never returned, in both the
+    single-query (device exclude mask) and batch (over-fetch) paths."""
+    prev = use_storage(storage)
+    try:
+        engine = RecommendationEngine().apply()
+        [model] = engine.train(ctx, ep())
+        algorithms, _ = engine.serving_and_algorithms(ep())
+        algo = algorithms[0]
+        base = algo.predict(model, Query(user="u0", num=4))
+        top = base.item_scores[0].item
+        banned = (top, "no-such-item")  # unknown ids are ignored
+        pred = algo.predict(model, Query(user="u0", num=4, black_list=banned))
+        assert len(pred.item_scores) == 4
+        assert top not in [s.item for s in pred.item_scores]
+        # remaining order matches the unfiltered ranking with `top` removed
+        rest = [s.item for s in base.item_scores if s.item != top]
+        assert [s.item for s in pred.item_scores][: len(rest)] == rest
+        results = dict(algo.batch_predict(model, [
+            (0, Query(user="u0", num=4, black_list=banned)),
+            (1, Query(user="u0", num=4)),
+        ]))
+        assert top not in [s.item for s in results[0].item_scores]
+        assert len(results[0].item_scores) == 4
+        assert [s.item for s in results[1].item_scores] == \
+            [s.item for s in base.item_scores]
+    finally:
+        use_storage(prev)
+
+
+def test_custom_event_names(ctx):
+    """train-with-view-event variant: eventNames=["view"] with an implicit
+    defaultRatings weight trains from view events alone."""
+    s = Storage({"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+    prev = use_storage(s)
+    try:
+        from incubator_predictionio_tpu.core import doer
+        from incubator_predictionio_tpu.templates.recommendation import DataSource
+
+        app_id = s.get_meta_data_apps().insert(App(0, "view-test"))
+        events = s.get_events()
+        events.init(app_id)
+        t0 = dt.datetime(2020, 1, 1, tzinfo=UTC)
+        for u in range(4):
+            for i in range(3):
+                events.insert(
+                    Event(event="view", entity_type="user", entity_id=f"u{u}",
+                          target_entity_type="item", target_entity_id=f"i{i}",
+                          event_time=t0), app_id)
+        ds = doer(DataSource, DataSourceParams(
+            app_name="view-test", event_names=("view",),
+            default_ratings={"view": 1.0}))
+        td = ds.read_training(ctx)
+        assert len(td.ratings) == 12 and (td.ratings == 1.0).all()
+        # default params see no rate/buy events at all
+        ds0 = doer(DataSource, DataSourceParams(app_name="view-test"))
+        assert len(ds0.read_training(ctx).ratings) == 0
+    finally:
+        use_storage(prev)
+        s.close()
+
+
 def test_later_event_wins(storage, ctx):
     prev = use_storage(storage)
     try:

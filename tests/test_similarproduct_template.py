@@ -72,6 +72,24 @@ def test_datasource_reads_catalog_and_events(storage, ctx):
         use_storage(prev)
 
 
+def test_custom_view_event_names(storage, ctx):
+    """train-with-rate-event variant: 'like' events counted as view signal
+    via viewEventNames (the rate→view remap the reference example does)."""
+    prev = use_storage(storage)
+    try:
+        base = doer(DataSource, DataSourceParams(app_name="sp-test"))
+        custom = doer(DataSource, DataSourceParams(
+            app_name="sp-test", view_event_names=("view", "like")))
+        td0, td1 = base.read_training(ctx), custom.read_training(ctx)
+        # viewEventNames takes precedence: matching events fold entirely into
+        # the view stream (the reference variant likewise repurposes the
+        # event, it does not double-count it)
+        assert len(td1.view_u) == len(td0.view_u) + len(td0.like_u)
+        assert len(td1.like_u) == 0
+    finally:
+        use_storage(prev)
+
+
 def test_als_similarity_respects_structure_and_filters(storage, ctx):
     prev = use_storage(storage)
     try:
