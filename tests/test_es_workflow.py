@@ -1,0 +1,130 @@
+"""Workflow tier for the Elasticsearch backend: ES serves METADATA +
+EVENTDATA + MODELDATA through a full app→ingest→train→deploy→query cycle
+(the reference's ES-default deployment topology), against the in-process ES
+protocol fake over a real socket.
+"""
+
+import asyncio
+import datetime as dt
+import json
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from incubator_predictionio_tpu.data.storage import Storage, use_storage
+
+UTC = dt.timezone.utc
+
+
+@pytest.fixture()
+def es_storage():
+    from tests.fixtures.fake_es import make_es_app
+    from tests.fixtures.servers import ThreadedApp
+
+    server = ThreadedApp(make_es_app())
+    s = Storage({
+        "PIO_STORAGE_SOURCES_ES_TYPE": "elasticsearch",
+        "PIO_STORAGE_SOURCES_ES_URL": f"http://127.0.0.1:{server.port}",
+    })
+    prev = use_storage(s)
+    yield s
+    use_storage(prev)
+    s.close()
+    server.close()
+
+
+def test_es_backs_all_three_repositories_end_to_end(es_storage, tmp_path):
+    storage = es_storage
+    from incubator_predictionio_tpu.server.event_server import (
+        EventServer,
+        EventServerConfig,
+    )
+    from incubator_predictionio_tpu.server.query_server import (
+        QueryServer,
+        ServerConfig,
+    )
+    from incubator_predictionio_tpu.tools import cli
+
+    # -- pio app new: metadata (app + access key) land in ES --------------
+    class Args:
+        name = "esapp"
+        id = 0
+        description = None
+        access_key = ""
+
+    assert cli.cmd_app_new(Args(), storage) == 0
+    app = storage.get_meta_data_apps().get_by_name("esapp")
+    assert app is not None
+    key = storage.get_meta_data_access_keys().get_by_app_id(app.id)[0].key
+
+    # -- ingest over the event server HTTP API: events land in ES ---------
+    rng = np.random.default_rng(11)
+    x = rng.normal(size=(48, 3))
+    y = (x[:, 0] + x[:, 1] > 0).astype(int)
+    events = [
+        {"event": "$set", "entityType": "user", "entityId": f"u{i}",
+         "properties": {"attr0": float(x[i, 0]), "attr1": float(x[i, 1]),
+                        "attr2": float(x[i, 2]), "plan": int(y[i])},
+         "eventTime": "2020-01-01T00:00:00Z"}
+        for i in range(48)
+    ]
+
+    async def ingest():
+        server = EventServer(EventServerConfig(), storage=storage)
+        client = TestClient(TestServer(server.make_app()))
+        await client.start_server()
+        try:
+            resp = await client.post(
+                f"/batch/events.json?accessKey={key}", json=events)
+            assert resp.status == 200
+            assert all(r["status"] == 201 for r in await resp.json())
+        finally:
+            await client.close()
+
+    asyncio.run(ingest())
+    assert len(list(storage.get_events().find(app.id))) == 48
+
+    # -- train: engine instance + model blob land in ES -------------------
+    variant_path = tmp_path / "engine.json"
+    variant_path.write_text(json.dumps({
+        "id": "es-wf", "version": "1",
+        "engineFactory":
+            "incubator_predictionio_tpu.templates.classification."
+            "ClassificationEngine",
+        "datasource": {"params": {"appName": "esapp"}},
+        "algorithms": [{"name": "mlp", "params": {
+            "hiddenDims": [8], "epochs": 60, "learningRate": 0.05,
+            "batchSize": 48}}],
+    }))
+    from incubator_predictionio_tpu.core.workflow.create_workflow import (
+        WorkflowConfig,
+        create_workflow,
+    )
+
+    instance_id = create_workflow(
+        WorkflowConfig(engine_variant=str(variant_path)), storage)
+    inst = storage.get_meta_data_engine_instances().get(instance_id)
+    assert inst.status == "COMPLETED"
+    blob = storage.get_model_data_models().get(instance_id)
+    assert blob is not None and len(blob.models) > 100
+
+    # -- deploy: the model loads back OUT of ES and answers queries -------
+    async def query():
+        server = QueryServer(
+            ServerConfig(engine_variant=str(variant_path)), storage=storage)
+        client = TestClient(TestServer(server.make_app()))
+        await client.start_server()
+        try:
+            ok = 0
+            for i in range(12):
+                resp = await client.post(
+                    "/queries.json",
+                    json={"features": [float(v) for v in x[i]]})
+                assert resp.status == 200
+                ok += int((await resp.json())["label"] == int(y[i]))
+            return ok
+        finally:
+            await client.close()
+
+    assert asyncio.run(query()) >= 9
