@@ -67,6 +67,7 @@ def _register_builtins() -> None:
     from incubator_predictionio_tpu.data.storage.memory import MemoryStorageClient
     from incubator_predictionio_tpu.data.storage.elasticsearch import ESStorageClient
     from incubator_predictionio_tpu.data.storage.remote import RemoteStorageClient
+    from incubator_predictionio_tpu.data.storage.postgres import PostgresStorageClient
     from incubator_predictionio_tpu.data.storage.s3 import S3StorageClient
     from incubator_predictionio_tpu.data.storage.sqlite_backend import SqliteStorageClient
     from incubator_predictionio_tpu.data.storage.webhdfs import WebHDFSStorageClient
@@ -79,6 +80,8 @@ def _register_builtins() -> None:
     BACKEND_TYPES.setdefault("webhdfs", WebHDFSStorageClient)
     BACKEND_TYPES.setdefault("s3", S3StorageClient)
     BACKEND_TYPES.setdefault("elasticsearch", ESStorageClient)
+    BACKEND_TYPES.setdefault("postgres", PostgresStorageClient)
+    BACKEND_TYPES.setdefault("jdbc", PostgresStorageClient)  # reference TYPE name
 
 
 _SOURCE_RE = re.compile(r"^PIO_STORAGE_SOURCES_([^_]+)_(.+)$")

@@ -1,0 +1,292 @@
+"""In-process PostgreSQL wire-protocol (v3) fake for the postgres backend.
+
+A threaded socket server that speaks the documented protocol subset the
+client uses — startup (incl. SSLRequest refusal), SCRAM-SHA-256 or cleartext
+auth, and the extended query protocol (Parse/Bind/Describe/Execute/Sync) —
+executing the SQL against a private in-memory sqlite database. The protocol
+layer is implemented independently from the client (messages are parsed from
+the spec, SCRAM per RFC 5802 server-side), so a client framing or handshake
+bug fails the suite instead of round-tripping through shared helpers.
+
+Dialect shims (PG → sqlite): ``$n`` placeholders → positional ``?``,
+``BIGSERIAL PRIMARY KEY`` → ``INTEGER PRIMARY KEY AUTOINCREMENT``,
+``BYTEA``/``BIGINT`` type names, bytea text format (``\\x…``) in both
+directions. Everything else the backend emits is SQL both engines share
+(ON CONFLICT DO UPDATE, RETURNING, IN lists, range predicates).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import re
+import secrets
+import socket
+import sqlite3
+import struct
+import threading
+
+
+def _scram_server_messages(password: str):
+    """Server-side SCRAM-SHA-256 state machine (RFC 5802)."""
+    salt = secrets.token_bytes(16)
+    iterations = 4096
+    salted = hashlib.pbkdf2_hmac("sha256", password.encode(), salt, iterations)
+    client_key = hmac.new(salted, b"Client Key", hashlib.sha256).digest()
+    stored_key = hashlib.sha256(client_key).digest()
+    server_key = hmac.new(salted, b"Server Key", hashlib.sha256).digest()
+    return salt, iterations, stored_key, server_key
+
+
+class FakePG:
+    """Serve PG v3 on a localhost socket; `password=None` means trust auth."""
+
+    def __init__(self, password: str | None = None):
+        self.password = password
+        self._db = sqlite3.connect(":memory:", check_same_thread=False)
+        self._db_lock = threading.Lock()
+        self._srv = socket.create_server(("127.0.0.1", 0))
+        self.port = self._srv.getsockname()[1]
+        self._closing = False
+        self._threads: list[threading.Thread] = []
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+        self._accept_thread.start()
+
+    # ------------------------------------------------------------------
+    def _accept_loop(self):
+        while not self._closing:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def close(self):
+        self._closing = True
+        self._srv.close()
+
+    # -- framing helpers ----------------------------------------------
+    @staticmethod
+    def _recv_exact(conn, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("client gone")
+            buf += chunk
+        return buf
+
+    @classmethod
+    def _recv_typed(cls, conn) -> tuple[bytes, bytes]:
+        head = cls._recv_exact(conn, 5)
+        ln = struct.unpack("!I", head[1:])[0]
+        return head[:1], cls._recv_exact(conn, ln - 4)
+
+    @staticmethod
+    def _msg(type_byte: bytes, payload: bytes) -> bytes:
+        return type_byte + struct.pack("!I", len(payload) + 4) + payload
+
+    @classmethod
+    def _auth(cls, code: int, extra: bytes = b"") -> bytes:
+        return cls._msg(b"R", struct.pack("!I", code) + extra)
+
+    @classmethod
+    def _error(cls, sqlstate: str, message: str) -> bytes:
+        fields = b"S" + b"ERROR\x00" + b"C" + sqlstate.encode() + b"\x00" \
+            + b"M" + message.encode() + b"\x00\x00"
+        return cls._msg(b"E", fields)
+
+    _READY = b"Z" + struct.pack("!I", 5) + b"I"
+
+    # -- connection lifecycle ------------------------------------------
+    def _serve_conn(self, conn: socket.socket):
+        try:
+            # startup (possibly preceded by an SSLRequest we refuse)
+            head = self._recv_exact(conn, 8)
+            ln, code = struct.unpack("!II", head)
+            if code == 80877103:  # SSLRequest → no TLS in the fake
+                conn.sendall(b"N")
+                head = self._recv_exact(conn, 8)
+                ln, code = struct.unpack("!II", head)
+            if code != 196608:
+                conn.sendall(self._error("08P01", f"bad protocol {code}"))
+                return
+            self._recv_exact(conn, ln - 8)  # startup params (ignored)
+
+            if self.password is None:
+                conn.sendall(self._auth(0))
+            else:
+                if not self._do_scram(conn):
+                    return
+            conn.sendall(
+                self._msg(b"S", b"server_version\x00fake-16\x00")
+                + self._msg(b"K", struct.pack("!II", 1, 2)) + self._READY)
+            self._extended_loop(conn)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def _do_scram(self, conn) -> bool:
+        conn.sendall(self._auth(10, b"SCRAM-SHA-256\x00\x00"))
+        t, body = self._recv_typed(conn)
+        if t != b"p":
+            conn.sendall(self._error("28000", "expected SASLInitialResponse"))
+            return False
+        mech_end = body.index(b"\x00")
+        if body[:mech_end] != b"SCRAM-SHA-256":
+            conn.sendall(self._error("28000", "unknown mechanism"))
+            return False
+        resp_len = struct.unpack("!I", body[mech_end + 1:mech_end + 5])[0]
+        client_first = body[mech_end + 5:mech_end + 5 + resp_len].decode()
+        # gs2 header "n,," then bare
+        client_first_bare = client_first.split(",", 2)[2]
+        cnonce = dict(p.split("=", 1)
+                      for p in client_first_bare.split(","))["r"]
+        salt, iterations, stored_key, server_key = _scram_server_messages(
+            self.password)
+        snonce = cnonce + base64.b64encode(secrets.token_bytes(12)).decode()
+        server_first = (f"r={snonce},s={base64.b64encode(salt).decode()},"
+                        f"i={iterations}")
+        conn.sendall(self._auth(11, server_first.encode()))
+        t, body = self._recv_typed(conn)
+        if t != b"p":
+            conn.sendall(self._error("28000", "expected SASLResponse"))
+            return False
+        client_final = body.decode()
+        without_proof, proof_b64 = client_final.rsplit(",p=", 1)
+        attrs = dict(p.split("=", 1) for p in without_proof.split(","))
+        if attrs.get("r") != snonce or attrs.get("c") != "biws":
+            conn.sendall(self._error("28000", "SCRAM attributes mismatch"))
+            return False
+        auth_message = ",".join(
+            [client_first_bare, server_first, without_proof]).encode()
+        client_sig = hmac.new(stored_key, auth_message,
+                              hashlib.sha256).digest()
+        client_proof = base64.b64decode(proof_b64)
+        client_key = bytes(a ^ b for a, b in zip(client_proof, client_sig))
+        if hashlib.sha256(client_key).digest() != stored_key:
+            conn.sendall(self._error(
+                "28P01", "password authentication failed"))
+            return False
+        server_sig = hmac.new(server_key, auth_message,
+                              hashlib.sha256).digest()
+        conn.sendall(self._auth(
+            12, b"v=" + base64.b64encode(server_sig)))
+        conn.sendall(self._auth(0))
+        return True
+
+    # -- extended query protocol ---------------------------------------
+    def _extended_loop(self, conn):
+        sql = ""
+        params: list = []
+        while True:
+            t, body = self._recv_typed(conn)
+            if t == b"X":
+                return
+            if t == b"P":  # Parse: name\0 sql\0 nparams...
+                _, rest = body.split(b"\x00", 1)
+                sql = rest.split(b"\x00", 1)[0].decode()
+                conn.sendall(self._msg(b"1", b""))
+            elif t == b"B":  # Bind
+                # portal\0 stmt\0 nfmt fmts... nparams (len val)* nresfmt...
+                off = body.index(b"\x00") + 1
+                off = body.index(b"\x00", off) + 1
+                nfmt = struct.unpack("!H", body[off:off + 2])[0]
+                off += 2 + 2 * nfmt
+                nparams = struct.unpack("!H", body[off:off + 2])[0]
+                off += 2
+                params = []
+                for _ in range(nparams):
+                    ln = struct.unpack("!i", body[off:off + 4])[0]
+                    off += 4
+                    if ln == -1:
+                        params.append(None)
+                    else:
+                        params.append(body[off:off + ln].decode())
+                        off += ln
+                conn.sendall(self._msg(b"2", b""))
+            elif t == b"D":
+                conn.sendall(self._msg(b"n", b""))  # NoData (client ignores)
+            elif t == b"E":
+                self._execute(conn, sql, params)
+            elif t == b"S":
+                conn.sendall(self._READY)
+            # else: ignore (H flush etc.)
+
+    # -- SQL translation + execution -----------------------------------
+    @staticmethod
+    def _translate(sql: str, params: list) -> tuple[str, list]:
+        order: list[int] = []
+
+        def repl(m):
+            order.append(int(m.group(1)) - 1)
+            return "?"
+
+        out = re.sub(r"\$(\d+)", repl, sql)
+        out = out.replace("BIGSERIAL PRIMARY KEY",
+                          "INTEGER PRIMARY KEY AUTOINCREMENT")
+        out = out.replace("BYTEA", "BLOB").replace("BIGINT", "INTEGER")
+        pyvals = []
+        for i in order:
+            v = params[i]
+            if v is None:
+                pyvals.append(None)
+            elif v.startswith("\\x"):
+                pyvals.append(bytes.fromhex(v[2:]))  # bytea text format
+            else:
+                # keep text verbatim (real PG binds by column type, never by
+                # value shape — "007" into TEXT must stay "007"); sqlite's
+                # column affinity converts for INTEGER columns/comparisons
+                pyvals.append(v)
+        return out, pyvals
+
+    @staticmethod
+    def _encode_value(v) -> bytes | None:
+        if v is None:
+            return None
+        if isinstance(v, bytes):
+            return b"\\x" + v.hex().encode()
+        if isinstance(v, float):
+            return repr(v).encode()
+        return str(v).encode()
+
+    def _execute(self, conn, sql: str, params: list):
+        try:
+            tsql, pyvals = self._translate(sql, params)
+            with self._db_lock:
+                cur = self._db.execute(tsql, pyvals)
+                rows = cur.fetchall()
+                self._db.commit()
+                rowcount = cur.rowcount
+        except sqlite3.IntegrityError as e:
+            conn.sendall(self._error("23505", str(e)))
+            return
+        except sqlite3.OperationalError as e:
+            state = "42P01" if "no such table" in str(e) else "42601"
+            conn.sendall(self._error(state, str(e)))
+            return
+        except Exception as e:  # noqa: BLE001 - report, don't kill the conn
+            conn.sendall(self._error("XX000", repr(e)))
+            return
+        out = b""
+        for r in rows:
+            fields = [self._encode_value(v) for v in r]
+            payload = struct.pack("!H", len(fields))
+            for f in fields:
+                if f is None:
+                    payload += struct.pack("!i", -1)
+                else:
+                    payload += struct.pack("!i", len(f)) + f
+            out += self._msg(b"D", payload)
+        verb = (sql.strip().split() or ["SELECT"])[0].upper()
+        n = len(rows) if verb == "SELECT" else max(rowcount, 0)
+        tag = f"INSERT 0 {n}" if verb == "INSERT" else f"{verb} {n}"
+        out += self._msg(b"C", tag.encode() + b"\x00")
+        conn.sendall(out)

@@ -1,0 +1,134 @@
+"""PostgreSQL wire client: SCRAM-SHA-256 correctness + handshake behaviors.
+
+The full storage contract runs against the protocol fake in
+test_storage_contract.py (param "postgres"); this file covers the pieces the
+contract can't: the RFC 7677 SCRAM test vector (pinning the client-side
+derivation against the spec, independent of our own server fake), the
+authenticated handshake, auth failure, and bytea/typed round-trips.
+"""
+
+import base64
+
+import pytest
+
+from incubator_predictionio_tpu.data.storage.base import Model, StorageError
+from incubator_predictionio_tpu.data.storage.postgres import (
+    PostgresStorageClient,
+    scram_client_proofs,
+)
+from tests.fixtures.fake_pg import FakePG
+
+
+def test_scram_rfc7677_vector():
+    """RFC 7677 §3 example: user=user pass=pencil, known nonces/salt."""
+    client_first_bare = "n=user,r=rOprNGfwEbeRWgbNEkqO"
+    server_first = ("r=rOprNGfwEbeRWgbNEkqO%hvYDpWUa2RaTCAfuxFIlj)hNlF$k0,"
+                    "s=W22ZaJ0SNY7soEsUEjb6gQ==,i=4096")
+    client_final_bare = ("c=biws,r=rOprNGfwEbeRWgbNEkqO%hvYDpWUa2RaTCAfuxFIlj"
+                         ")hNlF$k0")
+    auth_message = ",".join(
+        [client_first_bare, server_first, client_final_bare]).encode()
+    salt = base64.b64decode("W22ZaJ0SNY7soEsUEjb6gQ==")
+    proof, server_sig = scram_client_proofs("pencil", salt, 4096, auth_message)
+    assert base64.b64encode(proof).decode() == \
+        "dHzbZapWIk4jUhN+Ute9ytag9zjfMHgsqmmiz7AndVQ="
+    assert base64.b64encode(server_sig).decode() == \
+        "6rriTRBi23WpRR/wtup+mMhUZUn/dB5nLTJRsjl95G4="
+
+
+def test_scram_handshake_and_auth_failure():
+    server = FakePG(password="sekret")
+    try:
+        c = PostgresStorageClient({
+            "HOST": "127.0.0.1", "PORT": str(server.port),
+            "USERNAME": "pio", "PASSWORD": "sekret"})
+        assert c.apps().get_all() == []
+        c.close()
+        with pytest.raises(StorageError, match="28P01|authentication"):
+            PostgresStorageClient({
+                "HOST": "127.0.0.1", "PORT": str(server.port),
+                "USERNAME": "pio", "PASSWORD": "wrong"})
+    finally:
+        server.close()
+
+
+def test_bytea_and_null_round_trip():
+    server = FakePG()
+    try:
+        c = PostgresStorageClient({"HOST": "127.0.0.1",
+                                   "PORT": str(server.port)})
+        blob = bytes(range(256)) * 3  # every byte value through \x encoding
+        c.models().insert(Model("m", blob))
+        assert c.models().get("m").models == blob
+        # NULL params and results (description=None)
+        from incubator_predictionio_tpu.data.storage.base import App
+
+        app_id = c.apps().insert(App(0, "nulldesc", None))
+        assert c.apps().get(app_id).description is None
+        c.close()
+    finally:
+        server.close()
+
+
+def test_digit_only_text_values_stay_verbatim():
+    """entity ids like "007" are TEXT: they must round-trip unmangled and
+    keep matching find(entity_id=...) (real PG binds by column type)."""
+    import datetime as dt
+
+    from incubator_predictionio_tpu.data import Event
+
+    server = FakePG()
+    try:
+        c = PostgresStorageClient({"HOST": "127.0.0.1",
+                                   "PORT": str(server.port)})
+        ev = c.events()
+        ev.init(1)
+        ev.insert(Event(event="rate", entity_type="user", entity_id="007",
+                        target_entity_type="item", target_entity_id="0042",
+                        event_time=dt.datetime(2020, 1, 1,
+                                               tzinfo=dt.timezone.utc)), 1)
+        got = list(ev.find(1, entity_id="007"))
+        assert len(got) == 1
+        assert got[0].entity_id == "007" and got[0].target_entity_id == "0042"
+        assert list(ev.find(1, entity_id="7")) == []
+        c.close()
+    finally:
+        server.close()
+
+
+def test_poisoned_connection_reconnects():
+    """A mid-exchange socket failure must not leave stale frames for the
+    next query: the connection is poisoned and transparently re-established."""
+    from incubator_predictionio_tpu.data.storage.base import App
+
+    server = FakePG()
+    try:
+        c = PostgresStorageClient({"HOST": "127.0.0.1",
+                                   "PORT": str(server.port)})
+        app_id = c.apps().insert(App(0, "pre-crash", None))
+        # sever the socket under the client mid-session
+        c._conn._sock.close()
+        with pytest.raises(StorageError):
+            c.apps().get_all()
+        # next call reconnects and sees the (server-side) state again
+        assert c.apps().get(app_id).name == "pre-crash"
+        c.close()
+    finally:
+        server.close()
+
+
+def test_url_config_form():
+    server = FakePG(password="pw")
+    try:
+        c = PostgresStorageClient({
+            "URL": f"postgresql://pio:pw@127.0.0.1:{server.port}/pio"})
+        assert c.apps().get_all() == []
+        c.close()
+    finally:
+        server.close()
+
+
+def test_unreachable_reports_cleanly():
+    with pytest.raises(StorageError, match="unreachable"):
+        PostgresStorageClient({"HOST": "127.0.0.1", "PORT": "1",
+                               "TIMEOUT": "2"})

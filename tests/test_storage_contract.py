@@ -32,12 +32,27 @@ def t(n):
 
 
 @pytest.fixture(params=["memory", "sqlite", "eventlog", "eventlog-pyfallback",
-                        "remote", "elasticsearch"])
+                        "remote", "elasticsearch", "postgres"])
 def client(request, tmp_path, monkeypatch):
     if request.param == "memory":
         c = MemoryStorageClient({})
     elif request.param == "sqlite":
         c = SqliteStorageClient({"PATH": str(tmp_path / "pio.db")})
+    elif request.param == "postgres":
+        # the wire-protocol client against an in-process PG protocol fake —
+        # extended query protocol over a real socket
+        from incubator_predictionio_tpu.data.storage.postgres import (
+            PostgresStorageClient,
+        )
+        from tests.fixtures.fake_pg import FakePG
+
+        server = FakePG()
+        c = PostgresStorageClient({"HOST": "127.0.0.1",
+                                   "PORT": str(server.port)})
+        yield c
+        c.close()
+        server.close()
+        return
     elif request.param == "elasticsearch":
         # the REST client against an in-process ES protocol fake — exercises
         # query-DSL construction + search_after pagination over a real socket
