@@ -18,7 +18,7 @@ import pytest
 
 
 @pytest.mark.slow
-@pytest.mark.parametrize("backend", ["sqlite", "remote"])
+@pytest.mark.parametrize("backend", ["sqlite", "remote", "postgres"])
 def test_launch_two_process_train(tmp_path, backend, request):
     if backend == "sqlite":
         # shared filesystem: every process opens the same sqlite file
@@ -26,6 +26,22 @@ def test_launch_two_process_train(tmp_path, backend, request):
             "PIO_FS_BASEDIR": str(tmp_path / "fs"),
             "PIO_STORAGE_SOURCES_SQLITE_TYPE": "sqlite",
             "PIO_STORAGE_SOURCES_SQLITE_PATH": str(tmp_path / "pio.db"),
+        }
+    elif backend == "postgres":
+        # shared PostgreSQL — the reference's literal default topology —
+        # via the wire-protocol fake; each launch process opens its own
+        # authenticated connection over the socket
+        from tests.fixtures.fake_pg import FakePG
+
+        server = FakePG(password="launchpw")
+        request.addfinalizer(server.close)
+        env = {
+            "PIO_FS_BASEDIR": str(tmp_path / "fs"),
+            "PIO_STORAGE_SOURCES_PG_TYPE": "postgres",
+            "PIO_STORAGE_SOURCES_PG_HOST": "127.0.0.1",
+            "PIO_STORAGE_SOURCES_PG_PORT": str(server.port),
+            "PIO_STORAGE_SOURCES_PG_USERNAME": "pio",
+            "PIO_STORAGE_SOURCES_PG_PASSWORD": "launchpw",
         }
     else:
         # shared NOTHING: a storage server in this (parent) process owns the

@@ -1,0 +1,125 @@
+"""Workflow tier for the PostgreSQL backend: PG serves METADATA + EVENTDATA +
+MODELDATA through a full app→ingest→train→deploy→query cycle — the
+reference's default deployment topology (conf/pio-env.sh.template defaults
+all three repositories to PGSQL) — against the wire-protocol fake over a
+real socket with SCRAM auth.
+"""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from incubator_predictionio_tpu.data.storage import Storage, use_storage
+
+
+@pytest.fixture()
+def pg_storage():
+    from tests.fixtures.fake_pg import FakePG
+
+    server = FakePG(password="wfpw")
+    s = Storage({
+        "PIO_STORAGE_SOURCES_PG_TYPE": "jdbc",  # the reference's TYPE name
+        "PIO_STORAGE_SOURCES_PG_HOST": "127.0.0.1",
+        "PIO_STORAGE_SOURCES_PG_PORT": str(server.port),
+        "PIO_STORAGE_SOURCES_PG_USERNAME": "pio",
+        "PIO_STORAGE_SOURCES_PG_PASSWORD": "wfpw",
+    })
+    prev = use_storage(s)
+    yield s
+    use_storage(prev)
+    s.close()
+    server.close()
+
+
+def test_pg_backs_all_three_repositories_end_to_end(pg_storage, tmp_path):
+    storage = pg_storage
+    from incubator_predictionio_tpu.server.event_server import (
+        EventServer,
+        EventServerConfig,
+    )
+    from incubator_predictionio_tpu.server.query_server import (
+        QueryServer,
+        ServerConfig,
+    )
+    from incubator_predictionio_tpu.tools import cli
+
+    class Args:
+        name = "pgwf"
+        id = 0
+        description = None
+        access_key = ""
+
+    assert cli.cmd_app_new(Args(), storage) == 0
+    app = storage.get_meta_data_apps().get_by_name("pgwf")
+    key = storage.get_meta_data_access_keys().get_by_app_id(app.id)[0].key
+
+    rng = np.random.default_rng(23)
+    x = rng.normal(size=(48, 3))
+    y = (x[:, 0] + x[:, 1] > 0).astype(int)
+    events = [
+        {"event": "$set", "entityType": "user", "entityId": f"u{i}",
+         "properties": {"attr0": float(x[i, 0]), "attr1": float(x[i, 1]),
+                        "attr2": float(x[i, 2]), "plan": int(y[i])},
+         "eventTime": "2020-01-01T00:00:00Z"}
+        for i in range(48)
+    ]
+
+    async def ingest():
+        server = EventServer(EventServerConfig(), storage=storage)
+        client = TestClient(TestServer(server.make_app()))
+        await client.start_server()
+        try:
+            resp = await client.post(
+                f"/batch/events.json?accessKey={key}", json=events)
+            assert resp.status == 200
+            assert all(r["status"] == 201 for r in await resp.json())
+        finally:
+            await client.close()
+
+    asyncio.run(ingest())
+    assert len(list(storage.get_events().find(app.id))) == 48
+
+    variant_path = tmp_path / "engine.json"
+    variant_path.write_text(json.dumps({
+        "id": "pg-wf", "version": "1",
+        "engineFactory":
+            "incubator_predictionio_tpu.templates.classification."
+            "ClassificationEngine",
+        "datasource": {"params": {"appName": "pgwf"}},
+        "algorithms": [{"name": "mlp", "params": {
+            "hiddenDims": [8], "epochs": 60, "learningRate": 0.05,
+            "batchSize": 48}}],
+    }))
+    from incubator_predictionio_tpu.core.workflow.create_workflow import (
+        WorkflowConfig,
+        create_workflow,
+    )
+
+    instance_id = create_workflow(
+        WorkflowConfig(engine_variant=str(variant_path)), storage)
+    assert storage.get_meta_data_engine_instances().get(instance_id).status \
+        == "COMPLETED"
+    blob = storage.get_model_data_models().get(instance_id)
+    assert blob is not None and len(blob.models) > 100  # bytea round trip
+
+    async def query():
+        server = QueryServer(
+            ServerConfig(engine_variant=str(variant_path)), storage=storage)
+        client = TestClient(TestServer(server.make_app()))
+        await client.start_server()
+        try:
+            ok = 0
+            for i in range(12):
+                resp = await client.post(
+                    "/queries.json",
+                    json={"features": [float(v) for v in x[i]]})
+                assert resp.status == 200
+                ok += int((await resp.json())["label"] == int(y[i]))
+            return ok
+        finally:
+            await client.close()
+
+    assert asyncio.run(query()) >= 9

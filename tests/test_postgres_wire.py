@@ -117,6 +117,36 @@ def test_poisoned_connection_reconnects():
         server.close()
 
 
+def test_batch_with_duplicate_ids_is_last_wins():
+    """Real PG rejects a multi-row upsert touching one id twice (21000);
+    the backend must collapse duplicates last-wins like the other backends."""
+    import datetime as dt
+
+    from incubator_predictionio_tpu.data import DataMap, Event
+
+    server = FakePG()
+    try:
+        c = PostgresStorageClient({"HOST": "127.0.0.1",
+                                   "PORT": str(server.port)})
+        ev = c.events()
+        ev.init(1)
+        t0 = dt.datetime(2020, 1, 1, tzinfo=dt.timezone.utc)
+
+        def mk(v):
+            return Event(event_id="dup", event="rate", entity_type="user",
+                         entity_id="u1", target_entity_type="item",
+                         target_entity_id="i1",
+                         properties=DataMap({"rating": v}), event_time=t0)
+
+        ids = ev.insert_batch([mk(1.0), mk(5.0)], 1)
+        assert ids == ["dup", "dup"]
+        [got] = list(ev.find(1))
+        assert got.properties.get("rating") == 5.0  # last wins
+        c.close()
+    finally:
+        server.close()
+
+
 def test_url_config_form():
     server = FakePG(password="pw")
     try:
