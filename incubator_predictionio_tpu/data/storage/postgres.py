@@ -146,7 +146,8 @@ class _PGConn:
         self._connect()
 
     def _connect(self) -> None:
-        host, port, dbname, sslmode, timeout, read_timeout, _ = self._args
+        (host, port, dbname, sslmode, timeout, read_timeout,
+         root_cert) = self._args
         try:
             self._sock = socket.create_connection((host, port), timeout=timeout)
         except OSError as e:
@@ -158,7 +159,7 @@ class _PGConn:
             # each query risks a Nagle+delayed-ACK stall
             self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             if sslmode and sslmode != "disable":
-                self._start_tls(host, sslmode)
+                self._start_tls(host, sslmode, root_cert)
             self._startup(dbname)
             # the short timeout protects the handshake; queries may sort a
             # large table before the first row arrives
@@ -210,7 +211,7 @@ class _PGConn:
         return fields
 
     # -- connection setup -------------------------------------------------
-    def _start_tls(self, host: str, sslmode: str) -> None:
+    def _start_tls(self, host: str, sslmode: str, root_cert: str) -> None:
         import ssl
 
         if sslmode not in ("prefer", "require", "verify-ca", "verify-full"):
@@ -224,7 +225,6 @@ class _PGConn:
                 raise StorageError(
                     f"postgres server refused TLS (SSLMODE={sslmode})")
             return
-        root_cert = self._args[6]
         ctx = ssl.create_default_context(cafile=root_cert or None)
         if sslmode in ("prefer", "require"):
             # libpq semantics: encrypt, don't authenticate the server (certs
@@ -1007,15 +1007,25 @@ class PostgresStorageClient(StorageClient):
         url = config.get("URL")
         sslmode = config.get("SSLMODE", "")
         if url:
+            # accept the reference's literal pio-env.sh value:
+            # PIO_STORAGE_SOURCES_PGSQL_URL=jdbc:postgresql://host/db
+            if url.startswith("jdbc:"):
+                url = url[len("jdbc:"):]
             u = urllib.parse.urlsplit(url)
             host = u.hostname or "127.0.0.1"
             port = u.port or 5432
             dbname = (u.path or "/pio").lstrip("/") or "pio"
-            user = urllib.parse.unquote(u.username) if u.username else "pio"
-            password = urllib.parse.unquote(u.password) if u.password else ""
+            # credential precedence: userinfo in the URL, then the JDBC
+            # ?user=&password= query form, then the reference template's
+            # separate USERNAME/PASSWORD keys
+            q = urllib.parse.parse_qs(u.query)
+            user = (urllib.parse.unquote(u.username) if u.username
+                    else q.get("user", [config.get("USERNAME", "pio")])[-1])
+            password = (urllib.parse.unquote(u.password) if u.password
+                        else q.get("password",
+                                   [config.get("PASSWORD", "")])[-1])
             # honor the conventional libpq/JDBC ?sslmode=… suffix — silently
             # dropping it would downgrade an explicitly-requested TLS conn
-            q = urllib.parse.parse_qs(u.query)
             if "sslmode" in q:
                 sslmode = q["sslmode"][-1]
         else:

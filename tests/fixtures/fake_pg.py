@@ -257,9 +257,35 @@ class FakePG:
             return repr(v).encode()
         return str(v).encode()
 
+    @staticmethod
+    def _check_upsert_cardinality(tsql: str, pyvals: list):
+        """Real PG rejects a multi-row upsert touching one id twice
+        (SQLSTATE 21000); sqlite happily takes last-wins, so enforce the PG
+        behavior here or the client's dedup would be untestable."""
+        if "ON CONFLICT" not in tsql.upper():
+            return None
+        m = re.search(r"VALUES\s*(\(.+\))\s*ON CONFLICT", tsql,
+                      re.IGNORECASE | re.DOTALL)
+        if not m:
+            return None
+        n_rows = len(re.findall(r"\(", m.group(1)))
+        if n_rows <= 1 or len(pyvals) % n_rows:
+            return None
+        width = len(pyvals) // n_rows
+        ids = [pyvals[i * width] for i in range(n_rows)]  # PK is column 0
+        if len(set(ids)) != len(ids):
+            return ("21000",
+                    "ON CONFLICT DO UPDATE command cannot affect row a "
+                    "second time")
+        return None
+
     def _execute(self, conn, sql: str, params: list):
         try:
             tsql, pyvals = self._translate(sql, params)
+            err = self._check_upsert_cardinality(tsql, pyvals)
+            if err is not None:
+                conn.sendall(self._error(*err))
+                return
             with self._db_lock:
                 cur = self._db.execute(tsql, pyvals)
                 rows = cur.fetchall()

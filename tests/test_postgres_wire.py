@@ -154,6 +154,13 @@ def test_url_config_form():
             "URL": f"postgresql://pio:pw@127.0.0.1:{server.port}/pio"})
         assert c.apps().get_all() == []
         c.close()
+        # the reference's literal pio-env.sh form: jdbc: URL without
+        # credentials + separate USERNAME/PASSWORD keys
+        c = PostgresStorageClient({
+            "URL": f"jdbc:postgresql://127.0.0.1:{server.port}/pio",
+            "USERNAME": "pio", "PASSWORD": "pw"})
+        assert c.apps().get_all() == []
+        c.close()
     finally:
         server.close()
 
