@@ -1015,19 +1015,25 @@ class PostgresStorageClient(StorageClient):
             host = u.hostname or "127.0.0.1"
             port = u.port or 5432
             dbname = (u.path or "/pio").lstrip("/") or "pio"
+            # percent-decode only — parse_qs's form decoding would turn a
+            # literal '+' in a password into a space (JDBC/libpq query
+            # values are URI-escaped, not form-encoded)
+            q: dict[str, str] = {}
+            for part in u.query.split("&"):
+                if part:
+                    key, _, value = part.partition("=")
+                    q[key] = urllib.parse.unquote(value)
             # credential precedence: userinfo in the URL, then the JDBC
             # ?user=&password= query form, then the reference template's
             # separate USERNAME/PASSWORD keys
-            q = urllib.parse.parse_qs(u.query)
             user = (urllib.parse.unquote(u.username) if u.username
-                    else q.get("user", [config.get("USERNAME", "pio")])[-1])
+                    else q.get("user", config.get("USERNAME", "pio")))
             password = (urllib.parse.unquote(u.password) if u.password
-                        else q.get("password",
-                                   [config.get("PASSWORD", "")])[-1])
+                        else q.get("password", config.get("PASSWORD", "")))
             # honor the conventional libpq/JDBC ?sslmode=… suffix — silently
             # dropping it would downgrade an explicitly-requested TLS conn
             if "sslmode" in q:
-                sslmode = q["sslmode"][-1]
+                sslmode = q["sslmode"]
         else:
             host = config.get("HOST", "127.0.0.1")
             port = int(config.get("PORT", "5432"))

@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from incubator_predictionio_tpu.parallel.mesh import MeshContext
 from incubator_predictionio_tpu.parallel.ring import (
@@ -48,6 +49,12 @@ class TransformerConfig:
     epochs: int = 10
     seed: int = 0
     attention: str = "auto"       # "auto" | "local" | "ring"
+    # mixture-of-experts FFN (0 = dense). Switch-style top-1 routing with a
+    # static token capacity per expert; expert weights shard over the mesh's
+    # ``expert`` axis when present (XLA inserts the dispatch all_to_all)
+    n_experts: int = 0
+    expert_capacity_factor: float = 1.25
+    router_aux_weight: float = 1e-2
     # mid-training checkpoint/resume (utils/checkpoint.py); 0 = off
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 0     # epochs between checkpoints
@@ -65,18 +72,31 @@ def _init_params(key, cfg: TransformerConfig):
         "layers": [],
     }
     for _ in range(cfg.n_layers):
-        params["layers"].append({
+        layer = {
             "ln1": {"g": jnp.ones(d), "b": jnp.zeros(d)},
             "wq": init(next(k), (d, d), d ** -0.5),
             "wk": init(next(k), (d, d), d ** -0.5),
             "wv": init(next(k), (d, d), d ** -0.5),
             "wo": init(next(k), (d, d), d ** -0.5),
             "ln2": {"g": jnp.ones(d), "b": jnp.zeros(d)},
-            "w1": init(next(k), (d, dh), d ** -0.5),
-            "b1": jnp.zeros(dh),
-            "w2": init(next(k), (dh, d), dh ** -0.5),
-            "b2": jnp.zeros(d),
-        })
+        }
+        if cfg.n_experts:
+            e = cfg.n_experts
+            layer.update({
+                "wr": init(next(k), (d, e), d ** -0.5),      # router
+                "we1": init(next(k), (e, d, dh), d ** -0.5),
+                "be1": jnp.zeros((e, dh)),
+                "we2": init(next(k), (e, dh, d), dh ** -0.5),
+                "be2": jnp.zeros((e, d)),
+            })
+        else:
+            layer.update({
+                "w1": init(next(k), (d, dh), d ** -0.5),
+                "b1": jnp.zeros(dh),
+                "w2": init(next(k), (dh, d), dh ** -0.5),
+                "b2": jnp.zeros(d),
+            })
+        params["layers"].append(layer)
     return params
 
 
@@ -90,12 +110,66 @@ def _bf16_matmul(x, w):
     return (x.astype(jnp.bfloat16) @ w.astype(jnp.bfloat16)).astype(jnp.float32)
 
 
+def _moe_ffn(x, layer, cfg: TransformerConfig, mesh):
+    """Switch-style top-1 MoE FFN: x [B, L, D] → (y [B, L, D], aux loss).
+
+    Expert parallelism the XLA way: dispatched token slots [E, C, D] and the
+    expert weights [E, …] carry an ``expert``-axis sharding constraint when
+    the mesh has one, so the SPMD partitioner inserts the all_to_all on the
+    dispatch/combine einsums — no hand-written collective. Static capacity
+    C keeps every shape jit-constant; overflow tokens fall through on the
+    residual path (their combine weight is zero)."""
+    b, l, d = x.shape
+    e = cfg.n_experts
+    s = b * l
+    capacity = max(1, int(cfg.expert_capacity_factor * s / e))
+    xf = x.reshape(s, d)
+    logits = _bf16_matmul(xf, layer["wr"])                 # [S, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    chosen = jnp.argmax(probs, axis=-1)                    # [S]
+    onehot = jax.nn.one_hot(chosen, e, dtype=jnp.float32)  # [S, E]
+    gate = jnp.sum(probs * onehot, axis=-1)                # [S]
+    # position of each token within its expert's capacity slots
+    pos = jnp.cumsum(onehot, axis=0) * onehot - onehot     # [S, E], 0-based
+    keep = (pos < capacity).astype(jnp.float32) * onehot
+    dispatch = keep[..., None] * jax.nn.one_hot(
+        pos.sum(-1).astype(jnp.int32), capacity,
+        dtype=jnp.float32)[:, None, :]  # [S, E, C]
+    combine = dispatch * gate[:, None, None]
+
+    def on_experts(a):
+        if mesh is not None and "expert" in mesh.shape:
+            spec = P("expert", *([None] * (a.ndim - 1)))
+            return jax.lax.with_sharding_constraint(
+                a, NamedSharding(mesh, spec))
+        return a
+
+    bf = jnp.bfloat16
+    expert_in = on_experts(jnp.einsum(
+        "sec,sd->ecd", dispatch.astype(bf), xf.astype(bf)).astype(jnp.float32))
+    hidden = jax.nn.gelu(jnp.einsum(
+        "ecd,edh->ech", expert_in.astype(bf),
+        layer["we1"].astype(bf)).astype(jnp.float32) + layer["be1"][:, None, :])
+    out = on_experts(jnp.einsum(
+        "ech,ehd->ecd", hidden.astype(bf),
+        layer["we2"].astype(bf)).astype(jnp.float32) + layer["be2"][:, None, :])
+    y = jnp.einsum("sec,ecd->sd", combine.astype(bf),
+                   out.astype(bf)).astype(jnp.float32)
+    # load-balancing auxiliary (Switch Transformer eq. 4-6): fraction of
+    # tokens routed to each expert × mean router probability, scaled by E
+    frac = onehot.mean(axis=0)
+    mean_prob = probs.mean(axis=0)
+    aux = e * jnp.sum(frac * mean_prob)
+    return y.reshape(b, l, d), aux
+
+
 def _forward(params, tokens, positions, cfg: TransformerConfig,
              mesh=None, use_ring=False):
-    """tokens, positions: [B, L] int32 → hidden [B, L, D] fp32."""
+    """tokens, positions: [B, L] int32 → (hidden [B, L, D] fp32, aux loss)."""
     h = params["item_emb"][tokens] + params["pos_emb"][positions]
     b, l, d = h.shape
     nh, dh = cfg.n_heads, d // cfg.n_heads
+    aux_total = jnp.float32(0.0)
     for layer in params["layers"]:
         x = _ln(h, layer["ln1"])
         q = _bf16_matmul(x, layer["wq"]).reshape(b, l, nh, dh)
@@ -107,9 +181,14 @@ def _forward(params, tokens, positions, cfg: TransformerConfig,
             att = causal_attention(q, k, v)
         h = h + _bf16_matmul(att.reshape(b, l, d), layer["wo"])
         x = _ln(h, layer["ln2"])
-        x = jax.nn.gelu(_bf16_matmul(x, layer["w1"]) + layer["b1"])
-        h = h + _bf16_matmul(x, layer["w2"]) + layer["b2"]
-    return _ln(h, params["ln_f"])
+        if cfg.n_experts:
+            y, aux = _moe_ffn(x, layer, cfg, mesh)
+            aux_total = aux_total + aux
+            h = h + y
+        else:
+            x = jax.nn.gelu(_bf16_matmul(x, layer["w1"]) + layer["b1"])
+            h = h + _bf16_matmul(x, layer["w2"]) + layer["b2"]
+    return _ln(h, params["ln_f"]), aux_total
 
 
 @functools.lru_cache(maxsize=32)
@@ -128,10 +207,11 @@ def _train_epochs_fn(cfg: TransformerConfig, mesh, use_ring: bool):
     tx = optax.adam(cfg.learning_rate)
 
     def loss_fn(p, bt, bp, by, bw):
-        h = _forward(p, bt, bp, cfg, mesh, use_ring)
+        h, aux = _forward(p, bt, bp, cfg, mesh, use_ring)
         logits = _bf16_matmul(h, p["item_emb"].T)
         ls = optax.softmax_cross_entropy_with_integer_labels(logits, by)
-        return jnp.sum(ls * bw) / jnp.maximum(jnp.sum(bw), 1.0)
+        task = jnp.sum(ls * bw) / jnp.maximum(jnp.sum(bw), 1.0)
+        return task + cfg.router_aux_weight * aux
 
     # staged batches are jit ARGUMENTS, not closure captures: captured
     # arrays bake in as trace constants, which fails for multi-process
@@ -154,6 +234,26 @@ def _train_epochs_fn(cfg: TransformerConfig, mesh, use_ring: bool):
         return p, o, epoch_losses[-1]
 
     return train_epochs
+
+
+def _place_params_expert_sharded(ctx: MeshContext, host_params):
+    """Place params with expert weight tables sharded over the ``expert``
+    mesh axis (each device holds n_experts/ep of the FFN weights — the
+    memory win that makes MoE scale) and everything else replicated."""
+    expert_keys = ("we1", "be1", "we2", "be2")
+    placed = {
+        k: ctx.put(v) if not isinstance(v, (dict, list)) else v
+        for k, v in host_params.items() if k != "layers"
+    }
+    placed["ln_f"] = {k: ctx.put(v) for k, v in host_params["ln_f"].items()}
+    placed["layers"] = []
+    for layer in host_params["layers"]:
+        placed["layers"].append({
+            k: (ctx.put(v, "expert") if k in expert_keys
+                else jax.tree.map(ctx.put, v))
+            for k, v in layer.items()
+        })
+    return placed
 
 
 @dataclasses.dataclass
@@ -256,13 +356,19 @@ class TransformerRecommender:
         cache_cfg = dataclasses.replace(
             cfg, seed=0, checkpoint_dir=None, checkpoint_every=0)
         init = _jit_init_fn(cache_cfg)
-        if ctx.process_count == 1:
+        expert_parallel = bool(cfg.n_experts) and "expert" in ctx.mesh.shape
+        if expert_parallel and cfg.n_experts % ctx.axis_size("expert"):
+            raise ValueError(
+                f"n_experts={cfg.n_experts} must divide evenly over the "
+                f"expert axis ({ctx.axis_size('expert')} devices)")
+        if ctx.process_count == 1 and not expert_parallel:
             params = ctx.replicate(init(jax.random.key(cfg.seed)))
         else:
             # one batched device→host pull (per-leaf np.asarray costs one
             # round trip per leaf — see MeshContext.host_gather)
-            params = ctx.replicate(
-                jax.device_get(init(jax.random.key(cfg.seed))))
+            host_params = jax.device_get(init(jax.random.key(cfg.seed)))
+            params = (_place_params_expert_sharded(ctx, host_params)
+                      if expert_parallel else ctx.replicate(host_params))
         from incubator_predictionio_tpu.utils.optim import jit_adam_init
 
         opt_state = jit_adam_init(cfg.learning_rate)(params)
@@ -303,6 +409,6 @@ class TransformerRecommender:
 
 @partial(jax.jit, static_argnames=("cfg",))
 def _serve_scores(params, tokens, positions, cfg):
-    h = _forward(params, tokens, positions, cfg)  # local attention at serving
+    h, _ = _forward(params, tokens, positions, cfg)  # local attention at serving
     last = h[:, -1, :]  # left-padded → last position holds the newest item
     return _bf16_matmul(last, params["item_emb"].T)

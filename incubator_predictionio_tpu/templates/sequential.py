@@ -167,6 +167,9 @@ class TransformerAlgorithmParams(Params):
     epochs: int = 10
     seed: int = 0
     attention: str = "auto"  # "auto" | "local" | "ring"
+    # mixture-of-experts FFN: 0 = dense; >0 switches to top-1 routed experts
+    # sharded over the mesh's "expert" axis when present
+    num_experts: int = 0
     recent_events: tuple[str, ...] = ("view", "buy")
     checkpoint_dir: Optional[str] = None   # mid-training resume (utils/checkpoint.py)
     checkpoint_every: int = 0
@@ -193,6 +196,7 @@ class TransformerAlgorithm(PAlgorithm):
             epochs=p.epochs,
             seed=p.seed,
             attention=p.attention,
+            n_experts=p.num_experts,
             checkpoint_dir=p.checkpoint_dir,
             checkpoint_every=p.checkpoint_every,
         )

@@ -1,0 +1,109 @@
+"""Mixture-of-experts FFN: routing semantics, expert-parallel training.
+
+The expert axis is the ep leg of the parallelism story: expert weights and
+dispatched token slots shard over ``expert``; XLA inserts the all_to_all on
+the dispatch/combine einsums (no hand-written collective).
+"""
+
+
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from incubator_predictionio_tpu.models.transformer import (
+    TransformerConfig,
+    TransformerRecommender,
+    _forward,
+    _init_params,
+)
+from incubator_predictionio_tpu.parallel.mesh import MeshContext
+
+
+def _cfg(**kw):
+    base = dict(vocab_size=64, max_len=8, d_model=16, n_heads=2, n_layers=1,
+                batch_size=16, epochs=2, seed=0, attention="local")
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+def test_single_expert_matches_dense():
+    """E=1 routes every token to the one expert with gate prob 1.0 — the
+    layer must compute exactly the dense FFN with the same weights."""
+    cfg_d = _cfg()
+    cfg_m = _cfg(n_experts=1, expert_capacity_factor=1.0)
+    key = jax.random.key(0)
+    pd = _init_params(key, cfg_d)
+    pm = _init_params(key, cfg_m)
+    # graft the dense weights into the single expert
+    for ld, lm in zip(pd["layers"], pm["layers"]):
+        lm["we1"] = ld["w1"][None]
+        lm["be1"] = ld["b1"][None]
+        lm["we2"] = ld["w2"][None]
+        lm["be2"] = ld["b2"][None]
+        for k in ("wq", "wk", "wv", "wo", "ln1", "ln2"):
+            lm[k] = ld[k]
+    pm["item_emb"] = pd["item_emb"]
+    pm["pos_emb"] = pd["pos_emb"]
+    tokens = jax.random.randint(jax.random.key(1), (4, 8), 1, 64)
+    positions = jnp.broadcast_to(jnp.arange(8), (4, 8))
+    hd, aux_d = _forward(pd, tokens, positions, cfg_d)
+    hm, aux_m = _forward(pm, tokens, positions, cfg_m)
+    np.testing.assert_allclose(np.asarray(hd), np.asarray(hm),
+                               rtol=1e-4, atol=1e-4)
+    assert float(aux_d) == 0.0
+    assert float(aux_m) == pytest.approx(1.0)  # E * (1.0 * 1.0)
+
+
+def test_capacity_drops_overflow_tokens():
+    """With capacity 1 slot per expert, overflow tokens contribute nothing
+    (residual-only) instead of corrupting other tokens' slots."""
+    cfg = _cfg(n_experts=2, expert_capacity_factor=0.01)  # C = 1
+    params = _init_params(jax.random.key(0), cfg)
+    tokens = jnp.ones((2, 8), jnp.int32)
+    positions = jnp.broadcast_to(jnp.arange(8), (2, 8))
+    h, aux = _forward(params, tokens, positions, cfg)
+    assert np.isfinite(np.asarray(h)).all()
+    assert float(aux) > 0
+
+
+def test_expert_parallel_training_on_mesh():
+    """Train over a data×expert mesh: expert weights are genuinely sharded
+    over the expert axis, the step executes (all_to_all compiles and runs),
+    and loss decreases."""
+    ctx = MeshContext.create(axes={"data": 2, "expert": 4})
+    cfg = _cfg(n_experts=4, epochs=30, learning_rate=5e-3)
+    rng = np.random.default_rng(0)
+    # learnable structure: token t is followed by token t+1
+    seqs = np.zeros((32, 9), np.int32)
+    for i in range(32):
+        start = rng.integers(1, 40)
+        seqs[i] = np.arange(start, start + 9) % 63 + 1
+    from incubator_predictionio_tpu.data.bimap import BiMap
+
+    model = TransformerRecommender(cfg).fit(
+        ctx, seqs, BiMap({f"i{t}": t for t in range(64)}))
+    # sharding check: each expert table is split over the expert axis
+    we1 = None
+    # fit() gathers to host for the returned model; re-place to inspect
+    from incubator_predictionio_tpu.models.transformer import (
+        _place_params_expert_sharded,
+    )
+
+    placed = _place_params_expert_sharded(ctx, model.params)
+    we1 = placed["layers"][0]["we1"]
+    assert "expert" in we1.sharding.spec
+    shard_rows = {s.data.shape[0] for s in we1.addressable_shards}
+    assert shard_rows == {1}  # 4 experts / 4-device axis
+    assert np.isfinite(model.final_loss)
+    # learned the successor structure better than the uniform floor
+    assert model.final_loss < 4.0  # ln(63) ≈ 4.14 is chance level
+
+
+def test_expert_count_must_divide_axis():
+    ctx = MeshContext.create(axes={"data": 2, "expert": 4})
+    cfg = _cfg(n_experts=6)
+    with pytest.raises(ValueError, match="divide evenly"):
+        TransformerRecommender(cfg).fit(
+            ctx, np.ones((8, 9), np.int32), None)
