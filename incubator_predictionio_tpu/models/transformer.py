@@ -55,6 +55,11 @@ class TransformerConfig:
     n_experts: int = 0
     expert_capacity_factor: float = 1.25
     router_aux_weight: float = 1e-2
+    # pipeline parallelism (0 = off): split the layer stack into S stages
+    # over the mesh's ``pipe`` axis, GPipe microbatch schedule
+    # (parallel/pipeline.py); microbatches default to the stage count
+    pipeline_stages: int = 0
+    pipeline_microbatches: int = 0
     # mid-training checkpoint/resume (utils/checkpoint.py); 0 = off
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 0     # epochs between checkpoints
@@ -110,7 +115,7 @@ def _bf16_matmul(x, w):
     return (x.astype(jnp.bfloat16) @ w.astype(jnp.bfloat16)).astype(jnp.float32)
 
 
-def _moe_ffn(x, layer, cfg: TransformerConfig, mesh):
+def _moe_ffn(x, layer, cfg: TransformerConfig, mesh, token_mask=None):
     """Switch-style top-1 MoE FFN: x [B, L, D] → (y [B, L, D], aux loss).
 
     Expert parallelism the XLA way: dispatched token slots [E, C, D] and the
@@ -118,7 +123,11 @@ def _moe_ffn(x, layer, cfg: TransformerConfig, mesh):
     the mesh has one, so the SPMD partitioner inserts the all_to_all on the
     dispatch/combine einsums — no hand-written collective. Static capacity
     C keeps every shape jit-constant; overflow tokens fall through on the
-    residual path (their combine weight is zero)."""
+    residual path (their combine weight is zero).
+
+    ``token_mask`` [B, L] (1 = real token) keeps PADDING out of the router:
+    pad tokens claim no capacity slots and don't distort the load-balancing
+    statistics (batches are padded to mesh multiples at staging)."""
     b, l, d = x.shape
     e = cfg.n_experts
     s = b * l
@@ -128,6 +137,11 @@ def _moe_ffn(x, layer, cfg: TransformerConfig, mesh):
     probs = jax.nn.softmax(logits, axis=-1)
     chosen = jnp.argmax(probs, axis=-1)                    # [S]
     onehot = jax.nn.one_hot(chosen, e, dtype=jnp.float32)  # [S, E]
+    if token_mask is not None:
+        mask_f = token_mask.reshape(s).astype(jnp.float32)
+        onehot = onehot * mask_f[:, None]
+    else:
+        mask_f = jnp.ones((s,), jnp.float32)
     gate = jnp.sum(probs * onehot, axis=-1)                # [S]
     # position of each token within its expert's capacity slots
     pos = jnp.cumsum(onehot, axis=0) * onehot - onehot     # [S, E], 0-based
@@ -156,39 +170,66 @@ def _moe_ffn(x, layer, cfg: TransformerConfig, mesh):
     y = jnp.einsum("sec,ecd->sd", combine.astype(bf),
                    out.astype(bf)).astype(jnp.float32)
     # load-balancing auxiliary (Switch Transformer eq. 4-6): fraction of
-    # tokens routed to each expert × mean router probability, scaled by E
-    frac = onehot.mean(axis=0)
-    mean_prob = probs.mean(axis=0)
+    # REAL tokens routed to each expert × their mean router probability
+    n_real = jnp.maximum(mask_f.sum(), 1.0)
+    frac = onehot.sum(axis=0) / n_real
+    mean_prob = (probs * mask_f[:, None]).sum(axis=0) / n_real
     aux = e * jnp.sum(frac * mean_prob)
     return y.reshape(b, l, d), aux
+
+
+def _apply_layer(layer, h, cfg: TransformerConfig, mesh=None, use_ring=False,
+                 token_mask=None):
+    """One transformer block: h [B, L, D] → (h [B, L, D], aux loss)."""
+    b, l, d = h.shape
+    nh, dh = cfg.n_heads, d // cfg.n_heads
+    x = _ln(h, layer["ln1"])
+    q = _bf16_matmul(x, layer["wq"]).reshape(b, l, nh, dh)
+    k = _bf16_matmul(x, layer["wk"]).reshape(b, l, nh, dh)
+    v = _bf16_matmul(x, layer["wv"]).reshape(b, l, nh, dh)
+    if use_ring:
+        att = ring_attention_sharded(q, k, v, mesh)
+    else:
+        att = causal_attention(q, k, v)
+    h = h + _bf16_matmul(att.reshape(b, l, d), layer["wo"])
+    x = _ln(h, layer["ln2"])
+    if cfg.n_experts:
+        y, aux = _moe_ffn(x, layer, cfg, mesh, token_mask)
+        return h + y, aux
+    x = jax.nn.gelu(_bf16_matmul(x, layer["w1"]) + layer["b1"])
+    return h + _bf16_matmul(x, layer["w2"]) + layer["b2"], jnp.float32(0.0)
 
 
 def _forward(params, tokens, positions, cfg: TransformerConfig,
              mesh=None, use_ring=False):
     """tokens, positions: [B, L] int32 → (hidden [B, L, D] fp32, aux loss)."""
     h = params["item_emb"][tokens] + params["pos_emb"][positions]
-    b, l, d = h.shape
-    nh, dh = cfg.n_heads, d // cfg.n_heads
     aux_total = jnp.float32(0.0)
+    token_mask = (tokens != 0) if cfg.n_experts else None
     for layer in params["layers"]:
-        x = _ln(h, layer["ln1"])
-        q = _bf16_matmul(x, layer["wq"]).reshape(b, l, nh, dh)
-        k = _bf16_matmul(x, layer["wk"]).reshape(b, l, nh, dh)
-        v = _bf16_matmul(x, layer["wv"]).reshape(b, l, nh, dh)
-        if use_ring:
-            att = ring_attention_sharded(q, k, v, mesh)
-        else:
-            att = causal_attention(q, k, v)
-        h = h + _bf16_matmul(att.reshape(b, l, d), layer["wo"])
-        x = _ln(h, layer["ln2"])
-        if cfg.n_experts:
-            y, aux = _moe_ffn(x, layer, cfg, mesh)
-            aux_total = aux_total + aux
-            h = h + y
-        else:
-            x = jax.nn.gelu(_bf16_matmul(x, layer["w1"]) + layer["b1"])
-            h = h + _bf16_matmul(x, layer["w2"]) + layer["b2"]
+        h, aux = _apply_layer(layer, h, cfg, mesh, use_ring, token_mask)
+        aux_total = aux_total + aux
     return _ln(h, params["ln_f"]), aux_total
+
+
+def _forward_pipelined(params, tokens, positions, cfg: TransformerConfig,
+                       mesh, data_axis):
+    """Pipelined counterpart of :func:`_forward`: ``params["layers"]`` is the
+    STACKED pytree sharded over the ``pipe`` axis; embedding/unembedding stay
+    outside the pipeline (replicated, tied to the item table)."""
+    from incubator_predictionio_tpu.parallel.pipeline import pipeline_forward
+
+    h0 = params["item_emb"][tokens] + params["pos_emb"][positions]
+    m = cfg.pipeline_microbatches or cfg.pipeline_stages
+
+    def body(layer, h):
+        out, _aux = _apply_layer(layer, h, cfg)
+        return out
+
+    h = pipeline_forward(
+        params["layers"], h0, body, mesh, m,
+        data_axis=data_axis if data_axis in mesh.shape else None)
+    return _ln(h, params["ln_f"]), jnp.float32(0.0)
 
 
 @functools.lru_cache(maxsize=32)
@@ -198,7 +239,8 @@ def _jit_init_fn(cfg: TransformerConfig):
 
 
 @functools.lru_cache(maxsize=32)
-def _train_epochs_fn(cfg: TransformerConfig, mesh, use_ring: bool):
+def _train_epochs_fn(cfg: TransformerConfig, mesh, use_ring: bool,
+                     use_pipeline: bool = False, data_axis: str = "data"):
     """Module-level CACHED jitted schedule: repeated fits of the same
     (config, mesh, attention) reuse one executable. A jit defined inside
     ``fit`` is a fresh cache per call — every fit would recompile the whole
@@ -207,7 +249,10 @@ def _train_epochs_fn(cfg: TransformerConfig, mesh, use_ring: bool):
     tx = optax.adam(cfg.learning_rate)
 
     def loss_fn(p, bt, bp, by, bw):
-        h, aux = _forward(p, bt, bp, cfg, mesh, use_ring)
+        if use_pipeline:
+            h, aux = _forward_pipelined(p, bt, bp, cfg, mesh, data_axis)
+        else:
+            h, aux = _forward(p, bt, bp, cfg, mesh, use_ring)
         logits = _bf16_matmul(h, p["item_emb"].T)
         ls = optax.softmax_cross_entropy_with_integer_labels(logits, by)
         task = jnp.sum(ls * bw) / jnp.maximum(jnp.sum(bw), 1.0)
@@ -234,6 +279,29 @@ def _train_epochs_fn(cfg: TransformerConfig, mesh, use_ring: bool):
         return p, o, epoch_losses[-1]
 
     return train_epochs
+
+
+def _place_params_pipe_sharded(ctx: MeshContext, host_params):
+    """Stack the layer list and shard the stack's leading (layer) dim over
+    the ``pipe`` axis — each device holds only its stage's weights."""
+    from incubator_predictionio_tpu.parallel.pipeline import stack_layers
+
+    placed = {k: jax.tree.map(ctx.put, v)
+              for k, v in host_params.items() if k != "layers"}
+    placed["layers"] = jax.tree.map(
+        lambda a: ctx.put(a, "pipe"), stack_layers(host_params["layers"]))
+    return placed
+
+
+def _unstack_layers(params, n_layers: int):
+    """Stacked training layout → the canonical per-layer list (host arrays),
+    so serving and persistence see the same model shape as the dense path."""
+    out = dict(params)
+    stacked = params["layers"]
+    out["layers"] = [
+        jax.tree.map(lambda a: a[i], stacked) for i in range(n_layers)
+    ]
+    return out
 
 
 def _place_params_expert_sharded(ctx: MeshContext, host_params):
@@ -300,6 +368,21 @@ class TransformerRecommender:
         (parallel/staging.py) — host memory is data/P per process."""
         cfg = self.config
         use_ring = self._use_ring(ctx)
+        use_pipeline = bool(cfg.pipeline_stages) and "pipe" in ctx.mesh.shape
+        pipe_m = cfg.pipeline_microbatches or cfg.pipeline_stages
+        if use_pipeline:
+            if cfg.pipeline_stages != ctx.axis_size("pipe"):
+                raise ValueError(
+                    f"pipeline_stages={cfg.pipeline_stages} must equal the "
+                    f"pipe axis size ({ctx.axis_size('pipe')})")
+            if cfg.n_layers % cfg.pipeline_stages:
+                raise ValueError(
+                    f"n_layers={cfg.n_layers} must divide into "
+                    f"{cfg.pipeline_stages} pipeline stages")
+            if use_ring or cfg.n_experts:
+                raise ValueError(
+                    "pipeline parallelism composes with dp (and local "
+                    "attention), not with ring attention or MoE")
         tokens = sequences[:, :-1]
         targets = sequences[:, 1:]
         weights = (targets != 0).astype(np.float32) * (tokens != 0).astype(np.float32)
@@ -320,6 +403,12 @@ class TransformerRecommender:
                 stage_sharded_batches,
             )
 
+            if use_pipeline and cfg.batch_size % (
+                    pipe_m * ctx.axis_size(ctx.data_axis)):
+                raise ValueError(
+                    f"batch_size={cfg.batch_size} must be a multiple of "
+                    f"pipeline_microbatches × data axis "
+                    f"({pipe_m} × {ctx.axis_size(ctx.data_axis)})")
             (tb, pb, yb, wb), w_pad, _ = stage_sharded_batches(
                 ctx,
                 (tokens.astype(np.int32),
@@ -333,6 +422,11 @@ class TransformerRecommender:
             wb = wb * w_pad[..., None]
         else:
             global_batch = ctx.pad_to_batch_multiple(min(cfg.batch_size, max(n, 1)))
+            if use_pipeline:
+                # the GPipe schedule needs batch % (microbatches × data) == 0;
+                # round up — extra rows are zero-weight padding
+                mult = pipe_m * ctx.axis_size(ctx.data_axis)
+                global_batch = -(-global_batch // mult) * mult
             n_batches = max(1, (n + global_batch - 1) // global_batch)
             n_pad = n_batches * global_batch
             pad = n_pad - n
@@ -361,18 +455,24 @@ class TransformerRecommender:
             raise ValueError(
                 f"n_experts={cfg.n_experts} must divide evenly over the "
                 f"expert axis ({ctx.axis_size('expert')} devices)")
-        if ctx.process_count == 1 and not expert_parallel:
+        if ctx.process_count == 1 and not (expert_parallel or use_pipeline):
             params = ctx.replicate(init(jax.random.key(cfg.seed)))
         else:
             # one batched device→host pull (per-leaf np.asarray costs one
             # round trip per leaf — see MeshContext.host_gather)
             host_params = jax.device_get(init(jax.random.key(cfg.seed)))
-            params = (_place_params_expert_sharded(ctx, host_params)
-                      if expert_parallel else ctx.replicate(host_params))
+            if expert_parallel:
+                params = _place_params_expert_sharded(ctx, host_params)
+            elif use_pipeline:
+                params = _place_params_pipe_sharded(ctx, host_params)
+            else:
+                params = ctx.replicate(host_params)
         from incubator_predictionio_tpu.utils.optim import jit_adam_init
 
         opt_state = jit_adam_init(cfg.learning_rate)(params)
-        train_epochs = _train_epochs_fn(cache_cfg, ctx.mesh, use_ring)
+        train_epochs = _train_epochs_fn(
+            cache_cfg, ctx.mesh, use_ring,
+            use_pipeline=use_pipeline, data_axis=ctx.data_axis)
 
         from incubator_predictionio_tpu.utils.checkpoint import checkpointed_epochs
 
@@ -387,7 +487,10 @@ class TransformerRecommender:
         final_loss = float(loss) if loss is not None else float("nan")
         t_train = _time.perf_counter() - t_train  # float(loss) blocked above
         t_gather = _time.perf_counter()
-        model = TransformerModel(ctx.host_gather(params), item_map, cfg)
+        host_trained = ctx.host_gather(params)
+        if use_pipeline:
+            host_trained = _unstack_layers(host_trained, cfg.n_layers)
+        model = TransformerModel(host_trained, item_map, cfg)
         model.final_loss = final_loss
         model.timings = {"train_sec": round(t_train, 4),
                          "gather_sec": round(_time.perf_counter() - t_gather, 4)}

@@ -170,6 +170,9 @@ class TransformerAlgorithmParams(Params):
     # mixture-of-experts FFN: 0 = dense; >0 switches to top-1 routed experts
     # sharded over the mesh's "expert" axis when present
     num_experts: int = 0
+    # pipeline parallelism: stage count over the mesh's "pipe" axis (0 = off)
+    pipeline_stages: int = 0
+    pipeline_microbatches: int = 0
     recent_events: tuple[str, ...] = ("view", "buy")
     checkpoint_dir: Optional[str] = None   # mid-training resume (utils/checkpoint.py)
     checkpoint_every: int = 0
@@ -197,6 +200,8 @@ class TransformerAlgorithm(PAlgorithm):
             seed=p.seed,
             attention=p.attention,
             n_experts=p.num_experts,
+            pipeline_stages=p.pipeline_stages,
+            pipeline_microbatches=p.pipeline_microbatches,
             checkpoint_dir=p.checkpoint_dir,
             checkpoint_every=p.checkpoint_every,
         )

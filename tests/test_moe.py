@@ -56,6 +56,21 @@ def test_single_expert_matches_dense():
     assert float(aux_m) == pytest.approx(1.0)  # E * (1.0 * 1.0)
 
 
+def test_padding_tokens_do_not_route():
+    """Pad tokens (id 0) must not claim capacity slots or skew the aux
+    loss — a half-padding batch routes only its real tokens."""
+    cfg = _cfg(n_experts=2, expert_capacity_factor=1.0)
+    params = _init_params(jax.random.key(0), cfg)
+    real = jax.random.randint(jax.random.key(2), (2, 8), 1, 64)
+    padded = jnp.concatenate([real, jnp.zeros((2, 8), jnp.int32)])
+    positions = jnp.broadcast_to(jnp.arange(8), (4, 8))
+    h_all, aux_all = _forward(params, padded, positions, cfg)
+    h_real, aux_real = _forward(params, real, positions[:2], cfg)
+    # aux statistics computed over REAL tokens only: adding pure-padding
+    # rows leaves the load-balancing loss unchanged
+    assert float(aux_all) == pytest.approx(float(aux_real), rel=1e-4)
+
+
 def test_capacity_drops_overflow_tokens():
     """With capacity 1 slot per expert, overflow tokens contribute nothing
     (residual-only) instead of corrupting other tokens' slots."""
