@@ -24,8 +24,12 @@ import numpy as np
 from incubator_predictionio_tpu.core import (
     Engine,
     EngineFactory,
+    EngineParamsGenerator,
+    Evaluation,
     FirstServing,
     IdentityPreparator,
+    MetricEvaluator,
+    OptionAverageMetric,
     PAlgorithm,
     Params,
     PDataSource,
@@ -62,10 +66,19 @@ class PredictedResult:
 
 
 @dataclasses.dataclass(frozen=True)
+class ActualResult:
+    """Held-out next item of one session (eval ground truth)."""
+
+    next_item: str
+
+
+@dataclasses.dataclass(frozen=True)
 class DataSourceParams(Params):
     app_name: str = "sequential"
     max_len: int = 32
     events: tuple[str, ...] = ("view", "buy")
+    eval_k: Optional[int] = None  # k-fold next-item eval when set
+    eval_num: int = 10            # top-N asked per eval query
 
 
 @dataclasses.dataclass
@@ -100,15 +113,15 @@ class DataSource(PDataSource):
         super().__init__(params)
         self._store = PEventStore()
 
-    def read_training(self, ctx: MeshContext) -> TrainingData:
+    def _collect_sessions(self, ctx: MeshContext) -> tuple[dict[str, list[str]], bool]:
+        """user → ordered item list, for this process's user shard
+        (sessions are per-user; users are entity-sharded, so a session
+        never splits across processes)."""
         p = self.params
         procs, pid = ctx.process_count, ctx.process_index
         sharded = procs > 1
         sessions: dict[str, list[str]] = {}
-        item_ids: list[str] = []
         if sharded:
-            # sessions are per-user, users are entity-sharded → each process
-            # reads whole sessions for 1/P of the users (never splits one)
             events = self._store.find_sharded(
                 p.app_name, procs, entity_type="user",
                 event_names=tuple(p.events))[pid]
@@ -121,38 +134,94 @@ class DataSource(PDataSource):
             if e.target_entity_type != "item":
                 continue
             sessions.setdefault(e.entity_id, []).append(e.target_entity_id)
-            item_ids.append(e.target_entity_id)
-        # token 0 reserved for padding → 1-based item tokens
-        base = BiMap.string_int(item_ids)
+        return sessions, sharded
+
+    def _build_fold(self, ctx: MeshContext, sessions_list: list[list[str]],
+                    sharded: bool) -> TrainingData:
+        """Token space + encoded rows from the given sessions (global vocab
+        union when sharded; token 0 reserved for padding)."""
+        base = BiMap.string_int(
+            [i for items in sessions_list for i in items])
         n_rows_global = None
         if sharded:
-            from incubator_predictionio_tpu.data.sharded import (
-                global_row_count,
-                union_vocab,
-            )
+            from incubator_predictionio_tpu.data.sharded import union_vocab
 
             # global token space: first-seen union over shards in process
             # order (one vocab-sized allgather)
             vocab, _ = union_vocab(ctx, list(base))
             base = BiMap({v: i for i, v in enumerate(vocab.tolist())})
         item_map = BiMap({k: v + 1 for k, v in base.items()})
-        width = p.max_len + 1
+        width = self.params.max_len + 1
         rows = [
             encode_session(items, item_map, width)
-            for items in sessions.values()
+            for items in sessions_list
             if len(items) >= 2
         ]
         if sharded:
+            from incubator_predictionio_tpu.data.sharded import global_row_count
+
             n_rows_global = global_row_count(ctx, len(rows))
-            logger.info(
-                "sharded read: %d of %d rows (shard %d/%d)",
-                len(rows), n_rows_global, pid, procs)
+            logger.info("sharded read: %d of %d rows (shard %d/%d)",
+                        len(rows), n_rows_global, ctx.process_index,
+                        ctx.process_count)
         return TrainingData(
             sequences=np.stack(rows) if rows else np.zeros((0, width), np.int32),
             item_map=item_map,
             rows_are_local=sharded,
             n_rows_global=n_rows_global,
         )
+
+    def read_training(self, ctx: MeshContext) -> TrainingData:
+        sessions, sharded = self._collect_sessions(ctx)
+        return self._build_fold(ctx, list(sessions.values()), sharded)
+
+    def read_eval(self, ctx: MeshContext):
+        """k-fold next-item evaluation: sessions split by a stable user
+        hash; a held-out session becomes (Query(recentItems=prefix),
+        ActualResult(last item)). Fold vocabularies come from the fold's
+        TRAIN sessions only, so unseen items stay genuinely unknown (the
+        recommendation template's per-fold BiMap discipline)."""
+        import zlib
+
+        k = self.params.eval_k
+        if not k:
+            return []
+        p = self.params
+        sessions, sharded = self._collect_sessions(ctx)
+        # fold assignment computed ONCE per user (recommendation.py's
+        # fold_of discipline), not re-hashed per fold
+        fold_of = {
+            user: zlib.crc32(f"{p.app_name}|{user}".encode()) % k
+            for user in sessions
+        }
+        folds = []
+        for fold in range(k):
+            train_sessions, held = [], []
+            for user, items in sessions.items():
+                if fold_of[user] == fold:
+                    held.append(items)
+                else:
+                    train_sessions.append(items)
+            td = self._build_fold(ctx, train_sessions, sharded)
+            local_qa = [
+                (Query(recent_items=tuple(items[:-1]), num=p.eval_num),
+                 ActualResult(items[-1]))
+                for items in held if len(items) >= 3
+            ]
+            if sharded:
+                # every process evaluates the same (small) global query set
+                parts = ctx.allgather_obj([
+                    (list(q.recent_items), q.num, a.next_item)
+                    for q, a in local_qa
+                ])
+                qa = [
+                    (Query(recent_items=tuple(r), num=num), ActualResult(nx))
+                    for part in parts for r, num, nx in part
+                ]
+            else:
+                qa = local_qa
+            folds.append((td, {"fold": fold}, qa))
+        return folds
 
 
 @dataclasses.dataclass(frozen=True)
@@ -269,3 +338,46 @@ class SequentialEngine(EngineFactory):
             {"transformer": TransformerAlgorithm, "": TransformerAlgorithm},
             FirstServing,
         )
+
+
+# -- evaluation -------------------------------------------------------------
+
+class HitRateAtK(OptionAverageMetric):
+    """Fraction of held-out sessions whose true next item appears in the
+    top-k (the standard next-item metric; the serving path's unseen-only
+    policy applies, so repeat-item sessions count as misses)."""
+
+    def __init__(self, k: int = 10):
+        self.k = k
+
+    @property
+    def header(self) -> str:
+        return f"HitRate@K (k={self.k})"
+
+    def calculate_qpa(self, q: Query, p: PredictedResult, a: ActualResult):
+        if not p.item_scores:
+            return 0.0  # cold/unknown-vocab session: a miss, not a skip
+        return 1.0 if a.next_item in {
+            s.item for s in p.item_scores[: self.k]} else 0.0
+
+
+class SequentialEvaluation(Evaluation, EngineParamsGenerator):
+    """HitRate@10 over a small schedule grid — makes ``pio-tpu eval`` work
+    on the long-context flagship like it does on the recommendation
+    template."""
+
+    def __init__(self, app_name: str = "sequential", eval_k: int = 3):
+        from incubator_predictionio_tpu.core import EngineParams
+
+        self.engine = SequentialEngine().apply()
+        self.evaluator = MetricEvaluator(metric=HitRateAtK(k=10))
+        self.engine_params_list = [
+            EngineParams.create(
+                data_source=DataSourceParams(app_name=app_name, eval_k=eval_k),
+                algorithms=[("transformer", TransformerAlgorithmParams(
+                    app_name=app_name, d_model=32, n_layers=1,
+                    epochs=epochs, learning_rate=lr, batch_size=64))],
+            )
+            for epochs in (10, 30)
+            for lr in (1e-3, 5e-3)
+        ]
