@@ -9,6 +9,12 @@ queries; here the deployed models are loaded once and queries go through each
 algorithm's **vectorized** ``batch_predict`` in device-sized chunks — the
 "high-performance parallelization" the reference's docs promise is the MXU
 batch dimension instead of executor fan-out.
+
+Multi-process (``pio-tpu launch -n N batchpredict --distributed``): each
+process scores a contiguous slice of the input and writes
+``<output>.part-<pid>`` — the reference's ``saveAsTextFile`` part-file
+layout (BatchPredict.scala:228); concatenating the parts in order
+reproduces the input order.
 """
 
 from __future__ import annotations
@@ -47,8 +53,26 @@ def run_batch_predict(
     )
     serving = deployed.serving
     n = 0
-    with open(config.input_path) as fin, open(config.output_path, "w") as fout:
+    procs = ctx.process_count if ctx is not None else 1
+    pid = ctx.process_index if ctx is not None else 0
+    out_path = config.output_path
+    with open(config.input_path) as fin:
         lines = [line.strip() for line in fin if line.strip()]
+    if procs > 1:
+        # contiguous slice per process; part files concatenate in order
+        bounds = [round(i * len(lines) / procs) for i in range(procs + 1)]
+        lines = lines[bounds[pid]:bounds[pid + 1]]
+        out_path = f"{config.output_path}.part-{pid:05d}"
+        if pid == 0:
+            # stale parts from an earlier run (possibly with more
+            # processes) would corrupt the documented `cat part-*` merge
+            import glob
+            import os
+
+            for stale in glob.glob(f"{config.output_path}.part-*"):
+                os.remove(stale)
+        ctx.allgather_obj(None)  # barrier: cleanup precedes every write
+    with open(out_path, "w") as fout:
         queries = [
             serving.supplement(bind_query(deployed.query_cls, json.loads(line)))
             for line in lines
@@ -63,5 +87,5 @@ def run_batch_predict(
                 fout.write(json.dumps(to_jsonable(
                     serving.serve(q, preds), camelize_fields=True)) + "\n")
                 n += 1
-    logger.info("batch predict: %d queries → %s", n, config.output_path)
+    logger.info("batch predict: %d queries → %s", n, out_path)
     return n

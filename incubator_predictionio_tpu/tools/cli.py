@@ -326,7 +326,13 @@ def cmd_batchpredict(args, storage: Storage) -> int:
         BatchPredictConfig,
         run_batch_predict,
     )
+    from incubator_predictionio_tpu.parallel.mesh import MeshContext
 
+    ctx = None
+    if getattr(args, "distributed", False):
+        # under `pio-tpu launch -n N batchpredict --distributed` each
+        # process scores a slice and writes <output>.part-<pid>
+        ctx = MeshContext.from_conf({"distributed": True})
     n = run_batch_predict(
         BatchPredictConfig(
             engine_variant=args.engine_variant,
@@ -335,8 +341,14 @@ def cmd_batchpredict(args, storage: Storage) -> int:
             query_chunk=args.query_partitions or 1024,
         ),
         storage,
+        ctx,
     )
-    _out(f"Batch predict completed: {n} predictions written to {args.output}")
+    if ctx is not None and ctx.process_count > 1:
+        _out(f"Batch predict completed: {n} predictions written to "
+             f"{args.output}.part-{ctx.process_index:05d} "
+             f"(slice {ctx.process_index + 1}/{ctx.process_count})")
+    else:
+        _out(f"Batch predict completed: {n} predictions written to {args.output}")
     return 0
 
 
@@ -713,6 +725,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--output", default="batchpredict-output.json")
     p.add_argument("-v", "--engine-variant", default="engine.json")
     p.add_argument("--query-partitions", type=int)
+    p.add_argument("--distributed", action="store_true",
+                   help="score a per-process slice under `launch -n N`; "
+                        "writes <output>.part-<pid> files (the reference's "
+                        "saveAsTextFile layout)")
 
     # eventserver
     p = sub.add_parser("eventserver")
@@ -800,11 +816,11 @@ def cmd_launch(args, storage: Storage) -> int:
     if not verb_args:
         _out("launch: no verb given (e.g. pio-tpu launch -n 2 train -v engine.json)")
         return 2
-    if verb_args[0] not in ("train", "eval"):
+    if verb_args[0] not in ("train", "eval", "batchpredict"):
         # without --distributed gating, N processes of any other verb would
         # just run N independent copies against shared storage
-        _out(f"launch: only the train/eval verbs join a distributed job "
-             f"(got {verb_args[0]!r})")
+        _out(f"launch: only the train/eval/batchpredict verbs join a "
+             f"distributed job (got {verb_args[0]!r})")
         return 2
     if "--distributed" not in verb_args:
         verb_args.append("--distributed")

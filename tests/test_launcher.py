@@ -360,3 +360,94 @@ print("results:", insts[0].evaluator_results[:200] if insts else "")
     )
     assert check.returncode == 0, check.stdout + check.stderr
     assert "evalcompleted: 1" in check.stdout
+
+
+@pytest.mark.slow
+def test_launch_distributed_batchpredict(tmp_path):
+    """`launch -n 2 batchpredict --distributed`: each process scores a
+    contiguous input slice into <output>.part-<pid>; the concatenated parts
+    reproduce the single-process output line for line (the reference's
+    saveAsTextFile part layout, BatchPredict.scala:228)."""
+    env = {
+        "PIO_FS_BASEDIR": str(tmp_path / "fs"),
+        "PIO_STORAGE_SOURCES_SQLITE_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_SQLITE_PATH": str(tmp_path / "pio.db"),
+    }
+    run_env = dict(os.environ)
+    run_env.update(env)
+    run_env["JAX_PLATFORMS"] = "cpu"
+
+    seed = subprocess.run(
+        [sys.executable, "-"],
+        input="""
+import os, datetime as dt
+os.environ["JAX_PLATFORMS"] = "cpu"
+import numpy as np
+from incubator_predictionio_tpu.data.storage.registry import get_storage
+from incubator_predictionio_tpu.data.event import Event, DataMap
+from incubator_predictionio_tpu.data.storage.base import App
+storage = get_storage()
+app_id = storage.get_meta_data_apps().insert(App(id=0, name="launchapp"))
+ev = storage.get_events()
+ev.init(app_id)
+t0 = dt.datetime(2024, 1, 1, tzinfo=dt.timezone.utc)
+rng = np.random.default_rng(3)
+x = rng.normal(size=(48, 3))
+for i in range(48):
+    ev.insert(Event(event="$set", entity_type="user", entity_id=f"u{i}",
+                    properties=DataMap({"attr0": float(x[i,0]),
+                                        "attr1": float(x[i,1]),
+                                        "attr2": float(x[i,2]),
+                                        "plan": int(x[i,0]+x[i,1] > 0)}),
+                    event_time=t0), app_id)
+print("seeded", app_id)
+""",
+        capture_output=True, text=True, env=run_env, timeout=120,
+    )
+    assert seed.returncode == 0, seed.stdout + seed.stderr
+
+    variant = tmp_path / "engine.json"
+    variant.write_text(json.dumps({
+        "id": "launch-bp", "version": "1",
+        "datasource": {"params": {"appName": "launchapp"}},
+        **VARIANTS["classification"],
+    }))
+    train = subprocess.run(
+        [sys.executable, "-m", "incubator_predictionio_tpu.tools.cli",
+         "train", "-v", str(variant)],
+        capture_output=True, text=True, env=run_env, timeout=300,
+    )
+    assert train.returncode == 0, train.stdout + train.stderr
+
+    queries = tmp_path / "queries.json"
+    queries.write_text("\n".join(
+        json.dumps({"features": [0.1 * i, 0.2, -0.1 * i]}) for i in range(9)
+    ) + "\n")
+
+    # single-process reference output
+    single = subprocess.run(
+        [sys.executable, "-m", "incubator_predictionio_tpu.tools.cli",
+         "batchpredict", "-v", str(variant), "--input", str(queries),
+         "--output", str(tmp_path / "single.json")],
+        capture_output=True, text=True, env=run_env, timeout=300,
+    )
+    assert single.returncode == 0, single.stdout + single.stderr
+
+    # a stale part from an earlier, wider run must not survive the merge
+    (tmp_path / "multi.json.part-00005").write_text('{"stale": true}\n')
+    out = subprocess.run(
+        [sys.executable, "-m", "incubator_predictionio_tpu.tools.cli",
+         "launch", "-n", "2", "--cpu-devices-per-process", "1",
+         "batchpredict", "-v", str(variant), "--input", str(queries),
+         "--output", str(tmp_path / "multi.json"), "--distributed"],
+        capture_output=True, text=True, env=run_env, timeout=420,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    parts = sorted(tmp_path.glob("multi.json.part-*"))
+    assert [p.name for p in parts] == ["multi.json.part-00000",
+                                       "multi.json.part-00001"]
+    merged = "".join(p.read_text() for p in parts)
+    assert merged == (tmp_path / "single.json").read_text()
+    # 9 queries over 2 processes: a 5/4 contiguous split
+    counts = [len(p.read_text().splitlines()) for p in parts]
+    assert sorted(counts) == [4, 5]
