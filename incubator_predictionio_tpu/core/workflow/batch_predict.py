@@ -42,6 +42,11 @@ class BatchPredictConfig:
     query_chunk: int = 1024  # device batch per predict round
 
 
+def part_path(output_path: str, pid: int) -> str:
+    """The one place the distributed part-file naming scheme lives."""
+    return f"{output_path}.part-{pid:05d}"
+
+
 def run_batch_predict(
     config: BatchPredictConfig,
     storage: Optional[Storage] = None,
@@ -56,22 +61,49 @@ def run_batch_predict(
     procs = ctx.process_count if ctx is not None else 1
     pid = ctx.process_index if ctx is not None else 0
     out_path = config.output_path
-    with open(config.input_path) as fin:
-        lines = [line.strip() for line in fin if line.strip()]
     if procs > 1:
-        # contiguous slice per process; part files concatenate in order
-        bounds = [round(i * len(lines) / procs) for i in range(procs + 1)]
-        lines = lines[bounds[pid]:bounds[pid + 1]]
-        out_path = f"{config.output_path}.part-{pid:05d}"
+        # contiguous slice per process, STREAMED: only this slice is ever
+        # in memory (the large-input case is the point of this mode)
+        with open(config.input_path) as fin:
+            total = sum(1 for line in fin if line.strip())
+        bounds = [round(i * total / procs) for i in range(procs + 1)]
+        lo, hi = bounds[pid], bounds[pid + 1]
+        lines = []
+        with open(config.input_path) as fin:
+            i = 0
+            for line in fin:
+                line = line.strip()
+                if not line:
+                    continue
+                if i >= hi:
+                    break
+                if i >= lo:
+                    lines.append(line)
+                i += 1
+        out_path = part_path(config.output_path, pid)
+        cleanup_error = None
         if pid == 0:
             # stale parts from an earlier run (possibly with more
             # processes) would corrupt the documented `cat part-*` merge
             import glob
             import os
 
-            for stale in glob.glob(f"{config.output_path}.part-*"):
-                os.remove(stale)
-        ctx.allgather_obj(None)  # barrier: cleanup precedes every write
+            try:
+                for stale in glob.glob(
+                        glob.escape(config.output_path) + ".part-*"):
+                    os.remove(stale)
+            except OSError as e:
+                cleanup_error = repr(e)
+        # barrier (cleanup precedes every write) that also ships the cleanup
+        # outcome — raising BEFORE the collective would park the other
+        # processes in the allgather forever
+        failures = [s for s in ctx.allgather_obj(cleanup_error) if s]
+        if failures:
+            raise RuntimeError(
+                f"stale part cleanup failed on the primary: {failures[0]}")
+    else:
+        with open(config.input_path) as fin:
+            lines = [line.strip() for line in fin if line.strip()]
     with open(out_path, "w") as fout:
         queries = [
             serving.supplement(bind_query(deployed.query_cls, json.loads(line)))

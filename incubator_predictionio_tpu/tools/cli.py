@@ -9,7 +9,8 @@ Parity target: tools/console/Console.scala:134-623 and commands/*. Verbs:
   the in-package template registry; ``get`` scaffolds a ready-to-train
   engine.json),
   train, eval, deploy, undeploy, batchpredict, eventserver, storageserver,
-  export, import,
+  export, import, shell (bin/pio-shell: interactive console with the
+  storage/event-store/mesh bootstrap preloaded),
   start-all, stop-all (bin/pio-start-all / pio-stop-all: daemonize the
   serving stack with pidfiles), redeploy (examples/redeploy-script: cron-able
   train-with-retries + hot /reload of the deployed engine)
@@ -344,8 +345,12 @@ def cmd_batchpredict(args, storage: Storage) -> int:
         ctx,
     )
     if ctx is not None and ctx.process_count > 1:
+        from incubator_predictionio_tpu.core.workflow.batch_predict import (
+            part_path,
+        )
+
         _out(f"Batch predict completed: {n} predictions written to "
-             f"{args.output}.part-{ctx.process_index:05d} "
+             f"{part_path(args.output, ctx.process_index)} "
              f"(slice {ctx.process_index + 1}/{ctx.process_count})")
     else:
         _out(f"Batch predict completed: {n} predictions written to {args.output}")
@@ -438,6 +443,26 @@ def cmd_redeploy(args, storage: Storage) -> int:
         mesh_axes=json.loads(args.mesh_axes) if args.mesh_axes else None,
     ), storage)
     return 0 if instance_id else 1
+
+
+def cmd_shell(args, storage: Storage) -> int:
+    """Interactive console with the pypio-style bootstrap preloaded
+    (bin/pio-shell + python/pypio/shell.py slot): ``storage``,
+    ``l_event_store``, ``p_event_store``, and ``mesh(**axes)``."""
+    import incubator_predictionio_tpu.shell as sh
+
+    ns = {name: getattr(sh, name) for name in sh.__all__}
+    if args.shell_code:
+        exec(compile(args.shell_code, "<pio-tpu shell -c>", "exec"), ns)
+        return 0
+    import code
+
+    banner = (
+        f"incubator-predictionio-tpu shell (v{piotpu.__version__})\n"
+        "preloaded: storage, l_event_store, p_event_store, mesh(**axes)"
+    )
+    code.interact(banner=banner, local=ns, exitmsg="")
+    return 0
 
 
 #: In-package template registry (commands/Template.scala:33-69 points at the
@@ -792,6 +817,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="seconds between passes; omit to run once")
     p.add_argument("--mesh-axes", help='JSON, e.g. \'{"data": 4, "model": 2}\'')
 
+    # shell (bin/pio-shell counterpart)
+    p = sub.add_parser(
+        "shell",
+        help="interactive Python with the storage/event-store/mesh "
+             "bootstrap preloaded (bin/pio-shell --with-pyspark slot)")
+    p.add_argument("-c", "--code", dest="shell_code",
+                   help="run this statement instead of going interactive")
+
     # export / import
     p = sub.add_parser("export")
     p.add_argument("--appid", type=int, required=True)
@@ -859,6 +892,7 @@ _COMMANDS = {
     "start-all": cmd_start_all,
     "stop-all": cmd_stop_all,
     "redeploy": cmd_redeploy,
+    "shell": cmd_shell,
 }
 
 _APP_COMMANDS = {

@@ -170,6 +170,22 @@ def test_cli_subprocess_surface(tmp_path):
     assert out.returncode == 0
 
 
+def test_cli_shell_bootstrap(tmp_path):
+    """`pio-tpu shell -c` exposes the pypio-style namespace (storage,
+    event stores, mesh) against the configured backend."""
+    env, run = _cli_harness(tmp_path, timeout=120)
+    out = run("app", "new", "shellapp")
+    assert out.returncode == 0
+    out = run("shell", "-c",
+              "print('apps:', [a.name for a in "
+              "storage.get_meta_data_apps().get_all()]);"
+              "print('stores:', type(l_event_store).__name__,"
+              " type(p_event_store).__name__)")
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "apps: ['shellapp']" in out.stdout
+    assert "stores: LEventStore PEventStore" in out.stdout
+
+
 def test_cli_template_scaffold_trains(tmp_path):
     """`template list` names every in-package template and `template get`
     scaffolds an engine.json that actually trains (commands/Template.scala's
