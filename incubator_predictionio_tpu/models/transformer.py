@@ -60,6 +60,10 @@ class TransformerConfig:
     # (parallel/pipeline.py); microbatches default to the stage count
     pipeline_stages: int = 0
     pipeline_microbatches: int = 0
+    # rematerialization: recompute each block's activations in the backward
+    # pass instead of storing them — trades ~1 extra forward of FLOPs for
+    # O(n_layers) less activation HBM, the lever that fits long sequences
+    remat: bool = False
     # mid-training checkpoint/resume (utils/checkpoint.py); 0 = off
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 0     # epochs between checkpoints
@@ -206,8 +210,14 @@ def _forward(params, tokens, positions, cfg: TransformerConfig,
     h = params["item_emb"][tokens] + params["pos_emb"][positions]
     aux_total = jnp.float32(0.0)
     token_mask = (tokens != 0) if cfg.n_experts else None
+    block = _apply_layer
+    if cfg.remat:
+        # recompute-in-backward per block: activation HBM drops from
+        # O(n_layers × B × L × D) to O(B × L × D)
+        block = jax.checkpoint(
+            _apply_layer, static_argnums=(2, 3, 4))
     for layer in params["layers"]:
-        h, aux = _apply_layer(layer, h, cfg, mesh, use_ring, token_mask)
+        h, aux = block(layer, h, cfg, mesh, use_ring, token_mask)
         aux_total = aux_total + aux
     return _ln(h, params["ln_f"]), aux_total
 
@@ -225,6 +235,11 @@ def _forward_pipelined(params, tokens, positions, cfg: TransformerConfig,
     def body(layer, h):
         out, _aux = _apply_layer(layer, h, cfg)
         return out
+
+    if cfg.remat:
+        # remat composes with the pipeline: each stage recomputes its
+        # blocks' activations in backward (microbatch-sized, per layer)
+        body = jax.checkpoint(body)
 
     h = pipeline_forward(
         params["layers"], h0, body, mesh, m,

@@ -242,6 +242,9 @@ class TransformerAlgorithmParams(Params):
     # pipeline parallelism: stage count over the mesh's "pipe" axis (0 = off)
     pipeline_stages: int = 0
     pipeline_microbatches: int = 0
+    # recompute activations in backward (jax.checkpoint): fits longer
+    # sequences in HBM for ~1 extra forward of FLOPs
+    remat: bool = False
     recent_events: tuple[str, ...] = ("view", "buy")
     checkpoint_dir: Optional[str] = None   # mid-training resume (utils/checkpoint.py)
     checkpoint_every: int = 0
@@ -271,6 +274,7 @@ class TransformerAlgorithm(PAlgorithm):
             n_experts=p.num_experts,
             pipeline_stages=p.pipeline_stages,
             pipeline_microbatches=p.pipeline_microbatches,
+            remat=p.remat,
             checkpoint_dir=p.checkpoint_dir,
             checkpoint_every=p.checkpoint_every,
         )

@@ -15,10 +15,12 @@ Parity target: tools/console/Console.scala:134-623 and commands/*. Verbs:
   serving stack with pidfiles), redeploy (examples/redeploy-script: cron-able
   train-with-retries + hot /reload of the deployed engine)
 
-Differences by design: no ``build`` verb (Python engines need no sbt/assembly
-step — the variant JSON's ``engineFactory`` import path replaces the built
-jar), and ``run``'s spark-submit plumbing is unnecessary (everything runs
-in-process on the mesh).
+Differences by design: no ``build``/``unregister`` verbs (Python engines
+need no sbt/assembly step or manifest registry — the variant JSON's
+``engineFactory`` import path replaces the built jar), ``run``'s
+spark-submit plumbing is unnecessary (everything runs in-process on the
+mesh; ``launch`` covers multi-process), and ``upgrade`` (0.8-era HBase
+data migration) has no legacy stores to migrate.
 """
 
 from __future__ import annotations
@@ -325,6 +327,7 @@ def cmd_undeploy(args, storage: Storage) -> int:
 def cmd_batchpredict(args, storage: Storage) -> int:
     from incubator_predictionio_tpu.core.workflow.batch_predict import (
         BatchPredictConfig,
+        part_path,
         run_batch_predict,
     )
     from incubator_predictionio_tpu.parallel.mesh import MeshContext
@@ -345,10 +348,6 @@ def cmd_batchpredict(args, storage: Storage) -> int:
         ctx,
     )
     if ctx is not None and ctx.process_count > 1:
-        from incubator_predictionio_tpu.core.workflow.batch_predict import (
-            part_path,
-        )
-
         _out(f"Batch predict completed: {n} predictions written to "
              f"{part_path(args.output, ctx.process_index)} "
              f"(slice {ctx.process_index + 1}/{ctx.process_count})")

@@ -127,6 +127,29 @@ def test_pipeline_training_learns(ctx):
     assert scores.shape == (2, 64) and np.isfinite(scores).all()
 
 
+def test_remat_composes_with_pipeline(ctx):
+    """remat inside the pipeline body is semantics-preserving: gradients
+    match the unremat'd pipelined stack."""
+    import dataclasses as _dc
+
+    cfg = _cfg()
+    host_params = jax.device_get(_init_params(jax.random.key(0), cfg))
+    placed = _place_params_pipe_sharded(ctx, host_params)
+    tokens, positions = _inputs()
+
+    def loss(p, c):
+        h, _ = _forward_pipelined(p, tokens, positions, c, ctx.mesh,
+                                  ctx.data_axis)
+        return jnp.sum(h ** 2)
+
+    g0 = jax.jit(jax.grad(lambda p: loss(p, cfg)))(placed)
+    g1 = jax.jit(jax.grad(
+        lambda p: loss(p, _dc.replace(cfg, remat=True))))(placed)
+    np.testing.assert_allclose(
+        np.asarray(g0["layers"]["wq"]), np.asarray(g1["layers"]["wq"]),
+        rtol=1e-4, atol=1e-5)
+
+
 def test_indivisible_dataset_is_padded(ctx):
     """A dataset size with no relation to microbatches × data must train:
     the global batch rounds up and the extra rows ride as zero weight."""
