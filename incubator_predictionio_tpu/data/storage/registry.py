@@ -135,6 +135,15 @@ class Storage:
             out[repo] = (name, source)
         return out
 
+    def describe(self) -> list[tuple[str, str, str, str]]:
+        """(repository, name, source, type) rows — the ``pio status``
+        storage summary (commands/Management.scala:120-150 prints the
+        source behind each backend it verifies)."""
+        return [
+            (repo, name, source, self._sources[source].get("TYPE", "?"))
+            for repo, (name, source) in self._repos.items()
+        ]
+
     # -- client resolution ------------------------------------------------
     def _client_for(self, repo: str) -> StorageClient:
         _, source = self._repos[repo]

@@ -605,6 +605,8 @@ def cmd_status(args, storage: Storage) -> int:
             _out(f"  {row['device']}: {row['bytes_in_use'] / 2**20:.1f} MiB in use"
                  + (f" / {row['bytes_limit'] / 2**20:.0f} MiB"
                     if row["bytes_limit"] else ""))
+    for repo, name, source, type_name in storage.describe():
+        _out(f"  {repo}: name={name} source={source} type={type_name}")
     failures = storage.verify_all_data_objects()
     if failures:
         for f in failures:

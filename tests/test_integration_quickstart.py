@@ -166,6 +166,8 @@ def test_cli_subprocess_surface(tmp_path):
     assert "Finished listing 1 access key" in out.stdout
     out = run("status")
     assert "all ready to go" in out.stdout
+    # reference-style storage summary: repo → name/source/type bindings
+    assert "METADATA: name=" in out.stdout and "type=sqlite" in out.stdout
     out = run("app", "delete", "subapp", "-f")
     assert out.returncode == 0
 
