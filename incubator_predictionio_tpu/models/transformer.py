@@ -64,6 +64,11 @@ class TransformerConfig:
     # pass instead of storing them — trades ~1 extra forward of FLOPs for
     # O(n_layers) less activation HBM, the lever that fits long sequences
     remat: bool = False
+    # tensor parallelism (Megatron-style) over the mesh's ``model`` axis:
+    # attention heads and the FFN hidden dim shard column-wise, the output
+    # projections row-wise — the GSPMD way: annotate the WEIGHTS, let XLA
+    # insert the psums. The axis size must divide n_heads and 4*d_model.
+    tensor_parallel: bool = False
     # mid-training checkpoint/resume (utils/checkpoint.py); 0 = off
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 0     # epochs between checkpoints
@@ -319,6 +324,34 @@ def _unstack_layers(params, n_layers: int):
     return out
 
 
+def _place_params_tensor_sharded(ctx: MeshContext, host_params):
+    """Megatron-style weight placement over the ``model`` axis: the QKV and
+    FFN-up projections shard on their OUTPUT dim (column parallel: heads /
+    hidden features live on one device each), the attention-output and
+    FFN-down projections on their INPUT dim (row parallel). XLA's SPMD
+    partitioner then keeps every per-head / per-feature matmul local and
+    inserts exactly one psum after each row-parallel projection."""
+    col = {"wq", "wk", "wv", "w1", "b1"}   # shard last dim
+    row = {"wo", "w2"}                     # shard first weight dim
+    # (MoE expert tables never reach here — fit rejects tp + n_experts)
+
+    def place_layer(layer):
+        out = {}
+        for k, v in layer.items():
+            if k in col:
+                out[k] = ctx.put(v, *([None] * (np.ndim(v) - 1)), "model")
+            elif k in row:
+                out[k] = ctx.put(v, "model")
+            else:
+                out[k] = jax.tree.map(ctx.put, v)
+        return out
+
+    placed = {k: jax.tree.map(ctx.put, v)
+              for k, v in host_params.items() if k != "layers"}
+    placed["layers"] = [place_layer(l) for l in host_params["layers"]]
+    return placed
+
+
 def _place_params_expert_sharded(ctx: MeshContext, host_params):
     """Place params with expert weight tables sharded over the ``expert``
     mesh axis (each device holds n_experts/ep of the FFN weights — the
@@ -470,7 +503,22 @@ class TransformerRecommender:
             raise ValueError(
                 f"n_experts={cfg.n_experts} must divide evenly over the "
                 f"expert axis ({ctx.axis_size('expert')} devices)")
-        if ctx.process_count == 1 and not (expert_parallel or use_pipeline):
+        tensor_parallel = cfg.tensor_parallel and "model" in ctx.mesh.shape
+        if tensor_parallel:
+            tp = ctx.axis_size("model")
+            if cfg.n_heads % tp or (4 * cfg.d_model) % tp:
+                raise ValueError(
+                    f"tensor parallelism needs n_heads ({cfg.n_heads}) and "
+                    f"the FFN hidden dim ({4 * cfg.d_model}) divisible by "
+                    f"the model axis ({tp})")
+            if use_pipeline or cfg.n_experts:
+                # MoE expert tables have a different parallel layout (the
+                # expert axis); mixing the placements is unsupported
+                raise ValueError(
+                    "tensor parallelism composes with dp/sp, not with the "
+                    "pipeline or MoE placements")
+        if ctx.process_count == 1 and not (
+                expert_parallel or use_pipeline or tensor_parallel):
             params = ctx.replicate(init(jax.random.key(cfg.seed)))
         else:
             # one batched device→host pull (per-leaf np.asarray costs one
@@ -480,6 +528,8 @@ class TransformerRecommender:
                 params = _place_params_expert_sharded(ctx, host_params)
             elif use_pipeline:
                 params = _place_params_pipe_sharded(ctx, host_params)
+            elif tensor_parallel:
+                params = _place_params_tensor_sharded(ctx, host_params)
             else:
                 params = ctx.replicate(host_params)
         from incubator_predictionio_tpu.utils.optim import jit_adam_init

@@ -245,6 +245,8 @@ class TransformerAlgorithmParams(Params):
     # recompute activations in backward (jax.checkpoint): fits longer
     # sequences in HBM for ~1 extra forward of FLOPs
     remat: bool = False
+    # Megatron-style tensor parallelism over the mesh's "model" axis
+    tensor_parallel: bool = False
     recent_events: tuple[str, ...] = ("view", "buy")
     checkpoint_dir: Optional[str] = None   # mid-training resume (utils/checkpoint.py)
     checkpoint_every: int = 0
@@ -275,6 +277,7 @@ class TransformerAlgorithm(PAlgorithm):
             pipeline_stages=p.pipeline_stages,
             pipeline_microbatches=p.pipeline_microbatches,
             remat=p.remat,
+            tensor_parallel=p.tensor_parallel,
             checkpoint_dir=p.checkpoint_dir,
             checkpoint_every=p.checkpoint_every,
         )

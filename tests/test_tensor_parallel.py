@@ -1,0 +1,113 @@
+"""Tensor parallelism for the transformer: Megatron-style weight sharding
+over the ``model`` axis — parity with replicated training and genuine
+weight distribution (GSPMD inserts the collectives)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from incubator_predictionio_tpu.data.bimap import BiMap
+from incubator_predictionio_tpu.models.transformer import (
+    TransformerConfig,
+    TransformerRecommender,
+    _forward,
+    _init_params,
+    _place_params_tensor_sharded,
+)
+from incubator_predictionio_tpu.parallel.mesh import MeshContext
+
+
+def _cfg(**kw):
+    base = dict(vocab_size=64, max_len=8, d_model=16, n_heads=4, n_layers=2,
+                batch_size=16, epochs=2, seed=0, attention="local",
+                tensor_parallel=True)
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    return MeshContext.create(axes={"data": 2, "model": 4})
+
+
+def test_column_row_placement_is_exact_fp32(ctx):
+    """The Megatron pattern itself, pinned bit-tight in fp32: a column-
+    parallel projection followed by a row-parallel one equals the
+    replicated computation exactly (the psum GSPMD inserts after the
+    row-parallel matmul reconstructs the full contraction)."""
+    rng = np.random.default_rng(0)
+    d, dh = 16, 64
+    x = jnp.asarray(rng.normal(size=(8, d)).astype(np.float32))
+    w1 = rng.normal(size=(d, dh)).astype(np.float32)
+    w2 = rng.normal(size=(dh, d)).astype(np.float32)
+    y_rep = jnp.tanh(x @ w1) @ w2
+
+    w1_s = ctx.put(w1, None, "model")   # column parallel
+    w2_s = ctx.put(w2, "model")         # row parallel
+    y_tp = jax.jit(lambda a, b: jnp.tanh(x @ a) @ b)(w1_s, w2_s)
+    np.testing.assert_allclose(np.asarray(y_rep), np.asarray(y_tp),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_sharded_forward_matches_replicated(ctx):
+    """Transformer-level integration: sharded ≈ replicated (tolerance
+    covers bf16 rounding under different fusion boundaries; the exact
+    placement guarantee is test_column_row_placement_is_exact_fp32)."""
+    cfg = _cfg()
+    host_params = jax.device_get(_init_params(jax.random.key(0), cfg))
+    placed = _place_params_tensor_sharded(ctx, host_params)
+    tokens = jax.random.randint(jax.random.key(1), (8, 8), 1, 64)
+    positions = jnp.broadcast_to(jnp.arange(8), (8, 8))
+
+    h_rep, _ = _forward(host_params, tokens, positions, cfg)
+    h_tp, _ = jax.jit(
+        lambda p: _forward(p, tokens, positions, cfg))(placed)
+    np.testing.assert_allclose(np.asarray(h_rep), np.asarray(h_tp),
+                               rtol=5e-2, atol=5e-2)
+
+
+def test_weights_are_actually_distributed(ctx):
+    """Each device holds 1/tp of the heads and FFN features — the memory
+    point of tensor parallelism."""
+    cfg = _cfg()
+    host_params = jax.device_get(_init_params(jax.random.key(0), cfg))
+    placed = _place_params_tensor_sharded(ctx, host_params)
+    layer = placed["layers"][0]
+    d, dh = cfg.d_model, 4 * cfg.d_model
+    # column-parallel: output dim split 4 ways
+    assert {s.data.shape[1] for s in layer["wq"].addressable_shards} == {d // 4}
+    assert {s.data.shape[1] for s in layer["w1"].addressable_shards} == {dh // 4}
+    # row-parallel: input dim split 4 ways
+    assert {s.data.shape[0] for s in layer["wo"].addressable_shards} == {d // 4}
+    assert {s.data.shape[0] for s in layer["w2"].addressable_shards} == {dh // 4}
+
+
+def test_tensor_parallel_training_learns(ctx):
+    cfg = _cfg(epochs=30, learning_rate=5e-3)
+    rng = np.random.default_rng(0)
+    seqs = np.zeros((32, 9), np.int32)
+    for i in range(32):
+        start = rng.integers(1, 40)
+        seqs[i] = np.arange(start, start + 9) % 63 + 1
+    model = TransformerRecommender(cfg).fit(
+        ctx, seqs, BiMap({f"i{t}": t for t in range(64)}))
+    assert model.final_loss < 4.0  # ln(63) ≈ 4.14 is chance level
+    scores = TransformerRecommender.next_item_scores(model, seqs[:2, :-1])
+    assert scores.shape == (2, 64) and np.isfinite(scores).all()
+
+
+def test_validations(ctx):
+    with pytest.raises(ValueError, match="divisible by the model axis"):
+        TransformerRecommender(_cfg(n_heads=2)).fit(
+            ctx, np.ones((8, 9), np.int32), None)
+    with pytest.raises(ValueError, match="not with the pipeline"):
+        ctx4 = MeshContext.create(axes={"model": 2, "pipe": 4})
+        TransformerRecommender(_cfg(
+            n_heads=4, n_layers=4, pipeline_stages=4)).fit(
+            ctx4, np.ones((8, 9), np.int32), None)
+    # MoE has its own parallel layout — even REPLICATED experts (no
+    # 'expert' axis) must be rejected, not mis-sharded
+    with pytest.raises(ValueError, match="not with the pipeline or MoE"):
+        TransformerRecommender(_cfg(n_experts=2)).fit(
+            ctx, np.ones((8, 9), np.int32), None)
