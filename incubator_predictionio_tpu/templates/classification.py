@@ -30,15 +30,21 @@ import numpy as np
 from incubator_predictionio_tpu.core import (
     Engine,
     EngineFactory,
+    EngineParamsGenerator,
+    Evaluation,
     FirstServing,
     IdentityPreparator,
     LServing,
+    MetricEvaluator,
     P2LAlgorithm,
     Params,
     PDataSource,
     SanityCheck,
 )
-from incubator_predictionio_tpu.core.metric import AverageMetric
+from incubator_predictionio_tpu.core.metric import (
+    AverageMetric,
+    OptionAverageMetric,
+)
 from incubator_predictionio_tpu.data.store import PEventStore
 from incubator_predictionio_tpu.models.mlp import MLPClassifier, MLPConfig, MLPModel
 from incubator_predictionio_tpu.parallel.mesh import MeshContext
@@ -352,6 +358,24 @@ class Accuracy(AverageMetric):
         return 1.0 if p.label == a else 0.0
 
 
+class Precision(OptionAverageMetric):
+    """Per-label precision (PrecisionEvaluation.scala:25-45): scored only
+    where the PREDICTED label is the target — true positive 1.0, false
+    positive 0.0, everything else skipped (None)."""
+
+    def __init__(self, label):
+        self.label = label
+
+    @property
+    def header(self) -> str:
+        return f"Precision(label = {self.label})"
+
+    def calculate_qpa(self, q, p: PredictedResult, a):
+        if p.label != self.label:
+            return None  # unrelated to this label's precision
+        return 1.0 if p.label == a else 0.0
+
+
 # -- engine factory ---------------------------------------------------------
 
 class ClassificationEngine(EngineFactory):
@@ -362,3 +386,55 @@ class ClassificationEngine(EngineFactory):
             {"mlp": MLPAlgorithm, "nb": NaiveBayesAlgorithm, "": MLPAlgorithm},
             {"first": FirstServing, "vote": VoteServing, "": FirstServing},
         )
+
+
+# -- evaluations (Evaluation.scala / PrecisionEvaluation.scala /
+#    CompleteEvaluation.scala in the add-algorithm example) -----------------
+
+def _classification_grid(app_name: str, eval_k: int):
+    from incubator_predictionio_tpu.core import EngineParams
+
+    return [
+        EngineParams.create(
+            data_source=DataSourceParams(app_name=app_name, eval_k=eval_k),
+            algorithms=[("mlp", MLPAlgorithmParams(
+                hidden_dims=dims, learning_rate=lr, epochs=60))],
+        )
+        for dims in ((16,), (32, 32))
+        for lr in (1e-2, 3e-2)
+    ]
+
+
+class AccuracyEvaluation(Evaluation, EngineParamsGenerator):
+    """engineMetric = (ClassificationEngine(), Accuracy()) over a small
+    MLP grid (Evaluation.scala:36-41 + EngineParamsList)."""
+
+    def __init__(self, app_name: str = "classification", eval_k: int = 3):
+        self.engine = ClassificationEngine().apply()
+        self.evaluator = MetricEvaluator(metric=Accuracy())
+        self.engine_params_list = _classification_grid(app_name, eval_k)
+
+
+class PrecisionEvaluation(Evaluation, EngineParamsGenerator):
+    """engineMetric = (ClassificationEngine(), Precision(label=1.0))
+    (PrecisionEvaluation.scala:42-44)."""
+
+    def __init__(self, app_name: str = "classification", eval_k: int = 3,
+                 label=1.0):
+        self.engine = ClassificationEngine().apply()
+        self.evaluator = MetricEvaluator(metric=Precision(label=label))
+        self.engine_params_list = _classification_grid(app_name, eval_k)
+
+
+class CompleteEvaluation(Evaluation, EngineParamsGenerator):
+    """Accuracy + per-label precisions side by side
+    (CompleteEvaluation.scala: MetricEvaluator with otherMetrics)."""
+
+    def __init__(self, app_name: str = "classification", eval_k: int = 3,
+                 labels=(0.0, 1.0)):
+        self.engine = ClassificationEngine().apply()
+        self.evaluator = MetricEvaluator(
+            metric=Accuracy(),
+            other_metrics=[Precision(label=lb) for lb in labels],
+        )
+        self.engine_params_list = _classification_grid(app_name, eval_k)

@@ -202,5 +202,36 @@ def test_eval_accuracy_metric(storage, ctx):
         assert len(results) == 3
         acc = Accuracy().calculate(ctx, results)
         assert acc > 0.75, f"k-fold accuracy {acc}"
+        # per-label precision (PrecisionEvaluation.scala semantics): scored
+        # only where the PREDICTED label matches; on separable data both
+        # labels should be precise
+        from incubator_predictionio_tpu.templates.classification import (
+            Precision,
+        )
+
+        for label in (0, 1):
+            prec = Precision(label=label).calculate(ctx, results)
+            assert prec > 0.7, f"precision({label}) = {prec}"
+        # precision of a never-predicted label is undefined (all None → nan)
+        import math
+
+        assert math.isnan(Precision(label=42).calculate(ctx, results))
     finally:
         use_storage(prev)
+
+
+def test_evaluation_classes_wire_up():
+    """AccuracyEvaluation / PrecisionEvaluation / CompleteEvaluation carry
+    the engine+evaluator+grid contract `pio-tpu eval` consumes."""
+    from incubator_predictionio_tpu.templates.classification import (
+        AccuracyEvaluation,
+        CompleteEvaluation,
+        PrecisionEvaluation,
+    )
+
+    for cls in (AccuracyEvaluation, PrecisionEvaluation, CompleteEvaluation):
+        ev = cls(app_name="cls-test")
+        assert ev.engine is not None and ev.evaluator is not None
+        assert len(ev.engine_params_list) == 4
+    assert "Precision(label = 1.0)" in \
+        PrecisionEvaluation(app_name="cls-test").evaluator.metric.header
