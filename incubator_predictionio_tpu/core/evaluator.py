@@ -132,12 +132,17 @@ class MetricEvaluator(BaseEvaluator):
             scores.append((ep, ms))
         if not scores:
             raise ValueError("no engine params variants were evaluated")
-        best_idx, (best_ep, best_ms) = max(
-            enumerate(scores),
-            key=lambda t: (
-                t[1][1].score if self.metric.is_larger_better else -t[1][1].score
-            ),
-        )
+        def rank_key(t):
+            score = t[1][1].score
+            # NaN-safe: an undefined score (e.g. an Option metric that
+            # skipped every row) must never beat a defined one — max()
+            # would otherwise keep a leading NaN because `x > nan` is
+            # always False
+            if score != score:
+                return float("-inf")
+            return score if self.metric.is_larger_better else -score
+
+        best_idx, (best_ep, best_ms) = max(enumerate(scores), key=rank_key)
         result = MetricEvaluatorResult(
             best_score=best_ms,
             best_engine_params=best_ep,

@@ -30,6 +30,7 @@ import numpy as np
 from incubator_predictionio_tpu.core import (
     Engine,
     EngineFactory,
+    EngineParams,
     EngineParamsGenerator,
     Evaluation,
     FirstServing,
@@ -392,8 +393,6 @@ class ClassificationEngine(EngineFactory):
 #    CompleteEvaluation.scala in the add-algorithm example) -----------------
 
 def _classification_grid(app_name: str, eval_k: int):
-    from incubator_predictionio_tpu.core import EngineParams
-
     return [
         EngineParams.create(
             data_source=DataSourceParams(app_name=app_name, eval_k=eval_k),
@@ -427,14 +426,16 @@ class PrecisionEvaluation(Evaluation, EngineParamsGenerator):
 
 
 class CompleteEvaluation(Evaluation, EngineParamsGenerator):
-    """Accuracy + per-label precisions side by side
-    (CompleteEvaluation.scala: MetricEvaluator with otherMetrics)."""
+    """Accuracy + per-label precisions, winner recorded to best.json
+    (CompleteEvaluation.scala:24-30: otherMetrics = Precision(0/1/2),
+    outputPath = "best.json")."""
 
     def __init__(self, app_name: str = "classification", eval_k: int = 3,
-                 labels=(0.0, 1.0)):
+                 labels=(0.0, 1.0, 2.0), output_path: str = "best.json"):
         self.engine = ClassificationEngine().apply()
         self.evaluator = MetricEvaluator(
             metric=Accuracy(),
             other_metrics=[Precision(label=lb) for lb in labels],
+            output_path=output_path,
         )
         self.engine_params_list = _classification_grid(app_name, eval_k)

@@ -134,7 +134,7 @@ class DataSource(PDataSource):
             # (followed ids can live outside this follower shard)
             user_ids |= set(union_label_set(ctx, local_users))
             n_follows_global = global_row_count(ctx, len(follows))
-            logger.info("sharded read: %d of %d follow rows (shard %d/%d)",
+            logger.info("sharded read: %d of %d rows (shard %d/%d)",
                         len(follows), n_follows_global, pid, procs)
         else:
             user_ids |= local_users

@@ -173,6 +173,31 @@ for i in range(300):
                     target_entity_type="item", target_entity_id=f"i{i % 20}",
                     event_time=t0 + dt.timedelta(seconds=i)), app_id)
 """,
+    "similarproduct": """
+for i in range(12):
+    ev.insert(Event(event="$set", entity_type="item", entity_id=f"i{i}",
+                    properties=DataMap({"categories": ["c1"]}),
+                    event_time=t0), app_id)
+for i in range(300):
+    ev.insert(Event(event="view" if i % 4 else "like", entity_type="user",
+                    entity_id=f"u{i % 14}", target_entity_type="item",
+                    target_entity_id=f"i{i % 12}",
+                    event_time=t0 + dt.timedelta(seconds=i)), app_id)
+""",
+    "recommendeduser": """
+for u in range(14):
+    ev.insert(Event(event="$set", entity_type="user", entity_id=f"u{u}",
+                    event_time=t0), app_id)
+n = 0
+for u in range(14):
+    for t in range(14):
+        if u != t and (u % 2) == (t % 2):
+            ev.insert(Event(event="follow", entity_type="user",
+                            entity_id=f"u{u}", target_entity_type="user",
+                            target_entity_id=f"u{t}",
+                            event_time=t0 + dt.timedelta(seconds=n)), app_id)
+            n += 1
+""",
 }
 
 VARIANTS = {
@@ -197,11 +222,25 @@ VARIANTS = {
             "nLayers": 1, "epochs": 2, "batchSize": 32,
             "attention": "local"}}],
     },
+    "similarproduct": {
+        "engineFactory": "incubator_predictionio_tpu.templates.similarproduct."
+                         "SimilarProductEngine",
+        "algorithms": [{"name": "als", "params": {
+            "rank": 8, "numIterations": 2}}],
+    },
+    "recommendeduser": {
+        "engineFactory": "incubator_predictionio_tpu.templates.recommended_user."
+                         "RecommendedUserEngine",
+        "algorithms": [{"name": "als", "params": {
+            "rank": 8, "numIterations": 2}}],
+    },
 }
 
 
 @pytest.mark.slow
-@pytest.mark.parametrize("template", ["classification", "ecommerce", "sequential"])
+@pytest.mark.parametrize("template", ["classification", "ecommerce",
+                                      "sequential", "similarproduct",
+                                      "recommendeduser"])
 def test_launch_sharded_reads_other_templates(tmp_path, template):
     """Every template's data source reads only its entity shard under launch
     (VERDICT r2 weak #3: the sharded read path generalized beyond the

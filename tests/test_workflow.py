@@ -92,6 +92,36 @@ def test_run_evaluation_picks_best_variant(storage):
     assert stored.evaluator_results_json
 
 
+def test_nan_primary_score_never_wins(storage):
+    """An Option metric that skipped every row for one variant scores NaN;
+    the ranking must prefer any DEFINED score (max() alone would keep a
+    leading NaN because `x > nan` is always False)."""
+    from incubator_predictionio_tpu.core.metric import OptionAverageMetric
+
+    class FirstVariantUndefined(OptionAverageMetric):
+        def calculate_qpa(self, q, p, a):
+            # sample engine: p = 10*mult + q, so p - q == 10 identifies the
+            # mult=1 variant — skip ALL of its rows (score becomes NaN)
+            return None if (p - q) == 10 else -abs(p - a)
+
+    evaluation = Evaluation()
+    evaluation.engine = simple_engine()
+    evaluation.evaluator = MetricEvaluator(FirstVariantUndefined())
+    variants = [
+        EngineParams.create(data_source=DSParams(n=5),
+                            algorithms=[("algo", AlgoParams(mult=m))])
+        for m in (1, 2, 3)
+    ]
+    instance = EvaluationInstance(
+        id="", status="INIT", start_time=dt.datetime.now(UTC), end_time=None,
+        evaluation_class="test.Eval",
+    )
+    _, result = run_evaluation(evaluation, variants, instance, storage=storage)
+    # mult=1 scores NaN (all skipped); mult=2 has the best defined score
+    assert result.best_idx == 1
+    assert result.best_score.score == result.best_score.score  # not NaN
+
+
 def test_cmd_eval_routes_through_fast_eval_by_default(storage):
     """`pio-tpu eval` memoizes shared pipeline prefixes automatically
     (reference FastEvalEngine.scala is the default machinery): the
