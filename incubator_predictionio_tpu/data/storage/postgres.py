@@ -493,7 +493,11 @@ class PGEvents(EventStore):
                 creation_time BIGINT NOT NULL,
                 entity_shard BIGINT NOT NULL
             )""")
-        self._c.query(f"CREATE INDEX IF NOT EXISTS {t}_time ON {t} (event_time)")
+        # composite (event_time, id): keyset pages in _stream_find filter on
+        # the row comparison (event_time, id) > (...) and ORDER BY both —
+        # single-column event_time would re-scan prior pages every page
+        self._c.query(
+            f"CREATE INDEX IF NOT EXISTS {t}_time ON {t} (event_time, id)")
         self._c.query(
             f"CREATE INDEX IF NOT EXISTS {t}_entity ON {t} (entity_type, entity_id)")
         self._c.query(f"CREATE INDEX IF NOT EXISTS {t}_shard ON {t} (entity_shard)")
@@ -579,8 +583,12 @@ class PGEvents(EventStore):
         if entity_id is not None:
             where.append(f"entity_id = {ph(entity_id)}")
         if event_names is not None:
-            where.append(
-                "event IN (" + ",".join(ph(n) for n in event_names) + ")")
+            if event_names:
+                where.append(
+                    "event IN (" + ",".join(ph(n) for n in event_names) + ")")
+            else:
+                # empty IN () is a PG syntax error; match-nothing like sqlite
+                where.append("FALSE")
         if target_entity_type is not UNSET:
             if target_entity_type is None:
                 where.append("target_entity_type IS NULL")
@@ -594,9 +602,8 @@ class PGEvents(EventStore):
         if shard_range is not None:
             where.append(f"entity_shard >= {ph(shard_range[0])}")
             where.append(f"entity_shard < {ph(shard_range[1])}")
-        sql = f"SELECT {_EVENT_COLS} FROM {t}"
-        if where:
-            sql += " WHERE " + " AND ".join(where)
+        sql = f"SELECT {_EVENT_COLS} FROM {t} WHERE " + (
+            " AND ".join(where) if where else "TRUE")
         return sql, params
 
     def find(
@@ -616,17 +623,58 @@ class PGEvents(EventStore):
         sql, params = self._find_sql(
             app_id, channel_id, start_time, until_time, entity_type,
             entity_id, event_names, target_entity_type, target_entity_id)
-        sql += f" ORDER BY event_time {'DESC' if reversed else 'ASC'}"
-        if limit is not None and limit >= 0:
-            params.append(limit)
-            sql += f" LIMIT ${len(params)}"
         try:
-            rows, _ = self._c.query(sql, params)
+            return self._stream_find(
+                sql, params, reversed=reversed,
+                limit=limit if (limit is not None and limit >= 0) else None)
         except UndefinedTable as e:
             raise StorageError(
                 f"event table for app {app_id} channel {channel_id} "
                 f"not initialized") from e
-        return (_row_to_event(r) for r in rows)
+
+    def _stream_find(
+        self,
+        base_sql: str,
+        base_params: list,
+        reversed: bool = False,
+        limit: Optional[int] = None,
+        chunk: int = 5000,
+    ) -> Iterator[Event]:
+        """Keyset-paginated scan on ``(event_time, id)`` — large result sets
+        stream in ``chunk``-row pages instead of materializing in host memory
+        (the JDBCPEvents streaming counterpart). The first page is fetched
+        eagerly so an uninitialized table raises at call time."""
+        op, order = ("<", "DESC") if reversed else (">", "ASC")
+
+        def page(cursor, n: int) -> list[tuple]:
+            sql, params = base_sql, list(base_params)
+            if cursor is not None:
+                params.extend(cursor)
+                sql += (f" AND (event_time, id) {op} "
+                        f"(${len(params) - 1}, ${len(params)})")
+            params.append(n)
+            sql += f" ORDER BY event_time {order}, id {order} LIMIT ${len(params)}"
+            rows, _ = self._c.query(sql, params)
+            return rows
+
+        first_n = chunk if limit is None else min(chunk, limit)
+        first = page(None, first_n) if first_n > 0 else []
+
+        def gen() -> Iterator[Event]:
+            rows, n, remaining = first, first_n, limit
+            while True:
+                yield from (_row_to_event(r) for r in rows)
+                if remaining is not None:
+                    remaining -= len(rows)
+                    if remaining <= 0:
+                        return
+                if len(rows) < n:
+                    return
+                cursor = (int(rows[-1][7]), rows[-1][0])
+                n = chunk if remaining is None else min(chunk, remaining)
+                rows = page(cursor, n)
+
+        return gen()
 
     def find_sharded(
         self,
@@ -649,9 +697,8 @@ class PGEvents(EventStore):
             sql, params = self._find_sql(
                 app_id, channel_id, start_time, until_time, entity_type,
                 None, event_names, UNSET, UNSET, shard_range=(lo, hi))
-            sql += " ORDER BY event_time ASC"
-            rows, _ = self._c.query(sql, params)  # lazy: runs when iterated
-            yield from (_row_to_event(r) for r in rows)
+            # lazy: first page fetched when iterated; streams in chunks
+            yield from self._stream_find(sql, params)
 
         return [shard_iter(bounds[i], bounds[i + 1]) for i in range(n_shards)]
 

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import logging
 from functools import partial
 from typing import Optional
 
@@ -35,6 +36,8 @@ from incubator_predictionio_tpu.parallel.ring import (
     causal_attention,
     ring_attention_sharded,
 )
+
+logger = logging.getLogger(__name__)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -417,6 +420,11 @@ class TransformerRecommender:
         cfg = self.config
         use_ring = self._use_ring(ctx)
         use_pipeline = bool(cfg.pipeline_stages) and "pipe" in ctx.mesh.shape
+        if cfg.pipeline_stages and not use_pipeline:
+            logger.warning(
+                "pipeline_stages=%d requested but the mesh has no 'pipe' "
+                "axis (mesh axes: %s) — training runs without pipeline "
+                "parallelism", cfg.pipeline_stages, tuple(ctx.mesh.shape))
         pipe_m = cfg.pipeline_microbatches or cfg.pipeline_stages
         if use_pipeline:
             if cfg.pipeline_stages != ctx.axis_size("pipe"):
@@ -499,11 +507,21 @@ class TransformerRecommender:
             cfg, seed=0, checkpoint_dir=None, checkpoint_every=0)
         init = _jit_init_fn(cache_cfg)
         expert_parallel = bool(cfg.n_experts) and "expert" in ctx.mesh.shape
+        if cfg.n_experts and not expert_parallel:
+            logger.warning(
+                "n_experts=%d requested but the mesh has no 'expert' axis "
+                "(mesh axes: %s) — expert tables stay replicated",
+                cfg.n_experts, tuple(ctx.mesh.shape))
         if expert_parallel and cfg.n_experts % ctx.axis_size("expert"):
             raise ValueError(
                 f"n_experts={cfg.n_experts} must divide evenly over the "
                 f"expert axis ({ctx.axis_size('expert')} devices)")
         tensor_parallel = cfg.tensor_parallel and "model" in ctx.mesh.shape
+        if cfg.tensor_parallel and not tensor_parallel:
+            logger.warning(
+                "tensor_parallel requested but the mesh has no 'model' axis "
+                "(mesh axes: %s) — weights stay replicated",
+                tuple(ctx.mesh.shape))
         if tensor_parallel:
             tp = ctx.axis_size("model")
             if cfg.n_heads % tp or (4 * cfg.d_model) % tp:

@@ -5,8 +5,10 @@ Parity target: data/api/EventServer.scala:54-663, route for route:
 - ``GET  /``                    — welcome ``{"status": "alive"}``
 - ``POST /events.json``         — create (201 + eventId; creationTime is
                                   forced server-side, EventJson4sSupport.scala:77)
-- ``GET  /events.json``         — query with time/entity/event filters,
-                                  ``limit`` default 20 (−1 = all), ``reversed``
+- ``GET  /events.json``         — query with time/entity/event/target-entity
+                                  filters (:314-333), ``limit`` default 20
+                                  (−1 = all), ``reversed`` (requires both
+                                  entityType and entityId, :329-333)
 - ``GET/DELETE /events/<id>.json``
 - ``POST /batch/events.json``   — ≤ 50 events, per-item statuses (:376-462)
 - ``GET  /stats.json``          — opt-in via PIO_EVENTSERVER_STATS=true
@@ -263,9 +265,23 @@ class EventServer:
                 {"message": f"Invalid limit: {q.get('limit')}"}, status=400
             )
         event_names = q.getall("event") if "event" in q else None
-        from incubator_predictionio_tpu.data.storage.base import StorageError
+        from incubator_predictionio_tpu.data.storage.base import UNSET, StorageError
 
         start_time, until_time = parse_time("startTime"), parse_time("untilTime")
+        is_reversed = q.get("reversed", "false").lower() == "true"
+        # EventServer.scala:329-333 — reversed requires both entity params.
+        if is_reversed and not (q.get("entityType") and q.get("entityId")):
+            return web.json_response(
+                {
+                    "message": "the parameter reversed can only be used with "
+                    "both entityType and entityId specified."
+                },
+                status=400,
+            )
+        target_entity_type = (
+            q["targetEntityType"] if "targetEntityType" in q else UNSET
+        )
+        target_entity_id = q["targetEntityId"] if "targetEntityId" in q else UNSET
 
         def do_find() -> list[dict]:
             found = self.storage.get_events().find(
@@ -276,8 +292,10 @@ class EventServer:
                 entity_type=q.get("entityType"),
                 entity_id=q.get("entityId"),
                 event_names=event_names,
+                target_entity_type=target_entity_type,
+                target_entity_id=target_entity_id,
                 limit=None if limit == -1 else limit,
-                reversed=q.get("reversed", "false").lower() == "true",
+                reversed=is_reversed,
             )
             return [e.to_json_dict() for e in found]
 

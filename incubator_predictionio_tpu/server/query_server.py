@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import datetime as _dt
 import json
 import logging
 import time
@@ -341,6 +342,9 @@ class QueryServer:
 
     async def handle_status(self, request: web.Request) -> web.Response:
         inst = self.deployed.instance
+        if "text/html" in request.headers.get("Accept", ""):
+            return web.Response(
+                text=self._status_html(), content_type="text/html")
         return web.json_response({
             "status": "alive",
             "engineInstance": {
@@ -367,6 +371,82 @@ class QueryServer:
             "jitCompileKeys": jitstats.count(),
             "uptimeSec": time.time() - self._start_time,
         })
+
+    def _status_html(self) -> str:
+        """Human status page on ``/`` — the twirl template counterpart
+        (core/src/main/twirl/.../workflow/index.scala.html, served by
+        CreateServer.scala:437-462). Same sections: engine info, server info,
+        per-stage params, algorithms+models, feedback loop. Self-contained
+        CSS (no CDN — serving hosts may have no egress)."""
+        import html as _html
+
+        inst = self.deployed.instance
+        cfg = self.config
+
+        def esc(v) -> str:
+            return _html.escape(str(v))
+
+        def table(rows: list[tuple[str, object]]) -> str:
+            return "<table>" + "".join(
+                f"<tr><th>{esc(k)}</th><td>{esc(v)}</td></tr>"
+                for k, v in rows) + "</table>"
+
+        algo_rows = "".join(
+            f"<tr><th rowspan=\"3\">{i + 1}</th>"
+            f"<th>Class</th><td>{esc(type(a).__name__)}</td></tr>"
+            f"<tr><th>Parameters</th><td>{esc(p)}</td></tr>"
+            f"<tr><th>Model</th><td>{esc(m)}</td></tr>"
+            for i, (a, p, m) in enumerate(zip(
+                self.deployed.algorithms,
+                json.loads(inst.algorithms_params or "[]")
+                + [""] * len(self.deployed.algorithms),
+                [type(m).__name__ for m in self.deployed.models]))
+        )
+        title = (f"{inst.engine_factory} ({inst.engine_variant}) - "
+                 f"Engine Server at {cfg.ip}:{cfg.port}")
+        return f"""<!DOCTYPE html>
+<html lang="en">
+<head><title>{esc(title)}</title>
+<style>
+ body {{ font-family: sans-serif; margin: 2em; }}
+ table {{ border-collapse: collapse; margin-bottom: 1.5em; }}
+ th, td {{ border: 1px solid #ccc; padding: 4px 10px; text-align: left; }}
+ td {{ font-family: Menlo, Monaco, Consolas, monospace; }}
+</style></head>
+<body>
+<h1>Engine Server at {esc(cfg.ip)}:{esc(cfg.port)}</h1>
+<p>{esc(inst.engine_factory)} ({esc(inst.engine_variant)})</p>
+<h2>Engine Information</h2>
+{table([
+    ("Training Start Time", inst.start_time),
+    ("Training End Time", inst.end_time),
+    ("Variant ID", inst.engine_variant),
+    ("Instance ID", inst.id),
+])}
+<h2>Server Information</h2>
+{table([
+    ("Start Time", _dt.datetime.fromtimestamp(self._start_time)),
+    ("Request Count", self.request_count),
+    ("Average Serving Time", f"{self.avg_serving_sec:.4f} seconds"),
+    ("Last Serving Time", f"{self.last_serving_sec:.4f} seconds"),
+    ("Engine Factory Class", inst.engine_factory),
+])}
+<h2>Data Source</h2>
+{table([("Parameters", inst.data_source_params)])}
+<h2>Data Preparator</h2>
+{table([("Parameters", inst.preparator_params)])}
+<h2>Algorithms and Models</h2>
+<table><tr><th>#</th><th colspan="2">Information</th></tr>{algo_rows}</table>
+<h2>Serving</h2>
+{table([("Parameters", inst.serving_params)])}
+<h2>Feedback Loop Information</h2>
+{table([
+    ("Feedback Loop Enabled?", cfg.feedback),
+    ("Event Server IP", cfg.event_server_ip),
+    ("Event Server Port", cfg.event_server_port),
+])}
+</body>
+</html>"""
 
     async def handle_query(self, request: web.Request) -> web.Response:
         t0 = time.time()

@@ -433,6 +433,7 @@ class ALSAlgorithm(PAlgorithm):
         ]
         if known:
             banned = [self._banned(model, q) for _, q in known]
+            # recommend_batch clamps num to the catalog size internally
             num = max(q.num + len(b) for (_, q), b in zip(known, banned))
             uidx = np.asarray([model.user_map[q.user] for _, q in known], np.int32)
             idx, scores = TwoTowerMF.recommend_batch(model.mf, uidx, num)

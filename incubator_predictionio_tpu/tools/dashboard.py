@@ -4,7 +4,8 @@ Parity target: tools/dashboard/Dashboard.scala:44-160 + the twirl index page:
 an HTML index of completed EvaluationInstances (newest first) with per-
 instance evaluator results served as txt/html/json. TLS + key auth mirror
 the reference's SSLConfiguration.scala:30 (JKS keystore → PEM pair here) and
-KeyAuthentication.scala:28 (``accessKey`` query param).
+KeyAuthentication.scala:28 (``accessKey`` query param); CORS headers mirror
+CorsSupport.scala:31-81.
 """
 
 from __future__ import annotations
@@ -26,6 +27,37 @@ class DashboardConfig:
     ssl_cert: Optional[str] = None  # PEM pair (SSLConfiguration.scala:30)
     ssl_key: Optional[str] = None
     server_access_key: Optional[str] = None  # KeyAuthentication.scala:28
+
+
+_CORS_ALLOW_HEADERS = (
+    "Origin, X-Requested-With, Content-Type, Accept, Accept-Encoding, "
+    "Accept-Language, Host, Referer, User-Agent"
+)
+
+
+def cors_middleware():
+    """CORS on every route (CorsSupport.scala:31-81): allow-all origin on
+    responses; OPTIONS preflight answered with the allowed methods and the
+    reference's header list + 20-day max-age."""
+
+    @web.middleware
+    async def cors(request: web.Request, handler):
+        if request.method == "OPTIONS":
+            resp = web.Response(status=200)
+            resp.headers["Access-Control-Allow-Methods"] = "OPTIONS, GET"
+            resp.headers["Access-Control-Allow-Headers"] = _CORS_ALLOW_HEADERS
+            resp.headers["Access-Control-Max-Age"] = "1728000"
+        else:
+            try:
+                resp = await handler(request)
+            except web.HTTPException as e:
+                # 404/405 are raised, not returned — CORS decorates those too
+                e.headers["Access-Control-Allow-Origin"] = "*"
+                raise
+        resp.headers["Access-Control-Allow-Origin"] = "*"
+        return resp
+
+    return cors
 
 
 def key_auth_middleware(server_access_key: Optional[str]):
@@ -92,7 +124,8 @@ class Dashboard:
 
     def make_app(self) -> web.Application:
         app = web.Application(
-            middlewares=[key_auth_middleware(self.config.server_access_key)])
+            middlewares=[cors_middleware(),
+                         key_auth_middleware(self.config.server_access_key)])
         app.router.add_get("/", self.handle_index)
         app.router.add_get(
             "/engine_instances/{instance_id}/evaluator_results.{fmt:txt|html|json}",

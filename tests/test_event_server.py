@@ -192,10 +192,52 @@ def test_find_filters_and_limit(env):
             f"/events.json?accessKey={key}&entityType=user&entityId=u3"
         )
         assert len(await resp.json()) == 1
+        # reversed needs both entity params (EventServer.scala:329-333)
         resp = await client.get(
             f"/events.json?accessKey={key}&reversed=true&limit=1"
         )
-        assert (await resp.json())[0]["entityId"] == "u24"
+        assert resp.status == 400
+        assert "reversed" in (await resp.json())["message"]
+        resp = await client.get(
+            f"/events.json?accessKey={key}&reversed=true&entityType=user"
+        )
+        assert resp.status == 400
+        resp = await client.get(
+            f"/events.json?accessKey={key}"
+            f"&reversed=true&entityType=user&entityId=u3&limit=1"
+        )
+        assert resp.status == 200
+        assert (await resp.json())[0]["entityId"] == "u3"
+
+    run_client(env, t)
+
+
+def test_find_target_entity_filters(env):
+    """GET /events.json targetEntityType/Id params (EventServer.scala:314-333)."""
+
+    async def t(client, key, limited):
+        no_target = {k: v for k, v in EVENT.items()
+                     if not k.startswith("targetEntity")}
+        await client.post(f"/events.json?accessKey={key}", json=no_target)
+        await client.post(f"/events.json?accessKey={key}", json=EVENT)  # i1
+        await client.post(
+            f"/events.json?accessKey={key}",
+            json={**EVENT, "targetEntityId": "i2"},
+        )
+        resp = await client.get(
+            f"/events.json?accessKey={key}&targetEntityType=item"
+        )
+        assert len(await resp.json()) == 2
+        resp = await client.get(
+            f"/events.json?accessKey={key}"
+            f"&targetEntityType=item&targetEntityId=i2"
+        )
+        body = await resp.json()
+        assert len(body) == 1 and body[0]["targetEntityId"] == "i2"
+        resp = await client.get(
+            f"/events.json?accessKey={key}&targetEntityType=nosuch"
+        )
+        assert resp.status == 404
 
     run_client(env, t)
 

@@ -208,3 +208,33 @@ def test_dashboard_and_admin_tls_key_auth(tls_cert):
         asyncio.run(drive())
     finally:
         storage.close()
+
+
+def test_dashboard_cors_headers():
+    """CORS parity with CorsSupport.scala:31-81: allow-all origin on GETs,
+    preflight OPTIONS answered with methods/headers/max-age."""
+    from incubator_predictionio_tpu.tools.dashboard import Dashboard
+
+    storage = Storage({"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+
+    async def run():
+        client = TestClient(TestServer(Dashboard(storage=storage).make_app()))
+        await client.start_server()
+        try:
+            resp = await client.get("/")
+            assert resp.headers["Access-Control-Allow-Origin"] == "*"
+            pre = await client.options("/")
+            assert pre.status == 200
+            assert "GET" in pre.headers["Access-Control-Allow-Methods"]
+            assert "Content-Type" in pre.headers["Access-Control-Allow-Headers"]
+            assert pre.headers["Access-Control-Max-Age"] == "1728000"
+            assert pre.headers["Access-Control-Allow-Origin"] == "*"
+            # raised HTTPExceptions (unmatched route → 404) carry CORS too
+            notfound = await client.get("/nope")
+            assert notfound.status == 404
+            assert notfound.headers["Access-Control-Allow-Origin"] == "*"
+        finally:
+            await client.close()
+
+    asyncio.run(run())
+    storage.close()

@@ -169,3 +169,42 @@ def test_unreachable_reports_cleanly():
     with pytest.raises(StorageError, match="unreachable"):
         PostgresStorageClient({"HOST": "127.0.0.1", "PORT": "1",
                                "TIMEOUT": "2"})
+
+
+def test_keyset_streaming_pagination():
+    """find() streams in keyset-paginated pages (ADVICE r3: no full-scan
+    buffering); with chunk=3 a 10-event scan takes 4 pages and must still
+    return every event exactly once, in order, both directions."""
+    import datetime as dt
+
+    from incubator_predictionio_tpu.data import Event
+
+    server = FakePG()
+    try:
+        c = PostgresStorageClient({"HOST": "127.0.0.1",
+                                   "PORT": str(server.port)})
+        ev = c.events()
+        ev.init(1)
+        for i in range(10):
+            ev.insert(
+                Event(event="rate", entity_type="user", entity_id=f"u{i}",
+                      event_time=dt.datetime(2020, 1, 1, 0, 0, i % 4,
+                                             tzinfo=dt.timezone.utc)), 1)
+        from incubator_predictionio_tpu.data.storage.base import UNSET
+
+        sql, params = ev._find_sql(
+            1, None, None, None, None, None, None, UNSET, UNSET)
+        got = list(ev._stream_find(sql, params, chunk=3))
+        assert len(got) == 10
+        assert sorted(e.entity_id for e in got) == sorted(f"u{i}"
+                                                          for i in range(10))
+        times = [e.event_time for e in got]
+        assert times == sorted(times)
+        rev = list(ev._stream_find(sql, params, reversed=True, chunk=3))
+        assert [e.event_id for e in rev] == [e.event_id for e in got][::-1]
+        lim = list(ev._stream_find(sql, params, limit=7, chunk=3))
+        assert len(lim) == 7 and [e.event_id for e in lim] == \
+            [e.event_id for e in got][:7]
+        c.close()
+    finally:
+        server.close()

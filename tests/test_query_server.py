@@ -214,3 +214,25 @@ def test_undeployed_engine_errors(tmp_path):
     with pytest.raises(RuntimeError, match="No COMPLETED engine instance"):
         QueryServer(ServerConfig(engine_variant=variant_path), storage=storage)
     storage.close()
+
+
+def test_html_status_page(deployed_env):
+    """`Accept: text/html` on / serves the human status page — the twirl
+    index.scala.html counterpart (CreateServer.scala:437-462)."""
+
+    async def t(client, server, x, y):
+        resp = await client.get("/", headers={"Accept": "text/html"})
+        assert resp.status == 200
+        assert resp.content_type == "text/html"
+        page = await resp.text()
+        for section in ("Engine Information", "Server Information",
+                        "Algorithms and Models", "Feedback Loop Information"):
+            assert section in page
+        assert server.deployed.instance.id in page
+        # JSON clients keep getting JSON
+        resp = await client.get("/", headers={"Accept": "application/json"})
+        assert resp.content_type == "application/json"
+        resp = await client.get("/")
+        assert resp.content_type == "application/json"
+
+    run_server(deployed_env, t)
