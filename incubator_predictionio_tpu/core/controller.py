@@ -177,10 +177,9 @@ class LocalFileSystemPersistentModel(PersistentModel[Q]):
     def _path(model_id: str) -> str:
         import os
 
-        base = os.environ.get("PIO_FS_BASEDIR", os.path.expanduser("~/.pio_store"))
-        d = os.path.join(base, "pmodels")
-        os.makedirs(d, exist_ok=True)
-        return os.path.join(d, model_id)
+        from incubator_predictionio_tpu.utils.fs import subdir
+
+        return os.path.join(subdir("pmodels"), model_id)
 
     def save(self, model_id: str, params: Params, ctx: MeshContext) -> bool:
         from incubator_predictionio_tpu.utils.serialization import serialize_model

@@ -50,6 +50,14 @@ class TwoTowerConfig:
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 0       # epochs between checkpoints
     checkpoint_keep: int = 3
+    # model finalize: "host" pulls the trained tables to host numpy (the
+    # round-3 path — one full-table transfer, tens of seconds for production
+    # tables behind a device tunnel); "device" keeps them resident as jax
+    # Arrays (persisted as sharded orbax checkpoints, served without ever
+    # touching host); "auto" picks device for single-process runs whose
+    # CATALOG exceeds HOST_SERVE_MAX_ELEMENTS — the same criterion the
+    # serving path uses, so device residency and device serving agree
+    gather: str = "auto"
 
 
 #: Micro-batch bucket ladder for serving: every request batch is padded up to
@@ -77,20 +85,62 @@ def serve_bucket(b: int) -> int:
 
 @dataclasses.dataclass
 class TwoTowerModel:
-    """user/item factor tables + biases + global mean (host numpy)."""
+    """user/item factor tables + biases + global mean.
 
-    user_emb: np.ndarray    # [n_users, k]
-    item_emb: np.ndarray    # [n_items, k]
-    user_bias: np.ndarray   # [n_users]
-    item_bias: np.ndarray   # [n_items]
-    mean: float
-    config: TwoTowerConfig
+    Two residency modes:
 
+    - **host** (the reference-shaped path): ``user_emb``/``item_emb``/biases
+      are host numpy; pickles into MODELDATA like Kryo blobs do.
+    - **device** (``TwoTowerConfig.gather="device"``/big-table auto): the
+      fused padded tables stay resident as jax Arrays in ``_tables``
+      ({"ue": [nu_p, k+1], "ie": [ni_p, k+1]}, possibly "model"-axis
+      sharded); the host fields are ``None`` until :meth:`ensure_host`.
+      Persistence goes through sharded orbax checkpoints
+      (templates/recommendation.py RecModel.save), never a host gather.
+    """
+
+    user_emb: Optional[np.ndarray] = None    # [n_users, k]
+    item_emb: Optional[np.ndarray] = None    # [n_items, k]
+    user_bias: Optional[np.ndarray] = None   # [n_users]
+    item_bias: Optional[np.ndarray] = None   # [n_items]
+    mean: float = 0.0
+    config: TwoTowerConfig = dataclasses.field(default_factory=TwoTowerConfig)
+
+    _tables = None  # device-resident fused tables (device mode)
+    _n_users = 0  # real (unpadded) row counts in device mode
+    _n_items = 0
     _device_items = None  # (item_embᵀ bf16, item_bias, zero mask) for serving
     _device_items_q = None  # int8-quantized catalog (pallas retrieval kernel)
     _device_users = None  # (user_emb bf16, user_bias) — gathered inside jit
     _host_items = None  # small-catalog host fast path (item_embᵀ, item_bias)
     _serve_k = 0  # static top-k the serving executables are compiled for
+
+    @property
+    def device_resident(self) -> bool:
+        return self._tables is not None
+
+    def ensure_host(self) -> "TwoTowerModel":
+        """Materialize the host numpy views (one full-table device→host pull
+        — the transfer device mode exists to avoid; only consumers that
+        genuinely need host arrays, e.g. cosine-similarity model builds or
+        default pickling, should ever land here)."""
+        if self.user_emb is not None or self._tables is None:
+            return self
+        k = self.config.rank
+        host = jax.device_get(self._tables)
+        self.user_emb = np.ascontiguousarray(host["ue"][: self._n_users, :k])
+        self.user_bias = np.ascontiguousarray(host["ue"][: self._n_users, k])
+        self.item_emb = np.ascontiguousarray(host["ie"][: self._n_items, :k])
+        self.item_bias = np.ascontiguousarray(host["ie"][: self._n_items, k])
+        return self
+
+    def __getstate__(self):
+        # default pickling (MODELDATA blob) always ships host arrays; device
+        # handles and serving buffers never serialize — deploy rebuilds them
+        self.ensure_host()
+        return {k: v for k, v in self.__dict__.items()
+                if k not in ("_tables", "_device_items", "_device_items_q",
+                             "_device_users", "_host_items")}
 
     def prepare_for_serving(
         self, quantize: bool = False, serve_k: int = 128,
@@ -121,10 +171,37 @@ class TwoTowerModel:
         # host check first: ``quantize`` applies to device-resident catalogs;
         # a catalog small enough for the host path never benefits from it
         if self.n_items * (self.config.rank + 1) <= host_max:
+            self.ensure_host()  # no-op unless forced device mode on tiny tables
             self._host_items = (
                 np.ascontiguousarray(np.asarray(self.item_emb, np.float32).T),
                 np.asarray(self.item_bias, np.float32),
             )
+            return self
+        if self.device_resident and self.user_emb is None:
+            # device→device: slice/cast the resident fused tables — serving
+            # state is derived without a single host round trip (the whole
+            # point of gather="device")
+            k = self.config.rank
+            ue, ie = self._tables["ue"], self._tables["ie"]
+            self._device_users = (
+                ue[: self._n_users, :k].astype(jnp.bfloat16),
+                ue[: self._n_users, k].astype(jnp.float32),
+            )
+            item_emb = ie[: self._n_items, :k]
+            item_bias = ie[: self._n_items, k]
+            if quantize:
+                from incubator_predictionio_tpu.ops.retrieval import (
+                    quantize_catalog_device,
+                )
+
+                self._device_items_q = tuple(
+                    quantize_catalog_device(item_emb, item_bias))
+            else:
+                self._device_items = (
+                    item_emb.T.astype(jnp.bfloat16),
+                    item_bias.astype(jnp.float32),
+                    jnp.zeros(self._n_items, jnp.float32),
+                )
             return self
         self._device_users = (
             jax.device_put(np.asarray(self.user_emb, np.float32).astype(jnp.bfloat16)),
@@ -177,7 +254,11 @@ class TwoTowerModel:
 
     @property
     def n_items(self) -> int:
-        return self.item_emb.shape[0]
+        return self._n_items if self.item_emb is None else self.item_emb.shape[0]
+
+    @property
+    def n_users(self) -> int:
+        return self._n_users if self.user_emb is None else self.user_emb.shape[0]
 
     def serving_info(self) -> dict:
         """Which serving path this model runs (status-page observability)."""
@@ -313,20 +394,45 @@ class TwoTowerMF:
         else:
             loss = float(loss)  # blocks: the train schedule is done here
         t_train = _time.perf_counter() - t_train
-        # final host gather (collective when multi-process); behind a device
-        # tunnel this transfer can dwarf the train loop for big tables, so
-        # the phases are reported separately on the model
         t_gather = _time.perf_counter()
-        host = ctx.host_gather(params)
-        t_gather = _time.perf_counter() - t_gather
-        model = TwoTowerModel(
-            user_emb=host["ue"][:n_users, :cfg.rank],
-            item_emb=host["ie"][:n_items, :cfg.rank],
-            user_bias=host["ue"][:n_users, cfg.rank],
-            item_bias=host["ie"][:n_items, cfg.rank],
-            mean=mean,
-            config=cfg,
-        )
+        # auto keys on the CATALOG size — the same criterion
+        # prepare_for_serving uses to pick host vs device serving. Keying on
+        # user+item would keep a user-heavy/small-catalog model on device
+        # only for deploy to take the host serving path and pay the full
+        # user-table pull anyway (plus a pointless giant checkpoint)
+        item_elems = ni_p * (cfg.rank + 1)
+        keep_device = cfg.gather == "device" or (
+            cfg.gather == "auto" and item_elems > HOST_SERVE_MAX_ELEMENTS)
+        if keep_device and ctx.process_count > 1:
+            # persistence is primary-only (core_workflow.py) but an orbax
+            # save of process-spanning arrays would need every process —
+            # multi-process runs keep the collective host gather
+            keep_device = False
+        if keep_device:
+            # device-resident finalize: the trained tables never leave HBM.
+            # block_until_ready only drains the train schedule — the
+            # full-table device→host transfer (tens of seconds behind a
+            # device tunnel for production tables) is gone entirely
+            jax.block_until_ready(params)
+            model = TwoTowerModel(mean=mean, config=cfg)
+            model._tables = {"ue": params["ue"], "ie": params["ie"]}
+            model._n_users = n_users
+            model._n_items = n_items
+            t_gather = _time.perf_counter() - t_gather
+        else:
+            # host gather (collective when multi-process); behind a device
+            # tunnel this transfer can dwarf the train loop for big tables,
+            # so the phases are reported separately on the model
+            host = ctx.host_gather(params)
+            t_gather = _time.perf_counter() - t_gather
+            model = TwoTowerModel(
+                user_emb=host["ue"][:n_users, :cfg.rank],
+                item_emb=host["ie"][:n_items, :cfg.rank],
+                user_bias=host["ue"][:n_users, cfg.rank],
+                item_bias=host["ie"][:n_items, cfg.rank],
+                mean=mean,
+                config=cfg,
+            )
         model.final_loss = float(loss)
         model.timings = {
             "stage_sec": round(t_stage, 4),

@@ -35,6 +35,28 @@ def quantize_rows(items: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     return q, scale[:, 0]
 
 
+@jax.jit
+def quantize_catalog_device(
+    item_emb: jax.Array, item_bias: jax.Array
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Device-side :func:`quantize_rows` + :func:`pad_catalog` in one jitted
+    program — the deploy path for device-resident towers never round-trips
+    the catalog through host numpy. Returns ``(items_q, scales, bias, mask)``
+    padded to the :data:`ITEM_BLOCK` multiple (padding masked with -inf)."""
+    n, _ = item_emb.shape
+    amax = jnp.abs(item_emb).max(axis=1, keepdims=True)
+    scale = (amax / 127.0 + 1e-12).astype(jnp.float32)
+    q = jnp.clip(jnp.round(item_emb / scale), -127, 127).astype(jnp.int8)
+    pad = (-n) % ITEM_BLOCK
+    return (
+        jnp.pad(q, ((0, pad), (0, 0))),
+        jnp.pad(scale[:, 0], (0, pad)),
+        jnp.pad(item_bias.astype(jnp.float32), (0, pad)),
+        jnp.pad(jnp.zeros(n, jnp.float32), (0, pad),
+                constant_values=-jnp.inf),
+    )
+
+
 def _score_kernel(q_ref, items_ref, scale_ref, bias_ref, mask_ref, out_ref):
     q = q_ref[:].astype(jnp.bfloat16)                    # [B, D] resident
     block = items_ref[:].astype(jnp.bfloat16)            # [NB, D] int8→bf16

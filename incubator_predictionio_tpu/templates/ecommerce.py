@@ -225,6 +225,7 @@ class ECommAlgorithm(PAlgorithm):
             batch_size=8192, seed=p.seed if p.seed is not None else 0,
         )).fit(ctx, users, items, ratings, len(pd.users), len(pd.items),
                rows_are_local=pd.rows_are_local)
+        mf.ensure_host()  # similarity sidecar + host predict path need numpy
         norm = mf.item_emb / (np.linalg.norm(mf.item_emb, axis=1, keepdims=True) + 1e-9)
         return ECommModel(
             mf=mf,

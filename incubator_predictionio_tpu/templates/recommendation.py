@@ -38,6 +38,7 @@ from incubator_predictionio_tpu.core import (
     PAlgorithm,
     Params,
     PDataSource,
+    PersistentModel,
     SanityCheck,
 )
 from incubator_predictionio_tpu.data.bimap import BiMap
@@ -331,15 +332,105 @@ class ALSAlgorithmParams(Params):
     seed: Optional[int] = None
     checkpoint_dir: Optional[str] = None   # mid-training resume (utils/checkpoint.py)
     checkpoint_every: int = 0
+    # model residency at train end: "auto" keeps production-size towers on
+    # device (persisted via sharded orbax checkpoints, RecModel.save);
+    # "host"/"device" force either path (TwoTowerConfig.gather)
+    gather: str = "auto"
 
 
 @dataclasses.dataclass
-class RecModel:
-    """TwoTowerModel + id vocabularies (reference ALSModel: factors + BiMaps)."""
+class RecModel(PersistentModel):
+    """TwoTowerModel + id vocabularies (reference ALSModel: factors + BiMaps).
+
+    Persistence (PersistentModel SPI, controller/PersistentModel.scala:67):
+    host-mode models fall back to default MODELDATA pickling (``save`` returns
+    False — the Kryo-blob counterpart, CoreWorkflow.scala:79-84). Device-
+    resident models save their fused towers as a **sharded orbax checkpoint**
+    written straight from HBM plus a small pickled sidecar (BiMaps, config,
+    mean); deploy restores them device-resident — neither direction moves the
+    tables through host numpy. The MODELDATA row per instance is preserved
+    either way (the manifest is what lands in the blob)."""
 
     mf: TwoTowerModel
     user_map: BiMap
     item_map: BiMap
+
+    @staticmethod
+    def _device_dir(model_id: str) -> str:
+        import os
+
+        from incubator_predictionio_tpu.utils.fs import subdir
+
+        return os.path.join(subdir("device_models"), model_id)
+
+    def save(self, model_id: str, params: Params, ctx: MeshContext) -> bool:
+        if not self.mf.device_resident:
+            return False  # host model → default MODELDATA pickling
+        import os
+        import pickle
+
+        from incubator_predictionio_tpu.utils.checkpoint import (
+            TrainCheckpointer,
+        )
+
+        d = self._device_dir(model_id)
+        ckpt = TrainCheckpointer(d, max_to_keep=1)
+        # retrain-in-place reuses the instance id (core_workflow.py:80) and
+        # orbax SILENTLY SKIPS saving a step that already exists — a stale
+        # step 0 under a fresh sidecar would serve old embeddings with new
+        # id maps; drop any prior state first
+        ckpt.delete_all()
+        ckpt.save(0, self.mf._tables)
+        meta = {
+            "config": self.mf.config,
+            "mean": self.mf.mean,
+            "n_users": self.mf._n_users,
+            "n_items": self.mf._n_items,
+            "table_rows": {k: int(v.shape[0])
+                           for k, v in self.mf._tables.items()},
+            "user_map": self.user_map,
+            "item_map": self.item_map,
+        }
+        with open(os.path.join(d, "sidecar.pkl"), "wb") as f:
+            pickle.dump(meta, f)
+        return True
+
+    @classmethod
+    def load(cls, model_id: str, params: Params, ctx: MeshContext) -> "RecModel":
+        import os
+        import pickle
+
+        import jax.numpy as jnp
+
+        from incubator_predictionio_tpu.utils.checkpoint import (
+            TrainCheckpointer,
+        )
+
+        d = cls._device_dir(model_id)
+        with open(os.path.join(d, "sidecar.pkl"), "rb") as f:
+            meta = pickle.load(f)
+        cfg = meta["config"]
+        # like-template fixes the restored leaves' placement: "model"-axis
+        # row sharding when the deploy mesh has one (and the padded rows
+        # still divide), replicated otherwise — restore lands ON DEVICE in
+        # the serving layout, no host staging
+        def sharding_for(rows: int):
+            if "model" in ctx.mesh.shape and \
+                    rows % ctx.axis_size("model") == 0:
+                return ctx.sharding("model", None)
+            return ctx.replicated()
+
+        like = {
+            k: jnp.zeros((rows, cfg.rank + 1), jnp.float32,
+                         device=sharding_for(rows))
+            for k, rows in meta["table_rows"].items()
+        }
+        tables = TrainCheckpointer(d, max_to_keep=1).restore(like=like)
+        mf = TwoTowerModel(mean=meta["mean"], config=cfg)
+        mf._tables = tables
+        mf._n_users = meta["n_users"]
+        mf._n_items = meta["n_items"]
+        return cls(mf, meta["user_map"], meta["item_map"])
 
     def prepare_for_serving(self) -> "RecModel":
         # on TPU the catalog is int8-quantized and scored by the fused Pallas
@@ -385,6 +476,7 @@ class ALSAlgorithm(PAlgorithm):
             seed=p.seed if p.seed is not None else 0,
             checkpoint_dir=p.checkpoint_dir,
             checkpoint_every=p.checkpoint_every,
+            gather=p.gather,
         )
         mf = TwoTowerMF(cfg).fit(
             ctx,

@@ -205,6 +205,8 @@ class ALSAlgorithm(PAlgorithm):
             rows_are_local=pd.rows_are_local,
         )
         # followed-side tower = the reference's productFeatures
+        # (cosine model is a host build: materialize if device-resident)
+        mf.ensure_host()
         return SimilarUserModel(
             user_vecs=l2_normalize(mf.item_emb),
             user_map=pd.users,

@@ -294,6 +294,7 @@ class ALSAlgorithm(PAlgorithm):
             batch_size=8192, seed=p.seed if p.seed is not None else 0,
         )).fit(ctx, users, items, ratings, len(pd.users), len(pd.items),
                rows_are_local=pd.rows_are_local)
+        mf.ensure_host()  # cosine model is a host build
         return ItemSimModel(
             item_vecs=l2_normalize(mf.item_emb),
             item_map=pd.items,
@@ -324,6 +325,7 @@ class LikeAlgorithm(ALSAlgorithm):
         )).fit(ctx, pd.like_u, pd.like_i, pd.like_sign,
                len(pd.users), len(pd.items),
                rows_are_local=pd.rows_are_local)
+        mf.ensure_host()  # cosine model is a host build
         return ItemSimModel(
             item_vecs=l2_normalize(mf.item_emb),
             item_map=pd.items,

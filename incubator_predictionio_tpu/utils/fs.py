@@ -1,0 +1,24 @@
+"""Filesystem locations — the PIO_FS_BASEDIR convention in one place.
+
+The reference resolves its local model store root from ``PIO_FS_BASEDIR``
+(conf/pio-env.sh.template; used by LocalFileSystemPersistentModel.scala:43).
+Every persistence path (pickled PersistentModels, device-resident orbax
+checkpoints) must resolve through here so a convention change cannot split
+models across two trees.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def base_dir() -> str:
+    """``PIO_FS_BASEDIR`` or ``~/.pio_store``."""
+    return os.environ.get("PIO_FS_BASEDIR", os.path.expanduser("~/.pio_store"))
+
+
+def subdir(*parts: str) -> str:
+    """A directory under :func:`base_dir`, created on demand."""
+    d = os.path.join(base_dir(), *parts)
+    os.makedirs(d, exist_ok=True)
+    return d

@@ -1,0 +1,155 @@
+"""Device-resident two-tower persistence (round-4: the host-gather kill).
+
+VERDICT r3 #1: P-flavor models persist as sharded device-side orbax
+checkpoints instead of host_gather → pickle → MODELDATA; deploy restores
+them device-resident. These tests pin:
+
+- gather="device" fit skips the host pull (host fields stay None) yet serves
+  identically to the host-mode model trained from the same seed;
+- RecModel.save/load round-trips through the orbax checkpoint + sidecar and
+  the restored model answers the same top-k;
+- the engine-level persistence glue (models_for_persistence → manifest →
+  prepare_deploy) wires the SPI end to end;
+- default pickling of a device model still works (safety net: __getstate__
+  materializes host arrays) so FastEval/deepcopy paths cannot break.
+"""
+
+import numpy as np
+import pytest
+
+from incubator_predictionio_tpu.models.two_tower import (
+    TwoTowerConfig,
+    TwoTowerMF,
+)
+from incubator_predictionio_tpu.parallel.mesh import MeshContext
+
+
+def _fit(gather: str, seed: int = 3, n_users: int = 40, n_items: int = 60):
+    ctx = MeshContext.create()
+    rng = np.random.default_rng(0)
+    n = 3000
+    users = rng.integers(0, n_users, n).astype(np.int32)
+    items = rng.integers(0, n_items, n).astype(np.int32)
+    ratings = (1 + 4 * rng.random(n)).astype(np.float32)
+    cfg = TwoTowerConfig(rank=8, epochs=4, batch_size=512, seed=seed,
+                         gather=gather)
+    return TwoTowerMF(cfg).fit(ctx, users, items, ratings, n_users, n_items)
+
+
+def test_device_mode_skips_host_gather_and_serves_identically():
+    host_model = _fit("host")
+    dev_model = _fit("device")
+    assert not host_model.device_resident
+    assert dev_model.device_resident
+    assert dev_model.user_emb is None and dev_model.item_emb is None
+    assert dev_model.n_users == host_model.n_users == 40
+    assert dev_model.n_items == host_model.n_items == 60
+    # same seed → identical training → identical recommendations;
+    # host_max_elements=0 forces both through the device serving path
+    host_model.prepare_for_serving(host_max_elements=0)
+    dev_model.prepare_for_serving(host_max_elements=0)
+    users = np.arange(10, dtype=np.int32)
+    idx_h, sc_h = TwoTowerMF.recommend_batch(host_model, users, 5)
+    idx_d, sc_d = TwoTowerMF.recommend_batch(dev_model, users, 5)
+    np.testing.assert_array_equal(idx_h, idx_d)
+    np.testing.assert_allclose(sc_h, sc_d, rtol=1e-5, atol=1e-5)
+
+
+def test_ensure_host_and_default_pickle_safety_net():
+    import pickle
+
+    dev_model = _fit("device")
+    ref = _fit("host")
+    dev_model.prepare_for_serving(host_max_elements=0)  # serving buffers set
+    blob = pickle.dumps(dev_model)  # __getstate__ must drop device handles
+    back = pickle.loads(blob)
+    assert back.user_emb is not None and not back.device_resident
+    np.testing.assert_allclose(back.user_emb, ref.user_emb, rtol=1e-5)
+    np.testing.assert_allclose(back.item_bias, ref.item_bias, atol=1e-5)
+
+
+def test_recmodel_orbax_roundtrip(tmp_path, monkeypatch):
+    monkeypatch.setenv("PIO_FS_BASEDIR", str(tmp_path))
+    from incubator_predictionio_tpu.data.bimap import BiMap
+    from incubator_predictionio_tpu.templates.recommendation import RecModel
+
+    ctx = MeshContext.create()
+    mf = _fit("device")
+    user_map = BiMap({f"u{i}": i for i in range(mf.n_users)})
+    item_map = BiMap({f"i{i}": i for i in range(mf.n_items)})
+    model = RecModel(mf, user_map, item_map)
+    assert model.save("inst1_0", None, ctx) is True
+    loaded = RecModel.load("inst1_0", None, ctx)
+    assert loaded.mf.device_resident
+    assert loaded.mf.n_users == mf.n_users
+    assert loaded.user_map["u3"] == 3 and loaded.item_map["i7"] == 7
+    mf.prepare_for_serving(host_max_elements=0)
+    loaded.mf.prepare_for_serving(host_max_elements=0)
+    users = np.arange(8, dtype=np.int32)
+    idx_a, sc_a = TwoTowerMF.recommend_batch(mf, users, 5)
+    idx_b, sc_b = TwoTowerMF.recommend_batch(loaded.mf, users, 5)
+    np.testing.assert_array_equal(idx_a, idx_b)
+    np.testing.assert_allclose(sc_a, sc_b, rtol=1e-5, atol=1e-5)
+
+
+def test_host_model_save_falls_back_to_pickle():
+    from incubator_predictionio_tpu.data.bimap import BiMap
+    from incubator_predictionio_tpu.templates.recommendation import RecModel
+
+    ctx = MeshContext.create()
+    mf = _fit("host")
+    model = RecModel(mf, BiMap({"u": 0}), BiMap({"i": 0}))
+    assert model.save("x", None, ctx) is False  # default MODELDATA pickling
+
+
+def test_engine_persistence_glue_device_model(tmp_path, monkeypatch):
+    """models_for_persistence → PersistentModelManifest → prepare_deploy
+    restores the device model (Engine.scala:198-258 contract)."""
+    monkeypatch.setenv("PIO_FS_BASEDIR", str(tmp_path))
+    from incubator_predictionio_tpu.core.controller import (
+        PersistentModelManifest,
+    )
+    from incubator_predictionio_tpu.data.bimap import BiMap
+    from incubator_predictionio_tpu.templates.recommendation import (
+        ALSAlgorithmParams,
+        RecommendationEngine,
+        RecModel,
+    )
+
+    ctx = MeshContext.create()
+    engine = RecommendationEngine().apply()
+    engine_params = engine.engine_params_from_variant({
+        "id": "t", "version": "1",
+        "engineFactory": "x",
+        "datasource": {"params": {"appName": "a"}},
+        "algorithms": [{"name": "als", "params": {"rank": 8}}],
+    })
+    mf = _fit("device")
+    model = RecModel(mf, BiMap({f"u{i}": i for i in range(mf.n_users)}),
+                     BiMap({f"i{i}": i for i in range(mf.n_items)}))
+    persisted = engine.models_for_persistence(
+        ctx, [model], "instX", engine_params)
+    assert isinstance(persisted[0], PersistentModelManifest)
+    out = engine.prepare_deploy(ctx, engine_params, persisted, "instX")
+    assert isinstance(out[0], RecModel) and out[0].mf.device_resident
+
+
+def test_resave_same_model_id_overwrites(tmp_path, monkeypatch):
+    """Retrain-in-place reuses the instance id (core_workflow.py:80); orbax
+    silently skips saving an existing step, so save() must drop prior state
+    or deploy serves OLD embeddings under NEW id maps."""
+    monkeypatch.setenv("PIO_FS_BASEDIR", str(tmp_path))
+    from incubator_predictionio_tpu.data.bimap import BiMap
+    from incubator_predictionio_tpu.templates.recommendation import RecModel
+
+    ctx = MeshContext.create()
+    maps = lambda mf: (BiMap({f"u{i}": i for i in range(mf.n_users)}),
+                       BiMap({f"i{i}": i for i in range(mf.n_items)}))
+    mf1 = _fit("device", seed=3)
+    RecModel(mf1, *maps(mf1)).save("same_id", None, ctx)
+    mf2 = _fit("device", seed=4)  # different seed → different tables
+    RecModel(mf2, *maps(mf2)).save("same_id", None, ctx)
+    loaded = RecModel.load("same_id", None, ctx)
+    got = np.asarray(loaded.mf._tables["ue"])
+    np.testing.assert_allclose(got, np.asarray(mf2._tables["ue"]), rtol=1e-6)
+    assert not np.allclose(got, np.asarray(mf1._tables["ue"]))
