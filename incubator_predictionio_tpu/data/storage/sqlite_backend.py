@@ -116,6 +116,7 @@ def _row_to_event(r: tuple) -> Event:
 
 
 def _event_row(event_id: str, e: Event) -> tuple:
+    props = e.properties.to_dict()
     return (
         event_id,
         e.event,
@@ -123,9 +124,9 @@ def _event_row(event_id: str, e: Event) -> tuple:
         e.entity_id,
         e.target_entity_type,
         e.target_entity_id,
-        json.dumps(e.properties.to_dict()),
+        json.dumps(props) if props else "{}",  # empty fast path (hot)
         _us(e.event_time),
-        json.dumps(list(e.tags)),
+        json.dumps(list(e.tags)) if e.tags else "[]",
         e.pr_id,
         _us(e.creation_time),
         entity_shard(e.entity_id, N_SHARD_BUCKETS),
@@ -135,8 +136,13 @@ def _event_row(event_id: str, e: Event) -> tuple:
 class SqliteEvents(EventStore):
     def __init__(self, db: _Db):
         self._db = db
+        self._initialized: set[tuple[int, Optional[int]]] = set()
 
     def init(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        # idempotent and called on hot paths — 4 statements (each with a
+        # commit) per call otherwise
+        if (app_id, channel_id) in self._initialized:
+            return True
         t = _event_table(app_id, channel_id)
         self._db.execute(
             f"""CREATE TABLE IF NOT EXISTS {t} (
@@ -157,30 +163,50 @@ class SqliteEvents(EventStore):
         self._db.execute(f"CREATE INDEX IF NOT EXISTS {t}_time ON {t} (event_time)")
         self._db.execute(f"CREATE INDEX IF NOT EXISTS {t}_entity ON {t} (entity_type, entity_id)")
         self._db.execute(f"CREATE INDEX IF NOT EXISTS {t}_shard ON {t} (entity_shard)")
+        self._initialized.add((app_id, channel_id))
         return True
 
     def remove(self, app_id: int, channel_id: Optional[int] = None) -> bool:
+        self._initialized.discard((app_id, channel_id))
         self._db.execute(f"DROP TABLE IF EXISTS {_event_table(app_id, channel_id)}")
         return True
 
+    @staticmethod
+    def _new_event_id(e: Event) -> str:
+        # time-prefixed ids: random 32-hex PKs land on random btree pages
+        # (the classic UUID-PK insert wall); a monotonic prefix appends to
+        # the right edge instead. Same idea as the reference's time-ordered
+        # HBase rowkeys (HBEventsUtil.scala:76-131). Ids stay opaque 32-hex.
+        return f"{_us(e.creation_time):015x}" + os.urandom(8).hex() + "0"
+
+    def _heal_no_table(self, op, app_id: int, channel_id: Optional[int]):
+        """Run ``op``; if the table vanished underneath us (another process
+        ran data-delete → DROP TABLE), re-init and retry ONCE — the per-event
+        init this backend's cache replaced was self-healing, so the cached
+        path must be too."""
+        try:
+            return op()
+        except sqlite3.OperationalError as err:
+            if "no such table" not in str(err):
+                raise
+            self._initialized.discard((app_id, channel_id))
+            self.init(app_id, channel_id)
+            return op()
+
     def insert(self, event: Event, app_id: int, channel_id: Optional[int] = None) -> str:
-        event_id = event.event_id or uuid.uuid4().hex
-        t = _event_table(app_id, channel_id)
-        self._db.execute(
-            f"INSERT OR REPLACE INTO {t} ({_EVENT_COLS}) VALUES (?,?,?,?,?,?,?,?,?,?,?,?)",
-            _event_row(event_id, event),
-        )
-        return event_id
+        return self.insert_batch([event], app_id, channel_id)[0]
 
     def insert_batch(
         self, events: Sequence[Event], app_id: int, channel_id: Optional[int] = None
     ) -> list[str]:
         t = _event_table(app_id, channel_id)
-        ids = [e.event_id or uuid.uuid4().hex for e in events]
-        self._db.executemany(
-            f"INSERT OR REPLACE INTO {t} ({_EVENT_COLS}) VALUES (?,?,?,?,?,?,?,?,?,?,?,?)",
-            [_event_row(i, e) for i, e in zip(ids, events)],
-        )
+        ids = [e.event_id or self._new_event_id(e) for e in events]
+        rows = [_event_row(i, e) for i, e in zip(ids, events)]
+        self._heal_no_table(
+            lambda: self._db.executemany(
+                f"INSERT OR REPLACE INTO {t} ({_EVENT_COLS}) "
+                "VALUES (?,?,?,?,?,?,?,?,?,?,?,?)", rows),
+            app_id, channel_id)
         return ids
 
     def get(self, event_id: str, app_id: int, channel_id: Optional[int] = None) -> Optional[Event]:

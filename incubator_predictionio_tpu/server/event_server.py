@@ -28,8 +28,8 @@ import datetime as _dt
 import json
 import logging
 import os
+import time
 from concurrent.futures import ThreadPoolExecutor
-from dataclasses import replace
 from typing import Optional
 
 from aiohttp import web
@@ -100,6 +100,23 @@ class EventServer:
         # sqlite opens with check_same_thread=False).
         self._executor = ThreadPoolExecutor(
             max_workers=4, thread_name_prefix="evstore")
+        # ingestion caches (group-commit work, VERDICT r3 #3): access-key
+        # lookups hit METADATA per request and events.init per event — both
+        # are invariant across the hot path, so amortize them. Auth entries
+        # expire after _AUTH_TTL so key/channel changes take effect without
+        # a restart (the reference re-reads per request; a short TTL is the
+        # documented trade for ~10× the lookup cost).
+        self._auth_cache: dict[tuple[Optional[str], Optional[str]],
+                               tuple[float, AuthData]] = {}
+        self._init_done: set[tuple[int, Optional[int]]] = set()
+        # single-core hosts: the executor hop buys no overlap (the GIL and
+        # the core are the same resource) and costs two thread switches per
+        # request — run batch ingests inline on the loop there. Multi-core
+        # hosts keep the hop so a slow durable write can't stall the accept
+        # loop while other cores could be parsing the next request.
+        self._inline_batch = (os.cpu_count() or 2) <= 1
+
+    _AUTH_TTL = 5.0  # seconds
 
     async def _run(self, fn, *args):
         """Run a blocking storage call off the event loop."""
@@ -107,7 +124,11 @@ class EventServer:
             self._executor, fn, *args)
 
     # -- auth (EventServer.scala:92-120) ----------------------------------
-    def _authenticate(self, request: web.Request) -> AuthData:
+    @staticmethod
+    def _extract_key(request: web.Request) -> Optional[str]:
+        """accessKey query param or HTTP Basic username — ONE definition:
+        the cache key below must always match the identity _authenticate
+        resolves."""
         key = request.query.get("accessKey")
         if not key:
             auth = request.headers.get("Authorization", "")
@@ -117,6 +138,25 @@ class EventServer:
                     key = decoded.split(":", 1)[0]
                 except Exception:
                     key = None
+        return key
+
+    async def _authenticate_cached(self, request: web.Request) -> AuthData:
+        """Auth with a short-TTL cache over (accessKey, channel) — the
+        metadata lookups are per-request invariant on the ingest hot path."""
+        key = self._extract_key(request)
+        channel = request.query.get("channel")
+        now = time.monotonic()
+        hit = self._auth_cache.get((key, channel))
+        if hit is not None and hit[0] > now:
+            return hit[1]
+        data = await self._run(self._authenticate, request)
+        if len(self._auth_cache) > 1024:  # unbounded-growth guard
+            self._auth_cache.clear()
+        self._auth_cache[(key, channel)] = (now + self._AUTH_TTL, data)
+        return data
+
+    def _authenticate(self, request: web.Request) -> AuthData:
+        key = self._extract_key(request)
         if not key:
             raise web.HTTPUnauthorized(
                 text=json.dumps({"message": "Missing accessKey."}),
@@ -151,22 +191,57 @@ class EventServer:
             raise WhitelistDenied(f"{event_name} events are not allowed")
 
     # -- ingestion --------------------------------------------------------
-    def _ingest_one(self, payload: dict, auth: AuthData) -> str:
-        from incubator_predictionio_tpu.server.plugins import apply_input_plugins
+    def _prepare_event(self, payload: dict, auth: AuthData,
+                       receipt: Optional[_dt.datetime] = None) -> Event:
+        """Parse/validate one payload into a storable Event (no insert)."""
+        from incubator_predictionio_tpu.server.plugins import (
+            EVENT_SERVER_PLUGINS,
+            apply_input_plugins,
+        )
 
-        payload = apply_input_plugins(dict(payload))
-        event = Event.from_json_dict(payload)
+        if EVENT_SERVER_PLUGINS:  # defensive copy only if a plugin may mutate
+            payload = apply_input_plugins(dict(payload))
         # server assigns receipt time; client-supplied creationTime is ignored
         # (EventJson4sSupport.scala:77-78)
-        event = replace(event, creation_time=_dt.datetime.now(_dt.timezone.utc))
+        event = Event.from_json_dict(
+            payload,
+            creation_time=receipt or _dt.datetime.now(_dt.timezone.utc))
         validate_event(event)
         self._check_whitelist(auth, event.event)
-        events = self.storage.get_events()
-        events.init(auth.app_id, auth.channel_id)
-        return events.insert(event, auth.app_id, auth.channel_id)
+        return event
+
+    def _ensure_init(self, auth: AuthData) -> None:
+        """events.init once per (app, channel) per process — per-event init
+        costs several storage round trips for an idempotent no-op."""
+        key = (auth.app_id, auth.channel_id)
+        if key not in self._init_done:
+            self.storage.get_events().init(auth.app_id, auth.channel_id)
+            self._init_done.add(key)
+
+    def _insert_healing(self, op, auth: AuthData):
+        """Run a storage write; if it fails because the table/log vanished
+        (another process ran data-delete), drop the init cache, re-init and
+        retry once — the per-event init this cache replaced was self-healing,
+        so the cached path must be too."""
+        try:
+            return op()
+        except Exception as err:
+            if "no such table" not in str(err) and "not initialized" not in \
+                    str(err) and "UndefinedTable" not in type(err).__name__:
+                raise
+            self._init_done.discard((auth.app_id, auth.channel_id))
+            self._ensure_init(auth)
+            return op()
+
+    def _ingest_one(self, payload: dict, auth: AuthData) -> str:
+        event = self._prepare_event(payload, auth)
+        self._ensure_init(auth)
+        return self._insert_healing(
+            lambda: self.storage.get_events().insert(
+                event, auth.app_id, auth.channel_id), auth)
 
     async def handle_create(self, request: web.Request) -> web.Response:
-        auth = await self._run(self._authenticate, request)
+        auth = await self._authenticate_cached(request)
         payload = None
         try:
             payload = await request.json()
@@ -187,23 +262,40 @@ class EventServer:
         return web.json_response(body, status=status)
 
     def _ingest_batch(self, payload: list, auth: AuthData) -> list[dict]:
-        """One executor hop for the whole batch (not one per item)."""
-        results = []
+        """One executor hop AND one storage write for the whole batch.
+
+        Per-item validation statuses are preserved (EventServer.scala:430-433:
+        a denied/malformed item doesn't fail its neighbors); the accepted
+        items then land via ONE ``insert_batch`` — one transaction/commit in
+        sqlite, one append+flush in the event log — instead of a per-event
+        insert+fsync (the round-3 ingestion wall)."""
+        results: list[dict] = []
+        accepted: list[tuple[int, Event]] = []  # (result slot, event)
+        receipt = _dt.datetime.now(_dt.timezone.utc)  # one per batch
         for item in payload:
             try:
                 if not isinstance(item, dict):
                     raise EventValidationError("event JSON must be an object")
-                event_id = self._ingest_one(item, auth)
-                results.append({"status": 201, "eventId": event_id})
+                accepted.append(
+                    (len(results), self._prepare_event(item, auth, receipt)))
+                results.append({"status": 201})  # eventId filled below
             except EventValidationError as e:
                 results.append({"status": 400, "message": str(e)})
             except WhitelistDenied as e:
                 # per-item 403, batch continues (EventServer.scala:430-433)
                 results.append({"status": 403, "message": str(e)})
+        if accepted:
+            self._ensure_init(auth)
+            batch_events = [e for _, e in accepted]
+            ids = self._insert_healing(
+                lambda: self.storage.get_events().insert_batch(
+                    batch_events, auth.app_id, auth.channel_id), auth)
+            for (slot, _), event_id in zip(accepted, ids):
+                results[slot]["eventId"] = event_id
         return results
 
     async def handle_batch(self, request: web.Request) -> web.Response:
-        auth = await self._run(self._authenticate, request)
+        auth = await self._authenticate_cached(request)
         try:
             payload = await request.json()
         except json.JSONDecodeError as e:
@@ -218,12 +310,15 @@ class EventServer:
                             f"{MAX_BATCH_SIZE} events"},
                 status=400,
             )
-        results = await self._run(self._ingest_batch, payload, auth)
+        if self._inline_batch:
+            results = self._ingest_batch(payload, auth)
+        else:
+            results = await self._run(self._ingest_batch, payload, auth)
         return web.json_response(results, status=200)
 
     # -- reads ------------------------------------------------------------
     async def handle_get_event(self, request: web.Request) -> web.Response:
-        auth = await self._run(self._authenticate, request)
+        auth = await self._authenticate_cached(request)
         event = await self._run(
             self.storage.get_events().get,
             request.match_info["event_id"], auth.app_id, auth.channel_id,
@@ -233,7 +328,7 @@ class EventServer:
         return web.json_response(event.to_json_dict())
 
     async def handle_delete_event(self, request: web.Request) -> web.Response:
-        auth = await self._run(self._authenticate, request)
+        auth = await self._authenticate_cached(request)
         found = await self._run(
             self.storage.get_events().delete,
             request.match_info["event_id"], auth.app_id, auth.channel_id,
@@ -243,7 +338,7 @@ class EventServer:
         return web.json_response({"message": "Not Found"}, status=404)
 
     async def handle_find(self, request: web.Request) -> web.Response:
-        auth = await self._run(self._authenticate, request)
+        auth = await self._authenticate_cached(request)
         q = request.query
 
         def parse_time(name: str) -> Optional[_dt.datetime]:
@@ -312,7 +407,7 @@ class EventServer:
         return web.json_response({"status": "alive"})
 
     async def handle_stats(self, request: web.Request) -> web.Response:
-        auth = await self._run(self._authenticate, request)
+        auth = await self._authenticate_cached(request)
         if not self.config.stats:
             return web.json_response(
                 {"message": "To see stats, launch Event Server with stats enabled "
@@ -323,7 +418,7 @@ class EventServer:
 
     # -- webhooks (EventServer.scala:491-599) -----------------------------
     async def handle_webhook(self, request: web.Request) -> web.Response:
-        auth = await self._run(self._authenticate, request)
+        auth = await self._authenticate_cached(request)
         name = request.match_info["name"]
         form = request.match_info.get("ext") == "form"
         connector = CONNECTORS.get((name, "form" if form else "json"))
@@ -344,7 +439,7 @@ class EventServer:
             return web.json_response({"message": str(e)}, status=403)
 
     async def handle_webhook_get(self, request: web.Request) -> web.Response:
-        await self._run(self._authenticate, request)
+        await self._authenticate_cached(request)
         name = request.match_info["name"]
         form = request.match_info.get("ext") == "form"
         if CONNECTORS.get((name, "form" if form else "json")) is None:
@@ -368,7 +463,9 @@ class EventServer:
         return app
 
     async def start(self) -> None:
-        self._runner = web.AppRunner(self.make_app())
+        # no per-request access log: formatting a log line per request costs
+        # more than parsing the request at ingestion rates
+        self._runner = web.AppRunner(self.make_app(), access_log=None)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.config.ip, self.config.port,
                            ssl_context=_ssl_context(self.config))

@@ -423,3 +423,41 @@ def test_concurrent_batch_ingestion(env):
         assert len(await resp.json()) == 400
 
     run_client(env, t)
+
+
+def test_ingest_self_heals_after_external_table_drop(tmp_path):
+    """Init caches must not make an external data-delete (DROP TABLE from
+    another process, tools/cli.py data-delete) permanently 500 ingestion —
+    the per-event init they replaced was self-healing."""
+    import sqlite3
+
+    storage = Storage({"PIO_STORAGE_SOURCES_SQ_TYPE": "sqlite",
+                       "PIO_STORAGE_SOURCES_SQ_PATH": str(tmp_path / "db")})
+    app_id = storage.get_meta_data_apps().insert(App(0, "healapp"))
+    storage.get_events().init(app_id)
+    key = storage.get_meta_data_access_keys().insert(AccessKey("", app_id, ()))
+
+    async def runner():
+        server = EventServer(EventServerConfig(stats=False), storage=storage)
+        client = TestClient(TestServer(server.make_app()))
+        await client.start_server()
+        try:
+            resp = await client.post(f"/events.json?accessKey={key}",
+                                     json=EVENT)
+            assert resp.status == 201
+            # external process drops the table, bypassing every cache
+            other = sqlite3.connect(str(tmp_path / "db"))
+            other.execute(f"DROP TABLE pio_event_{app_id}")
+            other.commit()
+            other.close()
+            resp = await client.post(f"/events.json?accessKey={key}",
+                                     json=EVENT)
+            assert resp.status == 201  # healed: re-init + retry
+            resp = await client.post(
+                f"/batch/events.json?accessKey={key}", json=[EVENT])
+            assert (await resp.json())[0]["status"] == 201
+        finally:
+            await client.close()
+
+    asyncio.run(runner())
+    storage.close()
