@@ -15,6 +15,12 @@ import optax
 
 
 @functools.lru_cache(maxsize=64)
-def jit_adam_init(learning_rate: float):
-    """One jitted ``optax.adam(lr).init`` per learning rate per process."""
-    return jax.jit(optax.adam(learning_rate).init)
+def jit_adam_init(learning_rate: float, mu_dtype: str | None = None):
+    """One jitted ``optax.adam(lr).init`` per (lr, mu dtype) per process.
+
+    ``mu_dtype`` must match the dtype the train step's adam uses, or the
+    donated opt-state pytree mismatches at the scan boundary."""
+    import jax.numpy as jnp
+
+    dt = jnp.bfloat16 if mu_dtype == "bfloat16" else None
+    return jax.jit(optax.adam(learning_rate, mu_dtype=dt).init)

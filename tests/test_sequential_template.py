@@ -176,3 +176,65 @@ def test_user_history_query(storage):
         assert algos[0].predict(model, Query(user="ghost", num=3)).item_scores == ()
     finally:
         use_storage(prev)
+
+
+def test_chunked_xent_matches_optax():
+    """ops/xent.py chunked CE == optax full-logits CE, values AND grads
+    (the loss-path rewrite must not change the training objective)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from incubator_predictionio_tpu.ops.xent import chunked_xent_sum
+
+    rng = np.random.default_rng(0)
+    s, d, v = 96, 16, 37
+    h = jnp.asarray(rng.normal(size=(s, d)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(v, d)) * 0.1, jnp.float32)
+    t = jnp.asarray(rng.integers(0, v, s), jnp.int32)
+    wt = jnp.asarray((rng.random(s) > 0.2).astype(np.float32))
+
+    def ref(h, w):
+        logits = jnp.dot(h, w.T)
+        ls = optax.softmax_cross_entropy_with_integer_labels(logits, t)
+        return jnp.sum(ls * wt)
+
+    def ours(h, w):
+        return chunked_xent_sum(h, w, t, wt, 32)  # 3 chunks
+
+    np.testing.assert_allclose(ours(h, w), ref(h, w), rtol=2e-2)
+    gh_a, gw_a = jax.grad(ours, argnums=(0, 1))(h, w)
+    gh_b, gw_b = jax.grad(ref, argnums=(0, 1))(h, w)
+    np.testing.assert_allclose(gh_a, gh_b, atol=2e-2, rtol=2e-2)
+    np.testing.assert_allclose(gw_a, gw_b, atol=2e-2, rtol=2e-2)
+    # the weights cotangent (per-token CE) must flow too — an all-zeros
+    # dweights would silently freeze learned example weights
+    gwt_a = jax.grad(lambda wt: chunked_xent_sum(h, w, t, wt, 32))(wt)
+    gwt_b = jax.grad(lambda wt: jnp.sum(
+        optax.softmax_cross_entropy_with_integer_labels(
+            jnp.dot(h, w.T), t) * wt))(wt)
+    np.testing.assert_allclose(gwt_a, gwt_b, atol=2e-2, rtol=2e-2)
+
+
+def test_bf16_adam_moments_parity():
+    """adam_moments_dtype='bfloat16' trains to a loss within tolerance of
+    fp32 moments on the same data/config (VERDICT r4: flag + parity)."""
+    import dataclasses
+
+    from incubator_predictionio_tpu.models.transformer import (
+        TransformerConfig,
+        TransformerRecommender,
+    )
+    from incubator_predictionio_tpu.parallel.mesh import MeshContext
+
+    ctx = MeshContext.create()
+    rng = np.random.default_rng(3)
+    seqs = rng.integers(1, 50, (64, 17)).astype(np.int32)
+    cfg = TransformerConfig(vocab_size=50, max_len=16, d_model=32, n_heads=2,
+                            n_layers=1, batch_size=32, epochs=8,
+                            attention="local")
+    m32 = TransformerRecommender(cfg).fit(ctx, seqs, None)
+    m16 = TransformerRecommender(
+        dataclasses.replace(cfg, adam_moments_dtype="bfloat16")
+    ).fit(ctx, seqs, None)
+    assert m16.final_loss == pytest.approx(m32.final_loss, rel=0.05)
