@@ -58,12 +58,24 @@ def weighted_xent_sum(h, w_emb, targets, weights):
     return chunked_xent_sum(h, w_emb, targets, weights)
 
 
-def _pick_chunk(s: int, target: int = 4096) -> int:
-    """Largest divisor of ``s`` that is ≤ target (tokens per chunk)."""
-    c = min(s, target)
-    while s % c:
-        c -= 1
-    return c
+def _pad_chunks(h, targets, weights, chunk):
+    """Pad the token dim up to a whole number of ``chunk``-sized rows.
+
+    Pad rows carry weight 0 (they contribute nothing to the loss or any
+    cotangent) and target 0; requiring chunk | S instead would degenerate to
+    chunk 1-2 for divisor-poor token counts (e.g. 2 × prime) and explode the
+    scan length."""
+    s, d = h.shape
+    c = min(s, chunk)
+    pad = (-s) % c
+    if pad:
+        h = jnp.concatenate([h, jnp.zeros((pad, d), h.dtype)])
+        targets = jnp.concatenate(
+            [targets, jnp.zeros(pad, targets.dtype)])
+        weights = jnp.concatenate(
+            [weights, jnp.zeros(pad, weights.dtype)])
+    return (h.reshape(-1, c, h.shape[-1]), targets.reshape(-1, c),
+            weights.reshape(-1, c))
 
 
 def _chunk_logits(h_c, w_emb):
@@ -89,11 +101,7 @@ def chunked_xent_sum(h, w_emb, targets, weights, chunk=4096):
 
 
 def _xent_fwd(h, w_emb, targets, weights, chunk):
-    s, d = h.shape
-    c = _pick_chunk(s, chunk)
-    hc = h.reshape(-1, c, d)
-    tc = targets.reshape(-1, c)
-    wc = weights.reshape(-1, c)
+    hc, tc, wc = _pad_chunks(h, targets, weights, chunk)
 
     def body(acc, args):
         h_c, t_c, w_c = args
@@ -110,10 +118,7 @@ def _xent_fwd(h, w_emb, targets, weights, chunk):
 def _xent_bwd(chunk, res, g):
     h, w_emb, targets, weights = res
     s, d = h.shape
-    c = _pick_chunk(s, chunk)
-    hc = h.reshape(-1, c, d)
-    tc = targets.reshape(-1, c)
-    wc = weights.reshape(-1, c)
+    hc, tc, wc = _pad_chunks(h, targets, weights, chunk)
 
     w_bf = w_emb.astype(jnp.bfloat16)
     v = w_emb.shape[0]
@@ -146,9 +151,9 @@ def _xent_bwd(chunk, res, g):
 
     dw, (dh, dweights) = jax.lax.scan(
         body, jnp.zeros_like(w_emb, jnp.float32), (hc, tc, wc))
-    return (dh.reshape(s, d).astype(h.dtype), dw.astype(w_emb.dtype),
+    return (dh.reshape(-1, d)[:s].astype(h.dtype), dw.astype(w_emb.dtype),
             np.zeros(targets.shape, jax.dtypes.float0),
-            dweights.reshape(s).astype(weights.dtype))
+            dweights.reshape(-1)[:s].astype(weights.dtype))
 
 
 chunked_xent_sum.defvjp(_xent_fwd, _xent_bwd)

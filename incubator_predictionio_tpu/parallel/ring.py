@@ -144,7 +144,8 @@ def causal_attention(q, k, v):
     reference doubles as the kernel's correctness oracle in tests.
     Layout: [B, L, H, DH] in and out (the kernel wants [B, H, L, DH])."""
     l = q.shape[1]
-    if jax.devices()[0].platform == "tpu":
+    is_tpu = jax.devices()[0].platform == "tpu"
+    if is_tpu:
         from incubator_predictionio_tpu.ops.attention import (
             causal_mha_small_head,
             fits_small_head_kernel,
@@ -163,7 +164,7 @@ def causal_attention(q, k, v):
             )
             return out.transpose(0, 2, 1, 3).astype(q.dtype)
     b = flash_block_size(l)
-    if jax.devices()[0].platform == "tpu" and b is not None:
+    if is_tpu and b is not None:
         from jax.experimental.pallas.ops.tpu.flash_attention import (
             BlockSizes,
             flash_attention,

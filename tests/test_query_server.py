@@ -172,7 +172,8 @@ def test_batcher_stop_fails_queued_requests(deployed_env):
         # failed rather than left to hang until aiohttp force-cancels
         loop = asyncio.get_running_loop()
         fut = loop.create_future()
-        await server.batcher.queue.put(({"features": [0.0, 0.0, 0.0]}, fut))
+        await server.batcher.queue.put(
+                ({"features": [0.0, 0.0, 0.0]}, fut, 0.0))
         await server.shutdown()
         assert isinstance(fut.result(), RuntimeError)
 

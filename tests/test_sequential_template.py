@@ -238,3 +238,30 @@ def test_bf16_adam_moments_parity():
         dataclasses.replace(cfg, adam_moments_dtype="bfloat16")
     ).fit(ctx, seqs, None)
     assert m16.final_loss == pytest.approx(m32.final_loss, rel=0.05)
+
+
+def test_chunked_xent_unaligned_token_count():
+    """Divisor-poor token counts (2 × prime) must pad-and-mask, not
+    degenerate to chunk-1 scans; grads for real rows stay exact."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from incubator_predictionio_tpu.ops.xent import chunked_xent_sum
+
+    rng = np.random.default_rng(2)
+    s, d, v = 2 * 41, 8, 23  # no divisor near the chunk target
+    h = jnp.asarray(rng.normal(size=(s, d)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(v, d)) * 0.1, jnp.float32)
+    t = jnp.asarray(rng.integers(0, v, s), jnp.int32)
+    wt = jnp.ones(s, jnp.float32)
+    ref = jnp.sum(optax.softmax_cross_entropy_with_integer_labels(
+        jnp.dot(h, w.T), t) * wt)
+    got = chunked_xent_sum(h, w, t, wt, 32)  # 82 tokens → 3 padded chunks
+    np.testing.assert_allclose(got, ref, rtol=2e-2)
+    gh = jax.grad(lambda h: chunked_xent_sum(h, w, t, wt, 32))(h)
+    gh_ref = jax.grad(lambda h: jnp.sum(
+        optax.softmax_cross_entropy_with_integer_labels(
+            jnp.dot(h, w.T), t) * wt))(h)
+    np.testing.assert_allclose(gh, gh_ref, atol=2e-2, rtol=2e-2)
+    assert gh.shape == h.shape
