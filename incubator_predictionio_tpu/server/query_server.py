@@ -68,6 +68,10 @@ class ServerConfig:
     access_key: Optional[str] = None  # for feedback events
     server_access_key: Optional[str] = None  # guards /stop and /reload
     max_batch: int = 64  # micro-batch cap for /queries.json (1 = no batching)
+    # concurrent dispatches (host prep overlaps device time); 1 restores the
+    # strict predict_batch serialization some non-thread-safe user algorithm
+    # code may rely on (max_batch=1 implies it)
+    max_in_flight: int = 2
     log_url: Optional[str] = None  # remote error-log shipping (CreateServer.scala:423-436)
     log_prefix: str = ""  # prepended to shipped log messages
 
@@ -365,7 +369,12 @@ class QueryServer:
         self.storage = storage or get_storage()
         self.ctx = ctx or MeshContext.create()
         self.deployed = load_deployed_engine(config, self.storage, self.ctx)
-        self.batcher = MicroBatcher(self.deployed, max_batch=config.max_batch)
+        self.batcher = MicroBatcher(
+            self.deployed, max_batch=config.max_batch,
+            # max_batch=1 means "no batching" — keep its historical strict
+            # serialization of user predict code too
+            max_in_flight=1 if config.max_batch == 1 else config.max_in_flight,
+        )
         self.request_count = 0
         self.avg_serving_sec = 0.0
         self.last_serving_sec = 0.0
