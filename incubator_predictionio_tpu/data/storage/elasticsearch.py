@@ -742,6 +742,7 @@ class ESStorageClient(StorageClient):
             password=config.get("PASSWORD"),
         )
         meta = config.get("META_INDEX_PREFIX", "pio_meta")
+        self._transport = t  # live-tier cleanup reaches the raw REST calls
         seq = _ESSequences(t, f"{meta}_sequences")
         self._events = ESEvents(t, config.get("INDEX_PREFIX", "pio_event"))
         self._apps = ESApps(t, meta, seq)

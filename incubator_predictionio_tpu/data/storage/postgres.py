@@ -281,7 +281,13 @@ class _PGConn:
         raise StorageError(f"unsupported postgres auth code {code}")
 
     def _scram(self) -> None:
-        cnonce = base64.b64encode(secrets.token_bytes(18)).decode()
+        # PIO_PG_SCRAM_NONCE pins the client nonce — TEST ONLY: the wire-
+        # transcript capture/replay (tests/test_wire_replay.py) needs a
+        # deterministic SASL exchange to replay real-server captures
+        # byte-exactly. Never set it in production: a fixed nonce defeats
+        # SCRAM's replay protection.
+        cnonce = os.environ.get("PIO_PG_SCRAM_NONCE") or \
+            base64.b64encode(secrets.token_bytes(18)).decode()
         client_first_bare = f"n=,r={cnonce}"
         initial = b"n,," + client_first_bare.encode()
         self._send(b"p", b"SCRAM-SHA-256\x00"

@@ -6,6 +6,7 @@ each backend must pass identically.
 """
 
 import datetime as dt
+import os
 
 import pytest
 
@@ -27,13 +28,77 @@ UTC = dt.timezone.utc
 APP = 1
 
 
+def _live_cleanup_pg(c) -> None:
+    """Reset live-server state between runs: the contract scenarios assume
+    a clean slate (live DBs persist, unlike the per-test fakes). Event
+    tables are dropped; meta tables (created by the DAOs at connect) are
+    emptied in place."""
+    conn = c._conn
+    rows, _ = conn.query(
+        "SELECT tablename FROM pg_tables WHERE schemaname = 'public' "
+        "AND tablename LIKE 'pio_event_%'")
+    for (tbl,) in rows:
+        conn.query(f'DROP TABLE IF EXISTS "{tbl}"')
+    for tbl in ("pio_apps", "pio_access_keys", "pio_channels",
+                "pio_engine_instances", "pio_evaluation_instances",
+                "pio_models"):
+        conn.query(f'DELETE FROM "{tbl}"')
+
+
+def _live_cleanup_es(c) -> None:
+    # ES 8 rejects wildcard DELETEs (action.destructive_requires_name
+    # defaults to true) — list matching indices, then delete BY NAME
+    try:
+        _, listing = c._transport.call(
+            "GET", "/_cat/indices/pio_event_*,pio_meta*"
+            "?format=json&expand_wildcards=all", ok_codes=(200, 404))
+    except StorageError:
+        return
+    names = ([row["index"] for row in listing]
+             if isinstance(listing, list) else [])
+    for name in names:
+        c._transport.call("DELETE", f"/{name}", ok_codes=(200, 404))
+
+
 def t(n):
     return dt.datetime(2020, 1, 1, 0, 0, n, tzinfo=UTC)
 
 
 @pytest.fixture(params=["memory", "sqlite", "eventlog", "eventlog-pyfallback",
-                        "remote", "elasticsearch", "postgres"])
+                        "remote", "elasticsearch", "postgres",
+                        "postgres-live", "elasticsearch-live"])
 def client(request, tmp_path, monkeypatch):
+    if request.param == "postgres-live":
+        # LIVE tier (VERDICT r3 #2): the identical contract scenarios
+        # against a REAL PostgreSQL — tests/LIVE_TESTS.md for the runbook.
+        # Skipped unless PIO_TEST_POSTGRES_URL is set.
+        url = os.environ.get("PIO_TEST_POSTGRES_URL")
+        if not url:
+            pytest.skip("live tier: set PIO_TEST_POSTGRES_URL to enable")
+        from incubator_predictionio_tpu.data.storage.postgres import (
+            PostgresStorageClient,
+        )
+
+        c = PostgresStorageClient({"URL": url})
+        _live_cleanup_pg(c)
+        yield c
+        _live_cleanup_pg(c)
+        c.close()
+        return
+    if request.param == "elasticsearch-live":
+        url = os.environ.get("PIO_TEST_ES_URL")
+        if not url:
+            pytest.skip("live tier: set PIO_TEST_ES_URL to enable")
+        from incubator_predictionio_tpu.data.storage.elasticsearch import (
+            ESStorageClient,
+        )
+
+        c = ESStorageClient({"URL": url})
+        _live_cleanup_es(c)
+        yield c
+        _live_cleanup_es(c)
+        c.close()
+        return
     if request.param == "memory":
         c = MemoryStorageClient({})
     elif request.param == "sqlite":

@@ -1,0 +1,100 @@
+"""Recorded-transcript replay: the wire clients vs captured byte streams.
+
+VERDICT r3 #2 (offline half): the committed transcripts replay in default
+CI with no service running. The replay server asserts the client still
+emits the recorded request stream and feeds back the recorded responses;
+the scenario then re-asserts the parsed results recorded at capture time.
+This pins framing AND parsing in both directions — any refactor of
+postgres.py / elasticsearch.py that changes what goes on the wire, or how
+responses are interpreted, fails here immediately.
+
+``meta.captured_against`` says what produced the server bytes (currently
+the in-process protocol fakes; re-capturing against real services —
+tests/tools/capture_transcripts.py, tests/LIVE_TESTS.md — upgrades the
+same files to real-server oracles with no test change).
+"""
+
+import json
+import os
+
+import pytest
+
+from tests.fixtures.wire_capture import ReplayServer
+
+TRANSCRIPTS = os.path.join(os.path.dirname(__file__), "transcripts")
+
+
+def _load(name: str) -> dict:
+    with open(os.path.join(TRANSCRIPTS, name)) as f:
+        return json.load(f)
+
+
+def test_postgres_wire_replay(monkeypatch):
+    from incubator_predictionio_tpu.data.storage.postgres import (
+        PostgresStorageClient,
+    )
+    from tests.wire_scenarios import pg_scenario
+
+    tr = _load("postgres_scenario.json")
+    assert tr["meta"]["mode"] == "exact"
+    # identical startup/auth bytes: same (test) credentials and the pinned
+    # SCRAM nonce the capture ran with — this is what makes a real-server
+    # capture (password auth) replayable byte-exactly
+    monkeypatch.setenv("PIO_PG_SCRAM_NONCE", tr["meta"]["scram_nonce"])
+    server = ReplayServer(tr, mode="exact")
+    try:
+        client = PostgresStorageClient(
+            {"HOST": "127.0.0.1", "PORT": str(server.port),
+             **tr["meta"].get("client_config", {})})
+        results = pg_scenario(client)
+        client.close()
+    finally:
+        server.close()
+    assert server.errors == [], server.errors
+    assert results == tr["meta"]["expected_results"]
+
+
+def test_elasticsearch_wire_replay():
+    from incubator_predictionio_tpu.data.storage.elasticsearch import (
+        ESStorageClient,
+    )
+    from tests.wire_scenarios import es_scenario
+
+    tr = _load("elasticsearch_scenario.json")
+    assert tr["meta"]["mode"] == "http"
+    server = ReplayServer(tr, mode="http")
+    try:
+        client = ESStorageClient({"URL": f"http://127.0.0.1:{server.port}"})
+        results = es_scenario(client)
+        client.close()
+    finally:
+        server.close()
+    assert server.errors == [], server.errors
+    assert results == tr["meta"]["expected_results"]
+
+
+def test_replay_detects_divergence():
+    """The replay harness itself must FAIL when the client's bytes change —
+    otherwise the two tests above prove nothing."""
+    import socket
+
+    tr = {"connections": [[["C", b"hello".hex()], ["S", b"ok".hex()]]]}
+    server = ReplayServer(tr, mode="exact")
+    try:
+        s = socket.create_connection(("127.0.0.1", server.port))
+        s.sendall(b"hellX")  # diverges at the last byte
+        s.settimeout(2.0)
+        try:
+            s.recv(16)
+        except OSError:
+            pass
+        s.close()
+        import time
+
+        for _ in range(50):
+            if server.errors:
+                break
+            time.sleep(0.05)
+    finally:
+        server.close()
+    assert server.errors and "diverged" in server.errors[0]

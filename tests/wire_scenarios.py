@@ -1,0 +1,79 @@
+"""Deterministic storage-client scenarios for wire-transcript capture/replay.
+
+Every id, timestamp and value is FIXED so the client emits the identical
+byte stream at capture time and at replay time (the PG client's only other
+entropy source, the SCRAM nonce, only appears for password auth — the
+scenario connects without one). The returned summary is stored in the
+transcript's ``meta.expected_results`` and re-asserted at replay, so the
+client must also still PARSE the recorded responses into the same values.
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+
+from incubator_predictionio_tpu.data import DataMap, Event
+from incubator_predictionio_tpu.data.storage.base import Model
+
+UTC = dt.timezone.utc
+APP = 7
+
+
+def _ts(n: int) -> dt.datetime:
+    return dt.datetime(2021, 6, 1, 12, 0, n, tzinfo=UTC)
+
+
+def _event(i: int, name: str = "rate") -> Event:
+    return Event(
+        event=name, entity_type="user", entity_id=f"u{i}",
+        target_entity_type="item", target_entity_id=f"i{i}",
+        properties=DataMap({"rating": i}),
+        event_time=_ts(i), creation_time=_ts(i),
+        event_id=f"{i:032x}",  # fixed ids: no urandom on the wire
+    )
+
+
+def pg_scenario(client) -> dict:
+    """Events + models + apps against PostgreSQL — one connection."""
+    ev = client.events()
+    ev.init(APP)
+    ids = ev.insert_batch([_event(1), _event(2), _event(3, "view")], APP)
+    got = ev.get(ids[0], APP)
+    found = list(ev.find(APP, event_names=["rate"]))
+    rev = list(ev.find(APP, entity_type="user", entity_id="u2",
+                       reversed=True))
+    deleted = ev.delete(ids[2], APP)
+    remaining = sum(1 for _ in ev.find(APP))
+    models = client.models()
+    models.insert(Model("wiretest", b"\x00\x01\xffpayload"))
+    blob = models.get("wiretest")
+    ev.remove(APP)
+    return {
+        "insert_ids": ids,
+        "got_event": got.event if got else None,
+        "got_rating": got.properties.get("rating") if got else None,
+        "found_rate": sorted(e.entity_id for e in found),
+        "reversed_u2": [e.event_id for e in rev],
+        "deleted": deleted,
+        "remaining_after_delete": remaining,
+        "model_blob_hex": blob.models.hex() if blob else None,
+    }
+
+
+def es_scenario(client) -> dict:
+    """Events + apps against Elasticsearch — REST round trips."""
+    ev = client.events()
+    ev.init(APP)
+    ids = ev.insert_batch([_event(1), _event(2), _event(3, "view")], APP)
+    got = ev.get(ids[1], APP)
+    found = list(ev.find(APP, event_names=["rate"]))
+    deleted = ev.delete(ids[0], APP)
+    remaining = sum(1 for _ in ev.find(APP))
+    ev.remove(APP)
+    return {
+        "insert_ids": ids,
+        "got_entity": got.entity_id if got else None,
+        "found_rate": sorted(e.entity_id for e in found),
+        "deleted": deleted,
+        "remaining_after_delete": remaining,
+    }
