@@ -481,34 +481,61 @@ def bench_sequential(ctx, peaks, device) -> dict:
 # ---------------------------------------------------------------------------
 
 #: Standalone load client (argv: base_url, duration_s, n_users). Runs in its
-#: own process with plain aiohttp — no jax, no shared event loop with the
-#: server — and prints one JSON line of client-observed stats.
+#: own process — no jax, no shared event loop with the server — over raw
+#: keep-alive sockets, and prints one JSON line of client-observed stats.
 _SERVING_CLIENT_SCRIPT = """
-import asyncio, json, sys, time
+# Raw-socket HTTP/1.1 keep-alive load generator: the client shares the
+# host's core(s) with the server under test, and an aiohttp client costs
+# more per request than the server handler — measuring through it reports
+# the client, not the server (same rationale as the ingestion driver).
+import asyncio, json, sys, time, urllib.parse
 
-import aiohttp
 import numpy as np
 
 base, duration, n_users = sys.argv[1], float(sys.argv[2]), int(sys.argv[3])
+host = urllib.parse.urlsplit(base).hostname
+port = urllib.parse.urlsplit(base).port
 lat_ms = []
 
+
+def req_bytes(user):
+    body = json.dumps({"user": user, "num": 10}).encode()
+    return (f"POST /queries.json HTTP/1.1\\r\\nHost: {host}:{port}\\r\\n"
+            f"Content-Type: application/json\\r\\n"
+            f"Content-Length: {len(body)}\\r\\n\\r\\n").encode() + body
+
+
+async def post(r, w, user):
+    w.write(req_bytes(user))
+    await w.drain()
+    status = await r.readline()
+    assert b" 200 " in status, status
+    length = None
+    while True:
+        line = await r.readline()
+        if line in (b"\\r\\n", b""):
+            break
+        if line.lower().startswith(b"content-length:"):
+            length = int(line.split(b":")[1])
+    assert length is not None
+    await r.readexactly(length)
+
+
 async def main():
-    async with aiohttp.ClientSession() as s:
-        r = await s.post(base + "/queries.json", json={"user": "u1", "num": 10})
-        assert r.status == 200, r.status  # warmup round trip
-        stop_at = time.perf_counter() + duration
+    conns = [await asyncio.open_connection(host, port) for _ in range(16)]
+    await post(*conns[0], "u1")  # warmup round trip
+    stop_at = time.perf_counter() + duration
 
-        async def worker(wid):
-            rng = np.random.default_rng(wid)
-            while time.perf_counter() < stop_at:
-                q = {"user": f"u{rng.integers(0, n_users)}", "num": 10}
-                t0 = time.perf_counter()
-                r = await s.post(base + "/queries.json", json=q)
-                await r.read()
-                lat_ms.append((time.perf_counter() - t0) * 1e3)
-                assert r.status == 200, r.status
+    async def worker(conn, wid):
+        rng = np.random.default_rng(wid)
+        while time.perf_counter() < stop_at:
+            t0 = time.perf_counter()
+            await post(*conn, f"u{rng.integers(0, n_users)}")
+            lat_ms.append((time.perf_counter() - t0) * 1e3)
 
-        await asyncio.gather(*(worker(i) for i in range(16)))
+    await asyncio.gather(*(worker(c, i) for i, c in enumerate(conns)))
+    for _, w in conns:
+        w.close()
 
 asyncio.run(main())
 a = np.sort(np.asarray(lat_ms))

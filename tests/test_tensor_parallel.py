@@ -117,19 +117,9 @@ def test_warns_when_mesh_axis_missing(caplog):
     axis must WARN (ADVICE r3: silently-replicated training had no signal)."""
     import logging
 
-    import numpy as np
-
-    from incubator_predictionio_tpu.models.transformer import (
-        TransformerConfig,
-        TransformerRecommender,
-    )
-    from incubator_predictionio_tpu.parallel.mesh import MeshContext
-
     ctx = MeshContext.create()  # plain data mesh: no 'model'/'pipe'/'expert'
     seqs = np.ones((8, 9), np.int32)
-    cfg = TransformerConfig(vocab_size=16, max_len=8, d_model=16, n_heads=2,
-                            n_layers=1, batch_size=8, epochs=1,
-                            attention="local", tensor_parallel=True)
+    cfg = _cfg(vocab_size=16, n_heads=2, n_layers=1, batch_size=8, epochs=1)
     with caplog.at_level(logging.WARNING,
                          logger="incubator_predictionio_tpu.models.transformer"):
         TransformerRecommender(cfg).fit(ctx, seqs, None)
