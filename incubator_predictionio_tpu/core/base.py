@@ -82,6 +82,15 @@ class BasePreparator(AbstractDoer, Generic[TD, PD]):
 class BaseAlgorithm(AbstractDoer, Generic[PD, M, Q, P]):
     """(core/BaseAlgorithm.scala:69-126)"""
 
+    #: Declare True when ``predict``/``batch_predict`` (and any lazy state
+    #: built in ``prepare_for_serving``) tolerate concurrent calls from
+    #: multiple threads. The query server only overlaps dispatches
+    #: (``max_in_flight`` > 1) automatically when EVERY deployed algorithm
+    #: declares this; custom engines keep strict serialization by default.
+    #: All built-in template algorithms declare it (jit dispatch is
+    #: thread-safe; served models are read-only arrays).
+    serving_thread_safe: bool = False
+
     @abc.abstractmethod
     def train(self, ctx: MeshContext, pd: PD) -> M: ...
 

@@ -83,6 +83,15 @@ N_SHARD_BUCKETS = 1024  # same bucket fold as the sqlite backend
 # Errors (mapped from SQLSTATE so stores can branch like sqlite's exceptions)
 # ---------------------------------------------------------------------------
 
+def _gen_nonce() -> str:
+    """SCRAM client nonce. Module-level so the wire-transcript capture/replay
+    harness (tests/test_wire_replay.py) can monkeypatch a deterministic nonce
+    for byte-exact SASL replays — deliberately NOT env-var driven, so nothing
+    in a production environment can pin the nonce and defeat SCRAM's replay
+    protection (round-4 advisor finding)."""
+    return base64.b64encode(secrets.token_bytes(18)).decode()
+
+
 class PGError(StorageError):
     def __init__(self, fields: dict[str, str]):
         self.sqlstate = fields.get("C", "")
@@ -281,13 +290,7 @@ class _PGConn:
         raise StorageError(f"unsupported postgres auth code {code}")
 
     def _scram(self) -> None:
-        # PIO_PG_SCRAM_NONCE pins the client nonce — TEST ONLY: the wire-
-        # transcript capture/replay (tests/test_wire_replay.py) needs a
-        # deterministic SASL exchange to replay real-server captures
-        # byte-exactly. Never set it in production: a fixed nonce defeats
-        # SCRAM's replay protection.
-        cnonce = os.environ.get("PIO_PG_SCRAM_NONCE") or \
-            base64.b64encode(secrets.token_bytes(18)).decode()
+        cnonce = _gen_nonce()
         client_first_bare = f"n=,r={cnonce}"
         initial = b"n,," + client_first_bare.encode()
         self._send(b"p", b"SCRAM-SHA-256\x00"

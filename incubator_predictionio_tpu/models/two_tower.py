@@ -400,7 +400,11 @@ class TwoTowerMF:
         # user+item would keep a user-heavy/small-catalog model on device
         # only for deploy to take the host serving path and pay the full
         # user-table pull anyway (plus a pointless giant checkpoint)
-        item_elems = ni_p * (cfg.rank + 1)
+        # UNPADDED count: prepare_for_serving's host-path check keys on
+        # n_items, so keying auto on the padded ni_p would leave catalogs in
+        # the padding band device-resident (orbax checkpoint and all) only
+        # for deploy to take the host path anyway (round-4 advisor finding)
+        item_elems = n_items * (cfg.rank + 1)
         keep_device = cfg.gather == "device" or (
             cfg.gather == "auto" and item_elems > HOST_SERVE_MAX_ELEMENTS)
         if keep_device and ctx.process_count > 1:

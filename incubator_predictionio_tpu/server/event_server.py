@@ -108,6 +108,7 @@ class EventServer:
         # documented trade for ~10× the lookup cost).
         self._auth_cache: dict[tuple[Optional[str], Optional[str]],
                                tuple[float, AuthData]] = {}
+        self._AUTH_TTL = self._auth_ttl()
         self._init_done: set[tuple[int, Optional[int]]] = set()
         # single-core hosts: the executor hop buys no overlap (the GIL and
         # the core are the same resource) and costs two thread switches per
@@ -116,7 +117,22 @@ class EventServer:
         # loop while other cores could be parsing the next request.
         self._inline_batch = (os.cpu_count() or 2) <= 1
 
-    _AUTH_TTL = 5.0  # seconds
+    @staticmethod
+    def _auth_ttl() -> float:
+        """Auth-cache TTL (seconds). A cached success means a revoked key /
+        deleted channel / tightened whitelist is honored for up to TTL after
+        the change — a staleness window the reference's per-request lookup
+        doesn't have. PIO_EVENTSERVER_AUTH_TTL overrides; 0 disables caching
+        (restores exact reference semantics at ~10× the lookup cost).
+        Read per server instance; a malformed value is a warning, not a
+        crash of every importer."""
+        raw = os.environ.get("PIO_EVENTSERVER_AUTH_TTL", "5.0")
+        try:
+            return float(raw)
+        except ValueError:
+            logger.warning(
+                "invalid PIO_EVENTSERVER_AUTH_TTL=%r; using 5.0s", raw)
+            return 5.0
 
     async def _run(self, fn, *args):
         """Run a blocking storage call off the event loop."""
@@ -145,11 +161,18 @@ class EventServer:
         metadata lookups are per-request invariant on the ingest hot path."""
         key = self._extract_key(request)
         channel = request.query.get("channel")
+        if self._AUTH_TTL <= 0:  # caching disabled: per-request lookup
+            return await self._run(self._authenticate, request)
         now = time.monotonic()
         hit = self._auth_cache.get((key, channel))
         if hit is not None and hit[0] > now:
             return hit[1]
-        data = await self._run(self._authenticate, request)
+        try:
+            data = await self._run(self._authenticate, request)
+        except web.HTTPException:
+            # a rejection must never serve from (or leave) a cached success
+            self._auth_cache.pop((key, channel), None)
+            raise
         if len(self._auth_cache) > 1024:  # unbounded-growth guard
             self._auth_cache.clear()
         self._auth_cache[(key, channel)] = (now + self._AUTH_TTL, data)
@@ -271,13 +294,16 @@ class EventServer:
         insert+fsync (the round-3 ingestion wall)."""
         results: list[dict] = []
         accepted: list[tuple[int, Event]] = []  # (result slot, event)
-        receipt = _dt.datetime.now(_dt.timezone.utc)  # one per batch
         for item in payload:
             try:
                 if not isinstance(item, dict):
                     raise EventValidationError("event JSON must be an object")
+                # receipt creationTime stamped PER ITEM, matching
+                # EventJson4sSupport.scala:77-78 (each event at its own
+                # processing time — consumers sorting/deduping on
+                # creationTime must not see batch-wide ties)
                 accepted.append(
-                    (len(results), self._prepare_event(item, auth, receipt)))
+                    (len(results), self._prepare_event(item, auth, None)))
                 results.append({"status": 201})  # eventId filled below
             except EventValidationError as e:
                 results.append({"status": 400, "message": str(e)})

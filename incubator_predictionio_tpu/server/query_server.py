@@ -68,10 +68,12 @@ class ServerConfig:
     access_key: Optional[str] = None  # for feedback events
     server_access_key: Optional[str] = None  # guards /stop and /reload
     max_batch: int = 64  # micro-batch cap for /queries.json (1 = no batching)
-    # concurrent dispatches (host prep overlaps device time); 1 restores the
-    # strict predict_batch serialization some non-thread-safe user algorithm
-    # code may rely on (max_batch=1 implies it)
-    max_in_flight: int = 2
+    # concurrent dispatches (host prep overlaps device time). None = auto:
+    # overlap (2) only when every deployed algorithm declares
+    # ``serving_thread_safe``; otherwise strict predict_batch serialization
+    # (1) — custom engines with non-thread-safe predict code must never race
+    # by default. An explicit int overrides in either direction.
+    max_in_flight: Optional[int] = None
     log_url: Optional[str] = None  # remote error-log shipping (CreateServer.scala:423-436)
     log_prefix: str = ""  # prepended to shipped log messages
 
@@ -358,6 +360,22 @@ def load_deployed_engine(
                           max_batch=config.max_batch)
 
 
+def effective_max_in_flight(config: ServerConfig, deployed: DeployedEngine) -> int:
+    """Resolve ``ServerConfig.max_in_flight``'s auto (None) mode.
+
+    max_batch=1 means "no batching" and keeps its historical strict
+    serialization of user predict code regardless; otherwise overlap is only
+    enabled automatically when every deployed algorithm opted in via
+    ``serving_thread_safe`` (BaseAlgorithm)."""
+    if config.max_batch == 1:
+        return 1
+    if config.max_in_flight is not None:
+        return max(1, config.max_in_flight)
+    safe = all(getattr(a, "serving_thread_safe", False)
+               for a in deployed.algorithms)
+    return 2 if safe else 1
+
+
 class QueryServer:
     def __init__(
         self,
@@ -371,9 +389,7 @@ class QueryServer:
         self.deployed = load_deployed_engine(config, self.storage, self.ctx)
         self.batcher = MicroBatcher(
             self.deployed, max_batch=config.max_batch,
-            # max_batch=1 means "no batching" — keep its historical strict
-            # serialization of user predict code too
-            max_in_flight=1 if config.max_batch == 1 else config.max_in_flight,
+            max_in_flight=effective_max_in_flight(config, self.deployed),
         )
         self.request_count = 0
         self.avg_serving_sec = 0.0

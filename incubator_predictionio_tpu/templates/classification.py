@@ -175,6 +175,7 @@ class MLPAlgorithm(P2LAlgorithm):
     it fills, not the math)."""
 
     params_class = MLPAlgorithmParams
+    serving_thread_safe = True  # jit dispatch + read-only served arrays
     query_cls = Query
 
     def _config(self) -> MLPConfig:
@@ -257,6 +258,7 @@ class NaiveBayesAlgorithm(P2LAlgorithm):
     closed-form fit and the scoring pass both running as jax ops."""
 
     params_class = NaiveBayesAlgorithmParams
+    serving_thread_safe = True  # jit dispatch + read-only served arrays
     query_cls = Query
 
     def train(self, ctx: MeshContext, pd: TrainingData) -> NaiveBayesModel:

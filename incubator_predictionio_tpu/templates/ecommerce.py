@@ -204,6 +204,7 @@ class ECommModel:
 
 class ECommAlgorithm(PAlgorithm):
     params_class = ECommAlgorithmParams
+    serving_thread_safe = True  # jit dispatch + read-only served arrays
     query_cls = Query
 
     def __init__(self, params: ECommAlgorithmParams):

@@ -454,6 +454,7 @@ class ALSAlgorithm(PAlgorithm):
     """MLlib ALS slot (ALSAlgorithm.scala:50-93) filled by two-tower MF."""
 
     params_class = ALSAlgorithmParams
+    serving_thread_safe = True  # jit dispatch + read-only served arrays
     query_cls = Query
 
     def train(self, ctx: MeshContext, pd: TrainingData) -> RecModel:

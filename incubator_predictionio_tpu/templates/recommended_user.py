@@ -182,6 +182,7 @@ class ALSAlgorithm(PAlgorithm):
     (ALSAlgorithm.scala:104-185)."""
 
     params_class = ALSAlgorithmParams
+    serving_thread_safe = True  # jit dispatch + read-only served arrays
     query_cls = Query
 
     def train(self, ctx: MeshContext, pd: TrainingData) -> SimilarUserModel:

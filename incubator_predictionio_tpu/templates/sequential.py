@@ -254,6 +254,7 @@ class TransformerAlgorithmParams(Params):
 
 class TransformerAlgorithm(PAlgorithm):
     params_class = TransformerAlgorithmParams
+    serving_thread_safe = True  # jit dispatch + read-only served arrays
     query_cls = Query
 
     def __init__(self, params: TransformerAlgorithmParams):

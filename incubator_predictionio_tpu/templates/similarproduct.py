@@ -274,6 +274,7 @@ class ALSAlgorithm(PAlgorithm):
     ``ALS.trainImplicit``) via two-tower towers + sampled negatives."""
 
     params_class = ALSAlgorithmParams
+    serving_thread_safe = True  # jit dispatch + read-only served arrays
     query_cls = Query
 
     def train(self, ctx: MeshContext, pd: TrainingData) -> ItemSimModel:
@@ -351,6 +352,7 @@ class CooccurrenceAlgorithm(PAlgorithm):
     matrix, so one bf16 matmul yields every pairwise co-count."""
 
     params_class = CooccurrenceAlgorithmParams
+    serving_thread_safe = True  # jit dispatch + read-only served arrays
     query_cls = Query
 
     def train(self, ctx: MeshContext, pd: TrainingData) -> CooccurrenceModel:

@@ -40,7 +40,9 @@ def test_postgres_wire_replay(monkeypatch):
     # identical startup/auth bytes: same (test) credentials and the pinned
     # SCRAM nonce the capture ran with — this is what makes a real-server
     # capture (password auth) replayable byte-exactly
-    monkeypatch.setenv("PIO_PG_SCRAM_NONCE", tr["meta"]["scram_nonce"])
+    from incubator_predictionio_tpu.data.storage import postgres as _pg
+    monkeypatch.setattr(_pg, "_gen_nonce",
+                        lambda: tr["meta"]["scram_nonce"])
     server = ReplayServer(tr, mode="exact")
     try:
         client = PostgresStorageClient(

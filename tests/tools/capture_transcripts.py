@@ -39,7 +39,8 @@ PG_TEST_NONCE = "cGlvLXRyYW5zY3JpcHQtbm9uY2Ux"
 
 
 def capture_pg() -> None:
-    os.environ["PIO_PG_SCRAM_NONCE"] = PG_TEST_NONCE
+    from incubator_predictionio_tpu.data.storage import postgres as _pg
+    _pg._gen_nonce = lambda: PG_TEST_NONCE  # deterministic capture (test creds)
     pg_url = os.environ.get("PIO_TEST_POSTGRES_URL")
     if pg_url:
         u = urllib.parse.urlsplit(pg_url)
