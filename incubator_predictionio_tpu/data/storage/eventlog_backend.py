@@ -298,6 +298,62 @@ class EventLogEvents(EventStore):
             self._logs.clear()
 
     # -- CRUD -------------------------------------------------------------
+    def ingest_raw(
+        self,
+        body: bytes,
+        single: bool,
+        max_items: int,
+        whitelist: Sequence[str],
+        app_id: int,
+        channel_id: Optional[int] = None,
+    ):
+        """C ingest fast path: raw request body → parse→validate→encode in
+        native code (native/src/ingest.cc), then ONE append+flush of the
+        pre-encoded records. Returns the per-item response dicts the event
+        server would have produced (parity: EventServer.scala:376-462 via
+        server/event_server.py _ingest_batch), or ``None`` when the caller
+        must run the Python path (native lib unavailable, read-only log, or
+        the C core declined a construct it can't guarantee byte-parity on).
+
+        The whole C call happens under the log's write lock: interner ids
+        are assigned inside the C core from a snapshot of the writer's
+        string table, so the snapshot → encode → append must be atomic."""
+        from incubator_predictionio_tpu import native
+
+        if native.get_lib() is None:
+            return None
+        log = self._log(app_id, channel_id, create=True)
+        if log.f is None:  # read-only view: the Python path raises properly
+            return None
+        with log.lock:
+            # interner snapshot ordered by id (ids are dense, 0..n-1)
+            interned = [None] * len(log.interner.ids)
+            for s, i in log.interner.ids.items():
+                interned[i] = s
+            r = native.ingest(body, single, max_items, list(whitelist), interned)
+            if r is None or r is native.INGEST_FALLBACK:
+                return None
+            results, new_strings, offsets, blob = r
+            off_base = log.f.tell()
+            if blob:
+                log.f.write(blob)
+                log.f.flush()
+            acc = iter(offsets)
+            for status, _msg, event_id in results:
+                if status == 201:
+                    log.index[event_id] = off_base + next(acc)
+            for s in new_strings:
+                i = len(log.interner.ids)
+                log.interner.ids[s] = i
+                log.strings.setdefault(i, s)
+        out = []
+        for status, msg, event_id in results:
+            if status == 201:
+                out.append({"status": 201, "eventId": event_id})
+            else:
+                out.append({"status": status, "message": msg})
+        return out
+
     def insert(self, event: Event, app_id: int, channel_id: Optional[int] = None) -> str:
         return self.insert_batch([event], app_id, channel_id)[0]
 

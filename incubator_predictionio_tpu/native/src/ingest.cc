@@ -1,0 +1,771 @@
+// Native ingest core: parse -> validate -> encode for the event servers.
+//
+// Takes the RAW request body of POST /batch/events.json (or a single event),
+// performs the same JSON parse + validation the Python path does
+// (data/event.py Event.from_json_dict + validate_event + whitelist; parity
+// target EventServer.scala:376-462 batch semantics), and encodes accepted
+// events straight into PIOLOG01 records (native/format.py layout) ready for
+// one append+flush. This removes the Python json.loads / Event / encode work
+// from the single-core durable-ingestion path (PERF.md round-4: ~0.45 ms of
+// the ~1.2 ms batch cycle).
+//
+// Parity strategy: the C path handles the COMMON shapes bit-for-bit
+// (statuses, error messages, record bytes). Anything where byte-parity with
+// CPython is not certain (exotic timestamp formats, non-string tags,
+// fractional epoch times, pathological nesting, top-level errors whose
+// message comes from Python's json module) returns PL_INGEST_FALLBACK and
+// the caller runs the pure-Python path instead — so behavior is identical by
+// construction, the C core just accelerates the hot 99%.
+//
+// Entry point: pl_ingest (see header comment at the function).
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <cstdio>
+#include <cstdlib>
+#include <cmath>
+#include <ctime>
+#include <string>
+#include <vector>
+#include <unordered_map>
+#include <unordered_set>
+
+#include <sys/random.h>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// little-endian emit helpers
+// ---------------------------------------------------------------------------
+
+struct Buf {
+  std::vector<uint8_t> d;
+  void u8(uint8_t v) { d.push_back(v); }
+  void u16(uint16_t v) { d.push_back(v & 0xff); d.push_back(v >> 8); }
+  void u32(uint32_t v) { for (int i = 0; i < 4; i++) d.push_back((v >> (8 * i)) & 0xff); }
+  void u64(uint64_t v) { for (int i = 0; i < 8; i++) d.push_back((v >> (8 * i)) & 0xff); }
+  void i64(int64_t v) { u64((uint64_t)v); }
+  void i16(int16_t v) { u16((uint16_t)v); }
+  void f64(double v) { uint64_t b; memcpy(&b, &v, 8); u64(b); }
+  void raw(const void* p, size_t n) {
+    const uint8_t* c = (const uint8_t*)p;
+    d.insert(d.end(), c, c + n);
+  }
+  void str16(const std::string& s) { u16((uint16_t)s.size()); raw(s.data(), s.size()); }
+  size_t size() const { return d.size(); }
+};
+
+constexpr uint16_t ABSENT16 = 0xFFFF;
+constexpr uint32_t NONE_ID = 0xFFFFFFFF;
+constexpr uint8_t KIND_INTERN = 1;
+constexpr uint8_t KIND_EVENT = 2;
+
+// ---------------------------------------------------------------------------
+// JSON DOM
+// ---------------------------------------------------------------------------
+
+struct JVal;
+using JArr = std::vector<JVal>;
+using JObjEntry = std::pair<std::string, JVal>;
+
+struct JVal {
+  enum Type { NUL, BOOL, INT, BIGINT, DBL, STR, ARR, OBJ } type = NUL;
+  bool b = false;
+  int64_t i = 0;
+  double dbl = 0.0;
+  std::string s;              // STR payload or BIGINT decimal ascii
+  std::vector<JVal> arr;
+  std::vector<JObjEntry> obj; // insertion order, keys deduped (last wins)
+};
+
+struct Fallback {};  // thrown to abort into the Python path
+
+struct Parser {
+  const uint8_t* p;
+  const uint8_t* end;
+  int depth = 0;
+
+  void ws() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r')) p++;
+  }
+  [[noreturn]] void fail() { throw Fallback{}; }  // malformed JSON: Python
+                                                  // owns the exact message
+  bool lit(const char* s) {
+    size_t n = strlen(s);
+    if ((size_t)(end - p) < n || memcmp(p, s, n) != 0) return false;
+    p += n;
+    return true;
+  }
+
+  JVal parse_value() {
+    if (++depth > 64) fail();  // deep nesting: let Python decide
+    ws();
+    if (p >= end) fail();
+    JVal v;
+    switch (*p) {
+      case '{': parse_obj(v); break;
+      case '[': parse_arr(v); break;
+      case '"': v.type = JVal::STR; v.s = parse_string(); break;
+      case 't': if (!lit("true")) fail(); v.type = JVal::BOOL; v.b = true; break;
+      case 'f': if (!lit("false")) fail(); v.type = JVal::BOOL; v.b = false; break;
+      case 'n': if (!lit("null")) fail(); v.type = JVal::NUL; break;
+      case 'N': if (!lit("NaN")) fail(); v.type = JVal::DBL; v.dbl = NAN; break;
+      case 'I': if (!lit("Infinity")) fail(); v.type = JVal::DBL; v.dbl = INFINITY; break;
+      default: parse_number(v); break;
+    }
+    depth--;
+    return v;
+  }
+
+  void parse_obj(JVal& v) {
+    v.type = JVal::OBJ;
+    p++;  // '{'
+    ws();
+    if (p < end && *p == '}') { p++; return; }
+    while (true) {
+      ws();
+      if (p >= end || *p != '"') fail();
+      std::string key = parse_string();
+      ws();
+      if (p >= end || *p != ':') fail();
+      p++;
+      JVal item = parse_value();
+      // duplicate keys: CPython dict keeps the FIRST position, LAST value
+      bool dup = false;
+      for (auto& kv : v.obj)
+        if (kv.first == key) { kv.second = std::move(item); dup = true; break; }
+      if (!dup) v.obj.emplace_back(std::move(key), std::move(item));
+      ws();
+      if (p < end && *p == ',') { p++; continue; }
+      if (p < end && *p == '}') { p++; return; }
+      fail();
+    }
+  }
+
+  void parse_arr(JVal& v) {
+    v.type = JVal::ARR;
+    p++;  // '['
+    ws();
+    if (p < end && *p == ']') { p++; return; }
+    while (true) {
+      v.arr.push_back(parse_value());
+      ws();
+      if (p < end && *p == ',') { p++; continue; }
+      if (p < end && *p == ']') { p++; return; }
+      fail();
+    }
+  }
+
+  std::string parse_string() {
+    p++;  // opening quote
+    std::string out;
+    while (true) {
+      if (p >= end) fail();
+      uint8_t c = *p;
+      if (c == '"') { p++; return out; }
+      if (c == '\\') {
+        p++;
+        if (p >= end) fail();
+        switch (*p) {
+          case '"': out += '"'; break;
+          case '\\': out += '\\'; break;
+          case '/': out += '/'; break;
+          case 'b': out += '\b'; break;
+          case 'f': out += '\f'; break;
+          case 'n': out += '\n'; break;
+          case 'r': out += '\r'; break;
+          case 't': out += '\t'; break;
+          case 'u': {
+            uint32_t cp = parse_hex4();
+            if (cp >= 0xD800 && cp <= 0xDBFF) {  // surrogate pair
+              if (p + 2 < end && p[1] == '\\' && p[2] == 'u') {
+                p += 2;
+                uint32_t lo = parse_hex4();
+                if (lo >= 0xDC00 && lo <= 0xDFFF)
+                  cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+                else fail();  // Python pairs-or-keeps lone surrogates; punt
+              } else {
+                fail();  // lone surrogate: Python keeps it (surrogatepass
+                         // is not representable in clean UTF-8) — punt
+              }
+            } else if (cp >= 0xDC00 && cp <= 0xDFFF) {
+              fail();
+            }
+            append_utf8(out, cp);
+            break;
+          }
+          default: fail();
+        }
+        p++;
+      } else if (c < 0x20) {
+        fail();  // control chars are invalid JSON (strict mode)
+      } else {
+        out += (char)c;
+        p++;
+      }
+    }
+  }
+
+  uint32_t parse_hex4() {
+    if (end - p < 5) fail();
+    uint32_t v = 0;
+    for (int i = 1; i <= 4; i++) {
+      uint8_t c = p[i];
+      v <<= 4;
+      if (c >= '0' && c <= '9') v |= c - '0';
+      else if (c >= 'a' && c <= 'f') v |= c - 'a' + 10;
+      else if (c >= 'A' && c <= 'F') v |= c - 'A' + 10;
+      else fail();
+    }
+    p += 4;  // caller advances past the final hex digit via p++
+    return v;
+  }
+
+  static void append_utf8(std::string& out, uint32_t cp) {
+    if (cp < 0x80) out += (char)cp;
+    else if (cp < 0x800) {
+      out += (char)(0xC0 | (cp >> 6));
+      out += (char)(0x80 | (cp & 0x3F));
+    } else if (cp < 0x10000) {
+      out += (char)(0xE0 | (cp >> 12));
+      out += (char)(0x80 | ((cp >> 6) & 0x3F));
+      out += (char)(0x80 | (cp & 0x3F));
+    } else {
+      out += (char)(0xF0 | (cp >> 18));
+      out += (char)(0x80 | ((cp >> 12) & 0x3F));
+      out += (char)(0x80 | ((cp >> 6) & 0x3F));
+      out += (char)(0x80 | (cp & 0x3F));
+    }
+  }
+
+  void parse_number(JVal& v) {
+    const uint8_t* start = p;
+    if (p < end && *p == '-') {
+      p++;
+      if (p < end && *p == 'I') {  // -Infinity (Python json accepts it)
+        if (!lit("Infinity")) fail();
+        v.type = JVal::DBL;
+        v.dbl = -INFINITY;
+        return;
+      }
+    }
+    if (p >= end || *p < '0' || *p > '9') fail();
+    bool is_float = false;
+    while (p < end && *p >= '0' && *p <= '9') p++;
+    if (p < end && *p == '.') {
+      is_float = true;
+      p++;
+      if (p >= end || *p < '0' || *p > '9') fail();
+      while (p < end && *p >= '0' && *p <= '9') p++;
+    }
+    if (p < end && (*p == 'e' || *p == 'E')) {
+      is_float = true;
+      p++;
+      if (p < end && (*p == '+' || *p == '-')) p++;
+      if (p >= end || *p < '0' || *p > '9') fail();
+      while (p < end && *p >= '0' && *p <= '9') p++;
+    }
+    std::string text((const char*)start, (const char*)p);
+    if (is_float) {
+      v.type = JVal::DBL;
+      v.dbl = strtod(text.c_str(), nullptr);
+    } else {
+      errno = 0;
+      char* endp = nullptr;
+      long long r = strtoll(text.c_str(), &endp, 10);
+      if (errno == ERANGE || endp != text.c_str() + text.size()) {
+        v.type = JVal::BIGINT;   // outside i64: TLV kind 8, decimal ascii.
+        v.s = std::move(text);   // Python str(int(text)) == text with the
+        if (v.s[0] == '0' && v.s.size() > 1) throw Fallback{};  // no leading
+        if (v.s.size() > 1 && v.s[0] == '-' && v.s[1] == '0') throw Fallback{};
+      } else {                   // zeros possible in valid JSON anyway, but
+        v.type = JVal::INT;      // guard the invariant
+        v.i = r;
+      }
+    }
+  }
+};
+
+// ---------------------------------------------------------------------------
+// ISO-8601 subset parser (canonical forms only; anything else -> Fallback
+// so datetime.fromisoformat stays the authority)
+// ---------------------------------------------------------------------------
+
+// Days from civil epoch (Howard Hinnant's algorithm), proleptic Gregorian.
+int64_t days_from_civil(int64_t y, unsigned m, unsigned d) {
+  y -= m <= 2;
+  const int64_t era = (y >= 0 ? y : y - 399) / 400;
+  const unsigned yoe = (unsigned)(y - era * 400);
+  const unsigned doy = (153 * (m + (m > 2 ? -3 : 9)) + 2) / 5 + d - 1;
+  const unsigned doe = yoe * 365 + yoe / 4 - yoe / 100 + doy;
+  return era * 146097 + (int64_t)doe - 719468;
+}
+
+bool days_in_month_ok(int y, int m, int d) {
+  static const int dim[] = {31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31};
+  if (m < 1 || m > 12 || d < 1) return false;
+  int lim = dim[m - 1];
+  if (m == 2 && ((y % 4 == 0 && y % 100 != 0) || y % 400 == 0)) lim = 29;
+  return d <= lim;
+}
+
+struct ParsedTime { int64_t us; int16_t tz_min; };
+
+// returns false on "not canonical" (-> Fallback); Python-rejected strings
+// also land there so the 400 message stays Python's verbatim
+bool parse_iso(const std::string& s, ParsedTime& out) {
+  // Python path first does s.replace("Z", "+00:00") — an interior 'Z'
+  // anywhere triggers that replacement, so only handle the trailing case
+  // and punt on any other 'Z'
+  std::string t = s;
+  size_t zpos = t.find('Z');
+  if (zpos != std::string::npos) {
+    if (zpos != t.size() - 1) return false;
+    t = t.substr(0, zpos) + "+00:00";
+  }
+  const char* c = t.c_str();
+  size_t n = t.size();
+  auto digits = [&](size_t pos, size_t cnt, int& v) -> bool {
+    if (pos + cnt > n) return false;
+    v = 0;
+    for (size_t i = 0; i < cnt; i++) {
+      if (c[pos + i] < '0' || c[pos + i] > '9') return false;
+      v = v * 10 + (c[pos + i] - '0');
+    }
+    return true;
+  };
+  int year, mon, day, hh = 0, mm = 0, ss = 0;
+  int64_t frac_us = 0;
+  int tz_min = 0;
+  bool have_tz = false;
+  if (!digits(0, 4, year) || n < 10 || c[4] != '-' || !digits(5, 2, mon) ||
+      c[7] != '-' || !digits(8, 2, day))
+    return false;
+  size_t pos = 10;
+  if (pos < n) {
+    if (c[pos] != 'T' && c[pos] != ' ') return false;
+    pos++;
+    if (!digits(pos, 2, hh) || pos + 5 > n || c[pos + 2] != ':' ||
+        !digits(pos + 3, 2, mm))
+      return false;
+    pos += 5;
+    if (pos < n && c[pos] == ':') {
+      pos++;
+      if (!digits(pos, 2, ss)) return false;
+      pos += 2;
+      if (pos < n && c[pos] == '.') {
+        pos++;
+        size_t fs = pos;
+        while (pos < n && c[pos] >= '0' && c[pos] <= '9') pos++;
+        size_t fd = pos - fs;
+        if (fd == 0 || fd > 6) return false;  // >6 digits: fromisoformat
+                                              // truncates post-3.11; punt
+        for (size_t i = 0; i < 6; i++)
+          frac_us = frac_us * 10 + (i < fd ? c[fs + i] - '0' : 0);
+      }
+    }
+    if (pos < n) {
+      char sign = c[pos];
+      if (sign != '+' && sign != '-') return false;
+      pos++;
+      int oh, om = 0;
+      if (!digits(pos, 2, oh)) return false;
+      pos += 2;
+      if (pos < n && c[pos] == ':') {
+        pos++;
+        if (!digits(pos, 2, om)) return false;
+        pos += 2;
+      } else if (pos != n) {
+        return false;  // +HHMM / +HH forms: punt to Python
+      }
+      if (pos != n) return false;
+      if (oh > 23 || om > 59) return false;
+      tz_min = oh * 60 + om;
+      if (sign == '-') tz_min = -tz_min;
+      have_tz = true;
+    }
+  }
+  if (year < 1 || year > 9999 || !days_in_month_ok(year, mon, day) ||
+      hh > 23 || mm > 59 || ss > 59)
+    return false;  // Python raises its own message; keep it authoritative
+  (void)have_tz;
+  int64_t days = days_from_civil(year, mon, day);
+  int64_t local_us =
+      ((days * 24 + hh) * 60 + mm) * 60 * 1000000LL + (int64_t)ss * 1000000LL + frac_us;
+  out.us = local_us - (int64_t)tz_min * 60 * 1000000LL;  // store as UTC us
+  out.tz_min = (int16_t)tz_min;
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// TLV encode (format.py encode_tlv parity)
+// ---------------------------------------------------------------------------
+
+void encode_tlv(const JVal& v, Buf& out) {
+  switch (v.type) {
+    case JVal::NUL: out.u8(0); break;
+    case JVal::BOOL: out.u8(v.b ? 2 : 1); break;
+    case JVal::INT: out.u8(3); out.i64(v.i); break;
+    case JVal::BIGINT:
+      out.u8(8);
+      out.u32((uint32_t)v.s.size());
+      out.raw(v.s.data(), v.s.size());
+      break;
+    case JVal::DBL: out.u8(4); out.f64(v.dbl); break;
+    case JVal::STR:
+      out.u8(5);
+      out.u32((uint32_t)v.s.size());
+      out.raw(v.s.data(), v.s.size());
+      break;
+    case JVal::ARR:
+      out.u8(6);
+      out.u32((uint32_t)v.arr.size());
+      for (const auto& e : v.arr) encode_tlv(e, out);
+      break;
+    case JVal::OBJ:
+      out.u8(7);
+      out.u32((uint32_t)v.obj.size());
+      for (const auto& kv : v.obj) {
+        if (kv.first.size() >= ABSENT16) throw Fallback{};
+        out.u16((uint16_t)kv.first.size());
+        out.raw(kv.first.data(), kv.first.size());
+        encode_tlv(kv.second, out);
+      }
+      break;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// validation (event.py from_json_dict + validate_event parity)
+// ---------------------------------------------------------------------------
+
+struct ItemResult {
+  uint16_t status = 201;
+  std::string message;
+  std::string event_id;  // filled for 201
+};
+
+struct PreparedEvent {
+  std::string event, entity_type, entity_id;
+  bool has_target = false;
+  std::string target_type, target_id;
+  bool has_pr = false;
+  std::string pr_id;
+  std::string event_id;  // client-supplied or generated
+  std::vector<std::string> tags;
+  const std::vector<JObjEntry>* props = nullptr;  // borrowed from DOM
+  ParsedTime event_time;
+  ParsedTime creation_time;
+};
+
+struct ValidationError { std::string msg; };
+
+bool reserved_prefix(const std::string& s) {
+  return (!s.empty() && s[0] == '$') || s.rfind("pio_", 0) == 0;
+}
+bool special_event(const std::string& s) {
+  return s == "$set" || s == "$unset" || s == "$delete";
+}
+
+const JVal* obj_get(const JVal& o, const char* key) {
+  for (const auto& kv : o.obj)
+    if (kv.first == key) return &kv.second;
+  return nullptr;
+}
+
+std::string req_str(const JVal& o, const char* key) {
+  const JVal* v = obj_get(o, key);
+  if (v == nullptr || v->type != JVal::STR)
+    throw ValidationError{std::string("field ") + key +
+                          " is required and must be a string"};
+  return v->s;
+}
+
+// hex event id from getrandom, buffered
+std::string gen_event_id() {
+  static thread_local uint8_t pool[1024];
+  static thread_local size_t pos = sizeof(pool);
+  if (pos + 16 > sizeof(pool)) {
+    size_t got = 0;
+    while (got < sizeof(pool)) {
+      ssize_t r = getrandom(pool + got, sizeof(pool) - got, 0);
+      if (r < 0) throw Fallback{};
+      got += (size_t)r;
+    }
+    pos = 0;
+  }
+  static const char* hx = "0123456789abcdef";
+  std::string id(32, '0');
+  for (int i = 0; i < 16; i++) {
+    id[2 * i] = hx[pool[pos + i] >> 4];
+    id[2 * i + 1] = hx[pool[pos + i] & 0xf];
+  }
+  pos += 16;
+  return id;
+}
+
+int64_t now_us() {
+  struct timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  return (int64_t)ts.tv_sec * 1000000LL + ts.tv_nsec / 1000;
+}
+
+// from_json_dict + validate_event, exact rule and message order
+PreparedEvent prepare(const JVal& item, int64_t creation_us_override) {
+  if (item.type != JVal::OBJ)
+    throw ValidationError{"event JSON must be an object"};
+  PreparedEvent e;
+
+  // tags / properties TYPE checks come first (from_json_dict:253-260)
+  const JVal* tags = obj_get(item, "tags");
+  if (tags != nullptr && tags->type != JVal::ARR)
+    throw ValidationError{"tags must be a list of strings"};
+  const JVal* props = obj_get(item, "properties");
+  if (props != nullptr && props->type != JVal::NUL && props->type != JVal::OBJ)
+    throw ValidationError{"properties must be a JSON object"};
+  static const std::vector<JObjEntry> kEmptyObj;
+  e.props = (props && props->type == JVal::OBJ) ? &props->obj : &kEmptyObj;
+
+  e.event = req_str(item, "event");
+  e.entity_type = req_str(item, "entityType");
+  e.entity_id = req_str(item, "entityId");
+
+  // optional string-ish fields: Python's d.get() passes non-strings through
+  // and they explode later in encode — punt those to Python
+  auto opt_str = [&](const char* key, bool& has, std::string& dst) {
+    const JVal* v = obj_get(item, key);
+    if (v == nullptr || v->type == JVal::NUL) { has = false; return; }
+    if (v->type != JVal::STR) throw Fallback{};
+    has = true;
+    dst = v->s;
+  };
+  bool has_tid = false;
+  opt_str("targetEntityType", e.has_target, e.target_type);
+  opt_str("targetEntityId", has_tid, e.target_id);
+  bool has_eid = false;
+  opt_str("prId", e.has_pr, e.pr_id);
+  opt_str("eventId", has_eid, e.event_id);
+
+  if (tags != nullptr)
+    for (const auto& t : tags->arr) {
+      if (t.type != JVal::STR) throw Fallback{};  // Python str()-coerces
+      e.tags.push_back(t.s);
+    }
+
+  // eventTime (from_json_dict kwarg order: after the field checks)
+  const JVal* et = obj_get(item, "eventTime");
+  if (et == nullptr || et->type == JVal::NUL) {
+    e.event_time = {now_us(), 0};
+  } else if (et->type == JVal::STR) {
+    if (!parse_iso(et->s, e.event_time)) throw Fallback{};
+  } else if (et->type == JVal::INT) {
+    // fromtimestamp range: keep well inside year 1..9999
+    if (et->i < -62135596800LL || et->i > 253402300799LL) throw Fallback{};
+    e.event_time = {et->i * 1000000LL, 0};
+  } else {
+    throw Fallback{};  // float epoch (rounding parity) / other types
+  }
+  e.creation_time = {creation_us_override >= 0 ? creation_us_override : now_us(),
+                     0};
+
+  // validate_event (event.py:293-348), exact order + messages
+  auto req = [](bool cond, const std::string& msg) {
+    if (!cond) throw ValidationError{msg};
+  };
+  bool t_type_present = e.has_target;        // None vs "" distinction:
+  bool t_id_present = has_tid;               // absent(None) vs empty string
+  req(!e.event.empty(), "event must not be empty.");
+  req(!e.entity_type.empty(), "entityType must not be empty string.");
+  req(!e.entity_id.empty(), "entityId must not be empty string.");
+  req(!(t_type_present && e.target_type.empty()),
+      "targetEntityType must not be empty string");
+  req(!(t_id_present && e.target_id.empty()),
+      "targetEntityId must not be empty string.");
+  req(t_type_present == t_id_present,
+      "targetEntityType and targetEntityId must be specified together.");
+  req(!(e.event == "$unset" && e.props->empty()),
+      "properties cannot be empty for $unset event");
+  req(!reserved_prefix(e.event) || special_event(e.event),
+      e.event + " is not a supported reserved event name.");
+  req(!special_event(e.event) || !t_type_present,
+      "Reserved event " + e.event + " cannot have targetEntity");
+  req(!reserved_prefix(e.entity_type) || e.entity_type == "pio_pr",
+      "The entityType " + e.entity_type +
+          " is not allowed. 'pio_' is a reserved name prefix.");
+  req(!t_type_present || !reserved_prefix(e.target_type) ||
+          e.target_type == "pio_pr",
+      "The targetEntityType " + e.target_type +
+          " is not allowed. 'pio_' is a reserved name prefix.");
+  for (const auto& kv : *e.props)
+    req(!reserved_prefix(kv.first),
+        "The property " + kv.first +
+            " is not allowed. 'pio_' is a reserved name prefix.");
+  if (!has_eid) e.event_id = gen_event_id();
+  return e;
+}
+
+// ---------------------------------------------------------------------------
+// record encode (format.py encode_event parity)
+// ---------------------------------------------------------------------------
+
+struct Interner {
+  std::unordered_map<std::string, uint32_t> ids;
+  std::vector<std::string> new_strings;  // in assignment order
+
+  uint32_t intern(const std::string& s, Buf& out) {
+    auto it = ids.find(s);
+    if (it != ids.end()) return it->second;
+    uint32_t id = (uint32_t)ids.size();
+    ids.emplace(s, id);
+    new_strings.push_back(s);
+    if (s.size() > 0xFFFF) throw Fallback{};
+    Buf payload;
+    payload.u8(KIND_INTERN);
+    payload.u32(id);
+    payload.u16((uint16_t)s.size());
+    payload.raw(s.data(), s.size());
+    out.u32((uint32_t)payload.size());
+    out.raw(payload.d.data(), payload.size());
+    return id;
+  }
+};
+
+void check_str16(const std::string& s) {
+  if (s.size() >= ABSENT16) throw Fallback{};  // Python raises ValueError ->
+                                               // 500; keep its behavior
+}
+
+// returns the relative offset of the EVENT record within `out`
+uint64_t encode_event(const PreparedEvent& e, Interner& interner, Buf& out) {
+  uint32_t name_id = interner.intern(e.event, out);
+  uint32_t etype_id = interner.intern(e.entity_type, out);
+  uint32_t ttype_id = e.has_target ? interner.intern(e.target_type, out) : NONE_ID;
+  Buf body;
+  body.u8(KIND_EVENT);
+  check_str16(e.event_id);
+  body.str16(e.event_id);
+  body.i64(e.event_time.us);
+  body.i16(e.event_time.tz_min);
+  body.i64(e.creation_time.us);
+  body.i16(e.creation_time.tz_min);
+  body.u32(name_id);
+  body.u32(etype_id);
+  body.u32(ttype_id);
+  check_str16(e.entity_id);
+  body.str16(e.entity_id);
+  if (e.has_target) { check_str16(e.target_id); body.str16(e.target_id); }
+  else body.u16(ABSENT16);
+  if (e.has_pr) { check_str16(e.pr_id); body.str16(e.pr_id); }
+  else body.u16(ABSENT16);
+  body.u16((uint16_t)e.tags.size());
+  for (const auto& t : e.tags) { check_str16(t); body.str16(t); }
+  Buf props;
+  JVal pv;
+  pv.type = JVal::OBJ;
+  pv.obj = *e.props;  // copy is fine: objects are small
+  encode_tlv(pv, props);
+  body.u32((uint32_t)props.size());
+  body.raw(props.d.data(), props.size());
+  uint64_t rel = out.size();
+  out.u32((uint32_t)body.size());
+  out.raw(body.d.data(), body.size());
+  return rel;
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// entry point
+// ---------------------------------------------------------------------------
+//
+// pl_ingest(body, body_len, single, max_items,
+//           whitelist, n_whitelist, interned, n_interned,
+//           creation_us_override, out_buf) -> out_len | -1 err | -2 fallback
+//
+// out layout (little-endian):
+//   u32 n_results
+//   per result: u16 status; str16 message; str16 event_id ("" unless 201)
+//   u32 n_new_strings; str16* (interner additions, id order from n_interned)
+//   u32 n_accepted;   u64* (EVENT record offset within blob, result order)
+//   u64 blob_len; blob (INTERN + EVENT records ready to append)
+//
+// The caller MUST hold the target log's write lock across snapshotting
+// `interned`, this call, and the append — interner ids are assigned here.
+
+extern "C" int64_t pl_ingest(const uint8_t* body, int64_t body_len,
+                             int32_t single, int32_t max_items,
+                             const char** whitelist, int32_t n_whitelist,
+                             const char** interned, int32_t n_interned,
+                             int64_t creation_us_override,
+                             uint8_t** out_buf) {
+  try {
+    Parser parser{body, body + body_len};
+    JVal root = parser.parse_value();
+    parser.ws();
+    if (parser.p != parser.end) throw Fallback{};  // trailing garbage
+
+    std::vector<const JVal*> items;
+    if (single) {
+      items.push_back(&root);
+    } else {
+      if (root.type != JVal::ARR) throw Fallback{};  // Python's message
+      if (max_items >= 0 && (int64_t)root.arr.size() > max_items)
+        throw Fallback{};  // batch-too-large: Python's message
+      for (const auto& it : root.arr) items.push_back(&it);
+    }
+
+    std::unordered_set<std::string> wl;
+    for (int32_t i = 0; i < n_whitelist; i++) wl.insert(whitelist[i]);
+
+    Interner interner;
+    for (int32_t i = 0; i < n_interned; i++)
+      interner.ids.emplace(interned[i], (uint32_t)i);
+
+    std::vector<ItemResult> results;
+    std::vector<uint64_t> offsets;
+    Buf blob;
+    for (const JVal* item : items) {
+      ItemResult r;
+      try {
+        PreparedEvent e = prepare(*item, creation_us_override);
+        if (!wl.empty() && wl.find(e.event) == wl.end()) {
+          r.status = 403;  // per-item 403 (EventServer.scala:430-433)
+          r.message = e.event + " events are not allowed";
+        } else {
+          offsets.push_back(encode_event(e, interner, blob));
+          r.event_id = e.event_id;
+        }
+      } catch (const ValidationError& ve) {
+        r.status = 400;
+        r.message = ve.msg;
+      }
+      results.push_back(std::move(r));
+    }
+
+    Buf out;
+    out.u32((uint32_t)results.size());
+    for (const auto& r : results) {
+      out.u16(r.status);
+      if (r.message.size() >= ABSENT16) throw Fallback{};
+      out.str16(r.message);
+      out.str16(r.event_id);
+    }
+    out.u32((uint32_t)interner.new_strings.size());
+    for (const auto& s : interner.new_strings) out.str16(s);
+    out.u32((uint32_t)offsets.size());
+    for (uint64_t o : offsets) out.u64(o);
+    out.u64((uint64_t)blob.size());
+    out.raw(blob.d.data(), blob.size());
+
+    uint8_t* mem = (uint8_t*)malloc(out.size());
+    if (mem == nullptr) return -1;
+    memcpy(mem, out.d.data(), out.size());
+    *out_buf = mem;
+    return (int64_t)out.size();
+  } catch (const Fallback&) {
+    return -2;
+  } catch (...) {
+    return -1;
+  }
+}
