@@ -245,6 +245,12 @@ def ingest(
     lib = get_lib()
     if lib is None:
         return None
+    # char* marshalling truncates at NUL — a whitelist entry or interned
+    # string containing U+0000 (legal via a backslash-u escape) would cross the
+    # boundary wrong and corrupt intern-id assignment. Rare by construction;
+    # the Python path handles it.
+    if any("\x00" in s for s in whitelist) or any("\x00" in s for s in interned):
+        return INGEST_FALLBACK
     wl = (ctypes.c_char_p * max(1, len(whitelist)))(
         *[w.encode() for w in whitelist] or [b""])
     it = (ctypes.c_char_p * max(1, len(interned)))(

@@ -251,6 +251,8 @@ struct Parser {
       }
     }
     if (p >= end || *p < '0' || *p > '9') fail();
+    // JSON forbids leading zeros ("01"): Python rejects the whole body
+    if (*p == '0' && p + 1 < end && p[1] >= '0' && p[1] <= '9') fail();
     bool is_float = false;
     while (p < end && *p >= '0' && *p <= '9') p++;
     if (p < end && *p == '.') {
@@ -601,7 +603,9 @@ PreparedEvent prepare(const JVal& item, int64_t creation_us_override) {
     req(!reserved_prefix(kv.first),
         "The property " + kv.first +
             " is not allowed. 'pio_' is a reserved name prefix.");
-  if (!has_eid) e.event_id = gen_event_id();
+  // empty client eventId counts as absent: insert_batch's
+  // ``event.event_id or urandom`` regenerates it on the Python path too
+  if (!has_eid || e.event_id.empty()) e.event_id = gen_event_id();
   return e;
 }
 
@@ -658,6 +662,7 @@ uint64_t encode_event(const PreparedEvent& e, Interner& interner, Buf& out) {
   else body.u16(ABSENT16);
   if (e.has_pr) { check_str16(e.pr_id); body.str16(e.pr_id); }
   else body.u16(ABSENT16);
+  if (e.tags.size() > 0xFFFF) throw Fallback{};  // Python: struct.error -> 500
   body.u16((uint16_t)e.tags.size());
   for (const auto& t : e.tags) { check_str16(t); body.str16(t); }
   Buf props;
@@ -700,6 +705,32 @@ extern "C" int64_t pl_ingest(const uint8_t* body, int64_t body_len,
                              int64_t creation_us_override,
                              uint8_t** out_buf) {
   try {
+    // Whole-body UTF-8 validation first: Python's json.loads(bytes) decodes
+    // before parsing, and invalid UTF-8 surfaces as ITS error (a 500 today)
+    // — invalid bytes must never be accepted here and written durably.
+    {
+      const uint8_t* q = body;
+      const uint8_t* qe = body + body_len;
+      while (q < qe) {
+        uint8_t c = *q;
+        int n;
+        uint32_t min_cp;
+        if (c < 0x80) { q++; continue; }
+        else if ((c & 0xE0) == 0xC0) { n = 1; min_cp = 0x80; }
+        else if ((c & 0xF0) == 0xE0) { n = 2; min_cp = 0x800; }
+        else if ((c & 0xF8) == 0xF0) { n = 3; min_cp = 0x10000; }
+        else throw Fallback{};
+        if (qe - q < n + 1) throw Fallback{};
+        uint32_t cp = c & (0x3F >> n);
+        for (int i = 1; i <= n; i++) {
+          if ((q[i] & 0xC0) != 0x80) throw Fallback{};
+          cp = (cp << 6) | (q[i] & 0x3F);
+        }
+        if (cp < min_cp || cp > 0x10FFFF || (cp >= 0xD800 && cp <= 0xDFFF))
+          throw Fallback{};
+        q += n + 1;
+      }
+    }
     Parser parser{body, body + body_len};
     JVal root = parser.parse_value();
     parser.ws();

@@ -279,9 +279,11 @@ class EventServer:
         fn = getattr(store, "ingest_raw", None)
         if fn is None:
             return None
-        self._ensure_init(auth)
 
         def op():
+            # _ensure_init inside the hop: the first touch of a large log
+            # parses the whole file — that must not block the accept loop
+            self._ensure_init(auth)
             return self._insert_healing(
                 lambda: fn(raw, single, max_items, auth.events,
                            auth.app_id, auth.channel_id),
