@@ -41,6 +41,7 @@ import dataclasses
 import datetime as _dt
 import json
 import logging
+import http.client
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -142,7 +143,7 @@ class _Transport:
             detail = e.read()[:2048].decode(errors="replace")
             raise StorageError(
                 f"elasticsearch {method} {path}: {e.code} {detail}") from e
-        except (urllib.error.URLError, OSError) as e:
+        except (urllib.error.URLError, OSError, http.client.HTTPException) as e:
             raise StorageError(f"elasticsearch unreachable: {e}") from e
 
     def ensure(self, index: str, mapping: dict) -> None:

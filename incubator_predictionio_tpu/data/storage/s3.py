@@ -27,6 +27,7 @@ import hashlib
 import hmac
 import logging
 import os
+import http.client
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -144,7 +145,7 @@ class S3Models(ModelsStore):
     def insert(self, model: Model) -> None:
         try:
             self._request("PUT", model.id, model.models).read()
-        except (urllib.error.URLError, OSError) as e:
+        except (urllib.error.URLError, OSError, http.client.HTTPException) as e:
             raise StorageError(f"s3 insert failed: {e}") from e
 
     @staticmethod
@@ -171,7 +172,7 @@ class S3Models(ModelsStore):
             if self._missing(e):
                 return None
             raise StorageError(f"s3 get failed: {e}") from e
-        except (urllib.error.URLError, OSError) as e:
+        except (urllib.error.URLError, OSError, http.client.HTTPException) as e:
             raise StorageError(f"s3 unreachable: {e}") from e
 
     def delete(self, model_id: str) -> bool:
@@ -181,14 +182,14 @@ class S3Models(ModelsStore):
             if self._missing(e):
                 return False
             raise StorageError(f"s3 delete failed: {e}") from e
-        except (urllib.error.URLError, OSError) as e:
+        except (urllib.error.URLError, OSError, http.client.HTTPException) as e:
             raise StorageError(f"s3 unreachable: {e}") from e
         try:
             self._request("DELETE", model_id).read()
             return True
         except urllib.error.HTTPError as e:
             raise StorageError(f"s3 delete failed: {e}") from e
-        except (urllib.error.URLError, OSError) as e:
+        except (urllib.error.URLError, OSError, http.client.HTTPException) as e:
             raise StorageError(f"s3 unreachable: {e}") from e
 
 

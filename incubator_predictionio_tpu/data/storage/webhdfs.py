@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import json
 import logging
+import http.client
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -74,7 +75,7 @@ class WebHDFSModels(ModelsStore):
             req = urllib.request.Request(loc, data=model.models, method="PUT")
             req.add_header("Content-Type", "application/octet-stream")
             urllib.request.urlopen(req, timeout=self._timeout).read()
-        except (urllib.error.URLError, OSError) as e:
+        except (urllib.error.URLError, OSError, http.client.HTTPException) as e:
             raise StorageError(f"webhdfs insert failed: {e}") from e
 
     def get(self, model_id: str) -> Optional[Model]:
@@ -86,7 +87,7 @@ class WebHDFSModels(ModelsStore):
             if e.code == 404:
                 return None
             raise StorageError(f"webhdfs get failed: {e}") from e
-        except (urllib.error.URLError, OSError) as e:
+        except (urllib.error.URLError, OSError, http.client.HTTPException) as e:
             raise StorageError(f"webhdfs unreachable: {e}") from e
 
     def delete(self, model_id: str) -> bool:
@@ -101,7 +102,7 @@ class WebHDFSModels(ModelsStore):
             if e.code == 404:
                 return False
             raise StorageError(f"webhdfs delete failed: {e}") from e
-        except (urllib.error.URLError, OSError) as e:
+        except (urllib.error.URLError, OSError, http.client.HTTPException) as e:
             raise StorageError(f"webhdfs unreachable: {e}") from e
 
 

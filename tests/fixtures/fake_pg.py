@@ -151,7 +151,7 @@ class FakePG:
                       for p in client_first_bare.split(","))["r"]
         salt, iterations, stored_key, server_key = _scram_server_messages(
             self.password)
-        snonce = cnonce + base64.b64encode(secrets.token_bytes(12)).decode()
+        snonce = self._make_snonce(cnonce)
         server_first = (f"r={snonce},s={base64.b64encode(salt).decode()},"
                         f"i={iterations}")
         conn.sendall(self._auth(11, server_first.encode()))
@@ -178,9 +178,18 @@ class FakePG:
         server_sig = hmac.new(server_key, auth_message,
                               hashlib.sha256).digest()
         conn.sendall(self._auth(
-            12, b"v=" + base64.b64encode(server_sig)))
+            12, b"v=" + base64.b64encode(self._server_sig_bytes(server_sig))))
         conn.sendall(self._auth(0))
         return True
+
+    # hostile-mode hooks (overridden by the adversarial suite)
+    @staticmethod
+    def _make_snonce(cnonce: str) -> str:
+        return cnonce + base64.b64encode(secrets.token_bytes(12)).decode()
+
+    @staticmethod
+    def _server_sig_bytes(sig: bytes) -> bytes:
+        return sig
 
     # -- extended query protocol ---------------------------------------
     def _extended_loop(self, conn):

@@ -1,0 +1,458 @@
+"""Adversarial protocol conformance for the hand-written wire clients.
+
+VERDICT r4 next #3: within a network-less environment the strongest proof
+for the stdlib wire clients (PG v3, ES REST, S3 SigV4, WebHDFS) is hostile —
+fakes that inject the protocol's legal-but-awkward messages, fail
+mid-stream, or strictly validate every byte the client sends, rather than
+cooperating. Reference counterpart: the live Docker matrix
+(/root/reference/tests/README.md:30-60), which these failure paths stand in
+for until the live tier can run.
+
+Covered failure matrix:
+
+- PG: NoticeResponse/ParameterStatus mid-exchange; ErrorResponse during a
+  portal with clean resumption on the SAME connection; SCRAM server-
+  signature mismatch and non-extending server nonce must abort the
+  handshake; truncated stream mid-DataRow poisons the connection but the
+  next call reconnects; strict byte-level validation of the client's
+  Parse/Bind/Describe/Execute/Sync train (text-format results declared).
+- ES: strict unknown-field rejection over the whole search DSL the backend
+  emits; 429/503 (retry-after) surfaced as StorageError, never swallowed;
+  truncated body (Content-Length lies) surfaced as StorageError.
+- WebHDFS: CREATE redirect loop is bounded; OPEN redirect loop is bounded.
+- S3: signature-mismatch 403 surfaces as StorageError (distinct from the
+  404 → None path).
+"""
+
+from __future__ import annotations
+
+import base64
+import socket
+import struct
+import threading
+
+import pytest
+from aiohttp import web
+
+from incubator_predictionio_tpu.data.storage import Storage, StorageError
+from incubator_predictionio_tpu.data.storage.base import Model
+from incubator_predictionio_tpu.data.storage.postgres import _PGConn
+from tests.fixtures.fake_pg import FakePG
+from tests.fixtures.servers import ThreadedApp
+
+
+# ---------------------------------------------------------------------------
+# PostgreSQL
+# ---------------------------------------------------------------------------
+
+class HostilePG(FakePG):
+    """FakePG with protocol-legal hostility knobs."""
+
+    def __init__(self, password=None, *, noise=False, error_on=None,
+                 truncate_on=None, wrong_server_sig=False,
+                 fresh_nonce=False, strict=False):
+        self.noise = noise
+        self.error_on = error_on
+        self.truncate_on = truncate_on
+        self.wrong_server_sig = wrong_server_sig
+        self.fresh_nonce = fresh_nonce
+        self.strict = strict
+        self.violations: list[str] = []
+        super().__init__(password)
+
+    # legal async messages the client must absorb anywhere in the stream
+    _NOTICE = FakePG._msg(
+        b"N", b"SNOTICE\x00C01000\x00Mjust so you know\x00\x00")
+    _PARAM_STATUS = FakePG._msg(
+        b"S", b"application_name\x00hostile\x00")
+
+    def _make_snonce(self, cnonce: str) -> str:
+        if self.fresh_nonce:  # does NOT extend the client nonce → MITM shape
+            import secrets
+            return base64.b64encode(secrets.token_bytes(18)).decode()
+        return super()._make_snonce(cnonce)
+
+    def _server_sig_bytes(self, sig: bytes) -> bytes:
+        if self.wrong_server_sig:  # server that doesn't know the password
+            return bytes(b ^ 0xFF for b in sig)
+        return sig
+
+    def _execute(self, conn, sql, params):
+        if self.noise:
+            conn.sendall(self._NOTICE + self._PARAM_STATUS)
+        if self.error_on and self.error_on in sql:
+            conn.sendall(self._error("57014", "canceled by hostile fake"))
+            return
+        if self.truncate_on and self.truncate_on in sql:
+            # half a DataRow: header promises 32 bytes, 4 arrive, then FIN
+            conn.sendall(b"D" + struct.pack("!I", 32) + b"\x00\x01oops")
+            conn.close()
+            return
+        super()._execute(conn, sql, params)
+        if self.noise:  # again between CommandComplete and ReadyForQuery
+            conn.sendall(self._NOTICE + self._PARAM_STATUS)
+
+    # -- strict client-byte validation ----------------------------------
+    def _extended_loop(self, conn):
+        if not self.strict:
+            return super()._extended_loop(conn)
+        sql = ""
+        params: list = []
+        expect = "P"  # P → B → D → E → S, in order, every train
+        while True:
+            t, body = self._recv_typed(conn)
+            tc = t.decode()
+            if tc == "X":
+                return
+            if tc != expect:
+                self.violations.append(f"got {tc!r} while expecting {expect!r}")
+            if tc == "P":
+                stmt, rest = body.split(b"\x00", 1)
+                if stmt != b"":
+                    self.violations.append("named prepared statement used")
+                sql = rest.split(b"\x00", 1)[0].decode()
+                nparam_types = struct.unpack("!H", rest.split(b"\x00", 1)[1][:2])[0]
+                if nparam_types != 0:
+                    self.violations.append("client pins parameter OIDs")
+                conn.sendall(self._msg(b"1", b""))
+                expect = "B"
+            elif tc == "B":
+                off = body.index(b"\x00") + 1
+                stmt_end = body.index(b"\x00", off)
+                if body[:off - 1] != b"" or body[off:stmt_end] != b"":
+                    self.violations.append("named portal/statement in Bind")
+                off = stmt_end + 1
+                nfmt = struct.unpack("!H", body[off:off + 2])[0]
+                if nfmt != 0:
+                    self.violations.append("param format codes not default-text")
+                off += 2 + 2 * nfmt
+                nparams = struct.unpack("!H", body[off:off + 2])[0]
+                off += 2
+                params = []
+                for _ in range(nparams):
+                    ln = struct.unpack("!i", body[off:off + 4])[0]
+                    off += 4
+                    if ln == -1:
+                        params.append(None)
+                    else:
+                        params.append(body[off:off + ln].decode())
+                        off += ln
+                nres = struct.unpack("!H", body[off:off + 2])[0]
+                if nres != 0:
+                    self.violations.append(
+                        "result format codes not default-text")
+                off += 2
+                if off != len(body):
+                    self.violations.append("trailing bytes in Bind")
+                conn.sendall(self._msg(b"2", b""))
+                expect = "D"
+            elif tc == "D":
+                if body != b"P\x00":
+                    self.violations.append(f"Describe not unnamed portal: {body!r}")
+                conn.sendall(self._msg(b"n", b""))
+                expect = "E"
+            elif tc == "E":
+                portal, maxrows = body.split(b"\x00", 1)
+                if portal != b"" or struct.unpack("!I", maxrows)[0] != 0:
+                    self.violations.append("Execute with portal/row-limit")
+                self._execute(conn, sql, params)
+                expect = "S"
+            elif tc == "S":
+                conn.sendall(self._READY)
+                expect = "P"
+
+
+def _conn(fake: HostilePG, password=None) -> _PGConn:
+    return _PGConn("127.0.0.1", fake.port, "pio", user="pio",
+                   password=password, sslmode="disable", timeout=5.0,
+                   read_timeout=5.0)
+
+
+def test_pg_notices_and_parameter_status_mid_stream():
+    fake = HostilePG(noise=True)
+    try:
+        c = _conn(fake)
+        c.query("CREATE TABLE t (id BIGINT, v TEXT)")
+        c.query("INSERT INTO t VALUES ($1, $2)", [1, "a"])
+        rows, n = c.query("SELECT id, v FROM t")
+        assert rows == [("1", "a")]
+        c.close()
+    finally:
+        fake.close()
+
+
+def test_pg_error_during_portal_resumes_same_connection():
+    fake = HostilePG(error_on="poison_me")
+    try:
+        c = _conn(fake)
+        c.query("CREATE TABLE t (id BIGINT)")
+        with pytest.raises(StorageError, match="canceled by hostile fake"):
+            c.query("SELECT poison_me FROM t")
+        # the stream ended clean at ReadyForQuery: SAME connection serves on
+        c.query("INSERT INTO t VALUES ($1)", [7])
+        rows, _ = c.query("SELECT id FROM t")
+        assert rows == [("7",)]
+        c.close()
+    finally:
+        fake.close()
+
+
+def test_pg_scram_server_signature_mismatch_aborts():
+    fake = HostilePG(password="sekret", wrong_server_sig=True)
+    try:
+        with pytest.raises(StorageError, match="server signature mismatch"):
+            _conn(fake, password="sekret")
+    finally:
+        fake.close()
+
+
+def test_pg_scram_non_extending_nonce_aborts():
+    fake = HostilePG(password="sekret", fresh_nonce=True)
+    try:
+        with pytest.raises(StorageError,
+                           match="does not extend client nonce"):
+            _conn(fake, password="sekret")
+    finally:
+        fake.close()
+
+
+def test_pg_truncated_mid_datarow_poisons_then_reconnects():
+    fake = HostilePG(truncate_on="truncate_me")
+    try:
+        c = _conn(fake)
+        c.query("CREATE TABLE t (truncate_col BIGINT)")
+        with pytest.raises(StorageError, match="mid-query"):
+            c.query("SELECT truncate_me FROM t")
+        assert c._sock is None  # poisoned, not reused
+        # lazy reconnect on next use (a NEW connection to the fake)
+        rows, _ = c.query("SELECT truncate_col FROM t")
+        assert rows == []
+        c.close()
+    finally:
+        fake.close()
+
+
+def test_pg_strict_client_conformance():
+    """The full backend scenario under a fake that validates every client
+    message field against the protocol spec: unnamed statements/portals,
+    default-text param AND result formats, no row limit, P→B→D→E→S order."""
+    from tests.wire_scenarios import pg_scenario
+
+    fake = HostilePG(strict=True)
+    try:
+        from incubator_predictionio_tpu.data.storage.postgres import (
+            PostgresStorageClient,
+        )
+
+        client = PostgresStorageClient(
+            {"HOST": "127.0.0.1", "PORT": str(fake.port), "DBNAME": "pio",
+             "USERNAME": "pio", "SSLMODE": "disable"})
+        pg_scenario(client)
+        client.close()
+        assert fake.violations == []
+    finally:
+        fake.close()
+
+
+# ---------------------------------------------------------------------------
+# Elasticsearch
+# ---------------------------------------------------------------------------
+
+# every key the documented DSL subset may contain; anything else is a client
+# regression (real ES with strict mappings/parsers rejects unknown fields)
+_ES_ALLOWED_SEARCH_KEYS = {
+    "query", "bool", "filter", "must_not", "term", "terms", "range",
+    "exists", "sort", "search_after", "size", "_source", "order",
+    "gte", "lte", "gt", "lt", "field", "track_total_hits",
+}
+
+
+def _unknown_keys(node, path="") -> list[str]:
+    out = []
+    if isinstance(node, dict):
+        for k, v in node.items():
+            # field-name positions (inside term/terms/range/exists/sort) are
+            # data, not DSL keywords
+            last = path.rsplit(".", 1)[-1]
+            if last not in ("term", "terms", "range", "sort", "exists"):
+                if k not in _ES_ALLOWED_SEARCH_KEYS:
+                    out.append(f"{path}.{k}" if path else k)
+            out.extend(_unknown_keys(v, f"{path}.{k}" if path else k))
+    elif isinstance(node, list):
+        for v in node:
+            out.extend(_unknown_keys(v, path))
+    return out
+
+
+def test_es_strict_unknown_field_rejection():
+    """Run the backend's full search surface against a fake that 400s any
+    DSL key outside the documented subset — the stand-in for real ES strict
+    parsing."""
+    import json as _json
+
+    from tests.fixtures.fake_es import make_es_app
+
+    app = make_es_app()
+    seen_violations: list[str] = []
+
+    @web.middleware
+    async def strict(request, handler):
+        if request.path.endswith("/_search") and request.can_read_body:
+            body = await request.json()
+            bad = _unknown_keys(body)
+            if bad:
+                seen_violations.extend(bad)
+                return web.json_response(
+                    {"error": {"type": "parsing_exception",
+                               "reason": f"unknown fields {bad}"}},
+                    status=400)
+        return await handler(request)
+
+    app.middlewares.append(strict)
+    server = ThreadedApp(app)
+    try:
+        from incubator_predictionio_tpu.data.storage.elasticsearch import (
+            ESStorageClient,
+        )
+        from tests.wire_scenarios import es_scenario
+
+        client = ESStorageClient({"URL": f"http://127.0.0.1:{server.port}"})
+        summary = es_scenario(client)
+        assert summary["found_rate"] == ["u1", "u2"]
+        assert seen_violations == []
+    finally:
+        server.close()
+
+
+def test_es_429_and_503_surface():
+    calls = {"n": 0}
+
+    async def throttle(request):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            return web.json_response(
+                {"error": {"type": "circuit_breaking_exception"}},
+                status=429, headers={"Retry-After": "1"})
+        return web.json_response(
+            {"error": {"type": "unavailable"}}, status=503)
+
+    app = web.Application()
+    app.router.add_route("*", "/{tail:.*}", throttle)
+    server = ThreadedApp(app)
+    try:
+        from incubator_predictionio_tpu.data.storage.elasticsearch import _Transport
+
+        es = _Transport(f"http://127.0.0.1:{server.port}", timeout=5.0)
+        with pytest.raises(StorageError, match="429"):
+            es.call("GET", "/idx/_doc/1")
+        with pytest.raises(StorageError, match="503"):
+            es.call("GET", "/idx/_doc/1")
+    finally:
+        server.close()
+
+
+def test_es_truncated_body_surfaces_storage_error():
+    """Content-Length promises more bytes than arrive → the http stack
+    raises IncompleteRead (an HTTPException, NOT an OSError); the client
+    must wrap it, not leak it."""
+
+    def serve():
+        conn, _ = srv.accept()
+        conn.recv(65536)
+        conn.sendall(b"HTTP/1.1 200 OK\r\nContent-Type: application/json\r\n"
+                     b"Content-Length: 1000\r\n\r\n{\"partial\":")
+        conn.close()
+
+    srv = socket.create_server(("127.0.0.1", 0))
+    port = srv.getsockname()[1]
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    try:
+        from incubator_predictionio_tpu.data.storage.elasticsearch import _Transport
+
+        es = _Transport(f"http://127.0.0.1:{port}", timeout=5.0)
+        with pytest.raises(StorageError, match="unreachable|elasticsearch"):
+            es.call("GET", "/idx/_doc/1")
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# WebHDFS / S3
+# ---------------------------------------------------------------------------
+
+def test_webhdfs_redirect_loop_bounded():
+    """A namenode that 307s CREATE to a datanode that 307s again (loop
+    shape), and an OPEN that redirects to itself forever: both must surface
+    a StorageError, never hang or recurse unbounded."""
+
+    app = web.Application()
+
+    async def namenode(request):
+        op = request.query.get("op", "")
+        port = request.transport.get_extra_info("sockname")[1]
+        if op == "CREATE":
+            raise web.HTTPTemporaryRedirect(f"http://127.0.0.1:{port}/loop")
+        if op == "OPEN":  # self-redirect forever
+            raise web.HTTPTemporaryRedirect(
+                f"http://127.0.0.1:{port}{request.path_qs}")
+        raise web.HTTPBadRequest()
+
+    async def loop_write(request):
+        raise web.HTTPTemporaryRedirect("/loop")  # never accepts the blob
+
+    app.router.add_route("*", "/webhdfs/v1/pio/models/{name}", namenode)
+    app.router.add_put("/loop", loop_write)
+    server = ThreadedApp(app)
+    try:
+        s = Storage({
+            "PIO_STORAGE_SOURCES_H_TYPE": "webhdfs",
+            "PIO_STORAGE_SOURCES_H_URL": f"http://127.0.0.1:{server.port}",
+            "PIO_STORAGE_SOURCES_H_PATH": "/pio/models",
+        })
+        models = s.get_model_data_models()
+        with pytest.raises(StorageError, match="insert failed"):
+            models.insert(Model(id="m1", models=b"blob"))
+        with pytest.raises(StorageError):
+            models.get("m1")
+        s.close()
+    finally:
+        server.close()
+
+
+def test_s3_signature_mismatch_403_surfaces(caplog):
+    """A 403 (signature mismatch / clock skew / revoked key) must raise —
+    distinct from 404 → None — so operators see auth failures instead of
+    'model missing'."""
+
+    app = web.Application()
+
+    async def deny(request):
+        raise web.HTTPForbidden(
+            text="<Error><Code>SignatureDoesNotMatch</Code></Error>")
+
+    app.router.add_route("*", "/{tail:.*}", deny)
+    server = ThreadedApp(app)
+    try:
+        s = Storage({
+            "PIO_STORAGE_SOURCES_S3_TYPE": "s3",
+            "PIO_STORAGE_SOURCES_S3_ENDPOINT": f"http://127.0.0.1:{server.port}",
+            "PIO_STORAGE_SOURCES_S3_BUCKET_NAME": "pio-bucket",
+            "PIO_STORAGE_SOURCES_S3_ACCESS_KEY": "ak",
+            "PIO_STORAGE_SOURCES_S3_SECRET_KEY": "sk",
+            "PIO_STORAGE_SOURCES_S3_REGION": "us-east-1",
+        })
+        models = s.get_model_data_models()
+        # GET 403 → None BY DESIGN (object-only IAM policies answer 403 for
+        # absent keys), but it must warn loudly so all-403 ≠ silent "missing"
+        import logging
+
+        with caplog.at_level(logging.WARNING):
+            assert models.get("m1") is None
+        assert any("403" in r.message for r in caplog.records)
+        # writes have no such ambiguity: a 403 PUT must raise
+        with pytest.raises(StorageError, match="403|insert failed"):
+            models.insert(Model(id="m1", models=b"blob"))
+        s.close()
+    finally:
+        server.close()
