@@ -127,12 +127,19 @@ class ReplayServer:
     recording (per the transcript's matching mode) and answers with the
     recorded server bytes."""
 
-    def __init__(self, transcript: dict, mode: str = "exact"):
+    def __init__(self, transcript: dict, mode: str = "exact",
+                 rewrite: "tuple[bytes, bytes] | None" = None):
         self.connections = [
             [(tag, bytes.fromhex(h)) for tag, h in conn]
             for conn in transcript["connections"]
         ]
         self.mode = mode
+        # (old, new) substitution on SERVER bytes — for recorded absolute
+        # URLs (WebHDFS 307 Location) that must point at the replay server's
+        # port instead of the capture-time proxy's. Headers only: port-digit
+        # length may change, which never affects Content-Length (body bytes
+        # carry no URLs in these protocols).
+        self.rewrite = rewrite
         self.errors: list[str] = []
         self._lsock = socket.socket()
         self._lsock.bind(("127.0.0.1", 0))
@@ -194,6 +201,14 @@ class ReplayServer:
         want = _parse_http_requests(
             b"".join(d for t, d in entries if t == "C"))
         responses = b"".join(d for t, d in entries if t == "S")
+        if self.rewrite is not None:
+            old, new = self.rewrite
+            responses = responses.replace(old, new)
+            # the client re-requests the rewritten URL, so its recorded
+            # request paths/hosts need the same substitution to compare equal
+            want = [
+                (m, p.replace(old, new), b) for m, p, b in want
+            ]
         got = b""
         conn.settimeout(5.0)
         try:

@@ -75,6 +75,56 @@ def test_elasticsearch_wire_replay():
     assert results == tr["meta"]["expected_results"]
 
 
+def test_s3_wire_replay():
+    from incubator_predictionio_tpu.data.storage import Storage
+    from tests.wire_scenarios import s3_scenario
+
+    tr = _load("s3_scenario.json")
+    assert tr["meta"]["mode"] == "http"
+    server = ReplayServer(tr, mode="http")
+    try:
+        s = Storage({
+            "PIO_STORAGE_SOURCES_S3_TYPE": "s3",
+            "PIO_STORAGE_SOURCES_S3_ENDPOINT": f"http://127.0.0.1:{server.port}",
+            "PIO_STORAGE_SOURCES_S3_BUCKET_NAME": tr["meta"]["bucket"],
+            "PIO_STORAGE_SOURCES_S3_ACCESS_KEY": "test-access",
+            "PIO_STORAGE_SOURCES_S3_SECRET_KEY": "test-secret",
+            "PIO_STORAGE_SOURCES_S3_REGION": "us-east-1",
+        })
+        results = s3_scenario(s.get_model_data_models())
+        s.close()
+    finally:
+        server.close()
+    assert server.errors == [], server.errors
+    assert results == tr["meta"]["expected_results"]
+
+
+def test_webhdfs_wire_replay():
+    from incubator_predictionio_tpu.data.storage import Storage
+    from tests.wire_scenarios import webhdfs_scenario
+
+    tr = _load("webhdfs_scenario.json")
+    assert tr["meta"]["mode"] == "http"
+    # the recorded 307 Location carries the capture-time proxy port; rewrite
+    # it to the replay server's so the datanode write lands here too
+    old = f"127.0.0.1:{tr['meta']['capture_port']}".encode()
+    server = ReplayServer(tr, mode="http")
+    # the port is only known after bind; nothing connects before this line
+    server.rewrite = (old, f"127.0.0.1:{server.port}".encode())
+    try:
+        s = Storage({
+            "PIO_STORAGE_SOURCES_H_TYPE": "webhdfs",
+            "PIO_STORAGE_SOURCES_H_URL": f"http://127.0.0.1:{server.port}",
+            "PIO_STORAGE_SOURCES_H_PATH": "/pio/models",
+        })
+        results = webhdfs_scenario(s.get_model_data_models())
+        s.close()
+    finally:
+        server.close()
+    assert server.errors == [], server.errors
+    assert results == tr["meta"]["expected_results"]
+
+
 def test_replay_detects_divergence():
     """The replay harness itself must FAIL when the client's bytes change —
     otherwise the two tests above prove nothing."""

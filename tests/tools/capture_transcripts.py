@@ -28,6 +28,8 @@ from tests.fixtures.wire_capture import CaptureProxy  # noqa: E402
 from tests.wire_scenarios import (  # noqa: E402
     es_scenario,
     pg_scenario,
+    s3_scenario,
+    webhdfs_scenario,
 )
 
 OUT = os.path.join(REPO, "tests", "transcripts")
@@ -122,7 +124,131 @@ def capture_es() -> None:
     print(f"wrote {path} ({against})")
 
 
+def capture_s3() -> None:
+    """S3: signed headers (x-amz-date, Authorization) vary per capture, but
+    http-mode replay compares method+path+body only, so a fixed-content
+    scenario replays cleanly. PIO_TEST_S3_URL (+ PIO_TEST_S3_ACCESS_KEY /
+    _SECRET_KEY / _BUCKET / _REGION) upgrades to a real endpoint."""
+    s3_url = os.environ.get("PIO_TEST_S3_URL")
+    access = os.environ.get("PIO_TEST_S3_ACCESS_KEY", "test-access")
+    secret = os.environ.get("PIO_TEST_S3_SECRET_KEY", "test-secret")
+    bucket = os.environ.get("PIO_TEST_S3_BUCKET", "pio-bucket")
+    region = os.environ.get("PIO_TEST_S3_REGION", "us-east-1")
+    if s3_url:
+        u = urllib.parse.urlsplit(s3_url)
+        host, port = u.hostname, u.port or (443 if u.scheme == "https" else 80)
+        against = f"real S3 endpoint at {host}:{port}"
+        server = None
+    else:
+        from tests.fixtures.servers import ThreadedApp
+        from tests.test_remote_models import make_s3_app
+
+        server = ThreadedApp(make_s3_app({}, access, secret, region))
+        host, port = "127.0.0.1", server.port
+        against = "in-process protocol fake (tests/test_remote_models.py)"
+    proxy = CaptureProxy(host, port)
+    from incubator_predictionio_tpu.data.storage import Storage
+
+    s = Storage({
+        "PIO_STORAGE_SOURCES_S3_TYPE": "s3",
+        "PIO_STORAGE_SOURCES_S3_ENDPOINT": f"http://127.0.0.1:{proxy.port}",
+        "PIO_STORAGE_SOURCES_S3_BUCKET_NAME": bucket,
+        "PIO_STORAGE_SOURCES_S3_ACCESS_KEY": access,
+        "PIO_STORAGE_SOURCES_S3_SECRET_KEY": secret,
+        "PIO_STORAGE_SOURCES_S3_REGION": region,
+    })
+    results = s3_scenario(s.get_model_data_models())
+    s.close()
+    proxy.close()
+    if server is not None:
+        server.close()
+    path = os.path.join(OUT, "s3_scenario.json")
+    with open(path, "w") as f:
+        json.dump(proxy.transcript({
+            "protocol": "s3-rest-sigv4",
+            "mode": "http",
+            "captured_against": against,
+            "scenario": "tests/wire_scenarios.py::s3_scenario",
+            "bucket": bucket,
+            "expected_results": results,
+        }), f, indent=1)
+    print(f"wrote {path} ({against})")
+
+
+def capture_webhdfs() -> None:
+    """WebHDFS: the 307 CREATE redirect must route through the proxy (the
+    fake builds Location from the Host header), and the recorded Location
+    carries the capture-time proxy port — meta.capture_port lets replay
+    rewrite it to the replay server's port. PIO_TEST_WEBHDFS_URL upgrades
+    to a real namenode."""
+    from aiohttp import web
+
+    hd_url = os.environ.get("PIO_TEST_WEBHDFS_URL")
+    if hd_url:
+        u = urllib.parse.urlsplit(hd_url)
+        host, port = u.hostname, u.port or 9870
+        against = f"real WebHDFS at {host}:{port}"
+        server = None
+    else:
+        from tests.fixtures.servers import ThreadedApp
+
+        store: dict = {}
+        app = web.Application()
+
+        async def namenode(request: web.Request):
+            op = request.query.get("op", "")
+            name = request.match_info["name"]
+            if op == "CREATE":
+                # Host header = the proxy → the datanode write is recorded too
+                raise web.HTTPTemporaryRedirect(
+                    f"http://{request.headers['Host']}/write/{name}")
+            if op == "OPEN":
+                if name not in store:
+                    raise web.HTTPNotFound()
+                return web.Response(body=store[name])
+            if op == "DELETE":
+                return web.json_response(
+                    {"boolean": store.pop(name, None) is not None})
+            raise web.HTTPBadRequest(text=f"bad op {op}")
+
+        async def datanode_write(request: web.Request):
+            store[request.match_info["name"]] = await request.read()
+            return web.Response(status=201)
+
+        app.router.add_route("*", "/webhdfs/v1/pio/models/{name}", namenode)
+        app.router.add_put("/write/{name}", datanode_write)
+        server = ThreadedApp(app)
+        host, port = "127.0.0.1", server.port
+        against = "in-process protocol fake (tests/tools/capture_transcripts.py)"
+    proxy = CaptureProxy(host, port)
+    from incubator_predictionio_tpu.data.storage import Storage
+
+    s = Storage({
+        "PIO_STORAGE_SOURCES_H_TYPE": "webhdfs",
+        "PIO_STORAGE_SOURCES_H_URL": f"http://127.0.0.1:{proxy.port}",
+        "PIO_STORAGE_SOURCES_H_PATH": "/pio/models",
+    })
+    results = webhdfs_scenario(s.get_model_data_models())
+    s.close()
+    proxy.close()
+    if server is not None:
+        server.close()
+    path = os.path.join(OUT, "webhdfs_scenario.json")
+    with open(path, "w") as f:
+        json.dump(proxy.transcript({
+            "protocol": "webhdfs-rest",
+            "mode": "http",
+            "captured_against": against,
+            "scenario": "tests/wire_scenarios.py::webhdfs_scenario",
+            "capture_port": proxy.port,  # for the Location-port rewrite
+            "expected_results": results,
+        }), f, indent=1)
+    print(f"wrote {path} ({against})")
+
+
 if __name__ == "__main__":
     os.makedirs(OUT, exist_ok=True)
     capture_pg()
     capture_es()
+    capture_s3()
+    capture_webhdfs()

@@ -60,6 +60,41 @@ def pg_scenario(client) -> dict:
     }
 
 
+def s3_scenario(models) -> dict:
+    """MODELDATA CRUD against S3 — SigV4-signed REST round trips.
+
+    Takes the ModelsStore directly (S3 serves MODELDATA only)."""
+    blob = bytes(range(256)) * 8
+    models.insert(Model("s3wire", blob))
+    got = models.get("s3wire")
+    missing = models.get("nope")
+    deleted = models.delete("s3wire")
+    deleted_again = models.delete("s3wire")  # S3 DELETE is idempotent-true
+    return {
+        "blob_hex": got.models.hex() if got else None,
+        "missing_is_none": missing is None,
+        "deleted": deleted,
+        "deleted_again": deleted_again,
+    }
+
+
+def webhdfs_scenario(models) -> dict:
+    """MODELDATA CRUD against WebHDFS — two-step CREATE (307 redirect),
+    OPEN, DELETE."""
+    blob = b"\x00\x01\x02webhdfs-payload" * 16
+    models.insert(Model("hdwire", blob))
+    got = models.get("hdwire")
+    missing = models.get("nope")
+    deleted = models.delete("hdwire")
+    deleted_again = models.delete("hdwire")
+    return {
+        "blob_hex": got.models.hex() if got else None,
+        "missing_is_none": missing is None,
+        "deleted": deleted,
+        "deleted_again": deleted_again,
+    }
+
+
 def es_scenario(client) -> dict:
     """Events + apps against Elasticsearch — REST round trips."""
     ev = client.events()
