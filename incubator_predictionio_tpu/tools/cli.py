@@ -29,6 +29,7 @@ import argparse
 import contextlib
 import json
 import logging
+import os
 import sys
 from typing import Optional
 
@@ -924,6 +925,13 @@ def main(argv: Optional[list[str]] = None) -> int:
     if not args.command:
         parser.print_help()
         return 1
+    # The engine directory is the import path: a variant's ``engineFactory``
+    # names a module in the user's engine dir, and `pio train` in that dir
+    # must resolve it — the counterpart of the reference putting `pio build`'s
+    # jar on the classpath (console/Console.scala). `python -m` adds cwd
+    # already; the installed `pio-tpu` script does not.
+    if os.getcwd() not in sys.path and "" not in sys.path:
+        sys.path.insert(0, os.getcwd())
     # INFO-level console logging, like the reference console's log4j default
     # (WorkflowUtils.modifyLogging); framework INFO lines (mesh layout,
     # sharded reads, checkpoints) are part of the operator surface

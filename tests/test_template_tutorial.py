@@ -1,0 +1,85 @@
+"""docs/templates.md executes as written (VERDICT r4 next #7).
+
+The tutorial is the template-author developer journey (the reference's
+docs/manual/source/templates/** walk-throughs): app new → seed events →
+custom DASE engine → train → eval → deploy → query. This test parses the
+document's fenced code blocks IN ORDER and executes them — `title=` blocks
+become files, bash blocks run under one persistent shell (so `export`s and
+`cd` carry forward), everything in a scratch workdir with a `pio-tpu` shim
+on PATH. If the tutorial drifts from the code, this fails.
+"""
+
+import os
+import re
+import stat
+import subprocess
+import sys
+
+import pytest
+
+DOC = os.path.join(os.path.dirname(__file__), "..", "docs", "templates.md")
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+_FENCE = re.compile(r"```(\w+)([^\n]*)\n(.*?)```", re.DOTALL)
+
+
+def parse_blocks():
+    with open(DOC) as f:
+        text = f.read()
+    blocks = []
+    for lang, info, body in _FENCE.findall(text):
+        m = re.search(r"title=(\S+)", info)
+        if m:
+            blocks.append(("file", m.group(1), body))
+        elif lang == "bash":
+            blocks.append(("bash", None, body))
+        # untitled non-bash blocks (sample output, JSON responses) are prose
+    return blocks
+
+
+def test_tutorial_runs_as_written(tmp_path):
+    blocks = parse_blocks()
+    assert any(k == "file" and n == "engine.py" for k, n, _ in blocks)
+    assert sum(1 for k, _, _ in blocks if k == "bash") >= 5
+
+    bindir = tmp_path / "bin"
+    bindir.mkdir()
+    shim = bindir / "pio-tpu"
+    shim.write_text(
+        "#!/bin/sh\n"
+        f'exec {sys.executable} -m incubator_predictionio_tpu.tools.cli "$@"\n')
+    shim.chmod(shim.stat().st_mode | stat.S_IEXEC)
+
+    # one script, all blocks in order: exports/cd persist exactly as a
+    # reader typing the tutorial into one shell would experience
+    script_lines = ["set -ex"]
+    for kind, name, body in blocks:
+        if kind == "file":
+            # heredoc with a quoted delimiter: no shell expansion of content
+            script_lines.append(f"cat > {name} <<'PIO_TUTORIAL_EOF'")
+            script_lines.append(body.rstrip("\n"))
+            script_lines.append("PIO_TUTORIAL_EOF")
+        else:
+            script_lines.append(body.rstrip("\n"))
+    script = "\n".join(script_lines) + "\n"
+
+    env = dict(
+        os.environ,
+        PATH=f"{bindir}:{os.environ['PATH']}",
+        PYTHONPATH=REPO,
+        JAX_PLATFORMS="cpu",
+        HOME=str(tmp_path),
+    )
+    proc = subprocess.run(
+        ["bash", "-c", script], cwd=tmp_path, env=env,
+        capture_output=True, text=True, timeout=540,
+    )
+    sys.stdout.write(proc.stdout[-4000:])
+    sys.stderr.write(proc.stderr[-4000:])
+    assert proc.returncode == 0
+
+    # the journey's artifacts: query answered with item scores, eval ranked
+    # the grid, train recorded a completed instance
+    assert '"itemScores"' in proc.stdout
+    assert "HitRate" in proc.stdout or "HitRate" in proc.stderr
+    assert "Access Key" in proc.stdout
