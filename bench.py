@@ -106,19 +106,27 @@ REC_EVENTS = 120_000 if SMALL else 1_000_000
 REC_RANK, REC_BATCH, REC_EPOCHS = 64, 65536, 20
 
 
-def _two_tower_flops_bytes(n_events, rank, batch, epochs, n_users, n_items):
-    """Analytic per-schedule FLOPs and HBM bytes of the fused train loop."""
+def _two_tower_flops_bytes(n_events, rank, batch, epochs, n_users, n_items,
+                           moment_bytes=4):
+    """Analytic per-schedule FLOPs and HBM bytes of the fused train loop.
+    ``moment_bytes`` reflects the adam moment STORAGE dtype (4 = fp32,
+    2 = bf16 via ``adam_moments_dtype``) so hbm_util stays honest when the
+    traffic really shrinks."""
     n_batches = max(1, (n_events + batch - 1) // batch)
     steps = epochs * n_batches
     n_params = (n_users + n_items) * (rank + 1)
     flops_step = 12 * rank * batch + 12 * n_params  # fwd+bwd dots + dense adam
-    # adam state r/w (params+m+v, read+write, fp32) + batch embedding gathers
-    bytes_step = n_params * 4 * 6 + batch * rank * 4 * 4
+    # adam state r/w (params fp32 + m + v at their storage width, read+write)
+    # + batch embedding gathers
+    bytes_step = (n_params * (4 * 2 + moment_bytes * 4)
+                  + batch * rank * 4 * 4)
     return steps * flops_step, steps * bytes_step
 
 
-def _bench_two_tower(ctx, peaks, n_users, n_items, rank, n_events, batch,
-                     epochs, data_seed) -> tuple[dict, np.ndarray, np.ndarray, np.ndarray]:
+def _bench_two_tower(
+    ctx, peaks, n_users, n_items, rank, n_events, batch,
+    epochs, data_seed, moments_dtype="float32",
+) -> "tuple[dict, np.ndarray, np.ndarray, np.ndarray, object]":
     """Shared warmup+timed two-tower run. Distinct model seeds per run: a
     timed run identical to the warmup can be served from an execution cache
     by tunneled device backends. Utilization is computed over the train
@@ -135,6 +143,7 @@ def _bench_two_tower(ctx, peaks, n_users, n_items, rank, n_events, batch,
     def run(seed):
         return TwoTowerMF(TwoTowerConfig(
             rank=rank, batch_size=batch, epochs=epochs, seed=seed,
+            adam_moments_dtype=moments_dtype,
         )).fit(ctx, users, items, ratings, n_users, n_items)
 
     run(0)  # warmup: pays every compile
@@ -142,7 +151,8 @@ def _bench_two_tower(ctx, peaks, n_users, n_items, rank, n_events, batch,
     model = run(1)
     dt = time.perf_counter() - t0
     flops, bts = _two_tower_flops_bytes(
-        n_events, rank, batch, epochs, n_users, n_items)
+        n_events, rank, batch, epochs, n_users, n_items,
+        moment_bytes=2 if moments_dtype == "bfloat16" else 4)
     t_train = model.timings["train_sec"]
     return ({
         "events_per_sec": round(epochs * n_events / dt, 1),
@@ -182,10 +192,16 @@ def bench_recommendation_scaled(ctx, peaks, device) -> dict:
     small = SMALL or device.platform == "cpu"
     n_users, n_items, rank = (
         (100_000, 20_000, 64) if small else (1_000_000, 100_000, 128))
+    # bf16 moment storage: 6 → 4 fp32-equivalent table passes per step on
+    # the dense-adam traffic that dominates this config (parity:
+    # tests/test_optim_parity.py). PIO_BENCH_ADAM_MOMENTS=float32 ablates.
+    moments = os.environ.get("PIO_BENCH_ADAM_MOMENTS", "bfloat16")
     out, _u, _i, _r, model = _bench_two_tower(
         ctx, peaks, n_users, n_items, rank,
         n_events=200_000 if small else 4_000_000,
-        batch=65536, epochs=2 if small else 4, data_seed=9)
+        batch=65536, epochs=2 if small else 4, data_seed=9,
+        moments_dtype=moments)
+    out["adam_moments_dtype"] = moments
     # the headline ratio must compare THIS config against its own numpy
     # baseline (same table shapes/rank), not the MovieLens-shaped one
     host_eps = bench_numpy_baseline(

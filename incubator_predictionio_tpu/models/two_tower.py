@@ -32,7 +32,6 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-import optax
 
 from incubator_predictionio_tpu.parallel.mesh import MeshContext
 
@@ -50,6 +49,11 @@ class TwoTowerConfig:
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 0       # epochs between checkpoints
     checkpoint_keep: int = 3
+    # adam moment STORAGE dtype ("float32" | "bfloat16"): bf16 moments cut
+    # the dense-adam HBM traffic from 6 to 4 fp32-equivalent table passes
+    # per step (~33% on the bandwidth-bound scaled config); math stays fp32
+    # (utils/optim.adam_apply; parity: tests/test_optim_parity.py)
+    adam_moments_dtype: str = "float32"
     # model finalize: "host" pulls the trained tables to host numpy (the
     # round-3 path — one full-table transfer, tens of seconds for production
     # tables behind a device tunnel); "device" keeps them resident as jax
@@ -372,11 +376,10 @@ class TwoTowerMF:
                 "ie": ctx.put(init_table(ki, ni_p), *emb_spec),
             }
         # jitted init: multi-process-safe (optimizer state inherits the
-        # params' global shardings instead of materializing host-side);
-        # cached so repeated fits don't recompile it
-        from incubator_predictionio_tpu.utils.optim import jit_adam_init
+        # params' global shardings instead of materializing host-side)
+        from incubator_predictionio_tpu.utils.optim import adam_tree_init
 
-        opt_state = jit_adam_init(cfg.learning_rate)(params)
+        opt_state = adam_tree_init(params, cfg.adam_moments_dtype)
 
         from incubator_predictionio_tpu.utils.checkpoint import checkpointed_epochs
 
@@ -634,8 +637,10 @@ def _train_epochs(p, o, ub, ib, rb, wb, lr, reg, n_epochs):
     over staged batches — the whole schedule runs on device with no host
     round-trips (the dominant cost behind a device tunnel). Module-level with
     static (lr, reg, n_epochs) so repeated fits of the same shapes reuse one
-    executable. Returns the last epoch's mean loss."""
-    tx = optax.adam(lr)
+    executable. Returns the last epoch's mean loss. Adam runs through
+    utils/optim.adam_apply (optax-equivalent math; moment storage dtype —
+    fp32 or bf16 — is carried by the state ``o`` itself)."""
+    from incubator_predictionio_tpu.utils.optim import adam_apply
 
     def loss_fn(p, bu, bi, br, bw):
         # one ROW gather per table fetches vector + bias together (bias is
@@ -662,8 +667,7 @@ def _train_epochs(p, o, ub, ib, rb, wb, lr, reg, n_epochs):
         p, o = carry
         bu, bi, br, bw = batch
         loss, grads = jax.value_and_grad(loss_fn)(p, bu, bi, br, bw)
-        updates, o = tx.update(grads, o, p)
-        p = optax.apply_updates(p, updates)
+        p, o = adam_apply(p, grads, o, lr)
         return (p, o), loss
 
     def epoch(carry, _):

@@ -24,3 +24,94 @@ def jit_adam_init(learning_rate: float, mu_dtype: str | None = None):
 
     dt = jnp.bfloat16 if mu_dtype == "bfloat16" else None
     return jax.jit(optax.adam(learning_rate, mu_dtype=dt).init)
+
+
+# ---------------------------------------------------------------------------
+# fused adam with reduced-precision moment STORAGE (VERDICT r4 next #5)
+# ---------------------------------------------------------------------------
+#
+# optax's ``mu_dtype`` covers the first moment only; the dense-adam HBM
+# traffic of an embedding-table trainer is 6 table passes per step
+# (p/m/v × read+write), so storing BOTH moments in bf16 cuts it to 4
+# fp32-equivalent passes (p×2 + m×1 + v×1) — a ~33% traffic cut on the
+# bandwidth-bound recommendation_scaled schedule. Math stays fp32: moments
+# are upcast, updated, applied, and stored back rounded.
+#
+# Rounding: round-to-nearest-even, NOT stochastic. SR needs ≥1 random byte
+# per element per step — for a 142M-element table that is one extra full
+# HBM pass (plus the PRNG), i.e. it spends ~the traffic the bf16 store
+# saved. RTNE's bias is benign here: v is a positive EMA of squares (bf16's
+# 8 relative bits keep sqrt(v) within 0.4%), and m's small-update
+# cancellation is bounded by the parity suite (tests/test_optim_parity.py)
+# asserting fp32-vs-bf16 final-loss agreement on real fits.
+
+def _moments_jnp_dtype(moments_dtype: str):
+    import jax.numpy as jnp
+
+    if moments_dtype == "bfloat16":
+        return jnp.bfloat16
+    if moments_dtype == "float32":
+        return jnp.float32
+    raise ValueError(
+        f"adam_moments_dtype must be 'float32' or 'bfloat16', "
+        f"got {moments_dtype!r}")
+
+
+@functools.lru_cache(maxsize=8)
+def _jit_adam_tree_init(moments_dtype: str):
+    """One jitted init per moments dtype per process — a fresh jit wrapper
+    per fit would recompile this trivial program every training run."""
+    import jax.numpy as jnp
+
+    dt = _moments_jnp_dtype(moments_dtype)
+
+    @jax.jit
+    def init(p):
+        # (x * 0) instead of zeros(x.shape): the data dependency makes GSPMD
+        # CO-SHARD each moment with its parameter — on a model-axis-sharded
+        # table the adam state shards with it, cutting per-chip adam bytes
+        # (the VERDICT r4 "optimizer state over the model axis" lever)
+        z = jax.tree.map(lambda x: (x * 0).astype(dt), p)
+        z2 = jax.tree.map(lambda x: (x * 0).astype(dt), p)
+        return (jnp.zeros((), jnp.int32), z, z2)
+
+    return init
+
+
+def adam_tree_init(params, moments_dtype: str = "float32"):
+    """(count, m, v) state matching ``params``' structure and shardings;
+    moments in ``moments_dtype``. jit so the zeros inherit the params'
+    global shardings instead of materializing host-side."""
+    return _jit_adam_tree_init(moments_dtype)(params)
+
+
+def adam_apply(params, grads, state, lr: float, b1: float = 0.9,
+               b2: float = 0.999, eps: float = 1e-8):
+    """One adam step; returns (new_params, new_state).
+
+    Bit-matches ``optax.adam`` update math in fp32-moments mode (same
+    moment EMAs, bias correction by ``1-beta**t``, eps outside the sqrt) —
+    asserted by tests/test_optim_parity.py. Moments are stored back in
+    their state dtype; all arithmetic is fp32. The three tree maps below
+    recompute the fp32 EMAs, which XLA CSEs inside one jit."""
+    import jax.numpy as jnp
+
+    count, m, v = state
+    count = count + 1
+    cf = count.astype(jnp.float32)
+    bc1 = 1.0 - jnp.power(b1, cf)
+    bc2 = 1.0 - jnp.power(b2, cf)
+
+    def m32(g, m_):
+        return b1 * m_.astype(jnp.float32) + (1.0 - b1) * g
+
+    def v32(g, v_):
+        return b2 * v_.astype(jnp.float32) + (1.0 - b2) * (g * g)
+
+    new_p = jax.tree.map(
+        lambda p, g, m_, v_: p - lr * (m32(g, m_) / bc1)
+        / (jnp.sqrt(v32(g, v_) / bc2) + eps),
+        params, grads, m, v)
+    new_m = jax.tree.map(lambda g, m_: m32(g, m_).astype(m_.dtype), grads, m)
+    new_v = jax.tree.map(lambda g, v_: v32(g, v_).astype(v_.dtype), grads, v)
+    return new_p, (count, new_m, new_v)
