@@ -66,6 +66,7 @@ class _Db:
     event server serializes writes through this anyway)."""
 
     def __init__(self, path: str):
+        self.path = path
         self.lock = threading.RLock()
         if path != ":memory:":
             os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
@@ -195,6 +196,40 @@ class SqliteEvents(EventStore):
 
     def insert(self, event: Event, app_id: int, channel_id: Optional[int] = None) -> str:
         return self.insert_batch([event], app_id, channel_id)[0]
+
+    def ingest_raw(
+        self,
+        body: bytes,
+        single: bool,
+        max_items: int,
+        whitelist: Sequence[str],
+        app_id: int,
+        channel_id: Optional[int] = None,
+    ):
+        """C ingest fast path for sqlite: raw body -> native
+        parse/validate/bind/insert in ONE transaction against the same
+        database file over libsqlite3 (native/src/ingest.cc
+        pl_ingest_sqlite). Returns the event server's per-item response
+        dicts, or ``None`` when the Python path must run (lib/libsqlite3
+        unavailable, :memory: database — invisible to a second connection —
+        or a construct the C core declines). Parity: the same two-server
+        suite as the eventlog path, parametrized over backends."""
+        from incubator_predictionio_tpu import native
+
+        if self._db.path == ":memory:" or native.get_lib() is None:
+            return None
+        r = native.ingest_sqlite(
+            body, single, max_items, list(whitelist),
+            self._db.path, _event_table(app_id, channel_id))
+        if r is None or r is native.INGEST_FALLBACK:
+            return None
+        out = []
+        for status, msg, event_id in r:
+            if status == 201:
+                out.append({"status": 201, "eventId": event_id})
+            else:
+                out.append({"status": status, "message": msg})
+        return out
 
     def insert_batch(
         self, events: Sequence[Event], app_id: int, channel_id: Optional[int] = None

@@ -148,6 +148,15 @@ def get_lib() -> Any:
             ctypes.c_int64,                     # creation_us_override
             ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
         ]
+        lib.pl_ingest_sqlite.restype = ctypes.c_int64
+        lib.pl_ingest_sqlite.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64,    # body, body_len
+            ctypes.c_int32, ctypes.c_int32,     # single, max_items
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_int32,  # whitelist
+            ctypes.c_char_p, ctypes.c_char_p,   # db_path, table
+            ctypes.c_int64,                     # creation_us_override
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+        ]
         _lib = lib
         return _lib
 
@@ -298,6 +307,61 @@ def ingest(
     pos += 8
     blob = raw[pos:pos + blob_len]
     return results, new_strings, offsets, blob
+
+
+def ingest_sqlite(
+    body: bytes,
+    single: bool,
+    max_items: int,
+    whitelist: Sequence[str],
+    db_path: str,
+    table: str,
+    creation_us_override: int = -1,
+):
+    """C parse→validate→bind→insert straight into a sqlite events table
+    (one transaction, exact `_event_row` column encoding). Returns ``None``
+    (native lib unavailable), ``INGEST_FALLBACK`` (C declined — libsqlite3
+    missing, table missing, or a construct without certain byte-parity), or
+    a list of per-item ``(status, message, event_id)`` tuples."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    if any("\x00" in s for s in whitelist):
+        return INGEST_FALLBACK
+    wl = (ctypes.c_char_p * max(1, len(whitelist)))(
+        *[w.encode() for w in whitelist] or [b""])
+    buf = ctypes.POINTER(ctypes.c_uint8)()
+    n = lib.pl_ingest_sqlite(
+        body, len(body), 1 if single else 0, max_items,
+        wl, len(whitelist), db_path.encode(), table.encode(),
+        creation_us_override, ctypes.byref(buf),
+    )
+    if n == -2:
+        return INGEST_FALLBACK
+    if n < 0:
+        raise OSError("native sqlite ingest failed")
+    try:
+        raw = ctypes.string_at(buf, n)
+    finally:
+        lib.pl_free(buf)
+    pos = 0
+
+    def read_str16():
+        nonlocal pos
+        (slen,) = _U16.unpack_from(raw, pos)
+        pos += 2
+        s = raw[pos:pos + slen].decode()
+        pos += slen
+        return s
+
+    (n_results,) = _U32.unpack_from(raw, pos)
+    pos += 4
+    results = []
+    for _ in range(n_results):
+        (status,) = _U16.unpack_from(raw, pos)
+        pos += 2
+        results.append((status, read_str16(), read_str16()))
+    return results
 
 
 def scan(path: str, flt: _PlFilter) -> Optional[list[tuple[int, int]]]:

@@ -32,6 +32,8 @@
 #include <unordered_set>
 
 #include <sys/random.h>
+#include <dlfcn.h>
+#include <pthread.h>
 
 namespace {
 
@@ -455,6 +457,7 @@ struct PreparedEvent {
   bool has_pr = false;
   std::string pr_id;
   std::string event_id;  // client-supplied or generated
+  bool id_generated = false;
   std::vector<std::string> tags;
   const std::vector<JObjEntry>* props = nullptr;  // borrowed from DOM
   ParsedTime event_time;
@@ -605,7 +608,10 @@ PreparedEvent prepare(const JVal& item, int64_t creation_us_override) {
             " is not allowed. 'pio_' is a reserved name prefix.");
   // empty client eventId counts as absent: insert_batch's
   // ``event.event_id or urandom`` regenerates it on the Python path too
-  if (!has_eid || e.event_id.empty()) e.event_id = gen_event_id();
+  if (!has_eid || e.event_id.empty()) {
+    e.event_id = gen_event_id();
+    e.id_generated = true;
+  }
   return e;
 }
 
@@ -679,6 +685,387 @@ uint64_t encode_event(const PreparedEvent& e, Interner& interner, Buf& out) {
 }
 
 }  // namespace
+
+// ---------------------------------------------------------------------------
+// sqlite sink: parse->validate->bind->insert without Python OR the Python
+// sqlite3 module in the loop. libsqlite3.so.0 is loaded at runtime (no dev
+// headers in the image; the C ABI below is the stable documented surface).
+// ---------------------------------------------------------------------------
+
+extern "C" {
+typedef struct sqlite3 sqlite3;
+typedef struct sqlite3_stmt sqlite3_stmt;
+}
+
+namespace {
+
+struct SqliteApi {
+  int (*open_v2)(const char*, sqlite3**, int, const char*);
+  int (*close_v2)(sqlite3*);
+  int (*prepare_v2)(sqlite3*, const char*, int, sqlite3_stmt**, const char**);
+  int (*bind_text)(sqlite3_stmt*, int, const char*, int, void (*)(void*));
+  int (*bind_int64)(sqlite3_stmt*, int, long long);
+  int (*bind_null)(sqlite3_stmt*, int);
+  int (*step)(sqlite3_stmt*);
+  int (*reset)(sqlite3_stmt*);
+  int (*finalize)(sqlite3_stmt*);
+  int (*exec)(sqlite3*, const char*, int (*)(void*, int, char**, char**),
+              void*, char**);
+  const char* (*errmsg)(sqlite3*);
+  int (*busy_timeout)(sqlite3*, int);
+  bool ok = false;
+};
+
+constexpr int kSqliteOpenReadWrite = 0x00000002;
+constexpr int kSqliteRowStatus = 100;   // SQLITE_ROW
+constexpr int kSqliteDoneStatus = 101;  // SQLITE_DONE
+#define SQLITE_TRANSIENT_PTR ((void (*)(void*))(-1))
+
+SqliteApi& sqlite_api() {
+  static SqliteApi api;
+  static pthread_mutex_t mu = PTHREAD_MUTEX_INITIALIZER;
+  static bool tried = false;
+  pthread_mutex_lock(&mu);
+  if (!tried) {
+    tried = true;
+    void* h = dlopen("libsqlite3.so.0", RTLD_NOW | RTLD_GLOBAL);
+    if (h != nullptr) {
+      auto sym = [&](const char* n) { return dlsym(h, n); };
+      api.open_v2 = (decltype(api.open_v2))sym("sqlite3_open_v2");
+      api.close_v2 = (decltype(api.close_v2))sym("sqlite3_close_v2");
+      api.prepare_v2 = (decltype(api.prepare_v2))sym("sqlite3_prepare_v2");
+      api.bind_text = (decltype(api.bind_text))sym("sqlite3_bind_text");
+      api.bind_int64 = (decltype(api.bind_int64))sym("sqlite3_bind_int64");
+      api.bind_null = (decltype(api.bind_null))sym("sqlite3_bind_null");
+      api.step = (decltype(api.step))sym("sqlite3_step");
+      api.reset = (decltype(api.reset))sym("sqlite3_reset");
+      api.finalize = (decltype(api.finalize))sym("sqlite3_finalize");
+      api.exec = (decltype(api.exec))sym("sqlite3_exec");
+      api.errmsg = (decltype(api.errmsg))sym("sqlite3_errmsg");
+      api.busy_timeout = (decltype(api.busy_timeout))sym("sqlite3_busy_timeout");
+      api.ok = api.open_v2 && api.close_v2 && api.prepare_v2 && api.bind_text
+               && api.bind_int64 && api.bind_null && api.step && api.reset
+               && api.finalize && api.exec && api.errmsg && api.busy_timeout;
+    }
+  }
+  pthread_mutex_unlock(&mu);
+  return api;
+}
+
+// one cached connection per db path (WAL databases take concurrent
+// connections; sqlite serializes writers with busy_timeout backoff)
+sqlite3* sqlite_conn(const std::string& path) {
+  static std::unordered_map<std::string, sqlite3*> conns;
+  static pthread_mutex_t mu = PTHREAD_MUTEX_INITIALIZER;
+  SqliteApi& api = sqlite_api();
+  if (!api.ok) return nullptr;
+  pthread_mutex_lock(&mu);
+  auto it = conns.find(path);
+  if (it != conns.end()) {
+    sqlite3* db = it->second;
+    pthread_mutex_unlock(&mu);
+    return db;
+  }
+  sqlite3* db = nullptr;
+  // no CREATE flag: the Python backend owns schema/bootstrap
+  if (api.open_v2(path.c_str(), &db, kSqliteOpenReadWrite, nullptr) != 0) {
+    if (db != nullptr) api.close_v2(db);
+    pthread_mutex_unlock(&mu);
+    return nullptr;
+  }
+  api.busy_timeout(db, 5000);
+  api.exec(db, "PRAGMA synchronous=NORMAL", nullptr, nullptr, nullptr);
+  conns.emplace(path, db);
+  pthread_mutex_unlock(&mu);
+  return db;
+}
+
+// JSON text for the properties/tags columns. Value-parity with Python's
+// json.dumps (what the read path json.loads back): shortest-round-trip
+// doubles (to_chars), NaN/Infinity literals like CPython emits, raw UTF-8
+// strings with standard escapes. Byte-identity with dumps is NOT required
+// (nothing compares the raw text), value identity is.
+void json_escape(const std::string& s, std::string& out) {
+  out += '"';
+  for (unsigned char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\b': out += "\\b"; break;
+      case '\f': out += "\\f"; break;
+      case '\n': out += "\\n"; break;
+      case '\r': out += "\\r"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (c < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof buf, "\\u%04x", c);
+          out += buf;
+        } else {
+          out += (char)c;
+        }
+    }
+  }
+  out += '"';
+}
+
+void json_write(const JVal& v, std::string& out) {
+  char buf[40];
+  switch (v.type) {
+    case JVal::NUL: out += "null"; break;
+    case JVal::BOOL: out += v.b ? "true" : "false"; break;
+    case JVal::INT:
+      snprintf(buf, sizeof buf, "%lld", (long long)v.i);
+      out += buf;
+      break;
+    case JVal::BIGINT: out += v.s; break;
+    case JVal::DBL:
+      if (std::isnan(v.dbl)) out += "NaN";
+      else if (std::isinf(v.dbl)) out += (v.dbl > 0 ? "Infinity" : "-Infinity");
+      else {
+        snprintf(buf, sizeof buf, "%.17g", v.dbl);  // round-trips exactly
+        out += buf;
+      }
+      break;
+    case JVal::STR: json_escape(v.s, out); break;
+    case JVal::ARR: {
+      out += '[';
+      bool first = true;
+      for (const auto& e : v.arr) {
+        if (!first) out += ", ";
+        first = false;
+        json_write(e, out);
+      }
+      out += ']';
+      break;
+    }
+    case JVal::OBJ: {
+      out += '{';
+      bool first = true;
+      for (const auto& kv : v.obj) {
+        if (!first) out += ", ";
+        first = false;
+        json_escape(kv.first, out);
+        out += ": ";
+        json_write(kv.second, out);
+      }
+      out += '}';
+      break;
+    }
+  }
+}
+
+uint32_t crc32_zlib(const uint8_t* data, size_t n) {
+  // bit-identical to zlib.crc32 — the entity_shard partition
+  // (data/storage/base.py:325); duplicated from eventlog.cc's
+  // anonymous-namespace copy
+  static uint32_t table[256];
+  static bool init = false;
+  if (!init) {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      table[i] = c;
+    }
+    init = true;
+  }
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; i++) c = table[(c ^ data[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+constexpr int kShardBuckets = 1024;  // sqlite_backend.N_SHARD_BUCKETS
+
+// time-prefixed event id (sqlite_backend._new_event_id: 15-hex creation µs
+// + 16 hex random + '0' — monotonic prefix appends at the btree right edge)
+std::string sqlite_event_id(int64_t creation_us) {
+  char head[20];
+  snprintf(head, sizeof head, "%015llx", (unsigned long long)creation_us);
+  std::string id(head);
+  id += gen_event_id().substr(0, 16);
+  id += '0';
+  return id;
+}
+
+}  // namespace
+
+// pl_ingest_sqlite(body, body_len, single, max_items, whitelist, n_wl,
+//                  db_path, table, creation_us_override, out_buf)
+//   -> out_len | -1 err | -2 fallback
+//
+// out layout: u32 n_results; per result u16 status, str16 message,
+// str16 event_id. Accepted rows are INSERT OR REPLACEd in ONE transaction
+// (the group-commit the Python path gets from executemany), with the exact
+// column encoding of sqlite_backend._event_row.
+
+extern "C" int64_t pl_ingest_sqlite(const uint8_t* body, int64_t body_len,
+                                    int32_t single, int32_t max_items,
+                                    const char** whitelist, int32_t n_whitelist,
+                                    const char* db_path, const char* table,
+                                    int64_t creation_us_override,
+                                    uint8_t** out_buf) {
+  SqliteApi& api = sqlite_api();
+  if (!api.ok) return -2;
+  sqlite3* db = sqlite_conn(db_path);
+  if (db == nullptr) return -2;
+  try {
+    Parser parser{body, body + body_len};
+    // UTF-8 validation: same reasoning as pl_ingest
+    {
+      const uint8_t* q = body;
+      const uint8_t* qe = body + body_len;
+      while (q < qe) {
+        uint8_t c = *q;
+        int n;
+        uint32_t min_cp;
+        if (c < 0x80) { q++; continue; }
+        else if ((c & 0xE0) == 0xC0) { n = 1; min_cp = 0x80; }
+        else if ((c & 0xF0) == 0xE0) { n = 2; min_cp = 0x800; }
+        else if ((c & 0xF8) == 0xF0) { n = 3; min_cp = 0x10000; }
+        else throw Fallback{};
+        if (qe - q < n + 1) throw Fallback{};
+        uint32_t cp = c & (0x3F >> n);
+        for (int i = 1; i <= n; i++) {
+          if ((q[i] & 0xC0) != 0x80) throw Fallback{};
+          cp = (cp << 6) | (q[i] & 0x3F);
+        }
+        if (cp < min_cp || cp > 0x10FFFF || (cp >= 0xD800 && cp <= 0xDFFF))
+          throw Fallback{};
+        q += n + 1;
+      }
+    }
+    JVal root = parser.parse_value();
+    parser.ws();
+    if (parser.p != parser.end) throw Fallback{};
+
+    std::vector<const JVal*> items;
+    if (single) {
+      items.push_back(&root);
+    } else {
+      if (root.type != JVal::ARR) throw Fallback{};
+      if (max_items >= 0 && (int64_t)root.arr.size() > max_items)
+        throw Fallback{};
+      for (const auto& it : root.arr) items.push_back(&it);
+    }
+
+    std::unordered_set<std::string> wl;
+    for (int32_t i = 0; i < n_whitelist; i++) wl.insert(whitelist[i]);
+
+    std::vector<ItemResult> results;
+    std::vector<PreparedEvent> accepted;
+    for (const JVal* item : items) {
+      ItemResult r;
+      try {
+        PreparedEvent e = prepare(*item, creation_us_override);
+        if (!wl.empty() && wl.find(e.event) == wl.end()) {
+          r.status = 403;
+          r.message = e.event + " events are not allowed";
+        } else {
+          // generated ids take the sqlite backend's time-prefixed scheme
+          // (_new_event_id: btree right-edge locality); client ids as-is
+          if (e.id_generated)
+            e.event_id = sqlite_event_id(e.creation_time.us);
+          r.event_id = e.event_id;
+          accepted.push_back(std::move(e));
+        }
+      } catch (const ValidationError& ve) {
+        r.status = 400;
+        r.message = ve.msg;
+      }
+      results.push_back(std::move(r));
+    }
+
+    if (!accepted.empty()) {
+      std::string sql = "INSERT OR REPLACE INTO ";
+      sql += table;
+      sql += " (id, event, entity_type, entity_id, target_entity_type, "
+             "target_entity_id, properties, event_time, tags, pr_id, "
+             "creation_time, entity_shard) VALUES (?,?,?,?,?,?,?,?,?,?,?,?)";
+      sqlite3_stmt* stmt = nullptr;
+      if (api.prepare_v2(db, sql.c_str(), -1, &stmt, nullptr) != 0)
+        return -2;  // table missing etc.: Python path heals and retries
+      char* err = nullptr;
+      if (api.exec(db, "BEGIN IMMEDIATE", nullptr, nullptr, &err) != 0) {
+        api.finalize(stmt);
+        return -2;
+      }
+      bool failed = false;
+      for (const PreparedEvent& e : accepted) {
+        std::string props = "{}";
+        if (!e.props->empty()) {
+          props.clear();
+          JVal pv;
+          pv.type = JVal::OBJ;
+          pv.obj = *e.props;
+          json_write(pv, props);
+        }
+        std::string tags = "[]";
+        if (!e.tags.empty()) {
+          tags.clear();
+          tags += '[';
+          for (size_t i = 0; i < e.tags.size(); i++) {
+            if (i) tags += ", ";
+            json_escape(e.tags[i], tags);
+          }
+          tags += ']';
+        }
+        uint32_t shard = crc32_zlib(
+            (const uint8_t*)e.entity_id.data(), e.entity_id.size())
+            % kShardBuckets;
+        auto bt = [&](int idx, const std::string& s) {
+          api.bind_text(stmt, idx, s.data(), (int)s.size(),
+                        SQLITE_TRANSIENT_PTR);
+        };
+        bt(1, e.event_id);
+        bt(2, e.event);
+        bt(3, e.entity_type);
+        bt(4, e.entity_id);
+        if (e.has_target) { bt(5, e.target_type); bt(6, e.target_id); }
+        else { api.bind_null(stmt, 5); api.bind_null(stmt, 6); }
+        bt(7, props);
+        api.bind_int64(stmt, 8, e.event_time.us);
+        bt(9, tags);
+        if (e.has_pr) bt(10, e.pr_id);
+        else api.bind_null(stmt, 10);
+        api.bind_int64(stmt, 11, e.creation_time.us);
+        api.bind_int64(stmt, 12, (long long)shard);
+        int rc = api.step(stmt);
+        api.reset(stmt);
+        if (rc != kSqliteDoneStatus && rc != kSqliteRowStatus) {
+          failed = true;
+          break;
+        }
+      }
+      api.finalize(stmt);
+      if (failed) {
+        api.exec(db, "ROLLBACK", nullptr, nullptr, nullptr);
+        return -2;  // Python path reproduces the error surface
+      }
+      if (api.exec(db, "COMMIT", nullptr, nullptr, nullptr) != 0) {
+        api.exec(db, "ROLLBACK", nullptr, nullptr, nullptr);
+        return -2;
+      }
+    }
+
+    Buf out;
+    out.u32((uint32_t)results.size());
+    for (const auto& r : results) {
+      out.u16(r.status);
+      if (r.message.size() >= ABSENT16) throw Fallback{};
+      out.str16(r.message);
+      out.str16(r.event_id);
+    }
+    uint8_t* mem = (uint8_t*)malloc(out.size());
+    if (mem == nullptr) return -1;
+    memcpy(mem, out.d.data(), out.size());
+    *out_buf = mem;
+    return (int64_t)out.size();
+  } catch (const Fallback&) {
+    return -2;
+  } catch (...) {
+    return -1;
+  }
+}
 
 // ---------------------------------------------------------------------------
 // entry point

@@ -33,10 +33,19 @@ pytestmark = pytest.mark.skipif(
 )
 
 
-def _mk_env(tmp_path, name, disable_native):
+def _mk_env(tmp_path, name, disable_native, backend="eventlog"):
+    if backend == "eventlog":
+        src_conf = {
+            f"PIO_STORAGE_SOURCES_{name}_TYPE": "eventlog",
+            f"PIO_STORAGE_SOURCES_{name}_PATH": str(tmp_path / name),
+        }
+    else:
+        src_conf = {
+            f"PIO_STORAGE_SOURCES_{name}_TYPE": "sqlite",
+            f"PIO_STORAGE_SOURCES_{name}_PATH": str(tmp_path / f"{name}.db"),
+        }
     conf = {
-        f"PIO_STORAGE_SOURCES_{name}_TYPE": "eventlog",
-        f"PIO_STORAGE_SOURCES_{name}_PATH": str(tmp_path / name),
+        **src_conf,
         "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": name,
         "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": name,
         # metadata still needs a home
@@ -78,13 +87,14 @@ def _event_key(e, t0):
     )
 
 
-def run_pair(tmp_path, scenarios, monkeypatch):
+def run_pair(tmp_path, scenarios, monkeypatch, backend="eventlog"):
     """POST every scenario to a native-path server and a Python-path server;
     assert identical responses and identical stored events."""
 
     async def drive(disable):
         name = "NATC" if not disable else "PYF"
-        storage, app_id, key, _limited, _ = _mk_env(tmp_path, name, disable)
+        storage, app_id, key, _limited, _ = _mk_env(
+            tmp_path, name, disable, backend)
         if disable:
             monkeypatch.setenv("PIO_NATIVE_DISABLE", "1")
         else:
@@ -216,7 +226,12 @@ MATRIX = [
 ]
 
 
-def test_matrix_parity(tmp_path, monkeypatch):
+@pytest.fixture(params=["eventlog", "sqlite"])
+def backend(request):
+    return request.param
+
+
+def test_matrix_parity(tmp_path, monkeypatch, backend):
     scenarios = [{"body": json.dumps(batch).encode()} for batch in MATRIX]
     # malformed JSON / wrong top-level type / oversized batch
     scenarios.append({"body": b"{nope"})
@@ -245,7 +260,7 @@ def test_matrix_parity(tmp_path, monkeypatch):
     scenarios.append({"single": True, "body": json.dumps(
         {"event": "$unset", "entityType": "u", "entityId": "s2"}).encode()})
     scenarios.append({"single": True, "body": b"[1,2]"})
-    run_pair(tmp_path, scenarios, monkeypatch)
+    run_pair(tmp_path, scenarios, monkeypatch, backend)
 
 
 def _rand_value(rng, depth=0):
@@ -295,13 +310,35 @@ def _rand_event(rng):
     return d
 
 
-def test_fuzz_parity(tmp_path, monkeypatch):
+def test_fuzz_parity(tmp_path, monkeypatch, backend):
     rng = random.Random(20260730)
     scenarios = []
     for _ in range(40):
         batch = [_rand_event(rng) for _ in range(rng.randrange(1, 8))]
         scenarios.append({"body": json.dumps(batch).encode()})
-    run_pair(tmp_path, scenarios, monkeypatch)
+    run_pair(tmp_path, scenarios, monkeypatch, backend)
+
+
+def test_sqlite_fast_path_actually_engages(tmp_path, monkeypatch):
+    """Same guard for the sqlite sink (pl_ingest_sqlite over libsqlite3):
+    a silent permanent fallback would make the sqlite parity params prove
+    nothing."""
+    monkeypatch.delenv("PIO_NATIVE_DISABLE", raising=False)
+    native._reset_for_tests()
+    storage, app_id, key, _l, _ = _mk_env(tmp_path, "SQL", False, "sqlite")
+    store = storage.get_events()
+    body = json.dumps([
+        {"event": "rate", "entityType": "user", "entityId": "u1",
+         "properties": {"x": 1.5}}]).encode()
+    out = store.ingest_raw(body, False, 50, [], app_id)
+    assert out is not None and out[0]["status"] == 201
+    ev = list(store.find(app_id))
+    assert len(ev) == 1 and ev[0].properties["x"] == 1.5
+    got = store.get(out[0]["eventId"], app_id)
+    assert got is not None and got.entity_id == "u1"
+    # the time-prefixed id scheme (btree locality) is preserved
+    assert len(out[0]["eventId"]) == 32 and out[0]["eventId"].endswith("0")
+    storage.close()
 
 
 def test_fast_path_actually_engages(tmp_path, monkeypatch):
