@@ -92,6 +92,9 @@ class _Db:
     def close(self) -> None:
         with self.lock:
             self.conn.close()
+        from incubator_predictionio_tpu import native
+
+        native.sqlite_close(self.path)  # evict the C ingest connection too
 
 
 _EVENT_COLS = (

@@ -148,6 +148,8 @@ def get_lib() -> Any:
             ctypes.c_int64,                     # creation_us_override
             ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
         ]
+        lib.pl_sqlite_close.restype = None
+        lib.pl_sqlite_close.argtypes = [ctypes.c_char_p]
         lib.pl_ingest_sqlite.restype = ctypes.c_int64
         lib.pl_ingest_sqlite.argtypes = [
             ctypes.c_char_p, ctypes.c_int64,    # body, body_len
@@ -307,6 +309,14 @@ def ingest(
     pos += 8
     blob = raw[pos:pos + blob_len]
     return results, new_strings, offsets, blob
+
+
+def sqlite_close(db_path: Optional[str]) -> None:
+    """Close/evict the C side's cached connection(s) for a db path (None =
+    all). Called by the sqlite backend's close() so fds don't outlive it."""
+    lib = _lib  # only if already loaded; closing must never trigger a build
+    if lib is not None:
+        lib.pl_sqlite_close(None if db_path is None else db_path.encode())
 
 
 def ingest_sqlite(

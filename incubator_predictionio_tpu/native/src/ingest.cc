@@ -83,6 +83,34 @@ struct JVal {
 
 struct Fallback {};  // thrown to abort into the Python path
 
+// Whole-body UTF-8 validation, shared by both ingest sinks: Python's
+// json.loads(bytes) decodes before parsing, and invalid UTF-8 surfaces as
+// ITS error — invalid bytes must never be accepted and stored durably.
+void validate_utf8_or_fallback(const uint8_t* body, int64_t body_len) {
+  const uint8_t* q = body;
+  const uint8_t* qe = body + body_len;
+  while (q < qe) {
+    uint8_t c = *q;
+    int n;
+    uint32_t min_cp;
+    if (c < 0x80) { q++; continue; }
+    else if ((c & 0xE0) == 0xC0) { n = 1; min_cp = 0x80; }
+    else if ((c & 0xF0) == 0xE0) { n = 2; min_cp = 0x800; }
+    else if ((c & 0xF8) == 0xF0) { n = 3; min_cp = 0x10000; }
+    else throw Fallback{};
+    if (qe - q < n + 1) throw Fallback{};
+    uint32_t cp = c & (0x3F >> n);
+    for (int i = 1; i <= n; i++) {
+      if ((q[i] & 0xC0) != 0x80) throw Fallback{};
+      cp = (cp << 6) | (q[i] & 0x3F);
+    }
+    if (cp < min_cp || cp > 0x10FFFF || (cp >= 0xD800 && cp <= 0xDFFF))
+      throw Fallback{};
+    q += n + 1;
+  }
+}
+
+
 struct Parser {
   const uint8_t* p;
   const uint8_t* end;
@@ -701,6 +729,7 @@ namespace {
 
 struct SqliteApi {
   int (*open_v2)(const char*, sqlite3**, int, const char*);
+  void (*free_fn)(void*);
   int (*close_v2)(sqlite3*);
   int (*prepare_v2)(sqlite3*, const char*, int, sqlite3_stmt**, const char**);
   int (*bind_text)(sqlite3_stmt*, int, const char*, int, void (*)(void*));
@@ -743,41 +772,57 @@ SqliteApi& sqlite_api() {
       api.exec = (decltype(api.exec))sym("sqlite3_exec");
       api.errmsg = (decltype(api.errmsg))sym("sqlite3_errmsg");
       api.busy_timeout = (decltype(api.busy_timeout))sym("sqlite3_busy_timeout");
+      api.free_fn = (decltype(api.free_fn))sym("sqlite3_free");
       api.ok = api.open_v2 && api.close_v2 && api.prepare_v2 && api.bind_text
                && api.bind_int64 && api.bind_null && api.step && api.reset
-               && api.finalize && api.exec && api.errmsg && api.busy_timeout;
+               && api.finalize && api.exec && api.errmsg && api.busy_timeout
+               && api.free_fn;
     }
   }
   pthread_mutex_unlock(&mu);
   return api;
 }
 
-// one cached connection per db path (WAL databases take concurrent
-// connections; sqlite serializes writers with busy_timeout backoff)
-sqlite3* sqlite_conn(const std::string& path) {
-  static std::unordered_map<std::string, sqlite3*> conns;
-  static pthread_mutex_t mu = PTHREAD_MUTEX_INITIALIZER;
+// one cached connection per db path, each with its own mutex: two executor
+// threads ingesting concurrently must serialize their BEGIN..COMMIT windows
+// (a shared connection cannot nest transactions), and sqlite's own
+// busy_timeout covers cross-CONNECTION contention with the Python side
+struct SqliteConn {
+  sqlite3* db = nullptr;
+  pthread_mutex_t mu = PTHREAD_MUTEX_INITIALIZER;
+};
+
+std::unordered_map<std::string, SqliteConn*>& sqlite_conn_map() {
+  static std::unordered_map<std::string, SqliteConn*> conns;
+  return conns;
+}
+
+pthread_mutex_t g_conn_map_mu = PTHREAD_MUTEX_INITIALIZER;
+
+SqliteConn* sqlite_conn(const std::string& path) {
   SqliteApi& api = sqlite_api();
   if (!api.ok) return nullptr;
-  pthread_mutex_lock(&mu);
+  pthread_mutex_lock(&g_conn_map_mu);
+  auto& conns = sqlite_conn_map();
   auto it = conns.find(path);
   if (it != conns.end()) {
-    sqlite3* db = it->second;
-    pthread_mutex_unlock(&mu);
-    return db;
+    SqliteConn* c = it->second;
+    pthread_mutex_unlock(&g_conn_map_mu);
+    return c;
   }
   sqlite3* db = nullptr;
   // no CREATE flag: the Python backend owns schema/bootstrap
   if (api.open_v2(path.c_str(), &db, kSqliteOpenReadWrite, nullptr) != 0) {
     if (db != nullptr) api.close_v2(db);
-    pthread_mutex_unlock(&mu);
+    pthread_mutex_unlock(&g_conn_map_mu);
     return nullptr;
   }
   api.busy_timeout(db, 5000);
   api.exec(db, "PRAGMA synchronous=NORMAL", nullptr, nullptr, nullptr);
-  conns.emplace(path, db);
-  pthread_mutex_unlock(&mu);
-  return db;
+  SqliteConn* c = new SqliteConn{db};
+  conns.emplace(path, c);
+  pthread_mutex_unlock(&g_conn_map_mu);
+  return c;
 }
 
 // JSON text for the properties/tags columns. Value-parity with Python's
@@ -825,6 +870,11 @@ void json_write(const JVal& v, std::string& out) {
       else {
         snprintf(buf, sizeof buf, "%.17g", v.dbl);  // round-trips exactly
         out += buf;
+        // "%.17g" prints 2.0 as "2": keep it a FLOAT on json.loads (the
+        // Python path stores "2.0") or consumers see int vs float drift
+        if (out.find_first_of(".eE", out.size() - strlen(buf))
+            == std::string::npos)
+          out += ".0";
       }
       break;
     case JVal::STR: json_escape(v.s, out); break;
@@ -906,34 +956,12 @@ extern "C" int64_t pl_ingest_sqlite(const uint8_t* body, int64_t body_len,
                                     uint8_t** out_buf) {
   SqliteApi& api = sqlite_api();
   if (!api.ok) return -2;
-  sqlite3* db = sqlite_conn(db_path);
-  if (db == nullptr) return -2;
+  SqliteConn* conn = sqlite_conn(db_path);
+  if (conn == nullptr) return -2;
+  sqlite3* db = conn->db;
   try {
     Parser parser{body, body + body_len};
-    // UTF-8 validation: same reasoning as pl_ingest
-    {
-      const uint8_t* q = body;
-      const uint8_t* qe = body + body_len;
-      while (q < qe) {
-        uint8_t c = *q;
-        int n;
-        uint32_t min_cp;
-        if (c < 0x80) { q++; continue; }
-        else if ((c & 0xE0) == 0xC0) { n = 1; min_cp = 0x80; }
-        else if ((c & 0xF0) == 0xE0) { n = 2; min_cp = 0x800; }
-        else if ((c & 0xF8) == 0xF0) { n = 3; min_cp = 0x10000; }
-        else throw Fallback{};
-        if (qe - q < n + 1) throw Fallback{};
-        uint32_t cp = c & (0x3F >> n);
-        for (int i = 1; i <= n; i++) {
-          if ((q[i] & 0xC0) != 0x80) throw Fallback{};
-          cp = (cp << 6) | (q[i] & 0x3F);
-        }
-        if (cp < min_cp || cp > 0x10FFFF || (cp >= 0xD800 && cp <= 0xDFFF))
-          throw Fallback{};
-        q += n + 1;
-      }
-    }
+    validate_utf8_or_fallback(body, body_len);
     JVal root = parser.parse_value();
     parser.ws();
     if (parser.p != parser.end) throw Fallback{};
@@ -975,18 +1003,29 @@ extern "C" int64_t pl_ingest_sqlite(const uint8_t* body, int64_t body_len,
       results.push_back(std::move(r));
     }
 
+    // result-buffer size limits checked BEFORE any write: a fallback
+    // after COMMIT would re-run the batch in Python and store duplicates
+    for (const auto& r : results)
+      if (r.message.size() >= ABSENT16 || r.event_id.size() >= ABSENT16)
+        throw Fallback{};
+
     if (!accepted.empty()) {
       std::string sql = "INSERT OR REPLACE INTO ";
       sql += table;
       sql += " (id, event, entity_type, entity_id, target_entity_type, "
              "target_entity_id, properties, event_time, tags, pr_id, "
              "creation_time, entity_shard) VALUES (?,?,?,?,?,?,?,?,?,?,?,?)";
+      pthread_mutex_lock(&conn->mu);  // serialize BEGIN..COMMIT windows
       sqlite3_stmt* stmt = nullptr;
-      if (api.prepare_v2(db, sql.c_str(), -1, &stmt, nullptr) != 0)
+      if (api.prepare_v2(db, sql.c_str(), -1, &stmt, nullptr) != 0) {
+        pthread_mutex_unlock(&conn->mu);
         return -2;  // table missing etc.: Python path heals and retries
+      }
       char* err = nullptr;
       if (api.exec(db, "BEGIN IMMEDIATE", nullptr, nullptr, &err) != 0) {
+        if (err != nullptr) api.free_fn(err);
         api.finalize(stmt);
+        pthread_mutex_unlock(&conn->mu);
         return -2;
       }
       bool failed = false;
@@ -1039,20 +1078,22 @@ extern "C" int64_t pl_ingest_sqlite(const uint8_t* body, int64_t body_len,
       api.finalize(stmt);
       if (failed) {
         api.exec(db, "ROLLBACK", nullptr, nullptr, nullptr);
+        pthread_mutex_unlock(&conn->mu);
         return -2;  // Python path reproduces the error surface
       }
       if (api.exec(db, "COMMIT", nullptr, nullptr, nullptr) != 0) {
         api.exec(db, "ROLLBACK", nullptr, nullptr, nullptr);
+        pthread_mutex_unlock(&conn->mu);
         return -2;
       }
+      pthread_mutex_unlock(&conn->mu);
     }
 
     Buf out;
     out.u32((uint32_t)results.size());
     for (const auto& r : results) {
       out.u16(r.status);
-      if (r.message.size() >= ABSENT16) throw Fallback{};
-      out.str16(r.message);
+      out.str16(r.message);   // sizes pre-checked before the transaction
       out.str16(r.event_id);
     }
     uint8_t* mem = (uint8_t*)malloc(out.size());
@@ -1065,6 +1106,35 @@ extern "C" int64_t pl_ingest_sqlite(const uint8_t* body, int64_t body_len,
   } catch (...) {
     return -1;
   }
+}
+
+// Close and evict the cached connection for one db path (or all paths when
+// db_path is NULL) — called from the Python backend's close() so file
+// descriptors and WAL handles don't outlive the storage object, and a
+// deleted-then-recreated db file gets a fresh connection.
+extern "C" void pl_sqlite_close(const char* db_path) {
+  SqliteApi& api = sqlite_api();
+  if (!api.ok) return;
+  pthread_mutex_lock(&g_conn_map_mu);
+  auto& conns = sqlite_conn_map();
+  auto drop = [&](const std::string& key) {
+    auto it = conns.find(key);
+    if (it == conns.end()) return;
+    SqliteConn* c = it->second;
+    pthread_mutex_lock(&c->mu);   // wait out any in-flight transaction
+    api.close_v2(c->db);
+    pthread_mutex_unlock(&c->mu);
+    delete c;
+    conns.erase(it);
+  };
+  if (db_path == nullptr) {
+    std::vector<std::string> keys;
+    for (auto& kv : conns) keys.push_back(kv.first);
+    for (auto& k : keys) drop(k);
+  } else {
+    drop(db_path);
+  }
+  pthread_mutex_unlock(&g_conn_map_mu);
 }
 
 // ---------------------------------------------------------------------------
@@ -1092,32 +1162,7 @@ extern "C" int64_t pl_ingest(const uint8_t* body, int64_t body_len,
                              int64_t creation_us_override,
                              uint8_t** out_buf) {
   try {
-    // Whole-body UTF-8 validation first: Python's json.loads(bytes) decodes
-    // before parsing, and invalid UTF-8 surfaces as ITS error (a 500 today)
-    // — invalid bytes must never be accepted here and written durably.
-    {
-      const uint8_t* q = body;
-      const uint8_t* qe = body + body_len;
-      while (q < qe) {
-        uint8_t c = *q;
-        int n;
-        uint32_t min_cp;
-        if (c < 0x80) { q++; continue; }
-        else if ((c & 0xE0) == 0xC0) { n = 1; min_cp = 0x80; }
-        else if ((c & 0xF0) == 0xE0) { n = 2; min_cp = 0x800; }
-        else if ((c & 0xF8) == 0xF0) { n = 3; min_cp = 0x10000; }
-        else throw Fallback{};
-        if (qe - q < n + 1) throw Fallback{};
-        uint32_t cp = c & (0x3F >> n);
-        for (int i = 1; i <= n; i++) {
-          if ((q[i] & 0xC0) != 0x80) throw Fallback{};
-          cp = (cp << 6) | (q[i] & 0x3F);
-        }
-        if (cp < min_cp || cp > 0x10FFFF || (cp >= 0xD800 && cp <= 0xDFFF))
-          throw Fallback{};
-        q += n + 1;
-      }
-    }
+    validate_utf8_or_fallback(body, body_len);
     Parser parser{body, body + body_len};
     JVal root = parser.parse_value();
     parser.ws();
