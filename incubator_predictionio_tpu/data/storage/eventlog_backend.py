@@ -346,13 +346,7 @@ class EventLogEvents(EventStore):
                 i = len(log.interner.ids)
                 log.interner.ids[s] = i
                 log.strings.setdefault(i, s)
-        out = []
-        for status, msg, event_id in results:
-            if status == 201:
-                out.append({"status": 201, "eventId": event_id})
-            else:
-                out.append({"status": status, "message": msg})
-        return out
+        return native.results_to_response_dicts(results)
 
     def insert(self, event: Event, app_id: int, channel_id: Optional[int] = None) -> str:
         return self.insert_batch([event], app_id, channel_id)[0]

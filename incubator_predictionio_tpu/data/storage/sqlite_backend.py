@@ -92,9 +92,14 @@ class _Db:
     def close(self) -> None:
         with self.lock:
             self.conn.close()
-        from incubator_predictionio_tpu import native
+        # evict the C ingest connection too — but only when the native
+        # module is already loaded (never import at teardown) and the db
+        # could have one (:memory: never does)
+        import sys
 
-        native.sqlite_close(self.path)  # evict the C ingest connection too
+        native = sys.modules.get("incubator_predictionio_tpu.native")
+        if native is not None and self.path != ":memory:":
+            native.sqlite_close(self.path)
 
 
 _EVENT_COLS = (
@@ -226,13 +231,7 @@ class SqliteEvents(EventStore):
             self._db.path, _event_table(app_id, channel_id))
         if r is None or r is native.INGEST_FALLBACK:
             return None
-        out = []
-        for status, msg, event_id in r:
-            if status == 201:
-                out.append({"status": 201, "eventId": event_id})
-            else:
-                out.append({"status": status, "message": msg})
-        return out
+        return native.results_to_response_dicts(r)
 
     def insert_batch(
         self, events: Sequence[Event], app_id: int, channel_id: Optional[int] = None

@@ -61,6 +61,15 @@ class _PlFilter(ctypes.Structure):
     ]
 
 
+def _list_sources() -> list:
+    """Source files, or [] when the install didn't ship native/src — the
+    pure-Python fallback must engage, not a FileNotFoundError."""
+    try:
+        return os.listdir(_SRC_DIR)
+    except OSError:
+        return []
+
+
 def _build_dir() -> str:
     return os.environ.get("PIO_NATIVE_BUILD_DIR", os.path.dirname(__file__))
 
@@ -73,8 +82,11 @@ def _compile() -> Optional[str]:
     out = os.path.join(_build_dir(), _LIB_NAME)
     srcs = sorted(
         os.path.join(_SRC_DIR, f)
-        for f in os.listdir(_SRC_DIR) if f.endswith(".cc")
+        for f in _list_sources() if f.endswith(".cc")
     )
+    if not srcs:
+        logger.info("native sources not shipped; native event log disabled")
+        return None
     cmd = [cxx, "-O3", "-std=c++17", "-shared", "-fPIC", *srcs, "-o", out]
     try:
         subprocess.run(cmd, check=True, capture_output=True, text=True, timeout=300)
@@ -97,7 +109,7 @@ def get_lib() -> Any:
         path = os.path.join(_build_dir(), _LIB_NAME)
         src_mtime = max(
             (os.path.getmtime(os.path.join(_SRC_DIR, f))
-             for f in os.listdir(_SRC_DIR) if f.endswith(".cc")),
+             for f in _list_sources() if f.endswith(".cc")),
             default=0.0,
         )
         if not os.path.exists(path) or os.path.getmtime(path) < src_mtime:
@@ -228,6 +240,38 @@ def make_filter(
 INGEST_FALLBACK = object()
 
 
+def _read_results(raw: bytes, pos: int):
+    """Decode the per-item result section both C sinks emit:
+    u32 n; per item u16 status, str16 message, str16 event_id.
+    Returns ([(status, message, event_id)], next_pos)."""
+    (n_results,) = _U32.unpack_from(raw, pos)
+    pos += 4
+    results = []
+    for _ in range(n_results):
+        (status,) = _U16.unpack_from(raw, pos)
+        pos += 2
+        out = []
+        for _f in range(2):
+            (slen,) = _U16.unpack_from(raw, pos)
+            pos += 2
+            out.append(raw[pos:pos + slen].decode())
+            pos += slen
+        results.append((status, out[0], out[1]))
+    return results, pos
+
+
+def results_to_response_dicts(results) -> list:
+    """(status, message, event_id) triples → the event server's per-item
+    response dicts (shared by both backends' ingest_raw)."""
+    out = []
+    for status, msg, event_id in results:
+        if status == 201:
+            out.append({"status": 201, "eventId": event_id})
+        else:
+            out.append({"status": status, "message": msg})
+    return out
+
+
 def ingest(
     body: bytes,
     single: bool,
@@ -281,7 +325,7 @@ def ingest(
     finally:
         lib.pl_free(buf)
 
-    pos = 0
+    results, pos = _read_results(raw, 0)
 
     def read_str16():
         nonlocal pos
@@ -291,13 +335,6 @@ def ingest(
         pos += slen
         return s
 
-    (n_results,) = _U32.unpack_from(raw, pos)
-    pos += 4
-    results = []
-    for _ in range(n_results):
-        (status,) = _U16.unpack_from(raw, pos)
-        pos += 2
-        results.append((status, read_str16(), read_str16()))
     (n_new,) = _U32.unpack_from(raw, pos)
     pos += 4
     new_strings = [read_str16() for _ in range(n_new)]
@@ -354,23 +391,7 @@ def ingest_sqlite(
         raw = ctypes.string_at(buf, n)
     finally:
         lib.pl_free(buf)
-    pos = 0
-
-    def read_str16():
-        nonlocal pos
-        (slen,) = _U16.unpack_from(raw, pos)
-        pos += 2
-        s = raw[pos:pos + slen].decode()
-        pos += slen
-        return s
-
-    (n_results,) = _U32.unpack_from(raw, pos)
-    pos += 4
-    results = []
-    for _ in range(n_results):
-        (status,) = _U16.unpack_from(raw, pos)
-        pos += 2
-        results.append((status, read_str16(), read_str16()))
+    results, _pos = _read_results(raw, 0)
     return results
 
 

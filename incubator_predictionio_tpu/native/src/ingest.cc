@@ -792,6 +792,13 @@ struct SqliteConn {
   pthread_mutex_t mu = PTHREAD_MUTEX_INITIALIZER;
 };
 
+struct MutexGuard {  // RAII: every return/throw path unlocks
+  pthread_mutex_t* m;
+  explicit MutexGuard(pthread_mutex_t* mu) : m(mu) {}
+  ~MutexGuard() { if (m != nullptr) pthread_mutex_unlock(m); }
+  MutexGuard(const MutexGuard&) = delete;
+};
+
 std::unordered_map<std::string, SqliteConn*>& sqlite_conn_map() {
   static std::unordered_map<std::string, SqliteConn*> conns;
   return conns;
@@ -799,28 +806,33 @@ std::unordered_map<std::string, SqliteConn*>& sqlite_conn_map() {
 
 pthread_mutex_t g_conn_map_mu = PTHREAD_MUTEX_INITIALIZER;
 
-SqliteConn* sqlite_conn(const std::string& path) {
+// Returns the connection with c->mu ALREADY HELD (lock coupling: acquired
+// under the map mutex, so pl_sqlite_close — which takes map mutex then
+// c->mu — can never free a connection between lookup and lock; the caller
+// releases c->mu via MutexGuard).
+SqliteConn* sqlite_conn_locked(const std::string& path) {
   SqliteApi& api = sqlite_api();
   if (!api.ok) return nullptr;
   pthread_mutex_lock(&g_conn_map_mu);
   auto& conns = sqlite_conn_map();
   auto it = conns.find(path);
+  SqliteConn* c = nullptr;
   if (it != conns.end()) {
-    SqliteConn* c = it->second;
-    pthread_mutex_unlock(&g_conn_map_mu);
-    return c;
+    c = it->second;
+  } else {
+    sqlite3* db = nullptr;
+    // no CREATE flag: the Python backend owns schema/bootstrap
+    if (api.open_v2(path.c_str(), &db, kSqliteOpenReadWrite, nullptr) != 0) {
+      if (db != nullptr) api.close_v2(db);
+      pthread_mutex_unlock(&g_conn_map_mu);
+      return nullptr;
+    }
+    api.busy_timeout(db, 5000);
+    api.exec(db, "PRAGMA synchronous=NORMAL", nullptr, nullptr, nullptr);
+    c = new SqliteConn{db};
+    conns.emplace(path, c);
   }
-  sqlite3* db = nullptr;
-  // no CREATE flag: the Python backend owns schema/bootstrap
-  if (api.open_v2(path.c_str(), &db, kSqliteOpenReadWrite, nullptr) != 0) {
-    if (db != nullptr) api.close_v2(db);
-    pthread_mutex_unlock(&g_conn_map_mu);
-    return nullptr;
-  }
-  api.busy_timeout(db, 5000);
-  api.exec(db, "PRAGMA synchronous=NORMAL", nullptr, nullptr, nullptr);
-  SqliteConn* c = new SqliteConn{db};
-  conns.emplace(path, c);
+  pthread_mutex_lock(&c->mu);
   pthread_mutex_unlock(&g_conn_map_mu);
   return c;
 }
@@ -956,8 +968,9 @@ extern "C" int64_t pl_ingest_sqlite(const uint8_t* body, int64_t body_len,
                                     uint8_t** out_buf) {
   SqliteApi& api = sqlite_api();
   if (!api.ok) return -2;
-  SqliteConn* conn = sqlite_conn(db_path);
+  SqliteConn* conn = sqlite_conn_locked(db_path);
   if (conn == nullptr) return -2;
+  MutexGuard guard(&conn->mu);  // held for the whole call (incl. throws)
   sqlite3* db = conn->db;
   try {
     Parser parser{body, body + body_len};
@@ -1015,17 +1028,13 @@ extern "C" int64_t pl_ingest_sqlite(const uint8_t* body, int64_t body_len,
       sql += " (id, event, entity_type, entity_id, target_entity_type, "
              "target_entity_id, properties, event_time, tags, pr_id, "
              "creation_time, entity_shard) VALUES (?,?,?,?,?,?,?,?,?,?,?,?)";
-      pthread_mutex_lock(&conn->mu);  // serialize BEGIN..COMMIT windows
       sqlite3_stmt* stmt = nullptr;
-      if (api.prepare_v2(db, sql.c_str(), -1, &stmt, nullptr) != 0) {
-        pthread_mutex_unlock(&conn->mu);
+      if (api.prepare_v2(db, sql.c_str(), -1, &stmt, nullptr) != 0)
         return -2;  // table missing etc.: Python path heals and retries
-      }
       char* err = nullptr;
       if (api.exec(db, "BEGIN IMMEDIATE", nullptr, nullptr, &err) != 0) {
         if (err != nullptr) api.free_fn(err);
         api.finalize(stmt);
-        pthread_mutex_unlock(&conn->mu);
         return -2;
       }
       bool failed = false;
@@ -1078,15 +1087,12 @@ extern "C" int64_t pl_ingest_sqlite(const uint8_t* body, int64_t body_len,
       api.finalize(stmt);
       if (failed) {
         api.exec(db, "ROLLBACK", nullptr, nullptr, nullptr);
-        pthread_mutex_unlock(&conn->mu);
         return -2;  // Python path reproduces the error surface
       }
       if (api.exec(db, "COMMIT", nullptr, nullptr, nullptr) != 0) {
         api.exec(db, "ROLLBACK", nullptr, nullptr, nullptr);
-        pthread_mutex_unlock(&conn->mu);
         return -2;
       }
-      pthread_mutex_unlock(&conn->mu);
     }
 
     Buf out;

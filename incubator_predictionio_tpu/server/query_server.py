@@ -194,6 +194,7 @@ class MicroBatcher:
         self.queue_delay = LatencyReservoir()
         self.dispatch_sec = LatencyReservoir()
         self._task: Optional[asyncio.Task] = None
+        self._sem: Optional[asyncio.Semaphore] = None
         self._inflight: set[asyncio.Task] = set()
         self._stopped = False
 
@@ -231,9 +232,26 @@ class MicroBatcher:
             raise result
         return result
 
+    async def set_max_in_flight(self, n: int) -> None:
+        """Resize the dispatch-slot semaphore live (reload can swap in an
+        engine with a different thread-safety posture). Growing releases
+        slots immediately; shrinking acquires the excess — waiting out
+        in-flight dispatches — so the new bound is real, not advisory."""
+        n = max(1, n)
+        delta = n - self.max_in_flight
+        self.max_in_flight = n
+        if self._sem is None or delta == 0:  # drainer not started yet
+            return
+        if delta > 0:
+            for _ in range(delta):
+                self._sem.release()
+        else:
+            for _ in range(-delta):
+                await self._sem.acquire()
+
     async def _drain(self) -> None:
         loop = asyncio.get_running_loop()
-        sem = asyncio.Semaphore(self.max_in_flight)
+        sem = self._sem = asyncio.Semaphore(self.max_in_flight)
         try:
             while True:
                 # slot FIRST, assemble SECOND: requests that arrive while we
@@ -617,6 +635,11 @@ class QueryServer:
         # The batcher captured the old DeployedEngine at construction; repoint
         # it or /reload would silently keep serving the stale model.
         self.batcher.deployed = self.deployed
+        # the reloaded engine may have a different thread-safety posture —
+        # re-resolve the overlap bound or auto mode's no-race guarantee
+        # breaks across /reload
+        await self.batcher.set_max_in_flight(
+            effective_max_in_flight(self.config, self.deployed))
         return web.json_response({"message": "Reloaded",
                                   "engineInstanceId": self.deployed.instance.id})
 
