@@ -179,6 +179,85 @@ def available() -> bool:
     return get_lib() is not None
 
 
+# ---------------------------------------------------------------------------
+# native HTTP front (server/event_server.py opt-in; src/httpfront.cc)
+# ---------------------------------------------------------------------------
+
+_HTTP_HANDLER = ctypes.CFUNCTYPE(
+    ctypes.c_int32, ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+    ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64)
+
+
+class _HttpFront:
+    """Handle keeping the server pointer AND the callback object alive
+    (a GC'd CFUNCTYPE while the epoll thread runs is a segfault)."""
+
+    def __init__(self, ptr, cb):
+        self.ptr = ptr
+        self.cb = cb
+
+
+def _bind_http(lib) -> None:
+    if getattr(lib, "_http_bound", False):
+        return
+    lib.pl_http_start.restype = ctypes.c_void_p
+    lib.pl_http_start.argtypes = [
+        ctypes.c_char_p, ctypes.c_int32, ctypes.c_int32, _HTTP_HANDLER]
+    lib.pl_http_port.restype = ctypes.c_int32
+    lib.pl_http_port.argtypes = [ctypes.c_void_p]
+    lib.pl_http_stop.restype = None
+    lib.pl_http_stop.argtypes = [ctypes.c_void_p]
+    lib.pl_http_respond.restype = None
+    lib.pl_http_respond.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
+    lib._http_bound = True
+
+
+def http_front_start(ip: str, port: int, backend_port: int, handler):
+    """Start the native epoll HTTP front. ``handler(method, path_qs, body)``
+    runs on the epoll thread and returns full HTTP response bytes, or None
+    to tunnel the request to the aiohttp backend. Returns an opaque handle
+    (pass to :func:`http_front_stop`) or None."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    _bind_http(lib)
+
+    @_HTTP_HANDLER
+    def cb(ctx, method, path_qs, body_ptr, body_len):
+        try:
+            body = ctypes.string_at(body_ptr, body_len) if body_len else b""
+            resp = handler(method.decode(), path_qs.decode(), body)
+            if resp is None:
+                return 1  # tunnel
+            lib.pl_http_respond(ctx, resp, len(resp))
+            return 0
+        except Exception:  # noqa: BLE001 - the epoll loop must survive
+            logger.exception("http front handler raised; tunneling")
+            return 1
+
+    ptr = lib.pl_http_start(ip.encode(), port, backend_port, cb)
+    if not ptr:
+        return None
+    return _HttpFront(ptr, cb)
+
+
+def http_front_port(front) -> int:
+    lib = get_lib()
+    if lib is None or front is None or front.ptr is None:
+        return -1
+    return int(lib.pl_http_port(front.ptr))
+
+
+def http_front_stop(front) -> None:
+    if front is None or front.ptr is None:
+        return
+    lib = _lib
+    if lib is not None:
+        lib.pl_http_stop(front.ptr)
+    front.ptr = None
+
+
 def _reset_for_tests() -> None:
     """Drop the cached handle so env-var changes take effect (tests only)."""
     global _lib, _load_attempted

@@ -790,13 +790,7 @@ SqliteApi& sqlite_api() {
 struct SqliteConn {
   sqlite3* db = nullptr;
   pthread_mutex_t mu = PTHREAD_MUTEX_INITIALIZER;
-};
-
-struct MutexGuard {  // RAII: every return/throw path unlocks
-  pthread_mutex_t* m;
-  explicit MutexGuard(pthread_mutex_t* mu) : m(mu) {}
-  ~MutexGuard() { if (m != nullptr) pthread_mutex_unlock(m); }
-  MutexGuard(const MutexGuard&) = delete;
+  int pins = 0;  // in-flight users; close waits for 0 under the map mutex
 };
 
 std::unordered_map<std::string, SqliteConn*>& sqlite_conn_map() {
@@ -805,12 +799,14 @@ std::unordered_map<std::string, SqliteConn*>& sqlite_conn_map() {
 }
 
 pthread_mutex_t g_conn_map_mu = PTHREAD_MUTEX_INITIALIZER;
+pthread_cond_t g_conn_unpinned_cv = PTHREAD_COND_INITIALIZER;
 
-// Returns the connection with c->mu ALREADY HELD (lock coupling: acquired
-// under the map mutex, so pl_sqlite_close — which takes map mutex then
-// c->mu — can never free a connection between lookup and lock; the caller
-// releases c->mu via MutexGuard).
-SqliteConn* sqlite_conn_locked(const std::string& path) {
+// Pin-then-lock: the connection is PINNED under the map mutex (so
+// pl_sqlite_close can never free it while in use) but its per-connection
+// mutex is taken only AFTER the map mutex is released — a slow ingest on
+// one database never blocks other databases' ingest or close. The caller
+// releases via ConnGuard (mutex unlock, then unpin + broadcast).
+SqliteConn* sqlite_conn_pinned(const std::string& path) {
   SqliteApi& api = sqlite_api();
   if (!api.ok) return nullptr;
   pthread_mutex_lock(&g_conn_map_mu);
@@ -832,10 +828,24 @@ SqliteConn* sqlite_conn_locked(const std::string& path) {
     c = new SqliteConn{db};
     conns.emplace(path, c);
   }
-  pthread_mutex_lock(&c->mu);
+  c->pins++;
   pthread_mutex_unlock(&g_conn_map_mu);
+  pthread_mutex_lock(&c->mu);
   return c;
 }
+
+struct ConnGuard {  // RAII: unlock the conn mutex, then unpin
+  SqliteConn* c;
+  explicit ConnGuard(SqliteConn* conn) : c(conn) {}
+  ~ConnGuard() {
+    pthread_mutex_unlock(&c->mu);
+    pthread_mutex_lock(&g_conn_map_mu);
+    c->pins--;
+    pthread_cond_broadcast(&g_conn_unpinned_cv);
+    pthread_mutex_unlock(&g_conn_map_mu);
+  }
+  ConnGuard(const ConnGuard&) = delete;
+};
 
 // JSON text for the properties/tags columns. Value-parity with Python's
 // json.dumps (what the read path json.loads back): shortest-round-trip
@@ -968,9 +978,9 @@ extern "C" int64_t pl_ingest_sqlite(const uint8_t* body, int64_t body_len,
                                     uint8_t** out_buf) {
   SqliteApi& api = sqlite_api();
   if (!api.ok) return -2;
-  SqliteConn* conn = sqlite_conn_locked(db_path);
+  SqliteConn* conn = sqlite_conn_pinned(db_path);
   if (conn == nullptr) return -2;
-  MutexGuard guard(&conn->mu);  // held for the whole call (incl. throws)
+  ConnGuard guard(conn);  // held for the whole call (incl. throws)
   sqlite3* db = conn->db;
   try {
     Parser parser{body, body + body_len};
@@ -1127,11 +1137,14 @@ extern "C" void pl_sqlite_close(const char* db_path) {
     auto it = conns.find(key);
     if (it == conns.end()) return;
     SqliteConn* c = it->second;
-    pthread_mutex_lock(&c->mu);   // wait out any in-flight transaction
+    // wait out in-flight users: pins only change under the map mutex, and
+    // new users can't appear while we hold it — pins==0 means nobody holds
+    // or can acquire c->mu
+    while (c->pins > 0)
+      pthread_cond_wait(&g_conn_unpinned_cv, &g_conn_map_mu);
     api.close_v2(c->db);
-    pthread_mutex_unlock(&c->mu);
     delete c;
-    conns.erase(it);
+    conns.erase(key);
   };
   if (db_path == nullptr) {
     std::vector<std::string> keys;

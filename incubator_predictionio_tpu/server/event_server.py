@@ -179,7 +179,13 @@ class EventServer:
         return data
 
     def _authenticate(self, request: web.Request) -> AuthData:
-        key = self._extract_key(request)
+        return self._authenticate_parts(
+            self._extract_key(request), request.query.get("channel"))
+
+    def _authenticate_parts(self, key: Optional[str],
+                            channel_name: Optional[str]) -> AuthData:
+        """(key, channel) → AuthData or web.HTTPUnauthorized — the request-
+        free core, shared with the native HTTP front's sync handler."""
         if not key:
             raise web.HTTPUnauthorized(
                 text=json.dumps({"message": "Missing accessKey."}),
@@ -194,7 +200,6 @@ class EventServer:
                 content_type="application/json",
             )
         channel_id = None
-        channel_name = request.query.get("channel")
         if channel_name:
             channels = self.storage.get_meta_data_channels().get_by_app_id(
                 access_key.app_id
@@ -535,12 +540,125 @@ class EventServer:
         # more than parsing the request at ingestion rates
         self._runner = web.AppRunner(self.make_app(), access_log=None)
         await self._runner.setup()
+        use_front = (os.environ.get("PIO_NATIVE_HTTP", "1") != "0"
+                     and self.config.ssl_cert is None
+                     and self._native_front_possible())
+        if use_front:
+            # aiohttp becomes the loopback BACKEND; the native epoll front
+            # owns the public port, answers the hot ingest routes through
+            # _native_http_handler, and tunnels every other connection here
+            site = web.TCPSite(self._runner, "127.0.0.1", 0)
+            await site.start()
+            backend_port = site._server.sockets[0].getsockname()[1]
+            from incubator_predictionio_tpu import native
+
+            self._front = native.http_front_start(
+                self.config.ip, self.config.port, backend_port,
+                self._native_http_handler)
+            if self._front is not None:
+                logger.info(
+                    "event server listening on %s:%d (native front; "
+                    "aiohttp backend on 127.0.0.1:%d)",
+                    self.config.ip, self.config.port, backend_port)
+                return
+            # front failed to start (no native lib, port busy...): fall back
+            await self._runner.cleanup()
+            self._runner = web.AppRunner(self.make_app(), access_log=None)
+            await self._runner.setup()
         site = web.TCPSite(self._runner, self.config.ip, self.config.port,
                            ssl_context=_ssl_context(self.config))
         await site.start()
         logger.info("event server listening on %s:%d", self.config.ip, self.config.port)
 
+    def _native_front_possible(self) -> bool:
+        """The front only pays off when the hot routes can complete without
+        aiohttp: a storage backend with a C ingest sink and no input
+        plugins. (Everything else would tunnel anyway.)"""
+        from incubator_predictionio_tpu import native
+        from incubator_predictionio_tpu.server.plugins import EVENT_SERVER_PLUGINS
+
+        if EVENT_SERVER_PLUGINS or native.get_lib() is None:
+            return False
+        return getattr(self.storage.get_events(), "ingest_raw", None) is not None
+
+    def _native_http_handler(self, method: str, path_qs: str,
+                             body: bytes) -> Optional[bytes]:
+        """Sync handler for the native front's hot routes. Returns the FULL
+        HTTP response bytes, or ``None`` to make the front tunnel this exact
+        request to aiohttp (the FALLBACK discipline: only answer what the
+        fast path fully handles — auth via query param, C-sink storage)."""
+        import urllib.parse
+
+        def resp(status: int, reason: str, payload) -> bytes:
+            body_b = json.dumps(payload).encode()
+            return (f"HTTP/1.1 {status} {reason}\r\n"
+                    f"Content-Type: application/json; charset=utf-8\r\n"
+                    f"Content-Length: {len(body_b)}\r\n"
+                    f"Connection: keep-alive\r\n\r\n").encode() + body_b
+
+        try:
+            path, _, qs = path_qs.partition("?")
+            if method == "GET" and path == "/":
+                return resp(200, "OK", {"status": "alive"})
+            q = urllib.parse.parse_qs(qs)
+            key = (q.get("accessKey") or [None])[0]
+            channel = (q.get("channel") or [None])[0]
+            if not key:
+                return None  # Basic-auth header path: aiohttp owns it
+            if path == "/events.json" and self.config.stats:
+                return None  # stats needs the parsed payload fields
+            try:
+                auth = self._authenticate_cached_sync(key, channel)
+            except web.HTTPException as e:
+                return resp(e.status, e.reason, json.loads(e.text))
+            single = path == "/events.json"
+            store = self.storage.get_events()
+            self._ensure_init(auth)
+            fast = self._insert_healing(
+                lambda: store.ingest_raw(
+                    body, single, MAX_BATCH_SIZE, auth.events,
+                    auth.app_id, auth.channel_id),
+                auth)
+            if fast is None:
+                return None  # C sink declined: aiohttp reproduces exactly
+            if single:
+                r = fast[0]
+                if r["status"] == 201:
+                    return resp(201, "Created", {"eventId": r["eventId"]})
+                reason = "Bad Request" if r["status"] == 400 else "Forbidden"
+                return resp(r["status"], reason, {"message": r["message"]})
+            return resp(200, "OK", fast)
+        except Exception:  # noqa: BLE001 - never kill the epoll loop
+            logger.exception("native front handler error; tunneling")
+            return None
+
+    def _authenticate_cached_sync(self, key: Optional[str],
+                                  channel: Optional[str]) -> AuthData:
+        """Sync twin of _authenticate_cached for the native front's thread
+        (dict ops are GIL-atomic; the TTL semantics are identical)."""
+        if self._AUTH_TTL <= 0:
+            return self._authenticate_parts(key, channel)
+        now = time.monotonic()
+        hit = self._auth_cache.get((key, channel))
+        if hit is not None and hit[0] > now:
+            return hit[1]
+        try:
+            data = self._authenticate_parts(key, channel)
+        except web.HTTPException:
+            self._auth_cache.pop((key, channel), None)
+            raise
+        if len(self._auth_cache) > 1024:
+            self._auth_cache.clear()
+        self._auth_cache[(key, channel)] = (now + self._AUTH_TTL, data)
+        return data
+
     async def shutdown(self) -> None:
+        front = getattr(self, "_front", None)
+        if front is not None:
+            from incubator_predictionio_tpu import native
+
+            native.http_front_stop(front)
+            self._front = None
         if self._runner is not None:
             await self._runner.cleanup()
         self._executor.shutdown(wait=False)

@@ -1,0 +1,256 @@
+"""Native HTTP front (native/src/httpfront.cc) behavior and parity.
+
+The front owns the public port, answers the hot ingest routes through the
+event server's sync handler (which runs the C ingest sinks), and downgrades
+any connection that sends a non-hot request into a transparent byte tunnel
+to the aiohttp backend. Every client-visible behavior must match a plain
+aiohttp server: this suite drives identical scenario lists against both and
+compares (status, body) pairs, plus exercises the front-specific seams —
+keep-alive across hot requests, mixed hot→cold downgrade mid-connection,
+pipelined-ish sequential reuse, 401s, and Basic-auth tunneling.
+"""
+
+import asyncio
+import http.client
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from incubator_predictionio_tpu import native
+from incubator_predictionio_tpu.data.storage import AccessKey, App, Storage
+from incubator_predictionio_tpu.server.event_server import (
+    EventServer,
+    EventServerConfig,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library unavailable"
+)
+
+
+class LiveServer:
+    """EventServer started via start() (the real boot path that raises the
+    native front) on an ephemeral port, on a background loop thread."""
+
+    def __init__(self, tmp_path, name, native_front=True):
+        conf = {
+            f"PIO_STORAGE_SOURCES_{name}_TYPE": "eventlog",
+            f"PIO_STORAGE_SOURCES_{name}_PATH": str(tmp_path / name),
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": name,
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": name,
+            "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+            "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "MEM",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+        }
+        self.storage = Storage(conf)
+        self.app_id = self.storage.get_meta_data_apps().insert(App(0, name))
+        self.storage.get_events().init(self.app_id)
+        self.key = self.storage.get_meta_data_access_keys().insert(
+            AccessKey("", self.app_id, ()))
+        self.port = _free_port()
+        self.native_front = native_front
+        self._loop = None
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._started = threading.Event()
+        self._thread.start()
+        assert self._started.wait(10)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            try:
+                conn = http.client.HTTPConnection("127.0.0.1", self.port,
+                                                  timeout=1)
+                conn.request("GET", "/")
+                conn.getresponse().read()
+                conn.close()
+                return
+            except OSError:
+                time.sleep(0.05)
+        raise RuntimeError("server did not come up")
+
+    def _run(self):
+        import os
+
+        async def main():
+            os.environ["PIO_NATIVE_HTTP"] = "1" if self.native_front else "0"
+            self.server = EventServer(
+                EventServerConfig(ip="127.0.0.1", port=self.port),
+                storage=self.storage)
+            await self.server.start()
+            self._started.set()
+            await self._stop_event.wait()
+            await self.server.shutdown()
+
+        self._stop_event = None
+
+        async def boot():
+            self._stop_event = asyncio.Event()
+            await main()
+
+        self._loop = asyncio.new_event_loop()
+        self._loop.run_until_complete(boot())
+
+    def close(self):
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._stop_event.set)
+            self._thread.join(timeout=10)
+        self.storage.close()
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _request(port, method, path, body=None, headers=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    try:
+        conn.request(method, path, body=body, headers=headers or {})
+        r = conn.getresponse()
+        data = r.read()
+        try:
+            parsed = json.loads(data)
+        except ValueError:
+            parsed = data.decode(errors="replace")
+        return r.status, parsed
+    finally:
+        conn.close()
+
+
+def _norm(obj):
+    """Event ids are random and the scenario events carry server-stamped
+    times (no explicit eventTime) — collapse both for comparisons."""
+    if isinstance(obj, list):
+        return [_norm(o) for o in obj]
+    if isinstance(obj, dict):
+        return {k: ("<stamped>" if k in ("eventId", "eventTime",
+                                         "creationTime") else _norm(v))
+                for k, v in obj.items()}
+    return obj
+
+
+SCENARIOS = [
+    ("GET", "/", None),
+    ("POST", "/batch/events.json?accessKey={key}", json.dumps(
+        [{"event": "buy", "entityType": "user", "entityId": "u1",
+          "targetEntityType": "item", "targetEntityId": "i1"},
+         {"event": "$unset", "entityType": "user", "entityId": "u2"},
+         {"event": "view", "entityType": "user", "entityId": "u3",
+          "targetEntityType": "item", "targetEntityId": "i2",
+          "properties": {"n": 1.5, "s": "café"}}])),
+    ("POST", "/events.json?accessKey={key}", json.dumps(
+        {"event": "rate", "entityType": "user", "entityId": "u4",
+         "targetEntityType": "item", "targetEntityId": "i1",
+         "properties": {"rating": 5}})),
+    ("POST", "/events.json?accessKey={key}",
+     json.dumps({"event": "", "entityType": "u", "entityId": "x"})),
+    ("POST", "/batch/events.json?accessKey=wrongkey", "[]"),
+    ("POST", "/batch/events.json", "[]"),           # missing key → 401
+    ("POST", "/batch/events.json?accessKey={key}", "{nope"),   # tunneled 400
+    ("GET", "/events.json?accessKey={key}&limit=50", None),    # tunneled read
+    ("GET", "/events.json?accessKey={key}&event=buy", None),
+    ("POST", "/batch/events.json?accessKey={key}", json.dumps(
+        [{"event": f"e{i}", "entityType": "t", "entityId": str(i)}
+         for i in range(51)])),                      # oversize → tunneled 400
+]
+
+
+def test_front_matches_plain_aiohttp(tmp_path):
+    """Same scenario list against the native front and a plain aiohttp
+    server: every (status, normalized body) pair must be identical."""
+    results = {}
+    for mode, name in ((True, "FR"), (False, "PL")):
+        srv = LiveServer(tmp_path, name, native_front=mode)
+        try:
+            out = []
+            for method, path, body in SCENARIOS:
+                status, parsed = _request(
+                    srv.port, method, path.format(key=srv.key), body)
+                out.append((status, _norm(parsed)))
+            results[name] = out
+        finally:
+            srv.close()
+    for i, (fr, pl) in enumerate(zip(results["FR"], results["PL"])):
+        # find() results sort identically (same inserts, same order)
+        assert fr == pl, (i, SCENARIOS[i][1], fr, pl)
+
+
+def test_front_keepalive_and_mixed_mode_downgrade(tmp_path):
+    """One raw keep-alive connection: hot, hot, COLD (downgrades to tunnel),
+    then another request on the same (now tunneled) connection — every
+    response must still be correct and ordered."""
+    srv = LiveServer(tmp_path, "MX")
+    try:
+        s = socket.create_connection(("127.0.0.1", srv.port), timeout=10)
+
+        def send(method, path, body=b""):
+            head = (f"{method} {path} HTTP/1.1\r\nHost: x\r\n"
+                    f"Content-Length: {len(body)}\r\n\r\n").encode()
+            s.sendall(head + body)
+
+        def read_resp():
+            buf = b""
+            while b"\r\n\r\n" not in buf:
+                buf += s.recv(65536)
+            head, _, rest = buf.partition(b"\r\n\r\n")
+            clen = 0
+            for ln in head.split(b"\r\n"):
+                if ln.lower().startswith(b"content-length:"):
+                    clen = int(ln.split(b":")[1])
+            while len(rest) < clen:
+                rest += s.recv(65536)
+            status = int(head.split(b" ")[1])
+            return status, json.loads(rest[:clen]), rest[clen:]
+
+        body = json.dumps([{"event": "buy", "entityType": "u",
+                            "entityId": "1"}]).encode()
+        send("POST", f"/batch/events.json?accessKey={srv.key}", body)
+        st, r1, extra = read_resp()
+        assert st == 200 and r1[0]["status"] == 201 and not extra
+        send("GET", "/")
+        st, r2, extra = read_resp()
+        assert st == 200 and r2 == {"status": "alive"} and not extra
+        # COLD request: the connection downgrades to a tunnel
+        send("GET", f"/events.json?accessKey={srv.key}&limit=10")
+        st, r3, extra = read_resp()
+        assert st == 200 and len(r3) == 1 and not extra
+        # still usable after the downgrade (served by aiohttp now)
+        send("POST", f"/batch/events.json?accessKey={srv.key}", body)
+        st, r4, extra = read_resp()
+        assert st == 200 and r4[0]["status"] == 201 and not extra
+        s.close()
+        assert sum(1 for _ in srv.storage.get_events().find(srv.app_id)) == 2
+    finally:
+        srv.close()
+
+
+def test_front_basic_auth_tunnels(tmp_path):
+    """No accessKey query param → the front must tunnel so aiohttp's
+    Basic-auth extraction handles it (the front never sees headers)."""
+    import base64
+
+    srv = LiveServer(tmp_path, "BA")
+    try:
+        token = base64.b64encode(f"{srv.key}:".encode()).decode()
+        status, parsed = _request(
+            srv.port, "POST", "/batch/events.json",
+            json.dumps([{"event": "buy", "entityType": "u", "entityId": "1"}]),
+            headers={"Authorization": f"Basic {token}"})
+        assert status == 200 and parsed[0]["status"] == 201
+    finally:
+        srv.close()
+
+
+def test_front_disabled_by_env(tmp_path, monkeypatch):
+    srv = LiveServer(tmp_path, "OFF", native_front=False)
+    try:
+        assert getattr(srv.server, "_front", None) is None
+        status, parsed = _request(srv.port, "GET", "/")
+        assert status == 200 and parsed == {"status": "alive"}
+    finally:
+        srv.close()
