@@ -184,8 +184,12 @@ def available() -> bool:
 # ---------------------------------------------------------------------------
 
 _HTTP_HANDLER = ctypes.CFUNCTYPE(
-    ctypes.c_int32, ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
-    ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64)
+    ctypes.c_int32, ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p,
+    ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64)
+
+#: handler return sentinel: "I scheduled async work; I will call
+#: http_front_complete(front, token, response_bytes) later"
+HTTP_PENDING = object()
 
 
 class _HttpFront:
@@ -202,7 +206,8 @@ def _bind_http(lib) -> None:
         return
     lib.pl_http_start.restype = ctypes.c_void_p
     lib.pl_http_start.argtypes = [
-        ctypes.c_char_p, ctypes.c_int32, ctypes.c_int32, _HTTP_HANDLER]
+        ctypes.c_char_p, ctypes.c_int32, ctypes.c_int32, ctypes.c_char_p,
+        _HTTP_HANDLER]
     lib.pl_http_port.restype = ctypes.c_int32
     lib.pl_http_port.argtypes = [ctypes.c_void_p]
     lib.pl_http_stop.restype = None
@@ -210,36 +215,54 @@ def _bind_http(lib) -> None:
     lib.pl_http_respond.restype = None
     lib.pl_http_respond.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
+    lib.pl_http_complete.restype = None
+    lib.pl_http_complete.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_int64]
     lib._http_bound = True
 
 
-def http_front_start(ip: str, port: int, backend_port: int, handler):
-    """Start the native epoll HTTP front. ``handler(method, path_qs, body)``
-    runs on the epoll thread and returns full HTTP response bytes, or None
-    to tunnel the request to the aiohttp backend. Returns an opaque handle
-    (pass to :func:`http_front_stop`) or None."""
+def http_front_start(ip: str, port: int, backend_port: int, handler,
+                     hot_routes: str = ("POST /events.json,"
+                                        "POST /batch/events.json,GET /")):
+    """Start the native epoll HTTP front. ``handler(token, method, path_qs,
+    body)`` runs on the epoll thread and returns: full HTTP response bytes
+    (answered inline), ``None`` (tunnel the request to the aiohttp
+    backend), or :data:`HTTP_PENDING` (the handler scheduled async work and
+    will call :func:`http_front_complete` with the token). Returns an
+    opaque handle (pass to :func:`http_front_stop`) or None."""
     lib = get_lib()
     if lib is None:
         return None
     _bind_http(lib)
 
     @_HTTP_HANDLER
-    def cb(ctx, method, path_qs, body_ptr, body_len):
+    def cb(ctx, token, method, path_qs, body_ptr, body_len):
         try:
             body = ctypes.string_at(body_ptr, body_len) if body_len else b""
-            resp = handler(method.decode(), path_qs.decode(), body)
+            resp = handler(token, method.decode(), path_qs.decode(), body)
             if resp is None:
                 return 1  # tunnel
+            if resp is HTTP_PENDING:
+                return 2
             lib.pl_http_respond(ctx, resp, len(resp))
             return 0
         except Exception:  # noqa: BLE001 - the epoll loop must survive
             logger.exception("http front handler raised; tunneling")
             return 1
 
-    ptr = lib.pl_http_start(ip.encode(), port, backend_port, cb)
+    ptr = lib.pl_http_start(ip.encode(), port, backend_port,
+                            hot_routes.encode(), cb)
     if not ptr:
         return None
     return _HttpFront(ptr, cb)
+
+
+def http_front_complete(front, token: int, response: bytes) -> None:
+    """Deliver a PENDING request's full HTTP response bytes (any thread)."""
+    lib = _lib
+    if lib is None or front is None or front.ptr is None:
+        return
+    lib.pl_http_complete(front.ptr, token, response, len(response))
 
 
 def http_front_port(front) -> int:

@@ -43,9 +43,10 @@ namespace {
 constexpr size_t kMaxHead = 16 * 1024;
 constexpr size_t kMaxBody = 8 * 1024 * 1024;
 
-// handler fills the response via pl_http_respond(ctx,...); returns 0 on
-// success, nonzero = "tunnel this request instead"
-typedef int32_t (*HandlerFn)(void* ctx, const char* method,
+// handler return codes: 0 = responded inline (via pl_http_respond),
+// 1 = tunnel this request, 2 = PENDING — the response arrives later via
+// pl_http_complete(token) from any thread (async serving handlers)
+typedef int32_t (*HandlerFn)(void* ctx, uint64_t token, const char* method,
                              const char* path_qs, const uint8_t* body,
                              int64_t body_len);
 
@@ -57,18 +58,27 @@ struct Conn {
   std::string in;            // buffered inbound bytes (front side, pre-parse)
   std::string out;           // pending outbound bytes for THIS fd
   bool closing = false;      // close after out drains
+  uint64_t pending_token = 0;  // nonzero: awaiting pl_http_complete
+  bool pending_keep_alive = true;
 };
 
 struct Server {
+  std::vector<std::string> hot_routes;  // "METHOD path" entries
   int listen_fd = -1;
   int epoll_fd = -1;
-  int wake_fd = -1;          // eventfd: stop signal
+  int wake_fd = -1;          // eventfd: stop OR completions pending
   int backend_port = 0;
   HandlerFn handler = nullptr;
   pthread_t thread{};
   bool running = false;
+  bool stopping = false;
   std::unordered_map<int, Conn*> conns;
   std::string resp_scratch;  // filled by pl_http_respond during a callback
+  // deferred completions (any thread → epoll thread)
+  pthread_mutex_t comp_mu = PTHREAD_MUTEX_INITIALIZER;
+  std::vector<std::pair<uint64_t, std::string>> completions;
+  std::unordered_map<uint64_t, int> pending;  // token -> fd
+  uint64_t next_token = 1;
 };
 
 void set_nonblock(int fd) {
@@ -84,6 +94,13 @@ void epoll_mod(Server* s, int fd, uint32_t events) {
 }
 
 void close_conn(Server* s, Conn* c) {
+  if (c->pending_token != 0) {
+    // a completion may still arrive for this token; forget the mapping so
+    // it is dropped instead of touching a freed conn
+    pthread_mutex_lock(&s->comp_mu);
+    s->pending.erase(c->pending_token);
+    pthread_mutex_unlock(&s->comp_mu);
+  }
   auto drop = [&](int fd) {
     if (fd < 0) return;
     epoll_ctl(s->epoll_fd, EPOLL_CTL_DEL, fd, nullptr);
@@ -180,13 +197,11 @@ int parse_head(const std::string& in, ReqHead& h) {
   return 1;
 }
 
-bool is_hot(const ReqHead& h) {
+bool is_hot(const Server* s, const ReqHead& h) {
   if (h.chunked || (size_t)h.content_length > kMaxBody) return false;
-  std::string path = h.path_qs.substr(0, h.path_qs.find('?'));
-  if (h.method == "POST" &&
-      (path == "/events.json" || path == "/batch/events.json"))
-    return true;
-  if (h.method == "GET" && path == "/") return true;
+  std::string key = h.method + " " + h.path_qs.substr(0, h.path_qs.find('?'));
+  for (const auto& r : s->hot_routes)
+    if (r == key) return true;
   return false;
 }
 
@@ -234,6 +249,7 @@ const char* k400 =
 
 void process_front(Server* s, Conn* c) {
   while (true) {
+    if (c->pending_token != 0) return;  // in-order responses: wait it out
     ReqHead h;
     int r = parse_head(c->in, h);
     if (r == 0) return;  // need more bytes
@@ -243,7 +259,7 @@ void process_front(Server* s, Conn* c) {
       flush_out(s, c);
       return;
     }
-    if (!is_hot(h)) {
+    if (!is_hot(s, h)) {
       if (!start_tunnel(s, c)) {
         c->out += k400;
         c->closing = true;
@@ -253,10 +269,26 @@ void process_front(Server* s, Conn* c) {
     }
     size_t total = h.head_len + (size_t)h.content_length;
     if (c->in.size() < total) return;  // body incomplete
+    // pre-assign a completion token (only consumed if the handler returns
+    // PENDING); registered before the call so a completion can never race
+    // ahead of the registration
+    pthread_mutex_lock(&s->comp_mu);
+    uint64_t token = s->next_token++;
+    s->pending.emplace(token, c->fd);
+    pthread_mutex_unlock(&s->comp_mu);
     s->resp_scratch.clear();
     int32_t rc = s->handler(
-        s, h.method.c_str(), h.path_qs.c_str(),
+        s, token, h.method.c_str(), h.path_qs.c_str(),
         (const uint8_t*)c->in.data() + h.head_len, h.content_length);
+    if (rc == 2) {  // PENDING: response arrives via pl_http_complete
+      c->pending_token = token;
+      c->pending_keep_alive = h.keep_alive;
+      c->in.erase(0, total);
+      return;
+    }
+    pthread_mutex_lock(&s->comp_mu);
+    s->pending.erase(token);
+    pthread_mutex_unlock(&s->comp_mu);
     if (rc != 0 || s->resp_scratch.empty()) {
       // handler declined (storage backend without a sync fast path, auth
       // table miss it wants aiohttp to own, internal error): tunnel the
@@ -316,6 +348,37 @@ void pump(Server* s, Conn* c) {
   }
 }
 
+void drain_completions(Server* s) {
+  std::vector<std::pair<uint64_t, std::string>> done;
+  pthread_mutex_lock(&s->comp_mu);
+  done.swap(s->completions);
+  pthread_mutex_unlock(&s->comp_mu);
+  for (auto& [token, resp] : done) {
+    pthread_mutex_lock(&s->comp_mu);
+    auto it = s->pending.find(token);
+    int fd = (it != s->pending.end()) ? it->second : -1;
+    if (it != s->pending.end()) s->pending.erase(it);
+    pthread_mutex_unlock(&s->comp_mu);
+    if (fd < 0) continue;  // connection died first
+    auto cit = s->conns.find(fd);
+    if (cit == s->conns.end()) continue;
+    Conn* c = cit->second;
+    if (c->pending_token != token) continue;
+    c->pending_token = 0;
+    c->out += resp;
+    if (!c->pending_keep_alive) c->closing = true;
+    if (!flush_out(s, c)) {
+      close_conn(s, c);
+      continue;
+    }
+    if (c->closing && c->out.empty()) {
+      close_conn(s, c);
+      continue;
+    }
+    process_front(s, c);  // a buffered next request may be waiting
+  }
+}
+
 void* loop(void* arg) {
   Server* s = (Server*)arg;
   epoll_event evs[64];
@@ -327,7 +390,14 @@ void* loop(void* arg) {
     }
     for (int i = 0; i < n; i++) {
       int fd = evs[i].data.fd;
-      if (fd == s->wake_fd) return nullptr;  // stop requested
+      if (fd == s->wake_fd) {
+        uint64_t v = 0;
+        ssize_t unused = read(s->wake_fd, &v, sizeof v);
+        (void)unused;
+        if (s->stopping) return nullptr;
+        drain_completions(s);
+        continue;
+      }
       if (fd == s->listen_fd) {
         while (true) {
           int cfd = accept(s->listen_fd, nullptr, nullptr);
@@ -386,11 +456,23 @@ void pl_http_respond(void* server, const uint8_t* data, int64_t len) {
 // Start the front: listen on (ip, port), tunnel non-hot traffic to
 // 127.0.0.1:backend_port, dispatch hot routes to `handler`. Returns an
 // opaque handle or NULL.
+// hot_routes: comma-separated "METHOD path" entries, e.g.
+// "POST /events.json,GET /" — everything else tunnels
 void* pl_http_start(const char* ip, int32_t port, int32_t backend_port,
-                    HandlerFn handler) {
+                    const char* hot_routes, HandlerFn handler) {
   Server* s = new Server;
   s->backend_port = backend_port;
   s->handler = handler;
+  {
+    std::string all(hot_routes ? hot_routes : "");
+    size_t pos = 0;
+    while (pos <= all.size()) {
+      size_t c = all.find(',', pos);
+      if (c == std::string::npos) c = all.size();
+      if (c > pos) s->hot_routes.push_back(all.substr(pos, c - pos));
+      pos = c + 1;
+    }
+  }
   s->listen_fd = socket(AF_INET, SOCK_STREAM, 0);
   if (s->listen_fd < 0) {
     delete s;
@@ -439,10 +521,27 @@ int32_t pl_http_port(void* server) {
   return (int32_t)ntohs(addr.sin_port);
 }
 
+// Complete a PENDING request from any thread: enqueue the full HTTP
+// response bytes for `token` and wake the epoll loop. Dropped silently if
+// the connection already died.
+void pl_http_complete(void* server, uint64_t token, const uint8_t* data,
+                      int64_t len) {
+  Server* s = (Server*)server;
+  if (s == nullptr) return;
+  pthread_mutex_lock(&s->comp_mu);
+  s->completions.emplace_back(
+      token, std::string((const char*)data, (size_t)len));
+  pthread_mutex_unlock(&s->comp_mu);
+  uint64_t v = 1;
+  ssize_t unused = write(s->wake_fd, &v, sizeof v);
+  (void)unused;
+}
+
 void pl_http_stop(void* server) {
   Server* s = (Server*)server;
   if (s == nullptr) return;
   if (s->running) {
+    s->stopping = true;
     uint64_t v = 1;
     ssize_t unused = write(s->wake_fd, &v, sizeof v);
     (void)unused;

@@ -581,7 +581,7 @@ class EventServer:
             return False
         return getattr(self.storage.get_events(), "ingest_raw", None) is not None
 
-    def _native_http_handler(self, method: str, path_qs: str,
+    def _native_http_handler(self, _token: int, method: str, path_qs: str,
                              body: bytes) -> Optional[bytes]:
         """Sync handler for the native front's hot routes. Returns the FULL
         HTTP response bytes, or ``None`` to make the front tunnel this exact

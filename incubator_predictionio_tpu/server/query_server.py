@@ -541,15 +541,22 @@ class QueryServer:
 </html>"""
 
     async def handle_query(self, request: web.Request) -> web.Response:
+        status, result = await self._serve_payload(await request.read())
+        return web.json_response(result, status=status)
+
+    async def _serve_payload(self, body: bytes) -> tuple[int, Any]:
+        """The whole query lifecycle from raw body bytes — ONE code path
+        shared by the aiohttp route and the native front, so their behavior
+        cannot drift."""
         t0 = time.time()
         try:
-            payload = await request.json()
+            payload = json.loads(body)
         except json.JSONDecodeError:
-            return web.json_response({"message": "Invalid JSON query"}, status=400)
+            return 400, {"message": "Invalid JSON query"}
         try:
             prediction = await self.batcher.submit(payload)
         except (TypeError, ValueError, KeyError) as e:
-            return web.json_response({"message": f"Invalid query: {e}"}, status=400)
+            return 400, {"message": f"Invalid query: {e}"}
         except Exception as e:  # noqa: BLE001 - ship serving errors remotely
             self._ship_remote_log(f"query failed: {e!r}")
             raise
@@ -568,7 +575,7 @@ class QueryServer:
             task = asyncio.create_task(self._send_feedback(payload, result))
             self._feedback_tasks.add(task)
             task.add_done_callback(self._feedback_tasks.discard)
-        return web.json_response(result)
+        return 200, result
 
     @staticmethod
     async def _post_json(url: str, body: dict, what: str) -> None:
@@ -669,22 +676,91 @@ class QueryServer:
 
     # -- lifecycle --------------------------------------------------------
     async def start(self) -> None:
+        import os
+
         from incubator_predictionio_tpu.server.event_server import _ssl_context
 
         self._runner = web.AppRunner(self.make_app())
         await self._runner.setup()
+        # OPT-IN for serving (measured a wash on single-core CPU: the
+        # cross-thread completion hops cost what the aiohttp cycle saved —
+        # PERF.md round-5; multi-core / TPU hosts may differ, hence the knob)
+        if (os.environ.get("PIO_NATIVE_HTTP_SERVING", "0") == "1"
+                and os.environ.get("PIO_NATIVE_HTTP", "1") != "0"
+                and self.config.ssl_cert is None):
+            from incubator_predictionio_tpu import native
+
+            site = web.TCPSite(self._runner, "127.0.0.1", 0)
+            await site.start()
+            backend_port = site._server.sockets[0].getsockname()[1]
+            self._loop = asyncio.get_running_loop()
+            self._front = native.http_front_start(
+                self.config.ip, self.config.port, backend_port,
+                self._native_http_handler,
+                hot_routes="POST /queries.json")
+            if self._front is not None:
+                logger.info(
+                    "engine server listening on %s:%d (native front; "
+                    "aiohttp backend on 127.0.0.1:%d)",
+                    self.config.ip, self.config.port, backend_port)
+                return
+            await self._runner.cleanup()
+            self._runner = web.AppRunner(self.make_app())
+            await self._runner.setup()
         site = web.TCPSite(self._runner, self.config.ip, self.config.port,
                            ssl_context=_ssl_context(self.config))
         await site.start()
         logger.info("engine server listening on %s:%d", self.config.ip, self.config.port)
+
+    def _native_http_handler(self, token: int, method: str, path_qs: str,
+                             body: bytes):
+        """Runs on the native front's epoll thread: schedule the query on
+        the event loop (the SAME _serve_payload path aiohttp uses) and
+        answer later via the completion token — so micro-batching keeps
+        coalescing concurrent queries across connections."""
+        from incubator_predictionio_tpu import native
+
+        loop = getattr(self, "_loop", None)
+        if loop is None or loop.is_closed():
+            return None  # tunnel
+        asyncio.run_coroutine_threadsafe(
+            self._native_serve(token, body), loop)
+        return native.HTTP_PENDING
+
+    async def _native_serve(self, token: int, body: bytes) -> None:
+        from incubator_predictionio_tpu import native
+
+        try:
+            status, result = await self._serve_payload(body)
+            payload = json.dumps(result).encode()
+            reason = {200: "OK", 400: "Bad Request"}.get(status, "Error")
+            resp = (f"HTTP/1.1 {status} {reason}\r\n"
+                    f"Content-Type: application/json; charset=utf-8\r\n"
+                    f"Content-Length: {len(payload)}\r\n"
+                    f"Connection: keep-alive\r\n\r\n").encode() + payload
+        except Exception:  # noqa: BLE001 - aiohttp would 500 here
+            logger.exception("native serving handler error")
+            body_b = b"500 Internal Server Error"
+            resp = (b"HTTP/1.1 500 Internal Server Error\r\n"
+                    b"Content-Type: text/plain; charset=utf-8\r\n"
+                    b"Content-Length: " + str(len(body_b)).encode() +
+                    b"\r\nConnection: close\r\n\r\n" + body_b)
+        native.http_front_complete(getattr(self, "_front", None), token, resp)
 
     async def wait_stopped(self) -> None:
         await self._stop_event.wait()
         await self.shutdown()
 
     async def shutdown(self) -> None:
-        # stop accepting connections BEFORE stopping the batcher — a query in
-        # the gap would otherwise resurrect the drainer task
+        # stop the native front first (no new pending queries), then stop
+        # accepting backend connections BEFORE stopping the batcher — a
+        # query in the gap would otherwise resurrect the drainer task
+        front = getattr(self, "_front", None)
+        if front is not None:
+            from incubator_predictionio_tpu import native
+
+            native.http_front_stop(front)
+            self._front = None
         if self._runner is not None:
             await self._runner.cleanup()
         await self.batcher.stop()

@@ -246,6 +246,153 @@ def test_front_basic_auth_tunnels(tmp_path):
         srv.close()
 
 
+def _train_tiny_engine(tmp_path, name):
+    """Train the tiny classification engine so a QueryServer can deploy."""
+    import datetime as dtm
+    import os
+
+    import numpy as np
+
+    from incubator_predictionio_tpu.core.workflow import run_train
+    from incubator_predictionio_tpu.data import DataMap, Event
+    from incubator_predictionio_tpu.data.storage.base import EngineInstance
+    from incubator_predictionio_tpu.parallel.mesh import MeshContext
+    from incubator_predictionio_tpu.templates.classification import (
+        ClassificationEngine,
+    )
+
+    from incubator_predictionio_tpu.data.storage import use_storage
+
+    storage = Storage({"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+    use_storage(storage)  # DataSource resolves app names via the global
+    app_id = storage.get_meta_data_apps().insert(App(0, name))
+    events = storage.get_events()
+    events.init(app_id)
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(48, 3))
+    y = (x[:, 0] > 0).astype(int)
+    for i in range(48):
+        events.insert(Event(
+            event="$set", entity_type="user", entity_id=f"u{i}",
+            properties=DataMap({"attr0": float(x[i, 0]),
+                                "attr1": float(x[i, 1]),
+                                "attr2": float(x[i, 2]), "plan": int(y[i])}),
+            event_time=dtm.datetime(2020, 1, 1, tzinfo=dtm.timezone.utc)),
+            app_id)
+    variant_path = str(tmp_path / f"{name}.json")
+    variant = {
+        "id": "default", "version": "1",
+        "engineFactory": ("incubator_predictionio_tpu.templates."
+                          "classification.ClassificationEngine"),
+        "datasource": {"params": {"appName": name}},
+        "algorithms": [{"name": "mlp", "params": {
+            "hiddenDims": [8], "epochs": 40, "learningRate": 0.03,
+            "batchSize": 48}}],
+    }
+    with open(variant_path, "w") as f:
+        json.dump(variant, f)
+    engine = ClassificationEngine().apply()
+    run_train(
+        engine, engine.engine_params_from_variant(variant),
+        EngineInstance(
+            id="", status="INIT",
+            start_time=dtm.datetime.now(dtm.timezone.utc), end_time=None,
+            engine_id="default", engine_version="1",
+            engine_variant=os.path.abspath(variant_path),
+            engine_factory=variant["engineFactory"]),
+        storage=storage, ctx=MeshContext.create())
+    return storage, variant_path, x, y
+
+
+class LiveQueryServer:
+    """QueryServer booted via start() (raises the serving front) on a
+    background loop thread."""
+
+    def __init__(self, tmp_path, name, native_front=True):
+        import os
+
+        from incubator_predictionio_tpu.server.query_server import (
+            QueryServer,
+            ServerConfig,
+        )
+
+        self.storage, variant, self.x, self.y = _train_tiny_engine(
+            tmp_path, name)
+        self.port = _free_port()
+        self._started = threading.Event()
+
+        def run():
+            os.environ["PIO_NATIVE_HTTP"] = "1" if native_front else "0"
+            os.environ["PIO_NATIVE_HTTP_SERVING"] = "1" if native_front else "0"
+
+            async def main():
+                self.server = QueryServer(
+                    ServerConfig(engine_variant=variant, ip="127.0.0.1",
+                                 port=self.port, server_access_key="sk"),
+                    storage=self.storage)
+                await self.server.start()
+                self._stop = asyncio.Event()
+                self._started.set()
+                await self._stop.wait()
+                await self.server.shutdown()
+
+            self._loop = asyncio.new_event_loop()
+            self._loop.run_until_complete(main())
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+        assert self._started.wait(60)
+
+    def close(self):
+        self._loop.call_soon_threadsafe(self._stop.set)
+        self._thread.join(timeout=15)
+        self.storage.close()
+
+
+def test_query_server_front_parity_and_batching(tmp_path):
+    """POST /queries.json through the native front (deferred completion):
+    correct predictions, invalid-query and invalid-JSON parity with the
+    aiohttp path, concurrent queries still micro-batch, tunneled GET /
+    status page reflects the traffic."""
+    results = {}
+    for mode, name in ((True, "qfront"), (False, "qplain")):
+        srv = LiveQueryServer(tmp_path, name, native_front=mode)
+        try:
+            out = []
+            for i in range(6):
+                out.append(_request(
+                    srv.port, "POST", "/queries.json",
+                    json.dumps({"features": list(map(float, srv.x[i]))})))
+            out.append(_request(srv.port, "POST", "/queries.json",
+                                json.dumps({"bogus": 1})))
+            out.append(_request(srv.port, "POST", "/queries.json", "{nope"))
+            # concurrent burst: front must keep micro-batching across conns
+            burst = [None] * 8
+            def one(slot):
+                burst[slot] = _request(
+                    srv.port, "POST", "/queries.json",
+                    json.dumps({"features": list(map(float, srv.x[slot]))}))
+            ts = [threading.Thread(target=one, args=(i,)) for i in range(8)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            out.extend(burst)
+            status, page = _request(srv.port, "GET", "/")  # tunneled
+            assert status == 200 and page["requestCount"] >= 14
+            results[name] = out
+        finally:
+            srv.close()
+    for i, (fr, pl) in enumerate(zip(results["qfront"], results["qplain"])):
+        fs, fb = fr
+        ps, pb = pl
+        assert fs == ps, (i, fr, pl)
+        if isinstance(fb, dict) and "label" in fb:
+            assert fb["label"] == pb["label"], (i, fb, pb)
+        else:
+            assert fb == pb, (i, fb, pb)
+
+
 def test_front_disabled_by_env(tmp_path, monkeypatch):
     srv = LiveServer(tmp_path, "OFF", native_front=False)
     try:
