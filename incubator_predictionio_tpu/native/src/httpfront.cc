@@ -284,6 +284,10 @@ void process_front(Server* s, Conn* c) {
       c->pending_token = token;
       c->pending_keep_alive = h.keep_alive;
       c->in.erase(0, total);
+      if (!h.keep_alive) {
+        c->closing = true;   // mirror the inline path's close discipline
+        c->in.clear();       // drop pipelined bytes we will never answer
+      }
       return;
     }
     pthread_mutex_lock(&s->comp_mu);
@@ -365,8 +369,25 @@ void drain_completions(Server* s) {
     Conn* c = cit->second;
     if (c->pending_token != token) continue;
     c->pending_token = 0;
+    // Connection-header discipline (RFC 7230 §6.1): the handler does not
+    // know the request's keep-alive flag, so the front reconciles — a
+    // close-requesting client must see "close", and a handler-declared
+    // "Connection: close" must actually close the socket.
+    size_t head_end = resp.find("\r\n\r\n");
+    std::string head_low = resp.substr(
+        0, head_end == std::string::npos ? 0 : head_end);
+    for (auto& ch : head_low) ch = (char)tolower((unsigned char)ch);
+    bool resp_says_close = head_low.find("connection: close")
+                           != std::string::npos;
+    if (!c->pending_keep_alive && !resp_says_close &&
+        head_end != std::string::npos) {
+      size_t ka = head_low.find("connection: keep-alive");
+      if (ka != std::string::npos)
+        resp = resp.substr(0, ka) + "Connection: close" +
+               resp.substr(ka + strlen("connection: keep-alive"));
+    }
+    if (!c->pending_keep_alive || resp_says_close) c->closing = true;
     c->out += resp;
-    if (!c->pending_keep_alive) c->closing = true;
     if (!flush_out(s, c)) {
       close_conn(s, c);
       continue;
@@ -375,7 +396,8 @@ void drain_completions(Server* s) {
       close_conn(s, c);
       continue;
     }
-    process_front(s, c);  // a buffered next request may be waiting
+    if (!c->closing)
+      process_front(s, c);  // a buffered next request may be waiting
   }
 }
 

@@ -547,22 +547,18 @@ class EventServer:
             # aiohttp becomes the loopback BACKEND; the native epoll front
             # owns the public port, answers the hot ingest routes through
             # _native_http_handler, and tunnels every other connection here
-            site = web.TCPSite(self._runner, "127.0.0.1", 0)
-            await site.start()
-            backend_port = site._server.sockets[0].getsockname()[1]
-            from incubator_predictionio_tpu import native
+            from incubator_predictionio_tpu.server.front_boot import (
+                start_with_native_front,
+            )
 
-            self._front = native.http_front_start(
-                self.config.ip, self.config.port, backend_port,
-                self._native_http_handler)
+            self._front = await start_with_native_front(
+                self._runner, self.config.ip, self.config.port,
+                self._native_http_handler,
+                "POST /events.json,POST /batch/events.json,GET /",
+                "event server")
             if self._front is not None:
-                logger.info(
-                    "event server listening on %s:%d (native front; "
-                    "aiohttp backend on 127.0.0.1:%d)",
-                    self.config.ip, self.config.port, backend_port)
                 return
-            # front failed to start (no native lib, port busy...): fall back
-            await self._runner.cleanup()
+            # front failed (no native lib, port busy...): plain path
             self._runner = web.AppRunner(self.make_app(), access_log=None)
             await self._runner.setup()
         site = web.TCPSite(self._runner, self.config.ip, self.config.port,

@@ -1,0 +1,48 @@
+"""Shared bootstrap for servers that raise the native HTTP front.
+
+One place owns the dance both the event server and the query server need:
+bind the aiohttp runner to an ephemeral loopback BACKEND port, start the
+native epoll front on the public (ip, port) with the given hot routes, and
+— if the front fails to come up (no native lib, port busy) — tear the
+runner down so the caller can rebuild it bound to the public port directly.
+This also confines the one unavoidable private-API poke (reading the bound
+port off ``site._server``) to a single line.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from aiohttp import web
+
+logger = logging.getLogger(__name__)
+
+
+async def start_with_native_front(
+    runner: web.AppRunner,
+    ip: str,
+    port: int,
+    handler,
+    hot_routes: str,
+    label: str,
+):
+    """Try to boot ``runner`` behind the native front.
+
+    Returns the front handle on success (the runner is serving on an
+    internal loopback port). Returns ``None`` on failure — the runner has
+    been cleaned up and the caller must create a fresh one for the plain
+    path (an AppRunner cannot be re-setup after cleanup)."""
+    from incubator_predictionio_tpu import native
+
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    backend_port = site._server.sockets[0].getsockname()[1]  # noqa: SLF001
+    front = native.http_front_start(ip, port, backend_port, handler,
+                                    hot_routes=hot_routes)
+    if front is not None:
+        logger.info("%s listening on %s:%d (native front; aiohttp backend "
+                    "on 127.0.0.1:%d)", label, ip, port, backend_port)
+        return front
+    await runner.cleanup()
+    return None

@@ -688,23 +688,17 @@ class QueryServer:
         if (os.environ.get("PIO_NATIVE_HTTP_SERVING", "0") == "1"
                 and os.environ.get("PIO_NATIVE_HTTP", "1") != "0"
                 and self.config.ssl_cert is None):
-            from incubator_predictionio_tpu import native
+            from incubator_predictionio_tpu.server.front_boot import (
+                start_with_native_front,
+            )
 
-            site = web.TCPSite(self._runner, "127.0.0.1", 0)
-            await site.start()
-            backend_port = site._server.sockets[0].getsockname()[1]
             self._loop = asyncio.get_running_loop()
-            self._front = native.http_front_start(
-                self.config.ip, self.config.port, backend_port,
-                self._native_http_handler,
-                hot_routes="POST /queries.json")
+            self._front = await start_with_native_front(
+                self._runner, self.config.ip, self.config.port,
+                self._native_http_handler, "POST /queries.json",
+                "engine server")
             if self._front is not None:
-                logger.info(
-                    "engine server listening on %s:%d (native front; "
-                    "aiohttp backend on 127.0.0.1:%d)",
-                    self.config.ip, self.config.port, backend_port)
                 return
-            await self._runner.cleanup()
             self._runner = web.AppRunner(self.make_app())
             await self._runner.setup()
         site = web.TCPSite(self._runner, self.config.ip, self.config.port,

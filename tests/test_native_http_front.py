@@ -74,6 +74,7 @@ class LiveServer:
         import os
 
         async def main():
+            self._env_before = os.environ.get("PIO_NATIVE_HTTP")
             os.environ["PIO_NATIVE_HTTP"] = "1" if self.native_front else "0"
             self.server = EventServer(
                 EventServerConfig(ip="127.0.0.1", port=self.port),
@@ -93,10 +94,16 @@ class LiveServer:
         self._loop.run_until_complete(boot())
 
     def close(self):
+        import os
+
         if self._loop is not None:
             self._loop.call_soon_threadsafe(self._stop_event.set)
             self._thread.join(timeout=10)
         self.storage.close()
+        if getattr(self, "_env_before", None) is None:
+            os.environ.pop("PIO_NATIVE_HTTP", None)
+        else:
+            os.environ["PIO_NATIVE_HTTP"] = self._env_before
 
 
 def _free_port() -> int:
@@ -322,6 +329,8 @@ class LiveQueryServer:
         self._started = threading.Event()
 
         def run():
+            self._env_before = (os.environ.get("PIO_NATIVE_HTTP"),
+                                os.environ.get("PIO_NATIVE_HTTP_SERVING"))
             os.environ["PIO_NATIVE_HTTP"] = "1" if native_front else "0"
             os.environ["PIO_NATIVE_HTTP_SERVING"] = "1" if native_front else "0"
 
@@ -344,9 +353,17 @@ class LiveQueryServer:
         assert self._started.wait(60)
 
     def close(self):
+        import os
+
         self._loop.call_soon_threadsafe(self._stop.set)
         self._thread.join(timeout=15)
         self.storage.close()
+        for var, old in zip(("PIO_NATIVE_HTTP", "PIO_NATIVE_HTTP_SERVING"),
+                            getattr(self, "_env_before", (None, None))):
+            if old is None:
+                os.environ.pop(var, None)
+            else:
+                os.environ[var] = old
 
 
 def test_query_server_front_parity_and_batching(tmp_path):
