@@ -194,11 +194,14 @@ HTTP_PENDING = object()
 
 class _HttpFront:
     """Handle keeping the server pointer AND the callback object alive
-    (a GC'd CFUNCTYPE while the epoll thread runs is a segfault)."""
+    (a GC'd CFUNCTYPE while the epoll thread runs is a segfault). The lock
+    serializes complete() against stop(): pl_http_complete from another
+    thread racing pl_http_stop's `delete` would be a use-after-free."""
 
     def __init__(self, ptr, cb):
         self.ptr = ptr
         self.cb = cb
+        self.lock = threading.Lock()
 
 
 def _bind_http(lib) -> None:
@@ -260,9 +263,12 @@ def http_front_start(ip: str, port: int, backend_port: int, handler,
 def http_front_complete(front, token: int, response: bytes) -> None:
     """Deliver a PENDING request's full HTTP response bytes (any thread)."""
     lib = _lib
-    if lib is None or front is None or front.ptr is None:
+    if lib is None or front is None:
         return
-    lib.pl_http_complete(front.ptr, token, response, len(response))
+    with front.lock:
+        if front.ptr is None:  # stopped: the client connection is gone
+            return
+        lib.pl_http_complete(front.ptr, token, response, len(response))
 
 
 def http_front_port(front) -> int:
@@ -273,12 +279,15 @@ def http_front_port(front) -> int:
 
 
 def http_front_stop(front) -> None:
-    if front is None or front.ptr is None:
+    if front is None:
         return
-    lib = _lib
-    if lib is not None:
-        lib.pl_http_stop(front.ptr)
-    front.ptr = None
+    with front.lock:
+        if front.ptr is None:
+            return
+        lib = _lib
+        if lib is not None:
+            lib.pl_http_stop(front.ptr)
+        front.ptr = None
 
 
 def _reset_for_tests() -> None:

@@ -42,6 +42,10 @@ namespace {
 
 constexpr size_t kMaxHead = 16 * 1024;
 constexpr size_t kMaxBody = 8 * 1024 * 1024;
+// tunnel backpressure: stop reading the fast side while the slow side's
+// unsent buffer is past the high watermark; resume below the low one
+constexpr size_t kHighWater = 4 * 1024 * 1024;
+constexpr size_t kLowWater = 1 * 1024 * 1024;
 
 // handler return codes: 0 = responded inline (via pl_http_respond),
 // 1 = tunnel this request, 2 = PENDING — the response arrives later via
@@ -58,6 +62,8 @@ struct Conn {
   std::string in;            // buffered inbound bytes (front side, pre-parse)
   std::string out;           // pending outbound bytes for THIS fd
   bool closing = false;      // close after out drains
+  size_t out_off = 0;        // sent prefix of `out` (avoids O(n²) erases)
+  bool throttled = false;    // EPOLLIN paused: peer's buffer past watermark
   uint64_t pending_token = 0;  // nonzero: awaiting pl_http_complete
   bool pending_keep_alive = true;
 };
@@ -79,6 +85,10 @@ struct Server {
   std::vector<std::pair<uint64_t, std::string>> completions;
   std::unordered_map<uint64_t, int> pending;  // token -> fd
   uint64_t next_token = 1;
+  // conns removed mid-batch: their fds stay OPEN (so a stale event in the
+  // same epoll batch can't alias a freshly accepted fd) and are closed +
+  // deleted after the batch drains
+  std::vector<Conn*> graveyard;
 };
 
 void set_nonblock(int fd) {
@@ -93,53 +103,82 @@ void epoll_mod(Server* s, int fd, uint32_t events) {
   epoll_ctl(s->epoll_fd, EPOLL_CTL_MOD, fd, &ev);
 }
 
-void close_conn(Server* s, Conn* c) {
+size_t out_remaining(const Conn* c) { return c->out.size() - c->out_off; }
+
+// Remove ONE conn from the event machinery. Its fd is closed only after the
+// current epoll batch (graveyard) so a stale event in the same batch can't
+// be attributed to a reused fd. The peer (if any) is detached, not closed.
+void close_one(Server* s, Conn* c) {
   if (c->pending_token != 0) {
     // a completion may still arrive for this token; forget the mapping so
     // it is dropped instead of touching a freed conn
     pthread_mutex_lock(&s->comp_mu);
     s->pending.erase(c->pending_token);
     pthread_mutex_unlock(&s->comp_mu);
+    c->pending_token = 0;
   }
-  auto drop = [&](int fd) {
-    if (fd < 0) return;
-    epoll_ctl(s->epoll_fd, EPOLL_CTL_DEL, fd, nullptr);
-    close(fd);
-    auto it = s->conns.find(fd);
-    if (it != s->conns.end()) {
-      Conn* other = it->second;
-      s->conns.erase(it);
-      if (other != c) delete other;
-    }
-  };
+  if (s->conns.erase(c->fd) == 0) return;  // already closed
+  epoll_ctl(s->epoll_fd, EPOLL_CTL_DEL, c->fd, nullptr);
+  if (c->peer_fd >= 0) {
+    auto it = s->conns.find(c->peer_fd);
+    if (it != s->conns.end()) it->second->peer_fd = -1;
+    c->peer_fd = -1;
+  }
+  s->graveyard.push_back(c);
+}
+
+// Hard close: this conn AND its tunnel peer (data integrity already lost).
+void close_conn(Server* s, Conn* c) {
   int peer = c->peer_fd;
-  drop(c->fd);
-  delete c;
+  close_one(s, c);
   if (peer >= 0) {
     auto it = s->conns.find(peer);
-    if (it != s->conns.end()) {
-      Conn* pc = it->second;
-      pc->peer_fd = -1;
-      drop(peer);
-    }
+    if (it != s->conns.end()) close_one(s, it->second);
   }
+}
+
+void reap_graveyard(Server* s) {
+  for (Conn* c : s->graveyard) {
+    close(c->fd);
+    delete c;
+  }
+  s->graveyard.clear();
 }
 
 void want_write(Server* s, Conn* c) {
-  epoll_mod(s, c->fd, EPOLLIN | (c->out.empty() ? 0 : EPOLLOUT));
+  epoll_mod(s, c->fd, (c->throttled ? 0 : EPOLLIN)
+                      | (out_remaining(c) ? EPOLLOUT : 0));
+}
+
+void maybe_resume_peer(Server* s, Conn* c) {
+  // this side drained below the low watermark: resume reading the peer
+  if (out_remaining(c) >= kLowWater || c->peer_fd < 0) return;
+  auto it = s->conns.find(c->peer_fd);
+  if (it == s->conns.end() || !it->second->throttled) return;
+  it->second->throttled = false;
+  want_write(s, it->second);
 }
 
 bool flush_out(Server* s, Conn* c) {
-  while (!c->out.empty()) {
-    ssize_t n = send(c->fd, c->out.data(), c->out.size(), MSG_NOSIGNAL);
+  while (out_remaining(c) > 0) {
+    ssize_t n = send(c->fd, c->out.data() + c->out_off, out_remaining(c),
+                     MSG_NOSIGNAL);
     if (n > 0) {
-      c->out.erase(0, (size_t)n);
+      c->out_off += (size_t)n;
     } else if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
       break;
     } else {
       return false;  // caller closes
     }
   }
+  if (c->out_off == c->out.size()) {
+    c->out.clear();
+    c->out_off = 0;
+  } else if (c->out_off > (1u << 20) && c->out_off > c->out.size() / 2) {
+    c->out.erase(0, c->out_off);  // amortized compaction, not per-send
+    c->out_off = 0;
+  }
+  maybe_resume_peer(s, c);
   want_write(s, c);
   return !(c->closing && c->out.empty());
 }
@@ -151,6 +190,7 @@ struct ReqHead {
   int64_t content_length = 0;
   bool keep_alive = true;
   bool chunked = false;
+  bool have_content_length = false;
   size_t head_len = 0;  // bytes incl. trailing CRLFCRLF
 };
 
@@ -183,8 +223,15 @@ int parse_head(const std::string& in, ReqHead& h) {
       while (vs < hl.size() && hl[vs] == ' ') vs++;
       std::string val = hl.substr(vs);
       if (name == "content-length") {
-        h.content_length = strtoll(val.c_str(), nullptr, 10);
-        if (h.content_length < 0) return -1;
+        if (h.have_content_length) return -1;  // duplicate → reject
+        if (val.empty()) return -1;
+        char* endp = nullptr;
+        errno = 0;
+        h.content_length = strtoll(val.c_str(), &endp, 10);
+        if (errno == ERANGE || endp != val.c_str() + val.size() ||
+            h.content_length < 0)
+          return -1;  // non-numeric/overflow → 400, never a stream desync
+        h.have_content_length = true;
       } else if (name == "transfer-encoding") {
         h.chunked = true;
       } else if (name == "connection") {
@@ -249,21 +296,23 @@ const char* k400 =
 
 void process_front(Server* s, Conn* c) {
   while (true) {
-    if (c->pending_token != 0) return;  // in-order responses: wait it out
+    if (c->pending_token != 0 || c->closing) return;
     ReqHead h;
     int r = parse_head(c->in, h);
     if (r == 0) return;  // need more bytes
     if (r < 0) {
+      c->in.clear();  // never re-parse (and re-answer) the bad bytes
       c->out += k400;
       c->closing = true;
-      flush_out(s, c);
+      if (!flush_out(s, c)) close_conn(s, c);
       return;
     }
     if (!is_hot(s, h)) {
       if (!start_tunnel(s, c)) {
+        c->in.clear();
         c->out += k400;
         c->closing = true;
-        flush_out(s, c);
+        if (!flush_out(s, c)) close_conn(s, c);
       }
       return;
     }
@@ -298,9 +347,10 @@ void process_front(Server* s, Conn* c) {
       // table miss it wants aiohttp to own, internal error): tunnel the
       // buffered bytes so aiohttp serves this exact request
       if (!start_tunnel(s, c)) {
+        c->in.clear();
         c->out += k400;
         c->closing = true;
-        flush_out(s, c);
+        if (!flush_out(s, c)) close_conn(s, c);
       }
       return;
     }
@@ -310,8 +360,12 @@ void process_front(Server* s, Conn* c) {
       c->closing = true;
       c->in.clear();
     }
-    flush_out(s, c);
-    if (c->closing) return;
+    if (!flush_out(s, c)) {
+      // send error, or drained with closing set: either way, done
+      close_conn(s, c);
+      return;
+    }
+    if (c->closing) return;  // close lands when EPOLLOUT drains the rest
     // loop: a pipelined next request may already be buffered
   }
 }
@@ -333,6 +387,10 @@ void pump(Server* s, Conn* c) {
           close_conn(s, peer);
           return;
         }
+        if (out_remaining(peer) > kHighWater && !c->throttled) {
+          c->throttled = true;  // stop reading until the slow side drains
+          want_write(s, c);
+        }
       } else {
         c->in.append(buf, (size_t)n);
         if (c->in.size() > kMaxHead + kMaxBody) {
@@ -344,6 +402,20 @@ void pump(Server* s, Conn* c) {
         if (it == s->conns.end() || it->second != c) return;  // closed
       }
     } else if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+      return;
+    } else if (n == 0 && c->tunneling) {
+      // orderly EOF on one tunnel side: the peer may still hold unsent
+      // response bytes — half-close so they drain before its fd closes
+      int peer_fd = c->peer_fd;
+      close_one(s, c);
+      if (peer_fd >= 0) {
+        auto it = s->conns.find(peer_fd);
+        if (it != s->conns.end()) {
+          Conn* peer = it->second;
+          peer->closing = true;
+          if (!flush_out(s, peer)) close_one(s, peer);  // already drained
+        }
+      }
       return;
     } else {
       close_conn(s, c);
@@ -440,7 +512,7 @@ void* loop(void* arg) {
       auto it = s->conns.find(fd);
       if (it == s->conns.end()) continue;
       Conn* c = it->second;
-      if (evs[i].events & (EPOLLHUP | EPOLLERR)) {
+      if (evs[i].events & EPOLLERR) {
         close_conn(s, c);
         continue;
       }
@@ -454,8 +526,9 @@ void* loop(void* arg) {
           continue;
         }
       }
-      if (evs[i].events & EPOLLIN) pump(s, c);
+      if (evs[i].events & (EPOLLIN | EPOLLHUP)) pump(s, c);
     }
+    reap_graveyard(s);
   }
   return nullptr;
 }
@@ -505,8 +578,13 @@ void* pl_http_start(const char* ip, int32_t port, int32_t backend_port,
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
   addr.sin_port = htons((uint16_t)port);
-  if (inet_pton(AF_INET, ip, &addr.sin_addr) != 1)
-    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  if (inet_pton(AF_INET, ip, &addr.sin_addr) != 1) {
+    // a malformed bind IP must FAIL (the caller falls back to aiohttp),
+    // never silently widen to INADDR_ANY
+    close(s->listen_fd);
+    delete s;
+    return nullptr;
+  }
   if (bind(s->listen_fd, (sockaddr*)&addr, sizeof addr) != 0 ||
       listen(s->listen_fd, 1024) != 0) {
     close(s->listen_fd);
@@ -516,6 +594,13 @@ void* pl_http_start(const char* ip, int32_t port, int32_t backend_port,
   set_nonblock(s->listen_fd);
   s->epoll_fd = epoll_create1(0);
   s->wake_fd = eventfd(0, EFD_NONBLOCK);
+  if (s->epoll_fd < 0 || s->wake_fd < 0) {  // fd exhaustion: fail loudly
+    close(s->listen_fd);
+    if (s->epoll_fd >= 0) close(s->epoll_fd);
+    if (s->wake_fd >= 0) close(s->wake_fd);
+    delete s;
+    return nullptr;
+  }
   epoll_event ev{};
   ev.events = EPOLLIN;
   ev.data.fd = s->listen_fd;
@@ -574,6 +659,7 @@ void pl_http_stop(void* server) {
     delete kv.second;
   }
   s->conns.clear();
+  reap_graveyard(s);
   close(s->listen_fd);
   close(s->epoll_fd);
   close(s->wake_fd);

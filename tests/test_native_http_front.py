@@ -410,6 +410,63 @@ def test_query_server_front_parity_and_batching(tmp_path):
             assert fb == pb, (i, fb, pb)
 
 
+def test_front_concurrent_mixed_stress(tmp_path):
+    """16 threads × 30 requests of mixed traffic (hot batch posts, tunneled
+    reads, hot singles, bad keys) against the ingest front: every response
+    correct, nothing hangs, final event count exact."""
+    srv = LiveServer(tmp_path, "ST")
+    try:
+        n_threads, n_reqs = 16, 30
+        errors = []
+        posted = [0] * n_threads
+
+        def work(slot):
+            try:
+                for i in range(n_reqs):
+                    kind = (slot + i) % 4
+                    if kind == 0:  # hot batch
+                        st, body = _request(
+                            srv.port, "POST",
+                            f"/batch/events.json?accessKey={srv.key}",
+                            json.dumps([{"event": "buy", "entityType": "u",
+                                         "entityId": f"s{slot}_{i}"}]))
+                        assert st == 200 and body[0]["status"] == 201, body
+                        posted[slot] += 1
+                    elif kind == 1:  # tunneled read
+                        st, body = _request(
+                            srv.port, "GET",
+                            f"/events.json?accessKey={srv.key}&limit=5")
+                        assert st == 200 and isinstance(body, list), body
+                    elif kind == 2:  # hot single
+                        st, body = _request(
+                            srv.port, "POST",
+                            f"/events.json?accessKey={srv.key}",
+                            json.dumps({"event": "view", "entityType": "u",
+                                        "entityId": f"v{slot}_{i}"}))
+                        assert st == 201 and "eventId" in body, body
+                        posted[slot] += 1
+                    else:  # bad key (hot 401)
+                        st, body = _request(
+                            srv.port, "POST",
+                            "/batch/events.json?accessKey=bad", "[]")
+                        assert st == 401, (st, body)
+            except Exception as e:  # noqa: BLE001 - collect, don't die
+                errors.append((slot, repr(e)))
+
+        ts = [threading.Thread(target=work, args=(i,))
+              for i in range(n_threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120)
+        assert not any(t.is_alive() for t in ts), "stress workers hung"
+        assert errors == [], errors[:5]
+        total = sum(1 for _ in srv.storage.get_events().find(srv.app_id))
+        assert total == sum(posted)
+    finally:
+        srv.close()
+
+
 def test_front_disabled_by_env(tmp_path, monkeypatch):
     srv = LiveServer(tmp_path, "OFF", native_front=False)
     try:
