@@ -13,11 +13,37 @@ from __future__ import annotations
 
 import datetime as _dt
 import json
+import os
 from collections.abc import Iterator, Mapping
 from dataclasses import dataclass, field, replace
 from typing import Any
 
 UTC = _dt.timezone.utc
+
+EPOCH = _dt.datetime(1970, 1, 1, tzinfo=UTC)
+_US_TD = _dt.timedelta(microseconds=1)
+
+
+def epoch_micros(t: _dt.datetime) -> int:
+    """Exact integer microseconds since the epoch — the ONE definition the
+    sqlite/postgres backends and the C ingest sink must all agree with
+    bit-for-bit. Integer arithmetic only: ``timestamp() * 1e6`` detours
+    through a double whose granularity at current epochs is ~0.24 µs and
+    then truncates, so the same event time could round differently per
+    code path. Naive datetimes are treated as UTC (storage convention)."""
+    if t.tzinfo is None:
+        t = t.replace(tzinfo=UTC)
+    return (t - EPOCH) // _US_TD
+
+
+def time_prefixed_event_id(creation_time: _dt.datetime) -> str:
+    """Server-generated event id: 15 hex chars of creation micros + 16
+    random hex + '0'. The monotonic prefix appends at the btree right edge
+    instead of the classic random-UUID-PK insert wall (same idea as the
+    reference's time-ordered HBase rowkeys, HBEventsUtil.scala:76-131);
+    ids stay opaque 32-hex to clients."""
+    return f"{epoch_micros(creation_time):015x}" + os.urandom(8).hex() + "0"
+
 
 # Reserved name prefixes (Event.scala:77-78).
 _RESERVED_PREFIXES = ("$", "pio_")

@@ -67,6 +67,11 @@ from incubator_predictionio_tpu.data.storage.base import (
     StorageClient,
     StorageError,
 )
+from incubator_predictionio_tpu.resilience.policy import (
+    TRANSIENT_HTTP_CODES,
+    TransientError,
+    policy_from_config,
+)
 from incubator_predictionio_tpu.data.storage.wire import (
     dec_engine_instance,
     dec_evaluation_instance,
@@ -106,9 +111,14 @@ class _Transport:
     writer) after external index surgery.
     """
 
+    #: ES overload / recovering shard / gateway in front of the cluster —
+    #: no 500: an ES 500 is usually a real request bug, not an outage
+    _TRANSIENT_CODES = TRANSIENT_HTTP_CODES
+
     def __init__(self, url: str, timeout: float,
                  username: Optional[str] = None,
-                 password: Optional[str] = None):
+                 password: Optional[str] = None,
+                 config: Optional[dict] = None):
         self._url = url.rstrip("/")
         self._timeout = timeout
         self._auth = None
@@ -117,34 +127,61 @@ class _Transport:
                 f"{username}:{password or ''}".encode()).decode()
             self._auth = f"Basic {token}"
         self._known: set[str] = set()  # indices known to exist
+        self.policy = policy_from_config(f"elasticsearch:{self._url}", config)
+        self.fault_hook = None  # resilience/faults.FaultInjector seam
 
     def call(self, method: str, path: str, body: Any = None,
-             ndjson: bool = False, ok_codes: Sequence[int] = (200, 201)):
+             ndjson: bool = False, ok_codes: Sequence[int] = (200, 201),
+             idempotent: Optional[bool] = None):
+        """One ES REST call through the resilience policy.
+
+        Idempotency default follows the verb: GET/HEAD/PUT/DELETE re-apply
+        cleanly (PUT here is always a full-document/index write to an
+        explicit id), POST does not (e.g. auto-id indexing) — call sites
+        that know better pass ``idempotent`` explicitly.
+        """
+        if idempotent is None:
+            idempotent = method in ("GET", "HEAD", "PUT", "DELETE")
         url = f"{self._url}{path}"
         data = None
         if body is not None:
             data = body.encode() if isinstance(body, str) else json.dumps(
                 body).encode()
-        req = urllib.request.Request(url, data=data, method=method)
-        if data is not None:
-            req.add_header(
-                "Content-Type",
-                "application/x-ndjson" if ndjson else "application/json")
-        if self._auth:
-            req.add_header("Authorization", self._auth)
-        try:
-            with urllib.request.urlopen(req, timeout=self._timeout) as resp:
-                payload = resp.read()
-                return resp.status, json.loads(payload) if payload else {}
-        except urllib.error.HTTPError as e:
-            if e.code in ok_codes:
-                payload = e.read()
-                return e.code, json.loads(payload) if payload else {}
-            detail = e.read()[:2048].decode(errors="replace")
-            raise StorageError(
-                f"elasticsearch {method} {path}: {e.code} {detail}") from e
-        except (urllib.error.URLError, OSError, http.client.HTTPException) as e:
-            raise StorageError(f"elasticsearch unreachable: {e}") from e
+
+        def attempt(deadline):
+            req = urllib.request.Request(url, data=data, method=method)
+            if data is not None:
+                req.add_header(
+                    "Content-Type",
+                    "application/x-ndjson" if ndjson else "application/json")
+            if self._auth:
+                req.add_header("Authorization", self._auth)
+            try:
+                if self.fault_hook is not None:
+                    self.fault_hook(f"{method} {path}")
+                with urllib.request.urlopen(
+                        req, timeout=deadline.attempt_timeout(
+                            self._timeout)) as resp:
+                    payload = resp.read()
+                    return resp.status, json.loads(payload) if payload else {}
+            except urllib.error.HTTPError as e:
+                if e.code in ok_codes:
+                    payload = e.read()
+                    return e.code, json.loads(payload) if payload else {}
+                detail = e.read()[:2048].decode(errors="replace")
+                if e.code in self._TRANSIENT_CODES:
+                    raise TransientError(
+                        f"elasticsearch {method} {path}: "
+                        f"{e.code} {detail}") from e
+                raise StorageError(
+                    f"elasticsearch {method} {path}: {e.code} {detail}") from e
+            except (urllib.error.URLError, OSError,
+                    http.client.HTTPException) as e:
+                raise TransientError(
+                    f"elasticsearch unreachable: {e}") from e
+
+        return self.policy.call(attempt, idempotent=idempotent,
+                                op=f"{method} {path}")
 
     def ensure(self, index: str, mapping: dict) -> None:
         if index in self._known:
@@ -247,7 +284,8 @@ class ESEvents(EventStore):
         try:
             status, out = self._t.call(
                 "POST", f"/{idx}/_bulk?refresh=wait_for",
-                "\n".join(lines) + "\n", ndjson=True)
+                "\n".join(lines) + "\n", ndjson=True,
+                idempotent=True)  # explicit _ids: a replay overwrites itself
         except StorageError:
             self._t.forget(idx)
             raise
@@ -331,7 +369,8 @@ class ESEvents(EventStore):
                 body = {"query": query, "sort": sort, "size": size}
                 if search_after is not None:
                     body["search_after"] = search_after
-                _, out = self._t.call("POST", f"/{idx}/_search", body)
+                _, out = self._t.call("POST", f"/{idx}/_search", body,
+                                      idempotent=True)  # search is a read
                 hits = out.get("hits", {}).get("hits", [])
                 if not hits:
                     return
@@ -420,7 +459,8 @@ class _ESMetaIndex:
             status, _ = self._t.call(
                 "POST",
                 f"/{self._index}/_update/{_quote(doc_id)}?refresh=wait_for",
-                body, ok_codes=(200, 201, 404))
+                body, ok_codes=(200, 201, 404),
+                idempotent=True)  # same-source replacement re-applies cleanly
         except StorageError:
             self._t.forget(self._index)
             raise
@@ -455,7 +495,8 @@ class _ESMetaIndex:
             if search_after is not None:
                 body["search_after"] = search_after
             try:
-                _, out = self._t.call("POST", f"/{self._index}/_search", body)
+                _, out = self._t.call("POST", f"/{self._index}/_search", body,
+                                      idempotent=True)  # search is a read
             except StorageError:
                 # the index may have vanished (external surgery) — drop the
                 # memo so the next call's ensure() re-creates it
@@ -741,6 +782,7 @@ class ESStorageClient(StorageClient):
             float(config.get("TIMEOUT", "60")),
             username=config.get("USERNAME"),
             password=config.get("PASSWORD"),
+            config=config,
         )
         meta = config.get("META_INDEX_PREFIX", "pio_meta")
         self._transport = t  # live-tier cleanup reaches the raw REST calls

@@ -55,7 +55,16 @@ import urllib.parse
 import uuid
 from typing import Any, Iterator, Optional, Sequence
 
-from incubator_predictionio_tpu.data.event import DataMap, Event, UTC
+from incubator_predictionio_tpu.data.event import (
+    DataMap,
+    Event,
+    UTC,
+    epoch_micros,
+)
+from incubator_predictionio_tpu.resilience.policy import (
+    TransientError,
+    policy_from_config,
+)
 from incubator_predictionio_tpu.data.storage.base import (
     UNSET,
     AccessKey,
@@ -145,13 +154,19 @@ class _PGConn:
 
     def __init__(self, host: str, port: int, dbname: str, user: str,
                  password: str = "", sslmode: str = "", timeout: float = 30.0,
-                 read_timeout: float = 600.0, ssl_root_cert: str = ""):
+                 read_timeout: float = 600.0, ssl_root_cert: str = "",
+                 config: Optional[dict] = None):
         self.lock = threading.RLock()
         self._password = password
         self._user = user
         self._args = (host, port, dbname, sslmode, timeout, read_timeout,
                       ssl_root_cert)
         self._sock: Optional[socket.socket] = None
+        # idempotent statements (reads, IF [NOT] EXISTS DDL) retry through
+        # the shared policy with reconnect between attempts; mutations keep
+        # single-attempt semantics (a lost response may have committed)
+        self.policy = policy_from_config(f"postgres:{host}:{port}", config)
+        self.fault_hook = None  # resilience/faults.FaultInjector seam
         self._connect()
 
     def _connect(self) -> None:
@@ -336,23 +351,44 @@ class _PGConn:
             return b"\\x" + bytes(v).hex().encode()  # bytea text format
         return str(v).encode()
 
-    def query(self, sql: str, params: Sequence[Any] = ()) -> tuple[list[tuple], int]:
-        """Run one statement; returns (text rows, affected rowcount)."""
-        with self.lock:
-            if self._sock is None:
-                self._connect()  # lazy reconnect after a poisoned exchange
-            try:
-                return self._query_locked(sql, params)
-            except PGError:
-                raise  # server ErrorResponse: stream ended clean at ReadyForQuery
-            except (OSError, StorageError) as e:
-                # socket failure or truncated stream mid-exchange: leftover
-                # frames would corrupt the NEXT query's response
-                self._poison()
-                if isinstance(e, StorageError):
-                    raise
-                raise StorageError(f"postgres connection failed mid-query "
-                                   f"({e}); reconnecting on next use") from e
+    #: statement verbs safe to re-send after a failed/ambiguous exchange:
+    #: reads, and the DDL this module only ever issues in IF [NOT] EXISTS
+    #: form. INSERT/UPDATE/DELETE may have committed before the response
+    #: was lost, so they keep exactly one attempt.
+    _IDEMPOTENT_VERBS = frozenset({"SELECT", "CREATE", "DROP", "SHOW"})
+
+    def query(self, sql: str, params: Sequence[Any] = (),
+              idempotent: Optional[bool] = None) -> tuple[list[tuple], int]:
+        """Run one statement through the resilience policy; returns
+        (text rows, affected rowcount)."""
+        if idempotent is None:
+            verb = sql.lstrip().split(None, 1)[0].upper() if sql.strip() else ""
+            idempotent = verb in self._IDEMPOTENT_VERBS
+
+        def attempt(deadline):
+            with self.lock:
+                if self._sock is None:
+                    try:
+                        self._connect()  # lazy reconnect after a poison
+                    except StorageError as e:
+                        raise TransientError(str(e)) from e
+                try:
+                    if self.fault_hook is not None:
+                        self.fault_hook(
+                            sql.lstrip().split(None, 1)[0].upper())
+                    return self._query_locked(sql, params)
+                except PGError:
+                    raise  # server ErrorResponse: stream ended clean at ReadyForQuery
+                except (OSError, StorageError) as e:
+                    # socket failure or truncated stream mid-exchange:
+                    # leftover frames would corrupt the NEXT query's response
+                    self._poison()
+                    raise TransientError(
+                        f"postgres connection failed mid-query "
+                        f"({e}); reconnecting on next use") from e
+
+        return self.policy.call(attempt, idempotent=idempotent,
+                                op=sql[:48])
 
     def _query_locked(self, sql: str, params: Sequence[Any]) -> tuple[list[tuple], int]:
         bind = [b"\x00\x00", struct.pack("!H", 0), struct.pack("!H", len(params))]
@@ -418,10 +454,9 @@ class _PGConn:
 # Value codecs (wire text → python)
 # ---------------------------------------------------------------------------
 
-def _us(t: _dt.datetime) -> int:
-    if t.tzinfo is None:
-        t = t.replace(tzinfo=UTC)
-    return int(t.timestamp() * 1_000_000)
+# the shared exact-integer definition (data/event.py) — float timestamps
+# lose sub-µs precision, so per-path copies of this math drift by 1µs
+_us = epoch_micros
 
 
 def _from_us(us: str) -> _dt.datetime:
@@ -1100,7 +1135,8 @@ class PostgresStorageClient(StorageClient):
             host, port, dbname, user, password, sslmode=sslmode,
             timeout=float(config.get("TIMEOUT", "30")),
             read_timeout=float(config.get("READ_TIMEOUT", "600")),
-            ssl_root_cert=config.get("SSLROOTCERT", ""))
+            ssl_root_cert=config.get("SSLROOTCERT", ""),
+            config=config)
         self._apps = PGApps(self._conn)
         self._access_keys = PGAccessKeys(self._conn)
         self._channels = PGChannels(self._conn)

@@ -15,8 +15,11 @@ Config (``PIO_STORAGE_SOURCES_<NAME>_*``):
 - ``TIMEOUT=30``            (socket timeout, seconds)
 
 Transport notes:
-- unary calls reuse one persistent HTTP connection per thread (retried once
-  on a stale socket — the JDBC connection-pool analogue);
+- unary calls reuse one persistent HTTP connection per thread and route
+  through the shared resilience policy (resilience/policy.py): idempotent
+  calls retry with backoff under the ambient deadline, every call is gated
+  by this backend's circuit breaker (the JDBC connection-pool analogue,
+  hardened);
 - ``find`` streams JSON-lines on a dedicated connection and yields lazily, so
   scanning a big store holds O(1) events client-side;
 - ``find_sharded`` pushes the shard predicate to the server: each process of
@@ -35,6 +38,7 @@ import json
 import logging
 import ssl as _ssl
 import threading
+import time
 import urllib.parse
 from typing import Any, Iterator, Optional, Sequence
 
@@ -60,6 +64,13 @@ from incubator_predictionio_tpu.data.storage.base import (
     StorageError,
 )
 from incubator_predictionio_tpu.data.storage.registry import register_backend
+from incubator_predictionio_tpu.resilience.policy import (
+    TRANSIENT_HTTP_CODES,
+    Deadline,
+    ResiliencePolicy,
+    TransientError,
+    policy_from_config,
+)
 from incubator_predictionio_tpu.data.storage.wire import (
     _META_CODECS,
     dec_engine_instance,
@@ -89,7 +100,9 @@ class _Transport:
     MAX_IDLE_SECS = 55.0
 
     def __init__(self, url: str, key: Optional[str], timeout: float,
-                 ca_cert: Optional[str] = None):
+                 ca_cert: Optional[str] = None,
+                 policy: Optional[ResiliencePolicy] = None,
+                 config: Optional[dict] = None):
         p = urllib.parse.urlsplit(url)
         if p.scheme not in ("http", "https"):
             raise StorageError(f"remote storage URL must be http(s): {url!r}")
@@ -100,8 +113,14 @@ class _Transport:
         self.timeout = timeout
         self.ca_cert = ca_cert
         self._local = threading.local()
+        # shared retry/breaker policy; tests swap in a FakeClock policy and
+        # script faults through `fault_hook` (resilience/faults.FaultInjector)
+        self.policy = policy or policy_from_config(
+            f"remote:{self.host}:{self.port}", config)
+        self.fault_hook = None
 
-    def _new_conn(self) -> http.client.HTTPConnection:
+    def _new_conn(self, timeout: Optional[float] = None) -> http.client.HTTPConnection:
+        timeout = self.timeout if timeout is None else timeout
         if self.scheme == "https":
             if self.ca_cert:
                 # pin the server's own (self-signed) cert: encryption AND
@@ -117,9 +136,9 @@ class _Transport:
                 ctx.check_hostname = False
                 ctx.verify_mode = _ssl.CERT_NONE
             return http.client.HTTPSConnection(
-                self.host, self.port, timeout=self.timeout, context=ctx)
+                self.host, self.port, timeout=timeout, context=ctx)
         return http.client.HTTPConnection(
-            self.host, self.port, timeout=self.timeout)
+            self.host, self.port, timeout=timeout)
 
     def _headers(self) -> dict[str, str]:
         h = {"Content-Type": "application/json"}
@@ -127,63 +146,100 @@ class _Transport:
             h["X-PIO-Storage-Key"] = self.key
         return h
 
+    def _attempt_request(self, path: str, payload: bytes,
+                         deadline: Deadline) -> tuple[int, bytes]:
+        """One attempt on the pooled per-thread connection. Raises
+        TransientError for anything worth retrying; the policy decides
+        whether a retry actually happens (idempotency, budget, breaker)."""
+        conn = getattr(self._local, "conn", None)
+        now = time.monotonic()
+        if conn is not None and (
+            now - getattr(self._local, "last_used", 0.0) > self.MAX_IDLE_SECS
+        ):
+            # idle past the server keep-alive window: reconnect BEFORE
+            # sending (safe — nothing is in flight yet)
+            try:
+                conn.close()
+            except Exception:  # noqa: BLE001
+                pass
+            conn = None
+        if conn is None:
+            conn = self._new_conn(deadline.attempt_timeout(self.timeout))
+            self._local.conn = conn
+        try:
+            if self.fault_hook is not None:
+                # inside the transient-catching region: injected timeouts/
+                # resets classify exactly like their real counterparts
+                self.fault_hook(path)
+            if conn.sock is not None:
+                # cap this attempt by the remaining call budget (deadline
+                # propagated from the serving layer via deadline_scope)
+                conn.sock.settimeout(deadline.attempt_timeout(self.timeout))
+            conn.request("POST", path, payload, self._headers())
+            resp = conn.getresponse()
+            self._local.last_used = time.monotonic()
+            status, data = resp.status, resp.read()
+            if status in TRANSIENT_HTTP_CODES:
+                # gateway/restart blip (429/502/503/504): retryable like a
+                # connection failure — same classification as the other
+                # HTTP backends. (500 stays semantic: a storage-server 500
+                # is a handler bug, not an outage.)
+                raise TransientError(
+                    f"remote storage {path}: {status} "
+                    f"{data[:256].decode(errors='replace')}")
+            return status, data
+        except (http.client.HTTPException, ConnectionError, OSError) as e:
+            self._local.conn = None
+            try:
+                conn.close()
+            except Exception:  # noqa: BLE001
+                pass
+            raise TransientError(f"remote storage unreachable: {e!r}") from e
+
     def request(self, path: str, body: dict,
                 idempotent: bool = True) -> tuple[int, bytes]:
-        """Unary call on the pooled per-thread connection."""
-        import time
-
+        """Unary call through the resilience policy: idempotent calls retry
+        with backoff, writes get one attempt, the breaker gates everything.
+        DeadlineExceeded/CircuitOpenError surface as-is (both StorageError)."""
         payload = json.dumps(body).encode()
-        attempts = (0, 1) if idempotent else (1,)
-        for attempt in attempts:
-            conn = getattr(self._local, "conn", None)
-            now = time.monotonic()
-            if conn is not None and (
-                now - getattr(self._local, "last_used", 0.0) > self.MAX_IDLE_SECS
-            ):
-                # idle past the server keep-alive window: reconnect BEFORE
-                # sending (safe — nothing is in flight yet)
-                try:
-                    conn.close()
-                except Exception:  # noqa: BLE001
-                    pass
-                conn = None
-            if conn is None:
-                conn = self._new_conn()
-                self._local.conn = conn
-            try:
-                conn.request("POST", path, payload, self._headers())
-                resp = conn.getresponse()
-                self._local.last_used = time.monotonic()
-                return resp.status, resp.read()
-            except (http.client.HTTPException, ConnectionError, OSError) as e:
-                self._local.conn = None
-                try:
-                    conn.close()
-                except Exception:  # noqa: BLE001
-                    pass
-                if attempt:
-                    raise StorageError(
-                        f"remote storage unreachable: {e!r}") from e
-        raise AssertionError("unreachable")
+        return self.policy.call(
+            lambda d: self._attempt_request(path, payload, d),
+            idempotent=idempotent, op=path)
 
     def stream(self, path: str, body: dict):
         """Streaming call on a DEDICATED connection (the pooled one must stay
         free for unary calls issued while the caller consumes the stream).
-        Returns (response, connection); caller closes the connection."""
-        conn = self._new_conn()
-        try:
-            conn.request("POST", path, json.dumps(body).encode(),
-                         self._headers())
-            resp = conn.getresponse()
+        Connection setup goes through the policy (streams are reads —
+        idempotent until the first yielded byte is consumed); mid-stream
+        failures are the caller's to surface. Returns (response, connection);
+        caller closes the connection."""
+        payload = json.dumps(body).encode()
+
+        def attempt(deadline: Deadline):
+            conn = self._new_conn(deadline.attempt_timeout(self.timeout))
+            try:
+                if self.fault_hook is not None:
+                    self.fault_hook(path)
+                if conn.sock is not None:
+                    conn.sock.settimeout(
+                        deadline.attempt_timeout(self.timeout))
+                conn.request("POST", path, payload, self._headers())
+                resp = conn.getresponse()
+            except (http.client.HTTPException, ConnectionError, OSError) as e:
+                conn.close()
+                raise TransientError(
+                    f"remote storage unreachable: {e}") from e
             if resp.status != 200:
                 detail = resp.read(2048).decode(errors="replace")
                 conn.close()
+                if resp.status in TRANSIENT_HTTP_CODES:
+                    raise TransientError(
+                        f"remote storage {path}: {resp.status} {detail}")
                 raise StorageError(
                     f"remote storage {path} failed: {resp.status} {detail}")
             return resp, conn
-        except (http.client.HTTPException, ConnectionError, OSError) as e:
-            conn.close()
-            raise StorageError(f"remote storage unreachable: {e}") from e
+
+        return self.policy.call(attempt, idempotent=True, op=path)
 
     #: RPC methods safe to auto-retry on a stale socket (pure reads plus the
     #: contract's explicitly idempotent lifecycle calls). Mutations whose
@@ -556,7 +612,7 @@ class RemoteStorageClient(StorageClient):
             url = f"{scheme}://{host}:{port}"
         self._tp = _Transport(
             url, config.get("KEY"), float(config.get("TIMEOUT", "30")),
-            ca_cert=config.get("CA_CERT"))
+            ca_cert=config.get("CA_CERT"), config=config)
 
     def apps(self) -> AppsStore:
         return RemoteAppsStore(self._tp)

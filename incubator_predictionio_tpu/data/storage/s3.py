@@ -39,8 +39,17 @@ from incubator_predictionio_tpu.data.storage.base import (
     StorageClient,
     StorageError,
 )
+from incubator_predictionio_tpu.resilience.policy import (
+    TRANSIENT_HTTP_CODES_WITH_500,
+    TransientError,
+    policy_from_config,
+)
 
 logger = logging.getLogger(__name__)
+
+#: transient service conditions worth a retry (incl. 500: S3 InternalError
+#: is documented as retry-with-backoff)
+_TRANSIENT_CODES = TRANSIENT_HTTP_CODES_WITH_500
 
 
 def _sign(key: bytes, msg: str) -> bytes:
@@ -117,7 +126,7 @@ def sigv4_headers(
 class S3Models(ModelsStore):
     def __init__(self, endpoint: str, bucket: str, base_path: str,
                  region: str, access_key: str, secret_key: str,
-                 timeout: float):
+                 timeout: float, config: Optional[dict] = None):
         self._endpoint = endpoint.rstrip("/")
         self._bucket = bucket
         self._prefix = base_path.strip("/")
@@ -125,6 +134,11 @@ class S3Models(ModelsStore):
         self._access = access_key
         self._secret = secret_key
         self._timeout = timeout
+        # every S3 model op is idempotent (full-object PUT/GET/HEAD/DELETE),
+        # so the whole surface retries under one policy + breaker
+        self.policy = policy_from_config(
+            f"s3:{self._endpoint}/{bucket}", config)
+        self.fault_hook = None  # resilience/faults.FaultInjector seam
 
     def _url(self, model_id: str) -> str:
         if "/" in model_id or model_id in (".", ".."):
@@ -132,20 +146,45 @@ class S3Models(ModelsStore):
         key = f"{self._prefix}/{model_id}" if self._prefix else model_id
         return f"{self._endpoint}/{self._bucket}/{key}"
 
-    def _request(self, method: str, model_id: str, payload: bytes = b""):
+    def _request(self, method: str, model_id: str, payload: bytes = b"") -> bytes:
+        """One signed request through the resilience policy, returning the
+        response body: transient failures (connect errors, timeouts,
+        SlowDown/5xx, a connection dying mid-body) retry with backoff under
+        the ambient deadline; HTTP errors that mean something (404/403
+        probes) propagate raw for the callers' missing-key logic. The body
+        is read INSIDE the attempt so mid-stream failures classify as
+        transient too."""
         url = self._url(model_id)
-        req = urllib.request.Request(
-            url, data=payload if method == "PUT" else None, method=method)
-        for k, v in sigv4_headers(
-            method, url, self._region, self._access, self._secret, payload,
-        ).items():
-            req.add_header(k, v)
-        return urllib.request.urlopen(req, timeout=self._timeout)
+
+        def attempt(deadline):
+            req = urllib.request.Request(
+                url, data=payload if method == "PUT" else None, method=method)
+            for k, v in sigv4_headers(
+                method, url, self._region, self._access, self._secret, payload,
+            ).items():
+                req.add_header(k, v)
+            try:
+                if self.fault_hook is not None:
+                    self.fault_hook(f"{method} {model_id}")
+                with urllib.request.urlopen(
+                    req, timeout=deadline.attempt_timeout(self._timeout),
+                ) as resp:
+                    return resp.read()
+            except urllib.error.HTTPError as e:
+                if e.code in _TRANSIENT_CODES:
+                    raise TransientError(f"s3 {method}: {e}") from e
+                raise  # semantic status (404/403/...): caller interprets
+            except (urllib.error.URLError, OSError,
+                    http.client.HTTPException) as e:
+                raise TransientError(f"s3 unreachable: {e}") from e
+
+        return self.policy.call(attempt, idempotent=True,
+                                op=f"{method} {model_id}")
 
     def insert(self, model: Model) -> None:
         try:
-            self._request("PUT", model.id, model.models).read()
-        except (urllib.error.URLError, OSError, http.client.HTTPException) as e:
+            self._request("PUT", model.id, model.models)
+        except urllib.error.HTTPError as e:
             raise StorageError(f"s3 insert failed: {e}") from e
 
     @staticmethod
@@ -166,31 +205,24 @@ class S3Models(ModelsStore):
 
     def get(self, model_id: str) -> Optional[Model]:
         try:
-            with self._request("GET", model_id) as resp:
-                return Model(model_id, resp.read())
+            return Model(model_id, self._request("GET", model_id))
         except urllib.error.HTTPError as e:
             if self._missing(e):
                 return None
             raise StorageError(f"s3 get failed: {e}") from e
-        except (urllib.error.URLError, OSError, http.client.HTTPException) as e:
-            raise StorageError(f"s3 unreachable: {e}") from e
 
     def delete(self, model_id: str) -> bool:
         try:
-            self._request("HEAD", model_id).read()
+            self._request("HEAD", model_id)
         except urllib.error.HTTPError as e:
             if self._missing(e):
                 return False
             raise StorageError(f"s3 delete failed: {e}") from e
-        except (urllib.error.URLError, OSError, http.client.HTTPException) as e:
-            raise StorageError(f"s3 unreachable: {e}") from e
         try:
-            self._request("DELETE", model_id).read()
+            self._request("DELETE", model_id)
             return True
         except urllib.error.HTTPError as e:
             raise StorageError(f"s3 delete failed: {e}") from e
-        except (urllib.error.URLError, OSError, http.client.HTTPException) as e:
-            raise StorageError(f"s3 unreachable: {e}") from e
 
 
 class S3StorageClient(StorageClient):
@@ -215,6 +247,7 @@ class S3StorageClient(StorageClient):
         self._models = S3Models(
             endpoint, bucket, config.get("BASE_PATH", ""),
             region, access, secret, float(config.get("TIMEOUT", "60")),
+            config=config,
         )
 
     def models(self) -> ModelsStore:

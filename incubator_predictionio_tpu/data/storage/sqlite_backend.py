@@ -23,7 +23,13 @@ import threading
 import uuid
 from typing import Any, Iterator, Optional, Sequence
 
-from incubator_predictionio_tpu.data.event import DataMap, Event, UTC
+from incubator_predictionio_tpu.data.event import (
+    DataMap,
+    Event,
+    UTC,
+    epoch_micros,
+    time_prefixed_event_id,
+)
 from incubator_predictionio_tpu.data.storage.base import (
     UNSET,
     AccessKey,
@@ -47,8 +53,10 @@ from incubator_predictionio_tpu.data.storage.base import (
 N_SHARD_BUCKETS = 1024  # fixed bucket count; find_sharded folds buckets into n shards
 
 
-def _us(t: _dt.datetime) -> int:
-    return int(t.timestamp() * 1_000_000)
+# the shared exact-integer definition (data/event.py): the C ingest sink
+# computes integer microseconds, and both paths must store bit-identical
+# event_time for the same request body
+_us = epoch_micros
 
 
 def _from_us(us: int) -> _dt.datetime:
@@ -182,11 +190,8 @@ class SqliteEvents(EventStore):
 
     @staticmethod
     def _new_event_id(e: Event) -> str:
-        # time-prefixed ids: random 32-hex PKs land on random btree pages
-        # (the classic UUID-PK insert wall); a monotonic prefix appends to
-        # the right edge instead. Same idea as the reference's time-ordered
-        # HBase rowkeys (HBEventsUtil.scala:76-131). Ids stay opaque 32-hex.
-        return f"{_us(e.creation_time):015x}" + os.urandom(8).hex() + "0"
+        # time-prefixed, btree-right-edge ids (shared scheme, data/event.py)
+        return time_prefixed_event_id(e.creation_time)
 
     def _heal_no_table(self, op, app_id: int, channel_id: Optional[int]):
         """Run ``op``; if the table vanished underneath us (another process

@@ -32,17 +32,44 @@ from incubator_predictionio_tpu.data.storage.base import (
     StorageClient,
     StorageError,
 )
+from incubator_predictionio_tpu.resilience.policy import (
+    TRANSIENT_HTTP_CODES_WITH_500,
+    TransientError,
+    policy_from_config,
+)
 
 logger = logging.getLogger(__name__)
+
+#: namenode/datanode conditions worth a retry (incl. 500: standby-namenode
+#: failover surfaces as 500 RetriableException)
+_TRANSIENT_CODES = TRANSIENT_HTTP_CODES_WITH_500
 
 
 class WebHDFSModels(ModelsStore):
     def __init__(self, url: str, base_path: str, user: Optional[str],
-                 timeout: float):
+                 timeout: float, config: Optional[dict] = None):
         self._url = url.rstrip("/")
         self._base = "/" + base_path.strip("/")
         self._user = user
         self._timeout = timeout
+        # CREATE uses overwrite=true, OPEN is a read, DELETE re-applies —
+        # the whole WebHDFS surface is idempotent under one policy + breaker
+        self.policy = policy_from_config(f"webhdfs:{self._url}", config)
+        self.fault_hook = None  # resilience/faults.FaultInjector seam
+
+    def _open(self, op: str, req, timeout: float):
+        """urlopen with the module's transient/semantic error split."""
+        try:
+            if self.fault_hook is not None:
+                self.fault_hook(op)
+            return urllib.request.urlopen(req, timeout=timeout)
+        except urllib.error.HTTPError as e:
+            if e.code in _TRANSIENT_CODES:
+                raise TransientError(f"webhdfs {op}: {e}") from e
+            raise  # semantic status (404, 307 redirect): caller interprets
+        except (urllib.error.URLError, OSError,
+                http.client.HTTPException) as e:
+            raise TransientError(f"webhdfs unreachable: {e}") from e
 
     def _op_url(self, model_id: str, op: str, **params) -> str:
         if "/" in model_id or model_id in (".", ".."):
@@ -59,51 +86,79 @@ class WebHDFSModels(ModelsStore):
         blob goes to that second URL (urllib auto-follows 307 only for
         GET/HEAD, so the redirect is handled explicitly)."""
         url = self._op_url(model.id, "CREATE", overwrite="true")
-        try:
+
+        def attempt(deadline):
+            # BOTH steps inside one attempt: a datanode write URL from a
+            # previous attempt may have expired, so a retry restarts the
+            # namenode negotiation (overwrite=true keeps it idempotent)
+            t = deadline.attempt_timeout(self._timeout)
             loc = None
             try:
-                resp = urllib.request.urlopen(
-                    urllib.request.Request(url, method="PUT"),
-                    timeout=self._timeout)
-                loc = resp.headers.get("Location")  # gateway variants: 200/201
+                resp = self._open(
+                    "CREATE", urllib.request.Request(url, method="PUT"), t)
+                loc = resp.headers.get("Location")  # gateways: 200/201
             except urllib.error.HTTPError as e:
                 if e.code != 307:
-                    raise
+                    raise StorageError(f"webhdfs insert failed: {e}") from e
                 loc = e.headers.get("Location")
             if not loc:
                 raise StorageError("webhdfs CREATE returned no write location")
             req = urllib.request.Request(loc, data=model.models, method="PUT")
             req.add_header("Content-Type", "application/octet-stream")
-            urllib.request.urlopen(req, timeout=self._timeout).read()
-        except (urllib.error.URLError, OSError, http.client.HTTPException) as e:
-            raise StorageError(f"webhdfs insert failed: {e}") from e
+            try:
+                self._open("CREATE data", req,
+                           deadline.attempt_timeout(self._timeout)).read()
+            except urllib.error.HTTPError as e:
+                raise StorageError(f"webhdfs insert failed: {e}") from e
+            except (urllib.error.URLError, OSError,
+                    http.client.HTTPException) as e:
+                # mid-body failure on the datanode write: retryable
+                raise TransientError(f"webhdfs insert failed: {e}") from e
+
+        self.policy.call(attempt, idempotent=True, op=f"CREATE {model.id}")
 
     def get(self, model_id: str) -> Optional[Model]:
         url = self._op_url(model_id, "OPEN")
-        try:
-            with urllib.request.urlopen(url, timeout=self._timeout) as resp:
-                return Model(model_id, resp.read())
-        except urllib.error.HTTPError as e:
-            if e.code == 404:
-                return None
-            raise StorageError(f"webhdfs get failed: {e}") from e
-        except (urllib.error.URLError, OSError, http.client.HTTPException) as e:
-            raise StorageError(f"webhdfs unreachable: {e}") from e
+
+        def attempt(deadline):
+            try:
+                with self._open("OPEN", urllib.request.Request(url),
+                                deadline.attempt_timeout(self._timeout)) as resp:
+                    return Model(model_id, resp.read())
+            except urllib.error.HTTPError as e:
+                if e.code == 404:
+                    return None
+                raise StorageError(f"webhdfs get failed: {e}") from e
+            except (urllib.error.URLError, OSError,
+                    http.client.HTTPException) as e:
+                # connection died mid-body (after the 200): retryable, and
+                # it must surface as a StorageError subtype, never raw
+                raise TransientError(f"webhdfs get failed: {e}") from e
+
+        return self.policy.call(attempt, idempotent=True,
+                                op=f"OPEN {model_id}")
 
     def delete(self, model_id: str) -> bool:
         url = self._op_url(model_id, "DELETE")
-        try:
-            with urllib.request.urlopen(
-                urllib.request.Request(url, method="DELETE"),
-                timeout=self._timeout,
-            ) as resp:
-                return bool(json.loads(resp.read() or b"{}").get("boolean"))
-        except urllib.error.HTTPError as e:
-            if e.code == 404:
-                return False
-            raise StorageError(f"webhdfs delete failed: {e}") from e
-        except (urllib.error.URLError, OSError, http.client.HTTPException) as e:
-            raise StorageError(f"webhdfs unreachable: {e}") from e
+
+        def attempt(deadline):
+            try:
+                with self._open(
+                    "DELETE", urllib.request.Request(url, method="DELETE"),
+                    deadline.attempt_timeout(self._timeout),
+                ) as resp:
+                    return bool(
+                        json.loads(resp.read() or b"{}").get("boolean"))
+            except urllib.error.HTTPError as e:
+                if e.code == 404:
+                    return False
+                raise StorageError(f"webhdfs delete failed: {e}") from e
+            except (urllib.error.URLError, OSError,
+                    http.client.HTTPException) as e:
+                raise TransientError(f"webhdfs delete failed: {e}") from e
+
+        return self.policy.call(attempt, idempotent=True,
+                                op=f"DELETE {model_id}")
 
 
 class WebHDFSStorageClient(StorageClient):
@@ -119,6 +174,7 @@ class WebHDFSStorageClient(StorageClient):
             config.get("PATH", "/pio/models"),
             config.get("USER"),
             float(config.get("TIMEOUT", "60")),
+            config=config,
         )
 
     def models(self) -> ModelsStore:

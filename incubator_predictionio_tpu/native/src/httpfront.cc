@@ -291,6 +291,37 @@ bool start_tunnel(Server* s, Conn* c) {
 
 // ---- front request processing --------------------------------------------
 
+// Case-insensitive needle search bounded to the first `limit` bytes —
+// allocation-free so the inline fast path stays copy-free per response.
+size_t find_header_ci(const std::string& hay, size_t limit,
+                      const char* needle) {
+  size_t n = strlen(needle);
+  if (limit < n) return std::string::npos;
+  for (size_t i = 0; i + n <= limit; i++)
+    if (strncasecmp(hay.data() + i, needle, n) == 0) return i;
+  return std::string::npos;
+}
+
+// Connection-header discipline (RFC 7230 §6.1), shared by the inline and
+// PENDING-completion response paths: the Python handler does not know the
+// request's keep-alive flag, so the front reconciles — a close-requesting
+// client must see "Connection: close", and a handler-declared close must
+// actually close the socket. Returns true when the connection must close
+// after this response.
+bool reconcile_connection(bool req_keep_alive, std::string& resp) {
+  size_t head_end = resp.find("\r\n\r\n");
+  size_t limit = head_end == std::string::npos ? 0 : head_end;
+  bool resp_says_close =
+      find_header_ci(resp, limit, "connection: close") != std::string::npos;
+  if (!req_keep_alive && !resp_says_close && head_end != std::string::npos) {
+    size_t ka = find_header_ci(resp, limit, "connection: keep-alive");
+    if (ka != std::string::npos)
+      resp.replace(ka, strlen("connection: keep-alive"),
+                   "Connection: close");
+  }
+  return !req_keep_alive || resp_says_close;
+}
+
 const char* k400 =
     "HTTP/1.1 400 Bad Request\r\nContent-Length: 0\r\nConnection: close\r\n\r\n";
 
@@ -354,12 +385,14 @@ void process_front(Server* s, Conn* c) {
       }
       return;
     }
+    // same reconciliation as the PENDING drain path: the inline response's
+    // Connection header must never contradict actual socket behavior
+    if (reconcile_connection(h.keep_alive, s->resp_scratch)) {
+      c->closing = true;
+      c->in.clear();  // drop pipelined bytes we will never answer
+    }
     c->out += s->resp_scratch;
     c->in.erase(0, total);
-    if (!h.keep_alive) {
-      c->closing = true;
-      c->in.clear();
-    }
     if (!flush_out(s, c)) {
       // send error, or drained with closing set: either way, done
       close_conn(s, c);
@@ -441,24 +474,7 @@ void drain_completions(Server* s) {
     Conn* c = cit->second;
     if (c->pending_token != token) continue;
     c->pending_token = 0;
-    // Connection-header discipline (RFC 7230 §6.1): the handler does not
-    // know the request's keep-alive flag, so the front reconciles — a
-    // close-requesting client must see "close", and a handler-declared
-    // "Connection: close" must actually close the socket.
-    size_t head_end = resp.find("\r\n\r\n");
-    std::string head_low = resp.substr(
-        0, head_end == std::string::npos ? 0 : head_end);
-    for (auto& ch : head_low) ch = (char)tolower((unsigned char)ch);
-    bool resp_says_close = head_low.find("connection: close")
-                           != std::string::npos;
-    if (!c->pending_keep_alive && !resp_says_close &&
-        head_end != std::string::npos) {
-      size_t ka = head_low.find("connection: keep-alive");
-      if (ka != std::string::npos)
-        resp = resp.substr(0, ka) + "Connection: close" +
-               resp.substr(ka + strlen("connection: keep-alive"));
-    }
-    if (!c->pending_keep_alive || resp_says_close) c->closing = true;
+    if (reconcile_connection(c->pending_keep_alive, resp)) c->closing = true;
     c->out += resp;
     if (!flush_out(s, c)) {
       close_conn(s, c);

@@ -982,6 +982,7 @@ extern "C" int64_t pl_ingest_sqlite(const uint8_t* body, int64_t body_len,
   if (conn == nullptr) return -2;
   ConnGuard guard(conn);  // held for the whole call (incl. throws)
   sqlite3* db = conn->db;
+  uint8_t* mem = nullptr;  // pre-transaction result buffer (freed on error)
   try {
     Parser parser{body, body + body_len};
     validate_utf8_or_fallback(body, body_len);
@@ -1032,6 +1033,21 @@ extern "C" int64_t pl_ingest_sqlite(const uint8_t* body, int64_t body_len,
       if (r.message.size() >= ABSENT16 || r.event_id.size() >= ABSENT16)
         throw Fallback{};
 
+    // the FULL result buffer is serialized and allocated BEFORE the
+    // transaction for the same reason: results are final at this point
+    // (ids pre-assigned), and a post-commit malloc failure surfacing as a
+    // retryable error would make the aiohttp fallback re-ingest the batch
+    Buf out;
+    out.u32((uint32_t)results.size());
+    for (const auto& r : results) {
+      out.u16(r.status);
+      out.str16(r.message);
+      out.str16(r.event_id);
+    }
+    mem = (uint8_t*)malloc(out.size());
+    if (mem == nullptr) return -2;  // nothing written yet: fallback is safe
+    memcpy(mem, out.d.data(), out.size());
+
     if (!accepted.empty()) {
       std::string sql = "INSERT OR REPLACE INTO ";
       sql += table;
@@ -1039,12 +1055,15 @@ extern "C" int64_t pl_ingest_sqlite(const uint8_t* body, int64_t body_len,
              "target_entity_id, properties, event_time, tags, pr_id, "
              "creation_time, entity_shard) VALUES (?,?,?,?,?,?,?,?,?,?,?,?)";
       sqlite3_stmt* stmt = nullptr;
-      if (api.prepare_v2(db, sql.c_str(), -1, &stmt, nullptr) != 0)
+      if (api.prepare_v2(db, sql.c_str(), -1, &stmt, nullptr) != 0) {
+        free(mem);
         return -2;  // table missing etc.: Python path heals and retries
+      }
       char* err = nullptr;
       if (api.exec(db, "BEGIN IMMEDIATE", nullptr, nullptr, &err) != 0) {
         if (err != nullptr) api.free_fn(err);
         api.finalize(stmt);
+        free(mem);
         return -2;
       }
       bool failed = false;
@@ -1097,29 +1116,24 @@ extern "C" int64_t pl_ingest_sqlite(const uint8_t* body, int64_t body_len,
       api.finalize(stmt);
       if (failed) {
         api.exec(db, "ROLLBACK", nullptr, nullptr, nullptr);
+        free(mem);
         return -2;  // Python path reproduces the error surface
       }
       if (api.exec(db, "COMMIT", nullptr, nullptr, nullptr) != 0) {
         api.exec(db, "ROLLBACK", nullptr, nullptr, nullptr);
+        free(mem);
         return -2;
       }
     }
 
-    Buf out;
-    out.u32((uint32_t)results.size());
-    for (const auto& r : results) {
-      out.u16(r.status);
-      out.str16(r.message);   // sizes pre-checked before the transaction
-      out.str16(r.event_id);
-    }
-    uint8_t* mem = (uint8_t*)malloc(out.size());
-    if (mem == nullptr) return -1;
-    memcpy(mem, out.d.data(), out.size());
+    // post-COMMIT: nothing left that can fail (buffer built above)
     *out_buf = mem;
     return (int64_t)out.size();
   } catch (const Fallback&) {
+    free(mem);
     return -2;
   } catch (...) {
+    free(mem);
     return -1;
   }
 }

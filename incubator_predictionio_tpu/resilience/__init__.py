@@ -1,0 +1,62 @@
+"""Unified resilience layer: deadline-aware retries, per-backend circuit
+breakers, and a deterministic fault-injection harness.
+
+See ``docs/resilience.md`` for the configuration surface and usage; the
+short version:
+
+- transports route every network call through a :class:`ResiliencePolicy`
+  (``policy_from_config(name, config)``) which handles idempotency-aware
+  retry with backoff+jitter, per-attempt/total deadlines, and the backend's
+  circuit breaker;
+- the serving layer propagates its per-query budget to storage via
+  :func:`deadline_scope`;
+- health endpoints read :data:`BREAKERS` (``BREAKERS.snapshot()``);
+- tests script failures with :class:`FaultSchedule` + :class:`FaultInjector`
+  / :class:`FaultProxy` on a :class:`FakeClock` — deterministic, no wall
+  sleeps.
+"""
+
+from incubator_predictionio_tpu.resilience.breaker import (
+    BREAKERS,
+    BreakerRegistry,
+    CircuitBreaker,
+    CircuitOpenError,
+)
+from incubator_predictionio_tpu.resilience.clock import (
+    SYSTEM_CLOCK,
+    Clock,
+    FakeClock,
+    SystemClock,
+)
+from incubator_predictionio_tpu.resilience.faults import (
+    FaultInjector,
+    FaultProxy,
+    FaultSchedule,
+    Ok,
+    PartialWrite,
+    Reset,
+    Slow,
+    Timeout,
+)
+from incubator_predictionio_tpu.resilience.policy import (
+    Deadline,
+    DeadlineExceeded,
+    ResiliencePolicy,
+    RetryPolicy,
+    ServingUnavailable,
+    TransientError,
+    current_deadline,
+    deadline_scope,
+    policy_from_config,
+    run_with_deadline,
+)
+
+__all__ = [
+    "BREAKERS", "BreakerRegistry", "CircuitBreaker", "CircuitOpenError",
+    "SYSTEM_CLOCK", "Clock", "FakeClock", "SystemClock",
+    "FaultInjector", "FaultProxy", "FaultSchedule",
+    "Ok", "PartialWrite", "Reset", "Slow", "Timeout",
+    "Deadline", "DeadlineExceeded", "ResiliencePolicy", "RetryPolicy",
+    "ServingUnavailable", "TransientError", "current_deadline",
+    "deadline_scope", "policy_from_config", "run_with_deadline",
+]

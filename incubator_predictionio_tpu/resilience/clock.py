@@ -1,0 +1,65 @@
+"""Injectable time source for the resilience layer.
+
+Every component that waits (retry backoff, breaker reset windows, injected
+slow responses) takes a :class:`Clock` so tests can script failure/recovery
+timelines deterministically — the acceptance bar for the fault harness is
+"no wall-clock sleeps" (ISSUE 1), which :class:`FakeClock` delivers by
+advancing virtual time instead of blocking.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Protocol, runtime_checkable
+
+
+@runtime_checkable
+class Clock(Protocol):
+    def monotonic(self) -> float: ...
+
+    def sleep(self, seconds: float) -> None: ...
+
+
+class SystemClock:
+    """The real thing (time.monotonic / time.sleep)."""
+
+    def monotonic(self) -> float:
+        return time.monotonic()
+
+    def sleep(self, seconds: float) -> None:
+        if seconds > 0:
+            time.sleep(seconds)
+
+
+#: Shared default — the clock is stateless, one instance serves everyone.
+SYSTEM_CLOCK = SystemClock()
+
+
+class FakeClock:
+    """Deterministic virtual clock: ``sleep`` advances time instantly.
+
+    ``slept`` records every sleep request, so tests can assert the exact
+    backoff sequence a policy produced without ever blocking.
+    """
+
+    def __init__(self, start: float = 0.0):
+        self._now = start
+        self._lock = threading.Lock()
+        self.slept: list[float] = []
+
+    def monotonic(self) -> float:
+        with self._lock:
+            return self._now
+
+    def sleep(self, seconds: float) -> None:
+        with self._lock:
+            self.slept.append(seconds)
+            if seconds > 0:
+                self._now += seconds
+
+    def advance(self, seconds: float) -> None:
+        """Move time forward without recording a sleep (external passage of
+        time, e.g. waiting out a breaker's reset window)."""
+        with self._lock:
+            self._now += seconds

@@ -1,0 +1,184 @@
+"""Deterministic fault-injection harness.
+
+A :class:`FaultSchedule` is a scripted (or seed-generated) sequence of fault
+steps consumed one per intercepted operation. Two interception points:
+
+- :class:`FaultInjector` — the *hook* form. Network transports expose a
+  ``fault_hook`` attribute called at the start of every attempt; the
+  injector raises/delays there, BEFORE any bytes hit the wire. This is how
+  tests script "two timeouts, then recovery" against a live backend with
+  zero real sockets harmed.
+- :class:`FaultProxy` — the *wrapper* form. Wraps any storage object
+  (an ``EventStore``, a ``ModelsStore``, a whole transport) and applies the
+  schedule around real method calls, which enables :class:`PartialWrite`
+  (the op **executes**, then the response is "lost") — the exact hazard that
+  makes non-idempotent retries dangerous.
+
+Determinism: scripted schedules replay byte-for-byte; ``FaultSchedule.seeded``
+derives its step sequence from ``random.Random(seed)`` only. Pair either
+with :class:`~incubator_predictionio_tpu.resilience.clock.FakeClock` and a
+test never sleeps on the wall clock.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Any, Iterable, Optional, Sequence
+
+from incubator_predictionio_tpu.resilience.clock import SYSTEM_CLOCK, Clock
+
+
+@dataclasses.dataclass(frozen=True)
+class Ok:
+    """Let the operation through untouched."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Timeout:
+    """Raise TimeoutError before the operation runs (nothing sent)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Reset:
+    """Raise ConnectionResetError before the operation runs."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Slow:
+    """Delay (on the injected clock) then let the operation through."""
+
+    seconds: float = 0.5
+
+
+@dataclasses.dataclass(frozen=True)
+class PartialWrite:
+    """Execute the operation, then raise ConnectionResetError — the write
+    landed but the response was lost. Only meaningful on :class:`FaultProxy`
+    (the hook form cannot run the op); the classic trap that a retry policy
+    must NOT auto-retry for non-idempotent calls."""
+
+
+Step = Any  # one of the dataclasses above
+
+
+class FaultSchedule:
+    """An ordered fault script, optionally filtered to specific operations.
+
+    ``methods=None`` applies to every intercepted op; otherwise only ops
+    whose name is in ``methods`` consume steps (others pass through as
+    :class:`Ok` without consuming). Exhausted schedules return :class:`Ok`
+    forever — "N faults then recovery" is just a list of N faults.
+    """
+
+    def __init__(self, steps: Iterable[Step], *,
+                 methods: Optional[Sequence[str]] = None):
+        self._steps: list[Step] = list(steps)
+        self._pos = 0
+        self.methods = frozenset(methods) if methods is not None else None
+        #: (op, step) pairs in consumption order — the assertion surface.
+        self.log: list[tuple[str, Step]] = []
+
+    @classmethod
+    def scripted(cls, *steps: Step,
+                 methods: Optional[Sequence[str]] = None) -> "FaultSchedule":
+        return cls(steps, methods=methods)
+
+    @classmethod
+    def seeded(cls, seed: int, n: int, *, p_timeout: float = 0.2,
+               p_reset: float = 0.1, p_slow: float = 0.1,
+               slow_seconds: float = 0.5,
+               methods: Optional[Sequence[str]] = None) -> "FaultSchedule":
+        """A reproducible random script: same seed, same faults, forever."""
+        rng = random.Random(seed)
+        steps: list[Step] = []
+        for _ in range(n):
+            r = rng.random()
+            if r < p_timeout:
+                steps.append(Timeout())
+            elif r < p_timeout + p_reset:
+                steps.append(Reset())
+            elif r < p_timeout + p_reset + p_slow:
+                steps.append(Slow(slow_seconds))
+            else:
+                steps.append(Ok())
+        return cls(steps, methods=methods)
+
+    @property
+    def remaining(self) -> int:
+        return len(self._steps) - self._pos
+
+    def next_for(self, op: str) -> Step:
+        if self.methods is not None and op not in self.methods:
+            return Ok()
+        step = self._steps[self._pos] if self._pos < len(self._steps) else Ok()
+        if self._pos < len(self._steps):
+            self._pos += 1
+        self.log.append((op, step))
+        return step
+
+
+class FaultInjector:
+    """Hook-form injector for transports exposing ``fault_hook(op)``.
+
+    Raises the scheduled fault (or delays on the injected clock) before the
+    transport touches the network. ``calls`` records every intercepted op
+    name in order, so tests can assert exact attempt counts.
+    """
+
+    def __init__(self, schedule: FaultSchedule, clock: Clock = SYSTEM_CLOCK):
+        self.schedule = schedule
+        self.clock = clock
+        self.calls: list[str] = []
+
+    def __call__(self, op: str) -> None:
+        self.calls.append(op)
+        step = self.schedule.next_for(op)
+        if isinstance(step, Timeout):
+            raise TimeoutError(f"injected timeout in {op}")
+        if isinstance(step, Reset):
+            raise ConnectionResetError(f"injected connection reset in {op}")
+        if isinstance(step, Slow):
+            self.clock.sleep(step.seconds)
+        elif isinstance(step, PartialWrite):
+            raise TypeError(
+                "PartialWrite requires FaultProxy (the hook form runs "
+                "before the operation and cannot execute it)")
+
+
+class FaultProxy:
+    """Wrapper-form injector: ``FaultProxy(store, schedule)`` quacks like
+    ``store`` but applies the schedule around every method call."""
+
+    def __init__(self, target: Any, schedule: FaultSchedule,
+                 clock: Clock = SYSTEM_CLOCK):
+        self._target = target
+        self._schedule = schedule
+        self._clock = clock
+        #: op names in interception order (assertion surface).
+        self.calls: list[str] = []
+
+    def __getattr__(self, name: str) -> Any:
+        attr = getattr(self._target, name)
+        if not callable(attr):
+            return attr
+
+        def wrapper(*args: Any, **kwargs: Any) -> Any:
+            self.calls.append(name)
+            step = self._schedule.next_for(name)
+            if isinstance(step, Timeout):
+                raise TimeoutError(f"injected timeout in {name}")
+            if isinstance(step, Reset):
+                raise ConnectionResetError(
+                    f"injected connection reset in {name}")
+            if isinstance(step, Slow):
+                self._clock.sleep(step.seconds)
+                return attr(*args, **kwargs)
+            if isinstance(step, PartialWrite):
+                attr(*args, **kwargs)  # the write LANDS...
+                raise ConnectionResetError(  # ...but the caller never knows
+                    f"injected partial write in {name} "
+                    "(applied; response lost)")
+            return attr(*args, **kwargs)
+
+        return wrapper

@@ -23,30 +23,56 @@ from __future__ import annotations
 
 import asyncio
 import base64
+import collections
 import dataclasses
 import datetime as _dt
 import json
 import logging
 import os
+import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
-from typing import Optional
+from typing import Optional, Sequence
 
 from aiohttp import web
 
 from incubator_predictionio_tpu.data.event import (
     Event,
     EventValidationError,
+    time_prefixed_event_id,
     validate_event,
 )
 from incubator_predictionio_tpu.data.storage.base import AccessKey
 from incubator_predictionio_tpu.data.storage.registry import Storage, get_storage
 from incubator_predictionio_tpu.data.webhooks import CONNECTORS, ConnectorError
+from incubator_predictionio_tpu.resilience.breaker import (
+    BREAKERS,
+    CircuitBreaker,
+    CircuitOpenError,
+)
+from incubator_predictionio_tpu.resilience.policy import (
+    DeadlineExceeded,
+    TransientError,
+)
 from incubator_predictionio_tpu.server.stats import Stats
 
 logger = logging.getLogger(__name__)
 
 MAX_BATCH_SIZE = 50  # EventServer.scala:70
+
+#: storage-write failures that mean "backend unhealthy", not "bad event" —
+#: these count against the breaker and divert the write to the spill queue.
+#: Deliberately NOT all StorageError: a semantic rejection (constraint
+#: violation, mapping error) would be re-rejected identically on every
+#: drain replay, wedging the queue head — those must surface to the caller.
+_TRANSIENT_STORE_ERRORS = (ConnectionError, TimeoutError, OSError,
+                           TransientError, CircuitOpenError, DeadlineExceeded)
+
+
+class SpillQueueFull(Exception):
+    """The storage breaker is open (or writes are failing) AND the bounded
+    in-memory spill queue is at capacity — the only condition under which
+    ingestion answers 503 (with Retry-After)."""
 
 
 def _ssl_context(config) -> "Optional[object]":
@@ -71,6 +97,21 @@ class EventServerConfig:
         default_factory=lambda: os.environ.get("PIO_EVENTSERVER_STATS", "").lower()
         in ("1", "true", "yes")
     )
+    # -- write resilience (resilience/, docs/resilience.md) ---------------
+    # bounded spill queue: events accepted (201) while the event store is
+    # failing, drained when it recovers; 503 + Retry-After only when full
+    spill_max: int = dataclasses.field(
+        default_factory=lambda: int(
+            os.environ.get("PIO_EVENTSERVER_SPILL_MAX", "1000")))
+    retry_after_sec: int = dataclasses.field(
+        default_factory=lambda: int(
+            os.environ.get("PIO_EVENTSERVER_RETRY_AFTER", "5")))
+    breaker_threshold: int = dataclasses.field(
+        default_factory=lambda: int(
+            os.environ.get("PIO_EVENTSERVER_BREAKER_THRESHOLD", "5")))
+    breaker_reset_sec: float = dataclasses.field(
+        default_factory=lambda: float(
+            os.environ.get("PIO_EVENTSERVER_BREAKER_RESET", "10")))
 
 
 @dataclasses.dataclass
@@ -116,6 +157,19 @@ class EventServer:
         # hosts keep the hop so a slow durable write can't stall the accept
         # loop while other cores could be parsing the next request.
         self._inline_batch = (os.cpu_count() or 2) <= 1
+        # -- write resilience (resilience/) -------------------------------
+        # breaker over the event store's write path: opens after
+        # consecutive transient failures; while failing/open, accepted
+        # events divert to the bounded spill queue and drain on recovery
+        self._store_breaker = CircuitBreaker(
+            "eventstore", failure_threshold=config.breaker_threshold,
+            reset_timeout=config.breaker_reset_sec)
+        self._spill: collections.deque[tuple[Event, int, Optional[int]]] = (
+            collections.deque())
+        self._spill_lock = threading.Lock()
+        self._drain_task: Optional[asyncio.Task] = None
+        self._DRAIN_INTERVAL = 0.5
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
 
     @staticmethod
     def _auth_ttl() -> float:
@@ -159,6 +213,10 @@ class EventServer:
     async def _authenticate_cached(self, request: web.Request) -> AuthData:
         """Auth with a short-TTL cache over (accessKey, channel) — the
         metadata lookups are per-request invariant on the ingest hot path."""
+        if self._loop is None:
+            # embedded runs (aiohttp test server) never call start(); the
+            # spill drainer still needs a loop to schedule onto
+            self._loop = asyncio.get_running_loop()
         key = self._extract_key(request)
         channel = request.query.get("channel")
         if self._AUTH_TTL <= 0:  # caching disabled: per-request lookup
@@ -261,12 +319,149 @@ class EventServer:
             self._ensure_init(auth)
             return op()
 
+    # -- breaker-guarded writes + spill queue (resilience/) ---------------
+    def _store_events(self, events: Sequence[Event], auth: AuthData) -> list[str]:
+        """The ONE write path to the event store: gated by the breaker,
+        transient failures spill to the bounded in-memory queue (the write
+        is still acknowledged 201 — its id is pre-assigned so the drain
+        replay is idempotent), and only a full queue raises
+        :class:`SpillQueueFull` (→ 503 + Retry-After).
+
+        Ids are pre-assigned BEFORE the first attempt: a write whose
+        response was lost may have committed, and a spill-then-drain replay
+        under fresh ids would silently double-store those events — with the
+        id fixed up front, the replay overwrites itself on every backend
+        (INSERT OR REPLACE / explicit-id index)."""
+        events = [e if e.event_id else
+                  e.with_id(time_prefixed_event_id(e.creation_time))
+                  for e in events]
+        if not self._store_breaker.allow():
+            return self._spill_events(events, auth)
+        try:
+            self._ensure_init(auth)
+            ids = self._insert_healing(
+                lambda: self.storage.get_events().insert_batch(
+                    list(events), auth.app_id, auth.channel_id), auth)
+        except _TRANSIENT_STORE_ERRORS as e:
+            self._store_breaker.record_failure()
+            logger.warning("event store write failed (%s); spilling %d "
+                           "event(s)", e, len(events))
+            return self._spill_events(events, auth)
+        except Exception:
+            # non-transient = the store answered (bad data, programming
+            # error): health-wise a success, and a half-open probe slot
+            # must not leak
+            self._store_breaker.record_success()
+            raise
+        self._store_breaker.record_success()
+        return ids
+
+    def _spill_events(self, events: Sequence[Event],
+                      auth: AuthData) -> list[str]:
+        with self._spill_lock:
+            if len(self._spill) + len(events) > self.config.spill_max:
+                raise SpillQueueFull(
+                    f"spill queue at capacity ({self.config.spill_max})")
+            ids = []
+            for e in events:
+                # ids were pre-assigned by _store_events (time-prefixed
+                # 32-hex, btree-right-edge friendly for the burst replay);
+                # direct callers may still hand in id-less events
+                eid = e.event_id or time_prefixed_event_id(e.creation_time)
+                self._spill.append(
+                    (e.with_id(eid), auth.app_id, auth.channel_id))
+                ids.append(eid)
+        self._kick_drain()
+        return ids
+
+    def _kick_drain(self) -> None:
+        """Ensure the drain task is running (callable from executor
+        threads — the task itself must start on the loop)."""
+        loop = self._loop
+        if loop is None or loop.is_closed():
+            return
+        loop.call_soon_threadsafe(self._ensure_drain_task)
+
+    def _ensure_drain_task(self) -> None:
+        if self._drain_task is None or self._drain_task.done():
+            self._drain_task = asyncio.get_running_loop().create_task(
+                self._drain_spill())
+
+    async def _drain_spill(self) -> None:
+        while self._spill:
+            try:
+                progressed = await self._run(self._drain_spill_once)
+            except Exception:  # noqa: BLE001 - the drainer must survive
+                # _drain_spill_once already dropped the offending batch (a
+                # store-rejected batch can never succeed on replay); log is
+                # there — keep draining the rest after a beat
+                progressed = False
+            if not progressed:
+                await asyncio.sleep(self._DRAIN_INTERVAL)
+
+    def _drain_spill_once(self) -> bool:
+        """Flush one head-of-queue batch (same app/channel run, ≤ 50).
+        Returns True on progress; a failed probe re-opens the breaker and
+        the caller backs off. Sync — tests drive recovery deterministically
+        by calling this directly."""
+        with self._spill_lock:
+            if not self._spill:
+                return True
+            _, app_id, channel_id = self._spill[0]
+            batch = []
+            for e, a, c in self._spill:
+                if (a, c) != (app_id, channel_id) or len(batch) >= MAX_BATCH_SIZE:
+                    break
+                batch.append(e)
+        if not self._store_breaker.allow():
+            return False
+        auth = AuthData(app_id, channel_id, ())
+        try:
+            self._ensure_init(auth)
+        except Exception as e:  # noqa: BLE001
+            # init failing says NOTHING about these events (permission,
+            # schema drift): never drop on an init error — back off and
+            # keep the batch, whatever the failure class
+            self._store_breaker.record_failure()
+            logger.warning("spill drain: store init failed (%s); %d "
+                           "event(s) still queued", e, len(self._spill))
+            return False
+        try:
+            self._insert_healing(
+                lambda: self.storage.get_events().insert_batch(
+                    batch, app_id, channel_id), auth)
+        except _TRANSIENT_STORE_ERRORS as e:
+            self._store_breaker.record_failure()
+            logger.warning("spill drain probe failed (%s); %d event(s) "
+                           "still queued", e, len(self._spill))
+            return False
+        except Exception:
+            # the store ANSWERED and rejected THIS batch (semantic error):
+            # replaying it forever would wedge the whole queue behind it —
+            # drop it, loudly (these events were 201-acked; this is the
+            # bounded-durability trade docs/resilience.md documents)
+            self._store_breaker.record_success()
+            with self._spill_lock:
+                for _ in range(len(batch)):
+                    self._spill.popleft()
+            logger.exception(
+                "spill drain: store rejected %d event(s) non-transiently; "
+                "DROPPING them to unwedge the queue (ids: %s)",
+                len(batch), [e.event_id for e in batch][:8])
+            raise
+        self._store_breaker.record_success()
+        with self._spill_lock:
+            # only this drainer pops; ingest threads only append — the head
+            # run we snapshotted is still the head
+            for _ in range(len(batch)):
+                self._spill.popleft()
+        logger.info("spill drain: flushed %d event(s), %d remaining",
+                    len(batch), len(self._spill))
+        return True
+
     def _ingest_one(self, payload: dict, auth: AuthData) -> str:
         event = self._prepare_event(payload, auth)
-        self._ensure_init(auth)
-        return self._insert_healing(
-            lambda: self.storage.get_events().insert(
-                event, auth.app_id, auth.channel_id), auth)
+        return self._store_events([event], auth)[0]
 
     async def _try_native_ingest(self, raw: bytes, single: bool,
                                  max_items: int, auth: AuthData):
@@ -309,6 +504,7 @@ class EventServer:
                 return web.json_response({"message": r["message"]},
                                          status=r["status"])
         payload = None
+        headers = None
         try:
             payload = await request.json()
             if not isinstance(payload, dict):
@@ -319,13 +515,16 @@ class EventServer:
             status, body = 400, {"message": str(e)}
         except WhitelistDenied as e:
             status, body = 403, {"message": str(e)}
+        except SpillQueueFull as e:
+            status, body, headers = 503, {"message": str(e)}, \
+                {"Retry-After": str(self.config.retry_after_sec)}
         if self.config.stats:
             self.stats.update(
                 auth.app_id, status,
                 payload.get("event", "<invalid>") if isinstance(payload, dict) else "<invalid>",
                 payload.get("entityType", "<invalid>") if isinstance(payload, dict) else "<invalid>",
             )
-        return web.json_response(body, status=status)
+        return web.json_response(body, status=status, headers=headers)
 
     def _ingest_batch(self, payload: list, auth: AuthData) -> list[dict]:
         """One executor hop AND one storage write for the whole batch.
@@ -354,11 +553,16 @@ class EventServer:
                 # per-item 403, batch continues (EventServer.scala:430-433)
                 results.append({"status": 403, "message": str(e)})
         if accepted:
-            self._ensure_init(auth)
-            batch_events = [e for _, e in accepted]
-            ids = self._insert_healing(
-                lambda: self.storage.get_events().insert_batch(
-                    batch_events, auth.app_id, auth.channel_id), auth)
+            try:
+                ids = self._store_events([e for _, e in accepted], auth)
+            except SpillQueueFull as e:
+                # per-item statuses were already decided for the 400/403
+                # items — carry them on the exception so stats bookkeeping
+                # doesn't flatten the whole batch to 503
+                for slot, _ in accepted:
+                    results[slot] = {"status": 503}
+                e.results = results
+                raise
             for (slot, _), event_id in zip(accepted, ids):
                 results[slot]["eventId"] = event_id
         return results
@@ -366,9 +570,12 @@ class EventServer:
     async def handle_batch(self, request: web.Request) -> web.Response:
         auth = await self._authenticate_cached(request)
         raw = await request.read()
-        fast = await self._try_native_ingest(raw, False, MAX_BATCH_SIZE, auth)
-        if fast is not None:
-            return web.json_response(fast, status=200)
+        if not self.config.stats:  # stats needs the parsed payload fields
+            # (ADVICE r5: the fast path must not make batched events
+            # invisible to /stats.json — same gate as handle_create)
+            fast = await self._try_native_ingest(raw, False, MAX_BATCH_SIZE, auth)
+            if fast is not None:
+                return web.json_response(fast, status=200)
         try:
             payload = json.loads(raw)
         except json.JSONDecodeError as e:
@@ -383,11 +590,38 @@ class EventServer:
                             f"{MAX_BATCH_SIZE} events"},
                 status=400,
             )
-        if self._inline_batch:
-            results = self._ingest_batch(payload, auth)
-        else:
-            results = await self._run(self._ingest_batch, payload, auth)
+        try:
+            if self._inline_batch:
+                results = self._ingest_batch(payload, auth)
+            else:
+                results = await self._run(self._ingest_batch, payload, auth)
+        except SpillQueueFull as e:
+            if self.config.stats:
+                # overload rejections must be visible in /stats.json, same
+                # as handle_create's 503 bookkeeping — with the validated
+                # items' own 400/403 statuses preserved
+                self._update_batch_stats(
+                    auth, payload,
+                    getattr(e, "results", None)
+                    or [{"status": 503}] * len(payload))
+            return web.json_response(
+                {"message": str(e)}, status=503,
+                headers={"Retry-After": str(self.config.retry_after_sec)})
+        if self.config.stats:
+            # per accepted/denied item, like the reference's per-batch-event
+            # Bookkeeping updates (EventServer.scala:421-423)
+            self._update_batch_stats(auth, payload, results)
         return web.json_response(results, status=200)
+
+    def _update_batch_stats(self, auth: AuthData, payload: list,
+                            results: list[dict]) -> None:
+        for item, r in zip(payload, results):
+            is_dict = isinstance(item, dict)
+            self.stats.update(
+                auth.app_id, r["status"],
+                item.get("event", "<invalid>") if is_dict else "<invalid>",
+                item.get("entityType", "<invalid>") if is_dict else "<invalid>",
+            )
 
     # -- reads ------------------------------------------------------------
     async def handle_get_event(self, request: web.Request) -> web.Response:
@@ -479,6 +713,24 @@ class EventServer:
     async def handle_root(self, request: web.Request) -> web.Response:
         return web.json_response({"status": "alive"})
 
+    async def handle_health(self, request: web.Request) -> web.Response:
+        """Breaker + spill-queue state (resilience/): 'degraded' while the
+        event store is being routed around, 'ok' otherwise — always 200
+        (the server itself is alive either way)."""
+        store = self._store_breaker.snapshot()
+        backends = BREAKERS.snapshot()
+        with self._spill_lock:
+            depth = len(self._spill)
+        degraded = depth > 0 or any(
+            s["state"] != "closed" for s in (store, *backends.values()))
+        return web.json_response({
+            "status": "degraded" if degraded else "ok",
+            "eventStoreBreaker": store,
+            "backendBreakers": backends,
+            "spillQueueDepth": depth,
+            "spillQueueMax": self.config.spill_max,
+        })
+
     async def handle_stats(self, request: web.Request) -> web.Response:
         auth = await self._authenticate_cached(request)
         if not self.config.stats:
@@ -510,6 +762,10 @@ class EventServer:
             return web.json_response({"message": str(e)}, status=400)
         except WhitelistDenied as e:
             return web.json_response({"message": str(e)}, status=403)
+        except SpillQueueFull as e:
+            return web.json_response(
+                {"message": str(e)}, status=503,
+                headers={"Retry-After": str(self.config.retry_after_sec)})
 
     async def handle_webhook_get(self, request: web.Request) -> web.Response:
         await self._authenticate_cached(request)
@@ -525,6 +781,7 @@ class EventServer:
         app = web.Application()
         r = app.router
         r.add_get("/", self.handle_root)
+        r.add_get("/health", self.handle_health)
         r.add_post("/events.json", self.handle_create)
         r.add_get("/events.json", self.handle_find)
         r.add_get("/events/{event_id}.json", self.handle_get_event)
@@ -536,6 +793,8 @@ class EventServer:
         return app
 
     async def start(self) -> None:
+        # the spill drainer schedules onto this loop from executor threads
+        self._loop = asyncio.get_running_loop()
         # no per-request access log: formatting a log line per request costs
         # more than parsing the request at ingestion rates
         self._runner = web.AppRunner(self.make_app(), access_log=None)
@@ -601,8 +860,11 @@ class EventServer:
             channel = (q.get("channel") or [None])[0]
             if not key:
                 return None  # Basic-auth header path: aiohttp owns it
-            if path == "/events.json" and self.config.stats:
-                return None  # stats needs the parsed payload fields
+            if self.config.stats:
+                # stats needs the parsed payload fields — tunnel BOTH ingest
+                # routes to aiohttp, which counts per item (ADVICE r5: the
+                # batch route must not bypass /stats.json bookkeeping)
+                return None
             try:
                 auth = self._authenticate_cached_sync(key, channel)
             except web.HTTPException as e:
@@ -655,6 +917,29 @@ class EventServer:
 
             native.http_front_stop(front)
             self._front = None
+        if self._drain_task is not None:
+            self._drain_task.cancel()
+            try:
+                await self._drain_task
+            except asyncio.CancelledError:
+                pass
+            self._drain_task = None
+        # final best-effort flush: every queued event was 201-acked — if
+        # the store is reachable, land them before exiting (bounded: a
+        # still-down store must not block shutdown)
+        flush_deadline = time.monotonic() + 5.0
+        while self._spill and time.monotonic() < flush_deadline:
+            try:
+                if not await self._run(self._drain_spill_once):
+                    break
+            except Exception:  # noqa: BLE001 - poison batch already logged
+                continue
+        if self._spill:
+            logger.error(
+                "shutdown: DROPPING %d acknowledged spilled event(s) — the "
+                "event store never recovered (first ids: %s)",
+                len(self._spill), [e.event_id for e, _, _ in
+                                   list(self._spill)[:8]])
         if self._runner is not None:
             await self._runner.cleanup()
         self._executor.shutdown(wait=False)

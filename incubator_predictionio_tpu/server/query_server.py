@@ -29,6 +29,7 @@ from __future__ import annotations
 import asyncio
 import dataclasses
 import datetime as _dt
+import hashlib
 import json
 import logging
 import time
@@ -46,6 +47,17 @@ from incubator_predictionio_tpu.core.controller import (
 from incubator_predictionio_tpu.data.storage.base import EngineInstance
 from incubator_predictionio_tpu.data.storage.registry import Storage, get_storage
 from incubator_predictionio_tpu.parallel.mesh import MeshContext
+from incubator_predictionio_tpu.resilience.breaker import (
+    BREAKERS,
+    CircuitBreaker,
+    CircuitOpenError,
+)
+from incubator_predictionio_tpu.resilience.clock import SYSTEM_CLOCK, Clock
+from incubator_predictionio_tpu.resilience.policy import (
+    DeadlineExceeded,
+    ServingUnavailable,
+    run_with_deadline,
+)
 from incubator_predictionio_tpu.utils import jitstats
 from incubator_predictionio_tpu.utils.json_util import bind_query, to_jsonable
 from incubator_predictionio_tpu.utils.serialization import deserialize_model
@@ -76,6 +88,19 @@ class ServerConfig:
     max_in_flight: Optional[int] = None
     log_url: Optional[str] = None  # remote error-log shipping (CreateServer.scala:423-436)
     log_prefix: str = ""  # prepended to shipped log messages
+    # -- graceful degradation (resilience/) -------------------------------
+    # total per-query budget: a query still unanswered after this many
+    # seconds gets a degraded-but-valid response (last-good cache or the
+    # serving layer's default), never a 500. Also propagated to storage
+    # calls under the predict path via deadline_scope. None disables.
+    query_timeout_sec: Optional[float] = None
+    # per-algorithm deadline: an algorithm slower than this counts a
+    # breaker failure even when it eventually answers. None disables.
+    algo_deadline_sec: Optional[float] = None
+    # consecutive failures before an algorithm's breaker opens, and how
+    # long it stays open before a half-open probe
+    algo_breaker_threshold: int = 3
+    algo_breaker_reset_sec: float = 10.0
 
 
 class DeployedEngine:
@@ -89,6 +114,10 @@ class DeployedEngine:
         models: list[Any],
         max_batch: int = 64,
         warmup: bool = True,
+        algo_deadline: Optional[float] = None,
+        breaker_threshold: int = 3,
+        breaker_reset: float = 10.0,
+        clock: Clock = SYSTEM_CLOCK,
     ):
         self.engine = engine
         self.engine_params = engine_params
@@ -102,6 +131,19 @@ class DeployedEngine:
         self.query_cls = next(
             (a.query_class() for a in algorithms if a.query_class() is not None), None
         )
+        # per-algorithm circuit breakers: a consistently failing (or, with
+        # algo_deadline set, consistently slow) algorithm is skipped and the
+        # remaining algorithms keep serving — not registered in the global
+        # BREAKERS registry because their lifetime is this deployment's
+        # (reload/tests build fresh engines; /health composes both views)
+        self.algo_deadline = algo_deadline
+        self._clock = clock
+        self.algo_breakers = [
+            CircuitBreaker(f"algorithm:{i}:{type(a).__name__}",
+                           failure_threshold=breaker_threshold,
+                           reset_timeout=breaker_reset, clock=clock)
+            for i, a in enumerate(algorithms)
+        ]
         if warmup:
             self.warmup(max_batch)
 
@@ -119,19 +161,90 @@ class DeployedEngine:
             if callable(w):
                 w(max_batch)
 
+    def _record_algo_timing(self, idx: int, took: float) -> None:
+        """Success bookkeeping with the per-algorithm deadline: a completed
+        call slower than the deadline still counts as a breaker failure —
+        an algorithm that keeps blowing its budget should be skipped, not
+        waited on."""
+        brk = self.algo_breakers[idx]
+        if self.algo_deadline is not None and took > self.algo_deadline:
+            brk.record_failure()
+        else:
+            brk.record_success()
+
+    def _record_batch_outcome(self, ai: int, results: dict[int, Any],
+                              took: float, single_call: bool) -> None:
+        """Breaker verdict for one algorithm's share of a batch: healthy if
+        ANY query got a prediction, healthy if every failure is
+        query-semantic (bad queries, not a bad algorithm), failing only
+        when every query died with an infrastructure-class error."""
+        vals = list(results.values())
+        if any(not isinstance(v, Exception) for v in vals):
+            if single_call:
+                self._record_algo_timing(ai, took)
+            else:
+                self.algo_breakers[ai].record_success()
+        elif vals and all(isinstance(v, (TypeError, ValueError, KeyError))
+                          for v in vals):
+            self.algo_breakers[ai].record_success()
+        else:
+            self.algo_breakers[ai].record_failure()
+
+    def _live_algorithms(self) -> list[int]:
+        live = [i for i in range(len(self.algorithms))
+                if self.algo_breakers[i].allow()]
+        if not live:
+            raise ServingUnavailable(
+                "all algorithms have open circuit breakers")
+        return live
+
     def predict(self, payload: dict) -> Any:
         query = bind_query(self.query_cls, payload)
         query = self.serving.supplement(query)
-        predictions = [
-            a.predict(m, query) for a, m in zip(self.algorithms, self.models)
-        ]
+        predictions = []
+        live = self._live_algorithms()
+        # _live_algorithms admitted a (possibly half-open-probe) slot on
+        # EVERY live breaker; if an early algorithm raises, the later ones
+        # never get an outcome — hand their slots back or they wedge
+        pending = set(live)
+        try:
+            for i in live:
+                t0 = self._clock.monotonic()
+                try:
+                    predictions.append(
+                        self.algorithms[i].predict(self.models[i], query))
+                except (TypeError, ValueError, KeyError):
+                    # query-semantic rejection (unknown entity, bad shape):
+                    # the algorithm is healthy — a run of bad queries must
+                    # not trip its breaker and degrade everyone's traffic
+                    pending.discard(i)
+                    self.algo_breakers[i].record_success()
+                    raise
+                except Exception:
+                    pending.discard(i)
+                    self.algo_breakers[i].record_failure()
+                    raise
+                pending.discard(i)
+                self._record_algo_timing(i, self._clock.monotonic() - t0)
+        finally:
+            for j in pending:
+                self.algo_breakers[j].release_probe()
         return self.serving.serve(query, predictions)
 
     def predict_batch(self, payloads: list[dict]) -> list[Any]:
         """Batched predict: one ``batch_predict`` device dispatch per
         algorithm instead of one per query — the fix for the reference's
         unshipped 'TODO: Parallelize' (CreateServer.scala:488). Returns one
-        result OR exception per payload (bad queries don't fail the batch)."""
+        result OR exception per payload (bad queries don't fail the batch).
+
+        Degradation semantics (resilience/): algorithms whose breaker is
+        open are skipped; an algorithm that raises is retried query-by-query
+        so a poison query fails alone, and a breaker failure is counted only
+        when an algorithm fails every query with an infrastructure-class
+        error (backend down, model broken) — all-semantic failures (bad
+        queries) leave the breaker alone.
+        Queries are served from whichever algorithms survived; a query no
+        algorithm could answer carries its first error."""
         out: list[Any] = [None] * len(payloads)
         bound: list[Any] = [None] * len(payloads)
         for i, p in enumerate(payloads):
@@ -143,24 +256,64 @@ class DeployedEngine:
         if not live:
             return out
         try:
-            per_algo = [
-                dict(a.batch_predict(m, [(i, bound[i]) for i in live]))
-                for a, m in zip(self.algorithms, self.models)
-            ]
+            algo_live = self._live_algorithms()
+        except ServingUnavailable as e:
             for i in live:
-                out[i] = self.serving.serve(bound[i], [pa[i] for pa in per_algo])
-        except Exception:  # noqa: BLE001 - isolate the failing query
-            # a query poisoned the whole batch: retry one by one so only the
-            # offender fails
-            for i in live:
-                try:
-                    preds = [
-                        a.predict(m, bound[i])
-                        for a, m in zip(self.algorithms, self.models)
-                    ]
-                    out[i] = self.serving.serve(bound[i], preds)
-                except Exception as e:  # noqa: BLE001
-                    out[i] = e
+                out[i] = e
+            return out
+        per_algo: dict[int, dict[int, Any]] = {}  # algo idx -> query idx -> pred/exc
+        for ai in algo_live:
+            a, m = self.algorithms[ai], self.models[ai]
+            t0 = self._clock.monotonic()
+            healed = False
+            try:
+                got = dict(a.batch_predict(m, [(i, bound[i]) for i in live]))
+                for i in live:
+                    if i not in got:
+                        # sparse batch result: heal per query (the pre-
+                        # resilience code recovered this case through its
+                        # KeyError → retry-all path)
+                        healed = True
+                        try:
+                            got[i] = a.predict(m, bound[i])
+                        except Exception as e:  # noqa: BLE001
+                            got[i] = e
+                per_algo[ai] = {i: got[i] for i in live}
+            except Exception:  # noqa: BLE001 - isolate the failing query
+                # a query may have poisoned the whole batch: retry one by
+                # one so only the offender fails
+                healed = True
+                singles: dict[int, Any] = {}
+                for i in live:
+                    try:
+                        singles[i] = a.predict(m, bound[i])
+                    except Exception as e:  # noqa: BLE001
+                        singles[i] = e
+                per_algo[ai] = singles
+            self._record_batch_outcome(
+                ai, per_algo[ai], self._clock.monotonic() - t0,
+                # the per-call deadline is only meaningful when the elapsed
+                # time WAS one call: a single-query batch with no heals.
+                # Judging it against a coalesced N-query dispatch (or a
+                # batch attempt plus N retries) would brand a healthy
+                # algorithm slow exactly under peak load
+                single_call=(len(live) == 1 and not healed))
+        for i in live:
+            preds, first_err = [], None
+            for ai in algo_live:
+                v = per_algo[ai][i]
+                if isinstance(v, Exception):
+                    first_err = first_err or v
+                else:
+                    preds.append(v)
+            if not preds:
+                out[i] = first_err or ServingUnavailable(
+                    "no algorithm produced a prediction")
+                continue
+            try:
+                out[i] = self.serving.serve(bound[i], preds)
+            except Exception as e:  # noqa: BLE001
+                out[i] = e
         return out
 
 
@@ -184,10 +337,14 @@ class MicroBatcher:
     """
 
     def __init__(self, deployed: DeployedEngine, max_batch: int = 64,
-                 max_in_flight: int = 2):
+                 max_in_flight: int = 2,
+                 deadline_sec: Optional[float] = None):
         self.deployed = deployed
         self.max_batch = max_batch
         self.max_in_flight = max_in_flight
+        # per-batch budget, propagated into the worker thread as the
+        # ambient deadline so storage calls under predict inherit it
+        self.deadline_sec = deadline_sec
         self.queue: asyncio.Queue = asyncio.Queue()
         self.batches_served = 0
         self.max_batch_seen = 0
@@ -294,8 +451,11 @@ class MicroBatcher:
         t0 = time.perf_counter()
         payloads = [p for p, _, _ in batch]
         try:
+            # run_in_executor does not copy contextvars — run_with_deadline
+            # re-establishes the deadline scope inside the worker thread
             results = await loop.run_in_executor(
-                None, self.deployed.predict_batch, payloads
+                None, run_with_deadline, self.deadline_sec,
+                self.deployed.predict_batch, payloads
             )
         except asyncio.CancelledError:
             # cancelled mid-dispatch: these futures are already dequeued, so
@@ -375,7 +535,10 @@ def load_deployed_engine(
     logger.info("deployed engine instance %s (trained %s)", instance.id,
                 instance.start_time)
     return DeployedEngine(engine, engine_params, instance, models,
-                          max_batch=config.max_batch)
+                          max_batch=config.max_batch,
+                          algo_deadline=config.algo_deadline_sec,
+                          breaker_threshold=config.algo_breaker_threshold,
+                          breaker_reset=config.algo_breaker_reset_sec)
 
 
 def effective_max_in_flight(config: ServerConfig, deployed: DeployedEngine) -> int:
@@ -400,19 +563,40 @@ class QueryServer:
         config: ServerConfig,
         storage: Optional[Storage] = None,
         ctx: Optional[MeshContext] = None,
+        deployed: Optional[DeployedEngine] = None,
     ):
         self.config = config
         self.storage = storage or get_storage()
         self.ctx = ctx or MeshContext.create()
-        self.deployed = load_deployed_engine(config, self.storage, self.ctx)
+        # an explicit DeployedEngine skips storage loading (tests inject
+        # hand-built engines to script failure modes)
+        self.deployed = deployed or load_deployed_engine(
+            config, self.storage, self.ctx)
         self.batcher = MicroBatcher(
             self.deployed, max_batch=config.max_batch,
             max_in_flight=effective_max_in_flight(config, self.deployed),
+            deadline_sec=config.query_timeout_sec,
         )
         self.request_count = 0
         self.avg_serving_sec = 0.0
         self.last_serving_sec = 0.0
         self.latency = LatencyReservoir()
+        # -- graceful degradation state (resilience/) ---------------------
+        # server-level breaker over the whole predict path: opens after
+        # repeated timeouts/unavailability so a dead engine answers
+        # degraded responses instantly instead of waiting out every budget
+        self._serving_breaker = CircuitBreaker(
+            "serving", failure_threshold=config.algo_breaker_threshold,
+            reset_timeout=config.algo_breaker_reset_sec)
+        # last-good predictions keyed by canonical query JSON (bounded
+        # LRU); guarded by a lock — _degraded_result runs in executor
+        # threads while _remember_good mutates on the loop thread
+        import threading
+
+        self._last_good: "dict[str, Any]" = {}
+        self._last_good_lock = threading.Lock()
+        self._LAST_GOOD_MAX = 1024
+        self.degraded_count = 0
         self._start_time = time.time()
         self._runner: Optional[web.AppRunner] = None
         self._stop_event = asyncio.Event()
@@ -422,11 +606,32 @@ class QueryServer:
     def make_app(self) -> web.Application:
         app = web.Application()
         app.router.add_get("/", self.handle_status)
+        app.router.add_get("/health", self.handle_health)
         app.router.add_post("/queries.json", self.handle_query)
         app.router.add_post("/reload", self.handle_reload)
         app.router.add_post("/stop", self.handle_stop)
         app.router.add_get("/plugins.json", self.handle_plugins)
         return app
+
+    async def handle_health(self, request: web.Request) -> web.Response:
+        """Liveness + breaker state: per-algorithm, the serving path, and
+        every storage backend registered in the process-wide registry."""
+        algo = {
+            b.name: b.snapshot()
+            for b in self.deployed.algo_breakers
+        }
+        serving = self._serving_breaker.snapshot()
+        backends = BREAKERS.snapshot()
+        degraded = any(
+            s["state"] != "closed"
+            for s in (serving, *algo.values(), *backends.values()))
+        return web.json_response({
+            "status": "degraded" if degraded else "ok",
+            "servingBreaker": serving,
+            "algorithmBreakers": algo,
+            "backendBreakers": backends,
+            "degradedResponses": self.degraded_count,
+        })
 
     async def handle_status(self, request: web.Request) -> web.Response:
         inst = self.deployed.instance
@@ -553,13 +758,51 @@ class QueryServer:
             payload = json.loads(body)
         except json.JSONDecodeError:
             return 400, {"message": "Invalid JSON query"}
+        loop = asyncio.get_running_loop()
+        if not self._serving_breaker.allow():
+            # the predict path has been failing hard: degrade instantly
+            # instead of waiting out another budget (half-open probes are
+            # admitted by allow() once the reset window elapses). User code
+            # (default_result, plugins) runs in the executor — under outage
+            # EVERY request takes this path, and it must not block the loop
+            return 200, await loop.run_in_executor(
+                None, self._degraded_result, payload, "serving breaker open")
         try:
-            prediction = await self.batcher.submit(payload)
+            submitted = self.batcher.submit(payload)
+            if self.config.query_timeout_sec is not None:
+                prediction = await asyncio.wait_for(
+                    submitted, self.config.query_timeout_sec)
+            else:
+                prediction = await submitted
+        except asyncio.CancelledError:
+            # client disconnected mid-await (aiohttp cancels the handler):
+            # no verdict on the engine's health — hand back the admitted
+            # half-open probe slot or the breaker wedges half-open forever
+            self._serving_breaker.release_probe()
+            raise
         except (TypeError, ValueError, KeyError) as e:
+            # the engine answered (binding rejected the query): health-wise
+            # a success — a half-open probe slot must never leak
+            self._serving_breaker.record_success()
             return 400, {"message": f"Invalid query: {e}"}
+        except (asyncio.TimeoutError, ServingUnavailable, DeadlineExceeded,
+                CircuitOpenError) as e:
+            # deadline blown or every algorithm/backend breaker open:
+            # degraded-but-valid beats a 500 (ISSUE 1 acceptance)
+            self._serving_breaker.record_failure()
+            self._ship_remote_log(f"query degraded: {e!r}")
+            return 200, await loop.run_in_executor(
+                None, self._degraded_result, payload, repr(e))
         except Exception as e:  # noqa: BLE001 - ship serving errors remotely
+            # a per-query engine exception is the ENGINE answering (with an
+            # error) — not a serving outage. One client's poison query must
+            # not trip this breaker and degrade everyone; a genuinely
+            # broken engine opens the per-algorithm breakers instead, which
+            # surfaces here as ServingUnavailable (counted above).
+            self._serving_breaker.record_success()
             self._ship_remote_log(f"query failed: {e!r}")
             raise
+        self._serving_breaker.record_success()
         dt = time.time() - t0
         self.request_count += 1
         self.last_serving_sec = dt
@@ -571,11 +814,67 @@ class QueryServer:
         from incubator_predictionio_tpu.server.plugins import apply_output_plugins
 
         result = apply_output_plugins(self.deployed.instance, payload, result)
+        # cache POST-plugin: a degraded replay must never leak fields an
+        # output plugin (redaction, enrichment) would have removed
+        self._remember_good(payload, result)
         if self.config.feedback:
             task = asyncio.create_task(self._send_feedback(payload, result))
             self._feedback_tasks.add(task)
             task.add_done_callback(self._feedback_tasks.discard)
         return 200, result
+
+    # -- graceful degradation (resilience/) -------------------------------
+    @staticmethod
+    def _cache_key(payload: dict) -> str:
+        try:
+            canon = json.dumps(payload, sort_keys=True, default=str)
+        except (TypeError, ValueError):
+            canon = repr(payload)
+        # digest, not the canonical string: 1024 cached entries must not
+        # also pin 1024 full query bodies as dict keys
+        return hashlib.sha1(canon.encode()).hexdigest()
+
+    def _remember_good(self, payload: dict, result: Any) -> None:
+        key = self._cache_key(payload)
+        with self._last_good_lock:
+            self._last_good.pop(key, None)  # re-insert = move to MRU end
+            self._last_good[key] = result
+            while len(self._last_good) > self._LAST_GOOD_MAX:
+                self._last_good.pop(next(iter(self._last_good)))
+
+    def _degraded_result(self, payload: dict, reason: str) -> Any:
+        """Fallback when the engine cannot answer in time: the last good
+        prediction for this exact query, else the serving layer's declared
+        default (``serving.default_result(query)``), else a minimal valid
+        body — always 200, never a 500 (the engine being slow is our
+        problem, not the caller's)."""
+        with self._last_good_lock:
+            # += from concurrent executor threads is a lost-update hazard
+            self.degraded_count += 1
+            cached = self._last_good.get(self._cache_key(payload))
+        if cached is not None:
+            if isinstance(cached, dict):
+                return {**cached, "degraded": True}
+            return cached
+        default_fn = getattr(self.deployed.serving, "default_result", None)
+        if callable(default_fn):
+            try:
+                from incubator_predictionio_tpu.server.plugins import (
+                    apply_output_plugins,
+                )
+
+                # the documented contract passes the BOUND query (like
+                # supplement/serve), not the raw JSON dict
+                query = bind_query(self.deployed.query_cls, payload)
+                result = to_jsonable(default_fn(query), camelize_fields=True)
+                result = apply_output_plugins(
+                    self.deployed.instance, payload, result)
+                if isinstance(result, dict):
+                    return {**result, "degraded": True}
+                return result
+            except Exception:  # noqa: BLE001 - the default must never throw
+                logger.exception("serving default_result failed")
+        return {"degraded": True, "message": f"serving degraded: {reason}"}
 
     @staticmethod
     async def _post_json(url: str, body: dict, what: str) -> None:

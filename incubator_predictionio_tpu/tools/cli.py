@@ -303,6 +303,10 @@ def cmd_deploy(args, storage: Storage) -> int:
         ssl_key=args.ssl_key,
         log_url=args.log_url,
         log_prefix=args.log_prefix,
+        query_timeout_sec=args.query_timeout_sec,
+        algo_deadline_sec=args.algo_deadline_sec,
+        algo_breaker_threshold=args.algo_breaker_threshold,
+        algo_breaker_reset_sec=args.algo_breaker_reset_sec,
     )
     serve_forever(config, storage)
     return 0
@@ -741,6 +745,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "(reference CreateServer.scala:423-436)")
     p.add_argument("--log-prefix", default="",
                    help="prefix for shipped log messages")
+    p.add_argument("--query-timeout", type=float, dest="query_timeout_sec",
+                   help="total per-query budget in seconds; blown budgets "
+                        "answer degraded-200 from the last-good cache "
+                        "instead of 500 (docs/resilience.md)")
+    p.add_argument("--algo-deadline", type=float, dest="algo_deadline_sec",
+                   help="per-algorithm deadline in seconds; slower answers "
+                        "count as circuit-breaker failures")
+    p.add_argument("--algo-breaker-threshold", type=int, default=3,
+                   help="consecutive failures before an algorithm's "
+                        "breaker opens (default 3)")
+    p.add_argument("--algo-breaker-reset", type=float, default=10.0,
+                   dest="algo_breaker_reset_sec",
+                   help="seconds an open algorithm breaker waits before a "
+                        "half-open probe (default 10)")
     p = sub.add_parser("undeploy")
     p.add_argument("--ip", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8000)

@@ -282,6 +282,35 @@ def test_stats_opt_in(env):
     run_client(env, t_enabled, stats=True)
 
 
+def test_stats_count_batched_events(env):
+    """ADVICE r5: with stats enabled, /batch/events.json must feed
+    /stats.json per item (the reference updates Bookkeeping per accepted
+    batch event, EventServer.scala:421-423) — including when a fast path
+    would otherwise bypass the parsed-payload bookkeeping."""
+
+    async def t(client, key, limited):
+        batch = [
+            dict(EVENT, entityId="b1"),
+            dict(EVENT, entityId="b2"),
+            {"event": "rate"},  # invalid: missing entity fields → 400 item
+        ]
+        resp = await client.post(f"/batch/events.json?accessKey={key}",
+                                 json=batch)
+        assert resp.status == 200
+        statuses = [r["status"] for r in await resp.json()]
+        assert statuses == [201, 201, 400]
+        resp = await client.get(f"/stats.json?accessKey={key}")
+        assert resp.status == 200
+        body = await resp.json()
+        cur = body["currentHour"]
+        # every batch item counted per its own status, like handle_create
+        assert cur["status"] == {"201": 2, "400": 1}
+        assert cur["event"]["rate"] == 3
+        assert cur["entityType"] == {"user": 2, "<invalid>": 1}
+
+    run_client(env, t, stats=True)
+
+
 def test_webhooks_example_json(env):
     async def t(client, key, limited):
         resp = await client.get(f"/webhooks/exampleJson.json?accessKey={key}")

@@ -1,0 +1,765 @@
+"""Resilience layer: retry/deadline/breaker units, scripted fault schedules
+against the sqlite and remote backends, query-server degradation, and the
+event server's spill queue (ISSUE 1 acceptance scenarios).
+
+Everything time-dependent runs on FakeClock — no wall-clock sleeps; fault
+scripts are fixed lists (or fixed seeds), so every run sees the identical
+failure timeline.
+"""
+
+import asyncio
+import datetime as dt
+import threading
+import time
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from incubator_predictionio_tpu.core.controller import EngineParams
+from incubator_predictionio_tpu.data import DataMap, Event
+from incubator_predictionio_tpu.data.storage import (
+    AccessKey,
+    App,
+    Storage,
+    StorageError,
+)
+from incubator_predictionio_tpu.data.storage.base import EngineInstance
+from incubator_predictionio_tpu.data.storage.remote import RemoteStorageClient
+from incubator_predictionio_tpu.resilience import (
+    CircuitBreaker,
+    CircuitOpenError,
+    DeadlineExceeded,
+    FakeClock,
+    FaultInjector,
+    FaultProxy,
+    FaultSchedule,
+    Ok,
+    PartialWrite,
+    ResiliencePolicy,
+    RetryPolicy,
+    Timeout,
+    TransientError,
+    deadline_scope,
+    policy_from_config,
+)
+from incubator_predictionio_tpu.server.storage_server import (
+    StorageServerConfig,
+    ThreadedStorageServer,
+)
+
+UTC = dt.timezone.utc
+
+
+def mk_event(i=0):
+    return Event(event="rate", entity_type="user", entity_id=f"u{i}",
+                 properties=DataMap({"rating": 1.0 * i}),
+                 event_time=dt.datetime(2023, 1, 1, 0, 0, i, tzinfo=UTC))
+
+
+# ---------------------------------------------------------------------------
+# policy / breaker units
+# ---------------------------------------------------------------------------
+
+def test_backoff_is_deterministic_with_seed():
+    r1, r2 = RetryPolicy(seed=99), RetryPolicy(seed=99)
+    import random
+    g1, g2 = random.Random(99), random.Random(99)
+    seq1 = [r1.delay(a, g1) for a in range(1, 6)]
+    seq2 = [r2.delay(a, g2) for a in range(1, 6)]
+    assert seq1 == seq2
+    # exponential shape survives the jitter (jitter=0.2 < multiplier=2)
+    assert seq1[0] < seq1[1] < seq1[2]
+    assert max(seq1) <= r1.max_delay * (1 + r1.jitter)
+
+
+def test_policy_retries_then_succeeds_idempotent():
+    clk = FakeClock()
+    p = ResiliencePolicy(RetryPolicy(max_attempts=3, seed=1), clock=clk)
+    attempts = []
+
+    def fn(deadline):
+        attempts.append(1)
+        if len(attempts) < 3:
+            raise TransientError("flaky")
+        return "ok"
+
+    assert p.call(fn) == "ok"
+    assert len(attempts) == 3
+    assert len(clk.slept) == 2  # two backoffs, zero wall sleeps
+
+
+def test_policy_never_retries_non_idempotent():
+    clk = FakeClock()
+    p = ResiliencePolicy(RetryPolicy(max_attempts=5, seed=1), clock=clk)
+    attempts = []
+
+    def fn(deadline):
+        attempts.append(1)
+        raise TransientError("write lost")
+
+    with pytest.raises(TransientError):
+        p.call(fn, idempotent=False)
+    assert len(attempts) == 1
+    assert clk.slept == []
+
+
+def test_policy_total_deadline_bounds_retries():
+    clk = FakeClock()
+    p = ResiliencePolicy(
+        RetryPolicy(max_attempts=50, base_delay=1.0, multiplier=1.0,
+                    jitter=0.0, total_deadline=2.5),
+        clock=clk)
+    attempts = []
+
+    def fn(deadline):
+        attempts.append(1)
+        raise TransientError("down")
+
+    with pytest.raises(DeadlineExceeded):
+        p.call(fn)
+    # budget 2.5s, 1s backoff each: attempts at t=0,1,2 then the next pause
+    # would cross the deadline
+    assert len(attempts) == 3
+
+
+def test_ambient_deadline_scope_caps_attempt_timeout():
+    clk = FakeClock()
+    p = ResiliencePolicy(RetryPolicy(max_attempts=1), clock=clk)
+    seen = {}
+
+    def fn(deadline):
+        seen["timeout"] = deadline.attempt_timeout(30.0)
+        return True
+
+    with deadline_scope(5.0, clock=clk):
+        assert p.call(fn)
+    assert seen["timeout"] == pytest.approx(5.0)
+    # nested scopes tighten, never widen
+    with deadline_scope(10.0, clock=clk):
+        with deadline_scope(0.5, clock=clk):
+            p.call(fn)
+    assert seen["timeout"] == pytest.approx(0.5)
+
+
+def test_breaker_state_machine():
+    clk = FakeClock()
+    b = CircuitBreaker("b", failure_threshold=3, reset_timeout=10.0,
+                       clock=clk)
+    assert b.state == "closed" and b.allow()
+    for _ in range(3):
+        b.record_failure()
+    assert b.state == "open"
+    assert not b.allow()  # rejected instantly
+    assert 0 < b.retry_after() <= 10.0
+    clk.advance(10.0)
+    assert b.state == "half_open"
+    assert b.allow()       # ONE probe admitted
+    assert not b.allow()   # concurrent second probe rejected
+    b.record_failure()     # probe failed: re-open, window restarts
+    assert b.state == "open" and not b.allow()
+    clk.advance(10.0)
+    assert b.allow()
+    b.record_success()     # probe succeeded: closed, counters reset
+    assert b.state == "closed"
+    snap = b.snapshot()
+    assert snap["state"] == "closed" and snap["timesOpened"] == 2
+
+
+def test_breaker_gates_policy_and_reports_open():
+    clk = FakeClock()
+    b = CircuitBreaker("gate", failure_threshold=2, reset_timeout=5.0,
+                       clock=clk)
+    p = ResiliencePolicy(RetryPolicy(max_attempts=1), breaker=b, clock=clk)
+
+    def boom(deadline):
+        raise TransientError("down")
+
+    for _ in range(2):
+        with pytest.raises(TransientError):
+            p.call(boom)
+    calls = []
+    with pytest.raises(CircuitOpenError) as ei:
+        p.call(lambda d: calls.append(1))
+    assert ei.value.retry_after > 0
+    assert calls == []  # rejected without touching the callable
+
+
+def test_half_open_probe_with_semantic_error_closes_breaker():
+    """A probe whose call completes with a NON-transient error (404,
+    validation...) proves the backend is reachable — it must close the
+    breaker, not leak the probe slot and wedge it half-open."""
+    clk = FakeClock()
+    b = CircuitBreaker("sem", failure_threshold=1, reset_timeout=5.0,
+                       clock=clk)
+    p = ResiliencePolicy(RetryPolicy(max_attempts=1), breaker=b, clock=clk)
+    with pytest.raises(TransientError):
+        p.call(lambda d: (_ for _ in ()).throw(TransientError("down")))
+    assert b.state == "open"
+    clk.advance(5.0)
+
+    def semantic(deadline):
+        raise KeyError("no such thing")  # backend answered: not an outage
+
+    with pytest.raises(KeyError):
+        p.call(semantic)
+    assert b.state == "closed"
+    assert b.allow()
+
+
+def test_expired_deadline_releases_half_open_probe():
+    clk = FakeClock()
+    b = CircuitBreaker("lease", failure_threshold=1, reset_timeout=5.0,
+                       clock=clk)
+    p = ResiliencePolicy(
+        RetryPolicy(max_attempts=1, total_deadline=0.0), breaker=b,
+        clock=clk)
+    b.record_failure()
+    clk.advance(5.0)
+    # budget already spent before the first attempt: the probe slot must be
+    # handed back so the NEXT caller can still probe
+    with pytest.raises(DeadlineExceeded):
+        p.call(lambda d: "never runs")
+    assert b.state == "half_open"
+    assert b.allow()  # slot available again
+
+
+def test_policy_from_config_overrides():
+    import incubator_predictionio_tpu.resilience.breaker as breaker_mod
+    p = policy_from_config("cfg-test", {
+        "RETRY_MAX_ATTEMPTS": "7", "RETRY_BASE_DELAY": "0.5",
+        "BREAKER_THRESHOLD": "2", "BREAKER_RESET": "1.5",
+        "RETRY_SEED": "3",
+    })
+    assert p.retry.max_attempts == 7
+    assert p.retry.base_delay == 0.5
+    assert p.breaker is p.breaker and p.breaker.failure_threshold == 2
+    assert breaker_mod.BREAKERS.snapshot()["cfg-test"]["state"] == "closed"
+    disabled = policy_from_config("cfg-off", {"BREAKER_THRESHOLD": "0"})
+    assert disabled.breaker is None
+
+
+# ---------------------------------------------------------------------------
+# fault harness vs the sqlite backend
+# ---------------------------------------------------------------------------
+
+def test_faultproxy_sqlite_timeout_retry_and_partial_write(tmp_path):
+    storage = Storage({
+        "PIO_STORAGE_SOURCES_SQL_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_SQL_PATH": str(tmp_path / "ev.db"),
+    })
+    try:
+        store = storage.get_events()
+        store.init(1)
+        clk = FakeClock()
+        schedule = FaultSchedule.scripted(
+            Timeout(), Ok(),         # read: one timeout, then recovery
+            PartialWrite(),          # write: lands, response lost
+        )
+        proxy = FaultProxy(store, schedule, clock=clk)
+        policy = ResiliencePolicy(RetryPolicy(max_attempts=3, seed=5),
+                                  clock=clk)
+        eid = store.insert(mk_event(0), 1)
+
+        # idempotent read: the injected timeout is retried and succeeds
+        def read(deadline):
+            try:
+                return proxy.get(eid, 1)
+            except (TimeoutError, ConnectionError) as e:
+                raise TransientError(str(e)) from e
+
+        got = policy.call(read, idempotent=True, op="get")
+        assert got.entity_id == "u0"
+        assert proxy.calls.count("get") == 2  # 1 fault + 1 success
+
+        # non-idempotent write with a lost response: policy does NOT retry,
+        # so the row exists exactly once (a blind retry would duplicate
+        # server-generated ids)
+        def write(deadline):
+            try:
+                return proxy.insert(mk_event(1), 1)
+            except (TimeoutError, ConnectionError) as e:
+                raise TransientError(str(e)) from e
+
+        with pytest.raises(TransientError):
+            policy.call(write, idempotent=False, op="insert")
+        assert proxy.calls.count("insert") == 1
+        rows = [e for e in store.find(1) if e.entity_id == "u1"]
+        assert len(rows) == 1  # applied once despite the "lost" response
+        # exactly one backoff total (the read retry), all on the fake clock
+        assert len(clk.slept) == 1
+    finally:
+        storage.close()
+
+
+# ---------------------------------------------------------------------------
+# fault harness vs the remote backend (the ISSUE 1 acceptance scenario)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def remote_env():
+    backing = Storage({"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+    server = ThreadedStorageServer(
+        backing, StorageServerConfig(ip="127.0.0.1", port=0))
+    client = RemoteStorageClient({"URL": server.url})
+    try:
+        yield server, client
+    finally:
+        server.close()
+        backing.close()
+
+
+def _scripted_transport(client, steps, threshold=3, max_attempts=3,
+                        methods=None):
+    """Swap the transport's policy for a FakeClock one and attach a
+    scripted injector; returns (injector, breaker, clock)."""
+    clk = FakeClock()
+    brk = CircuitBreaker("remote-under-test", failure_threshold=threshold,
+                         reset_timeout=30.0, clock=clk)
+    inj = FaultInjector(FaultSchedule(steps, methods=methods), clock=clk)
+    tp = client._tp
+    tp.policy = ResiliencePolicy(
+        RetryPolicy(max_attempts=max_attempts, seed=42), breaker=brk,
+        clock=clk)
+    tp.fault_hook = inj
+    return inj, brk, clk
+
+
+def test_remote_scripted_faults_full_lifecycle(remote_env):
+    """N timeouts then recovery: idempotent reads retry, non-idempotent
+    writes never auto-retry, the breaker opens at the threshold and recovers
+    via a half-open probe — fixed script, fixed seed, injected clock."""
+    server, client = remote_env
+    ev = client.events()
+    ev.init(1)
+    eid = ev.insert(mk_event(0), 1)  # healthy write before the fault window
+
+    # -- idempotent read: two timeouts, then recovery → retried to success
+    inj, brk, clk = _scripted_transport(
+        client, [Timeout(), Timeout()], threshold=3,
+        methods=("/rpc/events/get",))
+    got = ev.get(eid, 1)
+    assert got is not None and got.entity_id == "u0"
+    assert len(inj.calls) == 3          # 2 faulted attempts + 1 success
+    assert len(clk.slept) == 2          # backoff on the fake clock only
+    assert brk.state == "closed"        # success reset the failure count
+
+    # -- non-idempotent write: ONE timeout → fails without any retry
+    inj, brk, clk = _scripted_transport(
+        client, [Timeout()], threshold=3,
+        methods=("/rpc/events/insert",))
+    before = len(list(ev.find(1)))
+    with pytest.raises(StorageError):
+        ev.insert(mk_event(1), 1)
+    insert_attempts = [c for c in inj.calls if c == "/rpc/events/insert"]
+    assert len(insert_attempts) == 1    # exactly one attempt, no auto-retry
+    assert clk.slept == []
+    assert len(list(ev.find(1))) == before  # nothing landed, nothing doubled
+
+    # -- breaker: enough consecutive write timeouts trip it open
+    inj, brk, clk = _scripted_transport(
+        client, [Timeout()] * 3, threshold=3,
+        methods=("/rpc/events/insert",))
+    for i in range(3):
+        with pytest.raises(StorageError):
+            ev.insert(mk_event(10 + i), 1)
+    assert brk.state == "open"
+    wire_calls = len(inj.calls)
+    with pytest.raises(CircuitOpenError):
+        ev.get(eid, 1)
+    assert len(inj.calls) == wire_calls  # rejected before touching the wire
+
+    # -- half-open recovery: reset window elapses on the INJECTED clock,
+    # the single probe succeeds (schedule exhausted → Ok), breaker closes
+    clk.advance(30.0)
+    assert brk.state == "half_open"
+    got = ev.get(eid, 1)
+    assert got is not None
+    assert brk.state == "closed"
+    # the whole lifecycle ran without one real sleep: every pause is on the
+    # fake clock's ledger
+    assert all(s >= 0 for s in clk.slept)
+
+
+def test_remote_deadline_scope_caps_call(remote_env):
+    """An expired ambient deadline fails fast with DeadlineExceeded instead
+    of burning retries (serving-layer budget propagation)."""
+    server, client = remote_env
+    ev = client.events()
+    ev.init(1)
+    clk = FakeClock()
+    tp = client._tp
+    tp.policy = ResiliencePolicy(RetryPolicy(max_attempts=3, seed=2),
+                                 clock=clk)
+    with deadline_scope(5.0, clock=clk):
+        clk.advance(6.0)  # budget exhausted before the first attempt
+        with pytest.raises(DeadlineExceeded):
+            ev.get("nope", 1)
+
+
+# ---------------------------------------------------------------------------
+# query server degradation
+# ---------------------------------------------------------------------------
+
+class _StubServing:
+    def supplement(self, q):
+        return q
+
+    def serve(self, q, predictions):
+        return predictions[0]
+
+
+class _FlakyAlgo:
+    """Controllable algorithm: ok → answers, slow → blows the deadline,
+    fail → raises."""
+
+    def __init__(self):
+        self.mode = "ok"
+        self.sleep_sec = 0.4
+
+    def query_class(self):
+        return None
+
+    def predict(self, model, query):
+        if self.mode == "fail":
+            raise RuntimeError("model backend down")
+        if self.mode == "slow":
+            time.sleep(self.sleep_sec)
+        return {"label": 1, "source": "live"}
+
+    def batch_predict(self, model, pairs):
+        return [(i, self.predict(model, q)) for i, q in pairs]
+
+
+class _StubEngine:
+    def __init__(self, algo):
+        self._algo = algo
+
+    def serving_and_algorithms(self, engine_params):
+        return [self._algo], _StubServing()
+
+
+def _mk_instance():
+    return EngineInstance(
+        id="inst-1", status="COMPLETED",
+        start_time=dt.datetime(2024, 1, 1, tzinfo=UTC), end_time=None,
+        engine_id="stub", engine_version="1", engine_variant="v",
+        engine_factory="stub.Engine")
+
+
+def _mk_query_server(algo, **cfg_kw):
+    from incubator_predictionio_tpu.server.query_server import (
+        DeployedEngine,
+        QueryServer,
+        ServerConfig,
+    )
+
+    storage = Storage({"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+    config = ServerConfig(**cfg_kw)
+    deployed = DeployedEngine(
+        _StubEngine(algo), EngineParams(), _mk_instance(), [None],
+        warmup=False, algo_deadline=config.algo_deadline_sec,
+        breaker_threshold=config.algo_breaker_threshold,
+        breaker_reset=config.algo_breaker_reset_sec)
+    return QueryServer(config, storage=storage, deployed=deployed), storage
+
+
+def test_query_server_degrades_on_deadline_and_recovers():
+    algo = _FlakyAlgo()
+    server, storage = _mk_query_server(
+        algo, query_timeout_sec=0.1, algo_deadline_sec=0.05,
+        algo_breaker_threshold=1, algo_breaker_reset_sec=1.0)
+
+    async def t():
+        client = TestClient(TestServer(server.make_app()))
+        await client.start_server()
+        try:
+            # 1) healthy query → 200, cached as last-good
+            resp = await client.post("/queries.json", json={"features": [1]})
+            assert resp.status == 200
+            body = await resp.json()
+            assert body["label"] == 1 and "degraded" not in body
+            health = await (await client.get("/health")).json()
+            assert health["status"] == "ok"
+
+            # 2) the algorithm hangs past the per-query budget → degraded
+            # 200 from the last-good cache, NOT a 500
+            algo.mode = "slow"
+            resp = await client.post("/queries.json", json={"features": [1]})
+            assert resp.status == 200
+            body = await resp.json()
+            assert body["degraded"] is True
+            assert body["label"] == 1  # the cached good answer
+
+            # 3) the slow dispatch finishes in the background and records
+            # the blown per-algorithm deadline; with threshold 1 both the
+            # serving and the algorithm breaker are now open
+            await asyncio.sleep(algo.sleep_sec + 0.2)
+            health = await (await client.get("/health")).json()
+            assert health["status"] == "degraded"
+            algo_states = {k: v["state"]
+                           for k, v in health["algorithmBreakers"].items()}
+            assert algo_states == {"algorithm:0:_FlakyAlgo": "open"}
+            assert health["servingBreaker"]["state"] == "open"
+
+            # 4) breaker open → instant degraded answers (no 0.1s wait)
+            algo.mode = "ok"
+            t0 = time.perf_counter()
+            resp = await client.post("/queries.json", json={"features": [1]})
+            assert resp.status == 200
+            assert (await resp.json())["degraded"] is True
+            assert time.perf_counter() - t0 < 0.09
+
+            # 5) reset window elapses → half-open probe goes through the
+            # now-healthy algorithm → full recovery
+            await asyncio.sleep(1.05)
+            resp = await client.post("/queries.json", json={"features": [1]})
+            assert resp.status == 200
+            body = await resp.json()
+            assert "degraded" not in body
+            health = await (await client.get("/health")).json()
+            assert health["servingBreaker"]["state"] == "closed"
+            assert health["degradedResponses"] >= 2
+        finally:
+            await client.close()
+            await server.batcher.stop()
+
+    try:
+        asyncio.run(t())
+    finally:
+        storage.close()
+
+
+def test_query_server_unknown_query_degrades_to_default_body():
+    """No cache entry and no serving default: the degraded response is
+    still a valid JSON 200, never a 500."""
+    algo = _FlakyAlgo()
+    algo.mode = "slow"
+    server, storage = _mk_query_server(
+        algo, query_timeout_sec=0.05, algo_breaker_threshold=10)
+
+    async def t():
+        client = TestClient(TestServer(server.make_app()))
+        await client.start_server()
+        try:
+            resp = await client.post("/queries.json", json={"features": [9]})
+            assert resp.status == 200
+            body = await resp.json()
+            assert body["degraded"] is True and "message" in body
+        finally:
+            await client.close()
+            await server.batcher.stop()
+
+    try:
+        asyncio.run(t())
+    finally:
+        storage.close()
+
+
+# ---------------------------------------------------------------------------
+# event server spill queue
+# ---------------------------------------------------------------------------
+
+class _FlakyStorage:
+    """Storage facade whose event store is wrapped in a FaultProxy."""
+
+    def __init__(self, storage, proxy):
+        self._storage = storage
+        self._proxy = proxy
+
+    def __getattr__(self, name):
+        return getattr(self._storage, name)
+
+    def get_events(self):
+        return self._proxy
+
+
+def test_event_server_spill_queue_503_and_drain():
+    from incubator_predictionio_tpu.server.event_server import (
+        EventServer,
+        EventServerConfig,
+    )
+    from incubator_predictionio_tpu.resilience.faults import Reset
+
+    storage = Storage({"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+    app_id = storage.get_meta_data_apps().insert(App(0, "spill-app"))
+    key = storage.get_meta_data_access_keys().insert(AccessKey("", app_id, ()))
+    storage.get_events().init(app_id)
+
+    # insert_batch fails 2× (trips threshold 2), then recovers
+    schedule = FaultSchedule.scripted(
+        Reset(), Reset(), methods=("insert_batch",))
+    flaky = _FlakyStorage(storage, FaultProxy(storage.get_events(), schedule))
+    clk = FakeClock()
+
+    def ev(i):
+        return {"event": "rate", "entityType": "user", "entityId": f"u{i}",
+                "eventTime": "2023-01-01T00:00:00Z"}
+
+    async def t():
+        config = EventServerConfig(spill_max=3, retry_after_sec=7,
+                                   breaker_threshold=2, breaker_reset_sec=60)
+        server = EventServer(config, storage=flaky)
+        # deterministic breaker timeline: injected clock, and the async
+        # drain loop disabled so the scripted schedule is consumed only by
+        # the requests and the manual drain below
+        server._store_breaker = CircuitBreaker(
+            "eventstore", failure_threshold=2, reset_timeout=60, clock=clk)
+        server._kick_drain = lambda: None
+        client = TestClient(TestServer(server.make_app()))
+        await client.start_server()
+        try:
+            url = f"/events.json?accessKey={key}"
+            spilled_ids = []
+            # 1+2: transient write failures → accepted (201) into the spill
+            # queue; the second failure opens the breaker
+            for i in range(2):
+                resp = await client.post(url, json=ev(i))
+                assert resp.status == 201
+                spilled_ids.append((await resp.json())["eventId"])
+            assert server._store_breaker.state == "open"
+            # 3: breaker open → straight to the queue, no wire touch
+            resp = await client.post(url, json=ev(2))
+            assert resp.status == 201
+            spilled_ids.append((await resp.json())["eventId"])
+            # 4: queue full → 503 + Retry-After, the ONLY rejection mode
+            resp = await client.post(url, json=ev(3))
+            assert resp.status == 503
+            assert resp.headers["Retry-After"] == "7"
+            health = await (await client.get("/health")).json()
+            assert health["status"] == "degraded"
+            assert health["spillQueueDepth"] == 3
+            assert health["eventStoreBreaker"]["state"] == "open"
+
+            # recovery: reset window elapses on the injected clock, the
+            # drain probe (schedule exhausted → Ok) flushes the queue
+            clk.advance(60.0)
+            assert server._drain_spill_once() is True
+            assert server._store_breaker.state == "closed"
+            health = await (await client.get("/health")).json()
+            assert health["status"] == "ok"
+            assert health["spillQueueDepth"] == 0
+            # every spilled event landed exactly once, under its 201 id
+            stored = {e.event_id for e in storage.get_events().find(app_id)}
+            assert set(spilled_ids) <= stored
+            assert len(list(storage.get_events().find(app_id))) == 3
+            # and the store accepts new writes directly again
+            resp = await client.post(url, json=ev(9))
+            assert resp.status == 201
+            assert len(list(storage.get_events().find(app_id))) == 4
+        finally:
+            await client.close()
+            await server.shutdown()
+
+    try:
+        asyncio.run(t())
+    finally:
+        storage.close()
+
+
+def test_event_server_semantic_rejection_never_spills_and_drain_unwedges():
+    """Non-transient store errors must NOT be 201-acked into the spill
+    queue (they would be re-rejected identically forever); and if a queued
+    batch turns out to be store-rejected at drain time, it is dropped —
+    loudly — instead of wedging every event behind it."""
+    from incubator_predictionio_tpu.server.event_server import (
+        EventServer,
+        EventServerConfig,
+    )
+
+    storage = Storage({"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+    app_id = storage.get_meta_data_apps().insert(App(0, "sem-app"))
+    key = storage.get_meta_data_access_keys().insert(AccessKey("", app_id, ()))
+    storage.get_events().init(app_id)
+
+    class _ModalStore:
+        """mode: ok | transient | semantic."""
+
+        def __init__(self, target):
+            self._t = target
+            self.mode = "ok"
+
+        def __getattr__(self, name):
+            return getattr(self._t, name)
+
+        def insert_batch(self, events, app_id, channel_id=None):
+            if self.mode == "transient":
+                raise ConnectionResetError("backend blip")
+            if self.mode == "semantic":
+                raise StorageError("constraint violation: duplicate key")
+            return self._t.insert_batch(events, app_id, channel_id)
+
+    modal = _ModalStore(storage.get_events())
+    flaky = _FlakyStorage(storage, modal)
+    clk = FakeClock()
+
+    def ev(i):
+        return {"event": "rate", "entityType": "user", "entityId": f"s{i}",
+                "eventTime": "2023-01-01T00:00:00Z"}
+
+    async def t():
+        server = EventServer(EventServerConfig(spill_max=10), storage=flaky)
+        server._store_breaker = CircuitBreaker(
+            "eventstore", failure_threshold=2, reset_timeout=60, clock=clk)
+        server._kick_drain = lambda: None
+        client = TestClient(TestServer(server.make_app()))
+        await client.start_server()
+        try:
+            url = f"/events.json?accessKey={key}"
+            # semantic rejection at ingest: surfaces (500), NOT spill-acked
+            modal.mode = "semantic"
+            resp = await client.post(url, json=ev(0))
+            assert resp.status == 500
+            assert len(server._spill) == 0
+
+            # transient failure: spilled + 201 as designed
+            modal.mode = "transient"
+            resp = await client.post(url, json=ev(1))
+            assert resp.status == 201
+            assert len(server._spill) == 1
+
+            # at drain time the store rejects the queued batch semantically:
+            # the batch is dropped and the queue unwedges
+            modal.mode = "semantic"
+            with pytest.raises(StorageError):
+                server._drain_spill_once()
+            assert len(server._spill) == 0
+            # and the store is usable again immediately
+            modal.mode = "ok"
+            resp = await client.post(url, json=ev(2))
+            assert resp.status == 201
+            assert len(list(storage.get_events().find(app_id))) == 1
+        finally:
+            await client.close()
+            await server.shutdown()
+
+    try:
+        asyncio.run(t())
+    finally:
+        storage.close()
+
+
+# ---------------------------------------------------------------------------
+# satellite: exact integer microseconds (sqlite/postgres ↔ C sink parity)
+# ---------------------------------------------------------------------------
+
+def test_us_is_exact_integer_microseconds():
+    from incubator_predictionio_tpu.data.storage.sqlite_backend import (
+        _from_us,
+        _us,
+    )
+    from incubator_predictionio_tpu.data.storage import postgres as pg
+
+    # a microsecond value where float µs-since-epoch loses exactness:
+    # timestamp()*1e6 detours through a double whose ulp at 1.7e15 µs > 0.5
+    t = dt.datetime(2023, 11, 14, 22, 13, 20, 123457, tzinfo=UTC)
+    exact = ((t - dt.datetime(1970, 1, 1, tzinfo=UTC))
+             // dt.timedelta(microseconds=1))
+    assert _us(t) == exact
+    assert pg._us(t) == exact
+    assert _from_us(_us(t)) == t
+    # sweep the microsecond field: integer arithmetic never truncates
+    base = dt.datetime(2024, 7, 1, 12, 0, 0, tzinfo=UTC)
+    for us in (1, 3, 7, 123456, 999999):
+        t = base.replace(microsecond=us)
+        assert _us(t) % 1_000_000 == us
+        assert pg._us(t) % 1_000_000 == us

@@ -324,16 +324,22 @@ def test_es_strict_unknown_field_rejection():
         server.close()
 
 
-def test_es_429_and_503_surface():
+def test_es_429_and_503_retry_then_surface():
+    """429/503 are transient (resilience/): idempotent calls retry through
+    them — a single throttle blip heals invisibly, a persistent outage
+    still surfaces as StorageError (with the final status) after the
+    retry budget."""
     calls = {"n": 0}
 
     async def throttle(request):
         calls["n"] += 1
-        if calls["n"] == 1:
+        if calls["n"] == 1:  # one 429 blip, then healthy
             return web.json_response(
                 {"error": {"type": "circuit_breaking_exception"}},
                 status=429, headers={"Retry-After": "1"})
-        return web.json_response(
+        if calls["n"] == 2:
+            return web.json_response({"found": True, "_source": {}})
+        return web.json_response(  # then a hard 503 outage
             {"error": {"type": "unavailable"}}, status=503)
 
     app = web.Application()
@@ -342,11 +348,16 @@ def test_es_429_and_503_surface():
     try:
         from incubator_predictionio_tpu.data.storage.elasticsearch import _Transport
 
-        es = _Transport(f"http://127.0.0.1:{server.port}", timeout=5.0)
-        with pytest.raises(StorageError, match="429"):
-            es.call("GET", "/idx/_doc/1")
+        es = _Transport(f"http://127.0.0.1:{server.port}", timeout=5.0,
+                        config={"RETRY_BASE_DELAY": "0.01",
+                                "BREAKER_THRESHOLD": "0"})
+        # blip: 429 → retried → 200 (the caller never sees the throttle)
+        status, _ = es.call("GET", "/idx/_doc/1")
+        assert status == 200 and calls["n"] == 2
+        # outage: every attempt 503s → surfaces after the retry budget
         with pytest.raises(StorageError, match="503"):
             es.call("GET", "/idx/_doc/1")
+        assert calls["n"] == 5  # 3 attempts (max) for the failing call
     finally:
         server.close()
 
