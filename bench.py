@@ -535,6 +535,22 @@ print(json.dumps({
 }))
 """
 
+def _metrics_snapshot(text: str) -> dict:
+    """Trim a /metrics page into a JSON-friendly snapshot: counter/gauge
+    samples plus histogram _count/_sum (bucket rows add noise, not signal,
+    to a bench artifact)."""
+    from incubator_predictionio_tpu.obs.metrics import parse_prometheus_text
+
+    out: dict[str, float] = {}
+    for name, fam in parse_prometheus_text(text).items():
+        for sname, labels, value in fam["samples"]:
+            if sname.endswith("_bucket"):
+                continue
+            label = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+            out[f"{sname}{{{label}}}" if label else sname] = value
+    return out
+
+
 def bench_serving(ctx) -> dict:
     """Train the recommendation template through the real workflow, deploy it
     in the real query server, and measure client-observed latency under
@@ -638,11 +654,14 @@ def bench_serving(ctx) -> dict:
                 async with aiohttp.ClientSession() as s:
                     status = await (await s.get(
                         f"http://127.0.0.1:{port}/")).json()
-                return client_stats, status
+                    metrics_text = await (await s.get(
+                        f"http://127.0.0.1:{port}/metrics")).text()
+                return client_stats, status, metrics_text
             finally:
                 await server.shutdown()
 
-        client_stats, status = asyncio.run(drive())
+        client_stats, status, metrics_text = asyncio.run(drive())
+        metrics_snapshot = _metrics_snapshot(metrics_text)
         out = {
             "predict_p50_ms": client_stats["p50_ms"],
             "predict_p95_ms": client_stats["p95_ms"],
@@ -652,6 +671,10 @@ def bench_serving(ctx) -> dict:
             "jit_compile_keys": status.get("jitCompileKeys"),
             "server_p50_ms": round(
                 status["servingSecPercentiles"]["p50"] * 1e3, 2),
+            # the /metrics fold (ISSUE 2): the same counters/gauges a
+            # Prometheus scrape would see during the run, archived with the
+            # bench so telemetry regressions show up in artifact diffs
+            "metrics": metrics_snapshot,
         }
         # Pallas/oracle parity on the DEPLOYED model's factors. The bench
         # catalog itself serves from the host fast path (small catalog); this

@@ -64,6 +64,7 @@ from incubator_predictionio_tpu.data.storage.base import (
     StorageError,
 )
 from incubator_predictionio_tpu.data.storage.registry import register_backend
+from incubator_predictionio_tpu.obs import trace as _obs_trace
 from incubator_predictionio_tpu.resilience.policy import (
     TRANSIENT_HTTP_CODES,
     Deadline,
@@ -144,6 +145,10 @@ class _Transport:
         h = {"Content-Type": "application/json"}
         if self.key:
             h["X-PIO-Storage-Key"] = self.key
+        # called once per attempt, inside the policy's per-attempt span: the
+        # storage server adopts this trace, so a query-server → storage call
+        # (including each retry) is ONE trace across both span logs
+        _obs_trace.inject(h)
         return h
 
     def _attempt_request(self, path: str, payload: bytes,

@@ -1,0 +1,39 @@
+"""Unified telemetry layer: metrics registry, Prometheus exposition, and
+end-to-end request tracing (docs/observability.md).
+
+- :mod:`.metrics` — process-wide counters/gauges/histograms + ``/metrics``
+  text exposition (:data:`~.metrics.REGISTRY`);
+- :mod:`.trace` — contextvar trace/span IDs, the recent-trace ring buffer,
+  ``X-PIO-Trace`` propagation;
+- :mod:`.http` — the aiohttp telemetry middleware and shared
+  ``/metrics`` + ``/traces.json`` routes (imported by servers; kept out of
+  this namespace so non-server processes never pay the aiohttp import).
+"""
+
+from incubator_predictionio_tpu.obs.metrics import (  # noqa: F401
+    DEFAULT_LATENCY_BUCKETS,
+    MetricError,
+    MetricsRegistry,
+    REGISTRY,
+    bucket_quantiles,
+    nearest_rank_percentiles,
+    parse_prometheus_text,
+    timed,
+)
+from incubator_predictionio_tpu.obs.trace import (  # noqa: F401
+    TRACE_HEADER,
+    TRACES,
+    SpanContext,
+    TraceBuffer,
+    current_trace_id,
+    span,
+    trace_scope,
+)
+
+__all__ = [
+    "DEFAULT_LATENCY_BUCKETS", "MetricError", "MetricsRegistry", "REGISTRY",
+    "bucket_quantiles", "nearest_rank_percentiles", "parse_prometheus_text",
+    "timed",
+    "TRACE_HEADER", "TRACES", "SpanContext", "TraceBuffer",
+    "current_trace_id", "span", "trace_scope",
+]

@@ -1,0 +1,140 @@
+"""aiohttp telemetry: ONE middleware instruments every route of every server.
+
+Per request the middleware:
+
+- adopts the caller's trace from ``X-PIO-Trace`` (else roots a fresh one)
+  and opens a server span for the route;
+- records the per-route latency histogram and status counter;
+- echoes ``X-PIO-Trace: <trace_id>`` on the response (success AND error
+  paths) so callers can correlate;
+- emits a trace-ID'd structured JSON access log line on the ``pio.access``
+  logger (guarded by ``isEnabledFor`` — silenced loggers cost one check, not
+  one formatted line, preserving the ingest hot path's no-access-log
+  discipline).
+
+``add_observability_routes`` mounts the shared ``GET /metrics`` (Prometheus
+text) and ``GET /traces.json`` (recent span trees) endpoints.
+
+The tier-1 meta-test walks every server's app and asserts this middleware is
+present (``__pio_telemetry__`` marker) — new endpoints cannot silently ship
+uninstrumented because instrumentation is app-wide, not per-route.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+
+from aiohttp import web
+
+from incubator_predictionio_tpu.obs import trace
+from incubator_predictionio_tpu.obs.metrics import REGISTRY
+
+logger = logging.getLogger(__name__)
+access_log = logging.getLogger("pio.access")
+
+HTTP_REQUESTS = REGISTRY.counter(
+    "pio_http_requests_total",
+    "HTTP requests by server, route pattern, method, and status",
+    labels=("service", "route", "method", "status"))
+HTTP_LATENCY = REGISTRY.histogram(
+    "pio_http_request_seconds",
+    "HTTP request latency (seconds) by server and route pattern",
+    labels=("service", "route"))
+
+
+def _route_pattern(request: web.Request) -> str:
+    """The route's canonical pattern (``/events/{event_id}.json``), NOT the
+    raw path — label cardinality must stay bounded."""
+    try:
+        resource = request.match_info.route.resource
+        if resource is not None:
+            return resource.canonical
+    except Exception:  # noqa: BLE001 - label resolution must never 500
+        pass
+    return "__unmatched__"
+
+
+def telemetry_middleware(service: str):
+    """Build the middleware for one server (the label value on every
+    metric/span it emits)."""
+
+    @web.middleware
+    async def middleware(request: web.Request, handler):
+        route = _route_pattern(request)
+        parent = trace.parse_header(request.headers.get(trace.TRACE_HEADER))
+        t0 = time.perf_counter()
+        status = 500
+        with trace.trace_scope(parent):
+            with trace.span(f"{request.method} {route}", service=service,
+                            method=request.method, route=route) as sp:
+                try:
+                    resp = await handler(request)
+                    status = resp.status
+                except web.HTTPException as ex:
+                    # auth/validation raise these; they ARE responses —
+                    # stamp the trace header on them before they propagate
+                    status = ex.status
+                    ex.headers[trace.TRACE_HEADER] = sp.trace_id
+                    raise
+                except Exception:  # noqa: BLE001 - CancelledError passes through
+                    # an unhandled handler error would become aiohttp's bare
+                    # 500 with no trace header; build the 500 here so even
+                    # THE failed request is correlatable (the whole point)
+                    logger.exception("unhandled error in %s %s",
+                                     request.method, request.path)
+                    resp = web.json_response(
+                        {"message": "Internal Server Error",
+                         "traceId": sp.trace_id}, status=500)
+                    status = 500
+                finally:
+                    sp.set_attr("status", status)
+                    dt = time.perf_counter() - t0
+                    HTTP_REQUESTS.labels(service=service, route=route,
+                                         method=request.method,
+                                         status=str(status)).inc()
+                    HTTP_LATENCY.labels(service=service,
+                                        route=route).observe(dt)
+                    if access_log.isEnabledFor(logging.INFO):
+                        access_log.info(json.dumps({
+                            "service": service,
+                            "method": request.method,
+                            "path": request.path,
+                            "route": route,
+                            "status": status,
+                            "durationSec": round(dt, 6),
+                            "traceId": sp.trace_id,
+                            "remote": request.remote,
+                        }, separators=(",", ":")))
+        resp.headers[trace.TRACE_HEADER] = sp.trace_id
+        return resp
+
+    middleware.__pio_telemetry__ = service
+    return middleware
+
+
+async def handle_metrics(request: web.Request) -> web.Response:
+    return web.Response(
+        text=REGISTRY.expose(),
+        content_type="text/plain", charset="utf-8",
+        headers={"X-Prometheus-Format": "0.0.4"})
+
+
+async def handle_traces(request: web.Request) -> web.Response:
+    try:
+        limit = int(request.query.get("limit", 50))
+    except ValueError:
+        limit = -1
+    if limit < 0:
+        return web.json_response({"message": "invalid limit"}, status=400)
+    trace_id = request.query.get("traceId")
+    if trace_id:
+        return web.json_response(
+            {"traceId": trace_id, "spans": trace.TRACES.spans(trace_id)})
+    return web.json_response({"traces": trace.TRACES.traces(limit)})
+
+
+def add_observability_routes(app: web.Application) -> None:
+    app.router.add_get("/metrics", handle_metrics)
+    app.router.add_get("/traces.json", handle_traces)

@@ -1,0 +1,500 @@
+"""Process-wide metrics registry with Prometheus text-format exposition.
+
+The reference's observability was two serving counters on a status page
+(CreateServer.scala:578-585) plus delegation to the Spark UI; this module is
+the single pane of glass that replaces both: every subsystem (servers,
+resilience layer, jit-compile gauge, device memory) registers counters,
+gauges, and fixed-bucket histograms here, and each server exposes the whole
+registry at ``GET /metrics`` in the Prometheus text format.
+
+Design:
+
+- **Lock-light.** One small lock per metric child, held only around a couple
+  of arithmetic ops — the serving hot path pays two short critical sections
+  per request (counter inc + histogram observe), no global lock.
+- **Exact quantiles.** Prometheus histograms are cumulative fixed buckets,
+  which can only approximate quantiles. Each histogram child additionally
+  keeps a bounded ring of raw samples, so ``percentiles()`` returns exact
+  p50/p95/p99 over the retained window (same nearest-rank definition as the
+  serving layer's ``LatencyReservoir``) — status pages and tests read those;
+  Prometheus scrapes the buckets.
+- **Collectors.** State that lives elsewhere (breaker registries, spill
+  queues, jit cache) is folded in via named collector callbacks run at
+  exposition time, so ``/metrics`` never holds stale copies.
+
+``parse_prometheus_text`` is the matching strict parser — the ``pio-tpu
+metrics`` pretty-printer and the format-validity tests share it so the
+emitter and the consumer cannot drift.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import math
+import re
+import threading
+import time
+from typing import Callable, Iterator, Optional, Sequence
+
+logger = logging.getLogger(__name__)
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+#: Default latency buckets (seconds): sub-ms serving hits through multi-second
+#: deadline blows. Chosen so the north-star predict p50 (~1ms, BASELINE.md)
+#: lands mid-range with resolution on both sides.
+DEFAULT_LATENCY_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+class MetricError(ValueError):
+    """Bad metric/label name, kind mismatch, or malformed exposition text."""
+
+
+def nearest_rank_percentiles(
+        samples: Sequence[float],
+        qs: Sequence[float] = (0.5, 0.95, 0.99)) -> dict[str, float]:
+    """Exact nearest-rank quantiles over raw samples — THE quantile
+    definition for the whole codebase (histogram rings here, the serving
+    layer's ``LatencyReservoir``), so status pages and /metrics can never
+    disagree on what p99 means."""
+    if not samples:
+        return {f"p{int(q * 100)}": 0.0 for q in qs}
+    s = sorted(samples)
+    out = {}
+    for q in qs:
+        idx = min(len(s) - 1, max(0, int(round(q * (len(s) - 1)))))
+        out[f"p{int(q * 100)}"] = s[idx]
+    return out
+
+
+def _escape_label_value(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt_value(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    if v == -math.inf:
+        return "-Inf"
+    if isinstance(v, float) and v.is_integer() and abs(v) < 2 ** 53:
+        return str(int(v))
+    return repr(v)
+
+
+def _fmt_labels(labelnames: Sequence[str], labelvalues: Sequence[str]) -> str:
+    if not labelnames:
+        return ""
+    inner = ",".join(
+        f'{k}="{_escape_label_value(str(v))}"'
+        for k, v in zip(labelnames, labelvalues))
+    return "{" + inner + "}"
+
+
+class _Counter:
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise MetricError("counters only go up")
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class _Gauge:
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value -= amount
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class _Histogram:
+    """Cumulative fixed-bucket histogram + bounded raw-sample ring."""
+
+    __slots__ = ("_lock", "buckets", "_counts", "_sum", "_count",
+                 "_ring", "_ring_cap", "_ring_pos")
+
+    def __init__(self, buckets: Sequence[float], ring_capacity: int = 2048):
+        self.buckets = tuple(buckets)  # upper bounds, ascending, no +Inf
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(self.buckets) + 1)  # last = +Inf
+        self._sum = 0.0
+        self._count = 0
+        self._ring: list[float] = []
+        self._ring_cap = ring_capacity
+        self._ring_pos = 0
+
+    def observe(self, value: float) -> None:
+        # bisect without the import: bucket lists are short (~14)
+        idx = len(self.buckets)
+        for i, ub in enumerate(self.buckets):
+            if value <= ub:
+                idx = i
+                break
+        with self._lock:
+            self._counts[idx] += 1
+            self._sum += value
+            self._count += 1
+            if len(self._ring) < self._ring_cap:
+                self._ring.append(value)
+            else:
+                self._ring[self._ring_pos] = value
+                self._ring_pos = (self._ring_pos + 1) % self._ring_cap
+
+    @contextlib.contextmanager
+    def time(self) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe(time.perf_counter() - t0)
+
+    def percentiles(
+            self, qs: Sequence[float] = (0.5, 0.95, 0.99)) -> dict[str, float]:
+        """Exact nearest-rank quantiles over the retained raw samples (the
+        whole history while under ring capacity)."""
+        with self._lock:
+            buf = list(self._ring)
+        return nearest_rank_percentiles(buf, qs)
+
+    def snapshot(self) -> tuple[list[int], float, int]:
+        with self._lock:
+            return list(self._counts), self._sum, self._count
+
+
+_KINDS = {"counter": _Counter, "gauge": _Gauge, "histogram": _Histogram}
+
+
+class Family:
+    """One named metric family, optionally labeled. ``labels(**kv)`` returns
+    (creating on first use) the child for one label combination; unlabeled
+    families proxy the child API directly (``family.inc()``)."""
+
+    def __init__(self, name: str, kind: str, help: str,
+                 labelnames: Sequence[str] = (),
+                 buckets: Sequence[float] = DEFAULT_LATENCY_BUCKETS):
+        if not _NAME_RE.match(name):
+            raise MetricError(f"invalid metric name {name!r}")
+        for ln in labelnames:
+            if not _LABEL_RE.match(ln):
+                raise MetricError(f"invalid label name {ln!r} for {name}")
+        self.name = name
+        self.kind = kind
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._buckets = tuple(buckets)
+        self._lock = threading.Lock()
+        self._children: dict[tuple[str, ...], object] = {}
+        if not self.labelnames:
+            self._children[()] = self._new_child()
+
+    def _new_child(self):
+        if self.kind == "histogram":
+            return _Histogram(self._buckets)
+        return _KINDS[self.kind]()
+
+    def labels(self, **kv: str):
+        if set(kv) != set(self.labelnames):
+            raise MetricError(
+                f"{self.name}: expected labels {self.labelnames}, got "
+                f"{tuple(kv)}")
+        key = tuple(str(kv[ln]) for ln in self.labelnames)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._children[key] = self._new_child()
+            return child
+
+    # unlabeled convenience: family IS its single child
+    def _default(self):
+        if self.labelnames:
+            raise MetricError(
+                f"{self.name} has labels {self.labelnames}; use .labels()")
+        return self._children[()]
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._default().inc(amount)
+
+    def set(self, value: float) -> None:
+        self._default().set(value)
+
+    def dec(self, amount: float = 1.0) -> None:
+        self._default().dec(amount)
+
+    def observe(self, value: float) -> None:
+        self._default().observe(value)
+
+    def time(self):
+        return self._default().time()
+
+    def percentiles(self, qs: Sequence[float] = (0.5, 0.95, 0.99)):
+        return self._default().percentiles(qs)
+
+    def children(self) -> list[tuple[tuple[str, ...], object]]:
+        with self._lock:
+            return sorted(self._children.items())
+
+    def clear(self) -> None:
+        with self._lock:
+            self._children.clear()
+            if not self.labelnames:
+                self._children[()] = self._new_child()
+
+    # -- exposition -------------------------------------------------------
+    def render(self) -> list[str]:
+        lines = []
+        if self.help:
+            lines.append(f"# HELP {self.name} "
+                         + self.help.replace("\\", "\\\\").replace("\n", "\\n"))
+        lines.append(f"# TYPE {self.name} {self.kind}")
+        for key, child in self.children():
+            if self.kind == "histogram":
+                counts, total, count = child.snapshot()
+                cum = 0
+                for ub, c in zip(child.buckets + (math.inf,), counts):
+                    cum += c
+                    lab = _fmt_labels(self.labelnames + ("le",),
+                                      key + (_fmt_value(float(ub)),))
+                    lines.append(f"{self.name}_bucket{lab} {cum}")
+                lab = _fmt_labels(self.labelnames, key)
+                lines.append(f"{self.name}_sum{lab} {_fmt_value(total)}")
+                lines.append(f"{self.name}_count{lab} {count}")
+            else:
+                lab = _fmt_labels(self.labelnames, key)
+                lines.append(f"{self.name}{lab} {_fmt_value(child.value)}")
+        return lines
+
+
+class MetricsRegistry:
+    """Name -> family map plus exposition-time collector callbacks."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._families: dict[str, Family] = {}
+        self._collectors: dict[str, Callable[[], None]] = {}
+
+    def _get_or_create(self, name: str, kind: str, help: str,
+                       labels: Sequence[str], **kw) -> Family:
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is not None:
+                if fam.kind != kind or fam.labelnames != tuple(labels):
+                    raise MetricError(
+                        f"metric {name} already registered as {fam.kind}"
+                        f"{fam.labelnames}, requested {kind}{tuple(labels)}")
+                return fam
+            fam = self._families[name] = Family(name, kind, help, labels, **kw)
+            return fam
+
+    def counter(self, name: str, help: str = "",
+                labels: Sequence[str] = ()) -> Family:
+        return self._get_or_create(name, "counter", help, labels)
+
+    def gauge(self, name: str, help: str = "",
+              labels: Sequence[str] = ()) -> Family:
+        return self._get_or_create(name, "gauge", help, labels)
+
+    def histogram(self, name: str, help: str = "",
+                  labels: Sequence[str] = (),
+                  buckets: Sequence[float] = DEFAULT_LATENCY_BUCKETS) -> Family:
+        return self._get_or_create(name, "histogram", help, labels,
+                                   buckets=buckets)
+
+    def get(self, name: str) -> Optional[Family]:
+        with self._lock:
+            return self._families.get(name)
+
+    # -- collectors -------------------------------------------------------
+    def add_collector(self, key: str, fn: Callable[[], None]) -> None:
+        """Register (or replace) a named exposition-time callback. Keyed so a
+        re-constructed server replaces its predecessor's collector instead of
+        stacking a stale one."""
+        with self._lock:
+            self._collectors[key] = fn
+
+    def remove_collector(self, key: str) -> None:
+        with self._lock:
+            self._collectors.pop(key, None)
+
+    # -- exposition -------------------------------------------------------
+    def expose(self) -> str:
+        """The full registry in Prometheus text format (version 0.0.4)."""
+        with self._lock:
+            collectors = list(self._collectors.items())
+        for key, fn in collectors:
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 - a bad collector must not
+                logger.exception("metrics collector %r failed", key)  # kill /metrics
+        with self._lock:
+            families = sorted(self._families.values(), key=lambda f: f.name)
+        lines: list[str] = []
+        for fam in families:
+            lines.extend(fam.render())
+        return "\n".join(lines) + "\n"
+
+    def reset(self) -> None:
+        """Zero every family's children (test isolation). Families and
+        collectors registered at import time survive — module-level handles
+        stay valid."""
+        with self._lock:
+            families = list(self._families.values())
+        for fam in families:
+            fam.clear()
+
+
+#: The process-wide registry every subsystem shares — ONE /metrics page.
+REGISTRY = MetricsRegistry()
+
+
+def timed(hist):
+    """``with timed(HIST.labels(route=...)):`` — observe the block's wall
+    duration into a histogram child (or unlabeled family). Free-function
+    spelling of ``hist.time()`` — one implementation, two idioms."""
+    return hist.time()
+
+
+# ---------------------------------------------------------------------------
+# parser (CLI pretty-printer + format-validity tests)
+# ---------------------------------------------------------------------------
+
+# the label block is matched as a sequence of quoted pairs (not [^}]*):
+# label VALUES may legally contain '}' — e.g. route="/rpc/{store}/{method}"
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>(?:\s*[a-zA-Z_][a-zA-Z0-9_]*\s*=\s*"
+    r'"(?:[^"\\]|\\.)*"\s*,?)*)\})?'
+    r"\s+(?P<value>[^\s]+)"
+    r"(?:\s+(?P<ts>-?\d+))?$")
+_LABEL_PAIR_RE = re.compile(
+    r'\s*([a-zA-Z_][a-zA-Z0-9_]*)\s*=\s*"((?:[^"\\]|\\.)*)"\s*(?:,|$)')
+
+
+def _unescape(v: str) -> str:
+    return v.replace('\\"', '"').replace("\\n", "\n").replace("\\\\", "\\")
+
+
+def parse_prometheus_text(text: str) -> dict[str, dict]:
+    """Strict parse of the exposition format. Returns
+    ``{family: {"type": str|None, "help": str|None,
+    "samples": [(name, labels_dict, value)]}}`` and raises
+    :class:`MetricError` on any malformed line — the validity oracle for
+    ``expose()``'s output."""
+    families: dict[str, dict] = {}
+
+    def fam_for(name: str) -> dict:
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[: -len(suffix)] in families:
+                base = name[: -len(suffix)]
+                break
+        return families.setdefault(
+            base, {"type": None, "help": None, "samples": []})
+
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            parts = line[len("# HELP "):].split(" ", 1)
+            if not parts or not _NAME_RE.match(parts[0]):
+                raise MetricError(f"line {lineno}: malformed HELP: {line!r}")
+            families.setdefault(
+                parts[0], {"type": None, "help": None, "samples": []})[
+                "help"] = parts[1] if len(parts) > 1 else ""
+            continue
+        if line.startswith("# TYPE "):
+            parts = line[len("# TYPE "):].split()
+            if len(parts) != 2 or parts[1] not in (
+                    "counter", "gauge", "histogram", "summary", "untyped"):
+                raise MetricError(f"line {lineno}: malformed TYPE: {line!r}")
+            families.setdefault(
+                parts[0], {"type": None, "help": None, "samples": []})[
+                "type"] = parts[1]
+            continue
+        if line.startswith("#"):
+            continue  # comment
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            raise MetricError(f"line {lineno}: malformed sample: {line!r}")
+        labels: dict[str, str] = {}
+        raw = m.group("labels")
+        if raw:
+            pos = 0
+            while pos < len(raw):
+                lm = _LABEL_PAIR_RE.match(raw, pos)
+                if lm is None:
+                    raise MetricError(
+                        f"line {lineno}: malformed labels: {line!r}")
+                labels[lm.group(1)] = _unescape(lm.group(2))
+                pos = lm.end()
+        v = m.group("value")
+        try:
+            value = float({"+Inf": "inf", "-Inf": "-inf", "NaN": "nan"}
+                          .get(v, v))
+        except ValueError:
+            raise MetricError(f"line {lineno}: bad value {v!r}: {line!r}")
+        fam_for(m.group("name"))["samples"].append(
+            (m.group("name"), labels, value))
+    return families
+
+
+def bucket_quantiles(
+        buckets: Sequence[tuple[float, float]],
+        qs: Sequence[float] = (0.5, 0.95, 0.99)) -> dict[str, float]:
+    """Approximate quantiles from cumulative ``(le, cumulative_count)``
+    pairs, linearly interpolated within the winning bucket (the
+    ``histogram_quantile`` estimate) — what the CLI pretty-printer shows for
+    scraped histograms, where raw samples aren't available."""
+    bs = sorted(buckets)
+    out: dict[str, float] = {}
+    total = bs[-1][1] if bs else 0.0
+    for q in qs:
+        key = f"p{int(q * 100)}"
+        if total <= 0:
+            out[key] = 0.0
+            continue
+        rank = q * total
+        prev_ub, prev_cum = 0.0, 0.0
+        val = bs[-1][0]
+        for ub, cum in bs:
+            if cum >= rank:
+                span = cum - prev_cum
+                frac = (rank - prev_cum) / span if span > 0 else 1.0
+                lo = prev_ub if ub != math.inf else prev_ub
+                hi = ub if ub != math.inf else prev_ub
+                val = lo + (hi - lo) * frac
+                break
+            prev_ub, prev_cum = ub, cum
+        out[key] = val
+    return out

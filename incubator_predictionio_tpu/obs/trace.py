@@ -1,0 +1,222 @@
+"""End-to-end request tracing: contextvar-scoped trace/span IDs, a ring
+buffer of recent spans served as JSON, and ``X-PIO-Trace`` header propagation.
+
+A *trace* is one logical request; a *span* is one timed operation inside it
+(an HTTP route, one storage-RPC attempt, a batch dispatch). The current
+span's identity rides a :mod:`contextvars` variable, so it composes with the
+resilience layer's ``deadline_scope`` (both are ambient, both survive
+``contextvars.copy_context()`` hops into worker threads) and it crosses
+process boundaries via the ``X-PIO-Trace: <trace_id>:<span_id>`` header —
+the ``remote`` storage transport injects it on every attempt, the storage
+server's telemetry middleware adopts it, so a query-server → storage-server
+call is ONE trace across both span logs.
+
+Every finished span lands in :data:`TRACES`, a bounded ring the servers
+serve at ``GET /traces.json`` — the flight-recorder view an operator reads
+after a latency blip, without having deployed a tracing backend first.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Any, Iterator, Optional
+
+#: Propagation header: ``<trace_id>:<span_id>`` (ids are 16 hex chars).
+TRACE_HEADER = "X-PIO-Trace"
+
+
+class SpanContext:
+    """The ambient identity: which trace we are in, which span is current."""
+
+    __slots__ = ("trace_id", "span_id")
+
+    def __init__(self, trace_id: str, span_id: str):
+        self.trace_id = trace_id
+        self.span_id = span_id
+
+
+class Span:
+    """One timed operation. Mutable while open (attrs, status); recorded
+    into the buffer exactly once, at exit."""
+
+    __slots__ = ("trace_id", "span_id", "parent_id", "name", "service",
+                 "start_unix", "duration", "status", "attrs", "_t0")
+
+    def __init__(self, trace_id: str, span_id: str, parent_id: Optional[str],
+                 name: str, service: Optional[str], attrs: dict[str, Any]):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.name = name
+        self.service = service
+        self.start_unix = time.time()
+        self.duration = 0.0
+        self.status = "ok"
+        self.attrs = attrs
+        self._t0 = time.perf_counter()
+
+    def set_attr(self, key: str, value: Any) -> None:
+        self.attrs[key] = value
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "traceId": self.trace_id,
+            "spanId": self.span_id,
+            "parentId": self.parent_id,
+            "name": self.name,
+            "service": self.service,
+            "startUnix": self.start_unix,
+            "durationSec": self.duration,
+            "status": self.status,
+            "attrs": dict(self.attrs),
+        }
+
+
+_CURRENT: contextvars.ContextVar[Optional[SpanContext]] = \
+    contextvars.ContextVar("pio_trace_context", default=None)
+
+
+def _new_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def current_context() -> Optional[SpanContext]:
+    return _CURRENT.get()
+
+
+def current_trace_id() -> Optional[str]:
+    ctx = _CURRENT.get()
+    return ctx.trace_id if ctx is not None else None
+
+
+class TraceBuffer:
+    """Bounded ring of finished spans, grouped on demand by trace id."""
+
+    def __init__(self, capacity: int = 2048):
+        self._lock = threading.Lock()
+        self._spans: deque[Span] = deque(maxlen=capacity)
+
+    def add(self, span: Span) -> None:
+        with self._lock:
+            self._spans.append(span)
+
+    def spans(self, trace_id: Optional[str] = None) -> list[dict]:
+        with self._lock:
+            snap = list(self._spans)
+        return [s.to_dict() for s in snap
+                if trace_id is None or s.trace_id == trace_id]
+
+    def traces(self, limit: int = 50) -> list[dict]:
+        """Recent traces, newest first: one entry per trace id with its span
+        tree flattened (spans in start order)."""
+        if limit <= 0:  # order[-limit:] would invert the meaning
+            return []
+        with self._lock:
+            snap = list(self._spans)
+        by_trace: dict[str, list[Span]] = {}
+        order: list[str] = []
+        for s in snap:
+            if s.trace_id not in by_trace:
+                by_trace[s.trace_id] = []
+                order.append(s.trace_id)
+            by_trace[s.trace_id].append(s)
+        out = []
+        for tid in reversed(order[-limit:]):
+            spans = sorted(by_trace[tid], key=lambda s: s.start_unix)
+            out.append({
+                "traceId": tid,
+                "spanCount": len(spans),
+                "durationSec": max((s.duration for s in spans), default=0.0),
+                "spans": [s.to_dict() for s in spans],
+            })
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+
+#: Process-wide flight recorder, served at ``GET /traces.json``.
+TRACES = TraceBuffer()
+
+
+@contextlib.contextmanager
+def span(name: str, service: Optional[str] = None,
+         buffer: Optional[TraceBuffer] = None, **attrs: Any) -> Iterator[Span]:
+    """Open a span as a child of the current context (or the root of a fresh
+    trace), make it current for the block, and record it on exit. An escaping
+    exception marks ``status="error:<Type>"`` and re-raises."""
+    parent = _CURRENT.get()
+    trace_id = parent.trace_id if parent is not None else _new_id()
+    parent_id = parent.span_id if parent is not None else None
+    sp = Span(trace_id, _new_id(), parent_id, name, service, attrs)
+    token = _CURRENT.set(SpanContext(trace_id, sp.span_id))
+    try:
+        yield sp
+    except BaseException as e:
+        sp.status = f"error:{type(e).__name__}"
+        raise
+    finally:
+        sp.duration = time.perf_counter() - sp._t0
+        _CURRENT.reset(token)
+        (buffer or TRACES).add(sp)
+
+
+@contextlib.contextmanager
+def trace_scope(ctx: Optional[SpanContext]) -> Iterator[None]:
+    """Force the ambient context for a block — how a server middleware adopts
+    a remote parent parsed from ``X-PIO-Trace`` (``ctx=None`` is a no-op, not
+    a reset: spans below still start a fresh trace naturally)."""
+    if ctx is None:
+        yield
+        return
+    token = _CURRENT.set(ctx)
+    try:
+        yield
+    finally:
+        _CURRENT.reset(token)
+
+
+# -- header propagation -----------------------------------------------------
+
+def header_value() -> Optional[str]:
+    """The outbound ``X-PIO-Trace`` value for the current context, or None
+    when no trace is active (callers simply omit the header)."""
+    ctx = _CURRENT.get()
+    if ctx is None:
+        return None
+    return f"{ctx.trace_id}:{ctx.span_id}"
+
+
+def parse_header(value: Optional[str]) -> Optional[SpanContext]:
+    """``<trace_id>:<span_id>`` (or bare ``<trace_id>``) → SpanContext.
+    Malformed values are ignored — a bad header must never fail a request."""
+    if not value:
+        return None
+
+    def ok(s: str) -> bool:
+        # ASCII-only: isalnum() alone admits non-ASCII "alphanumerics" that
+        # http.client cannot latin-1-encode when the id is re-injected into
+        # outbound headers — a crafted header must never fail a request
+        return 0 < len(s) <= 64 and s.isascii() and s.isalnum()
+
+    parts = value.strip().split(":")
+    tid = parts[0]
+    if not ok(tid):
+        return None
+    sid = parts[1] if len(parts) > 1 and parts[1] else tid
+    if not ok(sid):
+        return None
+    return SpanContext(tid, sid)
+
+
+def inject(headers) -> None:
+    """Set ``X-PIO-Trace`` on a mutable mapping when a trace is active."""
+    v = header_value()
+    if v is not None:
+        headers[TRACE_HEADER] = v

@@ -22,11 +22,37 @@ import threading
 from typing import Optional
 
 from incubator_predictionio_tpu.data.storage.base import StorageError
+from incubator_predictionio_tpu.obs.metrics import REGISTRY
 from incubator_predictionio_tpu.resilience.clock import SYSTEM_CLOCK, Clock
 
 CLOSED = "closed"
 OPEN = "open"
 HALF_OPEN = "half_open"
+
+#: numeric encoding for the state gauge (alerts key off > 0)
+STATE_VALUES = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
+
+_TRANSITIONS = REGISTRY.counter(
+    "pio_breaker_transitions_total",
+    "Circuit breaker state transitions by breaker name and target state",
+    labels=("breaker", "to"))
+_STATE = REGISTRY.gauge(
+    "pio_breaker_state",
+    "Circuit breaker state (0=closed, 1=half_open, 2=open)",
+    labels=("breaker",))
+_REJECTED = REGISTRY.gauge(
+    "pio_breaker_rejected_calls",
+    "Calls rejected while the breaker was open",
+    labels=("breaker",))
+
+
+def publish_breaker_metrics(snapshots: dict[str, dict]) -> None:
+    """Fold ``{name: breaker.snapshot()}`` into the state/rejected gauges —
+    shared by the registry collector below and the servers' collectors for
+    their standalone (non-registry) breakers."""
+    for name, snap in snapshots.items():
+        _STATE.labels(breaker=name).set(STATE_VALUES.get(snap["state"], -1))
+        _REJECTED.labels(breaker=name).set(snap["rejectedCalls"])
 
 
 class CircuitOpenError(StorageError):
@@ -102,6 +128,7 @@ class CircuitBreaker:
                 >= self.reset_timeout):
             self._state = HALF_OPEN
             self._probes = 0
+            _TRANSITIONS.labels(breaker=self.name, to=HALF_OPEN).inc()
 
     def release_probe(self) -> None:
         """Return an admitted half-open probe slot without recording an
@@ -115,6 +142,8 @@ class CircuitBreaker:
     # -- outcomes ---------------------------------------------------------
     def record_success(self) -> None:
         with self._lock:
+            if self._state != CLOSED:
+                _TRANSITIONS.labels(breaker=self.name, to=CLOSED).inc()
             self._state = CLOSED
             self._consecutive_failures = 0
             self._opened_at = None
@@ -128,6 +157,7 @@ class CircuitBreaker:
                     or self._consecutive_failures >= self.failure_threshold):
                 if self._state != OPEN:
                     self.opened_count += 1
+                    _TRANSITIONS.labels(breaker=self.name, to=OPEN).inc()
                 self._state = OPEN
                 self._opened_at = self._clock.monotonic()
                 self._probes = 0
@@ -180,3 +210,9 @@ class BreakerRegistry:
 #: The default registry: storage backends register here at construction so
 #: serving-layer ``/health`` endpoints see per-backend breaker state.
 BREAKERS = BreakerRegistry()
+
+# every registry-backed breaker's state lands on /metrics at scrape time;
+# standalone breakers (per-algorithm, serving, event-store) are folded in by
+# their owning server's collector through publish_breaker_metrics
+REGISTRY.add_collector(
+    "resilience.breakers", lambda: publish_breaker_metrics(BREAKERS.snapshot()))

@@ -31,6 +31,8 @@ import random
 from typing import Any, Callable, Optional
 
 from incubator_predictionio_tpu.data.storage.base import StorageError
+from incubator_predictionio_tpu.obs import trace as _trace
+from incubator_predictionio_tpu.obs.metrics import REGISTRY
 from incubator_predictionio_tpu.resilience.breaker import (
     BREAKERS,
     BreakerRegistry,
@@ -38,6 +40,16 @@ from incubator_predictionio_tpu.resilience.breaker import (
     CircuitOpenError,
 )
 from incubator_predictionio_tpu.resilience.clock import SYSTEM_CLOCK, Clock
+
+_ATTEMPTS = REGISTRY.counter(
+    "pio_resilience_attempts_total",
+    "Policy-routed call attempts by operation", labels=("op",))
+_RETRIES = REGISTRY.counter(
+    "pio_resilience_retries_total",
+    "Retries (second and later attempts) by operation", labels=("op",))
+_DEADLINE_EXPIRED = REGISTRY.counter(
+    "pio_deadline_expired_total",
+    "Calls abandoned because their time budget ran out", labels=("op",))
 
 
 class TransientError(StorageError):
@@ -208,6 +220,7 @@ class ResiliencePolicy:
             raise CircuitOpenError(self.breaker.name,
                                    self.breaker.retry_after())
         attempts = 0
+        opname = op or "call"
         while True:
             rem = deadline.remaining()
             if rem is not None and rem < self.MIN_ATTEMPT_BUDGET:
@@ -218,12 +231,21 @@ class ResiliencePolicy:
                     # hand back the admitted half-open probe instead of
                     # wedging the breaker
                     self.breaker.release_probe()
+                _DEADLINE_EXPIRED.labels(op=opname).inc()
                 raise DeadlineExceeded(
                     f"{op or 'call'}: deadline exceeded "
                     f"after {attempts} attempt(s)")
             attempts += 1
+            _ATTEMPTS.labels(op=opname).inc()
+            if attempts > 1:
+                _RETRIES.labels(op=opname).inc()
             try:
-                result = fn(deadline)
+                # one span per attempt: retries and half-open probes show up
+                # individually under the caller's ambient trace, and the
+                # transport injects X-PIO-Trace per attempt with THIS span as
+                # the parent — the cross-process stitch point
+                with _trace.span(opname, kind="attempt", attempt=attempts):
+                    result = fn(deadline)
             except TransientError as e:
                 if self.breaker is not None:
                     self.breaker.record_failure()
@@ -232,6 +254,7 @@ class ResiliencePolicy:
                 pause = self.retry.delay(attempts, self._rng)
                 rem = deadline.remaining()
                 if rem is not None and pause >= rem:
+                    _DEADLINE_EXPIRED.labels(op=opname).inc()
                     raise DeadlineExceeded(
                         f"{op or 'call'}: retry budget exhausted after "
                         f"{attempts} attempt(s)") from e

@@ -24,6 +24,7 @@ from __future__ import annotations
 import asyncio
 import base64
 import collections
+import contextvars
 import dataclasses
 import datetime as _dt
 import json
@@ -35,6 +36,13 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Optional, Sequence
 
 from aiohttp import web
+
+from incubator_predictionio_tpu.obs.http import (
+    add_observability_routes,
+    telemetry_middleware,
+)
+from incubator_predictionio_tpu.obs.metrics import REGISTRY
+from incubator_predictionio_tpu.resilience.breaker import publish_breaker_metrics
 
 from incubator_predictionio_tpu.data.event import (
     Event,
@@ -67,6 +75,20 @@ MAX_BATCH_SIZE = 50  # EventServer.scala:70
 #: drain replay, wedging the queue head — those must surface to the caller.
 _TRANSIENT_STORE_ERRORS = (ConnectionError, TimeoutError, OSError,
                            TransientError, CircuitOpenError, DeadlineExceeded)
+
+# -- telemetry (obs/, docs/observability.md) --------------------------------
+_SPILL_DEPTH = REGISTRY.gauge(
+    "pio_spill_queue_depth",
+    "Events waiting in the event server's in-memory spill queue")
+_SPILL_MAX = REGISTRY.gauge(
+    "pio_spill_queue_max", "Spill queue capacity")
+_SPILLED = REGISTRY.counter(
+    "pio_spill_events_total",
+    "Events diverted to the spill queue because the store was failing")
+_EVENTS_HOUR = REGISTRY.gauge(
+    "pio_eventserver_requests_current_hour",
+    "Current-hour ingestion outcomes per app (the /stats.json fold)",
+    labels=("app_id", "status"))
 
 
 class SpillQueueFull(Exception):
@@ -170,6 +192,27 @@ class EventServer:
         self._drain_task: Optional[asyncio.Task] = None
         self._DRAIN_INTERVAL = 0.5
         self._loop: Optional[asyncio.AbstractEventLoop] = None
+        # fold this server's signals into /metrics at scrape time (keyed:
+        # a re-constructed server replaces its predecessor's collector)
+        REGISTRY.add_collector("event_server", self._collect_metrics)
+
+    def _collect_metrics(self) -> None:
+        """Exposition-time fold: spill depth, the standalone event-store
+        breaker, and (when enabled) the hourly Stats counters."""
+        with self._spill_lock:
+            depth = len(self._spill)
+        _SPILL_DEPTH.set(depth)
+        _SPILL_MAX.set(self.config.spill_max)
+        publish_breaker_metrics({"eventstore": self._store_breaker.snapshot()})
+        # clear-then-set: when the hour rolls, current_totals() drops apps —
+        # label sets absent from the new snapshot must not keep serving the
+        # old hour's counts (the metrics-layer twin of the stats.py fix)
+        _EVENTS_HOUR.clear()
+        if self.config.stats:
+            for app_id, statuses in self.stats.current_totals().items():
+                for status, n in statuses.items():
+                    _EVENTS_HOUR.labels(app_id=str(app_id),
+                                        status=status).set(n)
 
     @staticmethod
     def _auth_ttl() -> float:
@@ -189,9 +232,13 @@ class EventServer:
             return 5.0
 
     async def _run(self, fn, *args):
-        """Run a blocking storage call off the event loop."""
+        """Run a blocking storage call off the event loop. The caller's
+        contextvars (trace identity from the telemetry middleware, ambient
+        deadline) are copied into the worker thread — run_in_executor alone
+        would drop them."""
+        ctx = contextvars.copy_context()
         return await asyncio.get_running_loop().run_in_executor(
-            self._executor, fn, *args)
+            self._executor, lambda: ctx.run(fn, *args))
 
     # -- auth (EventServer.scala:92-120) ----------------------------------
     @staticmethod
@@ -371,6 +418,7 @@ class EventServer:
                 self._spill.append(
                     (e.with_id(eid), auth.app_id, auth.channel_id))
                 ids.append(eid)
+        _SPILLED.inc(len(ids))
         self._kick_drain()
         return ids
 
@@ -778,10 +826,12 @@ class EventServer:
 
     # -- app --------------------------------------------------------------
     def make_app(self) -> web.Application:
-        app = web.Application()
+        app = web.Application(
+            middlewares=[telemetry_middleware("event_server")])
         r = app.router
         r.add_get("/", self.handle_root)
         r.add_get("/health", self.handle_health)
+        add_observability_routes(app)
         r.add_post("/events.json", self.handle_create)
         r.add_get("/events.json", self.handle_find)
         r.add_get("/events/{event_id}.json", self.handle_get_event)

@@ -27,6 +27,7 @@ Design notes vs the reference:
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import dataclasses
 import datetime as _dt
 import hashlib
@@ -37,6 +38,16 @@ import uuid
 from typing import Any, Optional
 
 from aiohttp import web
+
+from incubator_predictionio_tpu.obs.http import (
+    add_observability_routes,
+    telemetry_middleware,
+)
+from incubator_predictionio_tpu.obs.metrics import (
+    REGISTRY,
+    nearest_rank_percentiles,
+)
+from incubator_predictionio_tpu.resilience.breaker import publish_breaker_metrics
 
 from incubator_predictionio_tpu.core.controller import (
     Engine,
@@ -63,6 +74,33 @@ from incubator_predictionio_tpu.utils.json_util import bind_query, to_jsonable
 from incubator_predictionio_tpu.utils.serialization import deserialize_model
 
 logger = logging.getLogger(__name__)
+
+# -- telemetry (obs/, docs/observability.md) --------------------------------
+_DEGRADED = REGISTRY.counter(
+    "pio_serving_degraded_total",
+    "Queries answered from the degradation path (last-good cache / serving "
+    "default) instead of a live prediction")
+_G_REQUESTS = REGISTRY.gauge(
+    "pio_serving_requests", "Successfully served queries (this process)")
+_G_BATCHES = REGISTRY.gauge(
+    "pio_serving_batches", "Micro-batches dispatched to the device")
+_G_MAX_BATCH = REGISTRY.gauge(
+    "pio_serving_max_batch_seen", "Largest micro-batch coalesced so far")
+_G_LATENCY_Q = REGISTRY.gauge(
+    "pio_serving_latency_seconds",
+    "Serving latency split into its terms (exact reservoir quantiles)",
+    labels=("stage", "quantile"))
+_G_DEV_MEM = REGISTRY.gauge(
+    "pio_device_bytes_in_use",
+    "Accelerator memory in use (the device_memory_report fold)",
+    labels=("device",))
+
+#: per-algorithm wall times of the current dispatch, set by ``predict_batch``
+#: and read back from the SAME Context object after ``Context.run`` returns
+#: (writes inside ``ctx.run`` persist in ``ctx``) — per-dispatch state with
+#: no shared attribute, so overlapping dispatches can never swap timings
+_DISPATCH_ALGO_TIMES: contextvars.ContextVar[list] = contextvars.ContextVar(
+    "pio_dispatch_algo_times")
 
 
 @dataclasses.dataclass
@@ -262,6 +300,7 @@ class DeployedEngine:
                 out[i] = e
             return out
         per_algo: dict[int, dict[int, Any]] = {}  # algo idx -> query idx -> pred/exc
+        algo_times: list[tuple[str, float]] = []
         for ai in algo_live:
             a, m = self.algorithms[ai], self.models[ai]
             t0 = self._clock.monotonic()
@@ -290,14 +329,20 @@ class DeployedEngine:
                     except Exception as e:  # noqa: BLE001
                         singles[i] = e
                 per_algo[ai] = singles
+            took = self._clock.monotonic() - t0
+            algo_times.append((f"algo{ai}.{type(a).__name__}", took))
             self._record_batch_outcome(
-                ai, per_algo[ai], self._clock.monotonic() - t0,
+                ai, per_algo[ai], took,
                 # the per-call deadline is only meaningful when the elapsed
                 # time WAS one call: a single-query batch with no heals.
                 # Judging it against a coalesced N-query dispatch (or a
                 # batch attempt plus N retries) would brand a healthy
                 # algorithm slow exactly under peak load
                 single_call=(len(live) == 1 and not healed))
+        # the per-batch cost is per-dispatch state (a coalesced batch shares
+        # one device round trip): publish via the dispatch's own context so
+        # overlapping dispatches cannot swap each other's timings
+        _DISPATCH_ALGO_TIMES.set(algo_times)
         for i in live:
             preds, first_err = [], None
             for ai in algo_live:
@@ -315,6 +360,19 @@ class DeployedEngine:
             except Exception as e:  # noqa: BLE001
                 out[i] = e
         return out
+
+
+class _Delivered:
+    """Marker wrapper the dispatcher resolves futures with: the payload's
+    result plus the batch's per-algorithm timings. A distinct type (not a
+    tuple) so a prediction that happens to BE a tuple can never be mistaken
+    for the envelope; error paths deliver bare exceptions."""
+
+    __slots__ = ("result", "algo_times")
+
+    def __init__(self, result: Any, algo_times: list):
+        self.result = result
+        self.algo_times = algo_times
 
 
 class MicroBatcher:
@@ -374,20 +432,35 @@ class MicroBatcher:
             self._task = None
         while True:
             try:
-                _, fut, _ = self.queue.get_nowait()
+                _, fut, _, _ = self.queue.get_nowait()
             except asyncio.QueueEmpty:
                 break
             if not fut.done():
                 fut.set_result(RuntimeError("server shutting down"))
 
     async def submit(self, payload: dict) -> Any:
+        return (await self.submit_timed(payload))[0]
+
+    async def submit_timed(self, payload: dict) -> tuple[Any, list]:
+        """Submit and also return the dispatch's per-algorithm wall times
+        (the X-PIO-Server-Timing source) — per-call data, never read off
+        shared state, so overlapping dispatches can't swap timings."""
         self.start()
         fut = asyncio.get_running_loop().create_future()
-        await self.queue.put((payload, fut, time.perf_counter()))
-        result = await fut
+        # carry the submitter's contextvars (trace identity from the
+        # telemetry middleware) — the dispatch worker thread re-enters the
+        # first request's context so storage calls under predict stay on the
+        # caller's trace (coalesced followers share that dispatch span)
+        await self.queue.put((payload, fut, time.perf_counter(),
+                              contextvars.copy_context()))
+        got = await fut
+        if isinstance(got, _Delivered):
+            result, algo_times = got.result, got.algo_times
+        else:  # error paths deliver bare exceptions
+            result, algo_times = got, []
         if isinstance(result, Exception):
             raise result
-        return result
+        return result, algo_times
 
     async def set_max_in_flight(self, n: int) -> None:
         """Resize the dispatch-slot semaphore live (reload can swap in an
@@ -427,7 +500,7 @@ class MicroBatcher:
                     except asyncio.QueueEmpty:
                         break
                 now = time.perf_counter()
-                for _, _, t_enq in batch:
+                for _, _, t_enq, _ in batch:
                     self.queue_delay.record(now - t_enq)
                 self.batches_served += 1
                 self.max_batch_seen = max(self.max_batch_seen, len(batch))
@@ -449,28 +522,35 @@ class MicroBatcher:
 
     async def _dispatch(self, loop, batch) -> None:
         t0 = time.perf_counter()
-        payloads = [p for p, _, _ in batch]
+        payloads = [p for p, _, _, _ in batch]
+        # run_in_executor does not copy contextvars — run_with_deadline
+        # re-establishes the deadline scope inside the worker thread, and
+        # entering the first request's captured context carries its trace
+        # identity across the thread hop (each request's context is captured
+        # once at submit, so it is never entered twice)
+        ctx = batch[0][3]
         try:
-            # run_in_executor does not copy contextvars — run_with_deadline
-            # re-establishes the deadline scope inside the worker thread
             results = await loop.run_in_executor(
-                None, run_with_deadline, self.deadline_sec,
+                None, ctx.run, run_with_deadline, self.deadline_sec,
                 self.deployed.predict_batch, payloads
             )
         except asyncio.CancelledError:
             # cancelled mid-dispatch: these futures are already dequeued, so
             # the queue-drain in stop() can't see them — fail them here or
             # their callers hang forever
-            for _, fut, _ in batch:
+            for _, fut, _, _ in batch:
                 if not fut.done():
                     fut.set_result(RuntimeError("server shutting down"))
             raise
         except Exception as e:  # noqa: BLE001 - keep serving
             results = [e] * len(batch)
         self.dispatch_sec.record(time.perf_counter() - t0)
-        for (_, fut, _), r in zip(batch, results):
+        # predict_batch published its per-algorithm times inside ctx; writes
+        # made under Context.run persist in the Context object
+        algo_times = ctx.get(_DISPATCH_ALGO_TIMES, [])
+        for (_, fut, _, _), r in zip(batch, results):
             if not fut.done():
-                fut.set_result(r)
+                fut.set_result(_Delivered(r, algo_times))
 
 
 class LatencyReservoir:
@@ -492,14 +572,7 @@ class LatencyReservoir:
             self._pos = (self._pos + 1) % self.capacity
 
     def percentiles(self, qs: tuple[float, ...] = (0.5, 0.95, 0.99)) -> dict[str, float]:
-        if not self._buf:
-            return {f"p{int(q * 100)}": 0.0 for q in qs}
-        s = sorted(self._buf)
-        out = {}
-        for q in qs:
-            idx = min(len(s) - 1, max(0, int(round(q * (len(s) - 1)))))
-            out[f"p{int(q * 100)}"] = s[idx]
-        return out
+        return nearest_rank_percentiles(self._buf, qs)
 
 
 def load_deployed_engine(
@@ -601,12 +674,46 @@ class QueryServer:
         self._runner: Optional[web.AppRunner] = None
         self._stop_event = asyncio.Event()
         self._feedback_tasks: set[asyncio.Task] = set()  # strong refs (GC pitfall)
+        # fold this server's signals into /metrics at scrape time (keyed:
+        # a re-constructed server replaces its predecessor's collector)
+        REGISTRY.add_collector("query_server", self._collect_metrics)
+
+    def _collect_metrics(self) -> None:
+        """Exposition-time fold: standalone breakers (per-algorithm +
+        serving), the serving reservoirs, and device memory."""
+        breakers = {b.name: b.snapshot() for b in self.deployed.algo_breakers}
+        breakers["serving"] = self._serving_breaker.snapshot()
+        publish_breaker_metrics(breakers)
+        _G_REQUESTS.set(self.request_count)
+        _G_BATCHES.set(self.batcher.batches_served)
+        _G_MAX_BATCH.set(self.batcher.max_batch_seen)
+        for stage, res in (("total", self.latency),
+                           ("queue_delay", self.batcher.queue_delay),
+                           ("dispatch", self.batcher.dispatch_sec)):
+            for q, v in res.percentiles().items():
+                _G_LATENCY_Q.labels(stage=stage, quantile=q).set(v)
+        import sys
+
+        if "jax" in sys.modules:  # never the import that drags jax in
+            try:
+                from incubator_predictionio_tpu.utils.tracing import (
+                    device_memory_report,
+                )
+
+                for row in device_memory_report():
+                    if row["bytes_in_use"] is not None:
+                        _G_DEV_MEM.labels(device=row["device"]).set(
+                            row["bytes_in_use"])
+            except Exception:  # noqa: BLE001 - stats are best-effort
+                pass
 
     # -- routes -----------------------------------------------------------
     def make_app(self) -> web.Application:
-        app = web.Application()
+        app = web.Application(
+            middlewares=[telemetry_middleware("query_server")])
         app.router.add_get("/", self.handle_status)
         app.router.add_get("/health", self.handle_health)
+        add_observability_routes(app)
         app.router.add_post("/queries.json", self.handle_query)
         app.router.add_post("/reload", self.handle_reload)
         app.router.add_post("/stop", self.handle_stop)
@@ -746,18 +853,31 @@ class QueryServer:
 </html>"""
 
     async def handle_query(self, request: web.Request) -> web.Response:
-        status, result = await self._serve_payload(await request.read())
-        return web.json_response(result, status=status)
+        status, result, timing = await self._serve_payload(await request.read())
+        headers = {"X-PIO-Server-Timing": timing} if timing else None
+        return web.json_response(result, status=status, headers=headers)
 
-    async def _serve_payload(self, body: bytes) -> tuple[int, Any]:
+    @staticmethod
+    def _server_timing(total_sec: float,
+                       algo_times: list[tuple[str, float]]) -> str:
+        """``X-PIO-Server-Timing`` value: total µs plus this request's
+        dispatch's per-algorithm µs (``<name>;us=<int>`` entries) — clients
+        see server-side cost without scraping /metrics."""
+        parts = [f"total;us={int(total_sec * 1e6)}"]
+        parts.extend(f"{name};us={int(sec * 1e6)}"
+                     for name, sec in algo_times)
+        return ", ".join(parts)
+
+    async def _serve_payload(self, body: bytes) -> tuple[int, Any, Optional[str]]:
         """The whole query lifecycle from raw body bytes — ONE code path
         shared by the aiohttp route and the native front, so their behavior
-        cannot drift."""
+        cannot drift. Returns (status, jsonable body, Server-Timing value or
+        None on non-predict outcomes)."""
         t0 = time.time()
         try:
             payload = json.loads(body)
         except json.JSONDecodeError:
-            return 400, {"message": "Invalid JSON query"}
+            return 400, {"message": "Invalid JSON query"}, None
         loop = asyncio.get_running_loop()
         if not self._serving_breaker.allow():
             # the predict path has been failing hard: degrade instantly
@@ -766,14 +886,15 @@ class QueryServer:
             # (default_result, plugins) runs in the executor — under outage
             # EVERY request takes this path, and it must not block the loop
             return 200, await loop.run_in_executor(
-                None, self._degraded_result, payload, "serving breaker open")
+                None, self._degraded_result, payload,
+                "serving breaker open"), None
         try:
-            submitted = self.batcher.submit(payload)
+            submitted = self.batcher.submit_timed(payload)
             if self.config.query_timeout_sec is not None:
-                prediction = await asyncio.wait_for(
+                prediction, algo_times = await asyncio.wait_for(
                     submitted, self.config.query_timeout_sec)
             else:
-                prediction = await submitted
+                prediction, algo_times = await submitted
         except asyncio.CancelledError:
             # client disconnected mid-await (aiohttp cancels the handler):
             # no verdict on the engine's health — hand back the admitted
@@ -784,7 +905,7 @@ class QueryServer:
             # the engine answered (binding rejected the query): health-wise
             # a success — a half-open probe slot must never leak
             self._serving_breaker.record_success()
-            return 400, {"message": f"Invalid query: {e}"}
+            return 400, {"message": f"Invalid query: {e}"}, None
         except (asyncio.TimeoutError, ServingUnavailable, DeadlineExceeded,
                 CircuitOpenError) as e:
             # deadline blown or every algorithm/backend breaker open:
@@ -792,7 +913,7 @@ class QueryServer:
             self._serving_breaker.record_failure()
             self._ship_remote_log(f"query degraded: {e!r}")
             return 200, await loop.run_in_executor(
-                None, self._degraded_result, payload, repr(e))
+                None, self._degraded_result, payload, repr(e)), None
         except Exception as e:  # noqa: BLE001 - ship serving errors remotely
             # a per-query engine exception is the ENGINE answering (with an
             # error) — not a serving outage. One client's poison query must
@@ -821,7 +942,7 @@ class QueryServer:
             task = asyncio.create_task(self._send_feedback(payload, result))
             self._feedback_tasks.add(task)
             task.add_done_callback(self._feedback_tasks.discard)
-        return 200, result
+        return 200, result, self._server_timing(dt, algo_times)
 
     # -- graceful degradation (resilience/) -------------------------------
     @staticmethod
@@ -852,6 +973,7 @@ class QueryServer:
             # += from concurrent executor threads is a lost-update hazard
             self.degraded_count += 1
             cached = self._last_good.get(self._cache_key(payload))
+        _DEGRADED.inc()
         if cached is not None:
             if isinstance(cached, dict):
                 return {**cached, "degraded": True}
@@ -1024,12 +1146,15 @@ class QueryServer:
         from incubator_predictionio_tpu import native
 
         try:
-            status, result = await self._serve_payload(body)
+            status, result, timing = await self._serve_payload(body)
             payload = json.dumps(result).encode()
             reason = {200: "OK", 400: "Bad Request"}.get(status, "Error")
+            timing_line = (f"X-PIO-Server-Timing: {timing}\r\n"
+                           if timing else "")
             resp = (f"HTTP/1.1 {status} {reason}\r\n"
                     f"Content-Type: application/json; charset=utf-8\r\n"
                     f"Content-Length: {len(payload)}\r\n"
+                    f"{timing_line}"
                     f"Connection: keep-alive\r\n\r\n").encode() + payload
         except Exception:  # noqa: BLE001 - aiohttp would 500 here
             logger.exception("native serving handler error")

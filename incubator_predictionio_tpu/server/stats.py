@@ -7,15 +7,23 @@ from __future__ import annotations
 import datetime as _dt
 import threading
 from collections import Counter
-from typing import Optional
+from typing import Callable, Optional
 
 
 def _hour_floor(t: _dt.datetime) -> _dt.datetime:
     return t.replace(minute=0, second=0, microsecond=0)
 
 
+def _utcnow() -> _dt.datetime:
+    return _dt.datetime.now(_dt.timezone.utc)
+
+
 class Stats:
-    def __init__(self) -> None:
+    def __init__(self,
+                 clock: Optional[Callable[[], _dt.datetime]] = None) -> None:
+        # injectable wall-clock (returns an aware datetime) so the roll
+        # logic is testable without wall time
+        self._clock = clock or _utcnow
         self._lock = threading.Lock()
         self._hour: Optional[_dt.datetime] = None
         self._prev: dict[int, dict[str, Counter]] = {}
@@ -26,7 +34,13 @@ class Stats:
         if self._hour is None:
             self._hour = hour
         elif hour > self._hour:
-            self._prev = self._cur
+            # "previousHour" must mean exactly that: after a gap of two or
+            # more hours the stale _cur is hours old, not the previous hour —
+            # promoting it would report ancient counts as fresh
+            if hour - self._hour <= _dt.timedelta(hours=1):
+                self._prev = self._cur
+            else:
+                self._prev = {}
             self._cur = {}
             self._hour = hour
 
@@ -38,7 +52,7 @@ class Stats:
         entity_type: str,
         now: Optional[_dt.datetime] = None,
     ) -> None:
-        now = now or _dt.datetime.now(_dt.timezone.utc)
+        now = now or self._clock()
         with self._lock:
             self._roll(now)
             app = self._cur.setdefault(
@@ -51,7 +65,7 @@ class Stats:
 
     def get(self, app_id: int) -> dict:
         with self._lock:
-            self._roll(_dt.datetime.now(_dt.timezone.utc))
+            self._roll(self._clock())
             out = {}
             for label, data in (("previousHour", self._prev), ("currentHour", self._cur)):
                 app = data.get(app_id, {})
@@ -62,3 +76,12 @@ class Stats:
                 }
             out["startTime"] = self._hour.isoformat() if self._hour else None
             return out
+
+    def current_totals(self) -> dict[int, dict[str, int]]:
+        """Current-hour per-app status counts — the /metrics fold (the full
+        per-event/entity breakdown stays on /stats.json; metrics labels must
+        stay low-cardinality)."""
+        with self._lock:
+            self._roll(self._clock())
+            return {app_id: dict(data["status"])
+                    for app_id, data in self._cur.items()}

@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import asyncio
 import base64
+import contextvars
 import dataclasses
 import io
 import json
@@ -46,6 +47,11 @@ from typing import Any, Optional
 
 import numpy as np
 from aiohttp import web
+
+from incubator_predictionio_tpu.obs.http import (
+    add_observability_routes,
+    telemetry_middleware,
+)
 
 from incubator_predictionio_tpu.data.event import Event
 from incubator_predictionio_tpu.data.storage.base import (
@@ -99,13 +105,19 @@ class StorageServer:
         self._runner: Optional[web.AppRunner] = None
 
     async def _run(self, fn, *args, **kw):
+        # copy_context: run_in_executor drops contextvars, and the request's
+        # trace identity (set by the telemetry middleware) must follow the
+        # storage call into the worker thread
+        ctx = contextvars.copy_context()
         return await asyncio.get_running_loop().run_in_executor(
-            self._executor, lambda: fn(*args, **kw))
+            self._executor, lambda: ctx.run(fn, *args, **kw))
 
     # -- app --------------------------------------------------------------
     def make_app(self) -> web.Application:
-        app = web.Application(client_max_size=256 * 1024 * 1024)
+        app = web.Application(client_max_size=256 * 1024 * 1024,
+                              middlewares=[telemetry_middleware("storage_server")])
         app.router.add_get("/", self.handle_status)
+        add_observability_routes(app)
         app.router.add_post("/rpc/events/find", self.handle_find)
         app.router.add_post("/rpc/events/assemble_triples",
                             self.handle_assemble_triples)

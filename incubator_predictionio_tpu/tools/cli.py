@@ -9,7 +9,9 @@ Parity target: tools/console/Console.scala:134-623 and commands/*. Verbs:
   the in-package template registry; ``get`` scaffolds a ready-to-train
   engine.json),
   train, eval, deploy, undeploy, batchpredict, eventserver, storageserver,
-  export, import, shell (bin/pio-shell: interactive console with the
+  export, import, metrics (scrape + pretty-print any server's Prometheus
+  /metrics page, docs/observability.md),
+  shell (bin/pio-shell: interactive console with the
   storage/event-store/mesh bootstrap preloaded),
   start-all, stop-all (bin/pio-start-all / pio-stop-all: daemonize the
   serving stack with pidfiles), redeploy (examples/redeploy-script: cron-able
@@ -628,6 +630,72 @@ def cmd_version(args, storage) -> int:
     return 0
 
 
+def cmd_metrics(args, storage) -> int:
+    """Fetch and pretty-print a server's ``/metrics`` page (any of the three
+    servers — event, query, storage — serves one; docs/observability.md)."""
+    import math
+    import urllib.request
+
+    from incubator_predictionio_tpu.obs.metrics import (
+        MetricError,
+        bucket_quantiles,
+        parse_prometheus_text,
+    )
+
+    url = args.url.rstrip("/")
+    if not url.endswith("/metrics"):
+        url += "/metrics"
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            text = resp.read().decode()
+    except Exception as e:  # noqa: BLE001
+        _err(f"Unable to fetch {url}: {e}")
+        return 1
+    try:
+        families = parse_prometheus_text(text)
+    except MetricError as e:
+        _err(f"{url} served malformed metrics: {e}")
+        return 1
+    if args.raw:
+        _out(text.rstrip())
+        return 0
+    for name in sorted(families):
+        fam = families[name]
+        kind, samples = fam["type"] or "untyped", fam["samples"]
+        if args.filter and args.filter not in name:
+            continue
+        _out(f"{name} ({kind})" + (f" — {fam['help']}" if fam["help"] else ""))
+        if kind == "histogram":
+            # per label-set: count, sum, mean, estimated quantiles
+            by_key: dict[tuple, dict] = {}
+            for sname, labels, value in samples:
+                key = tuple(sorted((k, v) for k, v in labels.items()
+                                   if k != "le"))
+                slot = by_key.setdefault(key, {"buckets": []})
+                if sname.endswith("_bucket"):
+                    slot["buckets"].append((float(labels["le"]), value))
+                elif sname.endswith("_sum"):
+                    slot["sum"] = value
+                elif sname.endswith("_count"):
+                    slot["count"] = value
+            for key, slot in sorted(by_key.items()):
+                label = ",".join(f"{k}={v}" for k, v in key) or "(no labels)"
+                count = slot.get("count", 0)
+                mean = (slot.get("sum", 0.0) / count) if count else 0.0
+                qs = bucket_quantiles(slot["buckets"])
+                _out(f"  {label}: count={int(count)} mean={mean * 1e3:.3f}ms "
+                     + " ".join(f"~{k}={v * 1e3:.3f}ms"
+                                for k, v in qs.items()))
+        else:
+            for sname, labels, value in sorted(
+                    samples, key=lambda s: sorted(s[1].items())):
+                label = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+                v = int(value) if float(value).is_integer() \
+                    and not math.isinf(value) else value
+                _out(f"  {label or '(no labels)'}: {v}")
+    return 0
+
+
 # ---------------------------------------------------------------------------
 # parser
 # ---------------------------------------------------------------------------
@@ -845,6 +913,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-c", "--code", dest="shell_code",
                    help="run this statement instead of going interactive")
 
+    # metrics — scrape + pretty-print any server's /metrics
+    p = sub.add_parser(
+        "metrics",
+        help="fetch and pretty-print a server's Prometheus /metrics page "
+             "(docs/observability.md)")
+    p.add_argument("url", help="server base URL, e.g. http://127.0.0.1:8000")
+    p.add_argument("--raw", action="store_true",
+                   help="print the raw exposition text instead")
+    p.add_argument("--filter", help="only families whose name contains this")
+
     # export / import
     p = sub.add_parser("export")
     p.add_argument("--appid", type=int, required=True)
@@ -909,6 +987,7 @@ _COMMANDS = {
     "adminserver": cmd_adminserver,
     "export": cmd_export,
     "import": cmd_import,
+    "metrics": cmd_metrics,
     "start-all": cmd_start_all,
     "stop-all": cmd_stop_all,
     "redeploy": cmd_redeploy,

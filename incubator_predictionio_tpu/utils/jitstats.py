@@ -4,10 +4,16 @@ The round-2 serving regression (every distinct micro-batch size triggered a
 fresh XLA compile) was invisible in the bench artifact — the status page had
 ``maxBatchSeen`` but no compile counter. This module tracks the set of
 distinct jit cache keys the serving scorers have dispatched with, so the
-query-server status page (and the bench JSON) can expose exactly how many
-executables serving built. A healthy bucketed server warms up every bucket at
-deploy and the count stays flat under load; a growing count under load IS the
-round-2 bug.
+query-server status page, ``/metrics``, and the bench JSON can expose
+exactly how many executables serving built. A healthy bucketed server warms
+up every bucket at deploy and the count stays flat under load; a growing
+count under load IS the round-2 bug.
+
+Each key also records its first-seen monotonic timestamp, so
+``recent_count(window)`` turns "growing under load" into an alert condition:
+``pio_jit_compiles_recent`` on ``/metrics`` is non-zero only when a compile
+happened in the last N seconds — flat-after-warmup servers read 0 there
+within a scrape interval of deploy.
 
 Counting happens at the call site (models register the key they are about to
 dispatch with), not via XLA hooks — the key (function, bucket, k, catalog
@@ -18,34 +24,71 @@ functions are module-level with only those statics/shapes varying.
 from __future__ import annotations
 
 import threading
-from typing import Hashable
+import time
+from typing import Hashable, Optional
+
+from incubator_predictionio_tpu.obs.metrics import REGISTRY
 
 _lock = threading.Lock()
-_keys: set[Hashable] = set()
+_first_seen: dict[Hashable, float] = {}  # key -> monotonic first-dispatch
 
 
-def record(key: Hashable) -> bool:
-    """Register a jit dispatch key; returns True when it is new (a compile)."""
+def record(key: Hashable, now: Optional[float] = None) -> bool:
+    """Register a jit dispatch key; returns True when it is new (a compile).
+    ``now`` (monotonic seconds) is injectable for tests."""
+    ts = time.monotonic() if now is None else now
     with _lock:
-        if key in _keys:
+        if key in _first_seen:
             return False
-        _keys.add(key)
+        _first_seen[key] = ts
         return True
 
 
 def count() -> int:
     """Number of distinct serving executables built so far in this process."""
     with _lock:
-        return len(_keys)
+        return len(_first_seen)
+
+
+def recent_count(window_sec: float = 60.0, now: Optional[float] = None) -> int:
+    """Keys first seen within the last ``window_sec`` — the growing-under-
+    load alert gauge (non-zero after warmup means the round-2 bug is live)."""
+    cutoff = (time.monotonic() if now is None else now) - window_sec
+    with _lock:
+        return sum(1 for ts in _first_seen.values() if ts >= cutoff)
 
 
 def snapshot() -> list:
     """The keys themselves (sorted repr order) — for debugging/status pages."""
     with _lock:
-        return sorted(_keys, key=repr)
+        return sorted(_first_seen, key=repr)
+
+
+def first_seen() -> dict:
+    """key -> first-seen monotonic timestamp (copy)."""
+    with _lock:
+        return dict(_first_seen)
 
 
 def reset() -> None:
     """Test hook."""
     with _lock:
-        _keys.clear()
+        _first_seen.clear()
+
+
+# -- /metrics fold ----------------------------------------------------------
+_G_TOTAL = REGISTRY.gauge(
+    "pio_jit_compile_keys",
+    "Distinct serving executables built in this process (flat after warmup)")
+_G_RECENT = REGISTRY.gauge(
+    "pio_jit_compiles_recent",
+    "Jit keys first seen within the trailing window (alert when non-zero "
+    "after warmup)", labels=("window_seconds",))
+
+
+def _collect() -> None:
+    _G_TOTAL.set(count())
+    _G_RECENT.labels(window_seconds="60").set(recent_count(60.0))
+
+
+REGISTRY.add_collector("jitstats", _collect)

@@ -1,0 +1,567 @@
+"""Unified telemetry layer (ISSUE 2): metrics registry + Prometheus
+exposition, /metrics on all three servers, end-to-end trace propagation
+through resilience retries and across the query-server → storage-server hop,
+and the satellite fixes (Stats roll gap, jitstats first-seen window,
+X-PIO-Server-Timing).
+
+Everything time-dependent runs on FakeClock — zero wall-clock sleeps."""
+
+import asyncio
+import datetime as dt
+import math
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from incubator_predictionio_tpu.core.controller import EngineParams
+from incubator_predictionio_tpu.data.storage import Storage
+from incubator_predictionio_tpu.data.storage.base import EngineInstance
+from incubator_predictionio_tpu.data.storage.remote import RemoteStorageClient
+from incubator_predictionio_tpu.obs import trace
+from incubator_predictionio_tpu.obs.metrics import (
+    REGISTRY,
+    MetricError,
+    MetricsRegistry,
+    bucket_quantiles,
+    parse_prometheus_text,
+)
+from incubator_predictionio_tpu.resilience import (
+    CircuitBreaker,
+    CircuitOpenError,
+    FakeClock,
+    FaultInjector,
+    FaultSchedule,
+    ResiliencePolicy,
+    RetryPolicy,
+    Timeout,
+    TransientError,
+)
+from incubator_predictionio_tpu.server.event_server import (
+    EventServer,
+    EventServerConfig,
+)
+from incubator_predictionio_tpu.server.query_server import (
+    DeployedEngine,
+    QueryServer,
+    ServerConfig,
+)
+from incubator_predictionio_tpu.server.stats import Stats
+from incubator_predictionio_tpu.server.storage_server import (
+    StorageServer,
+    StorageServerConfig,
+    ThreadedStorageServer,
+)
+
+UTC = dt.timezone.utc
+
+
+@pytest.fixture(autouse=True)
+def clean_traces():
+    trace.TRACES.clear()
+    yield
+    trace.TRACES.clear()
+
+
+# ---------------------------------------------------------------------------
+# metrics registry units
+# ---------------------------------------------------------------------------
+
+def test_histogram_quantiles_exact_on_known_samples():
+    reg = MetricsRegistry()
+    h = reg.histogram("t_lat_seconds", "t", buckets=(0.1, 1.0, 10.0))
+    samples = [float(i) for i in range(1, 101)]  # 1..100
+    for v in samples:
+        h.observe(v)
+    s = sorted(samples)
+    got = h.percentiles((0.5, 0.95, 0.99))
+    # exact nearest-rank values from the raw ring, not bucket estimates
+    for q in (0.5, 0.95, 0.99):
+        assert got[f"p{int(q * 100)}"] == s[int(round(q * (len(s) - 1)))]
+    # and the Prometheus side stays cumulative-bucket-consistent
+    counts, total, count = h._default().snapshot()
+    assert count == 100 and total == sum(samples)
+    assert sum(counts) == 100
+
+
+def test_registry_exposition_parses_and_is_consistent():
+    reg = MetricsRegistry()
+    c = reg.counter("t_reqs_total", "requests", labels=("route", "status"))
+    c.labels(route="/a", status="200").inc(3)
+    c.labels(route='/b"x\\y', status="500").inc()  # escaping stress
+    g = reg.gauge("t_depth", "depth")
+    g.set(7)
+    h = reg.histogram("t_lat_seconds", "lat", buckets=(0.01, 0.1))
+    for v in (0.005, 0.05, 5.0):
+        h.observe(v)
+    fams = parse_prometheus_text(reg.expose())
+    assert fams["t_reqs_total"]["type"] == "counter"
+    vals = {tuple(sorted(l.items())): v
+            for _, l, v in fams["t_reqs_total"]["samples"]}
+    assert vals[(("route", "/a"), ("status", "200"))] == 3
+    assert vals[(("route", '/b"x\\y'), ("status", "500"))] == 1
+    assert fams["t_depth"]["samples"][0][2] == 7
+    hist = fams["t_lat_seconds"]
+    buckets = [(l["le"], v) for n, l, v in hist["samples"]
+               if n.endswith("_bucket")]
+    count = next(v for n, _, v in hist["samples"] if n.endswith("_count"))
+    # cumulative and capped by +Inf == _count
+    assert [v for _, v in buckets] == sorted(v for _, v in buckets)
+    assert buckets[-1][0] == "+Inf" and buckets[-1][1] == count == 3
+
+
+def test_parser_rejects_malformed_text():
+    with pytest.raises(MetricError):
+        parse_prometheus_text("what even is this{ 3\n")
+    with pytest.raises(MetricError):
+        parse_prometheus_text("ok_metric not-a-number\n")
+
+
+def test_bucket_quantile_estimation():
+    # 100 observations uniform in the (0, 1] bucket → ~p50 at 0.5
+    qs = bucket_quantiles([(1.0, 100.0), (math.inf, 100.0)], (0.5,))
+    assert qs["p50"] == pytest.approx(0.5)
+
+
+def test_kind_mismatch_rejected():
+    reg = MetricsRegistry()
+    reg.counter("t_x_total", "x")
+    with pytest.raises(MetricError):
+        reg.gauge("t_x_total", "x")
+
+
+# ---------------------------------------------------------------------------
+# satellite: Stats roll gap + jitstats window
+# ---------------------------------------------------------------------------
+
+def test_stats_promotes_adjacent_hour_but_clears_after_gap():
+    t0 = dt.datetime(2024, 1, 1, 10, 30, tzinfo=UTC)
+    now = [t0]
+    s = Stats(clock=lambda: now[0])
+    s.update(1, 201, "rate", "user")
+    # adjacent hour: current promotes to previousHour
+    now[0] = t0 + dt.timedelta(hours=1)
+    assert s.get(1)["previousHour"]["status"] == {"201": 1}
+    # the roll-bug scenario: quiet for >= 2 hours — the stale counts must
+    # NOT reappear as "previousHour"
+    s.update(1, 201, "rate", "user")
+    now[0] = t0 + dt.timedelta(hours=4)
+    got = s.get(1)
+    assert got["previousHour"]["status"] == {}
+    assert got["currentHour"]["status"] == {}
+    # and current_totals (the /metrics fold) rolled too
+    assert s.current_totals() == {}
+
+
+def test_jitstats_first_seen_window():
+    from incubator_predictionio_tpu.utils import jitstats
+
+    jitstats.reset()
+    try:
+        assert jitstats.record(("k", 1), now=100.0)
+        assert not jitstats.record(("k", 1), now=150.0)  # dup: keeps 100.0
+        assert jitstats.record(("k", 2), now=160.0)
+        assert jitstats.count() == 2
+        assert jitstats.recent_count(30.0, now=170.0) == 1  # only k2
+        assert jitstats.recent_count(120.0, now=170.0) == 2
+        assert jitstats.recent_count(5.0, now=500.0) == 0  # flat: healthy
+    finally:
+        jitstats.reset()
+
+
+def test_parse_header_rejects_non_ascii_and_malformed():
+    got = trace.parse_header("cafe1234:beef5678")
+    assert got.trace_id == "cafe1234" and got.span_id == "beef5678"
+    assert trace.parse_header("cafe1234").span_id == "cafe1234"
+    # isalnum()-but-not-ASCII ids would blow up http.client header encoding
+    # when re-injected outbound — must be dropped, not adopted
+    assert trace.parse_header("Ⅷ") is None
+    assert trace.parse_header("bad id:x") is None
+    assert trace.parse_header("ok1234:Ⅷ") is None
+    assert trace.parse_header("") is None
+    assert trace.parse_header("a" * 65) is None
+
+
+def test_middleware_stamps_trace_and_counts_unhandled_500():
+    from aiohttp import web
+
+    from incubator_predictionio_tpu.obs.http import telemetry_middleware
+
+    async def boom(request):
+        raise RuntimeError("engine exploded")
+
+    app = web.Application(middlewares=[telemetry_middleware("t500")])
+    app.router.add_get("/boom", boom)
+
+    async def t():
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            resp = await client.get("/boom")
+            assert resp.status == 500
+            assert resp.headers.get("X-PIO-Trace")  # even the failure is
+            body = await resp.json()                # correlatable
+            assert body["traceId"] == resp.headers["X-PIO-Trace"]
+        finally:
+            await client.close()
+
+    asyncio.run(t())
+    fams = parse_prometheus_text(REGISTRY.expose())
+    counted = [v for _, l, v in fams["pio_http_requests_total"]["samples"]
+               if l.get("service") == "t500" and l.get("status") == "500"]
+    assert counted and counted[0] >= 1
+
+
+def test_traces_json_rejects_negative_limit():
+    from aiohttp import web
+
+    from incubator_predictionio_tpu.obs.http import add_observability_routes
+
+    app = web.Application()
+    add_observability_routes(app)
+
+    async def t():
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            assert (await client.get("/traces.json?limit=-1")).status == 400
+            assert (await client.get("/traces.json?limit=nope")).status == 400
+            assert (await client.get("/traces.json?limit=2")).status == 200
+        finally:
+            await client.close()
+
+    asyncio.run(t())
+
+
+# ---------------------------------------------------------------------------
+# trace spans per resilience attempt (retries + half-open probes)
+# ---------------------------------------------------------------------------
+
+def test_trace_spans_one_per_retry_attempt():
+    clk = FakeClock()
+    policy = ResiliencePolicy(RetryPolicy(max_attempts=3, seed=7), clock=clk)
+    outcomes = [TransientError("t1"), TransientError("t2"), "ok"]
+
+    def fn(_deadline):
+        out = outcomes.pop(0)
+        if isinstance(out, Exception):
+            raise out
+        return out
+
+    with trace.span("unit-root") as root:
+        assert policy.call(fn, idempotent=True, op="obs-unit-op") == "ok"
+    spans = trace.TRACES.spans(root.trace_id)
+    attempts = [s for s in spans if s["attrs"].get("kind") == "attempt"]
+    assert [s["attrs"]["attempt"] for s in attempts] == [1, 2, 3]
+    assert [s["status"] for s in attempts] == [
+        "error:TransientError", "error:TransientError", "ok"]
+    # all retries under the caller's single trace, backoff on FakeClock only
+    assert all(s["traceId"] == root.trace_id for s in attempts)
+    assert len(clk.slept) == 2
+
+
+def test_trace_spans_survive_breaker_half_open_probe():
+    clk = FakeClock()
+    brk = CircuitBreaker("obs-halfopen", failure_threshold=2,
+                         reset_timeout=30.0, clock=clk)
+    policy = ResiliencePolicy(RetryPolicy(max_attempts=1, seed=7),
+                              breaker=brk, clock=clk)
+
+    def fail(_deadline):
+        raise TransientError("down")
+
+    with trace.span("probe-root") as root:
+        for _ in range(2):
+            with pytest.raises(TransientError):
+                policy.call(fail, idempotent=True, op="obs-probe-op")
+        assert brk.state == "open"
+        with pytest.raises(CircuitOpenError):
+            policy.call(lambda d: "ok", idempotent=True, op="obs-probe-op")
+        clk.advance(30.0)  # reset window elapses on the injected clock
+        assert policy.call(lambda d: "ok", idempotent=True,
+                           op="obs-probe-op") == "ok"
+    assert brk.state == "closed"
+    attempts = [s for s in trace.TRACES.spans(root.trace_id)
+                if s["attrs"].get("kind") == "attempt"]
+    # 2 failures + the half-open probe; the breaker-rejected call never
+    # produced an attempt span (it never reached the backend)
+    assert len(attempts) == 3
+    assert attempts[-1]["status"] == "ok"
+    assert clk.slept == []  # max_attempts=1: no backoff at all
+
+
+# ---------------------------------------------------------------------------
+# servers: stub query-server plumbing (pattern from test_resilience)
+# ---------------------------------------------------------------------------
+
+class _StubServing:
+    def supplement(self, q):
+        return q
+
+    def serve(self, q, predictions):
+        return predictions[0]
+
+
+class _OkAlgo:
+    def query_class(self):
+        return None
+
+    def predict(self, model, query):
+        return {"label": 1}
+
+    def batch_predict(self, model, pairs):
+        return [(i, self.predict(model, q)) for i, q in pairs]
+
+
+class _RemoteReadingAlgo(_OkAlgo):
+    """Algorithm that reads from remote storage at serving time (the
+    ecommerce/sequential pattern) — the cross-process trace scenario."""
+
+    def __init__(self, event_store, event_id):
+        self._ev = event_store
+        self._eid = event_id
+
+    def predict(self, model, query):
+        got = self._ev.get(self._eid, 1)
+        return {"found": got is not None}
+
+
+class _StubEngine:
+    def __init__(self, algo):
+        self._algo = algo
+
+    def serving_and_algorithms(self, engine_params):
+        return [self._algo], _StubServing()
+
+
+def _mk_instance():
+    return EngineInstance(
+        id="inst-obs", status="COMPLETED",
+        start_time=dt.datetime(2024, 1, 1, tzinfo=UTC), end_time=None,
+        engine_id="stub", engine_version="1", engine_variant="v",
+        engine_factory="stub.Engine")
+
+
+def _mk_query_server(algo, **cfg_kw):
+    storage = Storage({"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+    config = ServerConfig(**cfg_kw)
+    deployed = DeployedEngine(
+        _StubEngine(algo), EngineParams(), _mk_instance(), [None],
+        warmup=False)
+    return QueryServer(config, storage=storage, deployed=deployed), storage
+
+
+# ---------------------------------------------------------------------------
+# /metrics + middleware on all three servers
+# ---------------------------------------------------------------------------
+
+def test_all_routes_wrapped_by_telemetry_middleware():
+    """Tier-1 meta-test: every registered route on all three servers sits
+    behind the app-wide telemetry middleware, and the observability routes
+    are mounted — a future endpoint cannot ship uninstrumented."""
+    storage = Storage({"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+    qs, qs_storage = _mk_query_server(_OkAlgo())
+    apps = {
+        "event_server": EventServer(EventServerConfig(), storage).make_app(),
+        "storage_server": StorageServer(
+            StorageServerConfig(), storage).make_app(),
+        "query_server": qs.make_app(),
+    }
+    try:
+        for service, app in apps.items():
+            marks = [getattr(m, "__pio_telemetry__", None)
+                     for m in app.middlewares]
+            assert service in marks, \
+                f"{service}: telemetry middleware missing from {marks}"
+            routes = {r.resource.canonical
+                      for r in app.router.routes() if r.resource is not None}
+            assert "/metrics" in routes, f"{service}: /metrics not mounted"
+            assert "/traces.json" in routes, f"{service}: no /traces.json"
+            assert len(routes) >= 3  # the real API is mounted too
+    finally:
+        storage.close()
+        qs_storage.close()
+
+
+def test_metrics_endpoint_on_all_three_servers():
+    """Acceptance: GET /metrics on event, query, and storage servers emits
+    valid Prometheus text including per-route latency histograms, breaker
+    states, retry counters, and the jit-compile gauge — and every response
+    carries X-PIO-Trace."""
+    storage = Storage({"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+    qs, qs_storage = _mk_query_server(_OkAlgo())
+    servers = {
+        "event_server": EventServer(EventServerConfig(), storage),
+        "storage_server": StorageServer(StorageServerConfig(), storage),
+        "query_server": qs,
+    }
+
+    async def drive(service, app) -> None:
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            root = await client.get("/")
+            assert root.headers.get("X-PIO-Trace"), service
+            resp = await client.get("/metrics")
+            assert resp.status == 200
+            text = await resp.text()
+            fams = parse_prometheus_text(text)  # raises on malformed output
+            for family in ("pio_http_requests_total",
+                           "pio_http_request_seconds",
+                           "pio_breaker_state",
+                           "pio_breaker_transitions_total",
+                           "pio_resilience_retries_total",
+                           "pio_deadline_expired_total",
+                           "pio_jit_compile_keys",
+                           "pio_spill_queue_depth"):
+                assert family in fams, f"{service}: {family} missing"
+            # the GET / we just made is in the per-route histogram
+            lat = [s for s in fams["pio_http_request_seconds"]["samples"]
+                   if s[0].endswith("_count") and s[1]["service"] == service
+                   and s[1]["route"] == "/"]
+            assert lat and lat[0][2] >= 1, f"{service}: no route latency"
+            # trace flight recorder serves JSON
+            tr = await client.get("/traces.json")
+            assert tr.status == 200 and "traces" in await tr.json()
+        finally:
+            await client.close()
+
+    try:
+        for service, server in servers.items():
+            asyncio.run(drive(service, server.make_app()))
+        # query server folds its standalone breakers in at scrape time
+        text = REGISTRY.expose()
+        fams = parse_prometheus_text(text)
+        breakers = {s[1]["breaker"]
+                    for s in fams["pio_breaker_state"]["samples"]}
+        assert "serving" in breakers and "eventstore" in breakers
+        assert any(b.startswith("algorithm:") for b in breakers)
+    finally:
+        storage.close()
+        qs_storage.close()
+
+
+def test_server_timing_header_on_predictions():
+    qs, storage = _mk_query_server(_OkAlgo())
+
+    async def t():
+        client = TestClient(TestServer(qs.make_app()))
+        await client.start_server()
+        try:
+            resp = await client.post("/queries.json", json={"q": 1})
+            assert resp.status == 200
+            timing = resp.headers.get("X-PIO-Server-Timing", "")
+            parts = [p.strip() for p in timing.split(",")]
+            assert parts[0].startswith("total;us=")
+            assert int(parts[0].split("=")[1]) >= 0
+            assert parts[1].startswith("algo0._OkAlgo;us=")
+            # non-predict outcomes carry no timing header
+            bad = await client.post("/queries.json", data=b"not json")
+            assert bad.status == 400
+            assert "X-PIO-Server-Timing" not in bad.headers
+        finally:
+            await client.close()
+            await qs.batcher.stop()
+
+    asyncio.run(t())
+    storage.close()
+
+
+# ---------------------------------------------------------------------------
+# acceptance: one trace across query server → (faulted) remote storage
+# ---------------------------------------------------------------------------
+
+def test_single_trace_spans_query_and_storage_processes_through_faults():
+    """Drive a query-server request whose algorithm reads remote storage;
+    the storage transport times out twice (scripted, FakeClock) then
+    recovers. ONE trace id must span: the query-server route span, one span
+    per storage attempt (2 faulted + 1 ok), and the storage-server route
+    span recorded by the other server's middleware — zero wall sleeps."""
+    backing = Storage({"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+    remote_server = ThreadedStorageServer(
+        backing, StorageServerConfig(ip="127.0.0.1", port=0))
+    client_storage = RemoteStorageClient({"URL": remote_server.url})
+    ev = client_storage.events()
+    ev.init(1)
+    from incubator_predictionio_tpu.data import DataMap, Event
+
+    eid = ev.insert(
+        Event(event="rate", entity_type="user", entity_id="u0",
+              properties=DataMap({"rating": 1.0}),
+              event_time=dt.datetime(2023, 1, 1, tzinfo=UTC)), 1)
+
+    # scripted transport: two timeouts on the get RPC, then recovery —
+    # retries back off on the FakeClock only
+    clk = FakeClock()
+    inj = FaultInjector(FaultSchedule(
+        [Timeout(), Timeout()], methods=("/rpc/events/get",)), clock=clk)
+    tp = client_storage._tp
+    tp.policy = ResiliencePolicy(
+        RetryPolicy(max_attempts=3, seed=42),
+        breaker=CircuitBreaker("remote-obs", failure_threshold=5, clock=clk),
+        clock=clk)
+    tp.fault_hook = inj
+
+    qs, qs_storage = _mk_query_server(_RemoteReadingAlgo(ev, eid))
+
+    async def t() -> str:
+        client = TestClient(TestServer(qs.make_app()))
+        await client.start_server()
+        try:
+            resp = await client.post("/queries.json", json={"user": "u0"})
+            assert resp.status == 200
+            assert (await resp.json())["found"] is True
+            return resp.headers["X-PIO-Trace"]
+        finally:
+            await client.close()
+            await qs.batcher.stop()
+
+    try:
+        trace_id = asyncio.run(t())
+        spans = trace.TRACES.spans(trace_id)
+        # query-server process: the route span...
+        assert any(s["service"] == "query_server"
+                   and s["name"] == "POST /queries.json" for s in spans)
+        # ...and one span per storage attempt under the SAME trace
+        attempts = [s for s in spans if s["attrs"].get("kind") == "attempt"
+                    and s["name"] == "/rpc/events/get"]
+        assert [s["attrs"]["attempt"] for s in attempts] == [1, 2, 3]
+        assert [s["status"] for s in attempts] == [
+            "error:TransientError", "error:TransientError", "ok"]
+        # storage-server process: its middleware adopted the propagated
+        # header — same trace id in the OTHER span log
+        assert any(s["service"] == "storage_server"
+                   and s["name"] == "POST /rpc/{store}/{method}"
+                   for s in spans)
+        # both faulted attempts backed off on the fake clock; nothing slept
+        # on the wall
+        assert len(clk.slept) == 2
+        assert len(inj.calls) == 3
+    finally:
+        remote_server.close()
+        backing.close()
+        qs_storage.close()
+
+
+def test_retry_and_deadline_metrics_recorded():
+    """The resilience layer's log lines are now real counters."""
+    clk = FakeClock()
+    policy = ResiliencePolicy(RetryPolicy(max_attempts=2, seed=1), clock=clk)
+    outcomes = [TransientError("x"), "ok"]
+
+    def fn(_d):
+        out = outcomes.pop(0)
+        if isinstance(out, Exception):
+            raise out
+        return out
+
+    assert policy.call(fn, idempotent=True, op="obs-metrics-op") == "ok"
+    fams = parse_prometheus_text(REGISTRY.expose())
+
+    def val(family):
+        return {tuple(sorted(l.items())): v
+                for _, l, v in fams[family]["samples"]}
+
+    assert val("pio_resilience_attempts_total")[
+        (("op", "obs-metrics-op"),)] == 2
+    assert val("pio_resilience_retries_total")[
+        (("op", "obs-metrics-op"),)] == 1

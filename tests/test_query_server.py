@@ -172,8 +172,11 @@ def test_batcher_stop_fails_queued_requests(deployed_env):
         # failed rather than left to hang until aiohttp force-cancels
         loop = asyncio.get_running_loop()
         fut = loop.create_future()
+        import contextvars
+
         await server.batcher.queue.put(
-                ({"features": [0.0, 0.0, 0.0]}, fut, 0.0))
+                ({"features": [0.0, 0.0, 0.0]}, fut, 0.0,
+                 contextvars.copy_context()))
         await server.shutdown()
         assert isinstance(fut.result(), RuntimeError)
 
