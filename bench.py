@@ -348,43 +348,162 @@ def bench_classification(ctx, peaks) -> dict:
 # ---------------------------------------------------------------------------
 
 def bench_ecommerce_retrieval(ctx, peaks, device) -> dict:
-    """Batched top-k over the full catalog with an exclusion mask — the
-    ECommAlgorithm predict path at scale. On TPU this also asserts the Pallas
-    int8 kernel against the jnp oracle (kernel/oracle parity in the artifact,
-    not just in skipped-on-CPU tests)."""
-    from incubator_predictionio_tpu.models.two_tower import TwoTowerConfig, TwoTowerModel, TwoTowerMF
+    """Rule-filtered template serving at scale: the ECommAlgorithm predict
+    path with live business rules (categories, white/black lists, the
+    unavailable-items constraint read, unseen-only history) — serial
+    per-query with reference read-per-query semantics (TTL=0) vs the
+    vectorized ``batch_predict`` (mask compilation + cached/batched store
+    reads + axis-wise top-k). Both paths are parity-checked query-for-query
+    before timing; store-read counts and the coalesced batch-size
+    distribution are recorded so the speedup is attributable. On TPU this
+    also asserts the Pallas int8 kernel (plain + row-masked) against the
+    jnp oracle."""
+    import datetime as _dt
 
-    n_users, n_items, rank = 10_000, (20_000 if SMALL else 1_000_000), 64
-    rng = np.random.default_rng(3)
-    model = TwoTowerModel(
-        user_emb=rng.standard_normal((n_users, rank)).astype(np.float32),
-        item_emb=rng.standard_normal((n_items, rank)).astype(np.float32),
-        user_bias=np.zeros(n_users, np.float32),
-        item_bias=np.zeros(n_items, np.float32),
-        mean=3.0, config=TwoTowerConfig(rank=rank),
+    from incubator_predictionio_tpu.data import DataMap, Event
+    from incubator_predictionio_tpu.data.bimap import BiMap
+    from incubator_predictionio_tpu.data.storage import App, Storage, use_storage
+    from incubator_predictionio_tpu.models.two_tower import (
+        TwoTowerConfig,
+        TwoTowerModel,
     )
+    from incubator_predictionio_tpu.serving import TTLCache
+    from incubator_predictionio_tpu.templates.ecommerce import (
+        ECommAlgorithm,
+        ECommAlgorithmParams,
+        ECommModel,
+        Query,
+    )
+
+    # SMALL trims the catalog and query volume to keep wall time down, but
+    # keeps a production-depth view history — the serial lane's cost IS the
+    # per-query store reads, so shallow histories would understate the gap
+    n_users, n_items, rank = (200, 1_500, 32) if SMALL else (500, 4_000, 32)
+    views_per_user = 80 if SMALL else 40
+    rng = np.random.default_rng(3)
+    utc = _dt.timezone.utc
+    t0_ev = _dt.datetime(2020, 1, 1, tzinfo=utc)
+    storage = Storage({"PIO_STORAGE_SOURCES_BENCHMEM_TYPE": "memory"})
+    app_id = storage.get_meta_data_apps().insert(App(0, "bench-ecomm"))
+    events = storage.get_events()
+    events.init(app_id)
+    cats = {f"i{i}": (f"c{i % 8}", f"g{i % 3}") for i in range(n_items)}
+    for i in range(n_items):
+        events.insert(Event(
+            event="$set", entity_type="item", entity_id=f"i{i}",
+            properties=DataMap({"categories": list(cats[f"i{i}"])}),
+            event_time=t0_ev), app_id)
+    for u in range(n_users):
+        for i in map(int, rng.integers(0, n_items, views_per_user)):
+            events.insert(Event(
+                event="view", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item", target_entity_id=f"i{i}",
+                event_time=t0_ev), app_id)
+    events.insert(Event(
+        event="$set", entity_type="constraint", entity_id="unavailableItems",
+        properties=DataMap({"items": [f"i{i}" for i in range(0, 40)]}),
+        event_time=t0_ev), app_id)
+    norm = rng.standard_normal((n_items, rank)).astype(np.float32)
+    norm /= np.linalg.norm(norm, axis=1, keepdims=True) + 1e-9
+    model = ECommModel(
+        mf=TwoTowerModel(
+            user_emb=rng.standard_normal((n_users, rank)).astype(np.float32),
+            item_emb=rng.standard_normal((n_items, rank)).astype(np.float32),
+            user_bias=np.zeros(n_users, np.float32),
+            item_bias=np.zeros(n_items, np.float32),
+            mean=3.0, config=TwoTowerConfig(rank=rank)),
+        user_map=BiMap.string_int(f"u{u}" for u in range(n_users)),
+        item_map=BiMap.string_int(f"i{i}" for i in range(n_items)),
+        categories=cats,
+        popularity=rng.integers(0, 100, n_items).astype(np.float32),
+        item_vecs_norm=norm,
+    ).prepare_for_serving()
     parity = None
     if device.platform == "tpu":
-        parity = _pallas_parity_check(model)
-        model._device_items_q = None
-    # host_max_elements=0: this bench measures DEVICE catalog scoring by
-    # design (SMALL's 20k-item catalog would otherwise take the host path)
-    model.prepare_for_serving(quantize=device.platform == "tpu",
-                              host_max_elements=0)
-    batch, iters = 256, 20
-    exclude = rng.integers(0, n_items, 50).astype(np.int64)
-    uidx = rng.integers(0, n_users, batch).astype(np.int32)
+        parity = _pallas_parity_check(model.mf)
+    # the query mix: all four filter kinds + unknown users, like live traffic
+    def make_query(j: int) -> Query:
+        u = f"u{int(rng.integers(0, n_users))}" if j % 16 else "coldstart"
+        kind = j % 4
+        if kind == 0:
+            return Query(user=u, num=10)
+        if kind == 1:
+            return Query(user=u, num=10, categories=(f"c{j % 8}",))
+        if kind == 2:
+            return Query(user=u, num=10,
+                         black_list=tuple(f"i{i}" for i in range(j % 7)))
+        return Query(user=u, num=10, categories=(f"g{j % 3}",),
+                     white_list=tuple(f"i{i}" for i in range(100, 1100)))
 
-    TwoTowerMF.recommend_batch(model, uidx, 10, exclude)  # warmup
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        TwoTowerMF.recommend_batch(model, uidx, 10, exclude)
-    dt = time.perf_counter() - t0
-    qps = batch * iters / dt
-    flops = 2 * rank * n_items * batch * iters  # the scoring matmul
+    # throughput-oriented coalesce depth: the store-read + scan cost is per
+    # BATCH, so deeper batches amortize further (the server's max_batch knob;
+    # the recorded batch_size_distribution keeps the artifact honest). The
+    # query count is deliberately NOT a batch multiple — the tail batch is
+    # the partial coalesce a draining queue produces
+    batch = 128
+    n_serial = 128 if SMALL else 256
+    n_batched = 2016 if SMALL else 4064
+    queries = [make_query(j) for j in range(max(n_serial, n_batched))]
+    from tests.fixtures.counting_events import CountingEvents
+
+    counting = CountingEvents(events)
+    storage.get_events = lambda: counting
+    prev = use_storage(storage)
+    try:
+        serial_algo = ECommAlgorithm(
+            ECommAlgorithmParams(app_name="bench-ecomm"))
+        serial_algo._constraint_cache = TTLCache(0)  # reference semantics
+        batch_algo = ECommAlgorithm(
+            ECommAlgorithmParams(app_name="bench-ecomm"))
+        # parity first: the serial path is the oracle
+        want = [serial_algo.predict(model, q) for q in queries[:batch]]
+        got = dict(batch_algo.batch_predict(
+            model, list(enumerate(queries[:batch]))))
+        parity_ok = all(
+            [(s.item, s.score) for s in want[i].item_scores]
+            == [(s.item, s.score) for s in got[i].item_scores]
+            for i in range(batch))
+        if not parity_ok:
+            # the headline number is only meaningful for a path that
+            # answers identically — fail the config, don't publish a
+            # speedup for divergent results
+            raise RuntimeError(
+                "batched-vs-serial parity failure in ecommerce_retrieval")
+        # serial timing (reference read-per-query semantics)
+        reads0 = counting.total_reads
+        t0 = time.perf_counter()
+        for q in queries[:n_serial]:
+            serial_algo.predict(model, q)
+        dt_serial = time.perf_counter() - t0
+        serial_reads = (counting.total_reads - reads0) / n_serial
+        serial_qps = n_serial / dt_serial
+        # batched timing through coalesced micro-batches
+        batch_sizes: dict[str, int] = {}
+        reads0 = counting.total_reads
+        t0 = time.perf_counter()
+        for off in range(0, n_batched, batch):
+            chunk = queries[off:off + batch]
+            batch_algo.batch_predict(model, list(enumerate(chunk)))
+            batch_sizes[str(len(chunk))] = batch_sizes.get(str(len(chunk)), 0) + 1
+        dt_batched = time.perf_counter() - t0
+        n_dispatched = sum(int(k) * v for k, v in batch_sizes.items())
+        batched_reads = (counting.total_reads - reads0) / max(1, sum(batch_sizes.values()))
+        batched_qps = n_dispatched / dt_batched
+    finally:
+        use_storage(prev)
+        storage.close()
+    flops = 2 * rank * n_items * n_dispatched  # the scoring matmuls
     out = {
-        "queries_per_sec": round(qps, 1),
-        "mfu": _mfu(flops, dt, peaks[0]),
+        "queries_per_sec": round(batched_qps, 1),
+        "serial_queries_per_sec": round(serial_qps, 1),
+        "speedup_vs_serial": round(batched_qps / serial_qps, 1),
+        "batched_parity": parity_ok,
+        "batch_size_distribution": batch_sizes,
+        "store_reads": {
+            "serial_per_query": round(serial_reads, 2),
+            "batched_per_batch": round(batched_reads, 2),
+        },
+        "mfu": _mfu(flops, dt_batched, peaks[0]),
     }
     if parity is not None:
         out["pallas_kernel_parity"] = parity
@@ -392,7 +511,8 @@ def bench_ecommerce_retrieval(ctx, peaks, device) -> dict:
 
 
 def _pallas_parity_check(model) -> bool:
-    """Quantized Pallas scorer vs the jnp oracle on identical inputs."""
+    """Quantized Pallas scorer (plain + per-row rule mask) vs the jnp
+    oracle on identical inputs."""
     import jax.numpy as jnp
 
     from incubator_predictionio_tpu.ops.retrieval import (
@@ -408,13 +528,24 @@ def _pallas_parity_check(model) -> bool:
         items_q, scales,
         np.asarray(model.item_bias[:n], np.float32),
         np.zeros(n, np.float32))
-    ue = jnp.asarray(np.asarray(model.user_emb)[:64], jnp.float32)
-    got = np.asarray(score_catalog_quantized(ue, items_q, scales, bias, mask))
-    want = np.asarray(score_catalog_reference(ue, items_q, scales, bias, mask))
-    ok = bool(np.allclose(got, want, rtol=2e-2, atol=2e-2))
-    if not ok:
-        _log(f"PALLAS PARITY FAILURE: max abs diff "
-             f"{np.max(np.abs(got - want)):.4f}")
+    b = min(64, model.user_emb.shape[0])
+    ue = jnp.asarray(np.asarray(model.user_emb)[:b], jnp.float32)
+    rng = np.random.default_rng(0)
+    row_mask = np.zeros((b, items_q.shape[0]), np.float32)
+    row_mask[np.arange(b), rng.integers(0, n, b)] = -np.inf
+    row_mask = jnp.asarray(row_mask)
+    ok = True
+    for rm in (None, row_mask):
+        got = np.asarray(score_catalog_quantized(
+            ue, items_q, scales, bias, mask, rm))
+        want = np.asarray(score_catalog_reference(
+            ue, items_q, scales, bias, mask, rm))
+        good = bool(np.allclose(got, want, rtol=2e-2, atol=2e-2,
+                                equal_nan=True))
+        if not good:
+            _log(f"PALLAS PARITY FAILURE (row_mask={rm is not None}): "
+                 f"max abs diff {np.max(np.abs(got - want)):.4f}")
+        ok = ok and good
     return ok
 
 

@@ -22,7 +22,7 @@ V = TypeVar("V")
 class BiMap(Generic[K, V]):
     """Immutable bidirectional map (reference BiMap.scala:28)."""
 
-    __slots__ = ("_fwd", "_rev")
+    __slots__ = ("_fwd", "_rev", "_inv")
 
     def __init__(self, forward: Mapping[K, V]):
         fwd = dict(forward)
@@ -31,6 +31,7 @@ class BiMap(Generic[K, V]):
             raise ValueError("BiMap values must be unique")
         self._fwd = fwd
         self._rev = rev
+        self._inv = None
 
     # -- forward access ---------------------------------------------------
     def __getitem__(self, key: K) -> V:
@@ -62,10 +63,31 @@ class BiMap(Generic[K, V]):
 
     # -- inverse (BiMap.scala:44) ----------------------------------------
     def inverse(self) -> "BiMap[V, K]":
-        inv = BiMap.__new__(BiMap)
-        inv._fwd = self._rev
-        inv._rev = self._fwd
-        return inv
+        """The reversed view, memoized on the instance — every predict path
+        asks for it per query, and the map is immutable, so one wrapper pair
+        serves the process lifetime (the two views share the same dicts and
+        point at each other)."""
+        if self._inv is None:
+            inv = BiMap.__new__(BiMap)
+            inv._fwd = self._rev
+            inv._rev = self._fwd
+            inv._inv = self
+            self._inv = inv
+        return self._inv
+
+    # -- pickling (MODELDATA blobs) ---------------------------------------
+    # the memoized inverse never serializes (it is derived, and pickling it
+    # would drag a second wrapper into every model blob); blobs written
+    # before the memo slot existed restore cleanly too
+    def __getstate__(self):
+        return {"_fwd": self._fwd, "_rev": self._rev}
+
+    def __setstate__(self, state) -> None:
+        if isinstance(state, tuple):  # (None, slots_dict) pre-memo format
+            state = state[1]
+        self._fwd = state["_fwd"]
+        self._rev = state["_rev"]
+        self._inv = None
 
     def __eq__(self, other: object) -> bool:
         return isinstance(other, BiMap) and self._fwd == other._fwd

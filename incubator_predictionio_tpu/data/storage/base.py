@@ -110,6 +110,65 @@ class EventStore(abc.ABC):
         or a string (must equal).
         """
 
+    def find_by_entities(
+        self,
+        app_id: int,
+        entity_type: str,
+        entity_ids: Sequence[str],
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Any = UNSET,
+        target_entity_id: Any = UNSET,
+        limit_per_entity: Optional[int] = None,
+        reversed: bool = False,
+    ) -> dict[str, list[Event]]:
+        """Batched per-entity read: one storage round trip for many entities.
+
+        The serving-time counterpart of :meth:`find` for coalesced query
+        batches (a micro-batch of B users' histories is ONE call, not B).
+        Returns ``{entity_id: [events]}`` with every requested id present
+        (missing/eventless ids map to ``[]``); each entity's list is ordered
+        and truncated exactly as ``find(entity_id=..., limit=limit_per_entity,
+        reversed=reversed)`` would order it, so per-entity semantics are
+        unchanged — only the round-trip count differs.
+
+        The default loops :meth:`find` per entity (contract-correct for any
+        backend); backends with a cheaper bulk path (single scan, SQL ``IN``)
+        should override.
+        """
+        return {
+            eid: list(self.find(
+                app_id, channel_id, start_time, until_time, entity_type,
+                eid, event_names, target_entity_type, target_entity_id,
+                limit_per_entity, reversed=reversed,
+            ))
+            for eid in dict.fromkeys(entity_ids)
+        }
+
+    @staticmethod
+    def group_events_by_entity(
+        events: Iterable[Event],
+        entity_ids: Sequence[str],
+        limit_per_entity: Optional[int],
+    ) -> dict[str, list[Event]]:
+        """Shared grouping/cap loop for :meth:`find_by_entities` overrides:
+        bucket an (already ordered) event stream per entity, keeping at most
+        ``limit_per_entity`` each. ONE implementation so every backend's
+        per-entity cap semantics stay identical (events for entities outside
+        ``entity_ids`` are dropped; every requested id is present)."""
+        out: dict[str, list[Event]] = {eid: [] for eid in entity_ids}
+        limit = (limit_per_entity if limit_per_entity is not None
+                 and limit_per_entity >= 0 else None)
+        for e in events:
+            bucket = out.get(e.entity_id)
+            if bucket is None:
+                continue
+            if limit is None or len(bucket) < limit:
+                bucket.append(e)
+        return out
+
     def find_sharded(
         self,
         app_id: int,

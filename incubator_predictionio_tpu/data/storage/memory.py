@@ -91,14 +91,50 @@ class MemEvents(EventStore):
     ):
         with self._lock:
             events = list(self._table(app_id, channel_id).values())
-        events.sort(key=lambda e: e.event_time, reverse=reversed)
-        it = filter_events(
+        # filter BEFORE sorting: a serving-time read of one entity's handful
+        # of events must not pay an O(E log E) sort of the whole table.
+        # filter_events is a pure per-event predicate and sorting is stable,
+        # so filter→sort orders identically to sort→filter.
+        matched = list(filter_events(
             events, start_time, until_time, entity_type, entity_id,
             event_names, target_entity_type, target_entity_id,
-        )
+        ))
+        matched.sort(key=lambda e: e.event_time, reverse=reversed)
         if limit is not None and limit >= 0:
-            it = itertools.islice(it, limit)
-        return it
+            return iter(matched[:limit])
+        return iter(matched)
+
+    def find_by_entities(
+        self,
+        app_id: int,
+        entity_type: str,
+        entity_ids: Sequence[str],
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Any = UNSET,
+        target_entity_id: Any = UNSET,
+        limit_per_entity: Optional[int] = None,
+        reversed: bool = False,
+    ) -> dict[str, list[Event]]:
+        """One scan for the whole entity batch (the default would rescan the
+        table per entity). Same stable time ordering as :meth:`find`, so each
+        entity's list matches the per-entity read exactly."""
+        wanted = set(entity_ids)
+        with self._lock:
+            events = list(self._table(app_id, channel_id).values())
+        # filter first (see find): only the batch's matching events get sorted
+        matched = [
+            e for e in filter_events(
+                events, start_time, until_time, entity_type, None,
+                event_names, target_entity_type, target_entity_id,
+            )
+            if e.entity_id in wanted
+        ]
+        matched.sort(key=lambda e: e.event_time, reverse=reversed)
+        return self.group_events_by_entity(matched, list(entity_ids),
+                                           limit_per_entity)
 
 
 class MemApps(AppsStore):

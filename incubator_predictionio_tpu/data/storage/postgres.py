@@ -676,6 +676,64 @@ class PGEvents(EventStore):
                 f"event table for app {app_id} channel {channel_id} "
                 f"not initialized") from e
 
+    def find_by_entities(
+        self,
+        app_id: int,
+        entity_type: str,
+        entity_ids: Sequence[str],
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Any = UNSET,
+        target_entity_id: Any = UNSET,
+        limit_per_entity: Optional[int] = None,
+        reversed: bool = False,
+    ) -> dict[str, list[Event]]:
+        """One ``entity_id IN (...)`` keyset-paginated scan for the whole
+        batch (the per-entity default would pay B network round trips —
+        the batched-serving read path). Ordering is the same deterministic
+        ``(event_time, id)`` keyset as :meth:`find`, so per-entity results
+        match the per-entity read exactly. With a per-entity limit the cap
+        is pushed into SQL (ROW_NUMBER window, one bounded query ≤
+        ``len(ids) × limit`` rows); unlimited reads take the keyset-paginated
+        stream."""
+        ids = list(dict.fromkeys(entity_ids))
+        if not ids:
+            return {}
+        sql, params = self._find_sql(
+            app_id, channel_id, start_time, until_time, entity_type, None,
+            event_names, target_entity_type, target_entity_id)
+        placeholders = []
+        for eid in ids:
+            params.append(eid)
+            placeholders.append(f"${len(params)}")
+        sql += " AND entity_id IN (" + ",".join(placeholders) + ")"
+        limit = (limit_per_entity if limit_per_entity is not None
+                 and limit_per_entity >= 0 else None)
+        order = "DESC" if reversed else "ASC"
+        try:
+            if limit is not None:
+                prefix = f"SELECT {_EVENT_COLS} FROM "
+                inner = (
+                    f"SELECT {_EVENT_COLS}, ROW_NUMBER() OVER ("
+                    f"PARTITION BY entity_id "
+                    f"ORDER BY event_time {order}, id {order}) AS rn "
+                    f"FROM {sql[len(prefix):]}")
+                params.append(limit)
+                rows, _ = self._c.query(
+                    f"SELECT {_EVENT_COLS} FROM ({inner}) s "
+                    f"WHERE rn <= ${len(params)} "
+                    f"ORDER BY event_time {order}, id {order}", params)
+                events = (_row_to_event(r) for r in rows)
+            else:
+                events = self._stream_find(sql, params, reversed=reversed)
+            return self.group_events_by_entity(events, ids, limit_per_entity)
+        except UndefinedTable as e:
+            raise StorageError(
+                f"event table for app {app_id} channel {channel_id} "
+                f"not initialized") from e
+
     def _stream_find(
         self,
         base_sql: str,

@@ -74,6 +74,32 @@ class LEventStore(_BaseStore):
             reversed=latest,
         )
 
+    def find_by_entities(
+        self,
+        app_name: str,
+        entity_type: str,
+        entity_ids: Sequence[str],
+        channel_name: Optional[str] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Any = UNSET,
+        target_entity_id: Any = UNSET,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        limit_per_entity: Optional[int] = None,
+        latest: bool = True,
+    ) -> dict[str, list[Event]]:
+        """Batched :meth:`find_by_entity`: the histories of a coalesced
+        micro-batch's B users in ONE storage round trip. Per-entity ordering
+        and limits match ``find_by_entity`` exactly — see
+        :meth:`EventStore.find_by_entities
+        <incubator_predictionio_tpu.data.storage.base.EventStore.find_by_entities>`."""
+        app_id, channel_id = self._resolve(app_name, channel_name)
+        return self.storage.get_events().find_by_entities(
+            app_id, entity_type, entity_ids, channel_id, start_time,
+            until_time, event_names, target_entity_type, target_entity_id,
+            limit_per_entity, reversed=latest,
+        )
+
     def find(
         self,
         app_name: str,

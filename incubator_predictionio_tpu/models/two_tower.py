@@ -77,6 +77,15 @@ SERVE_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256)
 #: amortize the round trip over real MXU work and stay on device.
 HOST_SERVE_MAX_ELEMENTS = 2_000_000
 
+#: Per-row rule masks are DENSE [batch, n_items] f32 — the host build +
+#: device transfer scales with batch × catalog, so the row-mask path (and
+#: its deploy-time warmup) is limited to batches where that mask stays
+#: modest (≤ this many elements, 32 MB f32). Above it, callers fall back to
+#: shared-exclude / over-fetch semantics and warmup skips the row-mask
+#: executables (which are then never dispatched — the compile-count gauge
+#: stays flat either way).
+ROW_MASK_MAX_ELEMENTS = 8_000_000
+
 
 def serve_bucket(b: int) -> int:
     """Smallest bucket ≥ ``b`` (multiples of the top bucket past the ladder)."""
@@ -253,6 +262,17 @@ class TwoTowerModel:
             TwoTowerMF.recommend_batch(
                 self, np.zeros(b, np.int32), self._serve_k or 1
             )
+            # the rule-filtered variant ([b, n] row mask) is a distinct
+            # executable — warm it too so the first filtered live batch
+            # doesn't pay an XLA compile. Only under ROW_MASK_MAX_ELEMENTS:
+            # beyond it serving never dispatches the row-mask form (callers
+            # fall back to shared-exclude/over-fetch), and warming it would
+            # cost a batch×catalog host allocation + transfer per bucket
+            if b * self.n_items <= ROW_MASK_MAX_ELEMENTS:
+                TwoTowerMF.recommend_batch(
+                    self, np.zeros(b, np.int32), self._serve_k or 1,
+                    row_mask=np.zeros((b, self.n_items), np.float32),
+                )
             n += 1
         return n
 
@@ -524,6 +544,7 @@ class TwoTowerMF:
         user_idx: np.ndarray,
         num: int,
         exclude: Optional[np.ndarray] = None,
+        row_mask: Optional[np.ndarray] = None,
     ) -> tuple[np.ndarray, np.ndarray]:
         """Vectorized top-k over the full catalog for a batch of users.
 
@@ -532,15 +553,25 @@ class TwoTowerMF:
         static ``serve_k`` whenever ``num`` fits under it — so the whole
         query mix shares a handful of pre-warmed executables. The user-row
         gather happens ON DEVICE (indices in, [bucket, k] out) — no
-        full-table host round-trip per call."""
+        full-table host round-trip per call.
+
+        ``exclude`` masks one shared item-index set for the whole batch;
+        ``row_mask`` is the rule-filtered form — a ``[b, n_items]`` f32
+        additive mask (0 keep / -inf drop) giving EVERY query its own
+        filter set in the same single dispatch (ops/retrieval.py carries it
+        through the Pallas kernel on the quantized path)."""
         from incubator_predictionio_tpu.utils import jitstats
 
         num = min(num, model.n_items)  # k cannot exceed the catalog
         if (model._device_items is None and model._device_items_q is None
                 and model._host_items is None):
             model.prepare_for_serving()
+        if row_mask is not None and row_mask.shape != (len(user_idx), model.n_items):
+            raise ValueError(
+                f"row_mask shape {row_mask.shape} != "
+                f"(batch, n_items) {(len(user_idx), model.n_items)}")
         if model._host_items is not None:
-            return _recommend_batch_host(model, user_idx, num, exclude)
+            return _recommend_batch_host(model, user_idx, num, exclude, row_mask)
         b = len(user_idx)
         bucket = serve_bucket(max(b, 1))
         k = model._serve_k if 0 < num <= model._serve_k else num
@@ -557,19 +588,27 @@ class TwoTowerMF:
             m = np.zeros(base_mask.shape[0], np.float32)
             m[np.asarray(exclude, np.int64)] = -np.inf
             mask = mask + jnp.asarray(m)
+        rmask = None
+        if row_mask is not None:
+            # pad rows to the batch bucket and columns to the (quantized)
+            # catalog padding; padded columns are already -inf in base_mask
+            n_cols = int(mask.shape[0])
+            rm = np.zeros((bucket, n_cols), np.float32)
+            rm[:b, : row_mask.shape[1]] = row_mask
+            rmask = jnp.asarray(rm)
         jitstats.record((
             "two_tower_topk", quantized, bucket, k,
-            model.n_items, ue_tab.shape[0],
+            model.n_items, ue_tab.shape[0], rmask is not None,
         ))
         if quantized:
             idx, scores = _topk_quantized(
                 jnp.asarray(uidx), ue_tab, ub_tab,
-                items_q, scales, bias, mask, model.mean, k,
+                items_q, scales, bias, mask, rmask, model.mean, k,
             )
         else:
             idx, scores = _topk_scores(
                 jnp.asarray(uidx), ue_tab, ub_tab,
-                item_t, item_b, model.mean, mask, k,
+                item_t, item_b, model.mean, mask, rmask, k,
             )
         # ONE batched device→host pull for both results: each separate
         # np.asarray costs a full round trip on remote-attached devices
@@ -582,6 +621,7 @@ def _recommend_batch_host(
     user_idx: np.ndarray,
     num: int,
     exclude: Optional[np.ndarray] = None,
+    row_mask: Optional[np.ndarray] = None,
 ) -> tuple[np.ndarray, np.ndarray]:
     """Small-catalog top-k in host numpy: one [b, k] @ [k, n] GEMM + argpartition.
 
@@ -594,6 +634,8 @@ def _recommend_batch_host(
     scores = ue @ item_t + item_b[None, :] + ub[:, None] + model.mean
     if exclude is not None and len(exclude):
         scores[:, np.asarray(exclude, np.int64)] = -np.inf
+    if row_mask is not None:
+        scores += row_mask
     k = min(num, scores.shape[1])
     part = np.argpartition(-scores, k - 1, axis=1)[:, :k]
     row = np.arange(scores.shape[0])[:, None]
@@ -679,9 +721,12 @@ def _train_epochs(p, o, ub, ib, rb, wb, lr, reg, n_epochs):
 
 
 @partial(jax.jit, static_argnames=("num",))
-def _topk_quantized(uidx, ue_tab, ub_tab, items_q, scales, bias, mask, mean, num):
+def _topk_quantized(uidx, ue_tab, ub_tab, items_q, scales, bias, mask,
+                    row_mask, mean, num):
     """Quantized catalog scoring: Pallas kernel on TPU, jnp oracle elsewhere.
-    User rows are gathered on device from the resident bf16 table."""
+    User rows are gathered on device from the resident bf16 table.
+    ``row_mask`` (None or [b, n]) carries per-query rule filters into the
+    kernel itself — masked batches stay one dispatch."""
     from incubator_predictionio_tpu.ops.retrieval import (
         score_catalog_quantized,
         score_catalog_reference,
@@ -689,16 +734,18 @@ def _topk_quantized(uidx, ue_tab, ub_tab, items_q, scales, bias, mask, mean, num
 
     on_tpu = jax.devices()[0].platform == "tpu"
     scorer = score_catalog_quantized if on_tpu else score_catalog_reference
-    scores = scorer(ue_tab[uidx], items_q, scales, bias, mask) \
+    scores = scorer(ue_tab[uidx], items_q, scales, bias, mask, row_mask) \
         + ub_tab[uidx][:, None] + mean
     values, indices = jax.lax.top_k(scores, num)
     return indices, values
 
 
 @partial(jax.jit, static_argnames=("num",))
-def _topk_scores(uidx, ue_tab, ub_tab, item_t, item_b, mean, mask, num):
+def _topk_scores(uidx, ue_tab, ub_tab, item_t, item_b, mean, mask, row_mask,
+                 num):
     # device gather of the query rows, then [b,k] @ [k,n] on the MXU in
-    # bfloat16 with fp32 score accumulation
+    # bfloat16 with fp32 score accumulation; row_mask (None or [b, n]) adds
+    # per-query rule filters without leaving the single dispatch
     scores = (
         jax.lax.dot_general(
             ue_tab[uidx], item_t, (((1,), (0,)), ((), ())),
@@ -709,5 +756,7 @@ def _topk_scores(uidx, ue_tab, ub_tab, item_t, item_b, mean, mask, num):
         + mean
         + mask[None, :]
     )
+    if row_mask is not None:
+        scores = scores + row_mask
     values, indices = jax.lax.top_k(scores, num)
     return indices, values

@@ -68,40 +68,74 @@ def _score_kernel(q_ref, items_ref, scale_ref, bias_ref, mask_ref, out_ref):
     out_ref[:] = scores
 
 
+def _score_kernel_rowmask(q_ref, items_ref, scale_ref, bias_ref, mask_ref,
+                          rowmask_ref, out_ref):
+    """The rule-filtered variant: a per-row [B, NB] mask block streams in
+    alongside the catalog block — each query in the batch carries its own
+    business-rule filter (whitelist/blacklist/category/seen) while the
+    shared [NB] mask keeps covering catalog padding."""
+    q = q_ref[:].astype(jnp.bfloat16)
+    block = items_ref[:].astype(jnp.bfloat16)
+    scores = jax.lax.dot_general(
+        q, block, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    scores = scores * scale_ref[:] + bias_ref[:] + mask_ref[:] + rowmask_ref[:]
+    out_ref[:] = scores
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
-def score_catalog_quantized(q, items_q, scales, bias, mask, *, interpret=False):
-    """q [B, D] fp32; items_q [N, D] int8; scales/bias/mask [N] fp32 → [B, N]."""
+def score_catalog_quantized(q, items_q, scales, bias, mask, row_mask=None, *,
+                            interpret=False):
+    """q [B, D] fp32; items_q [N, D] int8; scales/bias/mask [N] fp32;
+    optional row_mask [B, N] fp32 (per-query -inf filters) → [B, N]."""
     b, d = q.shape
     n = items_q.shape[0]
     if n % ITEM_BLOCK:
         raise ValueError(f"catalog rows ({n}) must be padded to {ITEM_BLOCK}")
+    if row_mask is not None and row_mask.shape != (b, n):
+        raise ValueError(
+            f"row_mask shape {row_mask.shape} != (batch, catalog) {(b, n)}")
     grid = (n // ITEM_BLOCK,)
     row = lambda j: (j, 0)
+    col = lambda j: (0, j)
+    in_specs = [
+        pl.BlockSpec((b, d), lambda j: (0, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((ITEM_BLOCK, d), row, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, ITEM_BLOCK), col, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, ITEM_BLOCK), col, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, ITEM_BLOCK), col, memory_space=pltpu.VMEM),
+    ]
+    args = [q, items_q, scales.reshape(1, n), bias.reshape(1, n),
+            mask.reshape(1, n)]
+    kernel = _score_kernel
+    if row_mask is not None:
+        in_specs.append(
+            pl.BlockSpec((b, ITEM_BLOCK), col, memory_space=pltpu.VMEM))
+        args.append(row_mask)
+        kernel = _score_kernel_rowmask
     return pl.pallas_call(
-        _score_kernel,
+        kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((b, d), lambda j: (0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((ITEM_BLOCK, d), row, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, ITEM_BLOCK), lambda j: (0, j), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, ITEM_BLOCK), lambda j: (0, j), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, ITEM_BLOCK), lambda j: (0, j), memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec((b, ITEM_BLOCK), lambda j: (0, j),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((b, ITEM_BLOCK), col,
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((b, n), jnp.float32),
         interpret=interpret,
-    )(q, items_q, scales.reshape(1, n), bias.reshape(1, n), mask.reshape(1, n))
+    )(*args)
 
 
-def score_catalog_reference(q, items_q, scales, bias, mask):
+def score_catalog_reference(q, items_q, scales, bias, mask, row_mask=None):
     """Same math in plain jnp (the non-TPU serving path + test oracle)."""
     deq = items_q.astype(jnp.bfloat16)
     scores = jax.lax.dot_general(
         q.astype(jnp.bfloat16), deq, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
-    return scores * scales[None, :] + bias[None, :] + mask[None, :]
+    scores = scores * scales[None, :] + bias[None, :] + mask[None, :]
+    if row_mask is not None:
+        scores = scores + row_mask
+    return scores
 
 
 def pad_catalog(items_q: np.ndarray, *vectors: np.ndarray,

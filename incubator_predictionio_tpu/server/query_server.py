@@ -94,6 +94,13 @@ _G_DEV_MEM = REGISTRY.gauge(
     "pio_device_bytes_in_use",
     "Accelerator memory in use (the device_memory_report fold)",
     labels=("device",))
+_H_TEMPLATE_BATCH = REGISTRY.histogram(
+    "pio_serving_template_batch_size",
+    "Live queries per coalesced batch_predict dispatch, per algorithm class "
+    "— proves the micro-batcher's coalescing reaches the vectorized "
+    "template paths (docs/serving.md)",
+    labels=("template",),
+    buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0))
 
 #: per-algorithm wall times of the current dispatch, set by ``predict_batch``
 #: and read back from the SAME Context object after ``Context.run`` returns
@@ -303,6 +310,8 @@ class DeployedEngine:
         algo_times: list[tuple[str, float]] = []
         for ai in algo_live:
             a, m = self.algorithms[ai], self.models[ai]
+            _H_TEMPLATE_BATCH.labels(template=type(a).__name__).observe(
+                len(live))
             t0 = self._clock.monotonic()
             healed = False
             try:

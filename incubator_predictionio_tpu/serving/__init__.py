@@ -1,0 +1,41 @@
+"""Vectorized batched serving for the rule-filtered templates.
+
+The reference evaluates every business rule (category filter, white/black
+lists, live unavailable-items constraint, unseen-only) per query with
+per-item Scala closures (ECommAlgorithm.scala isCandidateItem); the seed
+port kept that shape as per-item Python loops, so a coalesced micro-batch
+of B queries still ran O(B × catalog) interpreter work plus O(B) live
+event-store reads. This package is the batched replacement:
+
+- :mod:`masks <incubator_predictionio_tpu.serving.masks>` — compile the
+  catalog's category metadata once at ``prepare_for_serving`` into a
+  :class:`~incubator_predictionio_tpu.serving.masks.CategoryIndex`
+  (category → member-row arrays), then assemble every query's filter as
+  vectorized index scatters into a ``[B, N]`` additive -inf mask.
+- :mod:`cache <incubator_predictionio_tpu.serving.cache>` — a TTL +
+  single-flight cache for serving-time live store reads (the per-query
+  ``unavailableItems`` constraint read), clock-injectable so tests script
+  expiry deterministically. ``PIO_SERVING_CONSTRAINT_TTL_MS=0`` restores
+  the reference's read-per-query semantics.
+
+See docs/serving.md ("Batched serving & mask compilation").
+"""
+
+from incubator_predictionio_tpu.serving.cache import TTLCache, constraint_ttl_sec
+from incubator_predictionio_tpu.serving.masks import (
+    CategoryIndex,
+    HasCategoryIndex,
+    ban_rows,
+    whitelist_vec,
+)
+from incubator_predictionio_tpu.serving.topk import grouped_topk
+
+__all__ = [
+    "CategoryIndex",
+    "HasCategoryIndex",
+    "TTLCache",
+    "ban_rows",
+    "constraint_ttl_sec",
+    "grouped_topk",
+    "whitelist_vec",
+]

@@ -1,13 +1,25 @@
 """Shared cosine-similarity serving path for the similarproduct family
 (similarproduct, recommended_user).
 
-One jitted bf16 MXU matmul scores every candidate against the summed query
-vectors; filters ride as an additive -inf mask (the reference's per-candidate
-cosine loops: similarproduct ALSAlgorithm.scala:150-175, recommended-user
+One jitted bf16 MXU matmul scores every candidate against the query vectors;
+filters ride as an additive -inf mask (the reference's per-candidate cosine
+loops: similarproduct ALSAlgorithm.scala:150-175, recommended-user
 ALSAlgorithm.scala:150-160).
+
+Batching contract: the matmul is the ONLY device op — the per-query sum over
+its vectors' score rows happens host-side. XLA's row results are invariant
+to how many rows share the dispatch, so a whole coalesced batch's query
+vectors can stack into one ``[ΣQ, k] × [k, n]`` dispatch
+(:func:`sim_scores_stacked`) and reproduce the per-query
+:func:`sim_scores` results bitwise — that equality is what the
+batched-vs-serial parity tests pin. (The pre-batching version summed inside
+the jit; XLA fuses that reduction differently for different stackings,
+which is exactly the bitwise drift this layout avoids.)
 """
 
 from __future__ import annotations
+
+from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -19,10 +31,55 @@ def l2_normalize(v: np.ndarray) -> np.ndarray:
 
 
 @jax.jit
-def sim_scores(qvecs, cand_vt, mask):
-    """[q, k] query rows × [k, n] candidate columns → [n] summed cosine
-    scores (+ mask). Rows must be L2-normalized for cosine semantics."""
-    scores = (
-        (qvecs.astype(jnp.bfloat16) @ cand_vt.astype(jnp.bfloat16)).astype(jnp.float32)
+def qv_scores(qvecs, cand_vt):
+    """[q, k] query rows × [k, n] candidate columns → [q, n] per-row scores
+    (bf16 MXU matmul, fp32 result). Rows must be L2-normalized for cosine
+    semantics."""
+    return (
+        (qvecs.astype(jnp.bfloat16) @ cand_vt.astype(jnp.bfloat16))
+        .astype(jnp.float32)
     )
-    return scores.sum(axis=0) + mask
+
+
+def _matmul_rows(qvecs: np.ndarray, cand_vt) -> np.ndarray:
+    """One bucket-padded :func:`qv_scores` dispatch → host [q, n] rows.
+
+    Row counts pad up to the serving bucket ladder (zero rows score zero
+    and are sliced off), so the executable count stays bounded instead of
+    one compile per distinct stack height."""
+    from incubator_predictionio_tpu.models.two_tower import serve_bucket
+
+    q = qvecs.shape[0]
+    bucket = serve_bucket(max(q, 1))
+    if bucket != q:
+        qvecs = np.concatenate(
+            [qvecs, np.zeros((bucket - q, qvecs.shape[1]), qvecs.dtype)])
+    return np.asarray(qv_scores(jnp.asarray(qvecs), cand_vt))[:q]
+
+
+def sim_scores(qvecs, cand_vt, mask) -> np.ndarray:
+    """[q, k] query rows → [n] summed cosine scores (+ mask), host sum."""
+    rows = _matmul_rows(np.asarray(qvecs, np.float32), cand_vt)
+    return rows.sum(axis=0) + np.asarray(mask)
+
+
+def sim_scores_stacked(
+    qvecs: np.ndarray,
+    counts: Sequence[int],
+    cand_vt,
+    masks: Optional[np.ndarray] = None,
+) -> np.ndarray:
+    """A whole batch in ONE matmul dispatch.
+
+    ``qvecs`` is every query's vectors concatenated ([ΣQ, k], query i owning
+    ``counts[i]`` consecutive rows); ``masks`` an optional [B, n] additive
+    mask. Returns [B, n] summed scores — row-for-row bitwise equal to
+    calling :func:`sim_scores` per query."""
+    rows = _matmul_rows(np.asarray(qvecs, np.float32), cand_vt)
+    out = np.empty((len(counts), rows.shape[1]), np.float32)
+    off = 0
+    for i, c in enumerate(counts):
+        row = rows[off:off + c].sum(axis=0)
+        out[i] = row + masks[i] if masks is not None else row
+        off += c
+    return out

@@ -42,6 +42,14 @@ from incubator_predictionio_tpu.models.two_tower import (
     TwoTowerModel,
 )
 from incubator_predictionio_tpu.parallel.mesh import MeshContext
+from incubator_predictionio_tpu.serving import (
+    HasCategoryIndex,
+    TTLCache,
+    ban_rows,
+    constraint_ttl_sec,
+    grouped_topk,
+    whitelist_vec,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -186,7 +194,7 @@ class ECommAlgorithmParams(Params):
 
 
 @dataclasses.dataclass
-class ECommModel:
+class ECommModel(HasCategoryIndex):
     mf: TwoTowerModel
     user_map: BiMap
     item_map: BiMap
@@ -196,6 +204,7 @@ class ECommModel:
 
     def prepare_for_serving(self) -> "ECommModel":
         self.mf.prepare_for_serving()
+        self.category_index()
         return self
 
     def serving_info(self) -> dict:
@@ -210,6 +219,10 @@ class ECommAlgorithm(PAlgorithm):
     def __init__(self, params: ECommAlgorithmParams):
         super().__init__(params)
         self._levents = LEventStore()
+        # TTL + single-flight cache over the per-query constraint read
+        # (``PIO_SERVING_CONSTRAINT_TTL_MS=0`` restores the reference's
+        # read-per-query semantics; tests swap in a FakeClock-backed cache)
+        self._constraint_cache = TTLCache(constraint_ttl_sec())
 
     def train(self, ctx: MeshContext, pd: TrainingData) -> ECommModel:
         from incubator_predictionio_tpu.models.negative_sampling import sample_negatives
@@ -240,7 +253,12 @@ class ECommAlgorithm(PAlgorithm):
     # -- live event-store reads (serving time) ----------------------------
     def _unavailable_items(self) -> set[str]:
         """Latest "constraint/unavailableItems" ``$set`` wins
-        (ECommAlgorithm.scala:150-180)."""
+        (ECommAlgorithm.scala:150-180) — read through the TTL single-flight
+        cache, so a query storm costs one storage read per TTL window."""
+        return self._constraint_cache.get(
+            "unavailableItems", self._read_unavailable_items)
+
+    def _read_unavailable_items(self) -> set[str]:
         try:
             events = list(self._levents.find_by_entity(
                 self.params.app_name, "constraint", "unavailableItems",
@@ -282,33 +300,82 @@ class ECommAlgorithm(PAlgorithm):
         except ValueError:
             return []
 
+    def _recent_similar_items_batch(
+        self, users: Sequence[str], limit: int = 10,
+    ) -> dict[str, list[str]]:
+        """Batched :meth:`_recent_similar_items` for a batch's unknown users."""
+        try:
+            by_user = self._levents.find_by_entities(
+                self.params.app_name, "user", users,
+                event_names=tuple(self.params.similar_events),
+                target_entity_type="item", limit_per_entity=limit,
+                latest=True,
+            )
+        except ValueError:
+            return {}
+        return {
+            u: [e.target_entity_id for e in evs if e.target_entity_id]
+            for u, evs in by_user.items()
+        }
+
+    def _histories_batch(
+        self, users: Sequence[str], unknown: Sequence[str], limit: int = 10,
+    ) -> tuple[dict[str, set[str]], dict[str, list[str]]]:
+        """ONE union read serving both per-user derivations: seen-items
+        (every user) and the unknown users' recent views. The event-name
+        union covers both reads' filters, and filtering a latest-first
+        stream by event name preserves each name-subset's order, so the
+        derived results equal the dedicated :meth:`_seen_items` /
+        :meth:`_recent_similar_items` reads exactly — one storage round
+        trip instead of two per batch."""
+        seen_names = tuple(self.params.seen_events)
+        similar_names = tuple(self.params.similar_events)
+        try:
+            by_user = self._levents.find_by_entities(
+                self.params.app_name, "user", users,
+                event_names=tuple(dict.fromkeys((*seen_names, *similar_names))),
+                target_entity_type="item", latest=True,
+            )
+        except ValueError:
+            return {}, {}
+        seen = {
+            u: {e.target_entity_id for e in evs
+                if e.event in seen_names and e.target_entity_id}
+            for u, evs in by_user.items()
+        }
+        recent: dict[str, list[str]] = {}
+        for u in unknown:
+            matching = [e for e in by_user.get(u, ())
+                        if e.event in similar_names][:limit]
+            recent[u] = [e.target_entity_id for e in matching
+                         if e.target_entity_id]
+        return seen, recent
+
     # -- masking ----------------------------------------------------------
-    def _mask(self, model: ECommModel, query: Query) -> np.ndarray:
+    @staticmethod
+    def _rule_mask(model: ECommModel, query: Query) -> np.ndarray:
+        """[n] additive -inf mask for the query-carried filters (whitelist,
+        blacklist, categories) — vectorized index scatters over the compiled
+        :class:`CategoryIndex` (serving/masks.py) instead of the seed's
+        per-item Python loops. ONE implementation shared verbatim by the
+        serial path and the batched per-batch memo, so a new filter added
+        here reaches both (the parity contract's single source of truth);
+        the read-dependent filters (unavailable, seen) compose on top."""
         n = len(model.item_map)
         mask = np.zeros(n, np.float32)
         if query.white_list is not None:
-            allowed = model.item_map.lookup_array(query.white_list)
-            white = np.full(n, -np.inf, np.float32)
-            white[allowed[allowed >= 0]] = 0.0
-            mask += white
-        for item in (query.black_list or ()):
-            idx = model.item_map.get(item)
-            if idx is not None:
-                mask[idx] = -np.inf
+            mask += whitelist_vec(model.item_map, query.white_list)
+        ban_rows(mask, model.item_map, query.black_list)
         if query.categories is not None:
-            wanted = set(query.categories)
-            for iid, idx in model.item_map.items():
-                if not wanted.intersection(model.categories.get(iid, ())):
-                    mask[idx] = -np.inf
-        for item in self._unavailable_items():
-            idx = model.item_map.get(item)
-            if idx is not None:
-                mask[idx] = -np.inf
+            mask += model.category_index().allow_vec(query.categories)
+        return mask
+
+    def _mask(self, model: ECommModel, query: Query) -> np.ndarray:
+        """The serial path's full mask: query rules + live store reads."""
+        mask = self._rule_mask(model, query)
+        ban_rows(mask, model.item_map, tuple(self._unavailable_items()))
         if self.params.unseen_only:
-            for item in self._seen_items(query.user):
-                idx = model.item_map.get(item)
-                if idx is not None:
-                    mask[idx] = -np.inf
+            ban_rows(mask, model.item_map, tuple(self._seen_items(query.user)))
         return mask
 
     # -- prediction -------------------------------------------------------
@@ -333,6 +400,8 @@ class ECommAlgorithm(PAlgorithm):
                 scores = model.popularity.copy()
         scores = scores + mask
         num = min(query.num, len(scores))
+        if num <= 0:  # degenerate query, not a catalog dump
+            return PredictedResult()
         top = np.argpartition(-scores, num - 1)[:num]
         top = top[np.argsort(-scores[top])]
         inv = model.item_map.inverse()
@@ -342,7 +411,105 @@ class ECommAlgorithm(PAlgorithm):
         ))
 
     def batch_predict(self, model, queries):
-        return [(i, self.predict(model, q)) for i, q in queries]
+        """Vectorized batch serving: a coalesced micro-batch costs O(1) live
+        store reads and one vectorized pass per stage instead of the serial
+        path's O(B) reads and O(B × catalog) Python.
+
+        - **reads**: one TTL-cached constraint read + ONE batched
+          ``find_by_entities`` for every user's seen history (+ one more for
+          unknown users' recent views) — the serial path pays 2 reads/query;
+        - **masks**: [B, N] assembled from compiled category rows and
+          ``lookup_array`` scatters;
+        - **scores**: each known user's row goes through the *same* BLAS
+          call chain as the serial path (bitwise-identical scores — the
+          parity tests' contract; a stacked GEMM's rows differ in final ulps
+          from the per-query GEMV), then ONE axis-wise top-k per ``num``
+          group replaces per-query selection. Unknown users take the (rare)
+          similar/popularity fallback exactly like the serial path.
+        """
+        queries = list(queries)
+        if not queries:
+            return []
+        qs = [q for _, q in queries]
+        n = len(model.item_map)
+        # -- O(1) live reads for the whole batch --------------------------
+        unavailable = tuple(self._unavailable_items())
+        seen_by_user: dict[str, set[str]] = {}
+        unknown = list(dict.fromkeys(
+            q.user for q in qs if model.user_map.get(q.user) is None))
+        if self.params.unseen_only:
+            # one union read covers seen-items AND unknown users' recent
+            # views (query users include the unknown ones)
+            users = list(dict.fromkeys(q.user for q in qs))
+            seen_by_user, recent_by_user = self._histories_batch(
+                users, unknown)
+        else:
+            recent_by_user = (
+                self._recent_similar_items_batch(unknown) if unknown else {})
+        if unknown:
+            logger.info("batch of %d: %d unknown users take the "
+                        "similar/popularity fallback", len(qs), len(unknown))
+        # -- [chunk, N] mask + scores + axis-wise top-k -------------------
+        # rule masks (whitelist/blacklist/categories) memoized per distinct
+        # filter tuple — live traffic repeats a handful of filters per batch;
+        # the shared unavailable-items vector is built once. Every component
+        # is {0, -inf}, so composing by addition matches the serial path's
+        # scatter order exactly. The dense scored buffer is capped at
+        # ROW_MASK_MAX_ELEMENTS (the device path's bound) by chunking the
+        # batch — a deep micro-batch over a huge catalog must not balloon
+        # host memory to O(B × N); chunking changes no result.
+        from incubator_predictionio_tpu.models.two_tower import (
+            ROW_MASK_MAX_ELEMENTS,
+        )
+
+        unavail_vec = np.zeros(n, np.float32)
+        ban_rows(unavail_vec, model.item_map, unavailable)
+        rule_cache: dict = {}
+        inv = model.item_map.inverse()
+        ue, ub = model.mf.user_emb, model.mf.user_bias
+        ie_t, ib = model.mf.item_emb.T, model.mf.item_bias
+        results: list[Optional[PredictedResult]] = [None] * len(qs)
+        chunk = max(1, ROW_MASK_MAX_ELEMENTS // max(n, 1))
+        for start in range(0, len(qs), chunk):
+            rows = range(start, min(start + chunk, len(qs)))
+            scored = np.empty((len(rows), n), np.float32)
+            for r, b in enumerate(rows):
+                q = qs[b]
+                # wire-bound queries carry filter fields as LISTS
+                # (bind_query does not coerce JSON arrays) — normalize to
+                # tuples or the cache key is unhashable and every filtered
+                # live batch crashes out of the vectorized path
+                key = tuple(
+                    tuple(f) if f is not None else None
+                    for f in (q.white_list, q.black_list, q.categories))
+                rules = rule_cache.get(key)
+                if rules is None:
+                    rules = rule_cache[key] = self._rule_mask(model, q)
+                mask = rules + unavail_vec
+                if self.params.unseen_only:
+                    ban_rows(mask, model.item_map,
+                             seen_by_user.get(q.user, ()))
+                uidx = model.user_map.get(q.user)
+                if uidx is not None:
+                    scores = ue[uidx] @ ie_t + ib + ub[uidx] + model.mf.mean
+                else:
+                    recent = [model.item_map[i]
+                              for i in recent_by_user.get(q.user, [])
+                              if i in model.item_map]
+                    if recent:
+                        qv = model.item_vecs_norm[np.asarray(recent)]
+                        scores = (qv @ model.item_vecs_norm.T).sum(axis=0)
+                    else:
+                        scores = model.popularity.copy()
+                scored[r] = scores + mask
+            for r, (idx_row, score_row) in enumerate(grouped_topk(
+                    scored, [min(qs[b].num, n) for b in rows])):
+                finite = np.isfinite(score_row)
+                results[start + r] = PredictedResult(tuple(
+                    ItemScore(inv[int(i)], float(v))
+                    for i, v, f in zip(idx_row, score_row, finite) if f
+                ))
+        return [(qi, results[b]) for b, (qi, _) in enumerate(queries)]
 
 
 class ECommerceEngine(EngineFactory):

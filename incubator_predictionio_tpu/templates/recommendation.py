@@ -525,17 +525,49 @@ class ALSAlgorithm(PAlgorithm):
             (qi, PredictedResult()) for qi, q in queries if q.user not in model.user_map
         ]
         if known:
+            from incubator_predictionio_tpu.models.two_tower import (
+                ROW_MASK_MAX_ELEMENTS,
+                serve_bucket,
+            )
+
             banned = [self._banned(model, q) for _, q in known]
-            # recommend_batch clamps num to the catalog size internally
-            num = max(q.num + len(b) for (_, q), b in zip(known, banned))
             uidx = np.asarray([model.user_map[q.user] for _, q in known], np.int32)
-            idx, scores = TwoTowerMF.recommend_batch(model.mf, uidx, num)
             inv = model.item_map.inverse()
-            for (qi, q), b, row_idx, row_scores in zip(known, banned, idx, scores):
-                out.append((qi, PredictedResult(tuple(
-                    ItemScore(inv[int(i)], float(s))
-                    for i, s in zip(row_idx, row_scores) if int(i) not in b
-                )[: q.num])))
+            n_items = model.mf.n_items
+            # gate on the BUCKET the dispatch will pad to — the same
+            # criterion warmup uses — so a row-mask dispatch always lands on
+            # a pre-compiled executable (never an XLA compile on a live path)
+            if any(banned) and serve_bucket(len(known)) * n_items <= ROW_MASK_MAX_ELEMENTS:
+                # per-query blacklists ride as a [B, n] row mask INTO the
+                # single scoring dispatch (ops/retrieval.py carries it
+                # through the Pallas kernel on the quantized path) — no
+                # over-fetch + host re-filter
+                num = max(q.num for _, q in known)
+                row_mask = np.zeros((len(known), n_items), np.float32)
+                for r, b in enumerate(banned):
+                    if b:
+                        row_mask[r, np.fromiter(b, np.int64)] = -np.inf
+                idx, scores = TwoTowerMF.recommend_batch(
+                    model.mf, uidx, num, row_mask=row_mask)
+                for (qi, q), row_idx, row_scores in zip(known, idx, scores):
+                    out.append((qi, PredictedResult(tuple(
+                        ItemScore(inv[int(i)], float(s))
+                        for i, s in zip(row_idx, row_scores) if np.isfinite(s)
+                    )[: q.num])))
+            else:
+                # huge catalogs (or no blacklists at all): a dense
+                # batch×catalog mask would cost more to build and ship than
+                # the scoring it filters — over-fetch a few extra columns
+                # and drop banned rows host-side instead
+                num = max(q.num + len(b) for (_, q), b in zip(known, banned))
+                idx, scores = TwoTowerMF.recommend_batch(model.mf, uidx, num)
+                for (qi, q), b, row_idx, row_scores in zip(
+                        known, banned, idx, scores):
+                    out.append((qi, PredictedResult(tuple(
+                        ItemScore(inv[int(i)], float(s))
+                        for i, s in zip(row_idx, row_scores)
+                        if int(i) not in b and np.isfinite(s)
+                    )[: q.num])))
         return out
 
 

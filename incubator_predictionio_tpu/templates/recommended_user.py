@@ -24,7 +24,6 @@ import logging
 from typing import Optional, Sequence
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from incubator_predictionio_tpu.core import (
@@ -41,7 +40,12 @@ from incubator_predictionio_tpu.data.bimap import BiMap
 from incubator_predictionio_tpu.data.store import PEventStore
 from incubator_predictionio_tpu.models.two_tower import TwoTowerConfig, TwoTowerMF
 from incubator_predictionio_tpu.parallel.mesh import MeshContext
-from incubator_predictionio_tpu.templates._similarity import l2_normalize, sim_scores
+from incubator_predictionio_tpu.serving import ban_rows, grouped_topk, whitelist_vec
+from incubator_predictionio_tpu.templates._similarity import (
+    l2_normalize,
+    sim_scores,
+    sim_scores_stacked,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -221,9 +225,11 @@ class ALSAlgorithm(PAlgorithm):
         if model._device_vt is None:
             model.prepare_for_serving()
         mask = self._filter_mask(model, query)
-        qvecs = jnp.asarray(model.user_vecs[np.asarray(known)])
-        scores = np.asarray(sim_scores(qvecs, model._device_vt, jnp.asarray(mask)))
+        qvecs = model.user_vecs[np.asarray(known)]
+        scores = sim_scores(qvecs, model._device_vt, mask)
         num = min(query.num, len(scores))
+        if num <= 0:  # degenerate query, not a catalog dump
+            return PredictedResult()
         top = np.argpartition(-scores, num - 1)[:num]
         top = top[np.argsort(-scores[top])]
         inv = model.user_map.inverse()
@@ -237,26 +243,50 @@ class ALSAlgorithm(PAlgorithm):
     @staticmethod
     def _filter_mask(model: SimilarUserModel, query: Query) -> np.ndarray:
         """-inf mask: whitelist/blacklist + query-user self-exclusion
-        (isCandidateSimilarUser, ALSAlgorithm.scala:200-230)."""
+        (isCandidateSimilarUser, ALSAlgorithm.scala:200-230) — vectorized
+        ``lookup_array`` scatters (serving/masks.py)."""
         n = len(model.user_map)
         mask = np.zeros(n, np.float32)
         if query.white_list is not None:
-            allowed = model.user_map.lookup_array(query.white_list)
-            white = np.full(n, -np.inf, np.float32)
-            white[allowed[allowed >= 0]] = 0.0
-            mask += white
-        for banned in (query.black_list or ()):
-            idx = model.user_map.get(banned)
-            if idx is not None:
-                mask[idx] = -np.inf
-        for qu in query.users:  # never recommend the query users themselves
-            idx = model.user_map.get(qu)
-            if idx is not None:
-                mask[idx] = -np.inf
+            mask += whitelist_vec(model.user_map, query.white_list)
+        ban_rows(mask, model.user_map, query.black_list)
+        # never recommend the query users themselves
+        ban_rows(mask, model.user_map, query.users)
         return mask
 
     def batch_predict(self, model, queries):
-        return [(i, self.predict(model, q)) for i, q in queries]
+        """Batched serving: one stacked scoring dispatch for the whole
+        coalesced batch (bitwise equal per row to the serial path — see
+        ``sim_scores_stacked``), vectorized [B, n] masks, axis-wise top-k
+        per ``num`` group, and the serial score>0 cut per row."""
+        queries = list(queries)
+        if not queries:
+            return []
+        if model._device_vt is None:
+            model.prepare_for_serving()
+        qs = [q for _, q in queries]
+        known = [
+            np.asarray([model.user_map[u] for u in q.users
+                        if u in model.user_map], np.int64)
+            for q in qs
+        ]
+        results: list[PredictedResult] = [PredictedResult()] * len(qs)
+        live = [b for b, k in enumerate(known) if len(k)]
+        if live:
+            masks = np.stack([self._filter_mask(model, qs[b]) for b in live])
+            counts = [len(known[b]) for b in live]
+            qvecs = model.user_vecs[np.concatenate([known[b] for b in live])]
+            scored = sim_scores_stacked(qvecs, counts, model._device_vt, masks)
+            inv = model.user_map.inverse()
+            n = scored.shape[1]
+            for r, (idx_row, score_row) in enumerate(grouped_topk(
+                    scored, [min(qs[b].num, n) for b in live])):
+                keep = np.isfinite(score_row) & (score_row > 0)
+                results[live[r]] = PredictedResult(tuple(
+                    SimilarUserScore(inv[int(i)], float(v))
+                    for i, v, k in zip(idx_row, score_row, keep) if k
+                ))
+        return [(qi, results[b]) for b, (qi, _) in enumerate(queries)]
 
 
 class RecommendedUserEngine(EngineFactory):

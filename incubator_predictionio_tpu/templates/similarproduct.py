@@ -41,7 +41,17 @@ from incubator_predictionio_tpu.data.bimap import BiMap
 from incubator_predictionio_tpu.data.store import PEventStore
 from incubator_predictionio_tpu.models.two_tower import TwoTowerConfig, TwoTowerMF
 from incubator_predictionio_tpu.parallel.mesh import MeshContext
-from incubator_predictionio_tpu.templates._similarity import l2_normalize, sim_scores
+from incubator_predictionio_tpu.serving import (
+    HasCategoryIndex,
+    ban_rows,
+    grouped_topk,
+    whitelist_vec,
+)
+from incubator_predictionio_tpu.templates._similarity import (
+    l2_normalize,
+    sim_scores,
+    sim_scores_stacked,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -191,7 +201,7 @@ class DataSource(PDataSource):
 # -- shared model + filtering ----------------------------------------------
 
 @dataclasses.dataclass
-class ItemSimModel:
+class ItemSimModel(HasCategoryIndex):
     """Normalized item vectors + catalog metadata for similarity scoring."""
 
     item_vecs: np.ndarray            # [n_items, k] L2-normalized
@@ -202,6 +212,7 @@ class ItemSimModel:
 
     def prepare_for_serving(self) -> "ItemSimModel":
         self._device_vt = jax.device_put(np.ascontiguousarray(self.item_vecs.T))
+        self.category_index()
         return self
 
     def serving_info(self) -> dict:
@@ -209,35 +220,38 @@ class ItemSimModel:
         return {"path": "device-bf16", "catalog_rows": len(self.item_map)}
 
 
-def _category_mask(model: ItemSimModel, query: Query) -> np.ndarray:
+def _category_mask(model, query: Query) -> np.ndarray:
     """-inf mask implementing whitelist/blacklist/category filters + query-item
-    exclusion (reference isCandidateItem, ALSAlgorithm.scala:200-230)."""
+    exclusion (reference isCandidateItem, ALSAlgorithm.scala:200-230) —
+    vectorized scatters over the model's compiled :class:`CategoryIndex`
+    instead of the seed's two per-item loops over the whole catalog. Works
+    for any model exposing ``item_map`` + ``category_index()``."""
+    cat_index = model.category_index()
     n = len(model.item_map)
     mask = np.zeros(n, np.float32)
     if query.white_list is not None:
-        allowed = model.item_map.lookup_array(query.white_list)
-        white = np.full(n, -np.inf, np.float32)
-        white[allowed[allowed >= 0]] = 0.0
-        mask += white
-    for black in (query.black_list or ()):
-        idx = model.item_map.get(black)
-        if idx is not None:
-            mask[idx] = -np.inf
+        mask += whitelist_vec(model.item_map, query.white_list)
+    ban_rows(mask, model.item_map, query.black_list)
     if query.categories is not None:
-        wanted = set(query.categories)
-        for iid, idx in model.item_map.items():
-            if not wanted.intersection(model.categories.get(iid, ())):
-                mask[idx] = -np.inf
+        mask += cat_index.allow_vec(query.categories)
     if query.category_black_list is not None:
-        banned = set(query.category_black_list)
-        for iid, idx in model.item_map.items():
-            if banned.intersection(model.categories.get(iid, ())):
-                mask[idx] = -np.inf
-    for qi in query.items:  # exclude the query items themselves
-        idx = model.item_map.get(qi)
-        if idx is not None:
-            mask[idx] = -np.inf
+        mask += cat_index.ban_vec(query.category_black_list)
+    ban_rows(mask, model.item_map, query.items)  # exclude the query items
     return mask
+
+
+def _topk_result(scores: np.ndarray, num: int, inv) -> PredictedResult:
+    """Serial top-k: selection, ordering and finiteness filter — the oracle
+    the batched axis-wise form must match row for row."""
+    num = min(num, len(scores))
+    if num <= 0:  # degenerate query, not a catalog dump
+        return PredictedResult()
+    top = np.argpartition(-scores, num - 1)[:num]
+    top = top[np.argsort(-scores[top])]
+    return PredictedResult(tuple(
+        ItemScore(inv[int(i)], float(scores[i]))
+        for i in top if np.isfinite(scores[i])
+    ))
 
 
 def _similar_items(model: ItemSimModel, query: Query) -> PredictedResult:
@@ -246,16 +260,47 @@ def _similar_items(model: ItemSimModel, query: Query) -> PredictedResult:
         return PredictedResult()
     if model._device_vt is None:
         model.prepare_for_serving()
-    qvecs = jnp.asarray(model.item_vecs[np.asarray(known)])
-    scores = np.asarray(sim_scores(qvecs, model._device_vt, jnp.asarray(_category_mask(model, query))))
-    num = min(query.num, len(scores))
-    top = np.argpartition(-scores, num - 1)[:num]
-    top = top[np.argsort(-scores[top])]
-    inv = model.item_map.inverse()
-    return PredictedResult(tuple(
-        ItemScore(inv[int(i)], float(scores[i]))
-        for i in top if np.isfinite(scores[i])
-    ))
+    qvecs = model.item_vecs[np.asarray(known)]
+    scores = sim_scores(qvecs, model._device_vt, _category_mask(model, query))
+    return _topk_result(scores, query.num, model.item_map.inverse())
+
+
+def _similar_items_batch(
+    model: ItemSimModel, queries: Sequence[tuple[int, Query]],
+) -> list[tuple[int, PredictedResult]]:
+    """Batched :func:`_similar_items`: every query's vectors stack into ONE
+    scoring dispatch (`sim_scores_stacked` — bitwise equal per row to the
+    serial call), masks assemble as [B, n] vectorized scatters, and top-k
+    runs axis-wise per ``num`` group. Queries with no known items return
+    empty results exactly like the serial path."""
+    queries = list(queries)
+    if not queries:
+        return []
+    if model._device_vt is None:
+        model.prepare_for_serving()
+    qs = [q for _, q in queries]
+    known = [
+        np.asarray([model.item_map[i] for i in q.items
+                    if i in model.item_map], np.int64)
+        for q in qs
+    ]
+    results: list[PredictedResult] = [PredictedResult()] * len(qs)
+    live = [b for b, k in enumerate(known) if len(k)]
+    if live:
+        masks = np.stack([_category_mask(model, qs[b]) for b in live])
+        counts = [len(known[b]) for b in live]
+        qvecs = model.item_vecs[np.concatenate([known[b] for b in live])]
+        scored = sim_scores_stacked(qvecs, counts, model._device_vt, masks)
+        inv = model.item_map.inverse()
+        n = scored.shape[1]
+        for r, (idx_row, score_row) in enumerate(grouped_topk(
+                scored, [min(qs[b].num, n) for b in live])):
+            finite = np.isfinite(score_row)
+            results[live[r]] = PredictedResult(tuple(
+                ItemScore(inv[int(i)], float(v))
+                for i, v, f in zip(idx_row, score_row, finite) if f
+            ))
+    return [(qi, results[b]) for b, (qi, _) in enumerate(queries)]
 
 
 # -- algorithms -------------------------------------------------------------
@@ -306,7 +351,7 @@ class ALSAlgorithm(PAlgorithm):
         return _similar_items(model, query)
 
     def batch_predict(self, model, queries):
-        return [(i, self.predict(model, q)) for i, q in queries]
+        return _similar_items_batch(model, queries)
 
 
 class LikeAlgorithm(ALSAlgorithm):
@@ -340,10 +385,14 @@ class CooccurrenceAlgorithmParams(Params):
 
 
 @dataclasses.dataclass
-class CooccurrenceModel:
+class CooccurrenceModel(HasCategoryIndex):
     top_cooccurrences: dict[int, list[tuple[int, int]]]  # item → [(item, count)]
     item_map: BiMap
     categories: dict[str, tuple[str, ...]]
+
+    def prepare_for_serving(self) -> "CooccurrenceModel":
+        self.category_index()
+        return self
 
 
 class CooccurrenceAlgorithm(PAlgorithm):
@@ -386,9 +435,7 @@ class CooccurrenceAlgorithm(PAlgorithm):
                 continue
             for j, c in model.top_cooccurrences.get(idx, ()):
                 counts[j] = counts.get(j, 0) + c
-        sim_model = ItemSimModel(np.zeros((len(model.item_map), 1)), model.item_map,
-                                 model.categories)
-        mask = _category_mask(sim_model, query)
+        mask = _category_mask(model, query)
         scored = [
             (j, c) for j, c in counts.items() if np.isfinite(mask[j])
         ]

@@ -1,0 +1,28 @@
+"""Transparent event-store proxy counting storage READ calls.
+
+Shared by the batched-serving regression tests and the bench (bench.py):
+the O(1)-reads-per-batch property is asserted/attributed by counting the
+same method set in both places, so they can never drift on what counts as
+a read.
+"""
+
+from __future__ import annotations
+
+
+class CountingEvents:
+    def __init__(self, inner):
+        self._inner = inner
+        self.counts = {"find": 0, "find_by_entities": 0}
+
+    def __getattr__(self, name):
+        attr = getattr(self._inner, name)
+        if name in self.counts:
+            def wrapper(*a, _attr=attr, _name=name, **kw):
+                self.counts[_name] += 1
+                return _attr(*a, **kw)
+            return wrapper
+        return attr
+
+    @property
+    def total_reads(self) -> int:
+        return sum(self.counts.values())

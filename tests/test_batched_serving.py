@@ -1,0 +1,731 @@
+"""Vectorized batched serving: mask compilation, cached live reads,
+one-dispatch scoring (ISSUE 3).
+
+The serial per-query ``predict`` paths are kept untouched as the oracle; the
+parity tests here pin the batched paths to them with STRICT equality —
+identical item ids AND bitwise-identical scores, across all four filter
+kinds and both unknown-user fallbacks. The TTL constraint cache is exercised
+purely on a FakeClock (zero wall sleeps), and a call-counting harness proves
+a coalesced batch of B queries performs O(1) event-store reads."""
+
+import datetime as dt
+import threading
+
+import numpy as np
+import pytest
+
+from incubator_predictionio_tpu.data import DataMap, Event
+from incubator_predictionio_tpu.data.bimap import BiMap
+from incubator_predictionio_tpu.data.storage import App, Storage, use_storage
+from incubator_predictionio_tpu.obs.metrics import REGISTRY
+from incubator_predictionio_tpu.resilience.clock import FakeClock
+from incubator_predictionio_tpu.serving import TTLCache
+from incubator_predictionio_tpu.serving.masks import (
+    CategoryIndex,
+    ban_rows,
+    whitelist_vec,
+)
+
+UTC = dt.timezone.utc
+T0 = dt.datetime(2020, 1, 1, tzinfo=UTC)
+
+
+def _counter(name: str) -> float:
+    return REGISTRY.get(name)._default().value
+
+
+# ---------------------------------------------------------------------------
+# TTL + single-flight cache (deterministic under the injected clock)
+# ---------------------------------------------------------------------------
+
+class _CountingLoader:
+    def __init__(self, value="v"):
+        self.calls = 0
+        self.value = value
+
+    def __call__(self):
+        self.calls += 1
+        return f"{self.value}{self.calls}"
+
+
+def test_ttl_cache_expiry_on_fake_clock():
+    clock = FakeClock()
+    cache = TTLCache(5.0, clock=clock)
+    loader = _CountingLoader()
+    assert cache.get("k", loader) == "v1"
+    assert cache.get("k", loader) == "v1"      # fresh → cached
+    assert loader.calls == 1
+    clock.advance(4.999)
+    assert cache.get("k", loader) == "v1"      # still inside the window
+    clock.advance(0.002)
+    assert cache.get("k", loader) == "v2"      # expired → reload
+    assert loader.calls == 2
+
+
+def test_ttl_cache_zero_ttl_reads_per_query():
+    """PIO_SERVING_CONSTRAINT_TTL_MS=0 semantics: every get is a real read."""
+    cache = TTLCache(0.0, clock=FakeClock())
+    loader = _CountingLoader()
+    m0 = _counter("pio_serving_store_read_cache_misses_total")
+    assert cache.get("k", loader) == "v1"
+    assert cache.get("k", loader) == "v2"
+    assert cache.get("k", loader) == "v3"
+    assert loader.calls == 3
+    assert _counter("pio_serving_store_read_cache_misses_total") == m0 + 3
+
+
+def test_ttl_cache_env_knob(monkeypatch):
+    from incubator_predictionio_tpu.serving.cache import constraint_ttl_sec
+
+    monkeypatch.setenv("PIO_SERVING_CONSTRAINT_TTL_MS", "0")
+    assert constraint_ttl_sec() == 0.0
+    monkeypatch.setenv("PIO_SERVING_CONSTRAINT_TTL_MS", "2500")
+    assert constraint_ttl_sec() == 2.5
+    monkeypatch.delenv("PIO_SERVING_CONSTRAINT_TTL_MS")
+    assert constraint_ttl_sec() == 1.0  # default
+
+
+def test_ttl_cache_hit_miss_counters():
+    clock = FakeClock()
+    cache = TTLCache(1.0, clock=clock)
+    loader = _CountingLoader()
+    h0 = _counter("pio_serving_store_read_cache_hits_total")
+    m0 = _counter("pio_serving_store_read_cache_misses_total")
+    cache.get("k", loader)                     # miss
+    cache.get("k", loader)                     # hit
+    cache.get("k", loader)                     # hit
+    clock.advance(2.0)
+    cache.get("k", loader)                     # miss
+    assert _counter("pio_serving_store_read_cache_hits_total") == h0 + 2
+    assert _counter("pio_serving_store_read_cache_misses_total") == m0 + 2
+
+
+def test_ttl_cache_failed_load_not_cached():
+    cache = TTLCache(10.0, clock=FakeClock())
+    calls = []
+
+    def bad():
+        calls.append(1)
+        raise RuntimeError("backend down")
+
+    with pytest.raises(RuntimeError):
+        cache.get("k", bad)
+    with pytest.raises(RuntimeError):
+        cache.get("k", bad)                    # no negative caching
+    assert len(calls) == 2
+    loader = _CountingLoader()
+    assert cache.get("k", loader) == "v1"      # recovers
+
+
+def test_ttl_cache_stale_while_revalidate():
+    """A caller hitting an EXPIRED entry while a refresh is in flight gets
+    the stale value immediately instead of queueing behind the leader's
+    (possibly deadline-length) backend read — head-of-line blocking would
+    defeat per-query deadlines."""
+    clock = FakeClock()
+    cache = TTLCache(1.0, clock=clock)
+    assert cache.get("k", lambda: "v1") == "v1"
+    clock.advance(2.0)  # expired, value retained
+    in_loader = threading.Event()
+    release = threading.Event()
+
+    def slow_refresh():
+        in_loader.set()
+        release.wait(5)
+        return "v2"
+
+    got = []
+    leader = threading.Thread(target=lambda: got.append(cache.get("k", slow_refresh)))
+    leader.start()
+    assert in_loader.wait(5)
+    # follower returns the STALE value without blocking on the leader
+    assert cache.get("k", slow_refresh) == "v1"
+    release.set()
+    leader.join(5)
+    assert got == ["v2"]
+    assert cache.get("k", slow_refresh) == "v2"  # refresh landed
+
+
+def test_ttl_cache_hung_leader_is_replaced():
+    """A refresh leader whose read hangs past leader_timeout_sec loses the
+    slot: the next caller elects itself leader and refreshes, so staleness
+    can never freeze at one snapshot for the process lifetime."""
+    clock = FakeClock()
+    cache = TTLCache(1.0, clock=clock)
+    assert cache.get("k", lambda: "v1") == "v1"
+    clock.advance(2.0)  # expired
+    in_loader = threading.Event()
+    hang = threading.Event()
+    hung = threading.Thread(
+        target=lambda: cache.get(
+            "k", lambda: (in_loader.set(), hang.wait(10), "late")[-1]))
+    hung.start()
+    assert in_loader.wait(5)
+    # stale-while-revalidate while the leader is young
+    assert cache.get("k", lambda: "fresh") == "v1"
+    clock.advance(cache.leader_timeout_sec + 0.1)  # leader presumed hung
+    assert cache.get("k", lambda: "fresh") == "fresh"  # new leader refreshed
+    hang.set()
+    hung.join(5)
+    # the late old leader resolved without evicting the new state
+    assert cache.get("k", lambda: "x") in ("fresh", "late")
+
+
+def test_ttl_cache_single_flight():
+    """Concurrent callers behind one expired key trigger exactly ONE loader
+    call; followers block on the leader's result (no sleeps — the loader is
+    gated on events)."""
+    cache = TTLCache(10.0, clock=FakeClock())
+    in_loader = threading.Event()
+    release = threading.Event()
+    calls = []
+
+    def slow_loader():
+        calls.append(1)
+        in_loader.set()
+        release.wait(5)
+        return "shared"
+
+    results = []
+    leader = threading.Thread(
+        target=lambda: results.append(cache.get("k", slow_loader)))
+    leader.start()
+    assert in_loader.wait(5)                   # leader is inside the loader
+    follower = threading.Thread(
+        target=lambda: results.append(cache.get("k", slow_loader)))
+    follower.start()
+    release.set()
+    leader.join(5)
+    follower.join(5)
+    assert results == ["shared", "shared"]
+    assert len(calls) == 1
+
+
+# ---------------------------------------------------------------------------
+# mask compilation
+# ---------------------------------------------------------------------------
+
+def test_category_index_matches_brute_force():
+    rng = np.random.default_rng(0)
+    ids = [f"i{i}" for i in range(200)]
+    id_map = BiMap.string_int(ids)
+    cats = {
+        iid: tuple(f"c{c}" for c in rng.choice(8, rng.integers(0, 4),
+                                               replace=False))
+        for iid in ids
+    }
+    index = CategoryIndex(id_map, cats)
+    for wanted in [("c0",), ("c1", "c5"), ("missing",), ()]:
+        brute = sorted(
+            id_map[iid] for iid in ids
+            if set(wanted).intersection(cats.get(iid, ())))
+        assert index.rows_with_any(wanted).tolist() == brute
+        allow = index.allow_vec(wanted)
+        ban = index.ban_vec(wanted)
+        assert np.isfinite(allow).sum() == len(brute)
+        assert np.isneginf(ban).sum() == len(brute)
+    # memoized union: same tuple (any order) returns the same array object
+    assert index.rows_with_any(("c5", "c1")) is index.rows_with_any(("c1", "c5"))
+
+
+def test_mask_scatter_helpers():
+    id_map = BiMap.string_int(["a", "b", "c", "d"])
+    white = whitelist_vec(id_map, ("b", "nope", "d"))
+    assert np.isfinite(white).sum() == 2 and np.isfinite(white[[1, 3]]).all()
+    mask = np.zeros(4, np.float32)
+    ban_rows(mask, id_map, ("a", "ghost"))
+    assert np.isneginf(mask[0]) and np.isfinite(mask[1:]).all()
+    ban_rows(mask, id_map, None)               # no-op
+    ban_rows(mask, id_map, ())                 # no-op
+    assert np.isneginf(mask).sum() == 1
+
+
+# ---------------------------------------------------------------------------
+# batched find_by_entities (storage contract)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(params=["memory", "sqlite"])
+def events_env(request, tmp_path):
+    if request.param == "memory":
+        s = Storage({"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+    else:
+        s = Storage({
+            "PIO_STORAGE_SOURCES_SQ_TYPE": "sqlite",
+            "PIO_STORAGE_SOURCES_SQ_PATH": str(tmp_path / "ev.db"),
+        })
+    app_id = s.get_meta_data_apps().insert(App(0, "fbe"))
+    ev = s.get_events()
+    ev.init(app_id)
+    for u in range(4):
+        for k in range(6):
+            ev.insert(Event(
+                event="view" if k % 2 == 0 else "buy",
+                entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item", target_entity_id=f"i{k}",
+                event_time=T0 + dt.timedelta(seconds=u * 10 + k)), app_id)
+    yield ev, app_id
+    s.close()
+
+
+def test_find_by_entities_matches_per_entity_find(events_env):
+    ev, app_id = events_env
+    wanted = ["u1", "u3", "missing"]
+    for kwargs in (
+        {},
+        {"event_names": ("view",)},
+        {"limit_per_entity": 2, "reversed": True},
+        {"limit_per_entity": 3, "reversed": False},
+    ):
+        got = ev.find_by_entities(app_id, "user", wanted, **kwargs)
+        assert set(got) == set(wanted)
+        for eid in wanted:
+            want = list(ev.find(
+                app_id, entity_type="user", entity_id=eid,
+                event_names=kwargs.get("event_names"),
+                limit=kwargs.get("limit_per_entity"),
+                reversed=kwargs.get("reversed", False),
+            ))
+            assert [e.event_id for e in got[eid]] == \
+                [e.event_id for e in want], (eid, kwargs)
+    assert got["missing"] == []
+
+
+def test_find_by_entities_postgres_bulk_override():
+    """The postgres backend's single ``entity_id IN (...)`` keyset scan
+    matches per-entity ``find`` exactly (deterministic (event_time, id)
+    ordering), driven against the FakePG wire fixture."""
+    from tests.fixtures.fake_pg import FakePG
+
+    server = FakePG()
+    try:
+        s = Storage({
+            "PIO_STORAGE_SOURCES_PG_TYPE": "postgres",
+            "PIO_STORAGE_SOURCES_PG_HOST": "127.0.0.1",
+            "PIO_STORAGE_SOURCES_PG_PORT": str(server.port),
+            "PIO_STORAGE_SOURCES_PG_USERNAME": "pio",
+            "PIO_STORAGE_SOURCES_PG_PASSWORD": "pio",
+            "PIO_STORAGE_SOURCES_PG_DATABASE": "pio",
+        })
+        ev = s.get_events()
+        ev.init(7)
+        for u in range(3):
+            for k in range(5):
+                ev.insert(Event(
+                    event="view", entity_type="user", entity_id=f"u{u}",
+                    target_entity_type="item", target_entity_id=f"i{k}",
+                    event_time=T0 + dt.timedelta(seconds=k)), 7)
+        got = ev.find_by_entities(
+            7, "user", ["u0", "u2", "ghost"], event_names=("view",),
+            limit_per_entity=3, reversed=True)
+        for eid in ("u0", "u2"):
+            want = list(ev.find(7, entity_type="user", entity_id=eid,
+                                event_names=("view",), limit=3, reversed=True))
+            assert [e.event_id for e in got[eid]] == \
+                [e.event_id for e in want]
+            assert len(got[eid]) == 3
+        assert got["ghost"] == []
+        s.close()
+    finally:
+        server.close()
+
+
+# ---------------------------------------------------------------------------
+# shared fixtures: an e-commerce world with live business rules
+# ---------------------------------------------------------------------------
+
+N_USERS, N_ITEMS, RANK = 30, 400, 16
+
+
+@pytest.fixture(scope="module")
+def ecomm_env():
+    from incubator_predictionio_tpu.models.two_tower import (
+        TwoTowerConfig,
+        TwoTowerModel,
+    )
+    from incubator_predictionio_tpu.templates.ecommerce import ECommModel
+
+    s = Storage({"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+    app_id = s.get_meta_data_apps().insert(App(0, "batchserve"))
+    ev = s.get_events()
+    ev.init(app_id)
+    rng = np.random.default_rng(5)
+    cats = {f"i{i}": (f"c{i % 5}", f"g{i % 3}") for i in range(N_ITEMS)}
+    for i in range(N_ITEMS):
+        ev.insert(Event(
+            event="$set", entity_type="item", entity_id=f"i{i}",
+            properties=DataMap({"categories": list(cats[f"i{i}"])}),
+            event_time=T0), app_id)
+    for u in range(N_USERS):
+        for i in map(int, rng.integers(0, N_ITEMS, 15)):
+            ev.insert(Event(
+                event="view", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item", target_entity_id=f"i{i}",
+                event_time=T0 + dt.timedelta(seconds=u * 100 + i)), app_id)
+    # an unknown-to-the-model user WITH recent views (predictSimilar path)
+    for i in (3, 17, 40):
+        ev.insert(Event(
+            event="view", entity_type="user", entity_id="drifter",
+            target_entity_type="item", target_entity_id=f"i{i}",
+            event_time=T0 + dt.timedelta(days=1, seconds=i)), app_id)
+    ev.insert(Event(
+        event="$set", entity_type="constraint", entity_id="unavailableItems",
+        properties=DataMap({"items": ["i5", "i123"]}),
+        event_time=T0 + dt.timedelta(days=2)), app_id)
+    norm = rng.standard_normal((N_ITEMS, RANK)).astype(np.float32)
+    norm /= np.linalg.norm(norm, axis=1, keepdims=True) + 1e-9
+    model = ECommModel(
+        mf=TwoTowerModel(
+            user_emb=rng.standard_normal((N_USERS, RANK)).astype(np.float32),
+            item_emb=rng.standard_normal((N_ITEMS, RANK)).astype(np.float32),
+            user_bias=rng.standard_normal(N_USERS).astype(np.float32),
+            item_bias=rng.standard_normal(N_ITEMS).astype(np.float32),
+            mean=2.0, config=TwoTowerConfig(rank=RANK)),
+        user_map=BiMap.string_int(f"u{u}" for u in range(N_USERS)),
+        item_map=BiMap.string_int(f"i{i}" for i in range(N_ITEMS)),
+        categories=cats,
+        popularity=rng.integers(0, 100, N_ITEMS).astype(np.float32),
+        item_vecs_norm=norm,
+    )
+    prev = use_storage(s)
+    yield s, app_id, model
+    use_storage(prev)
+    s.close()
+
+
+def _ecomm_algo(unseen_only=True, ttl=0.0, clock=None):
+    from incubator_predictionio_tpu.templates.ecommerce import (
+        ECommAlgorithm,
+        ECommAlgorithmParams,
+    )
+
+    algo = ECommAlgorithm(ECommAlgorithmParams(
+        app_name="batchserve", unseen_only=unseen_only))
+    algo._constraint_cache = TTLCache(ttl, clock=clock or FakeClock())
+    return algo
+
+
+def _ecomm_queries():
+    from incubator_predictionio_tpu.templates.ecommerce import Query
+
+    return [
+        Query(user="u0", num=10),
+        Query(user="u1", num=5, categories=("c1",)),
+        Query(user="u2", num=8, white_list=tuple(f"i{i}" for i in range(30))),
+        Query(user="u3", num=5, black_list=("i0", "i50", "ghost")),
+        Query(user="u4", num=6, categories=("c2", "c4"),
+              black_list=("i2",), white_list=tuple(f"i{i}" for i in range(2, 200))),
+        Query(user="stranger", num=5),          # popularity fallback
+        Query(user="drifter", num=7),           # predictSimilar fallback
+        Query(user="u5", num=3, categories=("nosuchcat",)),  # everything masked
+        Query(user="u0", num=10),               # duplicate user in one batch
+        Query(user="u6", num=0),                # degenerate num → empty
+        Query(user="u7", num=-3),               # degenerate num → empty
+    ]
+
+
+def _assert_strict_parity(serial, batched, field="item_scores"):
+    for i, sp in enumerate(serial):
+        bp = batched[i]
+        s_rows = [(x.item if field == "item_scores" else x.user, x.score)
+                  for x in getattr(sp, field)]
+        b_rows = [(x.item if field == "item_scores" else x.user, x.score)
+                  for x in getattr(bp, field)]
+        assert s_rows == b_rows, f"query {i}: {s_rows} != {b_rows}"
+
+
+def test_ecommerce_batch_parity(ecomm_env):
+    """Batched == serial, query for query: identical ids AND scores
+    (bitwise — both paths share the same per-row BLAS calls), across all
+    four filter kinds, both unknown-user fallbacks, and unseen-only."""
+    _, _, model = ecomm_env
+    queries = _ecomm_queries()
+    algo = _ecomm_algo(unseen_only=True)
+    serial = [algo.predict(model, q) for q in queries]
+    batched = dict(algo.batch_predict(model, list(enumerate(queries))))
+    _assert_strict_parity(serial, [batched[i] for i in range(len(queries))])
+    # the all-masked query really came back empty in both paths
+    assert serial[7].item_scores == ()
+    # and with unseen_only off (no seen read at all)
+    algo2 = _ecomm_algo(unseen_only=False)
+    serial2 = [algo2.predict(model, q) for q in queries]
+    batched2 = dict(algo2.batch_predict(model, list(enumerate(queries))))
+    _assert_strict_parity(serial2, [batched2[i] for i in range(len(queries))])
+
+
+def test_ecommerce_batch_parity_with_wire_bound_lists(ecomm_env):
+    """Queries bound from JSON carry filter fields as LISTS, not tuples
+    (bind_query does not coerce) — the batched path must stay vectorized
+    and parity-exact for them (regression: the rule-mask memo key was
+    unhashable for lists, silently dropping every filtered live batch to
+    the serial heal path)."""
+    _, _, model = ecomm_env
+    from incubator_predictionio_tpu.utils.json_util import bind_query
+    from incubator_predictionio_tpu.templates.ecommerce import Query
+
+    payloads = [
+        {"user": "u0", "num": 5, "categories": ["c1"]},
+        {"user": "u1", "num": 5, "blackList": ["i0", "i3"]},
+        {"user": "u2", "num": 5, "whiteList": [f"i{i}" for i in range(40)],
+         "categories": ["c0", "c2"]},
+        {"user": "u0", "num": 5, "categories": ["c1"]},  # repeats the memo key
+    ]
+    queries = [bind_query(Query, p) for p in payloads]
+    assert isinstance(queries[0].categories, list)  # the wire shape
+    algo = _ecomm_algo(unseen_only=True)
+    serial = [algo.predict(model, q) for q in queries]
+    batched = dict(algo.batch_predict(model, list(enumerate(queries))))
+    _assert_strict_parity(serial, [batched[i] for i in range(len(queries))])
+
+
+def test_ecommerce_unavailable_items_respected_in_batch(ecomm_env):
+    _, _, model = ecomm_env
+    from incubator_predictionio_tpu.templates.ecommerce import Query
+
+    algo = _ecomm_algo()
+    got = dict(algo.batch_predict(
+        model, [(0, Query(user="u0", num=N_ITEMS))]))
+    items = {x.item for x in got[0].item_scores}
+    assert not items.intersection({"i5", "i123"})
+
+
+@pytest.fixture
+def counting_store(ecomm_env):
+    from tests.fixtures.counting_events import CountingEvents
+
+    s, app_id, model = ecomm_env
+    proxy = CountingEvents(s.get_events())
+    orig = s.get_events
+    s.get_events = lambda: proxy
+    yield proxy, model
+    s.get_events = orig
+
+
+def test_batch_store_reads_are_o1_not_ob(counting_store):
+    """THE regression bar: a coalesced batch of B queries costs O(1) reads
+    (1 constraint + 1 seen batch + 1 recent batch), not O(B); a second batch
+    inside the TTL window drops the constraint read too. The serial loop
+    (reference semantics) costs ≥ 2 reads per query."""
+    proxy, model = counting_store
+    queries = _ecomm_queries()
+    clock = FakeClock()
+    algo = _ecomm_algo(unseen_only=True, ttl=30.0, clock=clock)
+
+    base = proxy.total_reads
+    batched = dict(algo.batch_predict(model, list(enumerate(queries))))
+    first_cost = proxy.total_reads - base
+    # 1 unavailable + ONE union history read (seen-items for all users AND
+    # the two unknown users' recent views) — NOT 2 × 9
+    assert first_cost == 2, proxy.counts
+    assert len(batched) == len(queries)
+
+    base = proxy.total_reads
+    algo.batch_predict(model, list(enumerate(queries)))
+    second_cost = proxy.total_reads - base
+    assert second_cost == 1  # constraint still cached (TTL window)
+
+    clock.advance(31.0)
+    base = proxy.total_reads
+    algo.batch_predict(model, list(enumerate(queries)))
+    assert proxy.total_reads - base == 2  # TTL expired → constraint re-read
+
+    # the serial oracle with reference read-per-query semantics: O(B)
+    serial_algo = _ecomm_algo(unseen_only=True, ttl=0.0)
+    base = proxy.total_reads
+    for q in queries:
+        serial_algo.predict(model, q)
+    assert proxy.total_reads - base >= 2 * len(queries)
+
+
+# ---------------------------------------------------------------------------
+# similarproduct parity
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def simprod_model():
+    from incubator_predictionio_tpu.templates.similarproduct import ItemSimModel
+
+    rng = np.random.default_rng(9)
+    n, k = 300, 8
+    vecs = rng.standard_normal((n, k)).astype(np.float32)
+    vecs /= np.linalg.norm(vecs, axis=1, keepdims=True) + 1e-9
+    cats = {f"i{i}": (f"c{i % 4}",) for i in range(n)}
+    return ItemSimModel(
+        item_vecs=vecs,
+        item_map=BiMap.string_int(f"i{i}" for i in range(n)),
+        categories=cats,
+    ).prepare_for_serving()
+
+
+def test_similarproduct_batch_parity(simprod_model):
+    from incubator_predictionio_tpu.templates.similarproduct import (
+        ALSAlgorithm,
+        ALSAlgorithmParams,
+        Query,
+    )
+
+    algo = ALSAlgorithm(ALSAlgorithmParams())
+    queries = [
+        Query(items=("i0", "i7"), num=10),
+        Query(items=("i3",), num=5, categories=("c1",)),
+        Query(items=("i4", "i5", "i6"), num=8,
+              category_black_list=("c2",)),
+        Query(items=("i10",), num=6, white_list=tuple(f"i{i}" for i in range(50))),
+        Query(items=("i11", "i2"), num=5, black_list=("i20", "i21")),
+        Query(items=("missing1", "missing2"), num=5),  # no known → empty
+        Query(items=("i0", "alsomissing"), num=4),     # partial known
+    ]
+    serial = [algo.predict(simprod_model, q) for q in queries]
+    batched = dict(algo.batch_predict(simprod_model, list(enumerate(queries))))
+    _assert_strict_parity(serial, [batched[i] for i in range(len(queries))])
+    assert serial[5].item_scores == () and batched[5].item_scores == ()
+
+
+# ---------------------------------------------------------------------------
+# recommended_user parity
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def recuser_model():
+    from incubator_predictionio_tpu.templates.recommended_user import (
+        SimilarUserModel,
+    )
+
+    rng = np.random.default_rng(13)
+    n, k = 250, 8
+    vecs = rng.standard_normal((n, k)).astype(np.float32)
+    vecs /= np.linalg.norm(vecs, axis=1, keepdims=True) + 1e-9
+    return SimilarUserModel(
+        user_vecs=vecs,
+        user_map=BiMap.string_int(f"u{i}" for i in range(n)),
+    ).prepare_for_serving()
+
+
+def test_recommended_user_batch_parity(recuser_model):
+    from incubator_predictionio_tpu.templates.recommended_user import (
+        ALSAlgorithm,
+        ALSAlgorithmParams,
+        Query,
+    )
+
+    algo = ALSAlgorithm(ALSAlgorithmParams())
+    queries = [
+        Query(users=("u0", "u9"), num=10),
+        Query(users=("u3",), num=5, white_list=tuple(f"u{i}" for i in range(40))),
+        Query(users=("u4", "u5"), num=8, black_list=("u6", "u7")),
+        Query(users=("nobody",), num=5),               # unknown → empty
+        Query(users=("u8", "gone"), num=6),            # partial known
+        Query(users=("u1",), num=4,
+              white_list=("u2",), black_list=("u2",)),  # fully masked
+    ]
+    serial = [algo.predict(recuser_model, q) for q in queries]
+    batched = dict(algo.batch_predict(recuser_model, list(enumerate(queries))))
+    _assert_strict_parity(serial, [batched[i] for i in range(len(queries))],
+                          field="similar_user_scores")
+    assert batched[3].similar_user_scores == ()
+    assert batched[5].similar_user_scores == ()
+    # the score>0 reference cut holds in the batched path
+    for i in range(len(queries)):
+        assert all(x.score > 0 for x in batched[i].similar_user_scores)
+
+
+# ---------------------------------------------------------------------------
+# device-path row mask (ops/retrieval + recommend_batch)
+# ---------------------------------------------------------------------------
+
+def test_recommend_batch_row_mask_matches_serial_exclude():
+    """Per-row [B, N] masks through the single dispatch == the serial
+    per-query exclude path, on both the host and (jnp-oracle) device path."""
+    from incubator_predictionio_tpu.models.two_tower import (
+        TwoTowerConfig,
+        TwoTowerModel,
+        TwoTowerMF,
+    )
+
+    rng = np.random.default_rng(21)
+    n_u, n_i, k = 20, 120, 8
+    base = dict(
+        user_emb=rng.standard_normal((n_u, k)).astype(np.float32),
+        item_emb=rng.standard_normal((n_i, k)).astype(np.float32),
+        user_bias=rng.standard_normal(n_u).astype(np.float32),
+        item_bias=rng.standard_normal(n_i).astype(np.float32),
+        mean=1.5, config=TwoTowerConfig(rank=k),
+    )
+    users = np.asarray([1, 7, 13], np.int32)
+    excludes = [np.asarray(e, np.int64) for e in ([0, 5], [9], [2, 4, 6])]
+    row_mask = np.zeros((3, n_i), np.float32)
+    for r, e in enumerate(excludes):
+        row_mask[r, e] = -np.inf
+    for host in (True, False):
+        model = TwoTowerModel(**base)
+        model.prepare_for_serving(
+            host_max_elements=10_000_000 if host else 0, serve_k=10)
+        idx_b, sc_b = TwoTowerMF.recommend_batch(
+            model, users, 10, row_mask=row_mask)
+        for r in range(3):
+            idx_1, sc_1 = TwoTowerMF.recommend(
+                model, int(users[r]), 10, exclude=excludes[r])
+            np.testing.assert_array_equal(idx_b[r], idx_1)
+            np.testing.assert_allclose(sc_b[r], sc_1, rtol=1e-6, atol=1e-6)
+            assert not set(idx_b[r]).intersection(excludes[r].tolist())
+
+
+def test_template_batch_size_histogram_recorded():
+    """DeployedEngine.predict_batch observes each dispatch's live-query
+    count into the per-template batch-size histogram (the obs satellite)."""
+    import dataclasses as _dc
+    import datetime as _dt
+
+    from incubator_predictionio_tpu.core import EngineParams
+    from incubator_predictionio_tpu.data.storage.base import EngineInstance
+    from incubator_predictionio_tpu.server.query_server import DeployedEngine
+    from tests.fixtures.sample_engine import AlgoParams, simple_engine
+
+    engine = simple_engine()
+    params = EngineParams.create(algorithms=[("algo", AlgoParams(mult=2))])
+    instance = EngineInstance(
+        id="i1", status="COMPLETED", start_time=_dt.datetime.now(UTC),
+        end_time=None, engine_id="default", engine_version="1",
+        engine_variant="v", engine_factory="f")
+    deployed = DeployedEngine(engine, params, instance,
+                              [{"sum": 3, "mult": 2}], warmup=False)
+    fam = REGISTRY.get("pio_serving_template_batch_size")
+    child = fam.labels(template="SampleAlgorithm")
+    before = child.snapshot()[2]
+    out = deployed.predict_batch([1, 2, 3, 4, 5])
+    assert all(not isinstance(r, Exception) for r in out)
+    _, total, count = child.snapshot()
+    assert count == before + 1          # one dispatch observed...
+    assert total >= 5                   # ...with the batch's live size
+    assert "pio_serving_template_batch_size_bucket" in REGISTRY.expose()
+
+
+def test_score_catalog_row_mask_kernel_parity():
+    """Row-masked Pallas kernel (interpret mode) == the jnp reference."""
+    import jax.numpy as jnp
+
+    from incubator_predictionio_tpu.ops.retrieval import (
+        pad_catalog,
+        quantize_rows,
+        score_catalog_quantized,
+        score_catalog_reference,
+    )
+
+    rng = np.random.default_rng(3)
+    n, d, b = 700, 16, 4
+    items_q, scales = quantize_rows(
+        rng.standard_normal((n, d)).astype(np.float32))
+    items_q, scales, bias, mask = pad_catalog(
+        items_q, scales, rng.standard_normal(n).astype(np.float32),
+        np.zeros(n, np.float32))
+    q = rng.standard_normal((b, d)).astype(np.float32)
+    row_mask = np.zeros((b, items_q.shape[0]), np.float32)
+    row_mask[np.arange(b), rng.integers(0, n, b)] = -np.inf
+    args = tuple(jnp.asarray(v) for v in (q, items_q, scales, bias, mask,
+                                          row_mask))
+    got = np.asarray(score_catalog_quantized(*args, interpret=True))
+    want = np.asarray(score_catalog_reference(*args))
+    np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-2)
+    assert np.isneginf(got[np.arange(b), :n][row_mask[:, :n] == -np.inf]).all()
+    with pytest.raises(ValueError, match="row_mask"):
+        score_catalog_quantized(*args[:5], jnp.zeros((b + 1, items_q.shape[0])),
+                                interpret=True)

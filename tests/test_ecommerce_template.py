@@ -65,6 +65,12 @@ def trained(env):
         )
         models = engine.train(ctx, params)
         algos, serving = engine.serving_and_algorithms(params)
+        # TTL=0 → read-per-query reference semantics: these tests assert that
+        # constraint writes are visible on the NEXT predict (the TTL cache
+        # itself is covered by tests/test_batched_serving.py)
+        from incubator_predictionio_tpu.serving import TTLCache
+
+        algos[0]._constraint_cache = TTLCache(0)
         yield engine, params, models[0], algos[0], serving
     finally:
         use_storage(prev)

@@ -145,12 +145,18 @@ def test_serving_buckets_no_compile_churn():
     jitstats.reset()
     model.warmup(max_batch=16)
     warmed = jitstats.count()
-    assert warmed == 5  # buckets 1, 2, 4, 8, 16
+    # buckets 1, 2, 4, 8, 16 × (plain, rule-filtered row-mask) variants
+    assert warmed == 10
     rng = np.random.default_rng(0)
     for b, num in [(1, 1), (3, 5), (5, 10), (7, 3), (16, 10), (2, 8)]:
         users = rng.integers(0, 30, b).astype(np.int32)
         idx, sc = TwoTowerMF.recommend_batch(model, users, num)
         assert idx.shape == (b, num) and sc.shape == (b, num)
+        # rule-filtered batches dispatch into the warmed row-mask variant
+        rm = np.zeros((b, model.n_items), np.float32)
+        rm[:, 0] = -np.inf
+        idx, sc = TwoTowerMF.recommend_batch(model, users, num, row_mask=rm)
+        assert idx.shape == (b, num) and not (idx == 0).any()
     assert jitstats.count() == warmed  # zero new executables under load
     # num > serve_k falls back to an exact (new) executable
     TwoTowerMF.recommend_batch(model, np.zeros(1, np.int32), 40)
