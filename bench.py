@@ -983,6 +983,55 @@ def bench_ingestion() -> dict:
 
 # ---------------------------------------------------------------------------
 
+def bench_ingest_durability() -> dict:
+    """The durability tax, isolated (ISSUE 4): spill-ack throughput with
+    the in-memory deque (PR 1's crash-lossy baseline) vs the WAL with and
+    without fsync. Batches of 50 mirror the event server's group-commit
+    (one append+fsync per /batch request), so the fsync lane measures what
+    a spilled batch ack actually pays on this host's storage."""
+    import collections
+    import tempfile
+
+    from incubator_predictionio_tpu.resilience.wal import SpillWal
+
+    N_BATCHES, BATCH = 40, 50
+
+    def mk_batch(b: int) -> list[dict]:
+        return [{"event": {"event": "rate", "entityType": "user",
+                           "entityId": f"u{b}-{i}", "eventId": f"{b:04d}{i:04d}",
+                           "eventTime": "2024-01-01T00:00:00Z",
+                           "properties": {"rating": 5}},
+                 "app_id": 1, "channel_id": None} for i in range(BATCH)]
+
+    batches = [mk_batch(b) for b in range(N_BATCHES)]
+    out: dict[str, float] = {}
+
+    t0 = time.perf_counter()
+    dq: collections.deque = collections.deque()
+    for batch in batches:
+        dq.extend(batch)
+    out["memory_events_per_sec"] = N_BATCHES * BATCH / max(
+        time.perf_counter() - t0, 1e-9)
+
+    for label, fsync in (("wal_nofsync", False), ("wal_fsync", True)):
+        with tempfile.TemporaryDirectory() as d:
+            wal = SpillWal(d, fsync=fsync)
+            t0 = time.perf_counter()
+            for batch in batches:
+                wal.append([dict(r) for r in batch])
+            dt = time.perf_counter() - t0
+            wal.close()
+        out[f"{label}_events_per_sec"] = N_BATCHES * BATCH / dt
+        out[f"{label}_batch_ms"] = dt / N_BATCHES * 1e3
+    # the headline ratio BENCH_*.json tracks from this PR on: how much of
+    # the in-memory ack rate survives the fsync-on-ack contract
+    out["fsync_tax_vs_memory"] = (
+        out["wal_fsync_events_per_sec"] / out["memory_events_per_sec"])
+    out["fsync_tax_vs_nofsync"] = (
+        out["wal_fsync_events_per_sec"] / out["wal_nofsync_events_per_sec"])
+    return out
+
+
 def build_result_line(configs: dict, device_info: dict,
                       wedged: str | None = None) -> str:
     """The single JSON artifact line. A non-TPU platform (probe fallback,
@@ -1012,12 +1061,13 @@ def build_result_line(configs: dict, device_info: dict,
     return json.dumps(line)
 
 
-# suite order; only "ingestion" never touches the device (it benches the
-# event servers' durable write path), so it survives a dead tunnel on CPU
+# suite order; "ingestion" and "ingest_durability" never touch the device
+# (they bench the event servers' durable write paths), so they survive a
+# dead tunnel on CPU
 CONFIG_NAMES = ["recommendation", "recommendation_scaled", "classification",
                 "similarproduct", "ecommerce_retrieval", "sequential",
-                "serving", "ingestion"]
-DEVICE_FREE = {"ingestion"}
+                "serving", "ingestion", "ingest_durability"]
+DEVICE_FREE = {"ingestion", "ingest_durability"}
 
 
 def _build_suite(ctx, peaks, device) -> dict:
@@ -1031,6 +1081,7 @@ def _build_suite(ctx, peaks, device) -> dict:
         "sequential": lambda: bench_sequential(ctx, peaks, device),
         "serving": lambda: bench_serving(ctx),
         "ingestion": lambda: bench_ingestion(),
+        "ingest_durability": lambda: bench_ingest_durability(),
     }
 
 

@@ -329,6 +329,25 @@ class ESEvents(EventStore):
         reversed: bool = False,
     ) -> Iterator[Event]:
         idx = self._index(app_id, channel_id)
+        query = self._bool_query(
+            start_time, until_time, entity_type, entity_id, event_names,
+            target_entity_type, target_entity_id)
+        remaining = None if limit is None or limit < 0 else limit
+        n = 0
+        for hit in self._paged_hits(idx, query, reversed, remaining):
+            if remaining is not None and n >= remaining:
+                return
+            n += 1
+            yield Event.from_json_dict(hit["_source"]["doc"])
+
+    @staticmethod
+    def _bool_query(
+        start_time, until_time, entity_type, entity_id, event_names,
+        target_entity_type, target_entity_id,
+        entity_ids: Optional[Sequence[str]] = None,
+    ) -> dict:
+        """The shared filter construction for find/find_by_entities —
+        ONE translation of the contract's filter semantics to ES."""
         must: list[dict] = []
         must_not: list[dict] = []
         rng: dict[str, int] = {}
@@ -342,6 +361,9 @@ class ESEvents(EventStore):
             must.append({"term": {"entityType": entity_type}})
         if entity_id is not None:
             must.append({"term": {"entityId": entity_id}})
+        if entity_ids is not None:
+            # the bulk read: one terms filter covers the whole batch
+            must.append({"terms": {"entityId": list(entity_ids)}})
         if event_names is not None:
             must.append({"terms": {"event": list(event_names)}})
         for field, flt in (("targetEntityType", target_entity_type),
@@ -352,40 +374,91 @@ class ESEvents(EventStore):
                 must_not.append({"exists": {"field": field}})
             else:
                 must.append({"term": {field: flt}})
-        query = {"bool": {"filter": must, "must_not": must_not}}
+        return {"bool": {"filter": must, "must_not": must_not}}
+
+    def _paged_hits(self, idx: str, query: dict, reversed: bool,
+                    remaining: Optional[int]):
+        """search_after pagination in contract order (time, then the unique
+        tiebreak) — never requests more docs than the limit still needs."""
         order = "desc" if reversed else "asc"
         sort = [{"eventTimeMillis": order}, {"tiebreak": order}]
-        remaining = None if limit is None or limit < 0 else limit
-
-        def pages():
-            search_after = None
-            served = 0
-            while True:
-                # never request more docs than the limit still needs
-                size = (_PAGE if remaining is None
-                        else min(_PAGE, remaining - served))
-                if size <= 0:
-                    return
-                body = {"query": query, "sort": sort, "size": size}
-                if search_after is not None:
-                    body["search_after"] = search_after
-                _, out = self._t.call("POST", f"/{idx}/_search", body,
-                                      idempotent=True)  # search is a read
-                hits = out.get("hits", {}).get("hits", [])
-                if not hits:
-                    return
-                yield from hits
-                served += len(hits)
-                if len(hits) < size:
-                    return
-                search_after = hits[-1]["sort"]
-
-        n = 0
-        for hit in pages():
-            if remaining is not None and n >= remaining:
+        search_after = None
+        served = 0
+        while True:
+            size = (_PAGE if remaining is None
+                    else min(_PAGE, remaining - served))
+            if size <= 0:
                 return
-            n += 1
-            yield Event.from_json_dict(hit["_source"]["doc"])
+            body = {"query": query, "sort": sort, "size": size}
+            if search_after is not None:
+                body["search_after"] = search_after
+            _, out = self._t.call("POST", f"/{idx}/_search", body,
+                                  idempotent=True)  # search is a read
+            hits = out.get("hits", {}).get("hits", [])
+            if not hits:
+                return
+            yield from hits
+            served += len(hits)
+            if len(hits) < size:
+                return
+            search_after = hits[-1]["sort"]
+
+    def find_by_entities(
+        self,
+        app_id: int,
+        entity_type: str,
+        entity_ids: Sequence[str],
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Any = UNSET,
+        target_entity_id: Any = UNSET,
+        limit_per_entity: Optional[int] = None,
+        reversed: bool = False,
+    ) -> dict[str, list[Event]]:
+        """One ``terms``-filtered search for the whole entity batch instead
+        of the contract default's B per-entity searches (ROADMAP open
+        item). Hits stream back in the same (time, tiebreak) order a
+        per-entity ``find`` uses, so the shared grouping/cap loop yields
+        per-entity lists identical to B separate reads. The per-entity
+        limit is applied while grouping — a hot entity's surplus still
+        crosses the wire (pushing it into ES needs a top_hits aggregation,
+        which loses the streamed pagination), but the query count stays 1."""
+        ids = list(dict.fromkeys(entity_ids))
+        if not ids:
+            return {}
+        idx = self._index(app_id, channel_id)
+        query = self._bool_query(
+            start_time, until_time, entity_type, None, event_names,
+            target_entity_type, target_entity_id, entity_ids=ids)
+        events = (Event.from_json_dict(h["_source"]["doc"])
+                  for h in self._paged_hits(idx, query, reversed, None))
+        limit = (limit_per_entity if limit_per_entity is not None
+                 and limit_per_entity >= 0 else None)
+        if limit is not None:
+            # stop consuming — and therefore PAGING — once every requested
+            # entity's cap is met: a hot entity's 50k-event history must
+            # not cross the wire to serve a latest-10 read
+            events = self._until_filled(events, ids, limit)
+        return self.group_events_by_entity(events, ids, limit_per_entity)
+
+    @staticmethod
+    def _until_filled(events, ids, limit: int):
+        remaining = {eid: limit for eid in ids}
+        unfilled = len(remaining) if limit > 0 else 0
+        if unfilled == 0:
+            return
+        for e in events:
+            yield e
+            r = remaining.get(e.entity_id)
+            if r is None or r == 0:
+                continue
+            remaining[e.entity_id] = r - 1
+            if r == 1:
+                unfilled -= 1
+                if unfilled == 0:
+                    return
 
 
 # ---------------------------------------------------------------------------

@@ -468,6 +468,37 @@ class EventLogEvents(EventStore):
         for _, _, e in out:
             yield e
 
+    def find_by_entities(
+        self,
+        app_id: int,
+        entity_type: str,
+        entity_ids: "Sequence[str]",
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        event_names: "Optional[Sequence[str]]" = None,
+        target_entity_type: Any = UNSET,
+        target_entity_id: Any = UNSET,
+        limit_per_entity: Optional[int] = None,
+        reversed: bool = False,
+    ) -> dict[str, list[Event]]:
+        """ONE log scan for the whole entity batch — the contract default
+        would rescan (and native-scan-sort) the log once per entity. The
+        scan filters on everything but entity_id (the scanner has no set
+        predicate); membership is applied while grouping, in the same
+        (time, offset) order a per-entity ``find`` yields, so per-entity
+        results match the per-entity read exactly."""
+        ids = list(dict.fromkeys(entity_ids))
+        if not ids:
+            return {}
+        wanted = set(ids)
+        events = (e for e in self.find(
+            app_id, channel_id, start_time, until_time, entity_type, None,
+            event_names, target_entity_type, target_entity_id,
+            None, reversed=reversed,
+        ) if e.entity_id in wanted)
+        return self.group_events_by_entity(events, ids, limit_per_entity)
+
     def assemble_triples(
         self,
         app_id: int,

@@ -6,6 +6,7 @@ import os
 from typing import Optional
 
 from incubator_predictionio_tpu.data.storage.base import Model, ModelsStore, StorageClient
+from incubator_predictionio_tpu.utils.fs import atomic_write_bytes
 
 
 class LocalFSModels(ModelsStore):
@@ -20,10 +21,10 @@ class LocalFSModels(ModelsStore):
         return os.path.join(self._path, model_id)
 
     def insert(self, model: Model) -> None:
-        tmp = self._file(model.id) + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(model.models)
-        os.replace(tmp, self._file(model.id))
+        # tmp + fsync + rename + dir fsync: a crash mid-train can never
+        # leave a deployable-looking torn blob, and a written blob survives
+        # power loss (the train→deploy handoff's durability contract)
+        atomic_write_bytes(self._file(model.id), model.models)
 
     def get(self, model_id: str) -> Optional[Model]:
         try:

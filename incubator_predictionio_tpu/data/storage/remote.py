@@ -251,7 +251,7 @@ class _Transport:
     #: response was lost may already have committed — the caller decides.
     _IDEMPOTENT = frozenset({
         "get", "get_all", "get_by_name", "get_by_app_id",
-        "aggregate_properties", "init",
+        "aggregate_properties", "find_by_entities", "init",
     })
 
     def call(self, store: str, method: str, args: dict) -> Any:
@@ -355,6 +355,39 @@ class RemoteEventStore(EventStore):
         _enc_opt_filter(args, "target_entity_type", target_entity_type)
         _enc_opt_filter(args, "target_entity_id", target_entity_id)
         return self._stream_find(args)
+
+    def find_by_entities(
+        self,
+        app_id: int,
+        entity_type: str,
+        entity_ids: Sequence[str],
+        channel_id: Optional[int] = None,
+        start_time: Optional[_dt.datetime] = None,
+        until_time: Optional[_dt.datetime] = None,
+        event_names: Optional[Sequence[str]] = None,
+        target_entity_type: Any = UNSET,
+        target_entity_id: Any = UNSET,
+        limit_per_entity: Optional[int] = None,
+        reversed: bool = False,
+    ) -> dict[str, list[Event]]:
+        """ONE unary RPC for the whole entity batch — the contract default
+        would loop B streaming ``find`` calls over the network, turning the
+        batched-serving O(1)-reads property into O(B) socket round trips on
+        split query-server/storage-server topologies. The server runs its
+        backing store's own bulk override and returns the grouped map."""
+        args: dict[str, Any] = {
+            "app_id": app_id, "entity_type": entity_type,
+            "entity_ids": list(entity_ids), "channel_id": channel_id,
+            "start_time": enc_dt(start_time), "until_time": enc_dt(until_time),
+            "event_names": (list(event_names)
+                            if event_names is not None else None),
+            "limit_per_entity": limit_per_entity, "reversed": reversed,
+        }
+        _enc_opt_filter(args, "target_entity_type", target_entity_type)
+        _enc_opt_filter(args, "target_entity_id", target_entity_id)
+        raw = self._tp.call("events", "find_by_entities", args)
+        return {eid: [Event.from_json_dict(d) for d in evs]
+                for eid, evs in raw.items()}
 
     def find_sharded(
         self,

@@ -50,6 +50,10 @@ from incubator_predictionio_tpu.resilience.policy import (
     policy_from_config,
     run_with_deadline,
 )
+from incubator_predictionio_tpu.resilience.wal import (
+    SpillWal,
+    WalError,
+)
 
 __all__ = [
     "BREAKERS", "BreakerRegistry", "CircuitBreaker", "CircuitOpenError",
@@ -57,6 +61,7 @@ __all__ = [
     "FaultInjector", "FaultProxy", "FaultSchedule",
     "Ok", "PartialWrite", "Reset", "Slow", "Timeout",
     "Deadline", "DeadlineExceeded", "ResiliencePolicy", "RetryPolicy",
-    "ServingUnavailable", "TransientError", "current_deadline",
-    "deadline_scope", "policy_from_config", "run_with_deadline",
+    "ServingUnavailable", "SpillWal", "TransientError", "WalError",
+    "current_deadline", "deadline_scope", "policy_from_config",
+    "run_with_deadline",
 ]

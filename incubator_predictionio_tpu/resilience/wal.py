@@ -1,0 +1,421 @@
+"""Write-ahead log for the event server's spill queue (docs/resilience.md).
+
+PR 1's spill queue made the event server *available* through backend
+outages — accepted events were held in memory and drained on recovery —
+but an ack was only as durable as the process: ``kill -9`` lost every
+queued 201. This module makes the ack a promise. The contract:
+
+- **fsync-on-ack.** ``append()`` returns only after the frames are flushed
+  AND fsynced to the active segment, so the caller may answer 201 knowing
+  the events survive an immediate power cut.
+- **CRC-framed segments.** Each segment file starts with an 8-byte magic
+  and holds frames of ``[u32 length][u32 crc32(payload)][payload]``; the
+  payload is one JSON record ``{"seq", "event", "app_id", "channel_id"}``.
+  A torn write (partial frame at the tail, the normal crash artifact)
+  or a flipped bit is detected by length/CRC and cleanly terminates the
+  scan of that segment — everything before it replays.
+- **Commit cursor, not in-place truncation.** The drainer calls
+  ``commit(seq)`` after a batch lands in the event store; the cursor file
+  is rewritten atomically (tmp + rename, deliberately *without* fsync:
+  losing a cursor update merely replays already-stored events, which is
+  harmless because event ids are pre-assigned and every backend overwrites
+  on replay). Segments whose records are all committed are deleted.
+- **Dead letters are still durable.** A batch the store rejects
+  *semantically* (it would be re-rejected identically on every replay)
+  moves to ``deadletter.log`` — same frame format — instead of vanishing,
+  and is counted on ``pio_spill_dead_letter_total``.
+- **Idempotent replay.** ``replay()`` returns every record past the
+  cursor, oldest first. The caller re-enqueues them; because ids were
+  assigned before the first ack, a record that *did* land before the crash
+  overwrites itself.
+
+``pio-tpu wal <dir>`` (tools/cli.py) inspects/verifies segments offline
+and can ``--replay`` them into a configured event store for manual
+recovery.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import struct
+import zlib
+from typing import Any, Iterator, Optional
+
+from incubator_predictionio_tpu.obs.metrics import REGISTRY
+
+logger = logging.getLogger(__name__)
+
+#: segment header. Version byte is part of the magic: a future frame-format
+#: change bumps it and old readers refuse loudly instead of mis-parsing.
+MAGIC = b"PIOWAL1\n"
+_FRAME = struct.Struct("<II")  # payload length, crc32(payload)
+_SEG_PREFIX = "wal-"
+_SEG_SUFFIX = ".log"
+DEAD_LETTER = "deadletter.log"
+_CURSOR = "committed.seq"
+
+_FSYNC_SECONDS = REGISTRY.histogram(
+    "pio_wal_fsync_seconds",
+    "Wall time of each WAL append's flush+fsync (the durability tax every "
+    "spilled ack pays; docs/resilience.md)",
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+             0.5, 1.0))
+_REPLAYED = REGISTRY.counter(
+    "pio_wal_replayed_total",
+    "WAL records replayed into the spill queue at startup (acked events a "
+    "previous process never managed to store)")
+DEAD_LETTER_TOTAL = REGISTRY.counter(
+    "pio_spill_dead_letter_total",
+    "Acked events diverted to the WAL dead-letter segment because the "
+    "event store rejected them non-transiently")
+_TORN = REGISTRY.counter(
+    "pio_wal_torn_frames_total",
+    "WAL frames discarded at replay because of a torn write or CRC mismatch")
+
+
+class WalError(Exception):
+    """Unrecoverable WAL I/O failure (disk full, unwritable dir) — the
+    caller must NOT ack the write it was trying to make durable."""
+
+
+def _crc(payload: bytes) -> int:
+    return zlib.crc32(payload) & 0xFFFFFFFF
+
+
+def write_frame(f, payload: bytes) -> None:
+    f.write(_FRAME.pack(len(payload), _crc(payload)))
+    f.write(payload)
+
+
+def iter_frames(path: str) -> Iterator[tuple[int, Optional[dict], str]]:
+    """Yield ``(offset, record_or_None, status)`` per frame in a segment.
+
+    ``status`` is ``"ok"`` or a human-readable defect (``"torn frame"``,
+    ``"crc mismatch"``, ``"bad json"``); scanning stops after the first
+    defect — past a corrupt length field nothing downstream is trustworthy.
+    Shared by replay and the ``pio-tpu wal`` inspector so what the CLI
+    calls valid is exactly what replay would recover.
+    """
+    with open(path, "rb") as f:
+        head = f.read(len(MAGIC))
+        if head != MAGIC:
+            yield 0, None, f"bad segment magic {head[:8]!r}"
+            return
+        off = len(MAGIC)
+        while True:
+            hdr = f.read(_FRAME.size)
+            if not hdr:
+                return  # clean end
+            if len(hdr) < _FRAME.size:
+                yield off, None, "torn frame (partial header)"
+                return
+            length, crc = _FRAME.unpack(hdr)
+            payload = f.read(length)
+            if len(payload) < length:
+                yield off, None, "torn frame (partial payload)"
+                return
+            if _crc(payload) != crc:
+                yield off, None, "crc mismatch"
+                return
+            try:
+                rec = json.loads(payload)
+            except ValueError:
+                yield off, None, "bad json"
+                return
+            yield off, rec, "ok"
+            off += _FRAME.size + length
+
+
+def _segment_seq(name: str) -> Optional[int]:
+    if not (name.startswith(_SEG_PREFIX) and name.endswith(_SEG_SUFFIX)):
+        return None
+    try:
+        return int(name[len(_SEG_PREFIX):-len(_SEG_SUFFIX)])
+    except ValueError:
+        return None
+
+
+def list_segments(directory: str) -> list[str]:
+    """Segment paths in append order (numeric, not lexicographic)."""
+    out = []
+    try:
+        names = os.listdir(directory)
+    except FileNotFoundError:
+        return []
+    for name in names:
+        n = _segment_seq(name)
+        if n is not None:
+            out.append((n, os.path.join(directory, name)))
+    return [p for _, p in sorted(out)]
+
+
+def read_cursor(directory: str) -> int:
+    try:
+        with open(os.path.join(directory, _CURSOR)) as f:
+            return int(f.read().strip() or 0)
+    except (FileNotFoundError, ValueError):
+        return 0
+
+
+class SpillWal:
+    """One process's spill WAL in ``directory`` (created on demand).
+
+    Not thread-safe by itself — the event server serializes access under
+    its spill lock, which is also what keeps the ack order and the WAL
+    order identical.
+    """
+
+    def __init__(self, directory: str, segment_bytes: int = 16 << 20,
+                 fsync: bool = True):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.segment_bytes = max(4096, segment_bytes)
+        self.fsync = fsync
+        self.committed = read_cursor(self.directory)
+        # segment path -> max seq it holds (known for fully-read segments;
+        # the active segment's entry tracks as we append)
+        self._seg_max: dict[str, int] = {}
+        self._next_seq = self.committed + 1
+        for path in list_segments(self.directory):
+            last = None
+            clean = True
+            for _, rec, status in iter_frames(path):
+                if status != "ok":
+                    clean = False
+                    break
+                last = rec["seq"]
+            if last is None and clean:
+                # empty leftover active segment from a prior open: drop it
+                # (a DEFECTIVE unreadable segment is kept for `pio-tpu wal`
+                # forensics instead)
+                try:
+                    os.remove(path)
+                except OSError:  # pragma: no cover
+                    pass
+                continue
+            if last is not None and last <= self.committed and clean:
+                # fully committed before the previous process exited
+                try:
+                    os.remove(path)
+                except OSError:  # pragma: no cover
+                    pass
+                continue
+            # defective segments get an infinite max so commit() can NEVER
+            # delete them: frames behind the defect are unreadable to
+            # replay but may still be recoverable by hand (`pio-tpu wal`)
+            self._seg_max[path] = (last if clean and last is not None
+                                   else float("inf"))
+            if last is not None:
+                self._next_seq = max(self._next_seq, last + 1)
+        # always open a FRESH segment: appending after a torn tail would
+        # bury valid frames behind a defect the scanner stops at
+        self._active_path = os.path.join(
+            self.directory,
+            f"{_SEG_PREFIX}{self._next_segment_number():08d}{_SEG_SUFFIX}")
+        self._active = open(self._active_path, "ab")
+        self._active.write(MAGIC)
+        self._active.flush()
+        self._seg_max[self._active_path] = 0
+        self.dead_letter_count = self._count_dead_letters()
+
+    def _next_segment_number(self) -> int:
+        nums = [_segment_seq(os.path.basename(p)) or 0 for p in self._seg_max]
+        return (max(nums) + 1) if nums else 1
+
+    def _count_dead_letters(self) -> int:
+        path = os.path.join(self.directory, DEAD_LETTER)
+        if not os.path.exists(path):
+            return 0
+        return sum(1 for _, _, status in iter_frames(path) if status == "ok")
+
+    # -- write path -------------------------------------------------------
+    def append(self, records: list[dict]) -> int:
+        """Durably append ``records`` (dicts WITHOUT ``seq``; sequence
+        numbers are assigned here). Returns the last assigned seq. Raises
+        :class:`WalError` on any I/O failure — the caller must not ack."""
+        import time as _time
+
+        try:
+            for rec in records:
+                rec = dict(rec, seq=self._next_seq)
+                write_frame(self._active,
+                            json.dumps(rec, separators=(",", ":")).encode())
+                self._seg_max[self._active_path] = self._next_seq
+                self._next_seq += 1
+            t0 = _time.perf_counter()
+            self._active.flush()
+            if self.fsync:
+                os.fsync(self._active.fileno())
+            _FSYNC_SECONDS.observe(_time.perf_counter() - t0)
+        except (OSError, ValueError) as e:
+            # ValueError: write on a closed file object — same disk-death
+            # class as an OSError for the caller's ack decision
+            raise WalError(f"WAL append failed: {e}") from e
+        if self._active.tell() >= self.segment_bytes:
+            self._rotate()
+        return self._next_seq - 1
+
+    def _rotate(self) -> None:
+        """Open-new-first, then swap: a rotation failure (ENOSPC on the new
+        segment…) keeps appending to the oversized current segment instead
+        of raising — the records this append() call just fsynced ARE
+        durable, and failing now would make the caller 503 an ack whose
+        events would replay anyway (duplicates on the client's retry)."""
+        new_path = os.path.join(
+            self.directory,
+            f"{_SEG_PREFIX}{self._next_segment_number():08d}{_SEG_SUFFIX}")
+        new_f = None
+        try:
+            new_f = open(new_path, "ab")
+            new_f.write(MAGIC)
+            new_f.flush()
+        except OSError as e:
+            logger.warning("WAL rotation failed (%s); continuing in the "
+                           "oversized segment %s", e, self._active_path)
+            if new_f is not None:
+                try:
+                    new_f.close()
+                    os.remove(new_path)  # partial-magic stub must not linger
+                except OSError:  # pragma: no cover
+                    pass
+            return
+        try:
+            self._active.close()
+        except OSError:  # pragma: no cover - old handle already fsynced
+            pass
+        self._active_path = new_path
+        self._active = new_f
+        self._seg_max[self._active_path] = 0
+
+    def commit(self, through_seq: int, durable: bool = False) -> None:
+        """Mark every record with ``seq <= through_seq`` as stored. Rewrites
+        the cursor atomically and deletes fully-committed closed segments.
+        Failures are logged, never raised — commit is an optimization (an
+        uncommitted-but-stored record replays idempotently). ``durable``
+        fsyncs the cursor too; the default skips it because a lost cursor
+        update for a store-ACCEPTED record is harmless (the dead-letter
+        path is the exception — see :meth:`dead_letter`)."""
+        if through_seq <= self.committed:
+            return
+        self.committed = through_seq
+        try:
+            from incubator_predictionio_tpu.utils.fs import atomic_write_bytes
+
+            atomic_write_bytes(os.path.join(self.directory, _CURSOR),
+                               str(through_seq).encode(), durable=durable)
+        except OSError as e:  # pragma: no cover - best-effort bookkeeping
+            logger.warning("WAL cursor write failed: %s", e)
+        for path, max_seq in list(self._seg_max.items()):
+            if path == self._active_path:
+                continue
+            if max_seq <= through_seq:
+                try:
+                    os.remove(path)
+                except OSError:  # pragma: no cover
+                    pass
+                self._seg_max.pop(path, None)
+
+    def dead_letter(self, records: list[dict]) -> None:
+        """Durably move acked-but-store-rejected records to the dead-letter
+        segment, then commit past them so replay skips them. Records must
+        carry their ``seq`` (they came out of the spill queue)."""
+        path = os.path.join(self.directory, DEAD_LETTER)
+        try:
+            fresh = not os.path.exists(path)
+            with open(path, "ab") as f:
+                if fresh:
+                    f.write(MAGIC)
+                for rec in records:
+                    write_frame(
+                        f, json.dumps(rec, separators=(",", ":")).encode())
+                f.flush()
+                os.fsync(f.fileno())
+        except OSError as e:
+            # the records were 201-acked: losing them is the existing
+            # bounded-durability trade, now at least counted
+            logger.error("WAL dead-letter write failed: %s", e)
+        self.dead_letter_count += len(records)
+        DEAD_LETTER_TOTAL.inc(len(records))
+        seqs = [r.get("seq") for r in records if r.get("seq") is not None]
+        if seqs:
+            # DURABLE cursor here, unlike the normal drain commit: a lost
+            # cursor update would replay these records, the store would
+            # reject them again, and they would dead-letter TWICE — the
+            # "replay overwrites itself" argument only covers records the
+            # store accepted
+            self.commit(max(seqs), durable=True)
+
+    # -- read path --------------------------------------------------------
+    def replay(self) -> list[dict]:
+        """Every uncommitted record, oldest first (records carry ``seq``).
+        Torn/corrupt tails end their segment's scan (counted on
+        ``pio_wal_torn_frames_total``); later segments still contribute
+        (their records were written after a successful rotation, so they
+        are independent of the defect)."""
+        out: list[dict] = []
+        for path in list_segments(self.directory):
+            for _, rec, status in iter_frames(path):
+                if status != "ok":
+                    _TORN.inc()
+                    logger.warning("WAL %s: %s — stopping this segment's "
+                                   "replay", path, status)
+                    break
+                if rec["seq"] > self.committed:
+                    out.append(rec)
+        out.sort(key=lambda r: r["seq"])
+        if out:
+            _REPLAYED.inc(len(out))
+        return out
+
+    def close(self) -> None:
+        try:
+            self._active.flush()
+            if self.fsync:
+                os.fsync(self._active.fileno())
+            self._active.close()
+        except OSError:  # pragma: no cover
+            pass
+
+
+def inspect_dir(directory: str) -> dict[str, Any]:
+    """Offline summary of a WAL directory for the ``pio-tpu wal`` verb:
+    per-segment frame counts and defects, cursor, pending/dead-letter
+    tallies. Read-only — safe against a live server's WAL."""
+    committed = read_cursor(directory)
+    segments = []
+    pending = 0
+    for path in list_segments(directory):
+        frames = 0
+        defect = None
+        max_seq = None
+        for _, rec, status in iter_frames(path):
+            if status != "ok":
+                defect = status
+                break
+            frames += 1
+            max_seq = rec["seq"]
+            if rec["seq"] > committed:
+                pending += 1
+        segments.append({
+            "path": path, "frames": frames, "maxSeq": max_seq,
+            "bytes": os.path.getsize(path), "defect": defect,
+        })
+    dl_path = os.path.join(directory, DEAD_LETTER)
+    dead = []
+    dl_defect = None
+    if os.path.exists(dl_path):
+        for _, rec, status in iter_frames(dl_path):
+            if status != "ok":
+                dl_defect = status
+                break
+            dead.append(rec)
+    return {
+        "directory": os.path.abspath(directory),
+        "committedSeq": committed,
+        "segments": segments,
+        "pending": pending,
+        "deadLetters": dead,
+        "deadLetterDefect": dl_defect,
+    }

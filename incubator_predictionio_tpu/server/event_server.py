@@ -62,6 +62,16 @@ from incubator_predictionio_tpu.resilience.policy import (
     DeadlineExceeded,
     TransientError,
 )
+from incubator_predictionio_tpu.resilience.wal import (
+    DEAD_LETTER_TOTAL,
+    SpillWal,
+    WalError,
+)
+from incubator_predictionio_tpu.server.lifecycle import (
+    DrainState,
+    drained_exit_deadline,
+    install_signal_drain,
+)
 from incubator_predictionio_tpu.server.stats import Stats
 
 logger = logging.getLogger(__name__)
@@ -134,6 +144,22 @@ class EventServerConfig:
     breaker_reset_sec: float = dataclasses.field(
         default_factory=lambda: float(
             os.environ.get("PIO_EVENTSERVER_BREAKER_RESET", "10")))
+    # -- durable spill (resilience/wal.py, docs/resilience.md) ------------
+    # directory for the write-ahead log backing the spill queue. Set →
+    # every spilled event is fsynced to disk BEFORE its 201, leftover
+    # records replay idempotently at startup, and store-rejected batches
+    # land in a dead-letter segment. Empty → PR 1's in-memory-only spill
+    # (availability without crash durability).
+    wal_dir: str = dataclasses.field(
+        default_factory=lambda: os.environ.get("PIO_EVENT_WAL_DIR", ""))
+    wal_segment_bytes: int = dataclasses.field(
+        default_factory=lambda: int(
+            os.environ.get("PIO_EVENT_WAL_SEGMENT_BYTES", str(16 << 20))))
+    # PIO_EVENT_WAL_FSYNC=0 keeps the log but skips fsync (bench mode /
+    # battery-backed storage): a crash may lose the OS write-back window
+    wal_fsync: bool = dataclasses.field(
+        default_factory=lambda: os.environ.get(
+            "PIO_EVENT_WAL_FSYNC", "1") != "0")
 
 
 @dataclasses.dataclass
@@ -186,12 +212,36 @@ class EventServer:
         self._store_breaker = CircuitBreaker(
             "eventstore", failure_threshold=config.breaker_threshold,
             reset_timeout=config.breaker_reset_sec)
-        self._spill: collections.deque[tuple[Event, int, Optional[int]]] = (
+        # spill entries: (event, app_id, channel_id, wal_seq) — wal_seq is
+        # None when the WAL is disabled
+        self._spill: collections.deque[
+            tuple[Event, int, Optional[int], Optional[int]]] = (
             collections.deque())
         self._spill_lock = threading.Lock()
         self._drain_task: Optional[asyncio.Task] = None
         self._DRAIN_INTERVAL = 0.5
         self._loop: Optional[asyncio.AbstractEventLoop] = None
+        # -- durable spill (resilience/wal.py) ----------------------------
+        # acked-but-unstored events survive kill -9: fsync before the 201,
+        # idempotent replay of leftovers here at startup
+        self._dead_lettered = 0  # this process's count (health surface)
+        self._wal: Optional[SpillWal] = None
+        if config.wal_dir:
+            self._wal = SpillWal(config.wal_dir,
+                                 segment_bytes=config.wal_segment_bytes,
+                                 fsync=config.wal_fsync)
+            for rec in self._wal.replay():
+                self._spill.append((Event.from_json_dict(rec["event"]),
+                                    rec["app_id"], rec.get("channel_id"),
+                                    rec["seq"]))
+            if self._spill:
+                logger.warning(
+                    "WAL replay: %d acked event(s) from a previous process "
+                    "re-queued for drain (first ids: %s)", len(self._spill),
+                    [e.event_id for e, _, _, _ in list(self._spill)[:8]])
+        # -- graceful drain (server/lifecycle.py) -------------------------
+        self._drain_state = DrainState("event_server",
+                                       retry_after_sec=config.retry_after_sec)
         # fold this server's signals into /metrics at scrape time (keyed:
         # a re-constructed server replaces its predecessor's collector)
         REGISTRY.add_collector("event_server", self._collect_metrics)
@@ -199,8 +249,9 @@ class EventServer:
     def _collect_metrics(self) -> None:
         """Exposition-time fold: spill depth, the standalone event-store
         breaker, and (when enabled) the hourly Stats counters."""
-        with self._spill_lock:
-            depth = len(self._spill)
+        # lockless read: len(deque) is GIL-atomic, and taking _spill_lock
+        # here would queue the scrape behind ingest threads' fsyncs
+        depth = len(self._spill)
         _SPILL_DEPTH.set(depth)
         _SPILL_MAX.set(self.config.spill_max)
         publish_breaker_metrics({"eventstore": self._store_breaker.snapshot()})
@@ -410,14 +461,32 @@ class EventServer:
                 raise SpillQueueFull(
                     f"spill queue at capacity ({self.config.spill_max})")
             ids = []
+            stamped = []
             for e in events:
                 # ids were pre-assigned by _store_events (time-prefixed
                 # 32-hex, btree-right-edge friendly for the burst replay);
                 # direct callers may still hand in id-less events
                 eid = e.event_id or time_prefixed_event_id(e.creation_time)
-                self._spill.append(
-                    (e.with_id(eid), auth.app_id, auth.channel_id))
+                stamped.append(e.with_id(eid))
                 ids.append(eid)
+            seqs: list[Optional[int]] = [None] * len(stamped)
+            if self._wal is not None:
+                # durability BEFORE the ack: one group-commit append+fsync
+                # for the whole batch — only after it returns may these
+                # events be 201-acked (docs/resilience.md ack contract)
+                try:
+                    last = self._wal.append([
+                        {"event": e.to_json_dict(), "app_id": auth.app_id,
+                         "channel_id": auth.channel_id} for e in stamped])
+                except WalError as err:
+                    # can't make the ack durable (disk full / unwritable):
+                    # refuse like a full queue rather than silently demote
+                    # the durability contract
+                    raise SpillQueueFull(f"spill WAL unwritable: {err}") \
+                        from err
+                seqs = list(range(last - len(stamped) + 1, last + 1))
+            for e, seq in zip(stamped, seqs):
+                self._spill.append((e, auth.app_id, auth.channel_id, seq))
         _SPILLED.inc(len(ids))
         self._kick_drain()
         return ids
@@ -455,12 +524,14 @@ class EventServer:
         with self._spill_lock:
             if not self._spill:
                 return True
-            _, app_id, channel_id = self._spill[0]
+            _, app_id, channel_id, _ = self._spill[0]
             batch = []
-            for e, a, c in self._spill:
+            batch_seqs: list[Optional[int]] = []
+            for e, a, c, s in self._spill:
                 if (a, c) != (app_id, channel_id) or len(batch) >= MAX_BATCH_SIZE:
                     break
                 batch.append(e)
+                batch_seqs.append(s)
         if not self._store_breaker.allow():
             return False
         auth = AuthData(app_id, channel_id, ())
@@ -486,16 +557,29 @@ class EventServer:
         except Exception:
             # the store ANSWERED and rejected THIS batch (semantic error):
             # replaying it forever would wedge the whole queue behind it —
-            # drop it, loudly (these events were 201-acked; this is the
-            # bounded-durability trade docs/resilience.md documents)
+            # divert it to the dead-letter segment, loudly, instead of the
+            # silent drop PR 1 shipped (these events were 201-acked; with
+            # the WAL they stay recoverable via `pio-tpu wal`)
             self._store_breaker.record_success()
             with self._spill_lock:
                 for _ in range(len(batch)):
                     self._spill.popleft()
+                # SpillWal is not thread-safe: every mutation happens under
+                # _spill_lock (append already does) — dead_letter outside it
+                # could race an ingest append's rotation/bookkeeping
+                if self._wal is not None:
+                    self._wal.dead_letter([
+                        {"seq": s, "event": e.to_json_dict(),
+                         "app_id": app_id, "channel_id": channel_id}
+                        for e, s in zip(batch, batch_seqs)])
+            self._dead_lettered += len(batch)
+            if self._wal is None:
+                DEAD_LETTER_TOTAL.inc(len(batch))
             logger.exception(
                 "spill drain: store rejected %d event(s) non-transiently; "
-                "DROPPING them to unwedge the queue (ids: %s)",
-                len(batch), [e.event_id for e in batch][:8])
+                "dead-lettered to unwedge the queue (ids: %s, wal: %s)",
+                len(batch), [e.event_id for e in batch][:8],
+                self.config.wal_dir or "<disabled>")
             raise
         self._store_breaker.record_success()
         with self._spill_lock:
@@ -503,6 +587,13 @@ class EventServer:
             # run we snapshotted is still the head
             for _ in range(len(batch)):
                 self._spill.popleft()
+            # commit under the SAME lock append holds: a commit racing an
+            # append could snapshot a stale per-segment max and delete a
+            # segment holding a newer fsynced (201-acked) frame
+            if self._wal is not None:
+                committed = [s for s in batch_seqs if s is not None]
+                if committed:
+                    self._wal.commit(max(committed))
         logger.info("spill drain: flushed %d event(s), %d remaining",
                     len(batch), len(self._spill))
         return True
@@ -541,6 +632,8 @@ class EventServer:
         return op() if self._inline_batch else await self._run(op)
 
     async def handle_create(self, request: web.Request) -> web.Response:
+        if self._drain_state.draining:
+            return self._drain_state.reject_response()
         auth = await self._authenticate_cached(request)
         raw = await request.read()
         if not self.config.stats:  # stats needs the parsed payload fields
@@ -616,6 +709,8 @@ class EventServer:
         return results
 
     async def handle_batch(self, request: web.Request) -> web.Response:
+        if self._drain_state.draining:
+            return self._drain_state.reject_response()
         auth = await self._authenticate_cached(request)
         raw = await request.read()
         if not self.config.stats:  # stats needs the parsed payload fields
@@ -762,21 +857,39 @@ class EventServer:
         return web.json_response({"status": "alive"})
 
     async def handle_health(self, request: web.Request) -> web.Response:
-        """Breaker + spill-queue state (resilience/): 'degraded' while the
-        event store is being routed around, 'ok' otherwise — always 200
-        (the server itself is alive either way)."""
+        """Breaker + spill-queue + durability state (resilience/):
+        'draining' during a graceful exit, 'degraded' while the event store
+        is being routed around, 'ok' otherwise — always 200 (the server
+        itself is alive either way)."""
         store = self._store_breaker.snapshot()
         backends = BREAKERS.snapshot()
-        with self._spill_lock:
-            depth = len(self._spill)
+        # lockless: _spill_lock is held across WAL fsyncs by ingest
+        # threads — /health runs ON the event loop and must never queue
+        # behind a disk flush (len(deque) is GIL-atomic)
+        depth = len(self._spill)
         degraded = depth > 0 or any(
             s["state"] != "closed" for s in (store, *backends.values()))
         return web.json_response({
-            "status": "degraded" if degraded else "ok",
+            "status": self._drain_state.health_status(degraded),
+            "draining": self._drain_state.draining,
             "eventStoreBreaker": store,
             "backendBreakers": backends,
             "spillQueueDepth": depth,
             "spillQueueMax": self.config.spill_max,
+            "spillWal": {
+                "enabled": self._wal is not None,
+                "dir": self.config.wal_dir or None,
+                "committedSeq": (self._wal.committed
+                                 if self._wal is not None else None),
+            },
+            # 201-acked events the store rejected non-transiently — they
+            # sit in the WAL dead-letter segment (`pio-tpu wal <dir>`)
+            # instead of vanishing into a log line. With a WAL, report the
+            # PERSISTED count: it survives restarts, so monitoring keeps
+            # firing until an operator actually empties the segment
+            "deadLettered": (self._wal.dead_letter_count
+                             if self._wal is not None
+                             else self._dead_lettered),
         })
 
     async def handle_stats(self, request: web.Request) -> web.Response:
@@ -791,6 +904,8 @@ class EventServer:
 
     # -- webhooks (EventServer.scala:491-599) -----------------------------
     async def handle_webhook(self, request: web.Request) -> web.Response:
+        if self._drain_state.draining:
+            return self._drain_state.reject_response()
         auth = await self._authenticate_cached(request)
         name = request.match_info["name"]
         form = request.match_info.get("ext") == "form"
@@ -845,6 +960,10 @@ class EventServer:
     async def start(self) -> None:
         # the spill drainer schedules onto this loop from executor threads
         self._loop = asyncio.get_running_loop()
+        if self._spill:
+            # WAL replay re-queued acked events from a previous process:
+            # start landing them as soon as a loop exists
+            self._ensure_drain_task()
         # no per-request access log: formatting a log line per request costs
         # more than parsing the request at ingestion rates
         self._runner = web.AppRunner(self.make_app(), access_log=None)
@@ -902,6 +1021,10 @@ class EventServer:
                     f"Connection: keep-alive\r\n\r\n").encode() + body_b
 
         try:
+            if self._drain_state.draining:
+                # tunnel: the aiohttp handlers own the 503 + Retry-After
+                # draining answer, so both fronts reject identically
+                return None
             path, _, qs = path_qs.partition("?")
             if method == "GET" and path == "/":
                 return resp(200, "OK", {"status": "alive"})
@@ -960,7 +1083,19 @@ class EventServer:
         self._auth_cache[(key, channel)] = (now + self._AUTH_TTL, data)
         return data
 
-    async def shutdown(self) -> None:
+    async def drain_and_shutdown(
+            self, deadline_sec: Optional[float] = None) -> None:
+        """The SIGTERM path (docs/resilience.md drain semantics): stop
+        accepting ingest (503 + Retry-After, /health → 'draining'), give
+        in-flight requests a moment to finish, flush the spill queue, and
+        exit within the deadline. aiohttp's runner cleanup below waits for
+        handlers that already entered the router."""
+        self._drain_state.begin()
+        await self.shutdown(
+            flush_deadline_sec=(drained_exit_deadline()
+                                if deadline_sec is None else deadline_sec))
+
+    async def shutdown(self, flush_deadline_sec: float = 5.0) -> None:
         front = getattr(self, "_front", None)
         if front is not None:
             from incubator_predictionio_tpu import native
@@ -975,23 +1110,37 @@ class EventServer:
                 pass
             self._drain_task = None
         # final best-effort flush: every queued event was 201-acked — if
-        # the store is reachable, land them before exiting (bounded: a
-        # still-down store must not block shutdown)
-        flush_deadline = time.monotonic() + 5.0
+        # the store is reachable, land them before exiting. Bounded by the
+        # deadline, but a no-progress beat RETRIES rather than giving up:
+        # the breaker may be waiting out its reset window on a store that
+        # already recovered (the SIGTERM-during-recovery drain case)
+        flush_deadline = time.monotonic() + flush_deadline_sec
         while self._spill and time.monotonic() < flush_deadline:
             try:
                 if not await self._run(self._drain_spill_once):
-                    break
+                    await asyncio.sleep(0.1)
             except Exception:  # noqa: BLE001 - poison batch already logged
                 continue
         if self._spill:
-            logger.error(
-                "shutdown: DROPPING %d acknowledged spilled event(s) — the "
-                "event store never recovered (first ids: %s)",
-                len(self._spill), [e.event_id for e, _, _ in
-                                   list(self._spill)[:8]])
+            if self._wal is not None:
+                # NOT dropped: the WAL holds them past the cursor and the
+                # next process replays them (the whole point of this PR)
+                logger.warning(
+                    "shutdown: %d acknowledged spilled event(s) remain in "
+                    "the WAL (%s) — they will replay at next startup "
+                    "(first ids: %s)", len(self._spill), self.config.wal_dir,
+                    [e.event_id for e, _, _, _ in list(self._spill)[:8]])
+            else:
+                logger.error(
+                    "shutdown: DROPPING %d acknowledged spilled event(s) — "
+                    "the event store never recovered and no WAL is "
+                    "configured (PIO_EVENT_WAL_DIR; first ids: %s)",
+                    len(self._spill), [e.event_id for e, _, _, _ in
+                                       list(self._spill)[:8]])
         if self._runner is not None:
             await self._runner.cleanup()
+        if self._wal is not None:
+            self._wal.close()
         self._executor.shutdown(wait=False)
 
 
@@ -1002,6 +1151,11 @@ def serve_forever(config: EventServerConfig = EventServerConfig(),
     async def main():
         server = EventServer(config, storage)
         await server.start()
-        await asyncio.Event().wait()
+        # SIGTERM/SIGINT → graceful drain: 503 new ingest, flush the spill
+        # WAL, exit within PIO_DRAIN_DEADLINE (second signal force-exits)
+        stop = asyncio.Event()
+        install_signal_drain(asyncio.get_running_loop(), stop, "event server")
+        await stop.wait()
+        await server.drain_and_shutdown()
 
     asyncio.run(main())

@@ -69,6 +69,12 @@ from incubator_predictionio_tpu.resilience.policy import (
     ServingUnavailable,
     run_with_deadline,
 )
+from incubator_predictionio_tpu.server.lifecycle import (
+    DrainState,
+    drained_exit_deadline,
+    install_signal_drain,
+    wait_for,
+)
 from incubator_predictionio_tpu.utils import jitstats
 from incubator_predictionio_tpu.utils.json_util import bind_query, to_jsonable
 from incubator_predictionio_tpu.utils.serialization import deserialize_model
@@ -94,6 +100,10 @@ _G_DEV_MEM = REGISTRY.gauge(
     "pio_device_bytes_in_use",
     "Accelerator memory in use (the device_memory_report fold)",
     labels=("device",))
+_ROLLBACKS = REGISTRY.counter(
+    "pio_deploy_rollbacks_total",
+    "Reloads rejected by the smoke-query gate or auto-rolled back during "
+    "the post-swap probation window (docs/resilience.md)")
 _H_TEMPLATE_BATCH = REGISTRY.histogram(
     "pio_serving_template_batch_size",
     "Live queries per coalesced batch_predict dispatch, per algorithm class "
@@ -146,6 +156,14 @@ class ServerConfig:
     # long it stays open before a half-open probe
     algo_breaker_threshold: int = 3
     algo_breaker_reset_sec: float = 10.0
+    # -- crash-safe model lifecycle (docs/resilience.md) ------------------
+    # smoke queries the /reload health gate runs against the NEW instance
+    # before it may serve: any exception keeps the live instance and
+    # answers 409. Payload dicts, exactly as POSTed to /queries.json.
+    smoke_queries: tuple = ()
+    # seconds after a successful swap during which a serving-breaker trip
+    # auto-rolls back to the previous (pinned) instance; 0 disables
+    reload_probation_sec: float = 30.0
 
 
 class DeployedEngine:
@@ -646,8 +664,10 @@ class QueryServer:
         storage: Optional[Storage] = None,
         ctx: Optional[MeshContext] = None,
         deployed: Optional[DeployedEngine] = None,
+        clock: Clock = SYSTEM_CLOCK,
     ):
         self.config = config
+        self._clock = clock
         self.storage = storage or get_storage()
         self.ctx = ctx or MeshContext.create()
         # an explicit DeployedEngine skips storage loading (tests inject
@@ -679,6 +699,17 @@ class QueryServer:
         self._last_good_lock = threading.Lock()
         self._LAST_GOOD_MAX = 1024
         self.degraded_count = 0
+        # -- crash-safe model lifecycle (docs/resilience.md) --------------
+        # the previous DeployedEngine stays pinned through the probation
+        # window after a successful /reload so a breaker-trip burst from
+        # the new instance can atomically roll back
+        self._previous: Optional[DeployedEngine] = None
+        self._probation_until: Optional[float] = None
+        self._rollback_count = 0
+        self._last_reload: dict = {"status": "initial",
+                                   "instanceId": self.deployed.instance.id}
+        # -- graceful drain (server/lifecycle.py) -------------------------
+        self._drain_state = DrainState("query_server")
         self._start_time = time.time()
         self._runner: Optional[web.AppRunner] = None
         self._stop_event = asyncio.Event()
@@ -742,11 +773,24 @@ class QueryServer:
             s["state"] != "closed"
             for s in (serving, *algo.values(), *backends.values()))
         return web.json_response({
-            "status": "degraded" if degraded else "ok",
+            "status": self._drain_state.health_status(degraded),
+            "draining": self._drain_state.draining,
             "servingBreaker": serving,
             "algorithmBreakers": algo,
             "backendBreakers": backends,
             "degradedResponses": self.degraded_count,
+            # crash-safe lifecycle surface (docs/resilience.md): which
+            # instance serves, whether a previous one is pinned for
+            # rollback, and what the last reload did
+            "deployment": {
+                "instanceId": self.deployed.instance.id,
+                "previousInstanceId": (
+                    self._previous.instance.id
+                    if self._previous is not None else None),
+                "probationActive": self._probation_active(),
+                "rollbacks": self._rollback_count,
+                "lastReload": self._last_reload,
+            },
         })
 
     async def handle_status(self, request: web.Request) -> web.Response:
@@ -862,6 +906,8 @@ class QueryServer:
 </html>"""
 
     async def handle_query(self, request: web.Request) -> web.Response:
+        if self._drain_state.draining:
+            return self._drain_state.reject_response()
         status, result, timing = await self._serve_payload(await request.read())
         headers = {"X-PIO-Server-Timing": timing} if timing else None
         return web.json_response(result, status=status, headers=headers)
@@ -920,6 +966,9 @@ class QueryServer:
             # deadline blown or every algorithm/backend breaker open:
             # degraded-but-valid beats a 500 (ISSUE 1 acceptance)
             self._serving_breaker.record_failure()
+            # a breaker trip inside a reload's probation window indicts the
+            # freshly swapped instance — restore the pinned previous one
+            await self._maybe_probation_rollback(repr(e))
             self._ship_remote_log(f"query degraded: {e!r}")
             return 200, await loop.run_in_executor(
                 None, self._degraded_result, payload, repr(e)), None
@@ -1063,22 +1112,127 @@ class QueryServer:
             request.query.get("accessKey", "").encode(), key.encode())
 
     async def handle_reload(self, request: web.Request) -> web.Response:
+        """Versioned hot-swap (docs/resilience.md crash-safe lifecycle):
+
+        1. load + warm the new instance BESIDE the live one (the live
+           engine keeps serving throughout — a crash anywhere in here
+           leaves it untouched);
+        2. run the configured smoke queries against the new instance; any
+           failure keeps the live instance and answers 409 (the new
+           instance never serves a query);
+        3. atomically swap the ``DeployedEngine`` reference and pin the
+           previous instance for ``reload_probation_sec`` — a
+           serving-breaker trip inside that window auto-rolls back.
+        """
         if not self._authorized(request):
             return web.json_response({"message": "Unauthorized"}, status=401)
+        if self._drain_state.draining:
+            return self._drain_state.reject_response()
+        loop = asyncio.get_running_loop()
         try:
-            self.deployed = load_deployed_engine(self.config, self.storage, self.ctx)
+            # executor: loading deserializes blobs and warms compile caches
+            # — seconds of work that must not stall live queries
+            new = await loop.run_in_executor(
+                None, load_deployed_engine, self.config, self.storage,
+                self.ctx)
         except RuntimeError as e:
             return web.json_response({"message": str(e)}, status=400)
+        failure = await self._smoke_gate(new)
+        if failure is not None:
+            self._rollback_count += 1
+            _ROLLBACKS.inc()
+            self._last_reload = {
+                "status": "rejected", "instanceId": new.instance.id,
+                "reason": failure,
+            }
+            logger.error("reload: smoke gate rejected instance %s (%s); "
+                         "instance %s keeps serving", new.instance.id,
+                         failure, self.deployed.instance.id)
+            return web.json_response({
+                "message": "Reload rejected by smoke-query gate; previous "
+                           "instance keeps serving",
+                "error": failure,
+                "engineInstanceId": self.deployed.instance.id,
+            }, status=409)
+        # atomic swap: in-flight dispatches hold their own reference to the
+        # old engine and complete against it; everything after this
+        # assignment serves the new one
+        old = self.deployed
+        self.deployed = new
         # The batcher captured the old DeployedEngine at construction; repoint
         # it or /reload would silently keep serving the stale model.
-        self.batcher.deployed = self.deployed
+        self.batcher.deployed = new
         # the reloaded engine may have a different thread-safety posture —
         # re-resolve the overlap bound or auto mode's no-race guarantee
         # breaks across /reload
         await self.batcher.set_max_in_flight(
-            effective_max_in_flight(self.config, self.deployed))
+            effective_max_in_flight(self.config, new))
+        self._previous = old
+        self._probation_until = (
+            self._clock.monotonic() + self.config.reload_probation_sec
+            if self.config.reload_probation_sec > 0 else None)
+        if self._probation_until is not None:
+            # release the pin proactively when the window ends: without a
+            # /health prober nothing else reads _probation_active(), and
+            # the old instance's device arrays would stay resident for the
+            # process lifetime (doubling memory per reload cycle). The
+            # callback is a no-op if a rollback already consumed the pin
+            # or an injected test clock says probation is still running.
+            loop.call_later(self.config.reload_probation_sec + 0.5,
+                            self._probation_active)
+        else:
+            self._previous = None  # probation disabled: nothing to pin
+        self._last_reload = {"status": "ok", "instanceId": new.instance.id,
+                             "previousInstanceId": old.instance.id}
         return web.json_response({"message": "Reloaded",
-                                  "engineInstanceId": self.deployed.instance.id})
+                                  "engineInstanceId": new.instance.id})
+
+    async def _smoke_gate(self, new: DeployedEngine) -> Optional[str]:
+        """Run ``config.smoke_queries`` against the not-yet-live instance.
+        Returns an error description, or None when the gate passes (no
+        queries configured = pass: warmup already exercised the models)."""
+        loop = asyncio.get_running_loop()
+        for payload in self.config.smoke_queries:
+            try:
+                await loop.run_in_executor(None, new.predict, dict(payload))
+            except Exception as e:  # noqa: BLE001 - any failure gates
+                return f"smoke query {payload!r} failed: {e!r}"
+        return None
+
+    def _probation_active(self) -> bool:
+        if self._previous is None or self._probation_until is None:
+            return False
+        if self._clock.monotonic() >= self._probation_until:
+            # probation survived: release the pinned previous instance so
+            # its device arrays can be reclaimed
+            self._previous = None
+            self._probation_until = None
+            return False
+        return True
+
+    async def _maybe_probation_rollback(self, reason: str) -> None:
+        """Called after a serving-breaker failure: if the breaker tripped
+        OPEN inside a reload's probation window, the new instance is
+        broken under real traffic — swap the pinned previous instance back
+        in and close the breaker so it serves immediately."""
+        if self._serving_breaker.state != "open" or not self._probation_active():
+            return
+        prev, self._previous = self._previous, None
+        self._probation_until = None
+        rolled_from = self.deployed.instance.id
+        self.deployed = prev
+        self.batcher.deployed = prev
+        await self.batcher.set_max_in_flight(
+            effective_max_in_flight(self.config, prev))
+        self._serving_breaker.record_success()  # clean slate for the restore
+        self._rollback_count += 1
+        _ROLLBACKS.inc()
+        self._last_reload = {"status": "rolled_back",
+                             "instanceId": prev.instance.id,
+                             "rolledBackFrom": rolled_from,
+                             "reason": reason}
+        logger.error("reload probation: rolled back from instance %s to %s "
+                     "(%s)", rolled_from, prev.instance.id, reason)
 
     async def handle_stop(self, request: web.Request) -> web.Response:
         if not self._authorized(request):
@@ -1144,6 +1298,12 @@ class QueryServer:
         coalescing concurrent queries across connections."""
         from incubator_predictionio_tpu import native
 
+        if self._drain_state.draining:
+            # tunnel: the aiohttp handler owns the 503 + Retry-After
+            # draining answer — accepting here would re-enter the
+            # micro-batcher and keep the drain's queue-empty wait from
+            # ever becoming true
+            return None
         loop = getattr(self, "_loop", None)
         if loop is None or loop.is_closed():
             return None  # tunnel
@@ -1176,6 +1336,24 @@ class QueryServer:
 
     async def wait_stopped(self) -> None:
         await self._stop_event.wait()
+        await self.drain_and_shutdown()
+
+    async def drain_and_shutdown(
+            self, deadline_sec: Optional[float] = None) -> None:
+        """Graceful exit (docs/resilience.md): stop accepting queries
+        (503 + Retry-After, /health → 'draining'), let every queued and
+        in-flight micro-batch complete, then shut down — all within the
+        deadline so a wedged dispatch can't hold the process hostage."""
+        self._drain_state.begin()
+        deadline = (drained_exit_deadline()
+                    if deadline_sec is None else deadline_sec)
+        drained = await wait_for(
+            lambda: (self.batcher.queue.qsize() == 0
+                     and not self.batcher._inflight),
+            deadline)
+        if not drained:
+            logger.warning("drain: in-flight queries still running after "
+                           "%.1fs — shutting down anyway", deadline)
         await self.shutdown()
 
     async def shutdown(self) -> None:
@@ -1199,6 +1377,10 @@ def serve_forever(config: ServerConfig, storage: Optional[Storage] = None) -> No
     async def main():
         server = QueryServer(config, storage)
         await server.start()
+        # SIGTERM/SIGINT drain exactly like POST /stop: finish in-flight
+        # micro-batches, then exit (second signal force-exits)
+        install_signal_drain(asyncio.get_running_loop(), server._stop_event,
+                             "engine server")
         await server.wait_stopped()
 
     asyncio.run(main())

@@ -62,6 +62,12 @@ from incubator_predictionio_tpu.data.storage.base import (
     StorageError,
 )
 from incubator_predictionio_tpu.data.storage.registry import Storage, get_storage
+from incubator_predictionio_tpu.resilience.breaker import BREAKERS
+from incubator_predictionio_tpu.server.lifecycle import (
+    DrainState,
+    drained_exit_deadline,
+    install_signal_drain,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -103,6 +109,9 @@ class StorageServer:
         self._executor = ThreadPoolExecutor(
             max_workers=8, thread_name_prefix="pio-storage")
         self._runner: Optional[web.AppRunner] = None
+        # graceful drain (server/lifecycle.py): new RPCs answer 503 while
+        # in-flight storage calls finish under the runner's cleanup
+        self._drain_state = DrainState("storage_server")
 
     async def _run(self, fn, *args, **kw):
         # copy_context: run_in_executor drops contextvars, and the request's
@@ -117,6 +126,7 @@ class StorageServer:
         app = web.Application(client_max_size=256 * 1024 * 1024,
                               middlewares=[telemetry_middleware("storage_server")])
         app.router.add_get("/", self.handle_status)
+        app.router.add_get("/health", self.handle_health)
         add_observability_routes(app)
         app.router.add_post("/rpc/events/find", self.handle_find)
         app.router.add_post("/rpc/events/assemble_triples",
@@ -137,8 +147,24 @@ class StorageServer:
     async def handle_status(self, request: web.Request) -> web.Response:
         return web.json_response({"status": "alive", "service": "storage"})
 
+    async def handle_health(self, request: web.Request) -> web.Response:
+        """Draining flag + the backing store's breaker registry — the same
+        shape the other two servers expose, so one probe works fleet-wide.
+        Clients see the 'draining' flip and stop routing before the
+        listener goes away (their retry policy classifies the 503 as
+        transient and fails over)."""
+        backends = BREAKERS.snapshot()
+        degraded = any(s["state"] != "closed" for s in backends.values())
+        return web.json_response({
+            "status": self._drain_state.health_status(degraded),
+            "draining": self._drain_state.draining,
+            "backendBreakers": backends,
+        })
+
     # -- generic JSON RPC --------------------------------------------------
     async def handle_rpc(self, request: web.Request) -> web.Response:
+        if self._drain_state.draining:
+            return self._drain_state.reject_response()
         if not self._authorized(request):
             return web.json_response({"message": "Unauthorized"}, status=401)
         store = request.match_info["store"]
@@ -161,6 +187,8 @@ class StorageServer:
 
     # -- streaming find ----------------------------------------------------
     async def handle_find(self, request: web.Request) -> web.StreamResponse:
+        if self._drain_state.draining:
+            return self._drain_state.reject_response()
         if not self._authorized(request):
             return web.json_response({"message": "Unauthorized"}, status=401)
         try:
@@ -229,6 +257,8 @@ class StorageServer:
 
     # -- columnar bulk read ------------------------------------------------
     async def handle_assemble_triples(self, request: web.Request) -> web.Response:
+        if self._drain_state.draining:
+            return self._drain_state.reject_response()
         if not self._authorized(request):
             return web.json_response({"message": "Unauthorized"}, status=401)
         try:
@@ -283,8 +313,26 @@ class StorageServer:
         logger.info("storage server listening on %s:%d",
                     self.config.ip, self.config.port)
 
+    async def drain_and_shutdown(
+            self, deadline_sec: Optional[float] = None) -> None:
+        """SIGTERM path: flip to draining (new RPCs 503), let in-flight
+        storage calls finish under the runner's graceful cleanup, exit —
+        bounded internally so a wedged RPC yields a logged, orderly exit
+        rather than a TimeoutError traceback out of asyncio.run."""
+        self._drain_state.begin()
+        deadline = (drained_exit_deadline()
+                    if deadline_sec is None else deadline_sec)
+        try:
+            await asyncio.wait_for(self.shutdown(), deadline)
+        except asyncio.TimeoutError:
+            logger.warning("storage server drain exceeded %.1fs — exiting "
+                           "with requests still in flight", deadline)
+            self._executor.shutdown(wait=False)
+
     async def shutdown(self) -> None:
         if self._runner is not None:
+            # aiohttp's cleanup waits for handlers already in the router —
+            # the in-flight-RPC half of the drain contract
             await self._runner.cleanup()
         self._executor.shutdown(wait=False)
 
@@ -292,12 +340,17 @@ class StorageServer:
 def serve_forever(config: StorageServerConfig,
                   storage: Optional[Storage] = None) -> None:
     """Blocking entry used by the CLI `storageserver` verb; runs until the
-    process is signalled (same lifecycle as the event server)."""
+    process is signalled (same graceful-drain lifecycle as the other
+    servers — see docs/resilience.md)."""
 
     async def main():
         server = StorageServer(config, storage)
         await server.start()
-        await asyncio.Event().wait()
+        stop = asyncio.Event()
+        install_signal_drain(asyncio.get_running_loop(), stop,
+                             "storage server")
+        await stop.wait()
+        await server.drain_and_shutdown()
 
     asyncio.run(main())
 
@@ -391,6 +444,25 @@ def _events_remove(s: Storage, a: dict):
     return s.get_events().remove(a["app_id"], a.get("channel_id"))
 
 
+def _events_find_by_entities(s: Storage, a: dict):
+    """Bulk per-entity read as ONE unary RPC (ROADMAP open item): the
+    batched-serving O(1)-reads-per-batch property holds across a split
+    query-server/storage-server topology because the backing store's own
+    bulk override (single scan / SQL IN / ES terms) runs server-side."""
+    res = s.get_events().find_by_entities(
+        a["app_id"], a["entity_type"], a["entity_ids"],
+        channel_id=a.get("channel_id"),
+        start_time=dec_dt(a.get("start_time")),
+        until_time=dec_dt(a.get("until_time")),
+        event_names=a.get("event_names"),
+        target_entity_type=dec_opt_filter(a, "target_entity_type"),
+        target_entity_id=dec_opt_filter(a, "target_entity_id"),
+        limit_per_entity=a.get("limit_per_entity"),
+        reversed=a.get("reversed", False),
+    )
+    return {eid: [e.to_json_dict() for e in evs] for eid, evs in res.items()}
+
+
 def _events_aggregate(s: Storage, a: dict):
     agg = s.get_events().aggregate_properties(
         a["app_id"], a["entity_type"],
@@ -445,6 +517,7 @@ _RPC: dict[tuple, Any] = {
     ("events", "init"): _events_init,
     ("events", "remove"): _events_remove,
     ("events", "aggregate_properties"): _events_aggregate,
+    ("events", "find_by_entities"): _events_find_by_entities,
     # models (bytes travel base64)
     ("models", "insert"): lambda s, a: s.get_model_data_models().insert(
         Model(a["id"], base64.b64decode(a["blob"]))),

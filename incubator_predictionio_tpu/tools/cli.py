@@ -11,6 +11,8 @@ Parity target: tools/console/Console.scala:134-623 and commands/*. Verbs:
   train, eval, deploy, undeploy, batchpredict, eventserver, storageserver,
   export, import, metrics (scrape + pretty-print any server's Prometheus
   /metrics page, docs/observability.md),
+  wal (inspect/verify/--replay an event-server spill WAL directory,
+  docs/resilience.md),
   shell (bin/pio-shell: interactive console with the
   storage/event-store/mesh bootstrap preloaded),
   start-all, stop-all (bin/pio-start-all / pio-stop-all: daemonize the
@@ -309,6 +311,9 @@ def cmd_deploy(args, storage: Storage) -> int:
         algo_deadline_sec=args.algo_deadline_sec,
         algo_breaker_threshold=args.algo_breaker_threshold,
         algo_breaker_reset_sec=args.algo_breaker_reset_sec,
+        smoke_queries=tuple(
+            json.loads(q) for q in (args.smoke_query or ())),
+        reload_probation_sec=args.reload_probation_sec,
     )
     serve_forever(config, storage)
     return 0
@@ -389,9 +394,12 @@ def cmd_eventserver(args, storage: Storage) -> int:
         serve_forever,
     )
 
+    kw = {}
+    if args.wal_dir:  # unset keeps the PIO_EVENT_WAL_DIR env default
+        kw["wal_dir"] = args.wal_dir
     serve_forever(EventServerConfig(ip=args.ip, port=args.port,
                                     stats=args.stats, ssl_cert=args.ssl_cert,
-                                    ssl_key=args.ssl_key), storage)
+                                    ssl_key=args.ssl_key, **kw), storage)
     return 0
 
 
@@ -630,6 +638,83 @@ def cmd_version(args, storage) -> int:
     return 0
 
 
+def cmd_wal(args, storage: Storage) -> int:
+    """Inspect / verify / replay an event-server spill WAL directory
+    (resilience/wal.py; docs/resilience.md "Durability & crash recovery").
+
+    Plain invocation is strictly read-only (safe against a live server):
+    per-segment frame counts, CRC/torn-frame defects, the commit cursor,
+    pending and dead-letter tallies. ``--replay`` lands every pending
+    record in the configured event store (idempotent — ids are
+    pre-assigned) and advances the cursor; ``--dead-letter`` prints the
+    dead-letter records so a store-rejected batch can be repaired by hand.
+    """
+    from incubator_predictionio_tpu.resilience.wal import SpillWal, inspect_dir
+
+    info = inspect_dir(args.directory)
+    if args.json:
+        _out(json.dumps(info, indent=2))
+    else:
+        _out(f"WAL directory: {info['directory']}")
+        _out(f"  committed seq: {info['committedSeq']}")
+        for seg in info["segments"]:
+            line = (f"  {os.path.basename(seg['path'])}: "
+                    f"{seg['frames']} frame(s), {seg['bytes']} bytes")
+            if seg["maxSeq"] is not None:
+                line += f", max seq {seg['maxSeq']}"
+            if seg["defect"]:
+                line += f"  [DEFECT: {seg['defect']}]"
+            _out(line)
+        _out(f"  pending (uncommitted): {info['pending']}")
+        _out(f"  dead letters: {len(info['deadLetters'])}"
+             + (f"  [DEFECT: {info['deadLetterDefect']}]"
+                if info["deadLetterDefect"] else ""))
+    if args.dead_letter and info["deadLetters"]:
+        for rec in info["deadLetters"]:
+            _out(json.dumps(rec))
+    if not args.replay:
+        return 0
+
+    from incubator_predictionio_tpu.data.event import Event
+
+    wal = SpillWal(args.directory)
+    pending = wal.replay()
+    if not pending:
+        _out("Nothing to replay.")
+        wal.close()
+        return 0
+    events_store = storage.get_events()
+    replayed = 0
+    try:
+        i = 0
+        while i < len(pending):
+            # one insert_batch per (app, channel) run, ≤ 50 like the server
+            app_id = pending[i]["app_id"]
+            channel_id = pending[i].get("channel_id")
+            batch = []
+            while (i < len(pending) and len(batch) < 50
+                   and pending[i]["app_id"] == app_id
+                   and pending[i].get("channel_id") == channel_id):
+                batch.append(pending[i])
+                i += 1
+            events_store.init(app_id, channel_id)
+            events_store.insert_batch(
+                [Event.from_json_dict(r["event"]) for r in batch],
+                app_id, channel_id)
+            wal.commit(max(r["seq"] for r in batch))
+            replayed += len(batch)
+    except Exception as e:  # noqa: BLE001 - partial progress is committed
+        _err(f"Replay stopped after {replayed}/{len(pending)} event(s): {e}")
+        wal.close()
+        return 1
+    finally:
+        if replayed:
+            _out(f"Replayed {replayed} event(s) into the configured "
+                 "event store.")
+    wal.close()
+    return 0
+
+
 def cmd_metrics(args, storage) -> int:
     """Fetch and pretty-print a server's ``/metrics`` page (any of the three
     servers — event, query, storage — serves one; docs/observability.md)."""
@@ -827,6 +912,16 @@ def build_parser() -> argparse.ArgumentParser:
                    dest="algo_breaker_reset_sec",
                    help="seconds an open algorithm breaker waits before a "
                         "half-open probe (default 10)")
+    p.add_argument("--smoke-query", action="append",
+                   help="JSON query payload the /reload health gate runs "
+                        "against a NEW instance before it may serve "
+                        "(repeatable; any failure keeps the live instance "
+                        "— docs/resilience.md)")
+    p.add_argument("--reload-probation", type=float, default=30.0,
+                   dest="reload_probation_sec",
+                   help="seconds after a /reload swap during which a "
+                        "serving-breaker trip auto-rolls back to the "
+                        "previous instance (default 30; 0 disables)")
     p = sub.add_parser("undeploy")
     p.add_argument("--ip", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8000)
@@ -850,6 +945,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--stats", action="store_true")
     p.add_argument("--ssl-cert")
     p.add_argument("--ssl-key")
+    p.add_argument("--wal-dir",
+                   help="write-ahead log directory for the spill queue: "
+                        "spilled events are fsynced before their 201 and "
+                        "replayed after a crash (PIO_EVENT_WAL_DIR env; "
+                        "docs/resilience.md)")
 
     # storageserver — serve this process's storage config to remote clients
     p = sub.add_parser(
@@ -923,6 +1023,21 @@ def build_parser() -> argparse.ArgumentParser:
                    help="print the raw exposition text instead")
     p.add_argument("--filter", help="only families whose name contains this")
 
+    # wal — inspect/verify/replay an event-server spill WAL
+    p = sub.add_parser(
+        "wal",
+        help="inspect, verify, or manually replay an event-server spill "
+             "WAL directory (docs/resilience.md)")
+    p.add_argument("directory", help="the PIO_EVENT_WAL_DIR to inspect")
+    p.add_argument("--dead-letter", action="store_true",
+                   help="print the dead-letter records (store-rejected, "
+                        "201-acked events) as JSON lines")
+    p.add_argument("--replay", action="store_true",
+                   help="insert every pending record into the configured "
+                        "event store (idempotent) and advance the cursor")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable inspection output")
+
     # export / import
     p = sub.add_parser("export")
     p.add_argument("--appid", type=int, required=True)
@@ -988,6 +1103,7 @@ _COMMANDS = {
     "export": cmd_export,
     "import": cmd_import,
     "metrics": cmd_metrics,
+    "wal": cmd_wal,
     "start-all": cmd_start_all,
     "stop-all": cmd_stop_all,
     "redeploy": cmd_redeploy,

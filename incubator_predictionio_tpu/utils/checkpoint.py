@@ -56,7 +56,15 @@ class TrainCheckpointer:
         )
 
     def save(self, step: int, state: Any) -> None:
+        """Durable by the time it returns: orbax writes the step into a tmp
+        directory and renames it into place (synchronous mode, so the data
+        files are flushed), and the directory fsync below makes the rename
+        itself survive a power cut — the resume contract is 'a step save()
+        returned for is restorable after kill -9 at any point'."""
+        from incubator_predictionio_tpu.utils.fs import fsync_dir
+
         self._mgr.save(step, args=self._ocp.args.StandardSave(state))
+        fsync_dir(self.directory)
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()

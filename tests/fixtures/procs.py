@@ -1,0 +1,107 @@
+"""Process-level chaos harness: real ``pio-tpu`` server subprocesses that
+can be SIGKILLed mid-work and restarted (ISSUE 4 acceptance scenarios).
+
+The in-process durability tests (tests/test_durability.py) drive the same
+code paths deterministically; this harness exists to prove the contract
+holds against a REAL process boundary — fsync'd WAL files surviving a
+``kill -9`` the kernel delivers, signal-driven graceful drain, subprocess
+restart replay."""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def http_json(method: str, url: str, body=None, timeout=5.0):
+    """(status, parsed json) — tolerant of error statuses."""
+    data = None if body is None else json.dumps(body).encode()
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read() or b"null")
+    except urllib.error.HTTPError as e:
+        payload = e.read()
+        try:
+            return e.code, json.loads(payload or b"null")
+        except ValueError:
+            return e.code, {"raw": payload.decode(errors="replace")}
+
+
+class ServerProc:
+    """One ``pio-tpu <verb>`` server as a subprocess in its own process
+    group (so ``kill9`` reaps any children it spawned too)."""
+
+    def __init__(self, verb_args: list[str], env: dict | None = None):
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m",
+             "incubator_predictionio_tpu.tools.cli", *verb_args],
+            cwd=REPO_ROOT,
+            env={**os.environ, "JAX_PLATFORMS": "cpu",
+                 "PIO_NATIVE_HTTP": "0", **(env or {})},
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            start_new_session=True,
+        )
+
+    def wait_ready(self, url: str, timeout: float = 90.0) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.proc.poll() is not None:
+                raise RuntimeError(
+                    f"server exited rc={self.proc.returncode} during boot:\n"
+                    f"{self.proc.stdout.read()}")
+            try:
+                with urllib.request.urlopen(url, timeout=1.0) as resp:
+                    if resp.status == 200:
+                        return
+            except Exception:  # noqa: BLE001 - still booting
+                pass
+            time.sleep(0.05)
+        self.stop()
+        raise TimeoutError(f"server at {url} not ready in {timeout}s")
+
+    def kill9(self) -> None:
+        """SIGKILL the whole group — the crash the WAL exists for."""
+        try:
+            os.killpg(self.proc.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        self.proc.wait(timeout=30)
+
+    def sigterm(self) -> None:
+        """Graceful drain signal (handled by install_signal_drain)."""
+        try:
+            os.killpg(self.proc.pid, signal.SIGTERM)
+        except ProcessLookupError:
+            pass
+
+    def wait_exit(self, timeout: float = 60.0) -> int:
+        return self.proc.wait(timeout=timeout)
+
+    def stop(self) -> None:
+        if self.proc.poll() is None:
+            self.kill9()
+
+    def output(self) -> str:
+        try:
+            return self.proc.stdout.read() or ""
+        except ValueError:  # already closed
+            return ""

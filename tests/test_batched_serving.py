@@ -244,10 +244,21 @@ def test_mask_scatter_helpers():
 # batched find_by_entities (storage contract)
 # ---------------------------------------------------------------------------
 
-@pytest.fixture(params=["memory", "sqlite"])
+@pytest.fixture(params=["memory", "sqlite", "eventlog"])
 def events_env(request, tmp_path):
     if request.param == "memory":
         s = Storage({"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+    elif request.param == "eventlog":
+        s = Storage({
+            "PIO_STORAGE_SOURCES_EL_TYPE": "eventlog",
+            "PIO_STORAGE_SOURCES_EL_PATH": str(tmp_path / "el"),
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_NAME": "EL",
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "EL",
+            # metadata still needs a home
+            "PIO_STORAGE_SOURCES_MEM_TYPE": "memory",
+            "PIO_STORAGE_REPOSITORIES_METADATA_NAME": "MEM",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "MEM",
+        })
     else:
         s = Storage({
             "PIO_STORAGE_SOURCES_SQ_TYPE": "sqlite",
@@ -324,6 +335,105 @@ def test_find_by_entities_postgres_bulk_override():
                 [e.event_id for e in want]
             assert len(got[eid]) == 3
         assert got["ghost"] == []
+        s.close()
+    finally:
+        server.close()
+
+
+def _seed_batch_events(ev, app_id, n_users=3, n_items=5):
+    for u in range(n_users):
+        for k in range(n_items):
+            ev.insert(Event(
+                event="view" if k % 2 == 0 else "buy",
+                entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item", target_entity_id=f"i{k}",
+                event_time=T0 + dt.timedelta(seconds=u * 10 + k)), app_id)
+
+
+def _assert_fbe_parity(ev, app_id, got, wanted, **kwargs):
+    """Per-entity parity with the serial oracle: every requested id is
+    present (eventless ids map to []) and each list matches the
+    per-entity ``find`` exactly."""
+    assert set(got) == set(wanted)
+    for eid in wanted:
+        want = list(ev.find(
+            app_id, entity_type="user", entity_id=eid,
+            event_names=kwargs.get("event_names"),
+            limit=kwargs.get("limit_per_entity"),
+            reversed=kwargs.get("reversed", False)))
+        assert [e.event_id for e in got[eid]] == \
+            [e.event_id for e in want], (eid, kwargs)
+
+
+def test_find_by_entities_remote_one_rpc_per_batch():
+    """ISSUE 4 acceptance: the RemoteEvents bulk override issues exactly
+    ONE RPC for the whole batch (counted server-side with the shared
+    counting-store fixture) and matches per-entity reads — the
+    O(1)-reads-per-batch property now holds on split
+    query-server/storage-server topologies (ROADMAP open item)."""
+    from tests.fixtures.counting_events import CountingEvents
+
+    from incubator_predictionio_tpu.data.storage.remote import (
+        RemoteStorageClient,
+    )
+    from incubator_predictionio_tpu.server.storage_server import (
+        ThreadedStorageServer,
+    )
+
+    backing = Storage({"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+    app_id = backing.get_meta_data_apps().insert(App(0, "fbe-remote"))
+    ev = backing.get_events()
+    ev.init(app_id)
+    _seed_batch_events(ev, app_id)
+    counting = CountingEvents(ev)
+
+    class _CountingStorage:
+        def __getattr__(self, name):
+            return getattr(backing, name)
+
+        def get_events(self):
+            return counting
+
+    server = ThreadedStorageServer(_CountingStorage())
+    try:
+        remote = RemoteStorageClient({"URL": server.url}).events()
+        wanted = ["u0", "u2", "ghost"]
+        kwargs = dict(event_names=("view",), limit_per_entity=2,
+                      reversed=True)
+        got = remote.find_by_entities(app_id, "user", wanted, **kwargs)
+        # exactly one storage-server RPC, which ran the backend's own bulk
+        # override — never the per-entity find loop
+        assert counting.counts["find_by_entities"] == 1
+        assert counting.counts["find"] == 0
+        _assert_fbe_parity(ev, app_id, got, wanted, **kwargs)
+        assert got["ghost"] == []
+    finally:
+        server.close()
+        backing.close()
+
+
+def test_find_by_entities_elasticsearch_terms_query():
+    """The ES override collapses the batch into one ``terms``-filtered
+    search whose (time, tiebreak) stream groups into per-entity lists
+    identical to per-entity ``find`` reads."""
+    from tests.fixtures.fake_es import make_es_app
+    from tests.fixtures.servers import ThreadedApp
+
+    server = ThreadedApp(make_es_app())
+    try:
+        s = Storage({
+            "PIO_STORAGE_SOURCES_ES_TYPE": "elasticsearch",
+            "PIO_STORAGE_SOURCES_ES_URL": f"http://127.0.0.1:{server.port}",
+        })
+        ev = s.get_events()
+        ev.init(11)
+        _seed_batch_events(ev, 11)
+        wanted = ["u0", "u1", "ghost"]
+        for kwargs in ({}, {"event_names": ("view",)},
+                       {"limit_per_entity": 2, "reversed": True}):
+            got = ev.find_by_entities(11, "user", wanted, **kwargs)
+            _assert_fbe_parity(ev, 11, got, wanted, **kwargs)
+            assert got["ghost"] == []
         s.close()
     finally:
         server.close()

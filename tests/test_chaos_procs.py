@@ -1,0 +1,202 @@
+"""Subprocess chaos tests (ISSUE 4 acceptance): SIGKILL a real event
+server at every interesting point in the ack lifecycle — store up, store
+down (WAL-spilling), mid-drain — restart it, and assert ZERO acked-event
+loss with exactly-once storage; then SIGTERM for the graceful-drain exit.
+
+Topology: the test process owns the real store (sqlite) and serves it over
+a ThreadedStorageServer on a fixed port; the event server subprocess
+points at it with the ``remote`` backend, so 'store down' is simply
+closing the storage server — exactly the split deployment the WAL is for.
+
+Marked ``slow``: real subprocess boots exceed the tier-1 budget."""
+
+import time
+
+import pytest
+
+from incubator_predictionio_tpu.data.storage import AccessKey, App, Storage
+from incubator_predictionio_tpu.server.storage_server import (
+    StorageServerConfig,
+    ThreadedStorageServer,
+)
+from tests.fixtures.procs import ServerProc, free_port, http_json
+
+pytestmark = pytest.mark.slow
+
+EVENT = {"event": "rate", "entityType": "user",
+         "eventTime": "2022-03-01T00:00:00Z"}
+
+
+def _storage(tmp_path):
+    s = Storage({
+        "PIO_STORAGE_SOURCES_SQ_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_SQ_PATH": str(tmp_path / "store.db"),
+    })
+    app_id = s.get_meta_data_apps().insert(App(0, "chaos"))
+    s.get_events().init(app_id)
+    key = s.get_meta_data_access_keys().insert(AccessKey("", app_id, ()))
+    return s, app_id, key
+
+
+def _es_env(storage_port: int, wal_dir: str) -> dict:
+    name = "R"
+    return {
+        f"PIO_STORAGE_SOURCES_{name}_TYPE": "remote",
+        f"PIO_STORAGE_SOURCES_{name}_URL": f"http://127.0.0.1:{storage_port}",
+        f"PIO_STORAGE_SOURCES_{name}_TIMEOUT": "3",
+        # fail fast so spilling starts on the first refused connection
+        f"PIO_STORAGE_SOURCES_{name}_RETRY_MAX_ATTEMPTS": "1",
+        **{f"PIO_STORAGE_REPOSITORIES_{repo}_{k}": name
+           for repo in ("METADATA", "EVENTDATA", "MODELDATA")
+           for k in ("NAME", "SOURCE")},
+        "PIO_EVENT_WAL_DIR": wal_dir,
+        # auth must survive the storage outage window from cache
+        "PIO_EVENTSERVER_AUTH_TTL": "600",
+        "PIO_EVENTSERVER_BREAKER_THRESHOLD": "2",
+        "PIO_EVENTSERVER_BREAKER_RESET": "0.3",
+        # the REMOTE backend's own breaker must also recover within the
+        # drain window, or the final flush waits out a 30s default reset
+        # the deadline doesn't cover (the WAL would keep the events —
+        # durable either way — but these tests assert the flush lands)
+        "PIO_RESILIENCE_BREAKER_RESET": "0.3",
+        "PIO_DRAIN_DEADLINE": "20",
+    }
+
+
+def _post_acked(eport, key, entity_id) -> str:
+    status, body = http_json(
+        "POST", f"http://127.0.0.1:{eport}/events.json?accessKey={key}",
+        dict(EVENT, entityId=entity_id))
+    assert status == 201, (status, body)
+    return body["eventId"]
+
+
+def _wait_health(eport, pred, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            status, health = http_json(
+                "GET", f"http://127.0.0.1:{eport}/health", timeout=2.0)
+            if status == 200 and pred(health):
+                return health
+        except Exception:  # noqa: BLE001
+            pass
+        time.sleep(0.1)
+    raise TimeoutError("health predicate not reached")
+
+
+def test_event_server_kill9_and_restart_loses_zero_acked_events(tmp_path):
+    storage, app_id, key = _storage(tmp_path)
+    sport = free_port()
+    eport = free_port()
+    wal_dir = str(tmp_path / "wal")
+    env = _es_env(sport, wal_dir)
+    sserver = ThreadedStorageServer(
+        storage, StorageServerConfig(ip="127.0.0.1", port=sport))
+    es = ServerProc(["eventserver", "--ip", "127.0.0.1",
+                     "--port", str(eport)], env=env)
+    acked = []
+    try:
+        es.wait_ready(f"http://127.0.0.1:{eport}/")
+        # phase 1 — store up: synchronous inserts, acked before 201
+        for i in range(8):
+            acked.append(_post_acked(eport, key, f"up-{i}"))
+        # phase 2 — store DOWN: acks keep flowing, now WAL-backed
+        sserver.close()
+        for i in range(8):
+            acked.append(_post_acked(eport, key, f"down-{i}"))
+        # phase 3 — kill -9 with the spill queue full of acked events
+        es.kill9()
+        # phase 4 — store back up, fresh event-server process: WAL replay
+        # + drain must land every acked event exactly once
+        sserver = ThreadedStorageServer(
+            storage, StorageServerConfig(ip="127.0.0.1", port=sport))
+        es = ServerProc(["eventserver", "--ip", "127.0.0.1",
+                         "--port", str(eport)], env=env)
+        es.wait_ready(f"http://127.0.0.1:{eport}/")
+        _wait_health(eport, lambda h: h["spillQueueDepth"] == 0
+                     and h["status"] == "ok")
+        # phase 5 — availability throughout: the restarted server ingests
+        acked.append(_post_acked(eport, key, "post-restart"))
+    finally:
+        es.stop()
+        sserver.close()
+    ids = [e.event_id for e in storage.get_events().find(app_id)]
+    assert len(ids) == len(set(ids)), "duplicate replay"
+    missing = set(acked) - set(ids)
+    assert not missing, f"ACKED EVENTS LOST: {missing}"
+    assert len(ids) == len(acked)
+    storage.close()
+
+
+def test_event_server_kill9_mid_drain_then_replay_is_exactly_once(tmp_path):
+    """The nastiest window: the drainer is mid-flush (some WAL records
+    committed, some not) when the process dies. The replay must re-insert
+    only what the cursor says is pending — and pre-assigned ids make even
+    a stale cursor idempotent."""
+    storage, app_id, key = _storage(tmp_path)
+    sport = free_port()
+    eport = free_port()
+    wal_dir = str(tmp_path / "wal")
+    env = _es_env(sport, wal_dir)
+    sserver = ThreadedStorageServer(
+        storage, StorageServerConfig(ip="127.0.0.1", port=sport))
+    es = ServerProc(["eventserver", "--ip", "127.0.0.1",
+                     "--port", str(eport)], env=env)
+    acked = []
+    try:
+        es.wait_ready(f"http://127.0.0.1:{eport}/")
+        acked.append(_post_acked(eport, key, "prime"))  # warm the auth cache
+        sserver.close()  # store down → spill
+        for i in range(20):
+            acked.append(_post_acked(eport, key, f"d-{i}"))
+        # store comes back: the drainer starts committing batches…
+        sserver = ThreadedStorageServer(
+            storage, StorageServerConfig(ip="127.0.0.1", port=sport))
+        # …and we kill -9 somewhere inside the drain window
+        time.sleep(0.6)
+        es.kill9()
+        es = ServerProc(["eventserver", "--ip", "127.0.0.1",
+                         "--port", str(eport)], env=env)
+        es.wait_ready(f"http://127.0.0.1:{eport}/")
+        _wait_health(eport, lambda h: h["spillQueueDepth"] == 0
+                     and h["status"] == "ok")
+    finally:
+        es.stop()
+        sserver.close()
+    ids = [e.event_id for e in storage.get_events().find(app_id)]
+    assert len(ids) == len(set(ids)), "duplicate replay"
+    assert set(acked) == set(ids)
+    storage.close()
+
+
+def test_event_server_sigterm_drains_and_exits_clean(tmp_path):
+    """Graceful drain end-to-end: SIGTERM → new ingest 503s, the spilled
+    acks flush to the recovered store, the process exits 0 within the
+    deadline."""
+    storage, app_id, key = _storage(tmp_path)
+    sport = free_port()
+    eport = free_port()
+    env = _es_env(sport, str(tmp_path / "wal"))
+    sserver = ThreadedStorageServer(
+        storage, StorageServerConfig(ip="127.0.0.1", port=sport))
+    es = ServerProc(["eventserver", "--ip", "127.0.0.1",
+                     "--port", str(eport)], env=env)
+    acked = []
+    try:
+        es.wait_ready(f"http://127.0.0.1:{eport}/")
+        acked.append(_post_acked(eport, key, "prime"))  # warm the auth cache
+        sserver.close()
+        for i in range(5):
+            acked.append(_post_acked(eport, key, f"g-{i}"))
+        sserver = ThreadedStorageServer(
+            storage, StorageServerConfig(ip="127.0.0.1", port=sport))
+        es.sigterm()
+        rc = es.wait_exit(timeout=45.0)
+        assert rc == 0, es.output()
+    finally:
+        es.stop()
+        sserver.close()
+    ids = {e.event_id for e in storage.get_events().find(app_id)}
+    assert set(acked) <= ids
+    storage.close()

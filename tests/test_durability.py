@@ -1,0 +1,419 @@
+"""Crash-safe durability: WAL-backed spill queue, dead-lettering, graceful
+drain, and the `pio-tpu wal` recovery verb (ISSUE 4).
+
+The WAL unit tests corrupt synthetic segment files exactly the way crashes
+do (torn tails, flipped bits) and assert the recovery contract; the event
+server tests simulate kill -9 by abandoning one server instance and
+constructing a fresh one over the same WAL directory — every 201-acked
+event must land in the store exactly once."""
+
+import asyncio
+import datetime as dt
+import os
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from incubator_predictionio_tpu.data.storage import AccessKey, App, Storage
+from incubator_predictionio_tpu.resilience.wal import (
+    MAGIC,
+    SpillWal,
+    inspect_dir,
+    list_segments,
+)
+from incubator_predictionio_tpu.server.event_server import (
+    EventServer,
+    EventServerConfig,
+)
+
+UTC = dt.timezone.utc
+
+EVENT = {
+    "event": "rate",
+    "entityType": "user",
+    "entityId": "u1",
+    "eventTime": "2021-06-01T00:00:00Z",
+}
+
+
+def _recs(n, start=0):
+    return [{"event": {"event": "rate", "entityType": "user",
+                       "entityId": f"u{i}", "eventId": f"id{i:04d}",
+                       "eventTime": "2021-06-01T00:00:00Z"},
+             "app_id": 1, "channel_id": None}
+            for i in range(start, start + n)]
+
+
+# ---------------------------------------------------------------------------
+# WAL unit tests (synthetic segment files)
+# ---------------------------------------------------------------------------
+
+def test_wal_append_replay_roundtrip(tmp_path):
+    w = SpillWal(str(tmp_path))
+    last = w.append(_recs(5))
+    assert last == 5
+    w.close()
+    w2 = SpillWal(str(tmp_path))
+    got = w2.replay()
+    assert [r["seq"] for r in got] == [1, 2, 3, 4, 5]
+    assert [r["event"]["eventId"] for r in got] == [f"id{i:04d}"
+                                                    for i in range(5)]
+    w2.close()
+
+
+def test_wal_commit_truncates_and_survives_reopen(tmp_path):
+    w = SpillWal(str(tmp_path), segment_bytes=4096)
+    w.append(_recs(3))
+    w.commit(2)
+    w.close()
+    w2 = SpillWal(str(tmp_path))
+    assert [r["seq"] for r in w2.replay()] == [3]
+    # committing through the tail drops every closed segment
+    w2.commit(3)
+    w2.close()
+    w3 = SpillWal(str(tmp_path))
+    assert w3.replay() == []
+    # only w3's fresh active segment remains on disk
+    assert len(list_segments(str(tmp_path))) == 1
+    w3.close()
+
+
+def test_wal_rotation_replays_across_segments(tmp_path):
+    # tiny segment cap → every append rotates; replay must stitch segments
+    # in numeric order
+    w = SpillWal(str(tmp_path), segment_bytes=4096)
+    for i in range(30):
+        w.append(_recs(1, start=i))
+    w.close()
+    assert len(list_segments(str(tmp_path))) > 1
+    w2 = SpillWal(str(tmp_path))
+    assert [r["seq"] for r in w2.replay()] == list(range(1, 31))
+    w2.close()
+
+
+def test_wal_torn_tail_recovers_prefix(tmp_path):
+    """kill -9 mid-append leaves a partial frame at the tail: replay must
+    recover every complete frame and stop cleanly at the tear."""
+    w = SpillWal(str(tmp_path))
+    w.append(_recs(4))
+    w.close()
+    seg = list_segments(str(tmp_path))[0]
+    size = os.path.getsize(seg)
+    with open(seg, "r+b") as f:
+        f.truncate(size - 7)  # mid-payload
+    w2 = SpillWal(str(tmp_path))
+    assert [r["seq"] for r in w2.replay()] == [1, 2, 3]
+    w2.close()
+
+
+def test_wal_crc_corruption_stops_segment(tmp_path):
+    """A flipped bit inside a frame's payload fails the CRC; the segment's
+    scan stops there (nothing downstream of a corrupt frame is trusted)
+    but a LATER segment — written after a healthy rotation — still
+    replays."""
+    w = SpillWal(str(tmp_path), segment_bytes=4096)
+    w.append(_recs(3))
+    w._rotate()
+    w.append(_recs(2, start=3))
+    w.close()
+    first = list_segments(str(tmp_path))[0]
+    with open(first, "r+b") as f:
+        f.seek(len(MAGIC) + 8 + 10)  # into the first frame's payload
+        b = f.read(1)
+        f.seek(-1, os.SEEK_CUR)
+        f.write(bytes([b[0] ^ 0xFF]))
+    w2 = SpillWal(str(tmp_path))
+    seqs = [r["seq"] for r in w2.replay()]
+    assert seqs == [4, 5]  # first segment dead at frame 1; second intact
+    info = inspect_dir(str(tmp_path))
+    assert any(s["defect"] == "crc mismatch" for s in info["segments"])
+    # a commit must NEVER delete the defective segment: the frames behind
+    # the defect are unreadable to replay but may be hand-recoverable
+    w2.commit(5)
+    assert first in list_segments(str(tmp_path))
+    w2.close()
+
+
+def test_wal_dead_letter_skips_replay_and_is_inspectable(tmp_path):
+    w = SpillWal(str(tmp_path))
+    w.append(_recs(3))
+    head = w.replay()[:2]
+    w.dead_letter(head)
+    assert w.dead_letter_count == 2
+    w.close()
+    w2 = SpillWal(str(tmp_path))
+    assert [r["seq"] for r in w2.replay()] == [3]
+    assert w2.dead_letter_count == 2
+    info = inspect_dir(str(tmp_path))
+    assert [r["seq"] for r in info["deadLetters"]] == [1, 2]
+    assert info["pending"] == 1
+    w2.close()
+
+
+def test_wal_fsync_off_still_replays(tmp_path):
+    w = SpillWal(str(tmp_path), fsync=False)
+    w.append(_recs(2))
+    w.close()
+    w2 = SpillWal(str(tmp_path), fsync=False)
+    assert len(w2.replay()) == 2
+    w2.close()
+
+
+# ---------------------------------------------------------------------------
+# event server: WAL-backed spill queue
+# ---------------------------------------------------------------------------
+
+class _ModalStore:
+    """mode: ok | transient | semantic (same shape as test_resilience)."""
+
+    def __init__(self, target):
+        self._t = target
+        self.mode = "ok"
+
+    def __getattr__(self, name):
+        return getattr(self._t, name)
+
+    def insert_batch(self, events, app_id, channel_id=None):
+        if self.mode == "transient":
+            raise ConnectionResetError("backend blip")
+        if self.mode == "semantic":
+            raise Exception("constraint violation")
+        return self._t.insert_batch(events, app_id, channel_id)
+
+
+class _ModalStorage:
+    def __init__(self, storage, store):
+        self._storage = storage
+        self._store = store
+
+    def __getattr__(self, name):
+        return getattr(self._storage, name)
+
+    def get_events(self):
+        return self._store
+
+
+def _mk_env():
+    storage = Storage({"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+    app_id = storage.get_meta_data_apps().insert(App(0, "wal-app"))
+    key = storage.get_meta_data_access_keys().insert(AccessKey("", app_id, ()))
+    storage.get_events().init(app_id)
+    modal = _ModalStore(storage.get_events())
+    return storage, _ModalStorage(storage, modal), modal, app_id, key
+
+
+def test_event_server_wal_survives_kill9(tmp_path):
+    """The acceptance scenario, in-process: events 201-acked while the
+    store was down hit the WAL before the ack; the process 'dies' (the
+    server object is abandoned, never shut down); a NEW server over the
+    same WAL directory replays them and the drain lands every acked event
+    exactly once under its original id."""
+    storage, flaky, modal, app_id, key = _mk_env()
+    wal_dir = str(tmp_path / "wal")
+
+    async def t():
+        config = EventServerConfig(wal_dir=wal_dir, spill_max=100)
+        server = EventServer(config, storage=flaky)
+        server._kick_drain = lambda: None  # deterministic manual drain
+        client = TestClient(TestServer(server.make_app()))
+        await client.start_server()
+        modal.mode = "transient"
+        acked = []
+        url = f"/events.json?accessKey={key}"
+        for i in range(5):
+            resp = await client.post(url, json=dict(EVENT, entityId=f"k{i}"))
+            assert resp.status == 201
+            acked.append((await resp.json())["eventId"])
+        # the acks are on disk BEFORE any drain ran
+        assert inspect_dir(wal_dir)["pending"] == 5
+        await client.close()
+        # kill -9: no shutdown(), no flush — the object is simply dropped
+        server._wal.close()  # only release the fd (the OS would)
+        return acked
+
+    acked = asyncio.run(t())
+
+    async def t2():
+        modal.mode = "ok"
+        config = EventServerConfig(wal_dir=wal_dir, spill_max=100)
+        server = EventServer(config, storage=flaky)
+        server._kick_drain = lambda: None
+        # replay repopulated the spill queue from the WAL
+        assert len(server._spill) == 5
+        while server._spill:
+            assert server._drain_spill_once()
+        await server.shutdown()
+
+    asyncio.run(t2())
+    stored = {e.event_id for e in storage.get_events().find(app_id)}
+    assert stored == set(acked)  # exactly once, original ids
+    assert len(list(storage.get_events().find(app_id))) == 5
+    # fully committed → a fresh open has nothing to replay
+    w = SpillWal(wal_dir)
+    assert w.replay() == []
+    w.close()
+    storage.close()
+
+
+def test_event_server_wal_unwritable_means_503(tmp_path):
+    """If the ack cannot be made durable the server must refuse (503),
+    never silently fall back to memory-only durability."""
+    storage, flaky, modal, app_id, key = _mk_env()
+    wal_dir = str(tmp_path / "wal")
+
+    async def t():
+        server = EventServer(
+            EventServerConfig(wal_dir=wal_dir, spill_max=100), storage=flaky)
+        server._kick_drain = lambda: None
+        client = TestClient(TestServer(server.make_app()))
+        await client.start_server()
+        try:
+            modal.mode = "transient"
+            server._wal._active.close()  # simulate dead disk
+            resp = await client.post(
+                f"/events.json?accessKey={key}", json=EVENT)
+            assert resp.status == 503
+            assert "Retry-After" in resp.headers
+            assert len(server._spill) == 0  # nothing half-acked
+        finally:
+            await client.close()
+
+    asyncio.run(t())
+    storage.close()
+
+
+def test_event_server_dead_letter_routing(tmp_path):
+    """Satellite: a batch the store rejects non-transiently at drain time
+    goes to the WAL dead-letter segment (counted, visible in /health)
+    instead of vanishing with only a log line."""
+    storage, flaky, modal, app_id, key = _mk_env()
+    wal_dir = str(tmp_path / "wal")
+
+    async def t():
+        server = EventServer(
+            EventServerConfig(wal_dir=wal_dir, spill_max=100), storage=flaky)
+        server._kick_drain = lambda: None
+        client = TestClient(TestServer(server.make_app()))
+        await client.start_server()
+        try:
+            modal.mode = "transient"
+            resp = await client.post(
+                f"/events.json?accessKey={key}", json=EVENT)
+            assert resp.status == 201
+            acked_id = (await resp.json())["eventId"]
+            modal.mode = "semantic"
+            with pytest.raises(Exception):
+                server._drain_spill_once()
+            assert len(server._spill) == 0  # unwedged
+            health = await (await client.get("/health")).json()
+            assert health["deadLettered"] == 1
+            info = inspect_dir(wal_dir)
+            assert [r["event"]["eventId"] for r in info["deadLetters"]] == \
+                [acked_id]
+            assert info["pending"] == 0  # dead letters are committed-past
+        finally:
+            await client.close()
+            await server.shutdown()
+
+    asyncio.run(t())
+    storage.close()
+
+
+def test_event_server_draining_rejects_ingest():
+    """Graceful drain: after SIGTERM the server answers ingest with 503 +
+    Retry-After, /health flips to 'draining', and reads keep working."""
+    storage, flaky, modal, app_id, key = _mk_env()
+
+    async def t():
+        server = EventServer(EventServerConfig(), storage=flaky)
+        client = TestClient(TestServer(server.make_app()))
+        await client.start_server()
+        try:
+            url = f"/events.json?accessKey={key}"
+            resp = await client.post(url, json=EVENT)
+            assert resp.status == 201
+            server._drain_state.begin()
+            for path, payload in (("/events.json", EVENT),
+                                  ("/batch/events.json", [EVENT]),
+                                  ("/webhooks/exampleJson.json", {})):
+                resp = await client.post(f"{path}?accessKey={key}",
+                                         json=payload)
+                assert resp.status == 503, path
+                assert resp.headers["Retry-After"]
+            health = await (await client.get("/health")).json()
+            assert health["status"] == "draining"
+            assert health["draining"] is True
+            # reads still served while the LB pulls us out
+            resp = await client.get(f"/events.json?accessKey={key}&limit=-1")
+            assert resp.status == 200
+        finally:
+            await client.close()
+            await server.shutdown()
+
+    asyncio.run(t())
+    storage.close()
+
+
+def test_event_server_shutdown_flushes_spill_to_store(tmp_path):
+    """Drain semantics: a SIGTERM with the store healthy lands every
+    spilled event before exit; the WAL ends fully committed."""
+    storage, flaky, modal, app_id, key = _mk_env()
+    wal_dir = str(tmp_path / "wal")
+
+    async def t():
+        server = EventServer(
+            EventServerConfig(wal_dir=wal_dir, spill_max=100), storage=flaky)
+        server._kick_drain = lambda: None
+        client = TestClient(TestServer(server.make_app()))
+        await client.start_server()
+        modal.mode = "transient"
+        resp = await client.post(f"/events.json?accessKey={key}", json=EVENT)
+        assert resp.status == 201
+        acked = (await resp.json())["eventId"]
+        modal.mode = "ok"
+        await client.close()
+        await server.drain_and_shutdown(deadline_sec=5.0)
+        return acked
+
+    acked = asyncio.run(t())
+    assert {e.event_id for e in storage.get_events().find(app_id)} == {acked}
+    assert inspect_dir(wal_dir)["pending"] == 0
+    storage.close()
+
+
+# ---------------------------------------------------------------------------
+# pio-tpu wal --replay (manual recovery path)
+# ---------------------------------------------------------------------------
+
+def test_cli_wal_inspect_and_replay(tmp_path, capsys):
+    from incubator_predictionio_tpu.tools.cli import main as cli_main
+
+    wal_dir = str(tmp_path / "wal")
+    w = SpillWal(wal_dir)
+    w.append(_recs(7))
+    w.commit(2)  # 2 already stored by the dead process
+    w.close()
+
+    storage = Storage({"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+    storage.get_events().init(1)
+    import incubator_predictionio_tpu.data.storage.registry as registry
+
+    prev = registry.use_storage(storage)
+    try:
+        rc = cli_main(["wal", wal_dir])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "pending (uncommitted): 5" in out
+        rc = cli_main(["wal", wal_dir, "--replay"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "Replayed 5 event(s)" in out
+        stored = {e.event_id for e in storage.get_events().find(1)}
+        assert stored == {f"id{i:04d}" for i in range(2, 7)}
+        # idempotent: a second replay finds nothing pending
+        rc = cli_main(["wal", wal_dir, "--replay"])
+        assert rc == 0
+        assert "Nothing to replay" in capsys.readouterr().out
+    finally:
+        registry.use_storage(prev)
+        storage.close()

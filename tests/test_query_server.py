@@ -469,6 +469,226 @@ def test_queue_delay_and_dispatch_reservoirs_on_status(deployed_env):
     run_server(deployed_env, t)
 
 
+def test_reload_smoke_gate_rejects_and_keeps_old(deployed_env):
+    """ISSUE 4 acceptance: a /reload whose smoke-query gate fails never
+    serves a query from the new instance — the live engine keeps serving
+    and /health reports the rejection."""
+
+    async def t(client, server, x, y):
+        old = server.deployed
+        resp = await client.post("/reload?accessKey=sk")
+        assert resp.status == 409
+        body = await resp.json()
+        assert "smoke" in body["error"]
+        # the gate failure left the OLD instance live everywhere
+        assert server.deployed is old
+        assert server.batcher.deployed is old
+        health = await (await client.get("/health")).json()
+        dep = health["deployment"]
+        assert dep["lastReload"]["status"] == "rejected"
+        assert dep["rollbacks"] == 1
+        resp = await client.post(
+            "/queries.json", json={"features": list(map(float, x[0]))})
+        assert resp.status == 200
+
+    # the smoke payload can't bind to the classification Query → the new
+    # instance fails its gate before ever serving
+    run_server(deployed_env, t, server_access_key="sk",
+               smoke_queries=({"bogus": "nope"},))
+
+
+def test_reload_smoke_gate_passes_and_pins_previous(deployed_env):
+    async def t(client, server, x, y):
+        old = server.deployed
+        resp = await client.post("/reload?accessKey=sk")
+        assert resp.status == 200
+        assert server.deployed is not old
+        assert server._previous is old  # pinned for the probation window
+        health = await (await client.get("/health")).json()
+        dep = health["deployment"]
+        assert dep["lastReload"]["status"] == "ok"
+        assert dep["probationActive"] is True
+        assert dep["previousInstanceId"] == old.instance.id
+        resp = await client.post(
+            "/queries.json", json={"features": list(map(float, x[0]))})
+        assert resp.status == 200
+
+    run_server(deployed_env, t, server_access_key="sk",
+               smoke_queries=({"features": [0.0, 0.0, 0.0]},))
+
+
+def _probation_server(deployed_env, clk, **kw):
+    storage, variant_path, x, y = deployed_env
+    return QueryServer(
+        ServerConfig(engine_variant=variant_path, server_access_key="sk",
+                     reload_probation_sec=30.0, algo_breaker_threshold=2,
+                     **kw),
+        storage=storage, clock=clk)
+
+
+def test_reload_probation_rollback_on_breaker_trip(deployed_env):
+    """A serving-breaker trip burst inside the probation window (FakeClock)
+    auto-rolls back to the pinned previous instance, which then serves
+    live traffic again."""
+    from incubator_predictionio_tpu.resilience.clock import FakeClock
+    from incubator_predictionio_tpu.resilience.policy import (
+        ServingUnavailable,
+    )
+
+    storage, variant_path, x, y = deployed_env
+
+    async def t():
+        clk = FakeClock()
+        server = _probation_server(deployed_env, clk)
+        client = TestClient(TestServer(server.make_app()))
+        await client.start_server()
+        try:
+            old = server.deployed
+            resp = await client.post("/reload?accessKey=sk")
+            assert resp.status == 200
+            new = server.deployed
+            assert new is not old and server._previous is old
+
+            def boom(payloads):
+                raise ServingUnavailable("post-swap burst")
+
+            new.predict_batch = boom
+            # threshold 2: two degraded 200s trip the serving breaker →
+            # rollback fires inside the probation window
+            for _ in range(2):
+                resp = await client.post(
+                    "/queries.json",
+                    json={"features": list(map(float, x[0]))})
+                assert resp.status == 200
+                assert (await resp.json()).get("degraded") is True
+            assert server.deployed is old
+            assert server.batcher.deployed is old
+            assert server._previous is None
+            health = await (await client.get("/health")).json()
+            dep = health["deployment"]
+            assert dep["lastReload"]["status"] == "rolled_back"
+            assert dep["lastReload"]["rolledBackFrom"] == new.instance.id
+            assert dep["rollbacks"] == 1
+            # the restored instance serves LIVE (breaker was closed on
+            # rollback; no degraded marker)
+            resp = await client.post(
+                "/queries.json", json={"features": list(map(float, x[0]))})
+            assert resp.status == 200
+            assert "label" in (await resp.json())
+        finally:
+            await client.close()
+            await server.shutdown()
+
+    asyncio.run(t())
+
+
+def test_reload_probation_expires_and_releases_previous(deployed_env):
+    """After the probation window elapses (FakeClock) the pinned previous
+    instance is released and breaker trips no longer roll back."""
+    from incubator_predictionio_tpu.resilience.clock import FakeClock
+    from incubator_predictionio_tpu.resilience.policy import (
+        ServingUnavailable,
+    )
+
+    storage, variant_path, x, y = deployed_env
+
+    async def t():
+        clk = FakeClock()
+        server = _probation_server(deployed_env, clk)
+        client = TestClient(TestServer(server.make_app()))
+        await client.start_server()
+        try:
+            old = server.deployed
+            resp = await client.post("/reload?accessKey=sk")
+            assert resp.status == 200
+            new = server.deployed
+            clk.advance(30.1)  # probation over
+
+            def boom(payloads):
+                raise ServingUnavailable("late failure")
+
+            new.predict_batch = boom
+            for _ in range(2):
+                resp = await client.post(
+                    "/queries.json",
+                    json={"features": list(map(float, x[0]))})
+                assert resp.status == 200
+            # no rollback: the new instance stays (and the pin is gone)
+            assert server.deployed is new
+            assert server._previous is None
+            health = await (await client.get("/health")).json()
+            assert health["deployment"]["lastReload"]["status"] == "ok"
+            assert health["deployment"]["rollbacks"] == 0
+            del old
+        finally:
+            await client.close()
+            await server.shutdown()
+
+    asyncio.run(t())
+
+
+def test_reload_loads_beside_live_instance(deployed_env):
+    """The crash-mid-reload guarantee, made observable: while the new
+    instance is still loading, the OLD instance keeps answering queries —
+    so a kill -9 anywhere inside the load window (the swap is the very
+    last step and persists nothing) leaves a server that was never not
+    serving the old instance."""
+    import threading
+
+    from incubator_predictionio_tpu.server import query_server as qs_mod
+
+    async def t(client, server, x, y):
+        old = server.deployed
+        gate = threading.Event()
+        real_load = qs_mod.load_deployed_engine
+
+        def slow_load(config, storage, ctx):
+            gate.wait(timeout=10.0)
+            return real_load(config, storage, ctx)
+
+        qs_mod.load_deployed_engine = slow_load
+        try:
+            reload_task = asyncio.create_task(
+                client.post("/reload?accessKey=sk"))
+            await asyncio.sleep(0.05)  # the load is blocked on the gate
+            # mid-reload: the live instance serves, untouched
+            for i in range(3):
+                resp = await client.post(
+                    "/queries.json", json={"features": list(map(float, x[i]))})
+                assert resp.status == 200
+            assert server.deployed is old
+            gate.set()
+            resp = await reload_task
+            assert resp.status == 200
+            assert server.deployed is not old
+        finally:
+            qs_mod.load_deployed_engine = real_load
+
+    run_server(deployed_env, t, server_access_key="sk")
+
+
+def test_query_server_draining_rejects_queries(deployed_env):
+    """Graceful drain: new queries answer 503 + Retry-After, /health flips
+    to 'draining', and drain_and_shutdown completes."""
+
+    async def t(client, server, x, y):
+        resp = await client.post(
+            "/queries.json", json={"features": list(map(float, x[0]))})
+        assert resp.status == 200
+        server._drain_state.begin()
+        resp = await client.post(
+            "/queries.json", json={"features": list(map(float, x[0]))})
+        assert resp.status == 503
+        assert resp.headers["Retry-After"]
+        resp = await client.post("/reload?accessKey=x")  # no key configured
+        assert resp.status == 503
+        health = await (await client.get("/health")).json()
+        assert health["status"] == "draining"
+        await server.drain_and_shutdown(deadline_sec=2.0)
+
+    run_server(deployed_env, t)
+
+
 def test_undeployed_engine_errors(tmp_path):
     storage = Storage({"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
     variant_path = str(tmp_path / "engine.json")
