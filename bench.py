@@ -682,18 +682,68 @@ def _metrics_snapshot(text: str) -> dict:
     return out
 
 
+def _train_recommendation(ctx, storage, tmp: str, n_users: int,
+                          n_items: int, n_events: int) -> str:
+    """Seed rating events and train the recommendation template through
+    the real workflow; returns the engine-variant path. Shared by the
+    serving and overload scenarios (one training recipe, two load
+    shapes)."""
+    import datetime as dt_mod
+
+    from incubator_predictionio_tpu.core.workflow import run_train
+    from incubator_predictionio_tpu.data import DataMap, Event
+    from incubator_predictionio_tpu.data.storage import App
+    from incubator_predictionio_tpu.data.storage.base import EngineInstance
+    from incubator_predictionio_tpu.templates.recommendation import (
+        RecommendationEngine,
+    )
+
+    app_id = storage.get_meta_data_apps().insert(App(0, "bench-app"))
+    events = storage.get_events()
+    events.init(app_id)
+    rng = np.random.default_rng(5)
+    utc = dt_mod.timezone.utc
+    batch = [
+        Event(event="rate", entity_type="user",
+              entity_id=f"u{rng.integers(0, n_users)}",
+              target_entity_type="item",
+              target_entity_id=f"i{rng.integers(0, n_items)}",
+              properties=DataMap({"rating": float(1 + 4 * rng.random())}),
+              event_time=dt_mod.datetime(2022, 1, 1, tzinfo=utc))
+        for _ in range(n_events)
+    ]
+    events.insert_batch(batch, app_id)
+
+    variant_path = os.path.join(tmp, "engine.json")
+    variant = {
+        "id": "bench", "version": "1",
+        "engineFactory":
+            "incubator_predictionio_tpu.templates.recommendation.RecommendationEngine",
+        "datasource": {"params": {"appName": "bench-app"}},
+        "algorithms": [{"name": "als", "params": {
+            "rank": 32, "numIterations": 3, "batchSize": 8192}}],
+    }
+    with open(variant_path, "w") as f:
+        json.dump(variant, f)
+    engine = RecommendationEngine().apply()
+    engine_params = engine.engine_params_from_variant(variant)
+    instance = EngineInstance(
+        id="", status="INIT",
+        start_time=dt_mod.datetime.now(utc), end_time=None,
+        engine_id="bench", engine_version="1",
+        engine_variant=os.path.abspath(variant_path),
+        engine_factory=variant["engineFactory"])
+    run_train(engine, engine_params, instance, storage=storage, ctx=ctx)
+    return variant_path
+
+
 def bench_serving(ctx) -> dict:
     """Train the recommendation template through the real workflow, deploy it
     in the real query server, and measure client-observed latency under
     concurrent load (16 closed-loop clients) — exercising bind → supplement →
     MicroBatcher → batch_predict → serve, the full CreateServer.scala:464-494
     path."""
-    import datetime as dt_mod
-
-    from incubator_predictionio_tpu.core.workflow import run_train
-    from incubator_predictionio_tpu.data import DataMap, Event
-    from incubator_predictionio_tpu.data.storage import App, Storage, use_storage
-    from incubator_predictionio_tpu.data.storage.base import EngineInstance
+    from incubator_predictionio_tpu.data.storage import Storage, use_storage
     from incubator_predictionio_tpu.server.query_server import QueryServer, ServerConfig
     from incubator_predictionio_tpu.templates.recommendation import RecommendationEngine
 
@@ -704,42 +754,8 @@ def bench_serving(ctx) -> dict:
     prev = use_storage(storage)
     tmp = tempfile.mkdtemp(prefix="pio-bench-")
     try:
-        app_id = storage.get_meta_data_apps().insert(App(0, "bench-app"))
-        events = storage.get_events()
-        events.init(app_id)
-        rng = np.random.default_rng(5)
-        utc = dt_mod.timezone.utc
-        batch = [
-            Event(event="rate", entity_type="user",
-                  entity_id=f"u{rng.integers(0, n_users)}",
-                  target_entity_type="item",
-                  target_entity_id=f"i{rng.integers(0, n_items)}",
-                  properties=DataMap({"rating": float(1 + 4 * rng.random())}),
-                  event_time=dt_mod.datetime(2022, 1, 1, tzinfo=utc))
-            for _ in range(n_events)
-        ]
-        events.insert_batch(batch, app_id)
-
-        variant_path = os.path.join(tmp, "engine.json")
-        variant = {
-            "id": "bench", "version": "1",
-            "engineFactory":
-                "incubator_predictionio_tpu.templates.recommendation.RecommendationEngine",
-            "datasource": {"params": {"appName": "bench-app"}},
-            "algorithms": [{"name": "als", "params": {
-                "rank": 32, "numIterations": 3, "batchSize": 8192}}],
-        }
-        with open(variant_path, "w") as f:
-            json.dump(variant, f)
-        engine = RecommendationEngine().apply()
-        engine_params = engine.engine_params_from_variant(variant)
-        instance = EngineInstance(
-            id="", status="INIT",
-            start_time=dt_mod.datetime.now(utc), end_time=None,
-            engine_id="bench", engine_version="1",
-            engine_variant=os.path.abspath(variant_path),
-            engine_factory=variant["engineFactory"])
-        run_train(engine, engine_params, instance, storage=storage, ctx=ctx)
+        variant_path = _train_recommendation(
+            ctx, storage, tmp, n_users, n_items, n_events)
 
         # The server runs IN the bench process (it owns the accelerator); the
         # LOAD CLIENT is a separate OS process driving a real TCP socket —
@@ -822,11 +838,140 @@ def bench_serving(ctx) -> dict:
                 deserialize_model,
             )
 
+            with open(variant_path) as f:
+                variant = json.load(f)
+            engine = RecommendationEngine().apply()
+            engine_params = engine.engine_params_from_variant(variant)
             persisted = deserialize_model(blob.models)
             models = engine.prepare_deploy(
                 ctx, engine_params, persisted, inst.id)
             # read-only check on the trained factor tables
             out["pallas_kernel_parity"] = _pallas_parity_check(models[0].mf)
+        return out
+    finally:
+        use_storage(prev)
+        storage.close()
+
+
+# ---------------------------------------------------------------------------
+# 7b. goodput under overload (docs/resilience.md "Overload & admission
+#     control"): offered load at ~3× measured capacity through the real
+#     admission layer — goodput and admitted-p99, not peak qps, are what a
+#     production stack is judged on
+# ---------------------------------------------------------------------------
+
+#: Three-phase load client (argv after the repo root: base_url, warm_s,
+#: cap_s, over_s, n_users). The protocol and the raw-socket driver live in
+#: ONE place — ``tests/fixtures/loadgen.py`` — shared with the chaos storm
+#: test; this subprocess shim only puts the repo on the path and runs it.
+#: Phase 1 (warm): single closed-loop connection — strictly below capacity,
+#: where zero requests may be shed. Phase 2 (capacity): 16 closed-loop
+#: connections — the measured ceiling. Phase 3 (overload): open-loop at 3×
+#: the phase-2 qps across 48 connections; 429/504 are counted, not errors.
+_OVERLOAD_CLIENT_SCRIPT = """
+import sys
+
+sys.path.insert(0, sys.argv[1])
+from tests.fixtures.loadgen import bench_main
+
+bench_main(sys.argv[2:])
+"""
+
+
+def bench_overload(ctx) -> dict:
+    """Offered load at ~3× measured capacity through the deployed query
+    server's admission layer (resilience/admission.py): records goodput
+    (qps of valid 200s, degraded included — brownout's whole point) and
+    the p99 of *admitted* requests, plus the 429/504 shed tallies. The
+    acceptance bars (goodput ≥ 70% of capacity, admitted p99 bounded,
+    zero sheds below capacity) are asserted by the slow storm test
+    (tests/test_chaos_procs.py); this scenario archives the numbers."""
+    import subprocess
+    import sys as _sys
+    import tempfile
+
+    from incubator_predictionio_tpu.data.storage import Storage, use_storage
+    from incubator_predictionio_tpu.parallel.launcher import free_port
+    from incubator_predictionio_tpu.server.query_server import (
+        QueryServer,
+        ServerConfig,
+    )
+
+    n_users, n_items, n_events = 2000, 1000, (5_000 if SMALL else 20_000)
+    warm_s, cap_s, over_s = (1.0, 1.5, 3.0) if SMALL else (2.0, 4.0, 8.0)
+    storage = Storage({"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+    prev = use_storage(storage)
+    tmp = tempfile.mkdtemp(prefix="pio-bench-overload-")
+    try:
+        variant_path = _train_recommendation(
+            ctx, storage, tmp, n_users, n_items, n_events)
+        port = free_port()
+
+        async def drive() -> tuple[dict, dict, str]:
+            server = QueryServer(
+                ServerConfig(
+                    engine_variant=variant_path, ip="127.0.0.1", port=port,
+                    # the overload posture under test: a real per-query
+                    # budget (the shed/deadline yardstick), a bounded
+                    # queue, and a quick-reacting brownout
+                    query_timeout_sec=0.5, admission_max_queue=128,
+                    brownout_enter_sec=0.3, brownout_exit_sec=1.0),
+                storage=storage, ctx=ctx)
+            await server.start()
+            try:
+                proc = await asyncio.create_subprocess_exec(
+                    _sys.executable, "-c", _OVERLOAD_CLIENT_SCRIPT,
+                    os.path.dirname(os.path.abspath(__file__)),
+                    f"http://127.0.0.1:{port}", str(warm_s), str(cap_s),
+                    str(over_s), str(n_users), stdout=subprocess.PIPE)
+                total_s = warm_s + cap_s + over_s
+                try:
+                    stdout, _ = await asyncio.wait_for(
+                        proc.communicate(), timeout=total_s + 120)
+                except asyncio.TimeoutError:
+                    proc.kill()
+                    await proc.wait()
+                    raise
+                assert proc.returncode == 0, proc.returncode
+                client = json.loads(stdout.decode().strip().splitlines()[-1])
+                import aiohttp
+
+                async with aiohttp.ClientSession() as s:
+                    health = await (await s.get(
+                        f"http://127.0.0.1:{port}/health")).json()
+                    metrics_text = await (await s.get(
+                        f"http://127.0.0.1:{port}/metrics")).text()
+                return client, health, metrics_text
+            finally:
+                await server.shutdown()
+
+        client, health, metrics_text = asyncio.run(drive())
+        cap = client["capacity"]
+        over = client["overload"]
+        warm = client["warm"]
+        warm_shed = sum(v for k, v in warm["counts"].items()
+                        if k in ("429", "504"))
+        out = {
+            "capacity_qps": cap["qps"],
+            "capacity_p50_ms": cap["p50_ms"],
+            "capacity_p99_ms": cap["p99_ms"],
+            "offered_qps": over["offered_qps"],
+            "goodput_qps": over["goodput_qps"],
+            "goodput_ratio": round(
+                over["goodput_qps"] / max(cap["qps"], 1e-9), 3),
+            "admitted_p50_ms": over["p50_ms"],
+            "admitted_p99_ms": over["p99_ms"],
+            "p99_ratio": round(
+                over["p99_ms"] / max(cap["p99_ms"], 1e-9), 3),
+            "rejected_429": over["counts"].get("429", 0),
+            "shed_504": over["counts"].get("504", 0),
+            "degraded_200": over["counts"].get("degraded", 0),
+            # the below-capacity invariant, recorded (the storm test
+            # asserts it): nothing sheds on an unloaded server
+            "below_capacity_sheds": warm_shed,
+            "admission_health": health.get("admission"),
+            "metrics": _metrics_snapshot(metrics_text),
+        }
         return out
     finally:
         use_storage(prev)
@@ -1066,7 +1211,7 @@ def build_result_line(configs: dict, device_info: dict,
 # dead tunnel on CPU
 CONFIG_NAMES = ["recommendation", "recommendation_scaled", "classification",
                 "similarproduct", "ecommerce_retrieval", "sequential",
-                "serving", "ingestion", "ingest_durability"]
+                "serving", "overload", "ingestion", "ingest_durability"]
 DEVICE_FREE = {"ingestion", "ingest_durability"}
 
 
@@ -1080,6 +1225,7 @@ def _build_suite(ctx, peaks, device) -> dict:
         "ecommerce_retrieval": lambda: bench_ecommerce_retrieval(ctx, peaks, device),
         "sequential": lambda: bench_sequential(ctx, peaks, device),
         "serving": lambda: bench_serving(ctx),
+        "overload": lambda: bench_overload(ctx),
         "ingestion": lambda: bench_ingestion(),
         "ingest_durability": lambda: bench_ingest_durability(),
     }

@@ -36,6 +36,8 @@ import http.client
 import io
 import json
 import logging
+import os
+import socket
 import ssl as _ssl
 import threading
 import time
@@ -86,6 +88,11 @@ _KEY_ENC, _KEY_DEC = _META_CODECS[AccessKey]
 _CHAN_ENC, _CHAN_DEC = _META_CODECS[Channel]
 
 logger = logging.getLogger(__name__)
+
+#: This process's identity, sent as ``X-PIO-Client`` so the storage
+#: server's per-client in-flight cap distinguishes query servers that
+#: share a source address (proxy/NAT) or a host.
+_CLIENT_ID = f"{socket.gethostname()}:{os.getpid()}"
 
 
 class _Transport:
@@ -142,7 +149,11 @@ class _Transport:
             self.host, self.port, timeout=timeout)
 
     def _headers(self) -> dict[str, str]:
-        h = {"Content-Type": "application/json"}
+        h = {"Content-Type": "application/json",
+             # per-process identity for the storage server's per-client
+             # in-flight cap: request.remote alone collapses every query
+             # server behind one proxy/NAT into a single shared cap
+             "X-PIO-Client": _CLIENT_ID}
         if self.key:
             h["X-PIO-Storage-Key"] = self.key
         # called once per attempt, inside the policy's per-attempt span: the

@@ -12,6 +12,7 @@ end-to-end request tracing (docs/observability.md).
 
 from incubator_predictionio_tpu.obs.metrics import (  # noqa: F401
     DEFAULT_LATENCY_BUCKETS,
+    LatencyReservoir,
     MetricError,
     MetricsRegistry,
     REGISTRY,
@@ -31,7 +32,8 @@ from incubator_predictionio_tpu.obs.trace import (  # noqa: F401
 )
 
 __all__ = [
-    "DEFAULT_LATENCY_BUCKETS", "MetricError", "MetricsRegistry", "REGISTRY",
+    "DEFAULT_LATENCY_BUCKETS", "LatencyReservoir",
+    "MetricError", "MetricsRegistry", "REGISTRY",
     "bucket_quantiles", "nearest_rank_percentiles", "parse_prometheus_text",
     "timed",
     "TRACE_HEADER", "TRACES", "SpanContext", "TraceBuffer",

@@ -72,6 +72,34 @@ def nearest_rank_percentiles(
     return out
 
 
+class LatencyReservoir:
+    """Fixed-size ring of recent latencies → p50/p95/p99 on demand.
+
+    The instrumented form of the north-star metric (BASELINE.md: predict
+    p50); the reference only ever kept avg/last
+    (CreateServer.scala:567-575). A general primitive — the serving layer's
+    status pages and the admission layer's limiter inputs both read it —
+    so it lives here rather than in the query server (its original home;
+    ``server.query_server.LatencyReservoir`` remains as a re-export)."""
+
+    def __init__(self, capacity: int = 4096):
+        self.capacity = capacity
+        self._buf: list[float] = []
+        self._pos = 0
+
+    def record(self, seconds: float) -> None:
+        if len(self._buf) < self.capacity:
+            self._buf.append(seconds)
+        else:
+            self._buf[self._pos] = seconds
+            self._pos = (self._pos + 1) % self.capacity
+
+    def percentiles(
+            self, qs: tuple[float, ...] = (0.5, 0.95, 0.99),
+    ) -> dict[str, float]:
+        return nearest_rank_percentiles(self._buf, qs)
+
+
 def _escape_label_value(v: str) -> str:
     return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
 

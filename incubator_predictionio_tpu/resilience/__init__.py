@@ -13,9 +13,23 @@ short version:
 - health endpoints read :data:`BREAKERS` (``BREAKERS.snapshot()``);
 - tests script failures with :class:`FaultSchedule` + :class:`FaultInjector`
   / :class:`FaultProxy` on a :class:`FakeClock` — deterministic, no wall
-  sleeps.
+  sleeps;
+- the servers gate sheddable work through the admission layer
+  (:mod:`.admission`): adaptive concurrency, bounded queues with
+  deadline-aware shedding, brownout, per-client fairness.
 """
 
+from incubator_predictionio_tpu.resilience.admission import (
+    AdaptiveConcurrencyLimiter,
+    AdmissionConfig,
+    AdmissionController,
+    FairnessGate,
+    InflightGate,
+    RateEstimator,
+    ShedExpired,
+    TokenBucket,
+    derive_retry_after,
+)
 from incubator_predictionio_tpu.resilience.breaker import (
     BREAKERS,
     BreakerRegistry,
@@ -56,6 +70,9 @@ from incubator_predictionio_tpu.resilience.wal import (
 )
 
 __all__ = [
+    "AdaptiveConcurrencyLimiter", "AdmissionConfig", "AdmissionController",
+    "FairnessGate", "InflightGate", "RateEstimator", "ShedExpired",
+    "TokenBucket", "derive_retry_after",
     "BREAKERS", "BreakerRegistry", "CircuitBreaker", "CircuitOpenError",
     "SYSTEM_CLOCK", "Clock", "FakeClock", "SystemClock",
     "FaultInjector", "FaultProxy", "FaultSchedule",

@@ -53,11 +53,17 @@ from incubator_predictionio_tpu.data.event import (
 from incubator_predictionio_tpu.data.storage.base import AccessKey
 from incubator_predictionio_tpu.data.storage.registry import Storage, get_storage
 from incubator_predictionio_tpu.data.webhooks import CONNECTORS, ConnectorError
+from incubator_predictionio_tpu.resilience.admission import (
+    FairnessGate,
+    RateEstimator,
+    derive_retry_after,
+)
 from incubator_predictionio_tpu.resilience.breaker import (
     BREAKERS,
     CircuitBreaker,
     CircuitOpenError,
 )
+from incubator_predictionio_tpu.resilience.clock import SYSTEM_CLOCK, Clock
 from incubator_predictionio_tpu.resilience.policy import (
     DeadlineExceeded,
     TransientError,
@@ -160,6 +166,18 @@ class EventServerConfig:
     wal_fsync: bool = dataclasses.field(
         default_factory=lambda: os.environ.get(
             "PIO_EVENT_WAL_FSYNC", "1") != "0")
+    # -- per-client fairness (resilience/admission.py) --------------------
+    # token-bucket rate per access key, events/sec; 0 disables. A client
+    # over its rate answers 429 + Retry-After alone — everyone else's
+    # ingest is untouched. Enabling this trades the native C ingest fast
+    # path for policing (the gate needs the parsed request).
+    client_rate: float = dataclasses.field(
+        default_factory=lambda: float(
+            os.environ.get("PIO_EVENTSERVER_CLIENT_RATE", "0")))
+    # bucket capacity (burst); 0 → 2× the rate
+    client_burst: float = dataclasses.field(
+        default_factory=lambda: float(
+            os.environ.get("PIO_EVENTSERVER_CLIENT_BURST", "0")))
 
 
 @dataclasses.dataclass
@@ -177,10 +195,19 @@ class WhitelistDenied(Exception):
 
 class EventServer:
     def __init__(self, config: EventServerConfig = EventServerConfig(),
-                 storage: Optional[Storage] = None):
+                 storage: Optional[Storage] = None,
+                 clock: Clock = SYSTEM_CLOCK):
         self.config = config
         self.storage = storage or get_storage()
         self.stats = Stats()
+        # -- overload protection (resilience/admission.py) ----------------
+        # per-access-key token buckets: a misbehaving client is throttled
+        # alone instead of starving every tenant's ingest; the drain-rate
+        # estimator turns spill pressure into honest Retry-After hints
+        self._fairness = FairnessGate(
+            config.client_rate, config.client_burst, clock=clock,
+            server="event_server")
+        self._drain_rate = RateEstimator(clock=clock)
         self._runner: Optional[web.AppRunner] = None
         # Storage calls are synchronous (LEvents contract, storage/base.py);
         # run them here so concurrent ingestion can't stall the accept loop —
@@ -264,6 +291,27 @@ class EventServer:
                 for status, n in statuses.items():
                     _EVENTS_HOUR.labels(app_id=str(app_id),
                                         status=status).set(n)
+
+    def _retry_after_hint(self) -> int:
+        """Pressure-derived ``Retry-After`` for 503s: WAL-backed spill
+        depth ÷ the recent drain throughput (resilience/admission.py),
+        falling back to the static ``retry_after_sec`` when the drainer
+        has produced no rate signal yet — a client told '5' while 900
+        events drain at 50/s would just come back to another 503."""
+        return derive_retry_after(len(self._spill), self._drain_rate.rate(),
+                                  self.config.retry_after_sec)
+
+    def _throttle_response(self, retry_after: int,
+                           app_id: Optional[int] = None) -> web.Response:
+        # overload rejections must be visible in /stats.json like the 503
+        # spill path — a hot app's event count dropping with no 429 tally
+        # would read as lost traffic, not rate enforcement
+        if self.config.stats and app_id is not None:
+            self.stats.update(app_id, 429, "<throttled>", "<throttled>")
+        return web.json_response(
+            {"message": "client rate limit exceeded; retry later "
+                        "(docs/resilience.md)"},
+            status=429, headers={"Retry-After": str(retry_after)})
 
     @staticmethod
     def _auth_ttl() -> float:
@@ -582,6 +630,9 @@ class EventServer:
                 self.config.wal_dir or "<disabled>")
             raise
         self._store_breaker.record_success()
+        # drained events are the Retry-After hint's rate signal: clients
+        # told to come back see depth ÷ THIS throughput, not a constant
+        self._drain_rate.record(len(batch))
         with self._spill_lock:
             # only this drainer pops; ingest threads only append — the head
             # run we snapshotted is still the head
@@ -635,6 +686,9 @@ class EventServer:
         if self._drain_state.draining:
             return self._drain_state.reject_response()
         auth = await self._authenticate_cached(request)
+        throttle = self._fairness.admit(self._extract_key(request) or "")
+        if throttle is not None:
+            return self._throttle_response(throttle, auth.app_id)
         raw = await request.read()
         if not self.config.stats:  # stats needs the parsed payload fields
             fast = await self._try_native_ingest(raw, True, -1, auth)
@@ -658,7 +712,7 @@ class EventServer:
             status, body = 403, {"message": str(e)}
         except SpillQueueFull as e:
             status, body, headers = 503, {"message": str(e)}, \
-                {"Retry-After": str(self.config.retry_after_sec)}
+                {"Retry-After": str(self._retry_after_hint())}
         if self.config.stats:
             self.stats.update(
                 auth.app_id, status,
@@ -713,9 +767,10 @@ class EventServer:
             return self._drain_state.reject_response()
         auth = await self._authenticate_cached(request)
         raw = await request.read()
-        if not self.config.stats:  # stats needs the parsed payload fields
-            # (ADVICE r5: the fast path must not make batched events
-            # invisible to /stats.json — same gate as handle_create)
+        # stats needs the parsed payload fields (ADVICE r5: the fast path
+        # must not make batched events invisible to /stats.json); fairness
+        # needs the parsed item count — both gate the raw-bytes fast path
+        if not self.config.stats and not self._fairness.enabled:
             fast = await self._try_native_ingest(raw, False, MAX_BATCH_SIZE, auth)
             if fast is not None:
                 return web.json_response(fast, status=200)
@@ -733,6 +788,12 @@ class EventServer:
                             f"{MAX_BATCH_SIZE} events"},
                 status=400,
             )
+        # fairness charges the batch its event count — 50-event batches
+        # must not cost the same as single posts or the bucket is a sieve
+        throttle = self._fairness.admit(
+            self._extract_key(request) or "", float(max(1, len(payload))))
+        if throttle is not None:
+            return self._throttle_response(throttle, auth.app_id)
         try:
             if self._inline_batch:
                 results = self._ingest_batch(payload, auth)
@@ -749,7 +810,7 @@ class EventServer:
                     or [{"status": 503}] * len(payload))
             return web.json_response(
                 {"message": str(e)}, status=503,
-                headers={"Retry-After": str(self.config.retry_after_sec)})
+                headers={"Retry-After": str(self._retry_after_hint())})
         if self.config.stats:
             # per accepted/denied item, like the reference's per-batch-event
             # Bookkeeping updates (EventServer.scala:421-423)
@@ -876,6 +937,14 @@ class EventServer:
             "backendBreakers": backends,
             "spillQueueDepth": depth,
             "spillQueueMax": self.config.spill_max,
+            # overload surface (docs/resilience.md "Overload & admission
+            # control"): what a 503'd client would currently be told, and
+            # the per-client fairness tallies
+            "admission": {
+                "retryAfterHint": self._retry_after_hint(),
+                "drainRatePerSec": round(self._drain_rate.rate(), 3),
+                "fairness": self._fairness.snapshot(),
+            },
             "spillWal": {
                 "enabled": self._wal is not None,
                 "dir": self.config.wal_dir or None,
@@ -907,6 +976,9 @@ class EventServer:
         if self._drain_state.draining:
             return self._drain_state.reject_response()
         auth = await self._authenticate_cached(request)
+        throttle = self._fairness.admit(self._extract_key(request) or "")
+        if throttle is not None:
+            return self._throttle_response(throttle, auth.app_id)
         name = request.match_info["name"]
         form = request.match_info.get("ext") == "form"
         connector = CONNECTORS.get((name, "form" if form else "json"))
@@ -928,7 +1000,7 @@ class EventServer:
         except SpillQueueFull as e:
             return web.json_response(
                 {"message": str(e)}, status=503,
-                headers={"Retry-After": str(self.config.retry_after_sec)})
+                headers={"Retry-After": str(self._retry_after_hint())})
 
     async def handle_webhook_get(self, request: web.Request) -> web.Response:
         await self._authenticate_cached(request)
@@ -1002,6 +1074,10 @@ class EventServer:
         from incubator_predictionio_tpu.server.plugins import EVENT_SERVER_PLUGINS
 
         if EVENT_SERVER_PLUGINS or native.get_lib() is None:
+            return False
+        if self._fairness.enabled:
+            # per-client fairness needs every ingest to pass the token
+            # bucket — the C front would answer hot routes un-policed
             return False
         return getattr(self.storage.get_events(), "ingest_raw", None) is not None
 

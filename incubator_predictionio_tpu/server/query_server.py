@@ -33,6 +33,7 @@ import datetime as _dt
 import hashlib
 import json
 import logging
+import os
 import time
 import uuid
 from typing import Any, Optional
@@ -45,7 +46,14 @@ from incubator_predictionio_tpu.obs.http import (
 )
 from incubator_predictionio_tpu.obs.metrics import (
     REGISTRY,
-    nearest_rank_percentiles,
+    LatencyReservoir,
+)
+from incubator_predictionio_tpu.resilience.admission import (
+    BROWNOUT,
+    REJECT,
+    AdmissionConfig,
+    AdmissionController,
+    ShedExpired,
 )
 from incubator_predictionio_tpu.resilience.breaker import publish_breaker_metrics
 
@@ -164,6 +172,36 @@ class ServerConfig:
     # seconds after a successful swap during which a serving-breaker trip
     # auto-rolls back to the previous (pinned) instance; 0 disables
     reload_probation_sec: float = 30.0
+    # -- overload protection (resilience/admission.py) --------------------
+    # bounded admission queue: queries beyond this many waiting requests
+    # are rejected at the door with 429 + pressure-derived Retry-After
+    # (docs/resilience.md "Overload & admission control")
+    admission_max_queue: int = dataclasses.field(
+        default_factory=lambda: int(
+            os.environ.get("PIO_ADMISSION_MAX_QUEUE", "256")))
+    # adaptive concurrency limiter: AIMD on observed latency, live-resizes
+    # the micro-batcher's dispatch slots within [1, effective max]
+    admission_adaptive: bool = dataclasses.field(
+        default_factory=lambda: os.environ.get(
+            "PIO_ADMISSION_ADAPTIVE", "1") != "0")
+    # explicit latency target for the limiter (ms); unset = gradient mode
+    # (the target tracks a rolling-minimum latency baseline)
+    admission_target_ms: Optional[float] = dataclasses.field(
+        default_factory=lambda: (
+            float(os.environ["PIO_ADMISSION_TARGET_MS"])
+            if os.environ.get("PIO_ADMISSION_TARGET_MS") else None))
+    # brownout hysteresis: saturation (predicted wait ≥ enter_frac of the
+    # deadline) sustained for enter_sec flips the server to the degraded
+    # path; exit needs exit_sec of clear air
+    brownout_enter_frac: float = dataclasses.field(
+        default_factory=lambda: float(
+            os.environ.get("PIO_BROWNOUT_ENTER_FRAC", "0.5")))
+    brownout_enter_sec: float = dataclasses.field(
+        default_factory=lambda: float(
+            os.environ.get("PIO_BROWNOUT_ENTER_SEC", "1.0")))
+    brownout_exit_sec: float = dataclasses.field(
+        default_factory=lambda: float(
+            os.environ.get("PIO_BROWNOUT_EXIT_SEC", "2.0")))
 
 
 class DeployedEngine:
@@ -419,20 +457,32 @@ class MicroBatcher:
     Tail observability: ``queue_delay`` (submit → batch assembly) and
     ``dispatch`` (assembly → results) reservoirs split the latency into its
     two terms; both are exposed on the status page.
+
+    Overload protection (resilience/admission.py): each request is tagged
+    with its deadline at enqueue; batch assembly evicts entries whose
+    deadline already expired (their futures resolve :class:`ShedExpired`
+    → 504) instead of wasting a device dispatch on work nobody is waiting
+    for. Deadline decisions run on the injected clock, so they are
+    deterministic under ``FakeClock``.
     """
 
     def __init__(self, deployed: DeployedEngine, max_batch: int = 64,
                  max_in_flight: int = 2,
-                 deadline_sec: Optional[float] = None):
+                 deadline_sec: Optional[float] = None,
+                 clock: Clock = SYSTEM_CLOCK,
+                 admission: Optional[AdmissionController] = None):
         self.deployed = deployed
         self.max_batch = max_batch
         self.max_in_flight = max_in_flight
         # per-batch budget, propagated into the worker thread as the
         # ambient deadline so storage calls under predict inherit it
         self.deadline_sec = deadline_sec
+        self._clock = clock
+        self._admission = admission  # shed bookkeeping only (may be None)
         self.queue: asyncio.Queue = asyncio.Queue()
         self.batches_served = 0
         self.max_batch_seen = 0
+        self.shed_expired = 0
         self.queue_delay = LatencyReservoir()
         self.dispatch_sec = LatencyReservoir()
         self._task: Optional[asyncio.Task] = None
@@ -459,9 +509,10 @@ class MicroBatcher:
             self._task = None
         while True:
             try:
-                _, fut, _, _ = self.queue.get_nowait()
+                entry = self.queue.get_nowait()
             except asyncio.QueueEmpty:
                 break
+            fut = entry[1]
             if not fut.done():
                 fut.set_result(RuntimeError("server shutting down"))
 
@@ -474,13 +525,24 @@ class MicroBatcher:
         shared state, so overlapping dispatches can't swap timings."""
         self.start()
         fut = asyncio.get_running_loop().create_future()
+        # deadline tagged at enqueue (docs/resilience.md shedding order):
+        # batch assembly evicts this entry with ShedExpired once it passes
+        deadline_at = (self._clock.monotonic() + self.deadline_sec
+                       if self.deadline_sec is not None else None)
         # carry the submitter's contextvars (trace identity from the
         # telemetry middleware) — the dispatch worker thread re-enters the
         # first request's context so storage calls under predict stay on the
         # caller's trace (coalesced followers share that dispatch span)
         await self.queue.put((payload, fut, time.perf_counter(),
-                              contextvars.copy_context()))
-        got = await fut
+                              contextvars.copy_context(), deadline_at))
+        try:
+            got = await fut
+        except asyncio.CancelledError:
+            # the waiter is gone (handler timeout/disconnect): mark the
+            # queued entry abandoned so assembly drops it silently instead
+            # of counting it as a shed the caller never saw
+            fut.cancel()
+            raise
         if isinstance(got, _Delivered):
             result, algo_times = got.result, got.algo_times
         else:  # error paths deliver bare exceptions
@@ -489,9 +551,10 @@ class MicroBatcher:
             raise result
         return result, algo_times
 
-    async def set_max_in_flight(self, n: int) -> None:
+    async def resize(self, n: int) -> None:
         """Resize the dispatch-slot semaphore live (reload can swap in an
-        engine with a different thread-safety posture). Growing releases
+        engine with a different thread-safety posture; the adaptive
+        admission limiter shrinks/grows it under load). Growing releases
         slots immediately; shrinking acquires the excess — waiting out
         in-flight dispatches — so the new bound is real, not advisory."""
         n = max(1, n)
@@ -505,6 +568,9 @@ class MicroBatcher:
         else:
             for _ in range(-delta):
                 await self._sem.acquire()
+
+    #: historical name, kept callable (pre-admission callers and tests)
+    set_max_in_flight = resize
 
     async def _drain(self) -> None:
         loop = asyncio.get_running_loop()
@@ -527,8 +593,14 @@ class MicroBatcher:
                     except asyncio.QueueEmpty:
                         break
                 now = time.perf_counter()
-                for _, _, t_enq, _ in batch:
-                    self.queue_delay.record(now - t_enq)
+                for entry in batch:
+                    self.queue_delay.record(now - entry[2])
+                batch = self._evict_expired(batch)
+                if not batch:
+                    # the whole assembly was dead on arrival: no dispatch,
+                    # hand the slot back and keep draining
+                    sem.release()
+                    continue
                 self.batches_served += 1
                 self.max_batch_seen = max(self.max_batch_seen, len(batch))
                 task = loop.create_task(self._dispatch(loop, batch))
@@ -547,9 +619,40 @@ class MicroBatcher:
                     pass
             raise
 
+    def _evict_expired(self, batch: list) -> list:
+        """Deadline-aware shedding at batch-assembly time (the 504-evict
+        step of the shedding order): entries whose deadline passed while
+        they queued resolve ShedExpired instead of riding the dispatch —
+        the caller already timed out, and dead work on the device would
+        only inflate every live request's tail."""
+        now = self._clock.monotonic()
+        live = []
+        shed = 0
+        for entry in batch:
+            # entries are (payload, fut, t_enq, ctx, deadline_at); tests
+            # that inject raw 4-tuples simply have no deadline
+            if entry[1].done():
+                # abandoned (waiter cancelled/answered already): drop
+                # without dispatching AND without shed bookkeeping — the
+                # caller never saw a 504, and phantom counts would inflate
+                # the service-rate estimate the 429 gate trusts
+                continue
+            deadline_at = entry[4] if len(entry) > 4 else None
+            if deadline_at is not None and now >= deadline_at:
+                shed += 1
+                entry[1].set_result(ShedExpired(
+                    "deadline expired before dispatch"))
+            else:
+                live.append(entry)
+        if shed:
+            self.shed_expired += shed
+            if self._admission is not None:
+                self._admission.on_shed_expired(shed)
+        return live
+
     async def _dispatch(self, loop, batch) -> None:
         t0 = time.perf_counter()
-        payloads = [p for p, _, _, _ in batch]
+        payloads = [entry[0] for entry in batch]
         # run_in_executor does not copy contextvars — run_with_deadline
         # re-establishes the deadline scope inside the worker thread, and
         # entering the first request's captured context carries its trace
@@ -565,9 +668,9 @@ class MicroBatcher:
             # cancelled mid-dispatch: these futures are already dequeued, so
             # the queue-drain in stop() can't see them — fail them here or
             # their callers hang forever
-            for _, fut, _, _ in batch:
-                if not fut.done():
-                    fut.set_result(RuntimeError("server shutting down"))
+            for entry in batch:
+                if not entry[1].done():
+                    entry[1].set_result(RuntimeError("server shutting down"))
             raise
         except Exception as e:  # noqa: BLE001 - keep serving
             results = [e] * len(batch)
@@ -575,31 +678,14 @@ class MicroBatcher:
         # predict_batch published its per-algorithm times inside ctx; writes
         # made under Context.run persist in the Context object
         algo_times = ctx.get(_DISPATCH_ALGO_TIMES, [])
-        for (_, fut, _, _), r in zip(batch, results):
-            if not fut.done():
-                fut.set_result(_Delivered(r, algo_times))
+        for entry, r in zip(batch, results):
+            if not entry[1].done():
+                entry[1].set_result(_Delivered(r, algo_times))
 
 
-class LatencyReservoir:
-    """Fixed-size ring of recent serving latencies → p50/p95/p99 on demand.
-
-    The instrumented form of the north-star metric (BASELINE.md: predict p50);
-    the reference only ever kept avg/last (CreateServer.scala:567-575)."""
-
-    def __init__(self, capacity: int = 4096):
-        self.capacity = capacity
-        self._buf: list[float] = []
-        self._pos = 0
-
-    def record(self, seconds: float) -> None:
-        if len(self._buf) < self.capacity:
-            self._buf.append(seconds)
-        else:
-            self._buf[self._pos] = seconds
-            self._pos = (self._pos + 1) % self.capacity
-
-    def percentiles(self, qs: tuple[float, ...] = (0.5, 0.95, 0.99)) -> dict[str, float]:
-        return nearest_rank_percentiles(self._buf, qs)
+# LatencyReservoir moved to obs/metrics.py (it is a general primitive the
+# admission limiter needs too); imported above and re-exported here so
+# existing ``from ...query_server import LatencyReservoir`` keeps working.
 
 
 def load_deployed_engine(
@@ -674,11 +760,31 @@ class QueryServer:
         # hand-built engines to script failure modes)
         self.deployed = deployed or load_deployed_engine(
             config, self.storage, self.ctx)
+        # -- overload protection (resilience/admission.py) ----------------
+        # the door policy for sheddable query traffic: bounded queue +
+        # deadline feasibility (429), brownout (degraded 200s), and the
+        # adaptive concurrency limiter that live-resizes dispatch slots.
+        # Health/metrics/reload are separate always-admitted routes.
+        self._admission = AdmissionController(
+            AdmissionConfig(
+                max_queue=config.admission_max_queue,
+                deadline_sec=config.query_timeout_sec,
+                adaptive=config.admission_adaptive,
+                max_inflight=effective_max_in_flight(config, self.deployed),
+                target_latency_sec=(
+                    config.admission_target_ms / 1e3
+                    if config.admission_target_ms is not None else None),
+                brownout_enter_frac=config.brownout_enter_frac,
+                brownout_enter_sec=config.brownout_enter_sec,
+                brownout_exit_sec=config.brownout_exit_sec,
+            ), clock=clock, server="query_server")
         self.batcher = MicroBatcher(
             self.deployed, max_batch=config.max_batch,
             max_in_flight=effective_max_in_flight(config, self.deployed),
             deadline_sec=config.query_timeout_sec,
+            clock=clock, admission=self._admission,
         )
+        self._resize_tasks: set[asyncio.Task] = set()  # strong refs
         self.request_count = 0
         self.avg_serving_sec = 0.0
         self.last_serving_sec = 0.0
@@ -727,6 +833,7 @@ class QueryServer:
         _G_REQUESTS.set(self.request_count)
         _G_BATCHES.set(self.batcher.batches_served)
         _G_MAX_BATCH.set(self.batcher.max_batch_seen)
+        self._admission.publish(self.batcher.queue.qsize())
         for stage, res in (("total", self.latency),
                            ("queue_delay", self.batcher.queue_delay),
                            ("dispatch", self.batcher.dispatch_sec)):
@@ -779,6 +886,10 @@ class QueryServer:
             "algorithmBreakers": algo,
             "backendBreakers": backends,
             "degradedResponses": self.degraded_count,
+            # overload surface (docs/resilience.md "Overload & admission
+            # control"): queue bound, brownout, limiter, shed tallies
+            "admission": self._admission.snapshot(
+                self.batcher.queue.qsize()),
             # crash-safe lifecycle surface (docs/resilience.md): which
             # instance serves, whether a previous one is pinned for
             # rollback, and what the last reload did
@@ -823,6 +934,10 @@ class QueryServer:
             "dispatchSecPercentiles": self.batcher.dispatch_sec.percentiles(),
             "batchesServed": self.batcher.batches_served,
             "maxBatchSeen": self.batcher.max_batch_seen,
+            # overload tallies (docs/resilience.md): queued-past-deadline
+            # evictions and the live dispatch-slot bound
+            "shedExpired": self.batcher.shed_expired,
+            "maxInFlight": self.batcher.max_in_flight,
             # compile-churn gauge: distinct serving executables built in this
             # process; must stay flat under load once warmup has run
             "jitCompileKeys": jitstats.count(),
@@ -908,8 +1023,7 @@ class QueryServer:
     async def handle_query(self, request: web.Request) -> web.Response:
         if self._drain_state.draining:
             return self._drain_state.reject_response()
-        status, result, timing = await self._serve_payload(await request.read())
-        headers = {"X-PIO-Server-Timing": timing} if timing else None
+        status, result, headers = await self._serve_payload(await request.read())
         return web.json_response(result, status=status, headers=headers)
 
     @staticmethod
@@ -923,17 +1037,58 @@ class QueryServer:
                      for name, sec in algo_times)
         return ", ".join(parts)
 
-    async def _serve_payload(self, body: bytes) -> tuple[int, Any, Optional[str]]:
+    def _feed_admission(self, dt: float,
+                        observe_latency: bool = True) -> None:
+        """Every request that consumed a batcher queue slot counts as drain
+        progress — 400 binding rejections, timeout-degraded answers, and
+        engine exceptions all drained the queue (and usually a dispatch)
+        just like clean 200s, and a service-rate estimate fed only by
+        successes under-reads the true drain rate, shedding good traffic
+        below capacity on mixed workloads. Brownout answers and abandoned
+        entries never enter the queue, so they stay out; assembly-time
+        504-evictions are recorded by ``on_shed_expired`` instead. Only
+        clean predictions carry ``observe_latency`` — the AIMD limiter's
+        gradient baseline must track genuine predict latency, not a fast
+        400's — and a changed limit resizes the batcher's slots off the
+        hot path."""
+        new_limit = self._admission.on_complete(
+            dt, observe_latency=observe_latency)
+        if new_limit is not None and new_limit != self.batcher.max_in_flight:
+            task = asyncio.create_task(self.batcher.resize(new_limit))
+            self._resize_tasks.add(task)
+            task.add_done_callback(self._resize_tasks.discard)
+
+    async def _serve_payload(
+            self, body: bytes) -> tuple[int, Any, Optional[dict]]:
         """The whole query lifecycle from raw body bytes — ONE code path
         shared by the aiohttp route and the native front, so their behavior
-        cannot drift. Returns (status, jsonable body, Server-Timing value or
-        None on non-predict outcomes)."""
+        cannot drift. Returns (status, jsonable body, response headers or
+        None) — headers carry X-PIO-Server-Timing on predictions and
+        Retry-After on overload rejections."""
         t0 = time.time()
         try:
             payload = json.loads(body)
         except json.JSONDecodeError:
             return 400, {"message": "Invalid JSON query"}, None
         loop = asyncio.get_running_loop()
+        # -- admission door (resilience/admission.py) ---------------------
+        # shedding order (docs/resilience.md): brownout (degraded 200)
+        # before 429-reject before the batcher's 504-evict. Health,
+        # /metrics, and /reload never pass this door.
+        decision, retry_after = self._admission.decide(
+            self.batcher.queue.qsize())
+        if decision == REJECT:
+            return 429, {
+                "message": "server overloaded; rejected by admission "
+                           "control (docs/resilience.md)",
+            }, {"Retry-After": str(retry_after)}
+        if decision == BROWNOUT:
+            # sustained saturation: answer from the degraded path (last-
+            # good cache / serving default) without touching the device
+            # queue — valid 200s for everyone beats shedding for some
+            return 200, await loop.run_in_executor(
+                None, self._degraded_result, payload,
+                "brownout (admission control)"), None
         if not self._serving_breaker.allow():
             # the predict path has been failing hard: degrade instantly
             # instead of waiting out another budget (half-open probes are
@@ -946,8 +1101,17 @@ class QueryServer:
         try:
             submitted = self.batcher.submit_timed(payload)
             if self.config.query_timeout_sec is not None:
+                # the degraded-200 backstop waits a small GRACE past the
+                # budget: the batcher's 504-evict (assembly-time shed of
+                # queued-expired requests) fires AT the budget, so under
+                # overload the orderly shed wins; the backstop only
+                # catches a wedged dispatch that produced no assembly at
+                # all — firing both at the same instant would make the
+                # shed path unreachable and charge the serving breaker
+                # (and probation rollback) for pure overload
+                budget = self.config.query_timeout_sec
                 prediction, algo_times = await asyncio.wait_for(
-                    submitted, self.config.query_timeout_sec)
+                    submitted, budget + max(0.05, 0.1 * budget))
             else:
                 prediction, algo_times = await submitted
         except asyncio.CancelledError:
@@ -956,10 +1120,22 @@ class QueryServer:
             # half-open probe slot or the breaker wedges half-open forever
             self._serving_breaker.release_probe()
             raise
+        except ShedExpired:
+            # evicted at batch assembly: the deadline passed while queued.
+            # Overload, not an engine verdict — the probe slot goes back
+            # untouched and the caller gets a fail-fast 504 with the same
+            # pressure-derived hint the 429 path sends
+            self._serving_breaker.release_probe()
+            return 504, {
+                "message": "deadline expired before dispatch; request "
+                           "shed (docs/resilience.md)",
+            }, {"Retry-After": str(
+                self._admission.retry_after(self.batcher.queue.qsize()))}
         except (TypeError, ValueError, KeyError) as e:
             # the engine answered (binding rejected the query): health-wise
             # a success — a half-open probe slot must never leak
             self._serving_breaker.record_success()
+            self._feed_admission(time.time() - t0, observe_latency=False)
             return 400, {"message": f"Invalid query: {e}"}, None
         except (asyncio.TimeoutError, ServingUnavailable, DeadlineExceeded,
                 CircuitOpenError) as e:
@@ -970,6 +1146,7 @@ class QueryServer:
             # freshly swapped instance — restore the pinned previous one
             await self._maybe_probation_rollback(repr(e))
             self._ship_remote_log(f"query degraded: {e!r}")
+            self._feed_admission(time.time() - t0, observe_latency=False)
             return 200, await loop.run_in_executor(
                 None, self._degraded_result, payload, repr(e)), None
         except Exception as e:  # noqa: BLE001 - ship serving errors remotely
@@ -980,6 +1157,7 @@ class QueryServer:
             # surfaces here as ServingUnavailable (counted above).
             self._serving_breaker.record_success()
             self._ship_remote_log(f"query failed: {e!r}")
+            self._feed_admission(time.time() - t0, observe_latency=False)
             raise
         self._serving_breaker.record_success()
         dt = time.time() - t0
@@ -987,6 +1165,7 @@ class QueryServer:
         self.last_serving_sec = dt
         self.avg_serving_sec += (dt - self.avg_serving_sec) / self.request_count
         self.latency.record(dt)
+        self._feed_admission(dt)
         # camelCase field names: the reference's response shape
         # (CreateServer.scala:494's json4s serialization of e.g. ItemScore)
         result = to_jsonable(prediction, camelize_fields=True)
@@ -1000,7 +1179,8 @@ class QueryServer:
             task = asyncio.create_task(self._send_feedback(payload, result))
             self._feedback_tasks.add(task)
             task.add_done_callback(self._feedback_tasks.discard)
-        return 200, result, self._server_timing(dt, algo_times)
+        return 200, result, {
+            "X-PIO-Server-Timing": self._server_timing(dt, algo_times)}
 
     # -- graceful degradation (resilience/) -------------------------------
     @staticmethod
@@ -1163,10 +1343,12 @@ class QueryServer:
         # it or /reload would silently keep serving the stale model.
         self.batcher.deployed = new
         # the reloaded engine may have a different thread-safety posture —
-        # re-resolve the overlap bound or auto mode's no-race guarantee
-        # breaks across /reload
-        await self.batcher.set_max_in_flight(
-            effective_max_in_flight(self.config, new))
+        # re-resolve the overlap bound (and re-bound the adaptive limiter,
+        # which also resets its latency baseline: new engine, new floor)
+        # or auto mode's no-race guarantee breaks across /reload
+        bound = effective_max_in_flight(self.config, new)
+        limit = self._admission.set_max_inflight(bound)
+        await self.batcher.resize(limit if limit is not None else bound)
         self._previous = old
         self._probation_until = (
             self._clock.monotonic() + self.config.reload_probation_sec
@@ -1222,8 +1404,9 @@ class QueryServer:
         rolled_from = self.deployed.instance.id
         self.deployed = prev
         self.batcher.deployed = prev
-        await self.batcher.set_max_in_flight(
-            effective_max_in_flight(self.config, prev))
+        bound = effective_max_in_flight(self.config, prev)
+        limit = self._admission.set_max_inflight(bound)
+        await self.batcher.resize(limit if limit is not None else bound)
         self._serving_breaker.record_success()  # clean slate for the restore
         self._rollback_count += 1
         _ROLLBACKS.inc()
@@ -1315,15 +1498,17 @@ class QueryServer:
         from incubator_predictionio_tpu import native
 
         try:
-            status, result, timing = await self._serve_payload(body)
+            status, result, headers = await self._serve_payload(body)
             payload = json.dumps(result).encode()
-            reason = {200: "OK", 400: "Bad Request"}.get(status, "Error")
-            timing_line = (f"X-PIO-Server-Timing: {timing}\r\n"
-                           if timing else "")
+            reason = {200: "OK", 400: "Bad Request",
+                      429: "Too Many Requests",
+                      504: "Gateway Timeout"}.get(status, "Error")
+            extra = "".join(f"{k}: {v}\r\n"
+                            for k, v in (headers or {}).items())
             resp = (f"HTTP/1.1 {status} {reason}\r\n"
                     f"Content-Type: application/json; charset=utf-8\r\n"
                     f"Content-Length: {len(payload)}\r\n"
-                    f"{timing_line}"
+                    f"{extra}"
                     f"Connection: keep-alive\r\n\r\n").encode() + payload
         except Exception:  # noqa: BLE001 - aiohttp would 500 here
             logger.exception("native serving handler error")
@@ -1368,6 +1553,10 @@ class QueryServer:
             self._front = None
         if self._runner is not None:
             await self._runner.cleanup()
+        # a shrink mid-shutdown could be parked on the dispatch semaphore;
+        # nothing will ever need the smaller bound again
+        for task in list(self._resize_tasks):
+            task.cancel()
         await self.batcher.stop()
 
 

@@ -42,6 +42,7 @@ import dataclasses
 import io
 import json
 import logging
+import os
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Optional
 
@@ -52,6 +53,7 @@ from incubator_predictionio_tpu.obs.http import (
     add_observability_routes,
     telemetry_middleware,
 )
+from incubator_predictionio_tpu.resilience.admission import InflightGate
 
 from incubator_predictionio_tpu.data.event import Event
 from incubator_predictionio_tpu.data.storage.base import (
@@ -97,6 +99,20 @@ class StorageServerConfig:
     ssl_cert: Optional[str] = None
     ssl_key: Optional[str] = None
     server_access_key: Optional[str] = None  # shared secret for all calls
+    # -- per-client fairness (resilience/admission.py) --------------------
+    # concurrent in-flight RPCs allowed per client address; beyond it the
+    # client answers 429 and queues behind ITSELF, not behind every other
+    # query server sharing this store. 0 disables.
+    client_inflight: int = dataclasses.field(
+        default_factory=lambda: int(
+            os.environ.get("PIO_STORAGE_CLIENT_INFLIGHT", "64")))
+    # aggregate in-flight cap per source ADDRESS, regardless of the
+    # self-reported X-PIO-Client identity: rotating identities must not
+    # mint unlimited budget. 0 = auto (8 × client_inflight — wide enough
+    # for a NAT'd fleet, bounded all the same).
+    remote_inflight: int = dataclasses.field(
+        default_factory=lambda: int(
+            os.environ.get("PIO_STORAGE_REMOTE_INFLIGHT", "0")))
 
 
 class StorageServer:
@@ -112,6 +128,50 @@ class StorageServer:
         # graceful drain (server/lifecycle.py): new RPCs answer 503 while
         # in-flight storage calls finish under the runner's cleanup
         self._drain_state = DrainState("storage_server")
+        # per-client in-flight caps (resilience/admission.py): one hot
+        # query server cannot occupy every executor thread at once
+        self._inflight_gate = InflightGate(config.client_inflight)
+        # the per-identity key comes from a self-reported header, so a
+        # second gate caps the source address in aggregate — an identity-
+        # rotating client stays bounded instead of minting fresh budget
+        # per request
+        self._remote_gate = InflightGate(
+            config.remote_inflight or 8 * config.client_inflight)
+
+    def _client_key(self, request: web.Request) -> str:
+        # the client's self-reported process identity (remote.py sends
+        # host:pid) beats the peer address: distinct query servers behind
+        # one proxy/NAT must not share a single in-flight cap, and two
+        # server processes on one host must not either. The address is
+        # appended so an adversarial client can't impersonate another's
+        # identity to eat its budget from a different machine.
+        ident = request.headers.get("X-PIO-Client")
+        remote = request.remote or "unknown"
+        return f"{ident}@{remote}" if ident else remote
+
+    def _throttle_response(self) -> web.Response:
+        return web.json_response(
+            {"message": "per-client in-flight RPC cap reached "
+                        "(docs/resilience.md)"},
+            status=429, headers={"Retry-After": "1"})
+
+    def _admit_rpc(self, request: web.Request) -> Optional[tuple[str, str]]:
+        """Acquire BOTH in-flight gates (per-identity, then per-address);
+        returns the key pair to hand back to :meth:`_release_rpc`, or
+        ``None`` when either cap is reached."""
+        key = self._client_key(request)
+        rkey = request.remote or "unknown"
+        if not self._inflight_gate.acquire(key):
+            return None
+        if not self._remote_gate.acquire(rkey):
+            self._inflight_gate.release(key)
+            return None
+        return key, rkey
+
+    def _release_rpc(self, keys: tuple[str, str]) -> None:
+        key, rkey = keys
+        self._inflight_gate.release(key)
+        self._remote_gate.release(rkey)
 
     async def _run(self, fn, *args, **kw):
         # copy_context: run_in_executor drops contextvars, and the request's
@@ -159,6 +219,12 @@ class StorageServer:
             "status": self._drain_state.health_status(degraded),
             "draining": self._drain_state.draining,
             "backendBreakers": backends,
+            # per-client RPC fairness (docs/resilience.md "Overload &
+            # admission control")
+            "admission": self._inflight_gate.snapshot(),
+            # the per-address aggregate backstop behind the self-reported
+            # identity key
+            "remoteAdmission": self._remote_gate.snapshot(),
         })
 
     # -- generic JSON RPC --------------------------------------------------
@@ -169,21 +235,28 @@ class StorageServer:
             return web.json_response({"message": "Unauthorized"}, status=401)
         store = request.match_info["store"]
         method = request.match_info["method"]
+        keys = self._admit_rpc(request)
+        if keys is None:
+            return self._throttle_response()
         try:
-            args = await request.json()
-        except json.JSONDecodeError:
-            return web.json_response({"message": "invalid JSON"}, status=400)
-        handler = _RPC.get((store, method))
-        if handler is None:
-            return web.json_response(
-                {"message": f"unknown rpc {store}.{method}"}, status=404)
-        try:
-            result = await self._run(handler, self.storage, args)
-        except StorageError as e:
-            return web.json_response({"message": str(e)}, status=500)
-        except (TypeError, ValueError, KeyError) as e:
-            return web.json_response({"message": repr(e)}, status=400)
-        return web.json_response({"result": result})
+            try:
+                args = await request.json()
+            except json.JSONDecodeError:
+                return web.json_response({"message": "invalid JSON"},
+                                         status=400)
+            handler = _RPC.get((store, method))
+            if handler is None:
+                return web.json_response(
+                    {"message": f"unknown rpc {store}.{method}"}, status=404)
+            try:
+                result = await self._run(handler, self.storage, args)
+            except StorageError as e:
+                return web.json_response({"message": str(e)}, status=500)
+            except (TypeError, ValueError, KeyError) as e:
+                return web.json_response({"message": repr(e)}, status=400)
+            return web.json_response({"result": result})
+        finally:
+            self._release_rpc(keys)
 
     # -- streaming find ----------------------------------------------------
     async def handle_find(self, request: web.Request) -> web.StreamResponse:
@@ -191,6 +264,18 @@ class StorageServer:
             return self._drain_state.reject_response()
         if not self._authorized(request):
             return web.json_response({"message": "Unauthorized"}, status=401)
+        # the gates are held for the WHOLE stream: a scan occupies an
+        # executor thread per chunk until it finishes, and that is exactly
+        # the resource one client must not monopolize
+        keys = self._admit_rpc(request)
+        if keys is None:
+            return self._throttle_response()
+        try:
+            return await self._handle_find_gated(request)
+        finally:
+            self._release_rpc(keys)
+
+    async def _handle_find_gated(self, request: web.Request) -> web.StreamResponse:
         try:
             a = await request.json()
         except json.JSONDecodeError:
@@ -261,6 +346,16 @@ class StorageServer:
             return self._drain_state.reject_response()
         if not self._authorized(request):
             return web.json_response({"message": "Unauthorized"}, status=401)
+        keys = self._admit_rpc(request)
+        if keys is None:
+            return self._throttle_response()
+        try:
+            return await self._handle_assemble_gated(request)
+        finally:
+            self._release_rpc(keys)
+
+    async def _handle_assemble_gated(
+            self, request: web.Request) -> web.Response:
         try:
             a = await request.json()
         except json.JSONDecodeError:

@@ -314,6 +314,13 @@ def cmd_deploy(args, storage: Storage) -> int:
         smoke_queries=tuple(
             json.loads(q) for q in (args.smoke_query or ())),
         reload_probation_sec=args.reload_probation_sec,
+        # unset flags keep the PIO_ADMISSION_* env defaults
+        **{k: v for k, v in (
+            ("admission_max_queue", args.admission_max_queue),
+            ("admission_target_ms", args.admission_target_ms),
+        ) if v is not None},
+        **({"admission_adaptive": False}
+           if args.no_adaptive_admission else {}),
     )
     serve_forever(config, storage)
     return 0
@@ -397,6 +404,10 @@ def cmd_eventserver(args, storage: Storage) -> int:
     kw = {}
     if args.wal_dir:  # unset keeps the PIO_EVENT_WAL_DIR env default
         kw["wal_dir"] = args.wal_dir
+    if args.client_rate is not None:  # unset keeps the env default
+        kw["client_rate"] = args.client_rate
+    if args.client_burst is not None:
+        kw["client_burst"] = args.client_burst
     serve_forever(EventServerConfig(ip=args.ip, port=args.port,
                                     stats=args.stats, ssl_cert=args.ssl_cert,
                                     ssl_key=args.ssl_key, **kw), storage)
@@ -409,10 +420,13 @@ def cmd_storageserver(args, storage: Storage) -> int:
         serve_forever,
     )
 
+    kw = {}
+    if args.client_inflight is not None:  # unset keeps the env default
+        kw["client_inflight"] = args.client_inflight
     serve_forever(StorageServerConfig(
         ip=args.ip, port=args.port,
         ssl_cert=args.ssl_cert, ssl_key=args.ssl_key,
-        server_access_key=args.server_access_key), storage)
+        server_access_key=args.server_access_key, **kw), storage)
     return 0
 
 
@@ -715,6 +729,83 @@ def cmd_wal(args, storage: Storage) -> int:
     return 0
 
 
+def _fetch_health(url: str, timeout: float = 5.0) -> dict:
+    """GET <url>/health, parsed. Module-level so tests can stub it."""
+    import urllib.request
+
+    base = url.rstrip("/")
+    if not base.endswith("/health"):
+        base += "/health"
+    with urllib.request.urlopen(base, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _health_row(url: str, h: Optional[dict], err: Optional[str]) -> dict:
+    """One table row from any of the three servers' /health shapes:
+    red = unreachable, draining, or degraded; the detail column names the
+    reason (open breakers, spill depth, brownout, shed/throttle tallies)."""
+    if h is None:
+        return {"url": url, "status": "unreachable", "red": True,
+                "detail": err or ""}
+    breakers: dict[str, dict] = {}
+    for k, v in h.items():
+        if k.endswith("Breakers") and isinstance(v, dict):
+            breakers.update(v)
+        elif k.endswith("Breaker") and isinstance(v, dict):
+            breakers[k] = v
+    parts = []
+    open_names = sorted(n for n, s in breakers.items()
+                        if isinstance(s, dict) and s.get("state") != "closed")
+    if open_names:
+        parts.append("breakers open: " + ", ".join(open_names[:4]))
+    if h.get("spillQueueDepth"):
+        parts.append(f"spill {h['spillQueueDepth']}/{h.get('spillQueueMax')}")
+    if h.get("deadLettered"):
+        parts.append(f"deadLettered {h['deadLettered']}")
+    adm = h.get("admission") or {}
+    if adm.get("brownoutActive"):
+        parts.append("BROWNOUT")
+    if adm.get("queueDepth"):
+        parts.append(f"queue {adm['queueDepth']}/{adm.get('queueMax')}")
+    if adm.get("rejected"):
+        parts.append(f"rejected {adm['rejected']}")
+    if adm.get("shedExpired"):
+        parts.append(f"shed {adm['shedExpired']}")
+    throttled = adm.get("throttled") or (adm.get("fairness") or {}).get(
+        "throttled")
+    if throttled:
+        parts.append(f"throttled {throttled}")
+    status = h.get("status", "unknown")
+    return {"url": url, "status": status, "red": status != "ok",
+            "detail": "; ".join(parts)}
+
+
+def cmd_health(args, storage) -> int:
+    """Aggregate ``GET /health`` from every given server (event, query,
+    storage — any mix) into one table: status, draining, breaker, spill,
+    and admission/overload state. Exit non-zero when ANY server is red
+    (unreachable, draining, or degraded) — the fleet smoke gate the
+    overload chaos test uses (docs/resilience.md)."""
+    rows = []
+    for url in args.urls:
+        try:
+            rows.append(_health_row(url, _fetch_health(url, args.timeout),
+                                    None))
+        except Exception as e:  # noqa: BLE001 - unreachable is a red row
+            rows.append(_health_row(url, None, repr(e)))
+    if args.json:
+        _out(json.dumps(rows, indent=2))
+    else:
+        w = max(len(r["url"]) for r in rows)
+        for r in rows:
+            mark = "!!" if r["red"] else "ok"
+            line = f"{mark} {r['url']:<{w}}  {r['status']}"
+            if r["detail"]:
+                line += f"  [{r['detail']}]"
+            _out(line)
+    return 1 if any(r["red"] for r in rows) else 0
+
+
 def cmd_metrics(args, storage) -> int:
     """Fetch and pretty-print a server's ``/metrics`` page (any of the three
     servers — event, query, storage — serves one; docs/observability.md)."""
@@ -922,6 +1013,18 @@ def build_parser() -> argparse.ArgumentParser:
                    help="seconds after a /reload swap during which a "
                         "serving-breaker trip auto-rolls back to the "
                         "previous instance (default 30; 0 disables)")
+    p.add_argument("--admission-max-queue", type=int,
+                   help="bounded admission queue depth; waiting queries "
+                        "beyond it answer 429 + Retry-After "
+                        "(PIO_ADMISSION_MAX_QUEUE env, default 256 — "
+                        "docs/resilience.md)")
+    p.add_argument("--admission-target-ms", type=float,
+                   help="explicit latency target (ms) for the adaptive "
+                        "concurrency limiter; unset = gradient mode "
+                        "(PIO_ADMISSION_TARGET_MS env)")
+    p.add_argument("--no-adaptive-admission", action="store_true",
+                   help="disable the AIMD concurrency limiter "
+                        "(PIO_ADMISSION_ADAPTIVE=0 env)")
     p = sub.add_parser("undeploy")
     p.add_argument("--ip", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8000)
@@ -950,6 +1053,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "spilled events are fsynced before their 201 and "
                         "replayed after a crash (PIO_EVENT_WAL_DIR env; "
                         "docs/resilience.md)")
+    p.add_argument("--client-rate", type=float,
+                   help="per-access-key ingest rate limit, events/sec; a "
+                        "client over it answers 429 alone "
+                        "(PIO_EVENTSERVER_CLIENT_RATE env; 0 disables)")
+    p.add_argument("--client-burst", type=float,
+                   help="per-access-key token-bucket burst capacity "
+                        "(PIO_EVENTSERVER_CLIENT_BURST env; default 2× "
+                        "the rate)")
 
     # storageserver — serve this process's storage config to remote clients
     p = sub.add_parser(
@@ -962,6 +1073,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ssl-key")
     p.add_argument("--server-access-key",
                    help="shared secret required from every client")
+    p.add_argument("--client-inflight", type=int,
+                   help="concurrent in-flight RPCs allowed per client "
+                        "address before 429 (PIO_STORAGE_CLIENT_INFLIGHT "
+                        "env, default 64; 0 disables)")
 
     # dashboard / adminserver
     p = sub.add_parser("dashboard")
@@ -1022,6 +1137,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--raw", action="store_true",
                    help="print the raw exposition text instead")
     p.add_argument("--filter", help="only families whose name contains this")
+
+    # health — one-probe fleet state across all three servers
+    p = sub.add_parser(
+        "health",
+        help="aggregate GET /health from the given servers into one "
+             "table (draining/breaker/spill/admission state); exits "
+             "non-zero when any is unreachable, draining, or degraded")
+    p.add_argument("urls", nargs="+",
+                   help="server base URLs, e.g. http://127.0.0.1:7070 "
+                        "http://127.0.0.1:8000 http://127.0.0.1:7072")
+    p.add_argument("--timeout", type=float, default=5.0,
+                   help="per-probe timeout in seconds (default 5)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable row output")
 
     # wal — inspect/verify/replay an event-server spill WAL
     p = sub.add_parser(
@@ -1103,6 +1232,7 @@ _COMMANDS = {
     "export": cmd_export,
     "import": cmd_import,
     "metrics": cmd_metrics,
+    "health": cmd_health,
     "wal": cmd_wal,
     "start-all": cmd_start_all,
     "stop-all": cmd_stop_all,

@@ -15,6 +15,7 @@ import signal
 import socket
 import subprocess
 import sys
+import threading
 import time
 import urllib.error
 import urllib.request
@@ -60,6 +61,22 @@ class ServerProc:
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             start_new_session=True,
         )
+        # the pipe MUST be drained continuously: a chatty server (one
+        # access-log line per request) fills the 64KB pipe buffer and then
+        # blocks on write — wedging its event loop mid-test
+        self._out_lock = threading.Lock()
+        self._out_chunks: list[str] = []
+        self._reader = threading.Thread(target=self._drain_stdout,
+                                        daemon=True)
+        self._reader.start()
+
+    def _drain_stdout(self) -> None:
+        try:
+            for line in self.proc.stdout:
+                with self._out_lock:
+                    self._out_chunks.append(line)
+        except ValueError:  # stream closed under us
+            pass
 
     def wait_ready(self, url: str, timeout: float = 90.0) -> None:
         deadline = time.monotonic() + timeout
@@ -67,7 +84,7 @@ class ServerProc:
             if self.proc.poll() is not None:
                 raise RuntimeError(
                     f"server exited rc={self.proc.returncode} during boot:\n"
-                    f"{self.proc.stdout.read()}")
+                    f"{self.output()}")
             try:
                 with urllib.request.urlopen(url, timeout=1.0) as resp:
                     if resp.status == 200:
@@ -101,7 +118,7 @@ class ServerProc:
             self.kill9()
 
     def output(self) -> str:
-        try:
-            return self.proc.stdout.read() or ""
-        except ValueError:  # already closed
-            return ""
+        if self.proc.poll() is not None:
+            self._reader.join(timeout=5.0)  # let the tail land
+        with self._out_lock:
+            return "".join(self._out_chunks)

@@ -8,8 +8,18 @@ a ThreadedStorageServer on a fixed port; the event server subprocess
 points at it with the ``remote`` backend, so 'store down' is simply
 closing the storage server — exactly the split deployment the WAL is for.
 
+Also here (ISSUE 5 acceptance): the overload storm — a real deployed
+query-server subprocess driven at ~3× its measured closed-loop capacity
+through the admission layer, asserting zero in-deadline sheds below
+capacity, goodput ≥ 70% of capacity, and a bounded admitted-request p99.
+
 Marked ``slow``: real subprocess boots exceed the tier-1 budget."""
 
+import asyncio
+import json
+import os
+import subprocess
+import sys
 import time
 
 import pytest
@@ -168,6 +178,181 @@ def test_event_server_kill9_mid_drain_then_replay_is_exactly_once(tmp_path):
     assert len(ids) == len(set(ids)), "duplicate replay"
     assert set(acked) == set(ids)
     storage.close()
+
+
+# ---------------------------------------------------------------------------
+# overload storm (ISSUE 5): goodput under saturation through a REAL
+# deployed query-server process
+# ---------------------------------------------------------------------------
+
+QUERY_DEADLINE_S = 0.4
+
+
+def _train_classification(tmp_path):
+    """Train the classification template into sqlite so a `deploy`
+    subprocess can serve it (the storm needs a real engine behind the
+    admission layer, not a stub)."""
+    import datetime as dt
+
+    import numpy as np
+
+    from incubator_predictionio_tpu.core.workflow import run_train
+    from incubator_predictionio_tpu.data import DataMap, Event
+    from incubator_predictionio_tpu.data.storage import use_storage
+    from incubator_predictionio_tpu.data.storage.base import EngineInstance
+    from incubator_predictionio_tpu.parallel.mesh import MeshContext
+    from incubator_predictionio_tpu.templates.classification import (
+        ClassificationEngine,
+    )
+
+    utc = dt.timezone.utc
+    store_cfg = {
+        "PIO_STORAGE_SOURCES_SQ_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_SQ_PATH": str(tmp_path / "store.db"),
+    }
+    storage = Storage(store_cfg)
+    prev = use_storage(storage)
+    try:
+        app_id = storage.get_meta_data_apps().insert(App(0, "storm-app"))
+        events = storage.get_events()
+        events.init(app_id)
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(64, 3))
+        y = (x[:, 0] > 0).astype(int)
+        batch = [
+            Event(event="$set", entity_type="user", entity_id=f"u{i}",
+                  properties=DataMap({"attr0": float(x[i, 0]),
+                                      "attr1": float(x[i, 1]),
+                                      "attr2": float(x[i, 2]),
+                                      "plan": int(y[i])}),
+                  event_time=dt.datetime(2020, 1, 1, tzinfo=utc))
+            for i in range(64)
+        ]
+        events.insert_batch(batch, app_id)
+        variant_path = str(tmp_path / "engine.json")
+        variant = {
+            "id": "storm", "version": "1",
+            "engineFactory": ("incubator_predictionio_tpu.templates."
+                              "classification.ClassificationEngine"),
+            "datasource": {"params": {"appName": "storm-app"}},
+            "algorithms": [{"name": "mlp", "params": {
+                "hiddenDims": [8], "epochs": 40, "learningRate": 0.03,
+                "batchSize": 64}}],
+        }
+        with open(variant_path, "w") as f:
+            json.dump(variant, f)
+        engine = ClassificationEngine().apply()
+        engine_params = engine.engine_params_from_variant(variant)
+        instance = EngineInstance(
+            id="", status="INIT", start_time=dt.datetime.now(utc),
+            end_time=None, engine_id="storm", engine_version="1",
+            engine_variant=os.path.abspath(variant_path),
+            engine_factory=variant["engineFactory"])
+        run_train(engine, engine_params, instance, storage=storage,
+                  ctx=MeshContext.create())
+    finally:
+        use_storage(prev)
+        storage.close()
+    return store_cfg, variant_path
+
+
+# the raw-socket driver and load shapes are shared with bench.py's
+# overload scenario — ONE implementation (tests/fixtures/loadgen.py)
+from tests.fixtures.loadgen import (  # noqa: E402
+    closed_loop,
+    open_loop,
+    pct,
+    post,
+    request_bytes,
+)
+
+_STORM_BODY = json.dumps({"features": [0.5, -0.2, 0.1]}).encode()
+
+
+def _status_counts(counts: dict) -> dict:
+    """Integer-status slice of a loadgen counts dict (drops the
+    'degraded' bookkeeping key)."""
+    return {k: v for k, v in counts.items() if isinstance(k, int)}
+
+
+def test_query_server_overload_storm(tmp_path):
+    """ISSUE 5 acceptance, against a real subprocess:
+
+    - `pio-tpu health` passes as the smoke gate before the storm;
+    - below capacity: every request 200, ZERO sheds/rejections;
+    - at ~3× measured capacity: goodput ≥ 70% of the under-capacity qps
+      and the p99 of admitted requests stays bounded (≤ 2× the capacity
+      p99, or the deadline-bounded ceiling the shedding order guarantees).
+    """
+    store_cfg, variant_path = _train_classification(tmp_path)
+    qport = free_port()
+    qs = ServerProc(
+        ["deploy", "-v", variant_path, "--ip", "127.0.0.1",
+         "--port", str(qport), "--query-timeout", str(QUERY_DEADLINE_S)],
+        env={**store_cfg,
+             "PIO_ADMISSION_MAX_QUEUE": "128",
+             "PIO_BROWNOUT_ENTER_SEC": "0.3",
+             "PIO_BROWNOUT_EXIT_SEC": "1.0"})
+    base = f"http://127.0.0.1:{qport}"
+    try:
+        qs.wait_ready(f"{base}/", timeout=180.0)
+
+        # smoke gate: the health verb must see a green server (non-zero
+        # exit would mean breakers open / draining before we even start)
+        gate = subprocess.run(
+            [sys.executable, "-m", "incubator_predictionio_tpu.tools.cli",
+             "health", base], capture_output=True, text=True, timeout=30)
+        assert gate.returncode == 0, gate.stdout + gate.stderr
+
+        req = request_bytes("127.0.0.1", qport, _STORM_BODY)
+
+        # phase 1 — strictly below capacity: serial requests
+        async def warm():
+            r, w = await asyncio.open_connection("127.0.0.1", qport)
+            out = [await post(r, w, req) for _ in range(40)]
+            w.close()
+            return out
+
+        warm_out = asyncio.run(warm())
+        assert all(s == 200 for s, _, _ in warm_out)
+        _, health = http_json("GET", f"{base}/health")
+        adm = health["admission"]
+        assert adm["rejected"] == 0, "shed below capacity"
+        assert adm["shedExpired"] == 0, "in-deadline shed below capacity"
+
+        # phase 2 — measured capacity (16 closed-loop connections)
+        cap_counts, cap_lat = asyncio.run(
+            closed_loop("127.0.0.1", qport, 16, 2.0, lambda: req))
+        cap_qps = cap_counts.get(200, 0) / 2.0
+        cap_p99 = pct(cap_lat, 0.99)
+        assert cap_qps > 0
+
+        # phase 3 — offered load at ~3× capacity, open loop
+        over_counts, over_lat = asyncio.run(
+            open_loop("127.0.0.1", qport, 32, 3.0, 3.0 * cap_qps,
+                      lambda: req))
+        goodput = over_counts.get(200, 0) / 3.0
+        assert goodput >= 0.7 * cap_qps, (
+            f"goodput {goodput:.0f} qps < 70% of capacity {cap_qps:.0f}")
+        # every non-200 must be an orderly shed (429/504), never a 5xx
+        # error or a hang
+        assert set(_status_counts(over_counts)) <= {200, 429, 504}, \
+            over_counts
+        # bounded tail for admitted requests: 2× the under-capacity p99,
+        # or the structural ceiling the 504-evict guarantees (no admitted
+        # request waits past the deadline, then pays one dispatch)
+        p99_over = pct(over_lat, 0.99)
+        bound = max(2.0 * cap_p99, QUERY_DEADLINE_S * 1e3 + cap_p99)
+        assert p99_over <= bound, (
+            f"admitted p99 {p99_over:.0f}ms exceeds bound {bound:.0f}ms "
+            f"(capacity p99 {cap_p99:.0f}ms)")
+
+        # the admission layer observed the storm: its tallies are on
+        # /health and the always-admitted routes stayed reachable
+        _, health = http_json("GET", f"{base}/health")
+        assert "admission" in health
+    finally:
+        qs.stop()
 
 
 def test_event_server_sigterm_drains_and_exits_clean(tmp_path):
