@@ -682,21 +682,44 @@ def _metrics_snapshot(text: str) -> dict:
     return out
 
 
+def _snapshot_delta(before: dict, after: dict) -> dict:
+    """Per-run view of a /metrics snapshot from a process that outlives
+    the run (the fleet bench's replicas and the bench-process router
+    registry serve several topologies in a row): monotonic samples
+    (counters, histogram _sum/_count) are differenced against the
+    ``before`` snapshot so the artifact records what THIS run did, not
+    the cumulative history; gauges keep their end-of-run value."""
+    out: dict = {}
+    for key, value in after.items():
+        base = before.get(key)
+        if (isinstance(value, (int, float))
+                and isinstance(base, (int, float))
+                and ("_total" in key or "_sum" in key or "_count" in key)):
+            out[key] = round(value - base, 6)
+        else:
+            out[key] = value
+    return out
+
+
 def _train_recommendation(ctx, storage, tmp: str, n_users: int,
-                          n_items: int, n_events: int) -> str:
+                          n_items: int, n_events: int,
+                          factory_path: str = (
+                              "incubator_predictionio_tpu.templates."
+                              "recommendation.RecommendationEngine")) -> str:
     """Seed rating events and train the recommendation template through
     the real workflow; returns the engine-variant path. Shared by the
-    serving and overload scenarios (one training recipe, two load
-    shapes)."""
+    serving, overload, and fleet scenarios (one training recipe, several
+    load shapes); ``factory_path`` lets a scenario deploy a wrapped engine
+    (the fleet scenario's service-floor fixture) around the same model."""
     import datetime as dt_mod
 
+    from incubator_predictionio_tpu.core.controller import (
+        resolve_engine_factory,
+    )
     from incubator_predictionio_tpu.core.workflow import run_train
     from incubator_predictionio_tpu.data import DataMap, Event
     from incubator_predictionio_tpu.data.storage import App
     from incubator_predictionio_tpu.data.storage.base import EngineInstance
-    from incubator_predictionio_tpu.templates.recommendation import (
-        RecommendationEngine,
-    )
 
     app_id = storage.get_meta_data_apps().insert(App(0, "bench-app"))
     events = storage.get_events()
@@ -717,15 +740,14 @@ def _train_recommendation(ctx, storage, tmp: str, n_users: int,
     variant_path = os.path.join(tmp, "engine.json")
     variant = {
         "id": "bench", "version": "1",
-        "engineFactory":
-            "incubator_predictionio_tpu.templates.recommendation.RecommendationEngine",
+        "engineFactory": factory_path,
         "datasource": {"params": {"appName": "bench-app"}},
         "algorithms": [{"name": "als", "params": {
             "rank": 32, "numIterations": 3, "batchSize": 8192}}],
     }
     with open(variant_path, "w") as f:
         json.dump(variant, f)
-    engine = RecommendationEngine().apply()
+    engine = resolve_engine_factory(factory_path)()
     engine_params = engine.engine_params_from_variant(variant)
     instance = EngineInstance(
         id="", status="INIT",
@@ -979,6 +1001,225 @@ def bench_overload(ctx) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# 7c. fleet serving (docs/serving.md "Fleet serving"): 1 vs 3 query-server
+#     replicas behind the fleet router at a FIXED offered load — the
+#     horizontal-scaling story the router exists for
+# ---------------------------------------------------------------------------
+
+#: Load-client shim for the fleet scenario (argv after the repo root:
+#: base_url, warm_s, cap_s, over_s, n_users, offered_qps). Same raw-socket
+#: driver as overload (tests/fixtures/loadgen.py); offered_qps <= 0 runs
+#: the capacity-measuring three-phase protocol, > 0 drives a fixed rate.
+_FLEET_CLIENT_SCRIPT = """
+import sys
+
+sys.path.insert(0, sys.argv[1])
+from tests.fixtures.loadgen import fleet_main
+
+fleet_main(sys.argv[2:])
+"""
+
+
+def bench_fleet(ctx) -> dict:
+    """Train once, deploy the SAME model in 1 and then 3 real query-server
+    subprocesses, and drive the fleet router over each topology: the
+    three-phase protocol sizes the 1-replica fleet, then the 3-replica
+    fleet takes the same saturating offered load. Replicas deploy the
+    service-floor fixture engine (tests/fixtures/floor_engine.py): each
+    query pays a fixed service cost on top of the real ALS compute, so
+    per-replica capacity is a known constant and goodput scaling measures
+    the ROUTER's spreading/retry behaviour — on a 2-core box CPU-bound
+    replicas would only contend with each other and the scaling number
+    would describe the box, not the fleet. Per-replica /metrics snapshots
+    ride along in the artifact."""
+    import subprocess
+    import sys as _sys
+    import tempfile
+    import urllib.request
+
+    from incubator_predictionio_tpu.data.storage import Storage, use_storage
+    from incubator_predictionio_tpu.fleet.router import (
+        RouterConfig,
+        RouterServer,
+    )
+    from incubator_predictionio_tpu.parallel.launcher import free_port
+
+    n_users, n_items, n_events = 2000, 1000, (5_000 if SMALL else 20_000)
+    warm_s, cap_s, over_s = (1.0, 1.5, 3.0) if SMALL else (2.0, 4.0, 8.0)
+    tmp = tempfile.mkdtemp(prefix="pio-bench-fleet-")
+    store_cfg = {
+        "PIO_STORAGE_SOURCES_SQ_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_SQ_PATH": os.path.join(tmp, "store.db"),
+    }
+    storage = Storage(store_cfg)
+    prev = use_storage(storage)
+    try:
+        variant_path = _train_recommendation(
+            ctx, storage, tmp, n_users, n_items, n_events,
+            factory_path="tests.fixtures.floor_engine."
+                         "FloorRecommendationEngine")
+    finally:
+        use_storage(prev)
+        storage.close()
+
+    def spawn_replica(port: int) -> subprocess.Popen:
+        # real subprocesses (not in-process servers): replica parallelism
+        # must come from the OS scheduler, not one GIL. --query-timeout 2.0
+        # leaves room for a full micro-batch at the service floor
+        # (64 x 25ms = 1.6s) inside the per-query budget. The 25ms floor
+        # pins per-replica capacity near 40 qps so the 3-replica ideal
+        # (~120 qps aggregate) stays inside this box's CPU headroom for
+        # client + router + replicas — at a higher aggregate rate the 2
+        # cores, not the router, become the measured constraint.
+        return subprocess.Popen(
+            [_sys.executable, "-m", "incubator_predictionio_tpu.tools.cli",
+             "deploy", "-v", variant_path, "--ip", "127.0.0.1",
+             "--port", str(port), "--query-timeout", "2.0"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            env={**os.environ, "JAX_PLATFORMS": "cpu",
+                 "PIO_NATIVE_HTTP": "0", **store_cfg,
+                 "PIO_BENCH_SERVICE_FLOOR_MS": "25",
+                 "PIO_ADMISSION_MAX_QUEUE": "128",
+                 "PIO_BROWNOUT_ENTER_SEC": "0.3",
+                 "PIO_BROWNOUT_EXIT_SEC": "1.0"},
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            start_new_session=True)
+
+    def wait_ready(port: int, timeout_s: float = 240.0) -> None:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/", timeout=1.0) as resp:
+                    if resp.status == 200:
+                        return
+            except Exception:  # noqa: BLE001 - still booting
+                time.sleep(0.1)
+        raise TimeoutError(f"replica on :{port} not ready")
+
+    ports = [free_port() for _ in range(3)]
+    replicas = [spawn_replica(p) for p in ports]
+
+    async def drive_topology(
+            replica_ports: list,
+            offered_qps: float) -> tuple[dict, dict, dict]:
+        """Router over the given replicas; offered_qps <= 0 measures.
+        Returns (client results, router metrics, per-replica metrics) —
+        both metric dicts are THIS run's deltas: the bench-process
+        registry and the replica subprocesses outlive the run, so raw
+        snapshots would accumulate every earlier topology's counts."""
+        rport = free_port()
+        router = RouterServer(RouterConfig(
+            replicas=tuple(f"http://127.0.0.1:{p}" for p in replica_ports),
+            ip="127.0.0.1", port=rport, deadline_sec=3.0,
+            health_interval_sec=0.5))
+        await router.start()
+        try:
+            import aiohttp
+
+            async with aiohttp.ClientSession() as s:
+                async def snap() -> tuple[dict, dict]:
+                    router_m = _metrics_snapshot(await (await s.get(
+                        f"http://127.0.0.1:{rport}/metrics")).text())
+                    reps: dict = {}
+                    for p in replica_ports:
+                        try:
+                            reps[f":{p}"] = _metrics_snapshot(
+                                await (await s.get(
+                                    f"http://127.0.0.1:{p}/metrics",
+                                    timeout=aiohttp.ClientTimeout(
+                                        total=5.0))).text())
+                        except Exception as e:  # noqa: BLE001
+                            reps[f":{p}"] = {"error": repr(e)}
+                    return router_m, reps
+
+                base_router, base_reps = await snap()
+                proc = await asyncio.create_subprocess_exec(
+                    _sys.executable, "-c", _FLEET_CLIENT_SCRIPT,
+                    os.path.dirname(os.path.abspath(__file__)),
+                    f"http://127.0.0.1:{rport}", str(warm_s), str(cap_s),
+                    str(over_s), str(n_users), str(offered_qps),
+                    stdout=subprocess.PIPE)
+                total_s = warm_s + cap_s + over_s
+                try:
+                    stdout, _ = await asyncio.wait_for(
+                        proc.communicate(), timeout=total_s + 120)
+                except asyncio.TimeoutError:
+                    proc.kill()
+                    await proc.wait()
+                    raise
+                assert proc.returncode == 0, proc.returncode
+                client = json.loads(
+                    stdout.decode().strip().splitlines()[-1])
+                final_router, final_reps = await snap()
+            return (client,
+                    _snapshot_delta(base_router, final_router),
+                    {k: _snapshot_delta(base_reps.get(k, {}), v)
+                     for k, v in final_reps.items()})
+        finally:
+            await router.shutdown()
+
+    try:
+        for p in ports:
+            wait_ready(p)
+        # topology 1: ONE replica behind the router — the three-phase
+        # protocol measures its closed-loop capacity and offers 3×; the
+        # micro-batcher often absorbs that outright (queue depth grows the
+        # batches — the PR 3 effect), so ESCALATE the offered rate until
+        # the single replica genuinely saturates (goodput < 85% of
+        # offered): only a load one replica cannot serve can show what
+        # three are worth
+        single, router_m1, replica_m1 = asyncio.run(
+            drive_topology(ports[:1], 0.0))
+        over1 = single["overload"]
+        offered = over1["offered_qps"]
+        g1 = over1["goodput_qps"]
+        for _ in range(3):
+            if g1 < 0.85 * offered:
+                break
+            offered = round(3.0 * g1, 1)
+            esc, router_m1, replica_m1 = asyncio.run(
+                drive_topology(ports[:1], offered))
+            over1 = esc["overload"]
+            g1 = over1["goodput_qps"]
+        single["overload"] = over1
+        # topology 2: THREE replicas take the SAME saturating offered
+        # load — goodput should scale with the fleet
+        fleet3, router_m3, replica_m3 = asyncio.run(
+            drive_topology(ports, offered))
+        g3 = fleet3["overload"]["goodput_qps"]
+        return {
+            "offered_qps": offered,
+            "single_capacity_qps": single["capacity"]["qps"],
+            "single_goodput_qps": g1,
+            "single_p99_ms": single["overload"]["p99_ms"],
+            "fleet3_goodput_qps": g3,
+            "fleet3_p99_ms": fleet3["overload"]["p99_ms"],
+            # the acceptance headline: ≥ 2× single-replica goodput with 3
+            # replicas at saturating load (ISSUE 6)
+            "goodput_scaling": round(g3 / max(g1, 1e-9), 3),
+            "p99_ratio": round(
+                fleet3["overload"]["p99_ms"]
+                / max(single["overload"]["p99_ms"], 1e-9), 3),
+            "single_counts": single["overload"]["counts"],
+            "fleet3_counts": fleet3["overload"]["counts"],
+            "router_metrics_single": router_m1,
+            "router_metrics_fleet3": router_m3,
+            "replica_metrics_single": replica_m1,
+            "replica_metrics_fleet3": replica_m3,
+        }
+    finally:
+        import signal as _signal
+
+        for proc in replicas:
+            try:
+                os.killpg(proc.pid, _signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            proc.wait()
+
+
+# ---------------------------------------------------------------------------
 # 8. event-server ingestion throughput (EventServer.scala:261-462 hot path)
 # ---------------------------------------------------------------------------
 
@@ -1211,8 +1452,12 @@ def build_result_line(configs: dict, device_info: dict,
 # dead tunnel on CPU
 CONFIG_NAMES = ["recommendation", "recommendation_scaled", "classification",
                 "similarproduct", "ecommerce_retrieval", "sequential",
-                "serving", "overload", "ingestion", "ingest_durability"]
-DEVICE_FREE = {"ingestion", "ingest_durability"}
+                "serving", "overload", "fleet", "ingestion",
+                "ingest_durability"]
+# "fleet" is device-free too: its replicas are CPU subprocesses (a fleet
+# on one host) — the scenario measures the ROUTER's horizontal scaling,
+# not chip throughput
+DEVICE_FREE = {"ingestion", "ingest_durability", "fleet"}
 
 
 def _build_suite(ctx, peaks, device) -> dict:
@@ -1226,6 +1471,7 @@ def _build_suite(ctx, peaks, device) -> dict:
         "sequential": lambda: bench_sequential(ctx, peaks, device),
         "serving": lambda: bench_serving(ctx),
         "overload": lambda: bench_overload(ctx),
+        "fleet": lambda: bench_fleet(ctx),
         "ingestion": lambda: bench_ingestion(),
         "ingest_durability": lambda: bench_ingest_durability(),
     }

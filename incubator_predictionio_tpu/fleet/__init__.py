@@ -1,0 +1,39 @@
+"""Fleet serving tier (docs/serving.md "Fleet serving").
+
+One query-server process serves one engine; "millions of users" need a
+*fleet*. This package is the routing front over N query-server replicas:
+
+- :mod:`balancer` — replica registry + health/admission-aware picking
+  (least-loaded weighted by each replica's live admission limit, passive
+  latency/error EWMAs, consecutive-error ejection, Retry-After backoff);
+- :mod:`health` — the concurrent ``/health`` prober (shared with
+  ``pio-tpu health``) and the watcher that folds probe results into the
+  balancer's replica states, including the ejected-replica probe cycle;
+- :mod:`router` — the async router server: ``/queries.json`` in,
+  health-aware replica choice, idempotent retry on a different replica
+  within the request deadline, A/B and shadow experiment routing;
+- :mod:`rollout` — the fleet rolling-deploy orchestrator driving each
+  replica's versioned ``/reload`` + smoke gate + probation hot-swap in
+  sequence, halting and rolling the fleet back on a tripped replica;
+- :mod:`experiments` — weighted / entity-hashed A/B arm assignment and
+  fire-and-forget shadow mirroring with per-arm ``pio_fleet_*`` metrics.
+"""
+
+from incubator_predictionio_tpu.fleet.balancer import Balancer, Replica
+from incubator_predictionio_tpu.fleet.experiments import Experiment
+from incubator_predictionio_tpu.fleet.health import (
+    HealthWatcher,
+    fetch_health,
+    probe_health_urls,
+)
+from incubator_predictionio_tpu.fleet.rollout import (
+    RolloutConfig,
+    RolloutResult,
+    run_rollout,
+)
+
+__all__ = [
+    "Balancer", "Replica", "Experiment", "HealthWatcher",
+    "fetch_health", "probe_health_urls",
+    "RolloutConfig", "RolloutResult", "run_rollout",
+]

@@ -1,0 +1,251 @@
+"""Replica registry + health/admission-aware balancing for the fleet router.
+
+A :class:`Replica` mirrors what one query server tells the fleet about
+itself — the machine-readable ``/health`` surface (draining, brownout,
+``admission.inflightLimit``, deployed instance/engine version) — plus what
+the router *observes* passively on every forwarded request (latency EWMA,
+error EWMA, consecutive transport errors, ``Retry-After`` backoff).
+
+The :class:`Balancer` picks the least-loaded available replica, where
+"load" is in-flight requests normalized by the replica's own live
+admission limit: a replica whose AIMD limiter shrank to 1 slot is half as
+attractive as one holding 2, so the fleet respects each process's
+self-reported capacity instead of spraying uniformly. Brownout and error
+history multiply the score — a degraded replica keeps serving (degraded
+200s beat sheds) but only picks up traffic the healthy replicas cannot.
+
+Everything is clock-injected; tests script ejection/backoff/probe
+timelines on ``FakeClock`` with zero wall sleeps (the resilience-layer
+pattern, resilience/clock.py).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Iterable, Optional
+
+from incubator_predictionio_tpu.obs.metrics import REGISTRY
+from incubator_predictionio_tpu.resilience.clock import SYSTEM_CLOCK, Clock
+
+logger = logging.getLogger(__name__)
+
+_G_HEALTHY = REGISTRY.gauge(
+    "pio_fleet_replica_healthy",
+    "1 while the router considers the replica routable (healthy, not "
+    "draining, not ejected), 0 otherwise", labels=("replica",))
+_EJECTIONS = REGISTRY.counter(
+    "pio_fleet_ejections_total",
+    "Replicas ejected from rotation after consecutive transport errors "
+    "(re-admitted by a successful health probe)", labels=("replica",))
+
+#: EWMA smoothing factor for the passive latency/error estimates: ~20
+#: requests of memory — fast enough to notice a replica going bad, slow
+#: enough that one outlier doesn't reshuffle the fleet.
+_EWMA_ALPHA = 0.1
+#: Retry-After values above this are clamped: a replica asking the fleet
+#: to stay away for minutes is better served by ejection + probe.
+_BACKOFF_CAP_SEC = 30.0
+
+
+class Replica:
+    """One query-server replica as the router sees it."""
+
+    def __init__(self, url: str, clock: Clock = SYSTEM_CLOCK,
+                 eject_threshold: int = 3):
+        self.url = url.rstrip("/")
+        self._clock = clock
+        self.eject_threshold = eject_threshold
+        # -- watcher-fed state (fleet/health.py) --------------------------
+        self.healthy = True          # False = ejected from rotation
+        self.draining = False
+        self.brownout = False
+        self.inflight_limit = 2      # admission.inflightLimit from /health
+        self.instance_id: Optional[str] = None
+        self.engine_version: Optional[str] = None
+        self.last_probe_ok: Optional[bool] = None
+        # -- passive per-request state (router observations) --------------
+        self.inflight = 0
+        self.lat_ewma: Optional[float] = None
+        self.err_ewma = 0.0
+        self.consecutive_errors = 0
+        self.backoff_until = 0.0     # Retry-After honor (monotonic)
+        self.requests = 0
+        self.errors = 0
+        self._publish()
+
+    def _publish(self) -> None:
+        _G_HEALTHY.labels(replica=self.url).set(
+            1 if (self.healthy and not self.draining) else 0)
+
+    # -- availability -----------------------------------------------------
+    def available(self, now: Optional[float] = None) -> bool:
+        if now is None:
+            now = self._clock.monotonic()
+        return (self.healthy and not self.draining
+                and now >= self.backoff_until)
+
+    def score(self, now: Optional[float] = None) -> float:
+        """Lower is better. Load per admitted slot, inflated by the error
+        EWMA and (heavily) by brownout — a browned-out replica is a last
+        resort, not a peer."""
+        load = (self.inflight + 1) / max(1, self.inflight_limit)
+        s = load * (1.0 + 4.0 * self.err_ewma)
+        if self.brownout:
+            s *= 8.0
+        return s
+
+    # -- passive observations (router request path) -----------------------
+    def on_success(self, latency_sec: float) -> None:
+        self.requests += 1
+        self.consecutive_errors = 0
+        self.err_ewma *= (1.0 - _EWMA_ALPHA)
+        self.lat_ewma = (latency_sec if self.lat_ewma is None else
+                         (1.0 - _EWMA_ALPHA) * self.lat_ewma
+                         + _EWMA_ALPHA * latency_sec)
+
+    def on_failure_status(self) -> None:
+        """Replica-side 5xx that is neither overload nor a transport
+        failure (an engine 500, a burned-deadline 504): the answer passes
+        through to the client, but the error EWMA must rise — a broken
+        replica failing in ~2ms would otherwise look like the fastest,
+        least-loaded pick and the balancer would concentrate traffic on
+        it. No ejection (its /health probe still succeeds and would
+        re-admit it instantly); the score penalty does the shunning."""
+        self.requests += 1
+        self.errors += 1
+        self.err_ewma = (1.0 - _EWMA_ALPHA) * self.err_ewma + _EWMA_ALPHA
+
+    def on_overload(self, retry_after_sec: Optional[float]) -> None:
+        """429/503 from the replica: honor its Retry-After — stop offering
+        it traffic for that window instead of hammering a server that just
+        told us its queue is full."""
+        self.requests += 1
+        backoff = min(_BACKOFF_CAP_SEC,
+                      retry_after_sec if retry_after_sec else 1.0)
+        self.backoff_until = self._clock.monotonic() + backoff
+        self.err_ewma = (1.0 - _EWMA_ALPHA) * self.err_ewma + _EWMA_ALPHA
+
+    def on_error(self) -> bool:
+        """Transport-level failure (refused, reset, timeout). Returns True
+        when this error crossed the ejection threshold."""
+        self.requests += 1
+        self.errors += 1
+        self.consecutive_errors += 1
+        self.err_ewma = (1.0 - _EWMA_ALPHA) * self.err_ewma + _EWMA_ALPHA
+        if self.healthy and self.consecutive_errors >= self.eject_threshold:
+            self.healthy = False
+            _EJECTIONS.labels(replica=self.url).inc()
+            self._publish()
+            logger.warning("fleet: ejected replica %s after %d consecutive "
+                           "errors (probe cycle will re-admit)", self.url,
+                           self.consecutive_errors)
+            return True
+        return False
+
+    # -- watcher updates (fleet/health.py) --------------------------------
+    def update_from_health(self, health: dict) -> None:
+        """Fold one successful ``/health`` probe in. A reachable replica
+        re-enters rotation (the probe IS the half-open probe of the
+        ejection cycle); draining/brownout/admission-limit ride along."""
+        self.last_probe_ok = True
+        self.draining = bool(health.get("draining"))
+        adm = health.get("admission") or {}
+        limit = adm.get("inflightLimit")
+        if isinstance(limit, (int, float)) and limit >= 1:
+            self.inflight_limit = int(limit)
+        self.brownout = bool(adm.get("brownoutActive"))
+        dep = health.get("deployment") or {}
+        self.instance_id = dep.get("instanceId", self.instance_id)
+        self.engine_version = dep.get("engineVersion", self.engine_version)
+        if not self.healthy:
+            logger.info("fleet: probe succeeded — re-admitting replica %s",
+                        self.url)
+        self.healthy = True
+        self.consecutive_errors = 0
+        self._publish()
+
+    def mark_unreachable(self) -> None:
+        """Failed health probe: out of rotation until a probe succeeds."""
+        self.last_probe_ok = False
+        if self.healthy:
+            _EJECTIONS.labels(replica=self.url).inc()
+            logger.warning("fleet: health probe failed — ejecting replica "
+                           "%s", self.url)
+        self.healthy = False
+        self._publish()
+
+    def snapshot(self) -> dict:
+        now = self._clock.monotonic()
+        return {
+            "url": self.url,
+            "healthy": self.healthy,
+            "draining": self.draining,
+            "brownout": self.brownout,
+            "available": self.available(now),
+            "inFlight": self.inflight,
+            "inflightLimit": self.inflight_limit,
+            "backoffSec": round(max(0.0, self.backoff_until - now), 3),
+            "latencyEwmaMs": (round(self.lat_ewma * 1e3, 2)
+                              if self.lat_ewma is not None else None),
+            "errorEwma": round(self.err_ewma, 4),
+            "requests": self.requests,
+            "errors": self.errors,
+            "instanceId": self.instance_id,
+            "engineVersion": self.engine_version,
+        }
+
+
+class Balancer:
+    """Least-score pick over a fixed replica set (one pool — the router
+    holds one balancer per experiment arm)."""
+
+    def __init__(self, replicas: Iterable, clock: Clock = SYSTEM_CLOCK,
+                 eject_threshold: int = 3):
+        self._clock = clock
+        self.replicas: list[Replica] = [
+            r if isinstance(r, Replica)
+            else Replica(r, clock=clock, eject_threshold=eject_threshold)
+            for r in replicas
+        ]
+
+    def pick(self, exclude: Iterable[str] = ()) -> Optional[Replica]:
+        """The available replica with the lowest load score (ties broken by
+        registration order — deterministic for tests). ``exclude`` names
+        replicas already tried this request, so a retry lands elsewhere.
+
+        ``Retry-After`` backoff is a routing *preference*, not a hard gate:
+        when every otherwise-healthy replica sits inside a backoff window
+        (a transient 429 burst — e.g. the retry wave right after a replica
+        dies — can put the whole remaining fleet there at once), the
+        least-loaded one is picked anyway. Worst case the replica answers
+        its own orderly 429; fabricating a router 503 below capacity is
+        strictly worse. Ejected/draining replicas are never relaxed in."""
+        now = self._clock.monotonic()
+        skip = set(exclude)
+        best = self._best(now, skip, ignore_backoff=False)
+        if best is None:
+            best = self._best(now, skip, ignore_backoff=True)
+        return best
+
+    def _best(self, now: float, skip: set,
+              ignore_backoff: bool) -> Optional[Replica]:
+        best: Optional[Replica] = None
+        best_score = float("inf")
+        for r in self.replicas:
+            if r.url in skip:
+                continue
+            if ignore_backoff:
+                if not (r.healthy and not r.draining):
+                    continue
+            elif not r.available(now):
+                continue
+            s = r.score(now)
+            if s < best_score:
+                best, best_score = r, s
+        return best
+
+    def snapshot(self) -> list[dict]:
+        return [r.snapshot() for r in self.replicas]
+
+
+__all__ = ["Balancer", "Replica"]

@@ -1,0 +1,138 @@
+"""A/B and shadow experiment routing (docs/serving.md "Fleet serving").
+
+An :class:`Experiment` splits ``/queries.json`` traffic between the
+*control* pool (the live engine version) and a *candidate* pool (the
+version under evaluation):
+
+- **ab** mode routes a slice of traffic to the candidate and serves its
+  answer. The slice is *entity-hashed* when ``hash_field`` names a query
+  field (the same user always lands on the same arm — session-stable, and
+  stable across router restarts because the hash is derived, not stored),
+  else a deterministic weighted rotation.
+- **shadow** mode serves every query from control and mirrors the slice
+  to the candidate fire-and-forget; the mirrored response is *compared*
+  (status + body) but never served — zero user risk, live parity
+  evidence.
+
+Per-arm ``pio_fleet_arm_*`` metrics (request/status counts, latency
+histograms) and the shadow match counters are the promote-or-abort
+evidence ``pio-tpu fleet experiment`` renders.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+from typing import Optional
+
+from incubator_predictionio_tpu.obs.metrics import REGISTRY
+
+CONTROL = "control"
+CANDIDATE = "candidate"
+
+ARM_REQUESTS = REGISTRY.counter(
+    "pio_fleet_arm_requests_total",
+    "Routed queries by experiment arm and response status",
+    labels=("arm", "status"))
+ARM_LATENCY = REGISTRY.histogram(
+    "pio_fleet_arm_latency_seconds",
+    "Client-observed latency through the router, by experiment arm",
+    labels=("arm",))
+SHADOW_MIRRORS = REGISTRY.counter(
+    "pio_fleet_shadow_total",
+    "Shadow-mirrored queries by comparison outcome (matched / mismatched "
+    "/ error — the candidate's answer is compared, never served)",
+    labels=("outcome",))
+
+#: hash-bucket resolution: 1/2^32 granularity on the weight split
+_BUCKETS = float(0xFFFFFFFF)
+
+
+@dataclasses.dataclass
+class Experiment:
+    """One live experiment's routing policy + bookkeeping."""
+
+    name: str = "candidate"
+    #: "ab" (serve the candidate's answers) or "shadow" (mirror + compare)
+    mode: str = "ab"
+    #: fraction of traffic assigned to the candidate arm, 0..1
+    weight: float = 0.1
+    #: query field whose value hashes to a sticky arm assignment (e.g.
+    #: "user"); None/absent field falls back to a weighted rotation
+    hash_field: Optional[str] = None
+
+    def __post_init__(self):
+        if self.mode not in ("ab", "shadow"):
+            raise ValueError(f"experiment mode must be ab|shadow, "
+                             f"got {self.mode!r}")
+        self.weight = min(1.0, max(0.0, float(self.weight)))
+        self._rotation_credit = 0.0
+        self.assigned = {CONTROL: 0, CANDIDATE: 0}
+
+    # -- assignment -------------------------------------------------------
+    def bucket(self, entity: str) -> float:
+        """Stable [0, 1) bucket for an entity: sha1 over name+entity, so
+        the split is reproducible across routers and restarts but
+        decorrelated between experiments (a user in experiment A's 10%
+        is not automatically in experiment B's)."""
+        digest = hashlib.sha1(
+            f"{self.name}:{entity}".encode()).hexdigest()[:8]
+        return int(digest, 16) / _BUCKETS
+
+    def assign(self, payload: Optional[dict]) -> str:
+        """Arm for one query. Entity-hashed when ``hash_field`` resolves;
+        otherwise a deterministic weighted rotation (accumulated credit —
+        no RNG, so tests and replays are exact)."""
+        arm = CONTROL
+        entity = None
+        if self.hash_field and isinstance(payload, dict):
+            entity = payload.get(self.hash_field)
+        if entity is not None:
+            if self.bucket(str(entity)) < self.weight:
+                arm = CANDIDATE
+        else:
+            self._rotation_credit += self.weight
+            if self._rotation_credit >= 1.0:
+                self._rotation_credit -= 1.0
+                arm = CANDIDATE
+        self.assigned[arm] += 1
+        return arm
+
+    # -- evidence ---------------------------------------------------------
+    @staticmethod
+    def observe(arm: str, status: int, latency_sec: float) -> None:
+        ARM_REQUESTS.labels(arm=arm, status=str(status)).inc()
+        ARM_LATENCY.labels(arm=arm).observe(latency_sec)
+
+    @staticmethod
+    def compare_shadow(served_status: int, served_body: bytes,
+                       shadow_status: int, shadow_body: bytes) -> str:
+        """Outcome label for one mirrored response. Body comparison is on
+        canonical JSON (key order must not count as drift); non-JSON
+        bodies compare raw."""
+        if served_status != shadow_status:
+            outcome = "mismatched"
+        else:
+            try:
+                outcome = ("matched"
+                           if json.loads(served_body) == json.loads(shadow_body)
+                           else "mismatched")
+            except ValueError:
+                outcome = ("matched" if served_body == shadow_body
+                           else "mismatched")
+        SHADOW_MIRRORS.labels(outcome=outcome).inc()
+        return outcome
+
+    def summary(self) -> dict:
+        return {
+            "name": self.name,
+            "mode": self.mode,
+            "weight": self.weight,
+            "hashField": self.hash_field,
+            "assigned": dict(self.assigned),
+        }
+
+
+__all__ = ["CANDIDATE", "CONTROL", "Experiment",
+           "ARM_LATENCY", "ARM_REQUESTS", "SHADOW_MIRRORS"]

@@ -1,0 +1,154 @@
+"""Concurrent ``/health`` probing — ONE implementation behind both the
+``pio-tpu health`` CLI verb and the fleet router's health watcher.
+
+The probe fans out over a thread pool: a fleet with one slow or dead
+replica answers in ~one probe timeout, not O(N × timeout) (the serial
+``_fetch_health`` loop the CLI used to run). The router's
+:class:`HealthWatcher` drives the same ``fetch`` concurrently from its
+async loop (per-URL ``run_in_executor`` on a persistent pool it owns),
+then folds the results into the balancer's replica states:
+
+- unreachable probe  → replica ejected from rotation;
+- reachable probe    → replica (re-)admitted — the probe IS the half-open
+  step of the ejection cycle — and its draining/brownout flags, live
+  ``admission.inflightLimit``, and deployed instance/engine version are
+  adopted.
+
+``apply_results`` is pure and synchronous, so the ejection/probe cycle is
+unit-testable on ``FakeClock`` with zero wall sleeps.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Iterable, Optional
+
+from incubator_predictionio_tpu.resilience.clock import SYSTEM_CLOCK, Clock
+
+logger = logging.getLogger(__name__)
+
+
+def fetch_health(url: str, timeout: float = 5.0) -> dict:
+    """GET ``<url>/health``, parsed (the probe the thread pool runs)."""
+    import urllib.request
+
+    base = url.rstrip("/")
+    if not base.endswith("/health"):
+        base += "/health"
+    with urllib.request.urlopen(base, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def probe_health_urls(
+    urls: Iterable[str], timeout: float = 5.0,
+    fetch: Optional[Callable[[str, float], dict]] = None,
+    max_workers: int = 16,
+) -> dict[str, tuple[Optional[dict], Optional[str]]]:
+    """Probe every URL concurrently. Returns ``{url: (health, error)}``
+    where exactly one of the pair is None — reachable probes carry the
+    parsed /health dict, failures carry ``repr(exception)``. The
+    synchronous one-shot fan-out (the CLI verb); the long-lived watcher
+    drives the same ``fetch`` through its own persistent pool."""
+    urls = list(urls)
+    if not urls:
+        return {}
+    fetch = fetch or fetch_health
+    results: dict[str, tuple[Optional[dict], Optional[str]]] = {}
+    with ThreadPoolExecutor(
+            max_workers=min(max_workers, len(urls))) as pool:
+        futures = {url: pool.submit(fetch, url, timeout) for url in urls}
+        for url, fut in futures.items():
+            try:
+                results[url] = (fut.result(), None)
+            except Exception as e:  # noqa: BLE001 - unreachable is a result
+                results[url] = (None, repr(e))
+    return results
+
+
+class HealthWatcher:
+    """Periodic concurrent probe of every fleet replica, folding results
+    into the balancer state (fleet/balancer.py)."""
+
+    def __init__(self, replicas, interval_sec: float = 2.0,
+                 timeout: float = 2.0,
+                 fetch: Optional[Callable[[str, float], dict]] = None,
+                 clock: Clock = SYSTEM_CLOCK):
+        #: the Replica objects to keep current (shared with the balancers)
+        self.replicas = list(replicas)
+        self.interval_sec = interval_sec
+        self.timeout = timeout
+        self._fetch = fetch
+        self._clock = clock
+        self._task: Optional[asyncio.Task] = None
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self.probes = 0
+
+    # -- pure state transitions (unit-tested on FakeClock) ----------------
+    def apply_results(
+            self, results: dict[str, tuple[Optional[dict], Optional[str]]],
+    ) -> None:
+        self.probes += 1
+        for replica in self.replicas:
+            got = results.get(replica.url)
+            if got is None:
+                continue
+            health, err = got
+            if health is None:
+                replica.mark_unreachable()
+            else:
+                replica.update_from_health(health)
+
+    # -- async loop (the router's background task) ------------------------
+    async def tick(self) -> None:
+        """One concurrent probe round on the watcher's own persistent
+        pool — per-URL ``run_in_executor`` + gather, so no per-tick
+        executor churn and no default-executor thread burned just to
+        join futures."""
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(
+                max_workers=min(16, len(self.replicas) or 1),
+                thread_name_prefix="fleet-probe")
+        loop = asyncio.get_running_loop()
+        fetch = self._fetch or fetch_health
+
+        async def probe(url: str):
+            try:
+                health = await loop.run_in_executor(
+                    self._pool, fetch, url, self.timeout)
+                return url, (health, None)
+            except Exception as e:  # noqa: BLE001 - unreachable is a result
+                return url, (None, repr(e))
+
+        results = dict(await asyncio.gather(
+            *(probe(r.url) for r in self.replicas)))
+        self.apply_results(results)
+
+    async def _run(self) -> None:
+        while True:
+            try:
+                await self.tick()
+            except Exception:  # noqa: BLE001 - the watcher must survive
+                logger.exception("fleet health watcher tick failed")
+            await asyncio.sleep(self.interval_sec)
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
+
+
+__all__ = ["HealthWatcher", "fetch_health", "probe_health_urls"]

@@ -1,0 +1,184 @@
+"""Fleet rolling deploys — ``pio-tpu fleet rollout``.
+
+Drives each replica's existing crash-safe single-server machinery
+(query_server ``/reload``: load-beside, smoke-query gate, probation
+auto-rollback — docs/resilience.md) *in sequence* across the fleet, and
+adds the fleet-wide invariant the single-server pieces cannot give:
+
+    a deploy that trips ANY replica halts the rollout and rolls the
+    already-updated replicas back to last-good, so the fleet never ends a
+    failed deploy half-old/half-new.
+
+Per replica: ``POST /reload`` (a 409 means the smoke gate rejected the
+new instance — the replica never served it), then an observation window
+polling ``/health`` for a probation auto-rollback (the replica itself
+detects a breaker-trip burst from the new instance under live traffic
+and restores the pinned previous engine). Either trip halts the rollout;
+already-updated replicas are rolled back via ``POST /rollback`` (which
+restores their pinned previous instance while probation still holds —
+keep ``--observe`` well under the replicas' ``--reload-probation``).
+
+The router keeps serving throughout: a reloading replica's live engine
+serves until the atomic swap, and a swapped replica's previous instance
+stays pinned — no client-visible downtime from the deploy itself (the
+chaos rollout test asserts zero non-200s through the router).
+
+HTTP and time are injected (``http(method, url, timeout)`` + ``Clock``)
+so the halt/rollback state machine is unit-tested on ``FakeClock`` with
+scripted responses and zero wall sleeps.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import urllib.error
+import urllib.request
+from typing import Callable, Optional
+
+from incubator_predictionio_tpu.obs.metrics import REGISTRY
+from incubator_predictionio_tpu.resilience.clock import SYSTEM_CLOCK, Clock
+
+logger = logging.getLogger(__name__)
+
+_ROLLOUTS = REGISTRY.counter(
+    "pio_fleet_rollouts_total",
+    "Fleet rollout outcomes (ok / halted)", labels=("outcome",))
+
+
+def _http_json(method: str, url: str,
+               timeout: float = 30.0) -> tuple[int, dict]:
+    """Minimal JSON round trip (status, body) tolerant of error statuses —
+    the default transport; tests inject scripted ones."""
+    req = urllib.request.Request(url, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read() or b"null")
+    except urllib.error.HTTPError as e:
+        payload = e.read()
+        try:
+            return e.code, json.loads(payload or b"null")
+        except ValueError:
+            return e.code, {"raw": payload.decode(errors="replace")}
+
+
+@dataclasses.dataclass
+class RolloutConfig:
+    replicas: tuple = ()
+    server_access_key: Optional[str] = None
+    #: per-replica post-swap observation window: how long the orchestrator
+    #: watches /health for a probation auto-rollback before moving on.
+    #: Keep it well under the replicas' --reload-probation so a later halt
+    #: can still roll THIS replica back.
+    observe_sec: float = 5.0
+    poll_sec: float = 0.5
+    timeout_sec: float = 120.0   # per /reload request (load+warm+smoke)
+
+
+@dataclasses.dataclass
+class RolloutResult:
+    ok: bool
+    #: replicas serving the new instance when the rollout ended (empty
+    #: after a successful fleet-wide rollback)
+    updated: list
+    #: replicas rolled back to last-good during the halt
+    rolled_back: list
+    halted_at: Optional[str] = None
+    reason: Optional[str] = None
+    #: human-readable timeline, one line per step (the CLI prints these)
+    events: list = dataclasses.field(default_factory=list)
+
+
+def _auth(url: str, key: Optional[str]) -> str:
+    return f"{url}?accessKey={key}" if key else url
+
+
+def run_rollout(config: RolloutConfig,
+                http: Callable[..., tuple[int, dict]] = _http_json,
+                clock: Clock = SYSTEM_CLOCK) -> RolloutResult:
+    """Sequential fleet rollout with halt-and-rollback. Returns the full
+    timeline; ``ok`` is False on any halt (even if the rollback repaired
+    every replica)."""
+    updated: list[str] = []
+    result = RolloutResult(ok=True, updated=updated, rolled_back=[])
+
+    def log(line: str) -> None:
+        result.events.append(line)
+        logger.info("fleet rollout: %s", line)
+
+    def halt(at: str, reason: str) -> RolloutResult:
+        result.ok = False
+        result.halted_at = at
+        result.reason = reason
+        log(f"HALT at {at}: {reason}")
+        # roll the already-updated replicas back, newest first (reverse
+        # deploy order — the mirror image of how they were updated)
+        for url in reversed(list(updated)):
+            try:
+                status, body = http(
+                    "POST", _auth(f"{url}/rollback",
+                                  config.server_access_key),
+                    timeout=config.timeout_sec)
+            except Exception as e:  # noqa: BLE001 - keep rolling back
+                log(f"rollback {url}: FAILED ({e!r})")
+                continue
+            if status == 200:
+                updated.remove(url)
+                result.rolled_back.append(url)
+                log(f"rollback {url}: restored "
+                    f"{body.get('engineInstanceId')}")
+            else:
+                log(f"rollback {url}: refused ({status} "
+                    f"{body.get('message')})")
+        _ROLLOUTS.labels(outcome="halted").inc()
+        return result
+
+    for url in config.replicas:
+        url = url.rstrip("/")
+        # pre-reload state: which instance would a rollback restore to
+        try:
+            _, health = http("GET", f"{url}/health", timeout=10.0)
+            pre = (health.get("deployment") or {}).get("instanceId")
+        except Exception as e:  # noqa: BLE001
+            return halt(url, f"health probe failed before reload: {e!r}")
+        log(f"{url}: serving {pre}; reloading")
+        try:
+            status, body = http(
+                "POST", _auth(f"{url}/reload", config.server_access_key),
+                timeout=config.timeout_sec)
+        except Exception as e:  # noqa: BLE001
+            return halt(url, f"reload failed: {e!r}")
+        if status != 200:
+            # 409 = smoke gate rejected the new instance (it never served);
+            # anything else = reload machinery failure. Either halts.
+            return halt(url, f"reload answered {status}: "
+                             f"{body.get('message') or body}")
+        new_id = body.get("engineInstanceId")
+        updated.append(url)
+        log(f"{url}: swapped to {new_id}; observing probation")
+        # observation window: the replica's own probation machinery is the
+        # detector — a serving-breaker trip under live traffic rolls the
+        # replica back and /health says so
+        deadline = clock.monotonic() + config.observe_sec
+        while clock.monotonic() < deadline:
+            try:
+                _, health = http("GET", f"{url}/health", timeout=10.0)
+            except Exception as e:  # noqa: BLE001
+                updated.remove(url)  # unknown state; don't "roll back" it
+                return halt(url, f"health probe failed during "
+                                 f"probation: {e!r}")
+            last = (health.get("deployment") or {}).get("lastReload") or {}
+            if last.get("status") == "rolled_back":
+                updated.remove(url)  # the replica already restored itself
+                return halt(url, "probation tripped: replica rolled back "
+                                 f"to {last.get('instanceId')} "
+                                 f"({last.get('reason')})")
+            clock.sleep(config.poll_sec)
+        log(f"{url}: probation clean")
+    log(f"rollout complete: {len(updated)} replica(s) updated")
+    _ROLLOUTS.labels(outcome="ok").inc()
+    return result
+
+
+__all__ = ["RolloutConfig", "RolloutResult", "run_rollout"]

@@ -863,6 +863,7 @@ class QueryServer:
         add_observability_routes(app)
         app.router.add_post("/queries.json", self.handle_query)
         app.router.add_post("/reload", self.handle_reload)
+        app.router.add_post("/rollback", self.handle_rollback)
         app.router.add_post("/stop", self.handle_stop)
         app.router.add_get("/plugins.json", self.handle_plugins)
         return app
@@ -892,9 +893,13 @@ class QueryServer:
                 self.batcher.queue.qsize()),
             # crash-safe lifecycle surface (docs/resilience.md): which
             # instance serves, whether a previous one is pinned for
-            # rollback, and what the last reload did
+            # rollback, and what the last reload did. engineVersion is
+            # what the fleet tier keys experiment arms and rollouts on
+            # (docs/serving.md "Fleet serving")
             "deployment": {
                 "instanceId": self.deployed.instance.id,
+                "engineId": self.deployed.instance.engine_id,
+                "engineVersion": self.deployed.instance.engine_version,
                 "previousInstanceId": (
                     self._previous.instance.id
                     if self._previous is not None else None),
@@ -1392,13 +1397,11 @@ class QueryServer:
             return False
         return True
 
-    async def _maybe_probation_rollback(self, reason: str) -> None:
-        """Called after a serving-breaker failure: if the breaker tripped
-        OPEN inside a reload's probation window, the new instance is
-        broken under real traffic — swap the pinned previous instance back
-        in and close the breaker so it serves immediately."""
-        if self._serving_breaker.state != "open" or not self._probation_active():
-            return
+    async def _restore_previous(self, reason: str) -> DeployedEngine:
+        """Swap the pinned previous instance back in (probation rollback
+        and the fleet orchestrator's POST /rollback share this): atomic
+        engine swap, limiter re-bound, serving breaker closed so the
+        restored instance serves immediately."""
         prev, self._previous = self._previous, None
         self._probation_until = None
         rolled_from = self.deployed.instance.id
@@ -1414,8 +1417,38 @@ class QueryServer:
                              "instanceId": prev.instance.id,
                              "rolledBackFrom": rolled_from,
                              "reason": reason}
-        logger.error("reload probation: rolled back from instance %s to %s "
+        logger.error("reload: rolled back from instance %s to %s "
                      "(%s)", rolled_from, prev.instance.id, reason)
+        return prev
+
+    async def _maybe_probation_rollback(self, reason: str) -> None:
+        """Called after a serving-breaker failure: if the breaker tripped
+        OPEN inside a reload's probation window, the new instance is
+        broken under real traffic — swap the pinned previous instance back
+        in and close the breaker so it serves immediately."""
+        if self._serving_breaker.state != "open" or not self._probation_active():
+            return
+        await self._restore_previous(reason)
+
+    async def handle_rollback(self, request: web.Request) -> web.Response:
+        """Operator/orchestrator-driven rollback to the pinned previous
+        instance — the fleet rollout's halt path (``pio-tpu fleet
+        rollout``, docs/serving.md "Fleet serving"): when a LATER replica
+        trips its smoke gate or probation, the already-updated replicas
+        are restored to last-good through this endpoint while their own
+        probation pins still hold. 409 once the pin is gone (probation
+        elapsed or rollback already consumed it)."""
+        if not self._authorized(request):
+            return web.json_response({"message": "Unauthorized"}, status=401)
+        if not self._probation_active():
+            return web.json_response({
+                "message": "no pinned previous instance (probation "
+                           "inactive); nothing to roll back to",
+            }, status=409)
+        prev = await self._restore_previous("operator rollback "
+                                            "(POST /rollback)")
+        return web.json_response({"message": "Rolled back",
+                                  "engineInstanceId": prev.instance.id})
 
     async def handle_stop(self, request: web.Request) -> web.Response:
         if not self._authorized(request):

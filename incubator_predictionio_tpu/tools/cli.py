@@ -730,14 +730,12 @@ def cmd_wal(args, storage: Storage) -> int:
 
 
 def _fetch_health(url: str, timeout: float = 5.0) -> dict:
-    """GET <url>/health, parsed. Module-level so tests can stub it."""
-    import urllib.request
+    """GET <url>/health, parsed. Module-level so tests can stub it; the
+    single implementation lives in fleet/health.py (the router's watcher
+    probes with exactly the same fetch)."""
+    from incubator_predictionio_tpu.fleet.health import fetch_health
 
-    base = url.rstrip("/")
-    if not base.endswith("/health"):
-        base += "/health"
-    with urllib.request.urlopen(base, timeout=timeout) as resp:
-        return json.loads(resp.read().decode())
+    return fetch_health(url, timeout)
 
 
 def _health_row(url: str, h: Optional[dict], err: Optional[str]) -> dict:
@@ -785,14 +783,18 @@ def cmd_health(args, storage) -> int:
     storage — any mix) into one table: status, draining, breaker, spill,
     and admission/overload state. Exit non-zero when ANY server is red
     (unreachable, draining, or degraded) — the fleet smoke gate the
-    overload chaos test uses (docs/resilience.md)."""
-    rows = []
-    for url in args.urls:
-        try:
-            rows.append(_health_row(url, _fetch_health(url, args.timeout),
-                                    None))
-        except Exception as e:  # noqa: BLE001 - unreachable is a red row
-            rows.append(_health_row(url, None, repr(e)))
+    overload chaos test uses (docs/resilience.md).
+
+    Probes run CONCURRENTLY (fleet/health.py — the same fan-out the fleet
+    router's health watcher uses): a fleet with slow or dead replicas
+    answers in ~one probe timeout, not O(N × timeout)."""
+    from incubator_predictionio_tpu.fleet.health import probe_health_urls
+
+    # fetch resolved through the module global so tests can stub it
+    probed = probe_health_urls(
+        args.urls, args.timeout,
+        fetch=lambda url, timeout: _fetch_health(url, timeout))
+    rows = [_health_row(url, *probed[url]) for url in args.urls]
     if args.json:
         _out(json.dumps(rows, indent=2))
     else:
@@ -869,6 +871,196 @@ def cmd_metrics(args, storage) -> int:
                 v = int(value) if float(value).is_integer() \
                     and not math.isinf(value) else value
                 _out(f"  {label or '(no labels)'}: {v}")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# fleet: router / rolling deploy / experiment (docs/serving.md
+# "Fleet serving")
+# ---------------------------------------------------------------------------
+
+def cmd_fleet_route(args, storage) -> int:
+    """Run the fleet router server over the given replicas."""
+    from incubator_predictionio_tpu.fleet.experiments import Experiment
+    from incubator_predictionio_tpu.fleet.router import (
+        RouterConfig,
+        serve_forever,
+    )
+
+    experiment = None
+    if args.experiment_weight is not None:
+        if not args.candidate:
+            # refuse rather than silently run 100% control: the operator
+            # believes an experiment is live (matches the runtime path,
+            # where POST /experiment without candidates answers 409)
+            _err("--experiment-weight needs at least one --candidate "
+                 "replica to route the candidate arm to")
+            return 2
+        experiment = Experiment(
+            name=args.experiment_name, mode=args.experiment_mode,
+            weight=args.experiment_weight,
+            hash_field=args.experiment_hash_field)
+    kw = {}
+    for flag, key in (("deadline", "deadline_sec"),
+                      ("retries", "max_attempts"),
+                      ("health_interval", "health_interval_sec"),
+                      ("probe_timeout", "probe_timeout_sec"),
+                      ("eject_threshold", "eject_threshold")):
+        v = getattr(args, flag)
+        if v is not None:  # unset flags keep the PIO_FLEET_* env defaults
+            kw[key] = v
+    serve_forever(RouterConfig(
+        replicas=tuple(args.replica),
+        candidates=tuple(args.candidate or ()),
+        ip=args.ip, port=args.port,
+        server_access_key=args.server_access_key,
+        experiment=experiment, **kw))
+    return 0
+
+
+def cmd_fleet_rollout(args, storage) -> int:
+    """Sequential fleet rolling deploy with halt-and-rollback
+    (fleet/rollout.py). Exits non-zero on a halt, even when the rollback
+    repaired every replica — a halted rollout is a failed deploy."""
+    from incubator_predictionio_tpu.fleet.rollout import (
+        RolloutConfig,
+        run_rollout,
+    )
+
+    result = run_rollout(RolloutConfig(
+        replicas=tuple(args.replicas),
+        server_access_key=args.server_access_key,
+        observe_sec=args.observe, poll_sec=args.poll,
+        timeout_sec=args.timeout))
+    if args.json:
+        _out(json.dumps({
+            "ok": result.ok, "updated": result.updated,
+            "rolledBack": result.rolled_back,
+            "haltedAt": result.halted_at, "reason": result.reason,
+            "events": result.events}, indent=2))
+    else:
+        for line in result.events:
+            _out(line)
+        _out("ROLLOUT " + ("OK" if result.ok else
+                           f"HALTED at {result.halted_at}: {result.reason}"))
+    return 0 if result.ok else 1
+
+
+def _arm_stats_from_metrics(families: dict) -> dict:
+    """Per-arm request/error/latency stats from a router's /metrics page
+    (pio_fleet_arm_* families; docs/observability.md)."""
+    from incubator_predictionio_tpu.obs.metrics import bucket_quantiles
+
+    arms: dict[str, dict] = {}
+
+    def slot(arm: str) -> dict:
+        return arms.setdefault(arm, {
+            "requests": 0, "errors": 0, "buckets": [],
+            "latency_sum": 0.0, "latency_count": 0})
+
+    fam = families.get("pio_fleet_arm_requests_total")
+    for _, labels, value in (fam["samples"] if fam else ()):
+        s = slot(labels.get("arm", "?"))
+        s["requests"] += int(value)
+        if labels.get("status", "").startswith("5"):
+            s["errors"] += int(value)
+    fam = families.get("pio_fleet_arm_latency_seconds")
+    for sname, labels, value in (fam["samples"] if fam else ()):
+        s = slot(labels.get("arm", "?"))
+        if sname.endswith("_bucket"):
+            s["buckets"].append((float(labels["le"]), value))
+        elif sname.endswith("_sum"):
+            s["latency_sum"] += value
+        elif sname.endswith("_count"):
+            s["latency_count"] += int(value)
+    out = {}
+    for arm, s in arms.items():
+        qs = bucket_quantiles(s["buckets"]) if s["buckets"] else {}
+        out[arm] = {
+            "requests": s["requests"],
+            "errorRate": round(s["errors"] / s["requests"], 4)
+            if s["requests"] else 0.0,
+            "meanMs": round(1e3 * s["latency_sum"]
+                            / max(1, s["latency_count"]), 2),
+            "p95Ms": round(qs.get("p95", 0.0) * 1e3, 2),
+        }
+    return out
+
+
+def _experiment_verdict(arms: dict) -> str:
+    """Promote-or-abort reading of the live per-arm evidence. Advisory —
+    the operator promotes by redeploying the control fleet, the CLI only
+    names what the numbers say."""
+    control, candidate = arms.get("control"), arms.get("candidate")
+    if not control or not candidate:
+        return "insufficient data (need traffic on both arms)"
+    if candidate["requests"] < 20:
+        return f"continue (candidate has {candidate['requests']} requests)"
+    if candidate["errorRate"] > control["errorRate"] + 0.01:
+        return (f"ABORT: candidate error rate {candidate['errorRate']:.2%} "
+                f"vs control {control['errorRate']:.2%}")
+    if control["p95Ms"] and candidate["p95Ms"] > 1.5 * control["p95Ms"]:
+        return (f"ABORT: candidate p95 {candidate['p95Ms']}ms vs control "
+                f"{control['p95Ms']}ms")
+    return "PROMOTE-worthy: error rate and latency within control's band"
+
+
+def cmd_fleet_experiment(args, storage) -> int:
+    """Inspect (default), start (--start), or stop (--stop) the A/B /
+    shadow experiment on a running router, with per-arm live evidence
+    from the router's /metrics."""
+    import urllib.request
+
+    from incubator_predictionio_tpu.obs.metrics import parse_prometheus_text
+
+    base = args.router_url.rstrip("/")
+    auth = (f"?accessKey={args.server_access_key}"
+            if args.server_access_key else "")
+    if args.start or args.stop:
+        body = (json.dumps({"stop": True}).encode() if args.stop
+                else json.dumps({
+                    "name": args.start, "mode": args.mode,
+                    "weight": args.weight,
+                    "hashField": args.hash_field}).encode())
+        req = urllib.request.Request(
+            f"{base}/experiment{auth}", data=body, method="POST",
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                _out(json.loads(resp.read()).get("message", "ok"))
+        except Exception as e:  # noqa: BLE001
+            _err(f"experiment update failed: {e}")
+            return 1
+        return 0
+    try:
+        with urllib.request.urlopen(f"{base}/experiment.json",
+                                    timeout=10) as resp:
+            state = json.loads(resp.read())
+        with urllib.request.urlopen(f"{base}/metrics", timeout=10) as resp:
+            arms = _arm_stats_from_metrics(
+                parse_prometheus_text(resp.read().decode()))
+    except Exception as e:  # noqa: BLE001
+        _err(f"Unable to read {base}: {e}")
+        return 1
+    exp = state.get("experiment")
+    payload = {"experiment": exp, "arms": arms,
+               "verdict": _experiment_verdict(arms) if exp else None}
+    if args.json:
+        _out(json.dumps(payload, indent=2))
+        return 0
+    if exp is None:
+        _out("no experiment running")
+        return 0
+    _out(f"experiment {exp['name']}: mode={exp['mode']} "
+         f"weight={exp['weight']} hashField={exp['hashField']}")
+    _out(f"  assigned: {exp['assigned']}")
+    for arm in ("control", "candidate"):
+        if arm in arms:
+            a = arms[arm]
+            _out(f"  {arm:<10} requests={a['requests']} "
+                 f"errorRate={a['errorRate']:.2%} mean={a['meanMs']}ms "
+                 f"p95={a['p95Ms']}ms")
+    _out(f"  verdict: {payload['verdict']}")
     return 0
 
 
@@ -1152,6 +1344,79 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--json", action="store_true",
                    help="machine-readable row output")
 
+    # fleet — router / rolling deploy / experiment (docs/serving.md)
+    fleet = sub.add_parser(
+        "fleet",
+        help="fleet serving tier: route (health-aware query router), "
+             "rollout (sequential rolling deploy with halt-and-rollback), "
+             "experiment (A/B / shadow inspection and control)")
+    fl = fleet.add_subparsers(dest="fleet_command")
+    p = fl.add_parser("route")
+    p.add_argument("--ip", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8200)
+    p.add_argument("--replica", action="append", required=True,
+                   help="query-server replica base URL (repeatable)")
+    p.add_argument("--candidate", action="append",
+                   help="candidate-arm replica base URL for A/B / shadow "
+                        "routing (repeatable; a different engine version "
+                        "deployed beside the control fleet)")
+    p.add_argument("--deadline", type=float,
+                   help="total per-query budget in seconds across every "
+                        "forwarding attempt (PIO_FLEET_DEADLINE env, "
+                        "default 3)")
+    p.add_argument("--retries", type=int,
+                   help="forwarding attempts per query, each on a "
+                        "different replica (PIO_FLEET_MAX_ATTEMPTS env, "
+                        "default 2)")
+    p.add_argument("--health-interval", type=float,
+                   help="seconds between concurrent /health probe rounds "
+                        "(PIO_FLEET_HEALTH_INTERVAL env, default 2)")
+    p.add_argument("--probe-timeout", type=float,
+                   help="per-replica /health probe timeout "
+                        "(PIO_FLEET_PROBE_TIMEOUT env, default 2)")
+    p.add_argument("--eject-threshold", type=int,
+                   help="consecutive transport errors before a replica is "
+                        "ejected until a probe succeeds "
+                        "(PIO_FLEET_EJECT_THRESHOLD env, default 3)")
+    p.add_argument("--experiment-name", default="candidate")
+    p.add_argument("--experiment-mode", choices=("ab", "shadow"),
+                   default="ab")
+    p.add_argument("--experiment-weight", type=float,
+                   help="fraction of traffic on the candidate arm; "
+                        "requires --candidate (omit to start without an "
+                        "experiment — POST /experiment starts one live)")
+    p.add_argument("--experiment-hash-field",
+                   help="query field whose value hashes to a sticky arm "
+                        "(e.g. user); omitted = weighted rotation")
+    p.add_argument("--server-access-key",
+                   help="guards POST /experiment")
+    p = fl.add_parser("rollout")
+    p.add_argument("replicas", nargs="+",
+                   help="query-server replica base URLs, deploy order")
+    p.add_argument("--server-access-key")
+    p.add_argument("--observe", type=float, default=5.0,
+                   help="seconds to watch each replica's /health for a "
+                        "probation auto-rollback after its swap (keep "
+                        "well under the replicas' --reload-probation; "
+                        "default 5)")
+    p.add_argument("--poll", type=float, default=0.5,
+                   help="seconds between /health polls while observing")
+    p.add_argument("--timeout", type=float, default=120.0,
+                   help="per-replica /reload timeout (load+warm+smoke)")
+    p.add_argument("--json", action="store_true")
+    p = fl.add_parser("experiment")
+    p.add_argument("router_url",
+                   help="fleet router base URL, e.g. http://127.0.0.1:8200")
+    p.add_argument("--start", metavar="NAME",
+                   help="start an experiment with this name")
+    p.add_argument("--stop", action="store_true",
+                   help="stop the running experiment")
+    p.add_argument("--mode", choices=("ab", "shadow"), default="ab")
+    p.add_argument("--weight", type=float, default=0.1)
+    p.add_argument("--hash-field")
+    p.add_argument("--server-access-key")
+    p.add_argument("--json", action="store_true")
+
     # wal — inspect/verify/replay an event-server spill WAL
     p = sub.add_parser(
         "wal",
@@ -1261,6 +1526,12 @@ _ACCESSKEY_COMMANDS = {
     "delete": cmd_accesskey_delete,
 }
 
+_FLEET_COMMANDS = {
+    "route": cmd_fleet_route,
+    "rollout": cmd_fleet_rollout,
+    "experiment": cmd_fleet_experiment,
+}
+
 
 def main(argv: Optional[list[str]] = None) -> int:
     parser = build_parser()
@@ -1293,6 +1564,11 @@ def main(argv: Optional[list[str]] = None) -> int:
             parser.parse_args(["accesskey", "--help"])
             return 1
         return _ACCESSKEY_COMMANDS[args.accesskey_command](args, storage)
+    if args.command == "fleet":
+        if not args.fleet_command:
+            _err("fleet: missing subcommand (route|rollout|experiment)")
+            return 1
+        return _FLEET_COMMANDS[args.fleet_command](args, storage)
     if args.command == "template":
         if not args.template_command:
             # parse_args(["template", "--help"]) would SystemExit(0); a

@@ -59,6 +59,15 @@ def pct(vals, q: float) -> float:
     return a[min(len(a) - 1, int(q * (len(a) - 1)))] if a else 0.0
 
 
+def attempted_qps(counts: dict, duration: float) -> float:
+    """Requests actually put on the wire per second — the *achieved*
+    offered rate (int keys only: 'degraded' shadows a 200 already
+    counted). Under heavy backend slowness this falls below the nominal
+    open-loop target; reporting it keeps the bench honest."""
+    n = sum(v for k, v in counts.items() if isinstance(k, int))
+    return n / duration
+
+
 def _track(counts: dict, lat_ms: list, status: int, degraded: bool,
            ms: float) -> None:
     counts[status] = counts.get(status, 0) + 1
@@ -103,6 +112,16 @@ async def open_loop(host: str, port: int, n_conns: int, duration: float,
             if t_sched - t0 >= duration:
                 return
             now = time.perf_counter()
+            # WALL-time cutoff, not just scheduled-time: when the backend
+            # answers slower than the offered rate, workers fall behind
+            # their slots — without this, every scheduled slot still fires
+            # long after the window closed, the phase stretches to
+            # slots/served_rate seconds, and counts/duration inflates
+            # goodput by the overrun factor (a slow fleet would *measure*
+            # faster). Slots the client could not offer in the window are
+            # dropped; the achieved rate is in the returned counts.
+            if now - t0 >= duration:
+                return
             if t_sched > now:
                 await asyncio.sleep(t_sched - now)
             _track(counts, lat_ms, *(await post(*conn, req_fn())))
@@ -143,6 +162,7 @@ def three_phase(base_url: str, warm_s: float, cap_s: float, over_s: float,
                 "counts": {str(k): v for k, v in cap_counts.items()}},
             "overload": {
                 "offered_qps": round(overload_factor * cap_qps, 1),
+                "achieved_qps": round(attempted_qps(over_counts, over_s), 1),
                 "goodput_qps": round(over_counts.get(200, 0) / over_s, 1),
                 "p50_ms": round(pct(over_lat, 0.5), 2),
                 "p99_ms": round(pct(over_lat, 0.99), 2),
@@ -152,13 +172,39 @@ def three_phase(base_url: str, warm_s: float, cap_s: float, over_s: float,
     return asyncio.run(main())
 
 
-def bench_main(argv: list[str]) -> None:
-    """Subprocess entry for ``bench.py overload``:
-    ``argv = [base_url, warm_s, cap_s, over_s, n_users]``. Prints one JSON
-    line of the three-phase results."""
-    base, warm_s, cap_s, over_s, n_users = (
-        argv[0], float(argv[1]), float(argv[2]), float(argv[3]),
-        int(argv[4]))
+def fixed_load(base_url: str, warm_s: float, over_s: float,
+               offered_qps: float, req_fn, n_conns: int = 48) -> dict:
+    """Warm (single closed-loop connection) then open-loop at a FIXED
+    offered rate — the ``bench.py fleet`` comparison shape: the same
+    absolute load offered to different fleet topologies, so goodput/p99
+    deltas are the topology's, not the load's."""
+    host = urllib.parse.urlsplit(base_url).hostname
+    port = urllib.parse.urlsplit(base_url).port
+
+    async def main() -> dict:
+        r, w = await asyncio.open_connection(host, port)
+        await post(r, w, req_fn())  # warmup round trip
+        w.close()
+        warm_counts, warm_lat = await closed_loop(
+            host, port, 1, warm_s, req_fn)
+        over_counts, over_lat = await open_loop(
+            host, port, n_conns, over_s, offered_qps, req_fn)
+        return {
+            "warm": {"counts": {str(k): v for k, v in warm_counts.items()},
+                     "p99_ms": round(pct(warm_lat, 0.99), 2)},
+            "overload": {
+                "offered_qps": round(offered_qps, 1),
+                "achieved_qps": round(attempted_qps(over_counts, over_s), 1),
+                "goodput_qps": round(over_counts.get(200, 0) / over_s, 1),
+                "p50_ms": round(pct(over_lat, 0.5), 2),
+                "p99_ms": round(pct(over_lat, 0.99), 2),
+                "counts": {str(k): v for k, v in over_counts.items()}},
+        }
+
+    return asyncio.run(main())
+
+
+def _rotating_user_req_fn(base: str, n_users: int):
     host = urllib.parse.urlsplit(base).hostname
     port = urllib.parse.urlsplit(base).port
     seq = itertools.count()
@@ -170,4 +216,39 @@ def bench_main(argv: list[str]) -> None:
                            "num": 10}).encode()
         return request_bytes(host, port, body)
 
-    print(json.dumps(three_phase(base, warm_s, cap_s, over_s, req_fn)))
+    return req_fn
+
+
+def bench_main(argv: list[str]) -> None:
+    """Subprocess entry for ``bench.py overload``:
+    ``argv = [base_url, warm_s, cap_s, over_s, n_users]``. Prints one JSON
+    line of the three-phase results."""
+    base, warm_s, cap_s, over_s, n_users = (
+        argv[0], float(argv[1]), float(argv[2]), float(argv[3]),
+        int(argv[4]))
+    print(json.dumps(three_phase(
+        base, warm_s, cap_s, over_s, _rotating_user_req_fn(base, n_users))))
+
+
+def fleet_main(argv: list[str]) -> None:
+    """Subprocess entry for ``bench.py fleet``:
+    ``argv = [base_url, warm_s, cap_s, over_s, n_users, offered_qps]``.
+    ``offered_qps <= 0`` runs the full three-phase protocol (measuring
+    capacity, overload at 3×); ``> 0`` skips capacity measurement and
+    drives the open loop at that absolute rate (``cap_s`` is unused) —
+    the fixed-offered-load topology comparison."""
+    base, warm_s, cap_s, over_s, n_users, offered = (
+        argv[0], float(argv[1]), float(argv[2]), float(argv[3]),
+        int(argv[4]), float(argv[5]))
+    req_fn = _rotating_user_req_fn(base, n_users)
+    if offered > 0:
+        # each keep-alive connection awaits its response before taking the
+        # next slot, so achievable rate is capped at n_conns / latency —
+        # at saturation (latency ~= the 1s-scale micro-batch drain) 48
+        # conns silently under-offer and the comparison measures the
+        # CLIENT. Size the pool to sustain ~1s latency at the target rate.
+        n_conns = min(max(48, int(offered)), 512)
+        print(json.dumps(fixed_load(base, warm_s, over_s, offered, req_fn,
+                                    n_conns=n_conns)))
+    else:
+        print(json.dumps(three_phase(base, warm_s, cap_s, over_s, req_fn)))

@@ -355,6 +355,230 @@ def test_query_server_overload_storm(tmp_path):
         qs.stop()
 
 
+# ---------------------------------------------------------------------------
+# fleet chaos (ISSUE 6): rolling deploy halt-and-rollback through real
+# replica processes, and a replica SIGKILL mid-storm absorbed by the router
+# ---------------------------------------------------------------------------
+
+
+def _train_second_instance(store_cfg: dict, variant_path: str) -> None:
+    """Add another COMPLETED engine instance to the shared store so each
+    replica's /reload has a NEW version to hot-swap to (ids differ — the
+    rollback assertions are meaningful)."""
+    import datetime as dt
+
+    from incubator_predictionio_tpu.core.workflow import run_train
+    from incubator_predictionio_tpu.data.storage import use_storage
+    from incubator_predictionio_tpu.data.storage.base import EngineInstance
+    from incubator_predictionio_tpu.parallel.mesh import MeshContext
+    from incubator_predictionio_tpu.templates.classification import (
+        ClassificationEngine,
+    )
+
+    utc = dt.timezone.utc
+    storage = Storage(store_cfg)
+    prev = use_storage(storage)
+    try:
+        with open(variant_path) as f:
+            variant = json.load(f)
+        engine = ClassificationEngine().apply()
+        engine_params = engine.engine_params_from_variant(variant)
+        instance = EngineInstance(
+            id="", status="INIT", start_time=dt.datetime.now(utc),
+            end_time=None, engine_id=variant["id"],
+            engine_version=variant["version"],
+            engine_variant=os.path.abspath(variant_path),
+            engine_factory=variant["engineFactory"])
+        run_train(engine, engine_params, instance, storage=storage,
+                  ctx=MeshContext.create())
+    finally:
+        use_storage(prev)
+        storage.close()
+
+
+def _deploy_replica(store_cfg, variant_path, port, *extra) -> ServerProc:
+    return ServerProc(
+        ["deploy", "-v", variant_path, "--ip", "127.0.0.1",
+         "--port", str(port), "--query-timeout", str(QUERY_DEADLINE_S),
+         "--reload-probation", "120", "--server-access-key", "sk",
+         *extra],
+        env={**store_cfg,
+             "PIO_ADMISSION_MAX_QUEUE": "128",
+             "PIO_BROWNOUT_ENTER_SEC": "0.3",
+             "PIO_BROWNOUT_EXIT_SEC": "1.0"})
+
+
+def _router_proc(store_cfg, replica_urls, port, *extra) -> ServerProc:
+    args = ["fleet", "route", "--ip", "127.0.0.1", "--port", str(port),
+            "--health-interval", "0.3", "--probe-timeout", "1.0",
+            "--deadline", "3.0", *extra]
+    for url in replica_urls:
+        args += ["--replica", url]
+    return ServerProc(args, env=dict(store_cfg))
+
+
+class _SteadyTraffic:
+    """Background client posting queries through the router for the whole
+    rollout, recording every status — the 'no client-visible 5xx from the
+    deploy itself' witness."""
+
+    def __init__(self, url: str):
+        import threading
+
+        self.url = url
+        self.statuses: list[int] = []
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                status, _ = http_json(
+                    "POST", self.url,
+                    {"features": [0.5, -0.2, 0.1]}, timeout=5.0)
+                self.statuses.append(status)
+            except Exception:  # noqa: BLE001 - a hang/refusal is the bug
+                self.statuses.append(-1)
+            time.sleep(0.05)
+
+    def stop(self) -> list[int]:
+        self._stop.set()
+        self._thread.join(timeout=10.0)
+        return self.statuses
+
+
+def test_fleet_rollout_halts_rolls_back_and_serves_throughout(tmp_path):
+    """ISSUE 6 acceptance: a `pio-tpu fleet rollout` where one replica's
+    smoke gate trips must halt, roll the already-updated replicas back to
+    last-good, and never surface a client-visible 5xx through the router."""
+    store_cfg, variant_path = _train_classification(tmp_path)
+    pa, pb, pr = free_port(), free_port(), free_port()
+    url_a, url_b = (f"http://127.0.0.1:{pa}", f"http://127.0.0.1:{pb}")
+    # replica A reloads clean; replica B's smoke gate can never pass (the
+    # payload can't bind) — the fleet-wide halt fires AFTER A swapped
+    ra = _deploy_replica(store_cfg, variant_path, pa)
+    rb = _deploy_replica(store_cfg, variant_path, pb,
+                         "--smoke-query", '{"bogus": "nope"}')
+    router = traffic = None
+    try:
+        ra.wait_ready(f"{url_a}/", timeout=180.0)
+        rb.wait_ready(f"{url_b}/", timeout=180.0)
+        # train the NEW version only after the replicas booted on v1, so
+        # /reload has a genuinely different instance to hot-swap to
+        _train_second_instance(store_cfg, variant_path)
+        _, ha = http_json("GET", f"{url_a}/health")
+        _, hb = http_json("GET", f"{url_b}/health")
+        a_v1 = ha["deployment"]["instanceId"]
+        b_v1 = hb["deployment"]["instanceId"]
+        router = _router_proc(store_cfg, [url_a, url_b], pr)
+        router.wait_ready(f"http://127.0.0.1:{pr}/")
+        traffic = _SteadyTraffic(f"http://127.0.0.1:{pr}/queries.json")
+        # a couple of pre-rollout answers prove traffic is really flowing
+        deadline = time.monotonic() + 20.0
+        while len(traffic.statuses) < 3 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert traffic.statuses, "no traffic reached the router"
+
+        rollout = subprocess.run(
+            [sys.executable, "-m", "incubator_predictionio_tpu.tools.cli",
+             "fleet", "rollout", url_a, url_b, "--server-access-key", "sk",
+             "--observe", "1.0", "--poll", "0.2", "--json"],
+            capture_output=True, text=True, timeout=300)
+        statuses = traffic.stop()
+        traffic = None
+        assert rollout.returncode == 1, rollout.stdout + rollout.stderr
+        report = json.loads(rollout.stdout)
+        assert report["haltedAt"] == url_b
+        assert report["rolledBack"] == [url_a]
+        assert report["updated"] == []
+
+        # replica A: swapped to the new instance, then restored to v1
+        _, ha = http_json("GET", f"{url_a}/health")
+        dep_a = ha["deployment"]
+        assert dep_a["instanceId"] == a_v1
+        assert dep_a["lastReload"]["status"] == "rolled_back"
+        assert dep_a["lastReload"]["rolledBackFrom"] != a_v1
+        # replica B: the gate kept the new instance from ever serving
+        _, hb = http_json("GET", f"{url_b}/health")
+        dep_b = hb["deployment"]
+        assert dep_b["instanceId"] == b_v1
+        assert dep_b["lastReload"]["status"] == "rejected"
+
+        # the deploy itself was invisible to clients: every request
+        # through the router answered 200 (no 5xx, no hangs/refusals)
+        assert statuses and set(statuses) == {200}, (
+            f"client saw non-200s during rollout: "
+            f"{sorted(set(statuses))} of {len(statuses)}")
+        # and the fleet still serves after the halt
+        status, body = http_json(
+            "POST", f"http://127.0.0.1:{pr}/queries.json",
+            {"features": [0.5, -0.2, 0.1]})
+        assert status == 200 and "label" in body
+    finally:
+        if traffic is not None:
+            traffic.stop()
+        if router is not None:
+            router.stop()
+        ra.stop()
+        rb.stop()
+
+
+def test_fleet_router_absorbs_replica_kill9_mid_storm(tmp_path):
+    """SIGKILL one of three replicas mid-storm at offered load well below
+    the remaining capacity: the router retries/ejects and sheds NOTHING —
+    zero non-orderly statuses, zero sheds (every request answers 200)."""
+    import threading
+
+    from tests.fixtures.loadgen import closed_loop, open_loop, request_bytes
+
+    store_cfg, variant_path = _train_classification(tmp_path)
+    ports = [free_port() for _ in range(3)]
+    urls = [f"http://127.0.0.1:{p}" for p in ports]
+    pr = free_port()
+    replicas = [_deploy_replica(store_cfg, variant_path, p) for p in ports]
+    router = None
+    try:
+        for url, proc in zip(urls, replicas):
+            proc.wait_ready(f"{url}/", timeout=180.0)
+        router = _router_proc(store_cfg, urls, pr,
+                              "--eject-threshold", "2")
+        router.wait_ready(f"http://127.0.0.1:{pr}/")
+
+        req = request_bytes("127.0.0.1", pr, _STORM_BODY)
+        # measured 3-replica capacity through the router (closed loop)
+        cap_counts, _ = asyncio.run(
+            closed_loop("127.0.0.1", pr, 8, 2.0, lambda: req))
+        cap_qps = cap_counts.get(200, 0) / 2.0
+        assert cap_qps > 0
+        # offered load ~40% of 3-replica capacity — comfortably below the
+        # 2-replica capacity that remains after the kill
+        offered = max(5.0, 0.4 * cap_qps)
+        killer = threading.Timer(1.5, replicas[0].kill9)
+        killer.start()
+        try:
+            counts, _lat = asyncio.run(
+                open_loop("127.0.0.1", pr, 16, 4.0, offered, lambda: req))
+        finally:
+            killer.cancel()
+        statuses = _status_counts(counts)
+        assert set(statuses) == {200}, (
+            f"non-orderly/shed statuses below remaining capacity: "
+            f"{statuses}")
+        # the dead replica was ejected from rotation (probe cycle keeps
+        # it out until it comes back)
+        _, health = http_json("GET", f"http://127.0.0.1:{pr}/health")
+        dead = next(r for r in health["replicas"]
+                    if r["url"] == urls[0])
+        assert not dead["healthy"]
+        assert health["availableReplicas"] == 2
+    finally:
+        if router is not None:
+            router.stop()
+        for proc in replicas:
+            proc.stop()
+
+
 def test_event_server_sigterm_drains_and_exits_clean(tmp_path):
     """Graceful drain end-to-end: SIGTERM → new ingest 503s, the spilled
     acks flush to the recovered store, the process exits 0 within the

@@ -1,0 +1,710 @@
+"""Fleet serving tier (ISSUE 6): balancer, health-watcher ejection/probe
+cycle, concurrent health probing (shared with ``pio-tpu health``), hashed
+A/B assignment stability, shadow comparison, the router's forwarding /
+retry / header-propagation behavior against stub replicas, and the
+rollout orchestrator's halt-and-rollback state machine.
+
+All timing rides the injectable ``Clock``/``FakeClock`` pattern — zero
+wall sleeps; the router end-to-end tests use in-loop aiohttp stub
+replicas (no subprocesses — the real-process chaos lives in
+tests/test_chaos_procs.py)."""
+
+import asyncio
+import json
+import threading
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from incubator_predictionio_tpu.fleet.balancer import Balancer, Replica
+from incubator_predictionio_tpu.fleet.experiments import (
+    CANDIDATE,
+    CONTROL,
+    Experiment,
+    SHADOW_MIRRORS,
+)
+from incubator_predictionio_tpu.fleet.health import (
+    HealthWatcher,
+    probe_health_urls,
+)
+from incubator_predictionio_tpu.fleet.rollout import (
+    RolloutConfig,
+    run_rollout,
+)
+from incubator_predictionio_tpu.fleet.router import (
+    RouterConfig,
+    RouterServer,
+)
+from incubator_predictionio_tpu.resilience.clock import FakeClock
+
+
+# ---------------------------------------------------------------------------
+# balancer
+# ---------------------------------------------------------------------------
+
+def test_balancer_picks_least_loaded_per_admission_slot():
+    clk = FakeClock()
+    b = Balancer(["http://a", "http://b"], clock=clk)
+    a, bb = b.replicas
+    # equal limits, unequal in-flight: the idle replica wins
+    a.inflight, bb.inflight = 2, 0
+    assert b.pick() is bb
+    # the loaded replica advertises a larger admission limit: load is
+    # normalized per slot, so 2-of-4 beats 1-of-1
+    a.inflight_limit = 4
+    a.inflight, bb.inflight = 2, 1
+    assert b.pick() is a
+
+
+def test_balancer_skips_draining_backoff_and_excluded():
+    clk = FakeClock()
+    b = Balancer(["http://a", "http://b", "http://c"], clock=clk)
+    a, bb, c = b.replicas
+    a.draining = True
+    bb.on_overload(retry_after_sec=5.0)  # Retry-After honored: backoff
+    assert b.pick() is c
+    # backoff is a preference, not a gate: with c excluded, the
+    # backing-off replica beats failing the query (draining stays hard)
+    assert b.pick(exclude={c.url}) is bb
+    # ejection IS a hard gate — nothing left once bb is unhealthy too
+    bb.healthy = False
+    assert b.pick(exclude={c.url}) is None
+    bb.healthy = True
+    # backoff expires with (virtual) time — bb strictly available again
+    clk.advance(5.1)
+    assert bb.available()
+    assert b.pick(exclude={c.url}) is bb
+
+
+def test_balancer_relaxes_backoff_when_whole_fleet_is_backing_off():
+    """The retry wave right after a replica dies can 429 every survivor
+    into a Retry-After window at once; the balancer must keep routing
+    (least-loaded backing-off pick) instead of handing the router a
+    fabricated 503 below capacity."""
+    clk = FakeClock()
+    b = Balancer(["http://a", "http://b"], clock=clk)
+    a, bb = b.replicas
+    a.on_overload(retry_after_sec=2.0)
+    bb.on_overload(retry_after_sec=2.0)
+    assert not a.available() and not bb.available()
+    a.inflight = 1
+    assert b.pick() is bb  # least-loaded among the backing-off
+    # a retry that already tried bb relaxes onto a, not None
+    assert b.pick(exclude={bb.url}) is a
+
+
+def test_balancer_brownout_is_last_resort():
+    clk = FakeClock()
+    b = Balancer(["http://a", "http://b"], clock=clk)
+    a, bb = b.replicas
+    a.brownout = True
+    assert b.pick() is bb
+    # ...but a browned-out replica still serves when it is the only one
+    bb.draining = True
+    assert b.pick() is a
+
+
+def test_balancer_fast_5xx_replica_does_not_become_preferred():
+    """A broken replica failing in ~2ms must not look like the best pick:
+    pass-through 5xx answers feed the error EWMA (a score penalty) even
+    though they are neither transport failures nor overload."""
+    clk = FakeClock()
+    b = Balancer(["http://bad", "http://good"], clock=clk)
+    bad, good = b.replicas
+    for _ in range(5):
+        bad.on_failure_status()
+        good.on_success(0.05)
+    assert bad.err_ewma > good.err_ewma
+    assert b.pick() is good
+    # no ejection — its /health probe still succeeds and would re-admit
+    # it instantly; the score penalty does the shunning
+    assert bad.available() and bad.consecutive_errors == 0
+
+
+def test_replica_ejection_after_consecutive_errors_and_probe_readmits():
+    clk = FakeClock()
+    b = Balancer(["http://a", "http://b"], clock=clk, eject_threshold=3)
+    a, bb = b.replicas
+    for _ in range(2):
+        assert a.on_error() is False
+    assert a.healthy  # below threshold
+    assert a.on_error() is True  # third consecutive error ejects
+    assert not a.healthy
+    assert b.pick() is bb
+    assert b.pick(exclude={bb.url}) is None  # ejected ≠ routable
+    # a success resets the streak on a healthy replica
+    bb.on_error()
+    bb.on_success(0.01)
+    assert bb.consecutive_errors == 0
+    # the probe cycle re-admits the ejected replica (fleet/health.py)
+    watcher = HealthWatcher([a, bb], clock=clk)
+    watcher.apply_results({
+        "http://a": ({"status": "ok", "draining": False,
+                      "admission": {"inflightLimit": 3}}, None),
+        "http://b": (None, "ConnectionRefusedError()"),
+    })
+    assert a.healthy and a.consecutive_errors == 0
+    assert a.inflight_limit == 3  # live admission limit adopted
+    assert not bb.healthy  # failed probe ejects
+    assert b.pick() is a
+
+
+def test_health_watcher_adopts_draining_brownout_and_version():
+    clk = FakeClock()
+    r = Replica("http://a", clock=clk)
+    w = HealthWatcher([r], clock=clk)
+    w.apply_results({"http://a": ({
+        "status": "ok", "draining": True,
+        "admission": {"inflightLimit": 2, "brownoutActive": True},
+        "deployment": {"instanceId": "i-42", "engineVersion": "7"},
+    }, None)})
+    assert r.draining and r.brownout
+    assert r.instance_id == "i-42" and r.engine_version == "7"
+    assert not r.available()  # draining replicas leave rotation
+    w.apply_results({"http://a": ({
+        "status": "ok", "draining": False, "admission": {},
+    }, None)})
+    assert r.available()
+
+
+# ---------------------------------------------------------------------------
+# concurrent health probe (satellite: pio-tpu health fan-out)
+# ---------------------------------------------------------------------------
+
+def test_probe_health_urls_runs_concurrently():
+    """All three probes must be in flight at once: each blocks on a
+    shared barrier that only releases when every thread arrives — a
+    serial prober would deadlock (and trip the barrier timeout)."""
+    barrier = threading.Barrier(3, timeout=10.0)
+
+    def fetch(url, timeout):
+        barrier.wait()
+        if url.endswith("dead"):
+            raise OSError("refused")
+        return {"status": "ok", "url": url}
+
+    urls = ["http://a", "http://b", "http://dead"]
+    results = probe_health_urls(urls, timeout=1.0, fetch=fetch)
+    assert results["http://a"][0]["status"] == "ok"
+    assert results["http://b"][0]["status"] == "ok"
+    health, err = results["http://dead"]
+    assert health is None and "refused" in err
+
+
+def test_cli_health_probes_concurrently(monkeypatch, capsys):
+    """The CLI verb rides the same concurrent fan-out (no O(N × timeout)
+    serial walk) and keeps its row semantics."""
+    from incubator_predictionio_tpu.tools import cli
+
+    barrier = threading.Barrier(2, timeout=10.0)
+
+    def fetch(url, timeout=5.0):
+        barrier.wait()
+        return {"status": "ok", "draining": False, "admission": {}}
+
+    monkeypatch.setattr(cli, "_fetch_health", fetch)
+    args = cli.build_parser().parse_args(
+        ["health", "http://q1:8000", "http://q2:8000"])
+    rc = cli.cmd_health(args, None)
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "http://q1:8000" in out and "http://q2:8000" in out
+
+
+def test_cli_fleet_route_rejects_experiment_without_candidate(capsys):
+    """--experiment-weight with no --candidate must refuse at startup,
+    not silently run 100% control while the operator believes an A/B
+    experiment is live."""
+    from incubator_predictionio_tpu.tools import cli
+
+    args = cli.build_parser().parse_args(
+        ["fleet", "route", "--replica", "http://q1:8000",
+         "--experiment-weight", "0.1"])
+    rc = cli.cmd_fleet_route(args, None)
+    assert rc == 2
+    assert "--candidate" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# A/B assignment + shadow comparison
+# ---------------------------------------------------------------------------
+
+def test_hashed_ab_assignment_is_stable_and_weighted():
+    exp = Experiment(name="v2", mode="ab", weight=0.3, hash_field="user")
+    first = {f"u{i}": exp.assign({"user": f"u{i}"}) for i in range(400)}
+    # stability: same entity → same arm, on this instance AND on a fresh
+    # one (derived from the hash, not stored — router restarts keep the
+    # split)
+    again = Experiment(name="v2", mode="ab", weight=0.3, hash_field="user")
+    for uid, arm in first.items():
+        assert exp.assign({"user": uid}) == arm
+        assert again.assign({"user": uid}) == arm
+    share = sum(1 for a in first.values() if a == CANDIDATE) / len(first)
+    assert 0.2 < share < 0.4  # weighted split lands near 0.3
+    # different experiment name → decorrelated split
+    other = Experiment(name="v3", mode="ab", weight=0.3, hash_field="user")
+    flips = sum(1 for uid in first
+                if other.assign({"user": uid}) != first[uid])
+    assert flips > 0
+
+
+def test_ab_weight_edges_and_rotation_fallback():
+    all_ctl = Experiment(name="z", weight=0.0, hash_field="user")
+    all_cand = Experiment(name="z", weight=1.0, hash_field="user")
+    for i in range(20):
+        assert all_ctl.assign({"user": f"u{i}"}) == CONTROL
+        assert all_cand.assign({"user": f"u{i}"}) == CANDIDATE
+    # no hash field resolvable → deterministic weighted rotation
+    rot = Experiment(name="r", weight=0.25)
+    arms = [rot.assign({"q": 1}) for _ in range(40)]
+    assert arms.count(CANDIDATE) == 10  # exactly weight × n, no RNG
+    assert rot.assigned[CANDIDATE] == 10
+
+
+def test_shadow_compare_canonicalizes_json():
+    assert Experiment.compare_shadow(
+        200, b'{"a": 1, "b": 2}', 200, b'{"b": 2, "a": 1}') == "matched"
+    assert Experiment.compare_shadow(
+        200, b'{"a": 1}', 200, b'{"a": 2}') == "mismatched"
+    assert Experiment.compare_shadow(
+        200, b'{"a": 1}', 400, b'{"a": 1}') == "mismatched"
+
+
+# ---------------------------------------------------------------------------
+# router end-to-end (in-loop stub replicas)
+# ---------------------------------------------------------------------------
+
+def _replica_app(record: list, responder=None):
+    """Stub query-server: records each /queries.json hit (headers+body)
+    and answers via ``responder(n, request) -> (status, body, headers)``
+    (default: echo 200)."""
+
+    async def queries(request):
+        body = await request.read()
+        record.append({"headers": dict(request.headers), "body": body})
+        if responder is None:
+            return web.json_response({"echo": json.loads(body or b"{}")})
+        status, payload, headers = responder(len(record), request)
+        return web.json_response(payload, status=status,
+                                 headers=headers or {})
+
+    app = web.Application()
+    app.router.add_post("/queries.json", queries)
+    return app
+
+
+async def _start_replicas(*apps):
+    servers = []
+    for app in apps:
+        s = TestServer(app)
+        await s.start_server()
+        servers.append(s)
+    return servers, [f"http://127.0.0.1:{s.port}" for s in servers]
+
+
+def _run_router(coro_fn, replica_apps, candidate_apps=(), **cfg_kw):
+    async def runner():
+        servers, urls = await _start_replicas(*replica_apps)
+        cand_servers, cand_urls = await _start_replicas(*candidate_apps)
+        clk = cfg_kw.pop("clock", None)
+        router = RouterServer(
+            RouterConfig(replicas=tuple(urls),
+                         candidates=tuple(cand_urls), **cfg_kw),
+            **({"clock": clk} if clk is not None else {}))
+        client = TestClient(TestServer(router.make_app()))
+        await client.start_server()
+        try:
+            return await coro_fn(client, router, urls, cand_urls)
+        finally:
+            await client.close()
+            await router.shutdown()
+            for s in [*servers, *cand_servers]:
+                await s.close()
+
+    return asyncio.run(runner())
+
+
+def test_router_forwards_and_propagates_trace_and_client():
+    """One trace spans client→router→replica, and the ORIGINATING client
+    identity (not the router's) reaches the replica — what the storage
+    tier's per-client caps meter."""
+    record: list = []
+
+    async def t(client, router, urls, _):
+        resp = await client.post(
+            "/queries.json", json={"user": "u1"},
+            headers={"X-PIO-Trace": "aaaa1111:bbbb2222",
+                     "X-PIO-Client": "edge-proxy:42"})
+        assert resp.status == 200
+        assert (await resp.json())["echo"] == {"user": "u1"}
+        assert resp.headers["X-PIO-Trace"].startswith("aaaa1111")
+        assert "X-PIO-Fleet-Replica" in resp.headers
+        seen = record[0]["headers"]
+        # the hop carries the client's trace id (middleware adopted it)
+        # and the true originating identity
+        assert seen["X-PIO-Trace"].split(":")[0] == "aaaa1111"
+        assert seen["X-PIO-Client"] == "edge-proxy:42"
+        assert router.request_count == 1
+
+    _run_router(t, [_replica_app(record)])
+
+
+def test_router_retries_transport_error_on_other_replica():
+    """A dead replica costs a retry, not an error: the query lands on the
+    healthy replica and the dead one accrues ejection pressure."""
+    record: list = []
+
+    async def t(client, router, urls, _):
+        # make the dead replica the preferred pick (idle) by loading the
+        # live one — the router must recover via the retry path
+        dead_url = urls[0]
+        for _i in range(3):
+            resp = await client.post("/queries.json", json={"q": 1})
+            assert resp.status == 200
+        assert router.retry_count >= 1
+        dead = next(r for r in router.balancer.replicas
+                    if r.url == dead_url)
+        assert dead.consecutive_errors >= 1
+
+    async def runner():
+        # one real replica + one refused port (bound then closed)
+        import socket
+
+        sock = socket.socket()
+        sock.bind(("127.0.0.1", 0))
+        dead_port = sock.getsockname()[1]
+        sock.close()
+        servers, urls = await _start_replicas(_replica_app(record))
+        router = RouterServer(RouterConfig(
+            replicas=(f"http://127.0.0.1:{dead_port}", urls[0]),
+            max_attempts=2, deadline_sec=5.0))
+        client = TestClient(TestServer(router.make_app()))
+        await client.start_server()
+        try:
+            await t(client, router,
+                    [f"http://127.0.0.1:{dead_port}", urls[0]], [])
+        finally:
+            await client.close()
+            await router.shutdown()
+            for s in servers:
+                await s.close()
+
+    asyncio.run(runner())
+
+
+def test_router_honors_retry_after_and_retries_elsewhere():
+    """A 429 + Retry-After from one replica backs it off for the window
+    and the query is retried (idempotent) on a different replica."""
+    overloaded: list = []
+    healthy: list = []
+
+    def reject(n, request):
+        return 429, {"message": "full"}, {"Retry-After": "7"}
+
+    async def t(client, router, urls, _):
+        # force deterministic first pick: replica 0 (the 429er) is idle
+        r0 = next(r for r in router.balancer.replicas if r.url == urls[0])
+        r1 = next(r for r in router.balancer.replicas if r.url == urls[1])
+        r1.inflight = 1  # bias the first attempt onto r0
+        resp = await client.post("/queries.json", json={"q": 1})
+        assert resp.status == 200  # served by the healthy replica
+        assert len(overloaded) == 1 and len(healthy) == 1
+        assert r0.backoff_until > router._clock.monotonic() + 5.0
+        # while r0 backs off, traffic flows to r1 only
+        r1.inflight = 0
+        resp = await client.post("/queries.json", json={"q": 2})
+        assert resp.status == 200
+        assert len(overloaded) == 1  # r0 untouched inside its window
+
+    _run_router(t, [_replica_app(overloaded, reject),
+                    _replica_app(healthy)])
+
+
+def test_router_passes_through_orderly_429_when_no_alternate_replica():
+    """A planned overload retry that finds no other replica must serve
+    the replica's REAL 429 (pressure-derived Retry-After and all), not a
+    router-fabricated 503 — the replica did answer."""
+    def reject(n, request):
+        return 429, {"message": "full"}, {"Retry-After": "7"}
+
+    async def t(client, router, urls, _):
+        resp = await client.post("/queries.json", json={"q": 1})
+        assert resp.status == 429
+        assert resp.headers["Retry-After"] == "7"
+        assert router.unroutable_count == 0
+        assert router.retry_count == 0  # no second attempt ever started
+
+    _run_router(t, [_replica_app([], reject)])
+
+
+def test_router_passes_through_engine_500_with_error_pressure():
+    """A non-overload 5xx is the engine's answer: passed through (not
+    retried — it is not in the retryable set) while the replica's error
+    EWMA rises so the balancer stops preferring it."""
+    def boom(n, request):
+        return 500, {"message": "engine exploded"}, {}
+
+    async def t(client, router, urls, _):
+        resp = await client.post("/queries.json", json={"q": 1})
+        assert resp.status == 500
+        r0 = router.balancer.replicas[0]
+        assert r0.err_ewma > 0
+        assert r0.consecutive_errors == 0  # not a transport failure
+        assert router.retry_count == 0
+
+    _run_router(t, [_replica_app([], boom)])
+
+
+def test_router_retry_metric_counts_actual_retries_only():
+    """A failed FINAL attempt is not a retry: a single dead replica costs
+    zero retries (there is nowhere else to go), so during a full outage
+    pio_fleet_retries_total stays flat."""
+    async def t(client, router, urls, _):
+        resp = await client.post("/queries.json", json={"q": 1})
+        assert resp.status == 503
+        assert router.retry_count == 0
+        assert router.unroutable_count == 1
+
+    async def runner():
+        import socket
+
+        sock = socket.socket()
+        sock.bind(("127.0.0.1", 0))
+        dead_port = sock.getsockname()[1]
+        sock.close()
+        router = RouterServer(RouterConfig(
+            replicas=(f"http://127.0.0.1:{dead_port}",)))
+        client = TestClient(TestServer(router.make_app()))
+        await client.start_server()
+        try:
+            await t(client, router, [f"http://127.0.0.1:{dead_port}"], [])
+        finally:
+            await client.close()
+            await router.shutdown()
+
+    asyncio.run(runner())
+
+
+def test_router_503s_with_retry_after_when_unroutable():
+    async def t(client, router, urls, _):
+        for r in router.balancer.replicas:
+            r.healthy = False  # the watcher ejected everyone
+        resp = await client.post("/queries.json", json={"q": 1})
+        assert resp.status == 503
+        assert resp.headers["Retry-After"]
+        assert router.unroutable_count == 1
+
+    _run_router(t, [_replica_app([])])
+
+
+def test_router_draining_rejects_new_queries():
+    async def t(client, router, urls, _):
+        router._drain_state.begin()
+        resp = await client.post("/queries.json", json={"q": 1})
+        assert resp.status == 503
+        health = await (await client.get("/health")).json()
+        assert health["status"] == "draining"
+
+    _run_router(t, [_replica_app([])])
+
+
+def test_router_ab_routes_candidate_arm_by_hash():
+    """weight=1 + hash field: every query with an entity serves from the
+    candidate pool; per-arm assignment is visible on /experiment.json."""
+    control_hits: list = []
+    candidate_hits: list = []
+
+    async def t(client, router, urls, cand_urls):
+        for i in range(4):
+            resp = await client.post(
+                "/queries.json", json={"user": f"u{i}"})
+            assert resp.status == 200
+        assert len(candidate_hits) == 4 and len(control_hits) == 0
+        state = await (await client.get("/experiment.json")).json()
+        assert state["experiment"]["assigned"][CANDIDATE] == 4
+        # candidate pool ejected → the experiment must not cost answers:
+        # fall back to control
+        for r in router.candidate_balancer.replicas:
+            r.healthy = False
+        resp = await client.post("/queries.json", json={"user": "u9"})
+        assert resp.status == 200
+        assert len(control_hits) == 1
+
+    _run_router(t, [_replica_app(control_hits)],
+                [_replica_app(candidate_hits)],
+                experiment=Experiment(name="v2", mode="ab", weight=1.0,
+                                      hash_field="user"))
+
+
+def test_router_shadow_mirrors_compares_and_never_serves_candidate():
+    control_hits: list = []
+    candidate_hits: list = []
+
+    def control_answer(n, request):
+        return 200, {"scores": [1, 2]}, None
+
+    def candidate_answer(n, request):
+        # first mirror agrees, second drifts
+        return 200, ({"scores": [1, 2]} if n == 1
+                     else {"scores": [9]}), None
+
+    async def t(client, router, urls, cand_urls):
+        matched0 = SHADOW_MIRRORS.labels(outcome="matched").value
+        mismatched0 = SHADOW_MIRRORS.labels(outcome="mismatched").value
+        for i in range(2):
+            resp = await client.post(
+                "/queries.json", json={"user": f"u{i}"})
+            assert resp.status == 200
+            # the SERVED answer always comes from control
+            assert (await resp.json()) == {"scores": [1, 2]}
+        # the mirrors are fire-and-forget: await them explicitly
+        await asyncio.gather(*router._shadow_tasks)
+        assert len(control_hits) == 2 and len(candidate_hits) == 2
+        # mirrored hops carry the trace/client headers too
+        assert "X-PIO-Trace" in candidate_hits[0]["headers"]
+        assert SHADOW_MIRRORS.labels(outcome="matched").value \
+            == matched0 + 1
+        assert SHADOW_MIRRORS.labels(outcome="mismatched").value \
+            == mismatched0 + 1
+
+    _run_router(t, [_replica_app(control_hits, control_answer)],
+                [_replica_app(candidate_hits, candidate_answer)],
+                experiment=Experiment(name="v2", mode="shadow", weight=1.0,
+                                      hash_field="user"))
+
+
+def test_router_experiment_runtime_control():
+    async def t(client, router, urls, cand_urls):
+        # start guarded by the access key
+        resp = await client.post("/experiment", json={"name": "v2"})
+        assert resp.status == 401
+        resp = await client.post(
+            "/experiment?accessKey=sk",
+            json={"name": "v2", "mode": "shadow", "weight": 0.5,
+                  "hashField": "user"})
+        assert resp.status == 200
+        assert router.experiment.mode == "shadow"
+        resp = await client.post("/experiment?accessKey=sk",
+                                 json={"stop": True})
+        assert resp.status == 200
+        assert router.experiment is None
+
+    _run_router(t, [_replica_app([])], [_replica_app([])],
+                server_access_key="sk")
+
+
+# ---------------------------------------------------------------------------
+# rollout orchestrator (scripted HTTP + FakeClock, zero wall sleeps)
+# ---------------------------------------------------------------------------
+
+class _ScriptedFleet:
+    """Two fake replicas' /health + /reload + /rollback behaviors."""
+
+    def __init__(self, clk):
+        self.clk = clk
+        self.calls: list = []
+        self.instance = {"http://a": "a-v1", "http://b": "b-v1"}
+        self.last_reload: dict = {}
+        #: per-url reload behavior: "ok" | "smoke-409" | "probation-trip"
+        self.behavior = {"http://a": "ok", "http://b": "ok"}
+
+    def http(self, method, url, timeout=0):
+        base, _, _q = url.partition("?")
+        host = base.rsplit("/", 1)[0]
+        verb = base.rsplit("/", 1)[1]
+        self.calls.append((method, base))
+        if verb == "health":
+            # a probation-trip replica reports its auto-rollback on the
+            # first post-swap poll
+            if self.last_reload.get(host, {}).get("status") == "probation":
+                self.last_reload[host] = {
+                    "status": "rolled_back",
+                    "instanceId": f"{host[-1]}-v1",
+                    "reason": "serving breaker open"}
+                self.instance[host] = f"{host[-1]}-v1"
+            return 200, {"deployment": {
+                "instanceId": self.instance[host],
+                "lastReload": self.last_reload.get(host, {})}}
+        if verb == "reload":
+            b = self.behavior[host]
+            if b == "smoke-409":
+                return 409, {"message": "smoke gate rejected"}
+            self.instance[host] = f"{host[-1]}-v2"
+            self.last_reload[host] = (
+                {"status": "probation"} if b == "probation-trip"
+                else {"status": "ok"})
+            return 200, {"message": "Reloaded",
+                         "engineInstanceId": self.instance[host]}
+        if verb == "rollback":
+            if self.instance[host].endswith("-v2"):
+                self.instance[host] = f"{host[-1]}-v1"
+                return 200, {"message": "Rolled back",
+                             "engineInstanceId": self.instance[host]}
+            return 409, {"message": "no pinned previous instance"}
+        raise AssertionError(f"unexpected {url}")
+
+
+def test_rollout_happy_path_updates_all_in_order():
+    clk = FakeClock()
+    fleet = _ScriptedFleet(clk)
+    result = run_rollout(
+        RolloutConfig(replicas=("http://a", "http://b"), observe_sec=1.0,
+                      poll_sec=0.5),
+        http=fleet.http, clock=clk)
+    assert result.ok
+    assert result.updated == ["http://a", "http://b"]
+    assert fleet.instance == {"http://a": "a-v2", "http://b": "b-v2"}
+    reloads = [u for m, u in fleet.calls if u.endswith("/reload")]
+    assert reloads == ["http://a/reload", "http://b/reload"]  # sequence
+    assert clk.slept  # probation observed on the injected clock
+
+
+def test_rollout_halts_on_smoke_gate_and_rolls_back_updated():
+    """ISSUE 6 acceptance shape: replica B's smoke gate trips AFTER A
+    swapped — the rollout halts, A restores last-good, B never served the
+    new instance."""
+    clk = FakeClock()
+    fleet = _ScriptedFleet(clk)
+    fleet.behavior["http://b"] = "smoke-409"
+    result = run_rollout(
+        RolloutConfig(replicas=("http://a", "http://b"), observe_sec=0.5,
+                      poll_sec=0.5),
+        http=fleet.http, clock=clk)
+    assert not result.ok
+    assert result.halted_at == "http://b"
+    assert "smoke gate" in result.reason
+    assert result.updated == []  # nothing left on the new version
+    assert result.rolled_back == ["http://a"]
+    assert fleet.instance == {"http://a": "a-v1", "http://b": "b-v1"}
+
+
+def test_rollout_halts_on_probation_trip_and_rolls_back_fleet():
+    """Replica B swaps but trips probation under live traffic (its own
+    auto-rollback restores it); the orchestrator halts and rolls A back
+    too — the fleet never ends half-new."""
+    clk = FakeClock()
+    fleet = _ScriptedFleet(clk)
+    fleet.behavior["http://b"] = "probation-trip"
+    result = run_rollout(
+        RolloutConfig(replicas=("http://a", "http://b"), observe_sec=1.0,
+                      poll_sec=0.5),
+        http=fleet.http, clock=clk)
+    assert not result.ok
+    assert result.halted_at == "http://b"
+    assert "probation tripped" in result.reason
+    assert result.rolled_back == ["http://a"]
+    assert fleet.instance == {"http://a": "a-v1", "http://b": "b-v1"}
+
+
+def test_rollout_first_replica_409_touches_nothing_else():
+    clk = FakeClock()
+    fleet = _ScriptedFleet(clk)
+    fleet.behavior["http://a"] = "smoke-409"
+    result = run_rollout(
+        RolloutConfig(replicas=("http://a", "http://b")),
+        http=fleet.http, clock=clk)
+    assert not result.ok and result.halted_at == "http://a"
+    assert result.rolled_back == []
+    # replica B was never contacted
+    assert not any("http://b" in u for _m, u in fleet.calls)

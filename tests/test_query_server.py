@@ -627,6 +627,87 @@ def test_reload_probation_expires_and_releases_previous(deployed_env):
     asyncio.run(t())
 
 
+def test_rollback_endpoint_restores_pinned_previous(deployed_env):
+    """POST /rollback (the fleet orchestrator's halt path, docs/serving.md
+    "Fleet serving"): inside the probation window it restores the pinned
+    previous instance; once the pin is gone it answers 409. /health also
+    carries the engine version the fleet tier keys on."""
+    from incubator_predictionio_tpu.resilience.clock import FakeClock
+
+    storage, variant_path, x, y = deployed_env
+
+    async def t():
+        clk = FakeClock()
+        server = _probation_server(deployed_env, clk)
+        client = TestClient(TestServer(server.make_app()))
+        await client.start_server()
+        try:
+            health = await (await client.get("/health")).json()
+            dep = health["deployment"]
+            assert dep["engineId"] == "default"
+            assert dep["engineVersion"] == "1"
+            # no reload yet → nothing pinned → 409
+            resp = await client.post("/rollback?accessKey=sk")
+            assert resp.status == 409
+            # auth is enforced like /reload's
+            old = server.deployed
+            resp = await client.post("/reload?accessKey=sk")
+            assert resp.status == 200
+            new = server.deployed
+            resp = await client.post("/rollback")
+            assert resp.status == 401
+            # inside probation: rollback restores the previous instance
+            resp = await client.post("/rollback?accessKey=sk")
+            assert resp.status == 200
+            body = await resp.json()
+            assert body["engineInstanceId"] == old.instance.id
+            assert server.deployed is old
+            assert server.batcher.deployed is old
+            assert server._previous is None
+            health = await (await client.get("/health")).json()
+            dep = health["deployment"]
+            assert dep["lastReload"]["status"] == "rolled_back"
+            assert dep["lastReload"]["rolledBackFrom"] == new.instance.id
+            # the restored instance serves live
+            resp = await client.post(
+                "/queries.json", json={"features": list(map(float, x[0]))})
+            assert resp.status == 200
+            assert "label" in (await resp.json())
+            # the pin was consumed: a second rollback has nothing to do
+            resp = await client.post("/rollback?accessKey=sk")
+            assert resp.status == 409
+        finally:
+            await client.close()
+            await server.shutdown()
+
+    asyncio.run(t())
+
+
+def test_rollback_endpoint_409_after_probation_expiry(deployed_env):
+    from incubator_predictionio_tpu.resilience.clock import FakeClock
+
+    storage, variant_path, x, y = deployed_env
+
+    async def t():
+        clk = FakeClock()
+        server = _probation_server(deployed_env, clk)
+        client = TestClient(TestServer(server.make_app()))
+        await client.start_server()
+        try:
+            resp = await client.post("/reload?accessKey=sk")
+            assert resp.status == 200
+            new = server.deployed
+            clk.advance(30.1)  # probation over: the pin is released
+            resp = await client.post("/rollback?accessKey=sk")
+            assert resp.status == 409
+            assert server.deployed is new
+        finally:
+            await client.close()
+            await server.shutdown()
+
+    asyncio.run(t())
+
+
 def test_reload_loads_beside_live_instance(deployed_env):
     """The crash-mid-reload guarantee, made observable: while the new
     instance is still loading, the OLD instance keeps answering queries —
