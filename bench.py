@@ -550,6 +550,115 @@ def _pallas_parity_check(model) -> bool:
 
 
 # ---------------------------------------------------------------------------
+# 5b. two-stage retrieval at catalog scale (docs/serving.md)
+# ---------------------------------------------------------------------------
+
+def bench_retrieval_scale(ctx, peaks, device) -> dict:
+    """Exact full-catalog top-k vs the two-stage (IVF coarse prune + exact
+    rerank) path across catalog sizes × ``nprobe`` — the qps-vs-recall@10
+    curve that justifies PIO_RETRIEVAL_MODE=two_stage for big catalogs.
+
+    Catalogs are mixture-of-concepts synthetic towers (√N concepts,
+    σ=0.5) — the clustered geometry trained MF factors actually have, and
+    the regime the recall floor is specified over (an iid-gaussian catalog
+    has no structure to prune by; see tests/test_two_stage_retrieval.py).
+    The exact lane is the oracle: recall@10 is measured against ITS answers
+    on a held-out query set, and the headline speedup is only quoted at
+    operating points with recall ≥ 0.95."""
+    from incubator_predictionio_tpu.models.two_tower import (
+        TwoTowerConfig,
+        TwoTowerModel,
+        TwoTowerMF,
+    )
+
+    rank = 32
+    n_users = 10_000
+    batch, num = 16, 10
+    n_eval = 256            # oracle/recall query users
+    sizes = (100_000, 250_000) if SMALL else (100_000, 1_000_000)
+    nprobes = (8, 16, 32, 64)
+    prev_env = {k: os.environ.get(k) for k in
+                ("PIO_RETRIEVAL_MODE", "PIO_RETRIEVAL_NPROBE")}
+    points = []
+    headline = {}
+    try:
+        for n_items in sizes:
+            rng = np.random.default_rng(11)
+            n_concepts = max(64, int(round(np.sqrt(n_items))))
+            concepts = rng.standard_normal((n_concepts, rank)).astype(np.float32)
+            item = concepts[rng.integers(0, n_concepts, n_items)] \
+                + 0.5 * rng.standard_normal((n_items, rank)).astype(np.float32)
+            user = concepts[rng.integers(0, n_concepts, n_users)] \
+                + 0.5 * rng.standard_normal((n_users, rank)).astype(np.float32)
+            model = TwoTowerModel(
+                user_emb=user, item_emb=item,
+                user_bias=(rng.standard_normal(n_users) * 0.1).astype(np.float32),
+                item_bias=(rng.standard_normal(n_items) * 0.1).astype(np.float32),
+                mean=3.0, config=TwoTowerConfig(rank=rank))
+            qusers = rng.integers(0, n_users, (64, batch)).astype(np.int32)
+            eusers = rng.integers(0, n_users, (n_eval // batch, batch)).astype(np.int32)
+
+            def lane_qps(min_sec=2.0):
+                # warm one batch, then timed closed-loop batches
+                TwoTowerMF.recommend_batch(model, qusers[0], num)
+                done = 0
+                t0 = time.perf_counter()
+                while True:
+                    TwoTowerMF.recommend_batch(
+                        model, qusers[done % len(qusers)], num)
+                    done += 1
+                    dt = time.perf_counter() - t0
+                    if dt >= min_sec and done >= 8:
+                        return done * batch / dt
+
+            os.environ["PIO_RETRIEVAL_MODE"] = "exact"
+            model.prepare_for_serving(serve_k=num)
+            exact_qps = lane_qps()
+            oracle = [TwoTowerMF.recommend_batch(model, row, num)[0]
+                      for row in eusers]
+            os.environ["PIO_RETRIEVAL_MODE"] = "two_stage"
+            model.prepare_for_serving(serve_k=num)  # builds the IVF index
+            build_sec = model._ivf.build_seconds
+            for nprobe in nprobes:
+                os.environ["PIO_RETRIEVAL_NPROBE"] = str(nprobe)
+                got = [TwoTowerMF.recommend_batch(model, row, num)[0]
+                       for row in eusers]
+                recall = float(np.mean([
+                    len(set(o[r]) & set(g[r])) / num
+                    for o, g in zip(oracle, got) for r in range(batch)]))
+                qps = lane_qps()
+                points.append({
+                    "n_items": n_items, "nprobe": nprobe,
+                    "n_partitions": model._ivf.n_partitions,
+                    "qps": round(qps, 1), "recall_at_10": round(recall, 4),
+                    "exact_qps": round(exact_qps, 1),
+                    "speedup_vs_exact": round(qps / exact_qps, 1),
+                })
+                _log(f"retrieval_scale n={n_items} nprobe={nprobe}: "
+                     f"{qps:.0f} qps vs exact {exact_qps:.0f} "
+                     f"(recall@10 {recall:.3f})")
+            os.environ.pop("PIO_RETRIEVAL_NPROBE", None)
+            good = [p for p in points
+                    if p["n_items"] == n_items and p["recall_at_10"] >= 0.95]
+            headline[str(n_items)] = {
+                "exact_qps": round(exact_qps, 1),
+                "index_build_sec": round(build_sec, 1),
+                **({"best_qps": max(p["qps"] for p in good),
+                    "best_speedup": max(p["speedup_vs_exact"] for p in good),
+                    "recall_floor": 0.95} if good else
+                   {"best_speedup": None}),
+            }
+    finally:
+        for k, v in prev_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return {"points": points, "headline": headline,
+            "batch": batch, "num": num, "rank": rank}
+
+
+# ---------------------------------------------------------------------------
 # 6. sequential transformer (the long-context flagship)
 # ---------------------------------------------------------------------------
 
@@ -1451,8 +1560,8 @@ def build_result_line(configs: dict, device_info: dict,
 # (they bench the event servers' durable write paths), so they survive a
 # dead tunnel on CPU
 CONFIG_NAMES = ["recommendation", "recommendation_scaled", "classification",
-                "similarproduct", "ecommerce_retrieval", "sequential",
-                "serving", "overload", "fleet", "ingestion",
+                "similarproduct", "ecommerce_retrieval", "retrieval_scale",
+                "sequential", "serving", "overload", "fleet", "ingestion",
                 "ingest_durability"]
 # "fleet" is device-free too: its replicas are CPU subprocesses (a fleet
 # on one host) — the scenario measures the ROUTER's horizontal scaling,
@@ -1468,6 +1577,7 @@ def _build_suite(ctx, peaks, device) -> dict:
         "classification": lambda: bench_classification(ctx, peaks),
         "similarproduct": lambda: bench_similarproduct(ctx, peaks),
         "ecommerce_retrieval": lambda: bench_ecommerce_retrieval(ctx, peaks, device),
+        "retrieval_scale": lambda: bench_retrieval_scale(ctx, peaks, device),
         "sequential": lambda: bench_sequential(ctx, peaks, device),
         "serving": lambda: bench_serving(ctx),
         "overload": lambda: bench_overload(ctx),

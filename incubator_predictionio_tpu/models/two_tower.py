@@ -26,6 +26,7 @@ zero-weight rows.
 from __future__ import annotations
 
 import dataclasses
+import threading
 from functools import partial
 from typing import Optional
 
@@ -127,6 +128,10 @@ class TwoTowerModel:
     _device_users = None  # (user_emb bf16, user_bias) — gathered inside jit
     _host_items = None  # small-catalog host fast path (item_embᵀ, item_bias)
     _serve_k = 0  # static top-k the serving executables are compiled for
+    # two-stage retrieval index (serving/ann.py). Unlike the device handles
+    # it IS host numpy and rides default pickling, so a persisted model
+    # redeploys without re-clustering the catalog
+    _ivf = None
 
     @property
     def device_resident(self) -> bool:
@@ -157,7 +162,7 @@ class TwoTowerModel:
 
     def prepare_for_serving(
         self, quantize: bool = False, serve_k: int = 128,
-        host_max_elements: Optional[int] = None,
+        host_max_elements: Optional[int] = None, build_index: bool = True,
     ) -> "TwoTowerModel":
         """Make serving state resident for the query hot path.
 
@@ -171,7 +176,58 @@ class TwoTowerModel:
 
         ``serve_k`` fixes the static top-k the device executables compute:
         queries asking ``num ≤ serve_k`` share ONE executable per batch bucket
-        (results sliced host-side), so per-query ``num`` never recompiles."""
+        (results sliced host-side), so per-query ``num`` never recompiles.
+
+        When two-stage retrieval is enabled for this catalog
+        (``PIO_RETRIEVAL_MODE``, serving/ann.py) this also builds — or
+        reuses, when a persisted index's build key still matches — the IVF
+        partition the coarse stage probes; the exact buffers above stay
+        resident as the fallback and recall oracle. ``build_index=False``
+        opts out — for callers (the ecommerce/similarity templates) whose
+        serving path never goes through :meth:`TwoTowerMF.recommend_batch`
+        and would pay the clustering for nothing."""
+        self._prepare_scoring(quantize, serve_k, host_max_elements)
+        if build_index:
+            self._prepare_index()
+        return self
+
+    def _prepare_index(self) -> None:
+        """Build/reuse the two-stage IVF partition (serving/ann.py)."""
+        from incubator_predictionio_tpu.serving import ann
+
+        if not ann.two_stage_enabled(self.n_items):
+            # keep any persisted index around: flipping the mode knob back
+            # shouldn't force a re-cluster on the next prepare
+            return
+        key = ann.build_key(self.n_items)
+        if self._ivf is not None and self._ivf.matches(key):
+            if not self._ivf.hydrated:
+                # persisted slim (clustering only): one O(N) gather rebuilds
+                # the member-order rerank tables — the k-means is skipped
+                self._ivf.rehydrate(*self._host_item_table())
+            return
+        self._ivf = ann.build_ivf(*self._host_item_table(), key=key)
+
+    def _host_item_table(self) -> tuple[np.ndarray, np.ndarray]:
+        """Host ``(item_emb, item_bias)`` WITHOUT materializing the full
+        host views: ``ensure_host`` would also pull the user table and set
+        ``user_emb``, flipping a device-gather model off its
+        device-to-device serving-prep fast path for good. The index build
+        only needs the item side."""
+        if self.item_emb is not None:
+            return (np.asarray(self.item_emb, np.float32),
+                    np.asarray(self.item_bias, np.float32))
+        k = self.config.rank
+        host_ie = np.asarray(jax.device_get(self._tables["ie"]))
+        return (np.ascontiguousarray(host_ie[: self._n_items, :k],
+                                     dtype=np.float32),
+                np.ascontiguousarray(host_ie[: self._n_items, k],
+                                     dtype=np.float32))
+
+    def _prepare_scoring(
+        self, quantize: bool = False, serve_k: int = 128,
+        host_max_elements: Optional[int] = None,
+    ) -> "TwoTowerModel":
         self._serve_k = min(serve_k, self.n_items)
         # re-preparation switches paths cleanly: clear every serving buffer
         # first (a stale _host_items would shadow a requested device path)
@@ -253,14 +309,29 @@ class TwoTowerModel:
         nothing compiles there)."""
         if (self._device_users is None and self._host_items is None):
             self.prepare_for_serving()
+        from incubator_predictionio_tpu.serving import ann
+
+        if self._ivf is not None and ann.two_stage_enabled(self.n_items):
+            # prime the two-stage path too: no XLA involved (the coarse +
+            # rerank stages are host numpy), but the first dispatch faults
+            # the member-order tables into memory and spins up the BLAS
+            # thread pool — deploy-time cost, not the first live query's
+            TwoTowerMF.recommend_batch(
+                self, np.zeros(1, np.int32),
+                min(max(self._serve_k, 1), self.n_items))
         if self._host_items is not None:
             return 0
         n = 0
         for b in SERVE_BUCKETS:
             if b > max(1, max_batch):
                 break
+            # _force_exact: with two-stage retrieval active these warmup
+            # dispatches would route to the (host-side) pruned path and the
+            # exact executables — the two-stage FALLBACK — would compile on
+            # the first live query that needs them
             TwoTowerMF.recommend_batch(
-                self, np.zeros(b, np.int32), self._serve_k or 1
+                self, np.zeros(b, np.int32), self._serve_k or 1,
+                _force_exact=True,
             )
             # the rule-filtered variant ([b, n] row mask) is a distinct
             # executable — warm it too so the first filtered live batch
@@ -272,6 +343,7 @@ class TwoTowerModel:
                 TwoTowerMF.recommend_batch(
                     self, np.zeros(b, np.int32), self._serve_k or 1,
                     row_mask=np.zeros((b, self.n_items), np.float32),
+                    _force_exact=True,
                 )
             n += 1
         return n
@@ -294,8 +366,13 @@ class TwoTowerModel:
             path = "host-numpy"
         else:
             path = "unprepared"
+        from incubator_predictionio_tpu.serving import ann
+
+        two_stage = self._ivf is not None and ann.two_stage_enabled(self.n_items)
         return {"path": path, "serve_k": self._serve_k,
-                "catalog_rows": self.n_items}
+                "catalog_rows": self.n_items,
+                "retrieval_mode": "two_stage" if two_stage else "exact",
+                "index": self._ivf.stats() if self._ivf is not None else None}
 
 
 class TwoTowerMF:
@@ -545,6 +622,7 @@ class TwoTowerMF:
         num: int,
         exclude: Optional[np.ndarray] = None,
         row_mask: Optional[np.ndarray] = None,
+        _force_exact: bool = False,
     ) -> tuple[np.ndarray, np.ndarray]:
         """Vectorized top-k over the full catalog for a batch of users.
 
@@ -563,6 +641,11 @@ class TwoTowerMF:
         from incubator_predictionio_tpu.utils import jitstats
 
         num = min(num, model.n_items)  # k cannot exceed the catalog
+        if num <= 0:
+            # degenerate query — every path (serial, grouped, device)
+            # answers empty; never hand a non-positive k to top-k
+            return (np.zeros((len(user_idx), 0), np.int64),
+                    np.zeros((len(user_idx), 0), np.float32))
         if (model._device_items is None and model._device_items_q is None
                 and model._host_items is None):
             model.prepare_for_serving()
@@ -570,6 +653,16 @@ class TwoTowerMF:
             raise ValueError(
                 f"row_mask shape {row_mask.shape} != "
                 f"(batch, n_items) {(len(user_idx), model.n_items)}")
+        if model._ivf is not None and not _force_exact:
+            from incubator_predictionio_tpu.serving import ann
+
+            if ann.two_stage_enabled(model.n_items):
+                res = _recommend_batch_two_stage(
+                    model, user_idx, num, exclude, row_mask)
+                if res is not None:
+                    return res
+                # fewer candidates than num survived the probe — the exact
+                # path below answers (pio_retrieval_fallback_total counts it)
         if model._host_items is not None:
             return _recommend_batch_host(model, user_idx, num, exclude, row_mask)
         b = len(user_idx)
@@ -593,7 +686,7 @@ class TwoTowerMF:
             # pad rows to the batch bucket and columns to the (quantized)
             # catalog padding; padded columns are already -inf in base_mask
             n_cols = int(mask.shape[0])
-            rm = np.zeros((bucket, n_cols), np.float32)
+            rm = _row_mask_pad_buffer(bucket, n_cols)
             rm[:b, : row_mask.shape[1]] = row_mask
             rmask = jnp.asarray(rm)
         jitstats.record((
@@ -614,6 +707,54 @@ class TwoTowerMF:
         # np.asarray costs a full round trip on remote-attached devices
         idx_h, scores_h = jax.device_get((idx, scores))
         return idx_h[:b, :num], scores_h[:b, :num]
+
+
+#: Per-thread [bucket, n_cols] row-mask pad buffers: the device dispatch
+#: consumes the padded mask synchronously (recommend_batch device_gets its
+#: results before returning), so each serving thread can recycle one scratch
+#: buffer per shape instead of allocating bucket × N × 4 bytes per dispatch.
+#: Thread-local because serving overlaps batches across threads
+#: (serving_thread_safe / max_in_flight).
+_ROW_MASK_SCRATCH = threading.local()
+
+
+def _row_mask_pad_buffer(bucket: int, n_cols: int) -> np.ndarray:
+    """A zeroed, reusable ``[bucket, n_cols]`` f32 pad buffer."""
+    cache = getattr(_ROW_MASK_SCRATCH, "cache", None)
+    if cache is None:
+        cache = _ROW_MASK_SCRATCH.cache = {}
+    buf = cache.get((bucket, n_cols))
+    if buf is None:
+        if len(cache) >= 16:  # many models/shapes in one process: tests
+            cache.clear()
+        buf = cache[(bucket, n_cols)] = np.zeros((bucket, n_cols), np.float32)
+    else:
+        buf.fill(0.0)
+    return buf
+
+
+def _recommend_batch_two_stage(
+    model: TwoTowerModel,
+    user_idx: np.ndarray,
+    num: int,
+    exclude: Optional[np.ndarray] = None,
+    row_mask: Optional[np.ndarray] = None,
+) -> Optional[tuple[np.ndarray, np.ndarray]]:
+    """Coarse IVF pruning + exact rerank (serving/ann.py): centroid scores
+    pick top-nprobe partitions per user, only their members are scored with
+    the exact math, and ``exclude``/``row_mask`` land on the rerank scores
+    in candidate-index space after the gather. Returns None when the probe
+    can't cover ``num`` candidates — the caller's exact path answers."""
+    if not model._ivf.hydrated:
+        # persisted slim and this model never ran _prepare_index (e.g. a
+        # build_index=False prepare): rebuild the rerank tables lazily
+        model._ivf.rehydrate(*model._host_item_table())
+    model.ensure_host()  # no-op unless the towers are device-resident
+    uidx = np.asarray(user_idx, np.int64)
+    q = np.asarray(model.user_emb, np.float32)[uidx]
+    ub = np.asarray(model.user_bias, np.float32)[uidx]
+    return model._ivf.search(
+        q, ub, model.mean, num, exclude=exclude, row_mask=row_mask)
 
 
 def _recommend_batch_host(

@@ -692,6 +692,7 @@ def load_deployed_engine(
     config: ServerConfig,
     storage: Optional[Storage] = None,
     ctx: Optional[MeshContext] = None,
+    warmup: bool = True,
 ) -> DeployedEngine:
     """variant → engine factory → latest COMPLETED instance → live models
     (createServerActorWithEngine, CreateServer.scala:187-246)."""
@@ -721,7 +722,7 @@ def load_deployed_engine(
     logger.info("deployed engine instance %s (trained %s)", instance.id,
                 instance.start_time)
     return DeployedEngine(engine, engine_params, instance, models,
-                          max_batch=config.max_batch,
+                          max_batch=config.max_batch, warmup=warmup,
                           algo_deadline=config.algo_deadline_sec,
                           breaker_threshold=config.algo_breaker_threshold,
                           breaker_reset=config.algo_breaker_reset_sec)

@@ -18,9 +18,18 @@ event-store reads. This package is the batched replacement:
   expiry deterministically. ``PIO_SERVING_CONSTRAINT_TTL_MS=0`` restores
   the reference's read-per-query semantics.
 
-See docs/serving.md ("Batched serving & mask compilation").
+- :mod:`ann <incubator_predictionio_tpu.serving.ann>` — two-stage
+  retrieval for big catalogs: a trained IVF partition over the item
+  embeddings prunes each query to the top-``nprobe`` partitions' members,
+  then the exact scoring math reranks only the gathered candidates
+  (``PIO_RETRIEVAL_*`` knobs; the full-catalog path stays the recall
+  oracle).
+
+See docs/serving.md ("Batched serving & mask compilation",
+"Two-stage retrieval").
 """
 
+from incubator_predictionio_tpu.serving.ann import IVFIndex, build_ivf
 from incubator_predictionio_tpu.serving.cache import TTLCache, constraint_ttl_sec
 from incubator_predictionio_tpu.serving.masks import (
     CategoryIndex,
@@ -28,14 +37,17 @@ from incubator_predictionio_tpu.serving.masks import (
     ban_rows,
     whitelist_vec,
 )
-from incubator_predictionio_tpu.serving.topk import grouped_topk
+from incubator_predictionio_tpu.serving.topk import grouped_topk, topk_row
 
 __all__ = [
     "CategoryIndex",
     "HasCategoryIndex",
+    "IVFIndex",
     "TTLCache",
     "ban_rows",
+    "build_ivf",
     "constraint_ttl_sec",
     "grouped_topk",
+    "topk_row",
     "whitelist_vec",
 ]

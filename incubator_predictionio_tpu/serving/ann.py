@@ -1,0 +1,443 @@
+"""Two-stage retrieval: trained IVF coarse pruning + exact candidate rerank.
+
+Exact serving scores every query against the whole catalog — an O(catalog)
+``[B, N]`` matmul per batch that stops being "as fast as the hardware
+allows" at the 10M-item shapes ALX (arxiv 2112.02194) targets. This module
+is the coarse-to-fine answer:
+
+- **Build** (deploy time, :func:`build_ivf`): k-means over the item
+  embeddings *augmented with the item bias as an extra coordinate* (the
+  query side implicitly carries a 1.0 there, so a centroid's coarse score
+  ``q·c_emb + c_bias`` is an unbiased estimate of its members' exact
+  scores — popular-but-orthogonal items don't fall out of the probe set).
+  Members are laid out contiguously per partition (CSR: ``member_ids`` +
+  ``offsets``), so gathering a partition's candidates is a slice, never a
+  fancy-index gather.
+- **Coarse stage**: score the ``[C]`` centroids per query and keep the
+  top-``nprobe`` partitions — pruning the catalog to a few percent.
+- **Rerank stage**: the surviving candidates are scored with the *exact*
+  serving math (fp32 rows + bias, optionally int8 rows through the same
+  symmetric row quantization the Pallas kernel uses —
+  :func:`~incubator_predictionio_tpu.ops.retrieval.quantize_rows`), then
+  the shared serial-parity top-k chain picks the result.
+
+Rule filters (``exclude`` / ``row_mask``) are applied **in candidate-index
+space after the gather**, as -inf on the exact rerank scores — a filtered
+candidate can therefore never displace an unfiltered one, exactly like the
+full-catalog path. The exact path itself stays untouched as the recall
+oracle; tests assert a recall@k floor against it
+(tests/test_two_stage_retrieval.py).
+
+Mode selection is env-driven (``PIO_RETRIEVAL_MODE`` = ``exact`` |
+``two_stage`` | ``auto``; auto keeps catalogs under
+``PIO_RETRIEVAL_MIN_ITEMS`` on the exact path so small templates keep
+bitwise parity). See docs/serving.md ("Two-stage retrieval").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from incubator_predictionio_tpu.obs.metrics import REGISTRY
+from incubator_predictionio_tpu.serving.topk import topk_row
+
+#: Rows per chunk for the full-catalog assignment pass at build time — keeps
+#: the [chunk, C] distance buffer bounded regardless of catalog size.
+ASSIGN_CHUNK = 131_072
+
+COARSE_SEC = REGISTRY.histogram(
+    "pio_retrieval_coarse_seconds",
+    "Two-stage retrieval: centroid scoring + partition selection per batch")
+RERANK_SEC = REGISTRY.histogram(
+    "pio_retrieval_rerank_seconds",
+    "Two-stage retrieval: exact candidate rerank per batch")
+CANDIDATES = REGISTRY.histogram(
+    "pio_retrieval_candidates",
+    "Candidates gathered per query by the coarse stage",
+    buckets=(64, 256, 1024, 4096, 16384, 65536, 262144, 1048576))
+TWO_STAGE_BATCHES = REGISTRY.counter(
+    "pio_retrieval_two_stage_total",
+    "Batches served through the two-stage (pruned) path")
+FALLBACKS = REGISTRY.counter(
+    "pio_retrieval_fallback_total",
+    "Two-stage-eligible batches that fell back to the exact path "
+    "(probed partitions held fewer raw — or post-rule-filter finite — "
+    "candidates than the requested top-k)")
+
+
+# -- env knobs ---------------------------------------------------------------
+
+def retrieval_mode() -> str:
+    """``PIO_RETRIEVAL_MODE``: ``exact`` | ``two_stage`` | ``auto``."""
+    mode = os.environ.get("PIO_RETRIEVAL_MODE", "auto").strip().lower()
+    if mode not in ("exact", "two_stage", "auto"):
+        raise ValueError(
+            f"PIO_RETRIEVAL_MODE={mode!r} (want exact|two_stage|auto)")
+    return mode
+
+
+def min_items() -> int:
+    return int(os.environ.get("PIO_RETRIEVAL_MIN_ITEMS", "100000"))
+
+
+def two_stage_enabled(n_items: int) -> bool:
+    """Whether a catalog of ``n_items`` should serve two-stage right now."""
+    mode = retrieval_mode()
+    if mode == "two_stage":
+        return True
+    return mode == "auto" and n_items >= min_items()
+
+
+def default_partitions(n_items: int) -> int:
+    """√N partitions, clamped — the classic IVF sizing."""
+    if n_items <= 0:
+        return 1
+    c = int(round(np.sqrt(n_items)))
+    return max(1, min(c, max(1, n_items // 4), 65_536))
+
+
+def resolved_partitions(n_items: int) -> int:
+    c = int(os.environ.get("PIO_RETRIEVAL_PARTITIONS", "0"))
+    return c if c > 0 else default_partitions(n_items)
+
+
+def resolved_nprobe(n_partitions: int) -> int:
+    """√C probes by default, clamped to the partition count."""
+    p = int(os.environ.get("PIO_RETRIEVAL_NPROBE", "0"))
+    if p <= 0:
+        p = max(1, int(round(np.sqrt(n_partitions))))
+    return min(p, n_partitions)
+
+
+def quantize_enabled() -> bool:
+    return os.environ.get("PIO_RETRIEVAL_QUANTIZE", "0") == "1"
+
+
+def build_key(n_items: int) -> dict:
+    """Everything that invalidates a built index when it changes — a
+    persisted index whose key still matches is reused instead of rebuilt."""
+    return {
+        "n_items": n_items,
+        "n_partitions": resolved_partitions(n_items),
+        "quantize": quantize_enabled(),
+        "kmeans_iters": int(os.environ.get("PIO_RETRIEVAL_KMEANS_ITERS", "6")),
+        "train_sample": int(
+            os.environ.get("PIO_RETRIEVAL_TRAIN_SAMPLE", "65536")),
+        "seed": int(os.environ.get("PIO_RETRIEVAL_SEED", "0")),
+    }
+
+
+# -- the index ---------------------------------------------------------------
+
+@dataclasses.dataclass
+class IVFIndex:
+    """Trained partition of the catalog + member-order rerank tables.
+
+    ``centroids`` is ``[C, D+1]`` — the last column is the partition's mean
+    item bias (see the module docstring). Members are stored sorted by
+    partition: ``member_ids[offsets[p]:offsets[p+1]]`` are partition ``p``'s
+    catalog indices, and ``emb_m``/``bias_m`` (or ``emb_q``/``scales_m``
+    when quantized) hold the matching rows contiguously, so the rerank
+    reads each probed partition as one slice. Read-only after build —
+    serving threads share it without locks. Pickles with the model (host
+    numpy only), so a persisted model redeploys without re-clustering.
+    """
+
+    centroids: np.ndarray        # [C, D+1] f32 (last col = mean member bias)
+    member_ids: np.ndarray       # [N] int32, partition-sorted catalog indices
+    offsets: np.ndarray          # [C+1] int64 partition boundaries
+    bias_m: np.ndarray           # [N] f32 item bias in member order
+    key: dict                    # build_key() this index was built under
+    emb_m: Optional[np.ndarray] = None     # [N, D] f32 (fp32 rerank mode)
+    emb_q: Optional[np.ndarray] = None     # [N, D] int8 (quantized mode)
+    scales_m: Optional[np.ndarray] = None  # [N] f32 dequant scales
+    build_seconds: float = 0.0
+
+    @property
+    def n_partitions(self) -> int:
+        return self.centroids.shape[0]
+
+    @property
+    def n_items(self) -> int:
+        return self.member_ids.shape[0]
+
+    @property
+    def quantized(self) -> bool:
+        return self.emb_q is not None
+
+    def matches(self, key: dict) -> bool:
+        return self.key == key
+
+    # -- persistence -------------------------------------------------------
+    #
+    # The member-order rerank tables duplicate the catalog (emb_m is a full
+    # fp32 copy of item_emb) — at the 10M-item scales two-stage targets that
+    # would DOUBLE the persisted model artifact and every deploy transfer.
+    # Only the clustering (centroids/member_ids/offsets/key — the part that
+    # is expensive to recompute) pickles; load rehydrates the tables with
+    # one O(N) gather from arrays the model blob already carries.
+
+    def __post_init__(self):
+        self._rehydrate_lock = threading.Lock()
+
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state.pop("_rehydrate_lock", None)
+        for k in ("emb_m", "emb_q", "scales_m", "bias_m"):
+            state[k] = None
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._rehydrate_lock = threading.Lock()
+
+    @property
+    def hydrated(self) -> bool:
+        """Whether the rerank tables are resident (False right after
+        unpickling — :meth:`rehydrate` before :meth:`search`)."""
+        return self.bias_m is not None and (
+            self.emb_m is not None or self.emb_q is not None)
+
+    def rehydrate(self, item_emb: np.ndarray,
+                  item_bias: np.ndarray) -> "IVFIndex":
+        """Rebuild the member-order rerank tables after unpickling.
+
+        Lock-guarded: a runtime mode flip (exact → two_stage) can land the
+        first rehydration on overlapped serving threads. ``bias_m`` is
+        assigned LAST — :attr:`hydrated` requires it, so a concurrent
+        reader can never observe a half-built table set."""
+        if self.hydrated:
+            return self
+        with self._rehydrate_lock:
+            if self.hydrated:
+                return self
+            order = self.member_ids.astype(np.int64)
+            emb_m = np.ascontiguousarray(
+                np.asarray(item_emb, np.float32)[order])
+            bias_m = np.ascontiguousarray(
+                np.asarray(item_bias, np.float32)[order])
+            if self.key.get("quantize"):
+                from incubator_predictionio_tpu.ops.retrieval import (
+                    quantize_rows,
+                )
+
+                self.emb_q, self.scales_m = quantize_rows(emb_m)
+            else:
+                self.emb_m = emb_m
+            self.bias_m = bias_m
+        return self
+
+    def stats(self) -> dict:
+        """Partition-shape summary for ``pio-tpu index`` / status pages."""
+        sizes = np.diff(self.offsets)
+        mean = float(sizes.mean()) if len(sizes) else 0.0
+        nbytes = sum(
+            a.nbytes for a in (
+                self.centroids, self.member_ids, self.offsets, self.bias_m,
+                self.emb_m, self.emb_q, self.scales_m)
+            if a is not None)
+        return {
+            "n_partitions": int(self.n_partitions),
+            "n_items": int(self.n_items),
+            "partition_size_min": int(sizes.min()) if len(sizes) else 0,
+            "partition_size_mean": round(mean, 1),
+            "partition_size_max": int(sizes.max()) if len(sizes) else 0,
+            "size_skew": round(float(sizes.max()) / mean, 2) if mean else 0.0,
+            "empty_partitions": int((sizes == 0).sum()),
+            "quantized": self.quantized,
+            "default_nprobe": resolved_nprobe(self.n_partitions),
+            "index_bytes": int(nbytes),
+            "build_seconds": round(self.build_seconds, 2),
+        }
+
+    # -- search -----------------------------------------------------------
+
+    def probe(self, q: np.ndarray, nprobe: int) -> np.ndarray:
+        """Top-``nprobe`` partition ids per query row (``[B, nprobe]``)."""
+        coarse = q @ self.centroids[:, :-1].T + self.centroids[:, -1][None, :]
+        if nprobe >= self.n_partitions:
+            return np.tile(np.arange(self.n_partitions), (len(q), 1))
+        return np.argpartition(-coarse, nprobe - 1, axis=1)[:, :nprobe]
+
+    def candidate_ids(self, qrow: np.ndarray, nprobe: int) -> np.ndarray:
+        """One query's gathered candidate set (tests / inspection)."""
+        parts = np.sort(self.probe(qrow[None, :], nprobe)[0])
+        return np.concatenate([
+            self.member_ids[self.offsets[p]:self.offsets[p + 1]]
+            for p in parts]) if len(parts) else np.empty(0, np.int32)
+
+    def search(
+        self,
+        q: np.ndarray,               # [B, D] f32 user vectors
+        user_bias: np.ndarray,       # [B] f32
+        mean: float,
+        num: int,
+        nprobe: Optional[int] = None,
+        exclude: Optional[np.ndarray] = None,
+        row_mask: Optional[np.ndarray] = None,
+    ) -> Optional[tuple[np.ndarray, np.ndarray]]:
+        """Two-stage top-``num``: returns ``(idx [B, num] int64, scores
+        [B, num] f32)`` with the exact path's score semantics, or ``None``
+        when some row's probed partitions hold fewer than ``num`` raw
+        candidates — or fewer than ``num`` candidates that survive the
+        rule filters with a finite score (the caller falls back to the
+        exact path, which sees the whole catalog — the pruned path never
+        returns a short result, and never serves a masked item in place
+        of an unmasked one the probe missed).
+
+        ``exclude``/``row_mask`` are in catalog-index space and are applied
+        to the exact rerank scores AFTER the gather (candidate-index
+        space): masked candidates score -inf and can only fill trailing
+        slots once every unmasked candidate is placed, mirroring the
+        full-catalog mask semantics.
+        """
+        b = q.shape[0]
+        if num <= 0:
+            return (np.zeros((b, 0), np.int64), np.zeros((b, 0), np.float32))
+        if b == 0:
+            return (np.zeros((0, num), np.int64), np.zeros((0, num), np.float32))
+        nprobe = resolved_nprobe(self.n_partitions) if nprobe is None \
+            else min(max(1, nprobe), self.n_partitions)
+        t0 = time.perf_counter()
+        probe = self.probe(q, nprobe)
+        counts = np.diff(self.offsets)[probe].sum(axis=1)
+        COARSE_SEC.observe(time.perf_counter() - t0)
+        if int(counts.min()) < num:
+            FALLBACKS.inc()
+            return None
+        # exclude lands per row via searchsorted over the SORTED exclude set
+        # — O(cnt log E) in candidate space; an n_items-sized lookup table
+        # would put O(catalog) allocation back on the path built to avoid it
+        excl_sorted = None
+        if exclude is not None and len(exclude):
+            excl_sorted = np.sort(np.asarray(exclude, np.int64))
+        t0 = time.perf_counter()
+        out_idx = np.empty((b, num), np.int64)
+        out_scores = np.empty((b, num), np.float32)
+        for r in range(b):
+            parts = np.sort(probe[r])  # ordered slices walk memory forward
+            cnt = int(counts[r])
+            ids = np.empty(cnt, np.int32)
+            scores = np.empty(cnt, np.float32)
+            qrow = q[r]
+            pos = 0
+            for p in parts:
+                lo, hi = int(self.offsets[p]), int(self.offsets[p + 1])
+                m = hi - lo
+                if not m:
+                    continue
+                ids[pos:pos + m] = self.member_ids[lo:hi]
+                if self.quantized:
+                    scores[pos:pos + m] = (
+                        self.emb_q[lo:hi].astype(np.float32) @ qrow
+                    ) * self.scales_m[lo:hi] + self.bias_m[lo:hi]
+                else:
+                    scores[pos:pos + m] = \
+                        self.emb_m[lo:hi] @ qrow + self.bias_m[lo:hi]
+                pos += m
+            scores += user_bias[r] + mean
+            if excl_sorted is not None:
+                pos = np.minimum(np.searchsorted(excl_sorted, ids),
+                                 len(excl_sorted) - 1)
+                scores[excl_sorted[pos] == ids] = -np.inf
+            if row_mask is not None:
+                scores += row_mask[r, ids]
+            top = topk_row(scores, num)
+            if not np.isfinite(scores[top[-1]]):
+                # fewer than num candidates survived the rule filters in
+                # THIS probe set — a masked (-inf) item would fill the
+                # trailing slots where the exact path, seeing the whole
+                # catalog, still has unmasked items to place. Fall back.
+                FALLBACKS.inc()
+                return None
+            out_idx[r] = ids[top]
+            out_scores[r] = scores[top]
+            CANDIDATES.observe(cnt)
+        RERANK_SEC.observe(time.perf_counter() - t0)
+        TWO_STAGE_BATCHES.inc()
+        return out_idx, out_scores
+
+
+# -- build -------------------------------------------------------------------
+
+def _assign(x: np.ndarray, cent: np.ndarray,
+            chunk: int = ASSIGN_CHUNK) -> np.ndarray:
+    """Nearest-centroid (euclidean) assignment, chunked over rows."""
+    half = 0.5 * np.einsum("cd,cd->c", cent, cent)
+    out = np.empty(len(x), np.int32)
+    for lo in range(0, len(x), chunk):
+        d = x[lo:lo + chunk] @ cent.T
+        d -= half[None, :]
+        out[lo:lo + chunk] = np.argmax(d, axis=1)
+    return out
+
+
+def _kmeans(x: np.ndarray, c: int, iters: int,
+            rng: np.random.Generator) -> np.ndarray:
+    """Lloyd's k-means on (a sample of) the augmented rows. Per-dimension
+    ``bincount`` accumulation keeps the update pass in C loops; empty
+    clusters reseed from random rows so every centroid stays live."""
+    cent = x[rng.choice(len(x), size=c, replace=False)].copy()
+    d = x.shape[1]
+    for _ in range(iters):
+        a = _assign(x, cent)
+        counts = np.bincount(a, minlength=c).astype(np.float64)
+        for j in range(d):
+            cent[:, j] = np.bincount(a, weights=x[:, j], minlength=c)
+        live = counts > 0
+        cent[live] /= counts[live, None]
+        n_dead = int((~live).sum())
+        if n_dead:
+            cent[~live] = x[rng.choice(len(x), size=n_dead, replace=False)]
+    return cent
+
+
+def build_ivf(item_emb: np.ndarray, item_bias: np.ndarray,
+              key: Optional[dict] = None) -> IVFIndex:
+    """Cluster the catalog and lay out the member-order rerank tables.
+
+    Deploy-time cost: k-means on a bounded sample plus ONE full-catalog
+    assignment pass (chunked matmuls) — minutes at 10M rows, amortized over
+    every query the deployment serves.
+    """
+    n, d = item_emb.shape
+    key = dict(key if key is not None else build_key(n))
+    if key.get("n_items") != n:
+        key["n_items"] = n
+    rng = np.random.default_rng(key["seed"])
+    c = min(key["n_partitions"], max(1, n))
+    t0 = time.perf_counter()
+    item_emb = np.asarray(item_emb, np.float32)
+    item_bias = np.asarray(item_bias, np.float32)
+    aug = np.concatenate([item_emb, item_bias[:, None]], axis=1)
+    sample = min(int(key["train_sample"]), n)
+    train = aug if sample >= n else \
+        aug[rng.choice(n, size=sample, replace=False)]
+    c = min(c, len(train))  # can't seed more centroids than training rows
+    cent = _kmeans(train, c, int(key["kmeans_iters"]), rng)
+    assign = _assign(aug, cent)
+    order = np.argsort(assign, kind="stable")
+    sizes = np.bincount(assign, minlength=c)
+    offsets = np.concatenate([[0], np.cumsum(sizes)]).astype(np.int64)
+    emb_m = np.ascontiguousarray(item_emb[order])
+    index = IVFIndex(
+        centroids=cent,
+        member_ids=order.astype(np.int32),
+        offsets=offsets,
+        bias_m=np.ascontiguousarray(item_bias[order]),
+        key=key,
+    )
+    if key["quantize"]:
+        from incubator_predictionio_tpu.ops.retrieval import quantize_rows
+
+        index.emb_q, index.scales_m = quantize_rows(emb_m)
+    else:
+        index.emb_m = emb_m
+    index.build_seconds = time.perf_counter() - t0
+    return index

@@ -17,6 +17,18 @@ from typing import Sequence
 import numpy as np
 
 
+def topk_row(scores: np.ndarray, num: int) -> np.ndarray:
+    """Top-``num`` indices of ONE 1-D score row, best-first — the same
+    ``argpartition`` → ``argsort`` chain :func:`grouped_topk` runs axis-wise,
+    so single-row consumers (the two-stage rerank) share the serial oracle's
+    tie resolution instead of re-implementing the selection."""
+    num = min(num, scores.shape[0])
+    if num <= 0:
+        return np.empty(0, np.int64)
+    part = np.argpartition(-scores, num - 1)[:num]
+    return part[np.argsort(-scores[part])]
+
+
 def grouped_topk(
     scored: np.ndarray, nums: Sequence[int],
 ) -> list[tuple[np.ndarray, np.ndarray]]:

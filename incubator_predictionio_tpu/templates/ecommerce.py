@@ -203,7 +203,10 @@ class ECommModel(HasCategoryIndex):
     item_vecs_norm: np.ndarray  # L2-normalized item factors for predictSimilar
 
     def prepare_for_serving(self) -> "ECommModel":
-        self.mf.prepare_for_serving()
+        # build_index=False: this template scores through its own
+        # mask-compiled host path, never TwoTowerMF.recommend_batch — a
+        # two-stage retrieval index would be dead weight at deploy
+        self.mf.prepare_for_serving(build_index=False)
         self.category_index()
         return self
 

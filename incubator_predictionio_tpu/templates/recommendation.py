@@ -390,6 +390,10 @@ class RecModel(PersistentModel):
                            for k, v in self.mf._tables.items()},
             "user_map": self.user_map,
             "item_map": self.item_map,
+            # two-stage retrieval index (host numpy; built at train end when
+            # the catalog qualifies, else None) — persisting it means
+            # redeploys skip the catalog re-cluster
+            "ivf": self.mf._ivf,
         }
         with open(os.path.join(d, "sidecar.pkl"), "wb") as f:
             pickle.dump(meta, f)
@@ -430,6 +434,7 @@ class RecModel(PersistentModel):
         mf._tables = tables
         mf._n_users = meta["n_users"]
         mf._n_items = meta["n_items"]
+        mf._ivf = meta.get("ivf")
         return cls(mf, meta["user_map"], meta["item_map"])
 
     def prepare_for_serving(self) -> "RecModel":
@@ -488,6 +493,12 @@ class ALSAlgorithm(PAlgorithm):
             n_items=len(item_map),
             rows_are_local=pd.rows_are_local,
         )
+        # two-stage retrieval (serving/ann.py): when the catalog qualifies,
+        # cluster it HERE — the trainer persists right after this (either the
+        # device-model sidecar or default model pickling), so the index ships
+        # with the model and redeploys skip the re-cluster. No-op below the
+        # auto threshold; prepare_for_serving still (re)builds on env drift.
+        mf._prepare_index()
         return RecModel(mf, user_map, item_map)
 
     @staticmethod

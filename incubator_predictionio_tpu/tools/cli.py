@@ -808,6 +808,62 @@ def cmd_health(args, storage) -> int:
     return 1 if any(r["red"] for r in rows) else 0
 
 
+def format_index_stats(models) -> list[str]:
+    """Human-readable two-stage retrieval state for a deployed engine's
+    models — separated from cmd_index so tests drive it with hand-built
+    models instead of a full storage round trip."""
+    lines: list[str] = []
+    for i, m in enumerate(models):
+        info = m.serving_info() if hasattr(m, "serving_info") else {}
+        name = type(m).__name__
+        mode = info.get("retrieval_mode", "exact")
+        lines.append(f"model {i} ({name}): path={info.get('path', '?')} "
+                     f"catalog_rows={info.get('catalog_rows', '?')} "
+                     f"retrieval={mode}")
+        stats = info.get("index")
+        if not stats:
+            lines.append("  no partition index (exact full-catalog retrieval"
+                         " — see PIO_RETRIEVAL_MODE in docs/serving.md)")
+            continue
+        lines.append(
+            f"  partitions: {stats['n_partitions']} over "
+            f"{stats['n_items']} items  "
+            f"(size min/mean/max {stats['partition_size_min']}/"
+            f"{stats['partition_size_mean']}/{stats['partition_size_max']}, "
+            f"skew {stats['size_skew']}, "
+            f"{stats['empty_partitions']} empty)")
+        lines.append(
+            f"  rerank storage: "
+            f"{'int8 (quantize_rows)' if stats['quantized'] else 'fp32'}  "
+            f"default nprobe: {stats['default_nprobe']}  "
+            f"index bytes: {stats['index_bytes']}  "
+            f"build: {stats['build_seconds']}s")
+    return lines
+
+
+def cmd_index(args, storage: Storage) -> int:
+    """Inspect (building if needed) the two-stage retrieval partition of the
+    latest COMPLETED instance's models (docs/serving.md "Two-stage
+    retrieval")."""
+    if args.two_stage:
+        # force the build so small/dev catalogs are inspectable too
+        os.environ["PIO_RETRIEVAL_MODE"] = "two_stage"
+    from incubator_predictionio_tpu.server.query_server import (
+        ServerConfig,
+        load_deployed_engine,
+    )
+
+    # warmup=False: inspection only reads serving_info() — XLA bucket
+    # compiles and two-stage priming would be paid for nothing
+    deployed = load_deployed_engine(
+        ServerConfig(engine_variant=args.engine_variant, max_batch=1),
+        storage, warmup=False)
+    _out(f"engine instance {deployed.instance.id}")
+    for line in format_index_stats(deployed.models):
+        _out(line)
+    return 0
+
+
 def cmd_metrics(args, storage) -> int:
     """Fetch and pretty-print a server's ``/metrics`` page (any of the three
     servers — event, query, storage — serves one; docs/observability.md)."""
@@ -1330,6 +1386,18 @@ def build_parser() -> argparse.ArgumentParser:
                    help="print the raw exposition text instead")
     p.add_argument("--filter", help="only families whose name contains this")
 
+    # index — two-stage retrieval partition inspection
+    p = sub.add_parser(
+        "index",
+        help="inspect the two-stage retrieval partition (IVF) of the "
+             "latest trained model: partition count, size skew, "
+             "quantization mode (docs/serving.md)")
+    p.add_argument("-v", "--engine-variant", default="engine.json")
+    p.add_argument("--two-stage", action="store_true",
+                   help="force PIO_RETRIEVAL_MODE=two_stage so an index is "
+                        "built (and shown) even below the auto catalog-size "
+                        "threshold")
+
     # health — one-probe fleet state across all three servers
     p = sub.add_parser(
         "health",
@@ -1498,6 +1566,7 @@ _COMMANDS = {
     "import": cmd_import,
     "metrics": cmd_metrics,
     "health": cmd_health,
+    "index": cmd_index,
     "wal": cmd_wal,
     "start-all": cmd_start_all,
     "stop-all": cmd_stop_all,

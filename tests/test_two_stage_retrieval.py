@@ -1,0 +1,511 @@
+"""Two-stage retrieval (IVF coarse pruning + exact rerank) vs the exact
+full-catalog path as the recall oracle, plus the grouped_topk tie-parity
+suite and the recommend_batch degenerate-num / scratch-buffer satellites.
+
+All catalogs here are SMALL and seeded (tier-1 fast); the two-stage path is
+forced on via ``PIO_RETRIEVAL_MODE`` so the auto threshold keeps every other
+suite's toy models on the bitwise-parity exact path.
+"""
+
+import pickle
+
+import numpy as np
+import pytest
+
+from incubator_predictionio_tpu.models.two_tower import (
+    TwoTowerConfig,
+    TwoTowerMF,
+    TwoTowerModel,
+)
+from incubator_predictionio_tpu.serving import ann
+from incubator_predictionio_tpu.serving.topk import grouped_topk, topk_row
+
+
+def _clustered_model(seed=1, n_users=160, n_items=4000, rank=16,
+                     n_concepts=64, sigma=0.5):
+    """Mixture-of-concepts towers — the geometry trained MF factors have
+    (items cluster; users live in the same space), which is what IVF
+    pruning exploits. IID-gaussian catalogs are the no-structure worst
+    case and are NOT what the recall floor is specified over."""
+    rng = np.random.default_rng(seed)
+    concepts = rng.standard_normal((n_concepts, rank)).astype(np.float32)
+    item = concepts[rng.integers(0, n_concepts, n_items)] \
+        + sigma * rng.standard_normal((n_items, rank)).astype(np.float32)
+    user = concepts[rng.integers(0, n_concepts, n_users)] \
+        + sigma * rng.standard_normal((n_users, rank)).astype(np.float32)
+    return TwoTowerModel(
+        user_emb=user.astype(np.float32),
+        item_emb=item.astype(np.float32),
+        user_bias=(rng.standard_normal(n_users) * 0.1).astype(np.float32),
+        item_bias=(rng.standard_normal(n_items) * 0.1).astype(np.float32),
+        mean=3.0,
+        config=TwoTowerConfig(rank=rank),
+    )
+
+
+@pytest.fixture
+def two_stage_env(monkeypatch):
+    """Force the two-stage path with a pinned, comfortable probe width."""
+    monkeypatch.setenv("PIO_RETRIEVAL_MODE", "two_stage")
+    monkeypatch.setenv("PIO_RETRIEVAL_NPROBE", "16")
+    monkeypatch.delenv("PIO_RETRIEVAL_QUANTIZE", raising=False)
+    monkeypatch.delenv("PIO_RETRIEVAL_PARTITIONS", raising=False)
+
+
+def _exact_oracle(seed=1):
+    """An exact-path twin: prepared with the mode pinned to ``exact`` so no
+    index is built — its recommend_batch stays full-catalog even while the
+    surrounding test forces two_stage."""
+    import os
+
+    model = _clustered_model(seed=seed)
+    prev = os.environ.get("PIO_RETRIEVAL_MODE")
+    os.environ["PIO_RETRIEVAL_MODE"] = "exact"
+    try:
+        model.prepare_for_serving()
+    finally:
+        if prev is None:
+            os.environ.pop("PIO_RETRIEVAL_MODE", None)
+        else:
+            os.environ["PIO_RETRIEVAL_MODE"] = prev
+    assert model._ivf is None
+    return model
+
+
+# -- satellite: num <= 0 ----------------------------------------------------
+
+def test_num_nonpositive_returns_empty_host_and_device():
+    from incubator_predictionio_tpu.utils import jitstats
+
+    users = np.asarray([0, 3, 7], np.int32)
+    host_m = _clustered_model()
+    host_m.prepare_for_serving()
+    dev_m = _clustered_model()
+    dev_m.prepare_for_serving(host_max_elements=0)  # force the device path
+    jitstats.reset()
+    for model in (host_m, dev_m):
+        for num in (0, -5):
+            idx, scores = TwoTowerMF.recommend_batch(model, users, num)
+            assert idx.shape == (3, 0) and scores.shape == (3, 0)
+    # the device path must NOT have dispatched (pre-fix it passed k=num
+    # straight into top-k); empty answers are host-side constants
+    assert jitstats.count() == 0
+    idx, scores = TwoTowerMF.recommend(host_m, 0, 0)
+    assert idx.shape == (0,) and scores.shape == (0,)
+
+
+# -- satellite: row-mask pad scratch buffer ---------------------------------
+
+def test_row_mask_pad_buffer_reused_and_zeroed():
+    from incubator_predictionio_tpu.models.two_tower import (
+        _row_mask_pad_buffer,
+    )
+
+    a = _row_mask_pad_buffer(8, 100)
+    a[3, 50] = -np.inf
+    b = _row_mask_pad_buffer(8, 100)
+    assert b is a  # same per-thread scratch, not a fresh allocation
+    assert np.all(b == 0.0)  # and re-zeroed — no stale mask rows
+    c = _row_mask_pad_buffer(16, 100)
+    assert c is not a and c.shape == (16, 100)
+
+
+def test_row_mask_dispatches_no_stale_leakage():
+    """Two consecutive row-masked device dispatches with different masks:
+    the second result must reflect ONLY its own mask (the scratch reuse
+    must never leak the first batch's -inf rows)."""
+    model = _clustered_model(seed=9)
+    model.prepare_for_serving(host_max_elements=0)
+    users = np.asarray([1, 2, 3], np.int32)
+    n = model.n_items
+    base_idx, _ = TwoTowerMF.recommend_batch(model, users, 5)
+    m1 = np.zeros((3, n), np.float32)
+    m1[:, base_idx[0]] = -np.inf  # ban row 0's favorites everywhere
+    i1, _ = TwoTowerMF.recommend_batch(model, users, 5, row_mask=m1)
+    assert not (set(base_idx[0].tolist()) & set(np.unique(i1).tolist()))
+    m2 = np.zeros((3, n), np.float32)  # second batch: NO bans
+    i2, s2 = TwoTowerMF.recommend_batch(model, users, 5, row_mask=m2)
+    np.testing.assert_array_equal(i2, base_idx)
+
+
+# -- satellite: grouped_topk tie-resolution parity --------------------------
+
+def _serial_chain(row: np.ndarray, num: int):
+    part = np.argpartition(-row, num - 1)[:num]
+    order = np.argsort(-row[part])
+    top = part[order]
+    return top, row[top]
+
+
+@pytest.mark.parametrize("case", ["heavy_ties", "all_neginf", "num_eq_ncols"])
+def test_grouped_topk_tie_parity_adversarial(case):
+    rng = np.random.default_rng(42)
+    b, n = 12, 64
+    if case == "heavy_ties":
+        # scores drawn from 3 distinct values: ties everywhere
+        scored = rng.integers(0, 3, (b, n)).astype(np.float32)
+        nums = [int(x) for x in rng.integers(1, n + 1, b)]
+    elif case == "all_neginf":
+        scored = np.full((b, n), -np.inf, np.float32)
+        scored[0, 5] = 1.0  # one row with a single finite survivor
+        nums = [10] * b
+    else:
+        scored = rng.standard_normal((b, n)).astype(np.float32)
+        scored[:, ::7] = 0.5  # tie stripes
+        nums = [n] * b
+    got = grouped_topk(scored, nums)
+    for r in range(b):
+        want_idx, want_scores = _serial_chain(scored[r], nums[r])
+        np.testing.assert_array_equal(got[r][0], want_idx)
+        np.testing.assert_array_equal(got[r][1], want_scores)
+
+
+def test_grouped_topk_nonpositive_and_mixed_nums():
+    scored = np.arange(12, dtype=np.float32).reshape(2, 6)
+    out = grouped_topk(scored, [0, -3])
+    assert all(len(i) == 0 and len(s) == 0 for i, s in out)
+    out = grouped_topk(scored, [2, 6])
+    np.testing.assert_array_equal(out[0][0], [5, 4])
+    np.testing.assert_array_equal(out[1][0], [5, 4, 3, 2, 1, 0])
+
+
+def test_topk_row_matches_grouped_chain():
+    rng = np.random.default_rng(3)
+    scores = rng.integers(0, 4, 50).astype(np.float32)  # heavy ties
+    for num in (1, 7, 50, 60):
+        got = topk_row(scores, num)
+        want, _ = _serial_chain(scores, min(num, 50))
+        np.testing.assert_array_equal(got, want)
+    assert topk_row(scores, 0).shape == (0,)
+
+
+# -- IVF build ---------------------------------------------------------------
+
+def test_ivf_build_partitions_cover_catalog(two_stage_env):
+    model = _clustered_model()
+    model.prepare_for_serving()
+    ivf = model._ivf
+    assert ivf is not None
+    # every catalog row lands in exactly one partition
+    np.testing.assert_array_equal(
+        np.sort(ivf.member_ids), np.arange(model.n_items))
+    assert ivf.offsets[0] == 0 and ivf.offsets[-1] == model.n_items
+    assert np.all(np.diff(ivf.offsets) >= 0)
+    stats = ivf.stats()
+    assert stats["n_partitions"] == ivf.n_partitions
+    assert stats["partition_size_min"] >= 0
+    assert stats["empty_partitions"] == int(
+        (np.diff(ivf.offsets) == 0).sum())
+    assert stats["default_nprobe"] == 16  # pinned by the fixture
+    # rerank rows really are the catalog rows in member order
+    np.testing.assert_allclose(
+        ivf.emb_m, np.asarray(model.item_emb)[ivf.member_ids])
+
+
+def test_small_catalog_auto_mode_stays_exact_parity(monkeypatch):
+    """Below PIO_RETRIEVAL_MIN_ITEMS the auto mode must not build an index
+    — small templates keep bitwise parity with the seed behavior."""
+    monkeypatch.delenv("PIO_RETRIEVAL_MODE", raising=False)
+    model = _clustered_model()
+    model.prepare_for_serving()
+    assert model._ivf is None
+    oracle = _exact_oracle()
+    users = np.arange(32, dtype=np.int32)
+    i1, s1 = TwoTowerMF.recommend_batch(model, users, 10)
+    i2, s2 = TwoTowerMF.recommend_batch(oracle, users, 10)
+    np.testing.assert_array_equal(i1, i2)
+    np.testing.assert_array_equal(s1, s2)
+
+
+# -- recall floor + rule-filter correctness through both stages -------------
+
+RECALL_FLOOR = 0.95
+
+
+def _recall(oracle_idx, got_idx):
+    k = oracle_idx.shape[1]
+    return np.mean([
+        len(set(oracle_idx[r]) & set(got_idx[r])) / k
+        for r in range(len(oracle_idx))])
+
+
+def _filter_cases(oracle_model, users):
+    """The four rule-filter kinds recommend_batch carries: shared exclude,
+    per-row ban mask, per-row whitelist mask, and exclude+row-mask
+    combined (plus unfiltered as the baseline case)."""
+    n = oracle_model.n_items
+    b = len(users)
+    rng = np.random.default_rng(7)
+    exclude = rng.choice(n, 40, replace=False).astype(np.int64)
+    ban = np.zeros((b, n), np.float32)
+    for r in range(b):
+        ban[r, rng.choice(n, 25, replace=False)] = -np.inf
+    white = np.full((b, n), -np.inf, np.float32)
+    for r in range(b):
+        white[r, rng.choice(n, 400, replace=False)] = 0.0
+    return {
+        "none": (None, None),
+        "exclude": (exclude, None),
+        "row_ban": (None, ban),
+        "row_whitelist": (None, white),
+        "exclude_plus_row": (exclude, ban),
+    }
+
+
+@pytest.mark.parametrize(
+    "kind", ["none", "exclude", "row_ban", "row_whitelist",
+             "exclude_plus_row"])
+def test_two_stage_recall_floor_and_mask_correctness(two_stage_env, kind):
+    oracle = _exact_oracle()
+    model = _clustered_model()
+    model.prepare_for_serving()
+    assert model._ivf is not None
+    users = np.arange(64, dtype=np.int32)
+    exclude, row_mask = _filter_cases(oracle, users)[kind]
+    oi, oscores = TwoTowerMF.recommend_batch(
+        oracle, users, 10, exclude=exclude, row_mask=row_mask)
+    gi, gscores = TwoTowerMF.recommend_batch(
+        model, users, 10, exclude=exclude, row_mask=row_mask)
+    assert gi.shape == (64, 10)
+    # (1) recall floor against the exact oracle
+    assert _recall(oi, gi) >= RECALL_FLOOR
+    # (2) masked items NEVER appear with a finite score: a filtered
+    # candidate must not displace an unfiltered one
+    for r in range(64):
+        finite = np.isfinite(gscores[r])
+        if exclude is not None:
+            assert not (set(exclude.tolist()) & set(gi[r][finite].tolist()))
+        if row_mask is not None:
+            assert np.all(row_mask[r, gi[r][finite]] == 0.0)
+    # (3) wherever the oracle's whole top-k survives pruning, the
+    # two-stage answer IS the oracle's answer
+    q = np.asarray(model.user_emb, np.float32)
+    checked = 0
+    for r in range(64):
+        cands = set(model._ivf.candidate_ids(q[users[r]], 16).tolist())
+        if set(oi[r].tolist()) <= cands and np.isfinite(oscores[r]).all():
+            np.testing.assert_array_equal(gi[r], oi[r])
+            np.testing.assert_allclose(gscores[r], oscores[r],
+                                       rtol=1e-5, atol=1e-5)
+            checked += 1
+    assert checked > 0  # the property was actually exercised
+
+
+def test_two_stage_quantized_rerank(two_stage_env, monkeypatch):
+    """int8 rerank storage (quantize_rows machinery): a coarser score, so a
+    slightly looser floor — and mask correctness must be unaffected."""
+    monkeypatch.setenv("PIO_RETRIEVAL_QUANTIZE", "1")
+    oracle = _exact_oracle()
+    model = _clustered_model()
+    model.prepare_for_serving()
+    assert model._ivf.quantized and model._ivf.emb_m is None
+    users = np.arange(48, dtype=np.int32)
+    exclude = np.arange(0, 30, dtype=np.int64)
+    oi, _ = TwoTowerMF.recommend_batch(oracle, users, 10, exclude=exclude)
+    gi, gs = TwoTowerMF.recommend_batch(model, users, 10, exclude=exclude)
+    assert _recall(oi, gi) >= 0.9
+    for r in range(48):
+        finite = np.isfinite(gs[r])
+        assert not (set(range(30)) & set(gi[r][finite].tolist()))
+
+
+def test_two_stage_falls_back_when_candidates_short(two_stage_env,
+                                                    monkeypatch):
+    """num bigger than the probe can cover → the exact path answers (and
+    the fallback counter says so); results equal the exact oracle's."""
+    monkeypatch.setenv("PIO_RETRIEVAL_NPROBE", "1")
+    model = _clustered_model()
+    model.prepare_for_serving()
+    ivf = model._ivf
+    num = int(np.diff(ivf.offsets).max()) + 1  # beats ANY single partition
+    before = ann.FALLBACKS._default().value
+    users = np.arange(8, dtype=np.int32)
+    gi, gs = TwoTowerMF.recommend_batch(model, users, num)
+    assert ann.FALLBACKS._default().value == before + 1
+    oracle = _exact_oracle()
+    oi, oscores = TwoTowerMF.recommend_batch(oracle, users, num)
+    np.testing.assert_array_equal(gi, oi)
+    np.testing.assert_allclose(gs, oscores, rtol=1e-5, atol=1e-5)
+
+
+def test_two_stage_narrow_whitelist_falls_back_not_masked(two_stage_env):
+    """A whitelist narrower than the probe's coverage: the probed
+    partitions hold plenty of RAW candidates but fewer than ``num``
+    finite-scored ones after the filter — the pruned path must fall back
+    to the exact path (which sees the whole catalog), never pad the
+    answer with masked (-inf) items."""
+    oracle = _exact_oracle()
+    model = _clustered_model()
+    model.prepare_for_serving()
+    users = np.arange(8, dtype=np.int32)
+    n = model.n_items
+    q = np.asarray(model.user_emb, np.float32)
+    rng = np.random.default_rng(3)
+    white = np.full((len(users), n), -np.inf, np.float32)
+    for r, u in enumerate(users):
+        cands = set(model._ivf.candidate_ids(q[u], 16).tolist())
+        inside = np.asarray(sorted(cands))
+        outside = np.asarray(sorted(set(range(n)) - cands))
+        # 2 probe-reachable + 10 unreachable whitelisted items: the probe
+        # can never place num=10 finite candidates; the catalog trivially can
+        pick = np.concatenate([rng.choice(inside, 2, replace=False),
+                               rng.choice(outside, 10, replace=False)])
+        white[r, pick] = 0.0
+    before = ann.FALLBACKS._default().value
+    gi, gs = TwoTowerMF.recommend_batch(model, users, 10, row_mask=white)
+    assert ann.FALLBACKS._default().value == before + 1
+    oi, oscores = TwoTowerMF.recommend_batch(oracle, users, 10, row_mask=white)
+    np.testing.assert_array_equal(gi, oi)
+    np.testing.assert_allclose(gs, oscores, rtol=1e-5, atol=1e-5)
+    for r in range(len(users)):
+        # zero masked items in the served answer, finite-scored or not
+        assert np.all(white[r, gi[r]] == 0.0)
+
+
+def test_search_num_nonpositive_public_api(two_stage_env):
+    """IVFIndex.search is exported via serving/__init__ — the num <= 0 edge
+    must answer empty there too, not only behind recommend_batch's guard."""
+    model = _clustered_model()
+    model.prepare_for_serving()
+    q = np.asarray(model.user_emb, np.float32)[:3]
+    ub = np.asarray(model.user_bias, np.float32)[:3]
+    for num in (0, -5):
+        idx, scores = model._ivf.search(q, ub, model.mean, num)
+        assert idx.shape == (3, 0) and scores.shape == (3, 0)
+
+
+def test_train_builds_index_for_persistence(two_stage_env):
+    """The standard lifecycle is train → persist → deploy: the index must
+    exist BEFORE persistence (ALSAlgorithm.train builds it when the catalog
+    qualifies), or 'redeploys skip the re-cluster' could never engage —
+    RecModel.save / default pickling run at train time, deploy never
+    re-saves."""
+    from incubator_predictionio_tpu.parallel.mesh import MeshContext
+    from incubator_predictionio_tpu.templates.recommendation import (
+        ALSAlgorithm,
+        ALSAlgorithmParams,
+        TrainingData,
+    )
+
+    rng = np.random.default_rng(5)
+    n, n_users, n_items = 600, 40, 80
+    td = TrainingData(
+        user_idx=rng.integers(0, n_users, n).astype(np.int32),
+        item_idx=rng.integers(0, n_items, n).astype(np.int32),
+        ratings=(1 + 4 * rng.random(n)).astype(np.float32),
+        user_vocab=np.asarray([f"u{i}" for i in range(n_users)]),
+        item_vocab=np.asarray([f"i{i}" for i in range(n_items)]),
+    )
+    ctx = MeshContext.create()  # all host devices on the data axis
+    algo = ALSAlgorithm(ALSAlgorithmParams(
+        rank=4, num_iterations=1, batch_size=256))
+    model = algo.train(ctx, td)
+    assert model.mf._ivf is not None  # built at train end (mode forced here)
+    assert model.mf.user_emb is None or model.mf._tables is None, \
+        "index build must not ensure_host a device-gather model"
+    clone = pickle.loads(pickle.dumps(model))  # the default persistence path
+    assert clone.mf._ivf is not None
+    assert clone.mf._ivf.matches(model.mf._ivf.key)
+    clone.mf.prepare_for_serving()  # rehydrates the slim-persisted index
+    assert clone.mf._ivf.hydrated
+    users = np.arange(8, dtype=np.int32)
+    i1, _ = TwoTowerMF.recommend_batch(model.mf, users, 5)
+    i2, _ = TwoTowerMF.recommend_batch(clone.mf, users, 5)
+    np.testing.assert_array_equal(i1, i2)
+
+
+# -- persistence, reuse, warmup, metrics ------------------------------------
+
+def test_index_persists_with_model_and_is_reused(two_stage_env):
+    model = _clustered_model()
+    model.prepare_for_serving()
+    first = model._ivf
+    assert first is not None
+    model.prepare_for_serving()  # same knobs → reused, not re-clustered
+    assert model._ivf is first
+    clone = pickle.loads(pickle.dumps(model))
+    assert clone._ivf is not None and clone._ivf.matches(first.key)
+    np.testing.assert_array_equal(clone._ivf.member_ids, first.member_ids)
+    # slim persistence: only the clustering pickles — the member-order
+    # rerank tables (a full catalog copy) rehydrate at prepare time
+    assert not clone._ivf.hydrated and clone._ivf.emb_m is None
+    clone.prepare_for_serving()  # persisted index satisfies the build key
+    assert clone._ivf.hydrated
+    np.testing.assert_array_equal(clone._ivf.bias_m, first.bias_m)
+    np.testing.assert_array_equal(
+        clone._ivf.centroids, first.centroids)
+    users = np.arange(16, dtype=np.int32)
+    i1, s1 = TwoTowerMF.recommend_batch(model, users, 10)
+    i2, s2 = TwoTowerMF.recommend_batch(clone, users, 10)
+    np.testing.assert_array_equal(i1, i2)
+
+
+def test_build_index_opt_out(two_stage_env):
+    """Templates whose serving path never calls recommend_batch (ecommerce)
+    opt out of the deploy-time clustering."""
+    model = _clustered_model()
+    model.prepare_for_serving(build_index=False)
+    assert model._ivf is None
+
+
+def test_index_rebuilds_when_knobs_change(two_stage_env, monkeypatch):
+    model = _clustered_model()
+    model.prepare_for_serving()
+    first = model._ivf
+    monkeypatch.setenv("PIO_RETRIEVAL_PARTITIONS", "13")
+    model.prepare_for_serving()
+    assert model._ivf is not first and model._ivf.n_partitions == 13
+
+
+def test_warmup_primes_two_stage_without_new_executables(two_stage_env):
+    from incubator_predictionio_tpu.utils import jitstats
+
+    model = _clustered_model()
+    model.prepare_for_serving(serve_k=10, host_max_elements=0)
+    jitstats.reset()
+    warmed = model.warmup(max_batch=4)
+    assert warmed == 3  # buckets 1/2/4
+    # the EXACT executables (the two-stage fallback) must still have been
+    # pre-compiled: plain + row-mask variant per bucket
+    assert jitstats.count() == 6
+    before = ann.TWO_STAGE_BATCHES._default().value
+    users = np.arange(16, dtype=np.int32)
+    idx, _ = TwoTowerMF.recommend_batch(model, users, 10)
+    assert idx.shape == (16, 10)
+    # the two-stage dispatch is host-side: the executable gauge stays flat
+    assert jitstats.count() == 6
+    assert ann.TWO_STAGE_BATCHES._default().value == before + 1
+
+
+def test_retrieval_metrics_recorded(two_stage_env):
+    model = _clustered_model()
+    model.prepare_for_serving()
+    coarse0 = ann.COARSE_SEC._default().snapshot()[2]
+    rerank0 = ann.RERANK_SEC._default().snapshot()[2]
+    cand0 = ann.CANDIDATES._default().snapshot()[2]
+    users = np.arange(12, dtype=np.int32)
+    TwoTowerMF.recommend_batch(model, users, 10)
+    assert ann.COARSE_SEC._default().snapshot()[2] == coarse0 + 1
+    assert ann.RERANK_SEC._default().snapshot()[2] == rerank0 + 1
+    assert ann.CANDIDATES._default().snapshot()[2] == cand0 + 12  # per query
+
+
+def test_serving_info_reports_two_stage(two_stage_env):
+    model = _clustered_model()
+    model.prepare_for_serving()
+    info = model.serving_info()
+    assert info["retrieval_mode"] == "two_stage"
+    assert info["index"]["n_items"] == model.n_items
+
+
+def test_cli_index_stats_formatting(two_stage_env):
+    from incubator_predictionio_tpu.tools.cli import format_index_stats
+
+    indexed = _clustered_model()
+    indexed.prepare_for_serving()
+    plain = _exact_oracle()
+    lines = format_index_stats([indexed, plain])
+    text = "\n".join(lines)
+    assert "retrieval=two_stage" in text
+    assert f"over {indexed.n_items} items" in text
+    assert "no partition index" in text  # the exact model's row
