@@ -1562,11 +1562,12 @@ def build_result_line(configs: dict, device_info: dict,
 CONFIG_NAMES = ["recommendation", "recommendation_scaled", "classification",
                 "similarproduct", "ecommerce_retrieval", "retrieval_scale",
                 "sequential", "serving", "overload", "fleet", "ingestion",
-                "ingest_durability"]
+                "ingest_durability", "streaming_freshness"]
 # "fleet" is device-free too: its replicas are CPU subprocesses (a fleet
 # on one host) — the scenario measures the ROUTER's horizontal scaling,
 # not chip throughput
-DEVICE_FREE = {"ingestion", "ingest_durability", "fleet"}
+DEVICE_FREE = {"ingestion", "ingest_durability", "fleet",
+               "streaming_freshness"}
 
 
 def _build_suite(ctx, peaks, device) -> dict:
@@ -1584,7 +1585,177 @@ def _build_suite(ctx, peaks, device) -> dict:
         "fleet": lambda: bench_fleet(ctx),
         "ingestion": lambda: bench_ingestion(),
         "ingest_durability": lambda: bench_ingest_durability(),
+        "streaming_freshness": lambda: bench_streaming_freshness(),
     }
+
+
+# ---------------------------------------------------------------------------
+# 10. streaming freshness (docs/streaming.md): event→recommendation-visible
+#     latency through the incremental delta pipeline vs the full
+#     retrain+redeploy cycle, plus the updater's sustained fold throughput
+# ---------------------------------------------------------------------------
+
+
+def bench_streaming_freshness() -> dict:
+    """Train the recommendation template on the eventlog backend, deploy it
+    in a real in-process query server, then stream live events through the
+    updater (tail → fold → delta → POST /delta with smoke-gate + probation)
+    and measure how long an event takes to become serving-visible — against
+    the only alternative the repo had before: a full retrain + /reload."""
+    import datetime as dt_mod
+    import tempfile
+
+    from incubator_predictionio_tpu.data import DataMap, Event
+    from incubator_predictionio_tpu.data.storage import Storage, use_storage
+    from incubator_predictionio_tpu.parallel.launcher import free_port
+    from incubator_predictionio_tpu.parallel.mesh import MeshContext
+    from incubator_predictionio_tpu.server.query_server import (
+        QueryServer,
+        ServerConfig,
+    )
+    from incubator_predictionio_tpu.streaming.updater import (
+        StreamUpdater,
+        UpdaterConfig,
+        load_base_model,
+    )
+
+    ctx = MeshContext.create()
+    tmp = tempfile.mkdtemp(prefix="pio-stream-bench-")
+    store_cfg = {
+        "PIO_STORAGE_SOURCES_SQ_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_SQ_PATH": os.path.join(tmp, "store.db"),
+        "PIO_STORAGE_SOURCES_EL_TYPE": "eventlog",
+        "PIO_STORAGE_SOURCES_EL_PATH": os.path.join(tmp, "eventlog"),
+        **{f"PIO_STORAGE_REPOSITORIES_{repo}_SOURCE": src
+           for repo, src in (("METADATA", "SQ"), ("EVENTDATA", "EL"),
+                             ("MODELDATA", "SQ"))},
+    }
+    storage = Storage(store_cfg)
+    prev = use_storage(storage)
+    n_users, n_items = 2000, 1000
+    n_events = 5_000 if SMALL else 20_000
+    rounds = 4 if SMALL else 8
+    events_per_round = 25
+    sustained_n = 2_000 if SMALL else 8_000
+    utc = dt_mod.timezone.utc
+    rng = np.random.default_rng(5)
+
+    def live_events(n):
+        now = dt_mod.datetime.now(utc)
+        return [
+            Event(event="rate", entity_type="user",
+                  entity_id=f"u{rng.integers(0, n_users)}",
+                  target_entity_type="item",
+                  target_entity_id=f"i{rng.integers(0, n_items)}",
+                  properties=DataMap({"rating": float(1 + 4 * rng.random())}),
+                  event_time=now)
+            for _ in range(n)
+        ]
+
+    try:
+        variant_path = _train_recommendation(
+            ctx, storage, tmp, n_users, n_items, n_events)
+        app = storage.get_meta_data_apps().get_by_name("bench-app")
+        events_store = storage.get_events()
+        port = free_port()
+        base = f"http://127.0.0.1:{port}"
+
+        async def drive() -> dict:
+            import aiohttp
+
+            loop = asyncio.get_running_loop()
+            server = QueryServer(
+                ServerConfig(engine_variant=variant_path, ip="127.0.0.1",
+                             port=port),
+                storage=storage, ctx=ctx)
+            await server.start()
+            try:
+                model, instance_id, event_names, defaults = \
+                    await loop.run_in_executor(
+                        None, lambda: load_base_model(variant_path, storage))
+                updater = StreamUpdater(
+                    UpdaterConfig(
+                        state_dir=os.path.join(tmp, "stream-state"),
+                        feed_path=events_store.log_path(app.id),
+                        replicas=(base,), batch_events=16_384),
+                    model, instance_id, event_names=event_names,
+                    default_values=defaults)
+                async with aiohttp.ClientSession() as s:
+                    m_before = _metrics_snapshot(
+                        await (await s.get(f"{base}/metrics")).text())
+                    # -- freshness rounds -----------------------------
+                    freshness_ms = []
+                    for _ in range(rounds):
+                        batch = live_events(events_per_round)
+                        t0 = time.perf_counter()
+                        await loop.run_in_executor(
+                            None, events_store.insert_batch, batch, app.id)
+                        out = await loop.run_in_executor(
+                            None, updater.run_once)
+                        assert out["status"] == "applied", out
+                        health = await (await s.get(
+                            f"{base}/health")).json()
+                        stream = health["deployment"]["streaming"]
+                        assert stream["lastDeltaSeq"] == out["toSeq"]
+                        freshness_ms.append(
+                            (time.perf_counter() - t0) * 1e3)
+                    # -- sustained fold throughput --------------------
+                    await loop.run_in_executor(
+                        None, events_store.insert_batch,
+                        live_events(sustained_n), app.id)
+                    t0 = time.perf_counter()
+                    folded = 0
+                    while folded < sustained_n:
+                        out = await loop.run_in_executor(
+                            None, updater.run_once)
+                        if out["status"] != "applied":
+                            break
+                        folded += out["events"]
+                    sustained_sec = time.perf_counter() - t0
+                    # freshness AT HEAD: probe health NOW, after the
+                    # catch-up fold — not a snapshot from the rounds loop
+                    health = await (await s.get(f"{base}/health")).json()
+                    staleness = (health["deployment"]["streaming"]
+                                 or {}).get("stalenessSeconds")
+                    m_after = _metrics_snapshot(
+                        await (await s.get(f"{base}/metrics")).text())
+                    # -- full retrain + redeploy baseline -------------
+                    t0 = time.perf_counter()
+                    await loop.run_in_executor(
+                        None, lambda: _train_recommendation(
+                            ctx, storage, tmp, n_users, n_items, 0))
+                    retrain_sec = time.perf_counter() - t0
+                    t0 = time.perf_counter()
+                    resp = await s.post(f"{base}/reload")
+                    assert resp.status == 200, await resp.text()
+                    reload_sec = time.perf_counter() - t0
+                freshness_ms.sort()
+                full_cycle_ms = (retrain_sec + reload_sec) * 1e3
+                p50 = freshness_ms[len(freshness_ms) // 2]
+                p99 = freshness_ms[-1]
+                return {
+                    "event_visible_p50_ms": round(p50, 1),
+                    "event_visible_p99_ms": round(p99, 1),
+                    "updater_events_per_sec": round(
+                        folded / sustained_sec, 1) if folded else 0.0,
+                    "sustained_events": folded,
+                    "full_retrain_redeploy_ms": round(full_cycle_ms, 1),
+                    "freshness_speedup": round(full_cycle_ms / p50, 1),
+                    "staleness_seconds_at_head": staleness,
+                    "metrics_delta": {
+                        k: round(m_after.get(k, 0) - m_before.get(k, 0), 3)
+                        for k in ("pio_stream_applied_total",
+                                  "pio_stream_deduped_total",
+                                  "pio_deploy_rollbacks_total")
+                        if k in m_after or k in m_before},
+                }
+            finally:
+                await server.shutdown()
+
+        return asyncio.run(drive())
+    finally:
+        use_storage(prev)
+        storage.close()
 
 
 def run_one_config(name: str) -> None:

@@ -255,6 +255,13 @@ class EventLogEvents(EventStore):
         name = f"app_{app_id}" + (f"_{channel_id}" if channel_id is not None else "")
         return os.path.join(self.base_dir, name + ".piolog")
 
+    def log_path(self, app_id: int, channel_id: Optional[int] = None) -> str:
+        """Path of the append-only log file for one app/channel — the
+        durable ordered change feed the streaming updater tails
+        (streaming/feed.py). Read-only consumers open the file themselves;
+        the single-writer flock stays with the event server."""
+        return self._path(app_id, channel_id)
+
     def _log(self, app_id: int, channel_id: Optional[int], create: bool = False) -> _Log:
         key = (app_id, channel_id)
         with self._lock:

@@ -62,6 +62,8 @@ class Replica:
         self.inflight_limit = 2      # admission.inflightLimit from /health
         self.instance_id: Optional[str] = None
         self.engine_version: Optional[str] = None
+        self.last_delta_seq: Optional[int] = None   # streaming chain pos
+        self.staleness_sec: Optional[float] = None  # model freshness lag
         self.last_probe_ok: Optional[bool] = None
         # -- passive per-request state (router observations) --------------
         self.inflight = 0
@@ -157,6 +159,13 @@ class Replica:
         dep = health.get("deployment") or {}
         self.instance_id = dep.get("instanceId", self.instance_id)
         self.engine_version = dep.get("engineVersion", self.engine_version)
+        # streaming update lag (docs/streaming.md): which delta chain
+        # position this replica serves and how stale its model is —
+        # surfaced on the router's /health so operators spot a replica the
+        # updater can't reach
+        stream = dep.get("streaming") or {}
+        self.last_delta_seq = stream.get("lastDeltaSeq")
+        self.staleness_sec = stream.get("stalenessSeconds")
         if not self.healthy:
             logger.info("fleet: probe succeeded — re-admitting replica %s",
                         self.url)
@@ -192,6 +201,8 @@ class Replica:
             "errors": self.errors,
             "instanceId": self.instance_id,
             "engineVersion": self.engine_version,
+            "lastDeltaSeq": self.last_delta_seq,
+            "stalenessSec": self.staleness_sec,
         }
 
 

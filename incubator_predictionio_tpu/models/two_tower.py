@@ -356,6 +356,80 @@ class TwoTowerModel:
     def n_users(self) -> int:
         return self._n_users if self.user_emb is None else self.user_emb.shape[0]
 
+    def with_row_updates(
+        self,
+        user_rows: Optional[dict] = None,
+        item_rows: Optional[dict] = None,
+    ) -> "TwoTowerModel":
+        """A NEW model with the given fused ``[rank+1]`` rows scattered in
+        — the streaming delta-apply primitive (docs/streaming.md).
+
+        Build-beside semantics: the receiver is NEVER mutated (it may be
+        the live serving model, or the probation-pinned previous one), so
+        the tables are copied, rows assigned, and the caller swaps the new
+        model in atomically — serving can't observe a half-applied table.
+
+        Two-stage index staleness: item rows that moved are overlaid on
+        the IVF index (:meth:`serving.ann.IVFIndex.with_updated_rows`) so
+        the pruned path rescopes them with CURRENT values; past
+        ``PIO_STREAM_STALE_REBUILD_FRAC`` of the catalog stale, the index
+        is re-clustered from the updated table instead."""
+        self.ensure_host()
+        k = self.config.rank
+        new = TwoTowerModel(
+            user_emb=np.array(self.user_emb, np.float32, copy=True),
+            item_emb=np.array(self.item_emb, np.float32, copy=True),
+            user_bias=np.array(self.user_bias, np.float32, copy=True),
+            item_bias=np.array(self.item_bias, np.float32, copy=True),
+            mean=self.mean,
+            config=self.config,
+        )
+
+        def scatter(emb, bias, rows, n):
+            for idx, row in rows.items():
+                idx = int(idx)
+                if not (0 <= idx < n):
+                    raise ValueError(f"delta row index {idx} outside "
+                                     f"[0, {n})")
+                row = np.asarray(row, np.float32)
+                if row.shape != (k + 1,):
+                    raise ValueError(
+                        f"delta row shape {row.shape} != ({k + 1},)")
+                emb[idx] = row[:k]
+                bias[idx] = row[k]
+
+        if user_rows:
+            scatter(new.user_emb, new.user_bias, user_rows, new.n_users)
+        if item_rows:
+            scatter(new.item_emb, new.item_bias, item_rows, new.n_items)
+        if self._ivf is not None:
+            if item_rows:
+                new._ivf = self._updated_index(new, item_rows)
+            else:
+                new._ivf = self._ivf  # shared read-only: nothing moved
+        return new
+
+    def _updated_index(self, new: "TwoTowerModel", item_rows: dict):
+        """Overlay the moved item rows on the shared IVF index, or rebuild
+        past the staleness threshold."""
+        import os as _os
+
+        from incubator_predictionio_tpu.serving import ann
+
+        ids = np.asarray(sorted(int(i) for i in item_rows), np.int64)
+        rows = np.stack([np.asarray(item_rows[int(i)], np.float32)
+                         for i in ids])
+        k = self.config.rank
+        overlaid = self._ivf.with_updated_rows(ids, rows[:, :k], rows[:, k])
+        frac = float(_os.environ.get("PIO_STREAM_STALE_REBUILD_FRAC", "0.25"))
+        if overlaid.stale_fraction > frac and ann.two_stage_enabled(
+                new.n_items):
+            return ann.build_ivf(
+                np.asarray(new.item_emb, np.float32),
+                np.asarray(new.item_bias, np.float32),
+                key=ann.build_key(new.n_items))
+        return overlaid
+
     def serving_info(self) -> dict:
         """Which serving path this model runs (status-page observability)."""
         if self._device_items_q is not None:

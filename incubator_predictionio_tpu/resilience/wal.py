@@ -128,6 +128,63 @@ def iter_frames(path: str) -> Iterator[tuple[int, Optional[dict], str]]:
             off += _FRAME.size + length
 
 
+def tail_frames(
+    path: str, from_offset: int = 0,
+) -> tuple[list[tuple[int, dict]], int, str]:
+    """Tail-follow read of a frame-format file that another process may be
+    appending to RIGHT NOW (the streaming feed / dead-letter followers).
+
+    Returns ``(records, next_offset, status)`` where ``records`` is a list
+    of ``(offset, record)`` pairs for every COMPLETE valid frame at or past
+    ``from_offset``, ``next_offset`` is where the next poll should resume,
+    and ``status`` is one of:
+
+    - ``"ok"`` — the scan reached a clean end-of-file;
+    - ``"waiting"`` — the file ends mid-frame (partial header or payload).
+      That is the NORMAL artifact of racing a live writer, not corruption:
+      the caller must keep ``next_offset`` where it is and re-poll once the
+      writer finishes the frame. Nothing is skipped, nothing is declared
+      torn;
+    - ``"corrupt"`` — a *complete* frame failed its CRC or JSON decode, or
+      the segment magic is wrong. Bytes did land and they are bad; waiting
+      longer cannot fix them.
+
+    This is deliberately a different contract from :func:`iter_frames`
+    (whose callers — replay, the CLI inspector — read files no one is
+    writing, so for them a partial tail really is a torn write to discard).
+    """
+    out: list[tuple[int, dict]] = []
+    with open(path, "rb") as f:
+        if from_offset < len(MAGIC):
+            head = f.read(len(MAGIC))
+            if len(head) < len(MAGIC):
+                return out, 0, "waiting"  # magic itself still being written
+            if head != MAGIC:
+                return out, 0, "corrupt"
+            off = len(MAGIC)
+        else:
+            off = from_offset
+            f.seek(off)
+        while True:
+            hdr = f.read(_FRAME.size)
+            if not hdr:
+                return out, off, "ok"
+            if len(hdr) < _FRAME.size:
+                return out, off, "waiting"
+            length, crc = _FRAME.unpack(hdr)
+            payload = f.read(length)
+            if len(payload) < length:
+                return out, off, "waiting"
+            if _crc(payload) != crc:
+                return out, off, "corrupt"
+            try:
+                rec = json.loads(payload)
+            except ValueError:
+                return out, off, "corrupt"
+            out.append((off, rec))
+            off += _FRAME.size + length
+
+
 def _segment_seq(name: str) -> Optional[int]:
     if not (name.startswith(_SEG_PREFIX) and name.endswith(_SEG_SUFFIX)):
         return None

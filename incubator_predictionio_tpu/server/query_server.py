@@ -56,6 +56,11 @@ from incubator_predictionio_tpu.resilience.admission import (
     ShedExpired,
 )
 from incubator_predictionio_tpu.resilience.breaker import publish_breaker_metrics
+from incubator_predictionio_tpu.streaming.stream_metrics import (
+    APPLIED as _STREAM_APPLIED,
+    DEDUPED as _STREAM_DEDUPED,
+    STALENESS as _STREAM_STALENESS,
+)
 
 from incubator_predictionio_tpu.core.controller import (
     Engine,
@@ -815,6 +820,13 @@ class QueryServer:
         self._rollback_count = 0
         self._last_reload: dict = {"status": "initial",
                                    "instanceId": self.deployed.instance.id}
+        # -- streaming delta state (docs/streaming.md) --------------------
+        # which [from_seq, to_seq) ranges of the updater's chain this
+        # replica has applied; None until the first delta lands (or after
+        # a full /reload resets the base). Snapshotted with the probation
+        # pin so a rollback restores the matching chain position.
+        self._delta_state: Optional[dict] = None
+        self._previous_delta_state: Optional[dict] = None
         # -- graceful drain (server/lifecycle.py) -------------------------
         self._drain_state = DrainState("query_server")
         self._start_time = time.time()
@@ -840,6 +852,9 @@ class QueryServer:
                            ("dispatch", self.batcher.dispatch_sec)):
             for q, v in res.percentiles().items():
                 _G_LATENCY_Q.labels(stage=stage, quantile=q).set(v)
+        stream = self._streaming_health()
+        if stream is not None and stream.get("stalenessSeconds") is not None:
+            _STREAM_STALENESS.set(stream["stalenessSeconds"])
         import sys
 
         if "jax" in sys.modules:  # never the import that drags jax in
@@ -864,6 +879,7 @@ class QueryServer:
         add_observability_routes(app)
         app.router.add_post("/queries.json", self.handle_query)
         app.router.add_post("/reload", self.handle_reload)
+        app.router.add_post("/delta", self.handle_delta)
         app.router.add_post("/rollback", self.handle_rollback)
         app.router.add_post("/stop", self.handle_stop)
         app.router.add_get("/plugins.json", self.handle_plugins)
@@ -907,6 +923,10 @@ class QueryServer:
                 "probationActive": self._probation_active(),
                 "rollbacks": self._rollback_count,
                 "lastReload": self._last_reload,
+                # streaming update lag: lastDeltaSeq is what the updater's
+                # ship-resync keys on; stalenessSeconds is the freshness
+                # SLO pio-tpu health and the fleet balancer read
+                "streaming": self._streaming_health(),
             },
         })
 
@@ -1340,22 +1360,41 @@ class QueryServer:
                 "error": failure,
                 "engineInstanceId": self.deployed.instance.id,
             }, status=409)
-        # atomic swap: in-flight dispatches hold their own reference to the
-        # old engine and complete against it; everything after this
-        # assignment serves the new one
+        old = await self._swap_in(new)
+        # a full reload resets the streaming chain: deltas were built for
+        # the previous base instance and the updater starts a fresh chain
+        # against this one (the snapshot _swap_in took still restores the
+        # old chain position if probation rolls this reload back)
+        self._delta_state = None
+        self._last_reload = {"status": "ok", "instanceId": new.instance.id,
+                             "previousInstanceId": old.instance.id}
+        return web.json_response({"message": "Reloaded",
+                                  "engineInstanceId": new.instance.id})
+
+    async def _swap_in(self, new: DeployedEngine) -> DeployedEngine:
+        """Atomic engine swap + probation pin, shared by /reload (full
+        model) and /delta (streaming delta deploy): in-flight dispatches
+        hold their own reference to the old engine and complete against
+        it; everything after the assignment serves the new one. The old
+        engine — and the delta-chain position that matched it — is pinned
+        for the probation window so a breaker trip rolls BOTH back."""
+        loop = asyncio.get_running_loop()
         old = self.deployed
         self.deployed = new
-        # The batcher captured the old DeployedEngine at construction; repoint
-        # it or /reload would silently keep serving the stale model.
+        # The batcher captured the old DeployedEngine at construction;
+        # repoint it or the swap would silently keep serving the stale
+        # model.
         self.batcher.deployed = new
-        # the reloaded engine may have a different thread-safety posture —
+        # the swapped engine may have a different thread-safety posture —
         # re-resolve the overlap bound (and re-bound the adaptive limiter,
         # which also resets its latency baseline: new engine, new floor)
-        # or auto mode's no-race guarantee breaks across /reload
+        # or auto mode's no-race guarantee breaks across the swap
         bound = effective_max_in_flight(self.config, new)
         limit = self._admission.set_max_inflight(bound)
         await self.batcher.resize(limit if limit is not None else bound)
         self._previous = old
+        self._previous_delta_state = (
+            dict(self._delta_state) if self._delta_state else None)
         self._probation_until = (
             self._clock.monotonic() + self.config.reload_probation_sec
             if self.config.reload_probation_sec > 0 else None)
@@ -1370,10 +1409,7 @@ class QueryServer:
                             self._probation_active)
         else:
             self._previous = None  # probation disabled: nothing to pin
-        self._last_reload = {"status": "ok", "instanceId": new.instance.id,
-                             "previousInstanceId": old.instance.id}
-        return web.json_response({"message": "Reloaded",
-                                  "engineInstanceId": new.instance.id})
+        return old
 
     async def _smoke_gate(self, new: DeployedEngine) -> Optional[str]:
         """Run ``config.smoke_queries`` against the not-yet-live instance.
@@ -1408,6 +1444,11 @@ class QueryServer:
         rolled_from = self.deployed.instance.id
         self.deployed = prev
         self.batcher.deployed = prev
+        # the restored engine's tables predate the swapped-in deploy —
+        # restore the delta-chain position that matched them, so the
+        # updater's ship-resync re-sends exactly what was rolled back
+        self._delta_state = self._previous_delta_state
+        self._previous_delta_state = None
         bound = effective_max_in_flight(self.config, prev)
         limit = self._admission.set_max_inflight(bound)
         await self.batcher.resize(limit if limit is not None else bound)
@@ -1430,6 +1471,158 @@ class QueryServer:
         if self._serving_breaker.state != "open" or not self._probation_active():
             return
         await self._restore_previous(reason)
+
+    def _streaming_health(self) -> Optional[dict]:
+        """Delta-chain position + freshness for /health.deployment (None
+        until a streaming delta has been applied to this base)."""
+        st = self._delta_state
+        if not st:
+            return None
+        staleness = None
+        if st.get("maxEventTimeUs"):
+            staleness = max(0.0, time.time() - st["maxEventTimeUs"] / 1e6)
+        return {
+            "lastDeltaSeq": st["lastDeltaSeq"],
+            "chainBase": st["chainBase"],
+            "applied": st["applied"],
+            "deduped": st["deduped"],
+            "stalenessSeconds": staleness,
+        }
+
+    async def handle_delta(self, request: web.Request) -> web.Response:
+        """Streaming delta deploy (docs/streaming.md): apply a versioned
+        embedding-row delta through the SAME discipline as a full /reload
+        — build the delta-applied engine BESIDE the live one, run the
+        smoke-query gate, swap atomically, pin the previous engine for
+        probation (a breaker trip rolls the delta back to last-good).
+
+        Exactly-once enforcement: every delta names its ``[from_seq,
+        to_seq)`` event range and the base instance it applies to.
+        Out-of-order or wrong-base deltas are rejected 409 (with this
+        replica's position, so the updater resyncs the chain); an
+        already-applied range answers 200 "duplicate" — the crash-replay
+        dedup — and is counted, never re-applied."""
+        if not self._authorized(request):
+            return web.json_response({"message": "Unauthorized"}, status=401)
+        if self._drain_state.draining:
+            return self._drain_state.reject_response()
+        from incubator_predictionio_tpu.streaming.delta import decode_delta
+
+        body = await request.read()
+        try:
+            delta = decode_delta(body)
+        except Exception as e:  # noqa: BLE001 - bad/foreign artifact
+            return web.json_response(
+                {"status": "rejected", "message": f"bad delta: {e}"},
+                status=400)
+        inst_id = self.deployed.instance.id
+        st = self._delta_state
+        last = st["lastDeltaSeq"] if st else None
+        if delta.base_instance != inst_id:
+            return web.json_response({
+                "status": "rejected", "reason": "base-mismatch",
+                "message": f"delta targets instance {delta.base_instance}, "
+                           f"this replica serves {inst_id}",
+                "instanceId": inst_id, "lastDeltaSeq": last,
+            }, status=409)
+        if last is not None and delta.to_seq <= last:
+            # already applied (the updater crashed between ship and cursor
+            # commit and is replaying): idempotent ack, counted
+            self._delta_state["deduped"] += 1
+            _STREAM_DEDUPED.inc()
+            return web.json_response({
+                "status": "duplicate", "lastDeltaSeq": last})
+        expected = last if last is not None else delta.chain_base
+        if delta.from_seq != expected:
+            return web.json_response({
+                "status": "rejected", "reason": "out-of-order",
+                "message": f"expected from_seq {expected}, got "
+                           f"{delta.from_seq} — resync the chain",
+                "lastDeltaSeq": last, "instanceId": inst_id,
+            }, status=409)
+        if not delta.finite():
+            return web.json_response({
+                "status": "rejected", "reason": "non-finite",
+                "message": "delta carries non-finite rows; quarantine the "
+                           "stream (docs/streaming.md)",
+                "lastDeltaSeq": last,
+            }, status=409)
+        loop = asyncio.get_running_loop()
+
+        def build() -> DeployedEngine:
+            import signal as _signal
+
+            models = []
+            applied = False
+            for m in self.deployed.models:
+                if hasattr(m, "apply_delta"):
+                    m = m.apply_delta(delta)
+                    applied = True
+                models.append(m)
+            if not applied:
+                raise LookupError("no deployed model supports streaming "
+                                  "deltas (apply_delta)")
+            if os.environ.get("PIO_DELTA_FAULT") == "kill:mid_apply":
+                # chaos hook: die with the new tables built but NOT
+                # swapped — serving must still hold the old engine after
+                # restart, with nothing half-applied
+                logger.error("PIO_DELTA_FAULT tripping mid_apply — SIGKILL")
+                os.kill(os.getpid(), _signal.SIGKILL)
+            return DeployedEngine(
+                self.deployed.engine, self.deployed.engine_params,
+                self.deployed.instance, models,
+                max_batch=self.config.max_batch, warmup=False,
+                algo_deadline=self.config.algo_deadline_sec,
+                breaker_threshold=self.config.algo_breaker_threshold,
+                breaker_reset=self.config.algo_breaker_reset_sec,
+                clock=self._clock)
+
+        try:
+            new = await loop.run_in_executor(None, build)
+        except LookupError as e:
+            return web.json_response(
+                {"status": "rejected", "message": str(e)}, status=409)
+        except (ValueError, RuntimeError) as e:
+            return web.json_response({
+                "status": "rejected", "reason": "apply-failed",
+                "message": str(e), "lastDeltaSeq": last,
+            }, status=409)
+        failure = await self._smoke_gate(new)
+        if failure is not None:
+            self._rollback_count += 1
+            _ROLLBACKS.inc()
+            self._last_reload = {
+                "status": "delta_rejected", "instanceId": inst_id,
+                "deltaRange": [delta.from_seq, delta.to_seq],
+                "reason": failure,
+            }
+            logger.error("delta [%d, %d): smoke gate rejected (%s); "
+                         "previous state keeps serving",
+                         delta.from_seq, delta.to_seq, failure)
+            return web.json_response({
+                "status": "rejected", "reason": "smoke-gate",
+                "error": failure, "lastDeltaSeq": last,
+            }, status=409)
+        await self._swap_in(new)
+        prev_max_t = st["maxEventTimeUs"] if st else 0
+        self._delta_state = {
+            "lastDeltaSeq": delta.to_seq,
+            "chainBase": delta.chain_base,
+            "maxEventTimeUs": max(prev_max_t, delta.max_event_time_us),
+            "applied": (st["applied"] if st else 0) + 1,
+            "deduped": st["deduped"] if st else 0,
+        }
+        _STREAM_APPLIED.inc()
+        self._last_reload = {
+            "status": "delta", "instanceId": inst_id,
+            "deltaRange": [delta.from_seq, delta.to_seq],
+        }
+        return web.json_response({
+            "status": "applied",
+            "lastDeltaSeq": delta.to_seq,
+            "rows": delta.n_rows,
+            "engineInstanceId": inst_id,
+        })
 
     async def handle_rollback(self, request: web.Request) -> web.Response:
         """Operator/orchestrator-driven rollback to the pinned previous

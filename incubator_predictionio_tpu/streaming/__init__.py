@@ -1,0 +1,41 @@
+"""Streaming incremental updates (docs/streaming.md).
+
+A crash-safe, exactly-once delta pipeline from the event feed into live
+serving: tail the eventlog change feed, fold events into per-row embedding
+deltas (gather → adam → scatter on just the touched rows), ship each delta
+to serving replicas through the smoke-gate + probation hot-swap path, with
+a divergence guard that quarantines the stream when incremental state
+drifts from what a full retrain would produce.
+"""
+
+from incubator_predictionio_tpu.streaming.coldstart import (  # noqa: F401
+    ColdStartBuckets,
+    coldstart_mode,
+)
+from incubator_predictionio_tpu.streaming.delta import (  # noqa: F401
+    ModelDelta,
+    decode_delta,
+    encode_delta,
+    load_delta,
+    save_delta,
+)
+from incubator_predictionio_tpu.streaming.feed import (  # noqa: F401
+    EventLogFeed,
+    FeedBatch,
+    read_cursor,
+    write_cursor,
+)
+from incubator_predictionio_tpu.streaming.guard import (  # noqa: F401
+    DivergenceGuard,
+    GuardConfig,
+    compare_to_reference,
+)
+from incubator_predictionio_tpu.streaming.trainer import (  # noqa: F401
+    DeltaTrainer,
+    PoisonEvent,
+)
+from incubator_predictionio_tpu.streaming.updater import (  # noqa: F401
+    HttpTransport,
+    StreamUpdater,
+    UpdaterConfig,
+)

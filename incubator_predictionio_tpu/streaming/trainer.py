@@ -1,0 +1,283 @@
+"""Incremental embedding-row trainer: gather → adam step → scatter.
+
+The Tensor Casting observation (PAPERS.md) is that a recsys gradient step
+touches only the embedding rows its batch names — so folding a batch of
+live events needs exactly: gather the touched user/item rows, run the same
+adam math the full trainer uses (``utils/optim.adam_apply``, fp32), and
+scatter the updated rows back. This module is that loop in host numpy,
+over a **sparse working state** (row overlays + per-row adam moments) kept
+by the updater and persisted with its cursor, so a SIGKILL replays the
+uncommitted batch deterministically onto the same state.
+
+Design points:
+
+- **Absolute rows out.** A fold returns the post-step values of every row
+  it touched; deltas therefore compose by overwrite and replay is
+  idempotent under the replica's range dedup.
+- **Per-row adam moments.** Moments and step counts are kept per touched
+  row (the sparse-adam convention): a row's bias correction advances only
+  when the row trains, matching what a dense trainer restricted to these
+  batches would do.
+- **Cold-start rows.** Events naming entities outside the vocab train the
+  hash-bucket rows (``PIO_COLDSTART_MODE=hash``) or are counted skipped
+  (mode ``off`` — the reference behavior).
+- **Poison events dead-letter.** An event the fold cannot interpret
+  (non-numeric rating, malformed properties) raises ``PoisonEvent``; the
+  updater diverts it to the dead-letter file instead of wedging the loop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import numpy as np
+
+from incubator_predictionio_tpu.data.event import Event, epoch_micros
+from incubator_predictionio_tpu.streaming.coldstart import (
+    ColdStartBuckets,
+    coldstart_mode,
+)
+
+
+class PoisonEvent(ValueError):
+    """An event the fold can never interpret — dead-letter it, don't retry."""
+
+
+@dataclasses.dataclass
+class FoldResult:
+    """One batch's outcome: rows touched (absolute values), bookkeeping."""
+
+    user_rows: dict[int, np.ndarray]
+    item_rows: dict[int, np.ndarray]
+    cold_user_rows: dict[int, np.ndarray]
+    cold_item_rows: dict[int, np.ndarray]
+    n_folded: int = 0
+    n_skipped: int = 0       # unknown entities with cold-start off
+    n_ignored: int = 0       # event names outside the training signal
+    max_event_time_us: int = 0
+
+
+class DeltaTrainer:
+    """Sparse online trainer over one base model's tables.
+
+    ``base_*`` arrays are read-only references to the deployed model's host
+    tables; all mutation happens in the overlay dicts. ``micro_batch``
+    bounds the vectorized step size — events fold in arrival order, so the
+    result is deterministic given (state, events)."""
+
+    def __init__(
+        self,
+        user_emb: np.ndarray, user_bias: np.ndarray,
+        item_emb: np.ndarray, item_bias: np.ndarray,
+        mean: float,
+        user_index: dict, item_index: dict,
+        learning_rate: float = 3e-2,
+        reg: float = 1e-4,
+        event_names: Sequence[str] = ("rate", "buy"),
+        value_property: str = "rating",
+        default_values: Optional[dict] = None,
+        coldstart: Optional[ColdStartBuckets] = None,
+        micro_batch: int = 256,
+    ):
+        self._base = {
+            "u": (np.asarray(user_emb, np.float32),
+                  np.asarray(user_bias, np.float32)),
+            "i": (np.asarray(item_emb, np.float32),
+                  np.asarray(item_bias, np.float32)),
+        }
+        self.rank = self._base["u"][0].shape[1]
+        self.mean = float(mean)
+        self.user_index = user_index
+        self.item_index = item_index
+        self.lr = float(learning_rate)
+        self.reg = float(reg)
+        self.event_names = tuple(event_names)
+        self.value_property = value_property
+        self.default_values = dict(default_values or {"buy": 4.0})
+        self.micro_batch = max(1, micro_batch)
+        mode = coldstart_mode()
+        if coldstart is None and mode == "hash":
+            coldstart = ColdStartBuckets.build(self.rank)
+        self.coldstart = coldstart
+        # sparse working state: key -> np arrays. Keys are ("u"|"i", idx)
+        # for table rows, ("cu"|"ci", bucket) for cold-start rows.
+        self.rows: dict[tuple, np.ndarray] = {}
+        self.m: dict[tuple, np.ndarray] = {}
+        self.v: dict[tuple, np.ndarray] = {}
+        self.t: dict[tuple, int] = {}
+        self.n_folded = 0
+
+    # -- state persistence (rides the updater's atomic state commit) ------
+    def to_state(self) -> dict:
+        return {
+            "rows": self.rows, "m": self.m, "v": self.v, "t": self.t,
+            "n_folded": self.n_folded,
+            "coldstart": self.coldstart,
+        }
+
+    def load_state(self, state: dict) -> None:
+        self.rows = state["rows"]
+        self.m = state["m"]
+        self.v = state["v"]
+        self.t = state["t"]
+        self.n_folded = state["n_folded"]
+        if state.get("coldstart") is not None:
+            self.coldstart = state["coldstart"]
+
+    # -- row access -------------------------------------------------------
+    def current_row(self, key: tuple) -> np.ndarray:
+        """Current fused ``[rank+1]`` row (overlay, else base/cold init)."""
+        row = self.rows.get(key)
+        if row is not None:
+            return row
+        kind, idx = key
+        if kind in ("u", "i"):
+            emb, bias = self._base[kind]
+            return np.concatenate([emb[idx], [bias[idx]]]).astype(np.float32)
+        cs = self.coldstart
+        if cs is None:
+            raise KeyError(f"cold-start row {key} without coldstart mode")
+        return (cs.user_rows[idx] if kind == "cu"
+                else cs.item_rows[idx]).astype(np.float32)
+
+    # -- event translation ------------------------------------------------
+    def _rating_of(self, event: Event) -> float:
+        props = event.properties or {}
+        if self.value_property in props:
+            v = props[self.value_property]
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                raise PoisonEvent(
+                    f"event {event.event_id}: property "
+                    f"{self.value_property!r}={v!r} is not numeric")
+            v = float(v)
+            if not np.isfinite(v):
+                raise PoisonEvent(
+                    f"event {event.event_id}: non-finite rating {v!r}")
+            return v
+        if event.event in self.default_values:
+            return float(self.default_values[event.event])
+        return 0.0  # assemble_triples' missing_value convention
+
+    def _keys_of(self, event: Event) -> Optional[tuple[tuple, tuple]]:
+        """(user_key, item_key) for a trainable event, or None to skip."""
+        if event.target_entity_id is None:
+            raise PoisonEvent(
+                f"event {event.event_id}: {event.event!r} without a "
+                "target entity")
+        uidx = self.user_index.get(event.entity_id)
+        iidx = self.item_index.get(event.target_entity_id)
+        cs = self.coldstart
+        if uidx is None:
+            if cs is None:
+                return None
+            ukey = ("cu", cs.user_bucket(event.entity_id))
+        else:
+            ukey = ("u", int(uidx))
+        if iidx is None:
+            if cs is None:
+                return None
+            ikey = ("ci", cs.item_bucket(event.target_entity_id))
+        else:
+            ikey = ("i", int(iidx))
+        return ukey, ikey
+
+    # -- the fold ---------------------------------------------------------
+    def fold(self, events: Sequence[Event]) -> tuple[FoldResult, list[Event]]:
+        """Fold a batch of events into the working state. Returns the
+        touched-row result and the list of poison events (dead-letter
+        candidates) — the good events still fold; one bad apple never
+        blocks the batch."""
+        triples: list[tuple[tuple, tuple, float, int]] = []
+        poison: list[Event] = []
+        skipped = ignored = 0
+        max_t_us = 0
+        for e in events:
+            if e.event not in self.event_names:
+                ignored += 1
+                continue
+            try:
+                keys = self._keys_of(e)
+                if keys is None:
+                    skipped += 1
+                    continue
+                rating = self._rating_of(e)
+            except PoisonEvent:
+                poison.append(e)
+                continue
+            max_t_us = max(max_t_us, epoch_micros(e.event_time))
+            triples.append((keys[0], keys[1], rating, 0))
+        touched: set[tuple] = set()
+        for lo in range(0, len(triples), self.micro_batch):
+            batch = triples[lo:lo + self.micro_batch]
+            touched.update(self._step(batch))
+        self.n_folded += len(triples)
+        result = FoldResult(
+            user_rows={}, item_rows={}, cold_user_rows={}, cold_item_rows={},
+            n_folded=len(triples), n_skipped=skipped, n_ignored=ignored,
+            max_event_time_us=max_t_us,
+        )
+        dest = {"u": result.user_rows, "i": result.item_rows,
+                "cu": result.cold_user_rows, "ci": result.cold_item_rows}
+        for key in touched:
+            dest[key[0]][key[1]] = self.rows[key].copy()
+        return result, poison
+
+    def _step(self, batch: list[tuple[tuple, tuple, float, int]]) -> set:
+        """One micro-batch SGD/adam step — the numpy mirror of the full
+        trainer's loss (models/two_tower.py ``_train_epochs``): squared
+        error on (dot + biases) against mean-centered ratings, L2 on the
+        embedding parts, gradients averaged over the batch, per-row adam."""
+        if not batch:
+            return set()
+        b = len(batch)
+        k = self.rank
+        ukeys = [t[0] for t in batch]
+        ikeys = [t[1] for t in batch]
+        urows = np.stack([self.current_row(key) for key in ukeys])
+        irows = np.stack([self.current_row(key) for key in ikeys])
+        ratings = np.asarray([t[2] for t in batch], np.float32) - self.mean
+        ue, bu = urows[:, :k], urows[:, k]
+        ie, bi = irows[:, :k], irows[:, k]
+        pred = np.einsum("bk,bk->b", ue, ie) + bu + bi
+        err = pred - ratings
+        denom = float(b)
+        # d(mse)/d(pred) = 2 err / denom; l2 adds 2 reg emb / denom
+        gp = (2.0 * err / denom)[:, None]
+        g_u = np.concatenate(
+            [gp * ie + (2.0 * self.reg / denom) * ue, gp], axis=1)
+        g_i = np.concatenate(
+            [gp * ue + (2.0 * self.reg / denom) * ie, gp], axis=1)
+        # duplicate rows in one batch accumulate their gradients first
+        # (matching a dense scatter-add), then take ONE adam step
+        grads: dict[tuple, np.ndarray] = {}
+        for key, g in zip(ukeys, g_u):
+            acc = grads.get(key)
+            grads[key] = g.copy() if acc is None else acc + g
+        for key, g in zip(ikeys, g_i):
+            acc = grads.get(key)
+            grads[key] = g.copy() if acc is None else acc + g
+        for key, g in grads.items():
+            self._adam(key, g)
+        return set(grads)
+
+    def _adam(self, key: tuple, g: np.ndarray,
+              b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8) -> None:
+        """Per-row adam, the ``utils/optim.adam_apply`` math element-wise
+        (fp32 moments; bias correction by this ROW's step count)."""
+        row = self.current_row(key).astype(np.float32, copy=True)
+        m = self.m.get(key)
+        v = self.v.get(key)
+        if m is None:
+            m = np.zeros_like(row)
+            v = np.zeros_like(row)
+        t = self.t.get(key, 0) + 1
+        m = b1 * m + (1.0 - b1) * g
+        v = b2 * v + (1.0 - b2) * (g * g)
+        bc1 = 1.0 - b1 ** t
+        bc2 = 1.0 - b2 ** t
+        row -= self.lr * (m / bc1) / (np.sqrt(v / bc2) + eps)
+        self.rows[key] = row
+        self.m[key] = m
+        self.v[key] = v
+        self.t[key] = t

@@ -394,6 +394,8 @@ class RecModel(PersistentModel):
             # the catalog qualifies, else None) — persisting it means
             # redeploys skip the catalog re-cluster
             "ivf": self.mf._ivf,
+            # trained cold-start bucket rows (streaming deltas update them)
+            "coldstart": getattr(self, "coldstart", None),
         }
         with open(os.path.join(d, "sidecar.pkl"), "wb") as f:
             pickle.dump(meta, f)
@@ -435,7 +437,9 @@ class RecModel(PersistentModel):
         mf._n_users = meta["n_users"]
         mf._n_items = meta["n_items"]
         mf._ivf = meta.get("ivf")
-        return cls(mf, meta["user_map"], meta["item_map"])
+        model = cls(mf, meta["user_map"], meta["item_map"])
+        model.coldstart = meta.get("coldstart")
+        return model
 
     def prepare_for_serving(self) -> "RecModel":
         # on TPU the catalog is int8-quantized and scored by the fused Pallas
@@ -446,6 +450,70 @@ class RecModel(PersistentModel):
         self.mf.prepare_for_serving(
             quantize=jax.devices()[0].platform == "tpu")
         return self
+
+    # -- streaming deltas (docs/streaming.md) -----------------------------
+    def apply_delta(self, delta) -> "RecModel":
+        """Build-beside application of a streaming delta: a NEW RecModel
+        with the delta's absolute rows scattered into copied tables (and
+        cold-start bucket rows merged); the receiver — possibly live, or
+        probation-pinned — is never mutated. The id maps are shared: a
+        delta never grows the vocabulary (unseen entities ride the
+        hash-bucket rows instead)."""
+        mf = self.mf.with_row_updates(delta.user_rows, delta.item_rows)
+        cs = getattr(self, "coldstart", None)
+        if delta.cold_user_rows or delta.cold_item_rows:
+            from incubator_predictionio_tpu.streaming.coldstart import (
+                ColdStartBuckets,
+            )
+
+            cs = (cs.copy() if cs is not None
+                  else ColdStartBuckets.build(self.mf.config.rank))
+            for rows, table in ((delta.cold_user_rows, cs.user_rows),
+                                (delta.cold_item_rows, cs.item_rows)):
+                for b, row in rows.items():
+                    b = int(b)
+                    if not (0 <= b < table.shape[0]):
+                        raise ValueError(
+                            f"cold-start bucket {b} outside "
+                            f"[0, {table.shape[0]}) — set "
+                            "PIO_COLDSTART_BUCKETS identically on the "
+                            "updater and every replica")
+                    table[b] = np.asarray(row, np.float32)
+        new = RecModel(mf, self.user_map, self.item_map)
+        new.coldstart = cs
+        return new
+
+    def coldstart_buckets(self):
+        """The hash-bucket cold-start rows when ``PIO_COLDSTART_MODE=hash``
+        (streaming/coldstart.py), else None. Deterministic build: every
+        process derives bit-identical initial rows, and delta deploys
+        overwrite them with trained values."""
+        from incubator_predictionio_tpu.streaming.coldstart import (
+            ColdStartBuckets,
+            coldstart_mode,
+        )
+
+        if coldstart_mode() != "hash":
+            return None
+        cs = getattr(self, "coldstart", None)
+        if cs is None:
+            cs = self.coldstart = ColdStartBuckets.build(self.mf.config.rank)
+        return cs
+
+    def _cold_item_table(self):
+        """Cached host (item_emb, item_bias) for cold-start scoring — one
+        device pull at most, reused across cold queries."""
+        cached = getattr(self, "_cold_items_cache", None)
+        if cached is None:
+            cached = self.mf._host_item_table()
+            self._cold_items_cache = cached
+        return cached
+
+    def __getstate__(self):
+        # the cold-item-table cache is derived state (possibly a device
+        # pull); never serialize it
+        return {k: v for k, v in self.__dict__.items()
+                if k != "_cold_items_cache"}
 
     def warmup(self, max_batch: int = 64) -> int:
         """Pre-compile every serving batch bucket (called at deploy)."""
@@ -510,11 +578,43 @@ class ALSAlgorithm(PAlgorithm):
             if (idx := model.item_map.get(b)) is not None
         }
 
+    @staticmethod
+    def _coldstart_predict(model: RecModel, query: Query,
+                           banned: set[int]) -> PredictedResult:
+        """Unknown-user answer from the hash-bucket cold-start row
+        (``PIO_COLDSTART_MODE=hash``; docs/streaming.md): score the catalog
+        with the user's bucket embedding in host numpy — a real (if
+        generic) recommendation instead of the empty fallback. Known users
+        never take this path, so mode=hash is bit-identical for them."""
+        cs = model.coldstart_buckets()
+        if cs is None:
+            # reference behavior: unknown user → empty itemScores
+            return PredictedResult()
+        row = cs.user_rows[cs.user_bucket(query.user)]
+        k = model.mf.config.rank
+        item_emb, item_bias = model._cold_item_table()
+        scores = item_emb @ row[:k] + item_bias + row[k] + model.mf.mean
+        if banned:
+            scores = scores.copy()
+            scores[np.fromiter(banned, np.int64)] = -np.inf
+        num = min(query.num, len(scores))
+        if num <= 0:
+            return PredictedResult()
+        part = np.argpartition(-scores, num - 1)[:num]
+        order = part[np.argsort(-scores[part])]
+        inv = model.item_map.inverse()
+        return PredictedResult(tuple(
+            ItemScore(inv[int(i)], float(scores[i]))
+            for i in order if np.isfinite(scores[i])
+        ))
+
     def predict(self, model: RecModel, query: Query) -> PredictedResult:
         uidx = model.user_map.get(query.user)
         if uidx is None:
-            # unknown user → empty result (reference returns empty itemScores)
-            return PredictedResult()
+            # unknown user → cold-start bucket row when enabled, else the
+            # reference's empty result
+            return self._coldstart_predict(
+                model, query, self._banned(model, query))
         banned = self._banned(model, query)
         # device-side -inf exclude mask: bucket shapes stay untouched
         idx, scores = TwoTowerMF.recommend(
@@ -532,8 +632,12 @@ class ALSAlgorithm(PAlgorithm):
         if not queries:
             return []
         known = [(qi, q) for qi, q in queries if q.user in model.user_map]
+        # unknown users: cold-start bucket scoring when enabled (host
+        # numpy, per query — cold traffic is the tail, not the hot path),
+        # else the reference's empty result
         out: list[tuple[int, PredictedResult]] = [
-            (qi, PredictedResult()) for qi, q in queries if q.user not in model.user_map
+            (qi, self._coldstart_predict(model, q, self._banned(model, q)))
+            for qi, q in queries if q.user not in model.user_map
         ]
         if known:
             from incubator_predictionio_tpu.models.two_tower import (

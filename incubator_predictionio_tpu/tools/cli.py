@@ -729,6 +729,69 @@ def cmd_wal(args, storage: Storage) -> int:
     return 0
 
 
+def cmd_stream(args, storage: Storage) -> int:
+    """Streaming incremental updates (docs/streaming.md): tail the
+    eventlog change feed, fold events into embedding-row deltas, and ship
+    them to the given replicas as versioned delta deploys — crash-safe and
+    exactly-once (cursor + delta archive live in ``--state-dir``).
+
+    ``--status`` prints the stream state (cursor, quarantine, dead
+    letters) without folding; ``--dead-letter`` prints the dead-lettered
+    poison events as JSON lines; ``--once`` runs a single
+    poll→fold→ship→commit round and exits (the chaos tests drive this)."""
+    from incubator_predictionio_tpu.streaming.feed import resolve_feed_path
+    from incubator_predictionio_tpu.streaming.updater import (
+        StreamUpdater,
+        UpdaterConfig,
+        inspect_state_dir,
+        load_base_model,
+    )
+
+    if args.status:
+        # strictly read-only: no model load, no cursor creation, no
+        # instance-change state reset — safe beside a live updater
+        info = inspect_state_dir(args.state_dir)
+        _out(json.dumps(info, indent=2, default=str))
+        return 1 if info["quarantine"] else 0
+    if args.dead_letter:
+        from incubator_predictionio_tpu.resilience.wal import tail_frames
+
+        path = os.path.join(args.state_dir, "deadletter.log")
+        if not os.path.exists(path):
+            _out("No dead letters.")
+            return 0
+        records, _, status = tail_frames(path)
+        for _, rec in records:
+            _out(json.dumps(rec))
+        if status == "corrupt":
+            _err("dead-letter file has a corrupt frame past the listed "
+                 "records")
+            return 1
+        return 0
+    model, instance_id, event_names, defaults = load_base_model(
+        args.engine_variant, storage)
+    feed_path = args.feed_path or resolve_feed_path(
+        storage, args.app, args.channel)
+    cfg = UpdaterConfig(
+        state_dir=args.state_dir,
+        feed_path=feed_path,
+        replicas=tuple(args.replica or ()),
+        access_key=args.server_access_key,
+        batch_events=args.batch_events,
+        poll_interval=args.interval,
+        from_start=args.from_start,
+    )
+    updater = StreamUpdater(cfg, model, instance_id,
+                            event_names=event_names,
+                            default_values=defaults)
+    if args.once:
+        out = updater.run_once()
+        _out(json.dumps(out, default=str))
+        return 1 if out["status"] == "quarantined" else 0
+    updater.run_forever(max_batches=args.max_batches)
+    return 1 if updater.quarantined else 0
+
+
 def _fetch_health(url: str, timeout: float = 5.0) -> dict:
     """GET <url>/health, parsed. Module-level so tests can stub it; the
     single implementation lives in fleet/health.py (the router's watcher
@@ -773,6 +836,13 @@ def _health_row(url: str, h: Optional[dict], err: Optional[str]) -> dict:
         "throttled")
     if throttled:
         parts.append(f"throttled {throttled}")
+    # streaming update lag (docs/streaming.md): chain position + freshness
+    stream = (h.get("deployment") or {}).get("streaming") or {}
+    if stream.get("lastDeltaSeq") is not None:
+        lag = stream.get("stalenessSeconds")
+        parts.append(
+            f"deltaSeq {stream['lastDeltaSeq']}"
+            + (f", staleness {lag:.0f}s" if lag is not None else ""))
     status = h.get("status", "unknown")
     return {"url": url, "status": status, "red": status != "ok",
             "detail": "; ".join(parts)}
@@ -1485,6 +1555,45 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--server-access-key")
     p.add_argument("--json", action="store_true")
 
+    # stream — incremental model updates from the live event feed
+    p = sub.add_parser(
+        "stream",
+        help="streaming incremental updates: tail the eventlog change "
+             "feed, fold events into embedding-row deltas, ship them to "
+             "replicas as exactly-once delta deploys (docs/streaming.md)")
+    p.add_argument("-v", "--engine-variant", default="engine.json")
+    p.add_argument("--app", default="recommendation",
+                   help="app whose eventlog to tail")
+    p.add_argument("--channel", help="channel name (default: none)")
+    p.add_argument("--state-dir", required=True,
+                   help="cursor + trainer state + delta archive + dead "
+                        "letters (crash-safe; single-writer)")
+    p.add_argument("--feed-path",
+                   help="explicit .piolog path (default: resolved from "
+                        "the configured eventlog backend and --app)")
+    p.add_argument("--replica", action="append",
+                   help="query-server base URL to ship deltas to "
+                        "(repeatable)")
+    p.add_argument("--server-access-key",
+                   help="the replicas' --server-access-key (guards "
+                        "POST /delta)")
+    p.add_argument("--batch-events", type=int, default=512,
+                   help="max events folded per delta (PIO_STREAM_BATCH)")
+    p.add_argument("--interval", type=float, default=1.0,
+                   help="seconds between idle polls")
+    p.add_argument("--once", action="store_true",
+                   help="one poll→fold→ship→commit round, then exit")
+    p.add_argument("--max-batches", type=int,
+                   help="exit after this many applied deltas")
+    p.add_argument("--from-start", action="store_true",
+                   help="start a fresh cursor at the BEGINNING of the log "
+                        "instead of its current end (fold history too)")
+    p.add_argument("--status", action="store_true",
+                   help="print stream state (cursor, quarantine, dead "
+                        "letters) and exit; non-zero when quarantined")
+    p.add_argument("--dead-letter", action="store_true",
+                   help="print dead-lettered poison events as JSON lines")
+
     # wal — inspect/verify/replay an event-server spill WAL
     p = sub.add_parser(
         "wal",
@@ -1568,6 +1677,7 @@ _COMMANDS = {
     "health": cmd_health,
     "index": cmd_index,
     "wal": cmd_wal,
+    "stream": cmd_stream,
     "start-all": cmd_start_all,
     "stop-all": cmd_stop_all,
     "redeploy": cmd_redeploy,

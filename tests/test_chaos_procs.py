@@ -579,6 +579,229 @@ def test_fleet_router_absorbs_replica_kill9_mid_storm(tmp_path):
             proc.stop()
 
 
+# ---------------------------------------------------------------------------
+# streaming chaos (ISSUE 8): SIGKILL the updater between delta-ship and
+# cursor-commit, and a replica mid-delta-apply — zero events lost, zero
+# applied twice, serving never observes a half-applied table
+# ---------------------------------------------------------------------------
+
+
+def _train_recommendation_eventlog(tmp_path):
+    """Train the recommendation template with EVENTDATA on the eventlog
+    backend (the streaming change feed) and META/MODEL on sqlite; returns
+    (store_cfg, variant_path, app_user_items). The test process keeps the
+    single eventlog writer and appends live events mid-test; the updater
+    and replicas only read."""
+    import datetime as dt
+
+    import numpy as np
+
+    from incubator_predictionio_tpu.core.workflow import run_train
+    from incubator_predictionio_tpu.data import DataMap, Event
+    from incubator_predictionio_tpu.data.storage import use_storage
+    from incubator_predictionio_tpu.data.storage.base import EngineInstance
+    from incubator_predictionio_tpu.parallel.mesh import MeshContext
+    from incubator_predictionio_tpu.templates.recommendation import (
+        RecommendationEngine,
+    )
+
+    utc = dt.timezone.utc
+    store_cfg = {
+        "PIO_STORAGE_SOURCES_SQ_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_SQ_PATH": str(tmp_path / "store.db"),
+        "PIO_STORAGE_SOURCES_EL_TYPE": "eventlog",
+        "PIO_STORAGE_SOURCES_EL_PATH": str(tmp_path / "eventlog"),
+        **{f"PIO_STORAGE_REPOSITORIES_{repo}_SOURCE": src
+           for repo, src in (("METADATA", "SQ"), ("EVENTDATA", "EL"),
+                             ("MODELDATA", "SQ"))},
+    }
+    storage = Storage(store_cfg)
+    prev = use_storage(storage)
+    try:
+        app_id = storage.get_meta_data_apps().insert(App(0, "stream-app"))
+        events = storage.get_events()
+        events.init(app_id)
+        rng = np.random.default_rng(11)
+        batch = [
+            Event(event="rate", entity_type="user",
+                  entity_id=f"u{int(rng.integers(0, 20))}",
+                  target_entity_type="item",
+                  target_entity_id=f"i{int(rng.integers(0, 30))}",
+                  properties=DataMap(
+                      {"rating": float(rng.integers(1, 6))}),
+                  event_time=dt.datetime(2023, 1, 1, tzinfo=utc))
+            for _ in range(240)
+        ]
+        events.insert_batch(batch, app_id)
+        variant_path = str(tmp_path / "engine.json")
+        variant = {
+            "id": "stream", "version": "1",
+            "engineFactory": ("incubator_predictionio_tpu.templates."
+                              "recommendation.RecommendationEngine"),
+            "datasource": {"params": {"appName": "stream-app"}},
+            "algorithms": [{"name": "als", "params": {
+                "rank": 8, "numIterations": 8, "batchSize": 256}}],
+        }
+        with open(variant_path, "w") as f:
+            json.dump(variant, f)
+        engine = RecommendationEngine().apply()
+        engine_params = engine.engine_params_from_variant(variant)
+        instance = EngineInstance(
+            id="", status="INIT", start_time=dt.datetime.now(utc),
+            end_time=None, engine_id="stream", engine_version="1",
+            engine_variant=os.path.abspath(variant_path),
+            engine_factory=variant["engineFactory"])
+        run_train(engine, engine_params, instance, storage=storage,
+                  ctx=MeshContext.create())
+    finally:
+        use_storage(prev)
+    return storage, store_cfg, variant_path, app_id
+
+
+def _append_live_events(storage, app_id, tag, n=12):
+    """Post-train events the streaming pipeline must fold (the test
+    process is the single eventlog writer)."""
+    import datetime as dt
+
+    from incubator_predictionio_tpu.data import DataMap, Event
+
+    utc = dt.timezone.utc
+    storage.get_events().insert_batch([
+        Event(event="rate", entity_type="user", entity_id=f"u{i % 20}",
+              target_entity_type="item", target_entity_id=f"i{i % 30}",
+              properties=DataMap({"rating": 5.0}),
+              event_time=dt.datetime(2023, 6, 1, i % 20, tzinfo=utc))
+        for i in range(n)
+    ], app_id)
+
+
+def _run_stream_once(store_cfg, variant_path, state_dir, replica_url,
+                     fault=None, timeout=240):
+    env = {**os.environ, **store_cfg, "JAX_PLATFORMS": "cpu",
+           "PIO_NATIVE_HTTP": "0"}
+    if fault:
+        env["PIO_STREAM_FAULT"] = fault
+    else:
+        env.pop("PIO_STREAM_FAULT", None)
+    from tests.fixtures.procs import REPO_ROOT
+
+    return subprocess.run(
+        [sys.executable, "-m", "incubator_predictionio_tpu.tools.cli",
+         "stream", "-v", variant_path, "--app", "stream-app",
+         "--state-dir", state_dir, "--replica", replica_url, "--once"],
+        cwd=REPO_ROOT, env=env, capture_output=True, text=True,
+        timeout=timeout)
+
+
+def _stream_health(base):
+    _, health = http_json("GET", f"{base}/health")
+    return (health["deployment"] or {}).get("streaming")
+
+
+def test_streaming_updater_kill9_between_ship_and_commit(tmp_path):
+    """ISSUE 8 acceptance: SIGKILL the updater after the delta shipped but
+    before the cursor committed. The restarted updater re-folds the same
+    range; the replica ends with the chain applied EXACTLY once and the
+    cursor catches up — zero lost, zero double-applied."""
+    storage, store_cfg, variant_path, app_id = \
+        _train_recommendation_eventlog(tmp_path)
+    qport = free_port()
+    base = f"http://127.0.0.1:{qport}"
+    qs = ServerProc(["deploy", "-v", variant_path, "--ip", "127.0.0.1",
+                     "--port", str(qport)], env=store_cfg)
+    try:
+        qs.wait_ready(f"{base}/", timeout=180.0)
+        state_dir = str(tmp_path / "stream-state")
+        # run 0 establishes the crash-safe cursor at the log's current end
+        # (the updater tails from where it starts, like production)
+        r0 = _run_stream_once(store_cfg, variant_path, state_dir, base)
+        assert r0.returncode == 0, r0.stdout + r0.stderr
+        _append_live_events(storage, app_id, "a")
+        # run 1: dies by SIGKILL right after shipping, before the commit
+        r1 = _run_stream_once(store_cfg, variant_path, state_dir, base,
+                              fault="kill:after_ship")
+        assert r1.returncode == -9, (r1.returncode, r1.stdout, r1.stderr)
+        s1 = _stream_health(base)
+        assert s1 is not None and s1["applied"] == 1, s1
+        applied_seq = s1["lastDeltaSeq"]
+        # run 2: clean restart over the same state dir — the re-fold
+        # produces the identical range; the replica must NOT apply twice
+        r2 = _run_stream_once(store_cfg, variant_path, state_dir, base)
+        assert r2.returncode == 0, r2.stdout + r2.stderr
+        out = json.loads(r2.stdout.strip().splitlines()[-1])
+        assert out["status"] == "applied"
+        assert out["toSeq"] == applied_seq
+        s2 = _stream_health(base)
+        assert s2["applied"] == 1, f"delta applied twice: {s2}"
+        assert s2["lastDeltaSeq"] == applied_seq
+        # freshness is now reported
+        assert s2["stalenessSeconds"] is not None
+        # run 3: nothing new — idle, still exactly once
+        r3 = _run_stream_once(store_cfg, variant_path, state_dir, base)
+        out3 = json.loads(r3.stdout.strip().splitlines()[-1])
+        assert out3["status"] in ("idle", "waiting")
+        assert _stream_health(base)["applied"] == 1
+        # serving stayed healthy throughout
+        status, body = http_json(
+            "POST", f"{base}/queries.json", {"user": "u1", "num": 3})
+        assert status == 200 and body["itemScores"]
+    finally:
+        qs.stop()
+        storage.close()
+
+
+def test_streaming_replica_kill9_mid_delta_apply_resyncs(tmp_path):
+    """SIGKILL the replica in the middle of a delta apply (tables built,
+    swap not reached). After restart it serves the BASE model — never a
+    half-applied table — and the updater's resync replays the archived
+    chain so nothing is lost and nothing applies twice."""
+    storage, store_cfg, variant_path, app_id = \
+        _train_recommendation_eventlog(tmp_path)
+    qport = free_port()
+    base = f"http://127.0.0.1:{qport}"
+    qs = ServerProc(["deploy", "-v", variant_path, "--ip", "127.0.0.1",
+                     "--port", str(qport)],
+                    env={**store_cfg,
+                         "PIO_DELTA_FAULT": "kill:mid_apply"})
+    try:
+        qs.wait_ready(f"{base}/", timeout=180.0)
+        state_dir = str(tmp_path / "stream-state")
+        r0 = _run_stream_once(store_cfg, variant_path, state_dir, base)
+        assert r0.returncode == 0, r0.stdout + r0.stderr
+        _append_live_events(storage, app_id, "b")
+        # the ship kills the replica mid-apply; the updater still commits
+        # (the archive is the source of truth; resync delivers later)
+        r1 = _run_stream_once(store_cfg, variant_path, state_dir, base)
+        assert r1.returncode == 0, r1.stdout + r1.stderr
+        out = json.loads(r1.stdout.strip().splitlines()[-1])
+        assert out["status"] == "applied"
+        assert "error" in out["ships"][0]
+        qs.proc.wait(timeout=30)
+        # restart WITHOUT the fault: base model, nothing half-applied
+        qs2 = ServerProc(["deploy", "-v", variant_path, "--ip", "127.0.0.1",
+                          "--port", str(qport)], env=store_cfg)
+        try:
+            qs2.wait_ready(f"{base}/", timeout=180.0)
+            assert _stream_health(base) is None  # clean base, no partial
+            status, _ = http_json(
+                "POST", f"{base}/queries.json", {"user": "u1", "num": 3})
+            assert status == 200
+            # idle round resyncs the archived chain into the replica
+            r2 = _run_stream_once(store_cfg, variant_path, state_dir, base)
+            assert r2.returncode == 0, r2.stdout + r2.stderr
+            s = _stream_health(base)
+            assert s is not None and s["applied"] == 1
+            assert s["lastDeltaSeq"] == out["toSeq"]
+            status, body = http_json(
+                "POST", f"{base}/queries.json", {"user": "u1", "num": 3})
+            assert status == 200 and body["itemScores"]
+        finally:
+            qs2.stop()
+    finally:
+        qs.stop()
+        storage.close()
+
+
 def test_event_server_sigterm_drains_and_exits_clean(tmp_path):
     """Graceful drain end-to-end: SIGTERM → new ingest 503s, the spilled
     acks flush to the recovered store, the process exits 0 within the
