@@ -1329,6 +1329,240 @@ def bench_fleet(ctx) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# 7d. storage failover (docs/replication.md): sustained ingest, SIGKILL the
+#     primary storage server, promote the follower — MTTR and zero acked
+#     loss through the quorum-replicated eventlog
+# ---------------------------------------------------------------------------
+
+
+def bench_storage_failover() -> dict:
+    """Replicated storage pair (quorum ack) behind a real event-server
+    subprocess whose EVENTDATA source lists BOTH endpoints
+    (PIO_STORAGE_SOURCES_R_URLS): ingest at a steady rate, SIGKILL the
+    primary mid-stream, promote the follower, and measure MTTR — kill →
+    first write verifiably landed on the promoted follower — plus the
+    recovery invariants (zero acked loss, zero duplicates, bumped epoch).
+    Replication + fencing metric deltas from the survivor ride along."""
+    import tempfile
+    import threading
+    import urllib.request
+
+    from incubator_predictionio_tpu.parallel.launcher import free_port
+    from tests.fixtures.procs import ServerProc, http_json
+
+    tmp = tempfile.mkdtemp(prefix="pio-bench-failover-")
+    pre_s = 2.0 if SMALL else 4.0
+    from incubator_predictionio_tpu.data.storage import (
+        AccessKey,
+        App,
+        Storage,
+    )
+
+    meta = Storage({
+        "PIO_STORAGE_SOURCES_SQ_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_SQ_PATH": os.path.join(tmp, "es-meta.db"),
+    })
+    app_id = meta.get_meta_data_apps().insert(App(0, "failover-bench"))
+    key = meta.get_meta_data_access_keys().insert(AccessKey("", app_id, ()))
+    meta.close()
+
+    pport, fport, eport = free_port(), free_port(), free_port()
+    purl, furl = f"http://127.0.0.1:{pport}", f"http://127.0.0.1:{fport}"
+
+    def store_env(name):
+        return {
+            "PIO_STORAGE_SOURCES_EL_TYPE": "eventlog",
+            "PIO_STORAGE_SOURCES_EL_PATH": os.path.join(tmp, f"{name}-log"),
+            "PIO_STORAGE_SOURCES_SQ_TYPE": "sqlite",
+            "PIO_STORAGE_SOURCES_SQ_PATH": os.path.join(tmp, f"{name}.db"),
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "EL",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQ",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SQ",
+        }
+
+    follower = ServerProc(
+        ["storageserver", "--ip", "127.0.0.1", "--port", str(fport),
+         "--repl-role", "follower", "--repl-sync", "quorum",
+         "--repl-peer", purl], env=store_env("f"))
+    primary = ServerProc(
+        ["storageserver", "--ip", "127.0.0.1", "--port", str(pport),
+         "--repl-role", "primary", "--repl-sync", "quorum",
+         "--repl-peer", furl], env=store_env("p"))
+    es = ServerProc(
+        ["eventserver", "--ip", "127.0.0.1", "--port", str(eport)],
+        env={
+            "PIO_STORAGE_SOURCES_R_TYPE": "remote",
+            "PIO_STORAGE_SOURCES_R_URLS": f"{purl},{furl}",
+            "PIO_STORAGE_SOURCES_R_TIMEOUT": "3",
+            "PIO_STORAGE_SOURCES_R_RETRY_MAX_ATTEMPTS": "1",
+            "PIO_STORAGE_SOURCES_SQ_TYPE": "sqlite",
+            "PIO_STORAGE_SOURCES_SQ_PATH": os.path.join(tmp, "es-meta.db"),
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "R",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQ",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SQ",
+            "PIO_EVENT_WAL_DIR": os.path.join(tmp, "wal"),
+            "PIO_EVENTSERVER_AUTH_TTL": "600",
+            "PIO_EVENTSERVER_BREAKER_THRESHOLD": "2",
+            "PIO_EVENTSERVER_BREAKER_RESET": "0.3",
+            "PIO_RESILIENCE_BREAKER_RESET": "0.3",
+        })
+
+    acked: list = []
+    stop = threading.Event()
+    base = f"http://127.0.0.1:{eport}"
+    event_body = {"event": "view", "entityType": "user",
+                  "eventTime": "2024-01-01T00:00:00Z"}
+
+    def ingest_loop():
+        i = 0
+        while not stop.is_set():
+            try:
+                status, body = http_json(
+                    "POST", f"{base}/events.json?accessKey={key}",
+                    dict(event_body, entityId=f"u{i}"), timeout=10.0)
+                if status == 201:
+                    acked.append(body["eventId"])
+            except Exception:  # noqa: BLE001 - ambiguous, not acked
+                pass
+            i += 1
+            time.sleep(0.01)
+
+    def snap_metrics(url):
+        try:
+            with urllib.request.urlopen(f"{url}/metrics", timeout=5) as r:
+                return _metrics_snapshot(r.read().decode())
+        except Exception as e:  # noqa: BLE001
+            return {"error": repr(e)}
+
+    loader = threading.Thread(target=ingest_loop, daemon=True)
+    try:
+        follower.wait_ready(f"{furl}/")
+        primary.wait_ready(f"{purl}/")
+        es.wait_ready(f"{base}/")
+        base_metrics = snap_metrics(furl)
+        t0 = time.monotonic()
+        loader.start()
+        time.sleep(pre_s)
+        pre_acked = len(acked)
+        pre_qps = pre_acked / (time.monotonic() - t0)
+
+        # SIGKILL the primary, promote the survivor (solo replica set —
+        # the dead primary rejoins via `pio-tpu store scrub`)
+        t_kill = time.monotonic()
+        primary.kill9()
+        t_reaped = time.monotonic()
+        st, body = http_json("POST", f"{furl}/repl/promote",
+                             {"peers": []}, timeout=10.0)
+        assert st == 200, (st, body)
+        t_promoted = time.monotonic()
+
+        # MTTR: first write verifiably ON the promoted follower (write a
+        # probe event through the event server, read it back from the
+        # follower's RPC surface)
+        mttr = None
+        deadline = time.monotonic() + 60.0
+        probe_n = 0
+        while time.monotonic() < deadline:
+            status, body = http_json(
+                "POST", f"{base}/events.json?accessKey={key}",
+                dict(event_body, entityId=f"probe-{probe_n}"),
+                timeout=10.0)
+            probe_n += 1
+            if status == 201:
+                acked.append(body["eventId"])
+                st2, got = http_json(
+                    "POST", f"{furl}/rpc/events/get",
+                    {"event_id": body["eventId"], "app_id": app_id},
+                    timeout=5.0)
+                if st2 == 200 and got.get("result") is not None:
+                    mttr = time.monotonic() - t_kill
+                    break
+            time.sleep(0.05)
+        stop.set()
+        loader.join(timeout=10.0)
+
+        # drain the spill, then verify the invariants
+        drain_deadline = time.monotonic() + 60.0
+        spill_depth = None
+        while time.monotonic() < drain_deadline:
+            st, h = http_json("GET", f"{base}/health", timeout=5.0)
+            spill_depth = h.get("spillQueueDepth")
+            if st == 200 and spill_depth == 0:
+                break
+            time.sleep(0.1)
+        _, fh = http_json("GET", f"{furl}/health")
+        after_metrics = snap_metrics(furl)
+
+        from incubator_predictionio_tpu.data.storage.remote import (
+            RemoteStorageClient,
+        )
+
+        reader = RemoteStorageClient({"URL": furl, "TIMEOUT": "10"})
+        ids = [e.event_id for e in reader.events().find(app_id)]
+        lost = sorted(set(acked) - set(ids))
+        dup = len(ids) - len(set(ids))
+        if lost:
+            # forensics BEFORE failing: where did each lost ack's bytes
+            # end up? (p-log = unreplicated primary suffix, wal = event
+            # server's spill, deadLettered = drain diverted it)
+            from incubator_predictionio_tpu.resilience.wal import (
+                inspect_dir,
+            )
+
+            def grep(path, needle):
+                try:
+                    with open(path, "rb") as fh:
+                        return needle.encode() in fh.read()
+                except OSError:
+                    return None
+
+            st_h, es_h = http_json("GET", f"{base}/health", timeout=5.0)
+            forensics = {
+                "deadLettered": es_h.get("deadLettered"),
+                "wal": inspect_dir(os.path.join(tmp, "wal")),
+                "lost": {
+                    lid: {
+                        "in_primary_log": grep(os.path.join(
+                            tmp, "p-log", "app_1.piolog"), lid),
+                        "in_follower_log": grep(os.path.join(
+                            tmp, "f-log", "app_1.piolog"), lid),
+                    } for lid in lost[:8]},
+            }
+            raise AssertionError(
+                f"acked events lost across failover: {lost[:8]} — "
+                f"{json.dumps(forensics, default=str)}")
+        assert dup == 0, f"{dup} duplicate ids served"
+        repl_delta = {
+            k: v for k, v in _snapshot_delta(base_metrics,
+                                             after_metrics).items()
+            if k.startswith(("pio_repl_", "pio_scrub_"))}
+        return {
+            "pre_failover_ack_qps": round(pre_qps, 1),
+            "acked_total": len(acked),
+            "stored_total": len(ids),
+            "acked_lost": len(lost),
+            "duplicate_ids": dup,
+            "mttr_s": round(mttr, 3) if mttr is not None else None,
+            "kill_reap_s": round(t_reaped - t_kill, 3),
+            "promote_rpc_s": round(t_promoted - t_reaped, 3),
+            "final_spill_depth": spill_depth,
+            "epoch_after": (fh.get("replication") or {}).get("epoch"),
+            "role_after": (fh.get("replication") or {}).get("role"),
+            # lag/fencing/repair counters across the whole run, survivor's
+            # point of view (applied bytes = everything quorum shipped)
+            "survivor_repl_metrics_delta": repl_delta,
+        }
+    finally:
+        stop.set()
+        es.stop()
+        primary.stop()
+        follower.stop()
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
 # 8. event-server ingestion throughput (EventServer.scala:261-462 hot path)
 # ---------------------------------------------------------------------------
 
@@ -1562,12 +1796,13 @@ def build_result_line(configs: dict, device_info: dict,
 CONFIG_NAMES = ["recommendation", "recommendation_scaled", "classification",
                 "similarproduct", "ecommerce_retrieval", "retrieval_scale",
                 "sequential", "serving", "overload", "fleet", "ingestion",
-                "ingest_durability", "streaming_freshness"]
+                "ingest_durability", "streaming_freshness",
+                "storage_failover"]
 # "fleet" is device-free too: its replicas are CPU subprocesses (a fleet
 # on one host) — the scenario measures the ROUTER's horizontal scaling,
 # not chip throughput
 DEVICE_FREE = {"ingestion", "ingest_durability", "fleet",
-               "streaming_freshness"}
+               "streaming_freshness", "storage_failover"}
 
 
 def _build_suite(ctx, peaks, device) -> dict:
@@ -1586,6 +1821,7 @@ def _build_suite(ctx, peaks, device) -> dict:
         "ingestion": lambda: bench_ingestion(),
         "ingest_durability": lambda: bench_ingest_durability(),
         "streaming_freshness": lambda: bench_streaming_freshness(),
+        "storage_failover": lambda: bench_storage_failover(),
     }
 
 

@@ -42,6 +42,15 @@ from incubator_predictionio_tpu.native import (
 from incubator_predictionio_tpu.native import format as fmt
 
 
+class ReadOnlyLogError(StorageError):
+    """A write hit a log opened read-only (another process holds the
+    writer flock, or this store is a replication follower). Distinct from
+    plain :class:`StorageError` because the condition is TRANSIENT
+    cluster-wise — a role flip or failover resolves it — so the storage
+    server answers 503 (retry/spill) instead of a semantic 500 that would
+    send acked events to the dead-letter segment."""
+
+
 class _Log:
     """One open log file: append handle + in-memory id index + string table.
 
@@ -176,9 +185,10 @@ class _Log:
 
     def _require_writer(self) -> None:
         if self.f is None:
-            raise StorageError(
+            raise ReadOnlyLogError(
                 f"event log {self.path} opened read-only (another process "
-                "holds the writer lock); route writes through that process"
+                "holds the writer lock, or this store is a replication "
+                "follower); route writes through the writer/primary"
             )
 
     def append_event(self, event: Event, event_id: str) -> None:
@@ -245,11 +255,17 @@ def _pread(path: str, offset: int, n: int) -> bytes:
 
 
 class EventLogEvents(EventStore):
-    def __init__(self, base_dir: str):
+    def __init__(self, base_dir: str, read_only: bool = False):
         self.base_dir = base_dir
         os.makedirs(base_dir, exist_ok=True)
         self._logs: dict[tuple[int, Optional[int]], _Log] = {}
         self._lock = threading.RLock()
+        # replication follower mode (replication/manager.py): every log
+        # opens as a lock-free read-only view, never a flock'd writer —
+        # the replicated appends own the files, and a writer opened here
+        # would both block them and truncate "torn" tails that are really
+        # just chunks still in flight
+        self._read_only = read_only
 
     def _path(self, app_id: int, channel_id: Optional[int]) -> str:
         name = f"app_{app_id}" + (f"_{channel_id}" if channel_id is not None else "")
@@ -272,14 +288,35 @@ class EventLogEvents(EventStore):
                     raise StorageError(
                         f"event log for app {app_id} channel {channel_id} not initialized"
                     )
-                try:
-                    log = _Log(path)
-                except StorageError:
-                    # another process (the event server) holds the writer
-                    # lock — serve reads from a lock-free read-only view
+                if self._read_only:
                     log = _Log(path, read_only=True)
+                else:
+                    try:
+                        log = _Log(path)
+                    except StorageError:
+                        # another process (the event server) holds the writer
+                        # lock — serve reads from a lock-free read-only view
+                        log = _Log(path, read_only=True)
                 self._logs[key] = log
             return log
+
+    def set_read_only(self, read_only: bool) -> None:
+        """Flip follower mode (replication role changes). Open logs are
+        dropped so the next access re-opens in the new mode — a promotion
+        re-acquires writer flocks, a demotion releases them."""
+        with self._lock:
+            self._read_only = read_only
+            self.reopen()
+
+    def reopen(self) -> None:
+        """Close and forget every open log so the next access re-reads
+        disk state from scratch. Used on replication role changes and
+        after an anti-entropy repair patched bytes a cached view may have
+        already parsed."""
+        with self._lock:
+            for log in self._logs.values():
+                log.close()
+            self._logs.clear()
 
     # -- lifecycle --------------------------------------------------------
     def init(self, app_id: int, channel_id: Optional[int] = None) -> bool:
@@ -623,7 +660,11 @@ class EventLogStorageClient(StorageClient):
         if not path:
             base = os.environ.get("PIO_FS_BASEDIR", os.path.expanduser("~/.pio_store"))
             path = os.path.join(base, "eventlog")
-        self._events = EventLogEvents(path)
+        # READ_ONLY=1: replication-follower mode (serve reads beside the
+        # replicated appends without ever taking a writer flock)
+        self._events = EventLogEvents(
+            path, read_only=str(config.get("READ_ONLY", "")).lower()
+            in ("1", "true", "yes"))
 
     def events(self) -> EventStore:
         return self._events

@@ -67,6 +67,7 @@ from incubator_predictionio_tpu.data.storage.base import (
 )
 from incubator_predictionio_tpu.data.storage.registry import register_backend
 from incubator_predictionio_tpu.obs import trace as _obs_trace
+from incubator_predictionio_tpu.resilience.breaker import CircuitOpenError
 from incubator_predictionio_tpu.resilience.policy import (
     TRANSIENT_HTTP_CODES,
     Deadline,
@@ -95,6 +96,19 @@ logger = logging.getLogger(__name__)
 _CLIENT_ID = f"{socket.gethostname()}:{os.getpid()}"
 
 
+class FencedWrite(TransientError):
+    """The storage server rejected a write because it is not the
+    current-epoch primary (409 + ``X-PIO-Fenced``, docs/replication.md).
+    Nothing was applied, so failing over to the real primary and
+    re-sending is always safe — and cluster-wise the condition is
+    transient (a TransientError subclass: the event server spills and
+    the drain lands the write on the promoted primary). ``no_retry``:
+    retrying the SAME endpoint can never unfence it — fail fast to the
+    multi-endpoint failover instead of burning the retry budget."""
+
+    no_retry = True
+
+
 class _Transport:
     """Thread-local persistent connections; idempotent calls get one retry on
     stale sockets, non-idempotent writes never auto-retry (an insert whose
@@ -117,6 +131,9 @@ class _Transport:
         self.host = p.hostname or "127.0.0.1"
         self.port = p.port or (443 if p.scheme == "https" else 7072)
         self.scheme = p.scheme
+        #: the endpoint every error message names — with multi-endpoint
+        #: sources, "connection refused" without an address is undebuggable
+        self.url_label = f"{self.scheme}://{self.host}:{self.port}"
         self.key = key
         self.timeout = timeout
         self.ca_cert = ca_cert
@@ -195,13 +212,22 @@ class _Transport:
             resp = conn.getresponse()
             self._local.last_used = time.monotonic()
             status, data = resp.status, resp.read()
+            if status == 409 and resp.getheader("X-PIO-Fenced"):
+                # epoch-fenced write (docs/replication.md): this endpoint
+                # is a demoted/stale primary or a follower — nothing was
+                # applied; the multi-endpoint transport re-probes for the
+                # real primary on this signal
+                raise FencedWrite(
+                    f"remote storage {self.url_label}{path}: write fenced "
+                    f"(server epoch {resp.getheader('X-PIO-Fenced')}): "
+                    f"{data[:256].decode(errors='replace')}")
             if status in TRANSIENT_HTTP_CODES:
                 # gateway/restart blip (429/502/503/504): retryable like a
                 # connection failure — same classification as the other
                 # HTTP backends. (500 stays semantic: a storage-server 500
                 # is a handler bug, not an outage.)
                 raise TransientError(
-                    f"remote storage {path}: {status} "
+                    f"remote storage {self.url_label}{path}: {status} "
                     f"{data[:256].decode(errors='replace')}")
             return status, data
         except (http.client.HTTPException, ConnectionError, OSError) as e:
@@ -210,7 +236,8 @@ class _Transport:
                 conn.close()
             except Exception:  # noqa: BLE001
                 pass
-            raise TransientError(f"remote storage unreachable: {e!r}") from e
+            raise TransientError(
+                f"remote storage {self.url_label} unreachable: {e!r}") from e
 
     def request(self, path: str, body: dict,
                 idempotent: bool = True) -> tuple[int, bytes]:
@@ -244,15 +271,18 @@ class _Transport:
             except (http.client.HTTPException, ConnectionError, OSError) as e:
                 conn.close()
                 raise TransientError(
-                    f"remote storage unreachable: {e}") from e
+                    f"remote storage {self.url_label} unreachable: {e}"
+                ) from e
             if resp.status != 200:
                 detail = resp.read(2048).decode(errors="replace")
                 conn.close()
                 if resp.status in TRANSIENT_HTTP_CODES:
                     raise TransientError(
-                        f"remote storage {path}: {resp.status} {detail}")
+                        f"remote storage {self.url_label}{path}: "
+                        f"{resp.status} {detail}")
                 raise StorageError(
-                    f"remote storage {path} failed: {resp.status} {detail}")
+                    f"remote storage {self.url_label}{path} failed: "
+                    f"{resp.status} {detail}")
             return resp, conn
 
         return self.policy.call(attempt, idempotent=True, op=path)
@@ -270,11 +300,12 @@ class _Transport:
             f"/rpc/{store}/{method}", args,
             idempotent=method in self._IDEMPOTENT)
         if status == 401:
-            raise StorageError("remote storage: unauthorized (bad KEY)")
+            raise StorageError(
+                f"remote storage {self.url_label}: unauthorized (bad KEY)")
         if status != 200:
             raise StorageError(
-                f"remote storage {store}.{method} failed: {status} "
-                f"{data[:2048].decode(errors='replace')}")
+                f"remote storage {self.url_label} {store}.{method} failed: "
+                f"{status} {data[:2048].decode(errors='replace')}")
         return json.loads(data)["result"]
 
 
@@ -282,6 +313,200 @@ def _enc_opt_filter(args: dict, key: str, value: Any) -> None:
     """UNSET → key absent; None/str → key present (see server dec_opt_filter)."""
     if value is not UNSET:
         args[key] = value
+
+
+# ---------------------------------------------------------------------------
+# multi-endpoint transport (replicated storage, docs/replication.md)
+# ---------------------------------------------------------------------------
+
+#: RPC methods a follower replica may answer (pure reads) — shared with
+#: the storage server's fence gate so the two sides cannot drift
+#: (wire.py, like the record codecs).
+from incubator_predictionio_tpu.data.storage.wire import (  # noqa: E402
+    READ_METHODS as _FOLLOWER_READS,
+)
+
+
+class _MultiTransport:
+    """One logical storage source over N replicated endpoints
+    (``PIO_STORAGE_SOURCES_<N>_URLS=url1,url2``): writes go to the
+    current primary — selected by probing each endpoint's ``/health``
+    for its replication role and epoch (highest epoch wins) — and fail
+    over automatically when the primary's per-backend breaker opens, a
+    transport error lands, or a write comes back epoch-fenced. Reads can
+    optionally (``READ_FOLLOWERS=1``) serve from a caught-up follower
+    under a bounded-staleness contract (``READ_STALENESS`` seconds since
+    the follower last heard from a primary).
+
+    Per-endpoint :class:`_Transport` instances keep their own pooled
+    connections, retry policies and circuit breakers — exactly the
+    failure isolation the fleet balancer gives query replicas."""
+
+    #: re-probe the primary at most this often while it looks healthy
+    PROBE_TTL = 5.0
+
+    def __init__(self, urls: "list[str]", key: Optional[str],
+                 timeout: float, ca_cert: Optional[str] = None,
+                 config: Optional[dict] = None):
+        if not urls:
+            raise StorageError("URLS must name at least one endpoint")
+        self.urls = list(urls)
+        self.transports = {
+            url: _Transport(url, key, timeout, ca_cert=ca_cert,
+                            config=config)
+            for url in self.urls}
+        cfg = config or {}
+        self.read_followers = str(cfg.get("READ_FOLLOWERS", "")).lower() \
+            in ("1", "true", "yes")
+        self.read_staleness_sec = float(cfg.get("READ_STALENESS", "10"))
+        self.probe_timeout = float(cfg.get("PROBE_TIMEOUT", "2"))
+        from incubator_predictionio_tpu.resilience.clock import SYSTEM_CLOCK
+
+        self.clock = SYSTEM_CLOCK  # injectable (FakeClock tests)
+        self._lock = threading.Lock()
+        self._primary_url: Optional[str] = None
+        self._followers: list[str] = []
+        self._probed_at: Optional[float] = None
+        self._probing = False  # one prober at a time; others don't block
+        self._rr = 0  # follower-read rotation
+
+    # -- probing -----------------------------------------------------------
+    def probe_health(self, url: str) -> Optional[dict]:
+        """GET ``<url>/health`` on a fresh connection (never the pooled
+        one — a probe must not race an in-flight RPC). Stubbed in tests."""
+        tp = self.transports[url]
+        conn = tp._new_conn(self.probe_timeout)
+        try:
+            conn.request("GET", "/health", headers=tp._headers())
+            resp = conn.getresponse()
+            if resp.status != 200:
+                return None
+            return json.loads(resp.read())
+        except (http.client.HTTPException, ConnectionError, OSError,
+                ValueError):
+            return None
+        finally:
+            conn.close()
+
+    def _reprobe(self) -> None:
+        """Probe every endpoint CONCURRENTLY and swap the selection in.
+        Runs outside the lock, and the probes fan out on a short-lived
+        pool (the fleet prober's pattern) — serially, one dead endpoint
+        would add its whole connect timeout to the elected prober's own
+        RPC latency every PROBE_TTL."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(
+                max_workers=min(8, len(self.urls))) as pool:
+            futures = {url: pool.submit(self.probe_health, url)
+                       for url in self.urls}
+            results = {url: fut.result() for url, fut in futures.items()}
+        best: Optional[tuple[int, str]] = None
+        followers: list[str] = []
+        for url in self.urls:
+            h = results[url]
+            if h is None:
+                continue
+            repl = h.get("replication")
+            if repl is None:
+                # unreplicated server in the list: primary-capable
+                if best is None:
+                    best = (0, url)
+                continue
+            if repl.get("fenced"):
+                continue
+            epoch = int(repl.get("epoch", 0) or 0)
+            if repl.get("role") == "primary":
+                if best is None or epoch > best[0]:
+                    best = (epoch, url)
+            else:
+                age = repl.get("contactAgeSeconds")
+                if age is not None and age <= self.read_staleness_sec:
+                    followers.append(url)
+        with self._lock:
+            self._primary_url = best[1] if best is not None else None
+            self._followers = followers
+            self._probed_at = self.clock.monotonic()
+
+    def _select(self, follower_ok: bool) -> "_Transport":
+        do_probe = False
+        with self._lock:
+            now = self.clock.monotonic()
+            stale = (self._probed_at is None
+                     or now - self._probed_at > self.PROBE_TTL
+                     or (self._primary_url is None and not follower_ok))
+            if stale and not self._probing:
+                self._probing = True
+                do_probe = True
+        if do_probe:
+            # other threads keep using the previous (possibly stale)
+            # selection meanwhile instead of queueing behind the probes
+            try:
+                self._reprobe()
+            finally:
+                with self._lock:
+                    self._probing = False
+        with self._lock:
+            if follower_ok and self.read_followers and self._followers:
+                self._rr += 1
+                url = self._followers[self._rr % len(self._followers)]
+                return self.transports[url]
+            url = self._primary_url or self.urls[0]
+            return self.transports[url]
+
+    def invalidate(self) -> None:
+        """Force the next call to re-probe (a failure or fence landed)."""
+        with self._lock:
+            self._probed_at = None
+            self._primary_url = None
+
+    # -- the _Transport surface the stores use -----------------------------
+    def call(self, store: str, method: str, args: dict) -> Any:
+        # ONLY events reads may serve from a follower: the eventlog is the
+        # replicated substrate — a follower's local META/MODEL stores never
+        # receive writes (those are epoch-fenced to the primary), so meta
+        # reads routed there would answer from permanently-empty tables
+        follower_ok = store == "events" and method in _FOLLOWER_READS
+        last_exc: Optional[Exception] = None
+        for attempt in range(2):
+            tp = self._select(follower_ok)
+            try:
+                return tp.call(store, method, args)
+            except (FencedWrite, CircuitOpenError) as e:
+                # definitely-not-applied failures: safe to re-route even
+                # a write — re-probe and try the (new) primary once
+                self.invalidate()
+                last_exc = e
+            except TransientError as e:
+                self.invalidate()
+                last_exc = e
+                if method not in _Transport._IDEMPOTENT:
+                    # ambiguous (may have applied): never auto-resend a
+                    # write — the caller's spill/retry path owns it, and
+                    # the NEXT call will probe the promoted primary
+                    raise
+        raise last_exc  # type: ignore[misc]
+
+    def stream(self, path: str, body: dict):
+        last_exc: Optional[Exception] = None
+        for attempt in range(2):
+            tp = self._select(follower_ok=True)
+            try:
+                return tp.stream(path, body)
+            except (TransientError, CircuitOpenError) as e:
+                self.invalidate()
+                last_exc = e
+        raise last_exc  # type: ignore[misc]
+
+    # -- test/diagnostic seams shared with _Transport ----------------------
+    @property
+    def fault_hook(self):
+        return next(iter(self.transports.values())).fault_hook
+
+    @fault_hook.setter
+    def fault_hook(self, hook) -> None:
+        for tp in self.transports.values():
+            tp.fault_hook = hook
 
 
 # ---------------------------------------------------------------------------
@@ -653,6 +878,16 @@ class RemoteStorageClient(StorageClient):
 
     def __init__(self, config: dict[str, str]):
         super().__init__(config)
+        urls_raw = config.get("URLS")
+        if urls_raw:
+            # replicated source: every endpoint of the replica set, comma-
+            # separated; the transport tracks the current primary by
+            # /health role+epoch and fails over (docs/replication.md)
+            urls = [u.strip() for u in urls_raw.split(",") if u.strip()]
+            self._tp = _MultiTransport(
+                urls, config.get("KEY"), float(config.get("TIMEOUT", "30")),
+                ca_cert=config.get("CA_CERT"), config=config)
+            return
         url = config.get("URL")
         if not url:
             scheme = config.get("SCHEME", "http")

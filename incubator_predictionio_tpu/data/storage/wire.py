@@ -24,6 +24,18 @@ from incubator_predictionio_tpu.data.storage.base import (
 )
 
 
+#: RPC methods that never mutate — THE one definition both halves share:
+#: the storage server serves them on fenced/follower replicas, and the
+#: multi-endpoint client may route them to a caught-up follower under the
+#: bounded-staleness contract (docs/replication.md). Everything else is a
+#: write and must reach the current-epoch primary. Deliberately NOT the
+#: retry-idempotency set (``init`` is idempotent but still a write).
+READ_METHODS = frozenset({
+    "get", "get_all", "get_by_name", "get_by_app_id",
+    "aggregate_properties", "find_by_entities",
+})
+
+
 def enc_dt(t: Optional[_dt.datetime]) -> Optional[str]:
     return None if t is None else t.isoformat()
 

@@ -68,6 +68,33 @@ def probe_health_urls(
     return results
 
 
+def replication_flags(health: Optional[dict]) -> Optional[dict]:
+    """Storage-replication reading of a ``/health`` payload
+    (docs/replication.md): role, epoch, lag, and whether the replica
+    should turn a fleet probe RED — fenced (a deposed primary every
+    write bounces off) or lag-exceeded (the async bound is blown and the
+    sole-copy window is growing). Returns None for servers without a
+    replication section (query/event servers, unreplicated stores) so
+    callers can thread it straight into their row fold."""
+    if not health:
+        return None
+    repl = health.get("replication")
+    if not isinstance(repl, dict):
+        return None
+    fenced = bool(repl.get("fenced"))
+    lag_exceeded = bool(repl.get("lagExceeded"))
+    return {
+        "role": repl.get("role"),
+        "epoch": repl.get("epoch"),
+        "fenced": fenced,
+        "lagBytes": repl.get("lagBytes"),
+        "lagExceeded": lag_exceeded,
+        "fencedWrites": repl.get("fencedWrites"),
+        "contactAgeSeconds": repl.get("contactAgeSeconds"),
+        "red": fenced or lag_exceeded,
+    }
+
+
 class HealthWatcher:
     """Periodic concurrent probe of every fleet replica, folding results
     into the balancer state (fleet/balancer.py)."""
@@ -151,4 +178,5 @@ class HealthWatcher:
             self._pool = None
 
 
-__all__ = ["HealthWatcher", "fetch_health", "probe_health_urls"]
+__all__ = ["HealthWatcher", "fetch_health", "probe_health_urls",
+           "replication_flags"]

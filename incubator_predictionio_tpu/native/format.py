@@ -351,7 +351,15 @@ def valid_extent(buf: bytes) -> int:
     zeroed tail begins; == len(buf) when the log is clean)."""
     if buf[:8] != MAGIC:
         raise ValueError("not a PIOLOG01 file")
-    pos = 8
+    return record_run_end(buf, 8)
+
+
+def record_run_end(buf: bytes, pos: int) -> int:
+    """Offset just past the last complete ``[u32 len][payload]`` record in
+    the run starting at ``pos`` (no magic header expected there); stops at
+    a zeroed length or a truncated record. THE one framing walk — shared
+    with the replication chunker (replication/manager.py) so the
+    boundary rules cannot drift between them."""
     n = len(buf)
     while pos + 4 <= n:
         (plen,) = struct.unpack_from("<I", buf, pos)

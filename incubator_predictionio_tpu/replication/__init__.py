@@ -1,0 +1,32 @@
+"""Storage high availability: replicated eventlog with epoch-fenced
+failover and anti-entropy repair (docs/replication.md).
+
+The append-only eventlog (data/storage/eventlog_backend.py) is the
+replicated substrate: byte offsets ARE sequence numbers (the same trick
+``streaming/feed.py`` uses), so a primary storage server ships raw
+complete-record byte ranges to followers and the files stay identical
+bit for bit — every consumer that addresses the log by offset (the
+streaming cursor, the scrubber's range digests) survives a failover
+unchanged.
+
+- :mod:`manager` — :class:`ReplicationManager`: primary→follower frame
+  shipping with CRC verification on apply, monotonic persisted epochs,
+  promote/demote/fence state machine, async bounded-lag and quorum-ack
+  modes.
+- :mod:`scrub` — anti-entropy: per-segment CRC range digests exchanged
+  between replicas, divergence/bitrot detection, repair by re-fetching
+  the authoritative range (``pio-tpu store scrub``).
+"""
+
+from incubator_predictionio_tpu.replication.manager import (  # noqa: F401
+    FencedError,
+    ReplicationConfig,
+    ReplicationManager,
+    ReplicationUnavailable,
+    complete_extent,
+    tail_extent,
+)
+from incubator_predictionio_tpu.replication.scrub import (  # noqa: F401
+    file_digests,
+    scrub_follower,
+)

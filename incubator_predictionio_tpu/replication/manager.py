@@ -1,0 +1,1032 @@
+"""Eventlog replication: primary→follower shipping with epoch fencing.
+
+One :class:`ReplicationManager` runs inside each storage server process
+(server/storage_server.py wires it up when ``--repl-peer``/``PIO_REPL_*``
+configure a replica set). The manager owns everything below the RPC
+surface; the server's ``/repl/{verb}`` routes are a thin HTTP shim over
+:meth:`ReplicationManager.handle`, so the whole protocol is unit-testable
+in-process by wiring two managers' ``handle`` methods together — no
+sockets, no sleeps.
+
+Protocol (all verbs carry the sender's epoch; stale epochs are fenced):
+
+- ``state``      — follower's per-log byte sizes (the replication cursor:
+                   byte offsets ARE sequence numbers).
+- ``append``     — one CRC32-verified chunk of complete eventlog records
+                   at an exact byte offset. The follower applies it only
+                   when the offset equals its current size — a mismatch
+                   returns the follower's size so the primary resyncs
+                   (the ``wal.tail_frames`` ok/waiting discipline, per
+                   replica instead of per reader).
+- ``heartbeat``  — epoch exchange; how a restarted stale primary learns
+                   it was deposed *before* it can accept a write.
+- ``promote``    — bump the persisted epoch, become primary, optionally
+                   reconfigure the peer set (failover removes the dead
+                   primary until it is scrubbed back in).
+- ``digest`` / ``fetch`` / ``patch`` — anti-entropy surface (scrub.py).
+
+Fencing invariant: an epoch is persisted (atomic-write discipline) before
+it is ever announced, every replicated append and admin RPC carries it,
+and any node that observes a higher epoch than its own immediately stops
+accepting writes (``pio_repl_fenced_writes_total`` counts the rejects).
+Split-brain therefore cannot corrupt the log: at most one epoch's primary
+can get its appends accepted by any follower.
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import http.client
+import json
+import logging
+import os
+import threading
+import urllib.parse
+import zlib
+from typing import Any, Callable, Optional
+
+from incubator_predictionio_tpu.native import format as fmt
+from incubator_predictionio_tpu.obs.metrics import REGISTRY
+from incubator_predictionio_tpu.resilience.clock import SYSTEM_CLOCK, Clock
+from incubator_predictionio_tpu.utils.fs import atomic_write_bytes
+
+logger = logging.getLogger(__name__)
+
+STATE_FILE = "repl-state.json"
+ROLE_PRIMARY = "primary"
+ROLE_FOLLOWER = "follower"
+
+_SHIPPED = REGISTRY.counter(
+    "pio_repl_shipped_bytes_total",
+    "Eventlog bytes this primary shipped to followers (acked appends)")
+_APPLIED = REGISTRY.counter(
+    "pio_repl_applied_bytes_total",
+    "Eventlog bytes this follower applied from replicated appends")
+FENCED_WRITES = REGISTRY.counter(
+    "pio_repl_fenced_writes_total",
+    "Client writes rejected because this storage server is not the "
+    "current-epoch primary (demoted, stale, or follower)")
+_FENCED_APPENDS = REGISTRY.counter(
+    "pio_repl_fenced_appends_total",
+    "Replicated appends/heartbeats rejected for carrying a stale epoch "
+    "(the split-brain write path that fencing exists to close)")
+_CRC_FAILURES = REGISTRY.counter(
+    "pio_repl_crc_failures_total",
+    "Replicated chunks rejected because the CRC32 did not match on apply")
+_DIVERGED = REGISTRY.counter(
+    "pio_repl_divergence_detected_total",
+    "Ship rounds that found a follower ahead of / disjoint from the "
+    "primary (needs `pio-tpu store scrub`)")
+_LAG_GAUGE = REGISTRY.gauge(
+    "pio_repl_lag_bytes",
+    "Replication lag in bytes (primary: bytes not yet acked by the "
+    "best-caught-up follower)")
+_EPOCH_GAUGE = REGISTRY.gauge(
+    "pio_repl_epoch", "This replica's current fencing epoch")
+_QUORUM_FAILURES = REGISTRY.counter(
+    "pio_repl_quorum_failures_total",
+    "Writes that could not reach quorum within the timeout (the storage "
+    "server answers 503; the event server spills to its WAL)")
+
+
+class FencedError(Exception):
+    """The peer holds a higher epoch — the caller has been deposed."""
+
+    def __init__(self, remote_epoch: int):
+        super().__init__(f"fenced by epoch {remote_epoch}")
+        self.remote_epoch = remote_epoch
+
+
+class ReplicationUnavailable(Exception):
+    """Quorum (or the async lag bound) cannot be satisfied right now —
+    transient cluster-wise: the storage server answers 503 so clients
+    spill/retry rather than treating an unreplicated write as durable."""
+
+
+# ---------------------------------------------------------------------------
+# record-boundary math (PIOLOG01 framing: magic, then [u32 len][payload]*)
+# ---------------------------------------------------------------------------
+
+def complete_extent(buf: bytes, file_offset: int) -> int:
+    """Bytes of ``buf`` (read from ``file_offset``, which is 0 or a record
+    boundary) forming complete PIOLOG records. A partial record at the end
+    — the live-writer race — is excluded; ``plen == 0`` (a zeroed torn
+    tail the writer will truncate at recovery) also stops the walk, so a
+    defect is never shipped as if it were data. The walk itself is
+    ``fmt.record_run_end`` — the same one ``valid_extent`` uses."""
+    if file_offset == 0:
+        if len(buf) < len(fmt.MAGIC) or buf[:len(fmt.MAGIC)] != fmt.MAGIC:
+            return 0
+        return fmt.record_run_end(buf, len(fmt.MAGIC))
+    return fmt.record_run_end(buf, 0)
+
+
+def tail_extent(path: str, from_offset: int,
+                max_bytes: int = 1 << 20) -> tuple[bytes, int, str]:
+    """Tail-follow read of complete records past ``from_offset`` — the
+    ``wal.tail_frames`` contract transplanted onto the eventlog framing.
+
+    Returns ``(data, next_offset, status)``: ``data`` is the raw byte
+    range ``[from_offset, next_offset)`` holding only complete records;
+    ``status`` is ``"ok"`` (clean end within the read), ``"waiting"``
+    (the file ends mid-record — a live writer's normal artifact, re-poll
+    from the same offset) or ``"bounded"`` (the read bound cut a record;
+    more data exists on disk right now)."""
+    try:
+        size = os.path.getsize(path)
+    except FileNotFoundError:
+        return b"", from_offset, "ok"
+    if size <= from_offset:
+        return b"", from_offset, "ok"
+    with open(path, "rb") as f:
+        f.seek(from_offset)
+        chunk = f.read(max_bytes)
+    usable = complete_extent(chunk, from_offset)
+    data = chunk[:usable]
+    next_offset = from_offset + usable
+    if usable == len(chunk) and next_offset >= size:
+        return data, next_offset, "ok"
+    if from_offset + len(chunk) < size:
+        return data, next_offset, "bounded"
+    return data, next_offset, "waiting"
+
+
+def _crc(data: bytes) -> int:
+    return zlib.crc32(data) & 0xFFFFFFFF
+
+
+def _safe_log_name(name: str) -> str:
+    """Log names cross the RPC boundary — refuse anything that is not a
+    plain ``*.piolog`` basename (no traversal, no absolute paths)."""
+    if (name != os.path.basename(name) or os.sep in name
+            or not name.endswith(".piolog") or name.startswith(".")):
+        raise ValueError(f"invalid log name {name!r}")
+    return name
+
+
+def list_logs(directory: str) -> dict[str, int]:
+    """``{basename: size}`` for every eventlog file in ``directory``."""
+    out: dict[str, int] = {}
+    try:
+        names = os.listdir(directory)
+    except FileNotFoundError:
+        return out
+    for name in names:
+        if name.endswith(".piolog"):
+            try:
+                out[name] = os.path.getsize(os.path.join(directory, name))
+            except OSError:  # pragma: no cover - raced a remove
+                pass
+    return out
+
+
+# ---------------------------------------------------------------------------
+# peer RPC (client half; the server half is storage_server's /repl routes)
+# ---------------------------------------------------------------------------
+
+def rpc_connection(url: str, timeout: float) -> http.client.HTTPConnection:
+    """Connection for a peer URL, honoring the scheme: ``https`` peers get
+    TLS (unverified context — like the remote client's unpinned mode, the
+    shared ``X-PIO-Storage-Key`` is the authentication and TLS provides
+    transport privacy) and the scheme's default port."""
+    p = urllib.parse.urlsplit(url)
+    host = p.hostname or "127.0.0.1"
+    if p.scheme == "https":
+        import ssl as _ssl
+
+        ctx = _ssl.create_default_context()
+        ctx.check_hostname = False
+        ctx.verify_mode = _ssl.CERT_NONE
+        return http.client.HTTPSConnection(
+            host, p.port or 443, timeout=timeout, context=ctx)
+    return http.client.HTTPConnection(host, p.port or 7072,
+                                      timeout=timeout)
+
+
+def default_rpc(url: str, verb: str, payload: dict,
+                key: Optional[str] = None,
+                timeout: float = 5.0) -> tuple[int, dict]:
+    """POST ``<url>/repl/<verb>`` with a JSON body; returns
+    ``(status, parsed_body)``. Connection-level failures raise ``OSError``
+    — the caller decides whether that peer counts as unreachable."""
+    conn = rpc_connection(url, timeout)
+    try:
+        headers = {"Content-Type": "application/json"}
+        if key:
+            headers["X-PIO-Storage-Key"] = key
+        conn.request("POST", f"/repl/{verb}",
+                     json.dumps(payload).encode(), headers)
+        resp = conn.getresponse()
+        data = resp.read()
+        try:
+            body = json.loads(data) if data else {}
+        except ValueError:
+            body = {"message": data[:256].decode(errors="replace")}
+        return resp.status, body
+    finally:
+        conn.close()
+
+
+# ---------------------------------------------------------------------------
+# configuration
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class ReplicationConfig:
+    log_dir: str                       # the eventlog directory replicated
+    role: str = ROLE_PRIMARY
+    peers: tuple[str, ...] = ()        # the OTHER replicas' base URLs
+    sync: str = dataclasses.field(     # "async" (bounded lag) | "quorum"
+        default_factory=lambda: os.environ.get("PIO_REPL_SYNC", "async"))
+    key: Optional[str] = None          # shared X-PIO-Storage-Key
+    chunk_bytes: int = dataclasses.field(
+        default_factory=lambda: int(
+            os.environ.get("PIO_REPL_CHUNK_BYTES", str(1 << 20))))
+    # async mode's lag bound: when the best-caught-up follower is more
+    # than this many bytes behind, new writes 503 (the event server
+    # spills) instead of growing the sole-copy window without bound.
+    # 0 disables enforcement (lag is still reported and probed red).
+    max_lag_bytes: int = dataclasses.field(
+        default_factory=lambda: int(
+            os.environ.get("PIO_REPL_MAX_LAG_BYTES", str(64 << 20))))
+    poll_interval: float = dataclasses.field(
+        default_factory=lambda: float(
+            os.environ.get("PIO_REPL_INTERVAL", "0.05")))
+    quorum_timeout: float = dataclasses.field(
+        default_factory=lambda: float(
+            os.environ.get("PIO_REPL_QUORUM_TIMEOUT", "5.0")))
+    # follower apply durability: fsync each applied chunk (the replicated
+    # copy should survive ITS host's power cut too; PIO_REPL_FSYNC=0 for
+    # bench/battery-backed hosts)
+    fsync: bool = dataclasses.field(
+        default_factory=lambda: os.environ.get("PIO_REPL_FSYNC", "1") != "0")
+    rpc_timeout: float = dataclasses.field(
+        default_factory=lambda: float(
+            os.environ.get("PIO_REPL_RPC_TIMEOUT", "5.0")))
+
+
+class _PeerState:
+    """Primary-side view of one follower."""
+
+    def __init__(self, url: str):
+        self.url = url
+        self.offsets: dict[str, int] = {}   # acked byte size per log
+        self.patches = 0                    # follower's repair counter
+        self.reachable = False
+        self.last_error: Optional[str] = None
+        self.diverged = False
+        # a peer's existing content must be CRC-verified as a prefix of
+        # ours ONCE before the first append (a rejoined deposed replica
+        # can hold a same-length-or-shorter divergent history that size
+        # comparison alone cannot detect); appends preserve the invariant
+        # afterwards
+        self.verified = False
+        # offsets signature at the last failed verification: the
+        # (expensive) prefix-CRC check only re-runs when the peer's
+        # state actually changed (a scrub repaired it)
+        self.diverged_sig: Optional[tuple] = None
+
+
+class ReplicationManager:
+    """State machine + transfer engine for one replica.
+
+    Thread-safety: role/epoch mutations and follower file writes happen
+    under ``self._lock``; each peer's ship path is serialized by a
+    per-peer lock so the background loop and a quorum-acking write RPC
+    never interleave chunks to the same follower.
+    """
+
+    def __init__(self, config: ReplicationConfig,
+                 clock: Clock = SYSTEM_CLOCK,
+                 rpc: Optional[Callable[..., tuple[int, dict]]] = None,
+                 on_writable: Optional[Callable[[], None]] = None,
+                 on_read_only: Optional[Callable[[], None]] = None):
+        self.config = config
+        self.clock = clock
+        self._rpc = rpc or (lambda url, verb, payload: default_rpc(
+            url, verb, payload, key=config.key,
+            timeout=config.rpc_timeout))
+        self._on_writable = on_writable or (lambda: None)
+        self._on_read_only = on_read_only or (lambda: None)
+        self._lock = threading.RLock()
+        os.makedirs(config.log_dir, exist_ok=True)
+        self.role = config.role
+        self.epoch = 1
+        self.fenced = False
+        self.fenced_writes = 0      # health-surface twin of the counter
+        self._load_state()
+        self.peers: dict[str, _PeerState] = {
+            url: _PeerState(url) for url in config.peers}
+        self._peer_locks: dict[str, threading.Lock] = {
+            url: threading.Lock() for url in config.peers}
+        # follower side: append handles (flock-held, so the co-resident
+        # events store serves reads through lock-free read-only views)
+        self._writers: dict[str, Any] = {}
+        # bumped by every repair (patch/remove_log) and reported in
+        # /repl/state: an in-place scrub repair leaves file SIZES
+        # unchanged, so the primary's prefix-verification cache keys on
+        # this too or it would never re-check a repaired peer
+        self.patch_count = 0
+        self._last_contact: Optional[float] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        _EPOCH_GAUGE.set(self.epoch)
+
+    # -- persisted state (atomic-write discipline) ------------------------
+    def _state_path(self) -> str:
+        return os.path.join(self.config.log_dir, STATE_FILE)
+
+    def _load_state(self) -> None:
+        try:
+            with open(self._state_path()) as f:
+                st = json.load(f)
+        except FileNotFoundError:
+            self._save_state()  # fresh replica: initialize
+            return
+        except ValueError as e:
+            # NEVER guess an epoch from a corrupt fencing token: a deposed
+            # primary re-initialized to epoch 1 could accept writes during
+            # a partition that fencing will later discard
+            raise RuntimeError(
+                f"corrupt replication state {self._state_path()}: {e} — "
+                "refusing to start with a guessed epoch; restore the file "
+                "or wipe the replica and scrub it back in "
+                "(docs/replication.md)") from e
+        self.epoch = int(st.get("epoch", self.epoch))
+        self.role = st.get("role", self.role)
+        self.fenced = bool(st.get("fenced", False))
+
+    def _save_state(self) -> None:
+        atomic_write_bytes(
+            self._state_path(),
+            json.dumps({"epoch": self.epoch, "role": self.role,
+                        "fenced": self.fenced},
+                       sort_keys=True).encode(),
+            durable=True)
+        _EPOCH_GAUGE.set(self.epoch)
+
+    # -- role surface ------------------------------------------------------
+    @property
+    def is_primary(self) -> bool:
+        return self.role == ROLE_PRIMARY and not self.fenced
+
+    def can_accept_writes(self) -> bool:
+        return self.is_primary
+
+    def record_fenced_write(self) -> None:
+        self.fenced_writes += 1
+        FENCED_WRITES.inc()
+
+    def _fence(self, remote_epoch: int) -> None:
+        """A higher epoch exists: whatever we believed, we are not the
+        primary of the current configuration. Persist the demotion BEFORE
+        acknowledging anything else."""
+        with self._lock:
+            if remote_epoch <= self.epoch and self.role != ROLE_PRIMARY:
+                return
+            logger.warning(
+                "replication: fenced by epoch %d (own epoch %d, role %s) — "
+                "demoting to read-only follower", remote_epoch, self.epoch,
+                self.role)
+            was_primary = self.role == ROLE_PRIMARY
+            self.epoch = max(self.epoch, remote_epoch)
+            self.role = ROLE_FOLLOWER
+            self.fenced = True
+            self._save_state()
+        if was_primary:
+            self._on_read_only()
+
+    def promote(self, peers: Optional[list[str]] = None) -> dict:
+        """Bump the epoch and become the primary (the failover step).
+        ``peers`` reconfigures the replica set — on failover the dead
+        primary is removed until it is repaired (`pio-tpu store scrub`)
+        and rejoined.
+
+        Ordering matters: the events store is flipped WRITABLE (and the
+        replication append handles released) BEFORE the role flip admits
+        the first write. The reverse order has a window where a write
+        passes the fence gate but lands on a still-read-only store — a
+        500 the event server's drain would misread as a semantic
+        rejection and dead-letter acked events on (found by the failover
+        bench: exactly one lost ack per unlucky promote)."""
+        with self._lock:
+            self._close_writers()
+            self._on_writable()
+            self.epoch += 1
+            self.role = ROLE_PRIMARY
+            self.fenced = False
+            self._save_state()
+            if peers is not None:
+                self.config = dataclasses.replace(
+                    self.config, peers=tuple(peers))
+                self.peers = {u: _PeerState(u) for u in self.config.peers}
+                self._peer_locks = {
+                    u: threading.Lock() for u in self.config.peers}
+            logger.warning("replication: PROMOTED to primary at epoch %d "
+                           "(peers: %s)", self.epoch,
+                           list(self.config.peers) or "none")
+        return {"epoch": self.epoch, "role": self.role}
+
+    # -- follower file plumbing -------------------------------------------
+    def _close_writers(self) -> None:
+        for f in self._writers.values():
+            try:
+                f.close()
+            except OSError:  # pragma: no cover
+                pass
+        self._writers.clear()
+
+    def _writer(self, name: str):
+        import fcntl
+
+        f = self._writers.get(name)
+        if f is None:
+            f = open(os.path.join(self.config.log_dir, name), "ab")
+            try:
+                fcntl.flock(f.fileno(), fcntl.LOCK_EX | fcntl.LOCK_NB)
+            except OSError:
+                f.close()
+                raise
+            self._writers[name] = f
+        return f
+
+    # -- RPC handler table (shared by the HTTP routes and in-process
+    #    tests: one implementation of the protocol) -----------------------
+    def handle(self, verb: str, payload: dict) -> tuple[int, dict]:
+        try:
+            fn = getattr(self, f"_handle_{verb}", None)
+            if fn is None:
+                return 404, {"message": f"unknown repl verb {verb!r}"}
+            return fn(payload)
+        except FencedError as e:
+            _FENCED_APPENDS.inc()
+            return 409, {"message": str(e), "fenced": self.epoch,
+                         "epoch": self.epoch}
+        except (ValueError, KeyError) as e:
+            return 400, {"message": repr(e)}
+        except OSError as e:
+            return 500, {"message": f"replication I/O failed: {e}"}
+
+    def _check_epoch(self, remote_epoch: int) -> None:
+        """Adopt newer epochs (demoting ourselves if we were primary);
+        fence senders with older ones."""
+        with self._lock:
+            if remote_epoch < self.epoch:
+                raise FencedError(self.epoch)
+            if remote_epoch > self.epoch:
+                if self.role == ROLE_PRIMARY:
+                    self._fence(remote_epoch)
+                else:
+                    self.epoch = remote_epoch
+                    self._save_state()
+
+    def _touch_contact(self) -> None:
+        """Refresh the bounded-staleness freshness token. ONLY traffic
+        from the current primary counts (its ship-loop state polls,
+        heartbeats, and appends) — a scrub/status CLI poking /repl/state
+        must not make a partitioned follower look freshly-synced."""
+        with self._lock:
+            self._last_contact = self.clock.monotonic()
+
+    def _handle_state(self, a: dict) -> tuple[int, dict]:
+        self._check_epoch(int(a.get("epoch", self.epoch)))
+        if a.get("role") == ROLE_PRIMARY:
+            self._touch_contact()  # the primary's ship loop polling us
+        return 200, {"epoch": self.epoch, "role": self.role,
+                     "fenced": self.fenced,
+                     "patches": self.patch_count,
+                     "logs": list_logs(self.config.log_dir)}
+
+    def _handle_heartbeat(self, a: dict) -> tuple[int, dict]:
+        remote = int(a.get("epoch", 0))
+        with self._lock:
+            if a.get("role") == ROLE_PRIMARY and remote >= self.epoch:
+                self._last_contact = self.clock.monotonic()
+            if remote > self.epoch:
+                if self.role == ROLE_PRIMARY:
+                    self._fence(remote)
+                else:
+                    self.epoch = remote
+                    self._save_state()
+        # NO FencedError here: the reply itself carries our epoch — a
+        # stale primary learns it was deposed from the body and fences
+        # itself (boot announce), whether or not it out-epochs us.
+        return 200, {"epoch": self.epoch, "role": self.role}
+
+    def _handle_append(self, a: dict) -> tuple[int, dict]:
+        self._check_epoch(int(a["epoch"]))
+        self._touch_contact()  # appends only come from the current primary
+        with self._lock:
+            if self.role == ROLE_PRIMARY:
+                # same-epoch append onto a primary: two primaries in one
+                # epoch is impossible by construction — refuse loudly
+                raise FencedError(self.epoch)
+            name = _safe_log_name(a["log"])
+            offset = int(a["offset"])
+            data = base64.b64decode(a["data"])
+            if _crc(data) != int(a["crc"]):
+                _CRC_FAILURES.inc()
+                return 400, {"message": "chunk crc mismatch on apply"}
+            path = os.path.join(self.config.log_dir, name)
+            size = os.path.getsize(path) if os.path.exists(path) else 0
+            if offset != size:
+                return 200, {"ok": False, "size": size,
+                             "epoch": self.epoch}
+            f = self._writer(name)
+            f.write(data)
+            f.flush()
+            if self.config.fsync:
+                os.fsync(f.fileno())
+            _APPLIED.inc(len(data))
+            if self.fenced:
+                # the current-epoch primary is streaming onto our log —
+                # and it only ships to a peer whose content it verified as
+                # a clean prefix (the diverged gate), so this node has
+                # rejoined as a consistent follower: stop reporting
+                # fenced/red (writes stay role-fenced regardless)
+                self.fenced = False
+                self._save_state()
+                logger.warning("replication: fence cleared at epoch %d — "
+                               "rejoined as a consistent follower",
+                               self.epoch)
+            return 200, {"ok": True, "size": offset + len(data),
+                         "epoch": self.epoch}
+
+    def _handle_promote(self, a: dict) -> tuple[int, dict]:
+        return 200, self.promote(a.get("peers"))
+
+    def _handle_remove_log(self, a: dict) -> tuple[int, dict]:
+        """Apply a log removal (``events.remove`` is an admin op: byte
+        shipping only moves record data, so deletions travel explicitly —
+        a retained follower copy would wedge shipping as divergent the
+        moment the app is re-initialized). Refused on a healthy primary:
+        the authoritative copy is never deleted from the outside."""
+        self._check_epoch(int(a.get("epoch", 0)))
+        with self._lock:
+            if self.is_primary:
+                return 409, {"message": "refusing to remove a log on the "
+                                        "primary (authoritative copy)"}
+            name = _safe_log_name(a["log"])
+            w = self._writers.pop(name, None)
+            if w is not None:
+                w.close()
+            path = os.path.join(self.config.log_dir, name)
+            existed = os.path.exists(path)
+            if existed:
+                os.remove(path)
+            self.patch_count += 1
+            self._invalidate_read_views()
+            return 200, {"removed": existed, "epoch": self.epoch}
+
+    def _handle_status(self, a: dict) -> tuple[int, dict]:
+        return 200, self.health()
+
+    # anti-entropy surface (driven by replication/scrub.py) ----------------
+    def _handle_digest(self, a: dict) -> tuple[int, dict]:
+        from incubator_predictionio_tpu.replication.scrub import file_digests
+
+        name = _safe_log_name(a["log"])
+        path = os.path.join(self.config.log_dir, name)
+        segment_bytes = int(a.get("segment_bytes", 1 << 20))
+        size, segments = file_digests(path, segment_bytes)
+        return 200, {"size": size, "segments": segments,
+                     "epoch": self.epoch}
+
+    def _handle_fetch(self, a: dict) -> tuple[int, dict]:
+        name = _safe_log_name(a["log"])
+        path = os.path.join(self.config.log_dir, name)
+        offset, length = int(a["offset"]), int(a["length"])
+        with open(path, "rb") as f:
+            f.seek(offset)
+            data = f.read(length)
+        return 200, {"data": base64.b64encode(data).decode(),
+                     "crc": _crc(data), "epoch": self.epoch}
+
+    def _handle_patch(self, a: dict) -> tuple[int, dict]:
+        """Repair write: overwrite an exact byte range (and/or truncate)
+        with authoritative bytes fetched from the primary. Refused on a
+        healthy primary — the authority is never patched."""
+        with self._lock:
+            if self.is_primary:
+                return 409, {"message": "refusing to patch the primary "
+                                        "(it is the authoritative copy)"}
+            name = _safe_log_name(a["log"])
+            path = os.path.join(self.config.log_dir, name)
+            data = base64.b64decode(a.get("data", "")) if a.get("data") \
+                else b""
+            if data and _crc(data) != int(a["crc"]):
+                _CRC_FAILURES.inc()
+                return 400, {"message": "patch crc mismatch"}
+            # the append handle (if any) holds the flock; reuse its fd via
+            # a fresh r+b handle only after closing it — flock conflicts
+            # between two open descriptions even in one process
+            w = self._writers.pop(name, None)
+            if w is not None:
+                w.close()
+            mode = "r+b" if os.path.exists(path) else "w+b"
+            with open(path, mode) as f:
+                if data:
+                    f.seek(int(a["offset"]))
+                    f.write(data)
+                if a.get("truncate_to") is not None:
+                    f.truncate(int(a["truncate_to"]))
+                f.flush()
+                os.fsync(f.fileno())
+            self.patch_count += 1
+            self._invalidate_read_views()
+            return 200, {"size": os.path.getsize(path),
+                         "epoch": self.epoch}
+
+    #: follower read views may have parsed the pre-repair bytes; the
+    #: storage server installs a callback that drops them (set in
+    #: storage_server — EventLogEvents.reopen)
+    invalidate_read_views: Optional[Callable[[], None]] = None
+
+    def _invalidate_read_views(self) -> None:
+        if self.invalidate_read_views is not None:
+            try:
+                self.invalidate_read_views()
+            except Exception:  # noqa: BLE001 - repair must not die on this
+                logger.exception("replication: read-view invalidation failed")
+
+    # -- primary-side shipping --------------------------------------------
+    def announce(self) -> None:
+        """One heartbeat round to every peer (the boot fence check): a
+        primary restarted with a stale epoch learns it was deposed HERE,
+        before the first client write can reach it."""
+        for url in list(self.config.peers):
+            try:
+                status, body = self._rpc(url, "heartbeat",
+                                         {"epoch": self.epoch,
+                                          "role": self.role})
+            except OSError as e:
+                logger.info("replication: peer %s unreachable at announce "
+                            "(%s)", url, e)
+                continue
+            remote = int(body.get("epoch", 0)) if isinstance(body, dict) \
+                else 0
+            if status == 409 or remote > self.epoch:
+                if self.role == ROLE_PRIMARY:
+                    self._fence(max(remote, self.epoch))
+                    return
+                # a follower merely BEHIND on epoch (restarted across a
+                # failover it missed) is not deposed — adopt the epoch
+                # without raising the fenced alarm, exactly like the
+                # heartbeat/append adoption path
+                with self._lock:
+                    if remote > self.epoch:
+                        self.epoch = remote
+                        self._save_state()
+
+    def propagate_remove(self, name: str) -> None:
+        """Best-effort fan-out of a log removal to every follower (the
+        storage server calls this after ``events.remove`` succeeds
+        locally). An unreachable follower keeps its copy and is
+        reconciled by ``store scrub`` (which deletes follower-only
+        logs)."""
+        for url in list(self.config.peers):
+            peer = self.peers[url]
+            with self._peer_locks[url]:
+                try:
+                    st, body = self._rpc(url, "remove_log",
+                                         {"epoch": self.epoch,
+                                          "log": name})
+                except OSError as e:
+                    peer.last_error = repr(e)
+                    logger.warning(
+                        "replication: remove of %s not propagated to %s "
+                        "(%s) — `pio-tpu store scrub` reconciles it",
+                        name, url, e)
+                    continue
+                if st == 409:
+                    self._fence(int(body.get("fenced",
+                                             body.get("epoch", 0))))
+                    return
+                peer.offsets.pop(name, None)
+
+    def ship_once(self, url: str) -> bool:
+        """Ship every log's outstanding complete-record bytes to one peer.
+        Returns True when the peer ended the round fully caught up.
+        Serialized per peer; safe to call from the background loop and
+        from a quorum-acking write RPC concurrently."""
+        peer = self.peers[url]
+        with self._peer_locks[url]:
+            return self._ship_once_locked(peer)
+
+    def _ship_once_locked(self, peer: _PeerState) -> bool:
+        if not self.is_primary:
+            return False
+        try:
+            status, body = self._rpc(peer.url, "state",
+                                     {"epoch": self.epoch,
+                                      "role": self.role})
+        except OSError as e:
+            peer.reachable = False
+            peer.last_error = repr(e)
+            return False
+        if status == 409:
+            self._fence(int(body.get("fenced", body.get("epoch", 0))))
+            return False
+        if status != 200:
+            peer.reachable = False
+            peer.last_error = f"state: {status} {body.get('message', '')}"
+            return False
+        remote_epoch = int(body.get("epoch", 0))
+        if remote_epoch > self.epoch:
+            self._fence(remote_epoch)
+            return False
+        peer.reachable = True
+        peer.last_error = None
+        peer.offsets = {k: int(v) for k, v in body.get("logs", {}).items()}
+        peer.patches = int(body.get("patches", 0))
+        if not self._ensure_prefix_verified(peer):
+            # NOTHING ships to an unverified/diverged peer: appending our
+            # bytes after a divergent history would interleave two
+            # histories into one log (per-chunk CRCs cannot catch it).
+            # `store scrub` repairs it; the verification resumes shipping
+            # once the peer's content is a CRC-identical prefix of ours.
+            return False
+        caught_up = True
+        for name, local_size in list_logs(self.config.log_dir).items():
+            offset = peer.offsets.get(name, 0)
+            if offset > local_size:
+                if not peer.diverged:
+                    logger.error(
+                        "replication: follower %s is AHEAD of the primary "
+                        "on %s (%d > %d) — divergent history; run "
+                        "`pio-tpu store scrub`", peer.url, name, offset,
+                        local_size)
+                    _DIVERGED.inc()
+                peer.diverged = True
+                peer.verified = False
+                peer.diverged_sig = None
+                return False
+            max_bytes = self.config.chunk_bytes
+            while offset < local_size:
+                data, next_offset, status_ = tail_extent(
+                    os.path.join(self.config.log_dir, name), offset,
+                    max_bytes)
+                if not data:
+                    if status_ == "bounded":
+                        # one record larger than the chunk bound: grow the
+                        # read until it fits (the bytes exist on disk) —
+                        # otherwise replication would stall forever on it
+                        max_bytes *= 4
+                        continue
+                    break  # waiting on the writer's partial tail
+                max_bytes = self.config.chunk_bytes
+                try:
+                    st, resp = self._rpc(peer.url, "append", {
+                        "epoch": self.epoch, "log": name,
+                        "offset": offset, "crc": _crc(data),
+                        "data": base64.b64encode(data).decode()})
+                except OSError as e:
+                    peer.reachable = False
+                    peer.last_error = repr(e)
+                    return False
+                if st == 409:
+                    self._fence(int(resp.get("fenced",
+                                             resp.get("epoch", 0))))
+                    return False
+                if st != 200 or not resp.get("ok", False):
+                    if st == 200 and "size" in resp:
+                        # offset mismatch: adopt the follower's position
+                        newsize = int(resp["size"])
+                        if newsize > offset:
+                            peer.offsets[name] = newsize
+                            offset = newsize
+                            continue
+                    peer.last_error = f"append: {st} {resp}"
+                    caught_up = False
+                    break
+                _SHIPPED.inc(len(data))
+                offset = next_offset
+                peer.offsets[name] = offset
+            if peer.offsets.get(name, 0) < local_size:
+                caught_up = False
+        return caught_up
+
+    def _ensure_prefix_verified(self, peer: _PeerState) -> bool:
+        """Gate every ship round: a peer's existing bytes must be a
+        CRC-identical PREFIX of ours before anything is appended. Runs
+        the O(size) comparison once per peer (and again only when a
+        previously-failed peer's offsets change — i.e. a `store scrub`
+        repaired it); a verified peer stays verified because appends at
+        matching offsets preserve the invariant."""
+        if peer.verified and not peer.diverged:
+            return True
+        sig = (tuple(sorted(peer.offsets.items())),
+               getattr(peer, "patches", 0))
+        if peer.diverged and peer.diverged_sig == sig:
+            return False  # unchanged since the last failed check
+        if self._prefix_matches(peer):
+            if peer.diverged:
+                logger.warning(
+                    "replication: peer %s verified as a clean prefix "
+                    "again — resuming shipping (divergence repaired)",
+                    peer.url)
+            peer.verified = True
+            peer.diverged = False
+            peer.diverged_sig = None
+            return True
+        if not peer.diverged:
+            logger.error(
+                "replication: follower %s holds a DIVERGENT history — "
+                "nothing ships to it; run `pio-tpu store scrub`",
+                peer.url)
+            _DIVERGED.inc()
+        peer.diverged = True
+        peer.diverged_sig = sig
+        return False
+
+    #: prefix-verification window — bounded memory per comparison step
+    #: whatever the log size (multi-GB logs must not be read in one gulp
+    #: on either replica)
+    VERIFY_WINDOW = 1 << 20
+
+    def _prefix_matches(self, peer: _PeerState) -> bool:
+        """True when every log the peer holds is a CRC-identical prefix
+        of our copy (empty logs trivially match). Windowed on both sides:
+        the peer answers its standard windowed digest and we stream our
+        prefix through matching windows — O(window) memory, O(size) I/O."""
+        for name, psize in peer.offsets.items():
+            try:
+                _safe_log_name(name)
+            except ValueError:
+                return False
+            path = os.path.join(self.config.log_dir, name)
+            lsize = os.path.getsize(path) if os.path.exists(path) else 0
+            if psize > lsize:
+                return False
+            if psize == 0:
+                continue
+            try:
+                st, body = self._rpc(
+                    peer.url, "digest",
+                    {"log": name, "segment_bytes": self.VERIFY_WINDOW})
+            except OSError:
+                return False
+            if st != 200:
+                return False
+            remote = [tuple(seg) for seg in (body.get("segments") or [])]
+            local: list[tuple[int, int, int]] = []
+            with open(path, "rb") as f:
+                off = 0
+                while off < psize:
+                    chunk = f.read(min(self.VERIFY_WINDOW, psize - off))
+                    if not chunk:
+                        break
+                    local.append((off, len(chunk), _crc(chunk)))
+                    off += len(chunk)
+            if off != psize or remote != local:
+                return False
+        return True
+
+    # -- lag / quorum ------------------------------------------------------
+    def _lag_per_peer(self) -> dict[str, int]:
+        local = list_logs(self.config.log_dir)
+        out: dict[str, int] = {}
+        for url, peer in self.peers.items():
+            if not peer.verified or peer.diverged:
+                # an unverified/diverged peer holds NOTHING durable of
+                # our history, whatever its byte sizes claim — its lag
+                # is everything
+                out[url] = sum(local.values())
+                continue
+            lag = 0
+            for name, size in local.items():
+                lag += max(0, size - peer.offsets.get(name, 0))
+            out[url] = lag
+        return out
+
+    def min_lag_bytes(self) -> int:
+        """Bytes that exist on NO follower yet — the sole-copy window the
+        async lag bound caps. 0 when there are no peers (a deliberately
+        unreplicated deployment bounds nothing)."""
+        lags = self._lag_per_peer()
+        lag = min(lags.values()) if lags else 0
+        _LAG_GAUGE.set(lag)
+        return lag
+
+    def check_async_bound(self) -> None:
+        """Async mode's write-path gate: refuse (→ 503 → client spill)
+        when the best follower is beyond the lag bound. Pull-forward is
+        attempted first so a healthy-but-momentarily-behind follower
+        doesn't bounce writes."""
+        if self.config.sync == "quorum" or not self.config.peers \
+                or self.config.max_lag_bytes <= 0:
+            return
+        if self.min_lag_bytes() <= self.config.max_lag_bytes:
+            return
+        for url in self.config.peers:
+            self.ship_once(url)
+        lag = self.min_lag_bytes()
+        if lag > self.config.max_lag_bytes:
+            raise ReplicationUnavailable(
+                f"replication lag {lag}B exceeds the "
+                f"{self.config.max_lag_bytes}B bound and no follower "
+                "could be caught up")
+
+    def sync_quorum(self) -> None:
+        """Quorum-ack write path: ship until a majority of the replica
+        set (self included) holds every byte written so far, or raise
+        :class:`ReplicationUnavailable` at the timeout. With no peers the
+        quorum is this process alone (the post-failover solo primary)."""
+        target = list_logs(self.config.log_dir)
+        needed = (len(self.config.peers) + 1) // 2
+        if needed == 0:
+            return
+        deadline = self.clock.monotonic() + self.config.quorum_timeout
+
+        def acked(peer: _PeerState) -> bool:
+            # size comparison only counts for a peer whose content is a
+            # VERIFIED prefix of ours: a diverged follower's equal-sized
+            # log holds none of these bytes, whatever its size says
+            return (peer.verified and not peer.diverged
+                    and all(peer.offsets.get(name, 0) >= size
+                            for name, size in target.items()))
+
+        while True:
+            count = 0
+            for url in self.config.peers:
+                peer = self.peers[url]
+                if not acked(peer):
+                    self.ship_once(url)
+                if acked(peer):
+                    count += 1
+                if count >= needed:
+                    return
+            if self.clock.monotonic() >= deadline:
+                _QUORUM_FAILURES.inc()
+                raise ReplicationUnavailable(
+                    f"quorum not reached: {count}/{needed} follower "
+                    f"ack(s) within {self.config.quorum_timeout}s")
+            self.clock.sleep(min(0.05, self.config.poll_interval))
+
+    # -- background loop ---------------------------------------------------
+    def start(self) -> None:
+        """Announce once (the boot fence check), then run the async ship
+        loop on a daemon thread (primary with peers only; followers are
+        passive)."""
+        self.announce()
+        if self._thread is None and self.config.peers:
+            self._thread = threading.Thread(
+                target=self._run, name="pio-repl-ship", daemon=True)
+            self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            progressed = False
+            if self.is_primary:
+                for url in list(self.config.peers):
+                    try:
+                        if self.ship_once(url):
+                            progressed = True
+                    except Exception:  # noqa: BLE001 - loop must survive
+                        logger.exception("replication: ship to %s failed",
+                                         url)
+                self.min_lag_bytes()  # keep the gauge fresh
+            self._stop.wait(self.config.poll_interval
+                            if progressed else 4 * self.config.poll_interval)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        with self._lock:
+            self._close_writers()
+
+    # -- health surface ----------------------------------------------------
+    def contact_age(self) -> Optional[float]:
+        if self._last_contact is None:
+            return None
+        return max(0.0, self.clock.monotonic() - self._last_contact)
+
+    def health(self) -> dict:
+        out: dict[str, Any] = {
+            "role": self.role, "epoch": self.epoch, "fenced": self.fenced,
+            "sync": self.config.sync,
+            "fencedWrites": self.fenced_writes,
+            "maxLagBytes": self.config.max_lag_bytes,
+        }
+        if self.role == ROLE_PRIMARY:
+            lags = self._lag_per_peer()
+            out["peers"] = {
+                url: {"lagBytes": lags.get(url, 0),
+                      "reachable": peer.reachable,
+                      "diverged": peer.diverged,
+                      "verified": peer.verified,
+                      "lastError": peer.last_error}
+                for url, peer in self.peers.items()}
+            lag = min(lags.values()) if lags else 0
+            out["lagBytes"] = lag
+            out["lagExceeded"] = bool(
+                self.config.peers and self.config.max_lag_bytes > 0
+                and lag > self.config.max_lag_bytes)
+        else:
+            age = self.contact_age()
+            out["contactAgeSeconds"] = (round(age, 3)
+                                        if age is not None else None)
+        return out

@@ -55,7 +55,16 @@ _DEADLINE_EXPIRED = REGISTRY.counter(
 class TransientError(StorageError):
     """A failure worth retrying (connection reset, timeout, 5xx): transports
     wrap their raw socket/HTTP errors in this so the policy engine never has
-    to know each library's exception taxonomy."""
+    to know each library's exception taxonomy.
+
+    ``no_retry = True`` on a subclass marks a condition that is transient
+    *cluster-wise* but can never improve by retrying THIS endpoint (an
+    epoch-fenced write on a deposed replica): the policy fails it fast so
+    a higher layer — the multi-endpoint transport's failover, the event
+    server's spill — can act instead of burning the retry budget in
+    place."""
+
+    no_retry = False
 
 
 #: HTTP statuses that signal a transient service condition (throttle or
@@ -249,7 +258,8 @@ class ResiliencePolicy:
             except TransientError as e:
                 if self.breaker is not None:
                     self.breaker.record_failure()
-                if not idempotent or attempts >= self.retry.max_attempts:
+                if e.no_retry or not idempotent \
+                        or attempts >= self.retry.max_attempts:
                     raise
                 pause = self.retry.delay(attempts, self._rng)
                 rem = deadline.remaining()

@@ -438,34 +438,45 @@ class SpillWal:
 
 def inspect_dir(directory: str) -> dict[str, Any]:
     """Offline summary of a WAL directory for the ``pio-tpu wal`` verb:
-    per-segment frame counts and defects, cursor, pending/dead-letter
-    tallies. Read-only — safe against a live server's WAL."""
+    per-segment frame counts and defects (with the BYTE OFFSET of the
+    first corrupt frame — scrub/forensics need the position, not just a
+    count), cursor, pending/dead-letter tallies. Read-only — safe against
+    a live server's WAL."""
     committed = read_cursor(directory)
     segments = []
     pending = 0
+    first_corrupt: Optional[dict[str, Any]] = None
     for path in list_segments(directory):
         frames = 0
         defect = None
+        defect_offset = None
         max_seq = None
-        for _, rec, status in iter_frames(path):
+        for off, rec, status in iter_frames(path):
             if status != "ok":
                 defect = status
+                defect_offset = off
                 break
             frames += 1
             max_seq = rec["seq"]
             if rec["seq"] > committed:
                 pending += 1
+        if defect is not None and first_corrupt is None:
+            first_corrupt = {"segment": path, "offset": defect_offset,
+                             "defect": defect}
         segments.append({
             "path": path, "frames": frames, "maxSeq": max_seq,
             "bytes": os.path.getsize(path), "defect": defect,
+            "defectOffset": defect_offset,
         })
     dl_path = os.path.join(directory, DEAD_LETTER)
     dead = []
     dl_defect = None
+    dl_defect_offset = None
     if os.path.exists(dl_path):
-        for _, rec, status in iter_frames(dl_path):
+        for off, rec, status in iter_frames(dl_path):
             if status != "ok":
                 dl_defect = status
+                dl_defect_offset = off
                 break
             dead.append(rec)
     return {
@@ -473,6 +484,10 @@ def inspect_dir(directory: str) -> dict[str, Any]:
         "committedSeq": committed,
         "segments": segments,
         "pending": pending,
+        # triage pointer: segment + byte offset of the first defect in
+        # append order (None when every segment scans clean)
+        "firstCorrupt": first_corrupt,
         "deadLetters": dead,
         "deadLetterDefect": dl_defect,
+        "deadLetterDefectOffset": dl_defect_offset,
     }

@@ -63,7 +63,13 @@ from incubator_predictionio_tpu.data.storage.base import (
     Model,
     StorageError,
 )
+from incubator_predictionio_tpu.data.storage.eventlog_backend import (
+    ReadOnlyLogError,
+)
 from incubator_predictionio_tpu.data.storage.registry import Storage, get_storage
+from incubator_predictionio_tpu.replication.manager import (
+    ReplicationUnavailable,
+)
 from incubator_predictionio_tpu.resilience.breaker import BREAKERS
 from incubator_predictionio_tpu.server.lifecycle import (
     DrainState,
@@ -72,6 +78,23 @@ from incubator_predictionio_tpu.server.lifecycle import (
 )
 
 logger = logging.getLogger(__name__)
+
+#: RPC methods that never mutate — a fenced/follower replica still serves
+#: them (bounded-staleness reads). Everything else is a write and must be
+#: epoch-fenced off non-primaries. ONE definition shared with the remote
+#: client's follower-read routing (wire.py) so the halves cannot drift.
+from incubator_predictionio_tpu.data.storage.wire import (  # noqa: E402
+    READ_METHODS as _READ_METHODS,
+)
+
+#: events-store mutations that append replicated bytes — the ones the
+#: quorum-ack / bounded-lag gates cover. (``init`` creates an empty log
+#: that ships like any bytes; ``remove`` is an admin op fanned out
+#: explicitly via ``propagate_remove`` below — neither carries acked
+#: event data to lose.)
+_REPLICATED_EVENT_MUTATIONS = frozenset({
+    "insert", "insert_batch", "delete",
+})
 
 
 # wire codecs live in data/storage/wire.py (server-independent — the remote
@@ -99,6 +122,20 @@ class StorageServerConfig:
     ssl_cert: Optional[str] = None
     ssl_key: Optional[str] = None
     server_access_key: Optional[str] = None  # shared secret for all calls
+    # -- eventlog replication (replication/, docs/replication.md) ---------
+    # role of this replica ("primary" serves writes and ships appends;
+    # "follower" serves bounded-staleness reads and applies appends) and
+    # the OTHER replicas' base URLs. Replication activates when peers are
+    # configured or the role is follower; it requires the EVENTDATA
+    # backend to be `eventlog`.
+    repl_role: str = dataclasses.field(
+        default_factory=lambda: os.environ.get("PIO_REPL_ROLE", "primary"))
+    repl_peers: tuple = dataclasses.field(
+        default_factory=lambda: tuple(
+            u.strip() for u in os.environ.get("PIO_REPL_PEERS", "").split(",")
+            if u.strip()))
+    repl_sync: str = dataclasses.field(
+        default_factory=lambda: os.environ.get("PIO_REPL_SYNC", "async"))
     # -- per-client fairness (resilience/admission.py) --------------------
     # concurrent in-flight RPCs allowed per client address; beyond it the
     # client answers 429 and queues behind ITSELF, not behind every other
@@ -137,6 +174,38 @@ class StorageServer:
         # per request
         self._remote_gate = InflightGate(
             config.remote_inflight or 8 * config.client_inflight)
+        # -- eventlog replication (replication/manager.py) ----------------
+        self._repl = None
+        if config.repl_peers or config.repl_role == "follower":
+            self._repl = self._build_replication()
+
+    def _build_replication(self):
+        from incubator_predictionio_tpu.replication.manager import (
+            ReplicationConfig,
+            ReplicationManager,
+        )
+
+        events = self.storage.get_events()
+        base_dir = getattr(events, "base_dir", None)
+        if base_dir is None:
+            raise StorageError(
+                "storage replication requires the 'eventlog' EVENTDATA "
+                "backend (the append-only log is the replicated "
+                f"substrate); got {type(events).__name__}")
+        repl = ReplicationManager(
+            ReplicationConfig(
+                log_dir=base_dir, role=self.config.repl_role,
+                peers=tuple(self.config.repl_peers),
+                sync=self.config.repl_sync,
+                key=self.config.server_access_key),
+            on_writable=lambda: events.set_read_only(False),
+            on_read_only=lambda: events.set_read_only(True))
+        repl.invalidate_read_views = events.reopen
+        # a follower (or a node fenced in a previous life) must serve
+        # reads through lock-free views so the replicated appends own
+        # the writer flocks
+        events.set_read_only(not repl.is_primary)
+        return repl
 
     def _client_key(self, request: web.Request) -> str:
         # the client's self-reported process identity (remote.py sends
@@ -192,6 +261,7 @@ class StorageServer:
         app.router.add_post("/rpc/events/assemble_triples",
                             self.handle_assemble_triples)
         app.router.add_post("/rpc/{store}/{method}", self.handle_rpc)
+        app.router.add_post("/repl/{verb}", self.handle_repl)
         return app
 
     def _authorized(self, request: web.Request) -> bool:
@@ -215,7 +285,7 @@ class StorageServer:
         transient and fails over)."""
         backends = BREAKERS.snapshot()
         degraded = any(s["state"] != "closed" for s in backends.values())
-        return web.json_response({
+        body = {
             "status": self._drain_state.health_status(degraded),
             "draining": self._drain_state.draining,
             "backendBreakers": backends,
@@ -225,7 +295,17 @@ class StorageServer:
             # the per-address aggregate backstop behind the self-reported
             # identity key
             "remoteAdmission": self._remote_gate.snapshot(),
-        })
+        }
+        if self._repl is not None:
+            # role/epoch/lag surface: clients select the primary from
+            # this, `pio-tpu health`/`store status` render it, and the
+            # prober turns red on fenced or lag-exceeded replicas
+            repl = await self._run(self._repl.health)
+            body["replication"] = repl
+            if repl.get("fenced") or repl.get("lagExceeded"):
+                body["status"] = ("draining" if self._drain_state.draining
+                                  else "degraded")
+        return web.json_response(body)
 
     # -- generic JSON RPC --------------------------------------------------
     async def handle_rpc(self, request: web.Request) -> web.Response:
@@ -235,6 +315,20 @@ class StorageServer:
             return web.json_response({"message": "Unauthorized"}, status=401)
         store = request.match_info["store"]
         method = request.match_info["method"]
+        if (self._repl is not None and method not in _READ_METHODS
+                and not self._repl.can_accept_writes()):
+            # epoch fencing (docs/replication.md): a demoted/stale
+            # primary or a follower must never apply a write — counted,
+            # and flagged so the multi-endpoint client re-probes for the
+            # real primary instead of retrying here
+            self._repl.record_fenced_write()
+            return web.json_response(
+                {"message": f"write fenced: this replica is "
+                            f"{self._repl.role} at epoch "
+                            f"{self._repl.epoch}, not the current primary "
+                            "(docs/replication.md)"},
+                status=409,
+                headers={"X-PIO-Fenced": str(self._repl.epoch)})
         keys = self._admit_rpc(request)
         if keys is None:
             return self._throttle_response()
@@ -248,8 +342,55 @@ class StorageServer:
             if handler is None:
                 return web.json_response(
                     {"message": f"unknown rpc {store}.{method}"}, status=404)
+            replicate = (self._repl is not None and store == "events"
+                         and method in _REPLICATED_EVENT_MUTATIONS)
+            replicate_remove = (self._repl is not None
+                                and store == "events" and method == "remove")
+
+            def run_handler():
+                if replicate_remove:
+                    # capture the log's basename BEFORE the local remove
+                    # deletes it, then fan the removal out: byte shipping
+                    # only moves record data, so a follower's retained
+                    # copy would wedge shipping as divergent when the app
+                    # is re-initialized smaller
+                    events = self.storage.get_events()
+                    name = os.path.basename(events.log_path(
+                        args["app_id"], args.get("channel_id")))
+                    result = handler(self.storage, args)
+                    self._repl.propagate_remove(name)
+                    return result
+                if replicate and self._repl.config.sync != "quorum":
+                    # bounded-lag async mode: refuse while the best
+                    # follower is beyond the lag bound — the sole-copy
+                    # window must not grow without limit
+                    self._repl.check_async_bound()
+                result = handler(self.storage, args)
+                if replicate and self._repl.config.sync == "quorum":
+                    # quorum-ack: the write is NOT acknowledged until a
+                    # majority of the replica set holds it. Failure is a
+                    # 503 (transient) — the event server spills to its
+                    # WAL rather than treating an unreplicated write as
+                    # durable (the PR 4 ack contract).
+                    self._repl.sync_quorum()
+                return result
+
             try:
-                result = await self._run(handler, self.storage, args)
+                result = await self._run(run_handler)
+            except ReplicationUnavailable as e:
+                # quorum unreachable / lag bound exceeded: transient
+                # cluster-wise — clients spill and retry, never a lossy ack
+                return web.json_response(
+                    {"message": str(e)}, status=503,
+                    headers={"Retry-After": "1"})
+            except ReadOnlyLogError as e:
+                # a write slipped into a role-transition window (or the
+                # flock genuinely lives elsewhere): 503, not a semantic
+                # 500 — a 500 here would make the event server's drain
+                # dead-letter acked events that a retry lands cleanly
+                return web.json_response(
+                    {"message": str(e)}, status=503,
+                    headers={"Retry-After": "1"})
             except StorageError as e:
                 return web.json_response({"message": str(e)}, status=500)
             except (TypeError, ValueError, KeyError) as e:
@@ -257,6 +398,31 @@ class StorageServer:
             return web.json_response({"result": result})
         finally:
             self._release_rpc(keys)
+
+    # -- replication RPC surface (replication/manager.py) ------------------
+    async def handle_repl(self, request: web.Request) -> web.Response:
+        """Thin HTTP shim over :meth:`ReplicationManager.handle` — the
+        protocol itself (epoch checks, CRC verify, offset contract,
+        promote, anti-entropy digests) lives in ONE place and is driven
+        identically by these routes and the in-process tests. Served even
+        while draining: catch-up replication during a graceful exit is
+        exactly what minimizes failover loss."""
+        if not self._authorized(request):
+            return web.json_response({"message": "Unauthorized"}, status=401)
+        if self._repl is None:
+            return web.json_response(
+                {"message": "replication not configured on this storage "
+                            "server (--repl-peer / PIO_REPL_PEERS)"},
+                status=404)
+        verb = request.match_info["verb"]
+        try:
+            args = await request.json()
+        except json.JSONDecodeError:
+            args = {}
+        status, body = await self._run(self._repl.handle, verb, args)
+        headers = ({"X-PIO-Fenced": str(body["fenced"])}
+                   if isinstance(body, dict) and "fenced" in body else None)
+        return web.json_response(body, status=status, headers=headers)
 
     # -- streaming find ----------------------------------------------------
     async def handle_find(self, request: web.Request) -> web.StreamResponse:
@@ -400,13 +566,20 @@ class StorageServer:
     async def start(self) -> None:
         from incubator_predictionio_tpu.server.event_server import _ssl_context
 
+        if self._repl is not None:
+            # announce BEFORE the listener exists: a primary restarted
+            # with a stale epoch learns it was deposed (and fences) before
+            # the first client write can possibly reach it
+            await self._run(self._repl.start)
         self._runner = web.AppRunner(self.make_app())
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.config.ip, self.config.port,
                            ssl_context=_ssl_context(self.config))
         await site.start()
-        logger.info("storage server listening on %s:%d",
-                    self.config.ip, self.config.port)
+        logger.info("storage server listening on %s:%d (replication: %s)",
+                    self.config.ip, self.config.port,
+                    f"{self._repl.role}@{self._repl.epoch}"
+                    if self._repl is not None else "off")
 
     async def drain_and_shutdown(
             self, deadline_sec: Optional[float] = None) -> None:
@@ -429,6 +602,8 @@ class StorageServer:
             # aiohttp's cleanup waits for handlers already in the router —
             # the in-flight-RPC half of the drain contract
             await self._runner.cleanup()
+        if self._repl is not None:
+            self._repl.stop()
         self._executor.shutdown(wait=False)
 
 

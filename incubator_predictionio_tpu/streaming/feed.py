@@ -93,12 +93,28 @@ class EventLogFeed:
         return self._next
 
     def _bootstrap(self, upto: int) -> None:
+        """One pass over the prefix ``[0, upto)``: intern records feed the
+        string table, and the walk doubles as the FAILOVER RESUME GUARD —
+        the cursor must land exactly on a record boundary of THIS file.
+        Replication keeps replica logs byte-identical (offsets preserved),
+        so a cursor committed against the old primary resumes cleanly on
+        the promoted one; a cursor pointed at the wrong file (or a
+        diverged, un-scrubbed copy) fails loudly here instead of decoding
+        garbage from mid-record."""
         with open(self.path, "rb") as f:
             buf = f.read(upto)
         for _, kind, payload in fmt.iter_records(buf):
             if kind == fmt.KIND_INTERN:
                 sid, slen = fmt.struct.unpack_from("<IH", payload, 1)
                 self._strings[sid] = payload[7:7 + slen].decode()
+        end = fmt.valid_extent(buf)
+        if end != upto:
+            raise ValueError(
+                f"feed cursor {upto} does not land on a record boundary "
+                f"of {self.path} (last boundary at {end}): the cursor "
+                "belongs to a different log — after a failover, point the "
+                "feed at the promoted primary's byte-identical copy "
+                "(docs/replication.md)")
 
     #: per-poll read bound: a multi-GB backlog is consumed in bounded
     #: chunks instead of re-reading the whole unconsumed tail every poll

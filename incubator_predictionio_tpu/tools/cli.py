@@ -423,6 +423,12 @@ def cmd_storageserver(args, storage: Storage) -> int:
     kw = {}
     if args.client_inflight is not None:  # unset keeps the env default
         kw["client_inflight"] = args.client_inflight
+    if getattr(args, "repl_role", None):
+        kw["repl_role"] = args.repl_role
+    if getattr(args, "repl_peer", None):
+        kw["repl_peers"] = tuple(args.repl_peer)
+    if getattr(args, "repl_sync", None):
+        kw["repl_sync"] = args.repl_sync
     serve_forever(StorageServerConfig(
         ip=args.ip, port=args.port,
         ssl_cert=args.ssl_cert, ssl_key=args.ssl_key,
@@ -677,11 +683,18 @@ def cmd_wal(args, storage: Storage) -> int:
             if seg["maxSeq"] is not None:
                 line += f", max seq {seg['maxSeq']}"
             if seg["defect"]:
-                line += f"  [DEFECT: {seg['defect']}]"
+                line += (f"  [DEFECT: {seg['defect']} @ byte "
+                         f"{seg['defectOffset']}]")
             _out(line)
         _out(f"  pending (uncommitted): {info['pending']}")
+        if info.get("firstCorrupt"):
+            fc = info["firstCorrupt"]
+            _out(f"  first corrupt frame: "
+                 f"{os.path.basename(fc['segment'])} @ byte "
+                 f"{fc['offset']} ({fc['defect']})")
         _out(f"  dead letters: {len(info['deadLetters'])}"
-             + (f"  [DEFECT: {info['deadLetterDefect']}]"
+             + (f"  [DEFECT: {info['deadLetterDefect']} @ byte "
+                f"{info['deadLetterDefectOffset']}]"
                 if info["deadLetterDefect"] else ""))
     if args.dead_letter and info["deadLetters"]:
         for rec in info["deadLetters"]:
@@ -843,8 +856,25 @@ def _health_row(url: str, h: Optional[dict], err: Optional[str]) -> dict:
         parts.append(
             f"deltaSeq {stream['lastDeltaSeq']}"
             + (f", staleness {lag:.0f}s" if lag is not None else ""))
+    # storage replication (docs/replication.md): role/epoch/lag rows so a
+    # lagging or fenced store turns the fleet probe red
+    from incubator_predictionio_tpu.fleet.health import replication_flags
+
+    repl = replication_flags(h)
+    repl_red = False
+    if repl is not None:
+        parts.append(f"repl {repl['role']}@{repl['epoch']}")
+        if repl["fenced"]:
+            parts.append(f"FENCED ({repl.get('fencedWrites') or 0} writes "
+                         "rejected)")
+        if repl.get("lagBytes"):
+            parts.append(f"lag {repl['lagBytes']}B"
+                         + (" EXCEEDED" if repl["lagExceeded"] else ""))
+        repl_red = repl["red"]
     status = h.get("status", "unknown")
-    return {"url": url, "status": status, "red": status != "ok",
+    return {"url": url, "status": status,
+            "red": status != "ok" or repl_red,
+            "replication": repl,
             "detail": "; ".join(parts)}
 
 
@@ -1191,6 +1221,139 @@ def cmd_fleet_experiment(args, storage) -> int:
 
 
 # ---------------------------------------------------------------------------
+# store: replicated-storage admin (docs/replication.md)
+# ---------------------------------------------------------------------------
+
+def _store_rpc(url: str, verb: str, payload: dict, key=None, timeout=10.0):
+    from incubator_predictionio_tpu.replication.manager import default_rpc
+
+    return default_rpc(url, verb, payload, key=key, timeout=timeout)
+
+
+def cmd_store_status(args, storage) -> int:
+    """Per-replica replication state from each storage server's /health:
+    role, epoch, fenced-write tally, per-peer lag. Exits non-zero when
+    any replica is unreachable, fenced, or beyond the lag bound."""
+    from incubator_predictionio_tpu.fleet.health import (
+        probe_health_urls,
+        replication_flags,
+    )
+
+    probed = probe_health_urls(args.urls, args.timeout,
+                               fetch=lambda u, t: _fetch_health(u, t))
+    red = False
+    rows = []
+    for url in args.urls:
+        h, err = probed[url]
+        repl = replication_flags(h)
+        if h is None:
+            rows.append({"url": url, "error": err})
+            red = True
+            continue
+        row = {"url": url, "status": h.get("status"),
+               "replication": h.get("replication")}
+        rows.append(row)
+        if repl is None:
+            red = True  # a storage replica without a replication section
+        else:
+            red = red or repl["red"]
+    if args.json:
+        _out(json.dumps(rows, indent=2))
+        return 1 if red else 0
+    w = max(len(r["url"]) for r in rows)
+    for r in rows:
+        if "error" in r:
+            _out(f"!! {r['url']:<{w}}  unreachable  [{r['error']}]")
+            continue
+        repl = r.get("replication")
+        if repl is None:
+            # reachable but replication is OFF — red (the operator asked
+            # about a replica set; an unreplicated member is the finding)
+            _out(f"!! {r['url']:<{w}}  replication not configured "
+                 "(--repl-peer / PIO_REPL_PEERS)")
+            continue
+        line = (f"{'!!' if (repl.get('fenced') or repl.get('lagExceeded')) else 'ok'} "
+                f"{r['url']:<{w}}  {repl.get('role', '?')}@"
+                f"{repl.get('epoch', '?')}")
+        if repl.get("fenced"):
+            line += f"  FENCED (writes rejected: {repl.get('fencedWrites', 0)})"
+        if repl.get("role") == "primary":
+            for peer, st in (repl.get("peers") or {}).items():
+                line += (f"\n     -> {peer}: lag {st.get('lagBytes', '?')}B"
+                         f"{'' if st.get('reachable') else ' UNREACHABLE'}"
+                         f"{' DIVERGED' if st.get('diverged') else ''}")
+        elif repl.get("contactAgeSeconds") is not None:
+            line += f"  last primary contact {repl['contactAgeSeconds']}s ago"
+        _out(line)
+    return 1 if red else 0
+
+
+def cmd_store_promote(args, storage) -> int:
+    """Promote a follower storage server to primary (the failover step):
+    bumps its persisted epoch, re-opens its logs writable, and (via
+    --peer) reconfigures its replica set — on failover the dead primary
+    is removed until `store scrub` repairs and rejoins it. The old
+    primary, wherever it resurfaces, is epoch-fenced from then on."""
+    payload: dict = {}
+    if args.peer is not None:
+        payload["peers"] = list(args.peer)
+    try:
+        status, body = _store_rpc(args.url, "promote", payload,
+                                  key=args.server_access_key)
+    except OSError as e:
+        _err(f"promote failed: {args.url} unreachable: {e}")
+        return 1
+    if status != 200:
+        _err(f"promote failed: {status} {body.get('message', body)}")
+        return 1
+    _out(f"{args.url} promoted: role={body['role']} epoch={body['epoch']}")
+    return 0
+
+
+def cmd_store_scrub(args, storage) -> int:
+    """Anti-entropy: exchange per-segment CRC digests between the primary
+    and each follower, repair divergence/bitrot by re-fetching the
+    authoritative range, and verify the copies come back bit-identical
+    (docs/replication.md scrub playbook). --check-only detects without
+    repairing. Exits non-zero when any follower could not be verified."""
+    from incubator_predictionio_tpu.replication.scrub import (
+        ScrubError,
+        scrub_follower,
+    )
+
+    rpc = lambda url, verb, payload: _store_rpc(  # noqa: E731
+        url, verb, payload, key=args.server_access_key)
+    ok = True
+    out = {}
+    for follower in args.followers:
+        try:
+            report = scrub_follower(args.primary, follower, rpc,
+                                    segment_bytes=args.segment_bytes,
+                                    repair=not args.check_only)
+        except ScrubError as e:
+            _err(f"scrub {follower}: {e}")
+            ok = False
+            continue
+        out[follower] = report
+        ok = ok and report["clean"]
+        if not args.json:
+            state = ("clean" if report["divergentSegments"] == 0 else
+                     ("REPAIRED" if report["clean"] else "DIVERGENT"))
+            _out(f"{follower}: {state} — "
+                 f"{report['divergentSegments']} divergent segment(s), "
+                 f"{report['repairedBytes']} byte(s) repaired")
+            for name, row in sorted(report["logs"].items()):
+                if row["divergent"] or not row["verified"]:
+                    _out(f"  {name}: divergent at offsets {row['divergent']}"
+                         f" (primary {row['sizePrimary']}B / follower "
+                         f"{row['sizeFollower']}B) verified="
+                         f"{row['verified']}")
+    if args.json:
+        _out(json.dumps(out, indent=2))
+    return 0 if ok else 1
+
+
+# ---------------------------------------------------------------------------
 # parser
 # ---------------------------------------------------------------------------
 
@@ -1395,6 +1558,47 @@ def build_parser() -> argparse.ArgumentParser:
                    help="concurrent in-flight RPCs allowed per client "
                         "address before 429 (PIO_STORAGE_CLIENT_INFLIGHT "
                         "env, default 64; 0 disables)")
+    p.add_argument("--repl-role", choices=("primary", "follower"),
+                   help="eventlog replication role (PIO_REPL_ROLE env; "
+                        "docs/replication.md)")
+    p.add_argument("--repl-peer", action="append",
+                   help="base URL of another replica (repeatable; "
+                        "PIO_REPL_PEERS env, comma-separated)")
+    p.add_argument("--repl-sync", choices=("async", "quorum"),
+                   help="replication ack mode: async (bounded lag, "
+                        "default) or quorum (a write acks only once a "
+                        "majority of the replica set holds it; "
+                        "PIO_REPL_SYNC env)")
+
+    # store — replicated-storage admin (docs/replication.md)
+    store = sub.add_parser(
+        "store",
+        help="replicated storage admin: status (role/epoch/lag per "
+             "replica), promote (epoch-fenced failover), scrub "
+             "(anti-entropy divergence detection + repair)")
+    st = store.add_subparsers(dest="store_command")
+    p = st.add_parser("status")
+    p.add_argument("urls", nargs="+",
+                   help="storage-server base URLs (the whole replica set)")
+    p.add_argument("--timeout", type=float, default=5.0)
+    p.add_argument("--json", action="store_true")
+    p = st.add_parser("promote")
+    p.add_argument("url", help="the follower to promote")
+    p.add_argument("--peer", action="append",
+                   help="replica set AFTER the promotion (repeatable; "
+                        "omit to keep the follower's configured peers — "
+                        "typically you exclude the dead primary here)")
+    p.add_argument("--server-access-key")
+    p = st.add_parser("scrub")
+    p.add_argument("primary", help="authoritative replica base URL")
+    p.add_argument("followers", nargs="+",
+                   help="follower base URLs to verify/repair against it")
+    p.add_argument("--segment-bytes", type=int, default=1 << 20,
+                   help="digest window size (default 1 MiB)")
+    p.add_argument("--check-only", action="store_true",
+                   help="detect divergence without repairing")
+    p.add_argument("--server-access-key")
+    p.add_argument("--json", action="store_true")
 
     # dashboard / adminserver
     p = sub.add_parser("dashboard")
@@ -1711,6 +1915,12 @@ _FLEET_COMMANDS = {
     "experiment": cmd_fleet_experiment,
 }
 
+_STORE_COMMANDS = {
+    "status": cmd_store_status,
+    "promote": cmd_store_promote,
+    "scrub": cmd_store_scrub,
+}
+
 
 def main(argv: Optional[list[str]] = None) -> int:
     parser = build_parser()
@@ -1748,6 +1958,11 @@ def main(argv: Optional[list[str]] = None) -> int:
             _err("fleet: missing subcommand (route|rollout|experiment)")
             return 1
         return _FLEET_COMMANDS[args.fleet_command](args, storage)
+    if args.command == "store":
+        if not args.store_command:
+            _err("store: missing subcommand (status|promote|scrub)")
+            return 1
+        return _STORE_COMMANDS[args.store_command](args, storage)
     if args.command == "template":
         if not args.template_command:
             # parse_args(["template", "--help"]) would SystemExit(0); a

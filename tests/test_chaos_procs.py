@@ -802,6 +802,277 @@ def test_streaming_replica_kill9_mid_delta_apply_resyncs(tmp_path):
         storage.close()
 
 
+# ---------------------------------------------------------------------------
+# storage replication chaos (ISSUE 9): SIGKILL the primary mid-ingest →
+# epoch-fenced failover with zero acked loss; a stale restarted primary
+# gets every write fenced; a flipped byte is scrubbed back to bit-identity
+# ---------------------------------------------------------------------------
+
+
+def _repl_store_env(tmp_path, name) -> dict:
+    return {
+        "PIO_STORAGE_SOURCES_EL_TYPE": "eventlog",
+        "PIO_STORAGE_SOURCES_EL_PATH": str(tmp_path / f"{name}-log"),
+        "PIO_STORAGE_SOURCES_SQ_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_SQ_PATH": str(tmp_path / f"{name}.db"),
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "EL",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQ",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SQ",
+    }
+
+
+def _start_storage(tmp_path, name, port, role, peers,
+                   sync="quorum") -> ServerProc:
+    args = ["storageserver", "--ip", "127.0.0.1", "--port", str(port),
+            "--repl-role", role, "--repl-sync", sync]
+    for p in peers:
+        args += ["--repl-peer", p]
+    proc = ServerProc(args, env=_repl_store_env(tmp_path, name))
+    proc.wait_ready(f"http://127.0.0.1:{port}/")
+    return proc
+
+
+def _repl_es_env(tmp_path, urls: list) -> dict:
+    return {
+        "PIO_STORAGE_SOURCES_R_TYPE": "remote",
+        "PIO_STORAGE_SOURCES_R_URLS": ",".join(urls),
+        "PIO_STORAGE_SOURCES_R_TIMEOUT": "3",
+        "PIO_STORAGE_SOURCES_R_RETRY_MAX_ATTEMPTS": "1",
+        "PIO_STORAGE_SOURCES_SQ_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_SQ_PATH": str(tmp_path / "es-meta.db"),
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "R",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQ",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SQ",
+        "PIO_EVENT_WAL_DIR": str(tmp_path / "wal"),
+        "PIO_EVENTSERVER_AUTH_TTL": "600",
+        "PIO_EVENTSERVER_BREAKER_THRESHOLD": "2",
+        "PIO_EVENTSERVER_BREAKER_RESET": "0.3",
+        "PIO_RESILIENCE_BREAKER_RESET": "0.3",
+        "PIO_DRAIN_DEADLINE": "20",
+    }
+
+
+def _seed_es_meta(tmp_path):
+    """The event server's auth metadata lives in ITS OWN sqlite (only
+    EVENTDATA is the replicated remote source)."""
+    meta = Storage({
+        "PIO_STORAGE_SOURCES_SQ_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_SQ_PATH": str(tmp_path / "es-meta.db"),
+    })
+    app_id = meta.get_meta_data_apps().insert(App(0, "repl-chaos"))
+    key = meta.get_meta_data_access_keys().insert(AccessKey("", app_id, ()))
+    meta.close()
+    return app_id, key
+
+
+def _find_ids_via(url: str, app_id: int) -> list:
+    from incubator_predictionio_tpu.data.storage.remote import (
+        RemoteStorageClient,
+    )
+
+    client = RemoteStorageClient({"URL": url, "TIMEOUT": "10"})
+    return [e.event_id for e in client.events().find(app_id)]
+
+
+def test_storage_failover_kill9_primary_zero_acked_loss(tmp_path):
+    """ISSUE 9 acceptance (a): SIGKILL the primary storage server
+    mid-ingest under load (quorum replication) → the follower is promoted
+    with a bumped epoch, the event server's multi-endpoint client fails
+    over, and every acked event is stored exactly once (verified by id
+    set) — the outage window's acks ride the WAL spill, never a lie."""
+    import threading
+
+    app_id, key = _seed_es_meta(tmp_path)
+    pport, fport, eport = free_port(), free_port(), free_port()
+    purl, furl = f"http://127.0.0.1:{pport}", f"http://127.0.0.1:{fport}"
+    follower = _start_storage(tmp_path, "f", fport, "follower", [purl])
+    primary = _start_storage(tmp_path, "p", pport, "primary", [furl])
+    es = ServerProc(["eventserver", "--ip", "127.0.0.1",
+                     "--port", str(eport)],
+                    env=_repl_es_env(tmp_path, [purl, furl]))
+    acked: list = []
+    stop = threading.Event()
+
+    def ingest_loop():
+        i = 0
+        while not stop.is_set():
+            try:
+                status, body = http_json(
+                    "POST",
+                    f"http://127.0.0.1:{eport}/events.json?accessKey={key}",
+                    dict(EVENT, entityId=f"load-{i}"), timeout=10.0)
+                if status == 201:
+                    acked.append(body["eventId"])
+            except Exception:  # noqa: BLE001 - ambiguous: not acked
+                pass
+            i += 1
+            time.sleep(0.02)
+
+    loader = threading.Thread(target=ingest_loop, daemon=True)
+    try:
+        es.wait_ready(f"http://127.0.0.1:{eport}/")
+        # phase 1 — replicated steady state
+        for i in range(6):
+            acked.append(_post_acked(eport, key, f"pre-{i}"))
+        loader.start()
+        time.sleep(0.5)
+        # phase 2 — SIGKILL the primary mid-ingest, promote the follower
+        # (the replica set shrinks to the survivor until a scrub rejoin)
+        primary.kill9()
+        st, body = http_json("POST", f"{furl}/repl/promote",
+                             {"peers": []}, timeout=10.0)
+        assert st == 200 and body["epoch"] == 2, (st, body)
+        # phase 3 — ingest keeps flowing; the spill drains onto the
+        # promoted primary and direct acks succeed again
+        time.sleep(1.5)
+        stop.set()
+        loader.join(timeout=10.0)
+        acked.append(_post_acked(eport, key, "post-failover"))
+        _wait_health(eport, lambda h: h["spillQueueDepth"] == 0
+                     and h["status"] == "ok")
+        # epoch bumped, follower is the primary now
+        _, fh = http_json("GET", f"{furl}/health")
+        assert fh["replication"]["role"] == "primary"
+        assert fh["replication"]["epoch"] == 2
+        # exactly-once by id set, read from the promoted primary: every
+        # acked event present, nothing served twice
+        ids = _find_ids_via(furl, app_id)
+        assert len(ids) == len(set(ids)), "duplicate ids served"
+        missing = set(acked) - set(ids)
+        assert not missing, f"ACKED EVENTS LOST: {missing}"
+    finally:
+        stop.set()
+        es.stop()
+        primary.stop()
+        follower.stop()
+
+
+def test_stale_primary_restart_every_write_fenced(tmp_path):
+    """ISSUE 9 acceptance (b): the demoted primary restarted with its
+    stale persisted epoch announces at boot, learns it was deposed, and
+    every write aimed at it is rejected 409 with
+    pio_repl_fenced_writes_total incremented; `pio-tpu health` turns
+    red on the fenced store."""
+    pport, fport = free_port(), free_port()
+    purl, furl = f"http://127.0.0.1:{pport}", f"http://127.0.0.1:{fport}"
+    follower = _start_storage(tmp_path, "f", fport, "follower", [purl],
+                              sync="async")
+    primary = _start_storage(tmp_path, "p", pport, "primary", [furl],
+                             sync="async")
+    try:
+        # some replicated data, then the failover
+        from incubator_predictionio_tpu.data.event import Event
+        from incubator_predictionio_tpu.data.storage.remote import (
+            RemoteStorageClient,
+        )
+
+        client = RemoteStorageClient({"URL": purl, "TIMEOUT": "10"})
+        client.events().init(1)
+        client.events().insert_batch([
+            Event(event="rate", entity_type="user", entity_id=f"u{i}",
+                  target_entity_type="item", target_entity_id="i1")
+            for i in range(4)], 1)
+        primary.kill9()
+        st, body = http_json("POST", f"{furl}/repl/promote",
+                             {"peers": [purl]}, timeout=10.0)
+        assert st == 200 and body["epoch"] == 2
+        # restart the deposed primary with its STALE persisted epoch and
+        # its original self-image (role=primary)
+        primary = _start_storage(tmp_path, "p", pport, "primary", [furl],
+                                 sync="async")
+        # its boot announce met epoch 2 → fenced before serving a write
+        fenced_statuses = []
+        for i in range(3):
+            st, body = http_json(
+                "POST", f"{purl}/rpc/events/insert",
+                {"event": dict(EVENT, entityId=f"stale-{i}"),
+                 "app_id": 1}, timeout=10.0)
+            fenced_statuses.append(st)
+        assert fenced_statuses == [409, 409, 409], fenced_statuses
+        _, h = http_json("GET", f"{purl}/health")
+        repl = h["replication"]
+        assert repl["fenced"] is True
+        assert repl["fencedWrites"] >= 3
+        assert repl["epoch"] == 2  # adopted the deposing epoch
+        # the fleet probe goes red on a fenced store (satellite)
+        gate = subprocess.run(
+            [sys.executable, "-m", "incubator_predictionio_tpu.tools.cli",
+             "health", purl], capture_output=True, text=True, timeout=30)
+        assert gate.returncode == 1, gate.stdout + gate.stderr
+        assert "FENCED" in gate.stdout
+        # reads still serve from the fenced replica (bounded staleness)
+        st, _ = http_json("POST", f"{purl}/rpc/events/get",
+                          {"event_id": "nope", "app_id": 1}, timeout=10.0)
+        assert st == 200
+    finally:
+        primary.stop()
+        follower.stop()
+
+
+def test_store_scrub_detects_and_repairs_flipped_byte(tmp_path):
+    """ISSUE 9 acceptance (c): a single flipped byte injected into a
+    follower segment is detected by `pio-tpu store scrub` and repaired
+    to bit-identical digests."""
+    pport, fport = free_port(), free_port()
+    purl, furl = f"http://127.0.0.1:{pport}", f"http://127.0.0.1:{fport}"
+    follower = _start_storage(tmp_path, "f", fport, "follower", [purl],
+                              sync="async")
+    primary = _start_storage(tmp_path, "p", pport, "primary", [furl],
+                             sync="async")
+    try:
+        from incubator_predictionio_tpu.data.event import Event
+        from incubator_predictionio_tpu.data.storage.remote import (
+            RemoteStorageClient,
+        )
+
+        client = RemoteStorageClient({"URL": purl, "TIMEOUT": "10"})
+        ev = client.events()
+        ev.init(1)
+        ev.insert_batch([
+            Event(event="rate", entity_type="user", entity_id=f"u{i}",
+                  target_entity_type="item", target_entity_id=f"i{i % 5}")
+            for i in range(50)], 1)
+        p_log = os.path.join(str(tmp_path / "p-log"), "app_1.piolog")
+        f_log = os.path.join(str(tmp_path / "f-log"), "app_1.piolog")
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            if (os.path.exists(f_log)
+                    and os.path.getsize(f_log) == os.path.getsize(p_log)):
+                break
+            time.sleep(0.05)
+        with open(p_log, "rb") as f:
+            authoritative = f.read()
+        assert open(f_log, "rb").read() == authoritative
+        # silent bitrot on the follower copy
+        blob = bytearray(authoritative)
+        blob[len(blob) // 2] ^= 0x20
+        with open(f_log, "wb") as f:
+            f.write(blob)
+        scrub = subprocess.run(
+            [sys.executable, "-m", "incubator_predictionio_tpu.tools.cli",
+             "store", "scrub", purl, furl, "--segment-bytes", "4096",
+             "--json"], capture_output=True, text=True, timeout=60)
+        assert scrub.returncode == 0, scrub.stdout + scrub.stderr
+        report = json.loads(scrub.stdout)[furl]
+        assert report["divergentSegments"] >= 1
+        assert report["repairedBytes"] > 0
+        assert report["clean"] is True
+        assert open(f_log, "rb").read() == authoritative
+        # the repaired replica serves correct reads again
+        got = _find_ids_via(furl, 1)
+        assert len(got) == 50
+        # second scrub pass: nothing left to repair
+        scrub2 = subprocess.run(
+            [sys.executable, "-m", "incubator_predictionio_tpu.tools.cli",
+             "store", "scrub", purl, furl, "--segment-bytes", "4096",
+             "--json"], capture_output=True, text=True, timeout=60)
+        assert scrub2.returncode == 0
+        assert json.loads(scrub2.stdout)[furl]["divergentSegments"] == 0
+    finally:
+        primary.stop()
+        follower.stop()
+
+
 def test_event_server_sigterm_drains_and_exits_clean(tmp_path):
     """Graceful drain end-to-end: SIGTERM → new ingest 503s, the spilled
     acks flush to the recovered store, the process exits 0 within the

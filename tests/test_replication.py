@@ -1,0 +1,1241 @@
+"""Replicated eventlog storage (ISSUE 9): frame shipping with CRC verify,
+epoch-fenced failover, quorum ack vs WAL spill, anti-entropy scrub, the
+multi-endpoint remote client, and the streaming feed's cursor surviving a
+failover — all in-process and deterministic (FakeClock, zero wall
+sleeps). The subprocess SIGKILL proofs live in tests/test_chaos_procs.py."""
+
+import base64
+import datetime as dt
+import json
+import os
+import struct
+import zlib
+
+import pytest
+
+from incubator_predictionio_tpu.data import DataMap, Event
+from incubator_predictionio_tpu.data.storage import Storage
+from incubator_predictionio_tpu.data.storage.base import StorageError
+from incubator_predictionio_tpu.data.storage.eventlog_backend import (
+    EventLogEvents,
+    EventLogStorageClient,
+)
+from incubator_predictionio_tpu.native import format as fmt
+from incubator_predictionio_tpu.replication.manager import (
+    ReplicationConfig,
+    ReplicationManager,
+    ReplicationUnavailable,
+    complete_extent,
+    list_logs,
+    tail_extent,
+)
+from incubator_predictionio_tpu.replication.scrub import (
+    file_digests,
+    scrub_follower,
+)
+from incubator_predictionio_tpu.resilience.clock import FakeClock
+
+UTC = dt.timezone.utc
+APP = 1
+
+
+def _rate(user, item, rating=5.0, minute=0) -> Event:
+    return Event(
+        event="rate", entity_type="user", entity_id=user,
+        target_entity_type="item", target_entity_id=item,
+        properties=DataMap({"rating": float(rating)}),
+        event_time=dt.datetime(2023, 5, 1, 0, minute % 60, tzinfo=UTC))
+
+
+def _read(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+class _Pair:
+    """A primary+follower manager pair wired RPC-to-handler in-process:
+    the real protocol (epochs, CRC, offset contract) with no sockets."""
+
+    def __init__(self, tmp_path, sync="async", clock=None, **cfg):
+        self.pd = str(tmp_path / "primary")
+        self.fd = str(tmp_path / "follower")
+        self.primary_store = EventLogStorageClient({"PATH": self.pd})
+        self.follower_store = EventLogStorageClient(
+            {"PATH": self.fd, "READ_ONLY": "1"})
+        self.calls = []
+        self.follower_down = False
+        kw = dict(clock=clock) if clock is not None else {}
+        # the storage server wires these callbacks in production: role
+        # changes flip the co-resident events store between writer and
+        # lock-free read-only modes (flocks must change hands)
+        p_events = self.primary_store.events()
+        f_events = self.follower_store.events()
+        self.f_mgr = ReplicationManager(
+            ReplicationConfig(log_dir=self.fd, role="follower"),
+            on_writable=lambda: f_events.set_read_only(False),
+            on_read_only=lambda: f_events.set_read_only(True), **kw)
+        self.f_mgr.invalidate_read_views = f_events.reopen
+        self.p_mgr = ReplicationManager(
+            ReplicationConfig(log_dir=self.pd, role="primary",
+                              peers=("follower",), sync=sync, **cfg),
+            rpc=self._rpc,
+            on_writable=lambda: p_events.set_read_only(False),
+            on_read_only=lambda: p_events.set_read_only(True), **kw)
+        self.p_mgr.invalidate_read_views = p_events.reopen
+
+    def _rpc(self, url, verb, payload):
+        self.calls.append((url, verb))
+        if self.follower_down:
+            raise ConnectionRefusedError("follower down")
+        return self.f_mgr.handle(verb, payload)
+
+    def insert(self, n, start=0):
+        ev = self.primary_store.events()
+        ev.init(APP)
+        return ev.insert_batch(
+            [_rate(f"u{start + i}", f"i{(start + i) % 7}") for i in range(n)],
+            APP)
+
+    def log(self, which="primary"):
+        return os.path.join(self.pd if which == "primary" else self.fd,
+                            "app_1.piolog")
+
+
+# ---------------------------------------------------------------------------
+# record-boundary math (the wal.tail_frames contract on PIOLOG framing)
+# ---------------------------------------------------------------------------
+
+def test_complete_extent_stops_at_partial_and_zeroed_tails(tmp_path):
+    store = EventLogEvents(str(tmp_path / "log"))
+    store.init(APP)
+    store.insert_batch([_rate("u1", "i1"), _rate("u2", "i2")], APP)
+    buf = _read(store.log_path(APP))
+    assert complete_extent(buf, 0) == len(buf)
+    # a torn record at the tail is excluded, never half-shipped
+    assert complete_extent(buf[:-3], 0) < len(buf) - 3
+    # a zeroed tail (crash artifact) stops the walk
+    assert complete_extent(buf + b"\x00" * 8, 0) == len(buf)
+    # mid-file offsets walk records, not magic
+    first_rec_end = complete_extent(buf, 0)
+    assert complete_extent(buf[len(fmt.MAGIC):], len(fmt.MAGIC)) \
+        == first_rec_end - len(fmt.MAGIC)
+    # garbage where the magic should be ships nothing
+    assert complete_extent(b"NOTALOG1" + buf[8:], 0) == 0
+
+
+def test_tail_extent_ok_waiting_bounded(tmp_path):
+    store = EventLogEvents(str(tmp_path / "log"))
+    store.init(APP)
+    store.insert_batch([_rate("u1", "i1")], APP)
+    path = store.log_path(APP)
+    full = _read(path)
+
+    data, off, status = tail_extent(path, 0)
+    assert (data, off, status) == (full, len(full), "ok")
+    # nothing new → ok with empty data at the same offset
+    assert tail_extent(path, off) == (b"", off, "ok")
+    # live-writer torn tail → waiting, nothing phantom-shipped
+    with open(path, "ab") as f:
+        f.write(struct.pack("<I", 100) + b"partial")
+    data, off2, status = tail_extent(path, off)
+    assert status == "waiting" and data == b"" and off2 == off
+    # a read bound that cuts a record is "bounded", not "waiting"
+    data, off3, status = tail_extent(path, 0, max_bytes=len(fmt.MAGIC) + 4)
+    assert status == "bounded" and off3 == len(fmt.MAGIC)
+
+
+# ---------------------------------------------------------------------------
+# shipping: byte-identity, CRC verify, resync, lag
+# ---------------------------------------------------------------------------
+
+def test_ship_makes_follower_byte_identical_and_readable(tmp_path):
+    pair = _Pair(tmp_path)
+    pair.insert(6)
+    assert pair.p_mgr.ship_once("follower") is True
+    assert _read(pair.log("primary")) == _read(pair.log("follower"))
+    assert pair.p_mgr.min_lag_bytes() == 0
+    # the follower serves the read path from its replica
+    got = sorted(e.entity_id
+                 for e in pair.follower_store.events().find(APP))
+    assert got == [f"u{i}" for i in range(6)]
+    # incremental append ships only the delta and stays identical
+    pair.insert(3, start=6)
+    assert pair.p_mgr.min_lag_bytes() > 0
+    assert pair.p_mgr.ship_once("follower") is True
+    assert _read(pair.log("primary")) == _read(pair.log("follower"))
+    assert len(list(pair.follower_store.events().find(APP))) == 9
+
+
+def test_crc_mismatch_rejected_on_apply(tmp_path):
+    pair = _Pair(tmp_path)
+    pair.insert(2)
+    real = pair.f_mgr.handle
+
+    def corrupting(verb, payload):
+        if verb == "append":
+            raw = bytearray(base64.b64decode(payload["data"]))
+            raw[len(raw) // 2] ^= 0xFF  # bit flip in flight
+            payload = dict(payload,
+                           data=base64.b64encode(bytes(raw)).decode())
+        return real(verb, payload)
+
+    pair.f_mgr.handle = corrupting
+    assert pair.p_mgr.ship_once("follower") is False
+    # nothing landed: the follower file does not exist / holds no records
+    assert list_logs(pair.fd).get("app_1.piolog", 0) == 0
+    # transport restored → the retry ships clean
+    pair.f_mgr.handle = real
+    assert pair.p_mgr.ship_once("follower") is True
+    assert _read(pair.log("primary")) == _read(pair.log("follower"))
+
+
+def test_follower_offset_mismatch_resyncs(tmp_path):
+    """The primary's cached view of a follower can go stale (restart,
+    competing ship round): the append answers with the follower's real
+    size and the primary resyncs from there — never overlapping bytes."""
+    pair = _Pair(tmp_path)
+    pair.insert(4)
+    assert pair.p_mgr.ship_once("follower") is True
+    # hand the follower manager a direct append replay: dup offset refused
+    data, _, _ = tail_extent(pair.log("primary"), 0)
+    status, body = pair.f_mgr.handle("append", {
+        "epoch": pair.p_mgr.epoch, "log": "app_1.piolog", "offset": 0,
+        "crc": zlib.crc32(data) & 0xFFFFFFFF,
+        "data": base64.b64encode(data).decode()})
+    assert status == 200 and body["ok"] is False
+    assert body["size"] == len(data)
+    # and the files never diverged
+    assert _read(pair.log("primary")) == _read(pair.log("follower"))
+
+
+# ---------------------------------------------------------------------------
+# epoch fencing: promote, demote, stale-primary writes
+# ---------------------------------------------------------------------------
+
+def test_promote_bumps_and_persists_epoch(tmp_path):
+    pair = _Pair(tmp_path)
+    pair.insert(2)
+    pair.p_mgr.ship_once("follower")
+    out = pair.f_mgr.promote(peers=[])
+    assert out == {"epoch": 2, "role": "primary"}
+    assert pair.f_mgr.is_primary
+    # persisted: a restarted manager over the same dir keeps the epoch
+    reloaded = ReplicationManager(
+        ReplicationConfig(log_dir=pair.fd, role="follower"))
+    assert reloaded.epoch == 2 and reloaded.role == "primary"
+
+
+def test_stale_primary_is_fenced_at_announce_and_append(tmp_path):
+    pair = _Pair(tmp_path)
+    pair.insert(2)
+    pair.p_mgr.ship_once("follower")
+    pair.f_mgr.promote(peers=[])
+    # the deposed primary heartbeats at boot → learns the higher epoch
+    pair.p_mgr.announce()
+    assert pair.p_mgr.fenced and not pair.p_mgr.can_accept_writes()
+    assert pair.p_mgr.role == "follower"
+    # and every write it would accept is now refused + counted
+    before = pair.p_mgr.fenced_writes
+    pair.p_mgr.record_fenced_write()
+    assert pair.p_mgr.fenced_writes == before + 1
+
+
+def test_stale_append_rejected_with_409_fence(tmp_path):
+    pair = _Pair(tmp_path)
+    pair.insert(1)
+    pair.f_mgr.promote(peers=[])  # follower now at epoch 2
+    status, body = pair.f_mgr.handle("append", {
+        "epoch": 1, "log": "app_1.piolog", "offset": 0, "crc": 0,
+        "data": ""})
+    assert status == 409 and body["fenced"] == 2
+
+
+def test_old_primary_demotes_on_higher_epoch_append(tmp_path):
+    """The other direction: the NEW primary ships to the old one once it
+    resurfaces — receiving a higher-epoch append demotes it in place."""
+    pair = _Pair(tmp_path)
+    pair.insert(1)
+    pair.p_mgr.ship_once("follower")
+    # make the follower the new primary and give it the old one as peer
+    pair.f_mgr.promote(peers=["old"])
+    pair.f_mgr._rpc = lambda url, verb, payload: \
+        pair.p_mgr.handle(verb, payload)
+    pair.f_mgr.peers["old"].url = "old"
+    # new primary writes (its own dir is now writable)
+    writer = EventLogEvents(pair.fd)
+    writer.init(APP)
+    writer.insert_batch([_rate("u9", "i9")], APP)
+    assert pair.f_mgr.ship_once("old") is True
+    assert pair.p_mgr.role == "follower" and pair.p_mgr.epoch == 2
+    assert _read(pair.log("primary")) == _read(pair.log("follower"))
+    writer.close()
+
+
+def test_diverged_peer_gets_nothing_until_scrub_repairs_it(tmp_path):
+    """Review regression: a follower observed AHEAD of the primary is
+    divergent history — shipping must stop entirely (appending our bytes
+    after its suffix would interleave two histories, and per-chunk CRCs
+    can't catch it), and resume only after the peer verifies as a clean
+    CRC prefix again (what `store scrub` leaves behind)."""
+    pair = _Pair(tmp_path)
+    pair.insert(3)
+    pair.p_mgr.ship_once("follower")
+    good = _read(pair.log("follower"))
+    # divergent suffix on the follower (async writes a deposed primary
+    # never shipped, in the from-the-other-side framing)
+    with open(pair.log("follower"), "ab") as f:
+        f.write(b"\x99" * 32)
+    assert pair.p_mgr.ship_once("follower") is False
+    assert pair.p_mgr.peers["follower"].diverged is True
+    # the primary outgrows the follower — STILL nothing ships
+    pair.insert(30, start=3)
+    assert os.path.getsize(pair.log("primary")) > \
+        os.path.getsize(pair.log("follower"))
+    assert pair.p_mgr.ship_once("follower") is False
+    assert _read(pair.log("follower")) == good + b"\x99" * 32  # untouched
+    # scrub repairs the follower → the re-check clears the flag and
+    # shipping resumes to byte identity
+    report = scrub_follower("primary", "follower", _scrub_rpc(pair),
+                            segment_bytes=4096)
+    assert report["clean"] is True
+    assert pair.p_mgr.ship_once("follower") is True
+    assert pair.p_mgr.peers["follower"].diverged is False
+    assert _read(pair.log("primary")) == _read(pair.log("follower"))
+
+
+def test_record_larger_than_chunk_bound_still_ships(tmp_path):
+    """Review regression: a single record bigger than PIO_REPL_CHUNK_BYTES
+    must grow the read instead of stalling replication forever."""
+    pair = _Pair(tmp_path, chunk_bytes=4096)
+    ev = pair.primary_store.events()
+    ev.init(APP)
+    big = Event(
+        event="rate", entity_type="user", entity_id="u-big",
+        target_entity_type="item", target_entity_id="i1",
+        properties=DataMap({"blob": "x" * 20_000}),
+        event_time=dt.datetime(2023, 5, 1, tzinfo=UTC))
+    ev.insert_batch([big, _rate("u2", "i2")], APP)
+    assert pair.p_mgr.ship_once("follower") is True
+    assert _read(pair.log("primary")) == _read(pair.log("follower"))
+    assert pair.p_mgr.min_lag_bytes() == 0
+
+
+def test_corrupt_repl_state_refuses_to_start(tmp_path):
+    """Review regression: a corrupt fencing token must fail startup
+    loudly, never re-initialize to a writable epoch-1 primary."""
+    d = str(tmp_path / "log")
+    mgr = ReplicationManager(ReplicationConfig(log_dir=d, role="primary"))
+    mgr.promote(peers=[])  # epoch 2 persisted
+    with open(os.path.join(d, "repl-state.json"), "w") as f:
+        f.write("{corrupt")
+    with pytest.raises(RuntimeError, match="corrupt replication state"):
+        ReplicationManager(ReplicationConfig(log_dir=d, role="primary"))
+
+
+def test_fence_clears_when_rejoined_follower_applies_cleanly(tmp_path):
+    """Review regression: a deposed primary that rejoins and receives a
+    clean current-epoch append (which the diverged gate only ships after
+    prefix verification) stops reporting fenced/red — it is a consistent
+    follower again, eligible for bounded-staleness reads."""
+    pair = _Pair(tmp_path)
+    pair.insert(2)
+    pair.p_mgr.ship_once("follower")
+    pair.f_mgr.promote(peers=["old"])
+    pair.f_mgr._rpc = lambda url, verb, payload: \
+        pair.p_mgr.handle(verb, payload)
+    pair.p_mgr.announce()  # old primary learns → fenced
+    assert pair.p_mgr.fenced is True
+    writer = EventLogEvents(pair.fd)
+    writer.init(APP)
+    writer.insert_batch([_rate("u9", "i9")], APP)
+    assert pair.f_mgr.ship_once("old") is True
+    assert pair.p_mgr.fenced is False          # rejoined cleanly
+    assert pair.p_mgr.role == "follower"       # writes stay role-fenced
+    assert pair.p_mgr.can_accept_writes() is False
+    # persisted: still unfenced after a restart
+    reloaded = ReplicationManager(
+        ReplicationConfig(log_dir=pair.pd, role="follower"))
+    assert reloaded.fenced is False and reloaded.epoch == 2
+    writer.close()
+
+
+def test_equal_length_divergent_peer_detected_before_first_ship(tmp_path):
+    """Review regression: a rejoined replica whose log is the SAME SIZE
+    (or shorter) but a different history must be caught by the prefix-CRC
+    verification before the first append — size comparison alone would
+    interleave two histories and even let the peer satisfy quorum."""
+    pair = _Pair(tmp_path, sync="quorum", clock=FakeClock(),
+                 quorum_timeout=0.5)
+    pair.insert(4)
+    assert pair.p_mgr.ship_once("follower") is True
+    good = _read(pair.log("follower"))
+    # same length, different bytes: a divergent history of equal size
+    blob = bytearray(good)
+    blob[len(blob) // 2] ^= 0xFF
+    with open(pair.log("follower"), "wb") as f:
+        f.write(bytes(blob))
+    # a FRESH primary manager (restart) must re-verify before shipping
+    fresh = ReplicationManager(
+        ReplicationConfig(log_dir=pair.pd, role="primary",
+                          peers=("follower",), sync="quorum",
+                          quorum_timeout=0.5),
+        rpc=pair._rpc, clock=FakeClock())
+    assert fresh.ship_once("follower") is False
+    assert fresh.peers["follower"].diverged is True
+    assert _read(pair.log("follower")) == bytes(blob)  # nothing appended
+    # quorum must NOT count the diverged peer's equal size as an ack
+    with pytest.raises(ReplicationUnavailable):
+        fresh.sync_quorum()
+    # and the lag bound sees it as holding nothing durable
+    assert fresh.min_lag_bytes() == os.path.getsize(pair.log("primary"))
+    # scrub repairs → verification passes → shipping resumes
+    def fresh_rpc(url, verb, payload):
+        mgr = fresh if url == "primary" else pair.f_mgr
+        return mgr.handle(verb, payload)
+
+    report = scrub_follower("primary", "follower", fresh_rpc,
+                            segment_bytes=4096)
+    assert report["clean"] is True
+    assert fresh.ship_once("follower") is True
+    assert fresh.peers["follower"].diverged is False
+    assert _read(pair.log("primary")) == _read(pair.log("follower"))
+
+
+def test_rpc_connection_honors_https_scheme():
+    """Review regression: replication RPCs against TLS storage servers
+    must actually speak TLS (and default to the scheme's port)."""
+    import http.client
+
+    from incubator_predictionio_tpu.replication.manager import (
+        rpc_connection,
+    )
+
+    c = rpc_connection("http://h:7073", 1.0)
+    assert type(c) is http.client.HTTPConnection and c.port == 7073
+    c = rpc_connection("http://h", 1.0)
+    assert c.port == 7072  # storage server default
+    c = rpc_connection("https://h", 1.0)
+    assert isinstance(c, http.client.HTTPSConnection) and c.port == 443
+    c = rpc_connection("https://h:7072", 1.0)
+    assert isinstance(c, http.client.HTTPSConnection) and c.port == 7072
+
+
+def test_store_status_flags_unreplicated_member(tmp_path, monkeypatch,
+                                                capsys):
+    """Review regression: a reachable replica WITHOUT a replication
+    section must render as red, matching the non-zero exit code."""
+    import incubator_predictionio_tpu.tools.cli as cli
+
+    monkeypatch.setattr(
+        cli, "_fetch_health",
+        lambda url, timeout=5.0: {"status": "ok"})  # no replication key
+    rc = cli.cmd_store_status(
+        type("A", (), {"urls": ["http://s"], "timeout": 1.0,
+                       "json": False})(), None)
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "!!" in out and "replication not configured" in out
+
+
+def test_remove_propagates_and_reinit_does_not_wedge(tmp_path):
+    """Review regression: events.remove must travel to followers (byte
+    shipping can't delete files) — a retained follower copy would wedge
+    ALL shipping as 'divergent' the moment the app is re-initialized
+    smaller, turning a routine app delete/recreate into a write outage."""
+    pair = _Pair(tmp_path)
+    pair.insert(5)
+    assert pair.p_mgr.ship_once("follower") is True
+    # the admin fan-out the storage server performs after events.remove
+    pair.primary_store.events().remove(APP)
+    pair.p_mgr.propagate_remove("app_1.piolog")
+    assert not os.path.exists(pair.log("follower"))
+    # re-init + write: ships cleanly, never flags divergence
+    pair.insert(2)
+    assert pair.p_mgr.ship_once("follower") is True
+    assert pair.p_mgr.peers["follower"].diverged is False
+    assert _read(pair.log("primary")) == _read(pair.log("follower"))
+
+
+def test_scrub_removes_follower_only_logs(tmp_path):
+    """Review regression: a follower-only log (removal never propagated —
+    the follower was down) is reconciled by scrub, not retained forever."""
+    pair = _Pair(tmp_path)
+    pair.insert(3)
+    pair.p_mgr.ship_once("follower")
+    pair.primary_store.events().remove(APP)  # follower never hears
+    assert os.path.exists(pair.log("follower"))
+    report = scrub_follower("primary", "follower", _scrub_rpc(pair),
+                            segment_bytes=4096)
+    assert report["removedLogs"] == ["app_1.piolog"]
+    assert report["clean"] is True
+    assert not os.path.exists(pair.log("follower"))
+    # check-only mode detects without deleting
+    pair.insert(1)
+    pair.p_mgr.ship_once("follower")
+    pair.primary_store.events().remove(APP)
+    report = scrub_follower("primary", "follower", _scrub_rpc(pair),
+                            segment_bytes=4096, repair=False)
+    assert report["clean"] is False
+    assert os.path.exists(pair.log("follower"))
+
+
+def test_remove_log_refused_on_primary_and_stale_epoch(tmp_path):
+    pair = _Pair(tmp_path)
+    pair.insert(1)
+    st, _ = pair.p_mgr.handle("remove_log",
+                              {"log": "app_1.piolog", "epoch": 1})
+    assert st == 409  # never delete the authoritative copy
+    pair.f_mgr.promote(peers=[])  # follower → epoch 2
+    st, _ = pair.f_mgr.handle("remove_log",
+                              {"log": "app_1.piolog", "epoch": 1})
+    assert st == 409  # stale sender fenced (and it's a primary now)
+
+
+def test_behind_epoch_follower_announce_adopts_without_fencing(tmp_path):
+    """Review regression: a follower restarted across a failover it
+    missed (persisted epoch behind the cluster) must ADOPT the higher
+    epoch at announce, not raise the fenced alarm — it was never a
+    deposed primary and its data is fine."""
+    peer_epoch = {"epoch": 5, "role": "primary"}
+    mgr = ReplicationManager(
+        ReplicationConfig(log_dir=str(tmp_path / "f"), role="follower",
+                          peers=("peer",)),
+        rpc=lambda url, verb, payload: (200, peer_epoch))
+    mgr.announce()
+    assert mgr.epoch == 5
+    assert mgr.fenced is False
+    assert mgr.role == "follower"
+
+
+def test_fenced_write_fails_fast_through_the_retry_policy():
+    """Review regression: FencedWrite is transient cluster-wise but can
+    never improve by retrying the SAME endpoint — the policy must raise
+    it after ONE attempt (no backoff burned) so the multi-endpoint
+    failover layer acts immediately."""
+    from incubator_predictionio_tpu.data.storage.remote import FencedWrite
+    from incubator_predictionio_tpu.resilience.policy import (
+        ResiliencePolicy,
+        RetryPolicy,
+    )
+
+    clock = FakeClock()
+    attempts = []
+
+    def fn(deadline):
+        attempts.append(1)
+        raise FencedWrite("fenced")
+
+    policy = ResiliencePolicy(RetryPolicy(max_attempts=5), clock=clock)
+    with pytest.raises(FencedWrite):
+        policy.call(fn, idempotent=True, op="init")
+    assert len(attempts) == 1
+    assert clock.slept == []
+
+
+def test_promote_makes_store_writable_before_admitting_writes(tmp_path):
+    """Regression (found by the failover bench): a write that passes the
+    fence gate in the instant after promote must never land on a
+    still-read-only store — the on_writable callback runs BEFORE the
+    role flip admits the first write, so there is no window where
+    can_accept_writes() is True but the eventlog would refuse the
+    append as read-only (a 500 the event server's drain would
+    dead-letter acked events on)."""
+    order = []
+    mgr = ReplicationManager(
+        ReplicationConfig(log_dir=str(tmp_path / "f"), role="follower"),
+        on_writable=lambda: order.append(
+            ("writable", mgr.can_accept_writes())))
+    mgr.promote(peers=[])
+    # at callback time the manager did NOT yet admit writes
+    assert order == [("writable", False)]
+    assert mgr.can_accept_writes() is True
+
+
+def test_read_only_log_write_is_503_not_dead_letterable(tmp_path):
+    """The defense in depth for every OTHER transition window: a write
+    reaching a read-only eventlog raises ReadOnlyLogError, and the
+    storage server answers 503 (transient → clients spill/retry), never
+    the semantic 500 that diverts acked events to the dead letter."""
+    from incubator_predictionio_tpu.data.storage.eventlog_backend import (
+        ReadOnlyLogError,
+    )
+    from incubator_predictionio_tpu.resilience.policy import TransientError
+
+    store = EventLogStorageClient(
+        {"PATH": str(tmp_path / "log"), "READ_ONLY": "1"})
+    with pytest.raises(ReadOnlyLogError):
+        store.events().insert_batch([_rate("u1", "i1")], APP)
+
+    # end to end: follower storage server window where the fence gate is
+    # open (simulated) but the store is still read-only → the remote
+    # client classifies the outcome as TRANSIENT
+    from incubator_predictionio_tpu.data.storage.remote import (
+        RemoteStorageClient,
+    )
+    from incubator_predictionio_tpu.server.storage_server import (
+        StorageServerConfig,
+        ThreadedStorageServer,
+    )
+
+    backing = Storage({
+        "PIO_STORAGE_SOURCES_EL_TYPE": "eventlog",
+        "PIO_STORAGE_SOURCES_EL_PATH": str(tmp_path / "srv-log"),
+        "PIO_STORAGE_SOURCES_SQ_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_SQ_PATH": str(tmp_path / "srv.db"),
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "EL",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQ",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SQ",
+    })
+    server = ThreadedStorageServer(backing, StorageServerConfig(
+        ip="127.0.0.1", port=0, repl_role="follower",
+        repl_peers=("http://127.0.0.1:9",)))
+    try:
+        # simulate the transition instant: writes admitted, store not yet
+        # flipped writable
+        server._server._repl.can_accept_writes = lambda: True
+        client = RemoteStorageClient({
+            "URL": server.url, "TIMEOUT": "5",
+            "RETRY_MAX_ATTEMPTS": "1"})
+        with pytest.raises(TransientError):
+            client.events().insert_batch([_rate("u1", "i1")], APP)
+    finally:
+        server.close()
+        backing.close()
+
+
+# ---------------------------------------------------------------------------
+# quorum ack + bounded lag (FakeClock, zero wall sleeps)
+# ---------------------------------------------------------------------------
+
+def test_quorum_ack_ships_before_returning(tmp_path):
+    clock = FakeClock()
+    pair = _Pair(tmp_path, sync="quorum", clock=clock)
+    pair.insert(3)
+    pair.p_mgr.sync_quorum()  # must ship everything, then return
+    assert _read(pair.log("primary")) == _read(pair.log("follower"))
+    assert clock.slept == []  # quorum reached without a single sleep
+
+
+def test_quorum_unreachable_raises_within_timeout_on_fake_clock(tmp_path):
+    clock = FakeClock()
+    pair = _Pair(tmp_path, sync="quorum", clock=clock, quorum_timeout=1.0)
+    pair.insert(2)
+    pair.follower_down = True
+    with pytest.raises(ReplicationUnavailable):
+        pair.p_mgr.sync_quorum()
+    assert clock.monotonic() >= 1.0  # waited virtually, not on the wall
+
+
+def test_quorum_solo_primary_is_trivially_satisfied(tmp_path):
+    mgr = ReplicationManager(ReplicationConfig(
+        log_dir=str(tmp_path / "solo"), role="primary", sync="quorum"))
+    mgr.sync_quorum()  # no peers → quorum of one → immediate
+
+
+def test_async_lag_bound_refuses_when_follower_unreachable(tmp_path):
+    pair = _Pair(tmp_path, max_lag_bytes=64)
+    pair.insert(8)  # well past 64 bytes of log
+    pair.follower_down = True
+    with pytest.raises(ReplicationUnavailable):
+        pair.p_mgr.check_async_bound()
+    # follower back: the gate pulls it forward instead of bouncing
+    pair.follower_down = False
+    pair.p_mgr.check_async_bound()
+    assert pair.p_mgr.min_lag_bytes() == 0
+
+
+def test_health_surfaces_role_epoch_lag_and_fence(tmp_path):
+    pair = _Pair(tmp_path)
+    pair.insert(2)
+    h = pair.p_mgr.health()
+    assert h["role"] == "primary" and h["epoch"] == 1
+    assert h["peers"]["follower"]["lagBytes"] > 0
+    pair.p_mgr.ship_once("follower")
+    assert pair.p_mgr.health()["lagBytes"] == 0
+    pair.f_mgr.promote(peers=[])
+    pair.p_mgr.announce()
+    h = pair.p_mgr.health()
+    assert h["fenced"] is True and h["epoch"] == 2
+    fh = pair.f_mgr.health()
+    assert fh["role"] == "primary" and fh["epoch"] == 2
+
+    from incubator_predictionio_tpu.fleet.health import replication_flags
+
+    flags = replication_flags({"replication": h})
+    assert flags["red"] is True and flags["fenced"] is True
+    assert replication_flags({"replication": fh})["red"] is False
+    assert replication_flags({"status": "ok"}) is None
+
+
+# ---------------------------------------------------------------------------
+# anti-entropy scrub: flipped byte detected + repaired to bit-identity
+# ---------------------------------------------------------------------------
+
+def _scrub_rpc(pair):
+    def rpc(url, verb, payload):
+        mgr = pair.p_mgr if url == "primary" else pair.f_mgr
+        return mgr.handle(verb, payload)
+
+    return rpc
+
+
+def test_scrub_detects_and_repairs_flipped_byte(tmp_path):
+    pair = _Pair(tmp_path)
+    pair.insert(40)
+    pair.p_mgr.ship_once("follower")
+    path = pair.log("follower")
+    blob = bytearray(_read(path))
+    blob[len(blob) // 2] ^= 0x40  # silent bitrot
+    with open(path, "wb") as f:
+        f.write(blob)
+    assert _read(pair.log("primary")) != _read(path)
+
+    report = scrub_follower("primary", "follower", _scrub_rpc(pair),
+                            segment_bytes=4096)
+    assert report["divergentSegments"] >= 1
+    assert report["repairedBytes"] > 0
+    assert report["clean"] is True
+    assert _read(pair.log("primary")) == _read(path)
+    # a second pass scans clean
+    again = scrub_follower("primary", "follower", _scrub_rpc(pair),
+                           segment_bytes=4096)
+    assert again["divergentSegments"] == 0 and again["clean"]
+
+
+def test_scrub_truncates_divergent_overlong_follower(tmp_path):
+    """A deposed primary's unshipped async suffix: the authoritative
+    history wins and the extra bytes go."""
+    pair = _Pair(tmp_path)
+    pair.insert(5)
+    pair.p_mgr.ship_once("follower")
+    with open(pair.log("follower"), "ab") as f:
+        f.write(b"\x00" * 64)  # divergent suffix
+    report = scrub_follower("primary", "follower", _scrub_rpc(pair),
+                            segment_bytes=4096)
+    assert report["clean"] is True
+    assert _read(pair.log("primary")) == _read(pair.log("follower"))
+
+
+def test_scrub_check_only_detects_without_repair(tmp_path):
+    pair = _Pair(tmp_path)
+    pair.insert(5)
+    pair.p_mgr.ship_once("follower")
+    path = pair.log("follower")
+    blob = bytearray(_read(path))
+    blob[10] ^= 0x01
+    with open(path, "wb") as f:
+        f.write(blob)
+    report = scrub_follower("primary", "follower", _scrub_rpc(pair),
+                            segment_bytes=4096, repair=False)
+    assert report["divergentSegments"] == 1
+    assert report["repairedBytes"] == 0 and report["clean"] is False
+    assert _read(path) == bytes(blob)  # untouched
+
+
+def test_scrub_refuses_to_patch_primary(tmp_path):
+    pair = _Pair(tmp_path)
+    pair.insert(1)
+    status, body = pair.p_mgr.handle(
+        "patch", {"log": "app_1.piolog", "offset": 0, "crc": 0,
+                  "data": base64.b64encode(b"x").decode()})
+    assert status == 409
+
+
+def test_file_digests_windows_cover_file_exactly(tmp_path):
+    path = str(tmp_path / "blob.piolog")
+    with open(path, "wb") as f:
+        f.write(os.urandom(10_000))
+    size, segs = file_digests(path, segment_bytes=4096)
+    assert size == 10_000
+    assert [s[0] for s in segs] == [0, 4096, 8192]
+    assert sum(s[1] for s in segs) == size
+    assert file_digests(str(tmp_path / "missing"), 4096) == (0, [])
+
+
+# ---------------------------------------------------------------------------
+# multi-endpoint remote client: primary selection, failover, follower reads
+# ---------------------------------------------------------------------------
+
+class _StubTransport:
+    def __init__(self, url, fail_with=None, result="ok"):
+        self.url_label = url
+        self.fail_with = fail_with
+        self.result = result
+        self.calls = []
+
+    def call(self, store, method, args):
+        self.calls.append((store, method))
+        if self.fail_with is not None:
+            raise self.fail_with
+        return self.result
+
+
+def _mk_multi(monkeypatch, healths, read_followers=False):
+    from incubator_predictionio_tpu.data.storage.remote import (
+        _MultiTransport,
+    )
+
+    urls = list(healths)
+    mt = _MultiTransport(urls, None, 5.0,
+                        config={"READ_FOLLOWERS":
+                                "1" if read_followers else "0"})
+    mt.probe_health = lambda url: healths[url]
+    for url in urls:
+        mt.transports[url] = _StubTransport(url)
+    return mt
+
+
+def _h(role, epoch, fenced=False, age=0.0):
+    return {"status": "ok",
+            "replication": {"role": role, "epoch": epoch, "fenced": fenced,
+                            "contactAgeSeconds": age}}
+
+
+def test_multi_transport_selects_highest_epoch_primary(monkeypatch):
+    healths = {
+        "http://a": _h("primary", 1, fenced=True),   # deposed
+        "http://b": _h("primary", 2),                # the real one
+        "http://c": _h("follower", 2),
+    }
+    mt = _mk_multi(monkeypatch, healths)
+    assert mt.call("events", "insert", {}) == "ok"
+    assert mt.transports["http://b"].calls  # writes went to b
+    assert not mt.transports["http://a"].calls
+
+
+def test_multi_transport_fails_over_on_fence(monkeypatch):
+    from incubator_predictionio_tpu.data.storage.remote import FencedWrite
+
+    healths = {"http://a": _h("primary", 1), "http://b": _h("follower", 1)}
+    mt = _mk_multi(monkeypatch, healths)
+
+    def fenced_call(store, method, args):
+        # the server fencing the write has, by definition, learned of the
+        # higher epoch — its /health flips before the client re-probes
+        healths["http://a"] = _h("primary", 1, fenced=True)
+        healths["http://b"] = _h("primary", 2)
+        raise FencedWrite("fenced")
+
+    mt.transports["http://a"].call = fenced_call
+    # the write bounces off a, the re-probe finds b promoted, retry lands
+    assert mt.call("events", "insert", {}) == "ok"
+    assert mt.transports["http://b"].calls == [("events", "insert")]
+
+
+def test_multi_transport_write_failover_on_breaker_open(monkeypatch):
+    from incubator_predictionio_tpu.resilience.breaker import (
+        CircuitOpenError,
+    )
+
+    healths = {"http://a": _h("primary", 1), "http://b": _h("primary", 2)}
+    mt = _mk_multi(monkeypatch, healths)
+    # a's breaker is open (it just died): the call was never sent, so
+    # even a WRITE may fail over immediately
+    mt._primary_url = "http://a"
+    mt._probed_at = mt.clock.monotonic()
+    mt.transports["http://a"].fail_with = CircuitOpenError("a", 1.0)
+    assert mt.call("events", "insert", {}) == "ok"
+    assert mt.transports["http://b"].calls
+
+
+def test_multi_transport_never_resends_ambiguous_write(monkeypatch):
+    from incubator_predictionio_tpu.resilience.policy import TransientError
+
+    healths = {"http://a": _h("primary", 1), "http://b": _h("follower", 1)}
+    mt = _mk_multi(monkeypatch, healths)
+    mt.transports["http://a"].fail_with = TransientError("conn reset")
+    with pytest.raises(TransientError):
+        mt.call("events", "insert", {})
+    assert not mt.transports["http://b"].calls  # no blind re-send
+    # but an idempotent read retries on the survivor
+    healths["http://a"] = None
+    healths["http://b"] = _h("primary", 2)
+    assert mt.call("events", "get", {}) == "ok"
+    assert mt.transports["http://b"].calls == [("events", "get")]
+
+
+def test_multi_transport_bounded_staleness_follower_reads(monkeypatch):
+    healths = {
+        "http://p": _h("primary", 3),
+        "http://f1": _h("follower", 3, age=0.5),     # caught up
+        "http://f2": _h("follower", 3, age=99.0),    # too stale
+    }
+    mt = _mk_multi(monkeypatch, healths, read_followers=True)
+    assert mt.call("events", "find_by_entities", {}) == "ok"
+    assert mt.transports["http://f1"].calls
+    assert not mt.transports["http://f2"].calls
+    # writes still go to the primary
+    mt.call("events", "insert_batch", {})
+    assert mt.transports["http://p"].calls == [("events", "insert_batch")]
+    # init is idempotent but NOT a read: primary-only
+    mt.call("events", "init", {})
+    assert ("events", "init") in mt.transports["http://p"].calls
+
+
+def test_meta_reads_never_routed_to_followers(monkeypatch):
+    """Review regression: only EVENTS reads may serve from a follower —
+    its local META/MODEL stores never receive writes (those are fenced to
+    the primary), so apps/access_keys/models reads routed there would
+    answer from permanently-empty tables."""
+    healths = {"http://p": _h("primary", 3),
+               "http://f": _h("follower", 3, age=0.1)}
+    mt = _mk_multi(monkeypatch, healths, read_followers=True)
+    mt.call("apps", "get_by_name", {})
+    mt.call("access_keys", "get", {})
+    mt.call("models", "get", {})
+    assert not mt.transports["http://f"].calls  # all meta → primary
+    assert len(mt.transports["http://p"].calls) == 3
+    mt.call("events", "get", {})                # events reads may route
+    assert mt.transports["http://f"].calls == [("events", "get")]
+
+
+def test_contact_freshness_only_from_primary_traffic(tmp_path):
+    """Review regression: a scrub/status CLI poking /repl/state must not
+    refresh the bounded-staleness token — only the primary's ship-loop
+    polls, heartbeats, and appends count as 'heard from a primary'."""
+    mgr = ReplicationManager(
+        ReplicationConfig(log_dir=str(tmp_path / "f"), role="follower"),
+        clock=FakeClock(start=100.0))
+    assert mgr.contact_age() is None
+    st, _ = mgr.handle("state", {"epoch": 1})       # scrub-style poke
+    assert st == 200 and mgr.contact_age() is None
+    st, _ = mgr.handle("state", {"epoch": 1, "role": "primary"})
+    assert st == 200 and mgr.contact_age() == 0.0   # the ship loop's poll
+
+
+def test_transport_error_names_the_endpoint(tmp_path):
+    """Satellite: with multi-endpoint sources, 'connection refused'
+    without an address is undebuggable — every transport error carries
+    the endpoint URL it was talking to."""
+    from incubator_predictionio_tpu.data.storage.remote import _Transport
+    from incubator_predictionio_tpu.resilience.policy import TransientError
+
+    tp = _Transport("http://127.0.0.1:9", None, 0.2,
+                    config={"RETRY_MAX_ATTEMPTS": "1"})
+    with pytest.raises(TransientError) as ei:
+        tp.call("events", "get", {"event_id": "x", "app_id": 1})
+    assert "http://127.0.0.1:9" in str(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# storage server end-to-end over real sockets (ThreadedStorageServer)
+# ---------------------------------------------------------------------------
+
+def _server_pair(tmp_path, sync="async"):
+    from incubator_predictionio_tpu.parallel.launcher import free_port
+    from incubator_predictionio_tpu.server.storage_server import (
+        StorageServerConfig,
+        ThreadedStorageServer,
+    )
+
+    pport, fport = free_port(), free_port()
+    purl, furl = (f"http://127.0.0.1:{pport}", f"http://127.0.0.1:{fport}")
+    p_storage = Storage({
+        "PIO_STORAGE_SOURCES_EL_TYPE": "eventlog",
+        "PIO_STORAGE_SOURCES_EL_PATH": str(tmp_path / "p-log"),
+        "PIO_STORAGE_SOURCES_SQ_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_SQ_PATH": str(tmp_path / "p.db"),
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "EL",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQ",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SQ",
+    })
+    f_storage = Storage({
+        "PIO_STORAGE_SOURCES_EL_TYPE": "eventlog",
+        "PIO_STORAGE_SOURCES_EL_PATH": str(tmp_path / "f-log"),
+        "PIO_STORAGE_SOURCES_SQ_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_SQ_PATH": str(tmp_path / "f.db"),
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "EL",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQ",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SQ",
+    })
+    follower = ThreadedStorageServer(f_storage, StorageServerConfig(
+        ip="127.0.0.1", port=fport, repl_role="follower",
+        repl_peers=(purl,), repl_sync=sync))
+    primary = ThreadedStorageServer(p_storage, StorageServerConfig(
+        ip="127.0.0.1", port=pport, repl_role="primary",
+        repl_peers=(furl,), repl_sync=sync))
+    return primary, follower, purl, furl, p_storage, f_storage
+
+
+def test_storage_server_replicates_fences_and_promotes(tmp_path):
+    from incubator_predictionio_tpu.data.storage.remote import (
+        RemoteStorageClient,
+    )
+    from incubator_predictionio_tpu.replication.manager import default_rpc
+
+    primary, follower, purl, furl, p_storage, f_storage = \
+        _server_pair(tmp_path, sync="quorum")
+    try:
+        client = RemoteStorageClient({
+            "URLS": f"{purl},{furl}", "TIMEOUT": "10",
+            "RETRY_MAX_ATTEMPTS": "1"})
+        ev = client.events()
+        ev.init(APP)
+        ids = ev.insert_batch([_rate(f"u{i}", "i1") for i in range(4)], APP)
+        assert len(ids) == 4
+        # quorum mode: the follower already holds the bytes
+        assert _read(str(tmp_path / "p-log" / "app_1.piolog")) == \
+            _read(str(tmp_path / "f-log" / "app_1.piolog"))
+        # a write aimed straight at the follower is epoch-fenced with 409
+        st, body = default_rpc(furl, "status", {})
+        assert st == 200 and body["role"] == "follower"
+        import http.client
+        import urllib.parse
+
+        p = urllib.parse.urlsplit(furl)
+        conn = http.client.HTTPConnection(p.hostname, p.port, timeout=5)
+        conn.request("POST", "/rpc/events/insert",
+                     json.dumps({"event": _rate("ux", "i1").to_json_dict(),
+                                 "app_id": APP}).encode(),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 409
+        assert resp.getheader("X-PIO-Fenced") == "1"
+        conn.close()
+        # /health carries the replication section
+        import urllib.request
+
+        with urllib.request.urlopen(f"{furl}/health", timeout=5) as r:
+            h = json.loads(r.read())
+        assert h["replication"]["role"] == "follower"
+        assert h["replication"]["fencedWrites"] >= 1
+        # promote the follower (reconfigured to solo) and write through
+        # the SAME multi-endpoint client: it re-probes and fails over
+        st, body = default_rpc(furl, "promote", {"peers": []})
+        assert st == 200 and body["epoch"] == 2
+        client._tp.invalidate()
+        more = ev.insert_batch([_rate("u9", "i2")], APP)
+        assert len(more) == 1
+        got = {e.entity_id for e in f_storage.get_events().find(APP)}
+        assert "u9" in got and "u0" in got
+    finally:
+        primary.close()
+        follower.close()
+        p_storage.close()
+        f_storage.close()
+
+
+# ---------------------------------------------------------------------------
+# event server: quorum unreachable ⇒ WAL spill, never a lossy ack
+# ---------------------------------------------------------------------------
+
+def test_event_server_spills_when_quorum_unreachable(tmp_path):
+    """Acceptance: with PIO_REPL_SYNC=quorum and all followers down, the
+    event server spills to its WAL (201-with-spill per the PR 4 contract)
+    rather than acking an unreplicated write as stored."""
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from incubator_predictionio_tpu.data.storage import AccessKey, App
+    from incubator_predictionio_tpu.parallel.launcher import free_port
+    from incubator_predictionio_tpu.server.event_server import (
+        EventServer,
+        EventServerConfig,
+    )
+    from incubator_predictionio_tpu.server.storage_server import (
+        StorageServerConfig,
+        ThreadedStorageServer,
+    )
+
+    sport = free_port()
+    dead_follower = f"http://127.0.0.1:{free_port()}"
+    backing = Storage({
+        "PIO_STORAGE_SOURCES_EL_TYPE": "eventlog",
+        "PIO_STORAGE_SOURCES_EL_PATH": str(tmp_path / "log"),
+        "PIO_STORAGE_SOURCES_SQ_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_SQ_PATH": str(tmp_path / "meta.db"),
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "EL",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQ",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SQ",
+    })
+    sserver = ThreadedStorageServer(backing, StorageServerConfig(
+        ip="127.0.0.1", port=sport, repl_role="primary",
+        repl_peers=(dead_follower,), repl_sync="quorum"))
+    # shrink the quorum timeout so the test round-trips fast
+    sserver._server._repl.config.quorum_timeout = 0.2
+    es_storage = Storage({
+        "PIO_STORAGE_SOURCES_R_TYPE": "remote",
+        "PIO_STORAGE_SOURCES_R_URL": f"http://127.0.0.1:{sport}",
+        "PIO_STORAGE_SOURCES_R_RETRY_MAX_ATTEMPTS": "1",
+        "PIO_STORAGE_SOURCES_R_TIMEOUT": "10",
+        "PIO_STORAGE_SOURCES_SQ_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_SQ_PATH": str(tmp_path / "es-meta.db"),
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "R",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQ",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SQ",
+    })
+    app_id = es_storage.get_meta_data_apps().insert(App(0, "q-app"))
+    key = es_storage.get_meta_data_access_keys().insert(
+        AccessKey("", app_id, ()))
+
+    async def run():
+        server = EventServer(
+            EventServerConfig(wal_dir=str(tmp_path / "wal")),
+            storage=es_storage)
+        client = TestClient(TestServer(server.make_app()))
+        await client.start_server()
+        try:
+            resp = await client.post(
+                f"/events.json?accessKey={key}",
+                json={"event": "rate", "entityType": "user",
+                      "entityId": "u1", "targetEntityType": "item",
+                      "targetEntityId": "i1",
+                      "eventTime": "2023-01-01T00:00:00Z"})
+            # 201-with-spill: acked AND durable in the WAL, not silently
+            # "stored" on an unreplicated primary
+            assert resp.status == 201
+            body = await resp.json()
+            assert body["eventId"]
+            health = await (await client.get("/health")).json()
+            assert health["spillQueueDepth"] == 1
+            assert health["spillWal"]["enabled"] is True
+        finally:
+            await client.close()
+            server._executor.shutdown(wait=False)
+
+    try:
+        asyncio.run(run())
+    finally:
+        sserver.close()
+        backing.close()
+        es_storage.close()
+
+
+# ---------------------------------------------------------------------------
+# streaming feed + updater survive failover (offsets preserved)
+# ---------------------------------------------------------------------------
+
+def test_feed_cursor_resumes_on_promoted_replica(tmp_path):
+    from incubator_predictionio_tpu.streaming.feed import EventLogFeed
+
+    pair = _Pair(tmp_path)
+    pair.insert(4)
+    pair.p_mgr.ship_once("follower")
+    feed = EventLogFeed(pair.log("primary"))
+    batch = feed.poll()
+    assert len(batch.events) == 4
+    cursor = batch.to_seq
+    # primary dies; follower promoted; its file is byte-identical so the
+    # cursor IS valid there — resume with no gap and no re-fold
+    pair.f_mgr.promote(peers=[])
+    writer = EventLogEvents(pair.fd)
+    writer.init(APP)
+    writer.insert_batch([_rate("u100", "i1"), _rate("u101", "i2")], APP)
+    feed2 = EventLogFeed(pair.log("follower"), from_seq=cursor)
+    batch2 = feed2.poll()
+    assert batch2.from_seq == cursor  # contiguous: no gap, no refold
+    assert [e.entity_id for e in batch2.events] == ["u100", "u101"]
+    writer.close()
+
+
+def test_feed_cursor_on_wrong_file_fails_loudly(tmp_path):
+    from incubator_predictionio_tpu.streaming.feed import EventLogFeed
+
+    store = EventLogEvents(str(tmp_path / "log"))
+    store.init(APP)
+    store.insert_batch([_rate("u1", "i1")], APP)
+    path = store.log_path(APP)
+    size = os.path.getsize(path)
+    with pytest.raises(ValueError, match="record boundary"):
+        EventLogFeed(path, from_seq=size - 3)
+
+
+def test_updater_resumes_chain_on_promoted_replica(tmp_path):
+    """Acceptance: the streaming updater resumes on the promoted primary
+    from its committed cursor — no gap, no re-fold, the delta chain stays
+    contiguous (FakeReplica asserts from_seq == last applied to_seq)."""
+    from tests.test_streaming import FakeReplica, _make_model
+    from incubator_predictionio_tpu.streaming.updater import (
+        StreamUpdater,
+        UpdaterConfig,
+    )
+
+    pair = _Pair(tmp_path)
+    ev = pair.primary_store.events()
+    ev.init(APP)
+    ev.insert_batch([_rate("u1", "i2", 5.0, m) for m in range(4)], APP)
+    pair.p_mgr.ship_once("follower")
+
+    replica = FakeReplica(_make_model())
+    state_dir = str(tmp_path / "stream-state")
+
+    def updater(feed_path):
+        cfg = UpdaterConfig(state_dir=state_dir, feed_path=feed_path,
+                            replicas=("fake://replica",), from_start=True)
+        return StreamUpdater(cfg, _make_model(), "inst-1",
+                             transport=replica)
+
+    up = updater(pair.log("primary"))
+    out = up.run_once()
+    assert out["status"] == "applied" and out["events"] == 4
+    first_to = out["toSeq"]
+
+    # failover: promote the follower, append on the NEW primary only
+    pair.f_mgr.promote(peers=[])
+    writer = EventLogEvents(pair.fd)
+    writer.init(APP)
+    writer.insert_batch([_rate("u2", "i3", 4.0, m) for m in range(3)], APP)
+
+    up2 = updater(pair.log("follower"))  # same state dir, new feed path
+    out2 = up2.run_once()
+    assert out2["status"] == "applied"
+    assert out2["fromSeq"] == first_to   # contiguous — no gap, no re-fold
+    assert out2["events"] == 3
+    assert replica.applied == 2 and replica.deduped == 0
+    writer.close()
+
+
+# ---------------------------------------------------------------------------
+# satellites: wal inspect defect position, CLI health row rendering
+# ---------------------------------------------------------------------------
+
+def test_wal_inspect_reports_first_corrupt_offset(tmp_path):
+    from incubator_predictionio_tpu.resilience import wal
+
+    w = wal.SpillWal(str(tmp_path), fsync=False)
+    w.append([{"event": {"eventId": f"e{i}"}, "app_id": 1,
+               "channel_id": None} for i in range(3)])
+    w.close()
+    seg = wal.list_segments(str(tmp_path))[0]
+    blob = bytearray(_read(seg))
+    # flip a byte inside the SECOND frame's payload
+    first_end = None
+    seen = 0
+    for off, _rec, status in wal.iter_frames(seg):
+        seen += 1
+        if seen == 2:
+            first_end = off
+            break
+    blob[first_end + wal._FRAME.size + 2] ^= 0xFF
+    with open(seg, "wb") as f:
+        f.write(blob)
+    info = wal.inspect_dir(str(tmp_path))
+    segrow = next(s for s in info["segments"] if s["path"] == seg)
+    assert segrow["defect"] == "crc mismatch"
+    assert segrow["defectOffset"] == first_end
+    assert info["firstCorrupt"] == {
+        "segment": seg, "offset": first_end, "defect": "crc mismatch"}
+
+
+def test_health_row_renders_replication_and_reds_on_fence():
+    from incubator_predictionio_tpu.tools.cli import _health_row
+
+    h = {"status": "degraded",
+         "replication": {"role": "follower", "epoch": 3, "fenced": True,
+                         "fencedWrites": 7}}
+    row = _health_row("http://s", h, None)
+    assert row["red"] is True
+    assert "repl follower@3" in row["detail"]
+    assert "FENCED" in row["detail"]
+    lagging = {"status": "ok",
+               "replication": {"role": "primary", "epoch": 3,
+                               "fenced": False, "lagBytes": 999,
+                               "lagExceeded": True}}
+    row = _health_row("http://s", lagging, None)
+    assert row["red"] is True and "lag 999B EXCEEDED" in row["detail"]
+    healthy = {"status": "ok",
+               "replication": {"role": "primary", "epoch": 3,
+                               "fenced": False, "lagBytes": 0,
+                               "lagExceeded": False}}
+    assert _health_row("http://s", healthy, None)["red"] is False

@@ -64,7 +64,110 @@ def t(n):
     return dt.datetime(2020, 1, 1, 0, 0, n, tzinfo=UTC)
 
 
+class _FollowerReadEvents:
+    """EventStore shim for the replicated read-parity tier: every mutation
+    lands on the PRIMARY and is shipped (replication/manager.py, the real
+    chunk/CRC/offset protocol in-process); every read is answered by the
+    caught-up FOLLOWER's byte-identical replica. The whole read-side
+    contract suite therefore doubles as the follower-parity proof."""
+
+    def __init__(self, primary, follower, ship):
+        self._primary = primary
+        self._follower = follower
+        self._ship = ship
+
+    # -- mutations: primary, then replicate -------------------------------
+    def init(self, app_id, channel_id=None):
+        r = self._primary.init(app_id, channel_id)
+        self._ship()
+        return r
+
+    def remove(self, app_id, channel_id=None):
+        # log removal is an admin RPC applied to every replica (the ship
+        # loop only moves record bytes; it does not delete logs)
+        r = self._primary.remove(app_id, channel_id)
+        self._follower.remove(app_id, channel_id)
+        return r
+
+    def insert(self, event, app_id, channel_id=None):
+        r = self._primary.insert(event, app_id, channel_id)
+        self._ship()
+        return r
+
+    def insert_batch(self, events, app_id, channel_id=None):
+        r = self._primary.insert_batch(events, app_id, channel_id)
+        self._ship()
+        return r
+
+    def delete(self, event_id, app_id, channel_id=None):
+        r = self._primary.delete(event_id, app_id, channel_id)
+        self._ship()
+        return r
+
+    # -- reads: the follower replica answers ------------------------------
+    def _read(self, name):
+        self._ship()
+        return getattr(self._follower, name)
+
+    def get(self, *a, **kw):
+        return self._read("get")(*a, **kw)
+
+    def find(self, *a, **kw):
+        return self._read("find")(*a, **kw)
+
+    def find_by_entities(self, *a, **kw):
+        return self._read("find_by_entities")(*a, **kw)
+
+    def find_sharded(self, *a, **kw):
+        return self._read("find_sharded")(*a, **kw)
+
+    def aggregate_properties(self, *a, **kw):
+        return self._read("aggregate_properties")(*a, **kw)
+
+    def assemble_triples(self, *a, **kw):
+        return self._read("assemble_triples")(*a, **kw)
+
+
+class _FollowerParityClient:
+    """EVENTDATA-only client wiring a primary+follower replication pair
+    (see tests/test_replication.py for the protocol-level suite)."""
+
+    def __init__(self, tmp_path):
+        from incubator_predictionio_tpu.data.storage.eventlog_backend import (
+            EventLogStorageClient,
+        )
+        from incubator_predictionio_tpu.replication.manager import (
+            ReplicationConfig,
+            ReplicationManager,
+        )
+
+        self._primary = EventLogStorageClient(
+            {"PATH": str(tmp_path / "primary")})
+        self._follower = EventLogStorageClient(
+            {"PATH": str(tmp_path / "follower"), "READ_ONLY": "1"})
+        self._f_mgr = ReplicationManager(ReplicationConfig(
+            log_dir=str(tmp_path / "follower"), role="follower"))
+        self._p_mgr = ReplicationManager(
+            ReplicationConfig(log_dir=str(tmp_path / "primary"),
+                              role="primary", peers=("follower",)),
+            rpc=lambda url, verb, payload: self._f_mgr.handle(verb, payload))
+
+    def events(self):
+        return _FollowerReadEvents(
+            self._primary.events(), self._follower.events(),
+            lambda: self._p_mgr.ship_once("follower"))
+
+    def apps(self):
+        raise NotImplementedError("EVENTDATA-only parity tier")
+
+    def close(self):
+        self._f_mgr.stop()
+        self._primary.close()
+        self._follower.close()
+
+
 @pytest.fixture(params=["memory", "sqlite", "eventlog", "eventlog-pyfallback",
+                        "eventlog-follower",
                         "remote", "elasticsearch", "postgres",
                         "postgres-live", "elasticsearch-live"])
 def client(request, tmp_path, monkeypatch):
@@ -154,6 +257,11 @@ def client(request, tmp_path, monkeypatch):
         server.close()
         backing.close()
         return
+    elif request.param == "eventlog-follower":
+        # replicated read-parity tier (docs/replication.md): writes land
+        # on a primary, reads come from a caught-up follower replica —
+        # find/get/find_by_entities/aggregate must answer identically
+        c = _FollowerParityClient(tmp_path)
     else:
         from incubator_predictionio_tpu.data.storage.eventlog_backend import (
             EventLogStorageClient,
