@@ -658,6 +658,158 @@ def bench_retrieval_scale(ctx, peaks, device) -> dict:
             "batch": batch, "num": num, "rank": rank}
 
 
+def bench_sharded_serving(ctx, peaks, device) -> dict:
+    """Sharded serving (docs/sharding.md) next to the exact and two-stage
+    lanes: the same catalog served (a) exact single-host, (b) two-stage
+    single-host IVF, (c) per-shard exact top-k + cross-shard merge from
+    model-axis-sharded device tables, (d) the composed per-shard-IVF +
+    merge-rerank path. Archives qps per lane, recall@10 vs the exact
+    oracle for the pruned lanes, and the per-lane ``pio_shard_*`` metric
+    deltas (merge fan-in, per-shard top-k/merge time, fallbacks).
+
+    Runs on 8 virtual CPU devices (run_one_config sets the XLA flag for
+    this config) — like the fleet scenario it measures the ARCHITECTURE
+    (merge overhead and layout), not chip throughput. The sharded_exact
+    lane's recall is vs the f32 HOST oracle, so slightly under 1.0 purely
+    from bf16 device scoring re-ordering near-ties — the sharded-vs-
+    single-DEVICE parity is bitwise and pinned in tests/test_sharding.py."""
+    import jax
+
+    from incubator_predictionio_tpu.models.two_tower import (
+        TwoTowerConfig,
+        TwoTowerModel,
+        TwoTowerMF,
+    )
+    from incubator_predictionio_tpu.obs.metrics import REGISTRY
+    from incubator_predictionio_tpu.parallel.mesh import MeshContext
+
+    rank = 32
+    n_users = 10_000
+    n_items = 60_000 if SMALL else 150_000
+    batch, num = 16, 10
+    n_shards = min(8, len(jax.devices()))
+    rng = np.random.default_rng(13)
+    n_concepts = max(64, int(round(np.sqrt(n_items))))
+    concepts = rng.standard_normal((n_concepts, rank)).astype(np.float32)
+    item = concepts[rng.integers(0, n_concepts, n_items)] \
+        + 0.5 * rng.standard_normal((n_items, rank)).astype(np.float32)
+    user = concepts[rng.integers(0, n_concepts, n_users)] \
+        + 0.5 * rng.standard_normal((n_users, rank)).astype(np.float32)
+    user_bias = (rng.standard_normal(n_users) * 0.1).astype(np.float32)
+    item_bias = (rng.standard_normal(n_items) * 0.1).astype(np.float32)
+
+    def host_model():
+        return TwoTowerModel(
+            user_emb=user, item_emb=item, user_bias=user_bias,
+            item_bias=item_bias, mean=3.0,
+            config=TwoTowerConfig(rank=rank))
+
+    def device_sharded_model():
+        """The same towers resident as model-axis-sharded device tables —
+        what a sharded fit/restore produces (fused bias column, rows
+        padded to the shard multiple)."""
+        mctx = MeshContext.create(axes={"data": 1, "model": n_shards})
+        m = TwoTowerModel(mean=3.0, config=TwoTowerConfig(rank=rank))
+
+        def fused(emb, bias):
+            t = np.concatenate([emb, bias[:, None]], axis=1)
+            pad = -(-t.shape[0] // n_shards) * n_shards - t.shape[0]
+            return np.pad(t, ((0, pad), (0, 0)))
+
+        m._tables = {
+            "ue": mctx.put(fused(user, user_bias), "model", None),
+            "ie": mctx.put(fused(item, item_bias), "model", None),
+        }
+        m._n_users, m._n_items = n_users, n_items
+        return m
+
+    qusers = rng.integers(0, n_users, (64, batch)).astype(np.int32)
+    eusers = rng.integers(0, n_users, (256 // batch, batch)).astype(np.int32)
+
+    def lane_qps(model, min_sec=2.0):
+        TwoTowerMF.recommend_batch(model, qusers[0], num)
+        done = 0
+        t0 = time.perf_counter()
+        while True:
+            TwoTowerMF.recommend_batch(model, qusers[done % len(qusers)], num)
+            done += 1
+            dt = time.perf_counter() - t0
+            if dt >= min_sec and done >= 8:
+                return done * batch / dt
+
+    def shard_delta(before):
+        after = _metrics_snapshot(REGISTRY.expose())
+        return {k: v for k, v in _snapshot_delta(before, after).items()
+                if k.startswith("pio_shard_")}
+
+    prev_env = {k: os.environ.get(k) for k in
+                ("PIO_SHARD_SERVE", "PIO_SHARD_SERVE_SHARDS",
+                 "PIO_RETRIEVAL_MODE", "PIO_RETRIEVAL_NPROBE")}
+    lanes: dict[str, dict] = {}
+    try:
+        os.environ["PIO_RETRIEVAL_NPROBE"] = "16"
+        # (a) exact single-host oracle lane
+        os.environ["PIO_SHARD_SERVE"] = "0"
+        os.environ["PIO_RETRIEVAL_MODE"] = "exact"
+        m = host_model()
+        m.prepare_for_serving(serve_k=num)
+        m.warmup(max_batch=batch)
+        lanes["exact"] = {"qps": round(lane_qps(m), 1)}
+        oracle = [TwoTowerMF.recommend_batch(m, row, num)[0]
+                  for row in eusers]
+
+        def recall(model):
+            got = [TwoTowerMF.recommend_batch(model, row, num)[0]
+                   for row in eusers]
+            return round(float(np.mean([
+                len(set(o[r]) & set(g[r])) / num
+                for o, g in zip(oracle, got) for r in range(batch)])), 4)
+
+        # (b) two-stage single-host lane
+        os.environ["PIO_RETRIEVAL_MODE"] = "two_stage"
+        m = host_model()
+        m.prepare_for_serving(serve_k=num)
+        m.warmup(max_batch=batch)
+        lanes["two_stage"] = {"qps": round(lane_qps(m), 1),
+                              "recall_at_10": recall(m)}
+        # (c) sharded exact from device tables
+        os.environ["PIO_SHARD_SERVE"] = "1"
+        os.environ["PIO_RETRIEVAL_MODE"] = "exact"
+        md = device_sharded_model()
+        md.prepare_for_serving(serve_k=num)
+        md.warmup(max_batch=batch)
+        before = _metrics_snapshot(REGISTRY.expose())
+        lanes["sharded_exact"] = {
+            "qps": round(lane_qps(md), 1), "n_shards": n_shards,
+            "recall_at_10": recall(md),  # exact: must be 1.0
+        }
+        lanes["sharded_exact"]["pio_shard"] = shard_delta(before)
+        # (d) composed per-shard IVF + merge rerank
+        os.environ["PIO_RETRIEVAL_MODE"] = "two_stage"
+        md = device_sharded_model()
+        md.prepare_for_serving(serve_k=num)
+        md.warmup(max_batch=batch)
+        before = _metrics_snapshot(REGISTRY.expose())
+        lanes["sharded_two_stage"] = {
+            "qps": round(lane_qps(md), 1), "n_shards": n_shards,
+            "recall_at_10": recall(md),
+        }
+        lanes["sharded_two_stage"]["pio_shard"] = shard_delta(before)
+    finally:
+        for k, v in prev_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    for name, lane in lanes.items():
+        _log(f"sharded_serving {name}: {lane['qps']} qps"
+             + (f" recall@10 {lane['recall_at_10']}"
+                if "recall_at_10" in lane else ""))
+    return {"lanes": lanes, "n_items": n_items, "batch": batch, "num": num,
+            "rank": rank, "n_shards": n_shards,
+            "n_devices": len(jax.devices())}
+
+
 # ---------------------------------------------------------------------------
 # 6. sequential transformer (the long-context flagship)
 # ---------------------------------------------------------------------------
@@ -1795,14 +1947,16 @@ def build_result_line(configs: dict, device_info: dict,
 # dead tunnel on CPU
 CONFIG_NAMES = ["recommendation", "recommendation_scaled", "classification",
                 "similarproduct", "ecommerce_retrieval", "retrieval_scale",
-                "sequential", "serving", "overload", "fleet", "ingestion",
-                "ingest_durability", "streaming_freshness",
-                "storage_failover"]
+                "sharded_serving", "sequential", "serving", "overload",
+                "fleet", "ingestion", "ingest_durability",
+                "streaming_freshness", "storage_failover"]
 # "fleet" is device-free too: its replicas are CPU subprocesses (a fleet
 # on one host) — the scenario measures the ROUTER's horizontal scaling,
-# not chip throughput
+# not chip throughput; "sharded_serving" likewise runs on 8 virtual CPU
+# devices (merge/layout architecture, not chip throughput)
 DEVICE_FREE = {"ingestion", "ingest_durability", "fleet",
-               "streaming_freshness", "storage_failover"}
+               "streaming_freshness", "storage_failover",
+               "sharded_serving"}
 
 
 def _build_suite(ctx, peaks, device) -> dict:
@@ -1814,6 +1968,7 @@ def _build_suite(ctx, peaks, device) -> dict:
         "similarproduct": lambda: bench_similarproduct(ctx, peaks),
         "ecommerce_retrieval": lambda: bench_ecommerce_retrieval(ctx, peaks, device),
         "retrieval_scale": lambda: bench_retrieval_scale(ctx, peaks, device),
+        "sharded_serving": lambda: bench_sharded_serving(ctx, peaks, device),
         "sequential": lambda: bench_sequential(ctx, peaks, device),
         "serving": lambda: bench_serving(ctx),
         "overload": lambda: bench_overload(ctx),
@@ -2003,6 +2158,14 @@ def run_one_config(name: str) -> None:
     resolved = os.environ.get("PIO_BENCH_RESOLVED_PLATFORM", "cpu")
     if resolved != "tpu":
         os.environ["JAX_PLATFORMS"] = "cpu"
+        if (name == "sharded_serving"
+                and "xla_force_host_platform_device_count"
+                not in os.environ.get("XLA_FLAGS", "")):
+            # the sharded lanes need a multi-device mesh; 8 virtual CPU
+            # devices (the tests/conftest.py trick) — set before jax init
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + " --xla_force_host_platform_device_count=8").strip()
     import jax
 
     from incubator_predictionio_tpu.parallel.mesh import (

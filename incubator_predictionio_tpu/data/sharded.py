@@ -37,10 +37,25 @@ def concat_vocab(
 
     Local index ``i`` globalizes as ``i + offset``. Requires that no id
     appears in two processes' vocabularies (guaranteed when the store was
-    read entity-sharded)."""
+    read entity-sharded) — a violation raises instead of silently minting
+    two global rows for one entity (which would split its training signal
+    and make the concat/offset arithmetic silently wrong)."""
     parts = ctx.allgather_obj(list(local_vocab))
-    offset = sum(len(p) for p in parts[: ctx.process_index])
     vocab = np.asarray([v for p in parts for v in p], object)
+    # vectorized disjointness check; the shard-attribution loop (O(total)
+    # Python) only runs on the failure path
+    if len(np.unique(vocab)) != len(vocab):
+        seen: dict = {}
+        for pi, p in enumerate(parts):
+            for v in p:
+                if v in seen:
+                    raise ValueError(
+                        f"entity id {v!r} appears in shards {seen[v]} and "
+                        f"{pi} — concat_vocab requires entity-disjoint "
+                        "shard reads (use union_vocab for cross-shard id "
+                        "spaces)")
+                seen[v] = pi
+    offset = sum(len(p) for p in parts[: ctx.process_index])
     return vocab, offset
 
 

@@ -525,20 +525,29 @@ class TransformerRecommender:
         init = _jit_init_fn(cache_cfg)
         expert_parallel = bool(cfg.n_experts) and "expert" in ctx.mesh.shape
         if cfg.n_experts and not expert_parallel:
-            logger.warning(
-                "n_experts=%d requested but the mesh has no 'expert' axis "
-                "(mesh axes: %s) — expert tables stay replicated",
-                cfg.n_experts, tuple(ctx.mesh.shape))
+            # once-per-key warning + machine-readable record (the MULTICHIP
+            # dryrun embeds sharding.degrade.degradations() in its JSON
+            # instead of tailing one stderr line per fit)
+            from incubator_predictionio_tpu.sharding.degrade import (
+                record_axis_degradation,
+            )
+
+            record_axis_degradation(
+                "transformer.moe", "expert", f"n_experts={cfg.n_experts}",
+                ctx.mesh.shape, "expert tables stay replicated")
         if expert_parallel and cfg.n_experts % ctx.axis_size("expert"):
             raise ValueError(
                 f"n_experts={cfg.n_experts} must divide evenly over the "
                 f"expert axis ({ctx.axis_size('expert')} devices)")
         tensor_parallel = cfg.tensor_parallel and "model" in ctx.mesh.shape
         if cfg.tensor_parallel and not tensor_parallel:
-            logger.warning(
-                "tensor_parallel requested but the mesh has no 'model' axis "
-                "(mesh axes: %s) — weights stay replicated",
-                tuple(ctx.mesh.shape))
+            from incubator_predictionio_tpu.sharding.degrade import (
+                record_axis_degradation,
+            )
+
+            record_axis_degradation(
+                "transformer.tp", "model", "tensor_parallel",
+                ctx.mesh.shape, "weights stay replicated")
         if tensor_parallel:
             tp = ctx.axis_size("model")
             if cfg.n_heads % tp or (4 * cfg.d_model) % tp:

@@ -132,6 +132,15 @@ class TwoTowerModel:
     # it IS host numpy and rides default pickling, so a persisted model
     # redeploys without re-clustering the catalog
     _ivf = None
+    # sharded serving state (sharding/serve.py): per-shard top-k + merge
+    # replaces the single-host scorers when the model-axis layout is a win.
+    # Derived at prepare time — never serialized (deploy rebuilds it)
+    _sharded = None
+    # per-shard IVF partitions (one slim-pickling IVFIndex per shard) and
+    # the training shard layout — both host-picklable, both persisted so a
+    # sharded redeploy skips the per-shard re-cluster
+    _shard_ivf = None
+    _shard_spec = None
 
     @property
     def device_resident(self) -> bool:
@@ -144,6 +153,9 @@ class TwoTowerModel:
         default pickling, should ever land here)."""
         if self.user_emb is not None or self._tables is None:
             return self
+        from incubator_predictionio_tpu.sharding import shard_metrics
+
+        shard_metrics.FULL_GATHERS.inc()
         k = self.config.rank
         host = jax.device_get(self._tables)
         self.user_emb = np.ascontiguousarray(host["ue"][: self._n_users, :k])
@@ -155,10 +167,12 @@ class TwoTowerModel:
     def __getstate__(self):
         # default pickling (MODELDATA blob) always ships host arrays; device
         # handles and serving buffers never serialize — deploy rebuilds them
+        # (the sharded serving state may hold device arrays; its host-only
+        # inputs — _shard_ivf, _shard_spec — do persist)
         self.ensure_host()
         return {k: v for k, v in self.__dict__.items()
                 if k not in ("_tables", "_device_items", "_device_items_q",
-                             "_device_users", "_host_items")}
+                             "_device_users", "_host_items", "_sharded")}
 
     def prepare_for_serving(
         self, quantize: bool = False, serve_k: int = 128,
@@ -199,6 +213,24 @@ class TwoTowerModel:
             # keep any persisted index around: flipping the mode knob back
             # shouldn't force a re-cluster on the next prepare
             return
+        if self._sharded is not None:
+            # composed sharded two-stage: each shard clusters its LOCAL
+            # rows (shard-at-a-time pulls — the full item table is never
+            # materialized on one host); persisted per-shard indexes are
+            # reused when their build keys still match
+            self._shard_ivf = self._sharded.ensure_ivf(
+                self, persisted=self._shard_ivf)
+            return
+        from incubator_predictionio_tpu.sharding import serve as shard_serve
+
+        shard_ivf = shard_serve.train_time_shard_ivf(
+            self, persisted=self._shard_ivf)
+        if shard_ivf is not None:
+            # train-time build for a model that will SERVE sharded: the
+            # per-shard clustering persists with the model, so redeploys
+            # skip the re-cluster — and the full table is never gathered
+            self._shard_ivf = shard_ivf
+            return
         key = ann.build_key(self.n_items)
         if self._ivf is not None and self._ivf.matches(key):
             if not self._ivf.hydrated:
@@ -217,6 +249,9 @@ class TwoTowerModel:
         if self.item_emb is not None:
             return (np.asarray(self.item_emb, np.float32),
                     np.asarray(self.item_bias, np.float32))
+        from incubator_predictionio_tpu.sharding import shard_metrics
+
+        shard_metrics.FULL_GATHERS.inc()
         k = self.config.rank
         host_ie = np.asarray(jax.device_get(self._tables["ie"]))
         return (np.ascontiguousarray(host_ie[: self._n_items, :k],
@@ -235,8 +270,23 @@ class TwoTowerModel:
         self._device_items = None
         self._device_items_q = None
         self._device_users = None
+        self._sharded = None
         host_max = (HOST_SERVE_MAX_ELEMENTS if host_max_elements is None
                     else host_max_elements)
+        # sharded serving (sharding/serve.py): per-shard top-k + cross-shard
+        # merge straight from the model-axis layout. auto engages when the
+        # tables restored sharded (or the simulated HBM budget says one chip
+        # can't hold the catalog) AND the catalog is device-scale;
+        # PIO_SHARD_SERVE=1 forces it (host models get virtual shards).
+        # serving_shards_for is the ONE engage decision (train-time IVF
+        # build and restore layout use it too)
+        from incubator_predictionio_tpu.sharding import serve as shard_serve
+
+        n_shards = shard_serve.serving_shards_for(
+            self, host_max_elements=host_max)
+        if n_shards > 1:
+            self._build_sharded(n_shards)
+            return self
         # host check first: ``quantize`` applies to device-resident catalogs;
         # a catalog small enough for the host path never benefits from it
         if self.n_items * (self.config.rank + 1) <= host_max:
@@ -302,16 +352,40 @@ class TwoTowerModel:
             )
         return self
 
+    def _build_sharded(self, n_shards: int) -> None:
+        """Materialize the per-shard serving state (sharding/serve.py):
+        device-resident models derive it device-to-device from the sharded
+        tables (the item table never visits the host); host models split
+        into virtual shard blocks (the CPU-parity twin)."""
+        import jax
+
+        from incubator_predictionio_tpu.sharding.serve import ShardedServing
+
+        serve_k = self._serve_k or min(128, self.n_items)
+        if self.device_resident and self.user_emb is None:
+            n_shards = min(n_shards, len(jax.devices()))
+            self._sharded = ShardedServing.build_device(
+                self._tables, self._n_users, self._n_items,
+                self.config.rank, self.mean, serve_k, n_shards)
+        else:
+            self._sharded = ShardedServing.build_host(
+                np.asarray(self.item_emb, np.float32),
+                np.asarray(self.item_bias, np.float32),
+                self.n_users, self.mean, serve_k, n_shards)
+
     def warmup(self, max_batch: int = 64) -> int:
         """Pre-compile the serving executable for every batch bucket up to
         ``max_batch`` (deploy-time cost, so no live query ever waits on XLA).
         Returns the number of buckets warmed (0 on the host fast path —
         nothing compiles there)."""
-        if (self._device_users is None and self._host_items is None):
+        if (self._device_users is None and self._host_items is None
+                and self._sharded is None):
             self.prepare_for_serving()
         from incubator_predictionio_tpu.serving import ann
 
-        if self._ivf is not None and ann.two_stage_enabled(self.n_items):
+        has_ivf = self._ivf is not None or (
+            self._sharded is not None and any(self._sharded.ivf or ()))
+        if has_ivf and ann.two_stage_enabled(self.n_items):
             # prime the two-stage path too: no XLA involved (the coarse +
             # rerank stages are host numpy), but the first dispatch faults
             # the member-order tables into memory and spins up the BLAS
@@ -319,7 +393,9 @@ class TwoTowerModel:
             TwoTowerMF.recommend_batch(
                 self, np.zeros(1, np.int32),
                 min(max(self._serve_k, 1), self.n_items))
-        if self._host_items is not None:
+        if self._host_items is not None or (
+                self._sharded is not None and self._sharded.device is None):
+            # pure-numpy serving paths: nothing compiles
             return 0
         n = 0
         for b in SERVE_BUCKETS:
@@ -373,7 +449,14 @@ class TwoTowerModel:
         the IVF index (:meth:`serving.ann.IVFIndex.with_updated_rows`) so
         the pruned path rescopes them with CURRENT values; past
         ``PIO_STREAM_STALE_REBUILD_FRAC`` of the catalog stale, the index
-        is re-clustered from the updated table instead."""
+        is re-clustered from the updated table instead.
+
+        Sharded models route each row to its OWNING shard
+        (sharding/serve.py) — only that shard's arrays (and its IVF
+        overlay) rebuild; a device-resident sharded model never pulls its
+        tables to host for a delta."""
+        if self._sharded is not None and self.user_emb is None:
+            return self._with_row_updates_sharded(user_rows, item_rows)
         self.ensure_host()
         k = self.config.rank
         new = TwoTowerModel(
@@ -407,6 +490,79 @@ class TwoTowerModel:
                 new._ivf = self._updated_index(new, item_rows)
             else:
                 new._ivf = self._ivf  # shared read-only: nothing moved
+        if self._sharded is not None:
+            # host-block sharded serving: route the rows to their owning
+            # shard's blocks/IVF overlay; untouched shards stay shared.
+            # _shard_ivf only follows when serving actually carries per-
+            # shard indexes — with two-stage currently off the persisted
+            # clustering must survive for a later mode flip
+            new._sharded = self._sharded.with_row_updates(
+                user_rows or {}, item_rows or {})
+            new._shard_ivf = (new._sharded.ivf
+                              if new._sharded.ivf is not None
+                              else self._shard_ivf)
+            new._shard_spec = self._shard_spec
+            new._serve_k = self._serve_k
+        return new
+
+    def _with_row_updates_sharded(
+        self,
+        user_rows: Optional[dict] = None,
+        item_rows: Optional[dict] = None,
+    ) -> "TwoTowerModel":
+        """Build-beside delta apply for a device-resident sharded model:
+        rows scatter into copies of the sharded tables ON DEVICE (XLA
+        routes each row to its owner — batch-sized traffic only) and the
+        serving state updates through the owning shard; the receiver keeps
+        serving its own arrays untouched."""
+        import jax.numpy as jnp
+
+        from incubator_predictionio_tpu.sharding.serve import _set_rows_fn
+
+        new = TwoTowerModel(mean=self.mean, config=self.config)
+        new._n_users, new._n_items = self._n_users, self._n_items
+        new._serve_k = self._serve_k
+        new._shard_spec = self._shard_spec
+        new._sharded = self._sharded.with_row_updates(
+            user_rows or {}, item_rows or {})
+        if self._tables is not None:
+            # keep the persistable tables coherent with what serving
+            # answers (a later save/pickle must not resurrect old rows).
+            # No re-validation here: ShardedServing.with_row_updates above
+            # already range/width-checked every row — one checker, one
+            # error message
+            tables = dict(self._tables)
+            for name, rows_dict in (("ue", user_rows), ("ie", item_rows)):
+                if not rows_dict:
+                    continue
+                ids = np.asarray(sorted(int(i) for i in rows_dict), np.int64)
+                rows = np.stack([np.asarray(rows_dict[int(i)], np.float32)
+                                 for i in ids])
+                tables[name] = _set_rows_fn()(
+                    tables[name], jnp.asarray(ids, jnp.int32),
+                    jnp.asarray(rows))
+            new._tables = tables
+        if item_rows and new._tables is not None:
+            # past the staleness threshold a shard re-clusters from the
+            # UPDATED tables (the overlay must not grow without bound)
+            new._sharded.rebuild_stale_ivf(new)
+        new._shard_ivf = (new._sharded.ivf if new._sharded.ivf is not None
+                          else self._shard_ivf)
+        if self._ivf is not None:
+            # a persisted whole-catalog index survives for a later
+            # retrieval/sharding mode flip — with the moved rows overlaid
+            # so an in-process flip never serves pre-delta embeddings
+            # (the host path's _updated_index semantics, minus its
+            # rebuild-past-threshold branch, which needs host towers)
+            if item_rows:
+                ids = np.asarray(sorted(int(i) for i in item_rows), np.int64)
+                rows = np.stack([np.asarray(item_rows[int(i)], np.float32)
+                                 for i in ids])
+                k = self.config.rank
+                new._ivf = self._ivf.with_updated_rows(
+                    ids, rows[:, :k], rows[:, k])
+            else:
+                new._ivf = self._ivf
         return new
 
     def _updated_index(self, new: "TwoTowerModel", item_rows: dict):
@@ -432,7 +588,10 @@ class TwoTowerModel:
 
     def serving_info(self) -> dict:
         """Which serving path this model runs (status-page observability)."""
-        if self._device_items_q is not None:
+        if self._sharded is not None:
+            path = ("sharded-device-bf16" if self._sharded.device is not None
+                    else "sharded-host-numpy")
+        elif self._device_items_q is not None:
             path = "device-int8-pallas"
         elif self._device_items is not None:
             path = "device-bf16"
@@ -442,11 +601,52 @@ class TwoTowerModel:
             path = "unprepared"
         from incubator_predictionio_tpu.serving import ann
 
-        two_stage = self._ivf is not None and ann.two_stage_enabled(self.n_items)
+        has_index = self._ivf is not None or (
+            self._sharded is not None and any(self._sharded.ivf or ()))
+        two_stage = has_index and ann.two_stage_enabled(self.n_items)
+        if self._ivf is not None:
+            index = self._ivf.stats()
+        elif self._sharded is not None and self._sharded.ivf:
+            index = [i.stats() if i is not None else None
+                     for i in self._sharded.ivf]
+        else:
+            index = None
         return {"path": path, "serve_k": self._serve_k,
                 "catalog_rows": self.n_items,
                 "retrieval_mode": "two_stage" if two_stage else "exact",
-                "index": self._ivf.stats() if self._ivf is not None else None}
+                "sharding": (self._sharded.info()
+                             if self._sharded is not None else None),
+                "index": index}
+
+    def shard_info(self) -> dict:
+        """Shard layout for ``pio-tpu shards``: the live serving layout
+        when sharded serving is active, else the training-layout record
+        (or the single-chip plan) plus what the current simulated HBM
+        budget implies."""
+        from incubator_predictionio_tpu.sharding.table import (
+            ShardSpec,
+            hbm_budget,
+            requires_sharding,
+        )
+
+        k = self.config.rank
+        if self._sharded is not None:
+            info = self._sharded.info()
+            info["sharded"] = True
+            return info
+        spec = self._shard_spec or {
+            "ue": ShardSpec("ue", self.n_users, k + 1, 1),
+            "ie": ShardSpec("ie", self.n_items, k + 1, 1),
+        }
+        return {
+            "sharded": False,
+            "n_shards": spec["ie"].n_shards,
+            "items": spec["ie"].to_dict(),
+            "users": spec["ue"].to_dict(),
+            "hbm_budget": hbm_budget(),
+            "requires_sharding": requires_sharding(
+                self.n_items, k + 1, self.config.adam_moments_dtype),
+        }
 
 
 class TwoTowerMF:
@@ -511,41 +711,24 @@ class TwoTowerMF:
         key = jax.random.key(cfg.seed)
         ku, ki = jax.random.split(key)
         scale = 1.0 / np.sqrt(cfg.rank)
-        model_axis = "model" if "model" in ctx.mesh.shape else None
-        # pad vocab rows up to the model-axis multiple (static row sharding)
-        def pad_rows(v: int) -> int:
-            if not model_axis:
-                return v
-            m = ctx.axis_size(model_axis)
-            return ((v + m - 1) // m) * m
-
-        nu_p, ni_p = pad_rows(n_users), pad_rows(n_items)
-        emb_spec = (model_axis, None) if model_axis else ()
         # biases live as the LAST COLUMN of each table: TPU gathers operate
         # on rows — a separate 1-D bias table means 65k scalar gathers per
         # step, measured ~3× the cost of the whole [B, rank] row gather.
-        if ctx.process_count == 1:
-            # init ON DEVICE, placed directly into the table sharding: a 1M×129
-            # table round-tripped through the host costs ~GB of transfer
-            # (tens of seconds behind a device tunnel) for pure noise
-            sharding = ctx.sharding(*emb_spec) if emb_spec else ctx.replicated()
-            params = {
-                "ue": jax.device_put(
-                    _init_table(ku, nu_p, cfg.rank, scale), sharding),
-                "ie": jax.device_put(
-                    _init_table(ki, ni_p, cfg.rank, scale), sharding),
-            }
-        else:
-            def init_table(key, rows):
-                t = np.zeros((rows, cfg.rank + 1), np.float32)
-                t[:, :cfg.rank] = np.asarray(
-                    jax.random.normal(key, (rows, cfg.rank), jnp.float32) * scale)
-                return t
+        #
+        # The tables materialize through ShardedTable (sharding/table.py):
+        # rows padded to the model-axis multiple and row-sharded via
+        # NamedSharding, init ON DEVICE with per-shard keys directly into
+        # that layout (a 1M×129 table round-tripped through the host costs
+        # ~GB of transfer for pure noise), and PIO_SHARD_HBM_BUDGET
+        # enforced per shard — the simulated stand-in for a real chip's
+        # OOM, so a CPU dryrun can prove the doesn't-fit-one-chip case.
+        from incubator_predictionio_tpu.sharding.table import ShardedTable
 
-            params = {
-                "ue": ctx.put(init_table(ku, nu_p), *emb_spec),
-                "ie": ctx.put(init_table(ki, ni_p), *emb_spec),
-            }
+        ut = ShardedTable.init_train(
+            ctx, "ue", n_users, cfg.rank, ku, scale, cfg.adam_moments_dtype)
+        it = ShardedTable.init_train(
+            ctx, "ie", n_items, cfg.rank, ki, scale, cfg.adam_moments_dtype)
+        params = {"ue": ut.array, "ie": it.array}
         # jitted init: multi-process-safe (optimizer state inherits the
         # params' global shardings instead of materializing host-side)
         from incubator_predictionio_tpu.utils.optim import adam_tree_init
@@ -596,6 +779,8 @@ class TwoTowerMF:
             model._tables = {"ue": params["ue"], "ie": params["ie"]}
             model._n_users = n_users
             model._n_items = n_items
+            # layout record: what `pio-tpu shards` and sharded serving read
+            model._shard_spec = {"ue": ut.spec, "ie": it.spec}
             t_gather = _time.perf_counter() - t_gather
         else:
             # host gather (collective when multi-process); behind a device
@@ -721,12 +906,18 @@ class TwoTowerMF:
             return (np.zeros((len(user_idx), 0), np.int64),
                     np.zeros((len(user_idx), 0), np.float32))
         if (model._device_items is None and model._device_items_q is None
-                and model._host_items is None):
+                and model._host_items is None and model._sharded is None):
             model.prepare_for_serving()
         if row_mask is not None and row_mask.shape != (len(user_idx), model.n_items):
             raise ValueError(
                 f"row_mask shape {row_mask.shape} != "
                 f"(batch, n_items) {(len(user_idx), model.n_items)}")
+        if model._sharded is not None:
+            # sharded layout: per-shard top-k + cross-shard merge
+            # (sharding/serve.py); _force_exact skips only the pruned
+            # (per-shard IVF) stage — exact answers stay sharded
+            return _recommend_batch_sharded(
+                model, user_idx, num, exclude, row_mask, _force_exact)
         if model._ivf is not None and not _force_exact:
             from incubator_predictionio_tpu.serving import ann
 
@@ -807,6 +998,31 @@ def _row_mask_pad_buffer(bucket: int, n_cols: int) -> np.ndarray:
     return buf
 
 
+def _recommend_batch_sharded(
+    model: TwoTowerModel,
+    user_idx: np.ndarray,
+    num: int,
+    exclude: Optional[np.ndarray] = None,
+    row_mask: Optional[np.ndarray] = None,
+    force_exact: bool = False,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Sharded retrieval (sharding/serve.py): the per-shard IVF prune +
+    merge-rerank when two-stage is enabled (falling back to sharded-exact
+    when any shard under-covers), else per-shard exact top-k + merge."""
+    sh = model._sharded
+    if (sh.ivf is not None and any(sh.ivf) and not force_exact):
+        from incubator_predictionio_tpu.serving import ann
+
+        if ann.two_stage_enabled(model.n_items):
+            q, ub = sh.user_rows(model, user_idx)
+            res = sh.search_ivf(q, ub, num, exclude=exclude,
+                                row_mask=row_mask)
+            if res is not None:
+                return res
+    return sh.search_exact(model, user_idx, num, exclude=exclude,
+                           row_mask=row_mask)
+
+
 def _recommend_batch_two_stage(
     model: TwoTowerModel,
     user_idx: np.ndarray,
@@ -877,15 +1093,6 @@ def _sort_batches_by_entity(
         np.take_along_axis(o2, srt, 1).reshape(-1),
         np.take_along_axis(w.reshape(n_batches, batch), srt, 1).reshape(-1),
     )
-
-
-@partial(jax.jit, static_argnames=("rows", "rank"))
-def _init_table(key, rows, rank, scale):
-    """Fused table init on device: [rows, rank+1], vectors ~N(0, scale²),
-    bias column zero."""
-    t = jnp.zeros((rows, rank + 1), jnp.float32)
-    return t.at[:, :rank].set(
-        jax.random.normal(key, (rows, rank), jnp.float32) * scale)
 
 
 @partial(jax.jit, static_argnames=("lr", "reg", "n_epochs"), donate_argnums=(0, 1))

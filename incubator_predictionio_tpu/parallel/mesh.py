@@ -182,6 +182,12 @@ class MeshContext:
     def axis_size(self, name: str) -> int:
         return self.mesh.shape[name]
 
+    def axis_size_or(self, name: str, default: int = 1) -> int:
+        """Axis size, or ``default`` when the mesh lacks the axis — how
+        optional-axis consumers (the sharded-table layout's ``model``
+        axis) ask without a membership check at every call site."""
+        return dict(self.mesh.shape).get(name, default)
+
     @property
     def data_axis(self) -> str:
         """The batch-parallel axis (first axis by convention)."""

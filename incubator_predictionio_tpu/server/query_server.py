@@ -927,8 +927,21 @@ class QueryServer:
                 # ship-resync keys on; stalenessSeconds is the freshness
                 # SLO pio-tpu health and the fleet balancer read
                 "streaming": self._streaming_health(),
+                # sharded serving (docs/sharding.md): per-model shard count
+                # + mode, None for single-host models — what `pio-tpu
+                # shards` and fleet tooling read without a full status page
+                "sharding": self._sharding_summary(),
             },
         })
+
+    def _sharding_summary(self) -> list:
+        out = []
+        for m in self.deployed.models:
+            info = m.serving_info() if hasattr(m, "serving_info") else None
+            sh = (info or {}).get("sharding")
+            out.append({"nShards": sh["n_shards"], "mode": sh["mode"],
+                        "mergeFanin": sh["merge_fanin"]} if sh else None)
+        return out
 
     async def handle_status(self, request: web.Request) -> web.Response:
         inst = self.deployed.instance

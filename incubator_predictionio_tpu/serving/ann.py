@@ -314,6 +314,10 @@ class IVFIndex:
         return {
             "n_partitions": int(self.n_partitions),
             "n_items": int(self.n_items),
+            # which table shard this index covers, when it is one of a
+            # sharded model's per-shard partitions (docs/sharding.md);
+            # None for a whole-catalog index
+            "shard": self.key.get("shard"),
             "partition_size_min": int(sizes.min()) if len(sizes) else 0,
             "partition_size_mean": round(mean, 1),
             "partition_size_max": int(sizes.max()) if len(sizes) else 0,
@@ -351,6 +355,7 @@ class IVFIndex:
         nprobe: Optional[int] = None,
         exclude: Optional[np.ndarray] = None,
         row_mask: Optional[np.ndarray] = None,
+        observe: bool = True,
     ) -> Optional[tuple[np.ndarray, np.ndarray]]:
         """Two-stage top-``num``: returns ``(idx [B, num] int64, scores
         [B, num] f32)`` with the exact path's score semantics, or ``None``
@@ -377,9 +382,11 @@ class IVFIndex:
         t0 = time.perf_counter()
         probe = self.probe(q, nprobe)
         counts = np.diff(self.offsets)[probe].sum(axis=1)
-        COARSE_SEC.observe(time.perf_counter() - t0)
+        if observe:
+            COARSE_SEC.observe(time.perf_counter() - t0)
         if int(counts.min()) < num:
-            FALLBACKS.inc()
+            if observe:
+                FALLBACKS.inc()
             return None
         # exclude lands per row via searchsorted over the SORTED exclude set
         # — O(cnt log E) in candidate space; an n_items-sized lookup table
@@ -426,13 +433,16 @@ class IVFIndex:
                 # THIS probe set — a masked (-inf) item would fill the
                 # trailing slots where the exact path, seeing the whole
                 # catalog, still has unmasked items to place. Fall back.
-                FALLBACKS.inc()
+                if observe:
+                    FALLBACKS.inc()
                 return None
             out_idx[r] = ids[top]
             out_scores[r] = scores[top]
-            CANDIDATES.observe(cnt)
-        RERANK_SEC.observe(time.perf_counter() - t0)
-        TWO_STAGE_BATCHES.inc()
+            if observe:
+                CANDIDATES.observe(cnt)
+        if observe:
+            RERANK_SEC.observe(time.perf_counter() - t0)
+            TWO_STAGE_BATCHES.inc()
         return out_idx, out_scores
 
 

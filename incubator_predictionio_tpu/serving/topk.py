@@ -29,6 +29,28 @@ def topk_row(scores: np.ndarray, num: int) -> np.ndarray:
     return part[np.argsort(-scores[part])]
 
 
+def merge_topk(
+    cand_ids: np.ndarray, cand_scores: np.ndarray, num: int,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Row-wise top-``num`` over gathered candidate lists (the cross-shard
+    merge of sharded serving): ``cand_ids``/``cand_scores`` are ``[B, C]``
+    with each shard's candidates already best-first and shards concatenated
+    in ascending-row-range order. Runs the same axis-wise
+    ``argpartition`` → ``argsort`` chain as :func:`grouped_topk`, so merged
+    results match the single-host serial oracle's selection (ids resolve
+    through ``cand_ids``)."""
+    b, c = cand_scores.shape
+    num = min(num, c)
+    if num <= 0 or b == 0:
+        return (np.empty((b, 0), cand_ids.dtype),
+                np.empty((b, 0), cand_scores.dtype))
+    part = np.argpartition(-cand_scores, num - 1, axis=1)[:, :num]
+    row = np.arange(b)[:, None]
+    order = np.argsort(-cand_scores[row, part], axis=1)
+    top = np.take_along_axis(part, order, 1)
+    return np.take_along_axis(cand_ids, top, 1), cand_scores[row, top]
+
+
 def grouped_topk(
     scored: np.ndarray, nums: Sequence[int],
 ) -> list[tuple[np.ndarray, np.ndarray]]:

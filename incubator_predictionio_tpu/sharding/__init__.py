@@ -1,0 +1,47 @@
+"""Sharded embedding subsystem: train AND serve catalog-scale tables
+directly from model-axis-sharded layouts (docs/sharding.md).
+
+ALX (arxiv 2112.02194) shards matrix factorization across TPU chips at
+exactly the 100M-user × 10M-item shapes the north star names; the
+pjit/TPUv4 programming model makes the layout declarative. The pieces this
+package unifies were parity levers before it — a ``model`` mesh axis that
+*ran* but cost more than it saved (MULTICHIP r05: tp 4.6× / ep 3.4×
+overhead), and serving that funneled every catalog through one host. The
+subsystem makes the model axis a *win* end to end:
+
+- :mod:`table <incubator_predictionio_tpu.sharding.table>` — the
+  :class:`~incubator_predictionio_tpu.sharding.table.ShardedTable`
+  abstraction: row-sharded embedding tables (NamedSharding over the
+  ``model`` axis, per-shard init keys, fused bias column) plus the
+  simulated per-chip HBM budget (``PIO_SHARD_HBM_BUDGET``) that proves the
+  doesn't-fit-one-chip case on CPU meshes.
+- :mod:`serve <incubator_predictionio_tpu.sharding.serve>` — serving read
+  straight from the sharded layout: per-shard top-k (the exact scoring
+  math, unchanged per shard) + cross-shard merge, composing with the IVF
+  two-stage path (each shard prunes its local partitions, the merge
+  reranks) and with streaming deltas (rows route to the owning shard).
+- :mod:`degrade <incubator_predictionio_tpu.sharding.degrade>` — the
+  once-per-key axis-degradation registry (a requested parallel axis the
+  mesh doesn't have logs ONE warning and is recorded machine-readably for
+  the MULTICHIP dryrun JSON instead of spamming stderr).
+- :mod:`shard_metrics <incubator_predictionio_tpu.sharding.shard_metrics>`
+  — ``pio_shard_*`` counters/histograms (docs/observability.md).
+"""
+
+from incubator_predictionio_tpu.sharding.table import (
+    HBMBudgetExceeded,
+    ShardSpec,
+    ShardedTable,
+    hbm_budget,
+    parse_bytes,
+    requires_sharding,
+)
+
+__all__ = [
+    "HBMBudgetExceeded",
+    "ShardSpec",
+    "ShardedTable",
+    "hbm_budget",
+    "parse_bytes",
+    "requires_sharding",
+]

@@ -1,0 +1,67 @@
+"""Once-per-key axis-degradation registry.
+
+A trainer asked for a parallel axis the mesh doesn't have (``n_experts=4``
+on a mesh with no ``expert`` axis, ``tensor_parallel`` with no ``model``
+axis, a sharded table request on a data-only mesh). The right response is
+to degrade — replicate the tables and keep training — but the old shape of
+that response was a ``logger.warning`` PER FIT, which a benchmark loop
+timing the same config three times turned into stderr spam (MULTICHIP r05
+tails three identical lines), and which no artifact recorded.
+
+This registry is the one place degradations land:
+
+- the warning logs ONCE per (component, axis, requested, mesh-axes) key,
+  with the requested-vs-available axes named;
+- every occurrence is COUNTED, and :func:`degradations` returns the
+  machine-readable list the MULTICHIP dryrun embeds in its JSON artifact
+  — the degradation is data, not log noise.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+logger = logging.getLogger(__name__)
+
+_LOCK = threading.Lock()
+_RECORDS: dict[tuple, dict] = {}
+
+
+def record_axis_degradation(component: str, axis: str, requested,
+                            mesh_axes, detail: str) -> dict:
+    """Note that ``component`` wanted ``requested`` over mesh axis ``axis``
+    but the mesh only has ``mesh_axes``. Logs once per distinct key;
+    returns the (shared, mutable) record with its occurrence count."""
+    mesh_axes = tuple(mesh_axes)
+    key = (component, axis, str(requested), mesh_axes)
+    with _LOCK:
+        rec = _RECORDS.get(key)
+        if rec is None:
+            rec = _RECORDS[key] = {
+                "component": component,
+                "axis": axis,
+                "requested": requested,
+                "mesh_axes": list(mesh_axes),
+                "detail": detail,
+                "count": 0,
+            }
+            logger.warning(
+                "%s: %s requested but the mesh has no '%s' axis "
+                "(mesh axes: %s) — %s",
+                component, requested, axis, mesh_axes, detail)
+        rec["count"] += 1
+        return rec
+
+
+def degradations() -> list[dict]:
+    """Every distinct degradation seen by this process, with counts —
+    what the MULTICHIP dryrun records in its JSON artifact."""
+    with _LOCK:
+        return [dict(r) for r in _RECORDS.values()]
+
+
+def reset() -> None:
+    """Forget everything (tests)."""
+    with _LOCK:
+        _RECORDS.clear()

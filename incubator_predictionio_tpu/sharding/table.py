@@ -1,0 +1,285 @@
+"""ShardedTable — row-sharded embedding tables over the ``model`` axis.
+
+One abstraction owns the layout questions every consumer was answering ad
+hoc (the trainer's ``pad_rows``, the checkpoint loader's ``sharding_for``,
+the serving math's row offsets):
+
+- **Layout** (:class:`ShardSpec`): rows padded to a whole number of equal
+  shards; shard ``s`` owns global rows ``[s·rows_per_shard,
+  (s+1)·rows_per_shard)``; an entity row's owner is ``row //
+  rows_per_shard``. The fused ``rank+1``-wide row (bias as the last
+  column) rides along from the trainer.
+- **Placement** (:class:`ShardedTable`): the table materializes as ONE
+  global ``jax.Array`` with ``NamedSharding(mesh, P("model", None))`` —
+  XLA sees the whole table, each chip holds only its row block, and the
+  co-sharded adam moments follow automatically (``utils/optim.py`` zeros
+  inherit the params' shardings).
+- **Init** uses *per-shard keys* (``jax.random.fold_in(key, shard)``)
+  computed on device directly into the sharded layout — no host staging,
+  and a shard's initial rows depend only on (key, shard, rows_per_shard),
+  not on which chip renders them.
+- **Budget** (``PIO_SHARD_HBM_BUDGET``): a *simulated* per-chip HBM bound.
+  Real chips enforce theirs with an OOM; the env knob lets a CPU dryrun
+  prove the doesn't-fit-one-chip case — creating a layout whose per-shard
+  bytes (table + both adam moments) exceed the budget raises
+  :class:`HBMBudgetExceeded` instead of silently fitting because host RAM
+  is big.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+import re
+from typing import Any, Optional
+
+import numpy as np
+
+#: f32 table bytes per element; the adam moments ride the moments dtype.
+_F32 = 4
+_BYTES_FOR_DTYPE = {"float32": 4, "bfloat16": 2}
+
+
+class HBMBudgetExceeded(RuntimeError):
+    """A table layout needs more per-chip HBM than ``PIO_SHARD_HBM_BUDGET``."""
+
+
+def parse_bytes(text: str) -> int:
+    """``"256MB"`` / ``"1.5GiB"`` / ``"64kb"`` / plain ints → bytes."""
+    s = str(text).strip()
+    m = re.fullmatch(
+        r"(?i)\s*([0-9]+(?:\.[0-9]+)?)\s*([kmgt]?i?b?)?\s*", s)
+    if not m:
+        raise ValueError(f"unparseable byte size {text!r}")
+    value = float(m.group(1))
+    unit = (m.group(2) or "").lower().rstrip("b").rstrip("i")
+    mult = {"": 1, "k": 1 << 10, "m": 1 << 20,
+            "g": 1 << 30, "t": 1 << 40}[unit]
+    return int(value * mult)
+
+
+def hbm_budget() -> Optional[int]:
+    """The simulated per-chip HBM byte budget, or None when unbounded."""
+    raw = os.environ.get("PIO_SHARD_HBM_BUDGET", "").strip()
+    if not raw:
+        return None
+    b = parse_bytes(raw)
+    return b if b > 0 else None
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardSpec:
+    """Pure layout: which global rows live on which shard.
+
+    ``width`` is the fused row width (``rank + 1``; bias is the last
+    column — see models/two_tower.py on why the bias is not a separate
+    1-D table). ``n_rows`` is the REAL row count; the padded tail rows
+    exist only to make the shards equal and never hold entities.
+    """
+
+    name: str
+    n_rows: int
+    width: int
+    n_shards: int
+
+    def __post_init__(self):
+        if self.n_shards < 1:
+            raise ValueError(f"n_shards must be >= 1, got {self.n_shards}")
+
+    @property
+    def padded_rows(self) -> int:
+        return -(-max(self.n_rows, 1) // self.n_shards) * self.n_shards
+
+    @property
+    def rows_per_shard(self) -> int:
+        return self.padded_rows // self.n_shards
+
+    def shard_bounds(self, shard: int) -> tuple[int, int]:
+        """Global ``[lo, hi)`` of shard ``shard``'s REAL rows (hi clipped
+        to ``n_rows`` — the last shard may own padding-only tail rows)."""
+        if not (0 <= shard < self.n_shards):
+            raise ValueError(f"shard {shard} outside [0, {self.n_shards})")
+        lo = shard * self.rows_per_shard
+        return min(lo, self.n_rows), min(lo + self.rows_per_shard, self.n_rows)
+
+    def owner_of(self, row: int) -> int:
+        """Which shard owns global row ``row`` (streaming deltas route
+        updated rows here)."""
+        if not (0 <= row < self.n_rows):
+            raise ValueError(f"row {row} outside [0, {self.n_rows})")
+        return row // self.rows_per_shard
+
+    def shard_row_counts(self) -> list[int]:
+        return [hi - lo for lo, hi in
+                (self.shard_bounds(s) for s in range(self.n_shards))]
+
+    # -- byte accounting ---------------------------------------------------
+    def table_bytes(self) -> int:
+        """f32 bytes of the full padded table."""
+        return self.padded_rows * self.width * _F32
+
+    def shard_table_bytes(self) -> int:
+        return self.rows_per_shard * self.width * _F32
+
+    def train_bytes_per_shard(self, moments_dtype: str = "float32") -> int:
+        """Per-chip training residency: the row block + BOTH co-sharded
+        adam moments (utils/optim.py stores m and v in ``moments_dtype``)."""
+        mb = _BYTES_FOR_DTYPE.get(moments_dtype, _F32)
+        return self.rows_per_shard * self.width * (_F32 + 2 * mb)
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "n_rows": int(self.n_rows),
+            "width": int(self.width),
+            "n_shards": int(self.n_shards),
+            "padded_rows": int(self.padded_rows),
+            "rows_per_shard": int(self.rows_per_shard),
+            "shard_rows": self.shard_row_counts(),
+            "table_bytes": int(self.table_bytes()),
+            "train_bytes_per_shard": int(self.train_bytes_per_shard()),
+        }
+
+
+def requires_sharding(n_rows: int, width: int,
+                      moments_dtype: str = "float32",
+                      budget: Optional[int] = None) -> bool:
+    """Would the SINGLE-CHIP (unsharded) training layout blow the budget?
+    This is the doesn't-fit-one-chip predicate the MULTICHIP dryrun proves
+    on CPU: when True, only a sharded layout can train the table."""
+    budget = hbm_budget() if budget is None else budget
+    if budget is None:
+        return False
+    one = ShardSpec("single", n_rows, width, 1)
+    return one.train_bytes_per_shard(moments_dtype) > budget
+
+
+def check_budget(spec: ShardSpec, moments_dtype: str = "float32",
+                 budget: Optional[int] = None) -> None:
+    """Raise :class:`HBMBudgetExceeded` when ``spec``'s PER-SHARD training
+    bytes exceed the simulated chip budget (what a real chip answers with
+    an OOM)."""
+    budget = hbm_budget() if budget is None else budget
+    if budget is None:
+        return
+    need = spec.train_bytes_per_shard(moments_dtype)
+    if need > budget:
+        hint = ("" if spec.n_shards > 1 else
+                " — shard the table over a 'model' mesh axis "
+                "(docs/sharding.md)")
+        raise HBMBudgetExceeded(
+            f"table {spec.name!r}: {need} bytes/chip "
+            f"({spec.rows_per_shard}×{spec.width} rows + adam moments over "
+            f"{spec.n_shards} shard(s)) exceeds PIO_SHARD_HBM_BUDGET="
+            f"{budget}{hint}")
+
+
+# -- placement ---------------------------------------------------------------
+
+#: jitted per-shard-key init fns, keyed on (mesh, axis, layout) — a fresh
+#: ``jax.jit`` wrapper per fit would recompile this trivial program every
+#: training run (the utils/optim.py lesson).
+_INIT_CACHE: dict[tuple, Any] = {}
+
+
+def _sharded_init_fn(mesh, axis: Optional[str], n_shards: int,
+                     rows_per_shard: int, rank: int):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    key_ = (mesh, axis, n_shards, rows_per_shard, rank)
+    fn = _INIT_CACHE.get(key_)
+    if fn is not None:
+        return fn
+    sharding = NamedSharding(mesh, P(axis, None) if axis else P())
+
+    def init(key, scale):
+        if n_shards == 1:
+            # legacy single-shard formula (one key, whole table) — keeps
+            # unsharded fits bit-identical across this refactor
+            t = jnp.zeros((rows_per_shard, rank + 1), jnp.float32)
+            return t.at[:, :rank].set(
+                jax.random.normal(key, (rows_per_shard, rank), jnp.float32)
+                * scale)
+        # per-shard keys: shard s's block depends only on fold_in(key, s)
+        keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(
+            jnp.arange(n_shards))
+
+        def block(k):
+            t = jnp.zeros((rows_per_shard, rank + 1), jnp.float32)
+            return t.at[:, :rank].set(
+                jax.random.normal(k, (rows_per_shard, rank), jnp.float32)
+                * scale)
+
+        return jax.vmap(block)(keys).reshape(
+            n_shards * rows_per_shard, rank + 1)
+
+    if len(_INIT_CACHE) >= 64:
+        _INIT_CACHE.clear()
+    fn = _INIT_CACHE[key_] = jax.jit(init, out_shardings=sharding)
+    return fn
+
+
+@dataclasses.dataclass
+class ShardedTable:
+    """A placed table: layout + the global sharded ``jax.Array``."""
+
+    spec: ShardSpec
+    array: Any                 # jax.Array [padded_rows, width]
+    axis: Optional[str]        # mesh axis the rows shard over (None = repl.)
+
+    @staticmethod
+    def init_train(ctx, name: str, n_rows: int, rank: int, key,
+                   scale: float, moments_dtype: str = "float32",
+                   ) -> "ShardedTable":
+        """Initialize a training table in its sharded layout.
+
+        Single-process: init runs ON DEVICE directly into the sharding
+        (per-shard fold_in keys) — a 1M×129 table round-tripped through the
+        host costs ~GB of transfer for pure noise. Multi-process: blocks
+        are built host-side with the same per-shard keys and placed via
+        :meth:`MeshContext.put` (every process must agree bit-for-bit).
+
+        Enforces ``PIO_SHARD_HBM_BUDGET`` on the per-shard bytes — the
+        simulated equivalent of the OOM a real chip would raise.
+        """
+        import jax
+
+        model_axis = "model" if "model" in ctx.mesh.shape else None
+        n_shards = ctx.axis_size(model_axis) if model_axis else 1
+        spec = ShardSpec(name, n_rows, rank + 1, n_shards)
+        check_budget(spec, moments_dtype)
+        if ctx.process_count == 1:
+            fn = _sharded_init_fn(
+                ctx.mesh, model_axis, n_shards, spec.rows_per_shard, rank)
+            return ShardedTable(spec, fn(key, scale), model_axis)
+        # multi-process: same per-shard blocks, staged host-side
+        blocks = []
+        for s in range(n_shards):  # pragma: no cover - multiproc
+            ks = jax.random.fold_in(key, s) if n_shards > 1 else key
+            t = np.zeros((spec.rows_per_shard, rank + 1), np.float32)
+            t[:, :rank] = np.asarray(
+                jax.random.normal(ks, (spec.rows_per_shard, rank))) * scale
+            blocks.append(t)
+        host = np.concatenate(blocks, axis=0)  # pragma: no cover - multiproc
+        spec_args = (model_axis, None) if model_axis else ()
+        return ShardedTable(  # pragma: no cover - multiproc
+            spec, ctx.put(host, *spec_args), model_axis)
+
+
+def array_model_shards(arr) -> int:
+    """How many ways a placed table's FIRST dim is actually split — 1 for
+    replicated/unsharded arrays. Serving uses this to recognize tables that
+    restored straight into a sharded layout."""
+    sharding = getattr(arr, "sharding", None)
+    spec = getattr(sharding, "spec", None)
+    if sharding is None or spec is None or not len(spec):
+        return 1
+    first = spec[0]
+    if first is None:
+        return 1
+    mesh = sharding.mesh
+    names = first if isinstance(first, tuple) else (first,)
+    return int(math.prod(mesh.shape[n] for n in names))

@@ -394,6 +394,11 @@ class RecModel(PersistentModel):
             # the catalog qualifies, else None) — persisting it means
             # redeploys skip the catalog re-cluster
             "ivf": self.mf._ivf,
+            # sharded layout record + per-shard IVF partitions
+            # (docs/sharding.md): deploy restores straight into the sharded
+            # layout and skips the per-shard re-cluster
+            "shard_spec": self.mf._shard_spec,
+            "shard_ivf": self.mf._shard_ivf,
             # trained cold-start bucket rows (streaming deltas update them)
             "coldstart": getattr(self, "coldstart", None),
         }
@@ -418,17 +423,23 @@ class RecModel(PersistentModel):
         cfg = meta["config"]
         # like-template fixes the restored leaves' placement: "model"-axis
         # row sharding when the deploy mesh has one (and the padded rows
-        # still divide), replicated otherwise — restore lands ON DEVICE in
-        # the serving layout, no host staging
-        def sharding_for(rows: int):
-            if "model" in ctx.mesh.shape and \
-                    rows % ctx.axis_size("model") == 0:
-                return ctx.sharding("model", None)
-            return ctx.replicated()
+        # still divide); else, when sharded SERVING will engage, straight
+        # into the 1-D serve-mesh layout; replicated otherwise — restore
+        # lands ON DEVICE in the serving layout, no host staging and no
+        # full-table gather (docs/sharding.md)
+        from incubator_predictionio_tpu.sharding import serve as shard_serve
+        from incubator_predictionio_tpu.utils.checkpoint import (
+            row_sharding_for,
+        )
+
+        trained = (meta.get("shard_spec") or {}).get("ie")
+        serve_shards = shard_serve.restore_shards(
+            meta["n_items"], cfg.rank,
+            trained.n_shards if trained is not None else 1)
 
         like = {
             k: jnp.zeros((rows, cfg.rank + 1), jnp.float32,
-                         device=sharding_for(rows))
+                         device=row_sharding_for(ctx, rows, serve_shards))
             for k, rows in meta["table_rows"].items()
         }
         tables = TrainCheckpointer(d, max_to_keep=1).restore(like=like)
@@ -437,6 +448,8 @@ class RecModel(PersistentModel):
         mf._n_users = meta["n_users"]
         mf._n_items = meta["n_items"]
         mf._ivf = meta.get("ivf")
+        mf._shard_spec = meta.get("shard_spec")
+        mf._shard_ivf = meta.get("shard_ivf")
         model = cls(mf, meta["user_map"], meta["item_map"])
         model.coldstart = meta.get("coldstart")
         return model
@@ -521,6 +534,10 @@ class RecModel(PersistentModel):
 
     def serving_info(self) -> dict:
         return self.mf.serving_info()
+
+    def shard_info(self) -> dict:
+        """Shard layout + HBM estimates (``pio-tpu shards``)."""
+        return self.mf.shard_info()
 
 
 class ALSAlgorithm(PAlgorithm):

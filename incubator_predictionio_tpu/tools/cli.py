@@ -921,6 +921,21 @@ def format_index_stats(models) -> list[str]:
                      f"catalog_rows={info.get('catalog_rows', '?')} "
                      f"retrieval={mode}")
         stats = info.get("index")
+        if isinstance(stats, list):
+            # sharded serving: one IVF per shard (docs/sharding.md)
+            live = [s for s in stats if s]
+            if live:
+                parts = [s["n_partitions"] for s in live]
+                lines.append(
+                    f"  per-shard IVF over {len(stats)} shards: "
+                    f"{sum(parts)} partitions total "
+                    f"({min(parts)}–{max(parts)}/shard) covering "
+                    f"{sum(s['n_items'] for s in live)} items; "
+                    f"rerank {'int8' if live[0]['quantized'] else 'fp32'}, "
+                    f"index bytes {sum(s['index_bytes'] for s in live)} "
+                    "— `pio-tpu shards` prints the layout")
+                continue
+            stats = None
         if not stats:
             lines.append("  no partition index (exact full-catalog retrieval"
                          " — see PIO_RETRIEVAL_MODE in docs/serving.md)")
@@ -939,6 +954,93 @@ def format_index_stats(models) -> list[str]:
             f"index bytes: {stats['index_bytes']}  "
             f"build: {stats['build_seconds']}s")
     return lines
+
+
+def _fmt_bytes(n) -> str:
+    if n is None:
+        return "unbounded"
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024
+    return f"{n:.1f}GiB"  # pragma: no cover - loop always returns
+
+
+def format_shard_stats(models) -> list[str]:
+    """Human-readable shard layout for a deployed engine's models —
+    separated from cmd_shards so tests drive it with hand-built models
+    (the format_index_stats pattern)."""
+    lines: list[str] = []
+    for i, m in enumerate(models):
+        name = type(m).__name__
+        if not hasattr(m, "shard_info"):
+            lines.append(f"model {i} ({name}): no shard layout "
+                         "(not an embedding-table model)")
+            continue
+        info = m.shard_info()
+        if not info.get("sharded"):
+            lines.append(f"model {i} ({name}): UNSHARDED single-host layout")
+            items = info.get("items") or {}
+            lines.append(
+                f"  items: {items.get('n_rows', '?')} rows × "
+                f"{items.get('width', '?')} "
+                f"({_fmt_bytes(items.get('table_bytes'))} f32; "
+                f"train+adam {_fmt_bytes(items.get('train_bytes_per_shard'))}"
+                "/chip)")
+            budget = info.get("hbm_budget")
+            lines.append(
+                f"  hbm budget: {_fmt_bytes(budget)}"
+                + ("  — EXCEEDS one chip: train/serve sharded "
+                   "(PIO_SHARD_SERVE, docs/sharding.md)"
+                   if info.get("requires_sharding") else ""))
+            continue
+        items, users = info["items"], info["users"]
+        lines.append(
+            f"model {i} ({name}): SHARDED ×{info['n_shards']} "
+            f"({info['mode']} shards)")
+        for label, t in (("items", items), ("users", users)):
+            rows = t["shard_rows"]
+            lines.append(
+                f"  {label}: {t['n_rows']} rows → {t['rows_per_shard']}"
+                f"/shard (real min/max {min(rows)}/{max(rows)}), "
+                f"{_fmt_bytes(t['table_bytes'] // t['n_shards'])} f32/shard, "
+                f"train+adam {_fmt_bytes(t['train_bytes_per_shard'])}/shard")
+        lines.append(
+            f"  merge fan-in: {info['merge_fanin']} candidates/query "
+            f"({info['n_shards']} shards × per-shard top-k, "
+            f"serve_k {info['serve_k']})")
+        budget = info.get("hbm_budget")
+        if budget is not None:
+            lines.append(f"  hbm budget: {_fmt_bytes(budget)}")
+        ivf = info.get("ivf")
+        if ivf and any(ivf):
+            parts = [s["n_partitions"] for s in ivf if s]
+            lines.append(
+                f"  per-shard IVF: {sum(parts)} partitions total "
+                f"({min(parts)}–{max(parts)}/shard) — each shard prunes "
+                "locally, the merge reranks")
+    return lines
+
+
+def cmd_shards(args, storage: Storage) -> int:
+    """Inspect the shard layout of the latest COMPLETED instance's models:
+    per-shard row counts, HBM-bytes estimates, merge fan-in
+    (docs/sharding.md)."""
+    from incubator_predictionio_tpu.server.query_server import (
+        ServerConfig,
+        load_deployed_engine,
+    )
+
+    # warmup=False: inspection only reads shard_info() — XLA bucket
+    # compiles would be paid for nothing
+    deployed = load_deployed_engine(
+        ServerConfig(engine_variant=args.engine_variant, max_batch=1),
+        storage, warmup=False)
+    _out(f"engine instance {deployed.instance.id}")
+    for line in format_shard_stats(deployed.models):
+        _out(line)
+    return 0
 
 
 def cmd_index(args, storage: Storage) -> int:
@@ -1672,6 +1774,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "built (and shown) even below the auto catalog-size "
                         "threshold")
 
+    # shards — sharded embedding layout inspection (docs/sharding.md)
+    p = sub.add_parser(
+        "shards",
+        help="inspect the sharded embedding layout of the latest trained "
+             "model: per-shard row counts, HBM-bytes estimates, merge "
+             "fan-in (docs/sharding.md)")
+    p.add_argument("-v", "--engine-variant", default="engine.json")
+
     # health — one-probe fleet state across all three servers
     p = sub.add_parser(
         "health",
@@ -1880,6 +1990,7 @@ _COMMANDS = {
     "metrics": cmd_metrics,
     "health": cmd_health,
     "index": cmd_index,
+    "shards": cmd_shards,
     "wal": cmd_wal,
     "stream": cmd_stream,
     "start-all": cmd_start_all,

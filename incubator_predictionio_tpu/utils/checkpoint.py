@@ -203,6 +203,32 @@ def checkpointed_epochs(
     return params, opt_state, loss
 
 
+def row_sharding_for(ctx, rows: int, serve_shards: int = 0):
+    """The sharding a restored ``[rows, width]`` embedding table should
+    land in — deploy restores STRAIGHT into the sharded layout, never
+    through a host gather (docs/sharding.md).
+
+    Preference order: the context's ``model`` axis when present and the
+    rows divide it; else, when sharded SERVING is engaged
+    (``serve_shards > 1``, from ``sharding.serve.serving_shards_for``-style
+    decisions) a 1-D serve mesh over the local devices; else replicated.
+    """
+    from jax.sharding import PartitionSpec
+
+    if "model" in ctx.mesh.shape and rows % ctx.axis_size("model") == 0:
+        return ctx.sharding("model", None)
+    if serve_shards > 1 and rows % serve_shards == 0:
+        from incubator_predictionio_tpu.sharding.serve import (
+            SHARD_AXIS,
+            _serve_mesh,
+        )
+        from jax.sharding import NamedSharding
+
+        return NamedSharding(_serve_mesh(serve_shards),
+                             PartitionSpec(SHARD_AXIS, None))
+    return ctx.replicated()
+
+
 def restore_placed(ck: TrainCheckpointer, like: Any, mesh) -> Any:
     """Restore the latest step and re-place every leaf for ``mesh``.
 

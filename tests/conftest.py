@@ -37,6 +37,27 @@ import pytest
 
 
 @pytest.fixture()
+def mesh8():
+    """A real data×model mesh over the 8 virtual CPU devices — the tier-1-
+    safe stand-in for a multi-chip TPU slice (``@pytest.mark.multichip``
+    cases run sharded train/serve parity in the NORMAL suite; the XLA_FLAGS
+    + JAX_PLATFORMS=cpu forcing above is what makes that safe)."""
+    from incubator_predictionio_tpu.parallel.mesh import MeshContext
+
+    return MeshContext.create(axes={"data": 2, "model": 4})
+
+
+@pytest.fixture()
+def shard_env(monkeypatch):
+    """Clean PIO_SHARD_* env for sharded-serving cases; returns monkeypatch
+    so tests set the knobs they pin."""
+    for var in ("PIO_SHARD_SERVE", "PIO_SHARD_SERVE_SHARDS",
+                "PIO_SHARD_HBM_BUDGET"):
+        monkeypatch.delenv(var, raising=False)
+    return monkeypatch
+
+
+@pytest.fixture()
 def tmp_pio_home(monkeypatch):
     """Isolated PIO_FS_BASEDIR + default sqlite storage config per test."""
     with tempfile.TemporaryDirectory() as d:

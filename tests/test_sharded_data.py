@@ -1,0 +1,120 @@
+"""data/sharded.py collectives: the shard → vocab-allgather → remap reads.
+
+Two regimes, both pinned:
+
+- the single-process DEGENERATE path (every function must be correct with
+  ``process_count == 1`` — data sources call them unconditionally);
+- a SIMULATED multi-shard path: a fake MeshContext whose ``allgather_obj``
+  returns pre-baked per-process parts, so the collective algebra
+  (disjointness, remap round trips, union determinism) is exercised
+  without spawning processes.
+"""
+
+import numpy as np
+import pytest
+
+from incubator_predictionio_tpu.data.sharded import (
+    concat_vocab,
+    global_row_count,
+    global_sum,
+    union_label_set,
+    union_vocab,
+)
+from incubator_predictionio_tpu.parallel.mesh import MeshContext
+
+
+class FakeShardCtx:
+    """Duck-typed MeshContext for the simulated multi-shard path: every
+    process's local payload is pre-baked, allgather returns them all in
+    process order (what multihost_utils.process_allgather guarantees)."""
+
+    def __init__(self, parts_by_process, process_index=0):
+        self._parts = parts_by_process
+        self.process_index = process_index
+        self.process_count = len(parts_by_process)
+
+    def allgather_obj(self, obj):
+        # the caller must pass ITS OWN part — a mismatch means the test
+        # (or a future refactor) desynchronized the collective
+        assert obj == self._parts[self.process_index], (
+            obj, self._parts[self.process_index])
+        return list(self._parts)
+
+
+# -- single-process degenerate path ------------------------------------------
+
+def test_single_process_degenerates_to_identity():
+    ctx = MeshContext.create()
+    vocab, offset = concat_vocab(ctx, ["u1", "u2"])
+    assert list(vocab) == ["u1", "u2"] and offset == 0
+    vocab, remap = union_vocab(ctx, ["i2", "i1", "i2"])
+    assert list(vocab) == ["i2", "i1"]
+    np.testing.assert_array_equal(remap, [0, 1, 0])
+    assert global_sum(ctx, 3) == 3
+    np.testing.assert_array_equal(
+        global_sum(ctx, np.arange(4)), np.arange(4))
+    assert global_row_count(ctx, 7) == 7
+    assert union_label_set(ctx, ["b", "a", "b"]) == ["a", "b"]
+
+
+# -- simulated multi-shard path ----------------------------------------------
+
+def test_concat_vocab_offsets_and_globalization():
+    parts = [["u0", "u2"], ["u1", "u3", "u5"], ["u4"]]
+    for pid, expect_offset in ((0, 0), (1, 2), (2, 5)):
+        ctx = FakeShardCtx(parts, pid)
+        vocab, offset = concat_vocab(ctx, parts[pid])
+        assert offset == expect_offset
+        assert list(vocab) == ["u0", "u2", "u1", "u3", "u5", "u4"]
+        # local index i globalizes as i + offset, landing on the same id
+        for i, v in enumerate(parts[pid]):
+            assert vocab[i + offset] == v
+
+
+def test_concat_vocab_disjointness_violation_raises():
+    """An id in two shards would silently split one entity's training
+    signal across two global rows — it must raise instead."""
+    parts = [["u0", "u1"], ["u1", "u2"]]
+    with pytest.raises(ValueError, match="appears in shards 0 and 1"):
+        concat_vocab(FakeShardCtx(parts, 0), parts[0])
+
+
+def test_union_vocab_remap_round_trips():
+    parts = [["i3", "i1"], ["i1", "i2"], ["i2", "i3", "i0"]]
+    vocabs = {}
+    for pid in range(3):
+        ctx = FakeShardCtx(parts, pid)
+        vocab, remap = union_vocab(ctx, parts[pid])
+        vocabs[pid] = list(vocab)
+        # remap[local] lands every local id on its global slot
+        for i, v in enumerate(parts[pid]):
+            assert vocab[remap[i]] == v
+    # every process computed the IDENTICAL global vocabulary —
+    # first-seen over shards in process order
+    assert vocabs[0] == vocabs[1] == vocabs[2] == ["i3", "i1", "i2", "i0"]
+
+
+def test_union_vocab_process_order_vs_sorted_union_determinism():
+    """union_vocab is FIRST-SEEN-in-process-order (matches single-process
+    first-seen reads); union_label_set is the SORTED union — two different
+    determinism contracts, both order-stable across processes."""
+    parts = [["z", "m"], ["a", "z"]]
+    vocab, _ = union_vocab(FakeShardCtx(parts, 0), parts[0])
+    assert list(vocab) == ["z", "m", "a"]  # NOT sorted: process order
+    labels_parts = [sorted({"z", "m"}), sorted({"a", "z"})]
+    got = union_label_set(FakeShardCtx(labels_parts, 1), ["a", "z"])
+    assert got == ["a", "m", "z"]  # sorted union
+
+
+def test_global_sum_scalars_arrays_pytrees():
+    parts = [
+        (2, {"rows": np.array([1.0, 2.0]), "n": 3}),
+        (5, {"rows": np.array([10.0, 20.0]), "n": 4}),
+    ]
+    ctx = FakeShardCtx([p[0] for p in parts], 0)
+    assert global_sum(ctx, parts[0][0]) == 7
+    ctx = FakeShardCtx([p[1] for p in parts], 1)
+    out = global_sum(ctx, parts[1][1])
+    np.testing.assert_array_equal(out["rows"], [11.0, 22.0])
+    assert out["n"] == 7
+    assert global_row_count(FakeShardCtx([3, 4], 0), 3) == 7
