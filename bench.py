@@ -1949,14 +1949,17 @@ CONFIG_NAMES = ["recommendation", "recommendation_scaled", "classification",
                 "similarproduct", "ecommerce_retrieval", "retrieval_scale",
                 "sharded_serving", "sequential", "serving", "overload",
                 "fleet", "ingestion", "ingest_durability",
-                "streaming_freshness", "storage_failover"]
+                "streaming_freshness", "storage_failover",
+                "continuous_training"]
 # "fleet" is device-free too: its replicas are CPU subprocesses (a fleet
 # on one host) — the scenario measures the ROUTER's horizontal scaling,
 # not chip throughput; "sharded_serving" likewise runs on 8 virtual CPU
-# devices (merge/layout architecture, not chip throughput)
+# devices (merge/layout architecture, not chip throughput);
+# "continuous_training" measures the control plane's recovery clock, not
+# the chip
 DEVICE_FREE = {"ingestion", "ingest_durability", "fleet",
                "streaming_freshness", "storage_failover",
-               "sharded_serving"}
+               "sharded_serving", "continuous_training"}
 
 
 def _build_suite(ctx, peaks, device) -> dict:
@@ -1977,6 +1980,7 @@ def _build_suite(ctx, peaks, device) -> dict:
         "ingest_durability": lambda: bench_ingest_durability(),
         "streaming_freshness": lambda: bench_streaming_freshness(),
         "storage_failover": lambda: bench_storage_failover(),
+        "continuous_training": lambda: bench_continuous_training(),
     }
 
 
@@ -2145,6 +2149,248 @@ def bench_streaming_freshness() -> dict:
 
         return asyncio.run(drive())
     finally:
+        use_storage(prev)
+        storage.close()
+
+
+# ---------------------------------------------------------------------------
+# 11. continuous training (docs/jobs.md): SIGKILL the training worker
+#     mid-epoch and measure retrain MTTR (kill → new instance serving),
+#     then trip the streaming quarantine and measure the auto-retrain loop's
+#     quarantine → fresh-recommendations end-to-end time
+# ---------------------------------------------------------------------------
+
+
+def bench_continuous_training() -> dict:
+    """Two clocks on the control plane (incubator_predictionio_tpu/jobs/):
+
+    - **retrain MTTR**: a train job is mid-epoch in a real worker
+      subprocess when it takes a SIGKILL; the job is reclaimed under a new
+      fence, RESUMES from the epoch checkpoint, and the clock stops when
+      the gated deploy lands on the serving process — with exactly one
+      /reload observed.
+    - **quarantine → fresh**: the stream's divergence quarantine marker is
+      planted; the trigger loop auto-submits the full retrain, an
+      in-process worker executes + promotes it, and the clock stops when a
+      restarted updater (marker cleared by the new instance id) has folded
+      live events into an applied delta again.
+    """
+    import datetime as dt_mod
+    import shutil
+    import tempfile
+
+    from incubator_predictionio_tpu.data import DataMap, Event
+    from incubator_predictionio_tpu.data.storage import (
+        App,
+        Storage,
+        use_storage,
+    )
+    from incubator_predictionio_tpu.data.storage.base import EngineInstance
+    from incubator_predictionio_tpu.jobs import (
+        JobWorker,
+        Orchestrator,
+        TriggerConfig,
+        TriggerLoop,
+        WorkerConfig,
+    )
+    from incubator_predictionio_tpu.obs.metrics import REGISTRY
+    from incubator_predictionio_tpu.parallel.mesh import MeshContext
+    from incubator_predictionio_tpu.streaming import guard as guards
+    from tests.fixtures.procs import ServerProc, free_port as _fp, http_json
+
+    ctx = MeshContext.create()
+    tmp = tempfile.mkdtemp(prefix="pio-ct-bench-")
+    iterations = 8 if SMALL else 16
+    n_events = 4_000 if SMALL else 10_000
+    n_users, n_items = 400, 300
+    utc = dt_mod.timezone.utc
+    store_cfg = {
+        "PIO_STORAGE_SOURCES_SQ_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_SQ_PATH": os.path.join(tmp, "store.db"),
+        "PIO_STORAGE_SOURCES_EL_TYPE": "eventlog",
+        "PIO_STORAGE_SOURCES_EL_PATH": os.path.join(tmp, "eventlog"),
+        **{f"PIO_STORAGE_REPOSITORIES_{repo}_SOURCE": src
+           for repo, src in (("METADATA", "SQ"), ("EVENTDATA", "EL"),
+                             ("MODELDATA", "SQ"))},
+    }
+    ckpt_dir = os.path.join(tmp, "ckpt")
+    variant_path = os.path.join(tmp, "engine.json")
+    storage = Storage(store_cfg)
+    prev = use_storage(storage)
+    rng = np.random.default_rng(9)
+
+    def live_events(n, rating=None):
+        now = dt_mod.datetime.now(utc)
+        return [
+            Event(event="rate", entity_type="user",
+                  entity_id=f"u{rng.integers(0, n_users)}",
+                  target_entity_type="item",
+                  target_entity_id=f"i{rng.integers(0, n_items)}",
+                  properties=DataMap({"rating": float(
+                      rating if rating is not None
+                      else 1 + 4 * rng.random())}),
+                  event_time=now)
+            for _ in range(n)
+        ]
+
+    def train_base() -> str:
+        from incubator_predictionio_tpu.core.controller import (
+            resolve_engine_factory,
+        )
+        from incubator_predictionio_tpu.core.workflow import run_train
+
+        with open(variant_path) as f:
+            variant = json.load(f)
+        engine = resolve_engine_factory(variant["engineFactory"])()
+        engine_params = engine.engine_params_from_variant(variant)
+        instance = EngineInstance(
+            id="", status="INIT", start_time=dt_mod.datetime.now(utc),
+            end_time=None, engine_id="ct", engine_version="1",
+            engine_variant=os.path.abspath(variant_path),
+            engine_factory=variant["engineFactory"])
+        return run_train(engine, engine_params, instance, storage=storage,
+                         ctx=ctx)
+
+    def jobs_delta(before):
+        after = _metrics_snapshot(REGISTRY.expose())
+        return {k: round(after.get(k, 0) - before.get(k, 0), 3)
+                for k in after
+                if k.startswith("pio_jobs_")
+                and after.get(k, 0) != before.get(k, 0)}
+
+    qs = w1 = w2 = None
+    try:
+        with open(variant_path, "w") as f:
+            json.dump({
+                "id": "ct", "version": "1",
+                "engineFactory": "incubator_predictionio_tpu.templates."
+                                 "recommendation.RecommendationEngine",
+                "datasource": {"params": {"appName": "ct-app"}},
+                "algorithms": [{"name": "als", "params": {
+                    "rank": 32, "numIterations": iterations,
+                    "batchSize": 1024,
+                    "checkpointDir": ckpt_dir, "checkpointEvery": 1}}],
+            }, f)
+        app_id = storage.get_meta_data_apps().insert(App(0, "ct-app"))
+        events_store = storage.get_events()
+        events_store.init(app_id)
+        events_store.insert_batch(live_events(n_events), app_id)
+        t0 = time.perf_counter()
+        base_instance = train_base()
+        base_train_s = time.perf_counter() - t0
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+        qport = _fp()
+        base_url = f"http://127.0.0.1:{qport}"
+        qs = ServerProc(["deploy", "-v", variant_path, "--ip", "127.0.0.1",
+                         "--port", str(qport)], env=dict(store_cfg))
+        qs.wait_ready(f"{base_url}/", timeout=300.0)
+
+        m_before = _metrics_snapshot(REGISTRY.expose())
+        orch = Orchestrator(storage.get_meta_data_jobs())
+        jobs_store = storage.get_meta_data_jobs()
+
+        # -- phase A: retrain MTTR under a mid-epoch SIGKILL --------------
+        job = orch.submit("train", {
+            "engine_variant": os.path.abspath(variant_path),
+            "server_url": base_url})
+        w1 = ServerProc(["jobs", "worker", "--poll", "0.2"],
+                        env={**store_cfg, "PIO_JOBS_LEASE_SEC": "2"})
+        deadline = time.monotonic() + 600.0
+        while time.monotonic() < deadline:
+            j = jobs_store.get(job.id)
+            steps = [d for d in (os.listdir(ckpt_dir)
+                                 if os.path.isdir(ckpt_dir) else [])
+                     if d.isdigit()]
+            if j.status == "RUNNING" and steps \
+                    and max(int(s) for s in steps) >= 2:
+                break
+            if not j.active:
+                raise RuntimeError(f"train finished early: {j.status}")
+            time.sleep(0.05)
+        else:
+            raise RuntimeError("no mid-epoch checkpoint window")
+        t_kill = time.perf_counter()
+        w1.kill9()
+        w2 = ServerProc(["jobs", "worker", "--poll", "0.2"],
+                        env={**store_cfg, "PIO_JOBS_LEASE_SEC": "30"})
+        while True:
+            j = jobs_store.get(job.id)
+            if not j.active:
+                break
+            if time.perf_counter() - t_kill > 600.0:
+                raise RuntimeError(f"reclaimed job never finished: {j}\n"
+                                   + w2.output()[-2000:])
+            time.sleep(0.1)
+        retrain_mttr_s = time.perf_counter() - t_kill
+        assert j.status == "COMPLETED", (j.status, j.failure)
+        out2 = w2.output()
+        resumed_epoch = (int(out2.split("resuming from epoch",
+                                        1)[1].split()[0])
+                         if "resuming from epoch" in out2 else 0)
+        _, health = http_json("GET", f"{base_url}/health")
+        served = health["deployment"]["instanceId"]
+        assert served == j.result["instanceId"] != base_instance
+
+        # -- phase B: quarantine → fresh recommendations ------------------
+        from incubator_predictionio_tpu.streaming.updater import (
+            StreamUpdater,
+            UpdaterConfig,
+            load_base_model,
+        )
+
+        state_dir = os.path.join(tmp, "stream-state")
+        os.makedirs(state_dir, exist_ok=True)
+        guards.quarantine(state_dir, "bench divergence trip", at_seq=0,
+                          base_instance=served)
+        worker = JobWorker(orch, storage,
+                           WorkerConfig(worker_id="bench-inproc",
+                                        lease_sec=120), ctx=ctx)
+        loop = TriggerLoop(orch, storage, TriggerConfig(
+            engine_variant=variant_path, server_url=base_url,
+            stream_state_dir=state_dir))
+        t_q = time.perf_counter()
+        submitted = loop.run_once()
+        assert submitted and submitted[0].trigger == "quarantine"
+        out = worker.run_once()
+        assert out["status"] == "COMPLETED", out
+        model, instance_id, event_names, defaults = load_base_model(
+            variant_path, storage)
+        updater = StreamUpdater(
+            UpdaterConfig(state_dir=state_dir,
+                          feed_path=events_store.log_path(app_id),
+                          replicas=(base_url,), batch_events=4096),
+            model, instance_id, event_names=event_names,
+            default_values=defaults)
+        assert updater.quarantined is None   # marker cleared by new id
+        events_store.insert_batch(live_events(50), app_id)
+        fold = updater.run_once()
+        assert fold["status"] == "applied", fold
+        quarantine_to_fresh_s = time.perf_counter() - t_q
+        _, h2 = http_json("GET", f"{base_url}/health")
+        stream = h2["deployment"]["streaming"]
+        assert stream["lastDeltaSeq"] == fold["toSeq"]
+
+        return {
+            "base_train_s": round(base_train_s, 2),
+            "retrain_mttr_s": round(retrain_mttr_s, 2),
+            "resumed_from_epoch": resumed_epoch,
+            "epochs_total": iterations,
+            "epochs_saved_by_resume": resumed_epoch,
+            "job_fence_at_completion": j.fence,
+            "job_attempts": j.attempt,
+            "quarantine_to_fresh_s": round(quarantine_to_fresh_s, 2),
+            "gate_verdicts": {
+                "killed_job": (j.result.get("gate") or {}).get("verdict"),
+                "quarantine_job": (out["result"].get("gate")
+                                   or {}).get("verdict"),
+            },
+            "pio_jobs_delta": jobs_delta(m_before),
+        }
+    finally:
+        for p in (w1, w2, qs):
+            if p is not None:
+                p.stop()
         use_storage(prev)
         storage.close()
 

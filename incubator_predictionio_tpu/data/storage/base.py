@@ -446,6 +446,65 @@ class EngineInstance:
     serving_params: str = "{}"
 
 
+#: Job lifecycle states (docs/jobs.md). QUEUED and RUNNING are "active";
+#: everything else is terminal. REFUSED is a completed train whose candidate
+#: failed the eval gate — distinct from FAILED so ``pio-tpu jobs list`` and
+#: the gate metrics surface the refusal explicitly.
+JOB_QUEUED = "QUEUED"
+JOB_RUNNING = "RUNNING"
+JOB_COMPLETED = "COMPLETED"
+JOB_FAILED = "FAILED"
+JOB_REFUSED = "REFUSED"
+JOB_CANCELLED = "CANCELLED"
+JOB_ACTIVE_STATUSES = (JOB_QUEUED, JOB_RUNNING)
+JOB_TERMINAL_STATUSES = (JOB_COMPLETED, JOB_FAILED, JOB_REFUSED,
+                         JOB_CANCELLED)
+
+
+@dataclass(frozen=True)
+class JobRecord:
+    """One orchestrated job (train / eval / batchpredict / rollout) in the
+    continuous-training control plane (docs/jobs.md).
+
+    Persisted next to :class:`EngineInstance` through the same metadata-DAO
+    pattern so every METADATA backend inherits the durable queue. Two fields
+    carry the crash-safety contract:
+
+    - ``fence`` — monotonic claim token (the epoch pattern from
+      replication/manager.py): every claim or lease reclaim increments it,
+      and a worker must re-verify its fence before any externally visible
+      side effect (deploy). A SIGKILL'd worker's job is reclaimed under a
+      higher fence; the zombie, if it wakes up, is fenced before it can
+      double-deploy.
+    - ``version`` — optimistic-concurrency token for
+      :meth:`JobsStore.cas`: every state transition is a compare-and-swap
+      on it, so two workers racing for one job cannot both win the claim.
+    """
+    id: str
+    kind: str            # train | eval | batchpredict | rollout
+    status: str          # see JOB_* constants above
+    params: dict[str, Any] = field(default_factory=dict)
+    trigger: str = "manual"   # manual | interval | drift | quarantine | retry
+    #: active-duplicate suppression key ("" = none): submit() returns the
+    #: existing active job instead of queueing a second one for the same key
+    dedupe_key: str = ""
+    attempt: int = 0
+    max_attempts: int = 3
+    submitted_at: Optional[_dt.datetime] = None
+    started_at: Optional[_dt.datetime] = None
+    finished_at: Optional[_dt.datetime] = None
+    lease_owner: str = ""
+    lease_expires_at: Optional[_dt.datetime] = None
+    fence: int = 0
+    version: int = 0
+    result: dict[str, Any] = field(default_factory=dict)
+    failure: str = ""
+
+    @property
+    def active(self) -> bool:
+        return self.status in JOB_ACTIVE_STATUSES
+
+
 @dataclass(frozen=True)
 class EvaluationInstance:
     """One evaluation run's metadata (EvaluationInstances.scala:35-60)."""
@@ -589,6 +648,51 @@ class EngineInstancesStore(abc.ABC):
         return out
 
 
+class JobsStore(abc.ABC):
+    """Durable job queue DAO (docs/jobs.md) — the control plane's only
+    storage dependency, so any METADATA backend can host it.
+
+    The one non-CRUD requirement is :meth:`cas`: state transitions must be
+    atomic compare-and-swap on ``JobRecord.version`` so concurrent workers
+    racing for a claim cannot both win. SQL backends express it as
+    ``UPDATE … WHERE id=? AND version=?``; the remote backend ships it as a
+    single RPC so the server-side store provides the atomicity."""
+
+    @abc.abstractmethod
+    def insert(self, job: JobRecord) -> str:
+        """Insert; empty id → auto-generate. Returns the id."""
+
+    @abc.abstractmethod
+    def get(self, job_id: str) -> Optional[JobRecord]: ...
+
+    @abc.abstractmethod
+    def get_all(self) -> list[JobRecord]: ...
+
+    @abc.abstractmethod
+    def cas(self, job: JobRecord, expected_version: int) -> bool:
+        """Write ``job`` (with ``version = expected_version + 1``) iff the
+        stored record's version is still ``expected_version``. Returns
+        whether the swap happened; False means another writer got there
+        first and the caller must re-read."""
+
+    @abc.abstractmethod
+    def delete(self, job_id: str) -> bool: ...
+
+    # -- derived queries (shared semantics over get_all) ------------------
+    def get_active(self, kind: Optional[str] = None,
+                   dedupe_key: Optional[str] = None) -> list[JobRecord]:
+        """QUEUED/RUNNING jobs, oldest submission first."""
+        out = [
+            j for j in self.get_all()
+            if j.active
+            and (kind is None or j.kind == kind)
+            and (dedupe_key is None or j.dedupe_key == dedupe_key)
+        ]
+        out.sort(key=lambda j: (j.submitted_at or _dt.datetime.min.replace(
+            tzinfo=_dt.timezone.utc), j.id))
+        return out
+
+
 class EvaluationInstancesStore(abc.ABC):
     """(EvaluationInstances.scala:65-100)"""
 
@@ -655,6 +759,9 @@ class StorageClient(abc.ABC):
         raise NotImplementedError(f"{type(self).__name__} does not serve METADATA")
 
     def evaluation_instances(self) -> EvaluationInstancesStore:
+        raise NotImplementedError(f"{type(self).__name__} does not serve METADATA")
+
+    def jobs(self) -> "JobsStore":
         raise NotImplementedError(f"{type(self).__name__} does not serve METADATA")
 
     def events(self) -> EventStore:

@@ -62,6 +62,8 @@ from incubator_predictionio_tpu.data.storage.base import (
     EvaluationInstance,
     EvaluationInstancesStore,
     EventStore,
+    JobRecord,
+    JobsStore,
     Model,
     ModelsStore,
     StorageClient,
@@ -75,8 +77,10 @@ from incubator_predictionio_tpu.resilience.policy import (
 from incubator_predictionio_tpu.data.storage.wire import (
     dec_engine_instance,
     dec_evaluation_instance,
+    dec_job,
     enc_engine_instance,
     enc_evaluation_instance,
+    enc_job,
 )
 
 logger = logging.getLogger(__name__)
@@ -539,6 +543,32 @@ class _ESMetaIndex:
             raise
         return status != 404
 
+    def replace_if(self, doc_id: str, source: dict, field: str,
+                   expected) -> bool:
+        """Conditional replace: swap the document only while
+        ``_source[field] == expected`` — the compare and the swap run inside
+        ONE ``_update`` script execution, so concurrent writers racing the
+        same document serialize in ES (the jobs DAO's claim CAS)."""
+        self._t.ensure(self._index, self._mapping)
+        body = {"script": {
+            "source": ("if (ctx._source[params.f] == params.expected) "
+                       "{ ctx._source = params.src } else { ctx.op = 'noop' }"),
+            "lang": "painless",
+            "params": {"src": source, "f": field, "expected": expected}}}
+        try:
+            # NOT idempotent: a replayed CAS must lose (the version moved).
+            # 409 = ES-level version conflict (two updates racing the same
+            # document): the compare lost — that is the CAS contract's
+            # False, not an error (put() treats 409 the same way).
+            status, out = self._t.call(
+                "POST",
+                f"/{self._index}/_update/{_quote(doc_id)}?refresh=wait_for",
+                body, ok_codes=(200, 201, 404, 409))
+        except StorageError:
+            self._t.forget(self._index)
+            raise
+        return status not in (404, 409) and out.get("result") == "updated"
+
     def get(self, doc_id: str) -> Optional[dict]:
         self._t.ensure(self._index, self._mapping)
         status, out = self._t.call(
@@ -813,6 +843,54 @@ class ESEvaluationInstances(EvaluationInstancesStore):
         return self._idx.delete(instance_id)
 
 
+class ESJobs(JobsStore):
+    """Job-queue DAO over ES: searchable status/kind + top-level ``version``
+    field the conditional-update script compares, full record as the
+    unindexed ``doc`` (the engine-instances layout)."""
+
+    def __init__(self, transport: _Transport, prefix: str):
+        self._idx = _ESMetaIndex(transport, f"{prefix}_jobs", {
+            "id": {"type": "keyword"},
+            "kind": {"type": "keyword"},
+            "status": {"type": "keyword"},
+            "version": {"type": "long"},
+            "submittedMillis": {"type": "long"},
+            "doc": {"type": "object", "enabled": False},
+        }, sort_field="id")
+
+    @staticmethod
+    def _src(j: JobRecord) -> dict:
+        return {
+            "id": j.id,
+            "kind": j.kind,
+            "status": j.status,
+            "version": j.version,
+            "submittedMillis": (_millis(j.submitted_at)
+                                if j.submitted_at else 0),
+            "doc": enc_job(j),
+        }
+
+    def insert(self, job: JobRecord) -> str:
+        job_id = job.id or uuid4().hex
+        self._idx.put(job_id, self._src(dataclasses.replace(job, id=job_id)))
+        return job_id
+
+    def get(self, job_id: str) -> Optional[JobRecord]:
+        src = self._idx.get(job_id)
+        return dec_job(src["doc"]) if src else None
+
+    def get_all(self) -> list[JobRecord]:
+        return [dec_job(s["doc"]) for s in self._idx.search()]
+
+    def cas(self, job: JobRecord, expected_version: int) -> bool:
+        j = dataclasses.replace(job, version=expected_version + 1)
+        return self._idx.replace_if(j.id, self._src(j), "version",
+                                    expected_version)
+
+    def delete(self, job_id: str) -> bool:
+        return self._idx.delete(job_id)
+
+
 class ESModels(ModelsStore):
     """Model blobs as base64 ``binary``-typed documents. The reference has no
     ESModels (models ride jdbc/localfs/hdfs/s3 there); this extension keeps a
@@ -866,6 +944,7 @@ class ESStorageClient(StorageClient):
         self._channels = ESChannels(t, meta, seq)
         self._engine_instances = ESEngineInstances(t, meta)
         self._evaluation_instances = ESEvaluationInstances(t, meta)
+        self._jobs = ESJobs(t, meta)
         self._models = ESModels(t, meta)
 
     def events(self) -> EventStore:
@@ -885,6 +964,9 @@ class ESStorageClient(StorageClient):
 
     def evaluation_instances(self) -> EvaluationInstancesStore:
         return self._evaluation_instances
+
+    def jobs(self) -> JobsStore:
+        return self._jobs
 
     def models(self) -> ModelsStore:
         return self._models

@@ -28,6 +28,8 @@ from incubator_predictionio_tpu.data.storage.base import (
     EvaluationInstance,
     EvaluationInstancesStore,
     EventStore,
+    JobRecord,
+    JobsStore,
     Model,
     ModelsStore,
     StorageClient,
@@ -295,6 +297,38 @@ class MemEvaluationInstances(EvaluationInstancesStore):
             return self._instances.pop(instance_id, None) is not None
 
 
+class MemJobs(JobsStore):
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._jobs: dict[str, JobRecord] = {}
+
+    def insert(self, job: JobRecord) -> str:
+        job_id = job.id or uuid.uuid4().hex
+        with self._lock:
+            from dataclasses import replace
+            self._jobs[job_id] = replace(job, id=job_id)
+        return job_id
+
+    def get(self, job_id: str) -> Optional[JobRecord]:
+        return self._jobs.get(job_id)
+
+    def get_all(self) -> list[JobRecord]:
+        return list(self._jobs.values())
+
+    def cas(self, job: JobRecord, expected_version: int) -> bool:
+        with self._lock:
+            current = self._jobs.get(job.id)
+            if current is None or current.version != expected_version:
+                return False
+            from dataclasses import replace
+            self._jobs[job.id] = replace(job, version=expected_version + 1)
+            return True
+
+    def delete(self, job_id: str) -> bool:
+        with self._lock:
+            return self._jobs.pop(job_id, None) is not None
+
+
 class MemModels(ModelsStore):
     def __init__(self) -> None:
         self._models: dict[str, Model] = {}
@@ -319,6 +353,7 @@ class MemoryStorageClient(StorageClient):
         self._channels = MemChannels()
         self._engine_instances = MemEngineInstances()
         self._evaluation_instances = MemEvaluationInstances()
+        self._jobs = MemJobs()
         self._events = MemEvents()
         self._models = MemModels()
 
@@ -336,6 +371,9 @@ class MemoryStorageClient(StorageClient):
 
     def evaluation_instances(self) -> EvaluationInstancesStore:
         return self._evaluation_instances
+
+    def jobs(self) -> JobsStore:
+        return self._jobs
 
     def events(self) -> EventStore:
         return self._events

@@ -78,6 +78,8 @@ from incubator_predictionio_tpu.data.storage.base import (
     EvaluationInstance,
     EvaluationInstancesStore,
     EventStore,
+    JobRecord,
+    JobsStore,
     Model,
     ModelsStore,
     StorageClient,
@@ -1049,6 +1051,95 @@ class PGEngineInstances(EngineInstancesStore):
         return count > 0
 
 
+_JOB_COLS = (
+    # "job_trigger": TRIGGER is a keyword in some SQL dialects; the column
+    # name is backend-internal so the safe spelling costs nothing
+    "id, kind, status, params, job_trigger, dedupe_key, attempt, "
+    "max_attempts, submitted_at, started_at, finished_at, lease_owner, "
+    "lease_expires_at, fence, version, result, failure"
+)
+
+
+class PGJobs(JobsStore):
+    """Job-queue DAO; the CAS is one conditional UPDATE (``WHERE id AND
+    version``), so racing workers serialize inside PostgreSQL."""
+
+    def __init__(self, conn: _PGConn):
+        self._c = conn
+        conn.query(
+            """CREATE TABLE IF NOT EXISTS pio_jobs (
+                id TEXT PRIMARY KEY, kind TEXT, status TEXT, params TEXT,
+                job_trigger TEXT, dedupe_key TEXT, attempt BIGINT,
+                max_attempts BIGINT, submitted_at BIGINT, started_at BIGINT,
+                finished_at BIGINT, lease_owner TEXT,
+                lease_expires_at BIGINT, fence BIGINT, version BIGINT,
+                result TEXT, failure TEXT
+            )""")
+
+    @staticmethod
+    def _to_row(j: JobRecord) -> tuple:
+        opt = lambda t: _us(t) if t is not None else None  # noqa: E731
+        return (
+            j.id, j.kind, j.status, json.dumps(j.params), j.trigger,
+            j.dedupe_key, j.attempt, j.max_attempts, opt(j.submitted_at),
+            opt(j.started_at), opt(j.finished_at), j.lease_owner,
+            opt(j.lease_expires_at), j.fence, j.version,
+            json.dumps(j.result), j.failure,
+        )
+
+    @staticmethod
+    def _from_row(r: tuple) -> JobRecord:
+        opt = lambda us: _from_us(int(us)) if us is not None else None  # noqa: E731
+        return JobRecord(
+            id=r[0], kind=r[1], status=r[2], params=json.loads(r[3]),
+            trigger=r[4], dedupe_key=r[5], attempt=int(r[6]),
+            max_attempts=int(r[7]), submitted_at=opt(r[8]),
+            started_at=opt(r[9]), finished_at=opt(r[10]), lease_owner=r[11],
+            lease_expires_at=opt(r[12]), fence=int(r[13]),
+            version=int(r[14]), result=json.loads(r[15]), failure=r[16],
+        )
+
+    def insert(self, job: JobRecord) -> str:
+        from dataclasses import replace
+
+        job_id = job.id or uuid.uuid4().hex
+        cols = _JOB_COLS.split(", ")
+        sets = ", ".join(f"{c} = EXCLUDED.{c}" for c in cols[1:])
+        ph = ", ".join(f"${i + 1}" for i in range(len(cols)))
+        self._c.query(
+            f"INSERT INTO pio_jobs ({_JOB_COLS}) VALUES ({ph}) "
+            f"ON CONFLICT (id) DO UPDATE SET {sets}",
+            self._to_row(replace(job, id=job_id)))
+        return job_id
+
+    def get(self, job_id: str) -> Optional[JobRecord]:
+        rows, _ = self._c.query(
+            f"SELECT {_JOB_COLS} FROM pio_jobs WHERE id=$1", (job_id,))
+        return self._from_row(rows[0]) if rows else None
+
+    def get_all(self) -> list[JobRecord]:
+        rows, _ = self._c.query(f"SELECT {_JOB_COLS} FROM pio_jobs")
+        return [self._from_row(r) for r in rows]
+
+    def cas(self, job: JobRecord, expected_version: int) -> bool:
+        from dataclasses import replace
+
+        j = replace(job, version=expected_version + 1)
+        cols = _JOB_COLS.split(", ")[1:]
+        sets = ", ".join(f"{c}=${i + 1}" for i, c in enumerate(cols))
+        n = len(cols)
+        _, count = self._c.query(
+            f"UPDATE pio_jobs SET {sets} "
+            f"WHERE id=${n + 1} AND version=${n + 2}",
+            (*self._to_row(j)[1:], j.id, expected_version))
+        return count > 0
+
+    def delete(self, job_id: str) -> bool:
+        _, count = self._c.query(
+            "DELETE FROM pio_jobs WHERE id=$1", (job_id,))
+        return count > 0
+
+
 _EVI_COLS = (
     "id, status, start_time, end_time, evaluation_class, "
     "engine_params_generator_class, batch, env, evaluator_results, "
@@ -1200,6 +1291,7 @@ class PostgresStorageClient(StorageClient):
         self._channels = PGChannels(self._conn)
         self._engine_instances = PGEngineInstances(self._conn)
         self._evaluation_instances = PGEvaluationInstances(self._conn)
+        self._jobs = PGJobs(self._conn)
         self._events = PGEvents(self._conn)
         self._models = PGModels(self._conn)
 
@@ -1217,6 +1309,9 @@ class PostgresStorageClient(StorageClient):
 
     def evaluation_instances(self) -> EvaluationInstancesStore:
         return self._evaluation_instances
+
+    def jobs(self) -> JobsStore:
+        return self._jobs
 
     def events(self) -> EventStore:
         return self._events

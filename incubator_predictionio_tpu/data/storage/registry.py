@@ -36,6 +36,7 @@ from incubator_predictionio_tpu.data.storage.base import (
     EngineInstancesStore,
     EvaluationInstancesStore,
     EventStore,
+    JobsStore,
     ModelsStore,
     StorageClient,
     StorageError,
@@ -178,6 +179,12 @@ class Storage:
 
     def get_meta_data_evaluation_instances(self) -> EvaluationInstancesStore:
         return self._client_for("METADATA").evaluation_instances()
+
+    def get_meta_data_jobs(self) -> "JobsStore":
+        """The durable job-orchestrator queue (docs/jobs.md) — a metadata
+        DAO like engine instances, so it rides whatever backend serves
+        METADATA."""
+        return self._client_for("METADATA").jobs()
 
     def get_events(self) -> EventStore:
         """The EVENTDATA store (both the L and P read paths of the reference)."""

@@ -60,6 +60,8 @@ from incubator_predictionio_tpu.data.storage.base import (
     EvaluationInstance,
     EvaluationInstancesStore,
     EventStore,
+    JobRecord,
+    JobsStore,
     Model,
     ModelsStore,
     StorageClient,
@@ -79,9 +81,11 @@ from incubator_predictionio_tpu.data.storage.wire import (
     _META_CODECS,
     dec_engine_instance,
     dec_evaluation_instance,
+    dec_job,
     enc_dt,
     enc_engine_instance,
     enc_evaluation_instance,
+    enc_job,
 )
 
 _APP_ENC, _APP_DEC = _META_CODECS[App]
@@ -851,6 +855,32 @@ class RemoteEvaluationInstancesStore(EvaluationInstancesStore):
                              {"id": instance_id})
 
 
+class RemoteJobsStore(JobsStore):
+    """The CAS travels as ONE RPC (record + expected version) so the
+    server-side store provides the claim atomicity — two workers racing
+    through different storage clients still serialize correctly."""
+
+    def __init__(self, tp: _Transport):
+        self._tp = tp
+
+    def insert(self, job: JobRecord) -> str:
+        return self._tp.call("jobs", "insert", {"record": enc_job(job)})
+
+    def get(self, job_id: str) -> Optional[JobRecord]:
+        d = self._tp.call("jobs", "get", {"id": job_id})
+        return None if d is None else dec_job(d)
+
+    def get_all(self) -> list[JobRecord]:
+        return [dec_job(d) for d in self._tp.call("jobs", "get_all", {})]
+
+    def cas(self, job: JobRecord, expected_version: int) -> bool:
+        return self._tp.call("jobs", "cas", {
+            "record": enc_job(job), "expected_version": expected_version})
+
+    def delete(self, job_id: str) -> bool:
+        return self._tp.call("jobs", "delete", {"id": job_id})
+
+
 class RemoteModelsStore(ModelsStore):
     def __init__(self, tp: _Transport):
         self._tp = tp
@@ -912,6 +942,9 @@ class RemoteStorageClient(StorageClient):
 
     def evaluation_instances(self) -> EvaluationInstancesStore:
         return RemoteEvaluationInstancesStore(self._tp)
+
+    def jobs(self) -> JobsStore:
+        return RemoteJobsStore(self._tp)
 
     def events(self) -> EventStore:
         return RemoteEventStore(self._tp)

@@ -43,6 +43,8 @@ from incubator_predictionio_tpu.data.storage.base import (
     EvaluationInstance,
     EvaluationInstancesStore,
     EventStore,
+    JobRecord,
+    JobsStore,
     Model,
     ModelsStore,
     StorageClient,
@@ -719,6 +721,96 @@ class SqliteEvaluationInstances(EvaluationInstancesStore):
         return cur.rowcount > 0
 
 
+_JOB_COLS = (
+    "id, kind, status, params, trigger, dedupe_key, attempt, max_attempts, "
+    "submitted_at, started_at, finished_at, lease_owner, lease_expires_at, "
+    "fence, version, result, failure"
+)
+
+
+class SqliteJobs(JobsStore):
+    """Durable job queue rows; the CAS is one conditional UPDATE, so two
+    workers racing for a claim serialize inside sqlite itself."""
+
+    def __init__(self, db: _Db):
+        self._db = db
+        db.execute(
+            """CREATE TABLE IF NOT EXISTS pio_jobs (
+                id TEXT PRIMARY KEY, kind TEXT, status TEXT, params TEXT,
+                trigger TEXT, dedupe_key TEXT, attempt INTEGER,
+                max_attempts INTEGER, submitted_at INTEGER,
+                started_at INTEGER, finished_at INTEGER, lease_owner TEXT,
+                lease_expires_at INTEGER, fence INTEGER, version INTEGER,
+                result TEXT, failure TEXT
+            )"""
+        )
+
+    @staticmethod
+    def _opt_us(t: Optional[_dt.datetime]) -> Optional[int]:
+        return None if t is None else _us(t)
+
+    @staticmethod
+    def _opt_from_us(us: Optional[int]) -> Optional[_dt.datetime]:
+        return None if us is None else _from_us(us)
+
+    def _to_row(self, j: JobRecord) -> tuple:
+        return (
+            j.id, j.kind, j.status, json.dumps(j.params), j.trigger,
+            j.dedupe_key, j.attempt, j.max_attempts,
+            self._opt_us(j.submitted_at), self._opt_us(j.started_at),
+            self._opt_us(j.finished_at), j.lease_owner,
+            self._opt_us(j.lease_expires_at), j.fence, j.version,
+            json.dumps(j.result), j.failure,
+        )
+
+    def _from_row(self, r: tuple) -> JobRecord:
+        return JobRecord(
+            id=r[0], kind=r[1], status=r[2], params=json.loads(r[3]),
+            trigger=r[4], dedupe_key=r[5], attempt=r[6], max_attempts=r[7],
+            submitted_at=self._opt_from_us(r[8]),
+            started_at=self._opt_from_us(r[9]),
+            finished_at=self._opt_from_us(r[10]),
+            lease_owner=r[11], lease_expires_at=self._opt_from_us(r[12]),
+            fence=r[13], version=r[14], result=json.loads(r[15]),
+            failure=r[16],
+        )
+
+    def insert(self, job: JobRecord) -> str:
+        from dataclasses import replace
+
+        job_id = job.id or uuid.uuid4().hex
+        self._db.execute(
+            f"INSERT OR REPLACE INTO pio_jobs ({_JOB_COLS}) "
+            f"VALUES ({','.join('?' * 17)})",
+            self._to_row(replace(job, id=job_id)),
+        )
+        return job_id
+
+    def get(self, job_id: str) -> Optional[JobRecord]:
+        rows = self._db.query(
+            f"SELECT {_JOB_COLS} FROM pio_jobs WHERE id=?", (job_id,))
+        return self._from_row(rows[0]) if rows else None
+
+    def get_all(self) -> list[JobRecord]:
+        return [self._from_row(r)
+                for r in self._db.query(f"SELECT {_JOB_COLS} FROM pio_jobs")]
+
+    def cas(self, job: JobRecord, expected_version: int) -> bool:
+        from dataclasses import replace
+
+        j = replace(job, version=expected_version + 1)
+        sets = ", ".join(f"{c}=?" for c in _JOB_COLS.split(", ")[1:])
+        cur = self._db.execute(
+            f"UPDATE pio_jobs SET {sets} WHERE id=? AND version=?",
+            (*self._to_row(j)[1:], j.id, expected_version),
+        )
+        return cur.rowcount > 0
+
+    def delete(self, job_id: str) -> bool:
+        cur = self._db.execute("DELETE FROM pio_jobs WHERE id=?", (job_id,))
+        return cur.rowcount > 0
+
+
 class SqliteModels(ModelsStore):
     def __init__(self, db: _Db):
         self._db = db
@@ -760,6 +852,7 @@ class SqliteStorageClient(StorageClient):
         self._channels = SqliteChannels(self._db)
         self._engine_instances = SqliteEngineInstances(self._db)
         self._evaluation_instances = SqliteEvaluationInstances(self._db)
+        self._jobs = SqliteJobs(self._db)
         self._events = SqliteEvents(self._db)
         self._models = SqliteModels(self._db)
 
@@ -777,6 +870,9 @@ class SqliteStorageClient(StorageClient):
 
     def evaluation_instances(self) -> EvaluationInstancesStore:
         return self._evaluation_instances
+
+    def jobs(self) -> JobsStore:
+        return self._jobs
 
     def events(self) -> EventStore:
         return self._events

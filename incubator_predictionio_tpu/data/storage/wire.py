@@ -21,6 +21,7 @@ from incubator_predictionio_tpu.data.storage.base import (
     Channel,
     EngineInstance,
     EvaluationInstance,
+    JobRecord,
 )
 
 
@@ -72,6 +73,24 @@ def dec_engine_instance(d: dict) -> EngineInstance:
     d["start_time"] = dec_dt(d["start_time"])
     d["end_time"] = dec_dt(d["end_time"])
     return EngineInstance(**d)
+
+
+_JOB_DT_FIELDS = ("submitted_at", "started_at", "finished_at",
+                  "lease_expires_at")
+
+
+def enc_job(j: JobRecord) -> dict:
+    d = dataclasses.asdict(j)
+    for k in _JOB_DT_FIELDS:
+        d[k] = enc_dt(getattr(j, k))
+    return d
+
+
+def dec_job(d: dict) -> JobRecord:
+    d = dict(d)
+    for k in _JOB_DT_FIELDS:
+        d[k] = dec_dt(d.get(k))
+    return JobRecord(**d)
 
 
 def enc_evaluation_instance(i: EvaluationInstance) -> dict:

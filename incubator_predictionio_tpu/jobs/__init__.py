@@ -1,0 +1,36 @@
+"""Continuous-training control plane (docs/jobs.md).
+
+A durable job orchestrator that closes the train → eval-gate → deploy →
+stream loop without a human in it: jobs persist through the metadata-DAO
+pattern (every storage backend inherits the queue), workers claim them
+under heartbeat leases with monotonic fence tokens (kill -9 costs one
+epoch via checkpoint resume, a zombie can never double-deploy), triggers
+auto-submit retrains (interval / event drift / stream quarantine), and an
+eval gate refuses regressed candidates before they serve.
+"""
+
+from incubator_predictionio_tpu.jobs.orchestrator import (
+    FencedJobError,
+    Orchestrator,
+)
+from incubator_predictionio_tpu.jobs.triggers import (
+    TriggerConfig,
+    TriggerLoop,
+    quarantine_age_seconds,
+)
+from incubator_predictionio_tpu.jobs.worker import (
+    JobWorker,
+    WorkerConfig,
+    wait_for_job,
+)
+
+__all__ = [
+    "FencedJobError",
+    "JobWorker",
+    "Orchestrator",
+    "TriggerConfig",
+    "TriggerLoop",
+    "WorkerConfig",
+    "quarantine_age_seconds",
+    "wait_for_job",
+]

@@ -1,0 +1,259 @@
+"""Eval-gated promotion: refuse a regressed candidate before it serves.
+
+After a train job completes, the worker scores the **candidate** instance
+and the **incumbent** (currently-deployed) instance on the SAME freshly
+sampled holdout window and refuses promotion when the candidate regresses
+past the configured floor — a poisoned training window (bad labels, a
+corrupted ingest stretch) produces a model that fits the poison and scores
+measurably worse on the recent clean events, and the last-good instance
+keeps serving (acceptance: ``pio_jobs_gate_refused_total`` + the REFUSED
+row in ``pio-tpu jobs list``).
+
+Two scorers:
+
+- **holdout** (default): rating RMSE over the most recent
+  ``PIO_JOBS_GATE_SAMPLE`` events, scored directly against the model's
+  factorization tables (any model exposing ``mf`` + ``user_map`` /
+  ``item_map`` — the RecModel shape every MF template serves). No serving
+  stack required, so the gate runs inside the worker between train and
+  deploy.
+- **eval class** (``PIO_JOBS_GATE_EVAL_CLASS`` or the job's
+  ``evaluation_class`` param): run the engine's own ``Evaluation`` through
+  the normal eval workflow (MetricEvaluator / FastEvalEngine) and compare
+  its primary metric against the incumbent's recorded score from ITS
+  promotion gate. For metrics where larger is better set
+  ``PIO_JOBS_GATE_LARGER_BETTER=1``.
+
+A model no scorer understands passes with ``verdict="unscorable"``
+(counted in ``pio_jobs_gate_skipped_total``) — the gate fails safe toward
+availability, and the chaos/bench lanes pin the refusal path explicitly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+from typing import Any, Optional
+
+import numpy as np
+
+from incubator_predictionio_tpu.jobs import job_metrics as m
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class GateConfig:
+    enabled: bool = True               # PIO_JOBS_GATE
+    sample: int = 512                  # PIO_JOBS_GATE_SAMPLE
+    #: relative regression tolerance: candidate_rmse may exceed
+    #: incumbent_rmse by at most this fraction (plus epsilon)
+    max_regression: float = 0.10       # PIO_JOBS_GATE_MAX_REGRESSION
+    larger_better: bool = False        # PIO_JOBS_GATE_LARGER_BETTER
+    eval_class: str = ""               # PIO_JOBS_GATE_EVAL_CLASS
+
+    @classmethod
+    def from_env(cls) -> "GateConfig":
+        e = os.environ.get
+        return cls(
+            enabled=e("PIO_JOBS_GATE", "1") not in ("0", "off", "false"),
+            sample=int(e("PIO_JOBS_GATE_SAMPLE", "512")),
+            max_regression=float(e("PIO_JOBS_GATE_MAX_REGRESSION", "0.10")),
+            larger_better=e("PIO_JOBS_GATE_LARGER_BETTER", "0")
+            in ("1", "true"),
+            eval_class=e("PIO_JOBS_GATE_EVAL_CLASS", ""),
+        )
+
+
+# -- model loading / scoring -------------------------------------------------
+
+def load_models_for_instance(storage, variant_path: str, instance_id: str,
+                             ctx=None) -> Optional[list]:
+    """The load_deployed_engine path for an EXPLICIT instance id (it only
+    loads the latest COMPLETED): variant → engine factory → model blob →
+    prepare_deploy. Returns None when the instance or its blob is gone."""
+    from incubator_predictionio_tpu.core.controller import (
+        resolve_engine_factory,
+        variant_from_file,
+    )
+    from incubator_predictionio_tpu.parallel.mesh import MeshContext
+    from incubator_predictionio_tpu.utils.serialization import (
+        deserialize_model,
+    )
+
+    instance = storage.get_meta_data_engine_instances().get(instance_id)
+    if instance is None:
+        return None
+    blob = storage.get_model_data_models().get(instance_id)
+    if blob is None:
+        return None
+    variant = variant_from_file(variant_path)
+    engine = resolve_engine_factory(variant["engineFactory"])()
+    engine_params = engine.engine_params_from_variant(variant)
+    ctx = ctx or MeshContext.create()
+    return engine.prepare_deploy(ctx, engine_params,
+                                 deserialize_model(blob.models), instance_id)
+
+
+def holdout_events(storage, variant_path: str, sample: int) -> list:
+    """The most recent ``sample`` signal events of the variant's datasource
+    app — the shared holdout window both sides of the gate score."""
+    from incubator_predictionio_tpu.core.controller import (
+        resolve_engine_factory,
+        variant_from_file,
+    )
+
+    variant = variant_from_file(variant_path)
+    engine = resolve_engine_factory(variant["engineFactory"])()
+    engine_params = engine.engine_params_from_variant(variant)
+    ds = engine_params.data_source_params[1]
+    app_name = getattr(ds, "app_name", None)
+    if app_name is None:
+        return []
+    app = storage.get_meta_data_apps().get_by_name(app_name)
+    if app is None:
+        return []
+    event_names = tuple(getattr(ds, "event_names", ("rate", "buy")))
+    getter = getattr(ds, "rating_defaults", None)
+    defaults = getter() if callable(getter) else {}
+    out = []
+    for e in storage.get_events().find(
+            app.id, entity_type="user", event_names=event_names,
+            limit=sample, reversed=True):
+        if e.target_entity_id is None:
+            continue
+        if e.event in defaults:
+            v = float(defaults[e.event])
+        else:
+            raw = e.properties.get("rating")
+            try:
+                v = float(raw)
+            except (TypeError, ValueError):
+                continue
+        out.append((e.entity_id, e.target_entity_id, v))
+    return out
+
+
+def score_holdout_rmse(models: list, triples: list) -> Optional[float]:
+    """Rating RMSE of an MF model over (user, item, value) triples. Scores
+    only pairs the model knows (both sides vocabulary-resident); returns
+    None when no model is scorable or nothing overlaps."""
+    for model in models:
+        mf = getattr(model, "mf", None)
+        umap = getattr(model, "user_map", None)
+        imap = getattr(model, "item_map", None)
+        if mf is None or umap is None or imap is None:
+            continue
+        mf.ensure_host()
+        ue = np.asarray(mf.user_emb, np.float32)
+        ub = np.asarray(mf.user_bias, np.float32)
+        ie = np.asarray(mf.item_emb, np.float32)
+        ib = np.asarray(mf.item_bias, np.float32)
+        errs = []
+        for user, item, value in triples:
+            ui = umap.get(user)
+            ii = imap.get(item)
+            if ui is None or ii is None:
+                continue
+            pred = float(ue[ui] @ ie[ii] + ub[ui] + ib[ii] + mf.mean)
+            errs.append((pred - value) ** 2)
+        if errs:
+            return float(np.sqrt(np.mean(errs)))
+    return None
+
+
+def run_eval_class(storage, variant_path: str, eval_class: str) -> float:
+    """Run the engine's own Evaluation through the normal eval workflow
+    (MetricEvaluator / FastEvalEngine) and return its primary best score."""
+    import json as _json
+
+    from incubator_predictionio_tpu.core.workflow.create_workflow import (
+        WorkflowConfig,
+        create_workflow,
+    )
+
+    instance_id = create_workflow(WorkflowConfig(
+        engine_variant=variant_path, evaluation_class=eval_class,
+        batch="jobs-gate"), storage)
+    inst = storage.get_meta_data_evaluation_instances().get(instance_id)
+    if inst is None or not inst.evaluator_results_json:
+        raise RuntimeError(f"gate eval {eval_class} produced no results")
+    return float(_json.loads(inst.evaluator_results_json)["bestScore"])
+
+
+# -- the gate ----------------------------------------------------------------
+
+def evaluate(storage, variant_path: str, candidate_id: str,
+             incumbent_id: Optional[str],
+             config: Optional[GateConfig] = None,
+             incumbent_score: Optional[float] = None,
+             ctx=None) -> dict[str, Any]:
+    """Score candidate vs incumbent; returns the verdict dict recorded on
+    the job (``passed`` bool + scores + reason). Promotion order: a missing
+    incumbent always passes (nothing to regress against); an unscorable
+    model passes as ``unscorable``; otherwise the metric must not regress
+    past ``max_regression``."""
+    cfg = config or GateConfig.from_env()
+    if not cfg.enabled:
+        m.GATE_SKIPPED.inc()
+        return {"passed": True, "verdict": "gate_off"}
+    eval_class = cfg.eval_class
+    try:
+        if eval_class:
+            candidate_score = run_eval_class(storage, variant_path,
+                                             eval_class)
+            # the incumbent's score was recorded at ITS promotion; without
+            # one there is nothing to compare against
+            reference = incumbent_score
+            larger_better = cfg.larger_better
+        else:
+            triples = holdout_events(storage, variant_path, cfg.sample)
+            if not triples:
+                m.GATE_SKIPPED.inc()
+                return {"passed": True, "verdict": "no_holdout_events"}
+            cand_models = load_models_for_instance(
+                storage, variant_path, candidate_id, ctx=ctx)
+            if cand_models is None:
+                raise RuntimeError(
+                    f"candidate instance {candidate_id} has no model blob")
+            candidate_score = score_holdout_rmse(cand_models, triples)
+            if candidate_score is None:
+                m.GATE_SKIPPED.inc()
+                return {"passed": True, "verdict": "unscorable"}
+            reference = None
+            larger_better = False
+            if incumbent_id and incumbent_id != candidate_id:
+                inc_models = load_models_for_instance(
+                    storage, variant_path, incumbent_id, ctx=ctx)
+                if inc_models is not None:
+                    reference = score_holdout_rmse(inc_models, triples)
+    except Exception as e:  # noqa: BLE001 — a broken gate must not brick CT
+        logger.exception("jobs gate: scoring failed — passing candidate")
+        m.GATE_SKIPPED.inc()
+        return {"passed": True, "verdict": "gate_error", "error": repr(e)}
+    out = {
+        "candidateScore": candidate_score,
+        "incumbentScore": reference,
+        "metric": eval_class or "holdout_rmse",
+        "sample": cfg.sample if not eval_class else None,
+    }
+    if reference is None:
+        m.GATE_SKIPPED.inc()
+        return {**out, "passed": True, "verdict": "no_incumbent"}
+    if larger_better:
+        floor = reference * (1.0 - cfg.max_regression)
+        regressed = candidate_score < floor - 1e-12
+    else:
+        ceiling = reference * (1.0 + cfg.max_regression)
+        regressed = candidate_score > ceiling + 1e-12
+    if regressed:
+        m.GATE_REFUSED.inc()
+        reason = (f"gate refused: {out['metric']} "
+                  f"{candidate_score:.6g} vs incumbent {reference:.6g} "
+                  f"(max regression {cfg.max_regression:.0%})")
+        logger.warning("jobs: %s", reason)
+        return {**out, "passed": False, "verdict": "refused",
+                "reason": reason}
+    m.GATE_PASSED.inc()
+    return {**out, "passed": True, "verdict": "passed"}

@@ -1,0 +1,48 @@
+"""``pio_jobs_*`` metrics for the continuous-training control plane
+(docs/observability.md). Process-wide counters in the obs registry — the
+orchestrator, worker, triggers, and ``pio-tpu redeploy`` all publish here.
+"""
+
+from __future__ import annotations
+
+from incubator_predictionio_tpu.obs.metrics import REGISTRY
+
+SUBMITTED = REGISTRY.counter(
+    "pio_jobs_submitted_total",
+    "Jobs accepted into the durable queue", labels=("kind", "trigger"))
+DEDUPED = REGISTRY.counter(
+    "pio_jobs_deduped_total",
+    "Submissions answered with an already-active job (dedupe key hit)")
+FINISHED = REGISTRY.counter(
+    "pio_jobs_finished_total",
+    "Jobs reaching a terminal state", labels=("kind", "outcome"))
+ATTEMPT_FAILURES = REGISTRY.counter(
+    "pio_jobs_attempt_failures_total",
+    "Individual job attempts that raised (including retried ones and the "
+    "legacy redeploy loop's train attempts)")
+RECLAIMED = REGISTRY.counter(
+    "pio_jobs_reclaimed_total",
+    "RUNNING jobs re-claimed after their worker's lease expired")
+FENCED = REGISTRY.counter(
+    "pio_jobs_fenced_total",
+    "Zombie-worker actions rejected because the job's fence token moved")
+GATE_PASSED = REGISTRY.counter(
+    "pio_jobs_gate_passed_total",
+    "Candidates the eval gate allowed to promote")
+GATE_REFUSED = REGISTRY.counter(
+    "pio_jobs_gate_refused_total",
+    "Candidates the eval gate refused (metric regressed past the floor; "
+    "the last-good instance keeps serving)")
+GATE_SKIPPED = REGISTRY.counter(
+    "pio_jobs_gate_skipped_total",
+    "Gate evaluations skipped (gate off, no incumbent, or unscorable model)")
+DEPLOYS = REGISTRY.counter(
+    "pio_jobs_deploys_total",
+    "Deploys the worker drove to serving", labels=("mode",))
+TRIGGERS = REGISTRY.counter(
+    "pio_jobs_triggers_total",
+    "Auto-retrain trigger firings", labels=("trigger",))
+QUEUE_DEPTH = REGISTRY.gauge(
+    "pio_jobs_queue_depth", "QUEUED jobs at the last orchestrator scan")
+RUNNING = REGISTRY.gauge(
+    "pio_jobs_running", "RUNNING jobs at the last orchestrator scan")

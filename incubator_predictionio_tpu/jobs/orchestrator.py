@@ -1,0 +1,342 @@
+"""Durable job orchestration over the :class:`JobsStore` DAO.
+
+The control plane's state machine (docs/jobs.md):
+
+    QUEUED ──claim──▶ RUNNING ──complete──▶ COMPLETED
+                         │  ├──refuse────▶ REFUSED   (eval gate)
+                         │  └──fail──┬──▶ FAILED     (attempts exhausted)
+                         │           └──▶ QUEUED     (attempt+1, retryable)
+    QUEUED/RUNNING ──cancel──▶ CANCELLED
+    terminal ──retry──▶ QUEUED (fresh attempt counter)
+
+Every transition is a compare-and-swap on ``JobRecord.version`` — two
+workers racing for one job cannot both win — and every claim (first or
+reclaim) increments the **fence** token, the epoch pattern from
+replication/manager.py: holders of a stale fence are rejected at their
+next heartbeat and, critically, at :meth:`verify_fence` *before* any
+externally visible side effect (the deploy), so a SIGKILL'd worker's
+zombie twin can finish its training compute but can never double-deploy.
+
+Leases are wall-clock (``now_fn`` → epoch seconds, injectable for tests):
+a RUNNING job whose ``lease_expires_at`` has passed is reclaimable by any
+worker. Heartbeats extend the lease; kill -9 simply stops them, and the
+job is reclaimed one lease window later — resuming mid-epoch through the
+trainer's own ``TrainCheckpointer`` state (utils/checkpoint.py), so the
+crash costs one epoch, never a restart from scratch.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import logging
+import time
+from dataclasses import replace
+from typing import Callable, Optional
+
+from incubator_predictionio_tpu.data.storage.base import (
+    JOB_ACTIVE_STATUSES,
+    JOB_CANCELLED,
+    JOB_COMPLETED,
+    JOB_FAILED,
+    JOB_QUEUED,
+    JOB_REFUSED,
+    JOB_RUNNING,
+    JOB_TERMINAL_STATUSES,
+    JobRecord,
+    JobsStore,
+)
+from incubator_predictionio_tpu.jobs import job_metrics as m
+
+logger = logging.getLogger(__name__)
+
+JOB_KINDS = ("train", "eval", "batchpredict", "rollout")
+
+
+class FencedJobError(Exception):
+    """The caller's fence token is stale: the job was reclaimed (or
+    cancelled/finished) under a newer fence. Whatever the caller was doing
+    is now another worker's job — abandon it without writing anything."""
+
+    def __init__(self, job_id: str, held_fence: int, reason: str):
+        super().__init__(
+            f"job {job_id}: fence {held_fence} is stale ({reason})")
+        self.job_id = job_id
+        self.held_fence = held_fence
+
+
+def _utc(ts: float) -> _dt.datetime:
+    return _dt.datetime.fromtimestamp(ts, _dt.timezone.utc)
+
+
+class Orchestrator:
+    """Submit / claim / transition jobs against one JobsStore.
+
+    Stateless between calls (everything durable lives in the store), so any
+    number of orchestrators — CLI submitters, trigger loops, workers on
+    other hosts — cooperate through the same METADATA source.
+    """
+
+    def __init__(self, jobs: JobsStore,
+                 now_fn: Callable[[], float] = time.time):
+        self.jobs = jobs
+        self.now_fn = now_fn
+
+    # -- submission -------------------------------------------------------
+    def submit(self, kind: str, params: Optional[dict] = None,
+               trigger: str = "manual", dedupe_key: str = "",
+               max_attempts: int = 3) -> JobRecord:
+        """Queue a job. With a ``dedupe_key``, an already-active job for the
+        same key is returned instead of queueing a second one — the
+        quarantine/interval triggers re-fire safely while a retrain runs."""
+        if kind not in JOB_KINDS:
+            raise ValueError(f"unknown job kind {kind!r}; one of {JOB_KINDS}")
+        if dedupe_key:
+            active = self.jobs.get_active(dedupe_key=dedupe_key)
+            if active:
+                m.DEDUPED.inc()
+                return active[0]
+        job = JobRecord(
+            id="", kind=kind, status=JOB_QUEUED, params=dict(params or {}),
+            trigger=trigger, dedupe_key=dedupe_key,
+            max_attempts=max(1, max_attempts),
+            submitted_at=_utc(self.now_fn()),
+        )
+        job_id = self.jobs.insert(job)
+        m.SUBMITTED.labels(kind=kind, trigger=trigger).inc()
+        logger.info("jobs: submitted %s job %s (trigger=%s)", kind, job_id,
+                    trigger)
+        return replace(job, id=job_id)
+
+    # -- claiming / leases ------------------------------------------------
+    def claim(self, owner: str, lease_sec: float) -> Optional[JobRecord]:
+        """Claim the oldest QUEUED job, or reclaim a RUNNING job whose lease
+        expired (its worker died). Returns the claimed record (fence already
+        bumped) or None when there is nothing to do.
+
+        A reclaim counts as a new attempt: the dead worker's attempt raised
+        nothing, but its work was lost — when the attempt budget is already
+        exhausted the job fails terminally instead of looping forever."""
+        now = self.now_fn()
+        queued, expired, running = [], [], 0
+        # ONE scan per poll: the depth gauges ride the records this claim
+        # pass already fetched instead of extra get_all round trips
+        for j in self.jobs.get_all():
+            if j.status == JOB_QUEUED:
+                queued.append(j)
+            elif j.status == JOB_RUNNING:
+                running += 1
+                if j.lease_expires_at is not None \
+                        and j.lease_expires_at.timestamp() <= now:
+                    expired.append(j)
+        m.QUEUE_DEPTH.set(len(queued))
+        m.RUNNING.set(running)
+        key = lambda j: (j.submitted_at or _utc(0), j.id)  # noqa: E731
+        for j in sorted(queued, key=key):
+            claimed = self._try_claim(j, owner, lease_sec, reclaim=False)
+            if claimed is not None:
+                return claimed
+        for j in sorted(expired, key=key):
+            claimed = self._try_claim(j, owner, lease_sec, reclaim=True)
+            if claimed is not None:
+                return claimed
+        return None
+
+    def _try_claim(self, j: JobRecord, owner: str, lease_sec: float,
+                   reclaim: bool) -> Optional[JobRecord]:
+        now = self.now_fn()
+        attempt = j.attempt + 1
+        if attempt > j.max_attempts:
+            # a reclaimed job that already burned its attempts fails here
+            # rather than ping-ponging between workers forever
+            dead = replace(
+                j, status=JOB_FAILED, finished_at=_utc(now),
+                lease_owner="", lease_expires_at=None,
+                failure=j.failure or
+                f"lease expired after {j.attempt} attempt(s); "
+                "attempt budget exhausted")
+            if self.jobs.cas(dead, j.version):
+                m.FINISHED.labels(kind=j.kind, outcome="failed").inc()
+                logger.warning("jobs: %s failed terminally (%s)", j.id,
+                               dead.failure)
+            return None
+        claimed = replace(
+            j, status=JOB_RUNNING, attempt=attempt, lease_owner=owner,
+            lease_expires_at=_utc(now + lease_sec), fence=j.fence + 1,
+            started_at=j.started_at or _utc(now),
+        )
+        if not self.jobs.cas(claimed, j.version):
+            return None  # another worker got it first
+        if reclaim:
+            m.RECLAIMED.inc()
+            logger.warning(
+                "jobs: reclaimed %s from %s (lease expired) — fence %d -> %d,"
+                " attempt %d/%d", j.id, j.lease_owner or "?", j.fence,
+                claimed.fence, attempt, j.max_attempts)
+        return replace(claimed, version=j.version + 1)
+
+    def heartbeat(self, job: JobRecord, lease_sec: float) -> JobRecord:
+        """Extend the caller's lease. Raises :class:`FencedJobError` when the
+        job moved under the caller (reclaimed, cancelled, finished)."""
+        return self._cas_retrying(job, lambda current: replace(
+            current, lease_expires_at=_utc(self.now_fn() + lease_sec)))
+
+    def _cas_retrying(self, job: JobRecord, mutate) -> JobRecord:
+        """Apply ``mutate(current) -> new record`` under CAS, re-reading on
+        a version race. A worker's OWN heartbeat thread legitimately bumps
+        the version while the main thread records a failure/refusal — that
+        race must re-read and retry, not masquerade as a fence loss (which
+        would leave the job RUNNING until the lease expires and burn an
+        attempt). A REAL fence loss surfaces from ``_verify`` on re-read."""
+        while True:
+            current = self._verify(job)
+            new = mutate(current)
+            if self.jobs.cas(new, current.version):
+                return replace(new, version=current.version + 1)
+
+    def verify_fence(self, job: JobRecord) -> JobRecord:
+        """The pre-side-effect check: re-read the job and confirm the caller
+        still holds the current fence — run this immediately before any
+        externally visible action (the deploy). A zombie worker that lost
+        its lease fails HERE, before it can double-deploy."""
+        return self._verify(job)
+
+    def _verify(self, job: JobRecord) -> JobRecord:
+        current = self.jobs.get(job.id)
+        if current is None:
+            raise self._fenced(job, "job deleted")
+        if current.status != JOB_RUNNING:
+            raise self._fenced(job, f"status is {current.status}")
+        if current.fence != job.fence:
+            raise self._fenced(
+                job, f"fence moved to {current.fence} "
+                     f"(owner {current.lease_owner or '?'})")
+        return current
+
+    def _fenced(self, job: JobRecord, reason: str) -> FencedJobError:
+        m.FENCED.inc()
+        return FencedJobError(job.id, job.fence, reason)
+
+    # -- terminal transitions --------------------------------------------
+    def complete(self, job: JobRecord, result: Optional[dict] = None
+                 ) -> JobRecord:
+        return self._finish(job, JOB_COMPLETED, result=result)
+
+    def refuse(self, job: JobRecord, reason: str,
+               result: Optional[dict] = None) -> JobRecord:
+        """Eval-gate refusal: the train run completed but its candidate must
+        not serve. Terminal and distinct from FAILED (``pio-tpu jobs list``
+        and pio_jobs_gate_refused_total surface it)."""
+        return self._finish(job, JOB_REFUSED, result=result, failure=reason)
+
+    def fail(self, job: JobRecord, failure: str) -> JobRecord:
+        """One attempt failed. Requeues while the attempt budget lasts
+        (the worker claims it again after ``claim()``), else FAILED."""
+        m.ATTEMPT_FAILURES.inc()
+        current = self._verify(job)
+        if current.attempt < current.max_attempts:
+            requeued = self._cas_retrying(job, lambda c: replace(
+                c, status=JOB_QUEUED, lease_owner="",
+                lease_expires_at=None, failure=failure))
+            logger.warning("jobs: %s attempt %d/%d failed (%s) — requeued",
+                           job.id, current.attempt, current.max_attempts,
+                           failure.splitlines()[0] if failure else "")
+            return requeued
+        return self._finish(job, JOB_FAILED, failure=failure)
+
+    def _finish(self, job: JobRecord, status: str,
+                result: Optional[dict] = None, failure: str = "") -> JobRecord:
+        done = self._cas_retrying(job, lambda current: replace(
+            current, status=status, finished_at=_utc(self.now_fn()),
+            lease_owner="", lease_expires_at=None,
+            result={**current.result, **(result or {})},
+            failure="" if status == JOB_COMPLETED
+            else (failure or current.failure),
+        ))
+        m.FINISHED.labels(kind=job.kind, outcome=status.lower()).inc()
+        logger.info("jobs: %s -> %s", job.id, status)
+        return done
+
+    # -- operator verbs ---------------------------------------------------
+    def cancel(self, job_id: str) -> Optional[JobRecord]:
+        """QUEUED/RUNNING → CANCELLED. A running worker is not interrupted
+        mid-compute; its next heartbeat / fence check rejects it, so the
+        cancellation wins before any deploy."""
+        j = self.jobs.get(job_id)
+        if j is None or j.status not in JOB_ACTIVE_STATUSES:
+            return None
+        cancelled = replace(
+            j, status=JOB_CANCELLED, finished_at=_utc(self.now_fn()),
+            lease_owner="", lease_expires_at=None, fence=j.fence + 1)
+        if not self.jobs.cas(cancelled, j.version):
+            return self.cancel(job_id)  # racing transition; re-read once
+        m.FINISHED.labels(kind=j.kind, outcome="cancelled").inc()
+        return replace(cancelled, version=j.version + 1)
+
+    def retry(self, job_id: str) -> Optional[JobRecord]:
+        """Terminal → QUEUED with a fresh attempt budget (trigger noted)."""
+        j = self.jobs.get(job_id)
+        if j is None or j.status not in JOB_TERMINAL_STATUSES:
+            return None
+        requeued = replace(
+            j, status=JOB_QUEUED, attempt=0, trigger="retry",
+            lease_owner="", lease_expires_at=None, finished_at=None,
+            submitted_at=_utc(self.now_fn()))
+        if not self.jobs.cas(requeued, j.version):
+            return None
+        m.SUBMITTED.labels(kind=j.kind, trigger="retry").inc()
+        return replace(requeued, version=j.version + 1)
+
+    # -- introspection ----------------------------------------------------
+    def summarize(self) -> dict:
+        """Per-kind queue counts + lease ages + last failure — the
+        ``pio-tpu status`` jobs section and /health building block."""
+        now = self.now_fn()
+        kinds: dict[str, dict] = {}
+        last_failure = None
+        for j in self.jobs.get_all():
+            k = kinds.setdefault(j.kind, {
+                "queued": 0, "running": 0, "completed": 0, "failed": 0,
+                "refused": 0, "cancelled": 0, "oldestLeaseAgeSec": None})
+            k[j.status.lower()] = k.get(j.status.lower(), 0) + 1
+            if j.status == JOB_RUNNING and j.lease_expires_at is not None:
+                # lease AGE = how long since the last heartbeat landed
+                # (negative margin means the lease already expired)
+                margin = j.lease_expires_at.timestamp() - now
+                age = k["oldestLeaseAgeSec"]
+                k["oldestLeaseAgeSec"] = (
+                    margin if age is None else min(age, margin))
+            if j.failure and j.finished_at is not None and (
+                    last_failure is None
+                    or j.finished_at > last_failure["finishedAt"]):
+                last_failure = {"id": j.id, "kind": j.kind,
+                                "status": j.status,
+                                "failure": j.failure.splitlines()[0],
+                                "finishedAt": j.finished_at}
+        return {"kinds": kinds, "lastFailure": last_failure}
+
+    def prune(self, keep_terminal: int = 200,
+              max_age_sec: Optional[float] = None) -> int:
+        """Delete old terminal jobs so the queue scans (claim, summarize,
+        ``jobs list``) stay bounded as the interval/drift triggers produce
+        history for weeks. Keeps the newest ``keep_terminal`` terminal jobs
+        (and everything active); with ``max_age_sec`` additionally drops any
+        terminal job older than that. Returns the number deleted."""
+        now = self.now_fn()
+        terminal = [j for j in self.jobs.get_all()
+                    if j.status in JOB_TERMINAL_STATUSES]
+        terminal.sort(key=lambda j: ((j.finished_at or j.submitted_at
+                                      or _utc(0)).timestamp()), reverse=True)
+        doomed = terminal[max(0, keep_terminal):]
+        if max_age_sec is not None:
+            cutoff = now - max_age_sec
+            doomed = list({j.id: j for j in doomed + [
+                j for j in terminal
+                if (j.finished_at or j.submitted_at
+                    or _utc(0)).timestamp() < cutoff]}.values())
+        n = 0
+        for j in doomed:
+            if self.jobs.delete(j.id):
+                n += 1
+        if n:
+            logger.info("jobs: pruned %d terminal job(s)", n)
+        return n

@@ -1,0 +1,264 @@
+"""Auto-retrain triggers: interval, event-drift, and stream quarantine.
+
+The trigger loop closes the control loop no human watches (docs/jobs.md):
+
+- **interval** — the cron the reference delegated to an external
+  crontab + ``spark-submit`` (and ``pio-tpu redeploy`` ran as a bare
+  in-process sleep loop): submit a train job every
+  ``PIO_JOBS_INTERVAL`` seconds.
+- **drift** — events ingested since the last COMPLETED train instance
+  exceed ``PIO_JOBS_DRIFT_EVENTS``: the model is provably stale relative
+  to the data, retrain now rather than at the next interval tick.
+- **quarantine** — the streaming divergence guard tripped
+  (streaming/guard.py): its durable marker says "full retrain required",
+  and before this subsystem existed nothing ever launched that retrain —
+  a quarantined fleet stayed stale until a human noticed. The trigger
+  auto-submits the retrain; the new instance id clears the marker when
+  the stream updater restarts against it, and the delta stream resumes.
+
+All three funnel through ``Orchestrator.submit`` with a per-variant
+dedupe key, so overlapping firings (interval tick while a drift retrain
+runs) coalesce onto the one active job.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import logging
+import os
+import time
+from typing import Callable, Optional
+
+from incubator_predictionio_tpu.data.storage.base import JobRecord
+from incubator_predictionio_tpu.jobs import job_metrics as m
+from incubator_predictionio_tpu.jobs.orchestrator import Orchestrator
+from incubator_predictionio_tpu.resilience.clock import SYSTEM_CLOCK, Clock
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class TriggerConfig:
+    engine_variant: str = "engine.json"
+    #: deploy targets forwarded onto submitted train jobs
+    server_url: Optional[str] = None
+    replicas: tuple[str, ...] = ()
+    server_access_key: Optional[str] = None
+    interval_sec: float = 0.0          # PIO_JOBS_INTERVAL; 0 disables
+    drift_events: int = 0              # PIO_JOBS_DRIFT_EVENTS; 0 disables
+    app_name: Optional[str] = None     # drift counting (default: datasource)
+    #: streaming state dir watched for the quarantine marker; "" disables
+    stream_state_dir: str = ""
+    poll_sec: float = 5.0
+    max_attempts: int = 3
+
+    @classmethod
+    def from_env(cls, **overrides) -> "TriggerConfig":
+        e = os.environ.get
+        base = cls(
+            interval_sec=float(e("PIO_JOBS_INTERVAL", "0")),
+            drift_events=int(e("PIO_JOBS_DRIFT_EVENTS", "0")),
+            stream_state_dir=e("PIO_JOBS_STREAM_STATE_DIR", ""),
+        )
+        return dataclasses.replace(base, **overrides)
+
+
+class TriggerLoop:
+    """Evaluates the three trigger conditions; ``run_once`` is pure enough
+    for FakeClock tests (time and quarantine reads injectable)."""
+
+    def __init__(self, orchestrator: Orchestrator, storage,
+                 config: TriggerConfig, clock: Clock = SYSTEM_CLOCK,
+                 now_fn: Callable[[], float] = time.time):
+        self.orchestrator = orchestrator
+        self.storage = storage
+        self.config = config
+        self.clock = clock
+        self.now_fn = now_fn
+        self._app_id: Optional[int] = None
+
+    # -- helpers ----------------------------------------------------------
+    def _dedupe_key(self) -> str:
+        return f"train:{os.path.abspath(self.config.engine_variant)}"
+
+    def _train_params(self) -> dict:
+        p: dict = {"engine_variant": self.config.engine_variant}
+        if self.config.server_url:
+            p["server_url"] = self.config.server_url
+        if self.config.replicas:
+            p["replicas"] = list(self.config.replicas)
+        if self.config.server_access_key:
+            p["server_access_key"] = self.config.server_access_key
+        return p
+
+    def _submit(self, trigger: str) -> JobRecord:
+        # count a FIRING only when this call actually queued a new job —
+        # a dedupe hit (the retrain is already queued/running) coalesces
+        # and must not re-increment every poll round
+        fresh = not self.orchestrator.jobs.get_active(
+            dedupe_key=self._dedupe_key())
+        job = self.orchestrator.submit(
+            "train", params=self._train_params(), trigger=trigger,
+            dedupe_key=self._dedupe_key(),
+            max_attempts=self.config.max_attempts)
+        if fresh:
+            m.TRIGGERS.labels(trigger=trigger).inc()
+        return job
+
+    def _retrained_since(self, marker: dict) -> bool:
+        """True when a train job for this variant reached ANY terminal
+        state after the quarantine marker was written. The marker itself is
+        cleared only by a restarted stream updater seeing the new instance
+        id — if that updater is down (a likely correlated failure), the
+        marker lingers and an unsuppressed trigger would storm full
+        retrains forever. One retrain per marker is the contract — and
+        that includes REFUSED (the gate said this data must not promote:
+        re-firing would re-refuse the same data back to back), FAILED
+        (the attempt budget is spent; ``jobs retry`` is the operator verb),
+        and CANCELLED (the operator said stop). The lingering marker stays
+        visible on ``pio-tpu health --stream-state-dir`` instead."""
+        from incubator_predictionio_tpu.data.storage.base import (
+            JOB_TERMINAL_STATUSES,
+        )
+
+        at = marker.get("quarantinedAt")
+        if not isinstance(at, (int, float)):
+            return False
+        key = self._dedupe_key()
+        for j in self.orchestrator.jobs.get_all():
+            if (j.kind == "train" and j.dedupe_key == key
+                    and j.status in JOB_TERMINAL_STATUSES
+                    and j.finished_at is not None
+                    and j.finished_at.timestamp() >= float(at)):
+                return True
+        return False
+
+    def _latest_train(self) -> tuple[Optional[float], Optional[float]]:
+        """(last submission ts, last COMPLETED train start ts) for this
+        variant — interval measures from the former (don't double-submit
+        while one runs was already handled by dedupe; don't re-fire right
+        after a manual run), drift from the latter (staleness is relative
+        to the data the MODEL saw)."""
+        key = self._dedupe_key()
+        last_submit = None
+        for j in self.orchestrator.jobs.get_all():
+            if j.kind == "train" and j.dedupe_key == key \
+                    and j.submitted_at is not None:
+                ts = j.submitted_at.timestamp()
+                last_submit = ts if last_submit is None else max(
+                    last_submit, ts)
+        last_trained = None
+        try:
+            from incubator_predictionio_tpu.core.controller import (
+                variant_from_file,
+            )
+
+            v = variant_from_file(self.config.engine_variant)
+            latest = (self.storage.get_meta_data_engine_instances()
+                      .get_latest_completed(
+                          v.get("id", "default"), v.get("version", "1"),
+                          os.path.abspath(self.config.engine_variant)))
+            if latest is not None:
+                last_trained = latest.start_time.timestamp()
+        except Exception:  # noqa: BLE001 — variant unreadable ⇒ no drift ref
+            pass
+        return last_submit, last_trained
+
+    def _resolve_app_id(self) -> Optional[int]:
+        if self._app_id is not None:
+            return self._app_id
+        name = self.config.app_name
+        if name is None:
+            try:
+                from incubator_predictionio_tpu.core.controller import (
+                    resolve_engine_factory,
+                    variant_from_file,
+                )
+
+                v = variant_from_file(self.config.engine_variant)
+                engine = resolve_engine_factory(v["engineFactory"])()
+                ds = engine.engine_params_from_variant(
+                    v).data_source_params[1]
+                name = getattr(ds, "app_name", None)
+            except Exception:  # noqa: BLE001
+                return None
+        if name is None:
+            return None
+        app = self.storage.get_meta_data_apps().get_by_name(name)
+        if app is None:
+            return None
+        self._app_id = app.id
+        return app.id
+
+    def _events_since(self, since_ts: float, cap: int) -> int:
+        """Events newer than ``since_ts``, counted lazily up to ``cap`` —
+        the drift check never scans past its own threshold."""
+        import datetime as _dt
+
+        app_id = self._resolve_app_id()
+        if app_id is None:
+            return 0
+        start = _dt.datetime.fromtimestamp(since_ts, _dt.timezone.utc)
+        it = self.storage.get_events().find(
+            app_id, start_time=start, limit=cap)
+        return sum(1 for _ in itertools.islice(it, cap))
+
+    # -- the loop ---------------------------------------------------------
+    def run_once(self) -> list[JobRecord]:
+        """Evaluate every enabled trigger; returns jobs submitted (or the
+        deduped active job a firing coalesced onto)."""
+        out: list[JobRecord] = []
+        cfg = self.config
+        # quarantine first: it is the hard-down condition
+        if cfg.stream_state_dir:
+            from incubator_predictionio_tpu.streaming import guard as guards
+
+            q = guards.read_quarantine(cfg.stream_state_dir)
+            if q is not None and not self._retrained_since(q):
+                logger.warning(
+                    "jobs: stream quarantined (%s at seq %s) — submitting "
+                    "full retrain", q.get("reason"), q.get("atSeq"))
+                out.append(self._submit("quarantine"))
+        last_submit, last_trained = self._latest_train()
+        if cfg.drift_events > 0 and last_trained is not None:
+            n = self._events_since(last_trained, cfg.drift_events)
+            if n >= cfg.drift_events:
+                logger.info("jobs: drift trigger — ≥%d events since the "
+                            "last trained instance", n)
+                out.append(self._submit("drift"))
+        if cfg.interval_sec > 0:
+            now = self.now_fn()
+            if last_submit is None or now - last_submit >= cfg.interval_sec:
+                out.append(self._submit("interval"))
+        return out
+
+    def run_forever(self, max_rounds: Optional[int] = None) -> None:
+        rounds = 0
+        while True:
+            try:
+                self.run_once()
+            except Exception:  # noqa: BLE001 — the loop must survive
+                logger.exception("jobs: trigger round failed")
+            rounds += 1
+            if max_rounds is not None and rounds >= max_rounds:
+                return
+            self.clock.sleep(self.config.poll_sec)
+
+
+def quarantine_age_seconds(state_dir: str,
+                           now_fn: Callable[[], float] = time.time
+                           ) -> Optional[float]:
+    """Age of the stream quarantine marker, or None when not quarantined —
+    the ``pio-tpu health`` stuck-control-loop probe: a marker older than
+    the retrain trigger interval means the loop that should have cleared
+    it is not running."""
+    from incubator_predictionio_tpu.streaming import guard as guards
+
+    q = guards.read_quarantine(state_dir)
+    if q is None:
+        return None
+    at = q.get("quarantinedAt")
+    if not isinstance(at, (int, float)):
+        return float("inf")
+    return max(0.0, now_fn() - float(at))

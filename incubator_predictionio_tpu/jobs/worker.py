@@ -1,0 +1,461 @@
+"""The job worker: claim → execute → gate → deploy, under a heartbeat lease.
+
+One worker process (``pio-tpu jobs worker``) drains the durable queue:
+
+- **train** — the full continuous-training pass: ``create_workflow`` train
+  (mid-epoch crash-safe through the trainer's own ``TrainCheckpointer``
+  when the variant sets ``checkpoint_dir``/``checkpoint_every``), then the
+  eval gate (jobs/gate.py) against the currently-deployed incumbent, then
+  — only on a gate pass AND a fresh fence check — the deploy: the single
+  server's ``POST /reload`` smoke gate, or the fleet ``rollout.py``
+  halt-and-rollback orchestrator when the job names multiple replicas.
+- **eval** — the engine's Evaluation through the normal eval workflow.
+- **batchpredict** — core/workflow/batch_predict.py.
+- **rollout** — fleet rolling deploy of the already-trained latest
+  instance (no training).
+
+Crash safety: the heartbeat thread extends the lease while the job runs;
+SIGKILL stops it and the orchestrator reclaims the job one lease window
+later — the reclaiming worker's train call resumes from the checkpoint
+(kill -9 costs one epoch, never a restart from scratch). The dead
+worker's zombie twin — a process that was merely wedged, not dead — is
+**fenced**: ``verify_fence`` re-reads the job immediately before the
+deploy, and a stale fence abandons the work without writing anything, so
+exactly ONE deploy ever reaches serving.
+
+``PIO_JOBS_FAULT=kill:<point>`` (``after_train``, ``after_gate``,
+``before_deploy``) SIGKILLs the worker at the named point — the chaos
+suite drives the reclaim/fence proofs through a real process boundary.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import signal
+import threading
+import time
+import traceback
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Optional
+
+from incubator_predictionio_tpu.data.storage.base import JobRecord
+from incubator_predictionio_tpu.jobs import gate as gates
+from incubator_predictionio_tpu.jobs import job_metrics as m
+from incubator_predictionio_tpu.jobs.orchestrator import (
+    FencedJobError,
+    Orchestrator,
+)
+from incubator_predictionio_tpu.resilience.clock import SYSTEM_CLOCK, Clock
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class WorkerConfig:
+    worker_id: str = ""                # default: host:pid
+    lease_sec: float = 60.0            # PIO_JOBS_LEASE_SEC
+    heartbeat_sec: float = 0.0         # PIO_JOBS_HEARTBEAT_SEC (0 = lease/3)
+    poll_sec: float = 1.0              # PIO_JOBS_POLL_SEC
+    reload_timeout_sec: float = 120.0  # per /reload (load+warm+smoke)
+
+    @classmethod
+    def from_env(cls) -> "WorkerConfig":
+        e = os.environ.get
+        return cls(
+            lease_sec=float(e("PIO_JOBS_LEASE_SEC", "60")),
+            heartbeat_sec=float(e("PIO_JOBS_HEARTBEAT_SEC", "0")),
+            poll_sec=float(e("PIO_JOBS_POLL_SEC", "1")),
+        )
+
+    def effective_heartbeat(self) -> float:
+        return self.heartbeat_sec or max(0.5, self.lease_sec / 3.0)
+
+
+def _default_worker_id() -> str:
+    import socket
+
+    return f"{socket.gethostname()}:{os.getpid()}"
+
+
+class _Heartbeat:
+    """Background lease extender. A FencedJobError latches ``lost`` — the
+    executing worker checks it (and re-verifies the fence) before any
+    side effect, then abandons silently: the job is someone else's now."""
+
+    def __init__(self, orchestrator: Orchestrator, job: JobRecord,
+                 config: WorkerConfig, clock: Clock):
+        self._orch = orchestrator
+        self.job = job
+        self._config = config
+        self._clock = clock
+        self.lost: Optional[FencedJobError] = None
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"jobs-heartbeat-{job.id[:8]}")
+
+    def __enter__(self) -> "_Heartbeat":
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+
+    def _run(self) -> None:
+        interval = self._config.effective_heartbeat()
+        while not self._stop.wait(interval):
+            try:
+                self.job = self._orch.heartbeat(self.job,
+                                                self._config.lease_sec)
+            except FencedJobError as e:
+                self.lost = e
+                logger.warning("jobs: heartbeat lost — %s", e)
+                return
+            except Exception:  # noqa: BLE001 — transient store outage:
+                # keep beating; the lease only dies if this outlasts it
+                logger.warning("jobs: heartbeat write failed (transient?)",
+                               exc_info=True)
+
+
+class JobWorker:
+    """Claims and executes jobs against one storage config."""
+
+    def __init__(self, orchestrator: Orchestrator, storage,
+                 config: Optional[WorkerConfig] = None,
+                 clock: Clock = SYSTEM_CLOCK, ctx=None):
+        self.orchestrator = orchestrator
+        self.storage = storage
+        self.config = config or WorkerConfig.from_env()
+        if not self.config.worker_id:
+            self.config = dataclasses.replace(
+                self.config, worker_id=_default_worker_id())
+        self.clock = clock
+        self.ctx = ctx
+
+    # -- loop -------------------------------------------------------------
+    def run_once(self) -> Optional[dict]:
+        """Claim and fully execute one job; None when the queue is idle."""
+        job = self.orchestrator.claim(self.config.worker_id,
+                                      self.config.lease_sec)
+        if job is None:
+            return None
+        logger.info("jobs: worker %s claimed %s job %s (attempt %d/%d, "
+                    "fence %d)", self.config.worker_id, job.kind, job.id,
+                    job.attempt, job.max_attempts, job.fence)
+        with _Heartbeat(self.orchestrator, job, self.config,
+                        self.clock) as hb:
+            try:
+                result = self._execute(hb)
+            except FencedJobError as e:
+                # someone else owns the job now — abandon without writing
+                logger.warning("jobs: abandoning %s — %s", job.id, e)
+                return {"id": job.id, "status": "fenced", "reason": str(e)}
+            except _GateRefused as e:
+                try:
+                    done = self.orchestrator.refuse(hb.job, e.reason,
+                                                    result=e.result)
+                except FencedJobError as fe:
+                    return {"id": job.id, "status": "fenced",
+                            "reason": str(fe)}
+                return {"id": job.id, "status": done.status,
+                        "result": done.result, "failure": done.failure}
+            except Exception:  # noqa: BLE001 — the attempt failed; the
+                # orchestrator decides between requeue and terminal FAILED
+                failure = traceback.format_exc()
+                logger.exception("jobs: %s job %s attempt %d failed",
+                                 job.kind, job.id, job.attempt)
+                try:
+                    done = self.orchestrator.fail(hb.job, failure)
+                except FencedJobError as e:
+                    return {"id": job.id, "status": "fenced",
+                            "reason": str(e)}
+                return {"id": job.id, "status": done.status,
+                        "failure": done.failure.splitlines()[-1]
+                        if done.failure else ""}
+        try:
+            done = self.orchestrator.complete(hb.job, result=result)
+        except FencedJobError as e:
+            # the fence moved after our last check and before the terminal
+            # write: the work already done stays done (train artifacts are
+            # idempotent), but the job belongs to the reclaiming worker
+            logger.warning("jobs: completion fenced for %s — %s", job.id, e)
+            return {"id": job.id, "status": "fenced", "reason": str(e)}
+        return {"id": job.id, "status": done.status, "result": done.result}
+
+    def run_forever(self, max_jobs: Optional[int] = None) -> int:
+        """Poll-claim-execute until stopped; returns jobs executed. A
+        transient metadata-store error during a poll (storage-server
+        restart, network blip) must not kill the daemon that IS the
+        control plane — log, back off one poll, keep going."""
+        n = 0
+        while True:
+            try:
+                out = self.run_once()
+            except Exception:  # noqa: BLE001 — the loop must survive
+                logger.exception("jobs: worker poll failed (transient?)")
+                self.clock.sleep(self.config.poll_sec)
+                continue
+            if out is None:
+                self.clock.sleep(self.config.poll_sec)
+                continue
+            n += 1
+            logger.info("jobs: %s", out)
+            if max_jobs is not None and n >= max_jobs:
+                return n
+
+    # -- execution --------------------------------------------------------
+    def _execute(self, hb: _Heartbeat) -> dict:
+        job = hb.job
+        runner = {
+            "train": self._run_train,
+            "eval": self._run_eval,
+            "batchpredict": self._run_batchpredict,
+            "rollout": self._run_rollout,
+        }.get(job.kind)
+        if runner is None:
+            raise ValueError(f"unknown job kind {job.kind!r}")
+        return runner(hb)
+
+    def _maybe_fault(self, point: str) -> None:
+        if os.environ.get("PIO_JOBS_FAULT") == f"kill:{point}":
+            logger.error("PIO_JOBS_FAULT tripping at %s — SIGKILL", point)
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    def _run_train(self, hb: _Heartbeat) -> dict:
+        from incubator_predictionio_tpu.core.workflow.create_workflow import (
+            WorkflowConfig,
+            create_workflow,
+        )
+
+        p = hb.job.params
+        variant = p.get("engine_variant", "engine.json")
+        # the incumbent is resolved BEFORE training: after create_workflow
+        # the candidate itself is the latest COMPLETED instance
+        incumbent = self._incumbent_instance(p, variant)
+        instance_id = create_workflow(WorkflowConfig(
+            engine_variant=variant,
+            batch=p.get("batch") or f"jobs:{hb.job.trigger}",
+            mesh_axes=p.get("mesh_axes"),
+        ), self.storage)
+        self._maybe_fault("after_train")
+        result: dict[str, Any] = {"instanceId": instance_id,
+                                  "incumbentId": incumbent}
+        # -- eval gate ----------------------------------------------------
+        gate_cfg = None
+        if p.get("gate") in ("off", False, "0"):
+            gate_cfg = gates.GateConfig(enabled=False)
+        elif any(k in p for k in ("gate_sample", "gate_max_regression",
+                                  "evaluation_class")):
+            base = gates.GateConfig.from_env()
+            gate_cfg = dataclasses.replace(
+                base,
+                sample=int(p.get("gate_sample", base.sample)),
+                max_regression=float(p.get("gate_max_regression",
+                                           base.max_regression)),
+                # a train job carrying evaluation_class gates on the
+                # engine's own Evaluation instead of the holdout RMSE
+                eval_class=p.get("evaluation_class", base.eval_class))
+        # the stored-reference scan is eval-class-only: the holdout gate
+        # re-scores both sides itself and never reads incumbent_score
+        eval_class = (gate_cfg.eval_class if gate_cfg is not None
+                      else gates.GateConfig.from_env().eval_class)
+        verdict = gates.evaluate(
+            self.storage, variant, instance_id, incumbent,
+            config=gate_cfg,
+            incumbent_score=(self._incumbent_gate_score(variant, eval_class)
+                             if eval_class else None),
+            ctx=self.ctx)
+        result["gate"] = verdict
+        self._maybe_fault("after_gate")
+        if not verdict.get("passed", True):
+            raise _GateRefused(verdict.get("reason", "gate refused"), result)
+        # -- deploy (fence-checked) ---------------------------------------
+        result["deploy"] = self._deploy(hb, p)
+        return result
+
+    def _incumbent_instance(self, params: dict,
+                            variant: str) -> Optional[str]:
+        """What the gate compares against: the serving fleet's live
+        instance (its /health names it) or, without a reachable server,
+        the latest COMPLETED instance of the same variant."""
+        for url in self._deploy_targets(params):
+            try:
+                with urllib.request.urlopen(f"{url}/health",
+                                            timeout=5.0) as resp:
+                    h = json.loads(resp.read())
+                iid = (h.get("deployment") or {}).get("instanceId")
+                if iid:
+                    return iid
+            except (urllib.error.URLError, OSError, ValueError):
+                continue
+        try:
+            from incubator_predictionio_tpu.core.controller import (
+                variant_from_file,
+            )
+
+            v = variant_from_file(variant)
+            latest = (self.storage.get_meta_data_engine_instances()
+                      .get_latest_completed(v.get("id", "default"),
+                                            v.get("version", "1"),
+                                            os.path.abspath(variant)))
+            return latest.id if latest is not None else None
+        except Exception:  # noqa: BLE001 — no incumbent is a valid state
+            return None
+
+    def _incumbent_gate_score(self, variant: str,
+                              metric: str) -> Optional[float]:
+        """The eval-class gate compares against the score recorded when the
+        incumbent itself was promoted (the holdout gate re-scores both
+        sides instead). Only scores produced by the SAME metric count — a
+        stored holdout-RMSE must never become the floor for a
+        precision-style eval class (that would brick every promotion)."""
+        best = None
+        for j in self.orchestrator.jobs.get_all():
+            if (j.kind == "train" and j.status == "COMPLETED"
+                    and j.params.get("engine_variant",
+                                     "engine.json") == variant
+                    and isinstance(j.result.get("gate"), dict)
+                    and j.result["gate"].get("metric") == metric
+                    and j.result["gate"].get("candidateScore") is not None):
+                if best is None or (j.finished_at or j.submitted_at) > (
+                        best.finished_at or best.submitted_at):
+                    best = j
+        if best is None:
+            return None
+        return best.result["gate"]["candidateScore"]
+
+    @staticmethod
+    def _deploy_targets(params: dict) -> list[str]:
+        urls = list(params.get("replicas") or ())
+        if params.get("server_url"):
+            urls.insert(0, params["server_url"])
+        return [u.rstrip("/") for u in urls]
+
+    def _deploy(self, hb: _Heartbeat, params: dict) -> dict:
+        """Drive the promotion to serving — the job's one externally
+        visible side effect, so the fence is re-verified IMMEDIATELY
+        before it (the zombie-worker guarantee)."""
+        targets = self._deploy_targets(params)
+        if not targets:
+            return {"mode": "none"}
+        if hb.lost is not None:
+            raise hb.lost
+        hb.job = self.orchestrator.verify_fence(hb.job)
+        self._maybe_fault("before_deploy")
+        key = params.get("server_access_key")
+        if len(targets) == 1:
+            body = self._reload(targets[0], key)
+            m.DEPLOYS.labels(mode="reload").inc()
+            return {"mode": "reload", "url": targets[0],
+                    "engineInstanceId": body.get("engineInstanceId")}
+        from incubator_predictionio_tpu.fleet.rollout import (
+            RolloutConfig,
+            run_rollout,
+        )
+
+        rollout = run_rollout(RolloutConfig(
+            replicas=tuple(targets), server_access_key=key,
+            timeout_sec=self.config.reload_timeout_sec))
+        if not rollout.ok:
+            raise RuntimeError(
+                f"fleet rollout halted at {rollout.halted_at}: "
+                f"{rollout.reason}")
+        m.DEPLOYS.labels(mode="rollout").inc()
+        return {"mode": "rollout", "updated": rollout.updated,
+                "events": rollout.events}
+
+    def _reload(self, url: str, key: Optional[str]) -> dict:
+        """POST /reload — the single-server smoke-gated hot swap. A 409
+        means the smoke gate rejected the new instance (it never served):
+        that surfaces as a failed attempt, not a silent pass."""
+        full = f"{url}/reload"
+        if key:
+            full += "?" + urllib.parse.urlencode({"accessKey": key})
+        req = urllib.request.Request(full, method="POST")
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=self.config.reload_timeout_sec) as resp:
+                return json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            raise RuntimeError(
+                f"reload {url} answered {e.code}: "
+                f"{e.read().decode(errors='replace')[:500]}") from e
+        except OSError as e:
+            raise RuntimeError(f"reload {url} unreachable: {e}") from e
+
+    def _run_eval(self, hb: _Heartbeat) -> dict:
+        from incubator_predictionio_tpu.core.workflow.create_workflow import (
+            WorkflowConfig,
+            create_workflow,
+        )
+
+        p = hb.job.params
+        if not p.get("evaluation_class"):
+            raise ValueError("eval job needs params.evaluation_class")
+        instance_id = create_workflow(WorkflowConfig(
+            engine_variant=p.get("engine_variant", "engine.json"),
+            evaluation_class=p["evaluation_class"],
+            engine_params_generator_class=p.get(
+                "engine_params_generator_class"),
+            batch=p.get("batch") or f"jobs:{hb.job.trigger}",
+        ), self.storage)
+        inst = (self.storage.get_meta_data_evaluation_instances()
+                .get(instance_id))
+        return {"evaluationInstanceId": instance_id,
+                "results": inst.evaluator_results if inst else ""}
+
+    def _run_batchpredict(self, hb: _Heartbeat) -> dict:
+        from incubator_predictionio_tpu.core.workflow.batch_predict import (
+            BatchPredictConfig,
+            run_batch_predict,
+        )
+
+        p = hb.job.params
+        n = run_batch_predict(BatchPredictConfig(
+            engine_variant=p.get("engine_variant", "engine.json"),
+            input_path=p.get("input", "batchpredict-input.json"),
+            output_path=p.get("output", "batchpredict-output.json"),
+            query_chunk=int(p.get("query_partitions") or 1024),
+        ), self.storage)
+        return {"predictions": n, "output": p.get(
+            "output", "batchpredict-output.json")}
+
+    def _run_rollout(self, hb: _Heartbeat) -> dict:
+        targets = self._deploy_targets(hb.job.params)
+        if not targets:
+            raise ValueError("rollout job needs params.replicas")
+        return self._deploy(hb, hb.job.params)
+
+
+class _GateRefused(Exception):
+    """Internal control flow: the candidate trained fine but must not
+    serve — mapped to the REFUSED terminal state."""
+
+    def __init__(self, reason: str, result: dict):
+        super().__init__(reason)
+        self.reason = reason
+        self.result = result
+
+
+def wait_for_job(orchestrator: Orchestrator, job_id: str,
+                 timeout: float = 3600.0, poll: float = 0.5,
+                 clock: Clock = SYSTEM_CLOCK) -> JobRecord:
+    """Block until a job reaches a terminal state (``jobs watch`` / the
+    redeploy wrapper). Raises TimeoutError with the live record attached."""
+    deadline = clock.monotonic() + timeout
+    while True:
+        j = orchestrator.jobs.get(job_id)
+        if j is None:
+            raise KeyError(f"job {job_id} not found")
+        if not j.active:
+            return j
+        if clock.monotonic() >= deadline:
+            raise TimeoutError(f"job {job_id} still {j.status} after "
+                               f"{timeout:.0f}s")
+        clock.sleep(poll)

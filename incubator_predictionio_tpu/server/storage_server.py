@@ -105,9 +105,11 @@ from incubator_predictionio_tpu.data.storage.wire import (  # noqa: E402
     dec_engine_instance,
     dec_evaluation_instance,
     dec_opt_filter,
+    dec_job,
     enc_dt,
     enc_engine_instance,
     enc_evaluation_instance,
+    enc_job,
 )
 
 
@@ -841,3 +843,18 @@ _RPC[("evaluation_instances", "update")] = lambda s, a: (
         dec_evaluation_instance(a["record"])))
 _RPC[("evaluation_instances", "delete")] = lambda s, a: (
     s.get_meta_data_evaluation_instances().delete(a["id"]))
+
+# jobs (docs/jobs.md): the durable orchestrator queue. ``cas`` is the one
+# non-CRUD verb — record + expected version in ONE call, so the server-side
+# store's compare-and-swap is the claim-atomicity point for remote workers.
+_RPC[("jobs", "insert")] = lambda s, a: (
+    s.get_meta_data_jobs().insert(dec_job(a["record"])))
+_RPC[("jobs", "get")] = lambda s, a: (
+    (lambda r: None if r is None else enc_job(r))
+    (s.get_meta_data_jobs().get(a["id"])))
+_RPC[("jobs", "get_all")] = lambda s, a: [
+    enc_job(r) for r in s.get_meta_data_jobs().get_all()]
+_RPC[("jobs", "cas")] = lambda s, a: (
+    s.get_meta_data_jobs().cas(dec_job(a["record"]),
+                               int(a["expected_version"])))
+_RPC[("jobs", "delete")] = lambda s, a: s.get_meta_data_jobs().delete(a["id"])

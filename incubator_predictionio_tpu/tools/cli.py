@@ -35,6 +35,7 @@ import json
 import logging
 import os
 import sys
+from dataclasses import replace as dataclasses_replace
 from typing import Optional
 
 import incubator_predictionio_tpu as piotpu
@@ -463,10 +464,15 @@ def cmd_stop_all(args, storage: Storage) -> int:
 
 
 def cmd_redeploy(args, storage: Storage) -> int:
-    from incubator_predictionio_tpu.tools.ops import RedeployConfig, redeploy
+    from incubator_predictionio_tpu.tools.ops import (
+        RedeployConfig,
+        redeploy,
+        redeploy_via_jobs,
+    )
 
     server_url = None if args.no_reload else f"http://{args.ip}:{args.port}"
-    instance_id = redeploy(RedeployConfig(
+    runner = redeploy if args.legacy else redeploy_via_jobs
+    instance_id = runner(RedeployConfig(
         engine_variant=args.engine_variant,
         batch=args.batch,
         retries=args.retries,
@@ -649,8 +655,44 @@ def cmd_status(args, storage: Storage) -> int:
         _err("Unable to connect to all storage backends successfully.")
         return 1
     _out("Storage: all repositories verified (METADATA/EVENTDATA/MODELDATA).")
+    _print_jobs_status(storage)
     _out("Your system is all ready to go.")
     return 0
+
+
+def _print_jobs_status(storage: Storage) -> None:
+    """The continuous-training section of ``pio-tpu status``: per-kind
+    queue counts, tightest remaining lease, last failure (docs/jobs.md).
+    Tolerant of backends without a jobs DAO (third-party METADATA)."""
+    try:
+        from incubator_predictionio_tpu.jobs import Orchestrator
+
+        summary = Orchestrator(storage.get_meta_data_jobs()).summarize()
+    except NotImplementedError:
+        _out("Jobs: METADATA backend has no jobs DAO (control plane off).")
+        return
+    except Exception as e:  # noqa: BLE001 — status must not crash on this
+        _err(f"Jobs: unreadable ({e})")
+        return
+    kinds = summary["kinds"]
+    if not kinds:
+        _out("Jobs: none submitted (docs/jobs.md — `pio-tpu jobs submit`).")
+        return
+    _out("Jobs:")
+    for kind in sorted(kinds):
+        k = kinds[kind]
+        line = (f"  {kind}: queued {k.get('queued', 0)}, running "
+                f"{k.get('running', 0)}, completed {k.get('completed', 0)}, "
+                f"failed {k.get('failed', 0)}, refused {k.get('refused', 0)}")
+        margin = k.get("oldestLeaseAgeSec")
+        if margin is not None:
+            line += (f", lease margin {margin:+.0f}s"
+                     + (" [EXPIRED — reclaim pending]" if margin < 0 else ""))
+        _out(line)
+    lf = summary["lastFailure"]
+    if lf:
+        _out(f"  last failure: {lf['kind']} {lf['id'][:12]} "
+             f"[{lf['status']}] {lf['failure']}")
 
 
 def cmd_version(args, storage) -> int:
@@ -895,6 +937,9 @@ def cmd_health(args, storage) -> int:
         args.urls, args.timeout,
         fetch=lambda url, timeout: _fetch_health(url, timeout))
     rows = [_health_row(url, *probed[url]) for url in args.urls]
+    if getattr(args, "stream_state_dir", None):
+        rows.append(_quarantine_row(args.stream_state_dir,
+                                    args.quarantine_max_age))
     if args.json:
         _out(json.dumps(rows, indent=2))
     else:
@@ -906,6 +951,29 @@ def cmd_health(args, storage) -> int:
                 line += f"  [{r['detail']}]"
             _out(line)
     return 1 if any(r["red"] for r in rows) else 0
+
+
+def _quarantine_row(state_dir: str, max_age: Optional[float]) -> dict:
+    """The stuck-control-loop probe (docs/jobs.md): a stream quarantine
+    marker older than the retrain trigger interval means the auto-retrain
+    loop that should have cleared it is not running — red. A younger
+    marker is the control loop mid-recovery — reported, not red."""
+    from incubator_predictionio_tpu.jobs import quarantine_age_seconds
+
+    age = quarantine_age_seconds(state_dir)
+    url = f"stream:{state_dir}"
+    if age is None:
+        return {"url": url, "status": "ok", "red": False,
+                "detail": "no quarantine marker"}
+    if max_age is None:
+        max_age = float(os.environ.get("PIO_JOBS_INTERVAL", "0")) or 300.0
+    stuck = age > max_age
+    detail = (f"QUARANTINED {age:.0f}s"
+              + (f" > trigger interval {max_age:.0f}s — control loop "
+                 "stuck (is `pio-tpu jobs triggers` + a worker running?)"
+                 if stuck else f" (retrain due within {max_age:.0f}s)"))
+    return {"url": url, "status": "quarantined", "red": stuck,
+            "detail": detail}
 
 
 def format_index_stats(models) -> list[str]:
@@ -1323,6 +1391,206 @@ def cmd_fleet_experiment(args, storage) -> int:
 
 
 # ---------------------------------------------------------------------------
+# jobs: continuous-training control plane (docs/jobs.md)
+# ---------------------------------------------------------------------------
+
+def _job_orchestrator(storage: Storage):
+    from incubator_predictionio_tpu.jobs import Orchestrator
+
+    return Orchestrator(storage.get_meta_data_jobs())
+
+
+def _job_params_from_args(args) -> dict:
+    params: dict = {"engine_variant": args.engine_variant}
+    if getattr(args, "batch", None):
+        params["batch"] = args.batch
+    if getattr(args, "server_url", None):
+        params["server_url"] = args.server_url
+    if getattr(args, "replica", None):
+        params["replicas"] = list(args.replica)
+    if getattr(args, "server_access_key", None):
+        params["server_access_key"] = args.server_access_key
+    if getattr(args, "mesh_axes", None):
+        params["mesh_axes"] = json.loads(args.mesh_axes)
+    if getattr(args, "evaluation_class", None):
+        params["evaluation_class"] = args.evaluation_class
+    if getattr(args, "no_gate", False):
+        params["gate"] = "off"
+    if getattr(args, "params", None):
+        params.update(json.loads(args.params))
+    return params
+
+
+def cmd_jobs_submit(args, storage: Storage) -> int:
+    orch = _job_orchestrator(storage)
+    job = orch.submit(
+        args.kind, params=_job_params_from_args(args), trigger="manual",
+        dedupe_key=(f"train:{os.path.abspath(args.engine_variant)}"
+                    if args.kind == "train" and not args.no_dedupe else ""),
+        max_attempts=args.max_attempts)
+    _out(f"Submitted {job.kind} job {job.id} (status {job.status}, "
+         f"attempt {job.attempt}/{job.max_attempts}).")
+    _out("Run `pio-tpu jobs worker` somewhere to execute it; "
+         f"`pio-tpu jobs watch {job.id}` follows it.")
+    return 0
+
+
+def _job_row(j, now: float) -> dict:
+    lease = None
+    if j.status == "RUNNING" and j.lease_expires_at is not None:
+        lease = round(j.lease_expires_at.timestamp() - now, 1)
+    summary = ""
+    if j.status == "COMPLETED":
+        summary = j.result.get("instanceId") or ""
+        gate = j.result.get("gate") or {}
+        if gate.get("verdict"):
+            summary += f" gate={gate['verdict']}"
+    elif j.failure:
+        summary = j.failure.splitlines()[-1][:80]
+    return {"id": j.id, "kind": j.kind, "status": j.status,
+            "trigger": j.trigger, "attempt": f"{j.attempt}/{j.max_attempts}",
+            "fence": j.fence, "leaseSecLeft": lease,
+            "owner": j.lease_owner or "",
+            "submittedAt": j.submitted_at.isoformat()
+            if j.submitted_at else None,
+            "summary": summary}
+
+
+def cmd_jobs_list(args, storage: Storage) -> int:
+    import time as _time
+
+    orch = _job_orchestrator(storage)
+    jobs = sorted(orch.jobs.get_all(),
+                  key=lambda j: (j.submitted_at.timestamp()
+                                 if j.submitted_at else 0.0, j.id))
+    if not args.all:
+        # active + the most recent terminal few — the operator's default view
+        terminal = [j for j in jobs if not j.active][-10:]
+        jobs = [j for j in jobs if j.active] + terminal
+        jobs.sort(key=lambda j: (j.submitted_at.timestamp()
+                                 if j.submitted_at else 0.0, j.id))
+    rows = [_job_row(j, _time.time()) for j in jobs]
+    if args.json:
+        _out(json.dumps(rows, indent=2))
+        return 0
+    if not rows:
+        _out("No jobs.")
+        return 0
+    _out(f"{'ID':<12} {'KIND':<12} {'STATUS':<10} {'TRIGGER':<10} "
+         f"{'ATT':<5} {'LEASE':<8} SUMMARY")
+    for r in rows:
+        lease = ("-" if r["leaseSecLeft"] is None
+                 else f"{r['leaseSecLeft']:+.0f}s")
+        _out(f"{r['id'][:12]:<12} {r['kind']:<12} {r['status']:<10} "
+             f"{r['trigger']:<10} {r['attempt']:<5} {lease:<8} "
+             f"{r['summary']}")
+    return 0
+
+
+def cmd_jobs_watch(args, storage: Storage) -> int:
+    from incubator_predictionio_tpu.jobs import wait_for_job
+
+    orch = _job_orchestrator(storage)
+    try:
+        j = wait_for_job(orch, args.id, timeout=args.timeout,
+                         poll=args.poll)
+    except KeyError:
+        _err(f"No job {args.id}.")
+        return 1
+    except TimeoutError as e:
+        _err(str(e))
+        return 1
+    _out(json.dumps(_job_row(j, __import__("time").time()), indent=2))
+    if j.status == "COMPLETED":
+        return 0
+    if j.failure:
+        _err(j.failure.splitlines()[-1])
+    return 1
+
+
+def cmd_jobs_cancel(args, storage: Storage) -> int:
+    j = _job_orchestrator(storage).cancel(args.id)
+    if j is None:
+        _err(f"Job {args.id} is not active (or does not exist).")
+        return 1
+    _out(f"Cancelled job {j.id} (a running worker is fenced off at its "
+         "next heartbeat; no deploy can land).")
+    return 0
+
+
+def cmd_jobs_retry(args, storage: Storage) -> int:
+    j = _job_orchestrator(storage).retry(args.id)
+    if j is None:
+        _err(f"Job {args.id} is not terminal (or does not exist).")
+        return 1
+    _out(f"Requeued job {j.id} with a fresh attempt budget.")
+    return 0
+
+
+def cmd_jobs_prune(args, storage: Storage) -> int:
+    n = _job_orchestrator(storage).prune(
+        keep_terminal=args.keep,
+        max_age_sec=args.older_than)
+    _out(f"Pruned {n} terminal job(s).")
+    return 0
+
+
+def cmd_jobs_worker(args, storage: Storage) -> int:
+    from incubator_predictionio_tpu.jobs import JobWorker, WorkerConfig
+
+    cfg = WorkerConfig.from_env()
+    if args.lease is not None:
+        cfg = dataclasses_replace(cfg, lease_sec=args.lease)
+    if args.poll is not None:
+        cfg = dataclasses_replace(cfg, poll_sec=args.poll)
+    worker = JobWorker(_job_orchestrator(storage), storage, cfg)
+    _out(f"jobs worker {worker.config.worker_id} polling "
+         f"(lease {worker.config.lease_sec:.0f}s).")
+    if args.once:
+        out = worker.run_once()
+        if out is None:
+            _out("Queue idle.")
+            return 0
+        _out(json.dumps(out, default=str))
+        return 0 if out.get("status") in ("COMPLETED",) else 1
+    worker.run_forever(max_jobs=args.max_jobs)
+    return 0
+
+
+def cmd_jobs_triggers(args, storage: Storage) -> int:  # noqa: C901
+    from incubator_predictionio_tpu.jobs import TriggerConfig, TriggerLoop
+
+    overrides: dict = {
+        "engine_variant": args.engine_variant,
+        "server_url": args.server_url,
+        "replicas": tuple(args.replica or ()),
+        "server_access_key": args.server_access_key,
+        "poll_sec": args.poll,
+    }
+    if args.interval is not None:
+        overrides["interval_sec"] = args.interval
+    if args.drift_events is not None:
+        overrides["drift_events"] = args.drift_events
+    if args.state_dir:
+        overrides["stream_state_dir"] = args.state_dir
+    if args.app:
+        overrides["app_name"] = args.app
+    loop = TriggerLoop(_job_orchestrator(storage), storage,
+                       TriggerConfig.from_env(**overrides))
+    if args.once:
+        jobs = loop.run_once()
+        _out(json.dumps([{"id": j.id, "trigger": j.trigger,
+                          "status": j.status} for j in jobs]))
+        return 0
+    _out("jobs trigger loop running "
+         f"(interval={loop.config.interval_sec or 'off'} "
+         f"drift={loop.config.drift_events or 'off'} "
+         f"quarantine={'on' if loop.config.stream_state_dir else 'off'}).")
+    loop.run_forever()
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # store: replicated-storage admin (docs/replication.md)
 # ---------------------------------------------------------------------------
 
@@ -1672,6 +1940,89 @@ def build_parser() -> argparse.ArgumentParser:
                         "majority of the replica set holds it; "
                         "PIO_REPL_SYNC env)")
 
+    # jobs — continuous-training control plane (docs/jobs.md)
+    jobs = sub.add_parser(
+        "jobs",
+        help="continuous-training control plane: submit/list/watch/cancel/"
+             "retry durable jobs, run the lease-fenced worker, run the "
+             "auto-retrain trigger loop (docs/jobs.md)")
+    jb = jobs.add_subparsers(dest="jobs_command")
+    p = jb.add_parser("submit")
+    p.add_argument("--kind", default="train",
+                   choices=("train", "eval", "batchpredict", "rollout"))
+    p.add_argument("-v", "--engine-variant", default="engine.json")
+    p.add_argument("--batch", default="")
+    p.add_argument("--server-url",
+                   help="query server whose /reload promotes a passing "
+                        "candidate (single-server deploy)")
+    p.add_argument("--replica", action="append",
+                   help="fleet replica base URL (repeatable; 2+ drive the "
+                        "halt-and-rollback rollout orchestrator)")
+    p.add_argument("--server-access-key")
+    p.add_argument("--mesh-axes", help='JSON, e.g. \'{"data": 4}\'')
+    p.add_argument("--evaluation-class",
+                   help="for --kind eval: the Evaluation to run")
+    p.add_argument("--no-gate", action="store_true",
+                   help="skip the eval gate for this job "
+                        "(PIO_JOBS_GATE=0 equivalent)")
+    p.add_argument("--no-dedupe", action="store_true",
+                   help="queue even if an identical train job is active")
+    p.add_argument("--max-attempts", type=int, default=3)
+    p.add_argument("--params", help="extra params JSON merged into the job")
+    p = jb.add_parser("list")
+    p.add_argument("--all", action="store_true",
+                   help="include every terminal job (default: active + "
+                        "the 10 most recent terminal)")
+    p.add_argument("--json", action="store_true")
+    p = jb.add_parser("watch")
+    p.add_argument("id")
+    p.add_argument("--timeout", type=float, default=3600.0)
+    p.add_argument("--poll", type=float, default=0.5)
+    p = jb.add_parser("cancel")
+    p.add_argument("id")
+    p = jb.add_parser("retry")
+    p.add_argument("id")
+    p = jb.add_parser("prune")
+    p.add_argument("--keep", type=int, default=200,
+                   help="terminal jobs to keep (newest first; active jobs "
+                        "are never pruned)")
+    p.add_argument("--older-than", type=float,
+                   help="also drop terminal jobs older than this many "
+                        "seconds")
+    p = jb.add_parser("worker")
+    p.add_argument("--once", action="store_true",
+                   help="claim and execute at most one job, then exit")
+    p.add_argument("--max-jobs", type=int,
+                   help="exit after executing this many jobs")
+    p.add_argument("--lease", type=float,
+                   help="lease seconds (PIO_JOBS_LEASE_SEC env, default 60);"
+                        " a worker dead this long has its job reclaimed")
+    p.add_argument("--poll", type=float,
+                   help="idle poll seconds (PIO_JOBS_POLL_SEC env)")
+    p = jb.add_parser("triggers")
+    p.add_argument("-v", "--engine-variant", default="engine.json")
+    p.add_argument("--interval", type=float,
+                   help="seconds between interval-trigger retrains "
+                        "(PIO_JOBS_INTERVAL env; 0 disables)")
+    p.add_argument("--drift-events", type=int,
+                   help="retrain once this many events land after the last "
+                        "trained instance (PIO_JOBS_DRIFT_EVENTS env; "
+                        "0 disables)")
+    p.add_argument("--state-dir",
+                   help="streaming state dir to watch for the quarantine "
+                        "marker (a trip auto-submits a full retrain)")
+    p.add_argument("--app", help="app whose events feed the drift counter "
+                                 "(default: the variant's datasource app)")
+    p.add_argument("--server-url",
+                   help="forwarded onto submitted train jobs as the deploy "
+                        "target")
+    p.add_argument("--replica", action="append")
+    p.add_argument("--server-access-key")
+    p.add_argument("--poll", type=float, default=5.0,
+                   help="seconds between trigger evaluations")
+    p.add_argument("--once", action="store_true",
+                   help="evaluate every trigger once and exit")
+
     # store — replicated-storage admin (docs/replication.md)
     store = sub.add_parser(
         "store",
@@ -1743,6 +2094,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--interval", type=float,
                    help="seconds between passes; omit to run once")
     p.add_argument("--mesh-axes", help='JSON, e.g. \'{"data": 4, "model": 2}\'')
+    p.add_argument("--legacy", action="store_true",
+                   help="run the old in-process train+reload loop instead "
+                        "of submitting through the durable job "
+                        "orchestrator (docs/jobs.md)")
 
     # shell (bin/pio-shell counterpart)
     p = sub.add_parser(
@@ -1795,6 +2150,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="per-probe timeout in seconds (default 5)")
     p.add_argument("--json", action="store_true",
                    help="machine-readable row output")
+    p.add_argument("--stream-state-dir",
+                   help="also probe this streaming state dir's quarantine "
+                        "marker: red when older than --quarantine-max-age "
+                        "(stuck control loop — docs/jobs.md)")
+    p.add_argument("--quarantine-max-age", type=float,
+                   help="seconds a quarantine marker may age before the "
+                        "row turns red (default: PIO_JOBS_INTERVAL, "
+                        "else 300)")
 
     # fleet — router / rolling deploy / experiment (docs/serving.md)
     fleet = sub.add_parser(
@@ -2032,6 +2395,17 @@ _STORE_COMMANDS = {
     "scrub": cmd_store_scrub,
 }
 
+_JOBS_COMMANDS = {
+    "submit": cmd_jobs_submit,
+    "list": cmd_jobs_list,
+    "watch": cmd_jobs_watch,
+    "cancel": cmd_jobs_cancel,
+    "retry": cmd_jobs_retry,
+    "prune": cmd_jobs_prune,
+    "worker": cmd_jobs_worker,
+    "triggers": cmd_jobs_triggers,
+}
+
 
 def main(argv: Optional[list[str]] = None) -> int:
     parser = build_parser()
@@ -2074,6 +2448,12 @@ def main(argv: Optional[list[str]] = None) -> int:
             _err("store: missing subcommand (status|promote|scrub)")
             return 1
         return _STORE_COMMANDS[args.store_command](args, storage)
+    if args.command == "jobs":
+        if not args.jobs_command:
+            _err("jobs: missing subcommand (submit|list|watch|cancel|"
+                 "retry|prune|worker|triggers)")
+            return 1
+        return _JOBS_COMMANDS[args.jobs_command](args, storage)
     if args.command == "template":
         if not args.template_command:
             # parse_args(["template", "--help"]) would SystemExit(0); a

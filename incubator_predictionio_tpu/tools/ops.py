@@ -247,8 +247,18 @@ def redeploy_once(config: RedeployConfig, storage=None) -> Optional[str]:
                 storage,
             )
             break
-        except Exception as e:  # noqa: BLE001 — retry loop must survive anything
-            logger.warning("train attempt %d/%d failed: %s", attempt, config.retries, e)
+        except Exception:  # noqa: BLE001 — retry loop must survive anything
+            # full traceback, not just str(e): a silently-swallowed train
+            # failure is how a cron redeploy rots unnoticed for weeks —
+            # and the attempt lands in pio_jobs_attempt_failures_total
+            # next to the orchestrated workers' failures
+            from incubator_predictionio_tpu.jobs.job_metrics import (
+                ATTEMPT_FAILURES,
+            )
+
+            ATTEMPT_FAILURES.inc()
+            logger.exception("train attempt %d/%d failed", attempt,
+                             config.retries)
             if attempt < config.retries:
                 time.sleep(config.retry_wait_secs)
     if instance_id is None:
@@ -274,7 +284,10 @@ def redeploy_once(config: RedeployConfig, storage=None) -> Optional[str]:
 
 
 def redeploy(config: RedeployConfig, storage=None) -> Optional[str]:
-    """Run the redeploy pass once, or forever at ``interval_secs``."""
+    """The LEGACY in-process loop (``pio-tpu redeploy --legacy``): run the
+    redeploy pass once, or forever at ``interval_secs``. The default
+    ``pio-tpu redeploy`` path is :func:`redeploy_via_jobs` — the same
+    outcome through the durable orchestrator (docs/jobs.md)."""
     if config.interval_secs is None:
         return redeploy_once(config, storage)
     last = None
@@ -282,3 +295,72 @@ def redeploy(config: RedeployConfig, storage=None) -> Optional[str]:
         last = redeploy_once(config, storage)
         time.sleep(config.interval_secs)
     return last  # pragma: no cover — loop exits only by signal
+
+
+def redeploy_via_jobs(config: RedeployConfig, storage=None) -> Optional[str]:
+    """``pio-tpu redeploy`` as a thin wrapper over the control plane: submit
+    a train job (interval-triggered when ``interval_secs`` is set) and run
+    an in-process worker to execute it — same train→gate→/reload outcome as
+    the legacy loop, but crash-safe (durable queue, checkpoint-resumed
+    retries, eval-gated promotion) and visible in ``pio-tpu jobs list``.
+
+    One-shot mode returns the new instance id (None if the job failed or
+    the gate refused the candidate). Interval mode runs the trigger loop +
+    worker forever, exactly like the old cron-in-process."""
+    from incubator_predictionio_tpu.data.storage import get_storage
+    from incubator_predictionio_tpu.jobs import (
+        JobWorker,
+        Orchestrator,
+        TriggerConfig,
+        TriggerLoop,
+        WorkerConfig,
+    )
+
+    storage = storage or get_storage()
+    orch = Orchestrator(storage.get_meta_data_jobs())
+    worker = JobWorker(orch, storage, WorkerConfig.from_env())
+    params = {
+        "engine_variant": config.engine_variant,
+        "batch": config.batch or "redeploy",
+    }
+    if config.server_url:
+        params["server_url"] = config.server_url
+    if config.server_access_key:
+        params["server_access_key"] = config.server_access_key
+    if config.mesh_axes:
+        params["mesh_axes"] = config.mesh_axes
+    if config.interval_secs is None:
+        job = orch.submit("train", params, trigger="manual",
+                          max_attempts=max(1, config.retries))
+        # drain the queue until OUR job is terminal (another queued job may
+        # be claimed first; keep working through them)
+        while True:
+            done = orch.jobs.get(job.id)
+            if done is None or not done.active:
+                break
+            if worker.run_once() is None:
+                time.sleep(0.2)
+        if done is None:
+            print("Redeploy job vanished from the queue.", file=sys.stderr)
+            return None
+        if done.status != "COMPLETED":
+            tail = done.failure.splitlines()[-1] if done.failure else ""
+            print(f"Redeploy job {done.status}: {tail}", file=sys.stderr)
+            return None
+        instance_id = done.result.get("instanceId")
+        gate = (done.result.get("gate") or {}).get("verdict")
+        deploy = (done.result.get("deploy") or {}).get("mode")
+        print(f"Redeploy completed. Engine instance ID: {instance_id} "
+              f"(gate={gate}, deploy={deploy}).")
+        return instance_id
+    loop = TriggerLoop(orch, storage, TriggerConfig(
+        engine_variant=config.engine_variant,
+        server_url=config.server_url,
+        server_access_key=config.server_access_key,
+        interval_sec=config.interval_secs,
+        max_attempts=max(1, config.retries),
+    ))
+    while True:  # pragma: no cover — loop exits only by signal
+        loop.run_once()
+        worker.run_once()
+        time.sleep(min(config.interval_secs, 5.0))

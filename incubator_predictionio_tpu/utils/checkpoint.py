@@ -153,6 +153,10 @@ def maybe_resume(
         )
         ck.delete_all()  # step numbers will be re-saved
         return ck, params, opt_state, 0
+    # operator-visible (and chaos-test-pinned) proof the interrupted run
+    # continued instead of restarting: kill -9 costs epochs-since-save only
+    logger.info("checkpoint: resuming from epoch %d (of %d) in %s",
+                resumed, epochs, directory)
     return ck, state["params"], state["opt"], resumed
 
 

@@ -1103,3 +1103,252 @@ def test_event_server_sigterm_drains_and_exits_clean(tmp_path):
     ids = {e.event_id for e in storage.get_events().find(app_id)}
     assert set(acked) <= ids
     storage.close()
+
+
+# ---------------------------------------------------------------------------
+# continuous-training control plane chaos (ISSUE 12): SIGKILL the training
+# worker mid-epoch (reclaim + checkpoint resume + exactly one deploy) and
+# between the eval-gate pass and the deploy (reclaimed job deploys once)
+# ---------------------------------------------------------------------------
+
+
+def _train_jobs_recommendation(tmp_path, n_events=6000, iterations=10):
+    """Seed rating events + train a base instance of the recommendation
+    template (checkpointing ON) into sqlite, returning (store_cfg,
+    variant_path, ckpt_dir). The base instance is the incumbent the gate
+    scores against and the engine the deploy subprocess serves first."""
+    import datetime as dt
+
+    import numpy as np
+
+    from incubator_predictionio_tpu.core.workflow import run_train
+    from incubator_predictionio_tpu.data import DataMap, Event
+    from incubator_predictionio_tpu.data.storage import use_storage
+    from incubator_predictionio_tpu.data.storage.base import EngineInstance
+    from incubator_predictionio_tpu.parallel.mesh import MeshContext
+    from incubator_predictionio_tpu.templates.recommendation import (
+        RecommendationEngine,
+    )
+
+    utc = dt.timezone.utc
+    store_cfg = {
+        "PIO_STORAGE_SOURCES_SQ_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_SQ_PATH": str(tmp_path / "store.db"),
+    }
+    ckpt_dir = str(tmp_path / "ckpt")
+    variant_path = str(tmp_path / "engine.json")
+    with open(variant_path, "w") as f:
+        json.dump({
+            "id": "ct", "version": "1",
+            "engineFactory": "incubator_predictionio_tpu.templates."
+                             "recommendation.RecommendationEngine",
+            "datasource": {"params": {"appName": "ct-app"}},
+            "algorithms": [{"name": "als", "params": {
+                "rank": 32, "numIterations": iterations,
+                "batchSize": 1024,
+                "checkpointDir": ckpt_dir, "checkpointEvery": 1}}],
+        }, f)
+    storage = Storage(store_cfg)
+    prev = use_storage(storage)
+    try:
+        app_id = storage.get_meta_data_apps().insert(App(0, "ct-app"))
+        events = storage.get_events()
+        events.init(app_id)
+        rng = np.random.default_rng(7)
+        batch = [
+            Event(event="rate", entity_type="user",
+                  entity_id=f"u{rng.integers(0, 400)}",
+                  target_entity_type="item",
+                  target_entity_id=f"i{rng.integers(0, 300)}",
+                  properties=DataMap(
+                      {"rating": float(1 + 4 * rng.random())}),
+                  event_time=dt.datetime(2022, 1, 1, tzinfo=utc))
+            for _ in range(n_events)
+        ]
+        events.insert_batch(batch, app_id)
+        with open(variant_path) as f:
+            variant = json.load(f)
+        engine = RecommendationEngine().apply()
+        engine_params = engine.engine_params_from_variant(variant)
+        instance = EngineInstance(
+            id="", status="INIT", start_time=dt.datetime.now(utc),
+            end_time=None, engine_id="ct", engine_version="1",
+            engine_variant=os.path.abspath(variant_path),
+            engine_factory=variant["engineFactory"])
+        run_train(engine, engine_params, instance, storage=storage,
+                  ctx=MeshContext.create())
+    finally:
+        use_storage(prev)
+        storage.close()
+    # the base train leaves completed-run checkpoints; the orchestrated
+    # job must start from a CLEAN dir so the mid-epoch kill window is
+    # detected from ITS fresh steps, not the stale ones
+    import shutil
+
+    shutil.rmtree(ckpt_dir, ignore_errors=True)
+    return store_cfg, variant_path, ckpt_dir
+
+
+def _worker_proc(store_cfg, lease_sec=2.0, extra_env=None) -> ServerProc:
+    return ServerProc(
+        ["jobs", "worker", "--poll", "0.2"],
+        env={**store_cfg,
+             "PIO_JOBS_LEASE_SEC": str(lease_sec),
+             **(extra_env or {})})
+
+
+def _reload_200_count(base_url: str) -> int:
+    """Successful POST /reload count from the query server's own
+    /metrics — the 'exactly ONE deploy reached serving' oracle."""
+    import urllib.request
+
+    from incubator_predictionio_tpu.obs.metrics import parse_prometheus_text
+
+    with urllib.request.urlopen(f"{base_url}/metrics", timeout=10) as resp:
+        fams = parse_prometheus_text(resp.read().decode())
+    fam = fams.get("pio_http_requests_total")
+    total = 0
+    for _, labels, value in (fam["samples"] if fam else ()):
+        if "reload" in labels.get("route", "") \
+                and labels.get("status") == "200":
+            total += int(value)
+    return total
+
+
+def _wait_job(jobs_store, job_id, statuses, timeout=420.0, procs=()):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        j = jobs_store.get(job_id)
+        if j is not None and j.status in statuses:
+            return j
+        time.sleep(0.25)
+    outs = "\n---\n".join(p.output()[-3000:] for p in procs)
+    raise TimeoutError(
+        f"job {job_id} never reached {statuses} "
+        f"(now {jobs_store.get(job_id)});\nworker output:\n{outs}")
+
+
+def test_jobs_worker_kill9_mid_epoch_resumes_and_deploys_once(tmp_path):
+    """ISSUE 12 chaos proof #1: SIGKILL the training worker mid-epoch.
+    The job is reclaimed under a new fence, the second worker RESUMES
+    from the epoch checkpoint (strictly fewer epochs than from scratch,
+    pinned via the resume log line), and exactly ONE deploy reaches
+    serving."""
+    store_cfg, variant_path, ckpt_dir = _train_jobs_recommendation(
+        tmp_path, n_events=6000, iterations=16)
+    qport = free_port()
+    base = f"http://127.0.0.1:{qport}"
+    qs = ServerProc(
+        ["deploy", "-v", variant_path, "--ip", "127.0.0.1",
+         "--port", str(qport)], env=dict(store_cfg))
+    storage = Storage(store_cfg)
+    w1 = w2 = None
+    try:
+        qs.wait_ready(f"{base}/", timeout=180.0)
+        _, h0 = http_json("GET", f"{base}/health")
+        incumbent = h0["deployment"]["instanceId"]
+
+        from incubator_predictionio_tpu.jobs import Orchestrator
+
+        orch = Orchestrator(storage.get_meta_data_jobs())
+        job = orch.submit("train", {
+            "engine_variant": os.path.abspath(variant_path),
+            "server_url": base})
+        w1 = _worker_proc(store_cfg, lease_sec=2.0)
+        # wait until training is genuinely mid-run: the job is RUNNING and
+        # at least one epoch checkpoint landed (so the resume is real)
+        deadline = time.monotonic() + 300.0
+        while time.monotonic() < deadline:
+            j = storage.get_meta_data_jobs().get(job.id)
+            steps = [d for d in (os.listdir(ckpt_dir)
+                                 if os.path.isdir(ckpt_dir) else [])
+                     if d.isdigit()]
+            if j.status == "RUNNING" and steps \
+                    and max(int(s) for s in steps) >= 2:
+                break
+            if j.status in ("COMPLETED", "FAILED"):
+                raise AssertionError(
+                    f"train finished before the kill window: {j.status}\n"
+                    + w1.output()[-2000:])
+            time.sleep(0.1)
+        else:
+            raise TimeoutError("no mid-epoch checkpoint appeared\n"
+                               + w1.output()[-2000:])
+        w1.kill9()   # mid-epoch, mid-lease
+
+        # the lease lapses; a fresh worker reclaims under a bumped fence
+        w2 = _worker_proc(store_cfg, lease_sec=30.0)
+        done = _wait_job(storage.get_meta_data_jobs(), job.id,
+                         ("COMPLETED", "FAILED", "REFUSED"),
+                         procs=(w2,))
+        assert done.status == "COMPLETED", (done, w2.output()[-3000:])
+        assert done.fence == 2 and done.attempt == 2
+
+        # resume proof: the reclaiming worker continued from a checkpoint
+        out2 = w2.output()
+        assert "resuming from epoch" in out2, out2[-3000:]
+        resumed_epoch = int(
+            out2.split("resuming from epoch", 1)[1].split()[0])
+        assert resumed_epoch >= 1   # strictly fewer epochs than scratch
+
+        # exactly ONE deploy reached serving, and it serves the new
+        # instance the job trained
+        assert _reload_200_count(base) == 1
+        _, h1 = http_json("GET", f"{base}/health")
+        assert h1["deployment"]["instanceId"] == \
+            done.result["instanceId"] != incumbent
+    finally:
+        for p in (w1, w2, qs):
+            if p is not None:
+                p.stop()
+        storage.close()
+
+
+def test_jobs_worker_kill9_between_gate_pass_and_deploy(tmp_path):
+    """ISSUE 12 chaos proof #2 (the satellite's second case): the worker
+    dies AFTER the eval gate passed but BEFORE the deploy. The reclaimed
+    job re-runs on a fresh worker and serving sees exactly one reload —
+    never zero (lost deploy) and never two (double deploy)."""
+    store_cfg, variant_path, _ = _train_jobs_recommendation(
+        tmp_path, n_events=2500, iterations=3)
+    qport = free_port()
+    base = f"http://127.0.0.1:{qport}"
+    qs = ServerProc(
+        ["deploy", "-v", variant_path, "--ip", "127.0.0.1",
+         "--port", str(qport)], env=dict(store_cfg))
+    storage = Storage(store_cfg)
+    w1 = w2 = None
+    try:
+        qs.wait_ready(f"{base}/", timeout=180.0)
+        from incubator_predictionio_tpu.jobs import Orchestrator
+
+        orch = Orchestrator(storage.get_meta_data_jobs())
+        job = orch.submit("train", {
+            "engine_variant": os.path.abspath(variant_path),
+            "server_url": base})
+        w1 = _worker_proc(store_cfg, lease_sec=2.0,
+                          extra_env={"PIO_JOBS_FAULT": "kill:before_deploy"})
+        # the fault point SIGKILLs w1 right before its /reload: wait for
+        # the process to die, with the job still RUNNING and undeployed
+        deadline = time.monotonic() + 300.0
+        while w1.proc.poll() is None and time.monotonic() < deadline:
+            time.sleep(0.2)
+        assert w1.proc.poll() is not None, "fault point never tripped"
+        assert _reload_200_count(base) == 0
+        j = storage.get_meta_data_jobs().get(job.id)
+        assert j.status == "RUNNING"   # died holding the lease
+
+        w2 = _worker_proc(store_cfg, lease_sec=30.0)
+        done = _wait_job(storage.get_meta_data_jobs(), job.id,
+                         ("COMPLETED", "FAILED", "REFUSED"),
+                         procs=(w2,))
+        assert done.status == "COMPLETED", (done, w2.output()[-3000:])
+        assert done.fence == 2
+        assert _reload_200_count(base) == 1   # exactly one deploy landed
+        _, h1 = http_json("GET", f"{base}/health")
+        assert h1["deployment"]["instanceId"] == done.result["instanceId"]
+    finally:
+        for p in (w1, w2, qs):
+            if p is not None:
+                p.stop()
+        storage.close()

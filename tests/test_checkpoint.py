@@ -65,6 +65,41 @@ def test_two_tower_resume_matches_uninterrupted(tmp_path):
     np.testing.assert_allclose(resumed.item_bias, straight.item_bias, atol=1e-6)
 
 
+def test_two_tower_repeated_interruption_resumes_each_time(tmp_path):
+    """Two consecutive kill -9s at DIFFERENT epochs (the job-orchestrator
+    reclaim loop: a retrained job can crash again on its next attempt) —
+    each restart must resume from the latest checkpoint, and the final
+    parameters must match a straight uninterrupted run."""
+    straight = _fit_two_tower(None, epochs=6, every=0)
+    d = str(tmp_path / "tt")
+    # crash #1 at epoch 2, crash #2 at epoch 4, final attempt finishes 6
+    _fit_two_tower(d, epochs=2, every=1)
+    _fit_two_tower(d, epochs=4, every=1)
+    resumed = _fit_two_tower(d, epochs=6, every=1)
+    np.testing.assert_allclose(resumed.user_emb, straight.user_emb,
+                               rtol=1e-5)
+    np.testing.assert_allclose(resumed.item_emb, straight.item_emb,
+                               rtol=1e-5)
+    np.testing.assert_allclose(resumed.item_bias, straight.item_bias,
+                               atol=1e-6)
+
+
+def test_maybe_resume_logs_resume_epoch(tmp_path, caplog):
+    """The resume INFO line is the observable the chaos suite (and an
+    operator reading worker logs) uses to prove a reclaimed job continued
+    instead of restarting — pin its presence and epoch."""
+    import logging
+
+    d = str(tmp_path / "tt")
+    _fit_two_tower(d, epochs=2, every=1)
+    with caplog.at_level(logging.INFO,
+                         logger="incubator_predictionio_tpu.utils.checkpoint"):
+        _fit_two_tower(d, epochs=4, every=1)
+    msgs = [r.getMessage() for r in caplog.records
+            if "resuming from epoch" in r.getMessage()]
+    assert msgs and "resuming from epoch 2" in msgs[0]
+
+
 def test_two_tower_stale_checkpoint_restarts_fresh(tmp_path):
     """A checkpoint left by a *completed* run must not short-circuit the next
     run (the redeploy cron loop retrains on new data every pass)."""

@@ -1,0 +1,739 @@
+"""Continuous-training control plane (incubator_predictionio_tpu/jobs/).
+
+Covers the ISSUE 12 acceptance surface that fits in-process:
+
+- JobsStore contract incl. the CAS claim-atomicity on memory AND sqlite;
+- orchestrator lease/reclaim/fence semantics on injected time (no wall
+  sleeps): expired leases reclaim under a bumped fence, stale holders are
+  fenced at heartbeat AND at the pre-deploy verify, attempts requeue then
+  exhaust;
+- the worker driving real workflows: EngineInstance INIT→COMPLETED and
+  →FAILED through orchestrated runs, the fenced-zombie case (exactly one
+  deploy), gate-refused promotion (poisoned training window) with the
+  last-good instance untouched and ``pio_jobs_gate_refused_total``
+  counted;
+- triggers: interval cadence, event-drift threshold, and the streaming
+  quarantine marker auto-submitting the retrain that clears it (the
+  end-to-end loop PR 8 left open);
+- the CLI verbs over a real sqlite store.
+
+The process-boundary twins (SIGKILL mid-epoch, SIGKILL between gate and
+deploy) live in tests/test_chaos_procs.py under the ``slow`` marker.
+"""
+
+import datetime as dt
+import json
+import os
+
+import numpy as np
+import pytest
+
+from incubator_predictionio_tpu.data import DataMap, Event
+from incubator_predictionio_tpu.data.storage import (
+    App,
+    JobRecord,
+    Storage,
+    use_storage,
+)
+from incubator_predictionio_tpu.data.storage.base import (
+    JOB_QUEUED,
+    JOB_RUNNING,
+)
+from incubator_predictionio_tpu.jobs import (
+    FencedJobError,
+    JobWorker,
+    Orchestrator,
+    TriggerConfig,
+    TriggerLoop,
+    WorkerConfig,
+)
+from incubator_predictionio_tpu.jobs import gate as gates
+from incubator_predictionio_tpu.jobs import job_metrics as jm
+
+UTC = dt.timezone.utc
+
+SAMPLE_FACTORY = "tests.fixtures.sample_engine.SampleEngineFactory"
+REC_FACTORY = ("incubator_predictionio_tpu.templates.recommendation."
+               "RecommendationEngine")
+
+
+def _sample_variant(tmp_path, fail_sanity=False, name="engine.json"):
+    path = str(tmp_path / name)
+    with open(path, "w") as f:
+        json.dump({
+            "id": "sample", "version": "1", "engineFactory": SAMPLE_FACTORY,
+            "datasource": {"params": {"n": 5, "failSanity": fail_sanity}},
+            "algorithms": [{"name": "algo", "params": {"mult": 2}}],
+        }, f)
+    return path
+
+
+@pytest.fixture()
+def mem_storage():
+    s = Storage({"PIO_STORAGE_SOURCES_M_TYPE": "memory"})
+    prev = use_storage(s)  # PEventStore templates resolve the singleton
+    yield s
+    use_storage(prev)
+    s.close()
+
+
+def _counter(c) -> float:
+    """Current value of an unlabeled counter family."""
+    return c._default().value
+
+
+# ---------------------------------------------------------------------------
+# JobsStore contract (memory + sqlite)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("backend", ["memory", "sqlite"])
+def test_jobs_store_contract_and_cas(backend, tmp_path):
+    cfg = ({"PIO_STORAGE_SOURCES_M_TYPE": "memory"} if backend == "memory"
+           else {"PIO_STORAGE_SOURCES_S_TYPE": "sqlite",
+                 "PIO_STORAGE_SOURCES_S_PATH": str(tmp_path / "pio.db")})
+    s = Storage(cfg)
+    try:
+        jobs = s.get_meta_data_jobs()
+        j = JobRecord(id="", kind="train", status=JOB_QUEUED,
+                      params={"engine_variant": "e.json", "n": 1},
+                      submitted_at=dt.datetime.now(UTC))
+        jid = jobs.insert(j)
+        got = jobs.get(jid)
+        assert got.kind == "train" and got.version == 0
+        assert got.params == {"engine_variant": "e.json", "n": 1}
+        # round-trip datetime fidelity through the backend
+        assert abs((got.submitted_at - j.submitted_at).total_seconds()) < 1e-3
+
+        from dataclasses import replace
+        claimed = replace(got, status=JOB_RUNNING, fence=1,
+                          lease_owner="w1",
+                          lease_expires_at=dt.datetime.now(UTC))
+        assert jobs.cas(claimed, 0)
+        # the losing side of the race: same expected version must fail
+        assert not jobs.cas(replace(got, lease_owner="w2"), 0)
+        after = jobs.get(jid)
+        assert (after.version, after.fence, after.lease_owner) == (1, 1, "w1")
+        assert [a.id for a in jobs.get_active()] == [jid]
+        assert jobs.delete(jid) and jobs.get(jid) is None
+    finally:
+        s.close()
+
+
+def test_job_wire_roundtrip():
+    from incubator_predictionio_tpu.data.storage.wire import dec_job, enc_job
+
+    j = JobRecord(id="abc", kind="rollout", status="COMPLETED",
+                  params={"replicas": ["http://a", "http://b"]},
+                  trigger="drift", dedupe_key="k", attempt=2,
+                  max_attempts=5, submitted_at=dt.datetime.now(UTC),
+                  started_at=dt.datetime.now(UTC),
+                  finished_at=dt.datetime.now(UTC), lease_owner="w",
+                  lease_expires_at=None, fence=3, version=7,
+                  result={"ok": True}, failure="")
+    encoded = enc_job(j)
+    json.dumps(encoded)  # must be JSON-serializable as-is (the RPC body)
+    assert dec_job(encoded) == j
+
+
+# ---------------------------------------------------------------------------
+# orchestrator: leases, fencing, attempts (injected time, zero sleeps)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def orch(mem_storage):
+    now = [1000.0]
+    o = Orchestrator(mem_storage.get_meta_data_jobs(),
+                     now_fn=lambda: now[0])
+    o._test_now = now
+    return o
+
+
+def test_submit_dedupes_active_jobs(orch):
+    a = orch.submit("train", {"engine_variant": "e"}, dedupe_key="k")
+    b = orch.submit("train", {"engine_variant": "e"}, dedupe_key="k")
+    assert a.id == b.id
+    c = orch.claim("w1", 30)
+    assert c.id == a.id
+    # still RUNNING → still deduped
+    assert orch.submit("train", {}, dedupe_key="k").id == a.id
+    orch.complete(c, {})
+    # terminal → a fresh submission queues a NEW job
+    assert orch.submit("train", {}, dedupe_key="k").id != a.id
+
+
+def test_lease_expiry_reclaims_under_new_fence_and_fences_zombie(orch):
+    orch.submit("train", {"engine_variant": "e"})
+    held = orch.claim("w1", lease_sec=30)
+    assert (held.fence, held.attempt) == (1, 1)
+    assert orch.claim("w2", lease_sec=30) is None  # lease still live
+    orch._test_now[0] += 29
+    held = orch.heartbeat(held, lease_sec=30)     # w1 keeps it alive
+    orch._test_now[0] += 29
+    assert orch.claim("w2", lease_sec=30) is None
+    orch._test_now[0] += 31                        # now the lease lapses
+    reclaimed = orch.claim("w2", lease_sec=30)
+    assert reclaimed is not None
+    assert (reclaimed.fence, reclaimed.attempt) == (2, 2)
+    fenced_before = _counter(jm.FENCED)
+    # the zombie (w1) is rejected at heartbeat AND at the pre-deploy check
+    with pytest.raises(FencedJobError):
+        orch.heartbeat(held, lease_sec=30)
+    with pytest.raises(FencedJobError):
+        orch.verify_fence(held)
+    assert _counter(jm.FENCED) == fenced_before + 2
+    # the reclaiming worker proceeds normally
+    done = orch.complete(orch.verify_fence(reclaimed), {"instanceId": "x"})
+    assert done.status == "COMPLETED"
+
+
+def test_reclaim_exhausts_attempt_budget(orch):
+    orch.submit("train", {}, max_attempts=2)
+    for expected_attempt in (1, 2):
+        c = orch.claim("w", lease_sec=10)
+        assert c.attempt == expected_attempt
+        orch._test_now[0] += 11   # die silently; lease lapses
+    assert orch.claim("w", lease_sec=10) is None
+    (j,) = orch.jobs.get_all()
+    assert j.status == "FAILED" and "attempt budget exhausted" in j.failure
+
+
+def test_fail_requeues_then_exhausts(orch):
+    job = orch.submit("eval", {"evaluation_class": "X"}, max_attempts=2)
+    c = orch.claim("w", 30)
+    r = orch.fail(c, "boom-1")
+    assert r.status == JOB_QUEUED and r.failure == "boom-1"
+    c2 = orch.claim("w", 30)
+    assert c2.attempt == 2
+    r2 = orch.fail(c2, "boom-2")
+    assert r2.status == "FAILED" and r2.failure == "boom-2"
+    # retry resets the attempt budget
+    rq = orch.retry(job.id)
+    assert (rq.status, rq.attempt, rq.trigger) == (JOB_QUEUED, 0, "retry")
+
+
+def test_cancel_fences_running_worker(orch):
+    orch.submit("train", {})
+    held = orch.claim("w1", 30)
+    cancelled = orch.cancel(held.id)
+    assert cancelled.status == "CANCELLED"
+    with pytest.raises(FencedJobError):
+        orch.verify_fence(held)   # the worker can never deploy
+    assert orch.cancel(held.id) is None  # not active anymore
+
+
+def test_transition_survives_concurrent_heartbeat_version_race(orch):
+    """A worker's OWN heartbeat thread bumping the version between a
+    transition's read and its CAS must retry, not masquerade as a fence
+    loss (which would leave the job RUNNING and burn an attempt)."""
+    orch.submit("train", {})
+    held = orch.claim("w1", 30)
+    real_cas = orch.jobs.cas
+    raced = {"n": 0}
+
+    def racing_cas(job, expected):
+        # first transition CAS loses: a heartbeat landed in between
+        if raced["n"] == 0:
+            raced["n"] += 1
+            orch.heartbeat(held, 30)   # bumps the stored version
+        return real_cas(job, expected)
+
+    orch.jobs.cas = racing_cas
+    try:
+        done = orch.complete(held, {"instanceId": "x"})
+    finally:
+        orch.jobs.cas = real_cas
+    assert done.status == "COMPLETED"
+    assert raced["n"] == 1             # exactly one retry, no FencedJobError
+
+
+def test_prune_keeps_active_and_newest_terminal(orch):
+    for i in range(5):
+        orch._test_now[0] += 1
+        orch.submit("train", {})
+        orch.complete(orch.claim("w", 30), {"i": i})
+    active = orch.submit("train", {})
+    pruned = orch.prune(keep_terminal=2)
+    assert pruned == 3
+    left = orch.jobs.get_all()
+    assert orch.jobs.get(active.id) is not None   # active never pruned
+    terminal = [j for j in left if not j.active]
+    assert len(terminal) == 2
+    # the newest terminal jobs survived
+    assert sorted(j.result["i"] for j in terminal) == [3, 4]
+    # age-based pruning drops the rest
+    orch._test_now[0] += 10_000
+    assert orch.prune(keep_terminal=0, max_age_sec=1.0) == 2
+    assert [j.id for j in orch.jobs.get_all()] == [active.id]
+
+
+def test_summarize_reports_lease_margin_and_last_failure(orch):
+    orch.submit("train", {})
+    orch.claim("w1", lease_sec=30)
+    orch._test_now[0] += 40       # expired, not yet reclaimed
+    ev = orch.submit("eval", {"evaluation_class": "X"}, max_attempts=1)
+    orch.fail(orch.claim("w2", 30), "kaboom\ndetails")
+    s = orch.summarize()
+    assert s["kinds"]["train"]["running"] == 1
+    assert s["kinds"]["train"]["oldestLeaseAgeSec"] < 0   # expired shows red
+    assert s["kinds"]["eval"]["failed"] == 1
+    assert s["lastFailure"]["id"] == ev.id
+    assert s["lastFailure"]["failure"] == "kaboom"
+
+
+# ---------------------------------------------------------------------------
+# worker: real workflows, engine-instance transitions, zombie deploy fence
+# ---------------------------------------------------------------------------
+
+def test_worker_train_completes_engine_instance(mem_storage, tmp_path):
+    variant = _sample_variant(tmp_path)
+    orch = Orchestrator(mem_storage.get_meta_data_jobs())
+    worker = JobWorker(orch, mem_storage,
+                       WorkerConfig(worker_id="w1", lease_sec=30))
+    orch.submit("train", {"engine_variant": variant})
+    out = worker.run_once()
+    assert out["status"] == "COMPLETED"
+    inst = mem_storage.get_meta_data_engine_instances().get(
+        out["result"]["instanceId"])
+    assert inst.status == "COMPLETED" and inst.end_time is not None
+    assert inst.batch == "jobs:manual"
+    # no deploy target → explicit "none", and the gate ran (sample engine
+    # has no datasource app → no holdout → pass-through)
+    assert out["result"]["deploy"] == {"mode": "none"}
+    assert out["result"]["gate"]["passed"] is True
+
+
+def test_worker_failed_train_marks_instance_failed_and_requeues(
+        mem_storage, tmp_path):
+    variant = _sample_variant(tmp_path, fail_sanity=True)
+    orch = Orchestrator(mem_storage.get_meta_data_jobs())
+    worker = JobWorker(orch, mem_storage,
+                       WorkerConfig(worker_id="w1", lease_sec=30))
+    job = orch.submit("train", {"engine_variant": variant}, max_attempts=2)
+    fails_before = _counter(jm.ATTEMPT_FAILURES)
+    out1 = worker.run_once()
+    assert out1["status"] == JOB_QUEUED          # attempt 1 → requeued
+    out2 = worker.run_once()
+    assert out2["status"] == "FAILED"            # attempt 2 → terminal
+    assert _counter(jm.ATTEMPT_FAILURES) == fails_before + 2
+    assert "sanity check failed" in orch.jobs.get(job.id).failure
+    # every orchestrated run left a FAILED engine instance, never INIT
+    instances = mem_storage.get_meta_data_engine_instances().get_all()
+    assert len(instances) == 2
+    assert {i.status for i in instances} == {"FAILED"}
+
+
+def test_zombie_worker_cannot_double_deploy(mem_storage, tmp_path,
+                                            monkeypatch):
+    """The fenced-zombie acceptance case: worker1's lease lapses mid-run,
+    worker2 reclaims and deploys; worker1 wakes up, finishes its compute,
+    and is fenced at the pre-deploy verify — exactly ONE deploy lands."""
+    variant = _sample_variant(tmp_path)
+    now = [0.0]
+    orch = Orchestrator(mem_storage.get_meta_data_jobs(),
+                        now_fn=lambda: now[0])
+    deploys = []
+    monkeypatch.setattr(
+        JobWorker, "_reload",
+        lambda self, url, key: deploys.append(url) or {
+            "engineInstanceId": "reloaded"})
+    params = {"engine_variant": variant, "server_url": "http://stub:1"}
+    job = orch.submit("train", params)
+    # worker1 claims, then "wedges" (we hold its claim record and stop)
+    stale = orch.claim("w1", lease_sec=5)
+    assert stale is not None
+    now[0] += 6.0    # lease lapses while w1 is wedged
+    worker2 = JobWorker(orch, mem_storage,
+                        WorkerConfig(worker_id="w2", lease_sec=30))
+    # suppress w2's incumbent /health probe wait (stub url is unreachable
+    # fast anyway, but keep the test network-free)
+    monkeypatch.setattr(JobWorker, "_incumbent_instance",
+                        lambda self, p, v: None)
+    out = worker2.run_once()
+    assert out["status"] == "COMPLETED" and deploys == ["http://stub:1"]
+    # the zombie wakes up and tries to deploy its own (stale) run
+    fenced_before = _counter(jm.FENCED)
+    with pytest.raises(FencedJobError):
+        orch.verify_fence(stale)
+    assert _counter(jm.FENCED) == fenced_before + 1
+    assert deploys == ["http://stub:1"]          # still exactly one
+    assert orch.jobs.get(job.id).status == "COMPLETED"
+
+
+def test_worker_rollout_job_drives_fleet_orchestrator(mem_storage, tmp_path,
+                                                      monkeypatch):
+    calls = {}
+
+    def fake_rollout(config, **kw):
+        from incubator_predictionio_tpu.fleet.rollout import RolloutResult
+
+        calls["replicas"] = config.replicas
+        return RolloutResult(ok=True, updated=list(config.replicas),
+                             rolled_back=[])
+
+    monkeypatch.setattr("incubator_predictionio_tpu.fleet.rollout"
+                        ".run_rollout", fake_rollout)
+    orch = Orchestrator(mem_storage.get_meta_data_jobs())
+    worker = JobWorker(orch, mem_storage,
+                       WorkerConfig(worker_id="w", lease_sec=30))
+    orch.submit("rollout",
+                {"replicas": ["http://r1:1", "http://r2:1"]})
+    out = worker.run_once()
+    assert out["status"] == "COMPLETED"
+    assert calls["replicas"] == ("http://r1:1", "http://r2:1")
+    assert out["result"]["mode"] == "rollout"
+
+
+# ---------------------------------------------------------------------------
+# eval gate: poisoned window refused, clean retrain promoted
+# ---------------------------------------------------------------------------
+
+def _rec_events(rng, n, n_users, n_items, t0, rating_fn):
+    return [
+        Event(event="rate", entity_type="user",
+              entity_id=f"u{rng.integers(0, n_users)}",
+              target_entity_type="item",
+              target_entity_id=f"i{rng.integers(0, n_items)}",
+              properties=DataMap({"rating": float(rating_fn())}),
+              event_time=t0 + dt.timedelta(
+                  seconds=int(rng.integers(0, 3600))))
+        for _ in range(n)
+    ]
+
+
+def _rec_variant(tmp_path, app_name):
+    path = str(tmp_path / "rec_engine.json")
+    with open(path, "w") as f:
+        json.dump({
+            "id": "rec", "version": "1", "engineFactory": REC_FACTORY,
+            "datasource": {"params": {"appName": app_name}},
+            "algorithms": [{"name": "als", "params": {
+                "rank": 8, "numIterations": 4}}],
+        }, f)
+    return path
+
+
+@pytest.fixture()
+def rec_setup(mem_storage, tmp_path):
+    """Recommendation app + variant + a clean training corpus."""
+    app_id = mem_storage.get_meta_data_apps().insert(App(0, "jobs-app"))
+    events = mem_storage.get_events()
+    events.init(app_id)
+    rng = np.random.default_rng(3)
+    n_users, n_items = 60, 40
+    events.insert_batch(
+        _rec_events(rng, 500, n_users, n_items,
+                    dt.datetime(2022, 1, 1, tzinfo=UTC),
+                    lambda: 1 + 4 * rng.random()), app_id)
+    variant = _rec_variant(tmp_path, "jobs-app")
+    return mem_storage, app_id, variant, rng, n_users, n_items
+
+
+def test_gate_refuses_poisoned_candidate_keeps_last_good(rec_setup):
+    storage, app_id, variant, rng, n_users, n_items = rec_setup
+    orch = Orchestrator(storage.get_meta_data_jobs())
+    worker = JobWorker(orch, storage,
+                       WorkerConfig(worker_id="w", lease_sec=60))
+    # 1) clean baseline trains and promotes (no incumbent to regress vs)
+    orch.submit("train", {"engine_variant": variant})
+    out = worker.run_once()
+    assert out["status"] == "COMPLETED"
+    incumbent = out["result"]["instanceId"]
+    # 2) poisoned training window lands (extreme ratings), followed by a
+    #    slice of normal traffic — the holdout the gate scores against
+    events = storage.get_events()
+    events.insert_batch(
+        _rec_events(rng, 500, n_users, n_items,
+                    dt.datetime(2022, 1, 2, tzinfo=UTC), lambda: 25.0),
+        app_id)
+    events.insert_batch(
+        _rec_events(rng, 120, n_users, n_items,
+                    dt.datetime(2022, 1, 3, tzinfo=UTC),
+                    lambda: 1 + 4 * rng.random()), app_id)
+    refused_before = _counter(jm.GATE_REFUSED)
+    # gate_sample pins the holdout to the recent CLEAN window (the default
+    # 512 would reach back into the poison itself)
+    job = orch.submit("train", {"engine_variant": variant,
+                                "gate_sample": 120})
+    out2 = worker.run_once()
+    # the refusal is terminal + visible: REFUSED status, counted metric
+    assert out2["status"] == "REFUSED"
+    assert _counter(jm.GATE_REFUSED) == refused_before + 1
+    stored = orch.jobs.get(job.id)
+    assert stored.status == "REFUSED"
+    assert "gate refused" in stored.failure
+    gate = stored.result["gate"]
+    assert gate["candidateScore"] > gate["incumbentScore"] * 1.1
+    # the last-good instance is untouched (still the latest COMPLETED
+    # whose blob a deploy would load — the refused candidate trained a
+    # NEWER instance, so "keeps serving" means the worker never reloaded;
+    # assert the refused run recorded no deploy)
+    assert "deploy" not in stored.result
+    assert stored.result["incumbentId"] == incumbent
+
+
+def test_gate_passes_clean_retrain(rec_setup):
+    storage, app_id, variant, rng, n_users, n_items = rec_setup
+    orch = Orchestrator(storage.get_meta_data_jobs())
+    worker = JobWorker(orch, storage,
+                       WorkerConfig(worker_id="w", lease_sec=60))
+    orch.submit("train", {"engine_variant": variant})
+    assert worker.run_once()["status"] == "COMPLETED"
+    # more clean traffic → retrain passes the gate
+    storage.get_events().insert_batch(
+        _rec_events(rng, 200, n_users, n_items,
+                    dt.datetime(2022, 1, 2, tzinfo=UTC),
+                    lambda: 1 + 4 * rng.random()), app_id)
+    orch.submit("train", {"engine_variant": variant})
+    out = worker.run_once()
+    assert out["status"] == "COMPLETED"
+    assert out["result"]["gate"]["verdict"] == "passed"
+    assert out["result"]["gate"]["candidateScore"] <= (
+        out["result"]["gate"]["incumbentScore"] * 1.1 + 1e-9)
+
+
+def test_gate_off_and_unscorable_pass_through(mem_storage, tmp_path):
+    variant = _sample_variant(tmp_path)
+    skipped_before = _counter(jm.GATE_SKIPPED)
+    v = gates.evaluate(mem_storage, variant, "cand", "inc",
+                       config=gates.GateConfig(enabled=False))
+    assert v == {"passed": True, "verdict": "gate_off"}
+    # sample engine has no datasource app → no holdout events → skip
+    v2 = gates.evaluate(mem_storage, variant, "cand", "inc",
+                        config=gates.GateConfig())
+    assert v2["passed"] and v2["verdict"] == "no_holdout_events"
+    assert _counter(jm.GATE_SKIPPED) == skipped_before + 2
+
+
+# ---------------------------------------------------------------------------
+# triggers: interval, drift, quarantine
+# ---------------------------------------------------------------------------
+
+def test_interval_trigger_fires_and_coalesces(mem_storage, tmp_path):
+    variant = _sample_variant(tmp_path)
+    now = [10_000.0]
+    orch = Orchestrator(mem_storage.get_meta_data_jobs(),
+                        now_fn=lambda: now[0])
+    loop = TriggerLoop(orch, mem_storage,
+                       TriggerConfig(engine_variant=variant,
+                                     interval_sec=300),
+                       now_fn=lambda: now[0])
+    (job,) = loop.run_once()
+    assert job.trigger == "interval"
+    # inside the interval nothing fires
+    now[0] += 100
+    assert loop.run_once() == []
+    # past the interval while the job is still queued: the firing
+    # COALESCES onto the active job instead of stacking a second one
+    now[0] += 201
+    (same,) = loop.run_once()
+    assert same.id == job.id
+    # execute it; the next tick past the interval queues a fresh job
+    worker = JobWorker(orch, mem_storage,
+                       WorkerConfig(worker_id="w", lease_sec=30))
+    assert worker.run_once()["status"] == "COMPLETED"
+    now[0] += 1
+    (nxt,) = loop.run_once()
+    assert nxt.id != job.id and nxt.trigger == "interval"
+
+
+def test_drift_trigger_counts_events_since_last_trained(rec_setup):
+    storage, app_id, variant, rng, n_users, n_items = rec_setup
+    now = [dt.datetime(2022, 6, 1, tzinfo=UTC).timestamp()]
+    orch = Orchestrator(storage.get_meta_data_jobs(),
+                        now_fn=lambda: now[0])
+    worker = JobWorker(orch, storage,
+                       WorkerConfig(worker_id="w", lease_sec=60))
+    loop = TriggerLoop(orch, storage,
+                       TriggerConfig(engine_variant=variant,
+                                     drift_events=100,
+                                     app_name="jobs-app"),
+                       now_fn=lambda: now[0])
+    # no trained instance yet → drift has no reference → nothing fires
+    assert loop.run_once() == []
+    orch.submit("train", {"engine_variant": variant},
+                dedupe_key=loop._dedupe_key())
+    assert worker.run_once()["status"] == "COMPLETED"
+    # fewer than the threshold → quiet
+    storage.get_events().insert_batch(
+        _rec_events(rng, 50, n_users, n_items,
+                    dt.datetime.now(UTC), lambda: 3.0), app_id)
+    assert loop.run_once() == []
+    # threshold crossed → drift retrain
+    storage.get_events().insert_batch(
+        _rec_events(rng, 60, n_users, n_items,
+                    dt.datetime.now(UTC), lambda: 3.0), app_id)
+    (job,) = loop.run_once()
+    assert job.trigger == "drift"
+
+
+def test_quarantine_trigger_submits_retrain_that_clears_marker(
+        rec_setup, tmp_path):
+    """The loop PR 8 left open, closed end to end: the stream's durable
+    quarantine marker auto-submits a full retrain; the retrained instance
+    clears the marker and the delta stream resumes with a fresh chain."""
+    storage, app_id, variant, rng, n_users, n_items = rec_setup
+    from incubator_predictionio_tpu.streaming import guard as guards
+
+    state_dir = str(tmp_path / "stream-state")
+    os.makedirs(state_dir)
+    orch = Orchestrator(storage.get_meta_data_jobs())
+    worker = JobWorker(orch, storage,
+                       WorkerConfig(worker_id="w", lease_sec=60))
+    # base model serves; its stream trips the guard and quarantines
+    orch.submit("train", {"engine_variant": variant})
+    out = worker.run_once()
+    assert out["status"] == "COMPLETED"
+    base_instance = out["result"]["instanceId"]
+    guards.quarantine(state_dir, "row u3 norm detonated", at_seq=123,
+                      base_instance=base_instance)
+    loop = TriggerLoop(orch, storage,
+                       TriggerConfig(engine_variant=variant,
+                                     stream_state_dir=state_dir))
+    (job,) = loop.run_once()
+    assert job.trigger == "quarantine"
+    # the trigger keeps coalescing while the retrain runs, not stacking
+    assert loop.run_once()[0].id == job.id
+    out2 = worker.run_once()
+    assert out2["status"] == "COMPLETED"
+    new_instance = out2["result"]["instanceId"]
+    assert new_instance != base_instance
+    # the marker clears exactly the way streaming defines it: a restarted
+    # updater on the NEW instance id resets chain + quarantine together
+    from incubator_predictionio_tpu.streaming.updater import (
+        StreamUpdater,
+        UpdaterConfig,
+    )
+
+    class _NoFeed:   # quarantine-clear path only; no eventlog needed
+        def __init__(self, *a, **kw):
+            pass
+
+    assert guards.read_quarantine(state_dir) is not None
+    import incubator_predictionio_tpu.streaming.updater as upd_mod
+    real_feed = upd_mod.feeds.EventLogFeed
+    try:
+        upd_mod.feeds.EventLogFeed = _NoFeed
+        from incubator_predictionio_tpu.streaming.updater import (
+            load_base_model,
+        )
+
+        model, instance_id, event_names, defaults = load_base_model(
+            variant, storage)
+        assert instance_id == new_instance
+        updater = StreamUpdater(
+            UpdaterConfig(state_dir=state_dir,
+                          feed_path=str(tmp_path / "nolog.piolog")),
+            model, instance_id, event_names=event_names,
+            default_values=defaults)
+        assert updater.quarantined is None          # marker cleared
+        assert updater.cursor["base_instance"] == new_instance
+        assert updater.cursor["seq"] == updater.cursor["chain_base"]
+    finally:
+        upd_mod.feeds.EventLogFeed = real_feed
+    assert guards.read_quarantine(state_dir) is None
+    # and ``pio-tpu health`` shows green for the cleared dir
+    from incubator_predictionio_tpu.tools.cli import _quarantine_row
+
+    row = _quarantine_row(state_dir, 300.0)
+    assert row["red"] is False and row["status"] == "ok"
+
+
+def test_quarantine_trigger_does_not_storm_after_completed_retrain(
+        rec_setup, tmp_path):
+    """With the stream updater down, the marker is never cleared — the
+    trigger must fire ONE retrain per marker, not one per poll forever."""
+    storage, app_id, variant, rng, n_users, n_items = rec_setup
+    from incubator_predictionio_tpu.streaming import guard as guards
+
+    state_dir = str(tmp_path / "stream-state")
+    os.makedirs(state_dir)
+    guards.quarantine(state_dir, "trip", at_seq=1, base_instance="base")
+    orch = Orchestrator(storage.get_meta_data_jobs())
+    worker = JobWorker(orch, storage,
+                       WorkerConfig(worker_id="w", lease_sec=60))
+    loop = TriggerLoop(orch, storage,
+                       TriggerConfig(engine_variant=variant,
+                                     stream_state_dir=state_dir))
+    fired_before = jm.TRIGGERS.labels(trigger="quarantine").value
+    (job,) = loop.run_once()
+    # coalesces while queued/running — and the metric counted ONE firing
+    assert loop.run_once()[0].id == job.id
+    assert jm.TRIGGERS.labels(
+        trigger="quarantine").value == fired_before + 1
+    assert worker.run_once()["status"] == "COMPLETED"
+    # marker still present (no updater ran) — but the retrain for it is
+    # done: nothing new fires, on this or any later round
+    assert guards.read_quarantine(state_dir) is not None
+    assert loop.run_once() == []
+    assert loop.run_once() == []
+    # a NEW trip (fresh marker, later timestamp) fires again
+    guards.quarantine(state_dir, "trip-2", at_seq=2, base_instance="b2")
+    (again,) = loop.run_once()
+    assert again.id != job.id and again.trigger == "quarantine"
+
+
+def test_quarantine_health_row_red_when_stale(tmp_path):
+    from incubator_predictionio_tpu.streaming import guard as guards
+    from incubator_predictionio_tpu.tools.cli import _quarantine_row
+
+    state_dir = str(tmp_path / "q")
+    os.makedirs(state_dir)
+    marker = guards.quarantine(state_dir, "trip", 1, "inst")
+    # fresh marker, retrain due soon → reported, not red
+    row = _quarantine_row(state_dir, 300.0)
+    assert row["status"] == "quarantined" and row["red"] is False
+    # backdate past the trigger interval → stuck control loop → red
+    marker["quarantinedAt"] -= 1000
+    with open(os.path.join(state_dir, "quarantine.json"), "w") as f:
+        json.dump(marker, f)
+    row = _quarantine_row(state_dir, 300.0)
+    assert row["red"] is True and "stuck" in row["detail"]
+
+
+# ---------------------------------------------------------------------------
+# CLI verbs over a real sqlite store
+# ---------------------------------------------------------------------------
+
+def test_jobs_cli_submit_worker_list_watch(tmp_pio_home, tmp_path, capsys):
+    from incubator_predictionio_tpu.data.storage import get_storage
+    from incubator_predictionio_tpu.tools import cli
+
+    variant = _sample_variant(tmp_path)
+    storage = get_storage(refresh=True)
+    try:
+        assert cli.main(["jobs", "submit", "-v", variant]) == 0
+        out = capsys.readouterr().out
+        job_id = out.split("job ")[1].split()[0]
+        assert cli.main(["jobs", "list"]) == 0
+        assert "QUEUED" in capsys.readouterr().out
+        assert cli.main(["jobs", "worker", "--once"]) == 0
+        capsys.readouterr()
+        assert cli.main(["jobs", "watch", job_id, "--timeout", "5"]) == 0
+        watched = json.loads(capsys.readouterr().out)
+        assert watched["status"] == "COMPLETED"
+        assert cli.main(["jobs", "list", "--json"]) == 0
+        rows = json.loads(capsys.readouterr().out)
+        assert rows and rows[-1]["status"] == "COMPLETED"
+        # cancel/retry error paths
+        assert cli.main(["jobs", "cancel", job_id]) == 1
+        assert cli.main(["jobs", "retry", job_id]) == 0
+    finally:
+        get_storage(refresh=True)
+
+
+def test_legacy_redeploy_counts_attempt_failures(mem_storage, tmp_path):
+    """Satellite: the legacy retry loop no longer swallows exceptions
+    silently — failures log with traceback and land in
+    pio_jobs_attempt_failures_total."""
+    from incubator_predictionio_tpu.tools.ops import (
+        RedeployConfig,
+        redeploy_once,
+    )
+
+    variant = _sample_variant(tmp_path, fail_sanity=True)
+    before = _counter(jm.ATTEMPT_FAILURES)
+    out = redeploy_once(RedeployConfig(
+        engine_variant=variant, retries=2, retry_wait_secs=0.0,
+        server_url=None), mem_storage)
+    assert out is None
+    assert _counter(jm.ATTEMPT_FAILURES) == before + 2
