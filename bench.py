@@ -1714,6 +1714,349 @@ def bench_storage_failover() -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_disaster_recovery() -> dict:
+    """The DR drill (docs/dr.md): sustained ingest against a real event
+    server on the eventlog backend, a backup taken IN FLIGHT, ``rm -rf``
+    of the whole live data surface (eventlog + WAL + metadata), a
+    verified restore, restart, and the recovery invariants: zero
+    acked-event loss up to the cut + replayed WAL tail (RPO =
+    post-backup window only, asserted by id set, forensics on any
+    discrepancy) with the restore wall time reported as RTO. A second
+    phase backs up a replication FOLLOWER's data dir mid-ingest and
+    measures the primary's ack goodput during the copy — read-only views,
+    primary serving untouched."""
+    import shutil
+    import tempfile
+    import threading
+
+    from incubator_predictionio_tpu.backup import (
+        BackupSource,
+        RestoreTargets,
+        create_backup,
+        restore_backup,
+    )
+    from incubator_predictionio_tpu.data.storage import (
+        AccessKey,
+        App,
+        Storage,
+    )
+    from incubator_predictionio_tpu.native import format as fmt
+    from incubator_predictionio_tpu.obs.metrics import REGISTRY
+    from incubator_predictionio_tpu.parallel.launcher import free_port
+    from tests.fixtures.procs import ServerProc, http_json
+
+    tmp = tempfile.mkdtemp(prefix="pio-bench-dr-")
+    pre_s = 1.5 if SMALL else 3.0
+    event_body = {"event": "view", "entityType": "user",
+                  "eventTime": "2024-01-01T00:00:00Z"}
+    m_before = _metrics_snapshot(REGISTRY.expose())
+
+    def seed_meta(db_path):
+        meta = Storage({
+            "PIO_STORAGE_SOURCES_SQ_TYPE": "sqlite",
+            "PIO_STORAGE_SOURCES_SQ_PATH": db_path,
+        })
+        app_id = meta.get_meta_data_apps().insert(App(0, "dr-bench"))
+        key = meta.get_meta_data_access_keys().insert(
+            AccessKey("", app_id, ()))
+        meta.close()
+        return app_id, key
+
+    def ingest_loop(base, key, acked, stop, lock):
+        i = 0
+        while not stop.is_set():
+            try:
+                status, body = http_json(
+                    "POST", f"{base}/events.json?accessKey={key}",
+                    dict(event_body, entityId=f"u{i}"), timeout=10.0)
+                if status == 201:
+                    with lock:
+                        acked.append(body["eventId"])
+            except Exception:  # noqa: BLE001 - ambiguous, not acked
+                pass
+            i += 1
+            time.sleep(0.005)
+
+    # ---- phase A: full-host-loss drill ---------------------------------
+    elog_dir = os.path.join(tmp, "live-elog")
+    wal_dir = os.path.join(tmp, "wal")
+    meta_db = os.path.join(tmp, "meta.db")
+    bdir = os.path.join(tmp, "backups")
+    env = {
+        "PIO_STORAGE_SOURCES_EL_TYPE": "eventlog",
+        "PIO_STORAGE_SOURCES_EL_PATH": elog_dir,
+        "PIO_STORAGE_SOURCES_SQ_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_SQ_PATH": meta_db,
+        "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "EL",
+        "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQ",
+        "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SQ",
+        "PIO_EVENT_WAL_DIR": wal_dir,
+        "PIO_EVENTSERVER_AUTH_TTL": "600",
+    }
+    app_id, key = seed_meta(meta_db)
+    eport = free_port()
+    base = f"http://127.0.0.1:{eport}"
+    acked: list = []
+    lock = threading.Lock()
+    stop = threading.Event()
+    es = ServerProc(["eventserver", "--ip", "127.0.0.1",
+                     "--port", str(eport)], env=env)
+    es2 = None
+    loader = threading.Thread(
+        target=ingest_loop, args=(base, key, acked, stop, lock),
+        daemon=True)
+    try:
+        es.wait_ready(f"{base}/")
+        # warm synchronously before the measured window: the server's
+        # first insert pays one-time lazy init (native-lib probe) that
+        # would otherwise eat the whole SMALL ingest window
+        status, body = http_json(
+            "POST", f"{base}/events.json?accessKey={key}",
+            dict(event_body, entityId="warm"), timeout=30.0)
+        assert status == 201, (status, body)
+        with lock:
+            acked.append(body["eventId"])
+        loader.start()
+        time.sleep(pre_s)
+        with lock:
+            n_before_backup = len(acked)
+        t_bk = time.monotonic()
+        meta_storage = Storage({
+            "PIO_STORAGE_SOURCES_SQ_TYPE": "sqlite",
+            "PIO_STORAGE_SOURCES_SQ_PATH": meta_db,
+        })
+        # ingest keeps flowing while the copy runs — the cut freezes the
+        # point in time, not the writers
+        rep = create_backup(bdir, BackupSource(
+            eventlog_dir=elog_dir, wal_dir=wal_dir, storage=meta_storage))
+        meta_storage.close()
+        backup_s = time.monotonic() - t_bk
+        assert rep["verify"]["clean"], rep["verify"]["errors"]
+        with lock:
+            n_after_backup = len(acked)
+        time.sleep(pre_s / 2)
+        es.kill9()
+        stop.set()
+        loader.join(timeout=10.0)
+        acked_all = list(acked)
+
+        # the disaster: the entire live data surface goes away
+        shutil.rmtree(elog_dir)
+        shutil.rmtree(wal_dir, ignore_errors=True)
+        os.remove(meta_db)
+
+        # RTO clock: restore start → first post-restore ack verifiably in
+        # the restored store (restore wall time reported separately)
+        t_restore = time.monotonic()
+        # full repository config: the WAL tail must replay into the
+        # restored EVENTLOG, not a defaulted sqlite EVENTDATA
+        restore_storage = Storage(env)
+        rr = restore_backup(bdir, RestoreTargets(
+            eventlog_dir=elog_dir, wal_dir=wal_dir),
+            storage=restore_storage, replay_wal=True)
+        restore_storage.close()
+        restore_wall_s = time.monotonic() - t_restore
+        es2 = ServerProc(["eventserver", "--ip", "127.0.0.1",
+                          "--port", str(eport)], env=env)
+        es2.wait_ready(f"{base}/")
+        status, body = http_json(
+            "POST", f"{base}/events.json?accessKey={key}",
+            dict(event_body, entityId="probe-after-restore"), timeout=30.0)
+        assert status == 201, (status, body)
+        probe = body["eventId"]
+        rto_s = time.monotonic() - t_restore
+        es2.sigterm()
+        es2.wait_exit()
+    finally:
+        stop.set()
+        es.stop()
+        if es2 is not None:
+            es2.stop()
+
+    # forensic parity by id set on the restored log itself
+    with open(os.path.join(elog_dir, "app_1.piolog"), "rb") as f:
+        buf = f.read()
+    strings, _live, _ = fmt.read_log(buf)
+    counts: dict = {}
+    for _off, kind, payload in fmt.iter_records(buf):
+        if kind == fmt.KIND_EVENT:
+            eid, _ = fmt.decode_event_payload(payload, strings)
+            counts[eid] = counts.get(eid, 0) + 1
+    stored = set(counts)
+    dup = {k: v for k, v in counts.items() if v > 1}
+    pre_backup = set(acked_all[:n_before_backup])
+    post_backup = set(acked_all[n_before_backup:])
+    lost = (pre_backup | post_backup) - stored
+    if (pre_backup - stored) or dup or not (lost <= post_backup):
+        forensics = {
+            "lost_pre_backup": sorted(pre_backup - stored)[:8],
+            "lost_outside_window": sorted(lost - post_backup)[:8],
+            "duplicates": dict(list(dup.items())[:8]),
+            "cuts": rep["cuts"],
+            "restore": rr,
+        }
+        raise AssertionError(
+            f"DR invariants violated: {json.dumps(forensics, default=str)}")
+    assert probe in stored
+
+    # ---- phase B: backup-from-follower, primary goodput untouched ------
+    follower_phase = _dr_follower_backup_phase(tmp, pre_s, event_body,
+                                               ingest_loop)
+
+    m_after = _metrics_snapshot(REGISTRY.expose())
+    backup_delta = {k: v for k, v in
+                    _snapshot_delta(m_before, m_after).items()
+                    if k.startswith("pio_backup_")}
+    result = {
+        "acked_total": len(acked_all),
+        "acked_before_backup": n_before_backup,
+        "acked_after_backup": len(acked_all) - n_after_backup,
+        "stored_total": len(stored),
+        "acked_lost_pre_cut": len(pre_backup - stored),
+        "rpo_lost_post_backup": len(lost),
+        "duplicate_ids": len(dup),
+        "backup_create_s": round(backup_s, 3),
+        "backup_bytes_stored": rep["bytesStored"],
+        "restore_wall_s_rto": round(restore_wall_s, 3),
+        "recovery_total_s": round(rto_s, 3),
+        "wal_tail_replayed": rr.get("walReplayed"),
+        "backup_metrics_delta": backup_delta,
+        "follower_backup": follower_phase,
+    }
+    shutil.rmtree(tmp, ignore_errors=True)
+    return result
+
+
+def _dr_follower_backup_phase(tmp, pre_s, event_body, ingest_loop) -> dict:
+    """Replicated pair (quorum), event server in front: measure the
+    primary's ack goodput in a clean window, then again WHILE a backup
+    reads the FOLLOWER's data dir — the copy must not dent primary
+    ingest (acceptance: no goodput regression; asserted at ≥0.6 to ride
+    host noise, reported exactly)."""
+    import shutil
+    import threading
+
+    from incubator_predictionio_tpu.backup import (
+        BackupSource,
+        create_backup,
+    )
+    from incubator_predictionio_tpu.data.storage import (
+        AccessKey,
+        App,
+        Storage,
+    )
+    from incubator_predictionio_tpu.parallel.launcher import free_port
+    from tests.fixtures.procs import ServerProc, http_json
+
+    meta_db = os.path.join(tmp, "f-es-meta.db")
+    meta = Storage({
+        "PIO_STORAGE_SOURCES_SQ_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_SQ_PATH": meta_db,
+    })
+    app_id = meta.get_meta_data_apps().insert(App(0, "dr-follower"))
+    key = meta.get_meta_data_access_keys().insert(AccessKey("", app_id, ()))
+    meta.close()
+
+    pport, fport, eport = free_port(), free_port(), free_port()
+    purl, furl = f"http://127.0.0.1:{pport}", f"http://127.0.0.1:{fport}"
+    f_log = os.path.join(tmp, "f-follower-log")
+
+    def store_env(name, log_dir):
+        return {
+            "PIO_STORAGE_SOURCES_EL_TYPE": "eventlog",
+            "PIO_STORAGE_SOURCES_EL_PATH": log_dir,
+            "PIO_STORAGE_SOURCES_SQ_TYPE": "sqlite",
+            "PIO_STORAGE_SOURCES_SQ_PATH": os.path.join(
+                tmp, f"{name}.db"),
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "EL",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQ",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SQ",
+        }
+
+    follower = ServerProc(
+        ["storageserver", "--ip", "127.0.0.1", "--port", str(fport),
+         "--repl-role", "follower", "--repl-sync", "quorum",
+         "--repl-peer", purl],
+        env=store_env("f-follower", f_log))
+    primary = ServerProc(
+        ["storageserver", "--ip", "127.0.0.1", "--port", str(pport),
+         "--repl-role", "primary", "--repl-sync", "quorum",
+         "--repl-peer", furl],
+        env=store_env("f-primary", os.path.join(tmp, "f-primary-log")))
+    es = ServerProc(
+        ["eventserver", "--ip", "127.0.0.1", "--port", str(eport)],
+        env={
+            "PIO_STORAGE_SOURCES_R_TYPE": "remote",
+            "PIO_STORAGE_SOURCES_R_URLS": f"{purl},{furl}",
+            "PIO_STORAGE_SOURCES_R_TIMEOUT": "3",
+            "PIO_STORAGE_SOURCES_SQ_TYPE": "sqlite",
+            "PIO_STORAGE_SOURCES_SQ_PATH": meta_db,
+            "PIO_STORAGE_REPOSITORIES_EVENTDATA_SOURCE": "R",
+            "PIO_STORAGE_REPOSITORIES_METADATA_SOURCE": "SQ",
+            "PIO_STORAGE_REPOSITORIES_MODELDATA_SOURCE": "SQ",
+            "PIO_EVENT_WAL_DIR": os.path.join(tmp, "f-wal"),
+            "PIO_EVENTSERVER_AUTH_TTL": "600",
+        })
+    base = f"http://127.0.0.1:{eport}"
+    acked: list = []
+    lock = threading.Lock()
+    stop = threading.Event()
+    loader = threading.Thread(
+        target=ingest_loop, args=(base, key, acked, stop, lock),
+        daemon=True)
+    try:
+        follower.wait_ready(f"{furl}/")
+        primary.wait_ready(f"{purl}/")
+        es.wait_ready(f"{base}/")
+        status, _body = http_json(
+            "POST", f"{base}/events.json?accessKey={key}",
+            dict(event_body, entityId="warm"), timeout=30.0)
+        assert status == 201, (status, _body)
+        loader.start()
+        time.sleep(pre_s / 2)  # warm
+        with lock:
+            n0 = len(acked)
+        time.sleep(pre_s)
+        with lock:
+            n1 = len(acked)
+        clean_qps = (n1 - n0) / pre_s
+
+        # backup the FOLLOWER's dir while ingest continues; keep copying
+        # (full, no incremental dedupe) for the whole measured window so
+        # the window is copy-saturated
+        bdir = os.path.join(tmp, "f-backups")
+        copies = 0
+        copy_stop = time.monotonic() + pre_s
+        with lock:
+            n2 = len(acked)
+        while time.monotonic() < copy_stop:
+            create_backup(bdir, BackupSource(eventlog_dir=f_log),
+                          incremental=False, self_verify=False)
+            copies += 1
+        copy_window = time.monotonic() - (copy_stop - pre_s)
+        with lock:
+            n3 = len(acked)
+        during_qps = (n3 - n2) / copy_window
+        stop.set()
+        loader.join(timeout=10.0)
+    finally:
+        stop.set()
+        es.stop()
+        primary.stop()
+        follower.stop()
+
+    ratio = during_qps / clean_qps if clean_qps else None
+    assert ratio is None or ratio >= 0.6, (
+        f"follower-dir backup dented primary ingest: {during_qps:.1f} "
+        f"vs {clean_qps:.1f} ack/s (ratio {ratio:.2f})")
+    return {
+        "clean_ack_qps": round(clean_qps, 1),
+        "during_copy_ack_qps": round(during_qps, 1),
+        "goodput_ratio": round(ratio, 3) if ratio is not None else None,
+        "backup_copies_in_window": copies,
+    }
+
+
 # ---------------------------------------------------------------------------
 # 8. event-server ingestion throughput (EventServer.scala:261-462 hot path)
 # ---------------------------------------------------------------------------
@@ -1950,7 +2293,7 @@ CONFIG_NAMES = ["recommendation", "recommendation_scaled", "classification",
                 "sharded_serving", "sequential", "serving", "overload",
                 "fleet", "ingestion", "ingest_durability",
                 "streaming_freshness", "storage_failover",
-                "continuous_training"]
+                "continuous_training", "disaster_recovery"]
 # "fleet" is device-free too: its replicas are CPU subprocesses (a fleet
 # on one host) — the scenario measures the ROUTER's horizontal scaling,
 # not chip throughput; "sharded_serving" likewise runs on 8 virtual CPU
@@ -1959,7 +2302,8 @@ CONFIG_NAMES = ["recommendation", "recommendation_scaled", "classification",
 # the chip
 DEVICE_FREE = {"ingestion", "ingest_durability", "fleet",
                "streaming_freshness", "storage_failover",
-               "sharded_serving", "continuous_training"}
+               "sharded_serving", "continuous_training",
+               "disaster_recovery"}
 
 
 def _build_suite(ctx, peaks, device) -> dict:
@@ -1981,6 +2325,7 @@ def _build_suite(ctx, peaks, device) -> dict:
         "streaming_freshness": lambda: bench_streaming_freshness(),
         "storage_failover": lambda: bench_storage_failover(),
         "continuous_training": lambda: bench_continuous_training(),
+        "disaster_recovery": lambda: bench_disaster_recovery(),
     }
 
 

@@ -1,0 +1,31 @@
+"""Disaster recovery: consistent point-in-time backup + verified restore
+of the entire state surface — eventlog segments, metadata (via the DAO
+dump/load contract), model artifacts + orbax sidecars, the ingest spill
+WAL, streaming state, and the replication fencing state (docs/dr.md).
+
+Driven by ``pio-tpu backup create|verify|restore|list|prune``; the
+``disaster_recovery`` bench lane measures RPO/RTO against a real
+``rm -rf`` of the live data dir.
+"""
+
+from incubator_predictionio_tpu.backup.create import (  # noqa: F401
+    BackupSource,
+    create_backup,
+    dump_metadata,
+    source_from_storage,
+)
+from incubator_predictionio_tpu.backup.manifest import (  # noqa: F401
+    BackupError,
+    BackupSet,
+    entry_summary,
+    prune,
+    read_verify,
+)
+from incubator_predictionio_tpu.backup.restore import (  # noqa: F401
+    RestoreTargets,
+    replay_wal_into,
+    restore_backup,
+)
+from incubator_predictionio_tpu.backup.verify import (  # noqa: F401
+    verify_backup,
+)

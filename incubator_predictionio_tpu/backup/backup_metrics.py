@@ -1,0 +1,64 @@
+"""Disaster-recovery telemetry (docs/observability.md, docs/dr.md).
+
+One module so the backup CLI, the bench lane, and any embedding process
+register the same family names — whichever process runs the backup
+increments its own counters and ``pio-tpu metrics`` reads the union,
+exactly like streaming/stream_metrics.py.
+"""
+
+from __future__ import annotations
+
+from incubator_predictionio_tpu.obs.metrics import REGISTRY
+
+CREATED = REGISTRY.counter(
+    "pio_backup_created_total",
+    "Backups committed (manifest renamed into place); incremental and "
+    "full entries both count")
+
+CREATE_FAILED = REGISTRY.counter(
+    "pio_backup_create_failures_total",
+    "Backup attempts that raised before the manifest committed (the "
+    "half-written .tmp entry is ignored by every reader and pruned)")
+
+VERIFIED = REGISTRY.counter(
+    "pio_backup_verified_total",
+    "Backup verifications that came back clean: every file's CRC range "
+    "digests matched the manifest and every cut landed on a record "
+    "boundary")
+
+VERIFY_FAILED = REGISTRY.counter(
+    "pio_backup_verify_failures_total",
+    "Backup verifications that found a damaged or inconsistent entry "
+    "(also turns the `pio-tpu health --backup-dir` row red)")
+
+RESTORES = REGISTRY.counter(
+    "pio_backup_restores_total",
+    "Restores that completed: every file rehydrated bit-identical "
+    "(CRC-checked while writing) and the metadata dump loaded")
+
+BYTES_COPIED = REGISTRY.counter(
+    "pio_backup_bytes_copied_total",
+    "Bytes physically written into backup entries (incremental backups "
+    "copy only new extents, so this tracks the true copy cost)")
+
+FILES_COPIED = REGISTRY.counter(
+    "pio_backup_files_copied_total",
+    "Files physically written into backup entries (parent-referenced "
+    "unchanged files do not count)")
+
+CREATE_SECONDS = REGISTRY.histogram(
+    "pio_backup_create_seconds",
+    "Wall time of one backup create (read + cut + copy + manifest commit "
+    "+ self-verify)",
+    buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0))
+
+RESTORE_SECONDS = REGISTRY.histogram(
+    "pio_backup_restore_seconds",
+    "Wall time of one verified restore — the measured RTO the "
+    "disaster_recovery bench lane archives",
+    buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0))
+
+CHAIN_LENGTH = REGISTRY.gauge(
+    "pio_backup_chain_length",
+    "Entries in the newest backup's incremental chain (root full backup "
+    "included); prune keeps referenced ancestors alive")

@@ -1,0 +1,377 @@
+"""Consistent point-in-time backup of the whole state surface (docs/dr.md).
+
+The copy never pauses writes. Consistency comes from the state surface's
+own disciplines, per file class:
+
+- **piolog** (eventlog): append-only with immutable records, so the backup
+  takes a **cut** at ``fmt.valid_extent`` of the bytes it read — byte
+  offsets ARE sequence numbers (the feed.py/replication trick), so the
+  prefix up to the cut is a frozen point in time regardless of what the
+  live writer appends afterwards.
+- **frames** (WAL segments, dead-letter files): same argument with the
+  CRC-framed format; the cut is the last complete valid frame
+  (``wal.frame_extent``).
+- **snapshot** (cursor, trainer state, ``repl-state.json``, quarantine
+  marker, model sidecars, orbax step files): everything here is written by
+  the atomic tmp+rename discipline, so any single read observes a whole
+  consistent version.
+- **metadata**: not copied as files at all — dumped through the
+  DAO dump/load contract (storage/base.py), so EngineInstance + JobRecord
+  restore byte-equivalently onto ANY backend, CAS version counters
+  included.
+
+Incremental backups ride the append-only property: a child entry stores
+only the extent past its parent's cut, after re-verifying the parent's
+prefix digests against the live file (a truncated/recreated log falls back
+to a full copy instead of silently composing two histories).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime as _dt
+import os
+import re
+import time
+import zlib
+from typing import Any, Optional
+
+from incubator_predictionio_tpu.backup import backup_metrics as bm
+from incubator_predictionio_tpu.backup.manifest import (
+    DEFAULT_SEGMENT_BYTES,
+    FORMAT_VERSION,
+    BackupSet,
+    Entry,
+    canonical_manifest_bytes,
+    commit_entry,
+    digest_windows,
+    entry_name,
+    manifest_crc,
+)
+from incubator_predictionio_tpu.native import format as fmt
+from incubator_predictionio_tpu.resilience.wal import frame_extent
+from incubator_predictionio_tpu.utils.fs import fsync_dir
+
+#: logical path prefixes, one per backed-up component
+PREFIX_EVENTLOG = "eventlog"
+PREFIX_WAL = "wal"
+PREFIX_STREAM = "stream"
+PREFIX_DEVICE_MODELS = "device_models"
+PREFIX_CHECKPOINTS = "checkpoints"
+META_FILE = "meta/metadata.json"
+MODELS_PREFIX = "models"
+
+_FRAME_NAME_RE = re.compile(r"^(wal-\d+\.log|deadletter\.log)$")
+
+#: metadata DAO accessor names on Storage, dump-section key ↔ getter
+META_STORES = (
+    ("apps", "get_meta_data_apps"),
+    ("access_keys", "get_meta_data_access_keys"),
+    ("channels", "get_meta_data_channels"),
+    ("engine_instances", "get_meta_data_engine_instances"),
+    ("evaluation_instances", "get_meta_data_evaluation_instances"),
+    ("jobs", "get_meta_data_jobs"),
+)
+
+
+@dataclasses.dataclass
+class BackupSource:
+    """What one backup covers. Any component may be absent (None); the
+    manifest records what was present so restore knows what to expect."""
+
+    eventlog_dir: Optional[str] = None        # .piolog logs + repl-state.json
+    wal_dir: Optional[str] = None             # ingest spill WAL
+    stream_state_dir: Optional[str] = None    # cursor/trainer/deltas/quarantine
+    device_models_dir: Optional[str] = None   # orbax sidecars + checkpoints
+    checkpoint_dirs: tuple[str, ...] = ()     # TrainCheckpointer dirs
+    storage: Any = None                       # metadata dump + model blobs
+
+    def components(self) -> dict[str, str]:
+        out: dict[str, str] = {}
+        if self.eventlog_dir:
+            out[PREFIX_EVENTLOG] = os.path.abspath(self.eventlog_dir)
+        if self.wal_dir:
+            out[PREFIX_WAL] = os.path.abspath(self.wal_dir)
+        if self.stream_state_dir:
+            out[PREFIX_STREAM] = os.path.abspath(self.stream_state_dir)
+        if self.device_models_dir:
+            out[PREFIX_DEVICE_MODELS] = os.path.abspath(
+                self.device_models_dir)
+        for i, d in enumerate(self.checkpoint_dirs):
+            out[f"{PREFIX_CHECKPOINTS}/{i}"] = os.path.abspath(d)
+        return out
+
+
+def file_class(logical: str) -> str:
+    """``piolog`` / ``frames`` (append-only with a computable cut) or
+    ``snapshot`` (atomic-write files copied whole)."""
+    base = os.path.basename(logical)
+    if base.endswith(".piolog"):
+        return "piolog"
+    if _FRAME_NAME_RE.match(base):
+        return "frames"
+    return "snapshot"
+
+
+def _cut(logical: str, data: bytes) -> int:
+    cls = file_class(logical)
+    if cls == "piolog":
+        return fmt.valid_extent(data)
+    if cls == "frames":
+        return frame_extent(data)
+    return len(data)
+
+
+def _walk_component(prefix: str, directory: str) -> list[tuple[str, str]]:
+    """(logical_path, abs_path) pairs under one component dir, sorted.
+    In-flight atomic-write temporaries and orbax staging dirs are skipped —
+    they are not state, they are the writer mid-write."""
+    out: list[tuple[str, str]] = []
+    for root, dirs, names in os.walk(directory):
+        dirs[:] = [d for d in dirs if "tmp" not in d.lower()]
+        for name in names:
+            if name.endswith(".tmp") or "tmp" in name.lower():
+                continue
+            abs_path = os.path.join(root, name)
+            rel = os.path.relpath(abs_path, directory)
+            out.append((prefix + "/" + rel.replace(os.sep, "/"), abs_path))
+    out.sort()
+    return out
+
+
+def _prefix_matches(data: bytes, parent_segments: list[list[int]]) -> bool:
+    """Is the live file's prefix still byte-identical to the parent
+    backup's logical copy? Checked window-by-window against the parent's
+    stored digests — an append-only file that was truncated/recreated in
+    between fails here and the child falls back to a full copy."""
+    for off, length, crc in parent_segments:
+        if off + length > len(data):
+            return False
+        if (zlib.crc32(data[off:off + length]) & 0xFFFFFFFF) != crc:
+            return False
+    return True
+
+
+def dump_metadata(storage) -> dict:
+    """All metadata DAOs → one portable JSON dump (the dump/load contract,
+    storage/base.py). DAOs the backend does not serve are omitted."""
+    out: dict[str, list[dict]] = {}
+    for key, getter in META_STORES:
+        try:
+            store = getattr(storage, getter)()
+        except NotImplementedError:
+            continue
+        if key == "channels":
+            # no get_all on the channels DAO: enumerate via the apps dump
+            out[key] = store.dump([a["id"] for a in out.get("apps", ())])
+        else:
+            out[key] = store.dump()
+    return out
+
+
+def collect_model_blobs(storage, meta_dump: dict) -> dict[str, bytes]:
+    """MODELDATA blobs for every dumped engine instance (model id ==
+    instance id, core_workflow.py)."""
+    out: dict[str, bytes] = {}
+    try:
+        models = storage.get_model_data_models()
+    except NotImplementedError:
+        return out
+    for inst in meta_dump.get("engine_instances", ()):
+        m = models.get(inst["id"])
+        if m is not None:
+            out[inst["id"]] = m.models
+    return out
+
+
+def create_backup(backup_dir: str, source: BackupSource,
+                  incremental: bool = True,
+                  segment_bytes: Optional[int] = None,
+                  include_meta: bool = True,
+                  self_verify: bool = True,
+                  now: Optional[_dt.datetime] = None) -> dict:
+    """Take one backup; returns the create report (manifest + verify).
+
+    Reads only — the live writers are never paused, locked, or signaled
+    (which is also why a backup may point at a replication FOLLOWER's data
+    dir: the primary's serving path never sees the copy happen)."""
+    if segment_bytes is None:
+        segment_bytes = int(os.environ.get(
+            "PIO_BACKUP_SEGMENT_BYTES", str(DEFAULT_SEGMENT_BYTES)))
+    # clamp ONCE here, so the manifest records the effective window size
+    # and verify re-windows with exactly what the digests used
+    segment_bytes = max(4096, segment_bytes)
+    t0 = time.perf_counter()
+    try:
+        report = _create(backup_dir, source, incremental, segment_bytes,
+                         include_meta, now)
+    except Exception:
+        bm.CREATE_FAILED.inc()
+        raise
+    bm.CREATED.inc()
+    bm.CREATE_SECONDS.observe(time.perf_counter() - t0)
+    if self_verify:
+        from incubator_predictionio_tpu.backup.verify import verify_backup
+
+        report["verify"] = verify_backup(backup_dir, report["backupId"],
+                                         segment_bytes=segment_bytes)
+    return report
+
+
+def _create(backup_dir: str, source: BackupSource, incremental: bool,
+            segment_bytes: int, include_meta: bool,
+            now: Optional[_dt.datetime]) -> dict:
+    os.makedirs(backup_dir, exist_ok=True)
+    bset = BackupSet(backup_dir)
+    tip = bset.tip()
+    parent: Optional[Entry] = tip if incremental else None
+    seq = tip.seq + 1 if tip is not None else 1
+    backup_id = os.urandom(6).hex()
+    name = entry_name(seq, backup_id)
+    tmp = os.path.join(os.path.abspath(backup_dir), ".tmp-" + name)
+    os.makedirs(os.path.join(tmp, "data"), exist_ok=True)
+
+    components = source.components()
+    files: list[dict] = []
+    cuts: dict[str, int] = {}
+    bytes_stored = 0
+    files_stored = 0
+
+    def add_file(logical: str, data: bytes) -> None:
+        nonlocal bytes_stored, files_stored
+        cut = _cut(logical, data)
+        logical_bytes = data[:cut]
+        cls = file_class(logical)
+        if cls != "snapshot":
+            cuts[logical] = cut
+        crc = zlib.crc32(logical_bytes) & 0xFFFFFFFF
+        segments = digest_windows(logical_bytes, segment_bytes)
+        pfe = parent.file_entry(logical) if parent is not None else None
+        store: dict
+        payload: Optional[bytes]
+        if pfe is not None and cls != "snapshot" \
+                and pfe["size"] <= cut \
+                and _prefix_matches(logical_bytes, pfe["segments"]):
+            if pfe["size"] == cut:
+                store, payload = {"kind": "parent",
+                                  "parent": parent.backup_id}, None
+            else:
+                store = {"kind": "extent", "offset": pfe["size"],
+                         "parent": parent.backup_id}
+                payload = logical_bytes[pfe["size"]:]
+        elif pfe is not None and cls == "snapshot" \
+                and pfe["size"] == cut and pfe["crc32"] == crc:
+            store, payload = {"kind": "parent",
+                              "parent": parent.backup_id}, None
+        else:
+            store, payload = {"kind": "full"}, logical_bytes
+        stored = 0
+        if payload is not None:
+            dest = os.path.join(tmp, "data", logical)
+            os.makedirs(os.path.dirname(dest), exist_ok=True)
+            with open(dest, "wb") as f:
+                f.write(payload)
+                f.flush()
+                os.fsync(f.fileno())
+            stored = len(payload)
+            bytes_stored += stored
+            files_stored += 1
+        files.append({"path": logical, "size": cut, "crc32": crc,
+                      "class": cls, "segments": segments, "store": store,
+                      "storedBytes": stored})
+
+    # snapshot-class state first, the eventlog cut LAST: the streaming
+    # cursor can then only trail the cut (restore still clamps, but the
+    # normal case needs no clamp)
+    ordered = sorted(components.items(),
+                     key=lambda kv: kv[0] == PREFIX_EVENTLOG)
+    for prefix, directory in ordered:
+        for logical, abs_path in _walk_component(prefix, directory):
+            try:
+                with open(abs_path, "rb") as f:
+                    data = f.read()
+            except (FileNotFoundError, IsADirectoryError):
+                continue  # vanished mid-walk (orbax GC, segment commit)
+            add_file(logical, data)
+
+    meta_dump: dict = {}
+    if include_meta and source.storage is not None:
+        import json as _json
+
+        meta_dump = dump_metadata(source.storage)
+        add_file(META_FILE, _json.dumps(
+            meta_dump, sort_keys=True, separators=(",", ":")).encode())
+        for model_id, blob in sorted(
+                collect_model_blobs(source.storage, meta_dump).items()):
+            add_file(f"{MODELS_PREFIX}/{model_id}", blob)
+
+    manifest = {
+        "formatVersion": FORMAT_VERSION,
+        "backupId": backup_id,
+        "seq": seq,
+        "parent": parent.backup_id if parent is not None else None,
+        "parentManifestCrc": (manifest_crc(parent.manifest)
+                              if parent is not None else None),
+        "createdAt": (now or _dt.datetime.now(_dt.timezone.utc)
+                      ).isoformat(),
+        "segmentBytes": segment_bytes,
+        "components": {k: v for k, v in components.items()},
+        "cuts": cuts,
+        "meta": {k: len(v) for k, v in meta_dump.items()},
+        "files": files,
+    }
+    with open(os.path.join(tmp, "MANIFEST.json"), "wb") as f:
+        f.write(canonical_manifest_bytes(manifest))
+        f.flush()
+        os.fsync(f.fileno())
+    fsync_dir(tmp)
+    fsync_dir(os.path.join(tmp, "data"))
+    commit_entry(os.path.abspath(backup_dir), tmp, name)
+    bm.BYTES_COPIED.inc(bytes_stored)
+    bm.FILES_COPIED.inc(files_stored)
+    committed = BackupSet(backup_dir)
+    bm.CHAIN_LENGTH.set(len(committed.chain(committed.get(backup_id))))
+    return {
+        "backupId": backup_id,
+        "name": name,
+        "seq": seq,
+        "parent": manifest["parent"],
+        "files": len(files),
+        "bytesStored": bytes_stored,
+        "bytesLogical": sum(fe["size"] for fe in files),
+        "cuts": cuts,
+        "meta": manifest["meta"],
+    }
+
+
+def source_from_storage(storage, eventlog_dir: Optional[str] = None,
+                        wal_dir: Optional[str] = None,
+                        stream_state_dir: Optional[str] = None,
+                        device_models_dir: Optional[str] = None,
+                        checkpoint_dirs: tuple[str, ...] = (),
+                        ) -> BackupSource:
+    """Resolve defaults from the configured storage: the eventlog dir from
+    an ``eventlog`` EVENTDATA backend, the device-model sidecar tree from
+    the PIO_FS_BASEDIR convention (only when it already exists — a backup
+    must not create state)."""
+    if eventlog_dir is None:
+        try:
+            from incubator_predictionio_tpu.data.storage.eventlog_backend \
+                import EventLogEvents
+
+            events = storage.get_events()
+            if isinstance(events, EventLogEvents):
+                eventlog_dir = events.base_dir
+        except Exception:  # noqa: BLE001 - no EVENTDATA configured
+            eventlog_dir = None
+    if device_models_dir is None:
+        from incubator_predictionio_tpu.utils.fs import base_dir
+
+        cand = os.path.join(base_dir(), "device_models")
+        if os.path.isdir(cand):
+            device_models_dir = cand
+    return BackupSource(
+        eventlog_dir=eventlog_dir, wal_dir=wal_dir,
+        stream_state_dir=stream_state_dir,
+        device_models_dir=device_models_dir,
+        checkpoint_dirs=tuple(checkpoint_dirs), storage=storage)

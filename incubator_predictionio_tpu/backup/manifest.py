@@ -1,0 +1,353 @@
+"""Backup-set layout: atomic, chained, self-describing manifests.
+
+A backup directory holds committed entries named ``bk-<seq>-<id>``; each
+entry is one point-in-time backup::
+
+    bk-00000001-3f2a9c01d4e5/
+        MANIFEST.json       # what the backup logically contains
+        verify.json         # last verification verdict (create self-verifies)
+        data/<path>         # physical bytes (full copy, or just a new extent)
+
+The manifest is the unit of atomicity: everything is written into a
+``.tmp-`` sibling, fsynced, and the DIRECTORY is renamed into place last —
+a reader never sees a half-written entry, and a crashed create leaves only
+an ignorable ``.tmp-`` stub.
+
+**Chaining.** Every entry names its ``parent`` (the previous chain tip)
+and carries the CRC of the parent's canonical manifest bytes, so a
+swapped-out or regenerated ancestor is detected, not silently trusted.
+Append-only files (eventlog ``.piolog``, WAL segments) store only the
+extent past the parent's copy; unchanged snapshot files store nothing and
+reference the parent. Resolving a logical file walks the chain down to a
+full copy — :meth:`BackupSet.iter_file`.
+
+Digest format is shared with the anti-entropy scrubber
+(replication/scrub.py): fixed byte windows of ``[offset, length, crc32]``,
+so ``verify`` and ``scrub`` agree about what "bit-identical" means.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import os
+import re
+import shutil
+import zlib
+from dataclasses import dataclass
+from typing import Iterator, Optional
+
+from incubator_predictionio_tpu.utils.fs import atomic_write_bytes, fsync_dir
+
+MANIFEST_NAME = "MANIFEST.json"
+VERIFY_NAME = "verify.json"
+DATA_DIR = "data"
+FORMAT_VERSION = 1
+
+#: digest window size (PIO_BACKUP_SEGMENT_BYTES) — same default as the
+#: replication scrubber's range digests
+DEFAULT_SEGMENT_BYTES = 1 << 20
+
+_ENTRY_RE = re.compile(r"^bk-(\d{8})-([0-9a-f]{12})$")
+
+
+class BackupError(Exception):
+    """A backup entry is missing, damaged, or its chain is broken."""
+
+
+def entry_name(seq: int, backup_id: str) -> str:
+    return f"bk-{seq:08d}-{backup_id}"
+
+
+def canonical_manifest_bytes(manifest: dict) -> bytes:
+    """The byte form the chain CRC covers — ONE canonicalization, so the
+    writer and every later verifier hash identical bytes."""
+    return json.dumps(manifest, sort_keys=True,
+                      separators=(",", ":")).encode()
+
+
+def manifest_crc(manifest: dict) -> int:
+    return zlib.crc32(canonical_manifest_bytes(manifest)) & 0xFFFFFFFF
+
+
+def digest_windows(data: bytes, segment_bytes: int) -> list[list[int]]:
+    """``[[offset, length, crc32], ...]`` over fixed windows of ``data`` —
+    the in-memory twin of ``replication.scrub.file_digests`` (same window
+    scheme and row shape, so the formats cannot drift). Callers pass an
+    already-clamped window size (create_backup clamps once so the
+    manifest records exactly what the digests used)."""
+    out: list[list[int]] = []
+    for off in range(0, len(data), segment_bytes):
+        chunk = data[off:off + segment_bytes]
+        out.append([off, len(chunk), zlib.crc32(chunk) & 0xFFFFFFFF])
+    return out
+
+
+@dataclass
+class Entry:
+    """One committed backup entry on disk."""
+
+    name: str
+    seq: int
+    backup_id: str
+    path: str
+    manifest: dict
+
+    def data_path(self, logical: str) -> str:
+        return os.path.join(self.path, DATA_DIR, logical)
+
+    def file_entry(self, logical: str) -> Optional[dict]:
+        for fe in self.manifest["files"]:
+            if fe["path"] == logical:
+                return fe
+        return None
+
+
+def read_manifest(entry_path: str) -> dict:
+    try:
+        with open(os.path.join(entry_path, MANIFEST_NAME)) as f:
+            return json.load(f)
+    except (FileNotFoundError, ValueError) as e:
+        raise BackupError(f"unreadable manifest in {entry_path}: {e}") from e
+
+
+def read_verify(entry_path: str) -> Optional[dict]:
+    try:
+        with open(os.path.join(entry_path, VERIFY_NAME)) as f:
+            return json.load(f)
+    except (FileNotFoundError, ValueError):
+        return None
+
+
+def write_verify(entry_path: str, report: dict) -> None:
+    atomic_write_bytes(
+        os.path.join(entry_path, VERIFY_NAME),
+        json.dumps(report, sort_keys=True, indent=1).encode(),
+        durable=True)
+
+
+class BackupSet:
+    """Read-side view of one backup directory.
+
+    The entry listing (one manifest parse per committed entry) is
+    memoized per instance: chain walks and per-file piece resolution
+    consult it once per operation instead of re-parsing every manifest
+    per logical file. Construct a fresh BackupSet (or call
+    :meth:`refresh`) to observe entries committed since."""
+
+    def __init__(self, backup_dir: str):
+        self.backup_dir = os.path.abspath(backup_dir)
+        self._entries: Optional[list[Entry]] = None
+
+    def refresh(self) -> None:
+        self._entries = None
+
+    def entries(self) -> list[Entry]:
+        """Committed entries in chain order (ascending seq). ``.tmp-``
+        stubs and foreign names are ignored."""
+        if self._entries is not None:
+            return self._entries
+        out: list[Entry] = []
+        try:
+            names = os.listdir(self.backup_dir)
+        except FileNotFoundError:
+            self._entries = []
+            return self._entries
+        for name in names:
+            m = _ENTRY_RE.match(name)
+            if not m:
+                continue
+            path = os.path.join(self.backup_dir, name)
+            out.append(Entry(name=name, seq=int(m.group(1)),
+                             backup_id=m.group(2), path=path,
+                             manifest=read_manifest(path)))
+        out.sort(key=lambda e: e.seq)
+        self._entries = out
+        return out
+
+    def tip(self) -> Optional[Entry]:
+        entries = self.entries()
+        return entries[-1] if entries else None
+
+    def get(self, backup_id: str) -> Entry:
+        for e in self.entries():
+            if e.backup_id == backup_id:
+                return e
+        raise BackupError(
+            f"no backup {backup_id!r} in {self.backup_dir} "
+            f"(`pio-tpu backup list` names what exists)")
+
+    def resolve(self, backup_id: Optional[str]) -> Entry:
+        if backup_id is not None:
+            return self.get(backup_id)
+        tip = self.tip()
+        if tip is None:
+            raise BackupError(f"no backups in {self.backup_dir}")
+        return tip
+
+    def chain(self, entry: Entry) -> list[Entry]:
+        """Root-first ancestor chain of ``entry``, with every parent link
+        verified against the child's recorded parent-manifest CRC — a
+        regenerated or swapped ancestor fails here, never silently feeds
+        bytes into a restore."""
+        by_id = {e.backup_id: e for e in self.entries()}
+        chain: list[Entry] = [entry]
+        cur = entry
+        while cur.manifest.get("parent"):
+            parent_id = cur.manifest["parent"]
+            parent = by_id.get(parent_id)
+            if parent is None:
+                raise BackupError(
+                    f"backup {cur.backup_id} references missing parent "
+                    f"{parent_id} — the chain was pruned out from under it")
+            got = manifest_crc(parent.manifest)
+            want = cur.manifest.get("parentManifestCrc")
+            if got != want:
+                raise BackupError(
+                    f"backup {cur.backup_id}'s parent {parent_id} has a "
+                    f"different manifest than when the child was taken "
+                    f"(crc {got} != recorded {want})")
+            chain.append(parent)
+            cur = parent
+        chain.reverse()
+        return chain
+
+    # -- logical file resolution ------------------------------------------
+    def _pieces(self, entry: Entry, logical: str
+                ) -> list[tuple[str, int, int]]:
+        """``(abs_path, logical_offset, length)`` pieces composing the
+        logical file, ascending offset; walks parent references down to a
+        full copy."""
+        by_id = {e.backup_id: e for e in self.entries()}
+        pieces: list[tuple[str, int, int]] = []
+        cur, path = entry, logical
+        while True:
+            fe = cur.file_entry(path)
+            if fe is None:
+                raise BackupError(
+                    f"backup {cur.backup_id} has no file {path!r}")
+            store = fe["store"]
+            kind = store["kind"]
+            if kind == "full":
+                pieces.append((cur.data_path(path), 0, fe["size"]))
+                break
+            parent = by_id.get(store["parent"])
+            if parent is None:
+                raise BackupError(
+                    f"backup {cur.backup_id} file {path!r} references "
+                    f"missing parent backup {store['parent']}")
+            if kind == "extent":
+                pieces.append((cur.data_path(path), store["offset"],
+                               fe["size"] - store["offset"]))
+            elif kind != "parent":
+                raise BackupError(f"unknown store kind {kind!r} for {path!r}")
+            cur = parent
+        pieces.reverse()
+        return pieces
+
+    def iter_file(self, entry: Entry, logical: str,
+                  chunk_bytes: int = 1 << 20) -> Iterator[bytes]:
+        """Stream the logical bytes of ``logical`` at ``entry`` by walking
+        the chain pieces in order — O(chunk) memory however long the
+        chain or large the log."""
+        expect_off = 0
+        for path, off, length in self._pieces(entry, logical):
+            if off != expect_off:
+                raise BackupError(
+                    f"{logical!r}: chain pieces are not contiguous "
+                    f"(offset {off}, expected {expect_off})")
+            try:
+                f = open(path, "rb")
+            except FileNotFoundError as e:
+                raise BackupError(
+                    f"{logical!r}: missing data file {path}") from e
+            with f:
+                remaining = length
+                while remaining > 0:
+                    chunk = f.read(min(chunk_bytes, remaining))
+                    if not chunk:
+                        raise BackupError(
+                            f"{logical!r}: {path} shorter than the "
+                            f"manifest records ({remaining} bytes missing)")
+                    remaining -= len(chunk)
+                    yield chunk
+            expect_off += length
+
+    def read_file(self, entry: Entry, logical: str) -> bytes:
+        return b"".join(self.iter_file(entry, logical))
+
+
+def commit_entry(backup_dir: str, tmp_path: str, name: str) -> str:
+    """Atomically promote a fully-written ``.tmp-`` entry: rename into the
+    final name, then fsync the backup dir so the commit survives a power
+    cut. The rename IS the commit point."""
+    final = os.path.join(backup_dir, name)
+    os.rename(tmp_path, final)
+    fsync_dir(backup_dir)
+    return final
+
+
+def discard_tmp(backup_dir: str) -> list[str]:
+    """Delete leftover ``.tmp-`` stubs from crashed creates."""
+    removed = []
+    try:
+        names = os.listdir(backup_dir)
+    except FileNotFoundError:
+        return removed
+    for name in names:
+        if name.startswith(".tmp-"):
+            shutil.rmtree(os.path.join(backup_dir, name),
+                          ignore_errors=True)
+            removed.append(name)
+    return removed
+
+
+def prune(backup_dir: str, keep: int) -> list[str]:
+    """Delete old entries while keeping the newest ``keep`` entries AND
+    every ancestor their chains reference — an incremental child must
+    never lose the full copy under it. Also clears crashed ``.tmp-``
+    stubs. Returns the removed entry names."""
+    bset = BackupSet(backup_dir)
+    entries = bset.entries()
+    removed = discard_tmp(backup_dir)
+    if keep < 1:
+        keep = 1
+    kept_ids: set[str] = set()
+    for e in entries[-keep:]:
+        for anc in bset.chain(e):
+            kept_ids.add(anc.backup_id)
+    for e in entries:
+        if e.backup_id not in kept_ids:
+            shutil.rmtree(e.path, ignore_errors=True)
+            removed.append(e.name)
+    if removed:
+        fsync_dir(backup_dir)
+    return removed
+
+
+def entry_summary(bset: BackupSet, e: Entry) -> dict:
+    """One ``pio-tpu backup list`` row."""
+    man = e.manifest
+    v = read_verify(e.path)
+    stored = sum(f.get("storedBytes", 0) for f in man["files"])
+    return {
+        "backupId": e.backup_id,
+        "seq": e.seq,
+        "createdAt": man.get("createdAt"),
+        "parent": man.get("parent"),
+        "files": len(man["files"]),
+        "logicalBytes": sum(f["size"] for f in man["files"]),
+        "storedBytes": stored,
+        "cuts": man.get("cuts", {}),
+        "verified": bool(v and v.get("clean")),
+        "verifiedAt": v.get("at") if v else None,
+    }
+
+
+def parse_iso(s: Optional[str]) -> Optional[_dt.datetime]:
+    if not s:
+        return None
+    try:
+        return _dt.datetime.fromisoformat(s)
+    except ValueError:
+        return None

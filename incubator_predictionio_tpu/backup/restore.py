@@ -1,0 +1,324 @@
+"""Verified restore: rehydrate a fresh data dir bit-identical to the cut.
+
+The restore is **verified while it writes**: every logical file streams
+through its chain pieces with a running CRC, and a mismatch aborts before
+the restored host can serve a byte the manifest never promised. After the
+files land:
+
+- **metadata** loads through the DAO dump/load contract into whatever
+  METADATA backend the restored host is configured with (it need not be
+  the backend the backup came from);
+- **model blobs** re-insert into MODELDATA keyed by instance id;
+- the **streaming cursor is clamped** to the eventlog cut. The cursor is
+  portable at all because the restored log is byte-identical up to the cut
+  (offsets ARE sequence numbers); a cursor that got copied a moment after
+  the log cut may point past it, and a clamp re-folds that suffix instead
+  of skipping it. Trainer state and archived deltas past the cut are
+  dropped for the same reason — they describe events the restored log does
+  not contain;
+- the **replication epoch is bumped** (``repl-state.json``), so any peer
+  still holding the pre-disaster epoch is fenced the moment it talks to
+  the restored host — the promote-time discipline from
+  replication/manager.py applied to restore;
+- the **WAL tail replays** (optionally here, otherwise at the event
+  server's next startup): acked-but-unstored events land in the store
+  idempotently, which is exactly the RPO statement — nothing acked before
+  the cut is lost, and the unflushed tail is bounded by the WAL.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import time
+import zlib
+from typing import Any, Optional
+
+from incubator_predictionio_tpu.backup import backup_metrics as bm
+from incubator_predictionio_tpu.backup.create import (
+    META_FILE,
+    META_STORES,
+    MODELS_PREFIX,
+    PREFIX_CHECKPOINTS,
+    PREFIX_DEVICE_MODELS,
+    PREFIX_EVENTLOG,
+    PREFIX_STREAM,
+    PREFIX_WAL,
+)
+from incubator_predictionio_tpu.backup.manifest import (
+    BackupError,
+    BackupSet,
+)
+from incubator_predictionio_tpu.utils.fs import atomic_write_bytes, fsync_dir
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class RestoreTargets:
+    """Where each backed-up component lands. A component present in the
+    backup but without a target here is skipped (named in the report)."""
+
+    eventlog_dir: Optional[str] = None
+    wal_dir: Optional[str] = None
+    stream_state_dir: Optional[str] = None
+    device_models_dir: Optional[str] = None
+    checkpoint_dirs: tuple[str, ...] = ()
+
+    def mapping(self) -> dict[str, str]:
+        out: dict[str, str] = {}
+        if self.eventlog_dir:
+            out[PREFIX_EVENTLOG] = os.path.abspath(self.eventlog_dir)
+        if self.wal_dir:
+            out[PREFIX_WAL] = os.path.abspath(self.wal_dir)
+        if self.stream_state_dir:
+            out[PREFIX_STREAM] = os.path.abspath(self.stream_state_dir)
+        if self.device_models_dir:
+            out[PREFIX_DEVICE_MODELS] = os.path.abspath(
+                self.device_models_dir)
+        for i, d in enumerate(self.checkpoint_dirs):
+            out[f"{PREFIX_CHECKPOINTS}/{i}"] = os.path.abspath(d)
+        return out
+
+
+def _target_for(mapping: dict[str, str], logical: str
+                ) -> Optional[tuple[str, str]]:
+    """(abs_destination, prefix) for one logical path, longest prefix
+    wins (``checkpoints/0`` before ``checkpoints``)."""
+    best = None
+    for prefix, directory in mapping.items():
+        if logical.startswith(prefix + "/"):
+            if best is None or len(prefix) > len(best[1]):
+                rel = logical[len(prefix) + 1:]
+                best = (os.path.join(directory, rel), prefix)
+    return best
+
+
+def restore_backup(backup_dir: str, targets: RestoreTargets,
+                   backup_id: Optional[str] = None,
+                   storage: Any = None,
+                   load_meta: bool = True,
+                   load_models: bool = True,
+                   epoch_bump: bool = True,
+                   replay_wal: bool = False,
+                   force: bool = False) -> dict:
+    """Restore one entry (default: the newest). Refuses a non-empty target
+    directory unless ``force`` — a restore rehydrates a FRESH data dir; it
+    must never silently merge into a live one."""
+    t0 = time.perf_counter()
+    bset = BackupSet(backup_dir)
+    entry = bset.resolve(backup_id)
+    bset.chain(entry)  # chain integrity gate before any byte lands
+    mapping = targets.mapping()
+    if not force:
+        for prefix, directory in mapping.items():
+            if os.path.isdir(directory) and os.listdir(directory):
+                raise BackupError(
+                    f"restore target {directory} ({prefix}) is not empty — "
+                    "a restore rehydrates a fresh data dir; pass force "
+                    "after confirming the survivor state is disposable")
+
+    restored_files = 0
+    restored_bytes = 0
+    skipped: list[str] = []
+    for fe in entry.manifest["files"]:
+        logical = fe["path"]
+        if logical == META_FILE or logical.startswith(MODELS_PREFIX + "/"):
+            continue  # loaded through the DAO contract below, not as files
+        tgt = _target_for(mapping, logical)
+        if tgt is None:
+            skipped.append(logical)
+            continue
+        dest, _prefix = tgt
+        os.makedirs(os.path.dirname(dest), exist_ok=True)
+        crc = 0
+        size = 0
+        with open(dest, "wb") as f:
+            for chunk in bset.iter_file(entry, logical):
+                crc = zlib.crc32(chunk, crc)
+                size += len(chunk)
+                f.write(chunk)
+            f.flush()
+            os.fsync(f.fileno())
+        if size != fe["size"] or (crc & 0xFFFFFFFF) != fe["crc32"]:
+            raise BackupError(
+                f"restore of {logical!r} did not verify (size {size} vs "
+                f"{fe['size']}, crc mismatch={crc & 0xFFFFFFFF != fe['crc32']})"
+                " — backup entry damaged; run `pio-tpu backup verify`")
+        restored_files += 1
+        restored_bytes += size
+    for directory in mapping.values():
+        if os.path.isdir(directory):
+            fsync_dir(directory)
+
+    report: dict = {
+        "backupId": entry.backup_id,
+        "filesRestored": restored_files,
+        "bytesRestored": restored_bytes,
+        "skippedComponents": sorted({p.split("/", 1)[0] for p in skipped}),
+        "cuts": entry.manifest.get("cuts", {}),
+    }
+    report.update(_clamp_stream_state(entry, targets))
+    report["epoch"] = _bump_epoch(targets, epoch_bump)
+    if storage is not None:
+        report["meta"] = _load_meta(bset, entry, storage, load_meta,
+                                    load_models)
+    if replay_wal and storage is not None and targets.wal_dir:
+        report["walReplayed"] = replay_wal_into(targets.wal_dir, storage)
+    rto = time.perf_counter() - t0
+    bm.RESTORES.inc()
+    bm.RESTORE_SECONDS.observe(rto)
+    report["seconds"] = round(rto, 3)
+    return report
+
+
+def _clamp_stream_state(entry, targets: RestoreTargets) -> dict:
+    """Clamp the restored streaming cursor to the eventlog cut and drop
+    trainer state / archived deltas describing events past it."""
+    out = {"cursorClamped": False, "trainerStateDropped": False,
+           "deltasDropped": 0}
+    if not targets.stream_state_dir:
+        return out
+    cuts = {p: c for p, c in entry.manifest.get("cuts", {}).items()
+            if p.startswith(PREFIX_EVENTLOG + "/")
+            and p.endswith(".piolog")}
+    if not cuts:
+        return out
+    # single-feed assumption: clamp against the largest cut — the feed's
+    # own boundary walk (feed._bootstrap) still fails loudly if the cursor
+    # belongs to a different log
+    cut = max(cuts.values())
+    from incubator_predictionio_tpu.streaming import delta as deltas
+    from incubator_predictionio_tpu.streaming import feed as feeds
+    from incubator_predictionio_tpu.streaming.updater import TRAINER_STATE
+
+    state_dir = targets.stream_state_dir
+    cursor = feeds.read_cursor(state_dir)
+    if cursor is not None and cursor.get("seq", 0) > cut:
+        cursor["seq"] = cut
+        cursor["delta_head"] = min(cursor.get("delta_head", cut), cut)
+        feeds.write_cursor(state_dir, cursor)
+        out["cursorClamped"] = True
+        logger.warning("restore: streaming cursor clamped to eventlog "
+                       "cut %d (the suffix will re-fold)", cut)
+    state_path = os.path.join(state_dir, TRAINER_STATE)
+    if os.path.exists(state_path):
+        import pickle
+
+        try:
+            with open(state_path, "rb") as f:
+                state = pickle.load(f)
+            ahead = state.get("to_seq", 0) > cut
+        except Exception:  # noqa: BLE001 - unreadable state is stale state
+            ahead = True
+        if ahead:
+            os.remove(state_path)
+            out["trainerStateDropped"] = True
+    for from_seq, to_seq, path in deltas.list_archived(state_dir):
+        if to_seq > cut:
+            os.remove(path)
+            out["deltasDropped"] += 1
+    return out
+
+
+def _bump_epoch(targets: RestoreTargets, epoch_bump: bool
+                ) -> Optional[dict]:
+    """Bump the restored replication epoch so peers still holding the
+    pre-disaster epoch are fenced on first contact (the promote-time
+    ordering: persist the higher epoch BEFORE the host serves anything)."""
+    if not targets.eventlog_dir:
+        return None
+    from incubator_predictionio_tpu.replication.manager import STATE_FILE
+
+    path = os.path.join(targets.eventlog_dir, STATE_FILE)
+    try:
+        with open(path) as f:
+            st = json.load(f)
+    except FileNotFoundError:
+        return None
+    except ValueError:
+        raise BackupError(
+            f"restored {path} is corrupt — refusing to guess a fencing "
+            "epoch (docs/replication.md)")
+    before = int(st.get("epoch", 1))
+    if epoch_bump:
+        st["epoch"] = before + 1
+        atomic_write_bytes(path, json.dumps(st, sort_keys=True).encode(),
+                           durable=True)
+    return {"epochBefore": before, "epochAfter": int(st["epoch"]),
+            "bumped": epoch_bump}
+
+
+def _load_meta(bset: BackupSet, entry, storage, load_meta: bool,
+               load_models: bool) -> dict:
+    out: dict = {"loaded": {}, "models": 0}
+    if load_meta and entry.file_entry(META_FILE) is not None:
+        dump = json.loads(bset.read_file(entry, META_FILE))
+        for key, getter in META_STORES:
+            if key not in dump:
+                continue
+            try:
+                store = getattr(storage, getter)()
+            except NotImplementedError:
+                continue
+            if key == "channels":
+                # the channels DAO can only enumerate per app: wipe the
+                # restored apps' channels so load REPLACES, not merges
+                store.load(dump[key],
+                           app_ids=[a["id"] for a in dump.get("apps", ())])
+            else:
+                store.load(dump[key])
+            out["loaded"][key] = len(dump[key])
+    if load_models:
+        from incubator_predictionio_tpu.data.storage.base import Model
+
+        try:
+            models = storage.get_model_data_models()
+        except NotImplementedError:
+            return out
+        for fe in entry.manifest["files"]:
+            if fe["path"].startswith(MODELS_PREFIX + "/"):
+                model_id = fe["path"].split("/", 1)[1]
+                models.insert(Model(model_id,
+                                    bset.read_file(entry, fe["path"])))
+                out["models"] += 1
+    return out
+
+
+def replay_wal_into(wal_dir: str, storage) -> int:
+    """Replay every pending WAL record into the configured event store —
+    the ``pio-tpu wal --replay`` loop as a library call so restore (and
+    the bench lane) can finish the RPO story in one verb. Idempotent: ids
+    were assigned before the first ack, so records that did land overwrite
+    themselves."""
+    from incubator_predictionio_tpu.data.event import Event
+    from incubator_predictionio_tpu.resilience.wal import SpillWal
+
+    wal = SpillWal(wal_dir)
+    try:
+        pending = wal.replay()
+        if not pending:
+            return 0
+        events_store = storage.get_events()
+        replayed = 0
+        i = 0
+        while i < len(pending):
+            app_id = pending[i]["app_id"]
+            channel_id = pending[i].get("channel_id")
+            batch = []
+            while (i < len(pending) and len(batch) < 50
+                   and pending[i]["app_id"] == app_id
+                   and pending[i].get("channel_id") == channel_id):
+                batch.append(pending[i])
+                i += 1
+            events_store.init(app_id, channel_id)
+            events_store.insert_batch(
+                [Event.from_json_dict(r["event"]) for r in batch],
+                app_id, channel_id)
+            wal.commit(max(r["seq"] for r in batch))
+            replayed += len(batch)
+        return replayed
+    finally:
+        wal.close()

@@ -532,7 +532,47 @@ class Model:
 # Meta-data DAO contracts
 # ---------------------------------------------------------------------------
 
-class AppsStore(abc.ABC):
+class DumpLoadMixin:
+    """Portable dump/load contract every metadata DAO inherits — the
+    backup/restore surface (docs/dr.md).
+
+    ``dump()`` serializes every record to the wire-codec JSON dicts (the
+    same encoding the remote backend ships over RPC, so a dump taken from
+    any backend loads into any other) sorted by primary key for stable
+    manifests; ``load()`` REPLACES the store's contents with the dumped
+    records verbatim — including optimistic-concurrency state like
+    ``JobRecord.version``/``fence``, because a restored job must keep
+    rejecting a fenced zombie's stale CAS exactly as the original would
+    have (tests/test_storage_contract.py pins this per backend).
+
+    Defaults ride the CRUD contract (every backend's ``insert`` writes the
+    record verbatim, auto-generating only empty ids), so all five METADATA
+    backends inherit working dump/load without backend code.
+    """
+
+    #: the record's primary-key attr (and manifest sort key)
+    _DUMP_KEY = "id"
+
+    @classmethod
+    def _dump_codec(cls):
+        """(encode, decode) wire-codec pair; imported lazily because
+        wire.py imports this module."""
+        raise NotImplementedError
+
+    def dump(self) -> list[dict]:
+        enc, _ = self._dump_codec()
+        return sorted((enc(r) for r in self.get_all()),
+                      key=lambda d: str(d[self._DUMP_KEY]))
+
+    def load(self, records: Sequence[dict]) -> None:
+        _, dec = self._dump_codec()
+        for existing in self.get_all():
+            self.delete(getattr(existing, self._DUMP_KEY))
+        for d in records:
+            self.insert(dec(d))
+
+
+class AppsStore(DumpLoadMixin, abc.ABC):
     """(Apps.scala:40-75)"""
 
     @abc.abstractmethod
@@ -554,8 +594,14 @@ class AppsStore(abc.ABC):
     @abc.abstractmethod
     def delete(self, app_id: int) -> bool: ...
 
+    @classmethod
+    def _dump_codec(cls):
+        from incubator_predictionio_tpu.data.storage import wire
 
-class AccessKeysStore(abc.ABC):
+        return wire.enc_app, wire.dec_app
+
+
+class AccessKeysStore(DumpLoadMixin, abc.ABC):
     """(AccessKeys.scala:42-77)"""
 
     @abc.abstractmethod
@@ -582,8 +628,16 @@ class AccessKeysStore(abc.ABC):
         """64 url-safe chars (reference: Random.alphanumeric, AccessKeys.scala:55)."""
         return secrets.token_urlsafe(48)[:64]
 
+    _DUMP_KEY = "key"
 
-class ChannelsStore(abc.ABC):
+    @classmethod
+    def _dump_codec(cls):
+        from incubator_predictionio_tpu.data.storage import wire
+
+        return wire.enc_access_key, wire.dec_access_key
+
+
+class ChannelsStore(DumpLoadMixin, abc.ABC):
     """(Channels.scala:47-80)"""
 
     @abc.abstractmethod
@@ -598,8 +652,38 @@ class ChannelsStore(abc.ABC):
     @abc.abstractmethod
     def delete(self, channel_id: int) -> bool: ...
 
+    @classmethod
+    def _dump_codec(cls):
+        from incubator_predictionio_tpu.data.storage import wire
 
-class EngineInstancesStore(abc.ABC):
+        return wire.enc_channel, wire.dec_channel
+
+    def dump(self, app_ids: Sequence[int] = ()) -> list[dict]:
+        """The channels DAO has no ``get_all`` (Channels.scala:47-80), so
+        a dump enumerates via the apps it belongs to — the backup passes
+        the app ids from its own apps dump."""
+        enc, _ = self._dump_codec()
+        out = []
+        for app_id in app_ids:
+            out.extend(enc(c) for c in self.get_by_app_id(app_id))
+        return sorted(out, key=lambda d: str(d["id"]))
+
+    def load(self, records: Sequence[dict],
+             app_ids: Sequence[int] = ()) -> None:
+        """REPLACE semantics like the mixin's, scoped to what this DAO can
+        enumerate: every channel of the given apps (the restore passes the
+        app ids from its apps dump) is wiped before the records land, so a
+        post-dump channel cannot survive into the restored state."""
+        _, dec = self._dump_codec()
+        for app_id in app_ids:
+            for existing in self.get_by_app_id(app_id):
+                self.delete(existing.id)
+        for d in records:
+            self.delete(d["id"])
+            self.insert(dec(d))
+
+
+class EngineInstancesStore(DumpLoadMixin, abc.ABC):
     """(EngineInstances.scala:55-95)"""
 
     @abc.abstractmethod
@@ -647,8 +731,14 @@ class EngineInstancesStore(abc.ABC):
         out.sort(key=lambda i: i.start_time, reverse=True)
         return out
 
+    @classmethod
+    def _dump_codec(cls):
+        from incubator_predictionio_tpu.data.storage import wire
 
-class JobsStore(abc.ABC):
+        return wire.enc_engine_instance, wire.dec_engine_instance
+
+
+class JobsStore(DumpLoadMixin, abc.ABC):
     """Durable job queue DAO (docs/jobs.md) — the control plane's only
     storage dependency, so any METADATA backend can host it.
 
@@ -692,8 +782,14 @@ class JobsStore(abc.ABC):
             tzinfo=_dt.timezone.utc), j.id))
         return out
 
+    @classmethod
+    def _dump_codec(cls):
+        from incubator_predictionio_tpu.data.storage import wire
 
-class EvaluationInstancesStore(abc.ABC):
+        return wire.enc_job, wire.dec_job
+
+
+class EvaluationInstancesStore(DumpLoadMixin, abc.ABC):
     """(EvaluationInstances.scala:65-100)"""
 
     @abc.abstractmethod
@@ -715,6 +811,12 @@ class EvaluationInstancesStore(abc.ABC):
         out = [i for i in self.get_all() if i.status == "EVALCOMPLETED"]
         out.sort(key=lambda i: i.start_time, reverse=True)
         return out
+
+    @classmethod
+    def _dump_codec(cls):
+        from incubator_predictionio_tpu.data.storage import wire
+
+        return wire.enc_evaluation_instance, wire.dec_evaluation_instance
 
 
 class ModelsStore(abc.ABC):

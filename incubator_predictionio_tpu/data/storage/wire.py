@@ -61,6 +61,30 @@ _META_CODECS = {
 }
 
 
+def enc_app(a: App) -> dict:
+    return _META_CODECS[App][0](a)
+
+
+def dec_app(d: dict) -> App:
+    return _META_CODECS[App][1](d)
+
+
+def enc_access_key(k: AccessKey) -> dict:
+    return _META_CODECS[AccessKey][0](k)
+
+
+def dec_access_key(d: dict) -> AccessKey:
+    return _META_CODECS[AccessKey][1](d)
+
+
+def enc_channel(c: Channel) -> dict:
+    return _META_CODECS[Channel][0](c)
+
+
+def dec_channel(d: dict) -> Channel:
+    return _META_CODECS[Channel][1](d)
+
+
 def enc_engine_instance(i: EngineInstance) -> dict:
     d = dataclasses.asdict(i)
     d["start_time"] = enc_dt(i.start_time)

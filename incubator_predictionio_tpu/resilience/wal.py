@@ -185,6 +185,27 @@ def tail_frames(
             off += _FRAME.size + length
 
 
+def frame_extent(data: bytes) -> int:
+    """Byte offset one past the last COMPLETE valid frame in an in-memory
+    frame-format buffer (magic + ``[len][crc][payload]`` frames) — the
+    backup cut for WAL segments and dead-letter files (backup/create.py).
+    A torn tail, CRC mismatch, or bad magic ends the walk at the last good
+    boundary; a buffer without even the magic cuts to 0."""
+    if data[:len(MAGIC)] != MAGIC:
+        return 0
+    off = len(MAGIC)
+    n = len(data)
+    while off + _FRAME.size <= n:
+        length, crc = _FRAME.unpack_from(data, off)
+        end = off + _FRAME.size + length
+        if end > n:
+            break
+        if _crc(data[off + _FRAME.size:end]) != crc:
+            break
+        off = end
+    return off
+
+
 def _segment_seq(name: str) -> Optional[int]:
     if not (name.startswith(_SEG_PREFIX) and name.endswith(_SEG_SUFFIX)):
         return None

@@ -940,6 +940,12 @@ def cmd_health(args, storage) -> int:
     if getattr(args, "stream_state_dir", None):
         rows.append(_quarantine_row(args.stream_state_dir,
                                     args.quarantine_max_age))
+    if getattr(args, "backup_dir", None):
+        rows.append(_backup_row(args.backup_dir, args.backup_max_age))
+    if not rows:
+        _err("health: nothing to probe (give server URLs and/or "
+             "--stream-state-dir / --backup-dir)")
+        return 2
     if args.json:
         _out(json.dumps(rows, indent=2))
     else:
@@ -1723,6 +1729,241 @@ def cmd_store_scrub(args, storage) -> int:
     return 0 if ok else 1
 
 
+def _backup_source(args, storage):
+    from incubator_predictionio_tpu.backup import source_from_storage
+
+    src = source_from_storage(
+        storage,
+        eventlog_dir=args.eventlog_dir,
+        wal_dir=args.wal_dir,
+        stream_state_dir=args.stream_state_dir,
+        device_models_dir=args.device_models_dir,
+        checkpoint_dirs=tuple(args.checkpoint_dir or ()),
+    )
+    if args.no_meta:
+        src = dataclasses_replace(src, storage=None)
+    return src
+
+
+def cmd_backup_create(args, storage: Storage) -> int:
+    """Take one consistent point-in-time backup (docs/dr.md): eventlog
+    segments up to a cut, the spill WAL, streaming state, model sidecars,
+    and a metadata dump via the DAO dump/load contract. Incremental by
+    default (append-only segments ⇒ only new extents copied); the entry
+    self-verifies before this verb reports success."""
+    from incubator_predictionio_tpu.backup import BackupError, create_backup
+
+    src = _backup_source(args, storage)
+    if not src.components() and src.storage is None:
+        _err("backup create: nothing to back up (no --eventlog-dir / "
+             "--wal-dir / --stream-state-dir / ... resolved, and --no-meta "
+             "set)")
+        return 2
+    try:
+        report = create_backup(args.backup_dir, src,
+                               incremental=not args.full,
+                               include_meta=not args.no_meta)
+    except BackupError as e:
+        _err(f"backup create failed: {e}")
+        return 1
+    if args.json:
+        _out(json.dumps(report, indent=2))
+    else:
+        v = report.get("verify") or {}
+        _out(f"backup {report['backupId']} (seq {report['seq']}"
+             + (f", incremental on {report['parent']}" if report["parent"]
+                else ", full") + ")")
+        _out(f"  files: {report['files']}  stored: {report['bytesStored']}B"
+             f"  logical: {report['bytesLogical']}B")
+        for path, cut in sorted(report["cuts"].items()):
+            _out(f"  cut {path} @ {cut}")
+        _out(f"  verify: {'clean' if v.get('clean') else 'FAILED'}")
+        for err in (v.get("errors") or [])[:8]:
+            _err(f"    {err}")
+    return 0 if (report.get("verify") or {}).get("clean") else 1
+
+
+def cmd_backup_verify(args, storage) -> int:
+    """Re-verify a backup entry end to end: chain integrity, per-window
+    CRC digests of every logical file, and cut/record-boundary
+    consistency. The verdict lands in the entry's verify.json, which the
+    `pio-tpu health --backup-dir` row reads."""
+    from incubator_predictionio_tpu.backup import BackupError, verify_backup
+
+    try:
+        report = verify_backup(args.backup_dir, args.id)
+    except BackupError as e:
+        _err(f"backup verify failed: {e}")
+        return 1
+    if args.json:
+        _out(json.dumps(report, indent=2))
+    else:
+        _out(f"backup {report['backupId']}: "
+             f"{'clean' if report['clean'] else 'FAILED'} "
+             f"({report['filesChecked']} file(s), "
+             f"{report['bytesChecked']}B in {report['seconds']}s)")
+        for err in report["errors"][:16]:
+            _err(f"  {err}")
+    return 0 if report["clean"] else 1
+
+
+def cmd_backup_restore(args, storage: Storage) -> int:
+    """Rehydrate a fresh data dir from a backup entry, verified while it
+    writes: files land bit-identical to the cut, the metadata dump loads
+    into the CONFIGURED backend, the streaming cursor is clamped to the
+    cut, the replication epoch is bumped so stale peers fence, and
+    --replay-wal finishes the RPO story by replaying the acked-but-
+    unstored WAL tail into the restored store."""
+    from incubator_predictionio_tpu.backup import (
+        BackupError,
+        RestoreTargets,
+        restore_backup,
+    )
+
+    targets = RestoreTargets(
+        eventlog_dir=args.eventlog_dir,
+        wal_dir=args.wal_dir,
+        stream_state_dir=args.stream_state_dir,
+        device_models_dir=args.device_models_dir,
+        checkpoint_dirs=tuple(args.checkpoint_dir or ()),
+    )
+    try:
+        report = restore_backup(
+            args.backup_dir, targets, backup_id=args.id,
+            storage=None if args.no_meta else storage,
+            epoch_bump=not args.no_epoch_bump,
+            replay_wal=args.replay_wal, force=args.force)
+    except BackupError as e:
+        _err(f"backup restore failed: {e}")
+        return 1
+    if args.json:
+        _out(json.dumps(report, indent=2))
+    else:
+        _out(f"restored backup {report['backupId']}: "
+             f"{report['filesRestored']} file(s), "
+             f"{report['bytesRestored']}B in {report['seconds']}s")
+        if report.get("meta"):
+            loaded = ", ".join(f"{k}={v}" for k, v in
+                               sorted(report["meta"]["loaded"].items()))
+            _out(f"  metadata: {loaded}; models: "
+                 f"{report['meta']['models']}")
+        if report.get("cursorClamped"):
+            _out("  streaming cursor clamped to the eventlog cut")
+        if report.get("epoch"):
+            ep = report["epoch"]
+            _out(f"  replication epoch {ep['epochBefore']} -> "
+                 f"{ep['epochAfter']}"
+                 + ("" if ep["bumped"] else " (bump disabled)"))
+        if report.get("walReplayed") is not None:
+            _out(f"  WAL tail replayed: {report['walReplayed']} event(s)")
+        if report.get("skippedComponents"):
+            _out("  skipped (no target dir given): "
+                 + ", ".join(report["skippedComponents"]))
+    return 0
+
+
+def cmd_backup_list(args, storage) -> int:
+    """List committed backup entries: seq, age, chain parent, stored vs
+    logical bytes, and the last verification verdict."""
+    from incubator_predictionio_tpu.backup import BackupSet, entry_summary
+
+    bset = BackupSet(args.backup_dir)
+    try:
+        rows = [entry_summary(bset, e) for e in bset.entries()]
+    except Exception as e:  # noqa: BLE001 - a damaged entry is the finding
+        _err(f"backup list failed: {e}")
+        return 1
+    if args.json:
+        _out(json.dumps(rows, indent=2))
+        return 0
+    if not rows:
+        _out(f"no backups in {args.backup_dir}")
+        return 0
+    for r in rows:
+        mark = "ok" if r["verified"] else "!!"
+        _out(f"{mark} {r['backupId']}  seq {r['seq']:>4}  "
+             f"{r['createdAt']}  "
+             f"{'incr on ' + r['parent'] if r['parent'] else 'full'}  "
+             f"{r['files']} file(s) {r['storedBytes']}B stored "
+             f"({r['logicalBytes']}B logical)  "
+             f"{'verified' if r['verified'] else 'NOT VERIFIED'}")
+    return 0
+
+
+def cmd_backup_prune(args, storage) -> int:
+    """Delete old entries, keeping the newest --keep entries plus every
+    chain ancestor they reference (an incremental child never loses the
+    full copy under it); crashed .tmp- stubs are cleared too."""
+    from incubator_predictionio_tpu.backup import BackupError
+    from incubator_predictionio_tpu.backup.manifest import prune
+
+    try:
+        removed = prune(args.backup_dir, args.keep)
+    except BackupError as e:
+        _err(f"backup prune failed: {e}")
+        return 1
+    _out(f"pruned {len(removed)} entr(ies): "
+         + (", ".join(removed) if removed else "nothing to remove"))
+    return 0
+
+
+def _backup_row(backup_dir: str, max_age: Optional[float],
+                now: Optional[float] = None) -> dict:
+    """The backup-staleness probe for ``pio-tpu health --backup-dir``
+    (same alarm pattern as the quarantine row): red when there is no
+    verified backup, the newest entry's last verify FAILED, or the newest
+    verified entry is older than PIO_BACKUP_MAX_AGE (default 24h). An
+    unverified-but-fresh backup is red too — an unverified backup is a
+    hope, not a recovery plan (docs/dr.md)."""
+    import time
+
+    from incubator_predictionio_tpu.backup import BackupSet, read_verify
+    from incubator_predictionio_tpu.backup.manifest import parse_iso
+
+    url = f"backup:{backup_dir}"
+    if max_age is None:
+        max_age = float(os.environ.get("PIO_BACKUP_MAX_AGE", "86400"))
+    try:
+        entries = BackupSet(backup_dir).entries()
+    except Exception as e:  # noqa: BLE001 - unreadable dir is red
+        return {"url": url, "status": "unreadable", "red": True,
+                "detail": str(e)}
+    if not entries:
+        return {"url": url, "status": "missing", "red": True,
+                "detail": "no backups — run `pio-tpu backup create`"}
+    tip = entries[-1]
+    v = read_verify(tip.path)
+    if v is not None and not v.get("clean"):
+        return {"url": url, "status": "verify-failed", "red": True,
+                "detail": f"backup {tip.backup_id} failed verification at "
+                          f"{v.get('at')} — the newest backup is not "
+                          "restorable"}
+    newest_verified = None
+    for e in reversed(entries):
+        ve = read_verify(e.path)
+        if ve is not None and ve.get("clean"):
+            newest_verified = e
+            break
+    if newest_verified is None:
+        return {"url": url, "status": "unverified", "red": True,
+                "detail": f"{len(entries)} backup(s), none verified — run "
+                          "`pio-tpu backup verify`"}
+    created = parse_iso(newest_verified.manifest.get("createdAt"))
+    now_s = now if now is not None else time.time()
+    age = (now_s - created.timestamp()) if created is not None else None
+    if age is None or age > max_age:
+        return {"url": url, "status": "stale", "red": True,
+                "detail": f"newest verified backup "
+                          f"{newest_verified.backup_id} is "
+                          + (f"{age:.0f}s old > PIO_BACKUP_MAX_AGE "
+                             f"{max_age:.0f}s" if age is not None
+                             else "undated")
+                          + " — backups are not keeping up"}
+    return {"url": url, "status": "ok", "red": False,
+            "detail": f"backup {newest_verified.backup_id} verified, "
+                      f"{age:.0f}s old (max {max_age:.0f}s)"}
+
+
 # ---------------------------------------------------------------------------
 # parser
 # ---------------------------------------------------------------------------
@@ -2053,6 +2294,73 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--server-access-key")
     p.add_argument("--json", action="store_true")
 
+    # backup — disaster recovery (docs/dr.md)
+    backup = sub.add_parser(
+        "backup",
+        help="disaster recovery: consistent point-in-time backup and "
+             "verified restore of the whole state surface — eventlog, "
+             "metadata (dump/load), models + sidecars, spill WAL, "
+             "streaming state, replication fencing state (docs/dr.md)")
+    bk = backup.add_subparsers(dest="backup_command")
+
+    def _backup_component_args(p, restoring: bool) -> None:
+        verb = "restore into" if restoring else "back up"
+        p.add_argument("--eventlog-dir",
+                       help=f"eventlog directory to {verb} (.piolog logs "
+                            "+ repl-state.json; default on create: "
+                            "resolved from the configured eventlog "
+                            "EVENTDATA backend)")
+        p.add_argument("--wal-dir",
+                       help=f"event-server spill WAL directory to {verb}")
+        p.add_argument("--stream-state-dir",
+                       help=f"streaming state directory to {verb} "
+                            "(cursor, trainer state, delta archive, "
+                            "quarantine marker)")
+        p.add_argument("--device-models-dir",
+                       help=f"device-model sidecar tree to {verb} "
+                            "(default on create: $PIO_FS_BASEDIR/"
+                            "device_models when present)")
+        p.add_argument("--checkpoint-dir", action="append",
+                       help=f"TrainCheckpointer directory to {verb} "
+                            "(repeatable; mid-epoch training state)")
+        p.add_argument("--no-meta", action="store_true",
+                       help="skip the metadata dump/load and model blobs")
+        p.add_argument("--json", action="store_true")
+
+    p = bk.add_parser("create")
+    p.add_argument("--backup-dir", required=True,
+                   help="backup set directory (entries chain inside it)")
+    _backup_component_args(p, restoring=False)
+    p.add_argument("--full", action="store_true",
+                   help="force a full copy instead of an incremental "
+                        "extent on the previous entry")
+    p = bk.add_parser("verify")
+    p.add_argument("--backup-dir", required=True)
+    p.add_argument("--id", help="backup id (default: the newest entry)")
+    p.add_argument("--json", action="store_true")
+    p = bk.add_parser("restore")
+    p.add_argument("--backup-dir", required=True)
+    p.add_argument("--id", help="backup id (default: the newest entry)")
+    _backup_component_args(p, restoring=True)
+    p.add_argument("--replay-wal", action="store_true",
+                   help="after restoring, replay the WAL tail into the "
+                        "configured event store (idempotent; otherwise "
+                        "the event server replays it at startup)")
+    p.add_argument("--no-epoch-bump", action="store_true",
+                   help="keep the backed-up replication epoch instead of "
+                        "bumping it (bump fences stale peers — only skip "
+                        "when restoring an isolated dev copy)")
+    p.add_argument("--force", action="store_true",
+                   help="restore into a non-empty target directory")
+    p = bk.add_parser("list")
+    p.add_argument("--backup-dir", required=True)
+    p.add_argument("--json", action="store_true")
+    p = bk.add_parser("prune")
+    p.add_argument("--backup-dir", required=True)
+    p.add_argument("--keep", type=int, default=7,
+                   help="newest entries to keep (their chain ancestors "
+                        "are kept too; default 7)")
+
     # dashboard / adminserver
     p = sub.add_parser("dashboard")
     p.add_argument("--ip", default="127.0.0.1")
@@ -2143,9 +2451,11 @@ def build_parser() -> argparse.ArgumentParser:
         help="aggregate GET /health from the given servers into one "
              "table (draining/breaker/spill/admission state); exits "
              "non-zero when any is unreachable, draining, or degraded")
-    p.add_argument("urls", nargs="+",
+    p.add_argument("urls", nargs="*",
                    help="server base URLs, e.g. http://127.0.0.1:7070 "
-                        "http://127.0.0.1:8000 http://127.0.0.1:7072")
+                        "http://127.0.0.1:8000 http://127.0.0.1:7072 "
+                        "(may be empty when only --stream-state-dir / "
+                        "--backup-dir rows are wanted)")
     p.add_argument("--timeout", type=float, default=5.0,
                    help="per-probe timeout in seconds (default 5)")
     p.add_argument("--json", action="store_true",
@@ -2158,6 +2468,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="seconds a quarantine marker may age before the "
                         "row turns red (default: PIO_JOBS_INTERVAL, "
                         "else 300)")
+    p.add_argument("--backup-dir",
+                   help="also probe this backup directory: red when the "
+                        "newest verified backup is older than "
+                        "--backup-max-age or the last verify failed "
+                        "(docs/dr.md)")
+    p.add_argument("--backup-max-age", type=float,
+                   help="seconds the newest verified backup may age "
+                        "before the row turns red (default: "
+                        "PIO_BACKUP_MAX_AGE, else 86400)")
 
     # fleet — router / rolling deploy / experiment (docs/serving.md)
     fleet = sub.add_parser(
@@ -2395,6 +2714,14 @@ _STORE_COMMANDS = {
     "scrub": cmd_store_scrub,
 }
 
+_BACKUP_COMMANDS = {
+    "create": cmd_backup_create,
+    "verify": cmd_backup_verify,
+    "restore": cmd_backup_restore,
+    "list": cmd_backup_list,
+    "prune": cmd_backup_prune,
+}
+
 _JOBS_COMMANDS = {
     "submit": cmd_jobs_submit,
     "list": cmd_jobs_list,
@@ -2448,6 +2775,12 @@ def main(argv: Optional[list[str]] = None) -> int:
             _err("store: missing subcommand (status|promote|scrub)")
             return 1
         return _STORE_COMMANDS[args.store_command](args, storage)
+    if args.command == "backup":
+        if not args.backup_command:
+            _err("backup: missing subcommand (create|verify|restore|"
+                 "list|prune)")
+            return 1
+        return _BACKUP_COMMANDS[args.backup_command](args, storage)
     if args.command == "jobs":
         if not args.jobs_command:
             _err("jobs: missing subcommand (submit|list|watch|cancel|"

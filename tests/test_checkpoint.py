@@ -122,6 +122,45 @@ def test_two_tower_shape_change_restarts_fresh(tmp_path):
     assert np.isfinite(grown.final_loss)
 
 
+def test_backup_restore_resume_mid_epoch(tmp_path, caplog):
+    """Disaster recovery for a mid-epoch training job (docs/dr.md): the
+    TrainCheckpointer state is part of the backup set, and a restored
+    host's job worker resumes from it — same "resuming from epoch N" pin
+    the chaos suite uses — converging to the straight run's parameters."""
+    import logging
+
+    from incubator_predictionio_tpu.backup import (
+        BackupSource,
+        RestoreTargets,
+        create_backup,
+        restore_backup,
+    )
+
+    straight = _fit_two_tower(None, epochs=4, every=0)
+    d = str(tmp_path / "tt")
+    _fit_two_tower(d, epochs=2, every=2)  # mid-job state: checkpoint @ 2
+    rep = create_backup(str(tmp_path / "bk"),
+                        BackupSource(checkpoint_dirs=(d,)))
+    assert rep["verify"]["clean"], rep["verify"]["errors"]
+    # the disaster: the training host's checkpoint dir is gone
+    import shutil
+
+    shutil.rmtree(d)
+    restored_dir = str(tmp_path / "tt-restored")
+    restore_backup(str(tmp_path / "bk"),
+                   RestoreTargets(checkpoint_dirs=(restored_dir,)))
+    with caplog.at_level(logging.INFO,
+                         logger="incubator_predictionio_tpu.utils.checkpoint"):
+        resumed = _fit_two_tower(restored_dir, epochs=4, every=2)
+    msgs = [r.getMessage() for r in caplog.records
+            if "resuming from epoch" in r.getMessage()]
+    assert msgs and "resuming from epoch 2" in msgs[0]
+    np.testing.assert_allclose(resumed.user_emb, straight.user_emb,
+                               rtol=1e-5)
+    np.testing.assert_allclose(resumed.item_emb, straight.item_emb,
+                               rtol=1e-5)
+
+
 def _fit_transformer(ckpt_dir, epochs, every):
     from incubator_predictionio_tpu.models.transformer import (
         TransformerConfig,
