@@ -137,6 +137,13 @@ class RouterServer:
             raise ValueError("fleet router needs at least one --replica")
         self.config = config
         self._clock = clock
+        # the router is the fleet's EDGE: it roots each query's trace, so
+        # the head sampling decision (PIO_TRACE_SAMPLE) is minted here and
+        # rides X-PIO-Trace as `:s=` to every downstream hop; the spool
+        # (PIO_TRACE_SPOOL_DIR) makes this process's fragment durable
+        from incubator_predictionio_tpu.obs import spool as trace_spool
+
+        trace_spool.configure_export_from_env("fleet_router")
         self.balancer = Balancer(config.replicas, clock=clock,
                                  eject_threshold=config.eject_threshold)
         self.candidate_balancer = Balancer(
@@ -296,19 +303,35 @@ class RouterServer:
                             headers: dict, timeout_sec: float):
         """One forwarding attempt → (status, body, headers). Transport
         errors propagate to the retry loop; the passive balancer signals
-        (EWMAs, backoff, ejection) are recorded here either way."""
+        (EWMAs, backoff, ejection) are recorded here either way. Each
+        attempt gets its own span (child of the route span) with the trace
+        header re-injected under it — a replica that dies mid-request
+        leaves THIS span, status `error:<Type>`, in the router's spool:
+        the forensic record the chaos suite assembles."""
         import aiohttp
 
         session = await self._session_or_start()
         replica.inflight += 1
         t0 = self._clock.monotonic()
         try:
-            async with session.post(
-                    replica.url + "/queries.json", data=body,
-                    headers=headers,
-                    timeout=aiohttp.ClientTimeout(total=timeout_sec)) as resp:
-                payload = await resp.read()
-                status, resp_headers = resp.status, resp.headers
+            with trace.span("forward", service="fleet_router",
+                            replica=replica.url) as fsp:
+                headers = dict(headers)
+                trace.inject(headers)
+                async with session.post(
+                        replica.url + "/queries.json", data=body,
+                        headers=headers,
+                        timeout=aiohttp.ClientTimeout(
+                            total=timeout_sec)) as resp:
+                    payload = await resp.read()
+                    status, resp_headers = resp.status, resp.headers
+                fsp.set_attr("status", status)
+                if status >= 500:
+                    # keep the edge in tail-kept traces: the replica's 5xx
+                    # span is kept, and without this its parent (THIS
+                    # span) would be head-dropped at s=0, orphaning the
+                    # replica subtree in the assembled tree
+                    fsp.status = f"error:http{status}"
         except asyncio.CancelledError:
             raise
         except Exception:
@@ -516,6 +539,9 @@ class RouterServer:
         if self._session is not None:
             await self._session.close()
             self._session = None
+        from incubator_predictionio_tpu.obs import spool as trace_spool
+
+        trace_spool.flush_export()
 
 
 def serve_forever(config: RouterConfig) -> None:

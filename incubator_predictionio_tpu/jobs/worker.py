@@ -137,6 +137,12 @@ class JobWorker:
                 self.config, worker_id=_default_worker_id())
         self.clock = clock
         self.ctx = ctx
+        # the worker is a dark plane (no HTTP surface): PIO_TRACE_SPOOL_DIR
+        # makes its per-job spans durable for fleet-wide trace assembly,
+        # --obs-port (tools/cli.py) makes pio_jobs_* scrapeable
+        from incubator_predictionio_tpu.obs import spool as trace_spool
+
+        trace_spool.configure_export_from_env("jobs_worker")
 
     # -- loop -------------------------------------------------------------
     def run_once(self) -> Optional[dict]:
@@ -211,6 +217,8 @@ class JobWorker:
 
     # -- execution --------------------------------------------------------
     def _execute(self, hb: _Heartbeat) -> dict:
+        from incubator_predictionio_tpu.obs import trace
+
         job = hb.job
         runner = {
             "train": self._run_train,
@@ -220,7 +228,11 @@ class JobWorker:
         }.get(job.kind)
         if runner is None:
             raise ValueError(f"unknown job kind {job.kind!r}")
-        return runner(hb)
+        # one trace per job execution; the deploy's /reload (and the fleet
+        # rollout's hops) join it via the injected X-PIO-Trace header
+        with trace.span(f"jobs.{job.kind}", service="jobs_worker",
+                        job=job.id, attempt=job.attempt):
+            return runner(hb)
 
     def _maybe_fault(self, point: str) -> None:
         if os.environ.get("PIO_JOBS_FAULT") == f"kill:{point}":
@@ -374,10 +386,14 @@ class JobWorker:
         """POST /reload — the single-server smoke-gated hot swap. A 409
         means the smoke gate rejected the new instance (it never served):
         that surfaces as a failed attempt, not a silent pass."""
+        from incubator_predictionio_tpu.obs import trace
+
         full = f"{url}/reload"
         if key:
             full += "?" + urllib.parse.urlencode({"accessKey": key})
-        req = urllib.request.Request(full, method="POST")
+        headers: dict = {}
+        trace.inject(headers)  # the replica's /reload span joins the job's
+        req = urllib.request.Request(full, method="POST", headers=headers)
         try:
             with urllib.request.urlopen(
                     req, timeout=self.config.reload_timeout_sec) as resp:

@@ -7,7 +7,12 @@ end-to-end request tracing (docs/observability.md).
   ``X-PIO-Trace`` propagation;
 - :mod:`.http` — the aiohttp telemetry middleware and shared
   ``/metrics`` + ``/traces.json`` routes (imported by servers; kept out of
-  this namespace so non-server processes never pay the aiohttp import).
+  this namespace so non-server processes never pay the aiohttp import);
+- :mod:`.spool` — durable span export: finished spans the sampling rules
+  keep are appended to a CRC-framed on-disk spool (``PIO_TRACE_SPOOL_DIR``)
+  that survives process death;
+- :mod:`.collect` — cross-process trace assembly from spools and live
+  ``/traces.json`` rings (``pio-tpu trace list|show|slowest``).
 """
 
 from incubator_predictionio_tpu.obs.metrics import (  # noqa: F401

@@ -1,0 +1,282 @@
+"""Cross-process trace assembly: merge spans from durable spools and/or
+live ``/traces.json`` endpoints into trace trees (docs/observability.md
+"The trace plane").
+
+One request through the fleet produces spans in N processes — router,
+replica, storage, and (for control-plane traffic) the stream updater and
+job workers. Each process only ever sees its own fragment; this module is
+the assembler behind ``pio-tpu trace list|show|slowest``:
+
+- **Sources.** Spool directories (the :mod:`.spool` segments of every
+  process that shares the dir — read with the live-writer-tolerant
+  ``tail_frames`` contract) and server base URLs (their in-memory ring at
+  ``GET /traces.json``). Spans are deduped on (traceId, spanId), so a span
+  present both in a spool and a ring counts once.
+- **Tree building.** Spans group by trace id; parent/child edges resolve
+  by span id. Each assembled trace reports ``complete`` (root present, no
+  dangling ``parentId``) and the ``orphans`` whose parents are missing —
+  a ring-evicted or SIGKILLed fragment is visible as such, never silently
+  passed off as a whole trace.
+- **Clock skew.** ``startUnix`` comes from each process's wall clock.
+  For every cross-service parent→child edge the child must nest inside
+  its parent's window; when it does not, the child's service gets a skew
+  estimate (relative to the root's service) that centres the child in the
+  parent — enough to make a waterfall readable across hosts whose clocks
+  disagree by more than a span duration.
+- **Waterfall.** One line per span: offset (skew-corrected), duration,
+  scaled bar, service, name, status.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from typing import Any, Iterable, Optional
+
+from incubator_predictionio_tpu.obs.spool import spool_files
+from incubator_predictionio_tpu.resilience.wal import tail_frames
+
+
+# ---------------------------------------------------------------------------
+# span sources
+# ---------------------------------------------------------------------------
+
+def read_spool_dir(directory: str) -> tuple[list[dict], list[str]]:
+    """Every span record in every spool segment under ``directory``.
+    Returns ``(spans, problems)``: a segment whose readable prefix ends in
+    a corrupt frame contributes its good prefix plus one problem string —
+    assembly is forensics, it must surface everything salvageable."""
+    spans: list[dict] = []
+    problems: list[str] = []
+    for path in spool_files(directory):
+        records, _, status = tail_frames(path)
+        spans.extend(rec for _, rec in records)
+        if status == "corrupt":
+            problems.append(f"{path}: corrupt frame past "
+                            f"{len(records)} readable span(s)")
+        # "waiting" = racing a live writer mid-frame: normal, not a problem
+    return spans, problems
+
+
+def fetch_url_spans(url: str, timeout: float = 5.0,
+                    limit: int = 500) -> list[dict]:
+    """Spans from a live server's ``GET /traces.json`` ring."""
+    base = url.rstrip("/")
+    if not base.endswith("/traces.json"):
+        base += "/traces.json"
+    with urllib.request.urlopen(f"{base}?limit={limit}",
+                                timeout=timeout) as resp:
+        payload = json.loads(resp.read().decode())
+    spans: list[dict] = []
+    for tr in payload.get("traces", []):
+        spans.extend(tr.get("spans", []))
+    return spans
+
+
+def gather_spans(spools: Iterable[str] = (), urls: Iterable[str] = (),
+                 fetch=None, timeout: float = 5.0,
+                 ) -> tuple[list[dict], list[str]]:
+    """Union of all sources, deduped on (traceId, spanId) — first source
+    wins (spools are listed first: the durable copy is authoritative).
+    An unreachable URL is a problem string, never an exception — partial
+    assembly beats none when half the fleet is down (the exact situation
+    an operator assembles traces in)."""
+    fetch = fetch or fetch_url_spans
+    out: list[dict] = []
+    problems: list[str] = []
+    seen: set[tuple[str, str]] = set()
+
+    def take(spans: Iterable[dict]) -> None:
+        for s in spans:
+            if not isinstance(s, dict):
+                continue
+            key = (s.get("traceId"), s.get("spanId"))
+            if key[0] is None or key[1] is None or key in seen:
+                continue
+            seen.add(key)
+            out.append(s)
+
+    for d in spools:
+        spans, probs = read_spool_dir(d)
+        take(spans)
+        problems.extend(probs)
+    for url in urls:
+        try:
+            take(fetch(url, timeout))
+        except Exception as e:  # noqa: BLE001 - a dead server is a finding
+            problems.append(f"{url}: {e!r}")
+    return out, problems
+
+
+# ---------------------------------------------------------------------------
+# assembly
+# ---------------------------------------------------------------------------
+
+def _estimate_skew(spans: list[dict],
+                   root: Optional[dict]) -> dict[str, float]:
+    """Per-service clock-skew estimate (seconds to ADD to a service's
+    ``startUnix``), relative to the root span's service.
+
+    Walks parent→child edges top-down (parents' skews settle before their
+    children's). For each cross-service edge whose child interval does not
+    nest inside its (skew-corrected) parent's window, the child service's
+    skew is corrected by ``centered_start - observed_start``; a later edge
+    into the same service refines the running estimate (an edge that
+    already fits leaves it alone)."""
+    if root is None:
+        return {}
+    skew: dict[str, float] = {root.get("service") or "": 0.0}
+    children: dict[Optional[str], list[dict]] = {}
+    for s in spans:
+        children.setdefault(s.get("parentId"), []).append(s)
+    queue = [root]
+    while queue:
+        parent = queue.pop(0)
+        p_svc = parent.get("service") or ""
+        p_start = parent.get("startUnix", 0.0) + skew.get(p_svc, 0.0)
+        p_dur = parent.get("durationSec", 0.0)
+        for child in children.get(parent.get("spanId"), []):
+            queue.append(child)
+            c_svc = child.get("service") or ""
+            if c_svc == p_svc:
+                continue
+            c_start = child.get("startUnix", 0.0) + skew.get(c_svc, 0.0)
+            c_dur = child.get("durationSec", 0.0)
+            skew.setdefault(c_svc, 0.0)
+            fits = (c_start >= p_start - 1e-6
+                    and c_start + c_dur <= p_start + p_dur + 1e-6)
+            if not fits:
+                centered = p_start + max(0.0, (p_dur - c_dur) / 2.0)
+                skew[c_svc] += centered - c_start
+    return {svc: round(v, 6) for svc, v in skew.items()}
+
+
+def assemble(spans: Iterable[dict]) -> list[dict]:
+    """Group spans into trace trees, newest trace first. Each tree:
+
+    ``{"traceId", "root" (span or None), "spans" (start-ordered, skew
+    corrected under "offsetSec"), "spanCount", "services", "durationSec",
+    "complete", "orphans" (spanIds whose parent is missing),
+    "clockSkewSec" ({service: skew}), "startUnix"}``."""
+    by_trace: dict[str, list[dict]] = {}
+    for s in spans:
+        tid = s.get("traceId")
+        if tid:
+            by_trace.setdefault(tid, []).append(s)
+    out = []
+    for tid, group in by_trace.items():
+        ids = {s.get("spanId") for s in group}
+        roots = [s for s in group if s.get("parentId") is None]
+        root = min(roots, key=lambda s: s.get("startUnix", 0.0)) \
+            if roots else None
+        orphans = sorted(
+            s.get("spanId") for s in group
+            if s.get("parentId") is not None
+            and s.get("parentId") not in ids)
+        skew = _estimate_skew(group, root)
+        corrected = []
+        base = min(s.get("startUnix", 0.0)
+                   + skew.get(s.get("service") or "", 0.0) for s in group)
+        for s in group:
+            c = dict(s)
+            c["offsetSec"] = round(
+                s.get("startUnix", 0.0)
+                + skew.get(s.get("service") or "", 0.0) - base, 6)
+            corrected.append(c)
+        corrected.sort(key=lambda s: (s["offsetSec"], s.get("spanId") or ""))
+        duration = (root.get("durationSec", 0.0) if root is not None
+                    else max((s["offsetSec"] + s.get("durationSec", 0.0)
+                              for s in corrected), default=0.0))
+        out.append({
+            "traceId": tid,
+            "root": root,
+            "spans": corrected,
+            "spanCount": len(corrected),
+            "services": sorted({s.get("service") or "?" for s in group}),
+            "durationSec": duration,
+            "complete": root is not None and not orphans,
+            "orphans": orphans,
+            "clockSkewSec": skew,
+            "startUnix": min(s.get("startUnix", 0.0) for s in group),
+        })
+    out.sort(key=lambda t: t["startUnix"], reverse=True)
+    return out
+
+
+def find_trace(traces: list[dict], trace_id: str,
+               ) -> tuple[Optional[dict], list[str]]:
+    """``(tree, prefix_matches)``: exact match first, then unique-prefix
+    (ids are long hex — operators paste prefixes). An ambiguous prefix
+    returns ``(None, [matching ids...])`` so the caller can say "which of
+    these" instead of the affirmatively-wrong "not found"."""
+    for t in traces:
+        if t["traceId"] == trace_id:
+            return t, [t["traceId"]]
+    prefixed = [t for t in traces if t["traceId"].startswith(trace_id)]
+    ids = [t["traceId"] for t in prefixed]
+    return (prefixed[0] if len(prefixed) == 1 else None), ids
+
+
+def slowest(traces: list[dict], n: int = 10) -> list[dict]:
+    return sorted(traces, key=lambda t: t["durationSec"], reverse=True)[:n]
+
+
+# ---------------------------------------------------------------------------
+# terminal rendering
+# ---------------------------------------------------------------------------
+
+def waterfall(tree: dict, width: int = 40) -> list[str]:
+    """One line per span: offset, duration, a bar scaled to the trace's
+    extent, service, name, status."""
+    spans = tree["spans"]
+    extent = max((s["offsetSec"] + s.get("durationSec", 0.0)
+                  for s in spans), default=0.0) or 1e-9
+    header = (f"trace {tree['traceId']}  spans={tree['spanCount']}  "
+              f"services={','.join(tree['services'])}  "
+              f"duration={tree['durationSec'] * 1e3:.1f}ms  "
+              f"complete={str(tree['complete']).lower()}")
+    lines = [header]
+    if tree["orphans"]:
+        lines.append(f"  ! {len(tree['orphans'])} orphan span(s) — parents "
+                     "missing (ring eviction or a dead process's unwritten "
+                     f"spans): {', '.join(tree['orphans'][:4])}")
+    skews = {svc: sk for svc, sk in tree.get("clockSkewSec", {}).items()
+             if abs(sk) > 1e-6}
+    if skews:
+        lines.append("  ~ clock skew corrected: " + ", ".join(
+            f"{svc}{sk * 1e3:+.1f}ms" for svc, sk in sorted(skews.items())))
+    for s in spans:
+        off = s["offsetSec"]
+        dur = s.get("durationSec", 0.0)
+        lo = min(width - 1, int(round(off / extent * width)))
+        ln = max(1, int(round(dur / extent * width)))
+        bar = " " * lo + "█" * min(ln, width - lo)
+        status = s.get("status", "?")
+        mark = "" if status == "ok" else "  !! " + status
+        lines.append(
+            f"  {off * 1e3:>9.1f}ms {dur * 1e3:>9.1f}ms "
+            f"|{bar:<{width}}| {s.get('service') or '?'}: "
+            f"{s.get('name') or '?'}{mark}")
+    return lines
+
+
+def list_rows(traces: list[dict]) -> list[dict[str, Any]]:
+    """Compact per-trace rows for ``pio-tpu trace list``."""
+    rows = []
+    for t in traces:
+        root = t["root"]
+        rows.append({
+            "traceId": t["traceId"],
+            "spans": t["spanCount"],
+            "services": ",".join(t["services"]),
+            "durationMs": round(t["durationSec"] * 1e3, 1),
+            "complete": t["complete"],
+            "root": (root.get("name") if root else "(no root)"),
+            "errors": sum(1 for s in t["spans"]
+                          if s.get("status", "ok") != "ok"),
+        })
+    return rows
+
+
+__all__ = ["read_spool_dir", "fetch_url_spans", "gather_spans", "assemble",
+           "find_trace", "slowest", "waterfall", "list_rows"]

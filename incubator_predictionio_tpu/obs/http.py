@@ -66,6 +66,7 @@ def telemetry_middleware(service: str):
         parent = trace.parse_header(request.headers.get(trace.TRACE_HEADER))
         t0 = time.perf_counter()
         status = 500
+        http_exc = False
         with trace.trace_scope(parent):
             with trace.span(f"{request.method} {route}", service=service,
                             method=request.method, route=route) as sp:
@@ -75,6 +76,7 @@ def telemetry_middleware(service: str):
                 except web.HTTPException as ex:
                     # auth/validation raise these; they ARE responses —
                     # stamp the trace header on them before they propagate
+                    http_exc = True
                     status = ex.status
                     ex.headers[trace.TRACE_HEADER] = sp.trace_id
                     raise
@@ -90,12 +92,38 @@ def telemetry_middleware(service: str):
                     status = 500
                 finally:
                     sp.set_attr("status", status)
+                    if status >= 500 and sp.status == "ok":
+                        # a server error is exactly what the tail keep
+                        # rules exist for: mark the span so it reaches the
+                        # durable spool even at s=0 (docs/observability.md)
+                        sp.status = f"error:http{status}"
+                    elif http_exc and status < 500:
+                        # a raised 4xx (bad accessKey, validation) is an
+                        # ORDERLY answer, not an error — without this, a
+                        # client hammering 401s would tail-keep every span
+                        # and evict the genuine 5xx/slow traces the spool
+                        # exists to retain. The non-"ok" terminal status
+                        # keeps the outcome visible AND stops span()'s
+                        # exception handler from re-stamping it as error
+                        sp.status = f"http{status}"
                     dt = time.perf_counter() - t0
                     HTTP_REQUESTS.labels(service=service, route=route,
                                          method=request.method,
                                          status=str(status)).inc()
-                    HTTP_LATENCY.labels(service=service,
-                                        route=route).observe(dt)
+                    # exemplar: the p99 bucket on /metrics links straight
+                    # to this request's trace (`pio-tpu trace show <id>`).
+                    # Only for traces that will stay FINDABLE: when the
+                    # spool is on, a head-dropped span that no tail rule
+                    # keeps would leave the exemplar pointing at nothing
+                    _, slow_sec = trace.sampling()
+                    findable = (not trace.export_enabled()
+                                or trace.keep_reason(sp.sampled, sp.status,
+                                                     dt, slow_sec))
+                    lat = HTTP_LATENCY.labels(service=service, route=route)
+                    if findable:
+                        lat.observe_exemplar(dt, trace_id=sp.trace_id)
+                    else:
+                        lat.observe(dt)
                     if access_log.isEnabledFor(logging.INFO):
                         access_log.info(json.dumps({
                             "service": service,
@@ -115,8 +143,16 @@ def telemetry_middleware(service: str):
 
 
 async def handle_metrics(request: web.Request) -> web.Response:
+    # exemplars only on explicit request (`?exemplars=1`, which the
+    # `pio-tpu metrics` pretty-printer sends): a stock Prometheus 0.0.4
+    # parser rejects the whole page on the first `# {...}` suffix, and
+    # Accept-header sniffing is a trap — stock Prometheus advertises
+    # openmetrics in its default Accept while expecting spec-exact OM
+    # (counter families without the _total suffix), which this exposition
+    # is not. A query param can only come from a caller that means it.
+    exemplars = request.query.get("exemplars") == "1"
     return web.Response(
-        text=REGISTRY.expose(),
+        text=REGISTRY.expose(exemplars=exemplars),
         content_type="text/plain", charset="utf-8",
         headers={"X-Prometheus-Format": "0.0.4"})
 
@@ -138,3 +174,88 @@ async def handle_traces(request: web.Request) -> web.Response:
 def add_observability_routes(app: web.Application) -> None:
     app.router.add_get("/metrics", handle_metrics)
     app.router.add_get("/traces.json", handle_traces)
+
+
+# ---------------------------------------------------------------------------
+# dark-plane observability server (stream updater, jobs worker)
+# ---------------------------------------------------------------------------
+
+class ObsServerHandle:
+    """Handle for a :func:`start_obs_server` thread — close() tears the
+    listener and its loop down."""
+
+    def __init__(self, thread, loop, runner, port: int):
+        self._thread = thread
+        self._loop = loop
+        self._runner = runner
+        self.port = port
+
+    def close(self, timeout: float = 5.0) -> None:
+        import asyncio
+
+        async def stop():
+            await self._runner.cleanup()
+            self._loop.stop()
+
+        try:
+            asyncio.run_coroutine_threadsafe(stop(), self._loop)
+            self._thread.join(timeout=timeout)
+        except RuntimeError:  # pragma: no cover - loop already gone
+            pass
+
+
+def start_obs_server(service: str, port: int,
+                     ip: str = "127.0.0.1") -> ObsServerHandle:
+    """Serve the shared ``GET /metrics`` + ``GET /traces.json`` routes from
+    a daemon thread with its own event loop — how processes without an HTTP
+    surface of their own (the stream updater, the jobs worker) publish
+    their slice of the process-wide registry and span ring
+    (``--obs-port``; docs/observability.md). Loopback by default — span
+    attributes carry internal endpoints; exposing wider is an explicit
+    ``--obs-ip`` decision, like every other server's ``--ip``."""
+    import asyncio
+    import threading
+
+    started = threading.Event()
+    holder: dict = {}
+
+    def run() -> None:
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+
+        async def boot():
+            app = web.Application(
+                middlewares=[telemetry_middleware(service)])
+            add_observability_routes(app)
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.TCPSite(runner, ip, port)
+            await site.start()
+            bound = site._server.sockets[0].getsockname()[1]
+            return runner, bound
+
+        try:
+            holder["runner"], holder["port"] = loop.run_until_complete(boot())
+        except Exception as e:  # noqa: BLE001 - surfaced to the caller
+            holder["error"] = e
+            started.set()
+            loop.close()
+            return
+        holder["loop"] = loop
+        started.set()
+        loop.run_forever()
+        # stop() already ran runner.cleanup on this loop
+        loop.close()
+
+    thread = threading.Thread(target=run, daemon=True,
+                              name=f"obs-server-{service}")
+    thread.start()
+    started.wait(timeout=10.0)
+    if "error" in holder:
+        raise holder["error"]
+    if "loop" not in holder:  # pragma: no cover - boot wedged
+        raise TimeoutError("obs server failed to start in 10s")
+    logger.info("%s: observability server on %s:%d (/metrics, /traces.json)",
+                service, ip, holder["port"])
+    return ObsServerHandle(thread, holder["loop"], holder["runner"],
+                           holder["port"])

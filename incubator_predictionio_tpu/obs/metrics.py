@@ -55,6 +55,12 @@ class MetricError(ValueError):
     """Bad metric/label name, kind mismatch, or malformed exposition text."""
 
 
+#: Exemplars older than this are dropped at exposition time: they likely
+#: outlived the trace spool's retention, and a dangling exemplar sends an
+#: operator to `pio-tpu trace show` for a trace nothing holds anymore.
+EXEMPLAR_MAX_AGE_SEC = 600.0
+
+
 def nearest_rank_percentiles(
         samples: Sequence[float],
         qs: Sequence[float] = (0.5, 0.95, 0.99)) -> dict[str, float]:
@@ -168,10 +174,16 @@ class _Gauge:
 
 
 class _Histogram:
-    """Cumulative fixed-bucket histogram + bounded raw-sample ring."""
+    """Cumulative fixed-bucket histogram + bounded raw-sample ring.
+
+    Optionally keeps one *exemplar* per bucket — the most recent observed
+    value that landed there together with the trace id that produced it
+    (``observe_exemplar``) — exposed in OpenMetrics exemplar syntax so a
+    p99 bucket on ``/metrics`` links straight to a showable trace
+    (docs/observability.md "Exemplars")."""
 
     __slots__ = ("_lock", "buckets", "_counts", "_sum", "_count",
-                 "_ring", "_ring_cap", "_ring_pos")
+                 "_ring", "_ring_cap", "_ring_pos", "_exemplars")
 
     def __init__(self, buckets: Sequence[float], ring_capacity: int = 2048):
         self.buckets = tuple(buckets)  # upper bounds, ascending, no +Inf
@@ -182,14 +194,18 @@ class _Histogram:
         self._ring: list[float] = []
         self._ring_cap = ring_capacity
         self._ring_pos = 0
+        #: bucket index -> (value, trace_id, unix_ts); sparse
+        self._exemplars: dict[int, tuple[float, str, float]] = {}
 
-    def observe(self, value: float) -> None:
+    def _bucket_idx(self, value: float) -> int:
         # bisect without the import: bucket lists are short (~14)
-        idx = len(self.buckets)
         for i, ub in enumerate(self.buckets):
             if value <= ub:
-                idx = i
-                break
+                return i
+        return len(self.buckets)
+
+    def observe(self, value: float) -> None:
+        idx = self._bucket_idx(value)
         with self._lock:
             self._counts[idx] += 1
             self._sum += value
@@ -199,6 +215,35 @@ class _Histogram:
             else:
                 self._ring[self._ring_pos] = value
                 self._ring_pos = (self._ring_pos + 1) % self._ring_cap
+
+    def observe_exemplar(self, value: float,
+                         trace_id: Optional[str] = None) -> None:
+        """``observe()`` plus: when a trace is active (or ``trace_id`` is
+        given), remember (value, trace id, now) as the bucket's exemplar."""
+        if trace_id is None:
+            # lazy import: metrics must stay importable without the trace
+            # module's contextvars machinery in minimal tools
+            from incubator_predictionio_tpu.obs import trace as _trace
+
+            trace_id = _trace.current_trace_id()
+        self.observe(value)
+        if trace_id is None:
+            return
+        idx = self._bucket_idx(value)
+        with self._lock:
+            self._exemplars[idx] = (value, trace_id, time.time())
+
+    def exemplars(self, max_age_sec: Optional[float] = None,
+                  ) -> dict[int, tuple[float, str, float]]:
+        """Per-bucket exemplars, optionally dropping entries older than
+        ``max_age_sec`` — an exemplar outliving the spool's retention
+        would advertise a trace id nothing can show anymore."""
+        with self._lock:
+            snap = dict(self._exemplars)
+        if max_age_sec is None:
+            return snap
+        cutoff = time.time() - max_age_sec
+        return {idx: ex for idx, ex in snap.items() if ex[2] >= cutoff}
 
     @contextlib.contextmanager
     def time(self) -> Iterator[None]:
@@ -283,11 +328,20 @@ class Family:
     def observe(self, value: float) -> None:
         self._default().observe(value)
 
+    def observe_exemplar(self, value: float,
+                         trace_id: Optional[str] = None) -> None:
+        self._default().observe_exemplar(value, trace_id)
+
     def time(self):
         return self._default().time()
 
     def percentiles(self, qs: Sequence[float] = (0.5, 0.95, 0.99)):
         return self._default().percentiles(qs)
+
+    @property
+    def value(self) -> float:
+        """Unlabeled counter/gauge read-through (tests, status pages)."""
+        return self._default().value
 
     def children(self) -> list[tuple[tuple[str, ...], object]]:
         with self._lock:
@@ -300,7 +354,7 @@ class Family:
                 self._children[()] = self._new_child()
 
     # -- exposition -------------------------------------------------------
-    def render(self) -> list[str]:
+    def render(self, exemplars: bool = False) -> list[str]:
         lines = []
         if self.help:
             lines.append(f"# HELP {self.name} "
@@ -309,12 +363,24 @@ class Family:
         for key, child in self.children():
             if self.kind == "histogram":
                 counts, total, count = child.snapshot()
+                exm = (child.exemplars(max_age_sec=EXEMPLAR_MAX_AGE_SEC)
+                       if exemplars else {})
                 cum = 0
-                for ub, c in zip(child.buckets + (math.inf,), counts):
+                for idx, (ub, c) in enumerate(
+                        zip(child.buckets + (math.inf,), counts)):
                     cum += c
                     lab = _fmt_labels(self.labelnames + ("le",),
                                       key + (_fmt_value(float(ub)),))
-                    lines.append(f"{self.name}_bucket{lab} {cum}")
+                    line = f"{self.name}_bucket{lab} {cum}"
+                    ex = exm.get(idx)
+                    if ex is not None:
+                        # OpenMetrics exemplar syntax: the bucket sample,
+                        # then `# {labels} value timestamp` on the same line
+                        value, trace_id, ts = ex
+                        line += (f' # {{trace_id="'
+                                 f'{_escape_label_value(trace_id)}"}} '
+                                 f"{_fmt_value(value)} {repr(float(ts))}")
+                    lines.append(line)
                 lab = _fmt_labels(self.labelnames, key)
                 lines.append(f"{self.name}_sum{lab} {_fmt_value(total)}")
                 lines.append(f"{self.name}_count{lab} {count}")
@@ -376,8 +442,17 @@ class MetricsRegistry:
             self._collectors.pop(key, None)
 
     # -- exposition -------------------------------------------------------
-    def expose(self) -> str:
-        """The full registry in Prometheus text format (version 0.0.4)."""
+    def expose(self, exemplars: bool = False) -> str:
+        """The full registry as exposition text.
+
+        Default: strict Prometheus text format 0.0.4 — NO exemplars,
+        because the 0.0.4 grammar has no exemplar production and a stock
+        Prometheus scraper rejects the whole page on the first ``# {...}``
+        suffix. ``exemplars=True`` appends them in OpenMetrics *exemplar
+        syntax* (the page stays 0.0.4 otherwise — this is pio-tpu's
+        extended exposition, requested explicitly via
+        ``GET /metrics?exemplars=1``, never served to a scraper that
+        didn't ask; obs/http.py)."""
         with self._lock:
             collectors = list(self._collectors.items())
         for key, fn in collectors:
@@ -389,7 +464,7 @@ class MetricsRegistry:
             families = sorted(self._families.values(), key=lambda f: f.name)
         lines: list[str] = []
         for fam in families:
-            lines.extend(fam.render())
+            lines.extend(fam.render(exemplars=exemplars))
         return "\n".join(lines) + "\n"
 
     def reset(self) -> None:
@@ -419,12 +494,16 @@ def timed(hist):
 
 # the label block is matched as a sequence of quoted pairs (not [^}]*):
 # label VALUES may legally contain '}' — e.g. route="/rpc/{store}/{method}"
+_LABELS_BLOCK = (r"(?:\s*[a-zA-Z_][a-zA-Z0-9_]*\s*=\s*"
+                 r'"(?:[^"\\]|\\.)*"\s*,?)*')
 _SAMPLE_RE = re.compile(
     r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
-    r"(?:\{(?P<labels>(?:\s*[a-zA-Z_][a-zA-Z0-9_]*\s*=\s*"
-    r'"(?:[^"\\]|\\.)*"\s*,?)*)\})?'
+    r"(?:\{(?P<labels>" + _LABELS_BLOCK + r")\})?"
     r"\s+(?P<value>[^\s]+)"
-    r"(?:\s+(?P<ts>-?\d+))?$")
+    r"(?:\s+(?P<ts>-?\d+))?"
+    # OpenMetrics exemplar: `# {labels} value [timestamp]` after the sample
+    r"(?:\s+#\s+\{(?P<exlabels>" + _LABELS_BLOCK + r")\}"
+    r"\s+(?P<exvalue>[^\s]+)(?:\s+(?P<exts>[^\s]+))?)?$")
 _LABEL_PAIR_RE = re.compile(
     r'\s*([a-zA-Z_][a-zA-Z0-9_]*)\s*=\s*"((?:[^"\\]|\\.)*)"\s*(?:,|$)')
 
@@ -433,12 +512,38 @@ def _unescape(v: str) -> str:
     return v.replace('\\"', '"').replace("\\n", "\n").replace("\\\\", "\\")
 
 
+def _parse_label_block(raw: Optional[str], lineno: int,
+                       line: str) -> dict[str, str]:
+    labels: dict[str, str] = {}
+    if raw:
+        pos = 0
+        while pos < len(raw):
+            lm = _LABEL_PAIR_RE.match(raw, pos)
+            if lm is None:
+                raise MetricError(
+                    f"line {lineno}: malformed labels: {line!r}")
+            labels[lm.group(1)] = _unescape(lm.group(2))
+            pos = lm.end()
+    return labels
+
+
+def _parse_value(v: str, lineno: int, line: str) -> float:
+    try:
+        return float({"+Inf": "inf", "-Inf": "-inf", "NaN": "nan"}
+                     .get(v, v))
+    except ValueError:
+        raise MetricError(f"line {lineno}: bad value {v!r}: {line!r}")
+
+
 def parse_prometheus_text(text: str) -> dict[str, dict]:
     """Strict parse of the exposition format. Returns
     ``{family: {"type": str|None, "help": str|None,
-    "samples": [(name, labels_dict, value)]}}`` and raises
+    "samples": [(name, labels_dict, value)],
+    "exemplars": [(name, labels_dict, exemplar_dict)]}}`` and raises
     :class:`MetricError` on any malformed line — the validity oracle for
-    ``expose()``'s output."""
+    ``expose()``'s output. Exemplars (OpenMetrics ``# {...} value ts``
+    suffixes on bucket samples) are surfaced in the separate ``exemplars``
+    list so existing 3-tuple ``samples`` consumers never see them."""
     families: dict[str, dict] = {}
 
     def fam_for(name: str) -> dict:
@@ -448,7 +553,8 @@ def parse_prometheus_text(text: str) -> dict[str, dict]:
                 base = name[: -len(suffix)]
                 break
         return families.setdefault(
-            base, {"type": None, "help": None, "samples": []})
+            base, {"type": None, "help": None, "samples": [],
+                   "exemplars": []})
 
     for lineno, line in enumerate(text.splitlines(), 1):
         if not line.strip():
@@ -458,7 +564,8 @@ def parse_prometheus_text(text: str) -> dict[str, dict]:
             if not parts or not _NAME_RE.match(parts[0]):
                 raise MetricError(f"line {lineno}: malformed HELP: {line!r}")
             families.setdefault(
-                parts[0], {"type": None, "help": None, "samples": []})[
+                parts[0], {"type": None, "help": None, "samples": [],
+                           "exemplars": []})[
                 "help"] = parts[1] if len(parts) > 1 else ""
             continue
         if line.startswith("# TYPE "):
@@ -467,7 +574,8 @@ def parse_prometheus_text(text: str) -> dict[str, dict]:
                     "counter", "gauge", "histogram", "summary", "untyped"):
                 raise MetricError(f"line {lineno}: malformed TYPE: {line!r}")
             families.setdefault(
-                parts[0], {"type": None, "help": None, "samples": []})[
+                parts[0], {"type": None, "help": None, "samples": [],
+                           "exemplars": []})[
                 "type"] = parts[1]
             continue
         if line.startswith("#"):
@@ -475,25 +583,19 @@ def parse_prometheus_text(text: str) -> dict[str, dict]:
         m = _SAMPLE_RE.match(line)
         if m is None:
             raise MetricError(f"line {lineno}: malformed sample: {line!r}")
-        labels: dict[str, str] = {}
-        raw = m.group("labels")
-        if raw:
-            pos = 0
-            while pos < len(raw):
-                lm = _LABEL_PAIR_RE.match(raw, pos)
-                if lm is None:
-                    raise MetricError(
-                        f"line {lineno}: malformed labels: {line!r}")
-                labels[lm.group(1)] = _unescape(lm.group(2))
-                pos = lm.end()
-        v = m.group("value")
-        try:
-            value = float({"+Inf": "inf", "-Inf": "-inf", "NaN": "nan"}
-                          .get(v, v))
-        except ValueError:
-            raise MetricError(f"line {lineno}: bad value {v!r}: {line!r}")
-        fam_for(m.group("name"))["samples"].append(
-            (m.group("name"), labels, value))
+        labels = _parse_label_block(m.group("labels"), lineno, line)
+        value = _parse_value(m.group("value"), lineno, line)
+        fam = fam_for(m.group("name"))
+        fam["samples"].append((m.group("name"), labels, value))
+        if m.group("exvalue") is not None:
+            exemplar = {
+                "labels": _parse_label_block(
+                    m.group("exlabels"), lineno, line),
+                "value": _parse_value(m.group("exvalue"), lineno, line),
+                "timestamp": (_parse_value(m.group("exts"), lineno, line)
+                              if m.group("exts") is not None else None),
+            }
+            fam["exemplars"].append((m.group("name"), labels, exemplar))
     return families
 
 

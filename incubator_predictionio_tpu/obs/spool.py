@@ -1,0 +1,376 @@
+"""Durable span export: finished spans appended to a CRC-framed on-disk
+spool that survives process death (docs/observability.md "The trace plane").
+
+PR 2's in-memory span ring answers "what just happened" — until the
+process dies or the ring evicts under load. The spool is the durable half:
+each process appends the spans the sampling rules keep to segment files in
+``PIO_TRACE_SPOOL_DIR`` using the exact WAL frame format from
+:mod:`incubator_predictionio_tpu.resilience.wal` (magic + ``[u32 len][u32
+crc32][json payload]``). Writes happen on a dedicated bounded-queue
+writer thread (the thread finishing a span — often the server's event
+loop — only enqueues; a full queue drops, counted, so spool backpressure
+can never reach the serving path) and each record is flushed as written,
+so a SIGKILL loses at most the few-ms tail still in the queue — the chaos
+suites read the victim's spool to see what it was doing when it died.
+
+Layout and bounds:
+
+- segments are named ``spool-<service>-<pid>-<n>.log`` so any number of
+  processes can share one spool directory without coordination (the
+  assembler, :mod:`.collect`, reads them all);
+- a segment rotates at ``PIO_TRACE_SPOOL_SEGMENT_BYTES``; the spool is
+  bounded by ``PIO_TRACE_SPOOL_MAX_BYTES`` per process with WHOLE-SEGMENT
+  eviction of this process's oldest closed segment — readers racing an
+  eviction lose a whole old segment cleanly, never a torn prefix;
+- readers use :func:`~incubator_predictionio_tpu.resilience.wal.
+  tail_frames` (the live-writer contract: a partial tail is "waiting",
+  not corruption).
+
+What gets spooled is the sampling policy's job (:func:`~incubator_
+predictionio_tpu.obs.trace.keep_reason`): head-sampled spans, plus — always
+— error-status spans and spans over ``PIO_TRACE_SLOW_MS``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import queue
+import threading
+from typing import Any, Optional
+
+from incubator_predictionio_tpu.obs import trace
+from incubator_predictionio_tpu.obs.metrics import REGISTRY
+from incubator_predictionio_tpu.resilience.wal import MAGIC, write_frame
+
+logger = logging.getLogger(__name__)
+
+#: env knobs (docs/configuration.md)
+ENV_DIR = "PIO_TRACE_SPOOL_DIR"
+ENV_SAMPLE = "PIO_TRACE_SAMPLE"
+ENV_SLOW_MS = "PIO_TRACE_SLOW_MS"
+ENV_SEGMENT_BYTES = "PIO_TRACE_SPOOL_SEGMENT_BYTES"
+ENV_MAX_BYTES = "PIO_TRACE_SPOOL_MAX_BYTES"
+
+DEFAULT_SLOW_MS = 1000.0
+DEFAULT_SEGMENT_BYTES = 4 << 20
+DEFAULT_MAX_BYTES = 64 << 20
+
+_SEG_PREFIX = "spool-"
+_SEG_SUFFIX = ".log"
+
+SPOOLED = REGISTRY.counter(
+    "pio_trace_spooled_spans_total",
+    "Finished spans appended to the durable trace spool, by keep reason "
+    "(head = sampled-in, error/slow = tail rules that override a drop "
+    "decision)", labels=("reason",))
+EVICTED = REGISTRY.counter(
+    "pio_trace_spool_evicted_segments_total",
+    "Whole spool segments deleted to hold this process under "
+    "PIO_TRACE_SPOOL_MAX_BYTES")
+SPOOL_BYTES = REGISTRY.gauge(
+    "pio_trace_spool_bytes",
+    "Bytes of span spool currently on disk for this process's segments")
+EXPORT_ERRORS = REGISTRY.counter(
+    "pio_trace_export_errors_total",
+    "Span export attempts that failed (I/O error on the spool) — the span "
+    "stays in the in-memory ring; the request is never failed")
+DROPPED = REGISTRY.counter(
+    "pio_trace_spool_dropped_total",
+    "Kept spans dropped because the spool writer's bounded queue was full "
+    "(disk slower than the span rate) — backpressure never reaches the "
+    "serving path")
+
+
+def spool_files(directory: str) -> list[str]:
+    """Every spool segment in ``directory`` (any service, any pid), oldest
+    first by (name) — segment numbers are zero-padded so lexicographic
+    order is append order within one writer."""
+    try:
+        names = sorted(os.listdir(directory))
+    except FileNotFoundError:
+        return []
+    return [os.path.join(directory, n) for n in names
+            if n.startswith(_SEG_PREFIX) and n.endswith(_SEG_SUFFIX)]
+
+
+class SpanSpool:
+    """One process's span spool writer in ``directory`` (created on
+    demand). Thread-safe: spans finish on the event loop, executor threads,
+    and background workers alike."""
+
+    def __init__(self, directory: str, service: str = "proc",
+                 segment_bytes: int = DEFAULT_SEGMENT_BYTES,
+                 max_bytes: int = DEFAULT_MAX_BYTES):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        # keep the filename component inert: service names are code-chosen,
+        # but a path separator here would escape the spool dir
+        safe = "".join(c if (c.isalnum() or c in "_.") else "_"
+                       for c in service) or "proc"
+        self._prefix = f"{_SEG_PREFIX}{safe}-{os.getpid()}-"
+        self.segment_bytes = max(4096, segment_bytes)
+        self.max_bytes = max(self.segment_bytes, max_bytes)
+        self._lock = threading.Lock()
+        #: this writer's closed segments as (path, size) — sizes are
+        #: recorded once at close/scan so the per-append accounting below
+        #: is O(1), not a stat() of every segment on the request path
+        self._own: list[tuple[str, int]] = []
+        self._closed_bytes = 0
+        self._next_n = self._scan_next_n()
+        self._active_path = ""
+        self._active = None
+        self._open_segment()
+
+    def _scan_next_n(self) -> int:
+        """Continue numbering after any segments a previous writer with the
+        same service+pid prefix left (same-process reconfigure in tests and
+        bench lanes must not collide with its own files)."""
+        n = 0
+        for path in spool_files(self.directory):
+            name = os.path.basename(path)
+            if not name.startswith(self._prefix):
+                continue
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                size = 0
+            self._own.append((path, size))
+            self._closed_bytes += size
+            try:
+                n = max(n, int(name[len(self._prefix):-len(_SEG_SUFFIX)]))
+            except ValueError:
+                pass
+        return n + 1
+
+    def _open_segment(self) -> None:
+        self._active_path = os.path.join(
+            self.directory, f"{self._prefix}{self._next_n:08d}{_SEG_SUFFIX}")
+        self._next_n += 1
+        self._active = open(self._active_path, "ab")
+        self._active.write(MAGIC)
+        self._active.flush()
+
+    def _own_bytes(self) -> int:
+        """Running total: closed-segment sizes + the active tell() — no
+        filesystem walk."""
+        try:
+            active = self._active.tell()
+        except (OSError, ValueError):  # pragma: no cover
+            active = 0
+        return self._closed_bytes + active
+
+    def add(self, record: dict[str, Any]) -> None:
+        """Frame + flush one span record. Raises OSError/ValueError on I/O
+        failure — the exporter shim catches and counts; span export must
+        never fail the request that produced the span."""
+        payload = json.dumps(record, separators=(",", ":"),
+                             default=str).encode()
+        with self._lock:
+            write_frame(self._active, payload)
+            # flush (no fsync): the chaos contract is SIGKILL survival —
+            # data handed to the kernel survives process death; an fsync
+            # per span would tax the serving path for power-cut durability
+            # nobody asked of a diagnostic artifact
+            self._active.flush()
+            if self._active.tell() >= self.segment_bytes:
+                size = self._active.tell()
+                self._active.close()
+                self._own.append((self._active_path, size))
+                self._closed_bytes += size
+                self._open_segment()
+            while self._own and self._own_bytes() > self.max_bytes:
+                victim, size = self._own.pop(0)
+                self._closed_bytes -= size
+                try:
+                    os.remove(victim)
+                except OSError:  # pragma: no cover - already gone
+                    pass
+                EVICTED.inc()
+            SPOOL_BYTES.set(self._own_bytes())
+
+    def flush(self) -> None:
+        with self._lock:
+            try:
+                self._active.flush()
+            except (OSError, ValueError):  # pragma: no cover
+                pass
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._active.flush()
+                self._active.close()
+            except (OSError, ValueError):  # pragma: no cover
+                pass
+
+
+# ---------------------------------------------------------------------------
+# process-wide wiring (servers call configure_export_from_env at boot)
+# ---------------------------------------------------------------------------
+
+_STOP = object()
+
+
+class _SpoolWriter:
+    """Bounded-queue writer thread: the thread that finishes a span (often
+    the server's event loop) only enqueues; disk write+flush happens here.
+    A full queue DROPS the span (counted) — when the process is saturated
+    and sheds 503s, every shed span is tail-kept, and synchronous spool
+    I/O on the loop would tax serving exactly when it can least afford it.
+    The cost: spans sit in the queue for ~ms before reaching the kernel, so
+    a SIGKILL can lose the tail of the queue (the ring keeps its copy)."""
+
+    def __init__(self, spool: SpanSpool, maxsize: int = 2048):
+        self.spool = spool
+        self._q: queue.Queue = queue.Queue(maxsize=maxsize)
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="trace-spool-writer")
+        self._thread.start()
+
+    def submit(self, record: dict, reason: str) -> None:
+        try:
+            self._q.put_nowait((record, reason))
+        except queue.Full:
+            DROPPED.inc()
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is _STOP:
+                return
+            record, reason = item
+            try:
+                self.spool.add(record)
+            except (OSError, ValueError):
+                EXPORT_ERRORS.inc()
+                continue
+            SPOOLED.labels(reason=reason).inc()
+
+    def drain(self, timeout: float = 5.0) -> None:
+        """Best-effort wait for queued spans to reach the file (lifecycle
+        flush; never blocks shutdown past the timeout)."""
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        while not self._q.empty() and _time.monotonic() < deadline:
+            _time.sleep(0.01)
+
+    def stop(self, timeout: float = 5.0) -> None:
+        """FIFO guarantees everything enqueued before the sentinel is
+        written before the thread exits."""
+        try:
+            self._q.put_nowait(_STOP)
+        except queue.Full:  # pragma: no cover - drop tail, stop anyway
+            with self._q.mutex:
+                self._q.queue.clear()
+            self._q.put(_STOP)
+        self._thread.join(timeout=timeout)
+
+
+_STATE_LOCK = threading.Lock()
+_SPOOL: Optional[SpanSpool] = None
+_WRITER: Optional[_SpoolWriter] = None
+
+
+def export_span(span) -> None:
+    """The export hook installed on :mod:`.trace`: apply the tail/head keep
+    rules, then hand the span to the writer thread. Never raises, never
+    blocks on disk."""
+    writer = _WRITER
+    if writer is None:
+        return
+    _, slow_sec = trace.sampling()
+    reason = trace.keep_reason(span.sampled, span.status, span.duration,
+                               slow_sec)
+    if reason is None:
+        return
+    writer.submit(span.to_dict(), reason)
+
+
+def _float_env(name: str, default: Optional[float]) -> Optional[float]:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        logger.warning("ignoring non-numeric %s=%r", name, raw)
+        return default
+
+
+def configure_export_from_env(service: str) -> Optional[SpanSpool]:
+    """Apply the PIO_TRACE_* env state to this process: sampling rate +
+    slow threshold always; the durable spool when PIO_TRACE_SPOOL_DIR is
+    set (unset tears an existing spool down). Every server calls this at
+    construction — idempotent, last call wins, returns the active spool
+    (None when export is disabled)."""
+    global _SPOOL, _WRITER
+    with _STATE_LOCK:
+        trace.set_sampling(
+            rate=_float_env(ENV_SAMPLE, None),
+            slow_ms=_float_env(ENV_SLOW_MS, DEFAULT_SLOW_MS))
+        directory = os.environ.get(ENV_DIR)
+        _teardown_locked()
+        if not directory:
+            return None
+        try:
+            _SPOOL = SpanSpool(
+                directory, service=service,
+                segment_bytes=int(_float_env(
+                    ENV_SEGMENT_BYTES, DEFAULT_SEGMENT_BYTES)),
+                max_bytes=int(_float_env(ENV_MAX_BYTES, DEFAULT_MAX_BYTES)))
+        except OSError as e:
+            # an unwritable spool dir degrades to ring-only tracing — the
+            # trace plane is diagnostics, never a reason to refuse to serve
+            logger.error("trace spool disabled (cannot open %s: %s)",
+                         directory, e)
+            EXPORT_ERRORS.inc()
+            return None
+        _WRITER = _SpoolWriter(_SPOOL)
+        trace.set_exporter(export_span)
+        logger.info("trace spool: %s (service=%s sample=%s slow_ms=%s)",
+                    _SPOOL.directory, service,
+                    os.environ.get(ENV_SAMPLE, "1"),
+                    os.environ.get(ENV_SLOW_MS, DEFAULT_SLOW_MS))
+        return _SPOOL
+
+
+def configured_spool() -> Optional[SpanSpool]:
+    return _SPOOL
+
+
+def flush_export() -> None:
+    """Drain queued spans to the file (server drain/shutdown hook). No-op
+    when export is disabled."""
+    writer, sp = _WRITER, _SPOOL
+    if writer is not None:
+        writer.drain()
+    if sp is not None:
+        sp.flush()
+
+
+def _teardown_locked() -> None:
+    global _SPOOL, _WRITER
+    trace.set_exporter(None)
+    if _WRITER is not None:
+        _WRITER.stop()
+        _WRITER = None
+    if _SPOOL is not None:
+        _SPOOL.close()
+        _SPOOL = None
+
+
+def close_export() -> None:
+    """Tear down the writer, spool, and export hook (tests, bench lanes).
+    Everything already enqueued is written first."""
+    with _STATE_LOCK:
+        _teardown_locked()
+
+
+__all__ = ["SpanSpool", "spool_files", "export_span",
+           "configure_export_from_env", "configured_spool",
+           "flush_export", "close_export",
+           "ENV_DIR", "ENV_SAMPLE", "ENV_SLOW_MS",
+           "ENV_SEGMENT_BYTES", "ENV_MAX_BYTES", "DEFAULT_SLOW_MS"]

@@ -14,30 +14,45 @@ call is ONE trace across both span logs.
 Every finished span lands in :data:`TRACES`, a bounded ring the servers
 serve at ``GET /traces.json`` — the flight-recorder view an operator reads
 after a latency blip, without having deployed a tracing backend first.
+
+The ring is process-local and evicts under load; the durable half of the
+trace plane lives in :mod:`.spool` (finished spans appended to a CRC-framed
+on-disk spool) and :mod:`.collect` (cross-process assembly). This module
+additionally owns the *sampling* identity: the process at the edge of a
+request (the fleet router, or the first server a client hits) mints a
+head-based keep/drop decision when it roots a trace, and the decision rides
+the ``X-PIO-Trace`` header as a ``:s=0|1`` suffix so every downstream hop
+agrees. Tail-based keep rules (error spans, slow spans) are applied by the
+export hook regardless of the head decision (docs/observability.md).
 """
 
 from __future__ import annotations
 
 import contextlib
 import contextvars
+import random
 import threading
 import time
 import uuid
 from collections import deque
-from typing import Any, Iterator, Optional
+from typing import Any, Callable, Iterator, Optional
 
-#: Propagation header: ``<trace_id>:<span_id>`` (ids are 16 hex chars).
+#: Propagation header: ``<trace_id>:<span_id>[:s=0|1]`` (ids are 16 hex
+#: chars; the optional third field is the head sampling decision — peers
+#: that predate it simply ignore extra ``:``-separated fields).
 TRACE_HEADER = "X-PIO-Trace"
 
 
 class SpanContext:
-    """The ambient identity: which trace we are in, which span is current."""
+    """The ambient identity: which trace we are in, which span is current,
+    and whether the trace's head sampling decision said *keep*."""
 
-    __slots__ = ("trace_id", "span_id")
+    __slots__ = ("trace_id", "span_id", "sampled")
 
-    def __init__(self, trace_id: str, span_id: str):
+    def __init__(self, trace_id: str, span_id: str, sampled: bool = True):
         self.trace_id = trace_id
         self.span_id = span_id
+        self.sampled = sampled
 
 
 class Span:
@@ -45,10 +60,12 @@ class Span:
     into the buffer exactly once, at exit."""
 
     __slots__ = ("trace_id", "span_id", "parent_id", "name", "service",
-                 "start_unix", "duration", "status", "attrs", "_t0")
+                 "start_unix", "duration", "status", "attrs", "sampled",
+                 "_t0")
 
     def __init__(self, trace_id: str, span_id: str, parent_id: Optional[str],
-                 name: str, service: Optional[str], attrs: dict[str, Any]):
+                 name: str, service: Optional[str], attrs: dict[str, Any],
+                 sampled: bool = True):
         self.trace_id = trace_id
         self.span_id = span_id
         self.parent_id = parent_id
@@ -58,6 +75,7 @@ class Span:
         self.duration = 0.0
         self.status = "ok"
         self.attrs = attrs
+        self.sampled = sampled
         self._t0 = time.perf_counter()
 
     def set_attr(self, key: str, value: Any) -> None:
@@ -73,6 +91,7 @@ class Span:
             "startUnix": self.start_unix,
             "durationSec": self.duration,
             "status": self.status,
+            "sampled": self.sampled,
             "attrs": dict(self.attrs),
         }
 
@@ -83,6 +102,71 @@ _CURRENT: contextvars.ContextVar[Optional[SpanContext]] = \
 
 def _new_id() -> str:
     return uuid.uuid4().hex[:16]
+
+
+# -- sampling + export configuration ----------------------------------------
+# Process-wide, set once at boot (obs/spool.py configure_export_from_env) or
+# explicitly by tests. ``None`` rate means "not configured": every root is
+# sampled, matching the pre-sampling behaviour bit for bit.
+
+_SAMPLE_RATE: Optional[float] = None
+_SLOW_SEC: Optional[float] = None
+_EXPORTER: Optional[Callable[[Span], None]] = None
+_SAMPLE_RNG = random.Random()
+
+
+def set_sampling(rate: Optional[float] = None,
+                 slow_ms: Optional[float] = None) -> None:
+    """Install the head sampling rate (0..1; None = keep everything) and the
+    tail slow-span threshold in milliseconds (None = no slow rule)."""
+    global _SAMPLE_RATE, _SLOW_SEC
+    _SAMPLE_RATE = None if rate is None else min(1.0, max(0.0, float(rate)))
+    _SLOW_SEC = None if slow_ms is None else float(slow_ms) / 1e3
+
+
+def sampling() -> tuple[Optional[float], Optional[float]]:
+    """(rate, slow_sec) as currently configured."""
+    return _SAMPLE_RATE, _SLOW_SEC
+
+
+def set_exporter(fn: Optional[Callable[[Span], None]]) -> None:
+    """Install (or clear) the finished-span export hook. The hook runs on
+    whatever thread finished the span and MUST NOT raise — a broken export
+    sink must never fail the request that produced the span."""
+    global _EXPORTER
+    _EXPORTER = fn
+
+
+def export_enabled() -> bool:
+    return _EXPORTER is not None
+
+
+def _mint_sampled() -> bool:
+    """The head-based decision, minted exactly once per trace — at the
+    process that roots it (the edge)."""
+    if _SAMPLE_RATE is None or _SAMPLE_RATE >= 1.0:
+        return True
+    if _SAMPLE_RATE <= 0.0:
+        return False
+    return _SAMPLE_RNG.random() < _SAMPLE_RATE
+
+
+def keep_reason(sampled: bool, status: str, duration_sec: float,
+                slow_sec: Optional[float]) -> Optional[str]:
+    """Why a finished span should reach the durable spool, or None to drop.
+
+    Tail rules outrank the head decision: ``error:*`` spans and spans over
+    the slow threshold are ALWAYS kept, so 1% head sampling still captures
+    100% of the interesting traces. Non-error terminal statuses (e.g. the
+    middleware's ``http401`` for orderly raised 4xx) follow the head
+    decision — a client hammering bad credentials must not flood the spool.
+    Pure — the FakeClock-style tail-sampling tests drive it with synthetic
+    durations, zero wall sleeps."""
+    if status.startswith("error"):
+        return "error"
+    if slow_sec is not None and duration_sec >= slow_sec:
+        return "slow"
+    return "head" if sampled else None
 
 
 def current_context() -> Optional[SpanContext]:
@@ -113,7 +197,12 @@ class TraceBuffer:
 
     def traces(self, limit: int = 50) -> list[dict]:
         """Recent traces, newest first: one entry per trace id with its span
-        tree flattened (spans in start order)."""
+        tree flattened (spans in start order).
+
+        Each entry carries ``"complete"``: the root span is present AND no
+        span's ``parentId`` dangles. A trace whose older spans were evicted
+        by the ring looks exactly like a short trace otherwise — the flag is
+        what keeps a partial trace from being read as a whole one."""
         if limit <= 0:  # order[-limit:] would invert the meaning
             return []
         with self._lock:
@@ -128,10 +217,15 @@ class TraceBuffer:
         out = []
         for tid in reversed(order[-limit:]):
             spans = sorted(by_trace[tid], key=lambda s: s.start_unix)
+            ids = {s.span_id for s in spans}
+            has_root = any(s.parent_id is None for s in spans)
+            dangling = any(s.parent_id is not None and s.parent_id not in ids
+                           for s in spans)
             out.append({
                 "traceId": tid,
                 "spanCount": len(spans),
                 "durationSec": max((s.duration for s in spans), default=0.0),
+                "complete": has_root and not dangling,
                 "spans": [s.to_dict() for s in spans],
             })
         return out
@@ -154,17 +248,26 @@ def span(name: str, service: Optional[str] = None,
     parent = _CURRENT.get()
     trace_id = parent.trace_id if parent is not None else _new_id()
     parent_id = parent.span_id if parent is not None else None
-    sp = Span(trace_id, _new_id(), parent_id, name, service, attrs)
-    token = _CURRENT.set(SpanContext(trace_id, sp.span_id))
+    sampled = parent.sampled if parent is not None else _mint_sampled()
+    sp = Span(trace_id, _new_id(), parent_id, name, service, attrs,
+              sampled=sampled)
+    token = _CURRENT.set(SpanContext(trace_id, sp.span_id, sampled))
     try:
         yield sp
     except BaseException as e:
-        sp.status = f"error:{type(e).__name__}"
+        # the body may have already classified the outcome (the telemetry
+        # middleware downgrades raised 4xx HTTPExceptions to a non-error
+        # terminal status before they propagate) — respect it
+        if sp.status == "ok":
+            sp.status = f"error:{type(e).__name__}"
         raise
     finally:
         sp.duration = time.perf_counter() - sp._t0
         _CURRENT.reset(token)
         (buffer or TRACES).add(sp)
+        exporter = _EXPORTER
+        if exporter is not None:
+            exporter(sp)
 
 
 @contextlib.contextmanager
@@ -186,16 +289,20 @@ def trace_scope(ctx: Optional[SpanContext]) -> Iterator[None]:
 
 def header_value() -> Optional[str]:
     """The outbound ``X-PIO-Trace`` value for the current context, or None
-    when no trace is active (callers simply omit the header)."""
+    when no trace is active (callers simply omit the header). Carries the
+    head sampling decision as ``:s=0|1`` — peers that predate the flag only
+    read the first two ``:`` fields and ignore the rest."""
     ctx = _CURRENT.get()
     if ctx is None:
         return None
-    return f"{ctx.trace_id}:{ctx.span_id}"
+    return f"{ctx.trace_id}:{ctx.span_id}:s={1 if ctx.sampled else 0}"
 
 
 def parse_header(value: Optional[str]) -> Optional[SpanContext]:
-    """``<trace_id>:<span_id>`` (or bare ``<trace_id>``) → SpanContext.
-    Malformed values are ignored — a bad header must never fail a request."""
+    """``<trace_id>:<span_id>[:s=0|1]`` (or bare ``<trace_id>``) →
+    SpanContext. Malformed values are ignored — a bad header must never
+    fail a request. An absent/unparseable ``s=`` flag means *sampled*: a
+    header from an old peer keeps today's keep-everything behaviour."""
     if not value:
         return None
 
@@ -212,7 +319,14 @@ def parse_header(value: Optional[str]) -> Optional[SpanContext]:
     sid = parts[1] if len(parts) > 1 and parts[1] else tid
     if not ok(sid):
         return None
-    return SpanContext(tid, sid)
+    sampled = True
+    for extra in parts[2:]:
+        if extra == "s=0":
+            sampled = False
+        elif extra == "s=1":
+            sampled = True
+        # anything else: a future field this version doesn't know — ignore
+    return SpanContext(tid, sid, sampled)
 
 
 def inject(headers) -> None:

@@ -200,6 +200,11 @@ class EventServer:
         self.config = config
         self.storage = storage or get_storage()
         self.stats = Stats()
+        # durable span export + sampling (obs/spool.py): applies the
+        # PIO_TRACE_* env state; a no-op unless the spool dir is set
+        from incubator_predictionio_tpu.obs import spool as trace_spool
+
+        trace_spool.configure_export_from_env("event_server")
         # -- overload protection (resilience/admission.py) ----------------
         # per-access-key token buckets: a misbehaving client is throttled
         # alone instead of starving every tenant's ingest; the drain-rate
@@ -1218,6 +1223,9 @@ class EventServer:
         if self._wal is not None:
             self._wal.close()
         self._executor.shutdown(wait=False)
+        from incubator_predictionio_tpu.obs import spool as trace_spool
+
+        trace_spool.flush_export()
 
 
 def serve_forever(config: EventServerConfig = EventServerConfig(),

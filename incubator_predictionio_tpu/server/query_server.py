@@ -762,6 +762,11 @@ class QueryServer:
         self._clock = clock
         self.storage = storage or get_storage()
         self.ctx = ctx or MeshContext.create()
+        # durable span export + sampling (obs/spool.py): applies the
+        # PIO_TRACE_* env state; a no-op unless the spool dir is set
+        from incubator_predictionio_tpu.obs import spool as trace_spool
+
+        trace_spool.configure_export_from_env("query_server")
         # an explicit DeployedEngine skips storage loading (tests inject
         # hand-built engines to script failure modes)
         self.deployed = deployed or load_deployed_engine(
@@ -1798,6 +1803,12 @@ class QueryServer:
         for task in list(self._resize_tasks):
             task.cancel()
         await self.batcher.stop()
+        # lifecycle flush for the trace spool: the drain's last spans (the
+        # 503s it answered, the final dispatches) must reach disk before
+        # the process exits
+        from incubator_predictionio_tpu.obs import spool as trace_spool
+
+        trace_spool.flush_export()
 
 
 def serve_forever(config: ServerConfig, storage: Optional[Storage] = None) -> None:

@@ -161,6 +161,11 @@ class StorageServer:
                  storage: Optional[Storage] = None):
         self.config = config
         self.storage = storage or get_storage()
+        # durable span export + sampling (obs/spool.py): applies the
+        # PIO_TRACE_* env state; a no-op unless the spool dir is set
+        from incubator_predictionio_tpu.obs import spool as trace_spool
+
+        trace_spool.configure_export_from_env("storage_server")
         self._executor = ThreadPoolExecutor(
             max_workers=8, thread_name_prefix="pio-storage")
         self._runner: Optional[web.AppRunner] = None
@@ -607,6 +612,9 @@ class StorageServer:
         if self._repl is not None:
             self._repl.stop()
         self._executor.shutdown(wait=False)
+        from incubator_predictionio_tpu.obs import spool as trace_spool
+
+        trace_spool.flush_export()
 
 
 def serve_forever(config: StorageServerConfig,

@@ -43,6 +43,7 @@ import urllib.error
 import urllib.request
 from typing import Optional
 
+from incubator_predictionio_tpu.obs import trace
 from incubator_predictionio_tpu.resilience.wal import (
     MAGIC as WAL_MAGIC,
     write_frame,
@@ -110,9 +111,13 @@ class HttpTransport:
         Raises ShipError on transport failure or non-2xx/409 statuses."""
         import json as _json
 
+        headers = {"Content-Type": "application/octet-stream"}
+        # the replica's /delta handling joins the updater's trace — a slow
+        # or failing delta apply is visible in the assembled trace tree
+        trace.inject(headers)
         req = urllib.request.Request(
             f"{url}/delta{self._qs()}", data=payload, method="POST",
-            headers={"Content-Type": "application/octet-stream"})
+            headers=headers)
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
                 return _json.loads(resp.read() or b"{}")
@@ -145,6 +150,13 @@ class StreamUpdater:
         self.transport = transport or HttpTransport(
             config.access_key, config.ship_timeout)
         self.guard = guard or guards.DivergenceGuard()
+        # the updater is a dark plane (no HTTP surface of its own): the
+        # span spool (obs/spool.py, PIO_TRACE_SPOOL_DIR) is how its
+        # fold/ship spans reach the fleet-wide trace assembly, and
+        # --obs-port (tools/cli.py) is how its registry gets scraped
+        from incubator_predictionio_tpu.obs import spool as trace_spool
+
+        trace_spool.configure_export_from_env("stream_updater")
         os.makedirs(config.state_dir, exist_ok=True)
         self.model = model
         self._handle_instance_change()
@@ -303,25 +315,30 @@ class StreamUpdater:
         """Bring one replica up to date from the archived chain. The
         replica's /health names what it has; we send, in order, everything
         past that — duplicates (crash replay) come back as counted dedups."""
-        applied, instance = self.transport.applied_seq(url)
-        if instance is not None and instance != self.instance_id:
-            raise ShipError(
-                f"{url}: serves instance {instance}, chain is for "
-                f"{self.instance_id} (deploy/reload the base model first)")
-        paths = deltas.chain_from(self.config.state_dir, applied)
-        shipped = deduped = 0
-        for path in paths:
-            answer = self.transport.ship(
-                url, open(path, "rb").read())
-            status = answer.get("status")
-            if status in ("applied", "ok"):
-                shipped += 1
-            elif status == "duplicate":
-                deduped += 1
-            else:
-                raise ShipError(f"{url}: delta {os.path.basename(path)} "
-                                f"rejected: {answer}")
-        return {"url": url, "shipped": shipped, "deduped": deduped}
+        with trace.span("stream.ship", service="stream_updater",
+                        replica=url) as sp:
+            applied, instance = self.transport.applied_seq(url)
+            if instance is not None and instance != self.instance_id:
+                raise ShipError(
+                    f"{url}: serves instance {instance}, chain is for "
+                    f"{self.instance_id} (deploy/reload the base model "
+                    "first)")
+            paths = deltas.chain_from(self.config.state_dir, applied)
+            shipped = deduped = 0
+            for path in paths:
+                answer = self.transport.ship(
+                    url, open(path, "rb").read())
+                status = answer.get("status")
+                if status in ("applied", "ok"):
+                    shipped += 1
+                elif status == "duplicate":
+                    deduped += 1
+                else:
+                    raise ShipError(f"{url}: delta {os.path.basename(path)} "
+                                    f"rejected: {answer}")
+            sp.set_attr("shipped", shipped)
+            sp.set_attr("deduped", deduped)
+            return {"url": url, "shipped": shipped, "deduped": deduped}
 
     def ship_all(self) -> list[dict]:
         out = []
@@ -352,6 +369,17 @@ class StreamUpdater:
                 "status": "waiting" if batch.waiting else "idle",
                 "cursor": self.cursor["seq"], "ships": ships}
             return self.last_result
+        # one trace per folded batch: the dark plane's unit of work. The
+        # ship spans (and, via the injected header, each replica's /delta
+        # span) hang off it in the fleet-wide assembly
+        with trace.span("stream.fold_batch", service="stream_updater",
+                        fromSeq=batch.from_seq, toSeq=batch.to_seq,
+                        events=len(batch.events)) as sp:
+            out = self._fold_and_ship(batch)
+            sp.set_attr("status", out.get("status"))
+            return out
+
+    def _fold_and_ship(self, batch) -> dict:
         result, poison = self.trainer.fold(batch.events)
         if poison:
             self._dead_letter(poison, "fold rejected (poison event)")

@@ -839,12 +839,24 @@ def cmd_stream(args, storage: Storage) -> int:
     updater = StreamUpdater(cfg, model, instance_id,
                             event_names=event_names,
                             default_values=defaults)
-    if args.once:
-        out = updater.run_once()
-        _out(json.dumps(out, default=str))
-        return 1 if out["status"] == "quarantined" else 0
-    updater.run_forever(max_batches=args.max_batches)
-    return 1 if updater.quarantined else 0
+    obs_handle = None
+    if args.obs_port:
+        # the updater has no HTTP surface of its own; this thread serves
+        # the shared /metrics + /traces.json so pio_stream_* is scrapeable
+        from incubator_predictionio_tpu.obs.http import start_obs_server
+
+        obs_handle = start_obs_server("stream_updater", args.obs_port,
+                                      ip=args.obs_ip)
+    try:
+        if args.once:
+            out = updater.run_once()
+            _out(json.dumps(out, default=str))
+            return 1 if out["status"] == "quarantined" else 0
+        updater.run_forever(max_batches=args.max_batches)
+        return 1 if updater.quarantined else 0
+    finally:
+        if obs_handle is not None:
+            obs_handle.close()
 
 
 def _fetch_health(url: str, timeout: float = 5.0) -> dict:
@@ -1140,35 +1152,51 @@ def cmd_index(args, storage: Storage) -> int:
     return 0
 
 
-def cmd_metrics(args, storage) -> int:
-    """Fetch and pretty-print a server's ``/metrics`` page (any of the three
-    servers — event, query, storage — serves one; docs/observability.md)."""
-    import math
+def _fetch_metrics_text(url: str, timeout: float = 10.0,
+                        exemplars: bool = False) -> str:
+    """GET one /metrics page. Module-level so tests can stub it. The
+    pretty-printer asks for exemplars explicitly (``?exemplars=1``);
+    ``--raw`` output must stay strict 0.0.4 — its consumers (promtool, a
+    pasted scrape) never asked for exemplar suffixes."""
     import urllib.request
 
-    from incubator_predictionio_tpu.obs.metrics import (
-        MetricError,
-        bucket_quantiles,
-        parse_prometheus_text,
-    )
+    if exemplars:
+        url = f"{url}{'&' if '?' in url else '?'}exemplars=1"
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read().decode()
 
-    url = args.url.rstrip("/")
-    if not url.endswith("/metrics"):
-        url += "/metrics"
-    try:
-        with urllib.request.urlopen(url, timeout=10) as resp:
-            text = resp.read().decode()
-    except Exception as e:  # noqa: BLE001
-        _err(f"Unable to fetch {url}: {e}")
-        return 1
-    try:
-        families = parse_prometheus_text(text)
-    except MetricError as e:
-        _err(f"{url} served malformed metrics: {e}")
-        return 1
-    if args.raw:
-        _out(text.rstrip())
-        return 0
+
+def _metrics_url(url: str) -> str:
+    url = url.rstrip("/")
+    return url if url.endswith("/metrics") else url + "/metrics"
+
+
+def _hist_by_labelset(samples) -> dict:
+    """Histogram samples → {labelset_key: {"buckets": [(le, cum)],
+    "sum": x, "count": n}}."""
+    by_key: dict[tuple, dict] = {}
+    for sname, labels, value in samples:
+        key = tuple(sorted((k, v) for k, v in labels.items() if k != "le"))
+        slot = by_key.setdefault(key, {"buckets": [], "sum": 0.0,
+                                       "count": 0.0})
+        if sname.endswith("_bucket"):
+            slot["buckets"].append((float(labels["le"]), value))
+        elif sname.endswith("_sum"):
+            slot["sum"] = value
+        elif sname.endswith("_count"):
+            slot["count"] = value
+    return by_key
+
+
+def _label_str(key: tuple) -> str:
+    return ",".join(f"{k}={v}" for k, v in key) or "(no labels)"
+
+
+def _render_metrics_single(families, args) -> None:
+    import math
+
+    from incubator_predictionio_tpu.obs.metrics import bucket_quantiles
+
     for name in sorted(families):
         fam = families[name]
         kind, samples = fam["type"] or "untyped", fam["samples"]
@@ -1176,26 +1204,27 @@ def cmd_metrics(args, storage) -> int:
             continue
         _out(f"{name} ({kind})" + (f" — {fam['help']}" if fam["help"] else ""))
         if kind == "histogram":
+            ex_by_key: dict[tuple, list] = {}
+            for sname, labels, ex in fam.get("exemplars", []):
+                k = tuple(sorted((lk, lv) for lk, lv in labels.items()
+                                 if lk != "le"))
+                ex_by_key.setdefault(k, []).append((labels.get("le", "?"),
+                                                    ex))
             # per label-set: count, sum, mean, estimated quantiles
-            by_key: dict[tuple, dict] = {}
-            for sname, labels, value in samples:
-                key = tuple(sorted((k, v) for k, v in labels.items()
-                                   if k != "le"))
-                slot = by_key.setdefault(key, {"buckets": []})
-                if sname.endswith("_bucket"):
-                    slot["buckets"].append((float(labels["le"]), value))
-                elif sname.endswith("_sum"):
-                    slot["sum"] = value
-                elif sname.endswith("_count"):
-                    slot["count"] = value
-            for key, slot in sorted(by_key.items()):
-                label = ",".join(f"{k}={v}" for k, v in key) or "(no labels)"
+            for key, slot in sorted(_hist_by_labelset(samples).items()):
                 count = slot.get("count", 0)
                 mean = (slot.get("sum", 0.0) / count) if count else 0.0
                 qs = bucket_quantiles(slot["buckets"])
-                _out(f"  {label}: count={int(count)} mean={mean * 1e3:.3f}ms "
+                _out(f"  {_label_str(key)}: count={int(count)} "
+                     f"mean={mean * 1e3:.3f}ms "
                      + " ".join(f"~{k}={v * 1e3:.3f}ms"
                                 for k, v in qs.items()))
+                for le, ex in ex_by_key.get(key, []):
+                    # the bucket's exemplar links the latency straight to
+                    # a showable trace (`pio-tpu trace show <id>`)
+                    tid = ex.get("labels", {}).get("trace_id", "?")
+                    _out(f"    exemplar le={le}: "
+                         f"{ex['value'] * 1e3:.3f}ms trace={tid}")
         else:
             for sname, labels, value in sorted(
                     samples, key=lambda s: sorted(s[1].items())):
@@ -1203,6 +1232,216 @@ def cmd_metrics(args, storage) -> int:
                 v = int(value) if float(value).is_integer() \
                     and not math.isinf(value) else value
                 _out(f"  {label or '(no labels)'}: {v}")
+
+
+def _render_metrics_fleet(pages: dict, args) -> None:
+    """Merged multi-server table: one row per sample with a per-server
+    column and an aggregate (sum for monotonic counters and histogram
+    count/sum, max for gauges; histogram quantiles re-estimated from the
+    bucket-merged fleet distribution)."""
+    import math
+
+    from incubator_predictionio_tpu.obs.metrics import bucket_quantiles
+
+    urls = list(pages)
+    aliases = {url: f"s{i + 1}" for i, url in enumerate(urls)}
+    _out("servers:")
+    for url in urls:
+        _out(f"  {aliases[url]} = {url}")
+    names = sorted({n for fams in pages.values() for n in fams})
+    for name in names:
+        if args.filter and args.filter not in name:
+            continue
+        kinds = [pages[u][name]["type"] for u in urls
+                 if name in pages[u] and pages[u][name]["type"]]
+        kind = kinds[0] if kinds else "untyped"
+        helps = [pages[u][name]["help"] for u in urls
+                 if name in pages[u] and pages[u][name]["help"]]
+        _out(f"{name} ({kind})"
+             + (f" — {helps[0]}" if helps else ""))
+        if kind == "histogram":
+            per_server = {u: _hist_by_labelset(pages[u][name]["samples"])
+                          for u in urls if name in pages[u]}
+            keys = sorted({k for slots in per_server.values()
+                           for k in slots})
+            for key in keys:
+                cols = []
+                merged_buckets: dict[float, float] = {}
+                total_count = total_sum = 0.0
+                for url in urls:
+                    slot = per_server.get(url, {}).get(key)
+                    if slot is None:
+                        cols.append(f"{aliases[url]}=-")
+                        continue
+                    count = slot.get("count", 0)
+                    p99 = bucket_quantiles(slot["buckets"],
+                                           qs=(0.99,))["p99"]
+                    cols.append(f"{aliases[url]} count={int(count)} "
+                                f"~p99={p99 * 1e3:.3f}ms")
+                    total_count += count
+                    total_sum += slot.get("sum", 0.0)
+                    for le, cum in slot["buckets"]:
+                        merged_buckets[le] = merged_buckets.get(le, 0) + cum
+                p99_all = bucket_quantiles(sorted(merged_buckets.items()),
+                                           qs=(0.99,))["p99"]
+                mean = (total_sum / total_count) if total_count else 0.0
+                cols.append(f"all count={int(total_count)} "
+                            f"mean={mean * 1e3:.3f}ms "
+                            f"~p99={p99_all * 1e3:.3f}ms")
+                _out(f"  {_label_str(key)}: " + " | ".join(cols))
+        else:
+            # counters sum across the fleet; gauges take the max (a depth
+            # or limit summed across servers is not a meaningful number)
+            agg = max if kind == "gauge" else sum
+            keys = sorted({tuple(sorted(labels.items()))
+                           for u in urls if name in pages[u]
+                           for _, labels, _ in pages[u][name]["samples"]})
+            for key in keys:
+                cols, values = [], []
+                for url in urls:
+                    vals = [
+                        v for _, labels, v
+                        in pages.get(url, {}).get(name, {}).get("samples", [])
+                        if tuple(sorted(labels.items())) == key]
+                    if not vals:
+                        cols.append(f"{aliases[url]}=-")
+                        continue
+                    v = vals[0]
+                    values.append(v)
+                    iv = int(v) if float(v).is_integer() \
+                        and not math.isinf(v) else v
+                    cols.append(f"{aliases[url]}={iv}")
+                a = agg(values) if values else 0
+                a = int(a) if float(a).is_integer() and not math.isinf(a) \
+                    else a
+                label = "max" if kind == "gauge" else "sum"
+                cols.append(f"{label}={a}")
+                _out(f"  {_label_str(key)}: " + " ".join(cols))
+
+
+def cmd_metrics(args, storage) -> int:
+    """Fetch and pretty-print one or more servers' ``/metrics`` pages
+    (docs/observability.md). Multiple URLs (or ``--fleet``) render a merged
+    table with per-server columns plus a summed/max aggregate — probes run
+    concurrently (the fleet/health.py fan-out pattern), so one dead server
+    costs one timeout, not O(N)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from incubator_predictionio_tpu.obs.metrics import (
+        MetricError,
+        parse_prometheus_text,
+    )
+
+    urls = [_metrics_url(u) for u in args.urls]
+    texts: dict[str, str] = {}
+    failures: list[str] = []
+    with ThreadPoolExecutor(max_workers=min(16, len(urls))) as pool:
+        futures = {url: pool.submit(_fetch_metrics_text, url, args.timeout,
+                                    not args.raw)
+                   for url in urls}
+        for url, fut in futures.items():
+            try:
+                texts[url] = fut.result()
+            except Exception as e:  # noqa: BLE001 - a dead server is a row
+                failures.append(f"{url}: {e}")
+    for f in failures:
+        _err(f"Unable to fetch {f}")
+    if not texts:
+        return 1
+    if args.raw:
+        for url, text in texts.items():
+            if len(texts) > 1:
+                _out(f"# ---- {url} ----")
+            _out(text.rstrip())
+        return 1 if failures else 0
+    pages: dict[str, dict] = {}
+    for url, text in texts.items():
+        try:
+            pages[url] = parse_prometheus_text(text)
+        except MetricError as e:
+            _err(f"{url} served malformed metrics: {e}")
+            failures.append(url)
+    if not pages:
+        return 1
+    if len(pages) == 1 and not args.fleet:
+        _render_metrics_single(next(iter(pages.values())), args)
+    else:
+        _render_metrics_fleet(pages, args)
+    return 1 if failures else 0
+
+
+def cmd_trace(args, storage) -> int:
+    """Assemble cross-process traces from span spools and/or live servers
+    (docs/observability.md "The trace plane"): ``list`` recent traces,
+    ``show <id>`` one trace's terminal waterfall, ``slowest`` the worst
+    offenders — the answer to "which hop made this p99 query slow?"."""
+    from incubator_predictionio_tpu.obs import collect
+
+    if not getattr(args, "trace_command", None):
+        _err("trace: missing subcommand (list|show|slowest)")
+        return 1
+    spools = list(args.spool or ())
+    urls = list(args.url or ())
+    if not spools and not urls:
+        default_dir = os.environ.get("PIO_TRACE_SPOOL_DIR")
+        if default_dir:
+            spools = [default_dir]
+        else:
+            _err("trace: give at least one --spool DIR or --url URL "
+                 "(or set PIO_TRACE_SPOOL_DIR)")
+            return 2
+    spans, problems = collect.gather_spans(
+        spools=spools, urls=urls, timeout=args.timeout)
+    for p in problems:
+        _err(f"trace: {p}")
+    traces = collect.assemble(spans)
+    if args.trace_command == "show":
+        tree, matches = collect.find_trace(traces, args.trace_id)
+        if tree is None:
+            if matches:
+                _err(f"trace prefix {args.trace_id!r} is ambiguous — "
+                     f"{len(matches)} match: " + ", ".join(matches[:8]))
+            else:
+                _err(f"trace {args.trace_id!r} not found "
+                     f"({len(traces)} trace(s) in the given sources)")
+            return 1
+        if args.json:
+            _out(json.dumps(tree, indent=2, default=str))
+        else:
+            for line in collect.waterfall(tree):
+                _out(line)
+        return 0
+    if args.trace_command == "slowest":
+        picked = collect.slowest(traces, args.limit)
+        if args.json:
+            _out(json.dumps(
+                {"slowest": collect.list_rows(picked),
+                 "waterfall": (collect.waterfall(picked[0])
+                               if picked else [])}, indent=2, default=str))
+            return 0
+        for row in collect.list_rows(picked):
+            _out(f"{row['traceId']}  {row['durationMs']:>9.1f}ms  "
+                 f"spans={row['spans']} errors={row['errors']} "
+                 f"complete={str(row['complete']).lower()}  "
+                 f"[{row['services']}]  {row['root']}")
+        if picked:
+            _out("")
+            for line in collect.waterfall(picked[0]):
+                _out(line)
+        return 0
+    # list (default)
+    rows = collect.list_rows(traces[:args.limit])
+    if args.json:
+        _out(json.dumps({"traces": rows}, indent=2, default=str))
+        return 0
+    if not rows:
+        _out("No traces in the given sources.")
+        return 0
+    for row in rows:
+        _out(f"{row['traceId']}  {row['durationMs']:>9.1f}ms  "
+             f"spans={row['spans']} errors={row['errors']} "
+             f"complete={str(row['complete']).lower()}  "
+             f"[{row['services']}]  {row['root']}")
     return 0
 
 
@@ -1552,15 +1791,27 @@ def cmd_jobs_worker(args, storage: Storage) -> int:
     worker = JobWorker(_job_orchestrator(storage), storage, cfg)
     _out(f"jobs worker {worker.config.worker_id} polling "
          f"(lease {worker.config.lease_sec:.0f}s).")
-    if args.once:
-        out = worker.run_once()
-        if out is None:
-            _out("Queue idle.")
-            return 0
-        _out(json.dumps(out, default=str))
-        return 0 if out.get("status") in ("COMPLETED",) else 1
-    worker.run_forever(max_jobs=args.max_jobs)
-    return 0
+    obs_handle = None
+    if args.obs_port:
+        # the worker has no HTTP surface of its own; this thread serves
+        # the shared /metrics + /traces.json so pio_jobs_* is scrapeable
+        from incubator_predictionio_tpu.obs.http import start_obs_server
+
+        obs_handle = start_obs_server("jobs_worker", args.obs_port,
+                                      ip=args.obs_ip)
+    try:
+        if args.once:
+            out = worker.run_once()
+            if out is None:
+                _out("Queue idle.")
+                return 0
+            _out(json.dumps(out, default=str))
+            return 0 if out.get("status") in ("COMPLETED",) else 1
+        worker.run_forever(max_jobs=args.max_jobs)
+        return 0
+    finally:
+        if obs_handle is not None:
+            obs_handle.close()
 
 
 def cmd_jobs_triggers(args, storage: Storage) -> int:  # noqa: C901
@@ -2240,6 +2491,12 @@ def build_parser() -> argparse.ArgumentParser:
                         " a worker dead this long has its job reclaimed")
     p.add_argument("--poll", type=float,
                    help="idle poll seconds (PIO_JOBS_POLL_SEC env)")
+    p.add_argument("--obs-port", type=int, default=0,
+                   help="serve GET /metrics + /traces.json on this port so "
+                        "pio_jobs_* gauges are scrapeable (0 = disabled, "
+                        "the default; docs/observability.md)")
+    p.add_argument("--obs-ip", default="127.0.0.1",
+                   help="bind address for --obs-port (default loopback)")
     p = jb.add_parser("triggers")
     p.add_argument("-v", "--engine-variant", default="engine.json")
     p.add_argument("--interval", type=float,
@@ -2418,12 +2675,55 @@ def build_parser() -> argparse.ArgumentParser:
     # metrics — scrape + pretty-print any server's /metrics
     p = sub.add_parser(
         "metrics",
-        help="fetch and pretty-print a server's Prometheus /metrics page "
-             "(docs/observability.md)")
-    p.add_argument("url", help="server base URL, e.g. http://127.0.0.1:8000")
+        help="fetch and pretty-print one or more servers' Prometheus "
+             "/metrics pages (multiple URLs merge into a per-server table "
+             "with a summed/max aggregate column; docs/observability.md)")
+    p.add_argument("urls", nargs="+",
+                   help="server base URL(s), e.g. http://127.0.0.1:8000 "
+                        "http://127.0.0.1:8001 — probed concurrently")
+    p.add_argument("--fleet", action="store_true",
+                   help="force the merged per-server table layout even for "
+                        "a single URL (stable format for scripts)")
     p.add_argument("--raw", action="store_true",
                    help="print the raw exposition text instead")
     p.add_argument("--filter", help="only families whose name contains this")
+    p.add_argument("--timeout", type=float, default=10.0,
+                   help="per-server fetch timeout in seconds (default 10)")
+
+    # trace — cross-process trace assembly (docs/observability.md)
+    tr = sub.add_parser(
+        "trace",
+        help="assemble cross-process traces from span spools and/or live "
+             "servers: list recent traces, show one as a terminal "
+             "waterfall, or rank the slowest (docs/observability.md)")
+    trs = tr.add_subparsers(dest="trace_command")
+
+    def _trace_source_args(p) -> None:
+        p.add_argument("--spool", action="append", metavar="DIR",
+                       help="span spool directory (PIO_TRACE_SPOOL_DIR of "
+                            "any fleet process; repeatable; default: "
+                            "$PIO_TRACE_SPOOL_DIR when set)")
+        p.add_argument("--url", action="append", metavar="URL",
+                       help="server base URL whose live /traces.json ring "
+                            "to include (repeatable)")
+        p.add_argument("--timeout", type=float, default=5.0)
+        p.add_argument("--json", action="store_true")
+
+    p = trs.add_parser("list")
+    _trace_source_args(p)
+    p.add_argument("--limit", type=int, default=20,
+                   help="traces to list, newest first (default 20)")
+    p = trs.add_parser("show")
+    p.add_argument("trace_id",
+                   help="trace id (or unique prefix) — e.g. from a "
+                        "response's X-PIO-Trace header or a /metrics "
+                        "exemplar")
+    _trace_source_args(p)
+    p = trs.add_parser("slowest")
+    _trace_source_args(p)
+    p.add_argument("-n", "--limit", type=int, default=10,
+                   help="slowest traces to rank (default 10); the worst "
+                        "one renders as a waterfall")
 
     # index — two-stage retrieval partition inspection
     p = sub.add_parser(
@@ -2589,6 +2889,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "letters) and exit; non-zero when quarantined")
     p.add_argument("--dead-letter", action="store_true",
                    help="print dead-lettered poison events as JSON lines")
+    p.add_argument("--obs-port", type=int, default=0,
+                   help="serve GET /metrics + /traces.json on this port so "
+                        "pio_stream_* gauges are scrapeable (0 = disabled, "
+                        "the default; docs/observability.md)")
+    p.add_argument("--obs-ip", default="127.0.0.1",
+                   help="bind address for --obs-port (default loopback)")
 
     # wal — inspect/verify/replay an event-server spill WAL
     p = sub.add_parser(
@@ -2670,6 +2976,7 @@ _COMMANDS = {
     "export": cmd_export,
     "import": cmd_import,
     "metrics": cmd_metrics,
+    "trace": cmd_trace,
     "health": cmd_health,
     "index": cmd_index,
     "shards": cmd_shards,

@@ -1,0 +1,76 @@
+"""Storage-touching classification engine for the trace-plane chaos proofs
+(tests/test_chaos_procs.py, ISSUE 14).
+
+The plain classification template reads storage only at train time, so a
+deployed replica's query trace would never reach the storage tier. This
+wrapper's algorithm performs ONE event-store read per predict — through
+whatever backend the process is configured with, so a replica configured
+with the ``remote`` backend produces a real query-server → storage-server
+RPC (and its span) on every query. ``PIO_TRACE_TEST_PREDICT_SLEEP_MS``
+pins a serve-time floor so a chaos test can SIGKILL the replica while the
+request is provably in flight.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from incubator_predictionio_tpu.core import (
+    Engine,
+    EngineFactory,
+    FirstServing,
+    IdentityPreparator,
+)
+from incubator_predictionio_tpu.data.storage import get_storage
+from incubator_predictionio_tpu.templates.classification import (
+    DataSource,
+    MLPAlgorithm,
+)
+
+
+class StorageTouchingMLP(MLPAlgorithm):
+    """MLP whose serving path reads the event store once per predict."""
+
+    _app_id = None
+
+    def _resolve_app_id(self):
+        if StorageTouchingMLP._app_id is None:
+            storage = get_storage()
+            apps = storage.get_meta_data_apps().get_all()
+            StorageTouchingMLP._app_id = apps[0].id if apps else 1
+        return StorageTouchingMLP._app_id
+
+    def _touch_storage_then_sleep(self) -> None:
+        # one real storage read on the request's trace (the executor hop
+        # copies contextvars, so this lands under the route span). The
+        # read runs BEFORE the sleep floor: when the chaos test SIGKILLs
+        # mid-sleep, the storage hop's spans are already spooled — the
+        # victim's fragment survives it
+        list(get_storage().get_events().find(
+            app_id=self._resolve_app_id(), limit=1))
+        sleep_ms = float(os.environ.get(
+            "PIO_TRACE_TEST_PREDICT_SLEEP_MS", "0"))
+        if sleep_ms:
+            time.sleep(sleep_ms / 1e3)
+
+    def predict(self, model, query):
+        self._touch_storage_then_sleep()
+        return super().predict(model, query)
+
+    def batch_predict(self, model, queries):
+        # the micro-batcher dispatches through batch_predict — the storage
+        # read must sit on THIS path for a served query's trace to reach
+        # the storage tier
+        self._touch_storage_then_sleep()
+        return super().batch_predict(model, queries)
+
+
+class TraceClassificationEngine(EngineFactory):
+    def apply(self) -> Engine:
+        return Engine(
+            DataSource,
+            IdentityPreparator,
+            {"mlp": StorageTouchingMLP, "": StorageTouchingMLP},
+            FirstServing,
+        )

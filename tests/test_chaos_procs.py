@@ -188,23 +188,28 @@ def test_event_server_kill9_mid_drain_then_replay_is_exactly_once(tmp_path):
 QUERY_DEADLINE_S = 0.4
 
 
-def _train_classification(tmp_path):
+def _train_classification(tmp_path, factory=None):
     """Train the classification template into sqlite so a `deploy`
     subprocess can serve it (the storm needs a real engine behind the
-    admission layer, not a stub)."""
+    admission layer, not a stub). ``factory`` swaps in a wrapper engine
+    (e.g. the trace-plane fixture's storage-touching one) around the same
+    MLP training."""
     import datetime as dt
 
     import numpy as np
 
+    from incubator_predictionio_tpu.core.controller import (
+        resolve_engine_factory,
+    )
     from incubator_predictionio_tpu.core.workflow import run_train
     from incubator_predictionio_tpu.data import DataMap, Event
     from incubator_predictionio_tpu.data.storage import use_storage
     from incubator_predictionio_tpu.data.storage.base import EngineInstance
-    from incubator_predictionio_tpu.parallel.mesh import MeshContext
-    from incubator_predictionio_tpu.templates.classification import (
-        ClassificationEngine,
-    )
 
+    from incubator_predictionio_tpu.parallel.mesh import MeshContext
+
+    factory = factory or ("incubator_predictionio_tpu.templates."
+                          "classification.ClassificationEngine")
     utc = dt.timezone.utc
     store_cfg = {
         "PIO_STORAGE_SOURCES_SQ_TYPE": "sqlite",
@@ -232,8 +237,7 @@ def _train_classification(tmp_path):
         variant_path = str(tmp_path / "engine.json")
         variant = {
             "id": "storm", "version": "1",
-            "engineFactory": ("incubator_predictionio_tpu.templates."
-                              "classification.ClassificationEngine"),
+            "engineFactory": factory,
             "datasource": {"params": {"appName": "storm-app"}},
             "algorithms": [{"name": "mlp", "params": {
                 "hiddenDims": [8], "epochs": 40, "learningRate": 0.03,
@@ -241,7 +245,7 @@ def _train_classification(tmp_path):
         }
         with open(variant_path, "w") as f:
             json.dump(variant, f)
-        engine = ClassificationEngine().apply()
+        engine = resolve_engine_factory(factory)()
         engine_params = engine.engine_params_from_variant(variant)
         instance = EngineInstance(
             id="", status="INIT", start_time=dt.datetime.now(utc),
@@ -1483,3 +1487,203 @@ def test_dr_backup_restore_after_data_dir_loss(tmp_path):
     assert lost_overall <= set(post_backup), (
         "a loss outside the post-backup window slipped through")
     assert probe in stored
+
+
+# ---------------------------------------------------------------------------
+# trace-plane chaos (ISSUE 14): one query's spans shredded across router,
+# replica, and storage-server PROCESSES assemble from the durable spool into
+# a single tree; a SIGKILLed replica's fragment still assembles with the
+# error span present
+# ---------------------------------------------------------------------------
+
+_TRACE_FACTORY = "tests.fixtures.trace_engine.TraceClassificationEngine"
+
+
+def _remote_store_env(storage_port: int) -> dict:
+    name = "R"
+    return {
+        f"PIO_STORAGE_SOURCES_{name}_TYPE": "remote",
+        f"PIO_STORAGE_SOURCES_{name}_URL": f"http://127.0.0.1:{storage_port}",
+        f"PIO_STORAGE_SOURCES_{name}_TIMEOUT": "5",
+        f"PIO_STORAGE_SOURCES_{name}_RETRY_MAX_ATTEMPTS": "1",
+        **{f"PIO_STORAGE_REPOSITORIES_{repo}_{k}": name
+           for repo in ("METADATA", "EVENTDATA", "MODELDATA")
+           for k in ("NAME", "SOURCE")},
+    }
+
+
+def _post_traced(url: str, body: dict, timeout=30.0):
+    """POST returning (status, parsed_body, trace_id) — the router echoes
+    X-PIO-Trace on success AND error paths."""
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(), method="POST",
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return (resp.status, json.loads(resp.read() or b"null"),
+                    resp.headers.get("X-PIO-Trace"))
+    except urllib.error.HTTPError as e:
+        payload = e.read()
+        try:
+            parsed = json.loads(payload or b"null")
+        except ValueError:
+            parsed = {"raw": payload.decode(errors="replace")}
+        return e.code, parsed, e.headers.get("X-PIO-Trace")
+
+
+def _assemble_via_cli(spool_dir: str, trace_id: str) -> dict:
+    """The acceptance path: `pio-tpu trace show <id>` over the spool."""
+    out = subprocess.run(
+        [sys.executable, "-m", "incubator_predictionio_tpu.tools.cli",
+         "trace", "show", trace_id, "--spool", spool_dir, "--json"],
+        capture_output=True, text=True, timeout=60,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stdout + out.stderr
+    return json.loads(out.stdout)
+
+
+def test_trace_plane_assembles_one_query_across_three_processes(tmp_path):
+    """ISSUE 14 acceptance: one query driven through router → replica →
+    storage assembles via `pio-tpu trace show` into a single tree with
+    spans from ≥ 3 distinct processes, correct parent/child edges, and
+    complete: true."""
+    store_cfg, variant_path = _train_classification(
+        tmp_path, factory=_TRACE_FACTORY)
+    spool_dir = str(tmp_path / "spool")
+    trace_env = {"PIO_TRACE_SPOOL_DIR": spool_dir}
+    sport, qport, rport = free_port(), free_port(), free_port()
+    store = replica = router = None
+    try:
+        store = ServerProc(
+            ["storageserver", "--ip", "127.0.0.1", "--port", str(sport)],
+            env={**store_cfg, **trace_env})
+        store.wait_ready(f"http://127.0.0.1:{sport}/", timeout=60.0)
+        replica = ServerProc(
+            ["deploy", "-v", variant_path, "--ip", "127.0.0.1",
+             "--port", str(qport), "--query-timeout", "10"],
+            env={**_remote_store_env(sport), **trace_env})
+        replica.wait_ready(f"http://127.0.0.1:{qport}/", timeout=180.0)
+        router = ServerProc(
+            ["fleet", "route", "--ip", "127.0.0.1", "--port", str(rport),
+             "--replica", f"http://127.0.0.1:{qport}",
+             "--health-interval", "0.5"],
+            env=dict(trace_env))
+        router.wait_ready(f"http://127.0.0.1:{rport}/")
+
+        status, body, trace_id = _post_traced(
+            f"http://127.0.0.1:{rport}/queries.json",
+            {"features": [0.5, -0.2, 0.1]})
+        assert status == 200, (status, body)
+        assert trace_id, "router did not echo X-PIO-Trace"
+
+        tree = _assemble_via_cli(spool_dir, trace_id)
+        assert tree["traceId"] == trace_id
+        # spans from >= 3 distinct PROCESSES: the three services map 1:1
+        # to the three subprocesses, and the spool segment names carry
+        # three distinct pids
+        assert {"fleet_router", "query_server", "storage_server"} <= set(
+            tree["services"])
+        pids = {os.path.basename(p).split("-")[-2]
+                for p in os.listdir(spool_dir)}
+        assert len(pids) >= 3, pids
+        # correct parent/child edges, nothing dangling
+        assert tree["complete"] is True and not tree["orphans"]
+        by_id = {s["spanId"]: s for s in tree["spans"]}
+        roots = [s for s in tree["spans"] if s["parentId"] is None]
+        assert len(roots) == 1 and roots[0]["service"] == "fleet_router"
+        # the replica's server span hangs off the router's forward span,
+        # and the storage server's span is below the replica's route span
+        serve = [s for s in tree["spans"]
+                 if s["service"] == "query_server"
+                 and s["name"].startswith("POST")][0]
+        assert by_id[serve["parentId"]]["name"] == "forward"
+        storage_spans = [s for s in tree["spans"]
+                         if s["service"] == "storage_server"]
+        assert storage_spans, "storage hop produced no spans"
+
+        def ancestors(s):
+            seen = []
+            while s["parentId"] is not None:
+                s = by_id[s["parentId"]]
+                seen.append(s["spanId"])
+            return seen
+
+        assert serve["spanId"] in ancestors(storage_spans[0])
+    finally:
+        for p in (router, replica, store):
+            if p is not None:
+                p.stop()
+
+
+def test_trace_plane_sigkill_replica_mid_request_fragments_assemble(
+        tmp_path):
+    """ISSUE 14 chaos variant: SIGKILL the replica mid-request. The spooled
+    fragments — the router's error span AND the storage hop the victim
+    completed before dying — still assemble; the tree is marked incomplete
+    (the victim's route span was never written)."""
+    import threading
+
+    store_cfg, variant_path = _train_classification(
+        tmp_path, factory=_TRACE_FACTORY)
+    spool_dir = str(tmp_path / "spool")
+    trace_env = {"PIO_TRACE_SPOOL_DIR": spool_dir}
+    sport, qport, rport = free_port(), free_port(), free_port()
+    store = replica = router = None
+    try:
+        store = ServerProc(
+            ["storageserver", "--ip", "127.0.0.1", "--port", str(sport)],
+            env={**store_cfg, **trace_env})
+        store.wait_ready(f"http://127.0.0.1:{sport}/", timeout=60.0)
+        replica = ServerProc(
+            ["deploy", "-v", variant_path, "--ip", "127.0.0.1",
+             "--port", str(qport), "--query-timeout", "30"],
+            env={**_remote_store_env(sport), **trace_env,
+                 # predict: storage read (spooled), THEN a 5s floor the
+                 # SIGKILL lands inside
+                 "PIO_TRACE_TEST_PREDICT_SLEEP_MS": "5000"})
+        replica.wait_ready(f"http://127.0.0.1:{qport}/", timeout=180.0)
+        router = ServerProc(
+            ["fleet", "route", "--ip", "127.0.0.1", "--port", str(rport),
+             "--replica", f"http://127.0.0.1:{qport}",
+             "--health-interval", "0.5", "--deadline", "20"],
+            env=dict(trace_env))
+        router.wait_ready(f"http://127.0.0.1:{rport}/")
+
+        result: dict = {}
+
+        def fire():
+            result["out"] = _post_traced(
+                f"http://127.0.0.1:{rport}/queries.json",
+                {"features": [0.5, -0.2, 0.1]}, timeout=40.0)
+
+        t = threading.Thread(target=fire)
+        t.start()
+        time.sleep(2.0)  # inside the 5s predict floor, storage hop done
+        replica.kill9()
+        t.join(timeout=60.0)
+        assert not t.is_alive(), "query through the router hung"
+        status, body, trace_id = result["out"]
+        assert status in (500, 502, 503), (status, body)
+        assert trace_id, "router did not echo X-PIO-Trace on the error"
+
+        tree = _assemble_via_cli(spool_dir, trace_id)
+        # the victim's fragment (its storage-attempt span) IS in the tree:
+        # what the replica was doing when it was SIGKILLed
+        statuses = [s["status"] for s in tree["spans"]]
+        services = set(tree["services"])
+        assert "fleet_router" in services
+        assert any(st.startswith("error:") for st in statuses), statuses
+        # the replica's route span died unwritten -> assembly says so
+        # instead of passing the fragment off as a whole trace
+        victim_spans = [s for s in tree["spans"]
+                        if s["service"] != "fleet_router"]
+        if victim_spans:  # storage hop completed before the kill
+            assert tree["complete"] is False and tree["orphans"]
+    finally:
+        for p in (router, replica, store):
+            if p is not None:
+                p.stop()
