@@ -1,0 +1,35 @@
+"""Project invariant linter (``pio-tpu lint`` — docs/analysis.md).
+
+Thirteen PRs of post-review hardening kept re-catching the same defect
+classes by hand: blocking calls on the asyncio event loop, wall-clock
+reads bypassing the injectable-Clock seam, bare ``open(..., 'w')`` state
+writes in crash-safe modules, and ``PIO_*`` knob drift between code and
+docs/configuration.md. The PR 13 metrics↔docs parity meta-test proved
+the pattern — mechanize an invariant once and it never regresses. This
+package generalizes that into a stdlib-``ast`` linter with one rule
+module per invariant the codebase already lives by:
+
+- **R1 async-blocking** — no blocking syscalls reachable inside
+  ``async def`` bodies (:mod:`.rules.r1_async_blocking`)
+- **R2 clock-discipline** — Clock-seam modules route time through the
+  injected clock (:mod:`.rules.r2_clock`)
+- **R3 durability-ordering** — durable modules write state atomically
+  (:mod:`.rules.r3_durability`)
+- **R4 knob-registry** — every ``PIO_*`` read has a configuration.md
+  row and vice versa; also hosts the ``pio_*`` metrics↔docs parity
+  check on the same cross-reference engine (:mod:`.rules.r4_knobs`,
+  :mod:`.crossref`)
+- **R5 lock/await-hygiene** — no ``await`` while holding a
+  ``threading.Lock`` (:mod:`.rules.r5_locks`)
+
+Suppressions are themselves audited: every inline
+``# pio-lint: disable=R<n> (reason)`` needs a reason (S1) and must
+still match a live finding (S2); baseline entries that no longer match
+fail the run (B1) — the metrics-allowlist pattern.
+"""
+
+from incubator_predictionio_tpu.analysis.engine import (  # noqa: F401
+    LintResult,
+    run_lint,
+)
+from incubator_predictionio_tpu.analysis.model import Finding  # noqa: F401

@@ -1,0 +1,93 @@
+"""Checked-in lint baseline: ``conf/lint_baseline.txt``.
+
+The baseline is the bulk-suppression mechanism that lets a new rule land
+green on a codebase with pre-existing debt, without blessing NEW
+violations: a finding whose :meth:`~incubator_predictionio_tpu.analysis.
+model.Finding.key` matches a baseline entry is reported as ``baselined``
+and does not fail the run; an entry that matches nothing fails the run
+as B1 (the debt was repaid — the file must shrink back, the
+metrics-allowlist pattern).
+
+Entries are line-number-free (``rule|relpath|scope|code``) so unrelated
+edits don't churn the file, and ``--update-baseline`` writes them
+sorted and path-relative so regeneration is deterministic and diffs
+stay reviewable.
+"""
+
+from __future__ import annotations
+
+import os
+from collections import Counter
+from typing import Iterable
+
+from incubator_predictionio_tpu.analysis.model import Finding
+
+HEADER = """\
+# pio-tpu lint baseline (docs/analysis.md).
+#
+# One entry per accepted pre-existing violation: rule|path|scope|code.
+# Regenerate with `pio-tpu lint --update-baseline` (deterministic:
+# sorted, path-relative). A stale entry — one no longer matching any
+# finding — FAILS the run (B1): delete it when the debt is repaid.
+"""
+
+B1_HINT = ("the baselined violation is gone — delete the entry (or run "
+           "`pio-tpu lint --update-baseline`) so the accepted-debt "
+           "ledger stays honest")
+
+
+def load(path: str) -> Counter:
+    """Baseline entries as a multiset of finding keys."""
+    entries: Counter = Counter()
+    if not os.path.exists(path):
+        return entries
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.rstrip("\n")
+            if not line.strip() or line.lstrip().startswith("#"):
+                continue
+            entries[line] += 1
+    return entries
+
+
+def save(path: str, findings: Iterable[Finding],
+         retained_keys: Iterable[str] = ()) -> None:
+    """Write the baseline for ``findings`` — sorted, deterministic.
+
+    ``retained_keys`` carries entries owned by rules OUTSIDE the current
+    run's selection: a ``--rule R3 --update-baseline`` pass must not
+    silently delete the accepted R1 debt it never re-checked.
+    """
+    keys = sorted(list(retained_keys) + [f.key() for f in findings])
+    body = HEADER + "".join(k + "\n" for k in keys)
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(body)
+
+
+def apply(entries: Counter, findings: list) -> list:
+    """Mark findings matching a baseline entry; return stale B1 findings.
+
+    Matching is multiset-aware: two identical violations need two
+    entries, so fixing one of them still surfaces the other.
+    """
+    remaining = Counter(entries)
+    for f in findings:
+        if f.suppressed:
+            continue
+        k = f.key()
+        if remaining.get(k, 0) > 0:
+            remaining[k] -= 1
+            f.baselined = True
+    stale = []
+    for key, count in sorted(remaining.items()):
+        if count <= 0:
+            continue
+        parts = key.split("|")
+        path = parts[1] if len(parts) > 1 and parts[1] else "conf/lint_baseline.txt"
+        stale.append(Finding(
+            rule="B1", path=path, line=0,
+            message=f"stale baseline entry ({count}×): {key}",
+            hint=B1_HINT, scope="", code=key))
+    return stale

@@ -1,0 +1,255 @@
+"""Reusable code↔docs cross-reference engine.
+
+The PR 13 metrics↔docs parity meta-test proved the shape: extract a set
+of names from code (by a literal-by-convention idiom), extract the
+documented rows from a markdown table, and assert the sets match in
+BOTH directions, with an audited allowlist for intentional exceptions
+(an allowlist entry that parity would pass anyway is itself an error).
+This module is that engine made generic, instantiated twice:
+
+- **knobs**: every ``PIO_*`` env var the code reads
+  (:func:`scan_env_reads`) ↔ the `docs/configuration.md` table rows
+  (:func:`doc_names`), allowlist `docs/config_allowlist.txt`;
+- **metrics**: every registered ``pio_*`` metric
+  (:func:`scan_metric_registrations`) ↔ the `docs/observability.md`
+  table rows, allowlist `docs/metrics_allowlist.txt`
+  (tests/test_metrics_docs_parity.py keeps its test ids by delegating
+  here).
+
+Names may be **prefixes**: code reading ``f"PIO_RESILIENCE_{key}"``
+yields the prefix ``PIO_RESILIENCE_``, and a documented row
+``PIO_RESILIENCE_<KEY>`` normalizes to the same prefix — a prefix on
+either side covers every name under it on the other.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+# ---------------------------------------------------------------------------
+# generic engine
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Name:
+    """One extracted name; ``prefix`` means "covers everything under it"."""
+
+    text: str
+    prefix: bool = False
+    #: where it came from — "relpath:line" for code and docs alike
+    where: str = ""
+
+
+@dataclass
+class CrossRefResult:
+    #: code names with no documented row (and not allowlisted)
+    undocumented: list = field(default_factory=list)
+    #: documented rows matching no code name (and not allowlisted)
+    stale_docs: list = field(default_factory=list)
+    #: allowlist entries parity would pass without — must be deleted
+    dead_allowlist: list = field(default_factory=list)
+
+    @property
+    def clean(self) -> bool:
+        return not (self.undocumented or self.stale_docs
+                    or self.dead_allowlist)
+
+
+def _matched(name: Name, others: Iterable[Name]) -> bool:
+    for o in others:
+        if o.prefix and name.text.startswith(o.text):
+            return True
+        if name.prefix and o.text.startswith(name.text):
+            return True
+        if not o.prefix and not name.prefix and o.text == name.text:
+            return True
+    return False
+
+
+def cross_reference(code: Iterable[Name], docs: Iterable[Name],
+                    allowlist: Iterable[str] = ()) -> CrossRefResult:
+    """Two-directional parity between code names and documented rows."""
+    code, docs = list(code), list(docs)
+    allow = set(allowlist)
+    res = CrossRefResult()
+    for n in code:
+        if n.text in allow:
+            continue
+        if not _matched(n, docs):
+            res.undocumented.append(n)
+    for d in docs:
+        if d.text in allow:
+            continue
+        if not _matched(d, code):
+            res.stale_docs.append(d)
+    # an allowlist entry must be load-bearing: it names something that is
+    # on exactly one side. Present on both (or neither) — parity passes
+    # without it and the entry is stale noise.
+    code_texts = {n.text for n in code}
+    doc_texts = {d.text for d in docs}
+    for a in sorted(allow):
+        in_code = a in code_texts or any(
+            n.prefix and a.startswith(n.text) for n in code)
+        in_docs = a in doc_texts or any(
+            d.prefix and a.startswith(d.text) for d in docs)
+        if in_code == in_docs:
+            res.dead_allowlist.append(a)
+    return res
+
+
+def load_allowlist(path: str) -> list:
+    """`#`-commented, one-name-per-line allowlist file."""
+    out = []
+    if not os.path.exists(path):
+        return out
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            entry = line.split("#", 1)[0].strip()
+            if entry:
+                out.append(entry)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# docs side: markdown table rows
+# ---------------------------------------------------------------------------
+
+def doc_names(doc_text: str, pattern: str, relpath: str = "") -> list:
+    """Backticked names matching ``pattern`` inside markdown TABLE rows.
+
+    Only table rows count as documentation — prose mentions (example
+    PromQL, cross-references) are not the contract, exactly like the
+    metrics parity test. A row token carrying placeholder syntax
+    (``PIO_RESILIENCE_<KEY>``, ``PIO_STORAGE_..._{A,B}``) normalizes to
+    its literal prefix and covers every concrete name under it.
+    """
+    names = []
+    token_re = re.compile(r"`(" + pattern + r"[A-Za-z0-9_<>{},.*]*)")
+    literal_re = re.compile(r"^(" + pattern + r"[A-Za-z0-9_]*)")
+    for i, line in enumerate(doc_text.splitlines(), start=1):
+        if not line.lstrip().startswith("|"):
+            continue
+        for m in token_re.finditer(line):
+            tok = m.group(1)
+            lit = literal_re.match(tok).group(1)
+            names.append(Name(text=lit, prefix=(lit != tok),
+                              where=f"{relpath}:{i}"))
+    return names
+
+
+# ---------------------------------------------------------------------------
+# code side: PIO_* env reads (AST)
+# ---------------------------------------------------------------------------
+
+#: callables that read the environment when given a key as first arg
+_DIRECT_ENV_CALLS = ("os.environ.get", "environ.get", "os.getenv", "getenv")
+_ENV_SUBSCRIPTS = ("os.environ", "environ")
+
+
+def _safe_unparse(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - defensive
+        return ""
+
+
+def scan_env_reads(tree: ast.AST, pattern: str = "PIO_") -> list:
+    """(name, is_prefix, lineno) for every env read of a ``pattern`` key.
+
+    Understands the idioms this codebase actually uses:
+
+    - direct: ``os.environ.get("PIO_X")`` / ``os.getenv`` / ``environ[...]``
+    - aliased getter: ``e = os.environ.get`` … ``e("PIO_X", "default")``
+    - module constant keys: ``ENV_DIR = "PIO_X"`` … ``environ.get(ENV_DIR)``
+    - local wrapper: ``def _float_env(name, d): … environ.get(name) …``
+      … ``_float_env("PIO_X", 1.0)``
+    - f-string patterns: ``environ.get(f"PIO_RESILIENCE_{key}")`` →
+      the literal prefix, matched against placeholder doc rows
+    """
+    aliases: set = set()        # names bound to an env getter
+    constants: dict = {}        # UPPER_NAME -> "PIO_..."
+    wrappers: set = set()       # local functions whose 1st arg is an env key
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt, val = node.targets[0], node.value
+            if isinstance(tgt, ast.Name):
+                if _safe_unparse(val) in _DIRECT_ENV_CALLS:
+                    aliases.add(tgt.id)
+                elif (isinstance(val, ast.Constant)
+                      and isinstance(val.value, str)
+                      and val.value.startswith(pattern)):
+                    constants[tgt.id] = val.value
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if not node.args.args:
+                continue
+            first = node.args.args[0].arg
+            for inner in ast.walk(node):
+                key = _env_key_node(inner, aliases=frozenset(), wrappers=frozenset())
+                if (key is not None and isinstance(key, ast.Name)
+                        and key.id == first):
+                    wrappers.add(node.name)
+                    break
+
+    out = []
+    for node in ast.walk(tree):
+        key = _env_key_node(node, aliases=aliases, wrappers=wrappers)
+        if key is None:
+            continue
+        if isinstance(key, ast.Name) and key.id in constants:
+            out.append((constants[key.id], False, node.lineno))
+        elif isinstance(key, ast.Constant) and isinstance(key.value, str):
+            if key.value.startswith(pattern):
+                out.append((key.value, False, node.lineno))
+        elif isinstance(key, ast.JoinedStr) and key.values:
+            head = key.values[0]
+            if (isinstance(head, ast.Constant)
+                    and isinstance(head.value, str)
+                    and head.value.startswith(pattern)):
+                out.append((head.value, True, node.lineno))
+    return out
+
+
+def _env_key_node(node: ast.AST, aliases: frozenset,
+                  wrappers: frozenset) -> Optional[ast.AST]:
+    """The key expression of an env read, or None."""
+    if isinstance(node, ast.Call):
+        fn = _safe_unparse(node.func)
+        if fn in _DIRECT_ENV_CALLS and node.args:
+            return node.args[0]
+        if (isinstance(node.func, ast.Name)
+                and (node.func.id in aliases or node.func.id in wrappers)
+                and node.args):
+            return node.args[0]
+    elif isinstance(node, ast.Subscript):
+        if _safe_unparse(node.value) in _ENV_SUBSCRIPTS:
+            return node.slice
+    return None
+
+
+# ---------------------------------------------------------------------------
+# code side: pio_* metric registrations (the PR 13 idiom, now shared)
+# ---------------------------------------------------------------------------
+
+#: a registration call whose first argument is a pio_* string literal
+#: (possibly on the next line — the dominant style in this codebase)
+METRIC_REGISTRATION_RE = re.compile(
+    r'\.(?:counter|gauge|histogram)\(\s*\n?\s*"(pio_[a-z0-9_]+)"')
+
+
+def scan_metric_registrations(source: str) -> list:
+    """Registered ``pio_*`` metric names in one file's source text."""
+    return METRIC_REGISTRATION_RE.findall(source)
+
+
+def walk_py_files(root: str, exclude_parts: tuple = ("__pycache__",)):
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d not in exclude_parts]
+        for fname in sorted(filenames):
+            if fname.endswith(".py"):
+                yield os.path.join(dirpath, fname)

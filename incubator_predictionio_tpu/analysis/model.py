@@ -1,0 +1,122 @@
+"""Shared data model of the invariant linter: findings and parsed modules.
+
+A :class:`Finding` is one violation at one source location, carrying the
+rule id, a message, and a fix hint. Its :meth:`Finding.key` is the
+line-number-free identity the baseline file stores (rule | relpath |
+enclosing scope | stripped source text), so baselines survive unrelated
+edits that only shift line numbers.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class Finding:
+    """One rule violation at one source location."""
+
+    rule: str          #: rule id — R1..R5, or the meta rules S1/S2/B1
+    path: str          #: repo-relative path, "/" separators
+    line: int          #: 1-indexed line of the offending node
+    message: str       #: what is wrong, concretely
+    hint: str = ""     #: how to fix it (rule-level guidance)
+    scope: str = ""    #: dotted enclosing class/function names, "" = module
+    code: str = ""     #: stripped source text of the offending line
+    suppressed: bool = False   #: matched an inline ``pio-lint: disable``
+    baselined: bool = False    #: matched a conf/lint_baseline.txt entry
+
+    def key(self) -> str:
+        """Line-number-free identity used by the baseline file."""
+        return "|".join((self.rule, self.path, self.scope, self.code))
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}"
+
+    def to_json(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "scope": self.scope,
+            "message": self.message,
+            "hint": self.hint,
+            "suppressed": self.suppressed,
+            "baselined": self.baselined,
+        }
+
+
+@dataclass
+class Module:
+    """One parsed source file handed to every rule."""
+
+    path: str                  #: absolute path
+    relpath: str               #: repo-relative, "/" separators
+    source: str
+    tree: ast.AST
+    #: line → dotted scope, filled lazily by :meth:`scope_at`
+    _scopes: Optional[dict] = field(default=None, repr=False)
+
+    @property
+    def lines(self) -> list:
+        return self.source.splitlines()
+
+    def line_text(self, lineno: int) -> str:
+        lines = self.lines
+        if 1 <= lineno <= len(lines):
+            return lines[lineno - 1].strip()
+        return ""
+
+    def scope_at(self, lineno: int) -> str:
+        """Dotted class/function scope enclosing ``lineno`` ("" = module)."""
+        if self._scopes is None:
+            self._scopes = _build_scope_map(self.tree)
+        best = ""
+        best_depth = -1
+        for (start, end, depth, name) in self._scopes:
+            if start <= lineno <= end and depth > best_depth:
+                best, best_depth = name, depth
+        return best
+
+    def finding(self, rule: str, lineno: int, message: str,
+                hint: str = "") -> Finding:
+        return Finding(
+            rule=rule, path=self.relpath, line=lineno, message=message,
+            hint=hint, scope=self.scope_at(lineno),
+            code=self.line_text(lineno))
+
+
+def _build_scope_map(tree: ast.AST) -> list:
+    """(start, end, depth, dotted-name) for every def/class in the tree."""
+    out: list = []
+
+    def walk(node: ast.AST, prefix: str, depth: int) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                name = f"{prefix}.{child.name}" if prefix else child.name
+                end = getattr(child, "end_lineno", child.lineno)
+                out.append((child.lineno, end, depth, name))
+                walk(child, name, depth + 1)
+            else:
+                walk(child, prefix, depth)
+
+    walk(tree, "", 0)
+    return out
+
+
+def load_module(path: str, root: str) -> Optional[Module]:
+    """Parse one file; returns None for unparseable sources (the linter
+    lints this project, whose files must parse — a SyntaxError file will
+    fail tests long before lint runs)."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        tree = ast.parse(source, filename=path)
+    except (OSError, SyntaxError, ValueError):
+        return None
+    rel = os.path.relpath(path, root).replace(os.sep, "/")
+    return Module(path=path, relpath=rel, source=source, tree=tree)

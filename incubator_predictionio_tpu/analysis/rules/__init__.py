@@ -1,0 +1,35 @@
+"""Rule registry: one module per project invariant (docs/analysis.md).
+
+Adding a rule: write a module with a ``Rule`` subclass, import it here,
+add it to :data:`ALL_RULES`, document it in docs/analysis.md, and give
+it positive/negative fixtures under tests/fixtures/lint_cases/ — the
+walkthrough in docs/analysis.md covers each step.
+"""
+
+from incubator_predictionio_tpu.analysis.rules.base import Rule  # noqa: F401
+from incubator_predictionio_tpu.analysis.rules.r1_async_blocking import (
+    AsyncBlockingRule,
+)
+from incubator_predictionio_tpu.analysis.rules.r2_clock import (
+    ClockDisciplineRule,
+)
+from incubator_predictionio_tpu.analysis.rules.r3_durability import (
+    DurabilityRule,
+)
+from incubator_predictionio_tpu.analysis.rules.r4_knobs import (
+    KnobRegistryRule,
+)
+from incubator_predictionio_tpu.analysis.rules.r5_locks import (
+    LockHygieneRule,
+)
+
+#: every shipped rule, id order
+ALL_RULES = (
+    AsyncBlockingRule(),
+    ClockDisciplineRule(),
+    DurabilityRule(),
+    KnobRegistryRule(),
+    LockHygieneRule(),
+)
+
+RULES_BY_ID = {r.id: r for r in ALL_RULES}

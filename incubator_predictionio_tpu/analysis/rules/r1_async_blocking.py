@@ -1,0 +1,100 @@
+"""R1 — async-blocking: no blocking syscalls on the asyncio event loop.
+
+The invariant: anything reachable inside an ``async def`` body runs on
+the event loop, and one blocking call stalls EVERY in-flight request on
+that server — the exact class PR 13 fixed by moving span-spool writes
+(fsync per append) off the span-finishing thread onto a bounded-queue
+writer thread, and PR 5's chaos harness hit when a full stdout pipe
+blocked a subprocess's loop mid-storm. Detected:
+
+- ``time.sleep`` (use ``await asyncio.sleep`` — or the injected clock's
+  sleep via a worker thread when under R2's seam);
+- ``os.fsync`` / ``os.fdatasync`` / ``os.system``;
+- synchronous file I/O: builtin ``open`` (read a config at startup,
+  fine — but annotate it; serve-path file I/O belongs on an executor);
+- subprocess spawns: ``subprocess.run/call/check_call/check_output/
+  Popen``;
+- synchronous network clients: ``socket.create_connection``,
+  ``urllib.request.urlopen``, ``requests.*``, ``http.client.*``;
+- ``<lock>.acquire()`` NOT under ``await`` — a ``threading.Lock``
+  acquire parks the whole loop behind whichever thread holds it
+  (``await sem.acquire()`` on asyncio primitives is the correct idiom
+  and is exempt).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from incubator_predictionio_tpu.analysis.model import Finding, Module
+from incubator_predictionio_tpu.analysis.rules.base import (
+    Rule,
+    awaited_calls,
+    dotted,
+    iter_async_nodes,
+)
+
+#: exact dotted-name calls that block the calling thread
+BLOCKING_CALLS = {
+    "time.sleep": "await asyncio.sleep(...) instead",
+    "os.fsync": "move the fsync to a worker thread (the PR 13 spool "
+                "writer-thread pattern) or run_in_executor",
+    "os.fdatasync": "move the fsync to a worker thread or run_in_executor",
+    "os.system": "use asyncio.create_subprocess_exec",
+    "open": "file I/O blocks the loop: run_in_executor, or annotate a "
+            "startup-only read with a reasoned suppression",
+    "io.open": "file I/O blocks the loop: run_in_executor",
+    "subprocess.run": "use asyncio.create_subprocess_exec",
+    "subprocess.call": "use asyncio.create_subprocess_exec",
+    "subprocess.check_call": "use asyncio.create_subprocess_exec",
+    "subprocess.check_output": "use asyncio.create_subprocess_exec",
+    "subprocess.Popen": "use asyncio.create_subprocess_exec",
+    "socket.create_connection": "use loop.sock_connect / aiohttp",
+    "urllib.request.urlopen": "use aiohttp (the project's async client)",
+}
+
+#: module prefixes whose every call is a synchronous network client
+BLOCKING_PREFIXES = ("requests.", "http.client.")
+
+
+class AsyncBlockingRule(Rule):
+    id = "R1"
+    title = "async-blocking: blocking call reachable inside async def"
+    hint = ("the event loop serves every in-flight request; one blocking "
+            "call stalls them all — await the async equivalent, or move "
+            "the work to a worker thread / run_in_executor "
+            "(docs/analysis.md#r1)")
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        awaited = awaited_calls(mod.tree)
+        for fn, node in iter_async_nodes(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func)
+            if name in BLOCKING_CALLS and id(node) not in awaited:
+                yield mod.finding(
+                    self.id, node.lineno,
+                    f"blocking call {name}() inside async def {fn.name}()",
+                    f"{BLOCKING_CALLS[name]} (docs/analysis.md#r1)")
+            elif (name.startswith(BLOCKING_PREFIXES)
+                    and id(node) not in awaited):
+                yield mod.finding(
+                    self.id, node.lineno,
+                    f"synchronous network call {name}() inside async def "
+                    f"{fn.name}()",
+                    "use aiohttp (the project's async client) "
+                    "(docs/analysis.md#r1)")
+            elif (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "acquire"
+                    and id(node) not in awaited):
+                recv = dotted(node.func.value) or "<expr>"
+                if "asyncio" in recv:
+                    continue
+                yield mod.finding(
+                    self.id, node.lineno,
+                    f"un-awaited {recv}.acquire() inside async def "
+                    f"{fn.name}() — a threading.Lock acquire parks the "
+                    "whole event loop",
+                    "await an asyncio primitive, or keep the lock "
+                    "short-held in a worker thread (docs/analysis.md#r1)")

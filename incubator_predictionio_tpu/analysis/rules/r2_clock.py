@@ -1,0 +1,88 @@
+"""R2 — clock-discipline: Clock-seam modules use the injected clock.
+
+The invariant behind every "zero wall sleeps" test suite in this repo
+(resilience, overload, streaming, jobs): a module that participates in
+the injectable-Clock seam — it imports
+:mod:`incubator_predictionio_tpu.resilience.clock` or takes a ``clock``
+parameter — must route ALL schedulable time through that clock.
+A direct ``time.time()`` / ``time.monotonic()`` / ``time.sleep()`` in
+such a module is invisible to FakeClock, so the deterministic timeline
+the tests script silently diverges from what production runs (the
+pre-PR-2 stats.py roll bug was exactly this class: a hand-rolled
+wall-clock read the tests could not advance).
+
+Legitimate survivors — e.g. ``time.time()`` producing an EPOCH
+timestamp for persistence or display, which the monotonic Clock protocol
+cannot express — carry a reasoned inline suppression.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from incubator_predictionio_tpu.analysis.model import Finding, Module
+from incubator_predictionio_tpu.analysis.rules.base import (
+    Rule,
+    dotted,
+    imported_names,
+)
+
+_WALL_CALLS = ("time.time", "time.monotonic", "time.sleep")
+_SEAM_MODULE = "incubator_predictionio_tpu.resilience.clock"
+
+#: the seam's own implementation is the one place wall time belongs
+_EXEMPT = ("resilience/clock.py",)
+
+
+def is_clock_seam(mod: Module) -> bool:
+    """Does this module participate in the injectable-Clock seam?"""
+    if mod.relpath.endswith(_EXEMPT):
+        return False
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ImportFrom):
+            if node.module and node.module.endswith("resilience.clock"):
+                return True
+            if (node.module
+                    and node.module.endswith((".resilience", "resilience"))
+                    and any(a.name in ("Clock", "FakeClock", "SystemClock",
+                                       "SYSTEM_CLOCK") for a in node.names)):
+                return True
+        elif isinstance(node, ast.Import):
+            if any(_SEAM_MODULE in a.name for a in node.names):
+                return True
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for a in list(node.args.args) + list(node.args.kwonlyargs):
+                if a.arg == "clock":
+                    return True
+    return False
+
+
+class ClockDisciplineRule(Rule):
+    id = "R2"
+    title = "clock-discipline: wall-clock read bypasses the Clock seam"
+    hint = ("this module takes an injectable Clock; a direct time.* call "
+            "is invisible to FakeClock and breaks the zero-wall-sleeps "
+            "test contract — route through the injected clock, or "
+            "suppress with the reason wall time is semantically required "
+            "(epoch timestamps for persistence/display) "
+            "(docs/analysis.md#r2)")
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        if not is_clock_seam(mod):
+            return
+        bare = imported_names(mod.tree, "time",
+                              ("time", "monotonic", "sleep"))
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func)
+            hit = name in _WALL_CALLS or (
+                isinstance(node.func, ast.Name) and node.func.id in bare)
+            if hit:
+                shown = name if name in _WALL_CALLS else f"time.{name}"
+                yield mod.finding(
+                    self.id, node.lineno,
+                    f"{shown}() in a Clock-seam module bypasses the "
+                    "injected clock",
+                    self.hint)

@@ -1,0 +1,81 @@
+"""R3 — durability-ordering: durable modules write state atomically.
+
+The invariant PR 4 established and every durable subsystem since has
+lived by: a state file in a crash-safety module is written tmp → flush →
+fsync → rename (:func:`incubator_predictionio_tpu.utils.fs.
+atomic_write_bytes`) or through the CRC-framed WAL append discipline —
+never a bare ``open(path, 'w')`` + dump, which a power cut mid-write
+turns into a torn file the next startup trusts (the pre-PR-4 model-blob
+and cursor writes were exactly this class; the WAL-cursor discipline in
+docs/resilience.md is the fix).
+
+Scope: modules under the durable packages (``resilience/``,
+``backup/``, ``replication/``, ``streaming/``, ``jobs/``) — the
+subsystems whose whole point is surviving kill -9. The implementations
+OF the discipline (framed appenders that fsync per group commit,
+streamed restore writers that verify while writing) carry reasoned
+inline suppressions: the exception list is the audit trail.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from incubator_predictionio_tpu.analysis.model import Finding, Module
+from incubator_predictionio_tpu.analysis.rules.base import Rule, dotted
+
+#: path components that mark a module as crash-safety-critical
+DURABLE_PACKAGES = ("resilience", "backup", "replication", "streaming",
+                    "jobs")
+
+_WRITE_MODE_CHARS = set("wax+")
+
+
+def _literal_mode(call: ast.Call) -> Optional[str]:
+    if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant) \
+            and isinstance(call.args[1].value, str):
+        return call.args[1].value
+    for kw in call.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant) \
+                and isinstance(kw.value.value, str):
+            return kw.value.value
+    return None
+
+
+def is_durable_module(relpath: str) -> bool:
+    parts = relpath.split("/")
+    return any(p in DURABLE_PACKAGES for p in parts[:-1])
+
+
+class DurabilityRule(Rule):
+    id = "R3"
+    title = "durability-ordering: non-atomic state write in a durable module"
+    hint = ("a bare write in a crash-safety module tears under kill -9 / "
+            "power cut — use utils.fs.atomic_write_bytes (tmp+fsync+"
+            "rename) or the WAL framing helpers; implementations of the "
+            "discipline itself carry a reasoned suppression "
+            "(docs/analysis.md#r3)")
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        if not is_durable_module(mod.relpath):
+            return
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func)
+            if name in ("open", "io.open"):
+                mode = _literal_mode(node)
+                if mode and (_WRITE_MODE_CHARS & set(mode)):
+                    yield mod.finding(
+                        self.id, node.lineno,
+                        f"bare open(..., {mode!r}) writes state without "
+                        "the tmp+fsync+rename discipline",
+                        self.hint)
+            elif (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("write_text", "write_bytes")):
+                yield mod.finding(
+                    self.id, node.lineno,
+                    f"{dotted(node.func) or node.func.attr}() writes "
+                    "state without the tmp+fsync+rename discipline",
+                    self.hint)

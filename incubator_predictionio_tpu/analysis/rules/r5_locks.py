@@ -1,0 +1,94 @@
+"""R5 — lock/await-hygiene: never ``await`` while holding a thread lock.
+
+The deadlock-and-stall class at the thread/coroutine boundary:
+
+- ``with self._lock: ... await ...`` — the coroutine SUSPENDS while the
+  ``threading.Lock`` stays held. Every other coroutine on the loop that
+  touches the lock then blocks the loop itself (R1's stall, caused by
+  R5's shape), and a worker thread waiting on the lock while the loop
+  waits on that thread is a deadlock. State shared between coroutines
+  is guarded by ``asyncio.Lock`` (which is awaited, releasing the loop)
+  — ``threading.Lock`` is for state shared with worker THREADS and must
+  be dropped before any await.
+
+A ``with`` on an asyncio primitive (``async with``) is a different AST
+node and never fires; a short-held thread lock with no await inside is
+the accepted idiom all over this codebase and never fires either.
+Detection is name-heuristic (context managers whose terminal name
+contains "lock"/"mutex" or is ``_mu``) — the false-negative risk of a
+creatively named lock is accepted over type inference.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from incubator_predictionio_tpu.analysis.model import Finding, Module
+from incubator_predictionio_tpu.analysis.rules.base import (
+    Rule,
+    dotted,
+    iter_async_nodes,
+)
+
+_LOCKISH = ("lock", "mutex")
+
+
+def _lockish(expr: ast.AST) -> str:
+    """The lock-ish name a with-item guards, or ""."""
+    name = dotted(expr)
+    if not name or "asyncio" in name:
+        return ""
+    terminal = name.rsplit(".", 1)[-1].lower()
+    if any(part in terminal for part in _LOCKISH) or terminal == "_mu":
+        return name
+    return ""
+
+
+def _awaits_inside(body: list) -> list:
+    """Await nodes in ``body``, not crossing a nested function def."""
+    out = []
+
+    def walk(node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            if isinstance(child, ast.Await):
+                out.append(child)
+            walk(child)
+
+    for stmt in body:
+        if isinstance(stmt, ast.Await):
+            out.append(stmt)
+        walk(stmt)
+    return out
+
+
+class LockHygieneRule(Rule):
+    id = "R5"
+    title = "lock/await-hygiene: await while holding a threading lock"
+    hint = ("the coroutine suspends with the thread lock HELD — every "
+            "other coroutine touching it then blocks the event loop, and "
+            "a worker thread waiting on it while the loop waits on that "
+            "thread deadlocks; guard coroutine-shared state with "
+            "asyncio.Lock, or drop the thread lock before awaiting "
+            "(docs/analysis.md#r5)")
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        for fn, node in iter_async_nodes(mod.tree):
+            if not isinstance(node, ast.With):
+                continue
+            names = [n for n in
+                     (_lockish(item.context_expr) for item in node.items)
+                     if n]
+            if not names:
+                continue
+            awaits = _awaits_inside(node.body)
+            for aw in awaits:
+                yield mod.finding(
+                    self.id, aw.lineno,
+                    f"await inside `with {names[0]}:` in async def "
+                    f"{fn.name}() — the thread lock stays held across "
+                    "the suspension",
+                    self.hint)

@@ -1,0 +1,96 @@
+"""Inline suppressions: ``# pio-lint: disable=R<n>,R<m> (reason)``.
+
+The reason is MANDATORY — a suppression is a reviewed exception to a
+project invariant, and the review lives in the parenthesized text (S1
+fires on a bare disable). A suppression that no longer matches any
+finding is stale noise and fails the run too (S2), exactly like the
+metrics allowlist: the file of exceptions must shrink back when a debt
+is repaid.
+
+Placement: on the flagged line itself, or alone on the line directly
+above it (for lines too long to carry a trailing comment).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+from incubator_predictionio_tpu.analysis.model import Finding, Module
+
+#: ``# pio-lint: disable=R1`` / ``disable=R1,R5 (reason text)``
+_DISABLE_RE = re.compile(
+    r"#\s*pio-lint:\s*disable=([A-Z0-9,\s]+?)\s*(?:\((.*)\))?\s*$")
+
+S1_HINT = ("every suppression is a reviewed exception — write the review: "
+           "# pio-lint: disable=R<n> (why this site is allowed)")
+S2_HINT = ("the rule no longer fires here; delete the stale suppression "
+           "so the exception surface stays honest")
+
+
+@dataclass
+class _Directive:
+    line: int                 #: line the comment sits on
+    rules: tuple              #: ("R1", "R5")
+    reason: str               #: "" when missing → S1
+    standalone: bool          #: comment-only line → covers the next line
+    used: set = field(default_factory=set)   #: rule ids that matched
+
+
+class Suppressions:
+    """Per-module suppression table with staleness accounting."""
+
+    def __init__(self, mod: Module):
+        self.mod = mod
+        self.directives: list = []
+        for i, text in enumerate(mod.lines, start=1):
+            m = _DISABLE_RE.search(text)
+            if not m:
+                continue
+            rules = tuple(r.strip() for r in m.group(1).split(",")
+                          if r.strip())
+            standalone = text.strip().startswith("#")
+            self.directives.append(_Directive(
+                line=i, rules=rules, reason=(m.group(2) or "").strip(),
+                standalone=standalone))
+
+    def _covering(self, finding: Finding):
+        for d in self.directives:
+            target = d.line + 1 if d.standalone else d.line
+            if target == finding.line and finding.rule in d.rules:
+                return d
+        return None
+
+    def apply(self, findings: list) -> None:
+        """Mark findings matched by a reasoned directive as suppressed."""
+        for f in findings:
+            d = self._covering(f)
+            if d is not None and d.reason:
+                f.suppressed = True
+                d.used.add(f.rule)
+
+    def meta_findings(self, checked_rules: set) -> list:
+        """S1 (missing reason) and S2 (stale) findings for this module.
+
+        ``checked_rules`` limits staleness to rules that actually ran —
+        a ``--rule R2`` pass must not call every R3 suppression stale.
+        """
+        out = []
+        for d in self.directives:
+            if not d.reason:
+                out.append(self.mod.finding(
+                    "S1", d.line,
+                    f"suppression of {','.join(d.rules)} has no reason",
+                    S1_HINT))
+                continue
+            stale = [r for r in d.rules
+                     if r in checked_rules and r not in d.used]
+            if stale:
+                out.append(self.mod.finding(
+                    "S2", d.line,
+                    f"stale suppression: {','.join(stale)} no longer "
+                    f"fires on the next line" if d.standalone else
+                    f"stale suppression: {','.join(stale)} no longer "
+                    f"fires on this line",
+                    S2_HINT))
+        return out

@@ -135,6 +135,7 @@ def restore_backup(backup_dir: str, targets: RestoreTargets,
         os.makedirs(os.path.dirname(dest), exist_ok=True)
         crc = 0
         size = 0
+        # pio-lint: disable=R3 (restore target, not live state: verified-while-writing with a running CRC, target dir refused unless empty/--force, aborted on mismatch)
         with open(dest, "wb") as f:
             for chunk in bset.iter_file(entry, logical):
                 crc = zlib.crc32(chunk, crc)

@@ -189,7 +189,7 @@ class _Transport:
         TransientError for anything worth retrying; the policy decides
         whether a retry actually happens (idempotency, budget, breaker)."""
         conn = getattr(self._local, "conn", None)
-        now = time.monotonic()
+        now = self.policy.clock.monotonic()
         if conn is not None and (
             now - getattr(self._local, "last_used", 0.0) > self.MAX_IDLE_SECS
         ):
@@ -214,7 +214,7 @@ class _Transport:
                 conn.sock.settimeout(deadline.attempt_timeout(self.timeout))
             conn.request("POST", path, payload, self._headers())
             resp = conn.getresponse()
-            self._local.last_used = time.monotonic()
+            self._local.last_used = self.policy.clock.monotonic()
             status, data = resp.status, resp.read()
             if status == 409 and resp.getheader("X-PIO-Fenced"):
                 # epoch-fenced write (docs/replication.md): this endpoint

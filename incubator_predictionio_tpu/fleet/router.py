@@ -165,7 +165,7 @@ class RouterServer:
         self._runner: Optional[web.AppRunner] = None
         self._stop_event = asyncio.Event()
         self._shadow_tasks: set[asyncio.Task] = set()  # strong refs
-        self._start_time = time.time()
+        self._start_time = self._clock.monotonic()
         REGISTRY.add_collector("fleet_router", self._collect_metrics)
 
     def _collect_metrics(self) -> None:
@@ -198,7 +198,7 @@ class RouterServer:
             "candidates": self.candidate_balancer.snapshot(),
             "experiment": (self.experiment.summary()
                            if self.experiment else None),
-            "uptimeSec": time.time() - self._start_time,
+            "uptimeSec": self._clock.monotonic() - self._start_time,
         })
 
     async def handle_health(self, request: web.Request) -> web.Response:

@@ -442,6 +442,7 @@ class ReplicationManager:
 
         f = self._writers.get(name)
         if f is None:
+            # pio-lint: disable=R3 (follower replica log: complete-record CRC-verified appends shipped from the primary; divergent suffixes are truncated by scrub, and flock guards single-writer)
             f = open(os.path.join(self.config.log_dir, name), "ab")
             try:
                 fcntl.flock(f.fileno(), fcntl.LOCK_EX | fcntl.LOCK_NB)

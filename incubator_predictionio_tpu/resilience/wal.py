@@ -292,6 +292,7 @@ class SpillWal:
         self._active_path = os.path.join(
             self.directory,
             f"{_SEG_PREFIX}{self._next_segment_number():08d}{_SEG_SUFFIX}")
+        # pio-lint: disable=R3 (this IS the WAL: CRC-framed appends with group-commit fsync before ack are the durability discipline R3 points at)
         self._active = open(self._active_path, "ab")
         self._active.write(MAGIC)
         self._active.flush()
@@ -346,6 +347,7 @@ class SpillWal:
             f"{_SEG_PREFIX}{self._next_segment_number():08d}{_SEG_SUFFIX}")
         new_f = None
         try:
+            # pio-lint: disable=R3 (WAL segment rotation: same CRC-framed append + group-commit fsync discipline as the active segment)
             new_f = open(new_path, "ab")
             new_f.write(MAGIC)
             new_f.flush()
@@ -402,6 +404,7 @@ class SpillWal:
         path = os.path.join(self.directory, DEAD_LETTER)
         try:
             fresh = not os.path.exists(path)
+            # pio-lint: disable=R3 (dead-letter segment: CRC-framed appends, fsynced before the commit cursor moves past the poisoned records)
             with open(path, "ab") as f:
                 if fresh:
                     f.write(MAGIC)

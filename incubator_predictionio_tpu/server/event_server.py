@@ -213,6 +213,9 @@ class EventServer:
             config.client_rate, config.client_burst, clock=clock,
             server="event_server")
         self._drain_rate = RateEstimator(clock=clock)
+        # auth-cache TTLs and the shutdown-flush deadline run on the same
+        # injected clock, so FakeClock tests can script expiry timelines
+        self._clock = clock
         self._runner: Optional[web.AppRunner] = None
         # Storage calls are synchronous (LEvents contract, storage/base.py);
         # run them here so concurrent ingestion can't stall the accept loop —
@@ -372,7 +375,7 @@ class EventServer:
         channel = request.query.get("channel")
         if self._AUTH_TTL <= 0:  # caching disabled: per-request lookup
             return await self._run(self._authenticate, request)
-        now = time.monotonic()
+        now = self._clock.monotonic()
         hit = self._auth_cache.get((key, channel))
         if hit is not None and hit[0] > now:
             return hit[1]
@@ -1150,7 +1153,7 @@ class EventServer:
         (dict ops are GIL-atomic; the TTL semantics are identical)."""
         if self._AUTH_TTL <= 0:
             return self._authenticate_parts(key, channel)
-        now = time.monotonic()
+        now = self._clock.monotonic()
         hit = self._auth_cache.get((key, channel))
         if hit is not None and hit[0] > now:
             return hit[1]
@@ -1195,8 +1198,8 @@ class EventServer:
         # deadline, but a no-progress beat RETRIES rather than giving up:
         # the breaker may be waiting out its reset window on a store that
         # already recovered (the SIGTERM-during-recovery drain case)
-        flush_deadline = time.monotonic() + flush_deadline_sec
-        while self._spill and time.monotonic() < flush_deadline:
+        flush_deadline = self._clock.monotonic() + flush_deadline_sec
+        while self._spill and self._clock.monotonic() < flush_deadline:
             try:
                 if not await self._run(self._drain_spill_once):
                     await asyncio.sleep(0.1)

@@ -834,7 +834,7 @@ class QueryServer:
         self._previous_delta_state: Optional[dict] = None
         # -- graceful drain (server/lifecycle.py) -------------------------
         self._drain_state = DrainState("query_server")
-        self._start_time = time.time()
+        self._start_time = self._clock.monotonic()
         self._runner: Optional[web.AppRunner] = None
         self._stop_event = asyncio.Event()
         self._feedback_tasks: set[asyncio.Task] = set()  # strong refs (GC pitfall)
@@ -985,7 +985,7 @@ class QueryServer:
             # compile-churn gauge: distinct serving executables built in this
             # process; must stay flat under load once warmup has run
             "jitCompileKeys": jitstats.count(),
-            "uptimeSec": time.time() - self._start_time,
+            "uptimeSec": self._clock.monotonic() - self._start_time,
         })
 
     def _status_html(self) -> str:
@@ -1109,7 +1109,7 @@ class QueryServer:
         cannot drift. Returns (status, jsonable body, response headers or
         None) — headers carry X-PIO-Server-Timing on predictions and
         Retry-After on overload rejections."""
-        t0 = time.time()
+        t0 = self._clock.monotonic()
         try:
             payload = json.loads(body)
         except json.JSONDecodeError:
@@ -1179,7 +1179,8 @@ class QueryServer:
             # the engine answered (binding rejected the query): health-wise
             # a success — a half-open probe slot must never leak
             self._serving_breaker.record_success()
-            self._feed_admission(time.time() - t0, observe_latency=False)
+            self._feed_admission(self._clock.monotonic() - t0,
+                                 observe_latency=False)
             return 400, {"message": f"Invalid query: {e}"}, None
         except (asyncio.TimeoutError, ServingUnavailable, DeadlineExceeded,
                 CircuitOpenError) as e:
@@ -1190,7 +1191,8 @@ class QueryServer:
             # freshly swapped instance — restore the pinned previous one
             await self._maybe_probation_rollback(repr(e))
             self._ship_remote_log(f"query degraded: {e!r}")
-            self._feed_admission(time.time() - t0, observe_latency=False)
+            self._feed_admission(self._clock.monotonic() - t0,
+                                 observe_latency=False)
             return 200, await loop.run_in_executor(
                 None, self._degraded_result, payload, repr(e)), None
         except Exception as e:  # noqa: BLE001 - ship serving errors remotely
@@ -1201,10 +1203,11 @@ class QueryServer:
             # surfaces here as ServingUnavailable (counted above).
             self._serving_breaker.record_success()
             self._ship_remote_log(f"query failed: {e!r}")
-            self._feed_admission(time.time() - t0, observe_latency=False)
+            self._feed_admission(self._clock.monotonic() - t0,
+                                 observe_latency=False)
             raise
         self._serving_breaker.record_success()
-        dt = time.time() - t0
+        dt = self._clock.monotonic() - t0
         self.request_count += 1
         self.last_serving_sec = dt
         self.avg_serving_sec += (dt - self.avg_serving_sec) / self.request_count
@@ -1498,6 +1501,7 @@ class QueryServer:
             return None
         staleness = None
         if st.get("maxEventTimeUs"):
+            # pio-lint: disable=R2 (maxEventTimeUs is an EPOCH stamp from the event log; staleness vs wall time is the semantic — the monotonic Clock seam cannot express it)
             staleness = max(0.0, time.time() - st["maxEventTimeUs"] / 1e6)
         return {
             "lastDeltaSeq": st["lastDeltaSeq"],

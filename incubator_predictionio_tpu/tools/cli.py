@@ -2158,6 +2158,37 @@ def cmd_backup_prune(args, storage) -> int:
     return 0
 
 
+def cmd_lint(args, storage) -> int:
+    """Run the project invariant linter (docs/analysis.md): R1
+    async-blocking, R2 clock-discipline, R3 durability-ordering, R4
+    knob-registry, R5 lock/await-hygiene, plus the S1/S2/B1 audits of
+    the suppression surface itself. Exit 0 = clean, 1 = findings,
+    2 = usage error (unknown rule id)."""
+    from incubator_predictionio_tpu.analysis.engine import (
+        render_json,
+        render_text,
+        run_lint,
+    )
+
+    try:
+        result = run_lint(
+            root=args.root,
+            rules=args.rule or None,
+            baseline_path=args.baseline,
+            update_baseline=args.update_baseline,
+        )
+    except ValueError as e:
+        _err(f"lint: {e}")
+        return 2
+    if args.update_baseline:
+        # stderr under --json: stdout must stay one valid JSON document
+        note = (f"baseline updated: {len(result.baselined)} entr(ies) "
+                f"({args.baseline or 'conf/lint_baseline.txt'})")
+        (_err if args.json else _out)(note)
+    _out(render_json(result) if args.json else render_text(result))
+    return 0 if result.clean else 1
+
+
 def _backup_row(backup_dir: str, max_age: Optional[float],
                 now: Optional[float] = None) -> dict:
     """The backup-staleness probe for ``pio-tpu health --backup-dir``
@@ -2911,6 +2942,31 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--json", action="store_true",
                    help="machine-readable inspection output")
 
+    # lint — project invariant linter (docs/analysis.md)
+    p = sub.add_parser(
+        "lint",
+        help="run the AST-based project invariant linter: R1 async-"
+             "blocking, R2 clock-discipline, R3 durability-ordering, "
+             "R4 knob-registry (PIO_* knobs + pio_* metrics ↔ docs), "
+             "R5 lock/await-hygiene; suppressions and the baseline are "
+             "audited too (docs/analysis.md)")
+    p.add_argument("--rule", action="append", metavar="R<n>",
+                   help="run only this rule id (repeatable, e.g. "
+                        "--rule R2 --rule R4; default: all)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable findings (schema in "
+                        "docs/analysis.md)")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="accept every current finding into the baseline "
+                        "file — deterministic output (sorted, "
+                        "path-relative) so the diff is reviewable")
+    p.add_argument("--baseline", metavar="PATH",
+                   help="baseline file, repo-relative "
+                        "(default conf/lint_baseline.txt)")
+    p.add_argument("--root",
+                   help="repo root to lint (default: the tree this "
+                        "package is installed from)")
+
     # export / import
     p = sub.add_parser("export")
     p.add_argument("--appid", type=int, required=True)
@@ -2981,6 +3037,7 @@ _COMMANDS = {
     "index": cmd_index,
     "shards": cmd_shards,
     "wal": cmd_wal,
+    "lint": cmd_lint,
     "stream": cmd_stream,
     "start-all": cmd_start_all,
     "stop-all": cmd_stop_all,

@@ -1,0 +1,14 @@
+"""R3 clean twin: durable-package state writes via the atomic helper."""
+
+import json
+
+from incubator_predictionio_tpu.utils.fs import atomic_write_bytes
+
+
+def save_cursor(path: str, offset: int) -> None:
+    atomic_write_bytes(path, json.dumps({"offset": offset}).encode())
+
+
+def read_cursor(path: str) -> int:
+    with open(path) as f:            # reads never fire R3
+        return json.load(f)["offset"]

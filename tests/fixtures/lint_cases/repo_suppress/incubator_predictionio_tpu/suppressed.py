@@ -1,0 +1,26 @@
+"""Suppression-audit fixture: S1 (bare disable) and S2 (stale disable).
+
+The reasoned suppression on the first violation is accepted (counted
+``suppressed``); the bare one on the second is itself a finding (S1);
+the third sits on a line where R2 never fires and is stale noise (S2).
+"""
+
+import time
+
+from incubator_predictionio_tpu.resilience.clock import SYSTEM_CLOCK, Clock
+
+_CLOCK: Clock = SYSTEM_CLOCK
+
+
+def reasoned() -> float:
+    # pio-lint: disable=R2 (epoch stamp persisted to disk; wall time is the contract)
+    return time.time()
+
+
+def bare() -> float:
+    return time.time()  # pio-lint: disable=R2
+
+
+def stale() -> float:
+    # pio-lint: disable=R2 (nothing on the next line trips R2 anymore)
+    return 42.0

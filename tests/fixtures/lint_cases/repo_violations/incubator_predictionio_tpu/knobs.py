@@ -1,0 +1,26 @@
+"""R4 fixture — knob drift, both directions.
+
+Reads a knob configuration.md doesn't document (the class PR 15's first
+real run caught: PIO_EVENTSERVER_SPILL_MAX and four siblings were read
+for ten PRs without a row), while the fixture docs table documents a
+knob nothing reads, and registers a metric observability.md doesn't
+list.
+"""
+
+import os
+
+
+def spill_capacity() -> int:
+    return int(os.environ.get("PIO_LINT_FIXTURE_UNDOCUMENTED", "1000"))
+
+
+class _Registry:
+    def counter(self, name, help_text):
+        return name
+
+
+REGISTRY = _Registry()
+
+ORPHAN_METRIC = REGISTRY.counter(
+    "pio_lint_fixture_orphan_total",
+    "registered but never documented — R4's metric direction")

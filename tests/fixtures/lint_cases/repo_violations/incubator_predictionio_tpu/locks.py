@@ -1,0 +1,29 @@
+"""R5 fixture — await while holding a threading lock.
+
+The deadlock shape R5 exists for: a ``threading.Lock`` guarding state
+shared between coroutines, held across an ``await``. The coroutine
+suspends with the lock held; any other coroutine touching the lock then
+blocks the event loop itself, and a worker thread waiting on the lock
+while the loop waits on that thread never wakes up. (The registries in
+obs/metrics.py hold their locks short and never await inside — that
+idiom is the clean twin and does not fire.)
+"""
+
+import threading
+
+
+class SharedState:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rows = {}
+
+    async def update(self, key, fetch):
+        with self._lock:
+            value = await fetch(key)      # R5: suspended with lock HELD
+            self._rows[key] = value
+
+    async def update_twice(self, key, fetch):
+        with self._lock:
+            first = await fetch(key)      # R5
+            second = await fetch(key)     # R5
+            self._rows[key] = (first, second)

@@ -1,0 +1,32 @@
+"""R1 fixture — the pre-PR-13 sync spool write, reproduced.
+
+Before PR 13's post-review hardening, span-spool export ran on the
+span-finishing thread — often the server's event loop: an ``os.fsync``
+per kept span, inline in async context. Under load that fsync stalled
+every in-flight request; the fix was a bounded-queue writer thread.
+This file is that bug, distilled.
+"""
+
+import os
+import subprocess
+import time
+
+
+async def export_span_the_old_way(frame: bytes, path: str) -> None:
+    f = open(path, "ab")              # R1: blocking file I/O on the loop
+    f.write(frame)
+    f.flush()
+    os.fsync(f.fileno())              # R1: the pre-PR-13 stall, verbatim
+    f.close()
+
+
+async def wait_for_segment_rotation() -> None:
+    time.sleep(0.05)                  # R1: parks the whole event loop
+
+
+async def compact_segments(tool: str) -> None:
+    subprocess.run([tool, "compact"])  # R1: child process on the loop
+
+
+async def grab_registry_lock(lock) -> None:
+    lock.acquire()                    # R1: un-awaited threading acquire

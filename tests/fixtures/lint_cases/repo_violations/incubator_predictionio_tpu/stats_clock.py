@@ -1,0 +1,28 @@
+"""R2 fixture — the pre-PR-2 stats roll bug class, reproduced.
+
+PR 2 fixed Stats' hour-roll logic by making its clock injectable; the
+original bug was exactly this shape: a module wired into the Clock seam
+whose internals still read the wall clock directly, so FakeClock tests
+could never advance its timeline and the ≥2h-gap roll path went
+untested (and wrong) for twelve PRs.
+"""
+
+import time
+
+from incubator_predictionio_tpu.resilience.clock import SYSTEM_CLOCK, Clock
+
+
+class RollingWindow:
+    def __init__(self, clock: Clock = SYSTEM_CLOCK):
+        self._clock = clock
+        self._rolled_at = time.monotonic()   # R2: bypasses the seam
+
+    def maybe_roll(self) -> bool:
+        now = time.time()                    # R2: invisible to FakeClock
+        if now - self._rolled_at > 3600:
+            self._rolled_at = now
+            return True
+        return False
+
+    def backoff(self) -> None:
+        time.sleep(1.0)                      # R2: un-scriptable wall sleep

@@ -1,0 +1,19 @@
+"""R3 fixture — the pre-PR-4 bare state write, reproduced.
+
+Before PR 4 introduced ``utils.fs.atomic_write_bytes``, model blobs and
+cursors were written with a bare ``open(..., 'w')`` + dump: a power cut
+mid-write left a torn file the next startup trusted. The streaming
+feed's crash-safe cursor (PR 8) is the disciplined descendant; this is
+the ancestor bug in a durable package.
+"""
+
+import json
+
+
+def save_cursor_the_old_way(path: str, offset: int) -> None:
+    with open(path, "w") as f:            # R3: torn-file window
+        json.dump({"offset": offset}, f)
+
+
+def save_marker(path, payload: bytes) -> None:
+    path.write_bytes(payload)             # R3: same class via pathlib

@@ -1,0 +1,397 @@
+"""Invariant-linter suite (ISSUE 15, docs/analysis.md).
+
+Three layers:
+
+1. per-rule positive/negative fixtures — each violation case-repo under
+   tests/fixtures/lint_cases/ reproduces a REAL historical bug class
+   (pre-PR-13 sync spool write for R1, pre-PR-2 stats clock for R2,
+   pre-PR-4 bare state write for R3, the undocumented
+   PIO_EVENTSERVER_* knobs for R4, await-under-thread-lock for R5) and
+   the clean twin produces zero findings;
+2. the exception machinery — inline suppressions (reason mandatory,
+   staleness fails), baseline round-trip + determinism, allowlist
+   liveness, CLI exit codes, ``--json`` schema;
+3. the tier-1 contract — the linter over the REAL repo is clean, and
+   seeding drift (deleting a configuration.md knob row, adding an
+   undocumented ``PIO_*`` read) makes it fail.
+"""
+
+import json
+import os
+import shutil
+
+import pytest
+
+from incubator_predictionio_tpu.analysis import crossref
+from incubator_predictionio_tpu.analysis.engine import (
+    render_json,
+    render_text,
+    run_lint,
+)
+from incubator_predictionio_tpu.analysis.rules import ALL_RULES, RULES_BY_ID
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CASES = os.path.join(REPO, "tests", "fixtures", "lint_cases")
+VIOLATIONS = os.path.join(CASES, "repo_violations")
+CLEAN = os.path.join(CASES, "repo_clean")
+SUPPRESS = os.path.join(CASES, "repo_suppress")
+
+
+def _active(result, rule=None):
+    return [f for f in result.active if rule is None or f.rule == rule]
+
+
+# ---------------------------------------------------------------------------
+# per-rule fixtures: every rule catches its seeded (historical) violation
+# ---------------------------------------------------------------------------
+
+def test_r1_catches_the_pre_pr13_sync_spool_write():
+    r = run_lint(root=VIOLATIONS, rules=["R1"])
+    found = _active(r, "R1")
+    msgs = [f.message for f in found]
+    path = "incubator_predictionio_tpu/spool_sync.py"
+    assert all(f.path == path for f in found)
+    assert any("os.fsync" in m for m in msgs), msgs
+    assert any("open" in m for m in msgs)
+    assert any("time.sleep" in m for m in msgs)
+    assert any("subprocess.run" in m for m in msgs)
+    assert any("acquire" in m for m in msgs)
+    assert len(found) == 5
+
+
+def test_r2_catches_the_pre_pr2_stats_clock_bug():
+    r = run_lint(root=VIOLATIONS, rules=["R2"])
+    found = _active(r, "R2")
+    assert {f.line for f in found} == {18, 21, 28}
+    assert all(f.path.endswith("stats_clock.py") for f in found)
+    # findings carry scope + code for the baseline identity
+    scopes = {f.scope for f in found}
+    assert "RollingWindow.maybe_roll" in scopes
+    assert all(f.code for f in found)
+
+
+def test_r3_catches_the_pre_pr4_bare_state_write():
+    r = run_lint(root=VIOLATIONS, rules=["R3"])
+    found = _active(r, "R3")
+    assert len(found) == 2
+    assert all("streaming/cursor.py" in f.path for f in found)
+    assert any("open" in f.message for f in found)
+    assert any("write_bytes" in f.message for f in found)
+
+
+def test_r4_catches_drift_in_all_four_directions():
+    r = run_lint(root=VIOLATIONS, rules=["R4"])
+    found = _active(r, "R4")
+    msgs = "\n".join(f.message for f in found)
+    assert "PIO_LINT_FIXTURE_UNDOCUMENTED" in msgs       # read, no row
+    assert "PIO_LINT_FIXTURE_STALE" in msgs              # row, no read
+    assert "pio_lint_fixture_orphan_total" in msgs       # registered, no row
+    assert "pio_lint_fixture_ghost_total" in msgs        # row, no metric
+    # the undocumented READ finding lands at the code site, suppressible
+    read = [f for f in found
+            if "PIO_LINT_FIXTURE_UNDOCUMENTED" in f.message][0]
+    assert read.path.endswith("knobs.py") and read.line > 0
+
+
+def test_r5_catches_await_under_threading_lock():
+    r = run_lint(root=VIOLATIONS, rules=["R5"])
+    found = _active(r, "R5")
+    assert len(found) == 3          # one await + two in update_twice
+    assert all("lock" in f.message.lower() for f in found)
+    assert all(f.path.endswith("locks.py") for f in found)
+
+
+def test_clean_twin_repo_is_clean():
+    r = run_lint(root=CLEAN)
+    assert _active(r) == [], render_text(r)
+    # the reasoned epoch-time suppression is counted, not active
+    assert any(f.rule == "R2" for f in r.suppressed)
+
+
+def test_rule_filter_scopes_the_run():
+    r = run_lint(root=VIOLATIONS, rules=["R3"])
+    assert {f.rule for f in r.active} == {"R3"}
+
+
+# ---------------------------------------------------------------------------
+# suppression audit: reason mandatory, staleness fails
+# ---------------------------------------------------------------------------
+
+def test_reasoned_suppression_suppresses_and_is_counted():
+    r = run_lint(root=SUPPRESS, rules=["R2"])
+    suppressed_lines = {f.line for f in r.suppressed}
+    assert 17 in suppressed_lines           # reasoned() wall-clock read
+    # the reasoned site is NOT active
+    assert all(f.line != 17 for f in _active(r, "R2"))
+
+
+def test_bare_suppression_is_an_s1_finding_and_does_not_suppress():
+    r = run_lint(root=SUPPRESS, rules=["R2"])
+    s1 = _active(r, "S1")
+    assert len(s1) == 1 and s1[0].line == 21
+    # the un-reasoned disable does NOT suppress: the violation stays live
+    assert any(f.line == 21 for f in _active(r, "R2"))
+
+
+def test_stale_suppression_is_an_s2_finding():
+    r = run_lint(root=SUPPRESS, rules=["R2"])
+    s2 = _active(r, "S2")
+    assert len(s2) == 1 and s2[0].line == 25
+    assert "stale" in s2[0].message
+
+
+def test_rule_scoped_run_does_not_call_other_rules_suppressions_stale():
+    # an R3-only pass must not flag the R2 suppressions as stale
+    r = run_lint(root=SUPPRESS, rules=["R3"])
+    assert _active(r, "S2") == []
+
+
+# ---------------------------------------------------------------------------
+# baseline: round-trip, determinism, staleness
+# ---------------------------------------------------------------------------
+
+def test_baseline_round_trip_makes_the_repo_green(tmp_path):
+    bl = str(tmp_path / "baseline.txt")
+    first = run_lint(root=VIOLATIONS, baseline_path=bl,
+                     update_baseline=True)
+    assert _active(first, "R1") == [] and first.baselined
+    second = run_lint(root=VIOLATIONS, baseline_path=bl)
+    assert [f for f in second.active if f.rule.startswith("R")] == [], \
+        render_text(second)
+    assert len(second.baselined) == len(first.baselined)
+
+
+def test_update_baseline_is_deterministic_sorted_and_path_relative(tmp_path):
+    b1, b2 = str(tmp_path / "b1.txt"), str(tmp_path / "b2.txt")
+    run_lint(root=VIOLATIONS, baseline_path=b1, update_baseline=True)
+    run_lint(root=VIOLATIONS, baseline_path=b2, update_baseline=True)
+    c1, c2 = open(b1).read(), open(b2).read()
+    assert c1 == c2, "regeneration must be byte-identical"
+    entries = [ln for ln in c1.splitlines()
+               if ln.strip() and not ln.startswith("#")]
+    assert entries == sorted(entries)
+    assert not any(os.path.isabs(e.split("|")[1]) for e in entries)
+    assert not any(VIOLATIONS in e for e in entries)
+
+
+def test_stale_baseline_entry_is_a_b1_finding(tmp_path):
+    bl = tmp_path / "baseline.txt"
+    bl.write_text(
+        "R2|incubator_predictionio_tpu/gone.py|Nope.never|t = time.time()\n")
+    r = run_lint(root=CLEAN, baseline_path=str(bl))
+    b1 = _active(r, "B1")
+    assert len(b1) == 1 and "stale baseline entry" in b1[0].message
+
+
+def test_scoped_update_baseline_retains_other_rules_entries(tmp_path):
+    """`--rule R3 --update-baseline` must not silently delete the
+    accepted R1 debt it never re-checked (review finding, regression)."""
+    bl = str(tmp_path / "baseline.txt")
+    run_lint(root=VIOLATIONS, baseline_path=bl, update_baseline=True)
+    before = {ln for ln in open(bl).read().splitlines()
+              if ln.startswith("R1|")}
+    assert before
+    run_lint(root=VIOLATIONS, rules=["R3"], baseline_path=bl,
+             update_baseline=True)
+    content = open(bl).read()
+    after = {ln for ln in content.splitlines() if ln.startswith("R1|")}
+    assert after == before, "R1 entries dropped by an R3-scoped update"
+    # and the merged file still makes the full run green
+    r = run_lint(root=VIOLATIONS, baseline_path=bl)
+    assert [f for f in r.active if f.rule.startswith("R")] == []
+
+
+def test_cli_json_stdout_is_pure_json_even_with_update_baseline(tmp_path,
+                                                                capsys):
+    from incubator_predictionio_tpu.tools.cli import main as cli_main
+
+    bl = str(tmp_path / "bl.txt")
+    assert cli_main(["lint", "--root", VIOLATIONS, "--json",
+                     "--baseline", bl, "--update-baseline"]) == 0
+    captured = capsys.readouterr()
+    doc = json.loads(captured.out)  # must parse as ONE json document
+    assert doc["counts"]["baselined"] > 0
+    assert "baseline updated" in captured.err
+
+
+def test_meta_findings_are_never_baselineable(tmp_path):
+    bl = str(tmp_path / "baseline.txt")
+    run_lint(root=SUPPRESS, baseline_path=bl, update_baseline=True)
+    content = open(bl).read()
+    assert "S1|" not in content and "S2|" not in content
+    # ... so after accepting the baseline the S1/S2 audit still fails
+    r = run_lint(root=SUPPRESS, baseline_path=bl)
+    assert _active(r, "S1") and _active(r, "S2")
+
+
+# ---------------------------------------------------------------------------
+# CLI: exit codes + --json schema
+# ---------------------------------------------------------------------------
+
+def test_cli_exit_codes_and_json_schema(capsys):
+    from incubator_predictionio_tpu.tools.cli import main as cli_main
+
+    assert cli_main(["lint", "--root", CLEAN, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["version"] == 1 and doc["clean"] is True
+    assert set(doc["counts"]) == {"active", "suppressed", "baselined"}
+    assert doc["rules"].keys() == RULES_BY_ID.keys()
+    for f in doc["suppressed"]:
+        assert set(f) == {"rule", "path", "line", "scope", "message",
+                          "hint", "suppressed", "baselined"}
+
+    assert cli_main(["lint", "--root", VIOLATIONS]) == 1
+    out = capsys.readouterr().out
+    assert "FAIL" in out and "R1" in out and "hint:" in out
+
+    assert cli_main(["lint", "--root", CLEAN, "--rule", "R9"]) == 2
+
+
+def test_cli_rule_filter_and_update_baseline(tmp_path, capsys):
+    from incubator_predictionio_tpu.tools.cli import main as cli_main
+
+    bl = str(tmp_path / "bl.txt")
+    assert cli_main(["lint", "--root", VIOLATIONS, "--rule", "R5",
+                     "--baseline", bl, "--update-baseline"]) == 0
+    out = capsys.readouterr().out
+    assert "baseline updated" in out
+    assert cli_main(["lint", "--root", VIOLATIONS, "--rule", "R5",
+                     "--baseline", bl]) == 0
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 contract: the REAL repo is clean, and drift fails
+# ---------------------------------------------------------------------------
+
+def test_repo_is_lint_clean():
+    """The acceptance bar: `pio-tpu lint` exits 0 on this repo, with
+    zero unexplained suppressions (S1 is a finding) and zero stale
+    exceptions (S2/B1/dead-allowlist are findings)."""
+    r = run_lint(root=REPO)
+    assert r.files_scanned > 100
+    assert _active(r) == [], "\n" + render_text(r)
+
+
+def test_fixture_trees_are_excluded_from_the_real_run():
+    r = run_lint(root=REPO)
+    everything = r.active + r.suppressed + r.baselined
+    assert not any("lint_cases" in f.path for f in everything)
+
+
+def _copy_repo_skeleton(tmp_path):
+    """A minimal real-repo copy for drift-injection: package docs + the
+    few files the knob crossref needs (full copies are too slow)."""
+    root = tmp_path / "repo"
+    (root / "docs").mkdir(parents=True)
+    for rel in ("docs/configuration.md", "docs/config_allowlist.txt",
+                "docs/observability.md", "docs/metrics_allowlist.txt"):
+        shutil.copy(os.path.join(REPO, rel), root / rel)
+    pkg = root / "incubator_predictionio_tpu"
+    pkg.mkdir()
+    return root
+
+
+def test_deleting_a_documented_knob_row_fails_the_lint(tmp_path):
+    """Acceptance: deleting any configuration.md knob row makes the
+    tier-1 lint test fail — proven against the REAL code surface: the
+    real env-read scan vs the real docs minus one row."""
+    from incubator_predictionio_tpu.analysis.rules import r4_knobs
+
+    code = r4_knobs.knob_code_names(REPO)
+    docs = r4_knobs.knob_doc_names(REPO)
+    allow = crossref.load_allowlist(
+        os.path.join(REPO, r4_knobs.KNOB_ALLOWLIST))
+    assert crossref.cross_reference(code, docs, allow).clean
+    victim = "PIO_EVENT_WAL_DIR"
+    assert any(n.text == victim for n in code)
+    doctored = [d for d in docs if d.text != victim]
+    res = crossref.cross_reference(code, doctored, allow)
+    assert victim in {n.text for n in res.undocumented}
+
+
+def test_adding_an_undocumented_pio_read_fails_the_lint(tmp_path):
+    root = _copy_repo_skeleton(tmp_path)
+    mod = root / "incubator_predictionio_tpu" / "sneaky.py"
+    mod.write_text(
+        "import os\n"
+        "LIMIT = int(os.environ.get('PIO_TOTALLY_NEW_KNOB', '1'))\n")
+    r = run_lint(root=str(root), rules=["R4"])
+    hits = [f for f in _active(r, "R4")
+            if "PIO_TOTALLY_NEW_KNOB" in f.message]
+    assert len(hits) == 1
+    assert hits[0].path == "incubator_predictionio_tpu/sneaky.py"
+
+
+def test_dead_allowlist_entry_fails_the_lint(tmp_path):
+    root = _copy_repo_skeleton(tmp_path)
+    allow = root / "docs" / "config_allowlist.txt"
+    allow.write_text(open(allow).read() + "PIO_NEVER_ANYWHERE\n")
+    r = run_lint(root=str(root), rules=["R4"])
+    assert any("PIO_NEVER_ANYWHERE" in f.message
+               for f in _active(r, "R4"))
+
+
+# ---------------------------------------------------------------------------
+# crossref engine unit coverage (the shared metrics/knobs core)
+# ---------------------------------------------------------------------------
+
+def test_env_read_extraction_understands_every_project_idiom(tmp_path):
+    src = '''
+import os
+from os import environ
+
+ENV_KEY = "PIO_CONST_KEY"
+e = os.environ.get
+
+def _float_env(name, default):
+    v = os.environ.get(name)
+    return float(v) if v else default
+
+direct = os.environ.get("PIO_DIRECT")
+getenv = os.getenv("PIO_GETENV")
+sub = os.environ["PIO_SUBSCRIPT"]
+aliased = e("PIO_ALIASED", "1")
+const = os.environ.get(ENV_KEY)
+wrapped = _float_env("PIO_WRAPPED", 1.0)
+pattern = os.environ.get(f"PIO_PREFIX_{direct}")
+not_env = print("PIO_NOT_A_READ")
+'''
+    import ast
+    reads = crossref.scan_env_reads(ast.parse(src))
+    exact = {t for t, p, _ in reads if not p}
+    prefixes = {t for t, p, _ in reads if p}
+    assert exact == {"PIO_DIRECT", "PIO_GETENV", "PIO_SUBSCRIPT",
+                     "PIO_ALIASED", "PIO_CONST_KEY", "PIO_WRAPPED"}
+    assert prefixes == {"PIO_PREFIX_"}
+
+
+def test_prefix_rows_cover_concrete_reads_both_ways():
+    code = [crossref.Name("PIO_RESILIENCE_", prefix=True, where="p.py:1")]
+    docs = crossref.doc_names(
+        "| `PIO_RESILIENCE_<KEY>` | per key | process default |\n",
+        "PIO_", "cfg.md")
+    assert docs[0].prefix and docs[0].text == "PIO_RESILIENCE_"
+    assert crossref.cross_reference(code, docs).clean
+    # a concrete documented name under a code prefix is covered too
+    docs2 = [crossref.Name("PIO_RESILIENCE_RETRY_MAX", where="cfg.md:3")]
+    assert crossref.cross_reference(code, docs2).clean
+
+
+def test_doc_rows_only_count_tables_not_prose():
+    text = ("prose mention of `PIO_IN_PROSE` does not count\n"
+            "| `PIO_IN_TABLE` | x | y |\n")
+    names = {n.text for n in crossref.doc_names(text, "PIO_")}
+    assert names == {"PIO_IN_TABLE"}
+
+
+def test_every_rule_has_id_title_and_hint():
+    assert [r.id for r in ALL_RULES] == ["R1", "R2", "R3", "R4", "R5"]
+    for r in ALL_RULES:
+        assert r.title and r.hint
+
+
+def test_render_json_round_trips():
+    r = run_lint(root=SUPPRESS)
+    doc = json.loads(render_json(r))
+    assert doc["clean"] is False
+    assert doc["counts"]["active"] == len(r.active)

@@ -1,82 +1,75 @@
-"""Metrics ↔ docs parity meta-test (ISSUE 14 satellite).
+"""Metrics ↔ docs parity meta-test (ISSUE 14 satellite; ISSUE 15 moved
+the implementation onto the shared cross-reference engine).
 
 The metric tables in docs/observability.md were hand-maintained for 12
-PRs; nothing ever checked them. This test statically greps the package
-for every registered ``pio_*`` metric name (the ``REGISTRY.counter/
-gauge/histogram("pio_...")`` idiom — names are literal by convention so
-dashboards can grep for them) and asserts the set matches the documented
-rows, in BOTH directions. Intentional exceptions go in
-docs/metrics_allowlist.txt.
+PRs; nothing ever checked them. These tests assert the registered
+``pio_*`` set matches the documented rows in BOTH directions, with
+intentional exceptions in docs/metrics_allowlist.txt — and since ISSUE
+15 they are one instantiation of
+:mod:`incubator_predictionio_tpu.analysis.crossref`, the same engine
+that checks ``PIO_*`` knobs against docs/configuration.md (the R4 rule
+of ``pio-tpu lint``, which runs this exact check too). The test ids
+predate the refactor and are kept stable.
 """
 
 import os
-import re
+
+from incubator_predictionio_tpu.analysis import crossref
+from incubator_predictionio_tpu.analysis.rules import r4_knobs
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PKG = os.path.join(REPO, "incubator_predictionio_tpu")
-DOC = os.path.join(REPO, "docs", "observability.md")
-ALLOWLIST = os.path.join(REPO, "docs", "metrics_allowlist.txt")
-
-#: a registration call whose first argument is a pio_* string literal
-#: (possibly on the next line — the dominant style in this codebase)
-_REGISTRATION_RE = re.compile(
-    r'\.(?:counter|gauge|histogram)\(\s*\n?\s*"(pio_[a-z0-9_]+)"')
-#: a backticked metric name inside a markdown table row
-_DOC_NAME_RE = re.compile(r"`(pio_[a-z0-9_]+)")
 
 
 def registered_names() -> set:
-    names = set()
-    for dirpath, _, files in os.walk(PKG):
-        for fname in files:
-            if not fname.endswith(".py"):
-                continue
-            with open(os.path.join(dirpath, fname)) as f:
-                names.update(_REGISTRATION_RE.findall(f.read()))
-    assert names, "registration grep found nothing — regex rotted?"
+    names = {n.text for n in r4_knobs.metric_code_names(REPO)}
+    assert names, "registration scan found nothing — idiom rotted?"
     return names
 
 
 def documented_names() -> set:
-    names = set()
-    with open(DOC) as f:
-        for line in f:
-            # only TABLE rows count as documentation; prose mentions
-            # (example PromQL, label snippets) are not the contract
-            if line.lstrip().startswith("|"):
-                names.update(_DOC_NAME_RE.findall(line))
+    names = {n.text for n in r4_knobs.metric_doc_names(REPO)}
     assert names, "no metric rows found in docs/observability.md"
     return names
 
 
 def allowlisted() -> set:
-    out = set()
-    with open(ALLOWLIST) as f:
-        for line in f:
-            line = line.split("#", 1)[0].strip()
-            if line:
-                out.add(line)
-    return out
+    return set(crossref.load_allowlist(
+        os.path.join(REPO, r4_knobs.METRIC_ALLOWLIST)))
+
+
+def _result() -> crossref.CrossRefResult:
+    return crossref.cross_reference(
+        r4_knobs.metric_code_names(REPO),
+        r4_knobs.metric_doc_names(REPO),
+        allowlisted())
 
 
 def test_every_registered_metric_is_documented():
-    missing = registered_names() - documented_names() - allowlisted()
+    missing = sorted(n.text for n in _result().undocumented)
     assert not missing, (
         "registered but undocumented metrics (add a row to the "
         "docs/observability.md table, or — sparingly — an entry in "
-        f"docs/metrics_allowlist.txt): {sorted(missing)}")
+        f"docs/metrics_allowlist.txt): {missing}")
 
 
 def test_every_documented_metric_is_registered():
-    stale = documented_names() - registered_names() - allowlisted()
+    stale = sorted(n.text for n in _result().stale_docs)
     assert not stale, (
         "documented metrics no longer registered anywhere (drop the row "
-        f"or fix the name): {sorted(stale)}")
+        f"or fix the name): {stale}")
 
 
 def test_allowlist_entries_are_live():
     """An allowlist entry for a name that parity would pass anyway is
     stale noise — the file must shrink back when a debt is repaid."""
+    dead = _result().dead_allowlist
+    assert not dead, f"allowlist entries no longer needed: {dead}"
+
+
+def test_same_engine_as_the_lint_rule():
+    """The refactor's point: ONE implementation. The R4 lint rule and
+    this test must observe the identical metric surface."""
     reg, doc = registered_names(), documented_names()
-    dead = {n for n in allowlisted() if (n in reg) == (n in doc)}
-    assert not dead, f"allowlist entries no longer needed: {sorted(dead)}"
+    assert reg and doc
+    # sanity overlap: the surfaces describe the same system
+    assert len(reg & doc) > 50
