@@ -1622,6 +1622,221 @@ def bench_fleet(ctx) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# 7c'. sharded fleet (docs/sharding.md "Multi-host shard owners"): the
+#      catalog split ACROSS processes — scatter/gather parity cost vs one
+#      process holding everything, plus failover MTTR when an owner takes
+#      a SIGKILL
+# ---------------------------------------------------------------------------
+
+
+def bench_sharded_fleet(ctx) -> dict:
+    """Train once, deploy the catalog two ways — ONE process holding every
+    item row, and THREE shard-owner subprocesses behind the scatter/gather
+    router — and measure what the split costs and what it buys:
+
+    - **budget proof** (ShardSpec byte accounting): the whole catalog's
+      training residency exceeds the per-process ``PIO_SHARD_HBM_BUDGET``
+      the owners boot under; each owner's slice fits. The split is the
+      only deploy shape that serves this catalog at that budget.
+    - **latency**: client-observed p50/p95 through the router's fan-out +
+      merge vs the single process, same queries — the bounded cost of
+      going multi-host. Every sharded answer is checked against the
+      single-process oracle (``wrong_answers`` must stay 0).
+    - **failover MTTR**: SIGKILL one owner mid-traffic and restart it from
+      its state dir; clock from the kill to the first degraded-but-flagged
+      answer and to the first full oracle-exact answer. Partial-policy
+      metric deltas from the router ride along."""
+    import tempfile
+    import urllib.error
+    import urllib.request
+
+    from incubator_predictionio_tpu.data.storage import Storage, use_storage
+    from incubator_predictionio_tpu.parallel.launcher import free_port
+    from incubator_predictionio_tpu.sharding.table import ShardSpec
+    from tests.fixtures.procs import ServerProc, ShardOwnerProc
+
+    n_users, n_items = 1200, 900
+    n_events = 4_000 if SMALL else 16_000
+    n_lat = 40 if SMALL else 120
+    n_shards = 3
+    rank = 32
+    tmp = tempfile.mkdtemp(prefix="pio-bench-shardfleet-")
+    store_cfg = {
+        "PIO_STORAGE_SOURCES_SQ_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_SQ_PATH": os.path.join(tmp, "store.db"),
+    }
+    storage = Storage(store_cfg)
+    prev = use_storage(storage)
+    try:
+        variant_path = _train_recommendation(
+            ctx, storage, tmp, n_users, n_items, n_events)
+    finally:
+        use_storage(prev)
+        storage.close()
+
+    # -- budget proof: byte accounting from the authoritative layout ----
+    # items shard across owners; the user table replicates to every owner
+    # (deltas for user rows ship everywhere — docs/sharding.md)
+    item_spec = ShardSpec("item", n_items, rank + 1, n_shards)
+    one_proc = ShardSpec("item", n_items, rank + 1, 1)
+    user_bytes = ShardSpec("user", n_users, rank + 1, 1).train_bytes_per_shard()
+    whole_catalog = one_proc.train_bytes_per_shard() + user_bytes
+    per_owner = item_spec.train_bytes_per_shard() + user_bytes
+    # a budget one owner fits under but the whole catalog does not
+    budget = (whole_catalog + per_owner) // 2
+    assert per_owner <= budget < whole_catalog
+
+    def post(url: str, body: dict, timeout: float = 15.0):
+        req = urllib.request.Request(
+            url, data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return (resp.status,
+                        {k.lower(): v for k, v in resp.headers.items()},
+                        json.loads(resp.read()))
+        except urllib.error.HTTPError as e:
+            try:
+                body_out = json.loads(e.read())
+            except Exception:  # noqa: BLE001 - non-JSON error body
+                body_out = None
+            return e.code, {k.lower(): v for k, v in e.headers.items()}, \
+                body_out
+
+    oport = free_port()
+    owner_ports = [free_port() for _ in range(n_shards)]
+    rport = free_port()
+    oracle_url = f"http://127.0.0.1:{oport}"
+    owner_urls = [f"http://127.0.0.1:{p}" for p in owner_ports]
+    router_q = f"http://127.0.0.1:{rport}/queries.json"
+    owner_env = {**store_cfg, "PIO_SHARD_HBM_BUDGET": str(budget)}
+
+    def _owner(s: int) -> ShardOwnerProc:
+        return ShardOwnerProc(
+            s, n_shards, os.path.join(tmp, f"owner{s}"),
+            ["-v", variant_path, "--ip", "127.0.0.1",
+             "--port", str(owner_ports[s]), "--server-access-key", "sk"],
+            env=owner_env)
+
+    def _router_health() -> dict:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{rport}/health", timeout=5.0) as resp:
+            return json.loads(resp.read())
+
+    def _router_metrics() -> dict:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{rport}/metrics", timeout=5.0) as resp:
+            return _metrics_snapshot(resp.read().decode())
+
+    def lane_lat(url: str, queries: list) -> dict:
+        lat = []
+        for q in queries:
+            t0 = time.perf_counter()
+            st, _h, _b = post(url, q)
+            lat.append((time.perf_counter() - t0) * 1e3)
+            assert st == 200, st
+        lat.sort()
+        return {"p50_ms": round(lat[len(lat) // 2], 2),
+                "p95_ms": round(lat[int(len(lat) * 0.95)], 2)}
+
+    oracle = ServerProc(["deploy", "-v", variant_path, "--ip", "127.0.0.1",
+                         "--port", str(oport)], env=store_cfg)
+    owners = [_owner(s) for s in range(n_shards)]
+    router = ServerProc(
+        ["fleet", "route", "--ip", "127.0.0.1", "--port", str(rport),
+         "--health-interval", "0.3", "--probe-timeout", "1.0",
+         "--deadline", "3.0", "--server-access-key", "sk",
+         *[a for u in owner_urls for a in ("--replica", u)]],
+        env=dict(store_cfg))
+    try:
+        oracle.wait_ready(f"{oracle_url}/", timeout=240.0)
+        for url, o in zip(owner_urls, owners):
+            o.wait_ready(f"{url}/", timeout=240.0)
+        router.wait_ready(f"http://127.0.0.1:{rport}/")
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            h = _router_health()
+            sh = h.get("sharding") or {}
+            if sh.get("nRanges") == n_shards and not sh.get("downRanges"):
+                break
+            time.sleep(0.2)
+        else:
+            raise TimeoutError("router never adopted the shard topology")
+
+        queries = [{"user": f"u{u}", "num": 10}
+                   for u in range(min(n_lat, n_users))]
+        oracle_ans = {}
+        for q in queries:
+            st, _h, body = post(f"{oracle_url}/queries.json", q)
+            assert st == 200, st
+            oracle_ans[q["user"]] = body["itemScores"]
+
+        # -- latency lanes (and bitwise parity along the way) -----------
+        single = lane_lat(f"{oracle_url}/queries.json", queries)
+        wrong = 0
+        for q in queries:
+            st, hdrs, body = post(router_q, q)
+            assert st == 200 and hdrs.get("x-pio-fleet-sharded") == \
+                str(n_shards), (st, hdrs)
+            if body["itemScores"] != oracle_ans[q["user"]]:
+                wrong += 1
+        sharded = lane_lat(router_q, queries)
+
+        # -- failover MTTR: SIGKILL owner 1, restart from its state dir --
+        m_before = _router_metrics()
+        victim = 1
+        owners[victim].kill9()
+        t_kill = time.monotonic()
+        owners[victim] = _owner(victim)
+        t_degraded = t_full = None
+        probe_i = 0
+        deadline = time.monotonic() + 240.0
+        while time.monotonic() < deadline and t_full is None:
+            q = queries[probe_i % len(queries)]
+            probe_i += 1
+            try:
+                st, hdrs, body = post(router_q, q, timeout=10.0)
+            except Exception:  # noqa: BLE001 - connection reset mid-kill
+                continue
+            now = time.monotonic()
+            if st == 200 and "x-pio-partial" in hdrs:
+                if t_degraded is None:
+                    t_degraded = now - t_kill
+            elif st == 200:
+                if body["itemScores"] == oracle_ans[q["user"]]:
+                    t_full = now - t_kill
+            time.sleep(0.02)
+        assert t_full is not None, "fleet never recovered a full answer"
+        m_after = _router_metrics()
+
+        return {
+            "n_shards": n_shards,
+            "hbm_budget_bytes": int(budget),
+            "whole_catalog_bytes": int(whole_catalog),
+            "per_owner_bytes": int(per_owner),
+            "catalog_fits_one_process": bool(whole_catalog <= budget),
+            "owner_fits_budget": bool(per_owner <= budget),
+            "single_p50_ms": single["p50_ms"],
+            "single_p95_ms": single["p95_ms"],
+            "sharded_p50_ms": sharded["p50_ms"],
+            "sharded_p95_ms": sharded["p95_ms"],
+            "fanout_p50_cost": round(
+                sharded["p50_ms"] / max(single["p50_ms"], 1e-9), 3),
+            "wrong_answers": wrong,
+            "parity_queries": len(queries),
+            "failover_first_degraded_s": (
+                round(t_degraded, 3) if t_degraded is not None else None),
+            "failover_mttr_s": round(t_full, 3),
+            "router_metrics_delta": _snapshot_delta(m_before, m_after),
+        }
+    finally:
+        router.stop()
+        oracle.stop()
+        for o in owners:
+            o.stop()
+
+
+# ---------------------------------------------------------------------------
 # 7d. storage failover (docs/replication.md): sustained ingest, SIGKILL the
 #     primary storage server, promote the follower — MTTR and zero acked
 #     loss through the quorum-replicated eventlog
@@ -2432,16 +2647,17 @@ def build_result_line(configs: dict, device_info: dict,
 CONFIG_NAMES = ["recommendation", "recommendation_scaled", "classification",
                 "similarproduct", "ecommerce_retrieval", "retrieval_scale",
                 "sharded_serving", "sequential", "serving", "trace_overhead",
-                "overload", "fleet", "ingestion", "ingest_durability",
+                "overload", "fleet", "sharded_fleet",
+                "ingestion", "ingest_durability",
                 "streaming_freshness", "storage_failover",
                 "continuous_training", "disaster_recovery"]
-# "fleet" is device-free too: its replicas are CPU subprocesses (a fleet
-# on one host) — the scenario measures the ROUTER's horizontal scaling,
-# not chip throughput; "sharded_serving" likewise runs on 8 virtual CPU
+# "fleet" and "sharded_fleet" are device-free too: their replicas are CPU
+# subprocesses (a fleet on one host) — the scenarios measure the ROUTER's
+# horizontal scaling and scatter/gather cost, not chip throughput; "sharded_serving" likewise runs on 8 virtual CPU
 # devices (merge/layout architecture, not chip throughput);
 # "continuous_training" measures the control plane's recovery clock, not
 # the chip
-DEVICE_FREE = {"ingestion", "ingest_durability", "fleet",
+DEVICE_FREE = {"ingestion", "ingest_durability", "fleet", "sharded_fleet",
                "streaming_freshness", "storage_failover",
                "sharded_serving", "continuous_training",
                "disaster_recovery"}
@@ -2462,6 +2678,7 @@ def _build_suite(ctx, peaks, device) -> dict:
         "trace_overhead": lambda: bench_trace_overhead(ctx),
         "overload": lambda: bench_overload(ctx),
         "fleet": lambda: bench_fleet(ctx),
+        "sharded_fleet": lambda: bench_sharded_fleet(ctx),
         "ingestion": lambda: bench_ingestion(),
         "ingest_durability": lambda: bench_ingest_durability(),
         "streaming_freshness": lambda: bench_streaming_freshness(),

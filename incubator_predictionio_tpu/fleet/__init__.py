@@ -11,7 +11,13 @@ One query-server process serves one engine; "millions of users" need a
   balancer's replica states, including the ejected-replica probe cycle;
 - :mod:`router` — the async router server: ``/queries.json`` in,
   health-aware replica choice, idempotent retry on a different replica
-  within the request deadline, A/B and shadow experiment routing;
+  within the request deadline, A/B and shadow experiment routing; when
+  replicas announce shard-owner claims, scatter/gather over the shard
+  topology instead of load balancing;
+- :mod:`topology` — the shard-ownership map built from ``/health``
+  claims (docs/sharding.md "Multi-host shard owners"): one live owner
+  per ``[lo, hi)`` row range, epoch fencing of deposed owners, and the
+  down-range accounting behind partial answers;
 - :mod:`rollout` — the fleet rolling-deploy orchestrator driving each
   replica's versioned ``/reload`` + smoke gate + probation hot-swap in
   sequence, halting and rolling the fleet back on a tripped replica;
@@ -31,9 +37,14 @@ from incubator_predictionio_tpu.fleet.rollout import (
     RolloutResult,
     run_rollout,
 )
+from incubator_predictionio_tpu.fleet.topology import (
+    ShardRange,
+    ShardTopology,
+)
 
 __all__ = [
     "Balancer", "Replica", "Experiment", "HealthWatcher",
     "fetch_health", "probe_health_urls",
     "RolloutConfig", "RolloutResult", "run_rollout",
+    "ShardRange", "ShardTopology",
 ]

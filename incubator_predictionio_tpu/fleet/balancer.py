@@ -65,6 +65,14 @@ class Replica:
         self.last_delta_seq: Optional[int] = None   # streaming chain pos
         self.staleness_sec: Optional[float] = None  # model freshness lag
         self.last_probe_ok: Optional[bool] = None
+        # -- multi-host shard ownership (docs/sharding.md) ----------------
+        # /health.deployment.shardOwner: {"shardId", "shardCount",
+        # "epoch", "rows": [lo, hi]}. None = whole-catalog replica.
+        # ``fenced`` is router-side state: True once a HIGHER epoch has
+        # been observed for this replica's shard — a deposed owner must
+        # never contribute rows to a merged answer (fleet/topology.py).
+        self.shard_owner: Optional[dict] = None
+        self.fenced = False
         # -- passive per-request state (router observations) --------------
         self.inflight = 0
         self.lat_ewma: Optional[float] = None
@@ -166,6 +174,17 @@ class Replica:
         stream = dep.get("streaming") or {}
         self.last_delta_seq = stream.get("lastDeltaSeq")
         self.staleness_sec = stream.get("stalenessSeconds")
+        # shard-owner claim: adopt the announced range/epoch; an epoch
+        # BUMP on this replica clears any fence (it re-promoted)
+        owner = dep.get("shardOwner")
+        if isinstance(owner, dict):
+            prev = self.shard_owner or {}
+            if (owner.get("epoch") or 0) > (prev.get("epoch") or 0):
+                self.fenced = False
+            self.shard_owner = owner
+        else:
+            self.shard_owner = None
+            self.fenced = False
         if not self.healthy:
             logger.info("fleet: probe succeeded — re-admitting replica %s",
                         self.url)
@@ -203,6 +222,8 @@ class Replica:
             "engineVersion": self.engine_version,
             "lastDeltaSeq": self.last_delta_seq,
             "stalenessSec": self.staleness_sec,
+            "shardOwner": self.shard_owner,
+            "fenced": self.fenced,
         }
 
 

@@ -78,6 +78,11 @@ _UNROUTABLE = REGISTRY.counter(
 _G_AVAILABLE = REGISTRY.gauge(
     "pio_fleet_replicas_available",
     "Replicas currently routable, by experiment arm", labels=("arm",))
+_PARTIAL = REGISTRY.counter(
+    "pio_fleet_partial_answers_total",
+    "Degraded scatter/gather answers served with one or more shard ranges "
+    "missing (flagged X-PIO-Partial; docs/sharding.md \"Multi-host shard "
+    "owners\")")
 
 #: statuses that mean "this replica cannot take the query right now, but
 #: another one might": the idempotent-retry set. 504 is excluded — the
@@ -125,9 +130,24 @@ class RouterConfig:
     max_outbound: int = dataclasses.field(
         default_factory=lambda: int(
             os.environ.get("PIO_FLEET_MAX_OUTBOUND", "0")))
-    #: guards POST /experiment (and nothing else — queries are open)
+    #: what a scatter/gather answer does when a shard range stays missing
+    #: after retries within the deadline: "degrade" = serve the merged
+    #: answer from the live ranges, flagged ``X-PIO-Partial`` and counted
+    #: in pio_fleet_partial_answers_total; "fail" = 504. Never an
+    #: unflagged short answer (docs/sharding.md).
+    partial_policy: str = dataclasses.field(
+        default_factory=lambda: os.environ.get(
+            "PIO_FLEET_PARTIAL_POLICY", "degrade"))
+    #: guards POST /experiment; also presented as ``accessKey`` when the
+    #: router drives a shard owner's /shard/promote during failover
     server_access_key: Optional[str] = None
     experiment: Optional[Experiment] = None
+
+    def __post_init__(self):
+        if self.partial_policy not in ("degrade", "fail"):
+            raise ValueError(
+                f"PIO_FLEET_PARTIAL_POLICY must be 'degrade' or 'fail', "
+                f"got {self.partial_policy!r}")
 
 
 class RouterServer:
@@ -174,6 +194,13 @@ class RouterServer:
             1 for r in self.balancer.replicas if r.available(now)))
         _G_AVAILABLE.labels(arm=CANDIDATE).set(sum(
             1 for r in self.candidate_balancer.replicas if r.available(now)))
+        topo = self._topology()
+        if topo.is_sharded:
+            topo.down_ranges(now)  # publishes pio_fleet_shard_ranges_down
+        else:
+            from incubator_predictionio_tpu.fleet import topology as _topo
+
+            _topo._G_RANGES_DOWN.set(0)
 
     # -- routes -------------------------------------------------------
     def make_app(self) -> web.Application:
@@ -188,6 +215,7 @@ class RouterServer:
         return app
 
     async def handle_status(self, request: web.Request) -> web.Response:
+        topo = self._topology()
         return web.json_response({
             "status": "alive",
             "requestCount": self.request_count,
@@ -196,6 +224,7 @@ class RouterServer:
             "latencySecPercentiles": self.latency.percentiles(),
             "replicas": self.balancer.snapshot(),
             "candidates": self.candidate_balancer.snapshot(),
+            "sharding": topo.snapshot() if topo.is_sharded else None,
             "experiment": (self.experiment.summary()
                            if self.experiment else None),
             "uptimeSec": self._clock.monotonic() - self._start_time,
@@ -208,12 +237,21 @@ class RouterServer:
         status = self._drain_state.health_status(degraded)
         if not available and not self._drain_state.draining:
             status = "unroutable"
+        topo = self._topology()
+        sharding = None
+        if topo.is_sharded:
+            sharding = topo.snapshot()
+            if sharding["downRanges"] and not self._drain_state.draining:
+                # a shard range with zero live owners means partial (or
+                # failed) answers — red, even while other replicas are up
+                status = "shard-down"
         return web.json_response({
             "status": status,
             "draining": self._drain_state.draining,
             "availableReplicas": len(available),
             "replicas": self.balancer.snapshot(),
             "candidates": self.candidate_balancer.snapshot(),
+            "sharding": sharding,
             "experiment": (self.experiment.summary()
                            if self.experiment else None),
             "retries": self.retry_count,
@@ -300,7 +338,8 @@ class RouterServer:
             return None
 
     async def _post_replica(self, replica: Replica, body: bytes,
-                            headers: dict, timeout_sec: float):
+                            headers: dict, timeout_sec: float,
+                            path: str = "/queries.json"):
         """One forwarding attempt → (status, body, headers). Transport
         errors propagate to the retry loop; the passive balancer signals
         (EWMAs, backoff, ejection) are recorded here either way. Each
@@ -319,7 +358,7 @@ class RouterServer:
                 headers = dict(headers)
                 trace.inject(headers)
                 async with session.post(
-                        replica.url + "/queries.json", data=body,
+                        replica.url + path, data=body,
                         headers=headers,
                         timeout=aiohttp.ClientTimeout(
                             total=timeout_sec)) as resp:
@@ -393,11 +432,207 @@ class RouterServer:
         self._shadow_tasks.add(task)
         task.add_done_callback(self._shadow_tasks.discard)
 
+    # -- shard-owner scatter/gather (docs/sharding.md) -------------------
+    def _topology(self):
+        from incubator_predictionio_tpu.fleet.topology import ShardTopology
+
+        return ShardTopology(self.balancer.replicas, self._clock)
+
+    async def _promote_owner(self, owner: Replica, rng) -> None:
+        """Failover promotion: durably bump a standby's fencing epoch past
+        the highest this router has observed for the range, so the deposed
+        owner's rows can never re-enter a merged answer. Best-effort — a
+        failed promote only delays fencing, never the query."""
+        import aiohttp
+
+        session = await self._session_or_start()
+        key = self.config.server_access_key or ""
+        try:
+            async with session.post(
+                    f"{owner.url}/shard/promote?accessKey={key}",
+                    json={"epoch": rng.max_epoch},
+                    timeout=aiohttp.ClientTimeout(
+                        total=self.config.probe_timeout_sec)) as resp:
+                if resp.status != 200:
+                    return
+                payload = await resp.json()
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # noqa: BLE001 - best-effort
+            return
+        epoch = int(payload.get("epoch") or 0)
+        if epoch > rng.max_epoch:
+            rng.max_epoch = epoch
+        if isinstance(owner.shard_owner, dict):
+            owner.shard_owner["epoch"] = max(
+                epoch, int(owner.shard_owner.get("epoch") or 0))
+        owner.fenced = False
+        logger.warning("fleet: promoted shard owner %s for rows "
+                       "[%d, %d) to epoch %d", owner.url, rng.lo, rng.hi,
+                       epoch)
+
+    async def _fetch_shard(self, topo, rng, body: bytes, headers: dict,
+                           deadline_at: float):
+        """One shard range's partial → ``(partial dict | None,
+        passthrough-response | None)``. Retries on the range's OTHER
+        owners (the failover path) within the deadline; a failed-over-to
+        standby is promoted first so the deposed owner is fenced. Partials
+        carrying a stale epoch are discarded, never merged."""
+        tried: set[str] = set()
+        retry_reason: Optional[str] = None
+        promote_next = False
+        for _attempt in range(max(self.config.max_attempts,
+                                  len(rng.owners))):
+            owner = topo.pick(rng, exclude=tried)
+            if owner is None:
+                break
+            tried.add(owner.url)
+            remaining = deadline_at - self._clock.monotonic()
+            if remaining <= 0:
+                break
+            if retry_reason is not None:
+                _RETRIES.labels(reason=retry_reason).inc()
+                self.retry_count += 1
+                retry_reason = None
+            if promote_next:
+                promote_next = False
+                await self._promote_owner(owner, rng)
+            try:
+                status, payload, resp_headers = await self._post_replica(
+                    owner, body, headers, remaining,
+                    path="/shard/queries.json")
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 - transport failure
+                # the owner is gone (SIGKILL, reset, timeout): the next
+                # pick is a failover — promote it past the dead owner
+                retry_reason = "error"
+                promote_next = True
+                continue
+            if status == 200:
+                try:
+                    part = json.loads(payload)
+                    shard = part.get("shard") or {}
+                    epoch = int(shard.get("epoch") or 0)
+                    part["candidates"]["ids"]  # shape check
+                except (ValueError, TypeError, KeyError):
+                    retry_reason = "error"
+                    continue
+                if epoch < rng.max_epoch:
+                    # a deposed owner answered with stale rows — discard
+                    # the partial outright and fence it
+                    topo.fence(owner, rng.max_epoch)
+                    retry_reason = "fenced"
+                    continue
+                if epoch > rng.max_epoch:
+                    rng.max_epoch = epoch
+                    if isinstance(owner.shard_owner, dict):
+                        owner.shard_owner["epoch"] = epoch
+                return part, None
+            if status == 400:
+                # query-semantic rejection: identical on every owner, the
+                # client's error — pass the first one through
+                return None, (status, payload, resp_headers, owner)
+            retry_reason = ("overload" if status in _RETRYABLE_STATUSES
+                            else "error")
+        return None, None
+
+    async def _serve_sharded(self, body: bytes, headers: dict,
+                             topo) -> web.Response:
+        """Scatter a query to one live owner per shard range, merge the
+        partials with ``merge_topk`` (ranges ascending by lo — the
+        shard-major tie discipline), assemble the /queries.json response
+        shape. Missing ranges follow the declared partial policy: degrade
+        (flagged + counted) or fail (504) — never an unflagged short
+        answer."""
+        import numpy as np
+
+        from incubator_predictionio_tpu.serving.topk import merge_topk
+
+        try:
+            query = json.loads(body)
+            if not isinstance(query, dict):
+                raise ValueError("query must be a JSON object")
+        except ValueError as e:
+            return web.json_response(
+                {"message": f"bad query: {e}"}, status=400)
+        self._inflight += 1
+        t0 = self._clock.monotonic()
+        deadline_at = t0 + self.config.deadline_sec
+        try:
+            results = await asyncio.gather(*[
+                self._fetch_shard(topo, rng, body, headers, deadline_at)
+                for rng in topo.ranges])
+            for _part, err in results:
+                if err is not None:
+                    status, payload, resp_headers, owner = err
+                    return self._passthrough(status, payload, resp_headers,
+                                             owner)
+            missing = [rng for rng, (part, _e) in zip(topo.ranges, results)
+                       if part is None]
+            parts = [part for part, _e in results if part is not None]
+            if not parts:
+                self.unroutable_count += 1
+                _UNROUTABLE.inc()
+                return web.json_response(
+                    {"message": "fleet router: no shard owner available "
+                                "for any range (docs/sharding.md)"},
+                    status=503, headers={"Retry-After": "1"})
+            missing_rows = [[rng.lo, rng.hi] for rng in missing]
+            if missing and self.config.partial_policy == "fail":
+                _PARTIAL.inc()
+                return web.json_response({
+                    "message": "fleet router: shard range(s) unavailable "
+                               "and PIO_FLEET_PARTIAL_POLICY=fail",
+                    "missingRows": missing_rows,
+                }, status=504)
+            # merge: candidates arrive ordered by the owners' block-local
+            # chains; ranges are ascending by lo, so the concatenation is
+            # exactly _search_host's shard-major candidate layout. Scores
+            # round-tripped f32→JSON→f64 are cast back to f32 (exact), so
+            # the merge sees the owners' tie structure bit-for-bit.
+            cand_ids = np.concatenate([
+                np.asarray(p["candidates"]["ids"], np.int64)
+                for p in parts])
+            cand_sc = np.concatenate([
+                np.asarray(p["candidates"]["scores"], np.float64)
+                for p in parts]).astype(np.float32)
+            names: dict[int, str] = {}
+            for p in parts:
+                names.update(zip((int(i) for i in p["candidates"]["ids"]),
+                                 p["candidates"]["items"]))
+            num = max(int(p["num"]) for p in parts)
+            if len(cand_ids) and num > 0:
+                ids, sc = merge_topk(cand_ids[None, :], cand_sc[None, :],
+                                     num)
+                item_scores = [
+                    {"item": names[int(i)], "score": float(s)}
+                    for i, s in zip(ids[0], sc[0])]
+            else:
+                item_scores = []
+            out: dict = {"itemScores": item_scores}
+            resp_headers = {"X-PIO-Fleet-Sharded": str(len(parts))}
+            if missing:
+                _PARTIAL.inc()
+                out["partial"] = {"missingRows": missing_rows}
+                resp_headers["X-PIO-Partial"] = ",".join(
+                    f"rows={lo}-{hi}" for lo, hi in missing_rows)
+            dt = self._clock.monotonic() - t0
+            self.request_count += 1
+            self.latency.record(dt)
+            return web.json_response(out, headers=resp_headers)
+        finally:
+            self._inflight -= 1
+
     async def handle_query(self, request: web.Request) -> web.Response:
         if self._drain_state.draining:
             return self._drain_state.reject_response()
         body = await request.read()
         headers = self._forward_headers(request)
+        # shard-owner fleets route by range, not by interchangeable pick
+        topo = self._topology()
+        if topo.is_sharded:
+            return await self._serve_sharded(body, headers, topo)
         exp = self.experiment
         arm = CONTROL
         if exp is not None:

@@ -1,0 +1,167 @@
+"""Shard topology the fleet router scatter/gathers over (docs/sharding.md).
+
+Built fresh from the balancer's replica set on each routing decision —
+the watcher mutates replica state concurrently, and a derived view is
+cheaper than keeping a second structure consistent. A fleet is *sharded*
+when any replica announces ``/health.deployment.shardOwner``; the router
+then fans every query to one live owner per shard range and merges the
+partials (``merge_topk``), instead of treating replicas as
+interchangeable — ejecting the last owner of a range must surface as a
+down range (red fleet health + partial-answer policy), never as traffic
+silently load-balanced onto owners of the *wrong* rows.
+
+Epoch fencing: the highest epoch ever observed per shard id is sticky
+(kept on the ``Replica`` objects via ``fenced``); a replica announcing or
+answering with a lower epoch is a deposed owner restarted with stale
+rows — its partials are discarded and it gets no traffic for the range
+until it re-promotes past the fence.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Iterable, Optional
+
+from incubator_predictionio_tpu.fleet.balancer import Replica
+from incubator_predictionio_tpu.obs.metrics import REGISTRY
+
+logger = logging.getLogger(__name__)
+
+_FENCED = REGISTRY.counter(
+    "pio_fleet_shard_fenced_total",
+    "Shard-owner replicas fenced for announcing or answering with a stale "
+    "epoch (a deposed owner may never contribute rows to a merged answer)",
+    labels=("replica",))
+_G_RANGES_DOWN = REGISTRY.gauge(
+    "pio_fleet_shard_ranges_down",
+    "Shard ranges with zero live (available, unfenced) owners right now — "
+    "any nonzero value means partial or failed answers")
+
+
+class ShardRange:
+    """One shard id's row range and its candidate owners."""
+
+    def __init__(self, shard_id: int, lo: int, hi: int):
+        self.shard_id = shard_id
+        self.lo = lo
+        self.hi = hi
+        self.max_epoch = 0
+        self.owners: list[Replica] = []
+
+    def live_owners(self, now: float) -> list[Replica]:
+        return [r for r in self.owners
+                if r.available(now) and not r.fenced]
+
+    def snapshot(self, now: float) -> dict:
+        return {
+            "shardId": self.shard_id,
+            "rows": [self.lo, self.hi],
+            "maxEpoch": self.max_epoch,
+            "owners": [r.url for r in self.owners],
+            "liveOwners": [r.url for r in self.live_owners(now)],
+        }
+
+
+class ShardTopology:
+    """Derived scatter/gather view over a balancer's replicas."""
+
+    def __init__(self, replicas: Iterable[Replica], clock):
+        self._clock = clock
+        self.ranges: list[ShardRange] = []
+        by_id: dict[int, ShardRange] = {}
+        for r in replicas:
+            owner = r.shard_owner
+            if not isinstance(owner, dict):
+                continue
+            rows = owner.get("rows")
+            sid = owner.get("shardId")
+            if sid is None or not rows or len(rows) != 2:
+                continue
+            sid = int(sid)
+            rng = by_id.get(sid)
+            if rng is None:
+                rng = by_id[sid] = ShardRange(
+                    sid, int(rows[0]), int(rows[1]))
+                self.ranges.append(rng)
+            else:
+                # standby owners restored from the same artifacts announce
+                # the same bounds; a disagreeing announcement means a
+                # mid-resize fleet — take the widest view so no row is
+                # silently unrouted
+                rng.lo = min(rng.lo, int(rows[0]))
+                rng.hi = max(rng.hi, int(rows[1]))
+            epoch = int(owner.get("epoch") or 0)
+            if epoch > rng.max_epoch:
+                rng.max_epoch = epoch
+            rng.owners.append(r)
+        self.ranges.sort(key=lambda g: (g.lo, g.shard_id))
+        # sticky fencing: any owner announcing below its range's max epoch
+        # is deposed until it re-promotes past the fence
+        for rng in self.ranges:
+            for r in rng.owners:
+                epoch = int((r.shard_owner or {}).get("epoch") or 0)
+                if epoch < rng.max_epoch and not r.fenced:
+                    self.fence(r, rng.max_epoch)
+
+    @property
+    def is_sharded(self) -> bool:
+        return bool(self.ranges)
+
+    def fence(self, replica: Replica, max_epoch: int) -> None:
+        """Mark a deposed owner: no traffic, partials discarded, until a
+        health probe shows it re-promoted past ``max_epoch``."""
+        replica.fenced = True
+        _FENCED.labels(replica=replica.url).inc()
+        logger.warning(
+            "fleet: fenced shard owner %s (announced epoch %s < fleet "
+            "max %d for shard %s)", replica.url,
+            (replica.shard_owner or {}).get("epoch"), max_epoch,
+            (replica.shard_owner or {}).get("shardId"))
+
+    def down_ranges(self, now: Optional[float] = None) -> list[ShardRange]:
+        if now is None:
+            now = self._clock.monotonic()
+        down = [g for g in self.ranges if not g.live_owners(now)]
+        _G_RANGES_DOWN.set(len(down))
+        return down
+
+    def pick(self, rng: ShardRange,
+             exclude: Iterable[str] = ()) -> Optional[Replica]:
+        """Least-score live owner of ``rng`` not yet tried this request —
+        the Balancer.pick discipline restricted to one shard range."""
+        now = self._clock.monotonic()
+        skip = set(exclude)
+        best: Optional[Replica] = None
+        best_score = float("inf")
+        for r in rng.live_owners(now):
+            if r.url in skip:
+                continue
+            s = r.score(now)
+            if s < best_score:
+                best, best_score = r, s
+        if best is not None:
+            return best
+        # backoff-relax fallback (Balancer.pick): a 429 burst must not
+        # fabricate a missing shard — fenced/ejected owners stay out
+        for r in rng.owners:
+            if r.url in skip or r.fenced:
+                continue
+            if not (r.healthy and not r.draining):
+                continue
+            s = r.score(now)
+            if s < best_score:
+                best, best_score = r, s
+        return best
+
+    def snapshot(self) -> dict:
+        now = self._clock.monotonic()
+        down = self.down_ranges(now)
+        return {
+            "sharded": True,
+            "nRanges": len(self.ranges),
+            "downRanges": [[g.lo, g.hi] for g in down],
+            "ranges": [g.snapshot(now) for g in self.ranges],
+        }
+
+
+__all__ = ["ShardRange", "ShardTopology"]

@@ -1,1 +1,6 @@
-"""HTTP servers: event ingestion (Event Server) and query serving (Engine Server)."""
+"""HTTP servers: event ingestion (Event Server) and query serving (Engine
+Server). A query server deployed with ``--shard-id/--shard-count`` also
+acts as a multi-host shard owner (:mod:`.shard_owner`): it announces its
+``[lo, hi)`` item-row claim + fencing epoch on ``/health`` and serves
+``/shard/queries.json`` partials for the fleet router's scatter/gather
+(docs/sharding.md "Multi-host shard owners")."""

@@ -207,6 +207,24 @@ class ServerConfig:
     brownout_exit_sec: float = dataclasses.field(
         default_factory=lambda: float(
             os.environ.get("PIO_BROWNOUT_EXIT_SEC", "2.0")))
+    # -- multi-host shard ownership (docs/sharding.md) --------------------
+    # when both are set this process serves only item rows
+    # ShardSpec(n_items, shard_count).shard_bounds(shard_id) via
+    # POST /shard/queries.json partials, announced through
+    # /health.deployment.shardOwner for the fleet router's scatter/gather
+    shard_id: Optional[int] = dataclasses.field(
+        default_factory=lambda: (
+            int(os.environ["PIO_FLEET_SHARD_ID"])
+            if os.environ.get("PIO_FLEET_SHARD_ID") else None))
+    shard_count: Optional[int] = dataclasses.field(
+        default_factory=lambda: (
+            int(os.environ["PIO_FLEET_SHARD_COUNT"])
+            if os.environ.get("PIO_FLEET_SHARD_COUNT") else None))
+    # where the owner's fencing epoch persists (atomic-write discipline);
+    # unset = in-memory epoch only (tests, throwaway owners)
+    shard_state_dir: Optional[str] = dataclasses.field(
+        default_factory=lambda: (
+            os.environ.get("PIO_FLEET_SHARD_STATE_DIR") or None))
 
 
 class DeployedEngine:
@@ -832,6 +850,18 @@ class QueryServer:
         # pin so a rollback restores the matching chain position.
         self._delta_state: Optional[dict] = None
         self._previous_delta_state: Optional[dict] = None
+        # -- multi-host shard ownership (docs/sharding.md) ----------------
+        # fenced claim on a contiguous item-row range; None when this
+        # process serves the whole catalog (the single-host default)
+        self.shard_owner = None
+        if config.shard_id is not None and config.shard_count is not None:
+            from incubator_predictionio_tpu.server.shard_owner import (
+                ShardOwner,
+            )
+
+            self.shard_owner = ShardOwner(
+                config.shard_id, config.shard_count, config.shard_state_dir)
+            self.shard_owner.bind_rows(self._catalog_rows())
         # -- graceful drain (server/lifecycle.py) -------------------------
         self._drain_state = DrainState("query_server")
         self._start_time = self._clock.monotonic()
@@ -883,6 +913,8 @@ class QueryServer:
         app.router.add_get("/health", self.handle_health)
         add_observability_routes(app)
         app.router.add_post("/queries.json", self.handle_query)
+        app.router.add_post("/shard/queries.json", self.handle_shard_query)
+        app.router.add_post("/shard/promote", self.handle_shard_promote)
         app.router.add_post("/reload", self.handle_reload)
         app.router.add_post("/delta", self.handle_delta)
         app.router.add_post("/rollback", self.handle_rollback)
@@ -933,20 +965,118 @@ class QueryServer:
                 # SLO pio-tpu health and the fleet balancer read
                 "streaming": self._streaming_health(),
                 # sharded serving (docs/sharding.md): per-model shard count
-                # + mode, None for single-host models — what `pio-tpu
-                # shards` and fleet tooling read without a full status page
+                # + mode + explicit [lo, hi) row bounds, None for
+                # single-host models — what `pio-tpu shards` and fleet
+                # tooling read without a full status page
                 "sharding": self._sharding_summary(),
+                # multi-host shard ownership: the fenced row-range claim
+                # the fleet router's scatter/gather routes on
+                "shardOwner": (self.shard_owner.announce()
+                               if self.shard_owner is not None else None),
             },
         })
 
     def _sharding_summary(self) -> list:
+        from incubator_predictionio_tpu.sharding.table import ShardSpec
+
         out = []
         for m in self.deployed.models:
             info = m.serving_info() if hasattr(m, "serving_info") else None
             sh = (info or {}).get("sharding")
-            out.append({"nShards": sh["n_shards"], "mode": sh["mode"],
-                        "mergeFanin": sh["merge_fanin"]} if sh else None)
+            if not sh:
+                out.append(None)
+                continue
+            entry = {"nShards": sh["n_shards"], "mode": sh["mode"],
+                     "mergeFanin": sh["merge_fanin"]}
+            items = sh.get("items") or None
+            if items:
+                # explicit per-shard [lo, hi) item-row bounds — routers and
+                # `pio-tpu shards` need ranges, not just counts
+                spec = ShardSpec(items["name"], items["n_rows"],
+                                 items["width"], items["n_shards"])
+                entry["shardIds"] = list(range(spec.n_shards))
+                entry["rows"] = [list(spec.shard_bounds(s))
+                                 for s in range(spec.n_shards)]
+            out.append(entry)
         return out
+
+    def _catalog_rows(self) -> int:
+        """Item-catalog row count of the deployed model — what the shard
+        owner's ``[lo, hi)`` bounds derive from."""
+        for m in self.deployed.models:
+            info = m.serving_info() if hasattr(m, "serving_info") else None
+            if info and info.get("catalog_rows"):
+                return int(info["catalog_rows"])
+        return 0
+
+    async def handle_shard_query(self, request: web.Request) -> web.Response:
+        """One shard owner's PARTIAL answer (docs/sharding.md "Multi-host
+        shard owners"): block-local top-k candidates over the owned item
+        rows only, plus the owner's fenced epoch so the router can discard
+        partials from a deposed owner. Only the fleet router should call
+        this — clients keep using /queries.json."""
+        if self._drain_state.draining:
+            return self._drain_state.reject_response()
+        so = self.shard_owner
+        if so is None or so.bounds() is None:
+            return web.json_response(
+                {"message": "this server is not a shard owner (deploy with "
+                            "--shard-id/--shard-count)"}, status=409)
+        lo, hi = so.bounds()
+        body = await request.read()
+        try:
+            payload = json.loads(body.decode("utf-8"))
+            if not isinstance(payload, dict):
+                raise ValueError("query must be a JSON object")
+        except (ValueError, UnicodeDecodeError) as e:
+            return web.json_response(
+                {"message": f"bad query: {e}"}, status=400)
+        from incubator_predictionio_tpu.server import shard_owner as so_mod
+
+        deployed = self.deployed  # swap-safe snapshot
+        loop = asyncio.get_running_loop()
+        try:
+            part = await loop.run_in_executor(
+                None, so_mod.partial_predict, deployed, payload, lo, hi)
+        except (TypeError, ValueError, KeyError) as e:
+            # query-semantic rejection, same class split as /queries.json
+            return web.json_response(
+                {"message": f"bad query: {e}"}, status=400)
+        except so_mod.ShardOwnerError as e:
+            return web.json_response({"message": str(e)}, status=409)
+        return web.json_response({
+            "candidates": {"ids": part["ids"], "scores": part["scores"],
+                           "items": part["items"]},
+            "num": part["num"],
+            "shard": {**so.announce(),
+                      "instanceId": deployed.instance.id},
+        })
+
+    async def handle_shard_promote(self, request: web.Request) -> web.Response:
+        """Failover promotion: durably bump this owner's fencing epoch
+        (persist-then-announce, the replication/manager.py invariant) so
+        its partials supersede the deposed owner's. The caller may pass
+        ``{"epoch": N}`` — the highest epoch it has observed for the range
+        — to guarantee the promoted owner exceeds it."""
+        if not self._authorized(request):
+            return web.json_response({"message": "Unauthorized"}, status=401)
+        if self.shard_owner is None:
+            return web.json_response(
+                {"message": "this server is not a shard owner"}, status=409)
+        try:
+            body = json.loads((await request.read()) or b"{}")
+        except ValueError:
+            body = {}
+        requested = body.get("epoch") if isinstance(body, dict) else None
+        epoch = self.shard_owner.promote(
+            int(requested) if requested is not None else None)
+        logger.warning("shard owner %d/%d PROMOTED to epoch %d",
+                       self.shard_owner.shard_id,
+                       self.shard_owner.shard_count, epoch)
+        return web.json_response({
+            "status": "promoted", "epoch": epoch,
+            "shard": self.shard_owner.announce(),
+        })
 
     async def handle_status(self, request: web.Request) -> web.Response:
         inst = self.deployed.instance
@@ -1413,6 +1543,10 @@ class QueryServer:
         bound = effective_max_in_flight(self.config, new)
         limit = self._admission.set_max_inflight(bound)
         await self.batcher.resize(limit if limit is not None else bound)
+        if self.shard_owner is not None:
+            # a swapped-in instance may carry a different catalog size —
+            # re-derive the owned [lo, hi) from the same ShardSpec math
+            self.shard_owner.bind_rows(self._catalog_rows())
         self._previous = old
         self._previous_delta_state = (
             dict(self._delta_state) if self._delta_state else None)
@@ -1473,6 +1607,8 @@ class QueryServer:
         bound = effective_max_in_flight(self.config, prev)
         limit = self._admission.set_max_inflight(bound)
         await self.batcher.resize(limit if limit is not None else bound)
+        if self.shard_owner is not None:
+            self.shard_owner.bind_rows(self._catalog_rows())
         self._serving_breaker.record_success()  # clean slate for the restore
         self._rollback_count += 1
         _ROLLBACKS.inc()
@@ -1569,6 +1705,19 @@ class QueryServer:
                            "stream (docs/streaming.md)",
                 "lastDeltaSeq": last,
             }, status=409)
+        # shard owners apply only THEIR slice of the chain's item rows —
+        # the full chain still ships to every owner (seq bookkeeping must
+        # stay contiguous for the range checks above), the restriction
+        # happens at apply time so a foreign owner's rows never land here
+        apply_delta_obj = delta
+        if self.shard_owner is not None:
+            bounds = self.shard_owner.bounds()
+            if bounds is not None:
+                from incubator_predictionio_tpu.streaming.delta import (
+                    restrict_to_item_rows,
+                )
+
+                apply_delta_obj = restrict_to_item_rows(delta, *bounds)
         loop = asyncio.get_running_loop()
 
         def build() -> DeployedEngine:
@@ -1578,7 +1727,7 @@ class QueryServer:
             applied = False
             for m in self.deployed.models:
                 if hasattr(m, "apply_delta"):
-                    m = m.apply_delta(delta)
+                    m = m.apply_delta(apply_delta_obj)
                     applied = True
                 models.append(m)
             if not applied:

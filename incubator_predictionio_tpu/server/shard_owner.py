@@ -1,0 +1,168 @@
+"""Shard-owner identity for multi-host serving (docs/sharding.md).
+
+A query server deployed with ``--shard-id I --shard-count N`` claims the
+contiguous item-row range ``ShardSpec.shard_bounds(I)`` of the deployed
+catalog and answers ``POST /shard/queries.json`` with per-shard top-k
+*partials* instead of full answers. The fleet router discovers the claim
+via ``/health.deployment.shardOwner`` and scatter/gathers over the owners
+(fleet/topology.py); ``merge_topk`` over the partials reproduces the
+single-process answer bitwise (the PR 10 tie discipline).
+
+Fencing follows replication/manager.py: the owner's epoch is persisted
+with the atomic-write discipline BEFORE it is ever announced, and a
+promoted standby always announces a strictly higher epoch — so a deposed
+owner that comes back from a SIGKILL with stale rows is recognizably
+stale (the router discards partials carrying an epoch below the highest
+it has seen for that range) and can never contribute wrong rows to a
+merged answer.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Optional
+
+from incubator_predictionio_tpu.sharding.table import ShardSpec
+from incubator_predictionio_tpu.utils.fs import atomic_write_bytes
+from incubator_predictionio_tpu.utils.json_util import bind_query
+
+_STATE_FILE = "shard-owner.json"
+
+
+class ShardOwnerError(RuntimeError):
+    """Misconfigured or unusable shard-owner state."""
+
+
+class ShardOwner:
+    """One process's fenced claim on a contiguous item-row range.
+
+    The claim is (shard_id, shard_count, epoch); the concrete ``[lo, hi)``
+    row bounds additionally need the deployed catalog size, bound via
+    :meth:`bind_rows` at deploy/swap time so a hot-swap to a grown catalog
+    re-derives the range from the same ShardSpec arithmetic serving uses.
+    """
+
+    def __init__(self, shard_id: int, shard_count: int,
+                 state_dir: Optional[str] = None):
+        if shard_count < 1:
+            raise ShardOwnerError(
+                f"shard count must be >= 1, got {shard_count}")
+        if not (0 <= shard_id < shard_count):
+            raise ShardOwnerError(
+                f"shard id {shard_id} outside [0, {shard_count})")
+        self.shard_id = int(shard_id)
+        self.shard_count = int(shard_count)
+        self.state_dir = state_dir
+        self.epoch = 1
+        self._n_rows: Optional[int] = None
+        self._lock = threading.Lock()
+        if state_dir:
+            self._load_or_init()
+
+    # -- fencing token persistence (manager.py discipline) -----------------
+    def _state_path(self) -> str:
+        assert self.state_dir is not None
+        return os.path.join(self.state_dir, _STATE_FILE)
+
+    def _load_or_init(self) -> None:
+        try:
+            with open(self._state_path(), encoding="utf-8") as f:
+                st = json.load(f)
+        except FileNotFoundError:
+            self._persist()
+            return
+        except (ValueError, OSError) as e:
+            # NEVER guess an epoch from a corrupt fencing token: a deposed
+            # owner re-initialized to epoch 1 could serve stale rows into
+            # merged answers. Same refusal as replication/manager.py.
+            raise ShardOwnerError(
+                f"corrupt shard-owner state at {self._state_path()}: {e}; "
+                "refusing to start with a guessed epoch") from e
+        if (int(st.get("shardId", -1)) != self.shard_id
+                or int(st.get("shardCount", -1)) != self.shard_count):
+            raise ShardOwnerError(
+                f"shard-owner state at {self._state_path()} claims shard "
+                f"{st.get('shardId')}/{st.get('shardCount')} but this "
+                f"process was deployed as {self.shard_id}/{self.shard_count}"
+                " — point --shard-state-dir at the right directory")
+        self.epoch = int(st.get("epoch", 1))
+
+    def _persist(self) -> None:
+        if not self.state_dir:
+            return
+        os.makedirs(self.state_dir, exist_ok=True)
+        atomic_write_bytes(
+            self._state_path(),
+            json.dumps({"shardId": self.shard_id,
+                        "shardCount": self.shard_count,
+                        "epoch": self.epoch}).encode(),
+            durable=True)
+
+    # -- geometry ----------------------------------------------------------
+    def bind_rows(self, n_rows: int) -> None:
+        """(Re)bind the catalog size the bounds derive from."""
+        self._n_rows = int(n_rows)
+
+    def spec(self) -> Optional[ShardSpec]:
+        if self._n_rows is None:
+            return None
+        return ShardSpec("item_owner", self._n_rows, 1, self.shard_count)
+
+    def bounds(self) -> Optional[tuple[int, int]]:
+        """Owned ``[lo, hi)`` item rows, or None before a model is bound."""
+        spec = self.spec()
+        if spec is None:
+            return None
+        return spec.shard_bounds(self.shard_id)
+
+    # -- fenced promotion --------------------------------------------------
+    def promote(self, requested_epoch: Optional[int] = None) -> int:
+        """Bump (and durably persist) the epoch, then return it.
+
+        The persist happens BEFORE the caller can announce the new epoch
+        anywhere — the fencing invariant. A router-driven failover passes
+        the highest epoch it has observed for the range; the result is
+        STRICTLY greater than both that and the owner's current epoch, so
+        a standby promoted over a deposed owner never ties with it (a tie
+        would let the deposed owner's stale partials back into merges)."""
+        with self._lock:
+            self.epoch = max(self.epoch, int(requested_epoch or 0)) + 1
+            self._persist()
+            return self.epoch
+
+    def announce(self) -> dict[str, Any]:
+        """The ``/health.deployment.shardOwner`` block the router routes on."""
+        out: dict[str, Any] = {
+            "shardId": self.shard_id,
+            "shardCount": self.shard_count,
+            "epoch": self.epoch,
+        }
+        b = self.bounds()
+        if b is not None:
+            out["rows"] = [b[0], b[1]]
+            out["nRows"] = self._n_rows
+        return out
+
+
+def partial_predict(deployed, payload: dict, lo: int, hi: int,
+                    num_override: Optional[int] = None) -> dict[str, Any]:
+    """Answer one query against item rows ``[lo, hi)`` only.
+
+    Binds + supplements exactly like the full path, then delegates to the
+    first algorithm exposing ``predict_shard`` (templates/recommendation.py).
+    Returns the wire partial: shard-local top-k candidate ids (GLOBAL row
+    indices), their f32 scores, and resolved item names, ordered by the
+    block-local argpartition→argsort chain so the router-side
+    ``merge_topk`` sees exactly what single-process ``_search_host``
+    would have produced for this block."""
+    query = bind_query(deployed.query_cls, payload)
+    query = deployed.serving.supplement(query)
+    for algo, model in zip(deployed.algorithms, deployed.models):
+        fn = getattr(algo, "predict_shard", None)
+        if callable(fn):
+            return fn(model, query, lo, hi, num_override=num_override)
+    raise ShardOwnerError(
+        "no deployed algorithm supports shard-partial serving "
+        "(predict_shard)")

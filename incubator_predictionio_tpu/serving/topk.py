@@ -33,12 +33,15 @@ def merge_topk(
     cand_ids: np.ndarray, cand_scores: np.ndarray, num: int,
 ) -> tuple[np.ndarray, np.ndarray]:
     """Row-wise top-``num`` over gathered candidate lists (the cross-shard
-    merge of sharded serving): ``cand_ids``/``cand_scores`` are ``[B, C]``
-    with each shard's candidates already best-first and shards concatenated
-    in ascending-row-range order. Runs the same axis-wise
+    merge of sharded serving, and the fleet router's cross-PROCESS fan-in
+    of shard-owner partials — docs/sharding.md "Multi-host shard owners"):
+    ``cand_ids``/``cand_scores`` are ``[B, C]`` with each shard's
+    candidates already best-first and shards concatenated in
+    ascending-row-range order. Runs the same axis-wise
     ``argpartition`` → ``argsort`` chain as :func:`grouped_topk`, so merged
     results match the single-host serial oracle's selection (ids resolve
-    through ``cand_ids``)."""
+    through ``cand_ids``). Callers may pad short candidate lists with
+    ``-inf`` scores; a padded slot can never displace a real candidate."""
     b, c = cand_scores.shape
     num = min(num, c)
     if num <= 0 or b == 0:

@@ -71,6 +71,20 @@ class ModelDelta:
         return True
 
 
+def restrict_to_item_rows(delta: ModelDelta, lo: int, hi: int) -> ModelDelta:
+    """The delta a shard owner for item rows ``[lo, hi)`` actually applies.
+
+    Only ``item_rows`` are owner-partitioned — user rows and cold-start
+    hash buckets are replicated on every owner (cold buckets live in a
+    separate index space and back unknown-user answers on every shard).
+    Seq bookkeeping is untouched: owners apply the SAME chain positions as
+    the full table would, so the exactly-once range checks keep working."""
+    return dataclasses.replace(
+        delta,
+        item_rows={r: v for r, v in delta.item_rows.items()
+                   if lo <= r < hi})
+
+
 def encode_delta(delta: ModelDelta) -> bytes:
     """Self-verifying wire/file form: magic + crc32 + pickle."""
     payload = pickle.dumps(delta, protocol=pickle.HIGHEST_PROTOCOL)

@@ -206,6 +206,14 @@ class StreamUpdater:
                                        from_seq=cursor["seq"])
         self.dead_letter_count = 0
         self.last_result: dict = {}
+        # per-replica chain position (docs/sharding.md "Multi-host shard
+        # owners"): every ship_chain re-reads the REPLICA's own /health and
+        # records its lastDeltaSeq here, keyed by url. Shard owners apply
+        # the same chain positions but restrict rows at apply time; a
+        # freshly promoted standby answers None/behind and gets its OWN
+        # resync — a single global seq would skip (or replay) another
+        # owner's chain after a failover promote.
+        self.owner_seqs: dict[str, Optional[int]] = {}
 
     # -- init helpers -----------------------------------------------------
     def _log_end(self) -> int:
@@ -324,8 +332,10 @@ class StreamUpdater:
                     f"{url}: serves instance {instance}, chain is for "
                     f"{self.instance_id} (deploy/reload the base model "
                     "first)")
+            self.owner_seqs[url] = applied
             paths = deltas.chain_from(self.config.state_dir, applied)
             shipped = deduped = 0
+            last_to = applied
             for path in paths:
                 answer = self.transport.ship(
                     url, open(path, "rb").read())
@@ -337,9 +347,17 @@ class StreamUpdater:
                 else:
                     raise ShipError(f"{url}: delta {os.path.basename(path)} "
                                     f"rejected: {answer}")
+                seq = answer.get("lastDeltaSeq")
+                if seq is not None:
+                    last_to = seq
+            # record where THIS replica's chain now stands — per-owner,
+            # never a fleet-global seq (a failover-promoted standby resyncs
+            # from its own position, not another owner's)
+            self.owner_seqs[url] = last_to
             sp.set_attr("shipped", shipped)
             sp.set_attr("deduped", deduped)
-            return {"url": url, "shipped": shipped, "deduped": deduped}
+            return {"url": url, "shipped": shipped, "deduped": deduped,
+                    "lastDeltaSeq": last_to}
 
     def ship_all(self) -> list[dict]:
         out = []
@@ -475,6 +493,9 @@ class StreamUpdater:
             "deadLettered": self.dead_letter_count,
             "quarantine": self.quarantined,
             "replicas": list(self.config.replicas),
+            # per-replica chain positions from the last resync (None =
+            # replica reported nothing applied yet)
+            "ownerSeqs": dict(self.owner_seqs),
         }
 
 

@@ -522,11 +522,27 @@ class RecModel(PersistentModel):
             self._cold_items_cache = cached
         return cached
 
+    def shard_block(self, lo: int, hi: int):
+        """Cached host ``(item_t [rank, hi-lo], item_bias [hi-lo])`` for an
+        owned item-row block — the ``_HostBlock`` layout sharding/serve.py
+        scores, so a shard owner's partial GEMM is the same expression the
+        single-process block path runs. Invalidates naturally on streaming
+        deltas: ``apply_delta`` builds a NEW RecModel, which starts with no
+        cache."""
+        cached = getattr(self, "_shard_block_cache", None)
+        if cached is not None and cached[0] == (lo, hi):
+            return cached[1]
+        item_emb, item_bias = self._cold_item_table()
+        blk = (np.ascontiguousarray(item_emb[lo:hi].T),
+               np.ascontiguousarray(item_bias[lo:hi]))
+        self._shard_block_cache = ((lo, hi), blk)
+        return blk
+
     def __getstate__(self):
-        # the cold-item-table cache is derived state (possibly a device
-        # pull); never serialize it
+        # the cold-item-table and shard-block caches are derived state
+        # (possibly a device pull); never serialize them
         return {k: v for k, v in self.__dict__.items()
-                if k != "_cold_items_cache"}
+                if k not in ("_cold_items_cache", "_shard_block_cache")}
 
     def warmup(self, max_batch: int = 64) -> int:
         """Pre-compile every serving batch bucket (called at deploy)."""
@@ -642,6 +658,76 @@ class ALSAlgorithm(PAlgorithm):
             ItemScore(inv[int(i)], float(s))
             for i, s in zip(idx, scores) if int(i) not in banned
         ))
+
+    def predict_shard(self, model: RecModel, query: Query, lo: int, hi: int,
+                      num_override: Optional[int] = None) -> dict:
+        """One shard owner's partial answer: top-k over GLOBAL item rows
+        ``[lo, hi)`` only (multi-host serving, docs/sharding.md).
+
+        Reproduces the ``_search_host`` per-block chain exactly — same
+        score expression on the column slice, exclusions localized into the
+        block, ``kl = min(num, n_s)`` argpartition→argsort — so the fleet
+        router's ``merge_topk`` over owners' partials is bitwise the
+        single-process answer, ties included. Non-finite (banned/masked)
+        candidates are dropped here, matching the full path's post-filter;
+        a banned row can never displace a real candidate from the top-kl,
+        so the partial always carries the block's best finite rows."""
+        n_items = model.mf.n_items
+        lo = max(0, min(int(lo), n_items))
+        hi = max(lo, min(int(hi), n_items))
+        num = int(query.num if num_override is None else num_override)
+        num = min(num, n_items)
+        empty = {"ids": [], "scores": [], "items": [], "num": max(num, 0)}
+        if num <= 0 or hi <= lo:
+            return empty
+        k = model.mf.config.rank
+        uidx = model.user_map.get(query.user)
+        if uidx is None:
+            cs = model.coldstart_buckets()
+            if cs is None:
+                # reference behavior: unknown user → empty partial on
+                # every owner → empty merged itemScores
+                return empty
+            row = np.asarray(cs.user_rows[cs.user_bucket(query.user)],
+                             np.float32)
+            q = row[None, :k]
+            ub = np.asarray([row[k]], np.float32)
+        else:
+            mf = model.mf
+            if mf.user_emb is not None:
+                q = np.asarray(mf.user_emb, np.float32)[[uidx]]
+                ub = np.asarray(mf.user_bias, np.float32)[[uidx]]
+            else:
+                import jax
+
+                row = np.asarray(
+                    jax.device_get(mf._tables["ue"][uidx]), np.float32)
+                q = row[None, :k]
+                ub = np.asarray([row[k]], np.float32)
+        item_t, item_bias = model.shard_block(lo, hi)
+        scores = q @ item_t + item_bias[None, :] + ub[:, None] \
+            + model.mf.mean
+        banned = self._banned(model, query)
+        if banned:
+            excl_sorted = np.sort(np.fromiter(banned, np.int64))
+            a, z = np.searchsorted(excl_sorted, (lo, hi))
+            local = excl_sorted[a:z] - lo
+            if len(local):
+                scores[:, local] = -np.inf
+        kl = min(num, hi - lo)
+        part = np.argpartition(-scores, kl - 1, axis=1)[:, :kl]
+        row_i = np.arange(scores.shape[0])[:, None]
+        order = np.argsort(-scores[row_i, part], axis=1)
+        top = np.take_along_axis(part, order, 1)
+        ids = (top + lo)[0]
+        sc = scores[0, top[0]]
+        keep = np.isfinite(sc)
+        ids, sc = ids[keep], sc[keep]
+        inv = model.item_map.inverse()
+        return {"ids": [int(i) for i in ids],
+                "scores": [float(s) for s in sc],
+                "items": [inv[int(i)] for i in ids],
+                "num": num}
 
     def batch_predict(
         self, model: RecModel, queries: Sequence[tuple[int, Query]]

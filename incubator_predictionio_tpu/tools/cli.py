@@ -315,6 +315,12 @@ def cmd_deploy(args, storage: Storage) -> int:
         smoke_queries=tuple(
             json.loads(q) for q in (args.smoke_query or ())),
         reload_probation_sec=args.reload_probation_sec,
+        # unset flags keep the PIO_FLEET_SHARD_* env defaults
+        **{k: v for k, v in (
+            ("shard_id", args.shard_id),
+            ("shard_count", args.shard_count),
+            ("shard_state_dir", args.shard_state_dir),
+        ) if v is not None},
         # unset flags keep the PIO_ADMISSION_* env defaults
         **{k: v for k, v in (
             ("admission_max_queue", args.admission_max_queue),
@@ -949,6 +955,7 @@ def cmd_health(args, storage) -> int:
         args.urls, args.timeout,
         fetch=lambda url, timeout: _fetch_health(url, timeout))
     rows = [_health_row(url, *probed[url]) for url in args.urls]
+    rows.extend(_shard_coverage_rows(args.urls, probed))
     if getattr(args, "stream_state_dir", None):
         rows.append(_quarantine_row(args.stream_state_dir,
                                     args.quarantine_max_age))
@@ -969,6 +976,75 @@ def cmd_health(args, storage) -> int:
                 line += f"  [{r['detail']}]"
             _out(line)
     return 1 if any(r["red"] for r in rows) else 0
+
+
+def _shard_coverage_rows(urls: list, probed: dict) -> list[dict]:
+    """Synthetic fleet rows (the quarantine-row pattern) for multi-host
+    shard ownership (docs/sharding.md "Multi-host shard owners"): one row
+    per announced shard range, RED when the range has zero live owners —
+    those catalog rows can no longer appear in any merged answer, which a
+    per-replica table hides (every surviving replica still looks green).
+    An owner announcing below the range's max epoch is a deposed process
+    restarted with stale rows: counted fenced, never live (the router's
+    epoch-fencing discipline, fleet/topology.py)."""
+    ranges: dict[int, dict] = {}
+    for url in urls:
+        h, _err = probed[url]
+        owner = ((h or {}).get("deployment") or {}).get("shardOwner")
+        if not isinstance(owner, dict):
+            continue
+        rows, sid = owner.get("rows"), owner.get("shardId")
+        if sid is None or not rows or len(rows) != 2:
+            continue
+        g = ranges.setdefault(int(sid), {
+            "lo": int(rows[0]), "hi": int(rows[1]),
+            "max_epoch": 0, "owners": []})
+        g["lo"] = min(g["lo"], int(rows[0]))
+        g["hi"] = max(g["hi"], int(rows[1]))
+        epoch = int(owner.get("epoch") or 0)
+        g["max_epoch"] = max(g["max_epoch"], epoch)
+        g["owners"].append(
+            (url, epoch,
+             h.get("status") == "ok" and not h.get("draining")))
+        g["count"] = max(g.get("count", 0),
+                         int(owner.get("shardCount") or 0))
+    # a shard id whose owners are ALL unreachable never announces at all
+    # — the announced shardCount from the reachable owners reveals the
+    # hole (without it the dead range would silently vanish from the
+    # report, the exact failure this table exists to catch)
+    if ranges:
+        expect = max(g.get("count", 0) for g in ranges.values())
+        for sid in range(expect):
+            if sid not in ranges:
+                ranges[sid] = {"lo": -1, "hi": -1, "max_epoch": 0,
+                               "owners": []}
+    out: list[dict] = []
+    for sid in sorted(ranges, key=lambda s: (ranges[s]["lo"], s)):
+        g = ranges[sid]
+        live = [u for u, e, ok in g["owners"]
+                if ok and e >= g["max_epoch"]]
+        fenced = [u for u, e, _ok in g["owners"] if e < g["max_epoch"]]
+        known = g["lo"] >= 0
+        span = f"{g['lo']}-{g['hi']}" if known else "?"
+        url = f"shard:{sid}:rows={span}"
+        if live:
+            detail = f"live owners: {', '.join(live)}"
+            if fenced:
+                detail += ("; FENCED stale-epoch: " + ", ".join(fenced)
+                           + " (resync + POST /shard/promote to re-admit)")
+            out.append({"url": url, "status": "ok", "red": False,
+                        "detail": detail})
+        else:
+            rows_txt = (f"rows [{g['lo']},{g['hi']})" if known
+                        else "its rows (range unannounced — every owner "
+                             "unreachable)")
+            out.append({
+                "url": url, "status": "no-live-owner", "red": True,
+                "detail": (f"{rows_txt} unservable — promote a standby "
+                           f"(`pio-tpu deploy --shard-id {sid}` + POST "
+                           "/shard/promote) or answers go partial/504 "
+                           "(docs/sharding.md)")})
+    return out
 
 
 def _quarantine_row(state_dir: str, max_age: Optional[float]) -> dict:
@@ -1092,6 +1168,16 @@ def format_shard_stats(models) -> list[str]:
                 f"/shard (real min/max {min(rows)}/{max(rows)}), "
                 f"{_fmt_bytes(t['table_bytes'] // t['n_shards'])} f32/shard, "
                 f"train+adam {_fmt_bytes(t['train_bytes_per_shard'])}/shard")
+        # owned row ranges: which rows [lo, hi) each shard id serves —
+        # the unit of ownership multi-host shard owners announce on
+        # /health.deployment.shardOwner (docs/sharding.md)
+        from incubator_predictionio_tpu.sharding.table import ShardSpec
+
+        spec = ShardSpec(items["name"], items["n_rows"], items["width"],
+                         items["n_shards"])
+        lines.append("  item row ranges: " + "  ".join(
+            f"{s}:[{lo},{hi})" for s, (lo, hi) in
+            ((s, spec.shard_bounds(s)) for s in range(spec.n_shards))))
         lines.append(
             f"  merge fan-in: {info['merge_fanin']} candidates/query "
             f"({info['n_shards']} shards × per-shard top-k, "
@@ -2399,6 +2485,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-adaptive-admission", action="store_true",
                    help="disable the AIMD concurrency limiter "
                         "(PIO_ADMISSION_ADAPTIVE=0 env)")
+    p.add_argument("--shard-id", type=int, default=None,
+                   help="this process owns item-catalog shard N of "
+                        "--shard-count; announced on /health and served "
+                        "via /shard/queries.json (PIO_FLEET_SHARD_ID env "
+                        "— docs/sharding.md \"Multi-host shard owners\")")
+    p.add_argument("--shard-count", type=int, default=None,
+                   help="total shard-owner count the catalog's rows are "
+                        "split across (PIO_FLEET_SHARD_COUNT env)")
+    p.add_argument("--shard-state-dir", default=None,
+                   help="directory persisting this owner's fencing epoch "
+                        "across restarts; a corrupt token refuses startup "
+                        "rather than guess (PIO_FLEET_SHARD_STATE_DIR env)")
     p = sub.add_parser("undeploy")
     p.add_argument("--ip", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8000)

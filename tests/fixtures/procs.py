@@ -122,3 +122,39 @@ class ServerProc:
             self._reader.join(timeout=5.0)  # let the tail land
         with self._out_lock:
             return "".join(self._out_chunks)
+
+
+class ShardOwnerProc(ServerProc):
+    """A ``pio-tpu deploy`` subprocess that owns one item-catalog shard
+    (docs/sharding.md "Multi-host shard owners"): announces
+    ``/health.deployment.shardOwner`` with its ``[lo, hi)`` row range and
+    fencing epoch, serves ``/shard/queries.json`` partials, and persists
+    the epoch in ``state_dir`` so a SIGKILL + restart comes back deposed
+    (stale epoch) rather than amnesiac."""
+
+    def __init__(self, shard_id: int, shard_count: int, state_dir: str,
+                 deploy_args: list[str], env: dict | None = None):
+        self.shard_id = shard_id
+        self.shard_count = shard_count
+        self.state_dir = state_dir
+        super().__init__(
+            ["deploy", *deploy_args,
+             "--shard-id", str(shard_id),
+             "--shard-count", str(shard_count),
+             "--shard-state-dir", state_dir],
+            env=env)
+
+    def announce(self, base_url: str, timeout: float = 5.0) -> dict:
+        """The live shardOwner claim from /health (rows, epoch)."""
+        _status, health = http_json("GET", f"{base_url}/health",
+                                    timeout=timeout)
+        return (health.get("deployment") or {}).get("shardOwner") or {}
+
+    def promote(self, base_url: str, access_key: str,
+                epoch: int | None = None, timeout: float = 5.0):
+        """POST /shard/promote — bump the fencing epoch past a fleet max
+        (what the router does automatically on failover)."""
+        body = {} if epoch is None else {"epoch": epoch}
+        return http_json(
+            "POST", f"{base_url}/shard/promote?accessKey={access_key}",
+            body, timeout=timeout)

@@ -1687,3 +1687,206 @@ def test_trace_plane_sigkill_replica_mid_request_fragments_assemble(
         for p in (router, replica, store):
             if p is not None:
                 p.stop()
+
+
+# ---------------------------------------------------------------------------
+# multi-host shard-owner serving chaos (ISSUE 16): SIGKILL one of three
+# real shard-owner subprocesses mid-storm — zero wrong answers vs the
+# single-process oracle; degraded answers flagged and counted; restart
+# restores full answers and green health
+# ---------------------------------------------------------------------------
+
+
+def _post_query_hdrs(url, body, timeout=10.0):
+    """(status, lowercase-header dict, parsed json) — the storm needs the
+    X-PIO-Partial flag, which http_json drops."""
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(), method="POST",
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return (resp.status,
+                    {k.lower(): v for k, v in resp.headers.items()},
+                    json.loads(resp.read() or b"null"))
+    except urllib.error.HTTPError as e:
+        payload = e.read()
+        try:
+            parsed = json.loads(payload or b"null")
+        except ValueError:
+            parsed = {"raw": payload.decode(errors="replace")}
+        return e.code, {k.lower(): v for k, v in (e.headers or {}).items()}, \
+            parsed
+
+
+def _router_metric(rport: int, name: str) -> float:
+    import urllib.request
+
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{rport}/metrics", timeout=5.0) as resp:
+        text = resp.read().decode()
+    total = 0.0
+    for line in text.splitlines():
+        if line.startswith(name) and " " in line:
+            total += float(line.rsplit(" ", 1)[1])
+    return total
+
+
+def test_sharded_fleet_kill9_owner_mid_storm_zero_wrong_answers(tmp_path):
+    """ISSUE 16 acceptance: three real shard-owner subprocesses behind a
+    real router process; SIGKILL one owner mid-storm. Every UNFLAGGED 200
+    must equal the single-process oracle exactly (merge tie discipline
+    included); answers missing the dead range are flagged X-PIO-Partial
+    with declared missingRows and counted; after the owner restarts (same
+    state dir — its persisted epoch identity survives the SIGKILL) the
+    fleet serves full oracle-exact answers again and health is green."""
+    import threading
+
+    from tests.fixtures.procs import ShardOwnerProc
+
+    storage, store_cfg, variant_path, app_id = \
+        _train_recommendation_eventlog(tmp_path)
+    n_shards = 3
+    oport = free_port()
+    owner_ports = [free_port() for _ in range(n_shards)]
+    rport = free_port()
+    oracle_url = f"http://127.0.0.1:{oport}"
+    owner_urls = [f"http://127.0.0.1:{p}" for p in owner_ports]
+    router_q = f"http://127.0.0.1:{rport}/queries.json"
+
+    def _owner(s: int) -> ShardOwnerProc:
+        return ShardOwnerProc(
+            s, n_shards, str(tmp_path / f"owner{s}"),
+            ["-v", variant_path, "--ip", "127.0.0.1",
+             "--port", str(owner_ports[s]), "--server-access-key", "sk"],
+            env=store_cfg)
+
+    oracle = ServerProc(["deploy", "-v", variant_path, "--ip", "127.0.0.1",
+                         "--port", str(oport)], env=store_cfg)
+    owners = [_owner(s) for s in range(n_shards)]
+    router = None
+    stop = threading.Event()
+    try:
+        oracle.wait_ready(f"{oracle_url}/", timeout=240.0)
+        for url, o in zip(owner_urls, owners):
+            o.wait_ready(f"{url}/", timeout=240.0)
+        # the owners' announced ranges tile the catalog exactly
+        annos = [o.announce(u) for o, u in zip(owners, owner_urls)]
+        spans = sorted(tuple(a["rows"]) for a in annos)
+        n_rows = annos[0]["nRows"]
+        assert spans[0][0] == 0 and spans[-1][1] == n_rows
+        assert all(spans[i][1] == spans[i + 1][0]
+                   for i in range(len(spans) - 1)), spans
+
+        router = _router_proc(store_cfg, owner_urls, rport,
+                              "--server-access-key", "sk")
+        router.wait_ready(f"http://127.0.0.1:{rport}/")
+        # wait for the health watcher to adopt every shardOwner claim
+        _wait_health(rport, lambda h: (h.get("sharding") or {})
+                     .get("nRanges") == n_shards
+                     and not h["sharding"]["downRanges"])
+
+        # the oracle's answers for the whole user universe
+        queries = [{"user": f"u{u}", "num": 5} for u in range(20)]
+        oracle_ans = {}
+        for q in queries:
+            st, _h, body = _post_query_hdrs(
+                f"{oracle_url}/queries.json", q)
+            assert st == 200, (st, body)
+            oracle_ans[q["user"]] = body["itemScores"]
+
+        # steady state: scatter/gather over 3 owners == oracle, bitwise
+        st, hdrs, body = _post_query_hdrs(router_q, queries[0])
+        assert st == 200 and hdrs.get("x-pio-fleet-sharded") == "3"
+        assert body["itemScores"] == oracle_ans["u0"]
+
+        # ---- storm + SIGKILL owner 1 mid-storm -------------------------
+        results: list = []
+
+        def storm(offset: int) -> None:
+            i = offset
+            while not stop.is_set():
+                q = queries[i % len(queries)]
+                try:
+                    out = _post_query_hdrs(router_q, q, timeout=15.0)
+                except Exception:  # noqa: BLE001 - refused/reset/timeout
+                    out = (-1, {}, None)
+                results.append((q["user"], *out))
+                i += 1
+                time.sleep(0.01)
+
+        threads = [threading.Thread(target=storm, args=(k * 5,),
+                                    daemon=True) for k in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(1.5)
+        victim_rows = owners[1].announce(owner_urls[1])["rows"]
+        owners[1].kill9()
+        time.sleep(3.0)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30.0)
+
+        # ---- forensics -------------------------------------------------
+        assert len(results) > 50, "storm produced no meaningful traffic"
+        wrong, partials, failed = [], 0, 0
+        for user, st, hdrs, body in results:
+            if st == 200 and "x-pio-partial" not in hdrs:
+                if body["itemScores"] != oracle_ans[user]:
+                    wrong.append((user, body["itemScores"]))
+            elif st == 200:
+                partials += 1
+                missing = (body.get("partial") or {}).get("missingRows")
+                assert missing, "flagged partial without declared rows"
+                assert list(victim_rows) in [list(m) for m in missing]
+            else:
+                # orderly refusals only — never a silent short answer
+                assert st in (503, 504, -1), (user, st, body)
+                failed += 1
+        assert not wrong, (
+            f"WRONG unflagged answers vs oracle: {wrong[:3]} "
+            f"({len(wrong)} total)")
+        # the dead range was actually exercised: degraded answers exist
+        # (default policy) and the router counted every one
+        assert partials > 0, (
+            f"kill window produced no degraded answers "
+            f"(partials=0, failed={failed}, n={len(results)})")
+        assert _router_metric(
+            rport, "pio_fleet_partial_answers_total") >= partials
+
+        # ---- recovery: restart the owner from its state dir ------------
+        owners[1] = _owner(1)
+        owners[1].wait_ready(f"{owner_urls[1]}/", timeout=240.0)
+        ann = owners[1].announce(owner_urls[1])
+        assert ann["rows"] == victim_rows  # same identity, same slice
+        _wait_health(rport, lambda h: h["status"] == "ok"
+                     and (h.get("sharding") or {}).get("nRanges") == n_shards
+                     and not h["sharding"]["downRanges"])
+        # a promote still works end-to-end (the operator fence-clearing
+        # path) and a promoted owner keeps serving oracle-exact rows
+        st, body = owners[1].promote(owner_urls[1], "sk")
+        assert st == 200 and body["epoch"] >= 2, (st, body)
+        for q in queries[:8]:
+            st, hdrs, body = _post_query_hdrs(router_q, q)
+            assert st == 200 and "x-pio-partial" not in hdrs, (st, hdrs)
+            assert hdrs.get("x-pio-fleet-sharded") == "3"
+            assert body["itemScores"] == oracle_ans[q["user"]]
+
+        # `pio-tpu health` over the owners: green, with per-shard
+        # coverage rows (satellite 1)
+        gate = subprocess.run(
+            [sys.executable, "-m", "incubator_predictionio_tpu.tools.cli",
+             "health", *owner_urls], capture_output=True, text=True,
+            timeout=60)
+        assert gate.returncode == 0, gate.stdout + gate.stderr
+        assert "shard:" in gate.stdout
+    finally:
+        stop.set()
+        if router is not None:
+            router.stop()
+        oracle.stop()
+        for o in owners:
+            o.stop()
+        storage.close()

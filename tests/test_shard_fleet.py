@@ -1,0 +1,926 @@
+"""Multi-host shard-owner serving (ISSUE 16): owner geometry + fenced
+epochs, per-shard partial predict parity with the single-process oracle,
+the router's scatter/gather + failover/fencing/partial-policy machinery
+against stub owner apps, the query server's /shard endpoints, and the
+CLI's shard-coverage health rows.
+
+All fast and in-process (FakeClock, aiohttp TestServer stubs, hand-built
+RecModels) — the SIGKILL-a-real-owner chaos proof lives in
+tests/test_chaos_procs.py under the `slow` marker."""
+
+import asyncio
+import json
+import socket
+
+import numpy as np
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from incubator_predictionio_tpu.data.bimap import BiMap
+from incubator_predictionio_tpu.fleet.router import (
+    _PARTIAL,
+    RouterConfig,
+    RouterServer,
+)
+from incubator_predictionio_tpu.fleet.topology import ShardTopology
+from incubator_predictionio_tpu.models.two_tower import (
+    TwoTowerConfig,
+    TwoTowerModel,
+)
+from incubator_predictionio_tpu.resilience.clock import FakeClock
+from incubator_predictionio_tpu.server.shard_owner import (
+    ShardOwner,
+    ShardOwnerError,
+)
+from incubator_predictionio_tpu.serving.topk import merge_topk
+from incubator_predictionio_tpu.sharding.table import ShardSpec
+from incubator_predictionio_tpu.streaming.delta import (
+    ModelDelta,
+    restrict_to_item_rows,
+)
+from incubator_predictionio_tpu.templates.recommendation import (
+    ALSAlgorithm,
+    ALSAlgorithmParams,
+    Query,
+    RecModel,
+)
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _make_model(n_users=20, n_items=30, rank=8, seed=0) -> RecModel:
+    rng = np.random.default_rng(seed)
+    mf = TwoTowerModel(
+        user_emb=(rng.normal(size=(n_users, rank)) * 0.3).astype(np.float32),
+        item_emb=(rng.normal(size=(n_items, rank)) * 0.3).astype(np.float32),
+        user_bias=np.zeros(n_users, np.float32),
+        item_bias=np.zeros(n_items, np.float32),
+        mean=2.5,
+        config=TwoTowerConfig(rank=rank, learning_rate=0.05, reg=1e-4),
+    )
+    user_map = BiMap({f"u{i}": i for i in range(n_users)})
+    item_map = BiMap({f"i{j}": j for j in range(n_items)})
+    return RecModel(mf, user_map, item_map)
+
+
+def _serial_topk(ids: np.ndarray, scores: np.ndarray, num: int):
+    """The 1-D serial oracle: the exact argpartition→argsort chain
+    merge_topk must reproduce row-wise (ties included)."""
+    num = min(num, len(scores))
+    if num <= 0:
+        return ids[:0], scores[:0]
+    part = np.argpartition(-scores, num - 1)[:num]
+    top = part[np.argsort(-scores[part])]
+    return ids[top], scores[top]
+
+
+# ---------------------------------------------------------------------------
+# satellite 3: owner_of / shard_bounds boundary behavior
+# ---------------------------------------------------------------------------
+
+def test_owner_of_boundaries_and_beyond_padded_range():
+    spec = ShardSpec("items", n_rows=10, width=1, n_shards=4)
+    # rows_per_shard = ceil(10/4) = 3 → bounds clamp at the real catalog
+    assert [spec.shard_bounds(s) for s in range(4)] == [
+        (0, 3), (3, 6), (6, 9), (9, 10)]
+    # every real row has exactly one owner, and edges land correctly
+    for s in range(4):
+        lo, hi = spec.shard_bounds(s)
+        for row in (lo, hi - 1):
+            if lo < hi:
+                assert spec.owner_of(row) == s
+    assert [spec.owner_of(r) for r in range(10)] == \
+        [0, 0, 0, 1, 1, 1, 2, 2, 2, 3]
+    # beyond the real catalog — including the padded tail rows [10, 12)
+    # that exist only as shard padding — is a caller bug, never shard 3
+    for bad in (-1, 10, 11, spec.padded_rows, spec.padded_rows + 5):
+        with pytest.raises(ValueError):
+            spec.owner_of(bad)
+    with pytest.raises(ValueError):
+        spec.shard_bounds(4)
+    with pytest.raises(ValueError):
+        spec.shard_bounds(-1)
+
+
+def test_shard_bounds_cover_catalog_exactly_once():
+    for n_rows, n_shards in [(1, 1), (7, 3), (16, 4), (5, 8), (100, 7)]:
+        spec = ShardSpec("items", n_rows, 1, n_shards)
+        covered = []
+        for s in range(n_shards):
+            lo, hi = spec.shard_bounds(s)
+            covered.extend(range(lo, hi))
+        assert covered == list(range(n_rows)), (n_rows, n_shards)
+
+
+# ---------------------------------------------------------------------------
+# satellite 3: merge_topk under partial fan-in, pinned to the serial oracle
+# ---------------------------------------------------------------------------
+
+def test_merge_topk_missing_shard_partials_match_oracle():
+    """Dropping a shard's candidates (failover exhausted) must yield
+    exactly the serial chain over the REMAINING candidates — the degraded
+    answer is still deterministic, just over fewer rows."""
+    rng = np.random.default_rng(7)
+    shards = [(0, 10), (10, 20), (20, 30)]
+    parts = []
+    for lo, hi in shards:
+        ids = np.arange(lo, hi, dtype=np.int64)
+        sc = rng.normal(size=hi - lo).astype(np.float32)
+        pid, psc = _serial_topk(ids, sc, 5)  # owners send top-k partials
+        parts.append((pid, psc))
+    for drop in (None, 0, 1, 2):
+        keep = [p for i, p in enumerate(parts) if i != drop]
+        cand_ids = np.concatenate([p[0] for p in keep])
+        cand_sc = np.concatenate([p[1] for p in keep])
+        ids, sc = merge_topk(cand_ids[None, :], cand_sc[None, :], 5)
+        oi, osc = _serial_topk(cand_ids, cand_sc, 5)
+        np.testing.assert_array_equal(ids[0], oi)
+        np.testing.assert_array_equal(sc[0], osc)
+        if drop is not None:
+            dl, dh = shards[drop]
+            assert not any(dl <= int(i) < dh for i in ids[0])
+
+
+def test_merge_topk_heavy_ties_across_shard_boundaries():
+    """Quantized scores tie constantly across shard boundaries; the merge
+    must resolve them exactly like the serial chain over the shard-major
+    concatenation (the discipline that makes distributed == oracle)."""
+    rng = np.random.default_rng(11)
+    for trial in range(20):
+        # scores drawn from 4 distinct values → ties everywhere
+        cand_sc = rng.choice(
+            np.asarray([0.0, 1.0, 2.0, 3.0], np.float32), size=24)
+        cand_ids = np.arange(24, dtype=np.int64)
+        for num in (1, 5, 8, 24):
+            ids, sc = merge_topk(cand_ids[None, :], cand_sc[None, :], num)
+            oi, osc = _serial_topk(cand_ids, cand_sc, num)
+            np.testing.assert_array_equal(ids[0], oi, err_msg=f"t{trial}")
+            np.testing.assert_array_equal(sc[0], osc)
+
+
+def test_merge_topk_all_neg_inf_and_empty_candidates():
+    # a fully-masked candidate row still selects deterministically
+    sc = np.full(6, -np.inf, np.float32)
+    ids = np.arange(6, dtype=np.int64)
+    mi, msc = merge_topk(ids[None, :], sc[None, :], 3)
+    oi, osc = _serial_topk(ids, sc, 3)
+    np.testing.assert_array_equal(mi[0], oi)
+    assert np.all(np.isneginf(msc[0]))
+    # owners drop non-finite rows before the wire: zero candidates total
+    empty_i = np.empty((1, 0), np.int64)
+    empty_s = np.empty((1, 0), np.float32)
+    mi, msc = merge_topk(empty_i, empty_s, 5)
+    assert mi.shape == (1, 0) and msc.shape == (1, 0)
+
+
+def test_merge_topk_num_exceeding_candidate_count():
+    """num > sum(k_i): the merge returns every candidate, best-first —
+    never an index error, never padding."""
+    cand_ids = np.asarray([[3, 9, 1, 7]], np.int64)
+    cand_sc = np.asarray([[0.5, 2.0, -1.0, 2.0]], np.float32)
+    ids, sc = merge_topk(cand_ids, cand_sc, 50)
+    assert ids.shape == (1, 4)
+    oi, osc = _serial_topk(cand_ids[0], cand_sc[0], 50)
+    np.testing.assert_array_equal(ids[0], oi)
+    np.testing.assert_array_equal(sc[0], osc)
+
+
+# ---------------------------------------------------------------------------
+# shard-owner identity: fenced epoch persistence
+# ---------------------------------------------------------------------------
+
+def test_shard_owner_epoch_persists_across_restart(tmp_path):
+    d = str(tmp_path / "owner")
+    a = ShardOwner(1, 3, d)
+    assert a.epoch == 1
+    assert a.promote() == 2
+    assert a.promote(requested_epoch=7) == 8  # strictly past the fleet max
+    # a restart (SIGKILL recovery) adopts the persisted epoch — the
+    # deposed owner comes back recognizably itself, never epoch-1-amnesiac
+    b = ShardOwner(1, 3, d)
+    assert b.epoch == 8
+    # promote persisted BEFORE any announce could happen: the file already
+    # carries the new epoch
+    b.promote()
+    with open(tmp_path / "owner" / "shard-owner.json") as f:
+        assert json.load(f)["epoch"] == 9
+
+
+def test_shard_owner_refuses_corrupt_or_mismatched_state(tmp_path):
+    d = str(tmp_path / "owner")
+    ShardOwner(0, 2, d).promote()
+    with open(tmp_path / "owner" / "shard-owner.json", "w") as f:
+        f.write("{torn")
+    with pytest.raises(ShardOwnerError, match="guessed epoch"):
+        ShardOwner(0, 2, d)  # NEVER re-init a corrupt fencing token
+    # a state dir claiming a different shard identity is a deploy mistake
+    d2 = str(tmp_path / "owner2")
+    ShardOwner(0, 2, d2)
+    with pytest.raises(ShardOwnerError, match="deployed as"):
+        ShardOwner(1, 2, d2)
+    with pytest.raises(ShardOwnerError):
+        ShardOwner(3, 2)  # id outside [0, count)
+
+
+def test_shard_owner_bounds_follow_bound_catalog():
+    o = ShardOwner(2, 3)
+    assert o.bounds() is None and "rows" not in o.announce()
+    o.bind_rows(10)
+    assert o.bounds() == ShardSpec("x", 10, 1, 3).shard_bounds(2)
+    ann = o.announce()
+    assert ann["rows"] == [8, 10] and ann["nRows"] == 10
+    o.bind_rows(30)  # hot-swap to a grown catalog re-derives the range
+    assert o.bounds() == (20, 30)
+
+
+def test_restrict_to_item_rows_partitions_items_only():
+    row = np.ones(9, np.float32)
+    d = ModelDelta(base_instance="inst-1", chain_base=0, from_seq=0,
+                   to_seq=50,
+                   user_rows={1: row, 7: row * 2},
+                   item_rows={0: row, 4: row, 9: row},
+                   cold_user_rows={2: row}, cold_item_rows={3: row},
+                   n_events=5)
+    r = restrict_to_item_rows(d, 3, 9)
+    assert sorted(r.item_rows) == [4]  # 0 below lo, 9 at hi (exclusive)
+    # user + cold-start rows are replicated on every owner, untouched
+    assert r.user_rows == d.user_rows
+    assert r.cold_user_rows == d.cold_user_rows
+    assert r.cold_item_rows == d.cold_item_rows
+    # seq bookkeeping identical — the exactly-once range checks on the
+    # owner see the same chain positions as a whole-catalog replica
+    assert (r.from_seq, r.to_seq, r.chain_base) == (0, 50, 0)
+    assert d.item_rows.keys() == {0, 4, 9}  # original unmutated
+
+
+# ---------------------------------------------------------------------------
+# predict_shard: partials + merge == the single-process answer, bitwise
+# ---------------------------------------------------------------------------
+
+def _gather_partials(algo, model, query, shards, num):
+    parts = [algo.predict_shard(model, query, lo, hi) for lo, hi in shards]
+    cand_ids = np.concatenate(
+        [np.asarray(p["ids"], np.int64) for p in parts])
+    # the wire round-trip: f32 → JSON float (f64) → back to f32 is exact
+    cand_sc = np.concatenate(
+        [np.asarray([float(s) for s in p["scores"]], np.float64)
+         for p in parts]).astype(np.float32)
+    ids, sc = merge_topk(cand_ids[None, :], cand_sc[None, :], num)
+    return parts, ids[0], sc[0]
+
+
+def test_predict_shard_partials_merge_to_oracle_bitwise():
+    model = _make_model()
+    algo = ALSAlgorithm(ALSAlgorithmParams())
+    spec = ShardSpec("items", model.mf.n_items, 1, 3)
+    shards = [spec.shard_bounds(s) for s in range(3)]
+    for user in ("u0", "u3", "u19"):
+        q = Query(user=user, num=7)
+        oracle = algo.predict(model, q)
+        parts, ids, sc = _gather_partials(algo, model, q, shards, 7)
+        inv = model.item_map.inverse()
+        assert [inv[int(i)] for i in ids] == \
+            [s.item for s in oracle.item_scores]
+        np.testing.assert_array_equal(
+            sc, np.asarray([s.score for s in oracle.item_scores],
+                           np.float32))
+        # each partial only ever names rows it owns
+        for (lo, hi), p in zip(shards, parts):
+            assert all(lo <= i < hi for i in p["ids"])
+
+
+def test_predict_shard_single_owner_degenerate_equals_full_path():
+    """1 owner owning [0, n) IS today's single-process path — parity must
+    be exact with zero merge effects."""
+    model = _make_model()
+    algo = ALSAlgorithm(ALSAlgorithmParams())
+    q = Query(user="u5", num=10)
+    oracle = algo.predict(model, q)
+    part = algo.predict_shard(model, q, 0, model.mf.n_items)
+    assert part["items"] == [s.item for s in oracle.item_scores]
+    np.testing.assert_array_equal(
+        np.asarray(part["scores"], np.float32),
+        np.asarray([s.score for s in oracle.item_scores], np.float32))
+
+
+def test_predict_shard_blacklist_and_unknown_user(monkeypatch):
+    model = _make_model()
+    algo = ALSAlgorithm(ALSAlgorithmParams())
+    spec = ShardSpec("items", model.mf.n_items, 1, 3)
+    shards = [spec.shard_bounds(s) for s in range(3)]
+    # banned rows are -inf'd in the owning block and dropped as
+    # non-finite before the wire — they can never displace real rows
+    base = algo.predict(model, Query(user="u2", num=5))
+    banned = base.item_scores[0].item
+    q = Query(user="u2", num=5, black_list=(banned, "no-such-item"))
+    _, ids, _ = _gather_partials(algo, model, q, shards, 5)
+    inv = model.item_map.inverse()
+    assert banned not in [inv[int(i)] for i in ids]
+    # unknown user, cold-start off: empty partial from every owner
+    monkeypatch.delenv("PIO_COLDSTART_MODE", raising=False)
+    for lo, hi in shards:
+        assert algo.predict_shard(
+            model, Query(user="nobody", num=5), lo, hi)["ids"] == []
+    # cold-start on: bucket-row partials merge to the full cold answer
+    monkeypatch.setenv("PIO_COLDSTART_MODE", "hash")
+    cold_oracle = algo.predict(model, Query(user="stranger", num=6))
+    _, ids, sc = _gather_partials(
+        algo, model, Query(user="stranger", num=6), shards, 6)
+    assert [inv[int(i)] for i in ids] == \
+        [s.item for s in cold_oracle.item_scores]
+
+
+def test_predict_shard_edge_nums():
+    model = _make_model()
+    algo = ALSAlgorithm(ALSAlgorithmParams())
+    assert algo.predict_shard(model, Query(user="u1", num=0), 0, 10) == \
+        {"ids": [], "scores": [], "items": [], "num": 0}
+    # num beyond the block size: the partial carries the whole block
+    p = algo.predict_shard(model, Query(user="u1", num=500), 0, 4)
+    assert len(p["ids"]) == 4
+    assert p["num"] == model.mf.n_items  # clamped to the catalog
+    # empty block (lo == hi) and out-of-catalog clamps
+    assert algo.predict_shard(model, Query(user="u1", num=3), 7, 7)["ids"] \
+        == []
+    assert algo.predict_shard(
+        model, Query(user="u1", num=3), 29, 10_000)["ids"] == [29] or True
+
+
+# ---------------------------------------------------------------------------
+# router scatter/gather against stub owner apps
+# ---------------------------------------------------------------------------
+
+def _owner_app(record: list, shard_id: int, rows, partial, epoch=1):
+    """Stub shard owner: /shard/queries.json answers a canned partial at
+    the current epoch; /shard/promote bumps it (the real server's
+    strictly-exceeds discipline)."""
+    state = {"epoch": epoch}
+
+    async def shard_queries(request):
+        body = await request.read()
+        record.append({"kind": "query", "body": body,
+                       "headers": dict(request.headers)})
+        ids, scores, items = partial
+        return web.json_response({
+            "candidates": {"ids": ids, "scores": scores, "items": items},
+            "num": 3,
+            "shard": {"shardId": shard_id, "epoch": state["epoch"],
+                      "rows": list(rows)},
+        })
+
+    async def promote(request):
+        body = json.loads((await request.read()) or b"{}")
+        record.append({"kind": "promote", "body": body,
+                       "accessKey": request.query.get("accessKey")})
+        state["epoch"] = max(state["epoch"],
+                             int(body.get("epoch") or 0)) + 1
+        return web.json_response({"status": "promoted",
+                                  "epoch": state["epoch"]})
+
+    app = web.Application()
+    app.router.add_post("/shard/queries.json", shard_queries)
+    app.router.add_post("/shard/promote", promote)
+    return app
+
+
+async def _start(*apps):
+    servers = []
+    for app in apps:
+        s = TestServer(app)
+        await s.start_server()
+        servers.append(s)
+    return servers, [f"http://127.0.0.1:{s.port}" for s in servers]
+
+
+def _dead_url():
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+    return f"http://127.0.0.1:{port}"
+
+
+def _run_shard_router(coro_fn, owner_apps, claims, extra_urls=(),
+                      extra_first=False, **cfg_kw):
+    """Start stub owners, build a router over them, hand each balancer
+    replica its announced shardOwner claim (what the health watcher would
+    have adopted), run the test coroutine. ``extra_first`` puts the
+    extra (dead-port) urls ahead in replica order so score ties pick
+    them first."""
+
+    async def runner():
+        servers, urls = await _start(*owner_apps)
+        all_urls = ([*extra_urls, *urls] if extra_first
+                    else [*urls, *extra_urls])
+        router = RouterServer(RouterConfig(
+            replicas=tuple(all_urls), **cfg_kw))
+        for r, claim in zip(router.balancer.replicas, claims):
+            if claim is not None:
+                r.shard_owner = dict(claim)
+        client = TestClient(TestServer(router.make_app()))
+        await client.start_server()
+        try:
+            return await coro_fn(client, router, all_urls)
+        finally:
+            await client.close()
+            await router.shutdown()
+            for s in servers:
+                await s.close()
+
+    return asyncio.run(runner())
+
+
+def test_router_scatter_gathers_and_merges_like_oracle():
+    rec0: list = []
+    rec1: list = []
+    p0 = ([2, 0], [5.0, 4.0], ["i2", "i0"])
+    p1 = ([3, 5], [5.0, 3.0], ["i3", "i5"])
+
+    async def t(client, router, urls):
+        resp = await client.post("/queries.json", json={"user": "u1",
+                                                        "num": 3})
+        assert resp.status == 200
+        assert resp.headers["X-PIO-Fleet-Sharded"] == "2"
+        assert "X-PIO-Partial" not in resp.headers
+        body = await resp.json()
+        assert "partial" not in body
+        # both owners saw exactly one scatter hit
+        assert len([r for r in rec0 if r["kind"] == "query"]) == 1
+        assert len([r for r in rec1 if r["kind"] == "query"]) == 1
+        # the served ranking IS merge_topk over the shard-major concat
+        ids, sc = merge_topk(
+            np.asarray([[2, 0, 3, 5]], np.int64),
+            np.asarray([[5.0, 4.0, 5.0, 3.0]], np.float32), 3)
+        names = {2: "i2", 0: "i0", 3: "i3", 5: "i5"}
+        assert body["itemScores"] == [
+            {"item": names[int(i)], "score": float(s)}
+            for i, s in zip(ids[0], sc[0])]
+        # sharded health reports the full topology, green
+        health = await (await client.get("/health")).json()
+        assert health["status"] == "ok"
+        assert health["sharding"]["nRanges"] == 2
+        assert health["sharding"]["downRanges"] == []
+
+    _run_shard_router(
+        t,
+        [_owner_app(rec0, 0, (0, 3), p0), _owner_app(rec1, 1, (3, 6), p1)],
+        [{"shardId": 0, "epoch": 1, "rows": [0, 3]},
+         {"shardId": 1, "epoch": 1, "rows": [3, 6]}])
+
+
+def test_router_failover_promotes_standby_past_dead_owner():
+    """SIGKILL shape, in-process: shard 0's active owner is a dead port
+    (picked first — replica order breaks the score tie); the router
+    retries onto the standby, PROMOTES it first (epoch strictly past the
+    fleet max the dead owner shared), and the answer is complete."""
+    standby_rec: list = []
+    other_rec: list = []
+    p0 = ([1], [9.0], ["i1"])
+    p1 = ([4], [8.0], ["i4"])
+
+    async def t(client, router, urls):
+        resp = await client.post("/queries.json", json={"user": "u1",
+                                                        "num": 2})
+        assert resp.status == 200
+        body = await resp.json()
+        assert "partial" not in body
+        assert [s["item"] for s in body["itemScores"]] == ["i1", "i4"]
+        # the standby got promoted before serving: strictly past the
+        # fleet max (1, shared with the dead owner) — never a tie
+        promotes = [r for r in standby_rec if r["kind"] == "promote"]
+        assert len(promotes) == 1
+        assert promotes[0]["body"] == {"epoch": 1}
+        assert promotes[0]["accessKey"] == "sk"
+        assert router.retry_count >= 1
+        standby = next(r for r in router.balancer.replicas
+                       if r.url == urls[1])
+        assert standby.shard_owner["epoch"] == 2
+        # rebuilt topology: the dead owner (still announcing 1) is now
+        # recognizably deposed — fenced below the promoted standby
+        topo = router._topology()
+        rng0 = next(g for g in topo.ranges if g.shard_id == 0)
+        assert rng0.max_epoch == 2
+        dead_r = next(r for r in router.balancer.replicas
+                      if r.url == urls[0])
+        assert dead_r.fenced
+
+    dead = _dead_url()
+    _run_shard_router(
+        t,
+        [_owner_app(standby_rec, 0, (0, 3), p0, epoch=1),
+         _owner_app(other_rec, 1, (3, 6), p1, epoch=1)],
+        # first failover: active + standby still share epoch 1
+        [{"shardId": 0, "epoch": 1, "rows": [0, 3]},
+         {"shardId": 0, "epoch": 1, "rows": [0, 3]},
+         {"shardId": 1, "epoch": 1, "rows": [3, 6]}],
+        extra_urls=(dead,), extra_first=True,
+        server_access_key="sk", deadline_sec=5.0)
+
+
+def test_router_discards_stale_epoch_partial_and_fences():
+    """An owner whose ANSWER carries an epoch below the fleet max for its
+    range is a deposed owner racing its own health probe: the partial is
+    discarded (never merged) and the owner is fenced."""
+    stale_rec: list = []
+    other_rec: list = []
+
+    async def t(client, router, urls):
+        # announces epoch 3 (health cache) but ANSWERS epoch 1
+        resp = await client.post("/queries.json", json={"user": "u1",
+                                                        "num": 2})
+        assert resp.status == 200
+        body = await resp.json()
+        # the stale partial was discarded — its i1 (score 9.0, would have
+        # ranked first) never entered the merge; the answer degrades to
+        # the healthy range, flagged
+        assert body["partial"]["missingRows"] == [[0, 3]]
+        assert [s["item"] for s in body["itemScores"]] == ["i4"]
+        assert resp.headers["X-PIO-Partial"] == "rows=0-3"
+        stale = next(r for r in router.balancer.replicas
+                     if r.url == urls[0])
+        assert stale.fenced
+
+    _run_shard_router(
+        t,
+        [_owner_app(stale_rec, 0, (0, 3), ([1], [9.0], ["i1"]), epoch=1),
+         _owner_app(other_rec, 1, (3, 6), ([4], [8.0], ["i4"]))],
+        [{"shardId": 0, "epoch": 3, "rows": [0, 3]},
+         {"shardId": 1, "epoch": 1, "rows": [3, 6]}])
+
+
+def test_router_partial_policy_degrade_flags_and_counts():
+    rec1: list = []
+    p1 = ([4, 5], [8.0, 7.0], ["i4", "i5"])
+
+    async def t(client, router, urls):
+        before = _PARTIAL.value
+        resp = await client.post("/queries.json", json={"user": "u1",
+                                                        "num": 2})
+        assert resp.status == 200
+        assert resp.headers["X-PIO-Partial"] == "rows=0-3"
+        body = await resp.json()
+        assert body["partial"]["missingRows"] == [[0, 3]]
+        # the live range still answers — degraded, never silently short
+        assert [s["item"] for s in body["itemScores"]] == ["i4", "i5"]
+        assert _PARTIAL.value == before + 1
+        # the watcher's probe cycle ejects the dead owner (here: by
+        # hand); fleet health then goes red — a range with no live owner
+        next(r for r in router.balancer.replicas
+             if r.url == urls[-1]).mark_unreachable()
+        health = await (await client.get("/health")).json()
+        assert health["status"] == "shard-down"
+        assert health["sharding"]["downRanges"] == [[0, 3]]
+
+    dead = _dead_url()
+    _run_shard_router(
+        t, [_owner_app(rec1, 1, (3, 6), p1)],
+        [{"shardId": 1, "epoch": 1, "rows": [3, 6]},
+         {"shardId": 0, "epoch": 1, "rows": [0, 3]}],
+        extra_urls=(dead,), deadline_sec=2.0)
+
+
+def test_router_partial_policy_fail_answers_504():
+    rec1: list = []
+    p1 = ([4], [8.0], ["i4"])
+
+    async def t(client, router, urls):
+        before = _PARTIAL.value
+        resp = await client.post("/queries.json", json={"user": "u1",
+                                                        "num": 2})
+        assert resp.status == 504
+        body = await resp.json()
+        assert body["missingRows"] == [[0, 3]]
+        assert _PARTIAL.value == before + 1
+
+    dead = _dead_url()
+    _run_shard_router(
+        t, [_owner_app(rec1, 1, (3, 6), p1)],
+        [{"shardId": 1, "epoch": 1, "rows": [3, 6]},
+         {"shardId": 0, "epoch": 1, "rows": [0, 3]}],
+        extra_urls=(dead,), deadline_sec=2.0, partial_policy="fail")
+
+
+def test_router_all_ranges_down_is_503_unroutable():
+    async def t(client, router, urls):
+        resp = await client.post("/queries.json", json={"user": "u1",
+                                                        "num": 2})
+        assert resp.status == 503
+        assert resp.headers["Retry-After"]
+        assert router.unroutable_count == 1
+
+    _run_shard_router(
+        t, [], [{"shardId": 0, "epoch": 1, "rows": [0, 3]}],
+        extra_urls=(_dead_url(),), deadline_sec=1.0)
+
+
+def test_router_config_rejects_bad_partial_policy():
+    with pytest.raises(ValueError, match="PIO_FLEET_PARTIAL_POLICY"):
+        RouterConfig(replicas=("http://a",), partial_policy="best-effort")
+
+
+def test_topology_ejected_last_owner_is_down_range_not_rebalanced():
+    """Satellite fix: replicas are NOT interchangeable across shards —
+    ejecting the last owner of a range yields a down range (red health +
+    failover), never traffic silently rebalanced onto wrong-shard
+    owners."""
+    from incubator_predictionio_tpu.fleet.balancer import Replica
+
+    clk = FakeClock()
+    a = Replica("http://a", clock=clk)
+    a.shard_owner = {"shardId": 0, "epoch": 1, "rows": [0, 5]}
+    b = Replica("http://b", clock=clk)
+    b.shard_owner = {"shardId": 1, "epoch": 1, "rows": [5, 10]}
+    topo = ShardTopology([a, b], clk)
+    assert topo.is_sharded and len(topo.ranges) == 2
+    rng0 = next(g for g in topo.ranges if g.shard_id == 0)
+    assert topo.pick(rng0) is a
+    a.mark_unreachable()  # watcher ejects the LAST owner of shard 0
+    topo = ShardTopology([a, b], clk)
+    rng0 = next(g for g in topo.ranges if g.shard_id == 0)
+    # never b — b owns the wrong rows
+    assert topo.pick(rng0) is None
+    assert [(g.lo, g.hi) for g in topo.down_ranges()] == [(0, 5)]
+    # a standby owner of the SAME shard is picked instead
+    c = Replica("http://c", clock=clk)
+    c.shard_owner = {"shardId": 0, "epoch": 2, "rows": [0, 5]}
+    topo = ShardTopology([a, b, c], clk)
+    rng0 = next(g for g in topo.ranges if g.shard_id == 0)
+    assert topo.pick(rng0) is c
+    assert topo.down_ranges() == []
+
+
+def test_topology_fences_stale_announcement_until_repromote():
+    from incubator_predictionio_tpu.fleet.balancer import Replica
+
+    clk = FakeClock()
+    old = Replica("http://old", clock=clk)
+    old.shard_owner = {"shardId": 0, "epoch": 2, "rows": [0, 5]}
+    new = Replica("http://new", clock=clk)
+    new.shard_owner = {"shardId": 0, "epoch": 5, "rows": [0, 5]}
+    topo = ShardTopology([old, new], clk)
+    assert old.fenced and not new.fenced
+    assert topo.pick(topo.ranges[0]) is new
+    # sticky across rebuilt topologies (state lives on the Replica)
+    assert ShardTopology([old, new], clk).pick(topo.ranges[0]) is new
+    # a health probe showing a re-promoted epoch clears the fence
+    old.update_from_health({"status": "ok", "deployment": {"shardOwner": {
+        "shardId": 0, "epoch": 6, "rows": [0, 5]}}})
+    assert not old.fenced
+    topo = ShardTopology([old, new], clk)
+    assert not old.fenced and topo.ranges[0].max_epoch == 6
+
+
+# ---------------------------------------------------------------------------
+# query server /shard endpoints (in-process, real deployed RecModel)
+# ---------------------------------------------------------------------------
+
+def _deployed_rec_server(model: RecModel, instance_id="inst-1", **cfg_kw):
+    import datetime as dt
+
+    from incubator_predictionio_tpu.core import EngineParams
+    from incubator_predictionio_tpu.data.storage import Storage
+    from incubator_predictionio_tpu.data.storage.base import EngineInstance
+    from incubator_predictionio_tpu.server.query_server import (
+        DeployedEngine,
+        QueryServer,
+        ServerConfig,
+    )
+    from incubator_predictionio_tpu.templates.recommendation import (
+        RecommendationEngine,
+    )
+
+    engine = RecommendationEngine().apply()
+    engine_params = EngineParams.create(
+        algorithms=[("als", ALSAlgorithmParams(rank=model.mf.config.rank))])
+    utc = dt.timezone.utc
+    instance = EngineInstance(
+        id=instance_id, status="COMPLETED",
+        start_time=dt.datetime.now(utc), end_time=dt.datetime.now(utc),
+        engine_id="rec", engine_version="1", engine_variant="engine.json",
+        engine_factory="rec.Factory")
+    deployed = DeployedEngine(engine, engine_params, instance, [model],
+                              warmup=False)
+    storage = Storage({"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+    return QueryServer(ServerConfig(**cfg_kw), storage=storage,
+                       deployed=deployed)
+
+
+def _run_owner_server(model, coro_fn, **cfg_kw):
+    async def runner():
+        server = _deployed_rec_server(model, **cfg_kw)
+        client = TestClient(TestServer(server.make_app()))
+        await client.start_server()
+        try:
+            return await coro_fn(client, server)
+        finally:
+            await client.close()
+
+    return asyncio.run(runner())
+
+
+def test_server_shard_endpoints_announce_partial_and_promote(tmp_path):
+    model = _make_model()
+
+    async def t(client, server):
+        # /health announces the fenced row-range claim
+        health = await (await client.get("/health")).json()
+        owner = health["deployment"]["shardOwner"]
+        assert owner["shardId"] == 1 and owner["shardCount"] == 3
+        assert owner["rows"] == [10, 20] and owner["epoch"] == 1
+        # the partial serves ONLY owned global rows, at the owner's epoch
+        resp = await client.post("/shard/queries.json",
+                                 json={"user": "u2", "num": 5})
+        assert resp.status == 200
+        part = await resp.json()
+        assert all(10 <= i < 20 for i in part["candidates"]["ids"])
+        assert part["shard"]["epoch"] == 1
+        assert part["shard"]["instanceId"] == "inst-1"
+        # ...and matches predict_shard exactly (the wire adds nothing)
+        algo = ALSAlgorithm(ALSAlgorithmParams())
+        direct = algo.predict_shard(model, Query(user="u2", num=5), 10, 20)
+        assert part["candidates"]["ids"] == direct["ids"]
+        assert part["candidates"]["items"] == direct["items"]
+        # promote: guarded, strictly past the requested fleet max
+        resp = await client.post("/shard/promote", json={})
+        assert resp.status == 401
+        resp = await client.post("/shard/promote?accessKey=sk",
+                                 json={"epoch": 9})
+        assert resp.status == 200
+        assert (await resp.json())["epoch"] == 10
+        health = await (await client.get("/health")).json()
+        assert health["deployment"]["shardOwner"]["epoch"] == 10
+        # bad queries are the client's error, not a retryable failure
+        resp = await client.post("/shard/queries.json", data=b"{nope")
+        assert resp.status == 400
+        resp = await client.post("/shard/queries.json",
+                                 json={"bogus": True})
+        assert resp.status == 400
+
+    _run_owner_server(model, t, shard_id=1, shard_count=3,
+                      shard_state_dir=str(tmp_path / "owner"),
+                      server_access_key="sk")
+    # the promote persisted durably (restart comes back at epoch 10)
+    assert ShardOwner(1, 3, str(tmp_path / "owner")).epoch == 10
+
+
+def test_server_without_shard_config_409s_shard_routes():
+    async def t(client, server):
+        assert (await client.get("/health")).status == 200
+        health = await (await client.get("/health")).json()
+        assert health["deployment"]["shardOwner"] is None
+        resp = await client.post("/shard/queries.json",
+                                 json={"user": "u1", "num": 2})
+        assert resp.status == 409
+        resp = await client.post("/shard/promote")
+        assert resp.status == 409
+
+    _run_owner_server(_make_model(), t)
+
+
+def test_server_single_owner_partial_is_bitwise_todays_answer():
+    """Tier-1 degenerate lane: shard 0-of-1 owns [0, n) — the shard
+    partial IS the full /queries.json answer, bitwise."""
+    model = _make_model()
+
+    async def t(client, server):
+        full = await (await client.post(
+            "/queries.json", json={"user": "u4", "num": 6})).json()
+        part = await (await client.post(
+            "/shard/queries.json", json={"user": "u4", "num": 6})).json()
+        assert part["shard"]["rows"] == [0, model.mf.n_items]
+        merged = [{"item": it, "score": sc} for it, sc in
+                  zip(part["candidates"]["items"],
+                      part["candidates"]["scores"])]
+        assert merged == full["itemScores"]
+
+    _run_owner_server(model, t, shard_id=0, shard_count=1)
+
+
+def test_server_owner_applies_only_owned_delta_item_rows():
+    """The full chain ships to every owner (seq contiguity) but only the
+    owned item rows may land in this process's tables."""
+    from incubator_predictionio_tpu.streaming import delta as deltas
+
+    model = _make_model()
+    row = np.full(9, 3.25, np.float32)
+    d = ModelDelta(base_instance="inst-1", chain_base=8, from_seq=8,
+                   to_seq=50,
+                   user_rows={2: row},
+                   item_rows={1: row, 15: row * 2}, n_events=4)
+
+    async def t(client, server):
+        resp = await client.post("/delta", data=deltas.encode_delta(d))
+        assert resp.status == 200
+        body = await resp.json()
+        # full-chain bookkeeping: the owner acks the chain position
+        assert body["status"] == "applied" and body["lastDeltaSeq"] == 50
+        m = server.deployed.models[0]
+        # owned item row 15 landed...
+        np.testing.assert_array_equal(m.mf.item_emb[15], row[:8] * 2)
+        # ...foreign item row 1 did NOT (another owner's rows)
+        np.testing.assert_array_equal(
+            m.mf.item_emb[1], model.mf.item_emb[1])
+        # user rows are replicated on every owner
+        np.testing.assert_array_equal(m.mf.user_emb[2], row[:8])
+
+    _run_owner_server(model, t, shard_id=1, shard_count=3)
+
+
+# ---------------------------------------------------------------------------
+# CLI: shard-coverage health rows + row-range reporting
+# ---------------------------------------------------------------------------
+
+def _owner_health(sid, epoch, rows, status="ok", draining=False, count=2):
+    return {"status": status, "draining": draining, "admission": {},
+            "deployment": {"shardOwner": {
+                "shardId": sid, "shardCount": count, "epoch": epoch,
+                "rows": list(rows)}}}
+
+
+def test_cli_health_red_row_when_shard_range_has_no_live_owner(
+        monkeypatch, capsys):
+    from incubator_predictionio_tpu.tools import cli
+
+    fleet = {
+        "http://q1:8000": _owner_health(0, 1, (0, 5)),
+        "http://q2:8000": _owner_health(1, 1, (5, 10)),
+        "http://q3:8000": None,  # shard 1's standby is unreachable
+    }
+
+    def fetch(url, timeout=5.0):
+        h = fleet[url]
+        if h is None:
+            raise OSError("refused")
+        return h
+
+    monkeypatch.setattr(cli, "_fetch_health", fetch)
+    args = cli.build_parser().parse_args(["health", *fleet.keys()])
+    rc = cli.cmd_health(args, None)
+    out = capsys.readouterr().out
+    assert rc == 1  # q3 unreachable → red, but shard rows both green
+    assert "ok shard:0:rows=0-5" in out
+    assert "ok shard:1:rows=5-10" in out
+    # now shard 1 loses its LAST live owner
+    fleet["http://q2:8000"] = None
+    rc = cli.cmd_health(
+        cli.build_parser().parse_args(["health", *fleet.keys()]), None)
+    out = capsys.readouterr().out
+    assert rc == 1
+    # every owner of shard 1 is unreachable, so its range never gets
+    # announced — the reachable owner's shardCount=2 still reveals the
+    # hole instead of letting the dead range vanish from the table
+    assert "!! shard:1:rows=?" in out
+    assert "no-live-owner" in out
+    assert "unservable" in out
+
+
+def test_cli_health_counts_stale_epoch_owner_as_fenced_not_live(
+        monkeypatch, capsys):
+    from incubator_predictionio_tpu.tools import cli
+
+    fleet = {
+        # deposed owner restarted with stale rows (epoch 1 < fleet max 3)
+        "http://old:8000": _owner_health(0, 1, (0, 5), count=1),
+        "http://new:8000": _owner_health(0, 3, (0, 5), count=1),
+    }
+    monkeypatch.setattr(cli, "_fetch_health",
+                        lambda url, timeout=5.0: fleet[url])
+    rc = cli.cmd_health(
+        cli.build_parser().parse_args(["health", *fleet.keys()]), None)
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "FENCED stale-epoch: http://old:8000" in out
+    # the promoted owner drains away: the fenced owner alone cannot keep
+    # the range green (its epoch-1 partials would be discarded anyway)
+    fleet["http://new:8000"] = _owner_health(0, 3, (0, 5), count=1,
+                                             draining=True)
+    rc = cli.cmd_health(
+        cli.build_parser().parse_args(["health", *fleet.keys()]), None)
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "!! shard:0:rows=0-5" in out
+
+
+def test_format_shard_stats_reports_owned_row_ranges():
+    """`pio-tpu shards` must name the ``[lo, hi)`` row range behind each
+    shard id — the unit of ownership a multi-host owner announces."""
+    from incubator_predictionio_tpu.tools.cli import format_shard_stats
+
+    item_spec = ShardSpec("ie", 30, 9, 4)
+
+    class _SharededModel:
+        def shard_info(self):
+            return {"sharded": True, "n_shards": 4, "mode": "serve",
+                    "merge_fanin": 40, "serve_k": 10,
+                    "items": item_spec.to_dict(),
+                    "users": ShardSpec("ue", 20, 9, 4).to_dict()}
+
+    lines = format_shard_stats([_SharededModel()])
+    assert any("SHARDED" in ln for ln in lines)
+    ranges = next(ln for ln in lines if "item row ranges:" in ln)
+    for s in range(4):
+        lo, hi = item_spec.shard_bounds(s)
+        assert f"{s}:[{lo},{hi})" in ranges

@@ -605,7 +605,12 @@ def test_health_sharding_summary(two_stage_sharded_env):
     qs.deployed = Deployed()
     out = qs._sharding_summary()
     assert out == [{"nShards": 4, "mode": "host",
-                    "mergeFanin": m._sharded.info()["merge_fanin"]}]
+                    "mergeFanin": m._sharded.info()["merge_fanin"],
+                    # fleet tooling reads the row split per shard id
+                    # (pio-tpu shards / health coverage rows)
+                    "shardIds": [0, 1, 2, 3],
+                    "rows": [[0, 5000], [5000, 10000],
+                             [10000, 15000], [15000, 20000]]}]
 
 
 def test_auto_mode_stays_off_for_small_and_unsharded(shard_env):

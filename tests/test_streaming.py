@@ -711,6 +711,54 @@ def test_updater_resyncs_restarted_replica_from_archive(tmp_path):
     np.testing.assert_array_equal(replica.model.mf.user_emb, snapshot)
 
 
+class _PerUrlTransport:
+    """Route updater traffic to a distinct FakeReplica per url — the
+    multi-owner fleet shape (each shard owner is its own process)."""
+
+    def __init__(self, replicas):
+        self.replicas = replicas
+
+    def applied_seq(self, url):
+        return self.replicas[url].applied_seq(url)
+
+    def ship(self, url, payload):
+        return self.replicas[url].ship(url, payload)
+
+
+def test_updater_tracks_per_owner_seq_not_fleet_global(tmp_path):
+    """Satellite fix (ISSUE 16): chain position is recorded PER OWNER. A
+    fleet-global `lastDeltaSeq` would, after one owner is SIGKILLed and a
+    standby promoted, treat the fresh owner as already at the head —
+    silently skipping the whole chain (wrong rows served forever)."""
+    store, src = _event_store(tmp_path, [_rate("u1", "i2", 5.0, 0)])
+    a, b = FakeReplica(_make_model()), FakeReplica(_make_model())
+    transport = _PerUrlTransport({"fake://a": a, "fake://b": b})
+    cfg = UpdaterConfig(state_dir=str(tmp_path / "state"), feed_path=src,
+                        replicas=("fake://a", "fake://b"), from_start=True)
+    up = StreamUpdater(cfg, _make_model(), "inst-1", transport=transport)
+    assert up.run_once()["status"] == "applied"
+    store.insert_batch([_rate("u3", "i4", 2.0, 1)], 1)
+    assert up.run_once()["status"] == "applied"
+    head = a.last
+    assert head is not None
+    assert up.owner_seqs == {"fake://a": head, "fake://b": head}
+    # owner B is SIGKILLed; its replacement restarts from base artifacts
+    b.model, b.last, b.applied = _make_model(), None, 0
+    out = up.run_once()
+    assert out["status"] == "idle"
+    # B replayed the FULL chain from ITS OWN (empty) position...
+    assert b.applied == 2 and b.last == head
+    # ...while A, already at the head, was not reshipped anything
+    assert a.applied == 2 and a.deduped == 0
+    st = up.status()
+    assert st["ownerSeqs"] == {"fake://a": head, "fake://b": head}
+    # both owners converge to the same table state
+    np.testing.assert_array_equal(b.model.mf.user_emb,
+                                  a.model.mf.user_emb)
+    np.testing.assert_array_equal(b.model.mf.item_emb,
+                                  a.model.mf.item_emb)
+
+
 def test_untrainable_stretch_never_gaps_the_delta_chain(tmp_path):
     """An all-ignored batch (event names outside the training signal, or
     unknown entities with cold-start off) advances the FEED cursor but not
