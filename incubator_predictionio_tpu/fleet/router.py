@@ -162,8 +162,14 @@ class RouterServer:
         # rides X-PIO-Trace as `:s=` to every downstream hop; the spool
         # (PIO_TRACE_SPOOL_DIR) makes this process's fragment durable
         from incubator_predictionio_tpu.obs import spool as trace_spool
+        from incubator_predictionio_tpu.obs.plane import (
+            configure_perf_plane_from_env,
+        )
 
         trace_spool.configure_export_from_env("fleet_router")
+        # continuous performance plane (obs/plane.py): procstats +
+        # profiler + metrics history + SLO burn-rate engine
+        configure_perf_plane_from_env("fleet_router")
         self.balancer = Balancer(config.replicas, clock=clock,
                                  eject_threshold=config.eject_threshold)
         self.candidate_balancer = Balancer(
@@ -245,9 +251,13 @@ class RouterServer:
                 # a shard range with zero live owners means partial (or
                 # failed) answers — red, even while other replicas are up
                 status = "shard-down"
+        from incubator_predictionio_tpu.obs import slo as _slo
+
         return web.json_response({
             "status": status,
             "draining": self._drain_state.draining,
+            # SLO burn-rate verdicts (obs/slo.py; None when no PIO_SLO_CONFIG)
+            "slo": _slo.health_block(),
             "availableReplicas": len(available),
             "replicas": self.balancer.snapshot(),
             "candidates": self.candidate_balancer.snapshot(),
@@ -735,6 +745,10 @@ class RouterServer:
 
     # -- lifecycle ------------------------------------------------------
     async def start(self) -> None:
+        from incubator_predictionio_tpu.obs import procstats
+
+        # loop-lag gauge rides this server's loop (pio_process_loop_lag_*)
+        self._loop_lag = procstats.start_loop_lag("fleet_router")
         self._runner = web.AppRunner(self.make_app())
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.config.ip, self.config.port)
@@ -766,6 +780,9 @@ class RouterServer:
         # whole object graph) — bench_fleet builds several routers in one
         # process
         REGISTRY.remove_collector("fleet_router")
+        lag = getattr(self, "_loop_lag", None)
+        if lag is not None:
+            lag.cancel()
         await self.watcher.stop()
         for task in list(self._shadow_tasks):
             task.cancel()

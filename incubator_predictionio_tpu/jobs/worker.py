@@ -141,8 +141,14 @@ class JobWorker:
         # makes its per-job spans durable for fleet-wide trace assembly,
         # --obs-port (tools/cli.py) makes pio_jobs_* scrapeable
         from incubator_predictionio_tpu.obs import spool as trace_spool
+        from incubator_predictionio_tpu.obs.plane import (
+            configure_perf_plane_from_env,
+        )
 
         trace_spool.configure_export_from_env("jobs_worker")
+        # continuous performance plane (obs/plane.py): procstats +
+        # profiler + metrics history + SLO burn-rate engine
+        configure_perf_plane_from_env("jobs_worker")
 
     # -- loop -------------------------------------------------------------
     def run_once(self) -> Optional[dict]:

@@ -706,6 +706,9 @@ class TwoTowerMF:
             rb = stage(ratings.astype(np.float32) - mean, np.float32)
             wb = ctx.put(w.reshape(n_batches, global_batch), None, ctx.data_axis)
 
+        # phase fence: staging transfers (h2d) must bill to this phase,
+        # not to whichever later phase first blocks on the batches
+        jax.block_until_ready((ub, ib, rb, wb))
         t_stage = _time.perf_counter() - t_stage
         t_init = _time.perf_counter()
         key = jax.random.key(cfg.seed)
@@ -737,6 +740,8 @@ class TwoTowerMF:
 
         from incubator_predictionio_tpu.utils.checkpoint import checkpointed_epochs
 
+        # phase fence: on-device table/moment init bills to init
+        jax.block_until_ready((params, opt_state))
         t_init = _time.perf_counter() - t_init
         t_train = _time.perf_counter()
         params, opt_state, loss = checkpointed_epochs(
@@ -803,6 +808,21 @@ class TwoTowerMF:
             "train_sec": round(t_train, 4),
             "gather_sec": round(t_gather, 4),
         }
+        # continuous performance plane: the same four timers feed the
+        # profiler's train.fit phase buckets (h2d staging / device init /
+        # fused train loop / host|collective gather) and the analytic-flops
+        # MFU gauge (docs/observability.md "Profiling")
+        from incubator_predictionio_tpu.obs import profile as _profile
+
+        _profile.record_phases("train.fit", {
+            "h2d": t_stage, "init": t_init,
+            "compute": t_train, "gather": t_gather,
+        })
+        n_b, g_batch = int(ub.shape[0]), int(ub.shape[1])
+        n_params = (n_users + n_items) * (cfg.rank + 1)
+        _profile.record_training_step(
+            cfg.epochs * n_b * (12 * cfg.rank * g_batch + 12 * n_params),
+            t_train)
         return model
 
     def _stage_local(self, ctx: MeshContext, users, items, ratings):
@@ -954,23 +974,23 @@ class TwoTowerMF:
             rm = _row_mask_pad_buffer(bucket, n_cols)
             rm[:b, : row_mask.shape[1]] = row_mask
             rmask = jnp.asarray(rm)
-        jitstats.record((
+        with jitstats.dispatch_timer((
             "two_tower_topk", quantized, bucket, k,
             model.n_items, ue_tab.shape[0], rmask is not None,
-        ))
-        if quantized:
-            idx, scores = _topk_quantized(
-                jnp.asarray(uidx), ue_tab, ub_tab,
-                items_q, scales, bias, mask, rmask, model.mean, k,
-            )
-        else:
-            idx, scores = _topk_scores(
-                jnp.asarray(uidx), ue_tab, ub_tab,
-                item_t, item_b, model.mean, mask, rmask, k,
-            )
-        # ONE batched device→host pull for both results: each separate
-        # np.asarray costs a full round trip on remote-attached devices
-        idx_h, scores_h = jax.device_get((idx, scores))
+        )):
+            if quantized:
+                idx, scores = _topk_quantized(
+                    jnp.asarray(uidx), ue_tab, ub_tab,
+                    items_q, scales, bias, mask, rmask, model.mean, k,
+                )
+            else:
+                idx, scores = _topk_scores(
+                    jnp.asarray(uidx), ue_tab, ub_tab,
+                    item_t, item_b, model.mean, mask, rmask, k,
+                )
+            # ONE batched device→host pull for both results: each separate
+            # np.asarray costs a full round trip on remote-attached devices
+            idx_h, scores_h = jax.device_get((idx, scores))
         return idx_h[:b, :num], scores_h[:b, :num]
 
 

@@ -1,0 +1,521 @@
+"""Embedded durable metrics history: every server self-scrapes its own
+registry into CRC-framed on-disk segments (docs/observability.md "Metrics
+history & SLOs").
+
+``/metrics`` is a point-in-time scrape; without a scraper deployment the
+repo could never answer "did qps degrade over the last hour". This module
+is the embedded answer — no external TSDB, the same trust model as the
+trace spool:
+
+- a background recorder thread scrapes the process's own
+  :data:`~incubator_predictionio_tpu.obs.metrics.REGISTRY` every
+  ``PIO_HISTORY_INTERVAL_MS`` through ``expose()`` +
+  :func:`~incubator_predictionio_tpu.obs.metrics.parse_prometheus_text`
+  (the strict parser IS the sampling path, so a page the parser would
+  reject can never be archived silently);
+- each snapshot is one JSON record framed with the exact WAL format from
+  :mod:`incubator_predictionio_tpu.resilience.wal` (magic + ``[u32 len]
+  [u32 crc32][payload]``) into segments named
+  ``history-<service>-<pid>-<n>.log`` — any number of processes share one
+  directory without coordination, like the trace spool;
+- segments rotate at ``PIO_HISTORY_SEGMENT_BYTES`` and the per-process
+  total is bounded by ``PIO_HISTORY_MAX_BYTES`` with WHOLE-segment
+  eviction (readers racing an eviction lose a whole old segment cleanly,
+  never a torn prefix);
+- readers (:func:`read_history`, ``pio-tpu history``/``top``/``slo``) use
+  :func:`~incubator_predictionio_tpu.resilience.wal.tail_frames` — a
+  partial tail while the writer is mid-frame is "waiting", not corruption;
+- the recorder also keeps a bounded in-memory ring of recent snapshots:
+  the SLO engine evaluates burn rates from it without touching disk, and
+  ``GET /history.json`` serves it to ``pio-tpu history <url>``.
+
+Record shape::
+
+    {"t": <unix sec>, "service": str,
+     "samples": [[name, {label: value}, value], ...],
+     "types": {family: "counter"|"gauge"|"histogram"}}
+"""
+
+from __future__ import annotations
+
+import collections
+import fnmatch
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Iterable, Optional
+
+from incubator_predictionio_tpu.obs.metrics import (
+    REGISTRY,
+    MetricError,
+    bucket_quantiles,
+    parse_prometheus_text,
+)
+from incubator_predictionio_tpu.resilience.wal import (
+    MAGIC,
+    tail_frames,
+    write_frame,
+)
+
+logger = logging.getLogger(__name__)
+
+#: env knobs (docs/configuration.md "Metrics history")
+ENV_DIR = "PIO_HISTORY_DIR"
+ENV_INTERVAL_MS = "PIO_HISTORY_INTERVAL_MS"
+ENV_SEGMENT_BYTES = "PIO_HISTORY_SEGMENT_BYTES"
+ENV_MAX_BYTES = "PIO_HISTORY_MAX_BYTES"
+
+DEFAULT_INTERVAL_MS = 5000.0
+DEFAULT_SEGMENT_BYTES = 1 << 20
+DEFAULT_MAX_BYTES = 32 << 20
+#: in-memory ring depth — 720 snapshots at the default 5s interval is an
+#: hour, enough for the fast 5m/1h SLO window pair without touching disk
+RING_SIZE = 720
+
+_SEG_PREFIX = "history-"
+_SEG_SUFFIX = ".log"
+
+SNAPSHOTS = REGISTRY.counter(
+    "pio_history_snapshots_total",
+    "Registry self-scrapes appended to the metrics history store")
+HISTORY_BYTES = REGISTRY.gauge(
+    "pio_history_bytes",
+    "Bytes of metrics history currently on disk for this process's "
+    "segments")
+EVICTED = REGISTRY.counter(
+    "pio_history_evicted_segments_total",
+    "Whole history segments deleted to hold this process under "
+    "PIO_HISTORY_MAX_BYTES")
+HISTORY_ERRORS = REGISTRY.counter(
+    "pio_history_errors_total",
+    "Self-scrape or history-append failures (the snapshot is skipped; "
+    "serving is never affected)")
+
+
+def history_files(directory: str) -> list[str]:
+    """Every history segment in ``directory`` (any service, any pid),
+    oldest first by name — segment numbers are zero-padded so lexicographic
+    order is append order within one writer."""
+    try:
+        names = sorted(os.listdir(directory))
+    except FileNotFoundError:
+        return []
+    return [os.path.join(directory, n) for n in names
+            if n.startswith(_SEG_PREFIX) and n.endswith(_SEG_SUFFIX)]
+
+
+class HistoryStore:
+    """One process's history segment writer (same rotation/eviction shape
+    as the trace spool's :class:`~incubator_predictionio_tpu.obs.spool.
+    SpanSpool`). Called only from the recorder thread; the lock exists for
+    test drivers poking ``append`` directly."""
+
+    def __init__(self, directory: str, service: str = "proc",
+                 segment_bytes: int = DEFAULT_SEGMENT_BYTES,
+                 max_bytes: int = DEFAULT_MAX_BYTES):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        safe = "".join(c if (c.isalnum() or c in "_.") else "_"
+                       for c in service) or "proc"
+        self._prefix = f"{_SEG_PREFIX}{safe}-{os.getpid()}-"
+        self.segment_bytes = max(4096, segment_bytes)
+        self.max_bytes = max(self.segment_bytes, max_bytes)
+        self._lock = threading.Lock()
+        self._own: list[tuple[str, int]] = []
+        self._closed_bytes = 0
+        self._next_n = self._scan_next_n()
+        self._active_path = ""
+        self._active = None
+        self._open_segment()
+
+    def _scan_next_n(self) -> int:
+        n = 0
+        for path in history_files(self.directory):
+            name = os.path.basename(path)
+            if not name.startswith(self._prefix):
+                continue
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                size = 0
+            self._own.append((path, size))
+            self._closed_bytes += size
+            try:
+                n = max(n, int(name[len(self._prefix):-len(_SEG_SUFFIX)]))
+            except ValueError:
+                pass
+        return n + 1
+
+    def _open_segment(self) -> None:
+        self._active_path = os.path.join(
+            self.directory, f"{self._prefix}{self._next_n:08d}{_SEG_SUFFIX}")
+        self._next_n += 1
+        # CRC-framed append-only segment: torn tails are detected by frame
+        # CRC and tolerated by tail_frames, the same discipline as the
+        # WAL/trace spool (no fsync — history is diagnostics)
+        self._active = open(self._active_path, "ab")
+        self._active.write(MAGIC)
+        self._active.flush()
+
+    def _own_bytes(self) -> int:
+        try:
+            active = self._active.tell()
+        except (OSError, ValueError):  # pragma: no cover
+            active = 0
+        return self._closed_bytes + active
+
+    def append(self, record: dict[str, Any]) -> None:
+        """Frame + flush one snapshot (no fsync: history is diagnostics;
+        data handed to the kernel survives SIGKILL, and a power cut costs
+        at most the tail snapshots). Raises OSError/ValueError on I/O
+        failure — the recorder catches and counts."""
+        payload = json.dumps(record, separators=(",", ":"),
+                             default=str).encode()
+        with self._lock:
+            write_frame(self._active, payload)
+            self._active.flush()
+            if self._active.tell() >= self.segment_bytes:
+                size = self._active.tell()
+                self._active.close()
+                self._own.append((self._active_path, size))
+                self._closed_bytes += size
+                self._open_segment()
+            while self._own and self._own_bytes() > self.max_bytes:
+                victim, size = self._own.pop(0)
+                self._closed_bytes -= size
+                try:
+                    os.remove(victim)
+                except OSError:  # pragma: no cover - already gone
+                    pass
+                EVICTED.inc()
+            HISTORY_BYTES.set(self._own_bytes())
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._active.flush()
+                self._active.close()
+            except (OSError, ValueError):  # pragma: no cover
+                pass
+
+
+# ---------------------------------------------------------------------------
+# snapshot construction
+# ---------------------------------------------------------------------------
+
+def snapshot_registry(service: str,
+                      ts: Optional[float] = None) -> dict[str, Any]:
+    """One history record from the live registry, via the SAME strict
+    text round-trip a scraper would do. ``ts`` is a unix timestamp
+    (injectable for deterministic tests)."""
+    text = REGISTRY.expose()
+    parsed = parse_prometheus_text(text)
+    samples: list[list] = []
+    types: dict[str, str] = {}
+    for family, data in parsed.items():
+        if data["type"]:
+            types[family] = data["type"]
+        for name, labels, value in data["samples"]:
+            samples.append([name, labels, value])
+    if ts is None:
+        ts = time.time()  # epoch: history records are cross-process series
+    return {"t": ts, "service": service, "samples": samples, "types": types}
+
+
+class HistoryRecorder:
+    """Background self-scrape loop + bounded in-memory ring.
+
+    ``store=None`` runs ring-only (the SLO engine needs recent history
+    even when durable history is off). ``record_once`` is public so tests
+    and the SLO chaos suite drive snapshots with injected timestamps and
+    zero wall sleeps."""
+
+    def __init__(self, service: str, store: Optional[HistoryStore] = None,
+                 interval_sec: float = DEFAULT_INTERVAL_MS / 1000.0,
+                 ring_size: int = RING_SIZE):
+        self.service = service
+        self.store = store
+        self.interval_sec = max(0.05, interval_sec)
+        self._ring: collections.deque = collections.deque(maxlen=ring_size)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="pio-history-recorder")
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_sec):
+            self.record_once()
+
+    def record_once(self, ts: Optional[float] = None) -> Optional[dict]:
+        """Scrape + archive one snapshot; returns the record (None on
+        scrape failure). Failures are counted, never raised."""
+        try:
+            record = snapshot_registry(self.service, ts=ts)
+        except (MetricError, Exception):  # noqa: BLE001 - must not kill loop
+            HISTORY_ERRORS.inc()
+            logger.exception("history self-scrape failed")
+            return None
+        self._ring.append(record)
+        if self.store is not None:
+            try:
+                self.store.append(record)
+            except (OSError, ValueError):
+                HISTORY_ERRORS.inc()
+                logger.warning("history append failed", exc_info=True)
+        SNAPSHOTS.inc()
+        return record
+
+    def recent(self, since: Optional[float] = None) -> list[dict]:
+        records = list(self._ring)
+        if since is not None:
+            records = [r for r in records if r["t"] >= since]
+        return records
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=timeout)
+        if self.store is not None:
+            self.store.close()
+
+
+# ---------------------------------------------------------------------------
+# readers + series math
+# ---------------------------------------------------------------------------
+
+def read_history(directory: str, since: Optional[float] = None,
+                 ) -> list[dict[str, Any]]:
+    """Every complete snapshot in ``directory``'s segments (any service,
+    any pid), merged and sorted by timestamp. Torn tails ("waiting") are
+    the live-writer artifact and simply end that segment's scan; corrupt
+    complete frames are logged and end it too — everything before them
+    still contributes."""
+    out: list[dict[str, Any]] = []
+    for path in history_files(directory):
+        try:
+            records, _, status = tail_frames(path)
+        except OSError:
+            continue
+        if status == "corrupt":
+            logger.warning("history segment %s: corrupt frame — keeping "
+                           "the valid prefix", path)
+        for _, rec in records:
+            if isinstance(rec, dict) and "t" in rec and "samples" in rec:
+                if since is None or rec["t"] >= since:
+                    out.append(rec)
+    out.sort(key=lambda r: r["t"])
+    return out
+
+
+def merged_types(records: Iterable[dict]) -> dict[str, str]:
+    types: dict[str, str] = {}
+    for rec in records:
+        types.update(rec.get("types") or {})
+    return types
+
+
+def _labels_match(labels: dict, where: Optional[dict]) -> bool:
+    if not where:
+        return True
+    return all(labels.get(k) == v for k, v in where.items())
+
+
+def series(records: Iterable[dict], name: str,
+           where: Optional[dict[str, str]] = None,
+           service: Optional[str] = None) -> list[tuple[float, float]]:
+    """``(t, value)`` per snapshot for sample ``name``, summed across the
+    label sets matching ``where`` (and optionally one writing service) —
+    the scalar view rate/quantile math runs on."""
+    out: list[tuple[float, float]] = []
+    for rec in records:
+        if service is not None and rec.get("service") != service:
+            continue
+        total = None
+        for s_name, labels, value in rec["samples"]:
+            if s_name == name and _labels_match(labels, where):
+                total = (total or 0.0) + value
+        if total is not None:
+            out.append((rec["t"], total))
+    return out
+
+
+def rate_series(points: list[tuple[float, float]],
+                ) -> list[tuple[float, float]]:
+    """Per-second rates between adjacent counter samples. A negative delta
+    is a counter reset (process restart): the new absolute value IS the
+    delta since the reset."""
+    out: list[tuple[float, float]] = []
+    for (t0, v0), (t1, v1) in zip(points, points[1:]):
+        dt = t1 - t0
+        if dt <= 0:
+            continue
+        delta = v1 - v0 if v1 >= v0 else v1
+        out.append((t1, delta / dt))
+    return out
+
+
+def value_at(points: list[tuple[float, float]], ts: float,
+             ) -> Optional[float]:
+    """Latest sample value at or before ``ts`` (None when the series
+    starts after it)."""
+    best = None
+    for t, v in points:
+        if t <= ts:
+            best = v
+        else:
+            break
+    return best
+
+
+def window_delta(points: list[tuple[float, float]], now: float,
+                 window_sec: float) -> Optional[float]:
+    """Counter increase over ``[now - window_sec, now]``. Counter resets
+    clamp to the post-reset absolute value; None when the series has no
+    sample inside the window."""
+    end = value_at(points, now)
+    if end is None:
+        return None
+    start = value_at(points, now - window_sec)
+    if start is None:
+        # the series began inside the window; counters start at 0, so
+        # everything counted so far happened in the window
+        start = 0.0
+    return end - start if end >= start else end
+
+
+def histogram_quantile_series(
+        records: list[dict], family: str, q: float = 0.99,
+        where: Optional[dict[str, str]] = None,
+        service: Optional[str] = None) -> list[tuple[float, float]]:
+    """Estimated quantile of a histogram family between adjacent
+    snapshots: per-bucket deltas -> ``bucket_quantiles`` interpolation
+    (the ``histogram_quantile`` estimate over each interval)."""
+    per_ts: list[tuple[float, dict[float, float]]] = []
+    bucket_name = f"{family}_bucket"
+    for rec in records:
+        if service is not None and rec.get("service") != service:
+            continue
+        cums: dict[float, float] = {}
+        for s_name, labels, value in rec["samples"]:
+            if s_name != bucket_name or "le" not in labels:
+                continue
+            flt = dict(labels)
+            le_raw = flt.pop("le")
+            if not _labels_match(flt, where):
+                continue
+            le = float({"+Inf": "inf"}.get(le_raw, le_raw))
+            cums[le] = cums.get(le, 0.0) + value
+        if cums:
+            per_ts.append((rec["t"], cums))
+    out: list[tuple[float, float]] = []
+    for (t0, c0), (t1, c1) in zip(per_ts, per_ts[1:]):
+        deltas = []
+        reset = any(c1.get(le, 0.0) < c0.get(le, 0.0) for le in c1)
+        for le in sorted(c1):
+            prev = 0.0 if reset else c0.get(le, 0.0)
+            deltas.append((le, max(0.0, c1[le] - prev)))
+        if deltas and deltas[-1][1] > 0:
+            out.append((t1, bucket_quantiles(deltas, (q,))[f"p{int(q*100)}"]))
+    return out
+
+
+def list_series(records: Iterable[dict],
+                pattern: Optional[str] = None) -> list[str]:
+    """Distinct sample names across records, optionally fnmatch-filtered
+    (``--series 'pio_http_*'``)."""
+    names: set[str] = set()
+    for rec in records:
+        for s_name, _, _ in rec["samples"]:
+            names.add(s_name)
+    if pattern:
+        names = {n for n in names if fnmatch.fnmatch(n, pattern)}
+    return sorted(names)
+
+
+# ---------------------------------------------------------------------------
+# process-wide wiring
+# ---------------------------------------------------------------------------
+
+_STATE_LOCK = threading.Lock()
+_RECORDER: Optional[HistoryRecorder] = None
+
+
+def _float_env(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        logger.warning("ignoring non-numeric %s=%r", name, raw)
+        return default
+
+
+def configure_history_from_env(service: str,
+                               ring_only: bool = False,
+                               ) -> Optional[HistoryRecorder]:
+    """Apply PIO_HISTORY_* to this process: start the self-scrape recorder
+    with a durable store when ``PIO_HISTORY_DIR`` is set. With the dir
+    unset: no recorder (``ring_only=False`` — the default off state costs
+    nothing) unless ``ring_only=True``, which starts a memory-only
+    recorder (the SLO engine's fallback). Idempotent; last call wins."""
+    global _RECORDER
+    with _STATE_LOCK:
+        if _RECORDER is not None:
+            _RECORDER.stop()
+            _RECORDER = None
+        directory = os.environ.get(ENV_DIR)
+        if not directory and not ring_only:
+            return None
+        store = None
+        if directory:
+            try:
+                store = HistoryStore(
+                    directory, service=service,
+                    segment_bytes=int(_float_env(
+                        ENV_SEGMENT_BYTES, DEFAULT_SEGMENT_BYTES)),
+                    max_bytes=int(_float_env(
+                        ENV_MAX_BYTES, DEFAULT_MAX_BYTES)))
+            except OSError as e:
+                # unwritable dir degrades to ring-only — history is
+                # diagnostics, never a reason to refuse to serve
+                logger.error("metrics history degraded to memory-only "
+                             "(cannot open %s: %s)", directory, e)
+                HISTORY_ERRORS.inc()
+        _RECORDER = HistoryRecorder(
+            service, store=store,
+            interval_sec=_float_env(
+                ENV_INTERVAL_MS, DEFAULT_INTERVAL_MS) / 1000.0)
+        _RECORDER.start()
+        logger.info(
+            "metrics history: %s (service=%s interval=%.0fms)",
+            store.directory if store is not None else "memory-only",
+            service, _RECORDER.interval_sec * 1000)
+        return _RECORDER
+
+
+def configured_recorder() -> Optional[HistoryRecorder]:
+    return _RECORDER
+
+
+def close_history() -> None:
+    """Stop the recorder and close its store (tests, bench lanes)."""
+    global _RECORDER
+    with _STATE_LOCK:
+        if _RECORDER is not None:
+            _RECORDER.stop()
+            _RECORDER = None
+
+
+__all__ = [
+    "ENV_DIR", "ENV_INTERVAL_MS", "ENV_SEGMENT_BYTES", "ENV_MAX_BYTES",
+    "HistoryStore", "HistoryRecorder", "history_files",
+    "snapshot_registry", "read_history", "merged_types",
+    "series", "rate_series", "value_at", "window_delta",
+    "histogram_quantile_series", "list_series",
+    "configure_history_from_env", "configured_recorder", "close_history",
+]

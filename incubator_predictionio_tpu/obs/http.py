@@ -28,6 +28,8 @@ import time
 
 from aiohttp import web
 
+from incubator_predictionio_tpu.obs import history as _history
+from incubator_predictionio_tpu.obs import profile as _profile
 from incubator_predictionio_tpu.obs import trace
 from incubator_predictionio_tpu.obs.metrics import REGISTRY
 
@@ -171,9 +173,32 @@ async def handle_traces(request: web.Request) -> web.Response:
     return web.json_response({"traces": trace.TRACES.traces(limit)})
 
 
+async def handle_profile(request: web.Request) -> web.Response:
+    """``GET /profile.json`` — the continuous profiler's live document:
+    phase aggregates, wall-stack top-N (when PIO_PROFILE_HZ > 0), training
+    MFU, device-memory watermarks (``pio-tpu profile <url>``)."""
+    return web.json_response(_profile.profile_payload())
+
+
+async def handle_history(request: web.Request) -> web.Response:
+    """``GET /history.json`` — the in-memory ring of self-scraped metric
+    snapshots (``pio-tpu history <url>``; the durable segments under
+    PIO_HISTORY_DIR hold the long tail)."""
+    since_raw = request.query.get("since")
+    try:
+        since = float(since_raw) if since_raw is not None else None
+    except ValueError:
+        return web.json_response({"message": "invalid since"}, status=400)
+    rec = _history.configured_recorder()
+    records = [] if rec is None else rec.recent(since=since)
+    return web.json_response({"records": records})
+
+
 def add_observability_routes(app: web.Application) -> None:
     app.router.add_get("/metrics", handle_metrics)
     app.router.add_get("/traces.json", handle_traces)
+    app.router.add_get("/profile.json", handle_profile)
+    app.router.add_get("/history.json", handle_history)
 
 
 # ---------------------------------------------------------------------------

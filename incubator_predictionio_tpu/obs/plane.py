@@ -1,0 +1,46 @@
+"""One-call boot/teardown for the continuous performance plane.
+
+Every long-running process wires the same four pieces at startup — process
+self-metrics (:mod:`.procstats`), the always-on profiler
+(:mod:`.profile`), the durable metrics history (:mod:`.history`), and the
+SLO burn-rate engine (:mod:`.slo`). This module is that one call, placed
+next to ``spool.configure_export_from_env`` at each boot seam so a new
+process kind cannot accidentally wire half the plane.
+
+Order matters only once: history before slo, because the SLO engine
+evaluates over the history recorder's ring and will start a ring-only
+recorder itself when none is configured — configuring history first means
+that fallback never shadows an operator's ``PIO_HISTORY_DIR``.
+"""
+
+from __future__ import annotations
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def configure_perf_plane_from_env(service: str) -> None:
+    """Apply the PIO_PROFILE_* / PIO_HISTORY_* / PIO_SLO_* env state to this
+    process (idempotent; last call wins, like the spool seam it sits next
+    to). Each piece degrades independently — a bad SLO config or an
+    unwritable history dir logs and disables that piece only."""
+    from incubator_predictionio_tpu.obs import history, procstats, profile, slo
+
+    procstats.register(service)
+    profile.configure_profiler_from_env(service)
+    history.configure_history_from_env(service)
+    slo.configure_slo_from_env(service)
+
+
+def close_perf_plane() -> None:
+    """Stop the plane's background threads and flush the history segment
+    (shutdown paths, bench lanes, tests). Reverse boot order."""
+    from incubator_predictionio_tpu.obs import history, profile, slo
+
+    slo.close_slo()
+    history.close_history()
+    profile.close_profiler()
+
+
+__all__ = ["configure_perf_plane_from_env", "close_perf_plane"]

@@ -1,0 +1,109 @@
+"""Process runtime self-metrics: RSS, open FDs, event-loop lag
+(``pio_process_*``; docs/observability.md).
+
+The latency histograms show loop stalls only indirectly (every in-flight
+request gets slower at once); these gauges give SLOs and the history store
+the direct signals — memory growth, FD leaks, and a starved asyncio loop:
+
+- RSS and open-FD counts are read at exposition time via the keyed
+  ``procstats`` collector (Linux ``/proc/self`` fast paths with a
+  ``resource``-module fallback, so a scrape never pays more than two tiny
+  reads);
+- loop lag is measured by a cooperative task per server event loop: sleep
+  ``interval``, compare against the loop clock, publish the overshoot.
+  A blocked loop can't run the task, so the NEXT wakeup reports the full
+  stall — exactly the signal a liveness probe misses.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+from typing import Optional
+
+from incubator_predictionio_tpu.obs.metrics import REGISTRY
+
+logger = logging.getLogger(__name__)
+
+_PAGE_SIZE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
+
+RSS_BYTES = REGISTRY.gauge(
+    "pio_process_rss_bytes",
+    "Resident set size of this process (sampled at exposition time)")
+OPEN_FDS = REGISTRY.gauge(
+    "pio_process_open_fds",
+    "Open file descriptors of this process (sampled at exposition time)")
+LOOP_LAG = REGISTRY.gauge(
+    "pio_process_loop_lag_seconds",
+    "Most recent asyncio event-loop lag sample per server (scheduling "
+    "overshoot of a periodic cooperative task; a starved loop reports the "
+    "full stall on its next wakeup)", labels=("service",))
+
+
+def rss_bytes() -> Optional[int]:
+    """Current RSS in bytes (``/proc/self/statm``; ``resource`` peak-RSS
+    fallback off-Linux). None when neither source is available."""
+    try:
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * _PAGE_SIZE
+    except (OSError, ValueError, IndexError):
+        pass
+    try:
+        import resource
+
+        # ru_maxrss is KiB on Linux, bytes on macOS; either way it is the
+        # peak, not current — good enough as a degraded fallback
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+    except Exception:  # noqa: BLE001 - diagnostics only
+        return None
+
+
+def open_fd_count() -> Optional[int]:
+    """Open descriptor count (``/proc/self/fd``). None off-procfs."""
+    try:
+        return len(os.listdir("/proc/self/fd"))
+    except OSError:
+        return None
+
+
+def _collect() -> None:
+    rss = rss_bytes()
+    if rss is not None:
+        RSS_BYTES.set(rss)
+    fds = open_fd_count()
+    if fds is not None:
+        OPEN_FDS.set(fds)
+
+
+def register(service: str = "proc") -> None:
+    """Install the exposition-time collector. Keyed ``procstats`` — a
+    re-constructed server replaces its predecessor's, and the gauges are
+    process-wide truths regardless of which server registered last."""
+    REGISTRY.add_collector("procstats", _collect)
+
+
+async def loop_lag_monitor(service: str,
+                           interval_sec: float = 0.5) -> None:
+    """Run forever on the server's loop, publishing scheduling overshoot
+    to ``pio_process_loop_lag_seconds{service=...}``. Cancellation-clean —
+    servers cancel the task at shutdown."""
+    loop = asyncio.get_running_loop()
+    gauge = LOOP_LAG.labels(service=service)
+    while True:
+        t0 = loop.time()
+        await asyncio.sleep(interval_sec)
+        gauge.set(max(0.0, loop.time() - t0 - interval_sec))
+
+
+def start_loop_lag(service: str,
+                   interval_sec: float = 0.5) -> "asyncio.Task":
+    """Spawn :func:`loop_lag_monitor` on the current running loop and
+    return the task (caller owns cancellation)."""
+    return asyncio.get_running_loop().create_task(
+        loop_lag_monitor(service, interval_sec),
+        name=f"loop-lag-{service}")
+
+
+__all__ = ["rss_bytes", "open_fd_count", "register",
+           "loop_lag_monitor", "start_loop_lag"]

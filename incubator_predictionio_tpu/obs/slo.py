@@ -1,0 +1,489 @@
+"""Declarative SLO engine: multi-window burn rates over the metrics
+history (docs/observability.md "Metrics history & SLOs").
+
+``conf/slo.json`` declares objectives against the telemetry middleware's
+per-route families (``pio_http_requests_total`` /
+``pio_http_request_seconds``):
+
+- ``availability`` — fraction of requests that did not 5xx;
+- ``latency`` — fraction of requests under ``threshold_ms``.
+
+Each objective is evaluated as the standard multi-window, multi-burn-rate
+alert: the error ratio over a SHORT and a LONG window (defaults: fast pair
+5m/1h at burn 14.4, slow pair 1h/6h at burn 6) divided by the error budget
+``1 - objective``. A pair breaches only when BOTH its windows exceed the
+threshold — the short window makes the alert fast, the long window keeps a
+brief blip from paging. Evaluation reads history snapshots (the recorder's
+in-memory ring live; segment files for ``pio-tpu slo <dir>``), needs only
+the records nearest each window boundary, and takes "now" from the newest
+record — so the whole engine is driven by data timestamps, deterministic
+under FakeClock-stamped records, zero wall sleeps.
+
+Surfaces: ``pio_slo_burn_rate{slo,window}`` / ``pio_slo_breaching{slo}`` /
+``pio_slo_budget_remaining{slo}`` gauges (exposition-time collector), a
+``slo`` block in every server's ``/health`` (red rows in ``pio-tpu
+health``), and the ``pio-tpu slo`` verdict/``--check`` verbs. ``--check``
+is schema validation with NAMED positions (``objectives[2].windows.fast:
+…``) so a malformed checked-in config fails CI with a pointer, not a
+traceback.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import math
+import os
+import threading
+from typing import Any, Callable, Optional
+
+from incubator_predictionio_tpu.obs import history
+from incubator_predictionio_tpu.obs.metrics import REGISTRY
+
+logger = logging.getLogger(__name__)
+
+#: env knob (docs/configuration.md "SLO engine")
+ENV_CONFIG = "PIO_SLO_CONFIG"
+
+DEFAULT_WINDOWS = {"fast": [300.0, 3600.0], "slow": [3600.0, 21600.0]}
+DEFAULT_BURN_THRESHOLDS = {"fast": 14.4, "slow": 6.0}
+
+_TOP_KEYS = {"objectives"}
+_OBJECTIVE_KEYS = {"name", "service", "type", "objective", "threshold_ms",
+                   "route", "windows", "burn_thresholds"}
+_WINDOW_KEYS = {"fast", "slow"}
+
+SLO_BURN = REGISTRY.gauge(
+    "pio_slo_burn_rate",
+    "Error-budget burn rate per objective and window (error ratio over "
+    "the window / (1 - objective); 1.0 = spending exactly the budget)",
+    labels=("slo", "window"))
+SLO_BREACHING = REGISTRY.gauge(
+    "pio_slo_breaching",
+    "1 when any of the objective's window pairs exceeds its burn "
+    "threshold on BOTH windows, else 0", labels=("slo",))
+SLO_BUDGET = REGISTRY.gauge(
+    "pio_slo_budget_remaining",
+    "Fraction of the error budget left over the slow long window "
+    "(negative = overspent)", labels=("slo",))
+
+
+class SloConfigError(ValueError):
+    """Invalid SLO config; ``errors`` lists named positions."""
+
+    def __init__(self, errors: list[str]):
+        super().__init__("; ".join(errors))
+        self.errors = errors
+
+
+# ---------------------------------------------------------------------------
+# config load + validation (named positions)
+# ---------------------------------------------------------------------------
+
+def _validate_window_pair(pos: str, pair: Any, errors: list[str]) -> None:
+    if (not isinstance(pair, (list, tuple)) or len(pair) != 2
+            or not all(isinstance(v, (int, float)) and not isinstance(v, bool)
+                       for v in pair)):
+        errors.append(f"{pos}: must be [short_seconds, long_seconds]")
+        return
+    short, long_ = pair
+    if short <= 0 or long_ <= 0:
+        errors.append(f"{pos}: windows must be positive seconds")
+    elif short >= long_:
+        errors.append(f"{pos}: non-monotonic — short window {short:g}s must "
+                      f"be < long window {long_:g}s")
+
+
+def validate_config(doc: Any) -> list[str]:
+    """Every schema violation as a ``position: problem`` string; an empty
+    list means valid."""
+    errors: list[str] = []
+    if not isinstance(doc, dict):
+        return ["top-level: must be an object with an \"objectives\" list"]
+    for key in sorted(set(doc) - _TOP_KEYS):
+        errors.append(f"top-level: unknown key {key!r}")
+    objectives = doc.get("objectives")
+    if not isinstance(objectives, list):
+        errors.append("objectives: must be a list")
+        return errors
+    seen_names: set[str] = set()
+    for i, obj in enumerate(objectives):
+        pos = f"objectives[{i}]"
+        if not isinstance(obj, dict):
+            errors.append(f"{pos}: must be an object")
+            continue
+        for key in sorted(set(obj) - _OBJECTIVE_KEYS):
+            errors.append(f"{pos}: unknown key {key!r}")
+        name = obj.get("name")
+        if not isinstance(name, str) or not name:
+            errors.append(f"{pos}.name: required non-empty string")
+        elif name in seen_names:
+            errors.append(f"{pos}.name: duplicate objective name {name!r}")
+        else:
+            seen_names.add(name)
+        if not isinstance(obj.get("service"), str) or not obj.get("service"):
+            errors.append(f"{pos}.service: required non-empty string")
+        typ = obj.get("type")
+        if typ not in ("availability", "latency"):
+            errors.append(f"{pos}.type: must be \"availability\" or "
+                          f"\"latency\" (got {typ!r})")
+        objective = obj.get("objective")
+        if (not isinstance(objective, (int, float))
+                or isinstance(objective, bool)):
+            errors.append(f"{pos}.objective: required number in (0, 1)")
+        elif objective >= 1:
+            errors.append(f"{pos}.objective: {objective:g} is >= 1 (100%) — "
+                          "a perfect objective has no error budget to burn")
+        elif objective <= 0:
+            errors.append(f"{pos}.objective: {objective:g} must be > 0")
+        thr = obj.get("threshold_ms")
+        if typ == "latency":
+            if (not isinstance(thr, (int, float)) or isinstance(thr, bool)
+                    or thr <= 0):
+                errors.append(f"{pos}.threshold_ms: latency objectives "
+                              "require a positive threshold_ms")
+        elif thr is not None:
+            errors.append(f"{pos}.threshold_ms: only valid for latency "
+                          "objectives")
+        route = obj.get("route")
+        if route is not None and (not isinstance(route, str) or not route):
+            errors.append(f"{pos}.route: must be a non-empty string")
+        windows = obj.get("windows")
+        if windows is not None:
+            if not isinstance(windows, dict):
+                errors.append(f"{pos}.windows: must be an object with "
+                              "\"fast\"/\"slow\" pairs")
+            else:
+                for key in sorted(set(windows) - _WINDOW_KEYS):
+                    errors.append(f"{pos}.windows: unknown key {key!r}")
+                for wname in _WINDOW_KEYS & set(windows):
+                    _validate_window_pair(f"{pos}.windows.{wname}",
+                                          windows[wname], errors)
+                fast = windows.get("fast", DEFAULT_WINDOWS["fast"])
+                slow = windows.get("slow", DEFAULT_WINDOWS["slow"])
+                if (isinstance(fast, (list, tuple)) and len(fast) == 2
+                        and isinstance(slow, (list, tuple)) and len(slow) == 2
+                        and all(isinstance(v, (int, float))
+                                for v in (*fast, *slow))
+                        and fast[1] > slow[1]):
+                    errors.append(
+                        f"{pos}.windows: non-monotonic — fast long window "
+                        f"{fast[1]:g}s must be <= slow long window "
+                        f"{slow[1]:g}s")
+        burns = obj.get("burn_thresholds")
+        if burns is not None:
+            if not isinstance(burns, dict):
+                errors.append(f"{pos}.burn_thresholds: must be an object")
+            else:
+                for key in sorted(set(burns) - _WINDOW_KEYS):
+                    errors.append(f"{pos}.burn_thresholds: unknown key "
+                                  f"{key!r}")
+                for wname, v in burns.items():
+                    if wname in _WINDOW_KEYS and (
+                            not isinstance(v, (int, float))
+                            or isinstance(v, bool) or v <= 0):
+                        errors.append(f"{pos}.burn_thresholds.{wname}: must "
+                                      "be a positive number")
+    return errors
+
+
+def normalize(obj: dict) -> dict:
+    """One objective with defaults applied (validated input assumed)."""
+    out = dict(obj)
+    windows = {**DEFAULT_WINDOWS, **(obj.get("windows") or {})}
+    out["windows"] = {k: [float(v[0]), float(v[1])]
+                      for k, v in windows.items()}
+    out["burn_thresholds"] = {**DEFAULT_BURN_THRESHOLDS,
+                              **(obj.get("burn_thresholds") or {})}
+    return out
+
+
+def load_config(path: str) -> list[dict]:
+    """Parse + validate ``path``; returns normalized objectives or raises
+    :class:`SloConfigError` with named positions (JSON syntax errors are
+    position-named too)."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except OSError as e:
+        raise SloConfigError([f"{path}: {e}"]) from e
+    except ValueError as e:
+        raise SloConfigError(
+            [f"{path}: invalid JSON — {e}"]) from e
+    errors = validate_config(doc)
+    if errors:
+        raise SloConfigError(errors)
+    return [normalize(o) for o in doc["objectives"]]
+
+
+# ---------------------------------------------------------------------------
+# evaluation
+# ---------------------------------------------------------------------------
+
+def _record_at(records: list[dict], ts: float) -> Optional[dict]:
+    """Newest record with ``t <= ts`` (records sorted ascending)."""
+    best = None
+    for rec in records:
+        if rec["t"] <= ts:
+            best = rec
+        else:
+            break
+    return best
+
+
+def _counter_sum(rec: Optional[dict], name: str, service: str,
+                 route: Optional[str],
+                 status_pred: Optional[Callable[[str], bool]] = None,
+                 ) -> Optional[float]:
+    if rec is None:
+        return None
+    total = None
+    for s_name, labels, value in rec["samples"]:
+        if s_name != name or labels.get("service") != service:
+            continue
+        if route is not None and labels.get("route") != route:
+            continue
+        if status_pred is not None and not status_pred(
+                labels.get("status", "")):
+            continue
+        total = (total or 0.0) + value
+    return total
+
+
+def _bucket_sums(rec: Optional[dict], family: str, service: str,
+                 route: Optional[str]) -> dict[float, float]:
+    out: dict[float, float] = {}
+    if rec is None:
+        return out
+    bucket_name = f"{family}_bucket"
+    for s_name, labels, value in rec["samples"]:
+        if s_name != bucket_name or labels.get("service") != service:
+            continue
+        if route is not None and labels.get("route") != route:
+            continue
+        le_raw = labels.get("le")
+        if le_raw is None:
+            continue
+        le = float({"+Inf": "inf"}.get(le_raw, le_raw))
+        out[le] = out.get(le, 0.0) + value
+    return out
+
+
+def _delta(end: Optional[float], start: Optional[float]) -> Optional[float]:
+    if end is None:
+        return None
+    if start is None or end < start:  # gap or counter reset
+        return end
+    return end - start
+
+
+def error_ratio(obj: dict, records: list[dict], now: float,
+                window_sec: float) -> Optional[float]:
+    """Error ratio of one objective over ``[now - window_sec, now]``.
+    ``None`` = no data at all; no traffic in the window reads 0.0 (an idle
+    service cannot burn budget)."""
+    end = _record_at(records, now)
+    start = _record_at(records, now - window_sec)
+    if end is None:
+        return None
+    service, route = obj["service"], obj.get("route")
+    if obj["type"] == "availability":
+        name = "pio_http_requests_total"
+        is_err = lambda s: s.startswith("5")  # noqa: E731
+        tot = _delta(_counter_sum(end, name, service, route),
+                     _counter_sum(start, name, service, route))
+        if tot is None:
+            return None
+        if tot <= 0:
+            return 0.0
+        err = _delta(_counter_sum(end, name, service, route, is_err),
+                     _counter_sum(start, name, service, route, is_err))
+        return max(0.0, min(1.0, (err or 0.0) / tot))
+    # latency: fraction of requests over threshold via the cumulative
+    # buckets — "good" is the cumulative count at the smallest bucket
+    # bound >= the threshold
+    family = "pio_http_request_seconds"
+    end_b = _bucket_sums(end, family, service, route)
+    if not end_b:
+        return None
+    start_b = _bucket_sums(start, family, service, route)
+    thr_sec = obj["threshold_ms"] / 1000.0
+    good_le = min((le for le in end_b if le >= thr_sec), default=math.inf)
+    tot = _delta(end_b.get(math.inf), start_b.get(math.inf))
+    if tot is None:
+        return None
+    if tot <= 0:
+        return 0.0
+    good = _delta(end_b.get(good_le), start_b.get(good_le)) or 0.0
+    return max(0.0, min(1.0, 1.0 - good / tot))
+
+
+def evaluate(objectives: list[dict], records: list[dict],
+             now: Optional[float] = None) -> list[dict[str, Any]]:
+    """One verdict per objective. ``now`` defaults to the newest record's
+    timestamp — the engine runs on data time, not wall time (deterministic
+    under FakeClock-stamped records)."""
+    if now is None and records:
+        now = records[-1]["t"]
+    out: list[dict[str, Any]] = []
+    for obj in objectives:
+        budget = 1.0 - obj["objective"]
+        verdict: dict[str, Any] = {
+            "name": obj["name"], "service": obj["service"],
+            "type": obj["type"], "objective": obj["objective"],
+            "windows": {}, "breaching": False, "no_data": False,
+        }
+        if now is None:
+            verdict["no_data"] = True
+            verdict["budget_remaining"] = None
+            out.append(verdict)
+            continue
+        any_data = False
+        for wname, (short, long_) in sorted(obj["windows"].items()):
+            threshold = obj["burn_thresholds"][wname]
+            ratios = [error_ratio(obj, records, now, w)
+                      for w in (short, long_)]
+            burns = [None if r is None else r / budget for r in ratios]
+            breaching = all(b is not None and b > threshold for b in burns)
+            any_data = any_data or any(b is not None for b in burns)
+            verdict["windows"][wname] = {
+                "short_sec": short, "long_sec": long_,
+                "burn_short": burns[0], "burn_long": burns[1],
+                "threshold": threshold, "breaching": breaching,
+            }
+            verdict["breaching"] = verdict["breaching"] or breaching
+        slow_long = obj["windows"]["slow"][1]
+        ratio_slow = error_ratio(obj, records, now, slow_long)
+        verdict["budget_remaining"] = (
+            None if ratio_slow is None
+            else round(1.0 - ratio_slow / budget, 6))
+        verdict["no_data"] = not any_data
+        out.append(verdict)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# live engine (gauges + /health block)
+# ---------------------------------------------------------------------------
+
+class SloEngine:
+    """Evaluates objectives against a records source (default: the history
+    recorder's in-memory ring) and folds verdicts into the ``pio_slo_*``
+    gauges at exposition time."""
+
+    def __init__(self, objectives: list[dict],
+                 records_fn: Optional[Callable[[], list[dict]]] = None):
+        self.objectives = objectives
+        self._records_fn = records_fn
+        self._lock = threading.Lock()
+        self._last: list[dict[str, Any]] = []
+
+    def _records(self) -> list[dict]:
+        if self._records_fn is not None:
+            return self._records_fn()
+        rec = history.configured_recorder()
+        return rec.recent() if rec is not None else []
+
+    def evaluate(self, now: Optional[float] = None) -> list[dict[str, Any]]:
+        verdicts = evaluate(self.objectives, self._records(), now=now)
+        with self._lock:
+            self._last = verdicts
+        return verdicts
+
+    def collect(self) -> None:
+        """Exposition-time collector: refresh verdicts, set gauges."""
+        for v in self.evaluate():
+            SLO_BREACHING.labels(slo=v["name"]).set(
+                1.0 if v["breaching"] else 0.0)
+            if v["budget_remaining"] is not None:
+                SLO_BUDGET.labels(slo=v["name"]).set(v["budget_remaining"])
+            for w in v["windows"].values():
+                for sec, burn in ((w["short_sec"], w["burn_short"]),
+                                  (w["long_sec"], w["burn_long"])):
+                    if burn is not None:
+                        SLO_BURN.labels(slo=v["name"],
+                                        window=f"{sec:g}").set(burn)
+
+    def health_block(self) -> dict[str, Any]:
+        """The ``slo`` block servers embed in ``/health`` — worst news
+        first, small enough for a probe."""
+        verdicts = self.evaluate()
+        return {
+            "breaching": any(v["breaching"] for v in verdicts),
+            "objectives": [{
+                "name": v["name"],
+                "service": v["service"],
+                "breaching": v["breaching"],
+                "noData": v["no_data"],
+                "budgetRemaining": v["budget_remaining"],
+                "maxBurn": max(
+                    (b for w in v["windows"].values()
+                     for b in (w["burn_short"], w["burn_long"])
+                     if b is not None), default=None),
+            } for v in verdicts],
+        }
+
+
+# ---------------------------------------------------------------------------
+# process-wide wiring
+# ---------------------------------------------------------------------------
+
+_STATE_LOCK = threading.Lock()
+_ENGINE: Optional[SloEngine] = None
+
+
+def configure_slo_from_env(service: str) -> Optional[SloEngine]:
+    """Apply ``PIO_SLO_CONFIG`` to this process: load the objectives and
+    register the gauge collector. The engine needs recent history, so when
+    no recorder is running it starts a memory-only one. A bad config
+    disables the engine with a logged error (it does NOT refuse to serve —
+    ``pio-tpu slo --check`` in CI is where a bad config fails loudly).
+    Idempotent; last call wins."""
+    global _ENGINE
+    with _STATE_LOCK:
+        REGISTRY.remove_collector("slo")
+        _ENGINE = None
+        path = os.environ.get(ENV_CONFIG)
+        if not path:
+            return None
+        try:
+            objectives = load_config(path)
+        except SloConfigError as e:
+            logger.error("SLO engine disabled — invalid %s:\n  %s",
+                         path, "\n  ".join(e.errors))
+            return None
+        if history.configured_recorder() is None:
+            history.configure_history_from_env(service, ring_only=True)
+        _ENGINE = SloEngine(objectives)
+        REGISTRY.add_collector("slo", _ENGINE.collect)
+        logger.info("SLO engine: %d objective(s) from %s",
+                    len(objectives), path)
+        return _ENGINE
+
+
+def configured_engine() -> Optional[SloEngine]:
+    return _ENGINE
+
+
+def close_slo() -> None:
+    """Drop the engine + collector (tests, bench lanes)."""
+    global _ENGINE
+    with _STATE_LOCK:
+        REGISTRY.remove_collector("slo")
+        _ENGINE = None
+
+
+def health_block() -> Optional[dict[str, Any]]:
+    """The configured engine's ``/health`` block, or None when no SLO
+    engine is running (servers embed this unconditionally)."""
+    engine = _ENGINE
+    return engine.health_block() if engine is not None else None
+
+
+__all__ = [
+    "ENV_CONFIG", "DEFAULT_WINDOWS", "DEFAULT_BURN_THRESHOLDS",
+    "SloConfigError", "validate_config", "normalize", "load_config",
+    "error_ratio", "evaluate", "SloEngine",
+    "configure_slo_from_env", "configured_engine", "close_slo",
+    "health_block",
+]

@@ -203,8 +203,14 @@ class EventServer:
         # durable span export + sampling (obs/spool.py): applies the
         # PIO_TRACE_* env state; a no-op unless the spool dir is set
         from incubator_predictionio_tpu.obs import spool as trace_spool
+        from incubator_predictionio_tpu.obs.plane import (
+            configure_perf_plane_from_env,
+        )
 
         trace_spool.configure_export_from_env("event_server")
+        # continuous performance plane (obs/plane.py): procstats +
+        # profiler + metrics history + SLO burn-rate engine
+        configure_perf_plane_from_env("event_server")
         # -- overload protection (resilience/admission.py) ----------------
         # per-access-key token buckets: a misbehaving client is throttled
         # alone instead of starving every tenant's ingest; the drain-rate
@@ -938,9 +944,13 @@ class EventServer:
         depth = len(self._spill)
         degraded = depth > 0 or any(
             s["state"] != "closed" for s in (store, *backends.values()))
+        from incubator_predictionio_tpu.obs import slo as _slo
+
         return web.json_response({
             "status": self._drain_state.health_status(degraded),
             "draining": self._drain_state.draining,
+            # SLO burn-rate verdicts (obs/slo.py; None when no PIO_SLO_CONFIG)
+            "slo": _slo.health_block(),
             "eventStoreBreaker": store,
             "backendBreakers": backends,
             "spillQueueDepth": depth,
@@ -1038,6 +1048,10 @@ class EventServer:
         return app
 
     async def start(self) -> None:
+        from incubator_predictionio_tpu.obs import procstats
+
+        # loop-lag gauge rides this server's loop (pio_process_loop_lag_*)
+        self._loop_lag = procstats.start_loop_lag("event_server")
         # the spill drainer schedules onto this loop from executor threads
         self._loop = asyncio.get_running_loop()
         if self._spill:
@@ -1180,6 +1194,9 @@ class EventServer:
                                 if deadline_sec is None else deadline_sec))
 
     async def shutdown(self, flush_deadline_sec: float = 5.0) -> None:
+        lag = getattr(self, "_loop_lag", None)
+        if lag is not None:
+            lag.cancel()
         front = getattr(self, "_front", None)
         if front is not None:
             from incubator_predictionio_tpu import native

@@ -44,6 +44,8 @@ from incubator_predictionio_tpu.obs.http import (
     add_observability_routes,
     telemetry_middleware,
 )
+from incubator_predictionio_tpu.obs import profile as _profile
+from incubator_predictionio_tpu.obs import slo as _slo
 from incubator_predictionio_tpu.obs.metrics import (
     REGISTRY,
     LatencyReservoir,
@@ -610,6 +612,7 @@ class MicroBatcher:
                 except asyncio.CancelledError:
                     sem.release()
                     raise
+                t_phase = time.perf_counter()
                 while len(batch) < self.max_batch:
                     try:
                         batch.append(self.queue.get_nowait())
@@ -618,7 +621,9 @@ class MicroBatcher:
                 now = time.perf_counter()
                 for entry in batch:
                     self.queue_delay.record(now - entry[2])
+                t_assemble, t_phase = now - t_phase, now
                 batch = self._evict_expired(batch)
+                t_mask = time.perf_counter() - t_phase
                 if not batch:
                     # the whole assembly was dead on arrival: no dispatch,
                     # hand the slot back and keep draining
@@ -626,7 +631,8 @@ class MicroBatcher:
                     continue
                 self.batches_served += 1
                 self.max_batch_seen = max(self.max_batch_seen, len(batch))
-                task = loop.create_task(self._dispatch(loop, batch))
+                task = loop.create_task(
+                    self._dispatch(loop, batch, t_assemble, t_mask))
                 self._inflight.add(task)
                 task.add_done_callback(self._inflight.discard)
                 task.add_done_callback(lambda _t: sem.release())
@@ -673,7 +679,8 @@ class MicroBatcher:
                 self._admission.on_shed_expired(shed)
         return live
 
-    async def _dispatch(self, loop, batch) -> None:
+    async def _dispatch(self, loop, batch,
+                        t_assemble: float = 0.0, t_mask: float = 0.0) -> None:
         t0 = time.perf_counter()
         payloads = [entry[0] for entry in batch]
         # run_in_executor does not copy contextvars — run_with_deadline
@@ -697,13 +704,22 @@ class MicroBatcher:
             raise
         except Exception as e:  # noqa: BLE001 - keep serving
             results = [e] * len(batch)
-        self.dispatch_sec.record(time.perf_counter() - t0)
+        t_dispatch = time.perf_counter() - t0
+        self.dispatch_sec.record(t_dispatch)
         # predict_batch published its per-algorithm times inside ctx; writes
         # made under Context.run persist in the Context object
         algo_times = ctx.get(_DISPATCH_ALGO_TIMES, [])
+        t_merge = time.perf_counter()
         for entry, r in zip(batch, results):
             if not entry[1].done():
                 entry[1].set_result(_Delivered(r, algo_times))
+        # perf-plane phases for this batch's full life: coalesce (assemble),
+        # deadline eviction (mask), device round-trip (dispatch), future
+        # resolution (merge) — docs/observability.md "Profiling"
+        _profile.record_phases("serve.batch", {
+            "assemble": t_assemble, "mask": t_mask, "dispatch": t_dispatch,
+            "merge": time.perf_counter() - t_merge,
+        })
 
 
 # LatencyReservoir moved to obs/metrics.py (it is a general primitive the
@@ -783,8 +799,14 @@ class QueryServer:
         # durable span export + sampling (obs/spool.py): applies the
         # PIO_TRACE_* env state; a no-op unless the spool dir is set
         from incubator_predictionio_tpu.obs import spool as trace_spool
+        from incubator_predictionio_tpu.obs.plane import (
+            configure_perf_plane_from_env,
+        )
 
         trace_spool.configure_export_from_env("query_server")
+        # continuous performance plane: procstats + profiler + metrics
+        # history + SLO burn-rate engine (obs/plane.py)
+        configure_perf_plane_from_env("query_server")
         # an explicit DeployedEngine skips storage loading (tests inject
         # hand-built engines to script failure modes)
         self.deployed = deployed or load_deployed_engine(
@@ -937,6 +959,9 @@ class QueryServer:
         return web.json_response({
             "status": self._drain_state.health_status(degraded),
             "draining": self._drain_state.draining,
+            # SLO burn-rate verdicts (obs/slo.py; None when no PIO_SLO_CONFIG)
+            # — pio-tpu health paints breaching objectives red
+            "slo": _slo.health_block(),
             "servingBreaker": serving,
             "algorithmBreakers": algo,
             "backendBreakers": backends,
@@ -1843,8 +1868,11 @@ class QueryServer:
     async def start(self) -> None:
         import os
 
+        from incubator_predictionio_tpu.obs import procstats
         from incubator_predictionio_tpu.server.event_server import _ssl_context
 
+        # loop-lag gauge rides this server's loop (pio_process_loop_lag_*)
+        self._loop_lag = procstats.start_loop_lag("query_server")
         self._runner = web.AppRunner(self.make_app())
         await self._runner.setup()
         # OPT-IN for serving (measured a wash on single-core CPU: the
@@ -1955,6 +1983,9 @@ class QueryServer:
         # nothing will ever need the smaller bound again
         for task in list(self._resize_tasks):
             task.cancel()
+        lag = getattr(self, "_loop_lag", None)
+        if lag is not None:
+            lag.cancel()
         await self.batcher.stop()
         # lifecycle flush for the trace spool: the drain's last spans (the
         # 503s it answered, the final dispatches) must reach disk before

@@ -164,8 +164,14 @@ class StorageServer:
         # durable span export + sampling (obs/spool.py): applies the
         # PIO_TRACE_* env state; a no-op unless the spool dir is set
         from incubator_predictionio_tpu.obs import spool as trace_spool
+        from incubator_predictionio_tpu.obs.plane import (
+            configure_perf_plane_from_env,
+        )
 
         trace_spool.configure_export_from_env("storage_server")
+        # continuous performance plane (obs/plane.py): procstats +
+        # profiler + metrics history + SLO burn-rate engine
+        configure_perf_plane_from_env("storage_server")
         self._executor = ThreadPoolExecutor(
             max_workers=8, thread_name_prefix="pio-storage")
         self._runner: Optional[web.AppRunner] = None
@@ -290,11 +296,15 @@ class StorageServer:
         Clients see the 'draining' flip and stop routing before the
         listener goes away (their retry policy classifies the 503 as
         transient and fails over)."""
+        from incubator_predictionio_tpu.obs import slo as _slo
+
         backends = BREAKERS.snapshot()
         degraded = any(s["state"] != "closed" for s in backends.values())
         body = {
             "status": self._drain_state.health_status(degraded),
             "draining": self._drain_state.draining,
+            # SLO burn-rate verdicts (obs/slo.py; None when no PIO_SLO_CONFIG)
+            "slo": _slo.health_block(),
             "backendBreakers": backends,
             # per-client RPC fairness (docs/resilience.md "Overload &
             # admission control")
@@ -571,8 +581,11 @@ class StorageServer:
 
     # -- lifecycle ---------------------------------------------------------
     async def start(self) -> None:
+        from incubator_predictionio_tpu.obs import procstats
         from incubator_predictionio_tpu.server.event_server import _ssl_context
 
+        # loop-lag gauge rides this server's loop (pio_process_loop_lag_*)
+        self._loop_lag = procstats.start_loop_lag("storage_server")
         if self._repl is not None:
             # announce BEFORE the listener exists: a primary restarted
             # with a stale epoch learns it was deposed (and fences) before
@@ -605,6 +618,9 @@ class StorageServer:
             self._executor.shutdown(wait=False)
 
     async def shutdown(self) -> None:
+        lag = getattr(self, "_loop_lag", None)
+        if lag is not None:
+            lag.cancel()
         if self._runner is not None:
             # aiohttp's cleanup waits for handlers already in the router —
             # the in-flight-RPC half of the drain contract

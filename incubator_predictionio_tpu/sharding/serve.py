@@ -53,6 +53,7 @@ from typing import Any, Optional
 
 import numpy as np
 
+from incubator_predictionio_tpu.obs import profile as _profile
 from incubator_predictionio_tpu.serving.topk import merge_topk
 from incubator_predictionio_tpu.sharding import shard_metrics as M
 from incubator_predictionio_tpu.sharding.table import (
@@ -565,6 +566,7 @@ class ShardedServing:
         )
 
         dev = self.device
+        t_phase = time.perf_counter()
         b = len(user_idx)
         bucket = serve_bucket(max(b, 1))
         k = self.serve_k if 0 < num <= self.serve_k else num
@@ -589,20 +591,32 @@ class ShardedServing:
         M.MERGE_FANIN.observe(self.n_shards * kl)
         from incubator_predictionio_tpu.utils import jitstats
 
-        jitstats.record((
+        # phase edge: exclusion-mask / row-mask staging transfers are h2d
+        _profile.fence(mask, rmask)
+        t_h2d, t_phase = time.perf_counter() - t_phase, time.perf_counter()
+        with jitstats.dispatch_timer((
             "two_tower_topk_sharded", self.n_shards, bucket, k,
             self.spec.n_rows, rmask is not None,
-        ))
-        fn = _sharded_exact_fn(dev.mesh, k, kl, rmask is not None)
-        if rmask is not None:
-            idx, scores = fn(jnp.asarray(uidx), dev.ue_bf, dev.ub,
-                             jnp.float32(self.mean), dev.item_t, dev.bias,
-                             mask, rmask)
-        else:
-            idx, scores = fn(jnp.asarray(uidx), dev.ue_bf, dev.ub,
-                             jnp.float32(self.mean), dev.item_t, dev.bias,
-                             mask)
-        idx_h, scores_h = jax.device_get((idx, scores))
+        )):
+            fn = _sharded_exact_fn(dev.mesh, k, kl, rmask is not None)
+            if rmask is not None:
+                idx, scores = fn(jnp.asarray(uidx), dev.ue_bf, dev.ub,
+                                 jnp.float32(self.mean), dev.item_t,
+                                 dev.bias, mask, rmask)
+            else:
+                idx, scores = fn(jnp.asarray(uidx), dev.ue_bf, dev.ub,
+                                 jnp.float32(self.mean), dev.item_t,
+                                 dev.bias, mask)
+            # phase edge: the fused per-shard score+local-topk+all-gather
+            # executable is compute; the host pull after it is gather
+            _profile.fence(idx, scores)
+            t_compute, t_phase = (time.perf_counter() - t_phase,
+                                  time.perf_counter())
+            idx_h, scores_h = jax.device_get((idx, scores))
+        _profile.record_phases("shard.search", {
+            "h2d": t_h2d, "compute": t_compute,
+            "gather": time.perf_counter() - t_phase,
+        })
         return idx_h[:b, :num], scores_h[:b, :num]
 
     def _search_host(self, q, ub, num, exclude, row_mask):
@@ -616,6 +630,7 @@ class ShardedServing:
         if exclude is not None and len(exclude):
             excl_sorted = np.sort(np.asarray(exclude, np.int64))
         ids_parts, sc_parts = [], []
+        t_phase = time.perf_counter()
         row = np.arange(b)[:, None]
         for blk in self.blocks:
             n_s = blk.hi - blk.lo
@@ -643,6 +658,9 @@ class ShardedServing:
         t0 = time.perf_counter()
         idx, scores = merge_topk(cand_ids, cand_sc, num)
         M.MERGE_SEC.observe(time.perf_counter() - t0)
+        _profile.record_phases("shard.search", {
+            "compute": t0 - t_phase, "merge": time.perf_counter() - t0,
+        })
         return idx, scores
 
     def search_ivf(self, q, ub, num: int, exclude=None, row_mask=None,
@@ -660,6 +678,7 @@ class ShardedServing:
         if exclude is not None and len(exclude):
             excl_sorted = np.sort(np.asarray(exclude, np.int64))
         ids_parts, sc_parts = [], []
+        t_phase = time.perf_counter()
         for s, idx_s in enumerate(self.ivf):
             lo, hi = self.spec.shard_bounds(s)
             n_s = hi - lo
@@ -695,6 +714,9 @@ class ShardedServing:
         t0 = time.perf_counter()
         idx, scores = merge_topk(cand_ids, cand_sc, num)
         M.MERGE_SEC.observe(time.perf_counter() - t0)
+        _profile.record_phases("shard.search", {
+            "compute": t0 - t_phase, "merge": time.perf_counter() - t0,
+        })
         M.SHARD_BATCHES.inc()
         return idx, scores
 

@@ -29,11 +29,13 @@ Design points:
 from __future__ import annotations
 
 import dataclasses
+import time as _time
 from typing import Optional, Sequence
 
 import numpy as np
 
 from incubator_predictionio_tpu.data.event import Event, epoch_micros
+from incubator_predictionio_tpu.obs import profile as _profile
 from incubator_predictionio_tpu.streaming.coldstart import (
     ColdStartBuckets,
     coldstart_mode,
@@ -188,6 +190,7 @@ class DeltaTrainer:
         touched-row result and the list of poison events (dead-letter
         candidates) — the good events still fold; one bad apple never
         blocks the batch."""
+        t_phase = _time.perf_counter()
         triples: list[tuple[tuple, tuple, float, int]] = []
         poison: list[Event] = []
         skipped = ignored = 0
@@ -207,11 +210,13 @@ class DeltaTrainer:
                 continue
             max_t_us = max(max_t_us, epoch_micros(e.event_time))
             triples.append((keys[0], keys[1], rating, 0))
+        t_assemble, t_phase = _time.perf_counter() - t_phase, _time.perf_counter()
         touched: set[tuple] = set()
         for lo in range(0, len(triples), self.micro_batch):
             batch = triples[lo:lo + self.micro_batch]
             touched.update(self._step(batch))
         self.n_folded += len(triples)
+        t_compute, t_phase = _time.perf_counter() - t_phase, _time.perf_counter()
         result = FoldResult(
             user_rows={}, item_rows={}, cold_user_rows={}, cold_item_rows={},
             n_folded=len(triples), n_skipped=skipped, n_ignored=ignored,
@@ -221,6 +226,13 @@ class DeltaTrainer:
                 "cu": result.cold_user_rows, "ci": result.cold_item_rows}
         for key in touched:
             dest[key[0]][key[1]] = self.rows[key].copy()
+        # perf-plane phases: event translation (assemble), micro-batch adam
+        # steps (compute), touched-row copy-out (gather) — host numpy, so
+        # plain perf_counter spans ARE the phase truth (no device fences)
+        _profile.record_phases("stream.fold", {
+            "assemble": t_assemble, "compute": t_compute,
+            "gather": _time.perf_counter() - t_phase,
+        })
         return result, poison
 
     def _step(self, batch: list[tuple[tuple, tuple, float, int]]) -> set:

@@ -155,8 +155,14 @@ class StreamUpdater:
         # fold/ship spans reach the fleet-wide trace assembly, and
         # --obs-port (tools/cli.py) is how its registry gets scraped
         from incubator_predictionio_tpu.obs import spool as trace_spool
+        from incubator_predictionio_tpu.obs.plane import (
+            configure_perf_plane_from_env,
+        )
 
         trace_spool.configure_export_from_env("stream_updater")
+        # continuous performance plane (obs/plane.py): procstats +
+        # profiler + metrics history + SLO burn-rate engine
+        configure_perf_plane_from_env("stream_updater")
         os.makedirs(config.state_dir, exist_ok=True)
         self.model = model
         self._handle_instance_change()

@@ -662,8 +662,26 @@ def cmd_status(args, storage: Storage) -> int:
         return 1
     _out("Storage: all repositories verified (METADATA/EVENTDATA/MODELDATA).")
     _print_jobs_status(storage)
+    _print_jit_status()
     _out("Your system is all ready to go.")
     return 0
+
+
+def _print_jit_status() -> None:
+    """The compile-churn section of ``pio-tpu status``: cumulative
+    first-dispatch (compile-dominated) wall time per executable name
+    (utils/jitstats.py) — in-process truth, so it is populated when status
+    runs after a train/serve in the same process (tests, shell, bench)."""
+    from incubator_predictionio_tpu.utils import jitstats
+
+    top = jitstats.top_compiles()
+    if not top:
+        return
+    total = jitstats.compile_seconds_total()
+    _out(f"JIT compiles: {total:.2f}s first-dispatch wall across "
+         f"{jitstats.count()} cached key(s)")
+    for name, sec, n in top:
+        _out(f"  {name}: {sec:.3f}s over {n} compile(s)")
 
 
 def _print_jobs_status(storage: Storage) -> None:
@@ -931,10 +949,20 @@ def _health_row(url: str, h: Optional[dict], err: Optional[str]) -> dict:
             parts.append(f"lag {repl['lagBytes']}B"
                          + (" EXCEEDED" if repl["lagExceeded"] else ""))
         repl_red = repl["red"]
+    # SLO burn-rate verdicts (obs/slo.py): a breaching objective turns the
+    # row red even while the server itself answers "ok" — error budget is
+    # burning NOW regardless of breaker state
+    slo = h.get("slo") or {}
+    slo_red = bool(slo.get("breaching"))
+    if slo_red:
+        bad = [o.get("name", "?") for o in slo.get("objectives", [])
+               if o.get("breaching")]
+        parts.append("SLO BREACH: " + ", ".join(bad[:4]))
     status = h.get("status", "unknown")
     return {"url": url, "status": status,
-            "red": status != "ok" or repl_red,
+            "red": status != "ok" or repl_red or slo_red,
             "replication": repl,
+            "slo": slo or None,
             "detail": "; ".join(parts)}
 
 
@@ -1454,6 +1482,306 @@ def cmd_metrics(args, storage) -> int:
     else:
         _render_metrics_fleet(pages, args)
     return 1 if failures else 0
+
+
+def _fetch_json(url: str, timeout: float = 10.0) -> dict:
+    """GET one JSON document. Module-level so tests can stub it."""
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def cmd_profile(args, storage) -> int:
+    """Fetch and render a server's ``GET /profile.json`` — the continuous
+    profiler's live document (docs/observability.md "Profiling"): per-scope
+    phase attribution (where the step time goes), the wall-stack sampler's
+    top-N (when PIO_PROFILE_HZ > 0), training MFU, and device-memory
+    watermarks."""
+    url = args.url.rstrip("/") + "/profile.json"
+    try:
+        doc = _fetch_json(url, args.timeout)
+    except Exception as e:  # noqa: BLE001 - a dead server is the answer
+        _err(f"Unable to fetch {url}: {e}")
+        return 1
+    if args.json:
+        _out(json.dumps(doc, indent=2))
+        return 0
+    _out(f"service: {doc.get('service', '?')}")
+    phases = doc.get("phases") or {}
+    if not phases:
+        _out("phases: none recorded yet")
+    for scope in sorted(phases):
+        e = phases[scope]
+        wall = e.get("wall_seconds", 0.0)
+        _out(f"{scope}: wall {wall:.3f}s over {e.get('count', 0)} scope(s)")
+        for p, ph in sorted((e.get("phases") or {}).items(),
+                            key=lambda kv: -kv[1]["seconds"]):
+            pct = 100.0 * ph["seconds"] / wall if wall else 0.0
+            _out(f"  {p:<12} {ph['seconds']:9.3f}s  {pct:5.1f}%  "
+                 f"({ph['count']} interval(s))")
+    tr = doc.get("training") or {}
+    if tr.get("mfu"):
+        peak = tr.get("peak_flops")
+        _out(f"training MFU: {tr['mfu'] * 100:.1f}%"
+             + (f" of {peak:.3g} FLOP/s peak" if peak else ""))
+    for dev, v in sorted((doc.get("deviceWatermark") or {}).items()):
+        _out(f"device {dev}: peak {v / 2**20:.1f} MiB")
+    sampler = doc.get("sampler")
+    if sampler is None:
+        _out("sampler: off (set PIO_PROFILE_HZ to enable the wall-stack "
+             "profiler)")
+        return 0
+    _out(f"sampler: {sampler['hz']:g} Hz, {sampler['samples']} sample(s)")
+    for i, row in enumerate(sampler.get("top") or [], 1):
+        stack = row.get("stack") or ["?"]
+        _out(f"  #{i:<3}{row['pct']:5.1f}%  ({row['samples']})  {stack[0]}")
+        for frame in stack[1:]:
+            _out(f"          {frame}")
+    return 0
+
+
+def _load_history_records(source: str, since, timeout: float) -> list:
+    """History records from a PIO_HISTORY_DIR (durable segments) or a
+    server base URL (the live in-memory ring via /history.json)."""
+    from incubator_predictionio_tpu.obs import history as hist
+
+    if source.startswith("http://") or source.startswith("https://"):
+        url = source.rstrip("/") + "/history.json"
+        if since is not None:
+            url += f"?since={since:g}"
+        return _fetch_json(url, timeout).get("records") or []
+    return hist.read_history(source, since=since)
+
+
+def cmd_history(args, storage) -> int:
+    """Inspect the durable metrics history (docs/observability.md "Metrics
+    history & SLOs"): a PIO_HISTORY_DIR's CRC-framed segments, or a live
+    server's in-memory ring over ``GET /history.json``. Without --series,
+    summarizes what is recorded; with --series (glob over family names),
+    prints the matching time series (counters additionally as per-interval
+    rates)."""
+    from incubator_predictionio_tpu.obs import history as hist
+
+    try:
+        records = _load_history_records(args.source, args.since, args.timeout)
+    except Exception as e:  # noqa: BLE001 - dead server / bad dir is the answer
+        _err(f"history: unable to read {args.source}: {e}")
+        return 1
+    if not records:
+        _out(f"history: no records in {args.source}")
+        return 1
+    if args.json and not args.series:
+        _out(json.dumps(records, indent=2))
+        return 0
+    services = sorted({r.get("service", "?") for r in records})
+    span = records[-1]["t"] - records[0]["t"]
+    if not args.series:
+        _out(f"{len(records)} snapshot(s) over {span:.0f}s from "
+             f"{', '.join(services)}")
+        types = hist.merged_types(records)
+        for name in hist.list_series(records):
+            count = sum(1 for r in records
+                        if any(s[0] == name for s in r["samples"]))
+            kind = types.get(name.split("_bucket")[0], "")
+            _out(f"  {name:<48} {count:>6} point(s)"
+                 + (f"  [{kind}]" if kind else ""))
+        return 0
+    types = hist.merged_types(records)
+    matched = hist.list_series(records, pattern=args.series)
+    if not matched:
+        _err(f"history: no series match {args.series!r}")
+        return 1
+    out_doc = {}
+    for name in matched:
+        points = hist.series(records, name)
+        kind = types.get(name, "")
+        if args.json:
+            out_doc[name] = points
+            continue
+        _out(f"{name}" + (f" ({kind})" if kind else ""))
+        shown = (hist.rate_series(points)
+                 if kind == "counter" and len(points) > 1 else points)
+        for t, v in shown[-args.limit:]:
+            vv = int(v) if float(v).is_integer() else round(v, 6)
+            _out(f"  {t:.0f}  {vv}")
+        if kind == "counter" and len(points) > 1:
+            _out(f"  (per-second rates; cumulative "
+                 f"{points[-1][1]:g} at t={points[-1][0]:.0f})")
+    if args.json:
+        _out(json.dumps(out_doc, indent=2))
+    return 0
+
+
+def _top_snapshot(url: str, timeout: float) -> dict:
+    """One server's 'top' row source: the parsed /metrics families."""
+    from incubator_predictionio_tpu.obs.metrics import parse_prometheus_text
+
+    return parse_prometheus_text(
+        _fetch_metrics_text(_metrics_url(url), timeout))
+
+
+def _top_row(url: str, fams: dict, prev: Optional[tuple],
+             now: float) -> tuple[str, tuple]:
+    """Render one server's top line; returns (line, state-for-next-tick).
+    qps derives from the pio_http_requests_total delta between refreshes."""
+    from incubator_predictionio_tpu.obs.metrics import bucket_quantiles
+
+    def total(family: str) -> Optional[float]:
+        fam = fams.get(family)
+        if fam is None:
+            return None
+        vals = [v for n, _l, v in fam["samples"] if n == family]
+        return sum(vals) if vals else None
+
+    reqs = total("pio_http_requests_total")
+    qps = None
+    if reqs is not None and prev is not None and now > prev[0]:
+        qps = max(0.0, (reqs - prev[1])) / (now - prev[0])
+    parts = []
+    parts.append(f"qps={qps:.1f}" if qps is not None else "qps=-")
+    lat = fams.get("pio_http_request_seconds")
+    if lat is not None:
+        merged: dict[float, float] = {}
+        for n, labels, v in lat["samples"]:
+            if n.endswith("_bucket"):
+                le = float(labels["le"])
+                merged[le] = merged.get(le, 0.0) + v
+        if merged:
+            p99 = bucket_quantiles(sorted(merged.items()), qs=(0.99,))["p99"]
+            parts.append(f"p99={p99 * 1e3:.1f}ms")
+    rss = total("pio_process_rss_bytes")
+    if rss:
+        parts.append(f"rss={rss / 2**20:.0f}MiB")
+    fds = total("pio_process_open_fds")
+    if fds:
+        parts.append(f"fds={int(fds)}")
+    lag_fam = fams.get("pio_process_loop_lag_seconds")
+    if lag_fam is not None and lag_fam["samples"]:
+        lag = max(v for _n, _l, v in lag_fam["samples"])
+        parts.append(f"lag={lag * 1e3:.1f}ms")
+    mfu = total("pio_training_mfu")
+    if mfu:
+        parts.append(f"mfu={mfu * 100:.1f}%")
+    compiles = total("pio_jit_compile_seconds_total")
+    if compiles:
+        parts.append(f"jit={compiles:.1f}s")
+    breaching = total("pio_slo_breaching")
+    mark = "ok"
+    if breaching:
+        parts.append(f"SLO_BREACH={int(breaching)}")
+        mark = "!!"
+    return f"{mark} {url}  " + " ".join(parts), (now, reqs)
+
+
+def cmd_top(args, storage) -> int:
+    """Live-refreshing one-line-per-server view of the performance plane
+    (docs/observability.md): qps (from the requests-counter delta between
+    refreshes), fleet p99, RSS/FDs/loop-lag, training MFU, cumulative jit
+    compile seconds, and SLO breach state. ``-n 1`` prints once (scripts);
+    the default refreshes until interrupted."""
+    import time as _time
+
+    prev: dict[str, tuple] = {}
+    iteration = 0
+    while True:
+        iteration += 1
+        lines = []
+        for url in args.urls:
+            now = _time.time()
+            try:
+                fams = _top_snapshot(url, args.timeout)
+            except Exception as e:  # noqa: BLE001 - a dead server is a row
+                lines.append(f"!! {url}  unreachable: {e}")
+                continue
+            line, state = _top_row(url, fams, prev.get(url), now)
+            prev[url] = state
+            lines.append(line)
+        if args.iterations != 1 and sys.stdout.isatty():
+            sys.stdout.write("\x1b[2J\x1b[H")
+        _out(_time.strftime("%H:%M:%S") + f"  refresh {iteration}")
+        for line in lines:
+            _out(line)
+        if args.iterations and iteration >= args.iterations:
+            return 0
+        try:
+            _time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+
+
+def cmd_slo(args, storage) -> int:
+    """SLO config validation and offline burn-rate verdicts
+    (docs/observability.md "Metrics history & SLOs").
+
+    ``--check <config>`` validates the objectives file and exits non-zero
+    with named-position errors on any defect — the CI gate for config
+    drift. With a history source (PIO_HISTORY_DIR or server URL), loads
+    the config (--config, else $PIO_SLO_CONFIG), evaluates every objective
+    over the recorded windows, prints the verdict table, and exits
+    non-zero when any objective is breaching."""
+    from incubator_predictionio_tpu.obs import slo as slomod
+
+    if args.check:
+        try:
+            objectives = slomod.load_config(args.check)
+        except slomod.SloConfigError as e:
+            _err(f"slo: {args.check} INVALID:")
+            for err in e.errors:
+                _err(f"  {err}")
+            return 1
+        _out(f"slo: {args.check} OK — {len(objectives)} objective(s)")
+        for o in objectives:
+            line = (f"  {o['name']}: {o['type']} on {o['service']} "
+                    f"objective={o['objective']:g}")
+            if o.get("threshold_ms") is not None:
+                line += f" threshold={o['threshold_ms']:g}ms"
+            _out(line)
+        if not args.source:
+            return 0
+    if not args.source:
+        _err("slo: give a history dir / server URL, or --check <config>")
+        return 2
+    cfg_path = args.config or (args.check if args.check else None) \
+        or os.environ.get(slomod.ENV_CONFIG)
+    if not cfg_path:
+        _err("slo: no objectives config (--config, --check, or "
+             "PIO_SLO_CONFIG)")
+        return 2
+    try:
+        objectives = slomod.load_config(cfg_path)
+    except slomod.SloConfigError as e:
+        _err(f"slo: {cfg_path} INVALID:")
+        for err in e.errors:
+            _err(f"  {err}")
+        return 1
+    try:
+        records = _load_history_records(args.source, args.since,
+                                        args.timeout)
+    except Exception as e:  # noqa: BLE001
+        _err(f"slo: unable to read {args.source}: {e}")
+        return 1
+    if not records:
+        _err(f"slo: no history records in {args.source}")
+        return 1
+    verdicts = slomod.evaluate(objectives, records)
+    if args.json:
+        _out(json.dumps(verdicts, indent=2))
+        return 1 if any(v["breaching"] for v in verdicts) else 0
+    for v in verdicts:
+        mark = "!!" if v["breaching"] else ("??" if v["no_data"] else "ok")
+        line = f"{mark} {v['name']} ({v['type']} on {v['service']})"
+        if v["budget_remaining"] is not None:
+            line += f"  budget {v['budget_remaining'] * 100:.2f}%"
+        _out(line)
+        for wname, w in sorted(v["windows"].items()):
+            bs = "-" if w["burn_short"] is None else f"{w['burn_short']:.2f}"
+            bl = "-" if w["burn_long"] is None else f"{w['burn_long']:.2f}"
+            _out(f"    {wname}: burn {bs}x/{bl}x "
+                 f"({w['short_sec']:g}s/{w['long_sec']:g}s windows, "
+                 f"threshold {w['threshold']:g}x)"
+                 + ("  BREACHING" if w["breaching"] else ""))
+    return 1 if any(v["breaching"] for v in verdicts) else 0
 
 
 def cmd_trace(args, storage) -> int:
@@ -2819,6 +3147,74 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--timeout", type=float, default=10.0,
                    help="per-server fetch timeout in seconds (default 10)")
 
+    # profile — the continuous profiler's live document
+    p = sub.add_parser(
+        "profile",
+        help="fetch and render a server's /profile.json: per-scope phase "
+             "attribution, wall-stack sampler top-N (PIO_PROFILE_HZ), "
+             "training MFU, device-memory watermarks "
+             "(docs/observability.md \"Profiling\")")
+    p.add_argument("url", help="server base URL, e.g. http://127.0.0.1:8000")
+    p.add_argument("--timeout", type=float, default=10.0)
+    p.add_argument("--json", action="store_true")
+
+    # history — durable metrics history (docs/observability.md)
+    p = sub.add_parser(
+        "history",
+        help="inspect the self-scraped metrics history: a PIO_HISTORY_DIR's "
+             "durable segments or a live server's ring via /history.json; "
+             "--series prints matching time series "
+             "(docs/observability.md \"Metrics history & SLOs\")")
+    p.add_argument("source",
+                   help="history directory (PIO_HISTORY_DIR) or server base "
+                        "URL")
+    p.add_argument("--series", metavar="GLOB",
+                   help="print series whose family name matches this glob "
+                        "(e.g. 'pio_http_*'); counters also render "
+                        "per-interval deltas")
+    p.add_argument("--since", type=float,
+                   help="only records with unix timestamp >= this")
+    p.add_argument("--limit", type=int, default=20,
+                   help="points shown per series, newest last (default 20)")
+    p.add_argument("--timeout", type=float, default=10.0)
+    p.add_argument("--json", action="store_true")
+
+    # top — live-refreshing performance-plane summary
+    p = sub.add_parser(
+        "top",
+        help="live one-line-per-server view from /metrics: qps, p99, "
+             "RSS/FDs/loop-lag, MFU, jit compile seconds, SLO breaches; "
+             "refreshes until interrupted (-n 1 prints once)")
+    p.add_argument("urls", nargs="+",
+                   help="server base URL(s), e.g. http://127.0.0.1:8000")
+    p.add_argument("-i", "--interval", type=float, default=2.0,
+                   help="seconds between refreshes (default 2)")
+    p.add_argument("-n", "--iterations", type=int, default=0,
+                   help="stop after N refreshes (default 0 = forever)")
+    p.add_argument("--timeout", type=float, default=5.0)
+
+    # slo — objectives validation + offline burn-rate verdicts
+    p = sub.add_parser(
+        "slo",
+        help="validate an SLO objectives config (--check, the CI gate) "
+             "and/or evaluate burn-rate verdicts over recorded history, "
+             "exiting non-zero on invalid config or a breaching objective "
+             "(docs/observability.md \"Metrics history & SLOs\")")
+    p.add_argument("source", nargs="?",
+                   help="history directory (PIO_HISTORY_DIR) or server base "
+                        "URL to evaluate over (omit with --check to only "
+                        "validate)")
+    p.add_argument("--check", metavar="CONFIG",
+                   help="validate this objectives JSON; exit 1 with "
+                        "named-position errors on any defect")
+    p.add_argument("--config", metavar="CONFIG",
+                   help="objectives JSON for evaluation (default: --check "
+                        "value, else $PIO_SLO_CONFIG)")
+    p.add_argument("--since", type=float,
+                   help="only records with unix timestamp >= this")
+    p.add_argument("--timeout", type=float, default=10.0)
+    p.add_argument("--json", action="store_true")
+
     # trace — cross-process trace assembly (docs/observability.md)
     tr = sub.add_parser(
         "trace",
@@ -3132,6 +3528,10 @@ _COMMANDS = {
     "metrics": cmd_metrics,
     "trace": cmd_trace,
     "health": cmd_health,
+    "profile": cmd_profile,
+    "history": cmd_history,
+    "top": cmd_top,
+    "slo": cmd_slo,
     "index": cmd_index,
     "shards": cmd_shards,
     "wal": cmd_wal,
@@ -3260,4 +3660,11 @@ def main(argv: Optional[list[str]] = None) -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    try:
+        code = main()
+        sys.stdout.flush()
+    except BrokenPipeError:
+        # downstream pager/head closed the pipe — conventional silent exit
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        code = 0
+    sys.exit(code)

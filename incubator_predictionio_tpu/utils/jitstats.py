@@ -23,14 +23,19 @@ functions are module-level with only those statics/shapes varying.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
-from typing import Hashable, Optional
+from typing import Hashable, Iterator, Optional
 
 from incubator_predictionio_tpu.obs.metrics import REGISTRY
 
 _lock = threading.Lock()
 _first_seen: dict[Hashable, float] = {}  # key -> monotonic first-dispatch
+#: executable name -> [cumulative first-dispatch wall seconds, compiles] —
+#: compile-time attribution, not just counts (a recompile storm shows up
+#: as SECONDS on one name, which is what makes it diagnosable)
+_compile: dict[str, list] = {}
 
 
 def record(key: Hashable, now: Optional[float] = None) -> bool:
@@ -70,10 +75,61 @@ def first_seen() -> dict:
         return dict(_first_seen)
 
 
+def executable_name(key: Hashable) -> str:
+    """The executable-name component of a jit cache key — by convention the
+    first tuple element (``"two_tower_topk"``, …); non-tuple keys name
+    themselves. Bounded cardinality: names are code-chosen literals."""
+    if isinstance(key, tuple) and key and isinstance(key[0], str):
+        return key[0]
+    return str(key)
+
+
+def observe_compile(key: Hashable, seconds: float) -> None:
+    """Attribute one first-dispatch wall time to ``key``'s executable name.
+    First-dispatch wall is compile-dominated (XLA tracing + lowering dwarf
+    the one execution it includes), so this is the repo's compile clock
+    without XLA hooks."""
+    name = executable_name(key)
+    seconds = max(0.0, seconds)
+    with _lock:
+        ent = _compile.setdefault(name, [0.0, 0])
+        ent[0] += seconds
+        ent[1] += 1
+    _C_COMPILE_SEC.labels(executable=name).inc(seconds)
+
+
+@contextlib.contextmanager
+def dispatch_timer(key: Hashable) -> Iterator[None]:
+    """``record(key)`` + time the enclosed (dispatch + block) region; a
+    FRESH key books the wall time as compile via :func:`observe_compile`.
+    Warm dispatches pay two perf_counter reads and nothing else."""
+    fresh = record(key)
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        if fresh:
+            observe_compile(key, time.perf_counter() - t0)
+
+
+def top_compiles(n: int = 10) -> list[tuple[str, float, int]]:
+    """``(executable, cumulative_seconds, compiles)`` sorted by seconds —
+    the ``pio-tpu status`` recompile-storm triage table."""
+    with _lock:
+        rows = [(name, ent[0], ent[1]) for name, ent in _compile.items()]
+    return sorted(rows, key=lambda r: -r[1])[:n]
+
+
+def compile_seconds_total() -> float:
+    with _lock:
+        return sum(ent[0] for ent in _compile.values())
+
+
 def reset() -> None:
     """Test hook."""
     with _lock:
         _first_seen.clear()
+        _compile.clear()
 
 
 # -- /metrics fold ----------------------------------------------------------
@@ -84,6 +140,11 @@ _G_RECENT = REGISTRY.gauge(
     "pio_jit_compiles_recent",
     "Jit keys first seen within the trailing window (alert when non-zero "
     "after warmup)", labels=("window_seconds",))
+_C_COMPILE_SEC = REGISTRY.counter(
+    "pio_jit_compile_seconds_total",
+    "Cumulative first-dispatch (compile-dominated) wall time per serving "
+    "executable name — a recompile storm is SECONDS here, not just a "
+    "growing key count", labels=("executable",))
 
 
 def _collect() -> None:
