@@ -385,19 +385,40 @@ class TwoTowerModel:
 
         has_ivf = self._ivf is not None or (
             self._sharded is not None and any(self._sharded.ivf or ()))
+        n = 0
         if has_ivf and ann.two_stage_enabled(self.n_items):
-            # prime the two-stage path too: no XLA involved (the coarse +
-            # rerank stages are host numpy), but the first dispatch faults
-            # the member-order tables into memory and spins up the BLAS
-            # thread pool — deploy-time cost, not the first live query's
-            TwoTowerMF.recommend_batch(
-                self, np.zeros(1, np.int32),
-                min(max(self._serve_k, 1), self.n_items))
+            # prime the two-stage path too: on host no XLA is involved (the
+            # coarse + rerank stages are numpy), but the first dispatch
+            # faults the member-order tables into memory and spins up the
+            # BLAS thread pool — deploy-time cost, not the first live
+            # query's
+            k = min(max(self._serve_k, 1), self.n_items)
+            TwoTowerMF.recommend_batch(self, np.zeros(1, np.int32), k)
+            quantized = (self._ivf is not None and self._ivf.quantized) or (
+                self._sharded is not None
+                and any(i is not None and i.quantized
+                        for i in self._sharded.ivf or ()))
+            if quantized and jax.default_backend() == "tpu":
+                # the int8 coarse kernel pads queries to power-of-two
+                # buckets (serving/ann._probe_tpu): compile each bucket's
+                # `ivf_coarse_int8` executable now so no live batch shape
+                # pays it (jitstats names them; the batch-1 prime above
+                # already built the ≤8 bucket)
+                seen = {8}
+                for b in SERVE_BUCKETS:
+                    if b > max(1, max_batch):
+                        break
+                    bp = 1 << max(3, (b - 1).bit_length())
+                    if bp in seen:
+                        continue
+                    seen.add(bp)
+                    TwoTowerMF.recommend_batch(
+                        self, np.zeros(b, np.int32), k)
+                    n += 1
         if self._host_items is not None or (
                 self._sharded is not None and self._sharded.device is None):
             # pure-numpy serving paths: nothing compiles
             return 0
-        n = 0
         for b in SERVE_BUCKETS:
             if b > max(1, max_batch):
                 break
@@ -974,9 +995,12 @@ class TwoTowerMF:
             rm = _row_mask_pad_buffer(bucket, n_cols)
             rm[:b, : row_mask.shape[1]] = row_mask
             rmask = jnp.asarray(rm)
+        # the int8 executable gets its own jitstats name so `pio-tpu status`
+        # top-compiles attributes quantized-kernel compiles distinctly from
+        # the bf16 exact scorer (utils/jitstats.executable_name)
         with jitstats.dispatch_timer((
-            "two_tower_topk", quantized, bucket, k,
-            model.n_items, ue_tab.shape[0], rmask is not None,
+            "two_tower_topk_int8" if quantized else "two_tower_topk",
+            bucket, k, model.n_items, ue_tab.shape[0], rmask is not None,
         )):
             if quantized:
                 idx, scores = _topk_quantized(

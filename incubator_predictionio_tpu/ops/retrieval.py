@@ -26,6 +26,15 @@ from jax.experimental.pallas import tpu as pltpu
 
 ITEM_BLOCK = 512  # catalog rows per grid step (int8 [512, D] ≤ 128KB for D≤256)
 
+#: Widest rank for which int8×int8 products summed over a row fit a float32
+#: mantissa EXACTLY: every partial product is ≤ 127² = 16129, so a D-dim dot
+#: is ≤ 127²·D < 2²⁴ for D ≤ 1040 — f32 BLAS over the int8-valued operands
+#: therefore computes the int32 accumulation bit-exactly (every intermediate
+#: sum is an integer below the mantissa limit, associativity-free). This is
+#: what lets the CPU host path share the TPU kernel's int8×int8→int32
+#: contract without an int8 GEMM in numpy.
+INT8_EXACT_MAX_RANK = (1 << 24) // (127 * 127)
+
 
 def quantize_rows(items: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     """Symmetric per-row int8 quantization: returns (int8 rows, fp32 scales)."""
@@ -136,6 +145,105 @@ def score_catalog_reference(q, items_q, scales, bias, mask, row_mask=None):
     if row_mask is not None:
         scores = scores + row_mask
     return scores
+
+
+def int8_matmul_exact(a_q: np.ndarray, b_q: np.ndarray) -> np.ndarray:
+    """Exact ``a_q [M, D] int8 @ b_q [N, D] int8 ᵀ → [M, N]`` accumulation on
+    host, returned as f32 holding exact integer values.
+
+    For D ≤ :data:`INT8_EXACT_MAX_RANK` the f32 BLAS GEMM over the upcast
+    operands IS the int32 result (see the constant's docstring) — and being
+    exact integers, the result is identical no matter how BLAS blocks the
+    reduction, so batched (GEMM) and per-query (GEMV) reranks score
+    bit-identically. Wider ranks fall back to f64 (exact to 2⁵³)."""
+    d = a_q.shape[1]
+    acc_dtype = np.float32 if d <= INT8_EXACT_MAX_RANK else np.float64
+    out = a_q.astype(acc_dtype) @ b_q.astype(acc_dtype).T
+    return out.astype(np.float32, copy=False)
+
+
+# -- int8 coarse stage (centroid scoring) ------------------------------------
+#
+# The IVF coarse stage scores each query against the bias-augmented centroid
+# table (serving/ann.py). With the catalog already int8 row-quantized, the
+# centroid embeddings quantize the same way (quantize_rows per-row scales);
+# the mean-member-bias column stays fp32 and is added AFTER the one rescale,
+# so bias precision never rides an int8 scale. The kernel runs int8×int8 on
+# the MXU with an int32 accumulator — the true quantized-retrieval contract —
+# and the host/reference paths reproduce it exactly via int8_matmul_exact.
+
+
+def _coarse_kernel(q_ref, cent_ref, qs_ref, cs_ref, cb_ref, out_ref):
+    q = q_ref[:]                                         # [B, D] int8 resident
+    block = cent_ref[:]                                  # [CB, D] int8
+    acc = jax.lax.dot_general(
+        q, block, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )                                                    # [B, CB] int32 MXU
+    scores = acc.astype(jnp.float32) * (qs_ref[:] * cs_ref[:]) + cb_ref[:]
+    out_ref[:] = scores
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def score_centroids_quantized(q_q, q_scales, cent_q, cent_scales, cent_bias,
+                              *, interpret=False):
+    """q_q [B, D] int8; q_scales [B] f32; cent_q [C, D] int8;
+    cent_scales/cent_bias [C] f32 → [B, C] f32 coarse scores.
+
+    ``C`` must be padded to the :data:`ITEM_BLOCK` multiple
+    (:func:`pad_centroids` — padding carries -inf bias so padded centroids
+    are never probed)."""
+    b, d = q_q.shape
+    c = cent_q.shape[0]
+    if c % ITEM_BLOCK:
+        raise ValueError(
+            f"centroid rows ({c}) must be padded to {ITEM_BLOCK}")
+    grid = (c // ITEM_BLOCK,)
+    col = lambda j: (0, j)
+    return pl.pallas_call(
+        _coarse_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((b, d), lambda j: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((ITEM_BLOCK, d), lambda j: (j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((b, 1), lambda j: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, ITEM_BLOCK), col, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, ITEM_BLOCK), col, memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((b, ITEM_BLOCK), col,
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b, c), jnp.float32),
+        interpret=interpret,
+    )(q_q, cent_q, q_scales.reshape(b, 1), cent_scales.reshape(1, c),
+      cent_bias.reshape(1, c))
+
+
+def score_centroids_reference(q_q, q_scales, cent_q, cent_scales, cent_bias):
+    """Same int8×int8→int32 math in plain jnp (non-TPU path + test oracle)."""
+    acc = jax.lax.dot_general(
+        q_q, cent_q, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    return (acc.astype(jnp.float32)
+            * (q_scales[:, None] * cent_scales[None, :])
+            + cent_bias[None, :])
+
+
+def pad_centroids(cent_q: np.ndarray, cent_scales: np.ndarray,
+                  cent_bias: np.ndarray, block: int = ITEM_BLOCK):
+    """Pad the quantized centroid table to the kernel block multiple.
+    Padded rows carry zero embeddings/scales and **-inf bias**, so they can
+    never win a probe slot."""
+    c = cent_q.shape[0]
+    pad = (-c) % block
+    if not pad:
+        return cent_q, cent_scales, cent_bias
+    return (
+        np.concatenate([cent_q, np.zeros((pad, cent_q.shape[1]), np.int8)]),
+        np.concatenate([cent_scales, np.zeros(pad, np.float32)]),
+        np.concatenate([cent_bias, np.full(pad, -np.inf, np.float32)]),
+    )
 
 
 def pad_catalog(items_q: np.ndarray, *vectors: np.ndarray,

@@ -27,6 +27,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from incubator_predictionio_tpu.parallel.ring import (
+    _SHARD_MAP_KW,
+    _mark_varying,
+    _shard_map,
+)
+
 
 def stack_layers(layers: list[dict]) -> dict:
     """List-of-layer-pytrees → one pytree with a leading [n_layers] dim
@@ -67,12 +73,13 @@ def pipeline_forward(stacked_layers, h0, apply_layer, mesh,
         return h
 
     @partial(
-        jax.shard_map,
+        _shard_map,
         mesh=mesh,
         # stacked layers split over the pipe axis; microbatch rows keep
         # their data sharding (dim 1 after the [m, mb, ...] reshape)
         in_specs=(P(axis), P(None, data_axis)),
         out_specs=P(None, data_axis),
+        **_SHARD_MAP_KW,
     )
     def run(layers_sharded, h0_rep):
         stage = jax.lax.axis_index(axis)
@@ -94,9 +101,8 @@ def pipeline_forward(stacked_layers, h0, apply_layer, mesh,
 
         # the carry becomes device-varying after the first ppermute; mark
         # the zeros init varying over the pipe axis up front (jax 0.9 vma
-        # typing — same as parallel/ring.py's pcast use)
-        init = jax.lax.pcast(
-            jnp.zeros_like(h0_rep[0]), (axis,), to="varying")
+        # typing — same helper as parallel/ring.py, identity on older jax)
+        init = _mark_varying(jnp.zeros_like(h0_rep[0]), (axis,))
         _, collected = jax.lax.scan(step, init, jnp.arange(m + s - 1))
         # step t >= s-1 emits microbatch t-(s-1) from the last stage;
         # psum broadcasts them (zeros everywhere but the last stage)

@@ -27,6 +27,22 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+try:  # jax ≥ 0.6 top-level export; experimental path before that
+    _shard_map = jax.shard_map
+    _SHARD_MAP_KW: dict = {}
+except AttributeError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    # the old rep-checker mis-types ppermute-carrying scan grads (jax#15175
+    # lineage); its own error message prescribes check_rep=False
+    _SHARD_MAP_KW = {"check_rep": False}
+
+
+def _axis_size(axis_name):
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)  # pragma: no cover - older jax
+
 
 def _chunk_attend(q, k, v, mask, m, l, o):
     """One online-softmax update with an extra additive mask.
@@ -60,7 +76,9 @@ def _mark_varying(x, axes):
     tests assert the suite is deprecation-warning-free)."""
     if hasattr(jax.lax, "pcast"):
         return jax.lax.pcast(x, axes, to="varying")
-    return jax.lax.pvary(x, axes)  # pragma: no cover - older jax
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(x, axes)  # pragma: no cover - older jax
+    return x  # pre-varying-type jax: scan carries need no marking
 
 
 def ring_attention(q, k, v, axis_name: str, pvary_axes=None):
@@ -71,7 +89,7 @@ def ring_attention(q, k, v, axis_name: str, pvary_axes=None):
     ``pvary_axes``: all manual axes in scope (defaults to just ``axis_name``);
     fresh accumulators must be marked varying over every one of them.
     """
-    s_size = jax.lax.axis_size(axis_name)
+    s_size = _axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
     b, lc, h, d = q.shape
     neg = jnp.float32(-jnp.inf)
@@ -110,12 +128,13 @@ def ring_attention_sharded(q, k, v, mesh, data_axis: str = "data",
     """shard_map wrapper: q/k/v [B, L, H, D] with B sharded over ``data_axis``
     and L over ``seq_axis``."""
     spec = P(data_axis, seq_axis, None, None)
-    fn = jax.shard_map(
+    fn = _shard_map(
         functools.partial(ring_attention, axis_name=seq_axis,
                           pvary_axes=mesh.axis_names),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
+        **_SHARD_MAP_KW,
     )
     return fn(q, k, v)
 

@@ -15,11 +15,15 @@ is the coarse-to-fine answer:
   fancy-index gather.
 - **Coarse stage**: score the ``[C]`` centroids per query and keep the
   top-``nprobe`` partitions — pruning the catalog to a few percent.
-- **Rerank stage**: the surviving candidates are scored with the *exact*
-  serving math (fp32 rows + bias, optionally int8 rows through the same
-  symmetric row quantization the Pallas kernel uses —
-  :func:`~incubator_predictionio_tpu.ops.retrieval.quantize_rows`), then
-  the shared serial-parity top-k chain picks the result.
+- **Rerank stage**: int8 storage is the DEFAULT — member rows are held
+  quantized (the same symmetric row quantization the Pallas kernel uses,
+  :func:`~incubator_predictionio_tpu.ops.retrieval.quantize_rows`) and
+  scored int8×int8→int32 with ONE fp32 rescale per candidate, grouped by
+  partition across the batch so each probed int8 block is read once.
+  The coarse stage quantizes alongside it (``PIO_RETRIEVAL_QUANT_COARSE``).
+  ``PIO_RETRIEVAL_QUANTIZE=0`` opts a deployment back onto fp32 rows +
+  exact serving math for the rerank (the recall-oracle path, always kept).
+  Either way the shared serial-parity top-k chain picks the result.
 
 Rule filters (``exclude`` / ``row_mask``) are applied **in candidate-index
 space after the gather**, as -inf on the exact rerank scores — a filtered
@@ -40,7 +44,7 @@ import dataclasses
 import os
 import threading
 import time
-from typing import Optional
+from typing import Iterator, Optional
 
 import numpy as np
 
@@ -69,6 +73,15 @@ FALLBACKS = REGISTRY.counter(
     "Two-stage-eligible batches that fell back to the exact path "
     "(probed partitions held fewer raw — or post-rule-filter finite — "
     "candidates than the requested top-k)")
+INT8_COARSE = REGISTRY.counter(
+    "pio_retrieval_int8_coarse_total",
+    "Batches whose coarse (centroid) stage scored int8×int8→int32 "
+    "against the quantized centroid table (PIO_RETRIEVAL_QUANT_COARSE)")
+INT8_RERANK = REGISTRY.counter(
+    "pio_retrieval_int8_rerank_total",
+    "Batches whose candidate rerank scored int8×int8→int32 over the "
+    "quantized member slices (one fp32 rescale per candidate; the fp32 "
+    "dequantize-first path is retired)")
 
 
 # -- env knobs ---------------------------------------------------------------
@@ -116,7 +129,27 @@ def resolved_nprobe(n_partitions: int) -> int:
 
 
 def quantize_enabled() -> bool:
-    return os.environ.get("PIO_RETRIEVAL_QUANTIZE", "0") == "1"
+    """int8 rerank storage is the default; ``PIO_RETRIEVAL_QUANTIZE=0``
+    opts a deployment back onto the fp32 exact-math rerank."""
+    return os.environ.get("PIO_RETRIEVAL_QUANTIZE", "1") != "0"
+
+
+def quant_coarse_enabled(index_quantized: bool) -> bool:
+    """``PIO_RETRIEVAL_QUANT_COARSE``: ``auto`` | ``1`` | ``0``.
+
+    Whether the coarse (centroid) stage scores int8×int8→int32 against the
+    quantized centroid table. ``auto`` (default) follows the index's rerank
+    storage — a quantized index probes quantized, an fp32 index probes
+    fp32; ``1``/``0`` force it per deployment. int8 coarse always requires
+    a quantized index (the centroid tables quantize alongside the member
+    rows)."""
+    val = os.environ.get("PIO_RETRIEVAL_QUANT_COARSE", "auto").strip().lower()
+    if val not in ("auto", "1", "0"):
+        raise ValueError(
+            f"PIO_RETRIEVAL_QUANT_COARSE={val!r} (want auto|1|0)")
+    if not index_quantized:
+        return False
+    return val != "0"
 
 
 def build_key(n_items: int) -> dict:
@@ -197,10 +230,14 @@ class IVFIndex:
 
     def __post_init__(self):
         self._rehydrate_lock = threading.Lock()
+        self._cent_quant = None
+        self._cent_device = None
 
     def __getstate__(self):
         state = dict(self.__dict__)
         state.pop("_rehydrate_lock", None)
+        state.pop("_cent_quant", None)
+        state.pop("_cent_device", None)
         for k in ("emb_m", "emb_q", "scales_m", "bias_m"):
             state[k] = None
         return state
@@ -208,6 +245,28 @@ class IVFIndex:
     def __setstate__(self, state):
         self.__dict__.update(state)
         self._rehydrate_lock = threading.Lock()
+        self._cent_quant = None
+        self._cent_device = None
+
+    def _coarse_quant(self) -> tuple[np.ndarray, np.ndarray]:
+        """Lazy ``(cent_q [C, D] int8, cent_scales [C] f32)`` — the
+        quantized twin of the centroid embedding columns (the mean-bias
+        column stays fp32 and is added after the rescale). Derived data:
+        cheap to recompute, so it never pickles (the slim-persistence
+        contract) and rebuilds on first int8 probe after a load."""
+        cq = self._cent_quant
+        if cq is None:
+            with self._rehydrate_lock:
+                cq = self._cent_quant
+                if cq is None:
+                    from incubator_predictionio_tpu.ops.retrieval import (
+                        quantize_rows,
+                    )
+
+                    q8, scales = quantize_rows(
+                        np.asarray(self.centroids[:, :-1], np.float32))
+                    cq = self._cent_quant = (q8, scales)
+        return cq
 
     @property
     def hydrated(self) -> bool:
@@ -311,6 +370,13 @@ class IVFIndex:
                 self.centroids, self.member_ids, self.offsets, self.bias_m,
                 self.emb_m, self.emb_q, self.scales_m)
             if a is not None)
+        # analytic rerank-storage accounting (stable whether or not the
+        # tables are hydrated): int8 layout = 1 byte/coord + one f32 scale
+        # per row; the fp32 equivalent is what the same rows cost unquantized
+        n = self.n_items
+        d = self.centroids.shape[1] - 1
+        fp32_bytes = n * d * 4
+        rerank_bytes = (n * d + n * 4) if self.quantized else fp32_bytes
         return {
             "n_partitions": int(self.n_partitions),
             "n_items": int(self.n_items),
@@ -324,6 +390,10 @@ class IVFIndex:
             "size_skew": round(float(sizes.max()) / mean, 2) if mean else 0.0,
             "empty_partitions": int((sizes == 0).sum()),
             "quantized": self.quantized,
+            "quant_coarse": quant_coarse_enabled(self.quantized),
+            "rerank_bytes": int(rerank_bytes),
+            "rerank_bytes_fp32": int(fp32_bytes),
+            "bytes_saved": int(fp32_bytes - rerank_bytes),
             "default_nprobe": resolved_nprobe(self.n_partitions),
             "index_bytes": int(nbytes),
             "build_seconds": round(self.build_seconds, 2),
@@ -332,12 +402,81 @@ class IVFIndex:
 
     # -- search -----------------------------------------------------------
 
-    def probe(self, q: np.ndarray, nprobe: int) -> np.ndarray:
-        """Top-``nprobe`` partition ids per query row (``[B, nprobe]``)."""
-        coarse = q @ self.centroids[:, :-1].T + self.centroids[:, -1][None, :]
+    def probe(self, q: np.ndarray, nprobe: int,
+              q_quant: Optional[tuple] = None) -> np.ndarray:
+        """Top-``nprobe`` partition ids per query row (``[B, nprobe]``).
+
+        With ``q_quant`` (the ``(q_q int8, q_scales f32)`` pair from
+        ``quantize_rows``) the centroid scores run int8×int8→int32 with one
+        fp32 rescale — the host-exact twin of the Pallas coarse kernel
+        (ops/retrieval.py ``score_centroids_quantized``); the fp32
+        mean-member-bias column is added after the rescale."""
+        if q_quant is not None:
+            import sys
+
+            from incubator_predictionio_tpu.ops.retrieval import (
+                int8_matmul_exact,
+            )
+
+            q_q, q_scales = q_quant
+            if "jax" in sys.modules and \
+                    sys.modules["jax"].default_backend() == "tpu":
+                # the Pallas int8 coarse kernel (ops/retrieval.py). Same
+                # int8×int8→int32 + one-rescale contract as the host twin
+                # below — the accumulation is exact integers either way;
+                # only the final rescale may FMA-contract (≤1 ulp), so
+                # probe sets agree except exact near-ties at the boundary
+                coarse = self._probe_tpu(q_q, q_scales)
+            else:
+                cent_q, cent_scales = self._coarse_quant()
+                coarse = (int8_matmul_exact(q_q, cent_q)
+                          * (q_scales[:, None] * cent_scales[None, :])
+                          + self.centroids[:, -1][None, :])
+        else:
+            coarse = (q @ self.centroids[:, :-1].T
+                      + self.centroids[:, -1][None, :])
         if nprobe >= self.n_partitions:
             return np.tile(np.arange(self.n_partitions), (len(q), 1))
         return np.argpartition(-coarse, nprobe - 1, axis=1)[:, :nprobe]
+
+    def _probe_tpu(self, q_q: np.ndarray, q_scales: np.ndarray) -> np.ndarray:
+        """Coarse scores through the Pallas int8 kernel on a resident
+        device copy of the quantized centroid table. The batch pads to a
+        power-of-two bucket (≥ 8) so the query mix shares a handful of
+        executables; centroid padding carries -inf bias and can never win
+        a probe slot."""
+        import jax
+        import jax.numpy as jnp
+
+        from incubator_predictionio_tpu.ops.retrieval import (
+            pad_centroids,
+            score_centroids_quantized,
+        )
+        from incubator_predictionio_tpu.utils import jitstats
+
+        dev = self._cent_device
+        if dev is None:
+            with self._rehydrate_lock:
+                dev = self._cent_device
+                if dev is None:
+                    cent_q, cent_scales = self._coarse_quant()
+                    cq, cs, cb = pad_centroids(
+                        cent_q, cent_scales,
+                        np.asarray(self.centroids[:, -1], np.float32))
+                    dev = self._cent_device = tuple(
+                        jax.device_put(v) for v in (cq, cs, cb))
+        cq, cs, cb = dev
+        b = q_q.shape[0]
+        bp = 1 << max(3, (b - 1).bit_length())
+        qq = np.zeros((bp, q_q.shape[1]), np.int8)
+        qq[:b] = q_q
+        qs = np.zeros(bp, np.float32)
+        qs[:b] = q_scales
+        with jitstats.dispatch_timer(
+                ("ivf_coarse_int8", bp, int(cq.shape[0]))):
+            out = jax.device_get(score_centroids_quantized(
+                jnp.asarray(qq), jnp.asarray(qs), cq, cs, cb))
+        return np.asarray(out)[:b, : self.n_partitions]
 
     def candidate_ids(self, qrow: np.ndarray, nprobe: int) -> np.ndarray:
         """One query's gathered candidate set (tests / inspection)."""
@@ -345,6 +484,65 @@ class IVFIndex:
         return np.concatenate([
             self.member_ids[self.offsets[p]:self.offsets[p + 1]]
             for p in parts]) if len(parts) else np.empty(0, np.int32)
+
+    def _int8_partition_scores(
+        self, probe: np.ndarray, q_quant: tuple,
+    ) -> dict[int, "Iterator[np.ndarray]"]:
+        """int8×int8→int32 rerank scores for every probed partition,
+        grouped by partition across the batch: each probed partition's int8
+        member block is upcast (and its scores rescaled) ONCE for all the
+        queries that probe it — one ``[probers, members]`` GEMM per
+        partition instead of a GEMV per (query, partition) pair. Because
+        the int8 accumulation is exact integers in f32
+        (ops/retrieval.int8_matmul_exact), the batched GEMM scores are
+        bit-identical to what per-query GEMVs would produce — batching is
+        free of reduction-order drift, something the fp32 path can't claim.
+        This cross-query amortization is where the int8 lane's serve-side
+        speedup comes from, so it grows with the coalesced batch size.
+
+        The (query, partition) grouping comes from ONE stable argsort of
+        the probe matrix — no per-partition membership scans. Returns
+        ``{partition: row-iterator}`` where the iterator yields that
+        partition's ``[members]`` f32 score rows in ascending query order:
+        the rescale (``scale_query · scale_row``) and member bias are
+        already applied, and because :meth:`search` walks queries in
+        ascending order and each query probes a partition at most once,
+        ``next()`` hands every consumer exactly its row with no lookup."""
+        from incubator_predictionio_tpu.ops.retrieval import (
+            INT8_EXACT_MAX_RANK,
+            int8_matmul_exact,
+        )
+
+        q_q, q_scales = q_quant
+        flat = probe.ravel()
+        order = np.argsort(flat, kind="stable")  # stable ⇒ ascending query
+        qidx = order // probe.shape[1]
+        sflat = flat[order]
+        bounds = np.flatnonzero(np.diff(sflat)) + 1
+        starts = np.concatenate(([0], bounds))
+        ends = np.concatenate((bounds, [len(sflat)]))
+        # the exact-accumulation dtype decision is per BATCH, not per GEMM:
+        # upcast the query block once and inline the per-partition matmul
+        # (int8_matmul_exact's math, minus its per-call dispatch overhead)
+        exact_f32 = q_q.shape[1] <= INT8_EXACT_MAX_RANK
+        qf = q_q.astype(np.float32 if exact_f32 else np.float64)
+        emb_q, offsets = self.emb_q, self.offsets
+        scales_m, bias_m = self.scales_m, self.bias_m
+        out: dict[int, Iterator[np.ndarray]] = {}
+        for a, e in zip(starts.tolist(), ends.tolist()):
+            p = int(sflat[a])
+            lo, hi = int(offsets[p]), int(offsets[p + 1])
+            if hi == lo:
+                continue
+            who = qidx[a:e]
+            if exact_f32:
+                acc = qf[who] @ emb_q[lo:hi].astype(np.float32).T
+            else:
+                acc = int8_matmul_exact(q_q[who], emb_q[lo:hi])
+            acc *= q_scales[who][:, None] * scales_m[lo:hi][None, :]
+            acc += bias_m[lo:hi][None, :]
+            out[p] = iter(acc)
+        return out
 
     def search(
         self,
@@ -380,10 +578,20 @@ class IVFIndex:
         nprobe = resolved_nprobe(self.n_partitions) if nprobe is None \
             else min(max(1, nprobe), self.n_partitions)
         t0 = time.perf_counter()
-        probe = self.probe(q, nprobe)
+        q_quant = None
+        if self.quantized:
+            from incubator_predictionio_tpu.ops.retrieval import quantize_rows
+
+            # one per-row query quantization serves BOTH stages (the int8
+            # coarse probe and the int8 rerank share q_q/q_scales)
+            q_quant = quantize_rows(np.asarray(q, np.float32))
+        int8_coarse = q_quant is not None and quant_coarse_enabled(True)
+        probe = self.probe(q, nprobe, q_quant=q_quant if int8_coarse else None)
         counts = np.diff(self.offsets)[probe].sum(axis=1)
         if observe:
             COARSE_SEC.observe(time.perf_counter() - t0)
+            if int8_coarse:
+                INT8_COARSE.inc()
         if int(counts.min()) < num:
             if observe:
                 FALLBACKS.inc()
@@ -395,6 +603,11 @@ class IVFIndex:
         if exclude is not None and len(exclude):
             excl_sorted = np.sort(np.asarray(exclude, np.int64))
         t0 = time.perf_counter()
+        part_scores = None
+        if q_quant is not None:
+            part_scores = self._int8_partition_scores(probe, q_quant)
+            if observe:
+                INT8_RERANK.inc()
         out_idx = np.empty((b, num), np.int64)
         out_scores = np.empty((b, num), np.float32)
         for r in range(b):
@@ -404,16 +617,17 @@ class IVFIndex:
             scores = np.empty(cnt, np.float32)
             qrow = q[r]
             pos = 0
-            for p in parts:
-                lo, hi = int(self.offsets[p]), int(self.offsets[p + 1])
+            bnds = self.offsets[parts].tolist()
+            ubnds = self.offsets[parts + 1].tolist()
+            for p, lo, hi in zip(parts.tolist(), bnds, ubnds):
                 m = hi - lo
                 if not m:
                     continue
                 ids[pos:pos + m] = self.member_ids[lo:hi]
-                if self.quantized:
-                    scores[pos:pos + m] = (
-                        self.emb_q[lo:hi].astype(np.float32) @ qrow
-                    ) * self.scales_m[lo:hi] + self.bias_m[lo:hi]
+                if part_scores is not None:
+                    # rows come off each partition's iterator in ascending
+                    # query order — exactly this loop's visit order
+                    scores[pos:pos + m] = next(part_scores[p])
                 else:
                     scores[pos:pos + m] = \
                         self.emb_m[lo:hi] @ qrow + self.bias_m[lo:hi]

@@ -847,6 +847,7 @@ class ShardedServing:
         if self.ivf is not None:
             ivf_stats = [i.stats() if i is not None else None
                          for i in self.ivf]
+        live = [s for s in (ivf_stats or []) if s]
         return {
             "n_shards": self.n_shards,
             "mode": "device" if self.device is not None else "host",
@@ -856,4 +857,9 @@ class ShardedServing:
             "serve_k": self.serve_k,
             "hbm_budget": hbm_budget(),
             "ivf": ivf_stats,
+            # per-shard rerank storage: int8 vs fp32 and the HBM saved by
+            # the quantized layout, summed over live shard indexes
+            "quantized": bool(live and all(s["quantized"] for s in live)),
+            "rerank_bytes": sum(s["rerank_bytes"] for s in live),
+            "rerank_bytes_saved": sum(s["bytes_saved"] for s in live),
         }

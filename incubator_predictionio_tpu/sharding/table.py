@@ -122,6 +122,17 @@ class ShardSpec:
     def shard_table_bytes(self) -> int:
         return self.rows_per_shard * self.width * _F32
 
+    def serve_bytes_int8(self) -> int:
+        """Bytes of the full padded table in the int8 serving layout
+        (ops/retrieval.quantize_rows): 1 byte per embedding coordinate +
+        one f32 dequant scale and one f32 bias per row — what the
+        quantized retrieval path actually keeps resident."""
+        return self.padded_rows * ((self.width - 1) + 2 * _F32)
+
+    def shard_serve_bytes_int8(self) -> int:
+        """Per-shard HBM bytes of the int8 serving layout."""
+        return self.rows_per_shard * ((self.width - 1) + 2 * _F32)
+
     def train_bytes_per_shard(self, moments_dtype: str = "float32") -> int:
         """Per-chip training residency: the row block + BOTH co-sharded
         adam moments (utils/optim.py stores m and v in ``moments_dtype``)."""
@@ -138,6 +149,8 @@ class ShardSpec:
             "rows_per_shard": int(self.rows_per_shard),
             "shard_rows": self.shard_row_counts(),
             "table_bytes": int(self.table_bytes()),
+            "table_bytes_int8": int(self.serve_bytes_int8()),
+            "shard_serve_bytes_int8": int(self.shard_serve_bytes_int8()),
             "train_bytes_per_shard": int(self.train_bytes_per_shard()),
         }
 

@@ -45,6 +45,15 @@ FOLDED = REGISTRY.counter(
     "pio_stream_folded_total",
     "Events folded into embedding-row deltas by the streaming updater")
 
+#: Updater side: micro-batches stepped through the fused
+#: gather→adam→scatter path (ops/sparse_update.py) instead of the
+#: three-pass per-row reference loop.
+FUSED_STEPS = REGISTRY.counter(
+    "pio_stream_fused_steps_total",
+    "Touched-row micro-batches updated through the fused "
+    "gather→adam→scatter path (PIO_STREAM_FUSED; bitwise-identical "
+    "to the per-row reference loop)")
+
 #: Updater side: guard trips that quarantined the stream.
 QUARANTINED = REGISTRY.counter(
     "pio_stream_quarantined_total",

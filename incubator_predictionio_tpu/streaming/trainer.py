@@ -29,6 +29,7 @@ Design points:
 from __future__ import annotations
 
 import dataclasses
+import os
 import time as _time
 from typing import Optional, Sequence
 
@@ -36,10 +37,27 @@ import numpy as np
 
 from incubator_predictionio_tpu.data.event import Event, epoch_micros
 from incubator_predictionio_tpu.obs import profile as _profile
+from incubator_predictionio_tpu.ops import sparse_update
+from incubator_predictionio_tpu.streaming import stream_metrics
 from incubator_predictionio_tpu.streaming.coldstart import (
     ColdStartBuckets,
     coldstart_mode,
 )
+
+
+def fused_fold_mode() -> str:
+    """``PIO_STREAM_FUSED``: ``auto`` | ``1`` | ``0`` | ``device``.
+
+    ``auto``/``1`` step each touched-row micro-batch through the fused
+    gather→adam→scatter path (ops/sparse_update.py — one stacked pass
+    instead of the per-row three-pass loop, bitwise-identical results);
+    ``0`` keeps the per-row reference loop; ``device`` runs the same fused
+    step as ONE compiled dispatch (the Pallas adam kernel on TPU)."""
+    val = os.environ.get("PIO_STREAM_FUSED", "auto").strip().lower()
+    if val not in ("auto", "1", "0", "device"):
+        raise ValueError(
+            f"PIO_STREAM_FUSED={val!r} (want auto|1|0|device)")
+    return val
 
 
 class PoisonEvent(ValueError):
@@ -269,9 +287,49 @@ class DeltaTrainer:
         for key, g in zip(ikeys, g_i):
             acc = grads.get(key)
             grads[key] = g.copy() if acc is None else acc + g
-        for key, g in grads.items():
-            self._adam(key, g)
+        mode = fused_fold_mode()
+        if mode == "0":
+            # per-row reference loop — the bitwise oracle the fused path
+            # is pinned against (tests/test_streaming.py)
+            for key, g in grads.items():
+                self._adam(key, g)
+        else:
+            self._fused_adam(grads, device=(mode == "device"))
         return set(grads)
+
+    def _fused_adam(self, grads: dict[tuple, np.ndarray],
+                    device: bool = False) -> None:
+        """Fused gather→adam→scatter over the micro-batch's touched rows:
+        ONE stacked gather, one vectorized adam (host numpy, or a single
+        compiled dispatch when ``device``), one scatter back into the
+        working state. The host pass is bit-for-bit the per-row
+        :meth:`_adam` math; the device engine is fp32-roundoff parity
+        (XLA FMA contraction) — see ops/sparse_update.py."""
+        keys = list(grads)
+        d = self.rank + 1
+        rows = np.stack([self.current_row(key) for key in keys]).astype(
+            np.float32, copy=False)
+        m = np.stack([
+            self.m[key] if key in self.m else np.zeros(d, np.float32)
+            for key in keys])
+        v = np.stack([
+            self.v[key] if key in self.v else np.zeros(d, np.float32)
+            for key in keys])
+        g = np.stack([grads[key] for key in keys]).astype(
+            np.float32, copy=False)
+        t_new = np.asarray([self.t.get(key, 0) + 1 for key in keys],
+                           np.int64)
+        step = (sparse_update.fused_adam_rows_device if device
+                else sparse_update.fused_adam_rows)
+        rows, m, v = step(rows, m, v, g, t_new, self.lr)
+        for j, key in enumerate(keys):
+            # .copy(): detach each row from the batch stack so the working
+            # state never keeps whole micro-batch buffers alive per key
+            self.rows[key] = rows[j].copy()
+            self.m[key] = m[j].copy()
+            self.v[key] = v[j].copy()
+            self.t[key] = int(t_new[j])
+        stream_metrics.FUSED_STEPS.inc()
 
     def _adam(self, key: tuple, g: np.ndarray,
               b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8) -> None:

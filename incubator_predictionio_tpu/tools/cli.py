@@ -1124,6 +1124,13 @@ def format_index_stats(models) -> list[str]:
                     f"rerank {'int8' if live[0]['quantized'] else 'fp32'}, "
                     f"index bytes {sum(s['index_bytes'] for s in live)} "
                     "— `pio-tpu shards` prints the layout")
+                saved = sum(s.get("bytes_saved", 0) for s in live)
+                if saved:
+                    lines.append(
+                        f"  quantization: int8 member rows + "
+                        f"{'int8' if live[0].get('quant_coarse') else 'fp32'}"
+                        f" coarse — saves {saved} bytes vs fp32 rerank "
+                        "storage across shards")
                 continue
             stats = None
         if not stats:
@@ -1143,6 +1150,13 @@ def format_index_stats(models) -> list[str]:
             f"default nprobe: {stats['default_nprobe']}  "
             f"index bytes: {stats['index_bytes']}  "
             f"build: {stats['build_seconds']}s")
+        if stats.get("quantized"):
+            lines.append(
+                f"  quantization: int8 member rows "
+                f"({stats.get('rerank_bytes', '?')} bytes, saves "
+                f"{stats.get('bytes_saved', 0)} vs fp32) + "
+                f"{'int8' if stats.get('quant_coarse') else 'fp32'} coarse "
+                "(PIO_RETRIEVAL_QUANT_COARSE)")
     return lines
 
 
@@ -1220,6 +1234,14 @@ def format_shard_stats(models) -> list[str]:
                 f"  per-shard IVF: {sum(parts)} partitions total "
                 f"({min(parts)}–{max(parts)}/shard) — each shard prunes "
                 "locally, the merge reranks")
+            if info.get("quantized"):
+                lines.append(
+                    f"  quantization: int8 rerank/shard "
+                    f"({_fmt_bytes(items.get('shard_serve_bytes_int8'))} "
+                    f"int8 vs "
+                    f"{_fmt_bytes(items.get('table_bytes', 0) // max(info.get('n_shards', 1), 1))}"
+                    f" f32 HBM/shard; saves "
+                    f"{_fmt_bytes(info.get('rerank_bytes_saved', 0))} total)")
     return lines
 
 

@@ -203,3 +203,98 @@ def test_host_fast_path_matches_device():
     idx_h2, _ = TwoTowerMF.recommend_batch(
         host_m, users, 6, exclude=np.asarray(idx_h[0][:2]))
     assert not set(idx_h[0][:2]) & set(idx_h2[0])
+
+
+# -- int8 exact accumulation + the coarse centroid kernel --------------------
+
+def test_int8_matmul_exact_matches_int64():
+    """The f32-BLAS trick really IS int32: exact for every D up to the
+    documented bound (and the f64 fallback past it) — so batched GEMM and
+    per-query GEMV reranks score bit-identically."""
+    from incubator_predictionio_tpu.ops.retrieval import (
+        INT8_EXACT_MAX_RANK,
+        int8_matmul_exact,
+    )
+
+    rng = np.random.default_rng(0)
+    assert INT8_EXACT_MAX_RANK == (1 << 24) // (127 * 127)
+    for d in (3, 64, INT8_EXACT_MAX_RANK, INT8_EXACT_MAX_RANK + 1):
+        a = rng.integers(-127, 128, (40, d)).astype(np.int8)
+        b = rng.integers(-127, 128, (d, 16)).astype(np.int8).T.copy()
+        got = int8_matmul_exact(a, b)
+        want = a.astype(np.int64) @ b.astype(np.int64).T
+        assert got.dtype == np.float32
+        np.testing.assert_array_equal(got.astype(np.int64), want)
+
+
+def test_quantize_score_rescale_error_bound():
+    """The analytic bound docs/serving.md states for the one-rescale int8
+    score: |q·v − rescaled| ≤ D·(|q|∞·s_v + |v|∞·s_q + s_q·s_v)/2."""
+    from incubator_predictionio_tpu.ops.retrieval import int8_matmul_exact
+
+    rng = np.random.default_rng(5)
+    d = 32
+    q = rng.normal(size=(16, d)).astype(np.float32)
+    v = rng.normal(size=(100, d)).astype(np.float32)
+    q_q, s_q = quantize_rows(q)
+    v_q, s_v = quantize_rows(v)
+    got = int8_matmul_exact(q_q, v_q) * (s_q[:, None] * s_v[None, :])
+    exact = q.astype(np.float64) @ v.astype(np.float64).T
+    bound = d * (np.abs(q).max(axis=1)[:, None] * s_v[None, :]
+                 + np.abs(v).max(axis=1)[None, :] * s_q[:, None]
+                 + s_q[:, None] * s_v[None, :]) / 2.0
+    assert np.all(np.abs(got - exact) <= bound + 1e-5)
+    # and the bound is TIGHT enough to matter: well under the score spread
+    assert bound.max() < (exact.max() - exact.min()) / 4
+
+
+def test_coarse_kernel_interpret_matches_reference_and_host():
+    """The Pallas int8 coarse kernel (interpret mode), the jnp reference,
+    and the host int8_matmul_exact probe math agree EXACTLY — identical
+    probe sets whichever engine scores the centroids."""
+    import jax.numpy as jnp
+
+    from incubator_predictionio_tpu.ops.retrieval import (
+        int8_matmul_exact,
+        pad_centroids,
+        score_centroids_quantized,
+        score_centroids_reference,
+    )
+
+    rng = np.random.default_rng(2)
+    c, d, b = ITEM_BLOCK + 5, 24, 8
+    cent = rng.normal(size=(c, d)).astype(np.float32)
+    bias = rng.normal(size=c).astype(np.float32)
+    cent_q, cent_s = quantize_rows(cent)
+    q = rng.normal(size=(b, d)).astype(np.float32)
+    q_q, q_s = quantize_rows(q)
+    cq, cs, cb = pad_centroids(cent_q, cent_s, bias)
+    assert cq.shape[0] == 2 * ITEM_BLOCK
+    assert np.isneginf(cb[c:]).all()  # padding can never win a probe slot
+    got = np.asarray(score_centroids_quantized(
+        jnp.asarray(q_q), jnp.asarray(q_s), jnp.asarray(cq),
+        jnp.asarray(cs), jnp.asarray(cb), interpret=True))
+    want = np.asarray(score_centroids_reference(
+        jnp.asarray(q_q), jnp.asarray(q_s), jnp.asarray(cq),
+        jnp.asarray(cs), jnp.asarray(cb)))
+    # the host probe math and the jnp reference agree to the BYTE (exact
+    # int32-valued accumulation, same rescale order)
+    host = (int8_matmul_exact(q_q, cent_q)
+            * (q_s[:, None] * cent_s[None, :]) + bias[None, :])
+    np.testing.assert_array_equal(want[:, :c], host)
+    # the kernel's accumulation is the same exact int32; only the final
+    # rescale may FMA-contract — a ≤1-ulp band, and the PROBE SETS (the
+    # operative contract) are identical
+    finite = np.isfinite(want)
+    np.testing.assert_array_equal(finite, np.isfinite(got))
+    np.testing.assert_allclose(got[finite], want[finite],
+                               rtol=3e-7, atol=1e-6)
+    for r in range(b):
+        np.testing.assert_array_equal(
+            np.sort(np.argsort(-got[r])[:16]),
+            np.sort(np.argsort(-want[r])[:16]))
+    # unpadded shapes are an error, not silent garbage
+    with pytest.raises(ValueError):
+        score_centroids_quantized(
+            jnp.asarray(q_q), jnp.asarray(q_s), jnp.asarray(cent_q),
+            jnp.asarray(cent_s), jnp.asarray(bias), interpret=True)

@@ -286,6 +286,9 @@ def two_stage_sharded_env(shard_env):
     shard_env.setenv("PIO_RETRIEVAL_NPROBE", "16")
     shard_env.setenv("PIO_SHARD_SERVE", "1")
     shard_env.setenv("PIO_SHARD_SERVE_SHARDS", "4")
+    # fp32 rerank baseline for these tests; the int8 compose test
+    # opts back in explicitly (int8 is the serving default)
+    shard_env.setenv("PIO_RETRIEVAL_QUANTIZE", "0")
     return shard_env
 
 
@@ -621,3 +624,57 @@ def test_auto_mode_stays_off_for_small_and_unsharded(shard_env):
     assert m._sharded is None and m._host_items is not None
     info = m.shard_info()
     assert not info["sharded"] and not info["requires_sharding"]
+
+
+# -- int8 per-shard scoring composes with shard-serve (ISSUE 18) -------------
+
+def test_sharded_int8_recall_floor_zero_full_gathers(two_stage_sharded_env):
+    """PIO_SHARD_SERVE=1 + PIO_RETRIEVAL_QUANTIZE=1: every shard scores
+    int8 coarse + int8 rerank, holds the 0.95 recall@10 floor vs the exact
+    oracle, performs ZERO full-table gathers, and reports the quantization
+    mode + bytes saved through shard info."""
+    from incubator_predictionio_tpu.serving import ann as ann_mod
+
+    n_items = 20_000
+    oracle = _model(n_items=n_items)
+    two_stage_sharded_env.setenv("PIO_SHARD_SERVE", "0")
+    two_stage_sharded_env.setenv("PIO_RETRIEVAL_MODE", "exact")
+    oracle.prepare_for_serving()
+
+    two_stage_sharded_env.setenv("PIO_SHARD_SERVE", "1")
+    two_stage_sharded_env.setenv("PIO_RETRIEVAL_MODE", "two_stage")
+    two_stage_sharded_env.setenv("PIO_RETRIEVAL_QUANTIZE", "1")
+    m = _model(n_items=n_items)
+    m.prepare_for_serving()
+    assert m._shard_ivf is not None and len(m._shard_ivf) == 4
+    assert all(i is not None and i.quantized for i in m._shard_ivf)
+
+    rng = np.random.default_rng(6)
+    users = rng.integers(0, 160, 32).astype(np.int32)
+    gathers0 = shard_metrics.FULL_GATHERS._default().value
+    rerank0 = ann_mod.INT8_RERANK._default().value
+    oi, _ = TwoTowerMF.recommend_batch(oracle, users, 10)
+    gi, gs = TwoTowerMF.recommend_batch(m, users, 10)
+    assert np.mean([len(set(a) & set(b)) / 10
+                    for a, b in zip(oi, gi)]) >= 0.95
+    assert np.isfinite(gs).all()
+    # zero full-table gathers; and the batch is accounted in pio_shard_*,
+    # never once-per-shard in the single-host int8 counters
+    assert shard_metrics.FULL_GATHERS._default().value == gathers0
+    assert ann_mod.INT8_RERANK._default().value == rerank0
+
+    info = m.shard_info()
+    assert info.get("quantized")
+    assert info.get("rerank_bytes_saved", 0) > 0
+    # pio-tpu shards renders the mode + per-shard HBM savings
+    from incubator_predictionio_tpu.tools.cli import format_shard_stats
+
+    class FakeRec:
+        def shard_info(self):
+            return info
+
+        def serving_info(self):
+            return m.serving_info()
+
+    text = "\n".join(format_shard_stats([FakeRec()]))
+    assert "int8 rerank/shard" in text

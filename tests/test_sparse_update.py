@@ -1,0 +1,246 @@
+"""Fused gather→adam→scatter (ops/sparse_update.py): the fused stacked
+pass and the default trainer fold must be BITWISE the per-row reference
+loop — per-row bias-correction step counts included; the compiled device
+engines are pinned to fp32 roundoff (XLA FMA contraction)."""
+
+import datetime as dt
+
+import numpy as np
+import pytest
+
+from incubator_predictionio_tpu.data import DataMap, Event
+from incubator_predictionio_tpu.ops import sparse_update
+from incubator_predictionio_tpu.ops.sparse_update import (
+    adam_bias_corrections,
+    fused_adam_rows,
+    fused_adam_rows_device,
+    fused_gather_adam_scatter,
+)
+from incubator_predictionio_tpu.streaming import stream_metrics
+from incubator_predictionio_tpu.streaming.trainer import (
+    DeltaTrainer,
+    fused_fold_mode,
+)
+
+UTC = dt.timezone.utc
+T0 = dt.datetime(2023, 5, 1, tzinfo=UTC)
+
+
+def _reference_rows(rows, m, v, g, t, lr, b1=0.9, b2=0.999, eps=1e-8):
+    """The three-dispatch per-row oracle: DeltaTrainer._adam op-for-op
+    (python-double bias corrections, f32 elementwise chain)."""
+    rows, m, v = rows.copy(), m.copy(), v.copy()
+    for j in range(rows.shape[0]):
+        mj = b1 * m[j] + (1.0 - b1) * g[j]
+        vj = b2 * v[j] + (1.0 - b2) * (g[j] * g[j])
+        bc1 = 1.0 - b1 ** int(t[j])
+        bc2 = 1.0 - b2 ** int(t[j])
+        rows[j] = rows[j] - lr * (mj / bc1) / (np.sqrt(vj / bc2) + eps)
+        m[j], v[j] = mj, vj
+    return rows, m, v
+
+
+def _stack_problem(r=37, d=17, seed=0):
+    rng = np.random.default_rng(seed)
+    rows = rng.normal(size=(r, d)).astype(np.float32)
+    m = (rng.normal(size=(r, d)) * 0.01).astype(np.float32)
+    v = np.abs(rng.normal(size=(r, d)) * 1e-4).astype(np.float32)
+    g = rng.normal(size=(r, d)).astype(np.float32)
+    # heterogeneous step counts: fresh rows (t=1) next to well-trained ones
+    t = rng.integers(1, 500, r).astype(np.int64)
+    t[:3] = 1
+    return rows, m, v, g, t
+
+
+def _assert_bitwise(got, want):
+    for a, b in zip(got, want):
+        assert a.dtype == np.float32 and b.dtype == np.float32
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+
+
+def _assert_fp32_roundoff(got, want):
+    """Device-engine contract: XLA may contract mul+add into FMA (and
+    cancellation in the moment update magnifies that to a few dozen ulps),
+    so the compiled step is pinned to fp32-roundoff agreement with the
+    host pass — the host pass vs the per-row loop IS bytes."""
+    for a, b in zip(got, want):
+        a, b = np.asarray(a), np.asarray(b)
+        assert a.dtype == np.float32 and b.dtype == np.float32
+        np.testing.assert_allclose(a, b, rtol=2e-5, atol=1e-7)
+
+
+def test_bias_corrections_match_scalar_pow():
+    t = np.asarray([1, 2, 7, 7, 300, 1], np.int64)
+    bc1, bc2 = adam_bias_corrections(t)
+    for j, tv in enumerate(t):
+        assert bc1[j] == np.float32(1.0 - 0.9 ** int(tv))
+        assert bc2[j] == np.float32(1.0 - 0.999 ** int(tv))
+    assert bc1.dtype == bc2.dtype == np.float32
+
+
+def test_fused_rows_bitwise_vs_per_row_reference():
+    rows, m, v, g, t = _stack_problem()
+    got = fused_adam_rows(rows, m, v, g, t, lr=0.05)
+    want = _reference_rows(rows, m, v, g, t, lr=0.05)
+    _assert_bitwise(got, want)
+    # inputs are never mutated (functional contract)
+    r2, m2, v2, g2, _ = _stack_problem()
+    np.testing.assert_array_equal(rows, r2)
+    np.testing.assert_array_equal(m, m2)
+
+
+def test_fused_rows_device_one_dispatch_roundoff_pinned():
+    """The device engine (jax, single compiled step over the padded row
+    stack) stays within fp32 roundoff of the host pass — and padding to
+    ROW_BLOCK buckets keeps the executable set bounded."""
+    pytest.importorskip("jax")
+    rows, m, v, g, t = _stack_problem(r=37)
+    want = fused_adam_rows(rows, m, v, g, t, lr=0.05)
+    got = fused_adam_rows_device(rows, m, v, g, t, lr=0.05)
+    _assert_fp32_roundoff(got, want)
+    # a second, differently-sized batch reuses the SAME padded executable
+    fn = sparse_update._adam_rows_jit()
+    n_exec = fn._cache_size()
+    rows2, m2, v2, g2, t2 = _stack_problem(r=5, seed=3)
+    got2 = fused_adam_rows_device(rows2, m2, v2, g2, t2, lr=0.05)
+    _assert_fp32_roundoff(got2, fused_adam_rows(rows2, m2, v2, g2, t2, lr=0.05))
+    assert fn._cache_size() == n_exec  # both pad to one ROW_BLOCK bucket
+
+
+def test_pallas_adam_kernel_interpret_roundoff_pinned():
+    """The Pallas row-block kernel (TPU engine) in interpret mode within
+    fp32 roundoff of the host pass — incl. the padded-lane unit bias
+    corrections (divide by one, never by zero)."""
+    pytest.importorskip("jax")
+    rows, m, v, g, t = _stack_problem(r=sparse_update.ROW_BLOCK + 9, d=8)
+    want = fused_adam_rows(rows, m, v, g, t, lr=0.05)
+    got = fused_adam_rows_device(rows, m, v, g, t, lr=0.05, interpret=True)
+    _assert_fp32_roundoff(got, want)
+
+
+def test_fused_gather_adam_scatter_functional():
+    """The table-resident engine: gather+adam+scatter in ONE jitted call —
+    touched rows match the host pass, untouched rows are byte-identical,
+    and the inputs stay unmutated."""
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(4)
+    n, d, r = 64, 9, 12
+    table = rng.normal(size=(n, d)).astype(np.float32)
+    m_tab = (rng.normal(size=(n, d)) * 0.01).astype(np.float32)
+    v_tab = np.abs(rng.normal(size=(n, d)) * 1e-4).astype(np.float32)
+    idx = rng.choice(n, r, replace=False).astype(np.int32)
+    g = rng.normal(size=(r, d)).astype(np.float32)
+    t = rng.integers(1, 40, r).astype(np.int64)
+    bc1, bc2 = adam_bias_corrections(t)
+    nt, nm, nv = fused_gather_adam_scatter(
+        jnp.asarray(table), jnp.asarray(m_tab), jnp.asarray(v_tab),
+        jnp.asarray(idx), jnp.asarray(g), jnp.asarray(bc1),
+        jnp.asarray(bc2), lr=0.05)
+    nt, nm, nv = map(np.asarray, jax.device_get((nt, nm, nv)))
+    rows, mm, vv = fused_adam_rows(table[idx], m_tab[idx], v_tab[idx],
+                                   g, t, lr=0.05)
+    _assert_fp32_roundoff((nt[idx], nm[idx], nv[idx]), (rows, mm, vv))
+    untouched = np.setdiff1d(np.arange(n), idx)
+    np.testing.assert_array_equal(nt[untouched], table[untouched])
+    np.testing.assert_array_equal(nm[untouched], m_tab[untouched])
+    np.testing.assert_array_equal(nv[untouched], v_tab[untouched])
+
+
+# -- the trainer fold wired through PIO_STREAM_FUSED -------------------------
+
+
+def _mini_trainer(n_users=6, n_items=8, rank=4, seed=0):
+    rng = np.random.default_rng(seed)
+    return DeltaTrainer(
+        (rng.normal(size=(n_users, rank)) * 0.3).astype(np.float32),
+        np.zeros(n_users, np.float32),
+        (rng.normal(size=(n_items, rank)) * 0.3).astype(np.float32),
+        np.zeros(n_items, np.float32),
+        2.5,
+        {f"u{i}": i for i in range(n_users)},
+        {f"i{j}": j for j in range(n_items)},
+        learning_rate=0.05, reg=1e-4)
+
+
+def _rate(user, item, rating, minute=0):
+    return Event(
+        event="rate", entity_type="user", entity_id=user,
+        target_entity_type="item", target_entity_id=item,
+        properties=DataMap({"rating": float(rating)}),
+        event_time=T0 + dt.timedelta(minutes=minute))
+
+
+def _fold_stream(mode, monkeypatch, with_poison=False):
+    monkeypatch.setenv("PIO_STREAM_FUSED", mode)
+    tr = _mini_trainer()
+    events = [
+        # duplicate keys inside a batch (u0 rates twice; i1 rated twice):
+        # gradients accumulate, the row takes ONE step
+        _rate("u0", "i1", 4.0), _rate("u0", "i2", 2.0),
+        _rate("u3", "i1", 5.0), _rate("u2", "i7", 1.0),
+    ]
+    if with_poison:
+        events.insert(2, Event(
+            event="rate", entity_type="user", entity_id="u1",
+            target_entity_type="item", target_entity_id="i3",
+            properties=DataMap({"rating": "five stars"}),
+            event_time=T0))
+    res1, poison1 = tr.fold(events)
+    # a second fold advances per-row t past 1 for re-touched rows only
+    res2, poison2 = tr.fold([_rate("u0", "i1", 3.0), _rate("u5", "i6", 4.0)])
+    return tr, (res1, poison1, res2, poison2)
+
+
+@pytest.mark.parametrize("mode", ["auto", "1"])
+def test_fold_fused_modes_bitwise_identical_to_reference(mode, monkeypatch):
+    ref, _ = _fold_stream("0", monkeypatch)
+    fused, _ = _fold_stream(mode, monkeypatch)
+    assert set(ref.rows) == set(fused.rows)
+    assert ref.t == fused.t  # per-row step counts intact (u0/i1 at t=2)
+    assert any(t == 2 for t in ref.t.values())
+    for key in ref.rows:
+        assert ref.rows[key].tobytes() == fused.rows[key].tobytes(), key
+        assert ref.m[key].tobytes() == fused.m[key].tobytes(), key
+        assert ref.v[key].tobytes() == fused.v[key].tobytes(), key
+
+
+def test_fold_device_mode_close_and_t_exact(monkeypatch):
+    pytest.importorskip("jax")
+    ref, _ = _fold_stream("0", monkeypatch)
+    fused, _ = _fold_stream("device", monkeypatch)
+    assert set(ref.rows) == set(fused.rows)
+    assert ref.t == fused.t
+    for key in ref.rows:
+        _assert_fp32_roundoff(
+            (fused.rows[key], fused.m[key], fused.v[key]),
+            (ref.rows[key], ref.m[key], ref.v[key]))
+
+
+def test_fold_fused_counts_steps_and_default_is_fused(monkeypatch):
+    monkeypatch.delenv("PIO_STREAM_FUSED", raising=False)
+    assert fused_fold_mode() == "auto"
+    before = stream_metrics.FUSED_STEPS._default().value
+    tr = _mini_trainer()
+    tr.fold([_rate("u0", "i1", 4.0)])
+    assert stream_metrics.FUSED_STEPS._default().value == before + 1
+    monkeypatch.setenv("PIO_STREAM_FUSED", "0")
+    tr.fold([_rate("u0", "i1", 4.0)])
+    assert stream_metrics.FUSED_STEPS._default().value == before + 1
+
+
+def test_fold_fused_poison_events_still_dead_lettered(monkeypatch):
+    ref, (r1, p1, _, _) = _fold_stream("0", monkeypatch, with_poison=True)
+    fused, (f1, fp1, _, _) = _fold_stream("1", monkeypatch, with_poison=True)
+    assert len(p1) == len(fp1) == 1  # the bad apple is reported, not folded
+    assert r1.n_folded == f1.n_folded == 4  # good events still fold
+    for key in ref.rows:
+        assert ref.rows[key].tobytes() == fused.rows[key].tobytes()
+
+
+def test_fused_fold_mode_validates(monkeypatch):
+    monkeypatch.setenv("PIO_STREAM_FUSED", "turbo")
+    with pytest.raises(ValueError, match="PIO_STREAM_FUSED"):
+        fused_fold_mode()

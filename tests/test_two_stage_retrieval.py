@@ -48,7 +48,9 @@ def two_stage_env(monkeypatch):
     """Force the two-stage path with a pinned, comfortable probe width."""
     monkeypatch.setenv("PIO_RETRIEVAL_MODE", "two_stage")
     monkeypatch.setenv("PIO_RETRIEVAL_NPROBE", "16")
-    monkeypatch.delenv("PIO_RETRIEVAL_QUANTIZE", raising=False)
+    # these tests exercise the fp32 exact-math rerank (the recall oracle
+    # path); int8 is the serving default, so opt out explicitly
+    monkeypatch.setenv("PIO_RETRIEVAL_QUANTIZE", "0")
     monkeypatch.delenv("PIO_RETRIEVAL_PARTITIONS", raising=False)
 
 
@@ -509,3 +511,163 @@ def test_cli_index_stats_formatting(two_stage_env):
     assert "retrieval=two_stage" in text
     assert f"over {indexed.n_items} items" in text
     assert "no partition index" in text  # the exact model's row
+
+
+# -- int8 end to end: coarse + rerank (ISSUE 18) ----------------------------
+
+@pytest.fixture
+def int8_env(two_stage_env, monkeypatch):
+    monkeypatch.setenv("PIO_RETRIEVAL_QUANTIZE", "1")
+    monkeypatch.delenv("PIO_RETRIEVAL_QUANT_COARSE", raising=False)
+
+
+@pytest.mark.parametrize(
+    "kind", ["none", "exclude", "row_ban", "row_whitelist",
+             "exclude_plus_row"])
+def test_int8_end_to_end_recall_floor_all_mask_kinds(int8_env, kind):
+    """int8 coarse + int8 rerank (both stages quantized, one fp32 rescale
+    each) holds the SAME 0.95 recall@10 floor as the fp32 two-stage path,
+    through every rule-filter kind — and masked items never surface."""
+    oracle = _exact_oracle()
+    model = _clustered_model()
+    model.prepare_for_serving()
+    ivf = model._ivf
+    assert ivf.quantized and ivf.emb_m is None
+    assert ivf.stats()["quant_coarse"]  # auto follows the quantized index
+    users = np.arange(64, dtype=np.int32)
+    exclude, row_mask = _filter_cases(oracle, users)[kind]
+    coarse0 = ann.INT8_COARSE._default().value
+    rerank0 = ann.INT8_RERANK._default().value
+    oi, _ = TwoTowerMF.recommend_batch(
+        oracle, users, 10, exclude=exclude, row_mask=row_mask)
+    gi, gs = TwoTowerMF.recommend_batch(
+        model, users, 10, exclude=exclude, row_mask=row_mask)
+    assert gi.shape == (64, 10)
+    assert _recall(oi, gi) >= RECALL_FLOOR
+    # the int8 engines really served the batch (counted, attributable)
+    assert ann.INT8_COARSE._default().value == coarse0 + 1
+    assert ann.INT8_RERANK._default().value == rerank0 + 1
+    for r in range(64):
+        finite = np.isfinite(gs[r])
+        if exclude is not None:
+            assert not (set(exclude.tolist()) & set(gi[r][finite].tolist()))
+        if row_mask is not None:
+            assert np.all(row_mask[r, gi[r][finite]] == 0.0)
+
+
+def test_int8_fallbacks_answer_from_exact_path(int8_env, monkeypatch):
+    """Both under-coverage fallbacks (probe too narrow for num; whitelist
+    narrower than the probe) keep answering from the EXACT path under int8
+    — bitwise the exact oracle, never a short or quantized answer."""
+    oracle = _exact_oracle()
+    # (a) num bigger than any single partition at nprobe=1
+    monkeypatch.setenv("PIO_RETRIEVAL_NPROBE", "1")
+    model = _clustered_model()
+    model.prepare_for_serving()
+    num = int(np.diff(model._ivf.offsets).max()) + 1
+    before = ann.FALLBACKS._default().value
+    users = np.arange(8, dtype=np.int32)
+    gi, gs = TwoTowerMF.recommend_batch(model, users, num)
+    assert ann.FALLBACKS._default().value == before + 1
+    oi, oscores = TwoTowerMF.recommend_batch(oracle, users, num)
+    np.testing.assert_array_equal(gi, oi)
+    np.testing.assert_allclose(gs, oscores, rtol=1e-5, atol=1e-5)
+    # (b) whitelist narrower than probe coverage
+    monkeypatch.setenv("PIO_RETRIEVAL_NPROBE", "16")
+    model = _clustered_model()
+    model.prepare_for_serving()
+    n = model.n_items
+    q = np.asarray(model.user_emb, np.float32)
+    rng = np.random.default_rng(3)
+    white = np.full((8, n), -np.inf, np.float32)
+    for r, u in enumerate(users):
+        cands = set(model._ivf.candidate_ids(q[u], 16).tolist())
+        inside = np.asarray(sorted(cands))
+        outside = np.asarray(sorted(set(range(n)) - cands))
+        pick = np.concatenate([rng.choice(inside, 2, replace=False),
+                               rng.choice(outside, 10, replace=False)])
+        white[r, pick] = 0.0
+    before = ann.FALLBACKS._default().value
+    gi, gs = TwoTowerMF.recommend_batch(model, users, 10, row_mask=white)
+    assert ann.FALLBACKS._default().value == before + 1
+    oi, oscores = TwoTowerMF.recommend_batch(oracle, users, 10,
+                                             row_mask=white)
+    np.testing.assert_array_equal(gi, oi)
+    np.testing.assert_allclose(gs, oscores, rtol=1e-5, atol=1e-5)
+
+
+def test_int8_coarse_knob_opt_out(int8_env, monkeypatch):
+    """PIO_RETRIEVAL_QUANT_COARSE=0: rerank stays int8, the coarse stage
+    scores fp32 — counted (and reported) accordingly."""
+    monkeypatch.setenv("PIO_RETRIEVAL_QUANT_COARSE", "0")
+    model = _clustered_model()
+    model.prepare_for_serving()
+    ivf = model._ivf
+    assert ivf.quantized and not ivf.stats()["quant_coarse"]
+    coarse0 = ann.INT8_COARSE._default().value
+    rerank0 = ann.INT8_RERANK._default().value
+    users = np.arange(16, dtype=np.int32)
+    gi, _ = TwoTowerMF.recommend_batch(model, users, 10)
+    assert gi.shape == (16, 10)
+    assert ann.INT8_COARSE._default().value == coarse0
+    assert ann.INT8_RERANK._default().value == rerank0 + 1
+    # an fp32 index can never opt IN to int8 coarse
+    assert not ann.quant_coarse_enabled(False)
+    with pytest.raises(ValueError, match="PIO_RETRIEVAL_QUANT_COARSE"):
+        monkeypatch.setenv("PIO_RETRIEVAL_QUANT_COARSE", "maybe")
+        ann.quant_coarse_enabled(True)
+
+
+def test_int8_stats_report_bytes_saved(int8_env):
+    model = _clustered_model()
+    model.prepare_for_serving()
+    stats = model._ivf.stats()
+    n, d = model.n_items, model.config.rank
+    assert stats["quantized"] and stats["quant_coarse"]
+    assert stats["rerank_bytes"] == n * d + n * 4  # int8 rows + f32 scales
+    assert stats["rerank_bytes_fp32"] == n * d * 4
+    assert stats["bytes_saved"] == \
+        stats["rerank_bytes_fp32"] - stats["rerank_bytes"]
+    assert stats["bytes_saved"] > 0
+    # pio-tpu index surfaces the mode + savings
+    from incubator_predictionio_tpu.tools.cli import format_index_stats
+
+    text = "\n".join(format_index_stats([model]))
+    assert "int8 member rows" in text and "int8 coarse" in text
+    # fp32 index reports no savings line
+    fp32 = _exact_oracle()
+    assert "int8" not in "\n".join(format_index_stats([fp32]))
+
+
+def test_int8_search_unknown_user_vector_paths(int8_env):
+    """IVFIndex.search under int8 with query vectors that did NOT come from
+    the user table (the unknown-user/cold-start serving shape): the scores
+    agree with the fp32 rerank formula within the quantization bound."""
+    model = _clustered_model()
+    model.prepare_for_serving()
+    ivf = model._ivf
+    rng = np.random.default_rng(11)
+    q = rng.standard_normal((4, model.config.rank)).astype(np.float32)
+    ub = np.zeros(4, np.float32)
+    idx, scores = ivf.search(q, ub, model.mean, 10)
+    assert idx.shape == (4, 10) and np.isfinite(scores).all()
+    item_emb = np.asarray(model.item_emb, np.float32)
+    item_bias = np.asarray(model.item_bias, np.float32)
+    want = np.take_along_axis(
+        q @ item_emb.T + item_bias[None, :], idx, axis=1) + model.mean
+    np.testing.assert_allclose(scores, want, rtol=0.05, atol=0.05)
+
+
+def test_int8_is_the_serving_default(two_stage_env, monkeypatch):
+    """The tentpole contract: with NO quantize knob set, a built index
+    stores and scores int8; PIO_RETRIEVAL_QUANTIZE=0 is the opt-OUT."""
+    from incubator_predictionio_tpu.serving import ann
+
+    monkeypatch.delenv("PIO_RETRIEVAL_QUANTIZE", raising=False)
+    assert ann.quantize_enabled()
+    model = _clustered_model()
+    model.prepare_for_serving()
+    assert model._ivf is not None and model._ivf.quantized
+    assert model._ivf.stats()["bytes_saved"] > 0
+    monkeypatch.setenv("PIO_RETRIEVAL_QUANTIZE", "0")
+    assert not ann.quantize_enabled()
