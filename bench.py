@@ -2850,7 +2850,8 @@ CONFIG_NAMES = ["recommendation", "recommendation_scaled", "classification",
                 "obs_overhead", "overload", "fleet", "sharded_fleet",
                 "ingestion", "ingest_durability",
                 "streaming_freshness", "storage_failover",
-                "continuous_training", "disaster_recovery"]
+                "continuous_training", "disaster_recovery",
+                "distributed_training"]
 # "fleet" and "sharded_fleet" are device-free too: their replicas are CPU
 # subprocesses (a fleet on one host) — the scenarios measure the ROUTER's
 # horizontal scaling and scatter/gather cost, not chip throughput; "sharded_serving" likewise runs on 8 virtual CPU
@@ -2860,7 +2861,7 @@ CONFIG_NAMES = ["recommendation", "recommendation_scaled", "classification",
 DEVICE_FREE = {"ingestion", "ingest_durability", "fleet", "sharded_fleet",
                "streaming_freshness", "storage_failover",
                "sharded_serving", "continuous_training",
-               "disaster_recovery"}
+               "disaster_recovery", "distributed_training"}
 
 
 def _build_suite(ctx, peaks, device) -> dict:
@@ -2886,6 +2887,7 @@ def _build_suite(ctx, peaks, device) -> dict:
         "storage_failover": lambda: bench_storage_failover(),
         "continuous_training": lambda: bench_continuous_training(),
         "disaster_recovery": lambda: bench_disaster_recovery(),
+        "distributed_training": lambda: bench_distributed_training(),
     }
 
 
@@ -3302,6 +3304,164 @@ def bench_continuous_training() -> dict:
                 p.stop()
         use_storage(prev)
         storage.close()
+
+
+# ---------------------------------------------------------------------------
+# 12. distributed training (docs/sharding.md "Multi-host training"): 1 vs N
+#     supervised member processes training the recommendation template with
+#     row-sharded tables, then SIGKILL one member mid-epoch — MTTR, the
+#     pinned resume epoch, and zero divergence vs the uninterrupted N-member
+#     run, plus the supervisor plane's pio_dist_* metric deltas
+# ---------------------------------------------------------------------------
+
+
+def bench_distributed_training() -> dict:
+    """Three supervised runs of ``pio-tpu train --distributed`` members:
+
+    - **1 member** (degenerate mesh) and **2 members** uninterrupted —
+      the multi-process overhead column;
+    - **2 members + SIGKILL** of one member after the second slice-
+      checkpoint commit: the supervisor fences generation 1, re-forms the
+      mesh, and the new generation resumes from the last commit. The lane
+      archives the recovery MTTR, the log-pinned resume epoch, and proves
+      the recovered run's final committed state is BIT-IDENTICAL to the
+      uninterrupted 2-member run (zero divergence).
+    """
+    import datetime as dt_mod
+    import glob as glob_mod
+    import tempfile
+    import threading
+
+    from incubator_predictionio_tpu.data import DataMap, Event
+    from incubator_predictionio_tpu.data.storage import App, Storage, use_storage
+    from incubator_predictionio_tpu.distributed.supervisor import Supervisor
+    from incubator_predictionio_tpu.obs.metrics import REGISTRY
+    from incubator_predictionio_tpu.utils import checkpoint as ckpt_fs
+
+    tmp = tempfile.mkdtemp(prefix="pio-dist-bench-")
+    iterations = 8 if SMALL else 12
+    n_events = 3_000 if SMALL else 8_000
+    utc = dt_mod.timezone.utc
+    store_cfg = {
+        "PIO_STORAGE_SOURCES_SQ_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_SQ_PATH": os.path.join(tmp, "store.db"),
+    }
+    storage = Storage(store_cfg)
+    prev = use_storage(storage)
+    try:
+        app_id = storage.get_meta_data_apps().insert(App(0, "dist-app"))
+        events = storage.get_events()
+        events.init(app_id)
+        rng = np.random.default_rng(13)
+        events.insert_batch([
+            Event(event="rate", entity_type="user",
+                  entity_id=f"u{rng.integers(0, 400)}",
+                  target_entity_type="item",
+                  target_entity_id=f"i{rng.integers(0, 300)}",
+                  properties=DataMap({"rating": float(1 + 4 * rng.random())}),
+                  event_time=dt_mod.datetime(2022, 1, 1, tzinfo=utc))
+            for _ in range(n_events)
+        ], app_id)
+    finally:
+        use_storage(prev)
+        storage.close()
+
+    def phase(tag: str, members: int):
+        ckpt_dir = os.path.join(tmp, f"ckpt-{tag}")
+        variant_path = os.path.join(tmp, f"engine-{tag}.json")
+        with open(variant_path, "w") as f:
+            json.dump({
+                "id": f"dist-{tag}", "version": "1",
+                "engineFactory": "incubator_predictionio_tpu.templates."
+                                 "recommendation.RecommendationEngine",
+                "datasource": {"params": {"appName": "dist-app"}},
+                "algorithms": [{"name": "als", "params": {
+                    "rank": 32, "numIterations": iterations,
+                    "batchSize": 1024,
+                    "checkpointDir": ckpt_dir, "checkpointEvery": 1}}],
+            }, f)
+        sup = Supervisor(
+            ["train", "-v", variant_path, "--distributed",
+             "--mesh-axes", json.dumps({"model": members})],
+            num_processes=members,
+            state_dir=os.path.join(tmp, f"mesh-{tag}"),
+            heartbeat_ms=2000,
+            max_recoveries=2,
+            cpu_devices_per_process=1,
+            env={**store_cfg, "PIO_FS_BASEDIR": os.path.join(tmp, f"fs-{tag}")},
+            timeout=900.0,
+        )
+        return sup, ckpt_dir
+
+    # -- 1 member (degenerate mesh) then 2 members, uninterrupted ----------
+    sup1, _ = phase("1p", 1)
+    t0 = time.perf_counter()
+    res1 = sup1.run()
+    train_1p_s = time.perf_counter() - t0
+    assert res1.ok, res1.logs_text()[-3000:]
+
+    sup2, ckpt_2p = phase("2p", 2)
+    t0 = time.perf_counter()
+    res2 = sup2.run()
+    train_2p_s = time.perf_counter() - t0
+    assert res2.ok and res2.recoveries == 0, res2.logs_text()[-3000:]
+
+    # -- 2 members, SIGKILL one mid-epoch ----------------------------------
+    m_before = _metrics_snapshot(REGISTRY.expose())
+    supc, ckpt_ch = phase("chaos", 2)
+    box: dict = {}
+    t0 = time.perf_counter()
+    runner = threading.Thread(target=lambda: box.update(res=supc.run()))
+    runner.start()
+    deadline = time.monotonic() + 600.0
+    while time.monotonic() < deadline:
+        steps = ckpt_fs.committed_steps(ckpt_ch)
+        alive = supc.alive_pids()
+        if steps and steps[-1] >= 2 and alive:
+            os.kill(sorted(alive.items())[-1][1], 9)
+            break
+        if not runner.is_alive():
+            raise AssertionError("chaos run finished before the kill window")
+        time.sleep(0.05)
+    runner.join(timeout=900.0)
+    chaos_total_s = time.perf_counter() - t0
+    resc = box["res"]
+    assert resc.ok and resc.recoveries == 1, resc.logs_text()[-3000:]
+    logs = resc.logs_text()
+    assert "resuming from epoch" in logs, logs[-3000:]
+    resumed_epoch = int(logs.split("resuming from epoch", 1)[1].split()[0])
+
+    # zero divergence: recovered == uninterrupted, bit for bit
+    leaves_2p = ckpt_fs.assemble_committed_step(ckpt_2p, iterations)
+    leaves_ch = ckpt_fs.assemble_committed_step(ckpt_ch, iterations)
+    div = max(
+        (float(np.max(np.abs(np.asarray(a, np.float64)
+                             - np.asarray(b, np.float64))))
+         if np.asarray(a).size else 0.0)
+        for a, b in zip(leaves_2p, leaves_ch))
+    assert div == 0.0, f"recovered run diverged by {div}"
+
+    after = _metrics_snapshot(REGISTRY.expose())
+    dist_delta = {k: round(after.get(k, 0) - m_before.get(k, 0), 3)
+                  for k in after
+                  if k.startswith("pio_dist_")
+                  and after.get(k, 0) != m_before.get(k, 0)}
+    slices = len(glob_mod.glob(os.path.join(
+        ckpt_ch, "slices", f"step-{iterations}", "member-*.json")))
+    return {
+        "members": 2,
+        "epochs": iterations,
+        "train_1p_s": round(train_1p_s, 2),
+        "train_2p_s": round(train_2p_s, 2),
+        "chaos_total_s": round(chaos_total_s, 2),
+        "recovery_mttr_s": [round(t, 3) for t in resc.mttr_s],
+        "recoveries": resc.recoveries,
+        "final_generation": resc.generation,
+        "resumed_from_epoch": resumed_epoch,
+        "member_slices_at_final_commit": slices,
+        "divergence_max_abs": div,
+        "pio_dist_delta": dist_delta,
+    }
 
 
 def run_one_config(name: str) -> None:

@@ -113,6 +113,12 @@ def _run_train(config: WorkflowConfig, storage: Optional[Storage]) -> str:
     )
     logger.info("training %s (factory %s)", instance.engine_id, factory_path)
     ctx = MeshContext.from_conf(mesh_conf or None)
+    # fault-tolerant member mode: under a dist supervisor (PIO_DIST_STATE_DIR
+    # set) the context gains heartbeat leases, generation fencing and slice
+    # checkpointing; otherwise this returns ctx untouched
+    from incubator_predictionio_tpu.distributed.context import maybe_wrap_distributed
+
+    ctx = maybe_wrap_distributed(ctx)
     return run_train(
         engine, engine_params, instance, _workflow_params(config),
         storage=storage, ctx=ctx,

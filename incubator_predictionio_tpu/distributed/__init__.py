@@ -1,0 +1,43 @@
+"""Fault-tolerant multi-host TRAINING tier (the serve half landed with the
+shard-owner scatter/gather work; this package is its training twin).
+
+N worker processes form a ``jax.distributed`` mesh and train the row-sharded
+tables with each owner holding only its ``[lo, hi)`` slice. Robustness rides
+three pieces, all in the repo's established idioms:
+
+- :mod:`.meshdir` — a durable coordination directory (heartbeat leases + a
+  monotonic mesh **generation**, the epoch-fencing pattern of the shard
+  owners) shared by the members and their supervisor;
+- :mod:`.checkpoint` — coordinated slice checkpointing: every member saves
+  its OWN rows, a commit marker lands only after all slices are durable, so
+  a kill between slices can never compose two histories;
+- :mod:`.context` / :mod:`.supervisor` — the in-process guard (collective
+  timeout detection, generation fencing, self-abort on lost peers) and the
+  process-level supervisor that detects member loss, bumps the generation,
+  re-forms the mesh, and resumes from the last committed checkpoint.
+
+docs/sharding.md ("Multi-host training") is the operator walkthrough.
+"""
+
+from incubator_predictionio_tpu.distributed.checkpoint import DistSliceCheckpointer
+from incubator_predictionio_tpu.distributed.context import (
+    DistConfig,
+    DistContext,
+    FencedGenerationError,
+    MemberLostError,
+    maybe_wrap_distributed,
+)
+from incubator_predictionio_tpu.distributed.meshdir import MeshDirectory
+from incubator_predictionio_tpu.distributed.supervisor import Supervisor, SupervisorResult
+
+__all__ = [
+    "DistConfig",
+    "DistContext",
+    "DistSliceCheckpointer",
+    "FencedGenerationError",
+    "MemberLostError",
+    "MeshDirectory",
+    "Supervisor",
+    "SupervisorResult",
+    "maybe_wrap_distributed",
+]

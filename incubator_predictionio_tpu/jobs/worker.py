@@ -34,6 +34,7 @@ import dataclasses
 import json
 import logging
 import os
+import re
 import signal
 import threading
 import time
@@ -256,14 +257,24 @@ class JobWorker:
         # the incumbent is resolved BEFORE training: after create_workflow
         # the candidate itself is the latest COMPLETED instance
         incumbent = self._incumbent_instance(p, variant)
-        instance_id = create_workflow(WorkflowConfig(
-            engine_variant=variant,
-            batch=p.get("batch") or f"jobs:{hb.job.trigger}",
-            mesh_axes=p.get("mesh_axes"),
-        ), self.storage)
+        dist_info: Optional[dict] = None
+        if int(p.get("dist") or 0) > 1:
+            # process-spanning train: N supervised member processes under
+            # the mesh-generation fence (distributed/supervisor.py); member
+            # loss is recovered there, a blown recovery budget surfaces
+            # here as a failed attempt under the normal retry accounting
+            instance_id, dist_info = self._dist_train(hb, p, variant)
+        else:
+            instance_id = create_workflow(WorkflowConfig(
+                engine_variant=variant,
+                batch=p.get("batch") or f"jobs:{hb.job.trigger}",
+                mesh_axes=p.get("mesh_axes"),
+            ), self.storage)
         self._maybe_fault("after_train")
         result: dict[str, Any] = {"instanceId": instance_id,
                                   "incumbentId": incumbent}
+        if dist_info is not None:
+            result["dist"] = dist_info
         # -- eval gate ----------------------------------------------------
         gate_cfg = None
         if p.get("gate") in ("off", False, "0"):
@@ -296,6 +307,70 @@ class JobWorker:
         # -- deploy (fence-checked) ---------------------------------------
         result["deploy"] = self._deploy(hb, p)
         return result
+
+    def _dist_train(self, hb: _Heartbeat, p: dict,
+                    variant: str) -> tuple[str, dict]:
+        """Run the train as ``p["dist"]`` supervised member processes.
+
+        The members execute the ordinary ``pio-tpu train --distributed``
+        verb; the supervisor owns mesh formation, loss detection, fencing
+        and relaunch. The worker's lease keeps beating in its own thread,
+        and ``should_abort`` folds the two fence domains together: losing
+        the JOB lease aborts the MESH, so a zombie worker cannot keep a
+        training fleet running for a job it no longer owns."""
+        from incubator_predictionio_tpu.distributed.context import DistConfig
+        from incubator_predictionio_tpu.distributed.supervisor import Supervisor
+        from incubator_predictionio_tpu.utils import fs
+
+        conf = DistConfig.from_env()
+        n = int(p["dist"])
+        state_dir = (p.get("dist_state_dir") or conf.state_dir
+                     or os.path.join(fs.subdir("dist"), hb.job.id))
+        # one "model" axis spanning the members: it doubles as the data
+        # axis (MeshContext.data_axis falls back to the first axis), so the
+        # per-process batch staging AND the row-sharded tables both split
+        # over process boundaries — each member owns exactly its [lo, hi)
+        # row block (docs/sharding.md "Multi-host training")
+        mesh_axes = p.get("mesh_axes") or {"model": n}
+        cli_args = ["train", "-v", variant, "--distributed",
+                    "--mesh-axes", json.dumps(mesh_axes),
+                    "--batch", p.get("batch") or f"jobs:{hb.job.trigger}"]
+        devices = None
+        if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+            devices = int(p.get("dist_devices_per_process") or 1)
+        sup = Supervisor(
+            cli_args, n, state_dir,
+            heartbeat_ms=conf.heartbeat_ms,
+            max_recoveries=conf.max_recoveries,
+            cpu_devices_per_process=devices,
+            clock=self.clock,
+            should_abort=lambda: hb.lost is not None,
+        )
+        res = self._run_supervised(sup)
+        if not res.ok:
+            if hb.lost is not None:
+                raise hb.lost
+            raise RuntimeError(
+                f"distributed train failed ({res.detail or 'member exit'}; "
+                f"rcs={res.returncodes}, recoveries={res.recoveries})")
+        match = re.search(r"Engine instance ID: (\S+)",
+                          res.logs_text(rank=0))
+        if not match:
+            raise RuntimeError(
+                "distributed train finished but member 0 never reported an "
+                "engine instance id")
+        return match.group(1), {
+            "members": n,
+            "recoveries": res.recoveries,
+            "mttrS": [round(t, 3) for t in res.mttr_s],
+            "generation": res.generation,
+            "stateDir": state_dir,
+        }
+
+    @staticmethod
+    def _run_supervised(sup) -> Any:
+        """Seam for tests: runs the supervisor to completion."""
+        return sup.run()
 
     def _incumbent_instance(self, params: dict,
                             variant: str) -> Optional[str]:

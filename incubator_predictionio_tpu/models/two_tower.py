@@ -765,12 +765,18 @@ class TwoTowerMF:
         jax.block_until_ready((params, opt_state))
         t_init = _time.perf_counter() - t_init
         t_train = _time.perf_counter()
+        # distributed members checkpoint by owned slice and fence-check at
+        # every chunk boundary (DistContext.dist_hooks); a plain ctx has no
+        # hooks and trains exactly as before
+        dist = getattr(ctx, "dist_hooks", None)
         params, opt_state, loss = checkpointed_epochs(
             cfg.checkpoint_dir, cfg.checkpoint_every, cfg.checkpoint_keep,
             cfg.epochs, params, opt_state, ctx.mesh,
             lambda p, o, n: _train_epochs(
                 p, o, ub, ib, rb, wb, cfg.learning_rate, cfg.reg, n
             ),
+            factory=None if dist is None else dist.checkpointer_factory,
+            on_chunk=None if dist is None else dist.on_chunk,
         )
         if loss is None:
             loss = np.inf

@@ -25,6 +25,7 @@ from __future__ import annotations
 import json
 import logging
 import time
+from typing import Optional
 
 from aiohttp import web
 
@@ -194,11 +195,58 @@ async def handle_history(request: web.Request) -> web.Response:
     return web.json_response({"records": records})
 
 
+def _mesh_health_block() -> Optional[dict]:
+    """The /health mesh block: the coordination directory's snapshot when
+    this process runs under (or supervises) a distributed training mesh
+    (``PIO_DIST_STATE_DIR``); None otherwise. Synchronous — callers hop
+    through an executor."""
+    import os
+
+    from incubator_predictionio_tpu.distributed.context import DistConfig
+    from incubator_predictionio_tpu.distributed.meshdir import MeshDirectory
+
+    state_dir = os.environ.get("PIO_DIST_STATE_DIR")
+    if not state_dir:
+        return None
+    conf = DistConfig.from_env()
+    snap = MeshDirectory(state_dir).health_snapshot(
+        conf.heartbeat_ms, quorum=conf.quorum or None)
+    return {
+        "stateDir": snap["stateDir"],
+        "generation": snap["generation"],
+        "members": snap["aliveMembers"],
+        "expectedMembers": snap["expectedMembers"],
+        "quorum": snap["quorum"],
+        "degraded": snap["degraded"],
+        "lastCommit": snap["lastCommit"],
+    }
+
+
+async def handle_obs_health(request: web.Request) -> web.Response:
+    """``GET /health`` on the dark-plane obs server (jobs worker, stream
+    updater): process liveness plus the distributed-training mesh block —
+    status degrades when the mesh falls below quorum, so one probe covers
+    both the worker and the fleet it trains."""
+    import asyncio
+
+    # the mesh snapshot stats/reads small files: executor hop keeps the
+    # event loop non-blocking (R1)
+    mesh = await asyncio.get_running_loop().run_in_executor(
+        None, _mesh_health_block)
+    body: dict = {"status": "ok"}
+    if mesh is not None:
+        body["mesh"] = mesh
+        if mesh["degraded"]:
+            body["status"] = "degraded"
+    return web.json_response(body)
+
+
 def add_observability_routes(app: web.Application) -> None:
     app.router.add_get("/metrics", handle_metrics)
     app.router.add_get("/traces.json", handle_traces)
     app.router.add_get("/profile.json", handle_profile)
     app.router.add_get("/history.json", handle_history)
+    app.router.add_get("/health", handle_obs_health)
 
 
 # ---------------------------------------------------------------------------

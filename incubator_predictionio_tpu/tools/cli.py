@@ -989,6 +989,8 @@ def cmd_health(args, storage) -> int:
                                     args.quarantine_max_age))
     if getattr(args, "backup_dir", None):
         rows.append(_backup_row(args.backup_dir, args.backup_max_age))
+    if getattr(args, "dist_state_dir", None):
+        rows.append(_mesh_row(args.dist_state_dir))
     if not rows:
         _err("health: nothing to probe (give server URLs and/or "
              "--stream-state-dir / --backup-dir)")
@@ -1096,6 +1098,74 @@ def _quarantine_row(state_dir: str, max_age: Optional[float]) -> dict:
                  if stuck else f" (retrain due within {max_age:.0f}s)"))
     return {"url": url, "status": "quarantined", "red": stuck,
             "detail": detail}
+
+
+def _mesh_row(state_dir: str) -> dict:
+    """Synthetic health row for a distributed-training mesh (the
+    quarantine-row pattern): red when live members are below quorum — a
+    mesh that can no longer make training progress or commit a checkpoint
+    (docs/sharding.md "Multi-host training")."""
+    from incubator_predictionio_tpu.distributed.context import DistConfig
+    from incubator_predictionio_tpu.distributed.meshdir import MeshDirectory
+
+    conf = DistConfig.from_env()
+    snap = MeshDirectory(state_dir).health_snapshot(
+        conf.heartbeat_ms, quorum=conf.quorum or None)
+    url = f"mesh:{state_dir}"
+    commit = snap.get("lastCommit") or {}
+    commit_txt = (f"last commit step {commit['step']} "
+                  f"(gen {commit['generation']})" if commit else "no commit yet")
+    detail = (f"generation {snap['generation']}, members "
+              f"{snap['aliveMembers']}/{snap['expectedMembers']} alive "
+              f"(quorum {snap['quorum']}); {commit_txt}")
+    if snap["degraded"]:
+        detail += (" — BELOW QUORUM: training cannot progress; restart the "
+                   "lost members or their supervisor (docs/sharding.md)")
+        return {"url": url, "status": "degraded", "red": True,
+                "detail": detail}
+    if snap["expectedMembers"] == 0:
+        return {"url": url, "status": "no-mesh", "red": False,
+                "detail": "no generation announced yet"}
+    return {"url": url, "status": "ok", "red": False, "detail": detail}
+
+
+def cmd_dist_status(args, storage) -> int:
+    """``pio-tpu dist status`` — the operator view of a training mesh:
+    generation, per-member heartbeat ages, last coordinated commit, and
+    the quorum verdict. Exits non-zero when the mesh is degraded (the
+    ``pio-tpu health`` convention)."""
+    from incubator_predictionio_tpu.distributed.context import DistConfig
+    from incubator_predictionio_tpu.distributed.meshdir import MeshDirectory
+
+    conf = DistConfig.from_env()
+    state_dir = getattr(args, "state_dir", None) or conf.state_dir
+    if not state_dir:
+        _err("dist status: no coordination dir (--state-dir or "
+             "PIO_DIST_STATE_DIR)")
+        return 2
+    snap = MeshDirectory(state_dir).health_snapshot(
+        conf.heartbeat_ms, quorum=conf.quorum or None)
+    if getattr(args, "json", False):
+        _out(json.dumps(snap, indent=2))
+        return 1 if snap["degraded"] else 0
+    _out(f"Mesh {state_dir}")
+    _out(f"  generation: {snap['generation']}   members: "
+         f"{snap['aliveMembers']}/{snap['expectedMembers']} alive   "
+         f"quorum: {snap['quorum']}   "
+         f"{'DEGRADED' if snap['degraded'] else 'ok'}")
+    commit = snap.get("lastCommit")
+    if commit:
+        _out(f"  last commit: step {commit['step']} "
+             f"(generation {commit['generation']})")
+    else:
+        _out("  last commit: none")
+    for mrec in snap["members"]:
+        state = "alive" if mrec["alive"] else (
+            "fenced" if mrec["generation"] != snap["generation"] else "STALE")
+        _out(f"  member {mrec['rank']}: pid {mrec['pid']} gen "
+             f"{mrec['generation']} step {mrec['step']} "
+             f"beat {mrec['ageMs']:.0f}ms ago [{state}]")
+    return 1 if snap["degraded"] else 0
 
 
 def format_index_stats(models) -> list[str]:
@@ -2097,6 +2167,12 @@ def _job_params_from_args(args) -> dict:
         params["evaluation_class"] = args.evaluation_class
     if getattr(args, "no_gate", False):
         params["gate"] = "off"
+    if getattr(args, "dist", 0):
+        if args.kind != "train":
+            raise SystemExit("jobs submit: --dist applies to --kind train")
+        params["dist"] = int(args.dist)
+        if getattr(args, "dist_state_dir", None):
+            params["dist_state_dir"] = args.dist_state_dir
     if getattr(args, "params", None):
         params.update(json.loads(args.params))
     return params
@@ -2939,6 +3015,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-dedupe", action="store_true",
                    help="queue even if an identical train job is active")
     p.add_argument("--max-attempts", type=int, default=3)
+    p.add_argument("--dist", type=int, default=0, metavar="N",
+                   help="for --kind train: run the train as N supervised "
+                        "member processes with mesh-generation fencing and "
+                        "coordinated slice checkpoints (docs/sharding.md "
+                        "\"Multi-host training\")")
+    p.add_argument("--dist-state-dir",
+                   help="coordination dir for --dist (default: "
+                        "PIO_DIST_STATE_DIR, else a per-job dir under "
+                        "PIO_FS_BASEDIR)")
     p.add_argument("--params", help="extra params JSON merged into the job")
     p = jb.add_parser("list")
     p.add_argument("--all", action="store_true",
@@ -3324,6 +3409,23 @@ def build_parser() -> argparse.ArgumentParser:
                    help="seconds the newest verified backup may age "
                         "before the row turns red (default: "
                         "PIO_BACKUP_MAX_AGE, else 86400)")
+    p.add_argument("--dist-state-dir",
+                   help="also probe this distributed-training coordination "
+                        "dir: red when live members fall below quorum "
+                        "(docs/sharding.md \"Multi-host training\")")
+
+    # dist — distributed-training mesh inspection (docs/sharding.md)
+    dist = sub.add_parser(
+        "dist",
+        help="distributed training tier: status (mesh generation, member "
+             "heartbeats, last coordinated checkpoint commit, quorum "
+             "verdict)")
+    ds = dist.add_subparsers(dest="dist_command")
+    p = ds.add_parser("status")
+    p.add_argument("--state-dir",
+                   help="coordination directory (default: "
+                        "PIO_DIST_STATE_DIR)")
+    p.add_argument("--json", action="store_true")
 
     # fleet — router / rolling deploy / experiment (docs/serving.md)
     fleet = sub.add_parser(
@@ -3617,6 +3719,10 @@ _JOBS_COMMANDS = {
     "triggers": cmd_jobs_triggers,
 }
 
+_DIST_COMMANDS = {
+    "status": cmd_dist_status,
+}
+
 
 def main(argv: Optional[list[str]] = None) -> int:
     parser = build_parser()
@@ -3671,6 +3777,11 @@ def main(argv: Optional[list[str]] = None) -> int:
                  "retry|prune|worker|triggers)")
             return 1
         return _JOBS_COMMANDS[args.jobs_command](args, storage)
+    if args.command == "dist":
+        if not args.dist_command:
+            _err("dist: missing subcommand (status)")
+            return 1
+        return _DIST_COMMANDS[args.dist_command](args, storage)
     if args.command == "template":
         if not args.template_command:
             # parse_args(["template", "--help"]) would SystemExit(0); a

@@ -113,6 +113,7 @@ def maybe_resume(
     opt_state: Any,
     epochs: int,
     mesh,
+    factory=None,
 ) -> tuple[Optional[TrainCheckpointer], Any, Any, int]:
     """Open a checkpointer and resume an interrupted run if one is recoverable.
 
@@ -128,10 +129,15 @@ def maybe_resume(
       this is a new run on possibly-new data, so it must not short-circuit.
 
     The caller owns ``ckpt.close()`` (wrap the epoch loop in try/finally).
+
+    ``factory`` (default :class:`TrainCheckpointer`) swaps the checkpointer
+    implementation — the distributed tier passes
+    :class:`~incubator_predictionio_tpu.distributed.checkpoint.DistSliceCheckpointer`
+    so every member saves/restores its own slice under the same contract.
     """
     if not directory or every <= 0:
         return None, params, opt_state, 0
-    ck = TrainCheckpointer(directory, max_to_keep=keep)
+    ck = (factory or TrainCheckpointer)(directory, max_to_keep=keep)
     if ck.latest_step() is None:
         return ck, params, opt_state, 0
     try:
@@ -169,6 +175,8 @@ def checkpointed_epochs(
     opt_state: Any,
     mesh,
     train_epochs,
+    factory=None,
+    on_chunk=None,
 ) -> tuple[Any, Any, Any]:
     """The shared epoch driver both trainers run.
 
@@ -187,12 +195,19 @@ def checkpointed_epochs(
     from incubator_predictionio_tpu.utils.tracing import step_annotation
 
     ckpt, params, opt_state, start_epoch = maybe_resume(
-        directory, every, keep, params, opt_state, epochs, mesh
+        directory, every, keep, params, opt_state, epochs, mesh,
+        factory=factory,
     )
     loss = None
     try:
         e = start_epoch
         while e < epochs:
+            if on_chunk is not None:
+                # distributed seam: heartbeat + peer/fence check at every
+                # chunk boundary (the host-sync point), so a lost member or
+                # a stale generation aborts the step instead of hanging the
+                # next cross-process collective
+                on_chunk(e)
             chunk = min(every, epochs - e) if ckpt is not None else epochs - e
             with step_annotation("train_epochs", e):
                 params, opt_state, loss = train_epochs(params, opt_state, chunk)
@@ -205,6 +220,236 @@ def checkpointed_epochs(
         if ckpt is not None:
             ckpt.close()
     return params, opt_state, loss
+
+
+# -- slice-aware coordinated checkpoints ----------------------------------
+#
+# The distributed training tier checkpoints by SLICE: each mesh member
+# writes only the rows it owns, and a step becomes restorable only once a
+# commit marker exists — written after every member's slice is durable.
+# These helpers are the filesystem protocol (layout, atomicity, retention);
+# the member-side driver is distributed/checkpoint.py DistSliceCheckpointer.
+#
+#   <dir>/slices/step-<s>/member-<m>.npz    one member's owned row blocks
+#   <dir>/slices/step-<s>/member-<m>.json   manifest — atomic, written LAST,
+#                                           so its presence == slice durable
+#   <dir>/slices/commit-<s>.json            commit marker (atomic)
+#
+# A kill between two members' slice writes leaves step-<s> without a commit
+# marker; restore then uses the previous committed step — two histories can
+# never compose (tests/test_checkpoint.py pins this).
+
+SLICES_DIR = "slices"
+
+
+def slice_step_dir(directory: str, step: int) -> str:
+    return os.path.join(os.path.abspath(directory), SLICES_DIR, f"step-{int(step)}")
+
+
+def _commit_path(directory: str, step: int) -> str:
+    return os.path.join(os.path.abspath(directory), SLICES_DIR,
+                        f"commit-{int(step)}.json")
+
+
+def save_member_slice(
+    directory: str,
+    step: int,
+    member: int,
+    generation: int,
+    entries: list[dict],
+    arrays: dict[str, np.ndarray],
+) -> None:
+    """Durably write one member's slice for ``step``.
+
+    ``entries`` describe the payload (one per saved block):
+    ``{"key": <npz key>, "leaf": <flat leaf index>, "globalShape": [...],
+    "index": [[lo, hi] | None per dim]}`` — ``index`` row-bounds the block
+    inside the full leaf; all-``None`` means the member holds the whole
+    (replicated) leaf. Data lands first (atomic npz), the manifest last —
+    manifest presence is the per-member durability marker the committer
+    polls for.
+    """
+    import io
+    import json
+
+    from incubator_predictionio_tpu.utils.fs import atomic_write_bytes
+
+    d = slice_step_dir(directory, step)
+    os.makedirs(d, exist_ok=True)
+    buf = io.BytesIO()
+    np.savez(buf, **{k: np.asarray(v) for k, v in arrays.items()})
+    atomic_write_bytes(os.path.join(d, f"member-{int(member)}.npz"),
+                       buf.getvalue())
+    manifest = {"step": int(step), "member": int(member),
+                "generation": int(generation), "entries": entries}
+    atomic_write_bytes(os.path.join(d, f"member-{int(member)}.json"),
+                       json.dumps(manifest, sort_keys=True).encode("utf-8"))
+
+
+def read_member_slice(directory: str, step: int, member: int):
+    """``(manifest, arrays)`` for one member's durable slice, or ``None``
+    when the manifest is absent (slice not finished)."""
+    import json
+
+    d = slice_step_dir(directory, step)
+    mpath = os.path.join(d, f"member-{int(member)}.json")
+    try:
+        with open(mpath, "rb") as f:
+            manifest = json.loads(f.read().decode("utf-8"))
+    except (OSError, ValueError):
+        return None
+    with np.load(os.path.join(d, f"member-{int(member)}.npz")) as z:
+        arrays = {k: z[k] for k in z.files}
+    return manifest, arrays
+
+
+def members_done(directory: str, step: int, members: int, generation: int) -> list[int]:
+    """Ranks whose slice for ``(step, generation)`` is durable — the
+    committer's poll predicate. A manifest from another generation does NOT
+    count: mixing a dead mesh's slice into a new commit is exactly the
+    composed-history corruption the marker exists to prevent."""
+    import json
+
+    d = slice_step_dir(directory, step)
+    done = []
+    for m in range(members):
+        try:
+            with open(os.path.join(d, f"member-{m}.json"), "rb") as f:
+                manifest = json.loads(f.read().decode("utf-8"))
+        except (OSError, ValueError):
+            continue
+        if int(manifest.get("generation", -1)) == int(generation):
+            done.append(m)
+    return done
+
+
+def write_commit_marker(directory: str, step: int, generation: int,
+                        members: int) -> None:
+    """The coordinated-commit point: atomic + durable, so restore-side
+    visibility of the marker implies every slice it covers is on disk."""
+    import json
+    import time
+
+    from incubator_predictionio_tpu.utils.fs import atomic_write_bytes
+
+    os.makedirs(os.path.join(os.path.abspath(directory), SLICES_DIR),
+                exist_ok=True)
+    atomic_write_bytes(_commit_path(directory, step), json.dumps({
+        "step": int(step), "generation": int(generation),
+        "members": int(members), "committedAt": time.time(),
+    }, sort_keys=True).encode("utf-8"))
+
+
+def read_commit_marker(directory: str, step: int) -> Optional[dict]:
+    import json
+
+    try:
+        with open(_commit_path(directory, step), "rb") as f:
+            return json.loads(f.read().decode("utf-8"))
+    except (OSError, ValueError):
+        return None
+
+
+def committed_steps(directory: str) -> list[int]:
+    """Steps with a commit marker, ascending — the only restorable steps."""
+    d = os.path.join(os.path.abspath(directory), SLICES_DIR)
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return []
+    out = []
+    for name in names:
+        if name.startswith("commit-") and name.endswith(".json"):
+            try:
+                out.append(int(name[len("commit-"):-len(".json")]))
+            except ValueError:
+                continue
+    return sorted(out)
+
+
+def gc_slice_steps(directory: str, keep: int) -> None:
+    """Retention: drop all but the newest ``keep`` committed steps (marker
+    first, then the slice dir — a crash between the two leaves an orphan
+    dir, which is garbage but never restorable). Uncommitted step dirs
+    older than the newest commit (leftovers of a dead generation) go too."""
+    import contextlib
+    import shutil
+
+    steps = committed_steps(directory)
+    if not steps:
+        return
+    latest = steps[-1]
+    for s in steps[:-max(1, keep)] if keep > 0 else []:
+        with contextlib.suppress(OSError):
+            os.unlink(_commit_path(directory, s))
+        shutil.rmtree(slice_step_dir(directory, s), ignore_errors=True)
+    base = os.path.join(os.path.abspath(directory), SLICES_DIR)
+    kept = set(committed_steps(directory))
+    for name in os.listdir(base):
+        if not name.startswith("step-"):
+            continue
+        try:
+            s = int(name[len("step-"):])
+        except ValueError:
+            continue
+        if s < latest and s not in kept:
+            shutil.rmtree(os.path.join(base, name), ignore_errors=True)
+
+
+def assemble_committed_step(directory: str, step: int) -> list[np.ndarray]:
+    """Reassemble the full flat leaf list of a COMMITTED step from its
+    member slices. Every leaf must be fully covered by exactly the slices
+    of the commit's generation — partial coverage (a history torn across
+    generations could produce it) raises instead of returning frankendata.
+    """
+    commit = read_commit_marker(directory, step)
+    if commit is None:
+        raise FileNotFoundError(
+            f"step {step} has no commit marker under {directory}")
+    generation, members = int(commit["generation"]), int(commit["members"])
+    leaves: dict[int, np.ndarray] = {}
+    covered: dict[int, list[tuple[int, int]]] = {}
+    for m in range(members):
+        got = read_member_slice(directory, step, m)
+        if got is None:
+            raise FileNotFoundError(
+                f"committed step {step} is missing member {m}'s slice")
+        manifest, arrays = got
+        if int(manifest.get("generation", -1)) != generation:
+            raise ValueError(
+                f"member {m} slice at step {step} is generation "
+                f"{manifest.get('generation')} but the commit is {generation}")
+        for e in manifest["entries"]:
+            leaf = int(e["leaf"])
+            block = arrays[e["key"]]
+            shape = tuple(e["globalShape"])
+            if leaf not in leaves:
+                leaves[leaf] = np.zeros(shape, dtype=block.dtype)
+                covered[leaf] = []
+            index = e.get("index")
+            if not index or all(i is None for i in index):
+                leaves[leaf][...] = block
+                covered[leaf].append((0, shape[0] if shape else 1))
+            else:
+                lo, hi = int(index[0][0]), int(index[0][1])
+                leaves[leaf][lo:hi, ...] = block
+                covered[leaf].append((lo, hi))
+    out = []
+    for leaf in sorted(leaves):
+        shape = leaves[leaf].shape
+        rows = shape[0] if shape else 1
+        spans = sorted(covered[leaf])
+        pos = 0
+        for lo, hi in spans:
+            if lo > pos:
+                break
+            pos = max(pos, hi)
+        if pos < rows:
+            raise ValueError(
+                f"leaf {leaf} of step {step} only covered to row {pos} of "
+                f"{rows} — refusing a partially-assembled restore")
+        out.append(leaves[leaf])
+    return out
 
 
 def row_sharding_for(ctx, rows: int, serve_shards: int = 0):

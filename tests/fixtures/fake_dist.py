@@ -1,0 +1,70 @@
+"""Fault-injecting fake MeshContext — the tests/test_sharded_data.py
+``FakeShardCtx`` pattern extended for the distributed tier.
+
+:class:`FaultyShardCtx` simulates a multi-process mesh whose collective can
+misbehave the two ways a real peer does:
+
+- ``die_in_collective`` — the collective fails outright (a gloo peer
+  reset), raised from inside ``allgather_obj``;
+- ``stall_in_collective`` — the collective never returns: the call blocks
+  on a ``threading.Event`` the test controls, which is how "peer went
+  silent mid-all-gather" is reproduced with zero wall sleeps (the guard
+  polls a FakeClock; the stuck thread is released at teardown).
+
+Both compose with a :class:`~incubator_predictionio_tpu.distributed.meshdir.
+MeshDirectory` on an injected ``now_fn`` so collective-timeout detection
+and generation fencing run entirely on virtual time.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class FakeShardCtx:
+    """Duck-typed MeshContext: pre-baked per-process payloads, allgather
+    returns them all in process order (same contract as
+    tests/test_sharded_data.py — duplicated here so fixtures stay
+    importable without reaching into test modules)."""
+
+    def __init__(self, parts_by_process, process_index=0):
+        self._parts = parts_by_process
+        self.process_index = process_index
+        self.process_count = len(parts_by_process)
+
+    @property
+    def is_primary(self):
+        return self.process_index == 0
+
+    def allgather_obj(self, obj):
+        assert obj == self._parts[self.process_index], (
+            obj, self._parts[self.process_index])
+        return list(self._parts)
+
+    def stop(self):
+        pass
+
+
+class FaultyShardCtx(FakeShardCtx):
+    """A mesh whose collective loses a member mid-flight."""
+
+    def __init__(self, parts_by_process, process_index=0,
+                 die_in_collective=False, stall_in_collective=False):
+        super().__init__(parts_by_process, process_index)
+        self.die_in_collective = die_in_collective
+        self.stall_in_collective = stall_in_collective
+        #: set by the test (or its teardown) to release a stalled collective
+        self.release = threading.Event()
+        self.calls = 0
+
+    def allgather_obj(self, obj):
+        self.calls += 1
+        if self.die_in_collective:
+            raise ConnectionResetError(
+                "simulated: peer closed the collective channel")
+        if self.stall_in_collective:
+            # a dead peer never answers: block until the test releases us
+            self.release.wait()
+            raise ConnectionAbortedError(
+                "simulated: stalled collective released at teardown")
+        return super().allgather_obj(obj)

@@ -186,3 +186,38 @@ def test_transformer_resume_matches_uninterrupted(tmp_path):
     np.testing.assert_allclose(
         resumed.params["item_emb"], straight.params["item_emb"], rtol=2e-5, atol=1e-6
     )
+
+
+def test_slice_kill_between_members_restores_previous_generation(tmp_path):
+    """Satellite regression for the coordinated-commit protocol at the
+    filesystem level (utils/checkpoint.py slice helpers): a kill between
+    two members' slice writes leaves the newer step uncommitted, so the
+    assembled state is the PREVIOUS complete step — never a mix."""
+    from incubator_predictionio_tpu.utils import checkpoint as ck
+
+    d = str(tmp_path)
+    old = np.arange(12, dtype=np.float32).reshape(6, 2)
+    for m, (lo, hi) in enumerate([(0, 3), (3, 6)]):
+        ck.save_member_slice(d, 1, m, 1, [
+            {"key": "l0b0", "leaf": 0, "globalShape": [6, 2],
+             "index": [[lo, hi], None]}], {"l0b0": old[lo:hi]})
+    ck.write_commit_marker(d, 1, 1, 2)
+    # step 2: member 0 writes its half, member 1 is killed first
+    ck.save_member_slice(d, 2, 0, 1, [
+        {"key": "l0b0", "leaf": 0, "globalShape": [6, 2],
+         "index": [[0, 3], None]}], {"l0b0": old[:3] + 100.0})
+    assert ck.committed_steps(d) == [1]
+    (leaf,) = ck.assemble_committed_step(d, 1)
+    np.testing.assert_array_equal(leaf, old)
+    # assembling the uncommitted step is refused outright
+    with pytest.raises(FileNotFoundError):
+        ck.assemble_committed_step(d, 2)
+    # a commit whose member slice is torn across generations is refused:
+    # member 1's step-3 slice is from generation 2, the marker claims 3
+    for m in (0, 1):
+        ck.save_member_slice(d, 3, m, 3 if m == 0 else 2, [
+            {"key": "l0b0", "leaf": 0, "globalShape": [6, 2],
+             "index": [[m * 3, m * 3 + 3], None]}], {"l0b0": old[:3]})
+    ck.write_commit_marker(d, 3, 3, 2)
+    with pytest.raises(ValueError, match="generation"):
+        ck.assemble_committed_step(d, 3)

@@ -737,3 +737,103 @@ def test_legacy_redeploy_counts_attempt_failures(mem_storage, tmp_path):
         server_url=None), mem_storage)
     assert out is None
     assert _counter(jm.ATTEMPT_FAILURES) == before + 2
+
+
+# ---------------------------------------------------------------------------
+# distributed train jobs: the worker supervises N member processes
+# ---------------------------------------------------------------------------
+
+def test_worker_dist_train_supervises_members(mem_storage, tmp_path,
+                                              monkeypatch):
+    """``jobs submit --kind train --dist N`` routes through the mesh
+    supervisor (distributed/supervisor.py) instead of an in-process
+    create_workflow: the worker records members/recoveries/MTTR on the job
+    result and parses the engine instance id out of member 0's log."""
+    from incubator_predictionio_tpu.distributed.supervisor import (
+        SupervisorResult,
+    )
+
+    variant = _sample_variant(tmp_path)
+    log = tmp_path / "member-0.gen-1.log"
+    log.write_text("mesh up\nTraining completed. "
+                   "Engine instance ID: dist-inst-7\n")
+    captured = {}
+
+    def fake_run(sup):
+        captured["sup"] = sup
+        return SupervisorResult(ok=True, returncodes=[0, 0], recoveries=1,
+                                mttr_s=[0.75], generation=2,
+                                log_paths=[str(log)])
+
+    monkeypatch.setattr(JobWorker, "_run_supervised",
+                        staticmethod(fake_run))
+    monkeypatch.setattr(JobWorker, "_incumbent_instance",
+                        lambda self, p, v: None)
+    orch = Orchestrator(mem_storage.get_meta_data_jobs())
+    worker = JobWorker(orch, mem_storage,
+                       WorkerConfig(worker_id="w", lease_sec=30))
+    orch.submit("train", {"engine_variant": variant, "dist": 2,
+                          "dist_state_dir": str(tmp_path / "mesh"),
+                          "gate": "off"})
+    out = worker.run_once()
+    assert out["status"] == "COMPLETED"
+    assert out["result"]["instanceId"] == "dist-inst-7"
+    assert out["result"]["dist"] == {
+        "members": 2, "recoveries": 1, "mttrS": [0.75], "generation": 2,
+        "stateDir": str(tmp_path / "mesh")}
+    sup = captured["sup"]
+    assert sup.num_processes == 2
+    assert sup.cli_args[:3] == ["train", "-v", variant]
+    assert "--distributed" in sup.cli_args
+    # the job lease and the mesh fence are folded together: while the
+    # lease is held the supervisor keeps going, and losing it aborts
+    assert sup.should_abort is not None and sup.should_abort() is False
+
+
+def test_worker_dist_train_blown_budget_fails_the_attempt(mem_storage,
+                                                          tmp_path,
+                                                          monkeypatch):
+    from incubator_predictionio_tpu.distributed.supervisor import (
+        SupervisorResult,
+    )
+
+    variant = _sample_variant(tmp_path)
+    monkeypatch.setattr(
+        JobWorker, "_run_supervised",
+        staticmethod(lambda sup: SupervisorResult(
+            ok=False, returncodes=[86, 1], recoveries=2, mttr_s=[0.4, 0.5],
+            generation=3, log_paths=[],
+            detail="member loss after 2 recoveries (budget exhausted)")))
+    monkeypatch.setattr(JobWorker, "_incumbent_instance",
+                        lambda self, p, v: None)
+    orch = Orchestrator(mem_storage.get_meta_data_jobs())
+    worker = JobWorker(orch, mem_storage,
+                       WorkerConfig(worker_id="w", lease_sec=30))
+    job = orch.submit("train", {"engine_variant": variant, "dist": 2,
+                                "dist_state_dir": str(tmp_path / "mesh"),
+                                "gate": "off"})
+    out = worker.run_once()
+    assert out["status"] != "COMPLETED"
+    assert "budget exhausted" in out["failure"]
+    assert orch.jobs.get(job.id).status != "COMPLETED"
+
+
+def test_jobs_submit_dist_cli_params(tmp_path, monkeypatch):
+    """The CLI arg → job param mapping for --dist / --dist-state-dir,
+    and the guard that --dist only applies to train jobs."""
+    from incubator_predictionio_tpu.tools import cli
+
+    class _A:
+        pass
+
+    args = _A()
+    args.kind = "train"
+    args.engine_variant = "engine.json"
+    args.dist = 3
+    args.dist_state_dir = str(tmp_path / "mesh")
+    params = cli._job_params_from_args(args)
+    assert params["dist"] == 3
+    assert params["dist_state_dir"] == str(tmp_path / "mesh")
+    args.kind = "rollout"
+    with pytest.raises(SystemExit):
+        cli._job_params_from_args(args)

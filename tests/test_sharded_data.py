@@ -118,3 +118,35 @@ def test_global_sum_scalars_arrays_pytrees():
     np.testing.assert_array_equal(out["rows"], [11.0, 22.0])
     assert out["n"] == 7
     assert global_row_count(FakeShardCtx([3, 4], 0), 3) == 7
+
+
+def test_concat_vocab_under_dist_guard_aborts_on_lost_member(tmp_path):
+    """The simulated multi-shard path composed with the distributed tier
+    (tests/fixtures/fake_dist.py): a peer dying inside the vocab
+    all-gather surfaces as a prompt MemberLostError through the
+    DistContext guard instead of wedging the sharded read."""
+    from incubator_predictionio_tpu.distributed.context import (
+        DistConfig,
+        DistContext,
+        MemberLostError,
+    )
+    from incubator_predictionio_tpu.distributed.meshdir import MeshDirectory
+    from incubator_predictionio_tpu.resilience.clock import FakeClock
+    from tests.fixtures.fake_dist import FaultyShardCtx
+
+    clock = FakeClock()
+    inner = FaultyShardCtx([["u0"], ["u1"]], 0, die_in_collective=True)
+    ctx = DistContext(
+        inner,
+        DistConfig(state_dir=str(tmp_path), heartbeat_ms=100),
+        meshdir=MeshDirectory(str(tmp_path), now_fn=clock.monotonic),
+        clock=clock, start_threads=False)
+    with pytest.raises(MemberLostError):
+        concat_vocab(ctx, ["u0"])
+    # the plain sharded contract is untouched on a healthy wrapped mesh
+    healthy = DistContext(
+        FakeShardCtx([["u0"], ["u1"]], 1),
+        DistConfig(state_dir=""), meshdir=None, clock=clock,
+        start_threads=False)
+    vocab, offset = concat_vocab(healthy, ["u1"])
+    assert list(vocab) == ["u0", "u1"] and offset == 1
