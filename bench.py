@@ -1822,6 +1822,217 @@ def bench_fleet(ctx) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# 7d. multi-tenant serving (docs/tenancy.md): four tenants in ONE
+#     query-server process under a shared byte budget, one tenant offering
+#     3× its quota — the noisy-neighbor containment + packing numbers whose
+#     acceptance bars the chaos test asserts
+#     (tests/test_chaos_procs.py::test_multi_tenant_noisy_neighbor_contained)
+# ---------------------------------------------------------------------------
+
+#: Per-tenant load driver (argv after the repo root: host, port, path,
+#: duration_s, target_qps, n_conns, body). Each tenant's driver is its OWN
+#: subprocess: on a small host, concurrent drivers sharing one client event
+#: loop pollute each other's latency tails through GIL/scheduler contention
+#: — the victim's p99 would measure the CLIENT, not the platform.
+_TENANT_CLIENT_SCRIPT = """
+import sys
+
+sys.path.insert(0, sys.argv[1])
+from tests.fixtures.loadgen import tenant_main
+
+tenant_main(sys.argv[2:])
+"""
+
+
+def bench_multi_tenant(ctx) -> dict:
+    """Deploy FOUR tenants of the same recommendation model in one
+    multi-tenant query server (server/tenancy.py) under a byte budget that
+    fits only three, then measure the victim tenant at its steady rate
+    twice: with the noisy neighbor offering exactly its quota (baseline —
+    within-quota admitted load shares the host legitimately) and offering
+    3× (storm). The headline ratios compare storm to baseline: containment
+    means 3× offered looks like 1× to the victim, with the excess shed as
+    orderly 429s. A final first-touch of the cold fourth tenant archives
+    the packing motion (LRU eviction + cold load, both counted) and the
+    per-tenant ledger. Identical engines per tenant on purpose: every
+    cross-tenant difference is then the PLATFORM's doing (quota, packing),
+    never the model's."""
+    import subprocess
+    import sys as _sys
+    import tempfile
+    import urllib.request
+
+    from incubator_predictionio_tpu.data.storage import Storage, use_storage
+    from incubator_predictionio_tpu.parallel.launcher import free_port
+    from tests.fixtures.loadgen import closed_loop, request_bytes
+
+    n_users, n_items, n_events = 2000, 1000, (5_000 if SMALL else 20_000)
+    window_s = 3.0 if SMALL else 6.0
+    quota_qps = 30.0
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+    tmp = tempfile.mkdtemp(prefix="pio-bench-tenants-")
+    store_cfg = {
+        "PIO_STORAGE_SOURCES_SQ_TYPE": "sqlite",
+        "PIO_STORAGE_SOURCES_SQ_PATH": os.path.join(tmp, "store.db"),
+    }
+    storage = Storage(store_cfg)
+    prev = use_storage(storage)
+    try:
+        variant_path = _train_recommendation(
+            ctx, storage, tmp, n_users, n_items, n_events)
+    finally:
+        use_storage(prev)
+        storage.close()
+
+    # 1000-byte resident hints under a 3000-byte budget: three tenants fit,
+    # the fourth provably cannot without evicting someone
+    tenants = [
+        {"tenant": "noisy", "engineVariant": variant_path,
+         "quotaQps": quota_qps, "quotaBurst": quota_qps,
+         "residentBytes": 1000},
+        {"tenant": "victim", "engineVariant": variant_path,
+         "residentBytes": 1000},
+        {"tenant": "steady", "engineVariant": variant_path,
+         "residentBytes": 1000},
+        {"tenant": "latecomer", "engineVariant": variant_path,
+         "residentBytes": 1000},
+    ]
+    tenants_file = os.path.join(tmp, "tenants.json")
+    with open(tenants_file, "w") as f:
+        json.dump(tenants, f)
+
+    port = free_port()
+    base = f"http://127.0.0.1:{port}"
+    body = json.dumps({"user": "u7", "num": 10})
+    server = subprocess.Popen(
+        [_sys.executable, "-m", "incubator_predictionio_tpu.tools.cli",
+         "deploy", "-v", variant_path, "--tenants", tenants_file,
+         "--ip", "127.0.0.1", "--port", str(port),
+         "--query-timeout", "0.5"],
+        cwd=repo_root,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", **store_cfg,
+             "PIO_TENANT_HBM_BUDGET": "3000"},
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        start_new_session=True)
+
+    def http(method: str, path: str, payload=None, timeout=60.0):
+        data = None if payload is None else json.dumps(payload).encode()
+        req = urllib.request.Request(
+            f"{base}{path}", data=data, method=method,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.loads(resp.read() or b"null")
+
+    def scrape() -> dict:
+        with urllib.request.urlopen(f"{base}/metrics", timeout=10.0) as r:
+            text = r.read().decode()
+        return {k: v for k, v in _metrics_snapshot(text).items()
+                if k.startswith("pio_tenant_")}
+
+    def driver(tenant: str, qps: float) -> subprocess.Popen:
+        return subprocess.Popen(
+            [_sys.executable, "-c", _TENANT_CLIENT_SCRIPT, repo_root,
+             "127.0.0.1", str(port), f"/engines/{tenant}/queries.json",
+             str(window_s), str(qps), "16", body],
+            cwd=repo_root, stdout=subprocess.PIPE, text=True)
+
+    def measure(noisy_qps: float) -> tuple[dict, dict, dict]:
+        """One concurrent (noisy, victim) window; returns their driver
+        results plus the window's pio_tenant_* metric delta."""
+        before = scrape()
+        noisy = driver("noisy", noisy_qps)
+        victim = driver("victim", victim_rate)
+        n_out, _ = noisy.communicate(timeout=window_s + 60)
+        v_out, _ = victim.communicate(timeout=60)
+        assert noisy.returncode == 0 and victim.returncode == 0
+        return (json.loads(n_out), json.loads(v_out),
+                _snapshot_delta(before, scrape()))
+
+    try:
+        deadline = time.monotonic() + 240.0
+        while time.monotonic() < deadline:
+            try:
+                with urllib.request.urlopen(f"{base}/", timeout=1.0) as r:
+                    if r.status == 200:
+                        break
+            except Exception:  # noqa: BLE001 - still booting
+                time.sleep(0.1)
+        else:
+            raise TimeoutError("multi-tenant server not ready")
+
+        # cold loads are off the hot path by design: pay them up front for
+        # every tenant but the latecomer — it must stay cold so its first
+        # touch under the now-full budget IS the packing motion. "steady"
+        # loads and then idles: the true LRU resident the eviction takes.
+        for t in ("noisy", "victim", "steady"):
+            http("POST", f"/engines/{t}/queries.json",
+                 json.loads(body), timeout=120.0)
+        # warm both hot tenants' batch buckets at real concurrency: a
+        # mid-window first-compile would masquerade as neighbor
+        # interference
+        req_noisy = request_bytes("127.0.0.1", port, body.encode(),
+                                  path="/engines/noisy/queries.json")
+        req_victim = request_bytes("127.0.0.1", port, body.encode(),
+                                   path="/engines/victim/queries.json")
+        asyncio.run(closed_loop(
+            "127.0.0.1", port, 8, 1.0, lambda: req_noisy))
+        cap_counts, _ = asyncio.run(closed_loop(
+            "127.0.0.1", port, 8, 2.0, lambda: req_victim))
+        # victim's steady rate: well inside its solo capacity — headroom
+        # the neighbor is NOT entitled to eat
+        victim_rate = max(10.0, 0.35 * cap_counts.get(200, 0) / 2.0)
+
+        base_noisy, base_victim, base_delta = measure(quota_qps)
+        storm_noisy, storm_victim, storm_delta = measure(3.0 * quota_qps)
+
+        # packing coda: the latecomer's first query under the full budget
+        http("POST", "/engines/latecomer/queries.json",
+             json.loads(body), timeout=120.0)
+        snap = http("GET", "/tenants.json")
+
+        vg_base = base_victim["goodput_qps"]
+        p99_base = base_victim["p99_ms"]
+        return {
+            "tenants": len(tenants),
+            "budget_bytes": 3000,
+            "quota_qps": quota_qps,
+            "victim_offered_qps": round(victim_rate, 1),
+            "noisy_offered_qps": round(3.0 * quota_qps, 1),
+            # acceptance bars (asserted by the chaos test, archived here):
+            # victim goodput ratio ≥ 0.95 and p99 ratio ≤ 1.5 vs the
+            # 1×-quota baseline
+            "victim_goodput_ratio": round(
+                storm_victim["goodput_qps"] / max(vg_base, 1e-9), 3),
+            "victim_p99_ratio": round(
+                storm_victim["p99_ms"] / max(p99_base, 1e-9), 3),
+            "noisy_goodput_vs_quota": round(
+                storm_noisy["goodput_qps"] / quota_qps, 3),
+            "noisy_rejected_429": storm_noisy["counts"].get("429", 0),
+            "noisy_shed_503": storm_noisy["counts"].get("503", 0),
+            "baseline": {"noisy": base_noisy, "victim": base_victim},
+            "storm": {"noisy": storm_noisy, "victim": storm_victim},
+            "tenant_metrics_baseline": base_delta,
+            "tenant_metrics_storm": storm_delta,
+            "packing": {
+                "resident_count": snap["residentCount"],
+                "latecomer_cold_loads":
+                    snap["tenants"]["latecomer"]["coldLoads"],
+                "evicted": sorted(t for t, row in snap["tenants"].items()
+                                  if not row["resident"]),
+            },
+            "tenants_snapshot": snap,
+        }
+    finally:
+        import signal as _signal
+
+        try:
+            os.killpg(server.pid, _signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        server.wait()
+
+
+# ---------------------------------------------------------------------------
 # 7c'. sharded fleet (docs/sharding.md "Multi-host shard owners"): the
 #      catalog split ACROSS processes — scatter/gather parity cost vs one
 #      process holding everything, plus failover MTTR when an owner takes
@@ -2847,7 +3058,8 @@ def build_result_line(configs: dict, device_info: dict,
 CONFIG_NAMES = ["recommendation", "recommendation_scaled", "classification",
                 "similarproduct", "ecommerce_retrieval", "retrieval_scale",
                 "sharded_serving", "sequential", "serving", "trace_overhead",
-                "obs_overhead", "overload", "fleet", "sharded_fleet",
+                "obs_overhead", "overload", "fleet", "multi_tenant",
+                "sharded_fleet",
                 "ingestion", "ingest_durability",
                 "streaming_freshness", "storage_failover",
                 "continuous_training", "disaster_recovery",
@@ -2858,7 +3070,8 @@ CONFIG_NAMES = ["recommendation", "recommendation_scaled", "classification",
 # devices (merge/layout architecture, not chip throughput);
 # "continuous_training" measures the control plane's recovery clock, not
 # the chip
-DEVICE_FREE = {"ingestion", "ingest_durability", "fleet", "sharded_fleet",
+DEVICE_FREE = {"ingestion", "ingest_durability", "fleet", "multi_tenant",
+               "sharded_fleet",
                "streaming_freshness", "storage_failover",
                "sharded_serving", "continuous_training",
                "disaster_recovery", "distributed_training"}
@@ -2880,6 +3093,7 @@ def _build_suite(ctx, peaks, device) -> dict:
         "obs_overhead": lambda: bench_obs_overhead(ctx),
         "overload": lambda: bench_overload(ctx),
         "fleet": lambda: bench_fleet(ctx),
+        "multi_tenant": lambda: bench_multi_tenant(ctx),
         "sharded_fleet": lambda: bench_sharded_fleet(ctx),
         "ingestion": lambda: bench_ingestion(),
         "ingest_durability": lambda: bench_ingest_durability(),

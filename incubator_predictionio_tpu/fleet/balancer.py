@@ -73,6 +73,13 @@ class Replica:
         # never contribute rows to a merged answer (fleet/topology.py).
         self.shard_owner: Optional[dict] = None
         self.fenced = False
+        # -- multi-tenant deployment (docs/tenancy.md) --------------------
+        # /health.deployment.engines: the engine ids this replica is
+        # REGISTERED to serve (it can cold-load any of them);
+        # deployment.resident: the subset currently loaded. Empty set =
+        # classic single-engine replica (serves everything it's asked).
+        self.engines: set[str] = set()
+        self.resident: set[str] = set()
         # -- passive per-request state (router observations) --------------
         self.inflight = 0
         self.lat_ewma: Optional[float] = None
@@ -94,14 +101,27 @@ class Replica:
         return (self.healthy and not self.draining
                 and now >= self.backoff_until)
 
-    def score(self, now: Optional[float] = None) -> float:
+    def serves(self, tenant: Optional[str]) -> bool:
+        """Can this replica answer for ``tenant``? Single-engine replicas
+        (no advertised engine set) serve whatever they're asked — the
+        pre-tenancy fleet shape keeps working unchanged."""
+        return tenant is None or not self.engines or tenant in self.engines
+
+    def score(self, now: Optional[float] = None,
+              tenant: Optional[str] = None) -> float:
         """Lower is better. Load per admitted slot, inflated by the error
         EWMA and (heavily) by brownout — a browned-out replica is a last
-        resort, not a peer."""
+        resort, not a peer. A multi-tenant replica that would have to
+        COLD-LOAD the tenant (registered but not resident) carries a
+        moderate penalty: a warm peer wins, but a cold load still beats
+        an unroutable 503."""
         load = (self.inflight + 1) / max(1, self.inflight_limit)
         s = load * (1.0 + 4.0 * self.err_ewma)
         if self.brownout:
             s *= 8.0
+        if (tenant is not None and self.engines
+                and tenant not in self.resident):
+            s *= 3.0
         return s
 
     # -- passive observations (router request path) -----------------------
@@ -176,6 +196,15 @@ class Replica:
         self.staleness_sec = stream.get("stalenessSeconds")
         # shard-owner claim: adopt the announced range/epoch; an epoch
         # BUMP on this replica clears any fence (it re-promoted)
+        # multi-tenant replicas advertise their registered + resident
+        # engine sets; the (tenant, load) pick and `pio-tpu tenants` read
+        # them off the snapshot
+        engines = dep.get("engines")
+        self.engines = (set(engines)
+                        if isinstance(engines, (list, set)) else set())
+        resident = dep.get("resident")
+        self.resident = (set(resident)
+                         if isinstance(resident, (list, set)) else set())
         owner = dep.get("shardOwner")
         if isinstance(owner, dict):
             prev = self.shard_owner or {}
@@ -224,6 +253,8 @@ class Replica:
             "stalenessSec": self.staleness_sec,
             "shardOwner": self.shard_owner,
             "fenced": self.fenced,
+            "engines": sorted(self.engines) or None,
+            "resident": sorted(self.resident) or None,
         }
 
 
@@ -240,10 +271,16 @@ class Balancer:
             for r in replicas
         ]
 
-    def pick(self, exclude: Iterable[str] = ()) -> Optional[Replica]:
+    def pick(self, exclude: Iterable[str] = (),
+             tenant: Optional[str] = None) -> Optional[Replica]:
         """The available replica with the lowest load score (ties broken by
         registration order — deterministic for tests). ``exclude`` names
         replicas already tried this request, so a retry lands elsewhere.
+        ``tenant`` restricts the pick to replicas that serve that engine
+        (docs/tenancy.md): multi-tenant replicas advertise their engine
+        set via /health; replicas with no set serve everything. Among the
+        eligible, a replica holding the tenant RESIDENT outranks one that
+        would cold-load it.
 
         ``Retry-After`` backoff is a routing *preference*, not a hard gate:
         when every otherwise-healthy replica sits inside a backoff window
@@ -254,24 +291,24 @@ class Balancer:
         strictly worse. Ejected/draining replicas are never relaxed in."""
         now = self._clock.monotonic()
         skip = set(exclude)
-        best = self._best(now, skip, ignore_backoff=False)
+        best = self._best(now, skip, ignore_backoff=False, tenant=tenant)
         if best is None:
-            best = self._best(now, skip, ignore_backoff=True)
+            best = self._best(now, skip, ignore_backoff=True, tenant=tenant)
         return best
 
-    def _best(self, now: float, skip: set,
-              ignore_backoff: bool) -> Optional[Replica]:
+    def _best(self, now: float, skip: set, ignore_backoff: bool,
+              tenant: Optional[str] = None) -> Optional[Replica]:
         best: Optional[Replica] = None
         best_score = float("inf")
         for r in self.replicas:
-            if r.url in skip:
+            if r.url in skip or not r.serves(tenant):
                 continue
             if ignore_backoff:
                 if not (r.healthy and not r.draining):
                     continue
             elif not r.available(now):
                 continue
-            s = r.score(now)
+            s = r.score(now, tenant=tenant)
             if s < best_score:
                 best, best_score = r, s
         return best

@@ -216,6 +216,10 @@ class RouterServer:
         app.router.add_get("/health", self.handle_health)
         add_observability_routes(app)
         app.router.add_post("/queries.json", self.handle_query)
+        # tenant-addressed queries (docs/tenancy.md): same handler — the
+        # path names the engine, the pick filters on (tenant, load)
+        app.router.add_post(
+            "/engines/{tenant}/queries.json", self.handle_query)
         app.router.add_get("/experiment.json", self.handle_experiment_get)
         app.router.add_post("/experiment", self.handle_experiment_set)
         return app
@@ -639,6 +643,14 @@ class RouterServer:
             return self._drain_state.reject_response()
         body = await request.read()
         headers = self._forward_headers(request)
+        # (tenant, load) routing (docs/tenancy.md): the engine id from the
+        # path or the X-PIO-Engine header narrows the pick to replicas
+        # that serve it; the id forwards as the header so both multi-
+        # tenant and classic single-engine replicas accept the request
+        tenant = (request.match_info.get("tenant")
+                  or request.headers.get("X-PIO-Engine"))
+        if tenant is not None:
+            headers["X-PIO-Engine"] = tenant
         # shard-owner fleets route by range, not by interchangeable pick
         topo = self._topology()
         if topo.is_sharded:
@@ -675,12 +687,12 @@ class RouterServer:
         last_retryable = None
         try:
             for attempt in range(self.config.max_attempts):
-                replica = balancer.pick(exclude=tried)
+                replica = balancer.pick(exclude=tried, tenant=tenant)
                 if replica is None and serve_candidate:
                     # candidate pool exhausted: the experiment must not
                     # cost a user their answer — fall back to control
                     balancer, arm = self.balancer, CONTROL
-                    replica = balancer.pick(exclude=tried)
+                    replica = balancer.pick(exclude=tried, tenant=tenant)
                 if replica is None:
                     last_unroutable = True
                     break

@@ -108,16 +108,34 @@ class Orchestrator:
         return replace(job, id=job_id)
 
     # -- claiming / leases ------------------------------------------------
+    @staticmethod
+    def _tenant_of(j: JobRecord) -> str:
+        """The fair-share grouping key: the job's declared tenant (its
+        ``params["tenant"]``), or the shared "" pool for untagged jobs."""
+        params = j.params if isinstance(j.params, dict) else {}
+        tenant = params.get("tenant")
+        return tenant if isinstance(tenant, str) else ""
+
     def claim(self, owner: str, lease_sec: float) -> Optional[JobRecord]:
-        """Claim the oldest QUEUED job, or reclaim a RUNNING job whose lease
-        expired (its worker died). Returns the claimed record (fence already
-        bumped) or None when there is nothing to do.
+        """Claim a QUEUED job under FAIR-SHARE ordering, or reclaim a
+        RUNNING job whose lease expired (its worker died). Returns the
+        claimed record (fence already bumped) or None when there is
+        nothing to do.
+
+        Fair share (docs/tenancy.md): queued jobs are offered tenant-
+        by-tenant, preferring the tenant with the fewest RUNNING jobs —
+        one tenant's retrain storm queues behind its own work, not in
+        front of another tenant's single trigger. Within a tenant the
+        order stays oldest-first; with no tenant tags every job shares
+        one pool and the ordering degenerates to the classic global
+        oldest-first.
 
         A reclaim counts as a new attempt: the dead worker's attempt raised
         nothing, but its work was lost — when the attempt budget is already
         exhausted the job fails terminally instead of looping forever."""
         now = self.now_fn()
         queued, expired, running = [], [], 0
+        running_by: dict[str, int] = {}
         # ONE scan per poll: the depth gauges ride the records this claim
         # pass already fetched instead of extra get_all round trips
         for j in self.jobs.get_all():
@@ -125,13 +143,20 @@ class Orchestrator:
                 queued.append(j)
             elif j.status == JOB_RUNNING:
                 running += 1
+                t = self._tenant_of(j)
+                running_by[t] = running_by.get(t, 0) + 1
                 if j.lease_expires_at is not None \
                         and j.lease_expires_at.timestamp() <= now:
                     expired.append(j)
         m.QUEUE_DEPTH.set(len(queued))
         m.RUNNING.set(running)
         key = lambda j: (j.submitted_at or _utc(0), j.id)  # noqa: E731
-        for j in sorted(queued, key=key):
+        # fewest-running tenant first, then oldest-within-tenant; the
+        # submitted_at tie-break between equally-loaded tenants keeps the
+        # global order stable (and exactly the old order when untagged)
+        fair_key = lambda j: (running_by.get(self._tenant_of(j), 0),  # noqa: E731
+                              j.submitted_at or _utc(0), j.id)
+        for j in sorted(queued, key=fair_key):
             claimed = self._try_claim(j, owner, lease_sec, reclaim=False)
             if claimed is not None:
                 return claimed

@@ -50,7 +50,7 @@ DEFAULT_BURN_THRESHOLDS = {"fast": 14.4, "slow": 6.0}
 
 _TOP_KEYS = {"objectives"}
 _OBJECTIVE_KEYS = {"name", "service", "type", "objective", "threshold_ms",
-                   "route", "windows", "burn_thresholds"}
+                   "route", "tenant", "windows", "burn_thresholds"}
 _WINDOW_KEYS = {"fast", "slow"}
 
 SLO_BURN = REGISTRY.gauge(
@@ -148,6 +148,14 @@ def validate_config(doc: Any) -> list[str]:
         route = obj.get("route")
         if route is not None and (not isinstance(route, str) or not route):
             errors.append(f"{pos}.route: must be a non-empty string")
+        tenant = obj.get("tenant")
+        if tenant is not None and (not isinstance(tenant, str) or not tenant):
+            errors.append(f"{pos}.tenant: must be a non-empty string "
+                          "(a tenant id from the PIO_TENANTS table)")
+        if tenant is not None and route is not None:
+            errors.append(f"{pos}: tenant objectives read the pio_tenant_* "
+                          "families, which carry no route label — drop "
+                          "\"route\"")
         windows = obj.get("windows")
         if windows is not None:
             if not isinstance(windows, dict):
@@ -234,6 +242,7 @@ def _record_at(records: list[dict], ts: float) -> Optional[dict]:
 def _counter_sum(rec: Optional[dict], name: str, service: str,
                  route: Optional[str],
                  status_pred: Optional[Callable[[str], bool]] = None,
+                 tenant: Optional[str] = None,
                  ) -> Optional[float]:
     if rec is None:
         return None
@@ -243,6 +252,8 @@ def _counter_sum(rec: Optional[dict], name: str, service: str,
             continue
         if route is not None and labels.get("route") != route:
             continue
+        if tenant is not None and labels.get("tenant") != tenant:
+            continue
         if status_pred is not None and not status_pred(
                 labels.get("status", "")):
             continue
@@ -251,7 +262,8 @@ def _counter_sum(rec: Optional[dict], name: str, service: str,
 
 
 def _bucket_sums(rec: Optional[dict], family: str, service: str,
-                 route: Optional[str]) -> dict[float, float]:
+                 route: Optional[str],
+                 tenant: Optional[str] = None) -> dict[float, float]:
     out: dict[float, float] = {}
     if rec is None:
         return out
@@ -260,6 +272,8 @@ def _bucket_sums(rec: Optional[dict], family: str, service: str,
         if s_name != bucket_name or labels.get("service") != service:
             continue
         if route is not None and labels.get("route") != route:
+            continue
+        if tenant is not None and labels.get("tenant") != tenant:
             continue
         le_raw = labels.get("le")
         if le_raw is None:
@@ -287,26 +301,33 @@ def error_ratio(obj: dict, records: list[dict], now: float,
     if end is None:
         return None
     service, route = obj["service"], obj.get("route")
+    tenant = obj.get("tenant")
     if obj["type"] == "availability":
-        name = "pio_http_requests_total"
+        # tenant objectives read the per-tenant cost meter (the bounded-
+        # cardinality `tenant` label, server/tenancy.py) instead of the
+        # route-level HTTP fold
+        name = ("pio_tenant_requests_total" if tenant is not None
+                else "pio_http_requests_total")
         is_err = lambda s: s.startswith("5")  # noqa: E731
-        tot = _delta(_counter_sum(end, name, service, route),
-                     _counter_sum(start, name, service, route))
+        tot = _delta(_counter_sum(end, name, service, route, tenant=tenant),
+                     _counter_sum(start, name, service, route, tenant=tenant))
         if tot is None:
             return None
         if tot <= 0:
             return 0.0
-        err = _delta(_counter_sum(end, name, service, route, is_err),
-                     _counter_sum(start, name, service, route, is_err))
+        err = _delta(
+            _counter_sum(end, name, service, route, is_err, tenant=tenant),
+            _counter_sum(start, name, service, route, is_err, tenant=tenant))
         return max(0.0, min(1.0, (err or 0.0) / tot))
     # latency: fraction of requests over threshold via the cumulative
     # buckets — "good" is the cumulative count at the smallest bucket
     # bound >= the threshold
-    family = "pio_http_request_seconds"
-    end_b = _bucket_sums(end, family, service, route)
+    family = ("pio_tenant_request_seconds" if tenant is not None
+              else "pio_http_request_seconds")
+    end_b = _bucket_sums(end, family, service, route, tenant=tenant)
     if not end_b:
         return None
-    start_b = _bucket_sums(start, family, service, route)
+    start_b = _bucket_sums(start, family, service, route, tenant=tenant)
     thr_sec = obj["threshold_ms"] / 1000.0
     good_le = min((le for le in end_b if le >= thr_sec), default=math.inf)
     tot = _delta(end_b.get(math.inf), start_b.get(math.inf))

@@ -216,6 +216,14 @@ class TokenBucket:
             self._refill(self._clock.monotonic())
             return self._tokens >= self.burst
 
+    def fill(self) -> float:
+        """Current token balance as a fraction of burst capacity. Negative
+        when ``try_charge`` drove the bucket into debt (an oversized batch
+        still being paid off) — callers render it as "over quota"."""
+        with self._lock:
+            self._refill(self._clock.monotonic())
+            return self._tokens / self.burst if self.burst > 0 else 0.0
+
 
 class FairnessGate:
     """Per-client token buckets (event-server ingest fairness).
@@ -235,6 +243,7 @@ class FairnessGate:
         self._max_clients = max_clients
         self._lock = threading.Lock()
         self._buckets: dict[str, TokenBucket] = {}
+        self._throttled_by: dict[str, int] = {}
         self.throttled_count = 0
 
     @property
@@ -261,26 +270,61 @@ class FairnessGate:
                     self.rate, self.burst, self._clock)
         if bucket.try_charge(needed, cost):
             return None
-        self.throttled_count += 1
+        with self._lock:
+            self.throttled_count += 1
+            self._throttled_by[key] = self._throttled_by.get(key, 0) + 1
         _THROTTLED.labels(server=self._server).inc()
         return max(1, math.ceil(bucket.retry_after(needed)))
 
     def _evict_idle(self) -> None:
         # full buckets belong to clients that haven't sent in ≥ burst/rate
-        # seconds — dropping them loses no throttle debt
+        # seconds — dropping them loses no throttle debt (the throttle
+        # TALLY survives eviction: forensics outlive the bucket)
         for k in [k for k, b in self._buckets.items() if b.idle]:
             del self._buckets[k]
         if len(self._buckets) >= self._max_clients:
             # every tracked client is active: reset rather than grow
             # unboundedly (a brief throttle-debt amnesty, documented)
             self._buckets.clear()
+        # the tally map is bounded too — keep only the loudest offenders
+        if len(self._throttled_by) > self._max_clients:
+            keep = sorted(self._throttled_by.items(),
+                          key=lambda kv: -kv[1])[: self._max_clients // 2]
+            self._throttled_by = dict(keep)
+
+    @staticmethod
+    def _mask(key: str) -> str:
+        """Access keys are credentials; show enough to NAME the tenant on
+        a dashboard without republishing the secret."""
+        return key if len(key) <= 8 else key[:8] + "…"
+
+    def per_client(self, top: int = 8) -> list[dict]:
+        """The ``top`` noisiest clients by throttle count, then the lowest
+        bucket fill — bounded output regardless of tracked-client count,
+        so /health stays O(top) under a million-key flood."""
+        with self._lock:
+            buckets = list(self._buckets.items())
+            tallies = dict(self._throttled_by)
+        rows = []
+        for key, bucket in buckets:
+            rows.append({"key": self._mask(key),
+                         "fill": round(bucket.fill(), 4),
+                         "throttled": tallies.pop(key, 0)})
+        # throttled clients whose bucket was evicted still get named
+        for key, count in tallies.items():
+            rows.append({"key": self._mask(key), "fill": None,
+                         "throttled": count})
+        rows.sort(key=lambda r: (-r["throttled"],
+                                 r["fill"] if r["fill"] is not None else 1.0))
+        return rows[:top]
 
     def snapshot(self) -> dict:
         with self._lock:
             tracked = len(self._buckets)
         return {"enabled": self.enabled, "ratePerSec": self.rate,
                 "burst": self.burst, "trackedClients": tracked,
-                "throttled": self.throttled_count}
+                "throttled": self.throttled_count,
+                "perClient": self.per_client() if self.enabled else []}
 
 
 class InflightGate:

@@ -987,7 +987,16 @@ class EventServer:
                             "(PIO_EVENTSERVER_STATS=true)"},
                 status=404,
             )
-        return web.json_response(self.stats.get(auth.app_id))
+        payload = self.stats.get(auth.app_id)
+        # per-access-key fairness forensics (docs/tenancy.md): the bucket
+        # fill + throttle tallies that NAME the noisy tenant before the
+        # aggregate 429 counter alone would tell you one exists
+        payload["fairness"] = {
+            "enabled": self._fairness.enabled,
+            "throttled": self._fairness.throttled_count,
+            "perClient": self._fairness.per_client(),
+        }
+        return web.json_response(payload)
 
     # -- webhooks (EventServer.scala:491-599) -----------------------------
     async def handle_webhook(self, request: web.Request) -> web.Response:

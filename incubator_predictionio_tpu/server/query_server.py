@@ -791,22 +791,29 @@ class QueryServer:
         ctx: Optional[MeshContext] = None,
         deployed: Optional[DeployedEngine] = None,
         clock: Clock = SYSTEM_CLOCK,
+        name: str = "query_server",
     ):
         self.config = config
+        self.name = name
         self._clock = clock
         self.storage = storage or get_storage()
         self.ctx = ctx or MeshContext.create()
         # durable span export + sampling (obs/spool.py): applies the
-        # PIO_TRACE_* env state; a no-op unless the spool dir is set
-        from incubator_predictionio_tpu.obs import spool as trace_spool
-        from incubator_predictionio_tpu.obs.plane import (
-            configure_perf_plane_from_env,
-        )
+        # PIO_TRACE_* env state; a no-op unless the spool dir is set.
+        # Only the process front (the default name) configures the
+        # process-wide planes — per-tenant cores hosted by a
+        # TenantRegistry (server/tenancy.py) must not re-arm them on
+        # every cold load
+        if name == "query_server":
+            from incubator_predictionio_tpu.obs import spool as trace_spool
+            from incubator_predictionio_tpu.obs.plane import (
+                configure_perf_plane_from_env,
+            )
 
-        trace_spool.configure_export_from_env("query_server")
-        # continuous performance plane: procstats + profiler + metrics
-        # history + SLO burn-rate engine (obs/plane.py)
-        configure_perf_plane_from_env("query_server")
+            trace_spool.configure_export_from_env("query_server")
+            # continuous performance plane: procstats + profiler + metrics
+            # history + SLO burn-rate engine (obs/plane.py)
+            configure_perf_plane_from_env("query_server")
         # an explicit DeployedEngine skips storage loading (tests inject
         # hand-built engines to script failure modes)
         self.deployed = deployed or load_deployed_engine(
@@ -828,7 +835,7 @@ class QueryServer:
                 brownout_enter_frac=config.brownout_enter_frac,
                 brownout_enter_sec=config.brownout_enter_sec,
                 brownout_exit_sec=config.brownout_exit_sec,
-            ), clock=clock, server="query_server")
+            ), clock=clock, server=name)
         self.batcher = MicroBatcher(
             self.deployed, max_batch=config.max_batch,
             max_in_flight=effective_max_in_flight(config, self.deployed),
@@ -885,14 +892,16 @@ class QueryServer:
                 config.shard_id, config.shard_count, config.shard_state_dir)
             self.shard_owner.bind_rows(self._catalog_rows())
         # -- graceful drain (server/lifecycle.py) -------------------------
-        self._drain_state = DrainState("query_server")
+        self._drain_state = DrainState(name)
         self._start_time = self._clock.monotonic()
         self._runner: Optional[web.AppRunner] = None
         self._stop_event = asyncio.Event()
         self._feedback_tasks: set[asyncio.Task] = set()  # strong refs (GC pitfall)
         # fold this server's signals into /metrics at scrape time (keyed:
-        # a re-constructed server replaces its predecessor's collector)
-        REGISTRY.add_collector("query_server", self._collect_metrics)
+        # a re-constructed server replaces its predecessor's collector;
+        # per-tenant cores each get their own key so an eviction removes
+        # exactly one collector)
+        REGISTRY.add_collector(name, self._collect_metrics)
 
     def _collect_metrics(self) -> None:
         """Exposition-time fold: standalone breakers (per-algorithm +

@@ -1,0 +1,690 @@
+"""Multi-tenant serving plane (docs/tenancy.md).
+
+One query-server process hosts N deployed engines — PredictionIO is
+multi-app by design (apps/access-keys/channels), and a TPU host only pays
+for itself when one fleet safely packs many medium tenants. Three pieces:
+
+- ``TenantSpec``/``load_tenant_specs`` — the declarative tenant table
+  (``PIO_TENANTS``: inline JSON or a file path): engine variant, quota,
+  pinning, and an optional resident-bytes hint per tenant.
+- ``TenantRegistry`` — lazy load/evict of per-tenant ``QueryServer``
+  cores under a host/HBM byte budget (``PIO_TENANT_HBM_BUDGET``,
+  generalizing the ``PIO_SHARD_HBM_BUDGET`` accounting in
+  sharding/table.py into a packing problem): LRU eviction with pins,
+  single-flight cold loads in the executor so one tenant's cold start
+  never blocks another tenant's hot path, and per-tenant ``TokenBucket``
+  quotas at the front door.
+- ``MultiTenantQueryServer`` — the HTTP front: routes on the engine id
+  (``/engines/{id}/...`` path or the ``X-PIO-Engine`` header), delegates
+  the full query lifecycle to the tenant's core (`_serve_payload` — the
+  SAME code path single-tenant serving uses, so behavior cannot drift),
+  and scopes ``/reload``/``/delta``/``/rollback``/probation per tenant.
+
+Isolation model: every core owns its own ``AdmissionController`` (server
+label = ``query_server:<tenant>``), micro-batcher, breakers, last-good
+cache, and probation pin — brownout/429/504 decisions never cross tenant
+boundaries. The ``tenant`` metric label is bounded by ``PIO_TENANT_MAX``
+registered tenants, enforced at registry construction.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import logging
+import math
+import os
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from aiohttp import web
+
+from incubator_predictionio_tpu.obs import slo as _slo
+from incubator_predictionio_tpu.obs.http import (
+    add_observability_routes,
+    telemetry_middleware,
+)
+from incubator_predictionio_tpu.obs.metrics import (
+    DEFAULT_LATENCY_BUCKETS,
+    REGISTRY,
+)
+from incubator_predictionio_tpu.resilience.admission import TokenBucket
+from incubator_predictionio_tpu.resilience.clock import SYSTEM_CLOCK, Clock
+from incubator_predictionio_tpu.server.lifecycle import (
+    DrainState,
+    drained_exit_deadline,
+    install_signal_drain,
+)
+from incubator_predictionio_tpu.server.query_server import (
+    QueryServer,
+    ServerConfig,
+    load_deployed_engine,
+)
+from incubator_predictionio_tpu.sharding.table import parse_bytes
+
+logger = logging.getLogger(__name__)
+
+# -- telemetry (docs/observability.md) --------------------------------------
+# tenant label cardinality is bounded: values come only from the registered
+# tenant table, whose size PIO_TENANT_MAX caps at registry construction
+_T_REQUESTS = REGISTRY.counter(
+    "pio_tenant_requests_total",
+    "Per-tenant query answers by HTTP status (the tenant cost meter)",
+    labels=("service", "tenant", "status"))
+_T_LATENCY = REGISTRY.histogram(
+    "pio_tenant_request_seconds",
+    "Per-tenant end-to-end query latency (front-door to answer)",
+    labels=("service", "tenant"), buckets=DEFAULT_LATENCY_BUCKETS)
+_T_THROTTLED = REGISTRY.counter(
+    "pio_tenant_quota_throttled_total",
+    "Queries rejected (429) by the per-tenant quota bucket",
+    labels=("tenant",))
+_T_EVICTIONS = REGISTRY.counter(
+    "pio_tenant_evictions_total",
+    "Tenant cores evicted by the LRU packer to fit another under the "
+    "byte budget",
+    labels=("tenant",))
+_T_COLD = REGISTRY.counter(
+    "pio_tenant_cold_loads_total",
+    "Tenant cold loads (first touch or reload after eviction)",
+    labels=("tenant",))
+_T_RESIDENT = REGISTRY.gauge(
+    "pio_tenant_resident_bytes",
+    "Bytes the tenant's resident models account against the budget "
+    "(0 when evicted)",
+    labels=("tenant",))
+_T_QUOTA_FILL = REGISTRY.gauge(
+    "pio_tenant_quota_fill",
+    "Per-tenant quota bucket fill fraction (negative = paying off debt)",
+    labels=("tenant",))
+_T_BUDGET = REGISTRY.gauge(
+    "pio_tenant_budget_bytes",
+    "Configured tenant packing budget (0 = unlimited)")
+
+
+class TenancyError(RuntimeError):
+    """Invalid tenant table (duplicates, over PIO_TENANT_MAX, bad spec)."""
+
+
+class TenantBudgetError(RuntimeError):
+    """The requested tenant cannot be made resident: every loaded tenant
+    is pinned or busy and the budget has no room. Transient — answered
+    as 503 + Retry-After, never an engine error."""
+
+
+def tenant_budget() -> Optional[int]:
+    """``PIO_TENANT_HBM_BUDGET`` in bytes (suffixes as parse_bytes);
+    None/unset/0 disables packing enforcement."""
+    raw = os.environ.get("PIO_TENANT_HBM_BUDGET", "").strip()
+    if not raw:
+        return None
+    n = parse_bytes(raw)
+    return n if n > 0 else None
+
+
+def max_tenants() -> int:
+    """``PIO_TENANT_MAX`` — the hard cap on registered tenants, which is
+    also the `tenant` metric-label cardinality bound."""
+    return int(os.environ.get("PIO_TENANT_MAX", "64"))
+
+
+@dataclass
+class TenantSpec:
+    """One row of the tenant table."""
+
+    tenant: str
+    engine_variant: str
+    quota_qps: float = 0.0    # 0 → PIO_TENANT_QUOTA_QPS default (0 = off)
+    quota_burst: float = 0.0  # 0 → max(1, 2×qps)
+    pinned: bool = False      # never evicted by the packer
+    resident_bytes: int = 0   # 0 → measured from the loaded models
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TenantSpec":
+        if not isinstance(d, dict):
+            raise TenancyError(f"tenant spec must be an object, got {d!r}")
+        tenant = d.get("tenant") or d.get("id")
+        variant = d.get("engineVariant") or d.get("variant")
+        if not tenant or not isinstance(tenant, str):
+            raise TenancyError(f"tenant spec needs a string 'tenant': {d!r}")
+        if not variant or not isinstance(variant, str):
+            raise TenancyError(
+                f"tenant {tenant!r} needs an 'engineVariant' path")
+        return cls(
+            tenant=tenant,
+            engine_variant=variant,
+            quota_qps=float(d.get("quotaQps", 0.0)),
+            quota_burst=float(d.get("quotaBurst", 0.0)),
+            pinned=bool(d.get("pinned", False)),
+            resident_bytes=int(d.get("residentBytes", 0)),
+        )
+
+
+def load_tenant_specs(source: str) -> list[TenantSpec]:
+    """Parse the tenant table from inline JSON (starts with ``[``) or a
+    file path — the ``PIO_TENANTS`` / ``--tenants`` value."""
+    text = source.strip()
+    if not text.startswith("["):
+        with open(text, "r", encoding="utf-8") as f:
+            text = f.read()
+    try:
+        rows = json.loads(text)
+    except json.JSONDecodeError as e:
+        raise TenancyError(f"tenant table is not valid JSON: {e}") from e
+    if not isinstance(rows, list) or not rows:
+        raise TenancyError("tenant table must be a non-empty JSON array")
+    specs = [TenantSpec.from_dict(r) for r in rows]
+    seen: set[str] = set()
+    for s in specs:
+        if s.tenant in seen:
+            raise TenancyError(f"duplicate tenant id {s.tenant!r}")
+        seen.add(s.tenant)
+    return specs
+
+
+def estimate_resident_bytes(deployed: Any) -> int:
+    """Bytes the deployed engine's models pin on the host/device — the
+    packing currency. Walks model attributes for array-like ``nbytes``
+    (depth-limited: model objects hold flat param dicts/lists of
+    ndarrays, not deep graphs). The spec's ``residentBytes`` hint
+    overrides this when set (tests and exotic models)."""
+
+    def walk(obj: Any, depth: int) -> int:
+        nb = getattr(obj, "nbytes", None)
+        if isinstance(nb, (int, float)) and not isinstance(obj, (bool,)):
+            return int(nb)
+        if depth <= 0:
+            return 0
+        if isinstance(obj, dict):
+            return sum(walk(v, depth - 1) for v in obj.values())
+        if isinstance(obj, (list, tuple)):
+            return sum(walk(v, depth - 1) for v in obj)
+        d = getattr(obj, "__dict__", None)
+        if isinstance(d, dict):
+            return sum(walk(v, depth - 1) for v in d.values())
+        return 0
+
+    return sum(walk(m, 3) for m in getattr(deployed, "models", []))
+
+
+@dataclass
+class TenantState:
+    spec: TenantSpec
+    bucket: Optional[TokenBucket]
+    core: Optional[QueryServer] = None
+    lock: asyncio.Lock = field(default_factory=asyncio.Lock)
+    last_used: float = 0.0
+    cold_loads: int = 0
+    evictions: int = 0
+    resident_bytes: int = 0
+    requests: int = 0
+    throttled: int = 0
+
+
+class TenantRegistry:
+    """Lazy per-tenant serving cores under a byte budget.
+
+    The packer: before a cold load, evict least-recently-used unpinned
+    residents until the expected bytes fit; after the load, reconcile
+    with the MEASURED bytes (first touch of a tenant without a hint can
+    transiently overshoot — the reconcile pass restores the invariant).
+    Cold loads run in the executor under a per-tenant single-flight
+    lock: concurrent queries for the SAME cold tenant wait on one load;
+    other tenants' hot paths never wait at all.
+    """
+
+    def __init__(
+        self,
+        specs: list[TenantSpec],
+        config: ServerConfig,
+        storage=None,
+        ctx=None,
+        clock: Clock = SYSTEM_CLOCK,
+        budget_bytes: Optional[int] = None,
+        limit: Optional[int] = None,
+    ):
+        cap = limit if limit is not None else max_tenants()
+        if len(specs) > cap:
+            raise TenancyError(
+                f"{len(specs)} tenants exceed PIO_TENANT_MAX={cap} — the "
+                "tenant label cardinality bound")
+        self.config = config
+        self.storage = storage
+        self.ctx = ctx
+        self._clock = clock
+        self.budget_bytes = (tenant_budget()
+                             if budget_bytes is None else budget_bytes)
+        _T_BUDGET.set(self.budget_bytes or 0)
+        default_qps = float(os.environ.get("PIO_TENANT_QUOTA_QPS", "0"))
+        default_burst = float(os.environ.get("PIO_TENANT_QUOTA_BURST", "0"))
+        self._states: dict[str, TenantState] = {}
+        for spec in specs:
+            qps = spec.quota_qps if spec.quota_qps > 0 else default_qps
+            burst = spec.quota_burst if spec.quota_burst > 0 else default_burst
+            bucket = None
+            if qps > 0:
+                bucket = TokenBucket(
+                    qps, burst if burst > 0 else max(1.0, 2.0 * qps), clock)
+            self._states[spec.tenant] = TenantState(spec=spec, bucket=bucket)
+
+    # -- lookups ----------------------------------------------------------
+    @property
+    def tenants(self) -> list[str]:
+        return list(self._states)
+
+    def state(self, tenant: str) -> Optional[TenantState]:
+        return self._states.get(tenant)
+
+    def resident_total(self) -> int:
+        return sum(s.resident_bytes for s in self._states.values()
+                   if s.core is not None)
+
+    # -- quota door -------------------------------------------------------
+    def admit(self, tenant: str) -> Optional[int]:
+        """None when within quota; otherwise Retry-After seconds for the
+        429. Tenant-scoped by construction — one bucket per tenant."""
+        st = self._states[tenant]
+        if st.bucket is None or st.bucket.try_acquire(1.0):
+            return None
+        st.throttled += 1
+        _T_THROTTLED.labels(tenant=tenant).inc()
+        return max(1, math.ceil(st.bucket.retry_after(1.0)))
+
+    # -- packing ----------------------------------------------------------
+    async def core_for(self, tenant: str) -> QueryServer:
+        """The tenant's live core, cold-loading (and evicting) as needed.
+        Raises KeyError for unknown tenants, TenantBudgetError when the
+        packer cannot make room."""
+        st = self._states[tenant]
+        st.last_used = self._clock.monotonic()
+        core = st.core
+        if core is not None:
+            return core
+        async with st.lock:  # single-flight: one cold load per tenant
+            if st.core is not None:
+                return st.core
+            expected = st.spec.resident_bytes or st.resident_bytes
+            await self._make_room(tenant, expected)
+            cfg = dataclasses.replace(
+                self.config, engine_variant=st.spec.engine_variant)
+            loop = asyncio.get_running_loop()
+            # the expensive part (deserialize + per-tenant warmup) runs in
+            # the executor — the loop keeps serving OTHER tenants' queries
+            deployed = await loop.run_in_executor(
+                None, load_deployed_engine, cfg, self.storage, self.ctx)
+            measured = st.spec.resident_bytes or estimate_resident_bytes(
+                deployed)
+            st.core = QueryServer(
+                cfg, storage=self.storage, ctx=self.ctx, deployed=deployed,
+                clock=self._clock, name=f"query_server:{tenant}")
+            st.resident_bytes = measured
+            st.cold_loads += 1
+            st.last_used = self._clock.monotonic()
+            _T_COLD.labels(tenant=tenant).inc()
+            _T_RESIDENT.labels(tenant=tenant).set(measured)
+            logger.info("tenant %s: cold load #%d (%d bytes resident)",
+                        tenant, st.cold_loads, measured)
+        # first touch without a hint could not pre-budget exactly —
+        # reconcile against the measured bytes (never evicts `tenant`)
+        await self._make_room(tenant, 0)
+        return st.core
+
+    async def _make_room(self, protect: str, incoming: int) -> None:
+        if self.budget_bytes is None:
+            return
+        while self.resident_total() + incoming > self.budget_bytes:
+            victim = self._pick_victim(protect)
+            if victim is None:
+                if incoming == 0 or self.resident_total() == 0:
+                    # Nothing left to evict. incoming == 0 is the post-load
+                    # reconcile: the overshoot is the protected tenant's own
+                    # (or pinned) bytes, so accept it — the next cold load
+                    # evicts it like any LRU resident. resident_total() == 0
+                    # is the pre-load case where the single incoming tenant
+                    # is bigger than the whole budget — admit it alone
+                    # rather than deadlock (documented packer escape hatch).
+                    return
+                raise TenantBudgetError(
+                    f"no room for tenant {protect!r}: "
+                    f"{self.resident_total() + incoming} bytes needed, "
+                    f"budget {self.budget_bytes}, all residents pinned")
+            await self._evict(victim)
+
+    def _pick_victim(self, protect: str) -> Optional[TenantState]:
+        """LRU among unpinned residents; idle cores (empty queue, nothing
+        in flight) are preferred so an eviction never fails queued work."""
+        candidates = [
+            s for s in self._states.values()
+            if s.core is not None and not s.spec.pinned
+            and s.spec.tenant != protect
+        ]
+        if not candidates:
+            return None
+
+        def busy(s: TenantState) -> bool:
+            b = s.core.batcher
+            return b.queue.qsize() > 0 or bool(b._inflight)
+
+        candidates.sort(key=lambda s: (busy(s), s.last_used))
+        return candidates[0]
+
+    async def _evict(self, st: TenantState) -> None:
+        tenant = st.spec.tenant
+        core, st.core = st.core, None
+        st.evictions += 1
+        # st.resident_bytes is kept as the last-known size — the packer
+        # pre-budgets a re-load with it so a round trip can't overshoot
+        _T_EVICTIONS.labels(tenant=tenant).inc()
+        _T_RESIDENT.labels(tenant=tenant).set(0)
+        logger.info("tenant %s: evicted (LRU, budget pressure)", tenant)
+        # stop the batcher (fails anything still queued fast — the packer
+        # prefers idle victims, so normally there is nothing) and drop the
+        # core's scrape collector so /metrics reflects the eviction
+        await core.batcher.stop()
+        REGISTRY.remove_collector(core.name)
+
+    async def evict_all(self) -> None:
+        for st in self._states.values():
+            if st.core is not None:
+                await self._evict(st)
+
+    # -- surfaces ---------------------------------------------------------
+    def publish(self) -> None:
+        """Exposition-time gauges (the front's collector calls this)."""
+        _T_BUDGET.set(self.budget_bytes or 0)
+        for tenant, st in self._states.items():
+            if st.bucket is not None:
+                _T_QUOTA_FILL.labels(tenant=tenant).set(
+                    round(st.bucket.fill(), 4))
+            _T_RESIDENT.labels(tenant=tenant).set(
+                st.resident_bytes if st.core is not None else 0)
+
+    def snapshot(self) -> dict:
+        now = self._clock.monotonic()
+        tenants = {}
+        for tenant, st in self._states.items():
+            row: dict[str, Any] = {
+                "resident": st.core is not None,
+                "pinned": st.spec.pinned,
+                "residentBytes": (st.resident_bytes
+                                  if st.core is not None else 0),
+                "coldLoads": st.cold_loads,
+                "evictions": st.evictions,
+                "requests": st.requests,
+                "throttled": st.throttled,
+                "lastUsedAgeSec": (round(now - st.last_used, 3)
+                                   if st.last_used else None),
+                "quota": None,
+            }
+            if st.bucket is not None:
+                row["quota"] = {
+                    "qps": st.bucket.rate,
+                    "burst": st.bucket.burst,
+                    "fill": round(st.bucket.fill(), 4),
+                }
+            if st.core is not None:
+                row["instanceId"] = st.core.deployed.instance.id
+                row["engineVersion"] = (
+                    st.core.deployed.instance.engine_version)
+                row["probationActive"] = st.core._probation_active()
+                row["admission"] = st.core._admission.snapshot(
+                    st.core.batcher.queue.qsize())
+            tenants[tenant] = row
+        return {
+            "budgetBytes": self.budget_bytes or 0,
+            "residentBytes": self.resident_total(),
+            "tenantCount": len(self._states),
+            "residentCount": sum(1 for s in self._states.values()
+                                 if s.core is not None),
+            "tenants": tenants,
+        }
+
+
+class MultiTenantQueryServer:
+    """The multi-tenant HTTP front (`pio-tpu deploy --tenants ...`).
+
+    Routing: ``POST /engines/{id}/queries.json`` (and the admin verbs
+    under the same prefix), or bare ``/queries.json`` with the
+    ``X-PIO-Engine`` header; with exactly one registered tenant the bare
+    path defaults to it, so a one-tenant table behaves like the classic
+    single-engine server."""
+
+    def __init__(self, registry: TenantRegistry, config: ServerConfig,
+                 clock: Clock = SYSTEM_CLOCK):
+        self.registry = registry
+        self.config = config
+        self._clock = clock
+        # process-wide planes are armed ONCE here — per-tenant cores skip
+        # them (query_server.py gates on the front's collector name)
+        from incubator_predictionio_tpu.obs import spool as trace_spool
+        from incubator_predictionio_tpu.obs.plane import (
+            configure_perf_plane_from_env,
+        )
+
+        trace_spool.configure_export_from_env("query_server")
+        configure_perf_plane_from_env("query_server")
+        self._drain_state = DrainState("query_server")
+        self._start_time = clock.monotonic()
+        self._runner: Optional[web.AppRunner] = None
+        self._stop_event = asyncio.Event()
+        REGISTRY.add_collector("query_server", self.registry.publish)
+
+    # -- routing ----------------------------------------------------------
+    def _resolve_tenant(self, request: web.Request) -> Optional[str]:
+        tenant = (request.match_info.get("tenant")
+                  or request.headers.get("X-PIO-Engine"))
+        if tenant is None and len(self.registry.tenants) == 1:
+            tenant = self.registry.tenants[0]
+        return tenant
+
+    @staticmethod
+    def _unknown(tenant: Optional[str]) -> web.Response:
+        if tenant is None:
+            return web.json_response(
+                {"message": "multi-tenant server: name the engine via "
+                            "/engines/{id}/... or the X-PIO-Engine header"},
+                status=400)
+        return web.json_response(
+            {"message": f"unknown engine {tenant!r} (docs/tenancy.md)"},
+            status=404)
+
+    def make_app(self) -> web.Application:
+        app = web.Application(
+            middlewares=[telemetry_middleware("query_server")])
+        app.router.add_get("/", self.handle_status)
+        app.router.add_get("/health", self.handle_health)
+        app.router.add_get("/tenants.json", self.handle_tenants)
+        add_observability_routes(app)
+        app.router.add_post("/queries.json", self.handle_query)
+        app.router.add_post(
+            "/engines/{tenant}/queries.json", self.handle_query)
+        app.router.add_post("/engines/{tenant}/reload", self.handle_admin)
+        app.router.add_post("/engines/{tenant}/delta", self.handle_admin)
+        app.router.add_post("/engines/{tenant}/rollback", self.handle_admin)
+        app.router.add_post("/reload", self.handle_admin)
+        app.router.add_post("/delta", self.handle_admin)
+        app.router.add_post("/rollback", self.handle_admin)
+        app.router.add_post("/stop", self.handle_stop)
+        return app
+
+    # -- handlers ---------------------------------------------------------
+    async def handle_query(self, request: web.Request) -> web.Response:
+        if self._drain_state.draining:
+            return self._drain_state.reject_response()
+        tenant = self._resolve_tenant(request)
+        st = self.registry.state(tenant) if tenant else None
+        if st is None:
+            return self._unknown(tenant)
+        t0 = self._clock.monotonic()
+        retry_after = self.registry.admit(tenant)
+        if retry_after is not None:
+            _T_REQUESTS.labels(service="query_server", tenant=tenant,
+                               status="429").inc()
+            return web.json_response(
+                {"message": f"tenant {tenant!r} over quota "
+                            "(docs/tenancy.md)"},
+                status=429,
+                headers={"Retry-After": str(retry_after),
+                         "X-PIO-Tenant": tenant})
+        try:
+            core = await self.registry.core_for(tenant)
+        except TenantBudgetError as e:
+            _T_REQUESTS.labels(service="query_server", tenant=tenant,
+                               status="503").inc()
+            return web.json_response(
+                {"message": str(e)}, status=503,
+                headers={"Retry-After": "1", "X-PIO-Tenant": tenant})
+        except RuntimeError as e:
+            _T_REQUESTS.labels(service="query_server", tenant=tenant,
+                               status="500").inc()
+            return web.json_response({"message": str(e)}, status=500)
+        status, result, headers = await core._serve_payload(
+            await request.read())
+        headers = dict(headers or {})
+        headers["X-PIO-Tenant"] = tenant
+        st.requests += 1
+        _T_REQUESTS.labels(service="query_server", tenant=tenant,
+                           status=str(status)).inc()
+        _T_LATENCY.labels(service="query_server", tenant=tenant).observe(
+            self._clock.monotonic() - t0)
+        return web.json_response(result, status=status, headers=headers)
+
+    async def handle_admin(self, request: web.Request) -> web.Response:
+        """Tenant-scoped /reload, /delta, /rollback: resolve the tenant,
+        make its core resident, delegate — probation pins, smoke gates,
+        and delta chains live inside the core, so one tenant's failed
+        reload can never touch another tenant's pinned instance."""
+        if self._drain_state.draining:
+            return self._drain_state.reject_response()
+        tenant = self._resolve_tenant(request)
+        if tenant is None or self.registry.state(tenant) is None:
+            return self._unknown(tenant)
+        verb = request.path.rsplit("/", 1)[-1]
+        try:
+            core = await self.registry.core_for(tenant)
+        except TenantBudgetError as e:
+            return web.json_response(
+                {"message": str(e)}, status=503,
+                headers={"Retry-After": "1"})
+        handler = {"reload": core.handle_reload,
+                   "delta": core.handle_delta,
+                   "rollback": core.handle_rollback}[verb]
+        return await handler(request)
+
+    async def handle_tenants(self, request: web.Request) -> web.Response:
+        return web.json_response(self.registry.snapshot())
+
+    async def handle_status(self, request: web.Request) -> web.Response:
+        return web.json_response({
+            "mode": "multi-tenant",
+            "tenants": self.registry.tenants,
+            "uptimeSec": round(
+                self._clock.monotonic() - self._start_time, 3),
+        })
+
+    async def handle_health(self, request: web.Request) -> web.Response:
+        """Aggregate liveness + the per-tenant packing state. The
+        ``deployment.engines``/``deployment.resident`` sets are what the
+        fleet balancer folds for (tenant, load) routing."""
+        snap = self.registry.snapshot()
+        degraded = False
+        for row in snap["tenants"].values():
+            adm = row.get("admission") or {}
+            if adm.get("brownoutActive"):
+                degraded = True
+        resident = [t for t, row in snap["tenants"].items()
+                    if row["resident"]]
+        return web.json_response({
+            "status": self._drain_state.health_status(degraded),
+            "draining": self._drain_state.draining,
+            "slo": _slo.health_block(),
+            "tenancy": snap,
+            "deployment": {
+                "multiTenant": True,
+                "engines": self.registry.tenants,
+                "resident": resident,
+                # single-instance fields stay None-shaped so existing
+                # fleet folds keep working against multi-tenant replicas
+                "instanceId": None,
+                "engineVersion": None,
+                "streaming": None,
+                "shardOwner": None,
+            },
+        })
+
+    async def handle_stop(self, request: web.Request) -> web.Response:
+        import hmac
+
+        key = self.config.server_access_key
+        if key and not hmac.compare_digest(
+                request.query.get("accessKey", "").encode(), key.encode()):
+            return web.json_response({"message": "Unauthorized"}, status=401)
+        self._stop_event.set()
+        return web.json_response({"message": "Shutting down"})
+
+    # -- lifecycle --------------------------------------------------------
+    async def start(self) -> None:
+        from incubator_predictionio_tpu.obs import procstats
+        from incubator_predictionio_tpu.server.event_server import (
+            _ssl_context,
+        )
+
+        self._loop_lag = procstats.start_loop_lag("query_server")
+        self._runner = web.AppRunner(self.make_app())
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.config.ip, self.config.port,
+                           ssl_context=_ssl_context(self.config))
+        await site.start()
+        logger.info("multi-tenant engine server listening on %s:%d "
+                    "(%d tenants, budget %s bytes)",
+                    self.config.ip, self.config.port,
+                    len(self.registry.tenants),
+                    self.registry.budget_bytes or "∞")
+
+    async def wait_stopped(self) -> None:
+        await self._stop_event.wait()
+        await self.drain_and_shutdown()
+
+    async def drain_and_shutdown(
+            self, deadline_sec: Optional[float] = None) -> None:
+        self._drain_state.begin()
+        deadline = (drained_exit_deadline()
+                    if deadline_sec is None else deadline_sec)
+        # give every resident core its drain window concurrently
+        cores = [st.core for st in self.registry._states.values()
+                 if st.core is not None]
+        if cores:
+            from incubator_predictionio_tpu.server.lifecycle import wait_for
+
+            await wait_for(
+                lambda: all(c.batcher.queue.qsize() == 0
+                            and not c.batcher._inflight for c in cores),
+                deadline)
+        await self.shutdown()
+
+    async def shutdown(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+        lag = getattr(self, "_loop_lag", None)
+        if lag is not None:
+            lag.cancel()
+        await self.registry.evict_all()
+        from incubator_predictionio_tpu.obs import spool as trace_spool
+
+        trace_spool.flush_export()
+
+
+def serve_forever_tenants(config: ServerConfig, specs: list[TenantSpec],
+                          storage=None) -> None:
+    """Blocking entry for the CLI `deploy --tenants` path."""
+
+    async def main():
+        registry = TenantRegistry(specs, config, storage=storage)
+        server = MultiTenantQueryServer(registry, config)
+        await server.start()
+        install_signal_drain(asyncio.get_running_loop(), server._stop_event,
+                             "multi-tenant engine server")
+        await server.wait_stopped()
+
+    asyncio.run(main())

@@ -329,6 +329,19 @@ def cmd_deploy(args, storage: Storage) -> int:
         **({"admission_adaptive": False}
            if args.no_adaptive_admission else {}),
     )
+    # multi-tenant mode (docs/tenancy.md): a tenant table via --tenants
+    # or PIO_TENANTS hosts N engines behind this one process; the classic
+    # single-engine path below stays byte-identical without one
+    tenants_src = args.tenants or os.environ.get("PIO_TENANTS", "").strip()
+    if tenants_src:
+        from incubator_predictionio_tpu.server.tenancy import (
+            load_tenant_specs,
+            serve_forever_tenants,
+        )
+
+        serve_forever_tenants(config, load_tenant_specs(tenants_src),
+                              storage)
+        return 0
     serve_forever(config, storage)
     return 0
 
@@ -1356,6 +1369,148 @@ def cmd_index(args, storage: Storage) -> int:
     for line in format_index_stats(deployed.models):
         _out(line)
     return 0
+
+
+def cmd_tenants(args, storage) -> int:
+    """Per-tenant fleet rollup (docs/tenancy.md): one row per tenant
+    aggregated across every given server's ``/health`` + ``/metrics`` —
+    requests + qps, p99, quota fill, throttles, cold loads, evictions,
+    and resident HBM bytes. Red rows (the `pio-tpu health` row pattern:
+    ``!!`` mark + non-zero exit) on quota exhaustion or eviction
+    thrash."""
+    from incubator_predictionio_tpu.fleet.health import probe_health_urls
+    from incubator_predictionio_tpu.obs.metrics import (
+        bucket_quantiles,
+        parse_prometheus_text,
+    )
+
+    probed = probe_health_urls(
+        args.urls, args.timeout,
+        fetch=lambda url, timeout: _fetch_health(url, timeout))
+    agg: dict[str, dict] = {}
+
+    def slot(t: str) -> dict:
+        return agg.setdefault(t, {
+            "tenant": t, "requests": 0, "throttled": 0, "evictions": 0,
+            "coldLoads": 0, "residentBytes": 0, "replicas": 0,
+            "resident": 0, "pinned": False, "quotaFill": None,
+            "p99Ms": None, "qps": None})
+
+    rows: list[dict] = []
+    for url in args.urls:
+        h, err = probed[url]
+        if h is None:
+            rows.append({"url": url, "status": "unreachable", "red": True,
+                         "detail": err or ""})
+            continue
+        tenants = ((h.get("tenancy") or {}).get("tenants")) or {}
+        for t, trow in tenants.items():
+            a = slot(t)
+            a["replicas"] += 1
+            a["resident"] += 1 if trow.get("resident") else 0
+            a["pinned"] = a["pinned"] or bool(trow.get("pinned"))
+            a["requests"] += int(trow.get("requests") or 0)
+            a["throttled"] += int(trow.get("throttled") or 0)
+            a["evictions"] += int(trow.get("evictions") or 0)
+            a["coldLoads"] += int(trow.get("coldLoads") or 0)
+            a["residentBytes"] += int(trow.get("residentBytes") or 0)
+            fill = (trow.get("quota") or {}).get("fill")
+            if fill is not None:
+                a["quotaFill"] = (fill if a["quotaFill"] is None
+                                  else min(a["quotaFill"], fill))
+    # /metrics fold: fleet-merged per-tenant histogram buckets give the
+    # p99; a second scrape ``--interval`` later turns the cumulative
+    # request counters into a live qps (0 disables the second scrape)
+    scrapes: list[dict] = [{}, {}]
+    n_scrapes = 2 if args.interval > 0 else 1
+    for phase in range(n_scrapes):
+        if phase == 1:
+            import time as _time
+
+            _time.sleep(args.interval)
+        for url in args.urls:
+            if probed[url][0] is None:
+                continue
+            try:
+                text = _fetch_metrics_text(_metrics_url(url), args.timeout)
+            except Exception:  # noqa: BLE001 - the rollup is best-effort
+                continue
+            scrapes[phase][url] = parse_prometheus_text(text)
+    reqs: list[dict[str, float]] = [{}, {}]
+    buckets: dict[str, dict[float, float]] = {}
+    last = scrapes[n_scrapes - 1]
+    for phase in range(n_scrapes):
+        for fams in scrapes[phase].values():
+            fam = fams.get("pio_tenant_requests_total") or {}
+            for _s, labels, value in fam.get("samples", []):
+                t = labels.get("tenant")
+                if t:
+                    reqs[phase][t] = reqs[phase].get(t, 0.0) + value
+    for fams in last.values():
+        fam = fams.get("pio_tenant_request_seconds") or {}
+        for sname, labels, value in fam.get("samples", []):
+            if not sname.endswith("_bucket"):
+                continue
+            t = labels.get("tenant")
+            if not t:
+                continue
+            le = float({"+Inf": "inf"}.get(labels["le"], labels["le"]))
+            b = buckets.setdefault(t, {})
+            b[le] = b.get(le, 0.0) + value
+    for t, b in buckets.items():
+        q = bucket_quantiles(sorted(b.items())).get("p99")
+        if q is not None:
+            slot(t)["p99Ms"] = round(q * 1e3, 2)
+    if n_scrapes == 2:
+        for t in list(agg):
+            d = reqs[1].get(t, 0.0) - reqs[0].get(t, 0.0)
+            agg[t]["qps"] = round(max(0.0, d) / args.interval, 2)
+    for t in sorted(agg):
+        a = agg[t]
+        reasons = []
+        fill = a["quotaFill"]
+        if a["throttled"] and fill is not None and fill <= args.fill_red:
+            reasons.append(f"QUOTA EXHAUSTED (fill {fill:.2f}, "
+                           f"{a['throttled']} throttled)")
+        if a["evictions"] >= args.thrash_evictions:
+            reasons.append(f"EVICTION THRASH ({a['evictions']} evictions "
+                           f">= {args.thrash_evictions} — grow "
+                           "PIO_TENANT_HBM_BUDGET or pin the tenant)")
+        parts = [f"req {a['requests']}"]
+        if a["qps"] is not None:
+            parts.append(f"qps {a['qps']}")
+        if a["p99Ms"] is not None:
+            parts.append(f"p99 {a['p99Ms']}ms")
+        if fill is not None:
+            parts.append(f"quota fill {fill:.2f}")
+        if a["throttled"]:
+            parts.append(f"throttled {a['throttled']}")
+        parts.append(f"resident {a['resident']}/{a['replicas']}"
+                     + (" pinned" if a["pinned"] else ""))
+        parts.append(f"hbm {a['residentBytes']}B")
+        if a["coldLoads"]:
+            parts.append(f"coldLoads {a['coldLoads']}")
+        if a["evictions"]:
+            parts.append(f"evictions {a['evictions']}")
+        parts.extend(reasons)
+        rows.append({"url": f"tenant:{t}", **a,
+                     "status": ("over-quota" if reasons else "ok"),
+                     "red": bool(reasons), "detail": "; ".join(parts)})
+    if not rows:
+        _err("tenants: nothing to report (are these multi-tenant "
+             "query servers? docs/tenancy.md)")
+        return 2
+    if args.json:
+        _out(json.dumps(rows, indent=2))
+    else:
+        w = max(len(r["url"]) for r in rows)
+        for r in rows:
+            mark = "!!" if r["red"] else "ok"
+            line = f"{mark} {r['url']:<{w}}  {r['status']}"
+            if r["detail"]:
+                line += f"  [{r['detail']}]"
+            _out(line)
+    return 1 if any(r["red"] for r in rows) else 0
 
 
 def _fetch_metrics_text(url: str, timeout: float = 10.0,
@@ -2923,6 +3078,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="directory persisting this owner's fencing epoch "
                         "across restarts; a corrupt token refuses startup "
                         "rather than guess (PIO_FLEET_SHARD_STATE_DIR env)")
+    p.add_argument("--tenants", default=None,
+                   help="multi-tenant mode: tenant table as a JSON file "
+                        "path or inline JSON array — this process hosts "
+                        "every listed engine behind /engines/{id}/... "
+                        "(PIO_TENANTS env — docs/tenancy.md)")
     p = sub.add_parser("undeploy")
     p.add_argument("--ip", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8000)
@@ -3414,6 +3574,31 @@ def build_parser() -> argparse.ArgumentParser:
                         "dir: red when live members fall below quorum "
                         "(docs/sharding.md \"Multi-host training\")")
 
+    # tenants — per-tenant fleet rollup (docs/tenancy.md)
+    p = sub.add_parser(
+        "tenants",
+        help="per-tenant rollup across the given multi-tenant query "
+             "servers: requests/qps/p99/quota/evictions/HBM bytes from "
+             "/health + /metrics; red rows on quota exhaustion or "
+             "eviction thrash, non-zero exit when any row is red")
+    p.add_argument("urls", nargs="+",
+                   help="query-server base URLs, e.g. "
+                        "http://127.0.0.1:8000 http://127.0.0.1:8001")
+    p.add_argument("--timeout", type=float, default=5.0,
+                   help="per-probe timeout in seconds (default 5)")
+    p.add_argument("--interval", type=float, default=1.0,
+                   help="seconds between the two /metrics scrapes the "
+                        "qps column derives from (0 = single scrape, "
+                        "no qps; default 1)")
+    p.add_argument("--fill-red", type=float, default=0.05,
+                   help="quota-fill fraction at or below which a tenant "
+                        "with throttles paints red (default 0.05)")
+    p.add_argument("--thrash-evictions", type=int, default=8,
+                   help="total evictions at which a tenant paints red "
+                        "for eviction thrash (default 8)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable row output")
+
     # dist — distributed-training mesh inspection (docs/sharding.md)
     dist = sub.add_parser(
         "dist",
@@ -3652,6 +3837,7 @@ _COMMANDS = {
     "metrics": cmd_metrics,
     "trace": cmd_trace,
     "health": cmd_health,
+    "tenants": cmd_tenants,
     "profile": cmd_profile,
     "history": cmd_history,
     "top": cmd_top,

@@ -230,6 +230,29 @@ def bench_main(argv: list[str]) -> None:
         base, warm_s, cap_s, over_s, _rotating_user_req_fn(base, n_users))))
 
 
+def tenant_main(argv: list[str]) -> None:
+    """Subprocess entry for per-tenant drivers (the multi-tenant chaos
+    test and ``bench.py multi_tenant``): drive ONE tenant's path at a
+    fixed open-loop rate from its own process, so concurrent tenant
+    drivers cannot pollute each other's latency measurements through
+    client-side GIL/scheduler contention.
+
+    ``argv = [host, port, path, duration_s, target_qps, n_conns, body]``.
+    Prints one JSON line: status counts + p50/p99 of the 200s."""
+    host, port, path, duration, qps, n_conns, body = (
+        argv[0], int(argv[1]), argv[2], float(argv[3]), float(argv[4]),
+        int(argv[5]), argv[6].encode())
+    req = request_bytes(host, port, body, path=path)
+    counts, lat = asyncio.run(
+        open_loop(host, port, n_conns, duration, qps, lambda: req))
+    print(json.dumps({
+        "counts": {str(k): v for k, v in counts.items()},
+        "goodput_qps": round(counts.get(200, 0) / duration, 1),
+        "p50_ms": round(pct(lat, 0.5), 2),
+        "p99_ms": round(pct(lat, 0.99), 2),
+    }))
+
+
 def fleet_main(argv: list[str]) -> None:
     """Subprocess entry for ``bench.py fleet``:
     ``argv = [base_url, warm_s, cap_s, over_s, n_users, offered_qps]``.

@@ -29,7 +29,7 @@ from incubator_predictionio_tpu.server.storage_server import (
     StorageServerConfig,
     ThreadedStorageServer,
 )
-from tests.fixtures.procs import ServerProc, free_port, http_json
+from tests.fixtures.procs import REPO_ROOT, ServerProc, free_port, http_json
 
 pytestmark = pytest.mark.slow
 
@@ -355,6 +355,280 @@ def test_query_server_overload_storm(tmp_path):
         # /health and the always-admitted routes stayed reachable
         _, health = http_json("GET", f"{base}/health")
         assert "admission" in health
+    finally:
+        qs.stop()
+
+
+# ---------------------------------------------------------------------------
+# multi-tenant chaos (ISSUE 20): noisy-neighbor containment + packing,
+# against one real multi-tenant query-server subprocess
+# ---------------------------------------------------------------------------
+
+
+async def _post_hdrs(r, w, req: bytes):
+    """Like loadgen.post but keeps the response headers — the tenant
+    attribution oracle reads X-PIO-Tenant off every answer."""
+    t0 = time.perf_counter()
+    w.write(req)
+    await w.drain()
+    status = int((await r.readline()).split()[1])
+    headers = {}
+    length = 0
+    while True:
+        line = await r.readline()
+        if line in (b"\r\n", b""):
+            break
+        k, _, v = line.decode().partition(":")
+        headers[k.strip().lower()] = v.strip()
+        if k.strip().lower() == "content-length":
+            length = int(v)
+    await r.readexactly(length)
+    return status, headers, (time.perf_counter() - t0) * 1e3
+
+
+async def _victim_loop(host, port, n_conns, duration, target_qps, req):
+    """Fixed-rate open loop over the victim's path, recording status
+    counts, 200-latencies, and EVERY X-PIO-Tenant header seen."""
+    import itertools as it
+
+    conns = [await asyncio.open_connection(host, port)
+             for _ in range(n_conns)]
+    t0 = time.perf_counter()
+    slots = it.count()
+    counts: dict = {}
+    lat_ms: list = []
+    tenants_seen: set = set()
+
+    async def worker(conn):
+        r, w = conn
+        while True:
+            t_sched = t0 + next(slots) / target_qps
+            if t_sched - t0 >= duration or time.perf_counter() - t0 >= duration:
+                return
+            now = time.perf_counter()
+            if t_sched > now:
+                await asyncio.sleep(t_sched - now)
+            status, headers, ms = await _post_hdrs(r, w, req)
+            counts[status] = counts.get(status, 0) + 1
+            if status == 200:
+                lat_ms.append(ms)
+            tenants_seen.add(headers.get("x-pio-tenant"))
+
+    await asyncio.gather(*(worker(c) for c in conns))
+    for _, w in conns:
+        w.close()
+    return counts, lat_ms, tenants_seen
+
+
+def _http_with_headers(method: str, url: str, body=None, timeout=10.0):
+    """(status, headers dict, parsed json) — the Retry-After forensics."""
+    import urllib.error
+    import urllib.request
+
+    data = None if body is None else json.dumps(body).encode()
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return (resp.status, dict(resp.headers),
+                    json.loads(resp.read() or b"null"))
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read() or b"null")
+
+
+def test_multi_tenant_noisy_neighbor_contained(tmp_path):
+    """ISSUE 20 tentpole acceptance, against a real subprocess: one
+    multi-tenant query server hosts three tenants under a byte budget that
+    provably cannot fit them all. A noisy tenant drives ~3× its quota
+    while the victim runs steady:
+
+    - the victim's goodput holds (≥ 0.95× its solo run) and its p99 stays
+      bounded (≤ 1.5× solo, plus a small scheduler-noise floor);
+    - the noisy tenant's excess is shed ORDERLY — only 429/503 with a
+      Retry-After header, never a 5xx error or a cross-tenant answer;
+    - attribution forensics: every victim answer carries
+      ``X-PIO-Tenant: victim`` — no request is ever answered by another
+      tenant's engine;
+    - packing: first touch of the third tenant under the full budget
+      evicts the LRU resident and cold-loads (both counted), and
+      ``pio-tpu tenants`` renders the packing state.
+    """
+    store_cfg, variant_path = _train_classification(tmp_path)
+    quota_qps = 30.0
+    tenants = [
+        {"tenant": "noisy", "engineVariant": variant_path,
+         "quotaQps": quota_qps, "quotaBurst": quota_qps,
+         "residentBytes": 1000},
+        {"tenant": "victim", "engineVariant": variant_path,
+         "residentBytes": 1000},
+        {"tenant": "spare", "engineVariant": variant_path,
+         "residentBytes": 1000},
+    ]
+    tenants_file = str(tmp_path / "tenants.json")
+    with open(tenants_file, "w") as f:
+        json.dump(tenants, f)
+    qport = free_port()
+    qs = ServerProc(
+        ["deploy", "-v", variant_path, "--tenants", tenants_file,
+         "--ip", "127.0.0.1", "--port", str(qport),
+         "--query-timeout", str(QUERY_DEADLINE_S)],
+        env={**store_cfg, "PIO_TENANT_HBM_BUDGET": "2000"})
+    base = f"http://127.0.0.1:{qport}"
+    body = {"features": [0.5, -0.2, 0.1]}
+    try:
+        qs.wait_ready(f"{base}/", timeout=180.0)
+        # cold loads are off the hot path by design: pay them here, once,
+        # per tenant the storm will touch (spare stays cold → lazy)
+        for t in ("noisy", "victim"):
+            status, hdrs, got = _http_with_headers(
+                "POST", f"{base}/engines/{t}/queries.json", body,
+                timeout=60.0)
+            assert status == 200, (t, status, got)
+            assert hdrs.get("X-PIO-Tenant") == t
+        _, health = http_json("GET", f"{base}/health")
+        assert health["deployment"]["multiTenant"] is True
+        assert sorted(health["deployment"]["resident"]) == [
+            "noisy", "victim"]
+
+        victim_req = request_bytes("127.0.0.1", qport, _STORM_BODY,
+                                   path="/engines/victim/queries.json")
+        noisy_req = request_bytes("127.0.0.1", qport, _STORM_BODY,
+                                  path="/engines/noisy/queries.json")
+
+        # warm BOTH tenants' serving paths at real concurrency before any
+        # measurement: micro-batch sizes vary under load, and each core
+        # compiles its batch buckets on first use — a mid-storm compile
+        # would masquerade as neighbor interference
+        asyncio.run(closed_loop(
+            "127.0.0.1", qport, 8, 1.0, lambda: noisy_req))
+        cap_counts, _ = asyncio.run(closed_loop(
+            "127.0.0.1", qport, 8, 2.0, lambda: victim_req))
+        # victim's steady rate: well inside its solo capacity — headroom
+        # the neighbor is NOT entitled to eat
+        victim_rate = max(10.0, 0.35 * cap_counts.get(200, 0) / 2.0)
+
+        def drive_noisy(offered_qps: float) -> subprocess.Popen:
+            # the noisy driver runs in its OWN subprocess — a driver
+            # thread here would pollute the victim's latency measurement
+            # through client-side GIL contention
+            return subprocess.Popen(
+                [sys.executable, "-c",
+                 "import sys; "
+                 "from tests.fixtures.loadgen import tenant_main; "
+                 "tenant_main(sys.argv[1:])",
+                 "127.0.0.1", str(qport), "/engines/noisy/queries.json",
+                 "3.0", str(offered_qps), "16", json.dumps(body)],
+                cwd=REPO_ROOT, stdout=subprocess.PIPE, text=True)
+
+        def measure(offered_qps: float):
+            driver = drive_noisy(offered_qps)
+            vic = asyncio.run(_victim_loop(
+                "127.0.0.1", qport, 16, 3.0, victim_rate, victim_req))
+            out, _ = driver.communicate(timeout=60)
+            assert driver.returncode == 0
+            res = json.loads(out)
+            counts = {int(k) if k.isdigit() else k: v
+                      for k, v in res["counts"].items()}
+            return counts, vic
+
+        # BASELINE vs STORM: the neighbor behaving (offered = 1× quota)
+        # vs rogue (3×). The quota can only shed EXCESS — the
+        # within-quota admitted load shares the host's CPU legitimately,
+        # so the containment claim is "3× offered load looks exactly
+        # like 1× to the victim", not "the victim cannot tell the
+        # neighbor exists". One re-measure of the pair is allowed: on a
+        # single-core host a one-off ~100ms scheduler stall in either
+        # 3s window moves that window's p99 by itself, while a REAL
+        # containment failure reproduces in every pair.
+        for attempt in (1, 2):
+            _, (solo_counts, solo_lat, solo_seen) = measure(quota_qps)
+            solo_good = solo_counts.get(200, 0) / 3.0
+            solo_p99 = pct(solo_lat, 0.99)
+            assert solo_good > 0 and solo_seen == {"victim"}
+
+            noisy_counts, (vic_counts, vic_lat, vic_seen) = (
+                measure(3.0 * quota_qps))
+            # the hard invariants hold on EVERY attempt: attribution
+            # (each victim answer came from the victim's engine) and
+            # orderly statuses — never a wrong answer, never a 5xx error
+            assert vic_seen == {"victim"}
+            assert set(_status_counts(vic_counts)) <= {200, 504}, \
+                vic_counts
+
+            # victim containment: goodput ratio ≥ 0.95, p99 ratio ≤ 1.5
+            # (a few ms of floor damps scheduler noise on tiny p99s)
+            vic_good = vic_counts.get(200, 0) / 3.0
+            vic_p99 = pct(vic_lat, 0.99)
+            bound = max(1.5 * solo_p99, solo_p99 + 25.0)
+            if (vic_good >= 0.95 * solo_good and vic_p99 <= bound):
+                break
+        else:
+            raise AssertionError(
+                f"noisy neighbor NOT contained in 2 measurement pairs: "
+                f"victim goodput {vic_good:.1f} qps (solo "
+                f"{solo_good:.1f}, need ≥ 95%), p99 {vic_p99:.1f}ms "
+                f"(solo {solo_p99:.1f}ms, bound {bound:.1f}ms)")
+
+        # the noisy tenant got ONLY orderly answers: 200 within quota,
+        # 429 (quota) / 503 (budget) / 504 (deadline) beyond it — and its
+        # served rate stayed pinned near the quota, not at its offer
+        assert set(_status_counts(noisy_counts)) <= {200, 429, 503, 504}, \
+            noisy_counts
+        assert noisy_counts.get(429, 0) > 0, "the quota never engaged"
+        noisy_good = noisy_counts.get(200, 0) / 3.0
+        assert noisy_good <= 1.6 * quota_qps, (
+            f"noisy served {noisy_good:.1f} qps — quota {quota_qps} "
+            "did not contain it")
+
+        # Retry-After forensics on a live 429
+        status, hdrs, got = (0, {}, None)
+        for _ in range(80):
+            status, hdrs, got = _http_with_headers(
+                "POST", f"{base}/engines/noisy/queries.json", body)
+            if status == 429:
+                break
+        assert status == 429, "could not re-exhaust the quota"
+        assert int(hdrs["Retry-After"]) >= 1
+        assert hdrs.get("X-PIO-Tenant") == "noisy"
+        assert "over quota" in got["message"]
+
+        # per-tenant ledger: throttles landed on noisy, none on victim
+        _, snap = http_json("GET", f"{base}/tenants.json")
+        assert snap["budgetBytes"] == 2000
+        assert snap["tenants"]["noisy"]["throttled"] > 0
+        assert snap["tenants"]["victim"]["throttled"] == 0
+
+        # packing proof: three 1000-byte tenants under a 2000-byte budget
+        # cannot all fit — touching the cold spare evicts the LRU and
+        # cold-loads the spare (one query, one right answer, both counted)
+        status, hdrs, got = _http_with_headers(
+            "POST", f"{base}/engines/spare/queries.json", body,
+            timeout=60.0)
+        assert status == 200 and hdrs.get("X-PIO-Tenant") == "spare"
+        _, snap = http_json("GET", f"{base}/tenants.json")
+        assert snap["residentCount"] == 2
+        assert snap["tenants"]["spare"]["resident"]
+        assert snap["tenants"]["spare"]["coldLoads"] == 1
+        evicted = [t for t, row in snap["tenants"].items()
+                   if not row["resident"]]
+        assert len(evicted) == 1 and evicted[0] in ("noisy", "victim")
+        assert snap["tenants"][evicted[0]]["evictions"] == 1
+
+        # the operator view renders the same packing state, and paints
+        # the quota exhaustion red (exit 1 — red rows, not a crash)
+        cli = subprocess.run(
+            [sys.executable, "-m", "incubator_predictionio_tpu.tools.cli",
+             "tenants", "--json", "--interval", "0.5", base],
+            capture_output=True, text=True, timeout=60)
+        assert cli.returncode in (0, 1), cli.stdout + cli.stderr
+        rows = {r["tenant"]: r for r in json.loads(cli.stdout)
+                if "tenant" in r}
+        assert set(rows) == {"noisy", "victim", "spare"}
+        assert rows["spare"]["coldLoads"] >= 1
+        assert rows["noisy"]["throttled"] > 0
+        assert rows[evicted[0]]["evictions"] >= 1
+        assert rows["spare"]["residentBytes"] == 1000
     finally:
         qs.stop()
 

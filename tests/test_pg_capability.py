@@ -1,0 +1,92 @@
+"""Meta-tests for the fake-pg capability gate (tests/fixtures/pg_capability).
+
+The gate exists so hosts whose bundled sqlite predates RETURNING (3.35.0)
+skip the affected postgres-fake tests with a NAMED reason instead of failing
+on an environmental limitation. These tests pin the two properties that keep
+the gate honest: the verdict derives solely from a live feature probe (so on
+any capable host the full set runs — no version allowlists, no env switches),
+and every gated test uses exactly this probe (no second, drifting gate).
+"""
+
+import sqlite3
+
+import pytest
+
+from tests.fixtures import pg_capability
+from tests.fixtures.pg_capability import pg_fake_skip_reason
+
+
+def test_probe_matches_live_sqlite_feature():
+    """The verdict must agree with what this host's sqlite actually does:
+    None exactly when an in-memory INSERT ... RETURNING works."""
+    conn = sqlite3.connect(":memory:")
+    try:
+        conn.execute("CREATE TABLE t (id INTEGER PRIMARY KEY, v TEXT)")
+        try:
+            conn.execute("INSERT INTO t (v) VALUES ('x') RETURNING id")
+            supported = True
+        except sqlite3.OperationalError:
+            supported = False
+    finally:
+        conn.close()
+    reason = pg_fake_skip_reason()
+    if supported:
+        assert reason is None, (
+            "host sqlite supports RETURNING yet the gate would skip: %s"
+            % reason)
+    else:
+        assert isinstance(reason, str) and reason
+        # a named reason: operator can tell it is environmental at a glance
+        assert "RETURNING" in reason and "3.35" in reason
+
+
+def test_probe_is_memoised():
+    """The probe runs once; repeat calls return the identical verdict
+    without re-touching sqlite (collection-time gates stay O(1))."""
+    first = pg_fake_skip_reason()
+    assert pg_fake_skip_reason() is first or pg_fake_skip_reason() == first
+    assert pg_capability._MEMO and pg_capability._MEMO[0] == first
+
+
+def test_gated_modules_share_this_probe():
+    """Every module-level gate is the probe's verdict, verbatim — not a
+    hand-rolled version check that could drift from reality."""
+    import tests.test_pg_workflow as wf
+    import tests.test_postgres_wire as wire
+    import tests.test_wire_replay as replay
+
+    verdict = pg_fake_skip_reason()
+    assert wire._PG_SKIP == verdict
+    assert wf._PG_SKIP == verdict
+    assert replay._PG_SKIP == verdict
+
+
+def test_contract_helper_only_targets_the_fake_param():
+    """skip_if_fake_pg_lacks_returning must leave every non-fake backend
+    param alone (postgres-live in particular), whatever the verdict."""
+
+    class _Node:
+        class callspec:
+            params = {"client": "postgres-live"}
+
+    class _Request:
+        node = _Node()
+
+    # must not raise Skipped for the live param even on an incapable host
+    pg_capability.skip_if_fake_pg_lacks_returning(_Request())
+
+    class _Bare:
+        node = object()  # no callspec at all (unparametrized caller)
+
+    pg_capability.skip_if_fake_pg_lacks_returning(_Bare())
+
+    if pg_fake_skip_reason() is not None:
+        class _FakeNode:
+            class callspec:
+                params = {"client": "postgres"}
+
+        class _FakeRequest:
+            node = _FakeNode()
+
+        with pytest.raises(pytest.skip.Exception):
+            pg_capability.skip_if_fake_pg_lacks_returning(_FakeRequest())

@@ -13,6 +13,9 @@ import pytest
 from aiohttp.test_utils import TestClient, TestServer
 
 from incubator_predictionio_tpu.data.storage import Storage, use_storage
+from tests.fixtures.pg_capability import pg_fake_skip_reason
+
+_PG_SKIP = pg_fake_skip_reason()
 
 
 @pytest.fixture()
@@ -34,6 +37,7 @@ def pg_storage():
     server.close()
 
 
+@pytest.mark.skipif(_PG_SKIP is not None, reason=_PG_SKIP or "")
 def test_pg_backs_all_three_repositories_end_to_end(pg_storage, tmp_path):
     storage = pg_storage
     from incubator_predictionio_tpu.server.event_server import (

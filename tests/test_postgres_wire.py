@@ -17,6 +17,9 @@ from incubator_predictionio_tpu.data.storage.postgres import (
     scram_client_proofs,
 )
 from tests.fixtures.fake_pg import FakePG
+from tests.fixtures.pg_capability import pg_fake_skip_reason
+
+_PG_SKIP = pg_fake_skip_reason()
 
 
 def test_scram_rfc7677_vector():
@@ -52,6 +55,7 @@ def test_scram_handshake_and_auth_failure():
         server.close()
 
 
+@pytest.mark.skipif(_PG_SKIP is not None, reason=_PG_SKIP or "")
 def test_bytea_and_null_round_trip():
     server = FakePG()
     try:
@@ -96,6 +100,7 @@ def test_digit_only_text_values_stay_verbatim():
         server.close()
 
 
+@pytest.mark.skipif(_PG_SKIP is not None, reason=_PG_SKIP or "")
 def test_poisoned_connection_reconnects():
     """A mid-exchange socket failure must not leave stale frames for the
     next query: the connection is poisoned and transparently re-established."""

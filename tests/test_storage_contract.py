@@ -23,6 +23,7 @@ from incubator_predictionio_tpu.data.storage import (
 )
 from incubator_predictionio_tpu.data.storage.memory import MemoryStorageClient
 from incubator_predictionio_tpu.data.storage.sqlite_backend import SqliteStorageClient
+from tests.fixtures.pg_capability import skip_if_fake_pg_lacks_returning
 
 UTC = dt.timezone.utc
 APP = 1
@@ -380,7 +381,9 @@ class TestEventStoreContract:
 
 
 class TestMetaContract:
-    def test_apps_crud(self, meta_client):
+    def test_apps_crud(self, meta_client, request):
+        # app creation drives INSERT ... RETURNING through the fake
+        skip_if_fake_pg_lacks_returning(request)
         apps = meta_client.apps()
         app_id = apps.insert(App(0, "myapp", "desc"))
         assert app_id and apps.get(app_id).name == "myapp"
@@ -402,7 +405,9 @@ class TestMetaContract:
         assert ak.insert(AccessKey(key, 4)) is None  # duplicate
         assert ak.delete(key) and ak.get(key) is None
 
-    def test_channels(self, meta_client):
+    def test_channels(self, meta_client, request):
+        # channel insert/delete drive RETURNING through the fake
+        skip_if_fake_pg_lacks_returning(request)
         ch = meta_client.channels()
         cid = ch.insert(Channel(0, "live", 3))
         assert cid and ch.get(cid).name == "live"

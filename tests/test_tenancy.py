@@ -1,0 +1,540 @@
+"""Multi-tenant serving plane (server/tenancy.py, docs/tenancy.md).
+
+Registry packing (lazy load, LRU evict, pins, byte budget), per-tenant
+quota isolation, and the tenant-scoped lifecycle verbs (/reload,
+/rollback, probation) through the HTTP front. Everything runs on
+FakeClock with stub engines — zero wall sleeps, no training, no device.
+"""
+
+import asyncio
+import datetime as dt
+import itertools
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from incubator_predictionio_tpu.core import EngineParams
+from incubator_predictionio_tpu.data.storage import Storage
+from incubator_predictionio_tpu.data.storage.base import EngineInstance
+from incubator_predictionio_tpu.obs.metrics import REGISTRY
+from incubator_predictionio_tpu.resilience.clock import FakeClock
+from incubator_predictionio_tpu.server import query_server as qs_mod
+from incubator_predictionio_tpu.server import tenancy as tn
+from incubator_predictionio_tpu.server.query_server import (
+    DeployedEngine,
+    ServerConfig,
+)
+from incubator_predictionio_tpu.server.tenancy import (
+    MultiTenantQueryServer,
+    TenancyError,
+    TenantBudgetError,
+    TenantRegistry,
+    TenantSpec,
+    estimate_resident_bytes,
+    load_tenant_specs,
+)
+
+UTC = dt.timezone.utc
+
+
+# ---------------------------------------------------------------------------
+# stub engine plumbing: the variant name IS the tenant tag, so every answer
+# proves which tenant's core produced it — the "never a wrong answer" oracle
+# ---------------------------------------------------------------------------
+
+class _Serving:
+    def supplement(self, q):
+        return q
+
+    def serve(self, q, preds):
+        return preds[0]
+
+
+class _Algo:
+    serving_thread_safe = True
+
+    def __init__(self, tag):
+        self.tag = tag
+
+    def query_class(self):
+        return None
+
+    def predict(self, model, query):
+        return {"tenant": self.tag, "label": 1}
+
+    def batch_predict(self, model, pairs):
+        return [(i, self.predict(model, q)) for i, q in pairs]
+
+
+class _Engine:
+    def __init__(self, algo):
+        self._algo = algo
+
+    def serving_and_algorithms(self, engine_params):
+        return [self._algo], _Serving()
+
+
+class _Blob:
+    """Array-like stand-in: exactly what the packer meters (``nbytes``)."""
+
+    def __init__(self, nbytes):
+        self.nbytes = nbytes
+
+
+def _loader(sizes, clock):
+    """Stand-in ``load_deployed_engine``: instance ids increment per load
+    so cold loads, reloads, and rollbacks are individually observable."""
+    seq = itertools.count(1)
+
+    def load(config, storage=None, ctx=None):
+        variant = config.engine_variant
+        inst = EngineInstance(
+            id=f"{variant}#{next(seq)}", status="COMPLETED",
+            start_time=dt.datetime(2024, 1, 1, tzinfo=UTC), end_time=None,
+            engine_id=variant, engine_version="1",
+            engine_variant=variant, engine_factory="stub.Engine")
+        return DeployedEngine(
+            _Engine(_Algo(variant)), EngineParams(), inst,
+            [_Blob(sizes.get(variant, 0))], warmup=False, clock=clock)
+
+    return load
+
+
+def _specs(*rows):
+    return [TenantSpec(**r) for r in rows]
+
+
+def _registry(monkeypatch, specs, clock, sizes=None, budget=None, **cfg_kw):
+    monkeypatch.setattr(tn, "load_deployed_engine",
+                        _loader(sizes or {}, clock))
+    storage = Storage({"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+    config = ServerConfig(engine_variant="unused", **cfg_kw)
+    reg = TenantRegistry(specs, config, storage=storage, clock=clock,
+                         budget_bytes=budget, limit=16)
+    return reg, storage
+
+
+def _resident(reg):
+    return sorted(t for t in reg.tenants if reg.state(t).core is not None)
+
+
+# ---------------------------------------------------------------------------
+# tenant table parsing
+# ---------------------------------------------------------------------------
+
+def test_load_tenant_specs_inline_file_aliases_and_errors(tmp_path):
+    rows = [
+        {"tenant": "a", "engineVariant": "ea.json", "quotaQps": 5,
+         "pinned": True, "residentBytes": 128},
+        {"id": "b", "variant": "eb.json"},  # accepted aliases
+    ]
+    inline = json.dumps(rows)
+    for source in (inline, str(tmp_path / "tenants.json")):
+        if not source.startswith("["):
+            (tmp_path / "tenants.json").write_text(inline)
+        specs = load_tenant_specs(source)
+        assert [s.tenant for s in specs] == ["a", "b"]
+        assert specs[0].quota_qps == 5 and specs[0].pinned
+        assert specs[0].resident_bytes == 128
+        assert specs[1].engine_variant == "eb.json" and not specs[1].pinned
+
+    with pytest.raises(TenancyError, match="duplicate"):
+        load_tenant_specs(json.dumps([rows[0], rows[0]]))
+    with pytest.raises(TenancyError, match="non-empty"):
+        load_tenant_specs("[]")
+    with pytest.raises(TenancyError, match="engineVariant"):
+        load_tenant_specs('[{"tenant": "x"}]')
+    with pytest.raises(TenancyError, match="not valid JSON"):
+        load_tenant_specs("[oops")
+
+
+def test_registry_enforces_tenant_cardinality_cap():
+    specs = _specs({"tenant": "a", "engine_variant": "a"},
+                   {"tenant": "b", "engine_variant": "b"},
+                   {"tenant": "c", "engine_variant": "c"})
+    with pytest.raises(TenancyError, match="PIO_TENANT_MAX"):
+        TenantRegistry(specs, ServerConfig(engine_variant="u"), limit=2)
+
+
+def test_estimate_resident_bytes_walks_models():
+    class _Deployed:
+        models = [{"w": _Blob(100), "b": _Blob(28)}, [_Blob(72)]]
+
+    assert estimate_resident_bytes(_Deployed()) == 200
+    assert estimate_resident_bytes(type("E", (), {"models": []})()) == 0
+
+
+# ---------------------------------------------------------------------------
+# packing: lazy load + LRU eviction under a byte budget
+# ---------------------------------------------------------------------------
+
+def test_lazy_load_and_lru_eviction_under_budget(monkeypatch):
+    """Three 600-byte tenants under a 1200-byte budget: the registry can
+    never hold all three — it lazily loads on first touch, evicts the
+    least-recently-used to make room, and a re-touch of an evicted tenant
+    cold-loads it back (counted) with the RIGHT engine every time."""
+    clock = FakeClock()
+    specs = _specs(
+        {"tenant": "a", "engine_variant": "a", "resident_bytes": 600},
+        {"tenant": "b", "engine_variant": "b", "resident_bytes": 600},
+        {"tenant": "c", "engine_variant": "c", "resident_bytes": 600})
+    reg, storage = _registry(monkeypatch, specs, clock, budget=1200)
+
+    async def t():
+        assert _resident(reg) == []  # lazy: nothing loads at construction
+        core_a = await reg.core_for("a")
+        assert core_a.deployed.instance.engine_variant == "a"
+        clock.advance(1)
+        await reg.core_for("b")
+        assert _resident(reg) == ["a", "b"]
+        assert reg.resident_total() == 1200
+
+        clock.advance(1)
+        core_c = await reg.core_for("c")  # no room: LRU (a) must go
+        assert core_c.deployed.instance.engine_variant == "c"
+        assert _resident(reg) == ["b", "c"]
+        st_a = reg.state("a")
+        assert st_a.evictions == 1 and st_a.cold_loads == 1
+
+        clock.advance(1)
+        core_a2 = await reg.core_for("a")  # evicts b (now the LRU)
+        assert core_a2.deployed.instance.engine_variant == "a"
+        assert core_a2 is not core_a  # a genuinely reloaded core
+        assert _resident(reg) == ["a", "c"]
+        assert st_a.cold_loads == 2
+        assert reg.state("b").evictions == 1
+
+        # a hot re-touch is free: same core object, no extra cold load
+        assert await reg.core_for("a") is core_a2
+        assert st_a.cold_loads == 2
+        await reg.evict_all()
+
+    asyncio.run(t())
+    storage.close()
+
+
+def test_pinned_tenants_survive_packing_and_exhaustion_is_503_shaped(
+        monkeypatch):
+    clock = FakeClock()
+    specs = _specs(
+        {"tenant": "pin", "engine_variant": "pin", "resident_bytes": 600,
+         "pinned": True},
+        {"tenant": "b", "engine_variant": "b", "resident_bytes": 600},
+        {"tenant": "c", "engine_variant": "c", "resident_bytes": 600})
+    reg, storage = _registry(monkeypatch, specs, clock, budget=1200)
+
+    async def t():
+        await reg.core_for("pin")
+        clock.advance(1)
+        await reg.core_for("b")
+        clock.advance(1)
+        # pin is the LRU, but pinned: the packer must take b instead
+        await reg.core_for("c")
+        assert _resident(reg) == ["c", "pin"]
+        assert reg.state("pin").evictions == 0
+        assert reg.state("b").evictions == 1
+
+        # shrink the budget so c cannot return once evicted: with only the
+        # pinned tenant resident there is no victim — a TenantBudgetError
+        # (the front answers it as 503 + Retry-After, never a wrong answer)
+        await reg._evict(reg.state("c"))
+        reg.budget_bytes = 600
+        with pytest.raises(TenantBudgetError, match="pinned"):
+            await reg.core_for("c")
+        assert reg.state("c").core is None
+        await reg.evict_all()
+
+    asyncio.run(t())
+    storage.close()
+
+
+def test_lone_overbudget_tenant_admitted_alone(monkeypatch):
+    """A tenant bigger than the whole budget still serves (escape hatch):
+    admitted alone, and the post-load reconcile must not throw it out."""
+    clock = FakeClock()
+    specs = _specs(
+        {"tenant": "whale", "engine_variant": "whale"},
+        {"tenant": "minnow", "engine_variant": "minnow",
+         "resident_bytes": 10})
+    # whale has NO hint: measured from the model blob (500 > budget 100)
+    reg, storage = _registry(monkeypatch, specs, clock,
+                             sizes={"whale": 500}, budget=100)
+
+    async def t():
+        core = await reg.core_for("whale")
+        assert core.deployed.instance.engine_variant == "whale"
+        assert reg.state("whale").resident_bytes == 500  # measured, kept
+        clock.advance(1)
+        # the next tenant evicts the whale and fits normally
+        await reg.core_for("minnow")
+        assert _resident(reg) == ["minnow"]
+        assert reg.state("whale").evictions == 1
+        await reg.evict_all()
+
+    asyncio.run(t())
+    storage.close()
+
+
+def test_single_flight_cold_load(monkeypatch):
+    """Concurrent first touches of one cold tenant share ONE load."""
+    clock = FakeClock()
+    specs = _specs({"tenant": "a", "engine_variant": "a"})
+    reg, storage = _registry(monkeypatch, specs, clock)
+
+    async def t():
+        cores = await asyncio.gather(*(reg.core_for("a") for _ in range(8)))
+        assert all(c is cores[0] for c in cores)
+        assert reg.state("a").cold_loads == 1
+        await reg.evict_all()
+
+    asyncio.run(t())
+    storage.close()
+
+
+# ---------------------------------------------------------------------------
+# quotas: per-tenant buckets, isolation by construction
+# ---------------------------------------------------------------------------
+
+def test_quota_isolation_between_tenants(monkeypatch):
+    clock = FakeClock()
+    specs = _specs(
+        {"tenant": "noisy", "engine_variant": "noisy", "quota_qps": 1.0,
+         "quota_burst": 2.0},
+        {"tenant": "victim", "engine_variant": "victim"})  # no quota
+    reg, storage = _registry(monkeypatch, specs, clock)
+
+    # noisy burns its burst, then only sees orderly Retry-After answers
+    assert reg.admit("noisy") is None
+    assert reg.admit("noisy") is None
+    ra = reg.admit("noisy")
+    assert isinstance(ra, int) and ra >= 1
+    assert reg.state("noisy").throttled == 1
+
+    # the victim's door never felt it — different bucket, zero throttles
+    for _ in range(50):
+        assert reg.admit("victim") is None
+    assert reg.state("victim").throttled == 0
+
+    # tokens return with time, not with retries
+    clock.advance(1.0)
+    assert reg.admit("noisy") is None
+    storage.close()
+
+
+def test_quota_env_default_applies_when_spec_silent(monkeypatch):
+    monkeypatch.setenv("PIO_TENANT_QUOTA_QPS", "2")
+    monkeypatch.setenv("PIO_TENANT_QUOTA_BURST", "2")
+    clock = FakeClock()
+    specs = _specs({"tenant": "a", "engine_variant": "a"})
+    reg, storage = _registry(monkeypatch, specs, clock)
+    st = reg.state("a")
+    assert st.bucket is not None
+    assert st.bucket.rate == 2.0 and st.bucket.burst == 2.0
+    assert reg.admit("a") is None and reg.admit("a") is None
+    assert reg.admit("a") >= 1
+    storage.close()
+
+
+# ---------------------------------------------------------------------------
+# the HTTP front: routing, quota answers, tenant-scoped lifecycle
+# ---------------------------------------------------------------------------
+
+def _run_front(monkeypatch, specs, clock, coro_fn, sizes=None, budget=None,
+               **cfg_kw):
+    loader = _loader(sizes or {}, clock)
+    monkeypatch.setattr(tn, "load_deployed_engine", loader)
+    # /reload goes through the core's own module-global loader
+    monkeypatch.setattr(qs_mod, "load_deployed_engine", loader)
+    storage = Storage({"PIO_STORAGE_SOURCES_MEM_TYPE": "memory"})
+    config = ServerConfig(engine_variant="unused", **cfg_kw)
+
+    async def runner():
+        reg = TenantRegistry(specs, config, storage=storage, clock=clock,
+                             budget_bytes=budget, limit=16)
+        front = MultiTenantQueryServer(reg, config, clock=clock)
+        client = TestClient(TestServer(front.make_app()))
+        await client.start_server()
+        try:
+            return await coro_fn(client, front, reg)
+        finally:
+            await client.close()
+            await reg.evict_all()
+            REGISTRY.remove_collector("query_server")
+
+    try:
+        return asyncio.run(runner())
+    finally:
+        storage.close()
+
+
+def test_front_routes_by_path_header_and_single_tenant_default(monkeypatch):
+    clock = FakeClock()
+    specs = _specs({"tenant": "alpha", "engine_variant": "alpha"},
+                   {"tenant": "beta", "engine_variant": "beta"})
+
+    async def t(client, front, reg):
+        q = {"features": [1]}
+        r = await client.post("/engines/alpha/queries.json", json=q)
+        assert r.status == 200
+        assert r.headers["X-PIO-Tenant"] == "alpha"
+        assert (await r.json())["tenant"] == "alpha"
+
+        r = await client.post("/queries.json", json=q,
+                              headers={"X-PIO-Engine": "beta"})
+        assert r.status == 200
+        assert (await r.json())["tenant"] == "beta"
+
+        # bare path with no header is ambiguous with two tenants: 400
+        r = await client.post("/queries.json", json=q)
+        assert r.status == 400
+        # unknown engine: 404, with a pointer at the docs
+        r = await client.post("/engines/nope/queries.json", json=q)
+        assert r.status == 404
+        assert "unknown engine" in (await r.json())["message"]
+
+        health = await (await client.get("/health")).json()
+        dep = health["deployment"]
+        assert dep["multiTenant"] is True
+        assert sorted(dep["engines"]) == ["alpha", "beta"]
+        assert dep["resident"] == ["alpha", "beta"]
+        assert health["tenancy"]["tenants"]["alpha"]["resident"]
+
+    _run_front(monkeypatch, specs, clock, t)
+
+    # with exactly ONE registered tenant, the bare path defaults to it —
+    # a one-row table behaves like the classic single-engine server
+    solo = _specs({"tenant": "only", "engine_variant": "only"})
+
+    async def t_solo(client, front, reg):
+        r = await client.post("/queries.json", json={"features": [1]})
+        assert r.status == 200
+        assert (await r.json())["tenant"] == "only"
+
+    _run_front(monkeypatch, solo, clock, t_solo)
+
+
+def test_front_quota_429_is_orderly_and_tenant_scoped(monkeypatch):
+    clock = FakeClock()
+    specs = _specs(
+        {"tenant": "noisy", "engine_variant": "noisy", "quota_qps": 1.0,
+         "quota_burst": 2.0},
+        {"tenant": "victim", "engine_variant": "victim"})
+
+    async def t(client, front, reg):
+        q = {"features": [1]}
+        for _ in range(2):
+            r = await client.post("/engines/noisy/queries.json", json=q)
+            assert r.status == 200
+        r = await client.post("/engines/noisy/queries.json", json=q)
+        assert r.status == 429
+        assert int(r.headers["Retry-After"]) >= 1
+        assert r.headers["X-PIO-Tenant"] == "noisy"
+        assert "over quota" in (await r.json())["message"]
+
+        # the victim's traffic is untouched while noisy is in the corner
+        for _ in range(5):
+            r = await client.post("/engines/victim/queries.json", json=q)
+            assert r.status == 200
+            assert (await r.json())["tenant"] == "victim"
+
+        snap = await (await client.get("/tenants.json")).json()
+        assert snap["tenants"]["noisy"]["throttled"] == 1
+        assert snap["tenants"]["noisy"]["quota"]["fill"] < 1.0
+        assert snap["tenants"]["victim"]["throttled"] == 0
+        assert snap["tenants"]["victim"]["requests"] == 5
+
+    _run_front(monkeypatch, specs, clock, t)
+
+
+def test_front_budget_exhaustion_answers_503_with_retry_after(monkeypatch):
+    clock = FakeClock()
+    specs = _specs(
+        {"tenant": "pin", "engine_variant": "pin", "resident_bytes": 600,
+         "pinned": True},
+        {"tenant": "b", "engine_variant": "b", "resident_bytes": 600})
+
+    async def t(client, front, reg):
+        q = {"features": [1]}
+        r = await client.post("/engines/pin/queries.json", json=q)
+        assert r.status == 200
+        # b cannot fit beside the pinned resident: orderly 503, never a
+        # wrong answer from another tenant's engine
+        r = await client.post("/engines/b/queries.json", json=q)
+        assert r.status == 503
+        assert r.headers["Retry-After"] == "1"
+        assert "no room" in (await r.json())["message"]
+
+    _run_front(monkeypatch, specs, clock, t, budget=600)
+
+
+def test_front_reload_rollback_probation_are_tenant_scoped(monkeypatch):
+    clock = FakeClock()
+    specs = _specs({"tenant": "a", "engine_variant": "a"},
+                   {"tenant": "b", "engine_variant": "b"})
+
+    async def t(client, front, reg):
+        q = {"features": [1]}
+        await client.post("/engines/a/queries.json", json=q)
+        await client.post("/engines/b/queries.json", json=q)
+        core_b = reg.state("b").core
+        inst_a0 = reg.state("a").core.deployed.instance.id
+        inst_b0 = core_b.deployed.instance.id
+
+        # wrong key: the tenant admin door is still authenticated
+        r = await client.post("/engines/a/reload?accessKey=wrong")
+        assert r.status == 401
+
+        r = await client.post("/engines/a/reload?accessKey=sesame")
+        assert r.status == 200
+        inst_a1 = (await r.json())["engineInstanceId"]
+        assert inst_a1 != inst_a0
+
+        # a's swap left b COMPLETELY alone: same core object, same instance
+        assert reg.state("b").core is core_b
+        assert core_b.deployed.instance.id == inst_b0
+
+        snap = await (await client.get("/tenants.json")).json()
+        assert snap["tenants"]["a"]["instanceId"] == inst_a1
+        assert snap["tenants"]["a"]["probationActive"] is True
+        assert snap["tenants"]["b"]["probationActive"] is False
+        assert snap["tenants"]["b"]["instanceId"] == inst_b0
+
+        # b has no probation pin: its rollback door answers 409 …
+        r = await client.post("/engines/b/rollback?accessKey=sesame")
+        assert r.status == 409
+        # … while a rolls back to its pre-reload instance
+        r = await client.post("/engines/a/rollback?accessKey=sesame")
+        assert r.status == 200
+        assert (await r.json())["engineInstanceId"] == inst_a0
+        r = await client.post("/engines/a/queries.json", json=q)
+        assert (await r.json())["tenant"] == "a"
+
+        # reload again; probation expires by CLOCK, not by wall waiting
+        r = await client.post("/engines/a/reload?accessKey=sesame")
+        assert r.status == 200
+        assert reg.state("a").core._probation_active()
+        clock.advance(31.0)
+        assert not reg.state("a").core._probation_active()
+        snap = await (await client.get("/tenants.json")).json()
+        assert snap["tenants"]["a"]["probationActive"] is False
+
+    _run_front(monkeypatch, specs, clock, t, server_access_key="sesame",
+               reload_probation_sec=30.0)
+
+
+def test_front_reload_of_evicted_tenant_makes_it_resident_first(monkeypatch):
+    """Admin verbs go through the same packer as queries: reloading a
+    cold/evicted tenant cold-loads it (counted) rather than erroring."""
+    clock = FakeClock()
+    specs = _specs({"tenant": "a", "engine_variant": "a",
+                    "resident_bytes": 10})
+
+    async def t(client, front, reg):
+        assert reg.state("a").core is None
+        r = await client.post("/engines/a/reload")
+        assert r.status == 200
+        assert reg.state("a").core is not None
+        assert reg.state("a").cold_loads == 1
+
+    _run_front(monkeypatch, specs, clock, t, budget=1000)

@@ -19,9 +19,15 @@ import os
 
 import pytest
 
+from tests.fixtures.pg_capability import pg_fake_skip_reason
 from tests.fixtures.wire_capture import ReplayServer
 
 TRANSCRIPTS = os.path.join(os.path.dirname(__file__), "transcripts")
+
+# The postgres transcript is captured against (and re-captured via) the
+# fake-pg protocol server; a host whose sqlite cannot back the fake cannot
+# validate or refresh the recording either, so it gates on the same probe.
+_PG_SKIP = pg_fake_skip_reason()
 
 
 def _load(name: str) -> dict:
@@ -29,6 +35,7 @@ def _load(name: str) -> dict:
         return json.load(f)
 
 
+@pytest.mark.skipif(_PG_SKIP is not None, reason=_PG_SKIP or "")
 def test_postgres_wire_replay(monkeypatch):
     from incubator_predictionio_tpu.data.storage.postgres import (
         PostgresStorageClient,
